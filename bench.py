@@ -1,0 +1,107 @@
+"""Benchmark: synthetic HIGGS-shaped binary training on the real TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload mirrors BASELINE.md config #2 scaled to one chip + bench budget:
+HIGGS-like dense f32 (28 features), binary:logistic, hist with max_bin=256,
+depth 6.  Metric of record is training throughput in M row·rounds/s (train
+loop only — DMatrix/sketch/bin time reported separately to stderr, matching
+how gpu_hist timings are usually quoted).
+
+vs_baseline compares against an H100 xgboost `gpu_hist` estimate for the same
+workload: public gpu_hist results put HIGGS-class training at roughly
+100-130 M row·rounds/s on top-end NVIDIA parts (BASELINE.md: the reference
+repo itself publishes no absolute numbers); we use 110 M row·rounds/s.
+vs_baseline > 1.0 means faster than that estimate.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+H100_BASELINE_ROW_ROUNDS_PER_S = 110e6
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
+N_FEATURES = int(os.environ.get("BENCH_FEATURES", 28))
+N_ROUNDS = int(os.environ.get("BENCH_ROUNDS", 40))
+MAX_DEPTH = int(os.environ.get("BENCH_DEPTH", 6))
+MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 256))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_data(n: int, f: int, seed: int = 0):
+    """HIGGS-like: informative low-order interactions + noise features."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logits = (
+        1.5 * X[:, 0]
+        + X[:, 1] * X[:, 2]
+        - 0.8 * np.abs(X[:, 3])
+        + 0.5 * X[:, 4]
+        + 0.3 * rng.normal(size=n)
+    )
+    y = (logits > 0).astype(np.float32)
+    return X, y
+
+
+def main() -> None:
+    import jax
+
+    import xgboost_tpu as xtb
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} platform={dev.platform}")
+
+    X, y = make_data(N_ROWS, N_FEATURES)
+    t0 = time.perf_counter()
+    dtrain = xtb.QuantileDMatrix(X, label=y, max_bin=MAX_BIN)
+    t_data = time.perf_counter() - t0
+    log(f"QuantileDMatrix build: {t_data:.2f}s ({N_ROWS} rows x {N_FEATURES} cols)")
+
+    params = {
+        "objective": "binary:logistic",
+        "max_depth": MAX_DEPTH,
+        "max_bin": MAX_BIN,
+        "eta": 0.1,
+        "device": "tpu",
+    }
+
+    # warmup: compile all level steps (cached across rounds)
+    t0 = time.perf_counter()
+    bst = xtb.train(params, dtrain, num_boost_round=2, verbose_eval=False)
+    log(f"warmup (2 rounds + compile): {time.perf_counter() - t0:.2f}s")
+
+    t0 = time.perf_counter()
+    bst = xtb.train(params, dtrain, num_boost_round=N_ROUNDS, verbose_eval=False,
+                    xgb_model=bst)
+    train_s = time.perf_counter() - t0
+
+    # sanity: the model must actually learn
+    idx = np.random.default_rng(1).choice(N_ROWS, size=min(200_000, N_ROWS), replace=False)
+    from xgboost_tpu.metric import auc as _auc
+
+    preds = bst.predict(xtb.DMatrix(X[idx]))
+    auc_v = _auc(preds, y[idx])
+    log(f"train: {train_s:.2f}s for {N_ROUNDS} rounds; sample AUC={auc_v:.4f}")
+    assert auc_v > 0.75, f"model failed to learn (AUC={auc_v})"
+
+    throughput = N_ROWS * N_ROUNDS / train_s
+    result = {
+        "metric": f"synthetic-HIGGS {N_ROWS // 10**6}Mx{N_FEATURES} "
+                  f"binary:logistic depth{MAX_DEPTH} train throughput",
+        "value": round(throughput / 1e6, 3),
+        "unit": "Mrow_rounds/s",
+        "vs_baseline": round(throughput / H100_BASELINE_ROW_ROUNDS_PER_S, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

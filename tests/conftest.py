@@ -1,0 +1,35 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh
+(SURVEY §4: single-process multi-device harness via
+--xla_force_host_platform_device_count, mirroring the reference's in-process
+multi-worker tests, tests/cpp/collective/test_worker.h:155).
+
+The ambient environment registers the tunneled single TPU chip as platform
+"axon" via sitecustomize (which imports jax at interpreter startup, freezing
+JAX_PLATFORMS=axon into jax.config before this file runs).  Tests must never
+touch the tunnel — initializing it can wedge the relay for the whole session —
+so we force the platform through jax.config.update, which works post-import.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    # must be in the environment before the CPU backend initializes
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs

@@ -1,0 +1,168 @@
+"""End-to-end train/predict behavior (reference: tests/python/test_basic.py)."""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.testing.data import make_binary, make_multiclass, make_regression
+
+
+def test_binary_training_improves():
+    X, y = make_binary(600, 8, seed=0)
+    dtrain = xtb.DMatrix(X, label=y)
+    res = {}
+    bst = xtb.train(
+        {"objective": "binary:logistic", "max_depth": 3, "eta": 0.5},
+        dtrain, num_boost_round=20, evals=[(dtrain, "train")],
+        evals_result=res, verbose_eval=False,
+    )
+    ll = res["train"]["logloss"]
+    assert ll[-1] < ll[0] * 0.5
+    p = bst.predict(dtrain)
+    assert p.shape == (600,)
+    assert 0 <= p.min() and p.max() <= 1
+    acc = ((p > 0.5) == y).mean()
+    assert acc > 0.9
+
+
+def test_regression_rmse():
+    X, y = make_regression(800, 10, seed=1)
+    dtrain = xtb.DMatrix(X, label=y)
+    res = {}
+    xtb.train({"objective": "reg:squarederror", "max_depth": 4}, dtrain,
+              num_boost_round=30, evals=[(dtrain, "train")], evals_result=res,
+              verbose_eval=False)
+    assert res["train"]["rmse"][-1] < 0.5 * np.std(y)
+
+
+def test_multiclass_softprob():
+    X, y = make_multiclass(600, 8, k=4, seed=2)
+    dtrain = xtb.DMatrix(X, label=y)
+    bst = xtb.train(
+        {"objective": "multi:softprob", "num_class": 4, "max_depth": 3},
+        dtrain, num_boost_round=10, verbose_eval=False,
+    )
+    p = bst.predict(dtrain)
+    assert p.shape == (600, 4)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+    assert (np.argmax(p, axis=1) == y).mean() > 0.85
+    # softmax returns class ids
+    bst2 = xtb.train(
+        {"objective": "multi:softmax", "num_class": 4, "max_depth": 3},
+        dtrain, num_boost_round=10, verbose_eval=False,
+    )
+    cls = bst2.predict(dtrain)
+    assert cls.shape == (600,)
+    assert set(np.unique(cls)).issubset({0.0, 1.0, 2.0, 3.0})
+
+
+def test_deterministic_across_runs():
+    X, y = make_binary(400, 6, seed=3)
+    dtrain = xtb.DMatrix(X, label=y)
+    params = {"objective": "binary:logistic", "max_depth": 4, "seed": 7,
+              "subsample": 0.8, "colsample_bytree": 0.8}
+    p1 = xtb.train(params, dtrain, 5, verbose_eval=False).predict(dtrain)
+    dtrain2 = xtb.DMatrix(X, label=y)
+    p2 = xtb.train(params, dtrain2, 5, verbose_eval=False).predict(dtrain2)
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_eval_on_holdout_and_early_stopping():
+    X, y = make_binary(800, 8, seed=4)
+    dtrain = xtb.DMatrix(X[:600], label=y[:600])
+    dvalid = xtb.DMatrix(X[600:], label=y[600:])
+    res = {}
+    bst = xtb.train(
+        {"objective": "binary:logistic", "max_depth": 2, "eta": 0.5},
+        dtrain, num_boost_round=60,
+        evals=[(dtrain, "train"), (dvalid, "valid")],
+        early_stopping_rounds=5, evals_result=res, verbose_eval=False,
+    )
+    assert bst.best_iteration is not None
+    assert bst.num_boosted_rounds() < 60  # stopped early
+
+
+def test_base_margin_and_weights():
+    X, y = make_regression(300, 5, seed=5)
+    w = np.abs(np.random.default_rng(0).normal(size=300)).astype(np.float32)
+    d = xtb.DMatrix(X, label=y, weight=w)
+    bst = xtb.train({"objective": "reg:squarederror"}, d, 5, verbose_eval=False)
+    p = bst.predict(d)
+    assert np.isfinite(p).all()
+    # output_margin == raw sums
+    m = bst.predict(d, output_margin=True)
+    np.testing.assert_allclose(p, m, rtol=1e-6)
+
+
+def test_missing_values_dense():
+    X, y = make_binary(500, 6, seed=6)
+    X[np.random.default_rng(1).random(X.shape) < 0.3] = np.nan
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3}, d, 10,
+                    verbose_eval=False)
+    p = bst.predict(d)
+    assert np.isfinite(p).all()
+    assert ((p > 0.5) == y).mean() > 0.7
+
+
+def test_csr_input():
+    from xgboost_tpu.testing.data import make_sparse_csr
+
+    M, y = make_sparse_csr(400, 15, density=0.3, seed=0)
+    d = xtb.DMatrix(M, label=y)
+    assert d.num_row() == 400 and d.num_col() == 15
+    bst = xtb.train({"objective": "reg:squarederror", "max_depth": 3}, d, 10,
+                    verbose_eval=False)
+    p = bst.predict(d)
+    assert np.isfinite(p).all()
+
+
+def test_pandas_input():
+    import pandas as pd
+
+    X, y = make_regression(200, 4, seed=8)
+    df = pd.DataFrame(X, columns=[f"col{i}" for i in range(4)])
+    d = xtb.DMatrix(df, label=y)
+    assert d.feature_names == ["col0", "col1", "col2", "col3"]
+    bst = xtb.train({"objective": "reg:squarederror"}, d, 5, verbose_eval=False)
+    assert np.isfinite(bst.predict(d)).all()
+
+
+def test_pred_leaf_shape():
+    X, y = make_binary(300, 5, seed=9)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3}, d, 4,
+                    verbose_eval=False)
+    leaves = bst.predict(d, pred_leaf=True)
+    assert leaves.shape == (300, 4)
+    assert leaves.dtype.kind in "iu" or leaves.dtype == np.int32
+
+
+def test_iteration_range_and_slice():
+    X, y = make_regression(300, 6, seed=10)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "reg:squarederror", "eta": 0.3}, d, 10,
+                    verbose_eval=False)
+    p5 = bst.predict(d, iteration_range=(0, 5))
+    sliced = bst[0:5]
+    np.testing.assert_allclose(sliced.predict(d), p5, rtol=1e-5)
+
+
+def test_custom_objective():
+    X, y = make_regression(300, 5, seed=11)
+    d = xtb.DMatrix(X, label=y)
+
+    def sq_obj(preds, dtrain):
+        return preds - dtrain.get_label(), np.ones_like(preds)
+
+    res = {}
+    xtb.train({"objective": "reg:squarederror", "base_score": 0.0}, d, 10, obj=sq_obj,
+              evals=[(d, "train")], evals_result=res, verbose_eval=False)
+    assert res["train"]["rmse"][-1] < res["train"]["rmse"][0]
+
+
+def test_cv_runs():
+    X, y = make_binary(300, 5, seed=12)
+    d = xtb.DMatrix(X, label=y)
+    out = xtb.cv({"objective": "binary:logistic", "max_depth": 2}, d,
+                 num_boost_round=5, nfold=3, as_pandas=False, verbose_eval=False)
+    assert len(out["test-logloss-mean"]) == 5

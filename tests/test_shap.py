@@ -1,0 +1,96 @@
+"""TreeSHAP correctness: local accuracy + brute-force Shapley parity
+(reference: tests/cpp/predictor test coverage of PredictContribution)."""
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.testing.data import make_regression
+
+
+def _expectation(tree, x, S):
+    """E[f(x) | x_S] with path-dependent (cover-weighted) expectations."""
+    t_left, t_right = tree.left_children, tree.right_children
+    feat, thr, dl = tree.split_indices, tree.split_conditions, tree.default_left
+    cover = np.maximum(tree.sum_hessian, 1e-16)
+
+    def rec(n):
+        if t_left[n] < 0:
+            return tree.split_conditions[n]
+        f = feat[n]
+        if f in S:
+            go_left = dl[n] if np.isnan(x[f]) else x[f] < thr[n]
+            return rec(t_left[n] if go_left else t_right[n])
+        l, r = t_left[n], t_right[n]
+        w = cover[l] + cover[r]
+        return (cover[l] * rec(l) + cover[r] * rec(r)) / w
+
+    return rec(0)
+
+
+def _brute_shapley(tree, x, n_features):
+    used = sorted(set(tree.split_indices[tree.left_children >= 0].tolist()))
+    phi = np.zeros(n_features + 1)
+    M = len(used)
+    for i in used:
+        others = [f for f in used if f != i]
+        for k in range(M):
+            for S in itertools.combinations(others, k):
+                w = math.factorial(len(S)) * math.factorial(M - len(S) - 1) / math.factorial(M)
+                phi[i] += w * (_expectation(tree, x, set(S) | {i}) - _expectation(tree, x, set(S)))
+    phi[n_features] = _expectation(tree, x, set())
+    return phi
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    X, y = make_regression(300, 5, seed=21)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "reg:squarederror", "max_depth": 3, "base_score": 0.0},
+                    d, 3, verbose_eval=False)
+    return bst, d, X
+
+
+def test_shap_local_accuracy(small_model):
+    bst, d, X = small_model
+    contribs = bst.predict(d, pred_contribs=True)
+    margin = bst.predict(d, output_margin=True)
+    np.testing.assert_allclose(contribs.sum(axis=1), margin, rtol=1e-4, atol=1e-5)
+
+
+def test_shap_matches_brute_force(small_model):
+    bst, d, X = small_model
+    from xgboost_tpu.interpret import shap_values_tree
+
+    tree = bst.trees[0]
+    rows = X[:5].astype(np.float64)
+    fast = shap_values_tree(tree, rows)
+    for r in range(5):
+        brute = _brute_shapley(tree, rows[r], X.shape[1])
+        np.testing.assert_allclose(fast[r], brute, rtol=1e-6, atol=1e-8)
+
+
+def test_saabas_local_accuracy(small_model):
+    bst, d, X = small_model
+    contribs = bst.predict(d, pred_contribs=True, approx_contribs=True)
+    margin = bst.predict(d, output_margin=True)
+    np.testing.assert_allclose(contribs.sum(axis=1), margin, rtol=1e-4, atol=1e-5)
+
+
+def test_shap_missing_values(small_model):
+    bst, _, X = small_model
+    Xm = X[:20].copy()
+    Xm[np.random.default_rng(0).random(Xm.shape) < 0.4] = np.nan
+    d = xtb.DMatrix(Xm)
+    contribs = bst.predict(d, pred_contribs=True)
+    margin = bst.predict(d, output_margin=True)
+    np.testing.assert_allclose(contribs.sum(axis=1), margin, rtol=1e-4, atol=1e-5)
+
+
+def test_interactions_sum_to_shap(small_model):
+    bst, d, X = small_model
+    inter = bst.predict(d.slice(range(8)), pred_interactions=True)
+    contribs = bst.predict(d.slice(range(8)), pred_contribs=True)
+    np.testing.assert_allclose(inter.sum(axis=2), contribs, rtol=1e-4, atol=1e-5)

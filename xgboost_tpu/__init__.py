@@ -1,0 +1,61 @@
+"""xgboost_tpu: a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch re-design of dmlc/xgboost for TPU hardware: quantile binning,
+histogram construction, split evaluation, and row partitioning run as XLA/MXU
+array programs (Pallas kernels on the hot path) over device-resident Ellpack
+pages; distributed training is jax.lax.psum over a jax.sharding.Mesh in place
+of NCCL/rabit allreduce.  The public API mirrors the reference Python package
+(python-package/xgboost): DMatrix/QuantileDMatrix, train/cv, Booster,
+sklearn wrappers, callbacks.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .config import config_context, get_config, set_config
+from .core import Booster
+from .data.dmatrix import DMatrix, MetaInfo, QuantileDMatrix
+from .data.ellpack import EllpackPage
+from .data.quantile import HistogramCuts
+from .training import cv, train
+from .callback import (
+    EarlyStopping,
+    EvaluationMonitor,
+    LearningRateScheduler,
+    TrainingCallback,
+    TrainingCheckPoint,
+)
+
+__all__ = [
+    "Booster",
+    "DMatrix",
+    "QuantileDMatrix",
+    "MetaInfo",
+    "EllpackPage",
+    "HistogramCuts",
+    "train",
+    "cv",
+    "config_context",
+    "set_config",
+    "get_config",
+    "TrainingCallback",
+    "EarlyStopping",
+    "EvaluationMonitor",
+    "LearningRateScheduler",
+    "TrainingCheckPoint",
+    "XGBModel",
+    "XGBClassifier",
+    "XGBRegressor",
+    "XGBRanker",
+    "XGBRFClassifier",
+    "XGBRFRegressor",
+]
+
+
+def __getattr__(name):  # lazy sklearn wrappers (heavy import)
+    if name in ("XGBModel", "XGBClassifier", "XGBRegressor", "XGBRanker",
+                "XGBRFClassifier", "XGBRFRegressor"):
+        from . import sklearn as _sk
+
+        return getattr(_sk, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,83 @@
+"""Execution context: device parsing and selection.
+
+TPU-native analogue of the reference's ``Context``/``DeviceOrd``
+(include/xgboost/context.h:40, src/context.cc:105-155).  The reference parses
+``device="cpu"|"cuda[:N]"|"gpu"|"sycl:*"``; here the accelerator is
+``device="tpu[:N]"`` and compute is dispatched through JAX, so "device" selects
+a ``jax.Device`` rather than a code path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+_DEVICE_RE = re.compile(r"^(cpu|tpu|gpu|cuda)(:(\d+))?$")
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceOrd:
+    """A parsed device: ``type`` is 'cpu' or 'tpu', ``ordinal`` indexes jax.devices().
+
+    Mirrors DeviceOrd (include/xgboost/context.h:40); 'gpu'/'cuda' are accepted
+    and mapped to the accelerator ('tpu') for drop-in compatibility.
+    """
+
+    type: str = "cpu"
+    ordinal: int = 0
+
+    @staticmethod
+    def parse(spec: str) -> "DeviceOrd":
+        spec = (spec or "cpu").strip().lower()
+        m = _DEVICE_RE.match(spec)
+        if m is None:
+            raise ValueError(
+                f"Invalid device spec: {spec!r}. Expected 'cpu', 'tpu', or 'tpu:<ordinal>'."
+            )
+        kind = m.group(1)
+        if kind in ("gpu", "cuda"):  # accept reference spellings; run on the accelerator
+            kind = "tpu"
+        ordinal = int(m.group(3) or 0)
+        return DeviceOrd(kind, ordinal)
+
+    @property
+    def is_accelerator(self) -> bool:
+        return self.type == "tpu"
+
+    def jax_device(self):
+        """Resolve to a concrete jax.Device, falling back to the default backend."""
+        import jax
+
+        if self.type == "tpu":
+            for plat in ("tpu", "axon"):
+                try:
+                    devs = jax.devices(plat)
+                except RuntimeError:
+                    continue
+                if devs:
+                    return devs[self.ordinal % len(devs)]
+            return jax.devices()[0]
+        try:
+            return jax.devices("cpu")[self.ordinal % len(jax.devices("cpu"))]
+        except RuntimeError:
+            return jax.devices()[0]
+
+
+@dataclasses.dataclass
+class Context:
+    """Runtime context threaded through training (reference: include/xgboost/context.h).
+
+    nthread/seed mirror the reference Context fields; device selects where
+    jitted kernels place their arrays.
+    """
+
+    device: DeviceOrd = dataclasses.field(default_factory=DeviceOrd)
+    nthread: int = 0
+    seed: int = 0
+
+    @staticmethod
+    def create(device: str = "cpu", nthread: int = 0, seed: int = 0) -> "Context":
+        return Context(device=DeviceOrd.parse(device), nthread=nthread, seed=seed)
+
+    def jax_device(self):
+        return self.device.jax_device()

@@ -1,0 +1,677 @@
+"""Booster: the trained model + training-step engine.
+
+TPU-native analogue of the reference Learner + GBTree + Python Booster
+(src/learner.cc:1030 LearnerImpl, src/gbm/gbtree.cc:225 DoBoost,
+python-package/xgboost/core.py:1749 Booster).  One object plays all three
+roles: it owns the objective, the tree list, per-DMatrix training caches
+(binned Ellpack + margin cache — the prediction cache of
+include/xgboost/cache.h:26), and the save/load surface.
+
+Call stack for one boosting iteration (mirrors SURVEY §3.1):
+  train() -> Booster.update(dtrain, i)
+    -> objective.get_gradient on the cached margin           [device]
+    -> HistTreeGrower.grow per output group                  [device loop]
+    -> leaf_margin_delta updates the margin cache            [device]
+    -> RegTree.from_grown appends the host model
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .context import Context
+from .data.dmatrix import DMatrix
+from .metric import create_metric
+from .models.tree import RegTree
+from .objective import ObjFunction, create_objective
+from .ops.predict import predict_leaf_ids, predict_margin_delta
+from .ops.split import SplitParams
+from .params import TrainParam, canonicalize, split_unknown
+from .tree.grow import HistTreeGrower, leaf_margin_delta
+
+__all__ = ["Booster"]
+
+
+class _Cache:
+    """Per-DMatrix training cache: margin (+ binned Ellpack for training).
+
+    Eval-only DMatrices never pay for sketching/binning: the Ellpack is built
+    lazily on first training touch (finding: eval sets only need the raw
+    feature matrix for the predictor)."""
+
+    def __init__(self, dmat: DMatrix, max_bin: int, ref: Optional[DMatrix] = None):
+        self.dmat = dmat
+        self.max_bin = max_bin
+        self.ref = ref
+        self.ellpack = None
+        self.n_padded = dmat.num_row()  # grows to the padded size on ensure_train
+        self.margin: Optional[Any] = None  # (n_padded, K) device
+        self.n_trees_applied = 0
+        self.raw_X: Optional[Any] = None  # lazily staged raw matrix for eval predict
+
+    def ensure_train(self) -> None:
+        """Build the binned page + padded label/weight/valid device arrays."""
+        import jax.numpy as jnp
+
+        if self.ellpack is not None:
+            return
+        self.ellpack = self.dmat.ensure_ellpack(max_bin=self.max_bin, ref=self.ref)
+        R_pad = self.ellpack.n_padded
+        R = self.ellpack.n_rows
+        self.valid = jnp.arange(R_pad) < R
+        lab = self.dmat.get_label()
+        pad = ((0, R_pad - R),) + tuple((0, 0) for _ in range(lab.ndim - 1))
+        self.labels = jnp.asarray(np.pad(lab, pad))
+        w = self.dmat.get_weight()
+        self.weights = None if w is None else jnp.asarray(np.pad(w, (0, R_pad - R)))
+        if self.margin is not None and self.margin.shape[0] != R_pad:
+            extra = R_pad - self.margin.shape[0]
+            self.margin = jnp.concatenate(
+                [self.margin, jnp.zeros((extra, self.margin.shape[1]), jnp.float32)], axis=0
+            )
+        self.n_padded = R_pad
+
+    def base_margin_init(self, base_score, K: int):
+        import jax.numpy as jnp
+
+        R_pad = self.n_padded
+        user = self.dmat.info.base_margin
+        if user is not None:
+            m = np.asarray(user, np.float32).reshape(len(user), -1)
+            if m.shape[1] != K:
+                m = np.broadcast_to(m, (m.shape[0], K))
+            out = np.zeros((R_pad, K), np.float32)
+            out[: m.shape[0]] = m
+            return jnp.asarray(out)
+        base = np.broadcast_to(np.asarray(base_score, np.float32).reshape(-1), (K,))
+        return jnp.broadcast_to(jnp.asarray(base), (R_pad, K)).astype(jnp.float32)
+
+
+class Booster:
+    """Gradient-boosted tree model (reference: core.py:1749, learner.cc:1030)."""
+
+    def __init__(
+        self,
+        params: Optional[Dict[str, Any]] = None,
+        cache: Sequence[DMatrix] = (),
+        model_file: Optional[str] = None,
+    ) -> None:
+        self.params: Dict[str, Any] = canonicalize(dict(params or {}))
+        self.trees: List[RegTree] = []
+        self.tree_info: List[int] = []  # group id per tree
+        self.attributes: Dict[str, str] = {}
+        self.feature_names: Optional[List[str]] = None
+        self.feature_types: Optional[List[str]] = None
+        self._caches: Dict[int, _Cache] = {}
+        self._configured = False
+        self.best_iteration: Optional[int] = None
+        self.best_score: Optional[float] = None
+        if model_file is not None:
+            self.load_model(model_file)
+        for d in cache:
+            self._get_cache(d)
+
+    # ------------------------------------------------------------------ config
+    def _configure(self) -> None:
+        """Lazy config (reference: learner.cc:521 Configure on every call)."""
+        if self._configured:
+            return
+        p = self.params
+        unknown = split_unknown(p)
+        if unknown and str(p.get("validate_parameters", "")).lower() in ("1", "true"):
+            raise ValueError(f"Unknown parameters: {unknown}")
+        self.tparam = TrainParam.from_dict(p)
+        self.context = Context.create(str(p.get("device", "cpu")), seed=int(p.get("seed", 0)))
+        obj_name = str(p.get("objective", "reg:squarederror"))
+        self.objective: ObjFunction = create_objective(obj_name, p)
+        self.num_class = int(p.get("num_class", 0))
+        self.n_groups = max(1, self.objective.n_groups())
+        self._base_score_param = p.get("base_score", None)
+        if not hasattr(self, "_base_margin_value"):
+            self._base_margin_value: Optional[np.ndarray] = None
+        booster = str(p.get("booster", "gbtree"))
+        if booster not in ("gbtree", "dart", "gblinear"):
+            raise ValueError(f"unknown booster {booster}")
+        self.booster_kind = booster
+        self._split_params = SplitParams(
+            eta=float(self.tparam.eta),
+            gamma=float(self.tparam.gamma),
+            min_child_weight=float(self.tparam.min_child_weight),
+            lambda_=float(self.tparam.lambda_),
+            alpha=float(self.tparam.alpha),
+            max_delta_step=float(self.tparam.max_delta_step),
+        )
+        self._configured = True
+
+    # params whose change invalidates binned data / margins / objective state
+    _STRUCTURAL_KEYS = {"max_bin", "objective", "num_class", "device", "booster",
+                        "tree_method", "base_score", "num_target", "multi_strategy"}
+
+    def _invalidate_config(self, structural: bool = True):
+        self._configured = False
+        if structural:
+            self._caches.clear()
+            self._base_margin_value = None
+
+    def set_param(self, params, value=None) -> None:
+        if isinstance(params, str):
+            params = {params: value}
+        elif isinstance(params, (list, tuple)):
+            params = dict(params)
+        params = canonicalize(params)
+        structural = any(
+            k in self._STRUCTURAL_KEYS and self.params.get(k) != v
+            for k, v in params.items()
+        )
+        self.params.update(params)
+        self._invalidate_config(structural=structural)
+
+    # ------------------------------------------------------------------ caches
+    def _get_cache(self, dmat: DMatrix, ref: Optional[DMatrix] = None) -> _Cache:
+        self._configure()
+        key = id(dmat)
+        if key not in self._caches:
+            self._caches[key] = _Cache(dmat, self.tparam.max_bin, ref=ref)
+            if getattr(self, "_num_feature", None) is None:
+                self._num_feature = dmat.num_col()
+        return self._caches[key]
+
+    def _ensure_base_margin(self, cache: _Cache):
+        if self._base_margin_value is None:
+            # InitEstimation / FitStump (src/tree/fit_stump.cc:34)
+            if self._base_score_param is not None:
+                prob = np.asarray(float(self._base_score_param), np.float32)
+                bm = np.asarray(self.objective.prob_to_margin(prob))
+            elif len(self.trees) == 0 and cache.ellpack is not None:
+                R = cache.ellpack.n_rows
+                bm = np.asarray(
+                    self.objective.init_estimation(
+                        cache.labels[:R], None if cache.weights is None else cache.weights[:R]
+                    )
+                )
+            else:
+                bm = np.zeros(self.n_groups, np.float32)
+            self._base_margin_value = np.broadcast_to(
+                np.asarray(bm, np.float32).reshape(-1), (self.n_groups,)
+            ).copy()
+        if cache.margin is None:
+            cache.margin = cache.base_margin_init(self._base_margin_value, self.n_groups)
+            cache.n_trees_applied = 0
+
+    def _sync_margin(self, cache: _Cache) -> None:
+        """Catch the cached margin up to all committed trees (the prediction
+        cache semantics of include/xgboost/cache.h:26) — covers continued
+        training via xgb_model= and caches rebuilt mid-train."""
+        import jax.numpy as jnp
+
+        self._ensure_base_margin(cache)
+        if cache.n_trees_applied < len(self.trees):
+            new = slice(cache.n_trees_applied, len(self.trees))
+            if cache.raw_X is None:
+                cache.raw_X = jnp.asarray(self.dmat_host_dense(cache), jnp.float32)
+            delta = self._margin_delta_for(cache.raw_X, new)
+            pad = cache.margin.shape[0] - delta.shape[0]
+            if pad:
+                delta = jnp.concatenate(
+                    [delta, jnp.zeros((pad, delta.shape[1]), jnp.float32)], axis=0
+                )
+            cache.margin = cache.margin + delta
+            cache.n_trees_applied = len(self.trees)
+
+    @staticmethod
+    def dmat_host_dense(cache: _Cache) -> np.ndarray:
+        return cache.dmat.host_dense()
+
+    @property
+    def base_score(self) -> np.ndarray:
+        self._configure()
+        if self._base_margin_value is None:
+            return np.full(self.n_groups, 0.5, np.float32)
+        return self._base_margin_value
+
+    # ------------------------------------------------------------------ train
+    def update(self, dtrain: DMatrix, iteration: int, fobj=None) -> None:
+        """One boosting iteration (learner.cc:1108 UpdateOneIter)."""
+        import jax.numpy as jnp
+
+        self._configure()
+        cache = self._get_cache(dtrain)
+        cache.ensure_train()
+        if hasattr(self.objective, "set_group_info"):
+            gp = dtrain.info.group_ptr
+            if gp is None:
+                gp = np.array([0, dtrain.num_row()], np.int64)
+            if not hasattr(self.objective, "_gidx"):
+                self.objective.set_group_info(gp)
+        self._sync_margin(cache)
+        R = cache.ellpack.n_rows
+        if fobj is not None:
+            # custom objectives receive RAW margins (reference: Booster.update
+            # passes output_margin=True predictions to fobj, core.py:2277)
+            m = np.asarray(cache.margin[:R])
+            preds = m[:, 0] if self.n_groups == 1 else m
+            grad, hess = fobj(preds, dtrain)
+            grad = np.asarray(grad, np.float32).reshape(R, -1)
+            hess = np.asarray(hess, np.float32).reshape(R, -1)
+            K = grad.shape[1]
+            gpair = np.stack([grad, hess], axis=-1)  # (R, K, 2)
+            pad = cache.ellpack.n_padded - R
+            gpair = jnp.asarray(np.pad(gpair, ((0, pad), (0, 0), (0, 0))))
+        else:
+            gpair = self.objective.get_gradient(
+                cache.margin, cache.labels, cache.weights, iteration
+            )  # (R_pad, K, 2)
+        gpair = gpair * cache.valid[:, None, None]
+        self._boost_trees(cache, gpair, iteration)
+
+    def boost(self, dtrain: DMatrix, grad, hess, iteration: int = 0) -> None:
+        """Custom-gradient boost (reference: XGBoosterBoostOneIter)."""
+        import jax.numpy as jnp
+
+        self._configure()
+        cache = self._get_cache(dtrain)
+        cache.ensure_train()
+        self._sync_margin(cache)
+        R = cache.ellpack.n_rows
+        g = np.asarray(grad, np.float32).reshape(R, -1)
+        h = np.asarray(hess, np.float32).reshape(R, -1)
+        gpair = np.stack([g, h], axis=-1)
+        pad = cache.ellpack.n_padded - R
+        gpair = jnp.asarray(np.pad(gpair, ((0, pad), (0, 0), (0, 0))))
+        gpair = gpair * cache.valid[:, None, None]
+        self._boost_trees(cache, gpair, iteration)
+
+    def _rng(self, iteration: int, tag: int) -> np.random.Generator:
+        seed = int(self.params.get("seed", 0))
+        return np.random.default_rng((seed * 1_000_003 + iteration * 131 + tag) % (2**63))
+
+    def _feature_masks(self, iteration: int, group: int, n_features: int):
+        """ColumnSampler (reference: src/common/random.h ColumnSampler)."""
+        tp = self.tparam
+        if tp.colsample_bytree >= 1.0 and tp.colsample_bylevel >= 1.0 and tp.colsample_bynode >= 1.0:
+            return None
+        rng = self._rng(iteration, 17 + group)
+
+        def sample(prev_mask, frac, shape):
+            if frac >= 1.0:
+                return prev_mask
+            m = prev_mask & (rng.random(shape if isinstance(shape, tuple) else (shape,)) < frac)
+            # guarantee at least one feature (reference ColumnSampler resamples)
+            bad = ~m.any(axis=-1)
+            if np.any(bad):
+                choices = rng.integers(0, n_features, size=int(np.sum(bad)))
+                if m.ndim == 1:
+                    m[choices[0]] = True
+                else:
+                    m[np.nonzero(bad)[0], choices] = True
+            return m
+
+        tree_mask = sample(np.ones(n_features, bool), tp.colsample_bytree, n_features)
+
+        def per_level(depth: int, n_nodes: int):
+            import jax.numpy as jnp
+
+            m = sample(tree_mask.copy(), tp.colsample_bylevel, n_features)
+            if tp.colsample_bynode < 1.0:
+                mm = np.broadcast_to(m, (n_nodes, n_features)).copy()
+                mm = sample(mm, tp.colsample_bynode, (n_nodes, n_features))
+                return jnp.asarray(mm)
+            return jnp.asarray(m[None, :])
+
+        return per_level
+
+    def _subsample_mask(self, gpair, iteration: int):
+        """Row subsampling: zeroed gpairs drop rows from hist + leaves
+        (reference: src/tree/hist/sampler.cc uniform path)."""
+        import jax
+
+        if self.tparam.subsample >= 1.0:
+            return gpair
+        key = jax.random.PRNGKey(
+            (int(self.params.get("seed", 0)) * 7919 + iteration) % (2**31)
+        )
+        mask = jax.random.bernoulli(key, self.tparam.subsample, (gpair.shape[0],))
+        return gpair * mask[:, None, None]
+
+    def _boost_trees(self, cache: _Cache, gpair, iteration: int) -> None:
+        import jax.numpy as jnp
+
+        gpair = self._subsample_mask(gpair, iteration)
+        ell = cache.ellpack
+        grower = HistTreeGrower(
+            self.tparam.max_depth if self.tparam.max_depth > 0 else 6,
+            self._split_params,
+            hist_impl=str(self.params.get("_hist_impl", "xla")),
+        )
+        K = gpair.shape[1]
+        new_margin = cache.margin
+        fmask_fn = self._feature_masks(iteration, 0, ell.n_features)
+        for k in range(K):
+            state = grower.grow(
+                ell.bins,
+                gpair[:, k, :],
+                cache.valid,
+                ell.cuts_pad,
+                ell.n_bins,
+                feature_masks=fmask_fn,
+            )
+            delta = leaf_margin_delta(state.pos, state.leaf_val)
+            new_margin = new_margin.at[:, k].add(delta)
+            tree = RegTree.from_grown(HistTreeGrower.to_host(state))
+            self.trees.append(tree)
+            self.tree_info.append(k)
+        cache.margin = new_margin
+        cache.n_trees_applied = len(self.trees)
+
+    # ------------------------------------------------------------------ eval
+    def eval_set(self, evals: Sequence[Tuple[DMatrix, str]], iteration: int = 0,
+                 feval=None, output_margin: bool = True) -> str:
+        """(reference: learner.cc:1159 EvalOneIter)"""
+        self._configure()
+        msgs = [f"[{iteration}]"]
+        metrics = self._eval_metric_list()
+        for dmat, name in evals:
+            margin = self._eval_margin(dmat)
+            preds = np.asarray(self.objective.pred_transform(margin))
+            if self.n_groups == 1:
+                preds = preds[:, 0]
+            labels = dmat.get_label()
+            weights = dmat.get_weight()
+            for fn, mname in metrics:
+                v = fn(preds, labels, weights, group_ptr=dmat.info.group_ptr)
+                msgs.append(f"{name}-{mname}:{v:g}")
+            if feval is not None:
+                res = feval(margin if output_margin else preds, dmat)
+                res = [res] if isinstance(res, tuple) else res
+                for mname, v in res:
+                    msgs.append(f"{name}-{mname}:{v:g}")
+        return "\t".join(msgs)
+
+    def _eval_metric_list(self):
+        self._configure()
+        names = self.params.get("eval_metric", None)
+        if names is None:
+            if str(self.params.get("disable_default_eval_metric", "0")).lower() in ("1", "true"):
+                return []
+            names = [self.objective.default_metric()]
+        elif isinstance(names, str):
+            names = [names]
+        return [create_metric(n) for n in names]
+
+    def _eval_margin(self, dmat: DMatrix) -> np.ndarray:
+        """Margin for an eval/predict DMatrix using the incremental cache."""
+        import jax.numpy as jnp
+
+        cache = self._get_cache(dmat)
+        self._sync_margin(cache)
+        R = dmat.num_row()
+        return np.asarray(cache.margin[:R])
+
+    # ------------------------------------------------------------------ predict
+    def _stacked(self, tree_slice: slice):
+        trees = self.trees[tree_slice]
+        info = self.tree_info[tree_slice]
+        width = max((t.n_nodes for t in trees), default=1)
+        depth = max((t.max_depth for t in trees), default=0) + 1
+        cols = {k: [] for k in ("feat", "thr", "dleft", "left", "right", "value")}
+        for t in trees:
+            arrs = t.padded_arrays(width)
+            for k in cols:
+                cols[k].append(arrs[k])
+        import jax.numpy as jnp
+
+        stacked = {k: jnp.asarray(np.stack(v)) for k, v in cols.items()}
+        groups = jnp.asarray(np.asarray(info, np.int32))
+        return stacked, groups, depth
+
+    def _margin_delta_for(self, X_dev, tree_slice: slice):
+        stacked, groups, depth = self._stacked(tree_slice)
+        return predict_margin_delta(
+            X_dev,
+            stacked["feat"], stacked["thr"], stacked["dleft"],
+            stacked["left"], stacked["right"], stacked["value"],
+            groups, n_groups=self.n_groups, depth=depth,
+        )
+
+    def predict(
+        self,
+        data: DMatrix,
+        output_margin: bool = False,
+        pred_leaf: bool = False,
+        pred_contribs: bool = False,
+        approx_contribs: bool = False,
+        pred_interactions: bool = False,
+        validate_features: bool = True,
+        training: bool = False,
+        iteration_range: Tuple[int, int] = (0, 0),
+        strict_shape: bool = False,
+    ) -> np.ndarray:
+        """(reference: core.py:2424 Booster.predict)"""
+        import jax.numpy as jnp
+
+        self._configure()
+        X = jnp.asarray(data.host_dense(), jnp.float32)
+        lo, hi = iteration_range
+        n_rounds = self.num_boosted_rounds()
+        if hi == 0:
+            hi = n_rounds
+        if self.best_iteration is not None and iteration_range == (0, 0) and not training:
+            pass  # reference keeps all trees unless user slices
+        tree_slice = slice(lo * self.n_groups, hi * self.n_groups)
+        if pred_leaf:
+            if not self.trees[tree_slice]:
+                return np.zeros((data.num_row(), 0), np.int32)
+            stacked, groups, depth = self._stacked(tree_slice)
+            out = predict_leaf_ids(
+                X, stacked["feat"], stacked["thr"], stacked["dleft"],
+                stacked["left"], stacked["right"], depth=depth,
+            )
+            return np.asarray(out)
+        if pred_contribs or pred_interactions:
+            from .interpret import predict_contribs, predict_interactions
+
+            if pred_interactions:
+                return predict_interactions(self, data, tree_slice)
+            return predict_contribs(self, data, tree_slice, approx=approx_contribs)
+        base = np.broadcast_to(self.base_score.reshape(-1), (self.n_groups,))
+        if len(self.trees) and tree_slice.start < tree_slice.stop:
+            margin = np.asarray(self._margin_delta_for(X, tree_slice)) + base[None, :]
+        else:
+            margin = np.broadcast_to(base, (data.num_row(), self.n_groups)).copy()
+        if data.info.base_margin is not None:
+            um = np.asarray(data.info.base_margin, np.float32).reshape(data.num_row(), -1)
+            margin = margin - base[None, :] + um
+        if output_margin:
+            out = margin
+        else:
+            out = np.asarray(self.objective.pred_transform(jnp.asarray(margin)))
+        if self.n_groups == 1 and not strict_shape:
+            out = out[:, 0]
+        return out
+
+    def inplace_predict(self, data, iteration_range=(0, 0), predict_type="value",
+                        missing=np.nan, validate_features=True, base_margin=None,
+                        strict_shape=False):
+        """(reference: core.py:2561) — wraps raw arrays without a DMatrix."""
+        d = DMatrix(data, missing=missing)
+        if base_margin is not None:
+            d.set_base_margin(base_margin)
+        return self.predict(
+            d, output_margin=(predict_type == "margin"),
+            iteration_range=iteration_range, strict_shape=strict_shape,
+        )
+
+    # ------------------------------------------------------------------ model IO
+    def num_boosted_rounds(self) -> int:
+        self._configure()
+        return len(self.trees) // max(self.n_groups, 1)
+
+    def num_features(self) -> int:
+        if getattr(self, "_num_feature", None):
+            return self._num_feature
+        for c in self._caches.values():
+            return c.dmat.num_col()
+        if self.trees:
+            return int(max(t.split_indices.max(initial=0) for t in self.trees)) + 1
+        return 0
+
+    def save_model(self, fname: Union[str, os.PathLike]) -> None:
+        """JSON (``.json``) or UBJSON (``.ubj``) model file
+        (reference: learner.cc:950 SaveModel; schema doc/model.schema)."""
+        obj = self.save_raw_dict()
+        fname = os.fspath(fname)
+        if fname.endswith(".ubj"):
+            from .utils.ubjson import dump_ubjson
+
+            with open(fname, "wb") as fh:
+                dump_ubjson(obj, fh)
+        else:
+            with open(fname, "w") as fh:
+                json.dump(obj, fh)
+
+    def save_raw_dict(self) -> dict:
+        self._configure()
+        n_feat = self.num_features()
+        trees = [t.to_json_dict(n_feat) for t in self.trees]
+        base_margin = float(np.asarray(self.base_score).reshape(-1)[0])
+        base = float(np.asarray(self.objective.margin_to_prob(np.float32(base_margin))))
+        obj_conf = {"name": self.objective.name}
+        if self.objective.name.startswith("multi:"):
+            obj_conf["softmax_multiclass_param"] = {"num_class": str(self.num_class)}
+        model = {
+            "gbtree_model_param": {
+                "num_trees": str(len(self.trees)),
+                "num_parallel_tree": "1",
+            },
+            "trees": trees,
+            "tree_info": list(self.tree_info),
+        }
+        return {
+            "version": [3, 1, 0],
+            "learner": {
+                "attributes": dict(self.attributes),
+                "feature_names": self.feature_names or [],
+                "feature_types": self.feature_types or [],
+                "gradient_booster": {"model": model, "name": "gbtree"},
+                "learner_model_param": {
+                    "base_score": f"{base:.9E}",
+                    "boost_from_average": "1",
+                    "num_class": str(self.num_class),
+                    "num_feature": str(n_feat),
+                    "num_target": "1",
+                },
+                "objective": obj_conf,
+            },
+        }
+
+    def load_model(self, fname: Union[str, os.PathLike, bytes, bytearray]) -> None:
+        if isinstance(fname, (bytes, bytearray)):
+            obj = json.loads(fname)
+        else:
+            fname = os.fspath(fname)
+            if fname.endswith(".ubj"):
+                from .utils.ubjson import load_ubjson
+
+                with open(fname, "rb") as fh:
+                    obj = load_ubjson(fh)
+            else:
+                with open(fname) as fh:
+                    obj = json.load(fh)
+        self.load_model_dict(obj)
+
+    def load_model_dict(self, obj: dict) -> None:
+        learner = obj["learner"]
+        lmp = learner["learner_model_param"]
+        self.params.setdefault("objective", learner["objective"]["name"])
+        nc = int(lmp.get("num_class", "0"))
+        if nc > 0:
+            self.params["num_class"] = nc
+        self._invalidate_config()
+        self._configure()
+        base_prob = np.float32(float(lmp["base_score"]))
+        self._base_margin_value = np.broadcast_to(
+            np.asarray(self.objective.prob_to_margin(base_prob), np.float32), (self.n_groups,)
+        ).astype(np.float32).copy()
+        self._num_feature = int(lmp.get("num_feature", "0")) or None
+        gb = learner["gradient_booster"]["model"]
+        self.trees = [RegTree.from_json_dict(t) for t in gb["trees"]]
+        self.tree_info = [int(i) for i in gb["tree_info"]]
+        self.attributes = dict(learner.get("attributes", {}))
+        self.feature_names = learner.get("feature_names") or None
+        self.feature_types = learner.get("feature_types") or None
+
+    def save_raw(self, raw_format: str = "ubj") -> bytearray:
+        obj = self.save_raw_dict()
+        if raw_format == "json":
+            return bytearray(json.dumps(obj).encode())
+        from io import BytesIO
+
+        from .utils.ubjson import dump_ubjson
+
+        buf = BytesIO()
+        dump_ubjson(obj, buf)
+        return bytearray(buf.getvalue())
+
+    # attributes API (reference: core.py attr/set_attr)
+    def attr(self, key: str) -> Optional[str]:
+        return self.attributes.get(key)
+
+    def set_attr(self, **kwargs: Optional[str]) -> None:
+        for k, v in kwargs.items():
+            if v is None:
+                self.attributes.pop(k, None)
+            else:
+                self.attributes[k] = str(v)
+
+    def __getitem__(self, val: slice) -> "Booster":
+        """Tree-slice (reference: Booster.__getitem__ / Learner::Slice)."""
+        if not isinstance(val, slice):
+            raise TypeError("Booster slicing requires a slice of rounds")
+        lo = val.start or 0
+        hi = val.stop if val.stop is not None else self.num_boosted_rounds()
+        out = Booster(dict(self.params))
+        out._configure()
+        k = out.n_groups
+        out.trees = self.trees[lo * k : hi * k]
+        out.tree_info = self.tree_info[lo * k : hi * k]
+        out._base_margin_value = self._base_margin_value
+        out._num_feature = getattr(self, "_num_feature", None)
+        out.feature_names = self.feature_names
+        out.feature_types = self.feature_types
+        out.attributes = dict(self.attributes)
+        out.best_iteration = self.best_iteration
+        out.best_score = self.best_score
+        return out
+
+    def copy(self) -> "Booster":
+        return self[0 : self.num_boosted_rounds()]
+
+    def get_dump(self, fmap: str = "", with_stats: bool = False, dump_format: str = "text"):
+        if dump_format == "json":
+            return [json.dumps(t.to_json_dict(self.num_features())) for t in self.trees]
+        return [t.dump_text(self.feature_names, with_stats) for t in self.trees]
+
+    def get_score(self, fmap: str = "", importance_type: str = "weight") -> Dict[str, float]:
+        """Feature importance (reference: core.py get_score)."""
+        self._configure()
+        names = self.feature_names or [f"f{i}" for i in range(self.num_features())]
+        acc: Dict[str, float] = {}
+        cnt: Dict[str, int] = {}
+        for t in self.trees:
+            for nid in range(t.n_nodes):
+                if t.left_children[nid] == -1:
+                    continue
+                f = names[t.split_indices[nid]]
+                cnt[f] = cnt.get(f, 0) + 1
+                if importance_type in ("gain", "total_gain"):
+                    acc[f] = acc.get(f, 0.0) + float(t.loss_changes[nid])
+                elif importance_type in ("cover", "total_cover"):
+                    acc[f] = acc.get(f, 0.0) + float(t.sum_hessian[nid])
+                else:
+                    acc[f] = acc.get(f, 0.0) + 1.0
+        if importance_type in ("gain", "cover"):
+            return {k: v / cnt[k] for k, v in acc.items()}
+        return acc

@@ -1,0 +1,267 @@
+"""DMatrix family: user-facing data containers.
+
+TPU-native re-design of the reference DMatrix (include/xgboost/data.h:549,
+MetaInfo data.h:65, SimpleDMatrix src/data/simple_dmatrix.h:20, QuantileDMatrix
+src/data/iterative_dmatrix.h:34).  The reference keeps CSR pages and converts
+to Ellpack/GHist lazily per tree method; here the canonical compute format IS
+the Ellpack page (a dense jax.Array of bin indices), built lazily on first
+training touch or eagerly by QuantileDMatrix.  ``ref=`` sharing of cuts between
+train and validation mirrors GetCutsFromRef (src/data/quantile_dmatrix.cc:19).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .ellpack import EllpackPage, build_ellpack, build_ellpack_csr
+from .quantile import HistogramCuts, sketch_csr, sketch_dense
+
+
+@dataclasses.dataclass
+class MetaInfo:
+    """Labels and auxiliary per-row/per-feature metadata (reference: data.h:65-116)."""
+
+    num_row: int = 0
+    num_col: int = 0
+    label: Optional[np.ndarray] = None
+    weight: Optional[np.ndarray] = None
+    base_margin: Optional[np.ndarray] = None
+    group_ptr: Optional[np.ndarray] = None  # ranking query groups (CSR ptr)
+    label_lower_bound: Optional[np.ndarray] = None  # survival
+    label_upper_bound: Optional[np.ndarray] = None
+    feature_names: Optional[List[str]] = None
+    feature_types: Optional[List[str]] = None
+    feature_weights: Optional[np.ndarray] = None
+
+    def validate(self) -> None:
+        for name in ("label", "weight", "base_margin"):
+            arr = getattr(self, name)
+            if arr is not None and arr.shape[0] != self.num_row:
+                raise ValueError(
+                    f"{name} has {arr.shape[0]} rows, expected {self.num_row}"
+                )
+        if self.group_ptr is not None and self.group_ptr[-1] != self.num_row:
+            raise ValueError("group sizes must sum to num_row")
+
+
+def _to_numpy_2d(data: Any, missing: float = np.nan):
+    """Dispatch user input -> (dense ndarray | csr triple, feature names/types).
+
+    Mirrors the adapter dispatch of the reference (src/data/adapter.h,
+    python-package/xgboost/data.py): numpy, pandas, scipy CSR/CSC, list.
+    """
+    feature_names = None
+    feature_types = None
+    # pandas
+    if hasattr(data, "iloc") and hasattr(data, "columns"):
+        feature_names = [str(c) for c in data.columns]
+        feature_types = []
+        cols = []
+        for c in data.columns:
+            col = data[c]
+            if str(col.dtype) == "category":
+                cols.append(col.cat.codes.to_numpy().astype(np.float32))
+                feature_types.append("c")
+            else:
+                cols.append(col.to_numpy().astype(np.float32))
+                feature_types.append("q" if col.dtype.kind == "f" else "int")
+        arr = np.stack(cols, axis=1) if cols else np.zeros((len(data), 0), np.float32)
+        return ("dense", arr), feature_names, feature_types
+    # scipy sparse
+    if hasattr(data, "tocsr"):
+        csr = data.tocsr()
+        return ("csr", (np.asarray(csr.indptr), np.asarray(csr.indices),
+                        np.asarray(csr.data, dtype=np.float32), csr.shape)), None, None
+    arr = np.asarray(data, dtype=np.float32)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    if not (missing is None or (isinstance(missing, float) and np.isnan(missing))):
+        arr = np.where(arr == missing, np.nan, arr)
+    return ("dense", arr), feature_names, feature_types
+
+
+class DMatrix:
+    """In-memory data matrix (reference: core.py:666 DMatrix, data.h:549).
+
+    Holds raw host data + MetaInfo; binning to an EllpackPage happens lazily at
+    training time (``ensure_ellpack``) or eagerly for QuantileDMatrix.
+    """
+
+    def __init__(
+        self,
+        data: Any,
+        label: Any = None,
+        *,
+        weight: Any = None,
+        base_margin: Any = None,
+        missing: float = np.nan,
+        feature_names: Optional[Sequence[str]] = None,
+        feature_types: Optional[Sequence[str]] = None,
+        group: Any = None,
+        qid: Any = None,
+        label_lower_bound: Any = None,
+        label_upper_bound: Any = None,
+        feature_weights: Any = None,
+        nthread: Optional[int] = None,
+        enable_categorical: bool = False,
+        silent: bool = False,
+    ) -> None:
+        (kind, payload), auto_names, auto_types = _to_numpy_2d(data, missing)
+        self._kind = kind
+        if kind == "dense":
+            self._dense: Optional[np.ndarray] = payload
+            self._csr = None
+            num_row, num_col = payload.shape
+        else:
+            self._dense = None
+            self._csr = payload
+            num_row, num_col = payload[3]
+        self.info = MetaInfo(num_row=num_row, num_col=num_col)
+        if label is not None:
+            self.set_label(label)
+        if weight is not None:
+            self.set_weight(weight)
+        if base_margin is not None:
+            self.set_base_margin(base_margin)
+        if group is not None:
+            self.set_group(group)
+        if qid is not None:
+            self.set_qid(qid)
+        if label_lower_bound is not None:
+            self.info.label_lower_bound = np.asarray(label_lower_bound, np.float32)
+        if label_upper_bound is not None:
+            self.info.label_upper_bound = np.asarray(label_upper_bound, np.float32)
+        if feature_weights is not None:
+            self.info.feature_weights = np.asarray(feature_weights, np.float32)
+        self.info.feature_names = list(feature_names) if feature_names else auto_names
+        self.info.feature_types = list(feature_types) if feature_types else auto_types
+        self.info.validate()
+        self._ellpack: Optional[EllpackPage] = None
+        self._max_bin_built: Optional[int] = None
+
+    # ---- setters (reference: core.py set_info family) ----
+    def set_label(self, label: Any) -> None:
+        arr = np.asarray(label, dtype=np.float32)
+        if arr.shape[0] != self.num_row():
+            raise ValueError(
+                f"label has {arr.shape[0]} entries but data has {self.num_row()} rows"
+            )
+        self.info.label = arr.reshape(self.num_row(), -1)
+        if self.info.label.shape[1] == 1:
+            self.info.label = self.info.label[:, 0]
+
+    def set_weight(self, weight: Any) -> None:
+        self.info.weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+
+    def set_base_margin(self, margin: Any) -> None:
+        self.info.base_margin = np.asarray(margin, dtype=np.float32)
+
+    def set_group(self, group: Any) -> None:
+        g = np.asarray(group, dtype=np.int64)
+        self.info.group_ptr = np.concatenate([[0], np.cumsum(g)]).astype(np.int64)
+
+    def set_qid(self, qid: Any) -> None:
+        q = np.asarray(qid)
+        if len(q) == 0:
+            return
+        change = np.nonzero(np.diff(q) != 0)[0] + 1
+        self.info.group_ptr = np.concatenate([[0], change, [len(q)]]).astype(np.int64)
+
+    # ---- shape ----
+    def num_row(self) -> int:
+        return self.info.num_row
+
+    def num_col(self) -> int:
+        return self.info.num_col
+
+    def get_label(self) -> np.ndarray:
+        return self.info.label if self.info.label is not None else np.zeros(self.num_row(), np.float32)
+
+    def get_weight(self) -> Optional[np.ndarray]:
+        return self.info.weight
+
+    @property
+    def feature_names(self):
+        return self.info.feature_names
+
+    @property
+    def feature_types(self):
+        return self.info.feature_types
+
+    # ---- raw views for prediction ----
+    def host_dense(self) -> np.ndarray:
+        """Dense f32 view with NaN missing (prediction walks raw values)."""
+        if self._dense is not None:
+            return self._dense
+        indptr, indices, values, (R, F) = self._csr
+        out = np.full((R, F), np.nan, dtype=np.float32)
+        row_of = np.repeat(np.arange(R), np.diff(indptr))
+        out[row_of, indices] = values
+        return out
+
+    # ---- binning ----
+    def ensure_ellpack(self, max_bin: int = 256, sketch_weights: Optional[np.ndarray] = None,
+                       ref: Optional["DMatrix"] = None) -> EllpackPage:
+        if self._ellpack is not None and self._max_bin_built == max_bin:
+            return self._ellpack
+        if ref is not None and ref._ellpack is not None:
+            cuts = ref._ellpack.cuts  # GetCutsFromRef (quantile_dmatrix.cc:19)
+        elif self._kind == "dense":
+            cuts = sketch_dense(self._dense, max_bin, weights=sketch_weights)
+        else:
+            indptr, indices, values, (R, F) = self._csr
+            cuts = sketch_csr(indptr, indices, values, F, max_bin, weights=sketch_weights)
+        if self._kind == "dense":
+            self._ellpack = build_ellpack(self._dense, cuts)
+        else:
+            indptr, indices, values, (R, F) = self._csr
+            self._ellpack = build_ellpack_csr(indptr, indices, values, F, cuts)
+        self._max_bin_built = max_bin
+        return self._ellpack
+
+    def slice(self, rindex: Sequence[int]) -> "DMatrix":
+        """Row slice (reference: XGDMatrixSliceDMatrix) — used by cv()."""
+        idx = np.asarray(rindex, dtype=np.int64)
+        if self._kind == "dense":
+            out = DMatrix(self._dense[idx])
+        else:
+            import scipy.sparse as sp
+
+            indptr, indices, values, shape = self._csr
+            csr = sp.csr_matrix((values, indices, indptr), shape=shape)[idx]
+            out = DMatrix(csr)
+        info = self.info
+        if info.label is not None:
+            out.info.label = info.label[idx]
+        if info.weight is not None:
+            out.info.weight = info.weight[idx]
+        if info.base_margin is not None:
+            out.info.base_margin = info.base_margin[idx]
+        if info.label_lower_bound is not None:
+            out.info.label_lower_bound = info.label_lower_bound[idx]
+        if info.label_upper_bound is not None:
+            out.info.label_upper_bound = info.label_upper_bound[idx]
+        if info.group_ptr is not None:
+            # re-derive query groups for the selected rows (qid per row -> regroup)
+            qid = np.repeat(np.arange(len(info.group_ptr) - 1), np.diff(info.group_ptr))
+            out.set_qid(qid[idx])
+        out.info.feature_weights = info.feature_weights
+        out.info.feature_names = info.feature_names
+        out.info.feature_types = info.feature_types
+        return out
+
+
+class QuantileDMatrix(DMatrix):
+    """Eagerly-binned DMatrix (reference: core.py:1434, iterative_dmatrix.h:34).
+
+    Sketches and bins at construction; ``ref=`` reuses the training cuts so
+    validation data lands in identical bins.
+    """
+
+    def __init__(self, data: Any, label: Any = None, *, max_bin: int = 256,
+                 ref: Optional[DMatrix] = None, **kwargs: Any) -> None:
+        super().__init__(data, label, **kwargs)
+        self.max_bin = max_bin
+        self.ensure_ellpack(max_bin=max_bin, ref=ref)

@@ -1,0 +1,232 @@
+"""Quantile sketch -> histogram bin boundaries.
+
+TPU-native equivalent of the reference's quantile sketching + ``HistogramCuts``
+(src/common/quantile.h:565 WQuantileSketch, src/common/hist_util.h:39-106
+HistogramCuts, GPU fused sketch src/common/quantile.cu).  The reference runs a
+GK merge-prune summary per feature; on TPU the data already lives on device as a
+dense array, so we compute (weighted) quantiles directly with a device sort —
+O(R log R) on the sorted axis, one pass, no summary machinery — and finalize the
+ragged per-feature cut arrays on host.  Distributed merging (quantile.cc:397-442
+AllreduceV of summaries) becomes an all-gather of fixed-size per-shard quantile
+grids (see parallel/collective.py).
+
+Cut semantics match the reference (hist_util.cc):
+ - bin b of feature f covers values v with cuts[b-1] <= v < cuts[b]
+   (bin index = count of cuts <= v, i.e. searchsorted side='right');
+ - the last cut is strictly greater than the feature max so every finite value
+   lands in a valid bin;
+ - ``min_vals`` records a value strictly below the feature min.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class HistogramCuts:
+    """Bin boundaries (reference: src/common/hist_util.h:39-106).
+
+    ``cut_ptrs``  : (F+1,) int32  — CSR offsets into ``cut_values``.
+    ``cut_values``: (total_bins,) f32 — ascending per-feature upper bounds.
+    ``min_vals``  : (F,) f32 — strictly below each feature's min.
+    """
+
+    cut_ptrs: np.ndarray
+    cut_values: np.ndarray
+    min_vals: np.ndarray
+
+    @property
+    def n_features(self) -> int:
+        return len(self.cut_ptrs) - 1
+
+    @property
+    def total_bins(self) -> int:
+        return int(self.cut_ptrs[-1])
+
+    def n_bins(self, f: int) -> int:
+        return int(self.cut_ptrs[f + 1] - self.cut_ptrs[f])
+
+    @property
+    def max_n_bins(self) -> int:
+        return int(np.max(np.diff(self.cut_ptrs))) if self.n_features else 0
+
+    def feature_cuts(self, f: int) -> np.ndarray:
+        return self.cut_values[self.cut_ptrs[f] : self.cut_ptrs[f + 1]]
+
+    def padded(self, width: Optional[int] = None) -> np.ndarray:
+        """Dense (F, B) cut matrix padded with +inf — the jit-friendly layout.
+
+        Padded slots never win a split because their histogram mass is zero and
+        the evaluator masks bins >= n_bins(f).
+        """
+        B = width or self.max_n_bins
+        out = np.full((self.n_features, B), np.inf, dtype=np.float32)
+        for f in range(self.n_features):
+            seg = self.feature_cuts(f)
+            out[f, : len(seg)] = seg
+        return out
+
+    def n_bins_array(self) -> np.ndarray:
+        return np.diff(self.cut_ptrs).astype(np.int32)
+
+
+def _final_cut(vmax: float) -> float:
+    # Reference hist_util.cc appends max + small delta so max lands in the last bin.
+    return float(vmax + (abs(vmax) * 1e-2 if vmax != 0.0 else 1e-5) + 1e-5)
+
+
+def cuts_from_quantile_grid(
+    grid: np.ndarray, n_valid: np.ndarray, vmax: np.ndarray, vmin: np.ndarray
+) -> HistogramCuts:
+    """Finalize ragged cuts from a dense (F, Q) quantile grid.
+
+    grid[f, q] is the q-th quantile candidate of feature f (rows with
+    n_valid[f]==0 are all-NaN features).  Dedupes per feature and appends the
+    open upper bound.
+    """
+    F, _ = grid.shape
+    ptrs = [0]
+    values: List[np.ndarray] = []
+    mins = np.empty(F, dtype=np.float32)
+    for f in range(F):
+        if n_valid[f] == 0:
+            seg = np.array([1e-5], dtype=np.float32)  # single catch-all bin
+            mins[f] = -1e-5
+        else:
+            cand = np.unique(grid[f][np.isfinite(grid[f])])
+            # drop candidates that equal the running max; the final cut covers them
+            last = _final_cut(float(vmax[f]))
+            cand = cand[cand < last]
+            # candidates must exceed the feature min so bin 0 is non-empty-able
+            seg = np.append(cand[cand > vmin[f]], np.float32(last)).astype(np.float32)
+            mins[f] = vmin[f] - (abs(vmin[f]) * 1e-2 if vmin[f] != 0 else 1e-5)
+        values.append(seg)
+        ptrs.append(ptrs[-1] + len(seg))
+    return HistogramCuts(
+        cut_ptrs=np.asarray(ptrs, dtype=np.int32),
+        cut_values=np.concatenate(values).astype(np.float32) if values else np.zeros(0, np.float32),
+        min_vals=mins,
+    )
+
+
+def sketch_dense(
+    X,
+    max_bin: int,
+    weights: Optional[np.ndarray] = None,
+    use_device: bool = True,
+) -> HistogramCuts:
+    """Build HistogramCuts from a dense (R, F) float matrix with NaN = missing.
+
+    Device path: one jnp.sort per feature column block + a gather at quantile
+    positions; only the (F, max_bin) grid is pulled back to host (the analogue
+    of the reference's device sketch returning pruned summaries,
+    src/common/hist_util.cuh:213 DeviceSketch).
+    Weighted data falls back to a host weighted-CDF quantile (reference:
+    WQSketch handles weights natively).
+    """
+    Xn = np.asarray(X, dtype=np.float32) if not hasattr(X, "devices") else X
+    R, F = Xn.shape
+    n_cand = max(max_bin - 1, 1)
+
+    if weights is not None:
+        return _sketch_weighted_host(np.asarray(Xn, dtype=np.float32), max_bin, np.asarray(weights))
+
+    if use_device and R * F > 0:
+        import jax.numpy as jnp
+
+        Xd = jnp.asarray(Xn, dtype=jnp.float32)
+        sortd = jnp.sort(Xd, axis=0)  # NaNs sort to the end
+        nvalid = jnp.sum(~jnp.isnan(Xd), axis=0)  # (F,)
+        # quantile candidate ranks: ceil(i/ncand * nvalid) - style positions
+        qs = (jnp.arange(1, n_cand + 1, dtype=jnp.float32) / (n_cand + 1))
+        pos = jnp.clip((qs[None, :] * nvalid[:, None].astype(jnp.float32)).astype(jnp.int32),
+                       0, jnp.maximum(nvalid[:, None] - 1, 0))
+        grid = jnp.take_along_axis(sortd.T, pos, axis=1)  # (F, n_cand)
+        vmax = jnp.take_along_axis(sortd.T, jnp.maximum(nvalid[:, None] - 1, 0), axis=1)[:, 0]
+        vmin = sortd[0]
+        grid_h = np.asarray(grid)
+        nvalid_h = np.asarray(nvalid)
+        vmax_h = np.where(nvalid_h > 0, np.asarray(vmax), 0.0)
+        vmin_h = np.where(nvalid_h > 0, np.asarray(vmin), 0.0)
+        grid_h = np.where(np.isnan(grid_h), np.inf, grid_h)
+        return cuts_from_quantile_grid(grid_h, nvalid_h, vmax_h, vmin_h)
+
+    return _sketch_weighted_host(Xn, max_bin, None)
+
+
+def _sketch_weighted_host(X: np.ndarray, max_bin: int, w: Optional[np.ndarray]) -> HistogramCuts:
+    R, F = X.shape
+    n_cand = max(max_bin - 1, 1)
+    grid = np.full((F, n_cand), np.inf, dtype=np.float32)
+    nvalid = np.zeros(F, dtype=np.int64)
+    vmax = np.zeros(F, dtype=np.float32)
+    vmin = np.zeros(F, dtype=np.float32)
+    qs = np.arange(1, n_cand + 1, dtype=np.float64) / (n_cand + 1)
+    for f in range(F):
+        col = X[:, f]
+        mask = ~np.isnan(col)
+        vals = col[mask]
+        nvalid[f] = len(vals)
+        if len(vals) == 0:
+            continue
+        vmax[f] = vals.max()
+        vmin[f] = vals.min()
+        if w is None:
+            grid[f] = np.quantile(vals, qs, method="inverted_cdf").astype(np.float32)
+        else:
+            wf = w[mask].astype(np.float64)
+            order = np.argsort(vals, kind="stable")
+            sv, sw = vals[order], wf[order]
+            cdf = np.cumsum(sw)
+            tot = cdf[-1]
+            if tot <= 0:
+                grid[f] = np.quantile(vals, qs, method="inverted_cdf").astype(np.float32)
+            else:
+                idx = np.searchsorted(cdf, qs * tot, side="left")
+                grid[f] = sv[np.clip(idx, 0, len(sv) - 1)].astype(np.float32)
+    return cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
+
+
+def sketch_csr(indptr, indices, values, n_features: int, max_bin: int,
+               weights: Optional[np.ndarray] = None) -> HistogramCuts:
+    """Sketch a CSR matrix column-by-column on host (sparse ingest path).
+
+    Implicit zeros in sparse input are treated as missing, matching the
+    reference's sparse DMatrix semantics (only stored entries are sketched,
+    src/common/hist_util.cc SketchOnDMatrix walks nonzeros).
+    """
+    R = len(indptr) - 1
+    n_cand = max(max_bin - 1, 1)
+    grid = np.full((n_features, n_cand), np.inf, dtype=np.float32)
+    nvalid = np.zeros(n_features, dtype=np.int64)
+    vmax = np.zeros(n_features, dtype=np.float32)
+    vmin = np.zeros(n_features, dtype=np.float32)
+    qs = np.arange(1, n_cand + 1, dtype=np.float64) / (n_cand + 1)
+    # bucket values per column
+    order = np.argsort(indices, kind="stable")
+    col_sorted = indices[order]
+    val_sorted = values[order]
+    starts = np.searchsorted(col_sorted, np.arange(n_features + 1))
+    if weights is not None:
+        row_of = np.repeat(np.arange(R), np.diff(indptr))[order]
+    for f in range(n_features):
+        seg = val_sorted[starts[f] : starts[f + 1]].astype(np.float32)
+        keep = ~np.isnan(seg)
+        vals = seg[keep]
+        nvalid[f] = len(vals)
+        if len(vals) == 0:
+            continue
+        vmax[f], vmin[f] = vals.max(), vals.min()
+        if weights is None:
+            grid[f] = np.quantile(vals, qs, method="inverted_cdf").astype(np.float32)
+        else:
+            wf = weights[row_of[starts[f] : starts[f + 1]]][keep].astype(np.float64)
+            o = np.argsort(vals, kind="stable")
+            sv, sw = vals[o], wf[o]
+            cdf = np.cumsum(sw)
+            idx = np.searchsorted(cdf, qs * cdf[-1], side="left")
+            grid[f] = sv[np.clip(idx, 0, len(sv) - 1)].astype(np.float32)
+    return cuts_from_quantile_grid(grid, nvalid, vmax, vmin)

@@ -1,0 +1,231 @@
+"""Evaluation metrics (reference: src/metric/ — elementwise_metric.cu,
+multiclass_metric.cu, auc.cc/.cu, rank_metric.cc).
+
+Each metric consumes *transformed* predictions (after the objective's
+PredTransform) except where the reference evaluates on margins; all are
+weighted and reduce to (sum, wsum) pairs so the distributed path can psum the
+partials exactly like the reference's allreduce-of-partials design.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_metric(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def create_metric(name: str):
+    base = name.split("@")[0]
+    if base not in _REGISTRY:
+        raise ValueError(f"Unknown metric {name!r}. Known: {sorted(_REGISTRY)}")
+    fn = _REGISTRY[base]
+    if "@" in name:
+        arg = float(name.split("@")[1])
+        return lambda *a, **k: fn(*a, at=arg, **k), name
+    return fn, name
+
+
+def list_metrics():
+    return sorted(_REGISTRY)
+
+
+def _w(labels, weights):
+    return np.ones_like(labels, dtype=np.float64) if weights is None else weights.astype(np.float64)
+
+
+def _wmean(err, labels, weights):
+    w = _w(labels if err.ndim == 1 else err[:, 0], weights)
+    return float(np.sum(err * w) / np.sum(w))
+
+
+@register_metric("rmse")
+def rmse(preds, labels, weights=None, **kw):
+    return float(np.sqrt(_wmean((preds - labels) ** 2, labels, weights)))
+
+
+@register_metric("rmsle")
+def rmsle(preds, labels, weights=None, **kw):
+    return float(
+        np.sqrt(_wmean((np.log1p(np.maximum(preds, 0)) - np.log1p(labels)) ** 2, labels, weights))
+    )
+
+
+@register_metric("mae")
+def mae(preds, labels, weights=None, **kw):
+    return _wmean(np.abs(preds - labels), labels, weights)
+
+
+@register_metric("mape")
+def mape(preds, labels, weights=None, **kw):
+    return _wmean(np.abs((labels - preds) / np.maximum(np.abs(labels), 1e-10)), labels, weights)
+
+
+@register_metric("mphe")
+def mphe(preds, labels, weights=None, slope: float = 1.0, **kw):
+    z = (preds - labels) / slope
+    return _wmean(slope**2 * (np.sqrt(1 + z**2) - 1), labels, weights)
+
+
+@register_metric("logloss")
+def logloss(preds, labels, weights=None, **kw):
+    p = np.clip(preds, 1e-16, 1 - 1e-16)
+    return _wmean(-(labels * np.log(p) + (1 - labels) * np.log(1 - p)), labels, weights)
+
+
+@register_metric("error")
+def error(preds, labels, weights=None, at: float = 0.5, **kw):
+    return _wmean(((preds > at) != (labels > 0.5)).astype(np.float64), labels, weights)
+
+
+@register_metric("poisson-nloglik")
+def poisson_nloglik(preds, labels, weights=None, **kw):
+    from scipy.special import gammaln
+
+    p = np.maximum(preds, 1e-16)
+    return _wmean(p - labels * np.log(p) + gammaln(labels + 1.0), labels, weights)
+
+
+@register_metric("gamma-nloglik")
+def gamma_nloglik(preds, labels, weights=None, **kw):
+    # reference elementwise_metric.cu GammaNLoglik (shape psi = 1)
+    p = np.maximum(preds, 1e-16)
+    y = np.maximum(labels, 1e-16)
+    return _wmean(y / p + np.log(p), labels, weights)
+
+
+@register_metric("gamma-deviance")
+def gamma_deviance(preds, labels, weights=None, **kw):
+    p = np.maximum(preds, 1e-16)
+    y = np.maximum(labels, 1e-16)
+    return _wmean(2 * (np.log(p / y) + y / p - 1), labels, weights)
+
+
+@register_metric("tweedie-nloglik")
+def tweedie_nloglik(preds, labels, weights=None, at: float = 1.5, **kw):
+    rho = at
+    p = np.maximum(preds, 1e-16)
+    a = labels * np.power(p, 1 - rho) / (1 - rho)
+    b = np.power(p, 2 - rho) / (2 - rho)
+    return _wmean(-a + b, labels, weights)
+
+
+@register_metric("quantile")
+def quantile_loss(preds, labels, weights=None, at: float = 0.5, **kw):
+    u = labels - preds
+    return _wmean(np.where(u >= 0, at * u, (at - 1) * u), labels, weights)
+
+
+@register_metric("merror")
+def merror(preds, labels, weights=None, **kw):
+    cls = preds if preds.ndim == 1 else np.argmax(preds, axis=1)
+    return _wmean((cls != labels).astype(np.float64), labels, weights)
+
+
+@register_metric("mlogloss")
+def mlogloss(preds, labels, weights=None, **kw):
+    p = np.clip(preds, 1e-16, 1 - 1e-16)
+    ll = -np.log(p[np.arange(len(labels)), labels.astype(np.int64)])
+    return _wmean(ll, labels, weights)
+
+
+@register_metric("auc")
+def auc(preds, labels, weights=None, group_ptr=None, **kw):
+    """Binary ROC-AUC via the rank statistic with exact tie handling
+    (reference: src/metric/auc.cc BinaryROCAUC)."""
+    s = np.asarray(preds, dtype=np.float64)
+    if s.ndim == 2:  # multiclass: 1-vs-rest average (reference MultiClassOVR)
+        K = s.shape[1]
+        vals = [auc(s[:, k], (labels == k).astype(np.float64), weights) for k in range(K)]
+        return float(np.mean(vals))
+    y = labels > 0.5
+    w = _w(labels, weights)
+    order = np.argsort(s, kind="stable")
+    ss, yy, ww = s[order], y[order], w[order]
+    uniq, first = np.unique(ss, return_index=True)
+    grp = np.searchsorted(uniq, ss)
+    pos_w = np.sum(ww[yy])
+    neg_w = np.sum(ww[~yy])
+    if pos_w == 0 or neg_w == 0:
+        return 0.5
+    # each positive scores (neg weight strictly below) + (tied neg weight)/2
+    cw_neg = np.cumsum(ww * (~yy))
+    below = np.concatenate([[0.0], cw_neg])[first[grp]]
+    ties_neg = np.zeros(len(uniq))
+    np.add.at(ties_neg, grp, ww * (~yy))
+    score = below + ties_neg[grp] / 2.0
+    return float(np.sum(ww[yy] * score[yy]) / (pos_w * neg_w))
+
+
+@register_metric("aucpr")
+def aucpr(preds, labels, weights=None, **kw):
+    s = np.asarray(preds, dtype=np.float64)
+    y = labels > 0.5
+    w = _w(labels, weights)
+    order = np.argsort(-s, kind="stable")
+    yy, ww = y[order], w[order]
+    tp = np.cumsum(ww * yy)
+    fp = np.cumsum(ww * ~yy)
+    pos = tp[-1]
+    if pos == 0:
+        return 0.0
+    precision = tp / np.maximum(tp + fp, 1e-16)
+    recall = tp / pos
+    return float(np.trapezoid(precision, recall))
+
+
+def _dcg_at(rel, k, exp_gain=True):
+    rel = rel[:k]
+    gain = (2.0**rel - 1.0) if exp_gain else rel
+    return np.sum(gain / np.log2(np.arange(2, len(rel) + 2)))
+
+
+@register_metric("ndcg")
+def ndcg(preds, labels, weights=None, group_ptr=None, at: float = 0, **kw):
+    """(reference: src/metric/rank_metric.cc NDCG; exp gain by default)."""
+    if group_ptr is None:
+        group_ptr = np.array([0, len(labels)])
+    k = int(at) if at else None
+    vals, ws = [], []
+    for g in range(len(group_ptr) - 1):
+        lo, hi = group_ptr[g], group_ptr[g + 1]
+        if hi <= lo:
+            continue
+        y = labels[lo:hi]
+        s = preds[lo:hi]
+        kk = k or (hi - lo)
+        order = np.argsort(-s, kind="stable")
+        dcg = _dcg_at(y[order], kk)
+        idcg = _dcg_at(np.sort(y)[::-1], kk)
+        vals.append(dcg / idcg if idcg > 0 else 1.0)
+        ws.append(1.0 if weights is None else weights[g if len(weights) == len(group_ptr) - 1 else lo])
+    return float(np.average(vals, weights=ws)) if vals else 1.0
+
+
+@register_metric("map")
+def map_metric(preds, labels, weights=None, group_ptr=None, at: float = 0, **kw):
+    if group_ptr is None:
+        group_ptr = np.array([0, len(labels)])
+    k = int(at) if at else None
+    vals = []
+    for g in range(len(group_ptr) - 1):
+        lo, hi = group_ptr[g], group_ptr[g + 1]
+        if hi <= lo:
+            continue
+        y = (labels[lo:hi] > 0).astype(np.float64)
+        s = preds[lo:hi]
+        order = np.argsort(-s, kind="stable")
+        yo = y[order][: k or (hi - lo)]
+        hits = np.cumsum(yo)
+        denom = np.arange(1, len(yo) + 1)
+        npos = yo.sum()
+        vals.append(float(np.sum(yo * hits / denom) / npos) if npos > 0 else 0.0)
+    return float(np.mean(vals)) if vals else 0.0

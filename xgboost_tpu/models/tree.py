@@ -1,0 +1,189 @@
+"""RegTree: the persisted tree model, struct-of-arrays.
+
+Reference: include/xgboost/tree_model.h:81 (RegTree), src/tree/tree_model.cc
+(JSON/UBJSON schema + text/graphviz dump).  The reference's packed 32-byte Node
+is already array-shaped; here the arrays are first-class numpy columns in the
+reference's JSON field layout (left_children, right_children, parents,
+split_indices, split_conditions, default_left, base_weights, loss_changes,
+sum_hessian), so ``save_model`` emits the same schema the reference reads.
+Node numbering is creation order (root 0, children appended on split in
+level order), matching the depthwise updater.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class RegTree:
+    left_children: np.ndarray  # (n,) int32, -1 for leaf
+    right_children: np.ndarray
+    parents: np.ndarray
+    split_indices: np.ndarray  # int32 feature, 0 for leaf
+    split_conditions: np.ndarray  # f32 threshold; LEAF VALUE for leaves
+    default_left: np.ndarray  # bool
+    base_weights: np.ndarray  # f32
+    loss_changes: np.ndarray  # f32
+    sum_hessian: np.ndarray  # f32
+    split_bins: Optional[np.ndarray] = None  # int32, internal (binned predict)
+    split_type: Optional[np.ndarray] = None  # 0 numeric, 1 categorical
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.left_children)
+
+    def is_leaf(self, nid: int) -> bool:
+        return self.left_children[nid] == -1
+
+    @property
+    def num_leaves(self) -> int:
+        return int(np.sum(self.left_children == -1))
+
+    @property
+    def max_depth(self) -> int:
+        depth = np.zeros(self.n_nodes, dtype=np.int32)
+        for i in range(1, self.n_nodes):
+            depth[i] = depth[self.parents[i]] + 1
+        return int(depth.max()) if self.n_nodes else 0
+
+    # ---- construction from the grower's heap layout ----
+    @staticmethod
+    def from_grown(gt) -> "RegTree":
+        """Compact a tree/grow.py GrownTree (heap arrays) into creation order."""
+        heap_ids: List[int] = [0]
+        id_of = {0: 0}
+        # level-order walk over real nodes, children appended in split order
+        order: List[int] = []
+        queue = [0]
+        while queue:
+            h = queue.pop(0)
+            order.append(h)
+            if gt.feat[h] >= 0 and not gt.is_leaf[h]:
+                for c in (2 * h + 1, 2 * h + 2):
+                    id_of[c] = len(order) + len(queue)
+                    queue.append(c)
+        n = len(order)
+        t = RegTree(
+            left_children=np.full(n, -1, np.int32),
+            right_children=np.full(n, -1, np.int32),
+            parents=np.full(n, -1, np.int32),
+            split_indices=np.zeros(n, np.int32),
+            split_conditions=np.zeros(n, np.float32),
+            default_left=np.zeros(n, bool),
+            base_weights=np.zeros(n, np.float32),
+            loss_changes=np.zeros(n, np.float32),
+            sum_hessian=np.zeros(n, np.float32),
+            split_bins=np.zeros(n, np.int32),
+        )
+        for h in order:
+            i = id_of[h]
+            t.base_weights[i] = gt.base_weight[h]
+            t.sum_hessian[i] = gt.sum_hess[h]
+            t.default_left[i] = gt.dleft[h]
+            if gt.feat[h] >= 0 and not gt.is_leaf[h]:
+                t.left_children[i] = id_of[2 * h + 1]
+                t.right_children[i] = id_of[2 * h + 2]
+                t.parents[id_of[2 * h + 1]] = i
+                t.parents[id_of[2 * h + 2]] = i
+                t.split_indices[i] = gt.feat[h]
+                t.split_conditions[i] = gt.thr[h]
+                t.split_bins[i] = gt.sbin[h]
+                t.loss_changes[i] = gt.gain[h]
+            else:
+                t.split_conditions[i] = gt.leaf_val[h]
+        return t
+
+    # ---- padded arrays for the vectorized predictor ----
+    def padded_arrays(self, width: int):
+        n = self.n_nodes
+        assert width >= n
+
+        def pad(a, fill=0):
+            out = np.full(width, fill, dtype=a.dtype)
+            out[:n] = a
+            return out
+
+        feat = np.where(self.left_children == -1, -1, self.split_indices).astype(np.int32)
+        value = np.where(self.left_children == -1, self.split_conditions, 0.0).astype(np.float32)
+        return dict(
+            feat=pad(feat, -1),
+            thr=pad(np.where(self.left_children == -1, np.float32(0), self.split_conditions)),
+            dleft=pad(self.default_left.astype(np.bool_)),
+            left=pad(self.left_children, -1),
+            right=pad(self.right_children, -1),
+            value=pad(value),
+        )
+
+    # ---- xgboost JSON schema (tree_model.cc SaveModel) ----
+    def to_json_dict(self, n_features: int) -> dict:
+        n = self.n_nodes
+        st = self.split_type if self.split_type is not None else np.zeros(n, np.int32)
+        return {
+            "tree_param": {
+                "num_nodes": str(n),
+                "num_feature": str(n_features),
+                "size_leaf_vector": "1",
+            },
+            "left_children": self.left_children.tolist(),
+            "right_children": self.right_children.tolist(),
+            "parents": self.parents.tolist(),
+            "split_indices": self.split_indices.tolist(),
+            "split_conditions": [float(x) for x in self.split_conditions],
+            "split_type": st.tolist(),
+            "default_left": self.default_left.astype(np.int32).tolist(),
+            "categories": [],
+            "categories_nodes": [],
+            "categories_segments": [],
+            "categories_sizes": [],
+            "base_weights": [float(x) for x in self.base_weights],
+            "loss_changes": [float(x) for x in self.loss_changes],
+            "sum_hessian": [float(x) for x in self.sum_hessian],
+        }
+
+    @staticmethod
+    def from_json_dict(d: dict) -> "RegTree":
+        return RegTree(
+            left_children=np.asarray(d["left_children"], np.int32),
+            right_children=np.asarray(d["right_children"], np.int32),
+            parents=np.asarray(d["parents"], np.int32),
+            split_indices=np.asarray(d["split_indices"], np.int32),
+            split_conditions=np.asarray(d["split_conditions"], np.float32),
+            default_left=np.asarray(d["default_left"]).astype(bool),
+            base_weights=np.asarray(d.get("base_weights", np.zeros(len(d["left_children"]))), np.float32),
+            loss_changes=np.asarray(d.get("loss_changes", np.zeros(len(d["left_children"]))), np.float32),
+            sum_hessian=np.asarray(d.get("sum_hessian", np.zeros(len(d["left_children"]))), np.float32),
+            split_type=np.asarray(d.get("split_type", np.zeros(len(d["left_children"])))).astype(np.int32),
+        )
+
+    # ---- text dump (tree_model.cc DumpModel, dump_format="text") ----
+    def dump_text(self, feature_names: Optional[List[str]] = None, with_stats: bool = False) -> str:
+        lines: List[str] = []
+
+        def fname(fid: int) -> str:
+            return feature_names[fid] if feature_names else f"f{fid}"
+
+        def rec(nid: int, depth: int):
+            indent = "\t" * depth
+            if self.is_leaf(nid):
+                s = f"{indent}{nid}:leaf={self.split_conditions[nid]:.6g}"
+                if with_stats:
+                    s += f",cover={self.sum_hessian[nid]:.6g}"
+            else:
+                s = (
+                    f"{indent}{nid}:[{fname(self.split_indices[nid])}<"
+                    f"{self.split_conditions[nid]:.6g}] yes={self.left_children[nid]},"
+                    f"no={self.right_children[nid]},missing="
+                    f"{self.left_children[nid] if self.default_left[nid] else self.right_children[nid]}"
+                )
+                if with_stats:
+                    s += f",gain={self.loss_changes[nid]:.6g},cover={self.sum_hessian[nid]:.6g}"
+            lines.append(s)
+            if not self.is_leaf(nid):
+                rec(self.left_children[nid], depth + 1)
+                rec(self.right_children[nid], depth + 1)
+
+        rec(0, 0)
+        return "\n".join(lines) + "\n"

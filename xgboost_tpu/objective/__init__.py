@@ -1,0 +1,93 @@
+"""Objective functions (reference: include/xgboost/objective.h:28 ObjFunction).
+
+Each objective is a pure vectorized function family: ``get_gradient`` returns
+per-row (grad, hess) pairs evaluated on device (the analogue of the CUDA
+objective kernels in src/objective/regression_obj.cu etc.), plus the link
+functions ``pred_transform`` / ``prob_to_margin`` and one-step Newton
+``init_estimation`` (reference: ObjFunction::InitEstimation + FitStump,
+src/tree/fit_stump.cc:34).
+
+Registry dispatch by name mirrors XGBOOST_REGISTER_OBJECTIVE.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Type
+
+import numpy as np
+
+_REGISTRY: Dict[str, Type["ObjFunction"]] = {}
+
+
+def register_objective(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        cls.name = name
+        return cls
+
+    return deco
+
+
+def create_objective(name: str, params: dict) -> "ObjFunction":
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"Unknown objective {name!r}. Known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name](params)
+
+
+def list_objectives():
+    return sorted(_REGISTRY)
+
+
+class ObjFunction:
+    """Base objective (objective.h:28). Subclasses define gradient + links."""
+
+    name = ""
+
+    def __init__(self, params: dict) -> None:
+        self.params = params
+
+    # number of model outputs per row (1, or num_class for softmax family)
+    def n_groups(self) -> int:
+        return 1
+
+    def task_is_classification(self) -> bool:
+        return False
+
+    def get_gradient(self, preds, labels, weights, iteration: int = 0):
+        """(R,K) margin, (R,) or (R,K) labels -> (R, K, 2) f32 gpair."""
+        raise NotImplementedError
+
+    def pred_transform(self, margin):
+        return margin
+
+    def prob_to_margin(self, prob):
+        return prob
+
+    def margin_to_prob(self, margin):
+        """Scalar inverse of prob_to_margin (for base_score serialization)."""
+        return margin
+
+    def init_estimation(self, labels, weights) -> float:
+        """One Newton step from margin 0 (FitStump) -> base margin scalar."""
+        import jax.numpy as jnp
+
+        g = self.get_gradient(
+            jnp.zeros((labels.shape[0], self.n_groups()), jnp.float32), labels, weights
+        )
+        G = jnp.sum(g[..., 0], axis=0)
+        H = jnp.sum(g[..., 1], axis=0)
+        return -G / jnp.maximum(H, 1e-6)
+
+    def default_metric(self) -> str:
+        return "rmse"
+
+    # adaptive leaf update hook (reference: ObjFunction::UpdateTreeLeaf,
+    # objective.h:129) — used by absoluteerror/quantile
+    def adaptive_leaf(self) -> bool:
+        return False
+
+
+from . import regression  # noqa: E402,F401  (registers objectives)
+from . import multiclass  # noqa: E402,F401
+from . import ranking  # noqa: E402,F401

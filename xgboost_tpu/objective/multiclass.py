@@ -1,0 +1,57 @@
+"""Multiclass objectives (reference: src/objective/multiclass_obj.cu).
+
+softprob/softmax gradients: p = softmax(margin); grad_k = p_k - 1[y==k],
+hess_k = 2 p_k (1 - p_k) — matching SoftmaxMultiClassObj.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import ObjFunction, register_objective
+
+
+class _SoftmaxBase(ObjFunction):
+    def __init__(self, params):
+        super().__init__(params)
+        self.num_class = int(params.get("num_class", 0))
+        if self.num_class < 2:
+            raise ValueError(f"{self.name} requires num_class >= 2")
+
+    def n_groups(self):
+        return self.num_class
+
+    def task_is_classification(self):
+        return True
+
+    def get_gradient(self, preds, labels, weights, iteration: int = 0):
+        K = self.num_class
+        p = jax.nn.softmax(preds, axis=1)  # (R, K)
+        y = jax.nn.one_hot(labels.astype(jnp.int32), K, dtype=jnp.float32)
+        grad = p - y
+        hess = jnp.maximum(2.0 * p * (1.0 - p), 1e-16)
+        if weights is not None:
+            grad = grad * weights[:, None]
+            hess = hess * weights[:, None]
+        return jnp.stack([grad, hess], axis=-1).astype(jnp.float32)
+
+    def init_estimation(self, labels, weights):
+        return jnp.zeros(self.num_class, jnp.float32)
+
+    def default_metric(self):
+        return "mlogloss"
+
+
+@register_objective("multi:softprob")
+class SoftProb(_SoftmaxBase):
+    def pred_transform(self, margin):
+        return jax.nn.softmax(margin, axis=1)
+
+
+@register_objective("multi:softmax")
+class SoftMax(_SoftmaxBase):
+    def pred_transform(self, margin):
+        return jnp.argmax(margin, axis=1).astype(jnp.float32)
+
+    def default_metric(self):
+        return "merror"

@@ -1,0 +1,97 @@
+"""Gradient histogram construction — the hot kernel of hist tree growing.
+
+TPU-native re-design of the reference's histogram build
+(src/tree/gpu_hist/histogram.cu:37-120 shared-memory atomic kernels;
+CPU src/tree/hist/histogram.h:44).  The CUDA design — atomic adds of quantised
+(grad,hess) into per-node bins — does not map to TPU (no fast global atomics).
+Instead we reformulate as a **masked one-hot matmul** that runs on the MXU:
+
+    hist[n, f, b, c] = sum_r  onehot(bins[r,f], b) * (pos[r] == node(n)) * gpair[r, c]
+
+i.e. ``A.T @ G`` with ``A = onehot(bins)`` of shape (rows, F*B) and
+``G[r, n*2+c] = gpair[r,c] * nodemask[r,n]`` of shape (rows, 2N).  No row
+sorting, no scatter, no atomics; per-row node membership lives in a ``pos``
+array updated elementwise each level (the analogue of RowPartitioner positions,
+src/tree/gpu_hist/row_partitioner.cuh:255, without the physical partition).
+
+Two implementations:
+ - ``build_histogram``: chunked XLA einsum (reference path, works everywhere);
+ - ``build_histogram_pallas`` (ops/hist_pallas.py): fuses one-hot construction
+   into VMEM so the (rows, F*B) operand never touches HBM — the production
+   TPU kernel.
+
+Determinism: float32 accumulation in a fixed sequential chunk order — the role
+played by fixed-point gradient quantisation in the reference
+(src/tree/gpu_hist/quantiser.cuh:52) is filled by the absence of atomics.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _hist_chunk(bins_c, gpair_c, pos_c, node0: int, n_nodes: int, n_bin: int):
+    """One row-chunk's contribution: (T,F) bins -> (N,F,B,C) partial histogram."""
+    T, F = bins_c.shape
+    C = gpair_c.shape[1]
+    onehot = (bins_c.astype(jnp.int32)[:, :, None] == jnp.arange(n_bin, dtype=jnp.int32)).astype(
+        jnp.float32
+    )  # (T, F, B); missing sentinel B compares false everywhere
+    nodemask = (
+        pos_c[:, None] == (node0 + jnp.arange(n_nodes, dtype=pos_c.dtype))
+    ).astype(jnp.float32)  # (T, N)
+    gm = (nodemask[:, :, None] * gpair_c[:, None, :]).reshape(T, n_nodes * C)
+    out = jnp.dot(
+        onehot.reshape(T, F * n_bin).T, gm, preferred_element_type=jnp.float32
+    )  # (F*B, N*C)
+    return out.reshape(F, n_bin, n_nodes, C).transpose(2, 0, 1, 3)
+
+
+@functools.partial(jax.jit, static_argnames=("node0", "n_nodes", "n_bin", "chunk"))
+def build_histogram(
+    bins, gpair, pos, *, node0: int, n_nodes: int, n_bin: int, chunk: int = 2048
+):
+    """hist (n_nodes, F, B, C) for the node batch [node0, node0+n_nodes).
+
+    bins  : (R_pad, F) int   — local bin indices, sentinel == n_bin for missing
+    gpair : (R_pad, C) f32   — C=2 (grad, hess); padded rows must be zero
+    pos   : (R_pad,) int32   — per-row node id (-1 for padded rows)
+    """
+    R, F = bins.shape
+    C = gpair.shape[1]
+    if R <= chunk:
+        return _hist_chunk(bins, gpair, pos, node0, n_nodes, n_bin)
+    n_chunks = R // chunk
+    rem = R - n_chunks * chunk
+
+    def body(acc, xs):
+        b, g, p = xs
+        return acc + _hist_chunk(b, g, p, node0, n_nodes, n_bin), None
+
+    acc0 = jnp.zeros((n_nodes, F, n_bin, C), dtype=jnp.float32)
+    xs = (
+        bins[: n_chunks * chunk].reshape(n_chunks, chunk, F),
+        gpair[: n_chunks * chunk].reshape(n_chunks, chunk, C),
+        pos[: n_chunks * chunk].reshape(n_chunks, chunk),
+    )
+    acc, _ = lax.scan(body, acc0, xs)
+    if rem:
+        acc = acc + _hist_chunk(bins[-rem:], gpair[-rem:], pos[-rem:], node0, n_nodes, n_bin)
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("node0", "n_nodes"))
+def node_sums(gpair, pos, *, node0: int, n_nodes: int):
+    """Per-node gradient totals: (N, C) — masked segment sum, MXU-friendly.
+
+    Used for the root sum (reference: updater_gpu_hist.cu:581 InitRoot device
+    reduce followed by collective::GlobalSum).
+    """
+    nodemask = (pos[:, None] == (node0 + jnp.arange(n_nodes, dtype=pos.dtype))).astype(
+        jnp.float32
+    )
+    return jnp.dot(nodemask.T, gpair, preferred_element_type=jnp.float32)

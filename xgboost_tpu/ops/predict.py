@@ -1,0 +1,66 @@
+"""Vectorized tree-traversal prediction.
+
+TPU-native equivalent of the reference's GPU predictor
+(src/predictor/gpu_predictor.cu:203 PredictKernel — one CUDA thread per row).
+Here the whole row batch advances one tree level per step (rows at leaves
+stick), a ``lax.scan`` walks trees, and the per-row feature read is a
+``take_along_axis`` gather.  Raw feature values + thresholds are used (not
+bins) so the same code serves training-eval and inference on fresh data.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _traverse_one_tree(X, feat, thr, dleft, left, right, depth: int):
+    """Leaf node id per row for one tree. X: (R,F) f32 with NaN missing."""
+    R, F = X.shape
+    nid = jnp.zeros(R, jnp.int32)
+
+    def step(_, nid):
+        fi = feat[nid]  # (R,) int32, -1 at leaves
+        leaf = fi < 0
+        x = jnp.take_along_axis(X, jnp.clip(fi, 0, F - 1)[:, None], axis=1)[:, 0]
+        gol = jnp.where(jnp.isnan(x), dleft[nid], x < thr[nid])
+        nxt = jnp.where(gol, left[nid], right[nid])
+        return jnp.where(leaf, nid, nxt)
+
+    return lax.fori_loop(0, depth, step, nid)
+
+
+@functools.partial(jax.jit, static_argnames=("n_groups", "depth"))
+def predict_margin_delta(X, feat, thr, dleft, left, right, value, groups,
+                         *, n_groups: int, depth: int):
+    """Sum leaf values of a stack of trees into (R, n_groups) margin deltas.
+
+    feat..value : (T, M) stacked padded tree arrays; groups: (T,) int32
+    (tree_info group ids, reference src/gbm/gbtree_model.h).
+    """
+    R = X.shape[0]
+
+    def body(margin, t):
+        f, th, dl, l, r, v, grp = t
+        nid = _traverse_one_tree(X, f, th, dl, l, r, depth)
+        delta = v[nid]
+        col = lax.dynamic_slice_in_dim(margin, grp, 1, axis=1)
+        margin = lax.dynamic_update_slice_in_dim(margin, col + delta[:, None], grp, axis=1)
+        return margin, None
+
+    margin0 = jnp.zeros((R, n_groups), jnp.float32)
+    margin, _ = lax.scan(body, margin0, (feat, thr, dleft, left, right, value, groups))
+    return margin
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def predict_leaf_ids(X, feat, thr, dleft, left, right, *, depth: int):
+    """(R, T) leaf indices (reference: Predictor::PredictLeaf)."""
+    def body(_, t):
+        f, th, dl, l, r = t
+        return None, _traverse_one_tree(X, f, th, dl, l, r, depth)
+
+    _, nids = lax.scan(body, None, (feat, thr, dleft, left, right))
+    return nids.T

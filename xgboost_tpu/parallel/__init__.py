@@ -1,0 +1,9 @@
+"""Distributed training over a jax.sharding.Mesh (reference: src/collective/ —
+here the mesh replaces sockets/NCCL/tracker, SURVEY §2 L1)."""
+from .mesh import (DATA_AXIS, init_distributed, make_mesh, replicated,
+                   row2d_sharding, row_sharding, shard_rows)
+from .grower import ShardedHistTreeGrower
+
+__all__ = ["DATA_AXIS", "init_distributed", "make_mesh", "replicated",
+           "row2d_sharding", "row_sharding", "shard_rows",
+           "ShardedHistTreeGrower"]

@@ -1,0 +1,76 @@
+"""Device mesh + sharding helpers.
+
+TPU-native replacement for the reference's collective bootstrap
+(src/collective/comm_group.h CommGroup + tracker): there is no tracker — the
+mesh IS the communicator.  GBDT training is pure row-sharded data parallelism
+(SURVEY §2 L1: the only cross-worker primitive is the histogram allreduce), so
+the mesh is 1-D over a ``data`` axis; ICI carries the psum on a pod, DCN
+across slices, all chosen by XLA.
+
+Multi-host: call ``init_distributed()`` (jax.distributed.initialize) before
+building the mesh — the analogue of RabitTracker rendezvous (tracker.h:141).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+DATA_AXIS = "data"
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap (replaces tracker rendezvous, tracker.cc)."""
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)
+
+
+def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None):
+    """1-D data-parallel mesh over the first n devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def row_sharding(mesh):
+    """NamedSharding: leading (row) dim split over the data axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def row2d_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def shard_rows(mesh, *arrays):
+    """Place arrays row-sharded over the mesh (no-op copies if already placed)."""
+    import jax
+
+    out = []
+    for a in arrays:
+        sh = row2d_sharding(mesh) if a.ndim >= 2 else row_sharding(mesh)
+        out.append(jax.device_put(a, sh))
+    return tuple(out)

@@ -1,0 +1,79 @@
+"""Test data generators (reference: python-package/xgboost/testing/data.py —
+make_sparse_regression:933, make_categorical:1034, make_ltr:813; C++
+RandomDataGenerator tests/cpp/helpers.h:224)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def make_regression(n: int = 1000, f: int = 10, *, sparsity: float = 0.0,
+                    seed: int = 0, noise: float = 0.1) -> Tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=f)
+    y = (X @ w + noise * rng.normal(size=n)).astype(np.float32)
+    if sparsity > 0:
+        mask = rng.random((n, f)) < sparsity
+        X[mask] = np.nan
+    return X, y
+
+
+def make_binary(n: int = 1000, f: int = 10, *, sparsity: float = 0.0,
+                seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    X, y = make_regression(n, f, sparsity=sparsity, seed=seed, noise=0.5)
+    return X, (y > np.median(y)).astype(np.float32)
+
+
+def make_multiclass(n: int = 1000, f: int = 10, k: int = 4, *, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=2.0, size=(k, f))
+    y = rng.integers(0, k, size=n)
+    X = (centers[y] + rng.normal(size=(n, f))).astype(np.float32)
+    return X, y.astype(np.float32)
+
+
+def make_ltr(n_query: int = 30, max_docs: int = 40, f: int = 8, *, seed: int = 0):
+    """Learning-to-rank data: (X, relevance, qid) with graded labels 0-4."""
+    rng = np.random.default_rng(seed)
+    Xs, ys, qids = [], [], []
+    for q in range(n_query):
+        nd = int(rng.integers(2, max_docs))
+        Xq = rng.normal(size=(nd, f)).astype(np.float32)
+        score = Xq[:, 0] + 0.5 * Xq[:, 1] + 0.3 * rng.normal(size=nd)
+        rel = np.clip(np.digitize(score, [-1.0, -0.3, 0.3, 1.0]), 0, 4)
+        Xs.append(Xq)
+        ys.append(rel.astype(np.float32))
+        qids.append(np.full(nd, q, np.int64))
+    return np.concatenate(Xs), np.concatenate(ys), np.concatenate(qids)
+
+
+def make_sparse_csr(n: int = 500, f: int = 20, density: float = 0.2, seed: int = 0):
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(seed)
+    M = sp.random(n, f, density=density, random_state=np.random.RandomState(seed),
+                  format="csr", dtype=np.float32)
+    y = np.asarray(M.sum(axis=1)).ravel() + 0.1 * rng.normal(size=n)
+    return M, y.astype(np.float32)
+
+
+def make_categorical(n: int = 500, num_f: int = 4, cat_f: int = 3, n_cats: int = 6,
+                     seed: int = 0, as_pandas: bool = True):
+    rng = np.random.default_rng(seed)
+    import pandas as pd
+
+    cols = {}
+    y = np.zeros(n)
+    for i in range(num_f):
+        v = rng.normal(size=n)
+        cols[f"num{i}"] = v.astype(np.float32)
+        y += v * rng.normal()
+    for i in range(cat_f):
+        codes = rng.integers(0, n_cats, size=n)
+        effect = rng.normal(size=n_cats)
+        y += effect[codes]
+        cols[f"cat{i}"] = pd.Categorical.from_codes(codes, categories=[f"c{j}" for j in range(n_cats)])
+    df = pd.DataFrame(cols)
+    return df, (y + 0.1 * rng.normal(size=n)).astype(np.float32)

@@ -1,0 +1,130 @@
+"""Pure-numpy reference implementation of hist tree growing.
+
+The correctness oracle for the device kernels (the role xgboost-CPU plays in
+the reference's GPU↔CPU parity tests, tests/python-gpu/test_gpu_updaters.py).
+Implements the same semantics as ops/histogram.py + ops/split.py +
+tree/grow.py with plain loops: any divergence is a bug in one of them.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def threshold_l1(g, alpha):
+    return np.sign(g) * np.maximum(np.abs(g) - alpha, 0.0)
+
+
+def calc_weight(G, H, lam, alpha, max_delta_step):
+    if H <= 0:
+        return 0.0
+    w = -threshold_l1(G, alpha) / (H + lam)
+    if max_delta_step > 0:
+        w = float(np.clip(w, -max_delta_step, max_delta_step))
+    return float(w)
+
+
+def calc_gain(G, H, lam, alpha, max_delta_step):
+    if H <= 0:
+        return 0.0
+    if max_delta_step == 0.0:
+        return float(threshold_l1(G, alpha) ** 2 / (H + lam))
+    w = calc_weight(G, H, lam, alpha, max_delta_step)
+    return float(-(2.0 * threshold_l1(G, alpha) * w + (H + lam) * w * w))
+
+
+def build_hist_np(bins, gpair, rows, n_bin):
+    """(F, B, 2) histogram over the given row subset; sentinel bins ignored."""
+    F = bins.shape[1]
+    hist = np.zeros((F, n_bin, 2), np.float64)
+    for r in rows:
+        for f in range(F):
+            b = int(bins[r, f])
+            if b < n_bin:
+                hist[f, b, 0] += gpair[r, 0]
+                hist[f, b, 1] += gpair[r, 1]
+    return hist
+
+
+def best_split_np(hist, total, n_bins_arr, lam, alpha, mds, min_child_weight, eps=1e-6):
+    """Mirror of ops/split.py evaluate_splits for one node. Returns dict or None."""
+    F, B, _ = hist.shape
+    parent_gain = calc_gain(total[0], total[1], lam, alpha, mds)
+    best = None
+    for f in range(F):
+        nb = int(n_bins_arr[f])
+        feat_sum = hist[f, :, :].sum(axis=0)
+        miss = total - feat_sum
+        for dleft in (True, False):
+            GL = HL = 0.0
+            if dleft:
+                GL, HL = miss[0], miss[1]
+            for b in range(nb):
+                GL += hist[f, b, 0]
+                HL += hist[f, b, 1]
+                if b == nb - 1:
+                    # top bin only valid when missing mass goes right
+                    if dleft or abs(miss[1]) <= eps:
+                        continue
+                GR, HR = total[0] - GL, total[1] - HL
+                if HL < min_child_weight or HR < min_child_weight or HL <= 0 or HR <= 0:
+                    continue
+                gain = (
+                    calc_gain(GL, HL, lam, alpha, mds)
+                    + calc_gain(GR, HR, lam, alpha, mds)
+                    - parent_gain
+                )
+                # tie-break identical to device: flat argmax over (f, b) with
+                # default-left preferred on exact ties
+                key = (gain, -(f * B + b), dleft)
+                if best is None or key > (best["gain"], -(best["f"] * B + best["b"]), best["dleft"]):
+                    best = dict(gain=gain, f=f, b=b, dleft=dleft,
+                                left=(GL, HL), right=(GR, HR))
+    return best
+
+
+def grow_tree_np(bins, gpair, n_bin, n_bins_arr, max_depth, lam=1.0, alpha=0.0,
+                 mds=0.0, min_child_weight=1.0, gamma=0.0, eta=0.3):
+    """Depthwise growth over heap node ids; returns dict heap arrays like
+    tree/grow.py TreeState (host mirror)."""
+    R = bins.shape[0]
+    max_nodes = (1 << (max_depth + 1)) - 1
+    feat = np.full(max_nodes, -1, np.int32)
+    sbin = np.zeros(max_nodes, np.int32)
+    dleft = np.ones(max_nodes, bool)
+    leaf_val = np.zeros(max_nodes, np.float64)
+    is_leaf = np.zeros(max_nodes, bool)
+    totals = np.zeros((max_nodes, 2), np.float64)
+    rows_of = {0: np.arange(R)}
+    totals[0] = gpair.sum(axis=0)
+    gamma_eps = max(gamma, 1e-6)
+
+    for d in range(max_depth + 1):
+        for node in range((1 << d) - 1, (1 << (d + 1)) - 1):
+            rows = rows_of.get(node)
+            if rows is None:
+                continue
+            total = totals[node]
+            if d == max_depth:
+                is_leaf[node] = True
+                leaf_val[node] = eta * calc_weight(total[0], total[1], lam, alpha, mds)
+                continue
+            hist = build_hist_np(bins, gpair, rows, n_bin)
+            best = best_split_np(hist, total, n_bins_arr, lam, alpha, mds, min_child_weight)
+            if best is None or best["gain"] <= gamma_eps:
+                is_leaf[node] = True
+                leaf_val[node] = eta * calc_weight(total[0], total[1], lam, alpha, mds)
+                continue
+            feat[node] = best["f"]
+            sbin[node] = best["b"]
+            dleft[node] = best["dleft"]
+            f, b = best["f"], best["b"]
+            bv = bins[rows, f]
+            go_left = np.where(bv >= n_bin, best["dleft"], bv <= b)
+            rows_of[2 * node + 1] = rows[go_left]
+            rows_of[2 * node + 2] = rows[~go_left]
+            totals[2 * node + 1] = best["left"]
+            totals[2 * node + 2] = best["right"]
+    return dict(feat=feat, sbin=sbin, dleft=dleft, leaf_val=leaf_val,
+                is_leaf=is_leaf, totals=totals, rows_of=rows_of)

@@ -1,0 +1,195 @@
+"""train() / cv() drivers (reference: python-package/xgboost/training.py:53,435).
+
+The loop shape matches the reference exactly: callbacks wrap a plain
+``bst.update`` per round; cv() builds stratified/group folds (CVPack,
+training.py:212) and aggregates fold metrics.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .callback import CallbackContainer, EarlyStopping, EvaluationMonitor, TrainingCallback
+from .core import Booster
+from .data.dmatrix import DMatrix
+
+__all__ = ["train", "cv"]
+
+
+def train(
+    params: Dict[str, Any],
+    dtrain: DMatrix,
+    num_boost_round: int = 10,
+    *,
+    evals: Optional[Sequence[Tuple[DMatrix, str]]] = None,
+    obj: Optional[Callable] = None,
+    maximize: Optional[bool] = None,
+    early_stopping_rounds: Optional[int] = None,
+    evals_result: Optional[dict] = None,
+    verbose_eval: Union[bool, int, None] = True,
+    xgb_model: Optional[Union[str, Booster]] = None,
+    callbacks: Optional[Sequence[TrainingCallback]] = None,
+    custom_metric: Optional[Callable] = None,
+) -> Booster:
+    callbacks = list(callbacks) if callbacks else []
+    evals = list(evals) if evals else []
+    if early_stopping_rounds is not None:
+        if not evals:
+            raise ValueError(
+                "Must have at least 1 validation dataset for early stopping."
+            )
+        callbacks.append(EarlyStopping(rounds=early_stopping_rounds, maximize=maximize))
+    if verbose_eval:
+        period = 1 if verbose_eval is True else int(verbose_eval)
+        callbacks.append(EvaluationMonitor(period=period))
+    cbs = CallbackContainer(callbacks, metric=custom_metric)
+
+    if isinstance(xgb_model, (str, bytes, bytearray)):
+        bst = Booster(params)
+        bst.load_model(xgb_model)
+        bst.set_param(params)
+    elif isinstance(xgb_model, Booster):
+        bst = xgb_model.copy()
+        bst.set_param(params)
+    else:
+        bst = Booster(params, cache=[dtrain])
+
+    bst = cbs.before_training(bst)
+    start = bst.num_boosted_rounds()
+    for i in range(start, start + num_boost_round):
+        if cbs.before_iteration(bst, i, dtrain, evals):
+            break
+        bst.update(dtrain, i, fobj=obj)
+        if cbs.after_iteration(bst, i, dtrain, evals):
+            break
+    bst = cbs.after_training(bst)
+
+    if evals_result is not None:
+        evals_result.update(cbs.history)
+    return bst
+
+
+class CVPack:
+    """One fold (reference: training.py:212)."""
+
+    def __init__(self, dtrain: DMatrix, dtest: DMatrix, params):
+        self.dtrain = dtrain
+        self.dtest = dtest
+        self.watchlist = [(dtrain, "train"), (dtest, "test")]
+        self.bst = Booster(params, cache=[dtrain, dtest])
+
+    def update(self, iteration: int, fobj) -> None:
+        self.bst.update(self.dtrain, iteration, fobj)
+
+    def eval(self, iteration: int, feval) -> str:
+        return self.bst.eval_set(self.watchlist, iteration, feval)
+
+
+def _make_folds(dall: DMatrix, nfold: int, params, seed: int, shuffle: bool,
+                stratified: bool, folds) -> List[CVPack]:
+    R = dall.num_row()
+    rng = np.random.default_rng(seed)
+    if folds is not None:
+        splits = [(np.asarray(tr), np.asarray(te)) for tr, te in folds]
+    else:
+        idx = np.arange(R)
+        label = dall.get_label()
+        if stratified:
+            if shuffle:
+                # random within equal-label blocks, stratified across folds
+                order = np.lexsort((rng.random(R), label))
+            else:
+                order = np.argsort(label, kind="stable")
+            fold_of = np.empty(R, np.int64)
+            fold_of[order] = np.arange(R) % nfold
+        else:
+            if shuffle:
+                idx = rng.permutation(R)
+            fold_of = np.empty(R, np.int64)
+            fold_of[idx] = np.arange(R) % nfold
+        splits = [
+            (np.nonzero(fold_of != k)[0], np.nonzero(fold_of == k)[0]) for k in range(nfold)
+        ]
+    return [CVPack(dall.slice(tr), dall.slice(te), params) for tr, te in splits]
+
+
+def cv(
+    params: Dict[str, Any],
+    dtrain: DMatrix,
+    num_boost_round: int = 10,
+    nfold: int = 3,
+    *,
+    stratified: bool = False,
+    folds=None,
+    metrics: Sequence[str] = (),
+    obj: Optional[Callable] = None,
+    maximize: Optional[bool] = None,
+    early_stopping_rounds: Optional[int] = None,
+    as_pandas: bool = True,
+    verbose_eval: Union[bool, int, None] = None,
+    show_stdv: bool = True,
+    seed: int = 0,
+    callbacks: Optional[Sequence[TrainingCallback]] = None,
+    shuffle: bool = True,
+    custom_metric: Optional[Callable] = None,
+):
+    """K-fold CV (reference: training.py:435). Returns a dict/DataFrame of
+    per-round mean/std metric values."""
+    params = dict(params)
+    if metrics:
+        params["eval_metric"] = list(metrics) if len(list(metrics)) > 1 else list(metrics)[0]
+    packs = _make_folds(dtrain, nfold, params, seed, shuffle, stratified, folds)
+
+    callbacks = list(callbacks) if callbacks else []
+    if early_stopping_rounds is not None:
+        callbacks.append(EarlyStopping(rounds=early_stopping_rounds, maximize=maximize))
+    if verbose_eval:
+        callbacks.append(EvaluationMonitor(period=1 if verbose_eval is True else int(verbose_eval)))
+    cbs = CallbackContainer(callbacks, is_cv=True)
+
+    class _Agg:
+        """Aggregate booster stand-in handed to callbacks (reference _PackedBooster)."""
+
+        best_iteration: Optional[int] = None
+        best_score: Optional[float] = None
+
+        def set_attr(self, **kw):
+            for p in packs:
+                p.bst.set_attr(**kw)
+
+        def set_param(self, k, v=None):
+            for p in packs:
+                p.bst.set_param(k, v)
+
+        def eval_set(self, evals, iteration):  # unused; cv aggregates manually
+            return ""
+
+    agg = _Agg()
+    results: Dict[str, List[float]] = {}
+    for i in range(num_boost_round):
+        if cbs.before_iteration(agg, i, dtrain, []):
+            break
+        fold_metrics: Dict[str, List[float]] = {}
+        for p in packs:
+            p.update(i, obj)
+            msg = p.eval(i, custom_metric)
+            for part in msg.strip().split("\t")[1:]:
+                key, v = part.rsplit(":", 1)
+                fold_metrics.setdefault(key, []).append(float(v))
+        for key, vals in fold_metrics.items():
+            results.setdefault(f"{key}-mean", []).append(float(np.mean(vals)))
+            results.setdefault(f"{key}-std", []).append(float(np.std(vals)))
+            cbs.history.setdefault(key.split("-", 1)[0], {}).setdefault(
+                key.split("-", 1)[1], []
+            ).append(float(np.mean(vals)))
+        if any(cb.after_iteration(agg, i, cbs.history) for cb in cbs.callbacks):
+            break
+    if as_pandas:
+        try:
+            import pandas as pd
+
+            return pd.DataFrame.from_dict(results)
+        except ImportError:
+            pass
+    return results

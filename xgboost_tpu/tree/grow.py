@@ -1,0 +1,266 @@
+"""Depthwise hist tree growing — the ``tpu_hist`` updater core.
+
+TPU-native re-design of the reference's GPU hist updater
+(src/tree/updater_gpu_hist.cu:617 UpdateTree; Driver loop src/tree/driver.h:30).
+The CUDA updater pops variable node batches from a priority queue and mutates
+the tree on host; under XLA we need static shapes, so the tree grows strictly
+level-by-level over a heap-indexed node array (node i -> children 2i+1, 2i+2),
+with one jitted ``level_step`` per depth (compile cache shared across all trees
+and boosting rounds).  Dead heap slots cost nothing: their node masks match no
+rows, so their histograms are zero and they become weightless leaves.
+
+Everything runs on device — histogram (ops/histogram.py), split choice
+(ops/split.py), position update (the RowPartitioner analogue,
+src/tree/gpu_hist/row_partitioner.cuh — here an elementwise ``pos`` rewrite,
+no physical partition) and tree-array writes — so the whole step can be wrapped
+in ``shard_map`` with ``lax.psum`` on the histogram for multi-chip training
+(the reference's AllReduceHist, src/tree/gpu_hist/histogram.cu:598-608).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.histogram import build_histogram, node_sums
+from ..ops.split import BestSplit, SplitParams, calc_weight, evaluate_splits
+
+_EPS = 1e-6
+
+
+class TreeState(NamedTuple):
+    """Device-side tree under construction (heap layout, max_nodes slots)."""
+
+    pos: jnp.ndarray  # (R_pad,) int32 — node id per row, -1 = padded/invalid
+    alive: jnp.ndarray  # (max_nodes,) bool — candidate for expansion
+    totals: jnp.ndarray  # (max_nodes, 2) f32 — node (G, H)
+    feat: jnp.ndarray  # (max_nodes,) int32 — split feature, -1 for leaf
+    sbin: jnp.ndarray  # (max_nodes,) int32 — split bin (left = bins <= sbin)
+    thr: jnp.ndarray  # (max_nodes,) f32 — raw split condition cuts[f][sbin]
+    dleft: jnp.ndarray  # (max_nodes,) bool — default direction for missing
+    is_leaf: jnp.ndarray  # (max_nodes,) bool
+    leaf_val: jnp.ndarray  # (max_nodes,) f32 — eta-scaled leaf weight
+    gain: jnp.ndarray  # (max_nodes,) f32 — loss_chg of the split
+    base_weight: jnp.ndarray  # (max_nodes,) f32 — raw node weight
+    sum_hess: jnp.ndarray  # (max_nodes,) f32
+
+
+def max_nodes_for_depth(max_depth: int) -> int:
+    return (1 << (max_depth + 1)) - 1
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes", "axis_name"))
+def init_tree_state(gpair, valid, *, max_nodes: int, axis_name: Optional[str] = None):
+    """Fresh state: all rows at the root; root totals (all)reduced.
+
+    valid : (R_pad,) bool — False for padding rows.
+    """
+    R = gpair.shape[0]
+    pos = jnp.where(valid, 0, -1).astype(jnp.int32)
+    root = node_sums(gpair, pos, node0=0, n_nodes=1)  # (1, 2)
+    if axis_name is not None:
+        root = lax.psum(root, axis_name)
+    mn = max_nodes
+    totals = jnp.zeros((mn, 2), jnp.float32).at[0].set(root[0])
+    return TreeState(
+        pos=pos,
+        alive=jnp.zeros(mn, bool).at[0].set(True),
+        totals=totals,
+        feat=jnp.full(mn, -1, jnp.int32),
+        sbin=jnp.zeros(mn, jnp.int32),
+        thr=jnp.zeros(mn, jnp.float32),
+        dleft=jnp.ones(mn, bool),
+        is_leaf=jnp.zeros(mn, bool),
+        leaf_val=jnp.zeros(mn, jnp.float32),
+        gain=jnp.zeros(mn, jnp.float32),
+        base_weight=jnp.zeros(mn, jnp.float32),
+        sum_hess=jnp.zeros(mn, jnp.float32),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("depth", "params", "last_level", "axis_name", "hist_impl"),
+)
+def level_step(
+    state: TreeState,
+    bins,
+    gpair,
+    cuts_pad,
+    n_bins,
+    feature_mask,
+    *,
+    depth: int,
+    params: SplitParams,
+    last_level: bool,
+    axis_name: Optional[str] = None,
+    hist_impl: str = "xla",
+):
+    """Expand every alive node at ``depth``: hist -> best split -> apply.
+
+    Mirrors one driver iteration of the reference
+    (updater_gpu_hist.cu:626-646: PartitionAndBuildHist + ReduceHist +
+    EvaluateSplits + ApplySplit), with the node batch = the whole level.
+    """
+    node0 = (1 << depth) - 1
+    N = 1 << depth
+    B = cuts_pad.shape[1]
+
+    idx = node0 + jnp.arange(N, dtype=jnp.int32)
+    totals_lvl = lax.dynamic_slice_in_dim(state.totals, node0, N, axis=0)
+    alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, N, axis=0)
+    w = calc_weight(totals_lvl[:, 0], totals_lvl[:, 1], params)
+
+    if last_level:
+        # no hist needed: every surviving node becomes a leaf
+        return state._replace(
+            is_leaf=state.is_leaf.at[idx].set(alive_lvl),
+            leaf_val=state.leaf_val.at[idx].set(
+                jnp.where(alive_lvl, params.eta * w, 0.0)
+            ),
+            base_weight=state.base_weight.at[idx].set(w),
+            sum_hess=state.sum_hess.at[idx].set(totals_lvl[:, 1]),
+        )
+
+    if hist_impl == "pallas":
+        from ..ops.hist_pallas import build_histogram_pallas
+
+        hist = build_histogram_pallas(bins, gpair, state.pos, node0=node0, n_nodes=N, n_bin=B)
+    else:
+        hist = build_histogram(bins, gpair, state.pos, node0=node0, n_nodes=N, n_bin=B)
+    if axis_name is not None:
+        hist = lax.psum(hist, axis_name)  # the one distributed cost (SURVEY §3.1)
+
+    best = evaluate_splits(hist, totals_lvl, n_bins, params, feature_mask)
+
+    gamma_eps = max(params.gamma, _EPS)
+    can_split = alive_lvl & (best.gain > gamma_eps)
+    new_leaf = alive_lvl & ~can_split
+
+    thr_lvl = cuts_pad[best.feature, jnp.minimum(best.bin, B - 1)]
+
+    st = state
+    st = st._replace(
+        feat=st.feat.at[idx].set(jnp.where(can_split, best.feature, -1)),
+        sbin=st.sbin.at[idx].set(jnp.where(can_split, best.bin, 0)),
+        thr=st.thr.at[idx].set(jnp.where(can_split, thr_lvl, 0.0)),
+        dleft=st.dleft.at[idx].set(best.default_left),
+        is_leaf=st.is_leaf.at[idx].set(new_leaf),
+        leaf_val=st.leaf_val.at[idx].set(jnp.where(new_leaf, params.eta * w, 0.0)),
+        gain=st.gain.at[idx].set(jnp.where(can_split, best.gain, 0.0)),
+        base_weight=st.base_weight.at[idx].set(w),
+        sum_hess=st.sum_hess.at[idx].set(totals_lvl[:, 1]),
+    )
+
+    left_ids = 2 * idx + 1
+    right_ids = 2 * idx + 2
+    st = st._replace(
+        alive=st.alive.at[left_ids].set(can_split).at[right_ids].set(can_split),
+        totals=st.totals.at[left_ids].set(best.left_sum).at[right_ids].set(best.right_sum),
+    )
+
+    # --- position update (RowPartitioner analogue) ---
+    pos = st.pos
+    local = pos - node0
+    in_lvl = (local >= 0) & (local < N)
+    lc = jnp.clip(local, 0, N - 1)
+    can_r = can_split[lc]
+    fr = best.feature[lc]
+    sb = best.bin[lc]
+    dl = best.default_left[lc]
+    binval = jnp.take_along_axis(
+        bins, jnp.clip(fr, 0, bins.shape[1] - 1)[:, None].astype(jnp.int32), axis=1
+    )[:, 0].astype(jnp.int32)
+    goleft = jnp.where(binval >= B, dl, binval <= sb)  # sentinel B = missing
+    child = 2 * pos + 1 + jnp.where(goleft, 0, 1)
+    st = st._replace(pos=jnp.where(in_lvl & can_r, child, pos))
+
+    return st
+
+
+@jax.jit
+def leaf_margin_delta(pos, leaf_val):
+    """Per-row margin update from the finished tree — the prediction-cache
+    fast path (reference: TreeUpdater::UpdatePredictionCache,
+    include/xgboost/tree_updater.h:92): every row sits on its leaf already."""
+    safe = jnp.clip(pos, 0, leaf_val.shape[0] - 1)
+    return jnp.where(pos >= 0, leaf_val[safe], 0.0)
+
+
+class GrownTree(NamedTuple):
+    """Host copy of a finished tree (heap layout)."""
+
+    feat: "object"
+    sbin: "object"
+    thr: "object"
+    dleft: "object"
+    is_leaf: "object"
+    leaf_val: "object"
+    gain: "object"
+    base_weight: "object"
+    sum_hess: "object"
+    totals: "object"
+
+
+class HistTreeGrower:
+    """Host driver looping jitted level steps (reference: GPUHistMaker::Update,
+    src/tree/updater_gpu_hist.cu:703)."""
+
+    def __init__(
+        self,
+        max_depth: int,
+        params: SplitParams,
+        *,
+        axis_name: Optional[str] = None,
+        hist_impl: str = "xla",
+    ) -> None:
+        self.max_depth = max_depth
+        self.params = params
+        self.axis_name = axis_name
+        self.hist_impl = hist_impl
+        self.max_nodes = max_nodes_for_depth(max_depth)
+
+    def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None) -> TreeState:
+        """feature_masks: None, or callable (depth, n_nodes) -> (1|N, F) bool mask
+        (the ColumnSampler hook: bytree/bylevel/bynode, src/common/random.h)."""
+        F = bins.shape[1]
+        ones = jnp.ones((1, F), dtype=bool)
+        state = init_tree_state(
+            gpair, valid, max_nodes=self.max_nodes, axis_name=self.axis_name
+        )
+        for d in range(self.max_depth + 1):
+            fm = ones if feature_masks is None else feature_masks(d, 1 << d)
+            state = level_step(
+                state,
+                bins,
+                gpair,
+                cuts_pad,
+                n_bins,
+                fm,
+                depth=d,
+                params=self.params,
+                last_level=(d == self.max_depth),
+                axis_name=self.axis_name,
+                hist_impl=self.hist_impl,
+            )
+        return state
+
+    @staticmethod
+    def to_host(state: TreeState) -> GrownTree:
+        import numpy as np
+
+        return GrownTree(
+            feat=np.asarray(state.feat),
+            sbin=np.asarray(state.sbin),
+            thr=np.asarray(state.thr),
+            dleft=np.asarray(state.dleft),
+            is_leaf=np.asarray(state.is_leaf),
+            leaf_val=np.asarray(state.leaf_val),
+            gain=np.asarray(state.gain),
+            base_weight=np.asarray(state.base_weight),
+            sum_hess=np.asarray(state.sum_hess),
+            totals=np.asarray(state.totals),
+        )
