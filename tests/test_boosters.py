@@ -1,0 +1,92 @@
+"""Booster variants: gblinear, DART, num_parallel_tree
+(reference: tests/python/test_linear.py, test_dart.py aspects of
+tests/python/test_basic_models.py)."""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.testing.data import make_binary, make_regression
+
+
+def test_gblinear_recovers_coefficients():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1000, 5)).astype(np.float32)
+    true_w = np.array([1.0, -2.0, 0.5, 0.0, 3.0], np.float32)
+    y = X @ true_w + 0.05 * rng.normal(size=1000).astype(np.float32)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"booster": "gblinear", "objective": "reg:squarederror",
+                     "eta": 0.7, "lambda": 0.01}, d, 40, verbose_eval=False)
+    np.testing.assert_allclose(bst.linear_weights[:, 0], true_w, atol=0.05)
+    p = bst.predict(d)
+    assert np.sqrt(np.mean((p - y) ** 2)) < 0.1
+
+
+def test_gblinear_l1_sparsity():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(500, 10)).astype(np.float32)
+    y = (2 * X[:, 0]).astype(np.float32)  # only feature 0 matters
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"booster": "gblinear", "objective": "reg:squarederror",
+                     "eta": 0.7, "alpha": 5.0, "lambda": 0.0}, d, 40,
+                    verbose_eval=False)
+    w = bst.linear_weights[:, 0]
+    assert abs(w[0]) > 0.5
+    assert np.abs(w[1:]).max() < 0.05  # L1 zeroes the noise features
+
+
+def test_gblinear_save_load_roundtrip(tmp_path):
+    X, y = make_regression(300, 4, seed=2)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"booster": "gblinear", "objective": "reg:squarederror"},
+                    d, 10, verbose_eval=False)
+    f = str(tmp_path / "lin.json")
+    bst.save_model(f)
+    b2 = xtb.Booster()
+    b2.load_model(f)
+    np.testing.assert_allclose(b2.predict(d), bst.predict(d), rtol=1e-5)
+
+
+def test_dart_trains_and_roundtrips(tmp_path):
+    X, y = make_binary(500, 6, seed=3)
+    d = xtb.DMatrix(X, label=y)
+    res = {}
+    bst = xtb.train({"booster": "dart", "objective": "binary:logistic",
+                     "rate_drop": 0.4, "one_drop": 1, "max_depth": 3, "seed": 5},
+                    d, 15, evals=[(d, "t")], evals_result=res, verbose_eval=False)
+    ll = res["t"]["logloss"]
+    assert ll[-1] < ll[0]
+    assert any(w != 1.0 for w in bst.tree_weights)  # dropout actually fired
+    f = str(tmp_path / "dart.json")
+    bst.save_model(f)
+    b2 = xtb.Booster()
+    b2.load_model(f)
+    np.testing.assert_allclose(b2.predict(d), bst.predict(d), rtol=1e-5)
+
+
+def test_dart_weighted_sampling():
+    X, y = make_binary(400, 5, seed=6)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"booster": "dart", "objective": "binary:logistic",
+                     "rate_drop": 0.3, "sample_type": "weighted",
+                     "normalize_type": "forest", "max_depth": 3}, d, 10,
+                    verbose_eval=False)
+    assert np.isfinite(bst.predict(d)).all()
+
+
+def test_num_parallel_tree_forest():
+    X, y = make_regression(500, 6, seed=4)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "reg:squarederror", "num_parallel_tree": 4,
+                     "subsample": 0.8, "colsample_bynode": 0.8, "eta": 1.0,
+                     "max_depth": 4, "seed": 9}, d, 3, verbose_eval=False)
+    assert len(bst.trees) == 12
+    assert bst.num_boosted_rounds() == 3
+    # slicing respects rounds (4 trees each)
+    b1 = bst[0:1]
+    assert len(b1.trees) == 4
+    # random forest (single round, eta=1) should fit decently
+    rf = xtb.XGBRFRegressor(n_estimators=1, num_parallel_tree=20, max_depth=6,
+                            random_state=0)
+    rf.fit(X, y)
+    pred = rf.predict(X)
+    assert np.corrcoef(pred, y)[0, 1] > 0.9

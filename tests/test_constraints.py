@@ -1,0 +1,116 @@
+"""Monotone / interaction constraints, max_leaves, adaptive leaves
+(reference: tests/python/test_monotone_constraints.py,
+test_interaction_constraints.py)."""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.testing.data import make_regression
+
+
+def _monotone_data(n=800, seed=0):
+    rng = np.random.default_rng(seed)
+    x0 = rng.uniform(-3, 3, n)
+    x1 = rng.uniform(-3, 3, n)
+    y = 2 * x0 - 1.5 * x1 + 0.3 * np.sin(4 * x0) + 0.2 * rng.normal(size=n)
+    X = np.stack([x0, x1], axis=1).astype(np.float32)
+    return X, y.astype(np.float32)
+
+
+def _check_monotone(bst, sign, feature, grid=30):
+    """Predictions must be monotone in `feature` for any fixed other values."""
+    rng = np.random.default_rng(1)
+    base = rng.uniform(-3, 3, size=(20, 2)).astype(np.float32)
+    xs = np.linspace(-3, 3, grid, dtype=np.float32)
+    for row in base:
+        pts = np.tile(row, (grid, 1))
+        pts[:, feature] = xs
+        p = bst.predict(xtb.DMatrix(pts))
+        diffs = np.diff(p)
+        if sign > 0:
+            assert (diffs >= -1e-5).all(), diffs.min()
+        else:
+            assert (diffs <= 1e-5).all(), diffs.max()
+
+
+def test_monotone_increasing_decreasing():
+    X, y = _monotone_data()
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train(
+        {"objective": "reg:squarederror", "max_depth": 4,
+         "monotone_constraints": "(1,-1)", "eta": 0.5},
+        d, 15, verbose_eval=False,
+    )
+    _check_monotone(bst, +1, 0)
+    _check_monotone(bst, -1, 1)
+    # and the unconstrained model does violate (sanity that the test can fail)
+    bst2 = xtb.train({"objective": "reg:squarederror", "max_depth": 4, "eta": 0.5},
+                     d, 15, verbose_eval=False)
+    with pytest.raises(AssertionError):
+        _check_monotone(bst2, +1, 0)
+
+
+def test_interaction_constraints_respected():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(600, 4)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + X[:, 2] - X[:, 3] + 0.1 * rng.normal(size=600)).astype(
+        np.float32
+    )
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train(
+        {"objective": "reg:squarederror", "max_depth": 4,
+         "interaction_constraints": [[0, 1], [2, 3]]},
+        d, 8, verbose_eval=False,
+    )
+    # every root-to-leaf path must use features from a single constraint set
+    for tree in bst.trees:
+        def rec(nid, used):
+            if tree.left_children[nid] == -1:
+                if used:
+                    assert used <= {0, 1} or used <= {2, 3}, used
+                return
+            f = int(tree.split_indices[nid])
+            rec(tree.left_children[nid], used | {f})
+            rec(tree.right_children[nid], used | {f})
+        rec(0, set())
+
+
+def test_max_leaves_budget():
+    X, y = make_regression(600, 8, seed=3)
+    d = xtb.DMatrix(X, label=y)
+    for policy in ("depthwise", "lossguide"):
+        bst = xtb.train(
+            {"objective": "reg:squarederror", "max_depth": 6, "max_leaves": 8,
+             "grow_policy": policy},
+            d, 3, verbose_eval=False,
+        )
+        for t in bst.trees:
+            assert t.num_leaves <= 8, (policy, t.num_leaves)
+
+
+def test_adaptive_leaf_mae():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(500, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1]).astype(np.float32)
+    d = xtb.DMatrix(X, label=y)
+    res = {}
+    xtb.train({"objective": "reg:absoluteerror", "max_depth": 4, "eta": 0.5},
+              d, 25, evals=[(d, "t")], evals_result=res, verbose_eval=False)
+    mae = res["t"]["mae"]
+    assert mae[-1] < 0.25 * mae[0], mae[::6]
+
+
+def test_quantile_objective_coverage():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(1500, 3)).astype(np.float32)
+    y = (X[:, 0] + rng.normal(size=1500)).astype(np.float32)
+    d = xtb.DMatrix(X, label=y)
+    for alpha in (0.2, 0.8):
+        bst = xtb.train(
+            {"objective": "reg:quantileerror", "quantile_alpha": alpha,
+             "max_depth": 4, "eta": 0.3},
+            d, 40, verbose_eval=False,
+        )
+        p = bst.predict(d)
+        cover = float((y <= p).mean())
+        assert abs(cover - alpha) < 0.1, (alpha, cover)

@@ -50,6 +50,7 @@ class _Cache:
         self.n_padded = dmat.num_row()  # grows to the padded size on ensure_train
         self.margin: Optional[Any] = None  # (n_padded, K) device
         self.n_trees_applied = 0
+        self.weights_version = 0  # DART tree-weight epoch this margin reflects
         self.raw_X: Optional[Any] = None  # lazily staged raw matrix for eval predict
 
     def ensure_train(self) -> None:
@@ -136,6 +137,20 @@ class Booster:
         if booster not in ("gbtree", "dart", "gblinear"):
             raise ValueError(f"unknown booster {booster}")
         self.booster_kind = booster
+        self.num_parallel_tree = int(p.get("num_parallel_tree", 1))
+        if not hasattr(self, "tree_weights"):
+            self.tree_weights: List[float] = []
+        if not hasattr(self, "linear_weights"):
+            self.linear_weights: Optional[np.ndarray] = None  # (F, K)
+            self.linear_bias: Optional[np.ndarray] = None  # (K,)
+        # DART (reference: src/gbm/gbtree.cc Dart booster)
+        self.rate_drop = float(p.get("rate_drop", 0.0))
+        self.skip_drop = float(p.get("skip_drop", 0.0))
+        self.one_drop = str(p.get("one_drop", "0")).lower() in ("1", "true")
+        self.sample_type = str(p.get("sample_type", "uniform"))
+        self.normalize_type = str(p.get("normalize_type", "tree"))
+        if self.tparam.monotone_constraints is not None:
+            pass  # length checked on first training touch (needs n_features)
         self._split_params = SplitParams(
             eta=float(self.tparam.eta),
             gamma=float(self.tparam.gamma),
@@ -143,6 +158,7 @@ class Booster:
             lambda_=float(self.tparam.lambda_),
             alpha=float(self.tparam.alpha),
             max_delta_step=float(self.tparam.max_delta_step),
+            monotone=self.tparam.monotone_constraints,
         )
         self._configured = True
 
@@ -208,6 +224,21 @@ class Booster:
         import jax.numpy as jnp
 
         self._ensure_base_margin(cache)
+        if self.booster_kind == "gblinear":
+            rounds = getattr(self, "_linear_rounds", 0)
+            if self.linear_weights is None or cache.n_trees_applied == rounds > 0:
+                if cache.margin is None:
+                    cache.margin = cache.base_margin_init(
+                        self._base_margin_value, self.n_groups)
+                return
+            cache.margin = self._linear_margin(cache)
+            cache.n_trees_applied = rounds
+            return
+        if cache.weights_version != getattr(self, "_weights_version", 0):
+            # DART rescaled historical trees: rebuild this cache from scratch
+            cache.margin = cache.base_margin_init(self._base_margin_value, self.n_groups)
+            cache.n_trees_applied = 0
+            cache.weights_version = getattr(self, "_weights_version", 0)
         if cache.n_trees_applied < len(self.trees):
             new = slice(cache.n_trees_applied, len(self.trees))
             if cache.raw_X is None:
@@ -265,7 +296,10 @@ class Booster:
                 cache.margin, cache.labels, cache.weights, iteration
             )  # (R_pad, K, 2)
         gpair = gpair * cache.valid[:, None, None]
-        self._boost_trees(cache, gpair, iteration)
+        if self.booster_kind == "gblinear":
+            self._boost_linear(cache, gpair)
+        else:
+            self._boost_trees(cache, gpair, iteration)
 
     def boost(self, dtrain: DMatrix, grad, hess, iteration: int = 0) -> None:
         """Custom-gradient boost (reference: XGBoosterBoostOneIter)."""
@@ -282,7 +316,58 @@ class Booster:
         pad = cache.ellpack.n_padded - R
         gpair = jnp.asarray(np.pad(gpair, ((0, pad), (0, 0), (0, 0))))
         gpair = gpair * cache.valid[:, None, None]
-        self._boost_trees(cache, gpair, iteration)
+        if self.booster_kind == "gblinear":
+            self._boost_linear(cache, gpair)
+        else:
+            self._boost_trees(cache, gpair, iteration)
+
+    def _linear_margin(self, cache: _Cache):
+        """Full (padded) margin of the current linear model for a cache."""
+        import jax.numpy as jnp
+
+        from .models.gblinear import linear_predict
+
+        if cache.raw_X is None:
+            cache.raw_X = jnp.asarray(cache.dmat.host_dense(), jnp.float32)
+        base = jnp.asarray(self._base_margin_value)[None, :]
+        m = linear_predict(cache.raw_X, jnp.asarray(self.linear_weights),
+                           jnp.asarray(self.linear_bias)) + base
+        pad = (cache.margin.shape[0] if cache.margin is not None else cache.n_padded) - m.shape[0]
+        if pad:
+            m = jnp.concatenate([m, jnp.zeros((pad, m.shape[1]), jnp.float32)], 0)
+        return m
+
+    def _boost_linear(self, cache: _Cache, gpair) -> None:
+        """gblinear round (reference: src/gbm/gblinear.cc GBLinear::DoBoost)."""
+        import jax.numpy as jnp
+
+        from .models.gblinear import linear_predict, linear_update
+
+        F = cache.dmat.num_col()
+        K = gpair.shape[1]
+        if self.linear_weights is None:
+            self.linear_weights = np.zeros((F, K), np.float32)
+            self.linear_bias = np.zeros(K, np.float32)
+        if cache.raw_X is None:
+            cache.raw_X = jnp.asarray(cache.dmat.host_dense(), jnp.float32)
+        Xz = jnp.nan_to_num(cache.raw_X, nan=0.0)
+        updater = str(self.params.get("updater", "coord_descent"))
+        W = jnp.asarray(self.linear_weights)
+        b = jnp.asarray(self.linear_bias)
+        R = cache.dmat.num_row()
+        for k in range(K):
+            wk, bk = linear_update(
+                Xz, gpair[:R, k, :], W[:, k], b[k],
+                eta=float(self.tparam.eta), lambda_=float(self.tparam.lambda_),
+                alpha=float(self.tparam.alpha), updater=updater,
+            )
+            W = W.at[:, k].set(wk)
+            b = b.at[k].set(bk)
+        self.linear_weights = np.asarray(W)
+        self.linear_bias = np.asarray(b)
+        self._linear_rounds = getattr(self, "_linear_rounds", 0) + 1
+        cache.margin = self._linear_margin(cache)
+        cache.n_trees_applied = self._linear_rounds
 
     def _rng(self, iteration: int, tag: int) -> np.random.Generator:
         seed = int(self.params.get("seed", 0))
@@ -339,30 +424,137 @@ class Booster:
     def _boost_trees(self, cache: _Cache, gpair, iteration: int) -> None:
         import jax.numpy as jnp
 
-        gpair = self._subsample_mask(gpair, iteration)
         ell = cache.ellpack
+        mono = self.tparam.monotone_constraints
+        if mono is not None and len(mono) != ell.n_features:
+            raise ValueError(
+                f"monotone_constraints has {len(mono)} entries but data has "
+                f"{ell.n_features} features"
+            )
+        lossguide = self.tparam.grow_policy == "lossguide"
+        max_depth = self.tparam.max_depth
+        if max_depth <= 0:
+            # lossguide with unbounded depth: cap at 10 heap levels for static
+            # shapes (deeper growth is a planned extension)
+            max_depth = 10 if lossguide else 6
         grower = HistTreeGrower(
-            self.tparam.max_depth if self.tparam.max_depth > 0 else 6,
+            max_depth,
             self._split_params,
             hist_impl=str(self.params.get("_hist_impl", "xla")),
+            interaction_sets=self.tparam.interaction_constraints,
+            max_leaves=self.tparam.max_leaves,
+            lossguide=lossguide,
         )
         K = gpair.shape[1]
+        adaptive = (
+            hasattr(self.objective, "adaptive_leaf") and self.objective.adaptive_leaf()
+        )
+
+        # ---- DART dropout (reference: gbtree.cc Dart::DoBoost + DropTrees) ----
+        dart = self.booster_kind == "dart"
+        drop_idx: List[int] = []
+        drop_margin = None
+        if dart and self.trees and self.rate_drop > 0.0:
+            rng = self._rng(iteration, 97)
+            if rng.random() >= self.skip_drop:
+                n = len(self.trees)
+                if self.sample_type == "weighted":
+                    wts = np.asarray(self.tree_weights, np.float64)
+                    prob = wts / max(wts.sum(), 1e-16)
+                    k_drop = int(rng.binomial(n, self.rate_drop))
+                    if k_drop == 0 and self.one_drop:
+                        k_drop = 1
+                    if k_drop > 0:
+                        drop_idx = list(rng.choice(n, size=min(k_drop, n),
+                                                   replace=False, p=prob))
+                else:
+                    mask = rng.random(n) < self.rate_drop
+                    drop_idx = list(np.nonzero(mask)[0])
+                    if not drop_idx and self.one_drop:
+                        drop_idx = [int(rng.integers(0, n))]
+        if drop_idx:
+            import jax.numpy as jnp
+
+            if cache.raw_X is None:
+                cache.raw_X = jnp.asarray(cache.dmat.host_dense(), jnp.float32)
+            drop_margin = self._margin_for_trees(cache.raw_X, drop_idx)
+            pad = cache.margin.shape[0] - drop_margin.shape[0]
+            if pad:
+                drop_margin = jnp.concatenate(
+                    [drop_margin, jnp.zeros((pad, drop_margin.shape[1]), jnp.float32)],
+                    axis=0,
+                )
+            # gradients computed on the margin WITHOUT dropped trees
+            reduced = cache.margin - drop_margin
+            gpair = self.objective.get_gradient(
+                reduced, cache.labels, cache.weights, iteration
+            ) * cache.valid[:, None, None]
+
         new_margin = cache.margin
-        fmask_fn = self._feature_masks(iteration, 0, ell.n_features)
-        for k in range(K):
-            state = grower.grow(
-                ell.bins,
-                gpair[:, k, :],
-                cache.valid,
-                ell.cuts_pad,
-                ell.n_bins,
-                feature_masks=fmask_fn,
+        n_new = 0
+        for p_idx in range(max(self.num_parallel_tree, 1)):
+            fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx, ell.n_features)
+            # one independent subsample per parallel tree (reference: each
+            # member of the forest draws its own rows)
+            gp = self._subsample_mask(gpair, iteration * 131 + p_idx)
+            for k in range(K):
+                state = grower.grow(
+                    ell.bins,
+                    gp[:, k, :],
+                    cache.valid,
+                    ell.cuts_pad,
+                    ell.n_bins,
+                    feature_masks=fmask_fn,
+                )
+                if adaptive:
+                    # exact quantile leaves (ObjFunction::UpdateTreeLeaf,
+                    # src/objective/adaptive.cc)
+                    from .ops.adaptive import segment_quantile_leaf
+
+                    residual = cache.labels - new_margin[:, k]
+                    new_leaf = segment_quantile_leaf(
+                        state.pos, residual, cache.valid, state.is_leaf,
+                        float(self.objective.adaptive_alpha()),
+                        float(self.tparam.eta), max_nodes=grower.max_nodes,
+                    )
+                    state = state._replace(leaf_val=new_leaf)
+                delta = leaf_margin_delta(state.pos, state.leaf_val)
+                new_margin = new_margin.at[:, k].add(delta)
+                tree = RegTree.from_grown(HistTreeGrower.to_host(state))
+                self.trees.append(tree)
+                self.tree_info.append(k)
+                self.tree_weights.append(1.0)
+                n_new += 1
+
+        if drop_idx:
+            # normalize (Dart::NormalizeTrees): with k dropped and lr=eta,
+            # 'tree': new *= 1/(k+lr), dropped *= k/(k+lr)
+            # 'forest': new *= 1/(1+lr), dropped *= lr... per reference: /(1+lr)
+            import jax.numpy as jnp
+
+            k_d = len(drop_idx)
+            lr = float(self.tparam.eta)
+            if self.normalize_type == "forest":
+                new_w = 1.0 / (1.0 + lr)
+                factor = 1.0 / (1.0 + lr)
+            else:
+                new_w = 1.0 / (k_d + lr)
+                factor = k_d / (k_d + lr)
+            for t in range(len(self.trees) - n_new, len(self.trees)):
+                self.tree_weights[t] = new_w
+            for t in drop_idx:
+                self.tree_weights[t] *= factor
+            # margin: dropped trees shrank by `factor`, new trees contribute
+            # scaled by new_w; rebuild incrementally
+            new_contrib = new_margin - cache.margin  # unscaled new trees
+            new_margin = (
+                cache.margin
+                - (1.0 - factor) * drop_margin
+                + new_w * new_contrib
             )
-            delta = leaf_margin_delta(state.pos, state.leaf_val)
-            new_margin = new_margin.at[:, k].add(delta)
-            tree = RegTree.from_grown(HistTreeGrower.to_host(state))
-            self.trees.append(tree)
-            self.tree_info.append(k)
+            self._weights_version = getattr(self, "_weights_version", 0) + 1
+            cache.weights_version = self._weights_version
+
         cache.margin = new_margin
         cache.n_trees_applied = len(self.trees)
 
@@ -411,14 +603,24 @@ class Booster:
         return np.asarray(cache.margin[:R])
 
     # ------------------------------------------------------------------ predict
-    def _stacked(self, tree_slice: slice):
-        trees = self.trees[tree_slice]
-        info = self.tree_info[tree_slice]
+    def _stacked(self, tree_slice: slice, tree_ids: Optional[Sequence[int]] = None):
+        if tree_ids is not None:
+            trees = [self.trees[i] for i in tree_ids]
+            info = [self.tree_info[i] for i in tree_ids]
+            wts = [self.tree_weights[i] if self.tree_weights else 1.0 for i in tree_ids]
+        else:
+            trees = self.trees[tree_slice]
+            info = self.tree_info[tree_slice]
+            wts = (self.tree_weights[tree_slice]
+                   if self.tree_weights else [1.0] * len(trees))
         width = max((t.n_nodes for t in trees), default=1)
         depth = max((t.max_depth for t in trees), default=0) + 1
         cols = {k: [] for k in ("feat", "thr", "dleft", "left", "right", "value")}
-        for t in trees:
+        for t, w in zip(trees, wts):
             arrs = t.padded_arrays(width)
+            if w != 1.0:  # DART per-tree weight (gbtree.cc weight_drop_)
+                arrs = dict(arrs)
+                arrs["value"] = arrs["value"] * np.float32(w)
             for k in cols:
                 cols[k].append(arrs[k])
         import jax.numpy as jnp
@@ -426,6 +628,15 @@ class Booster:
         stacked = {k: jnp.asarray(np.stack(v)) for k, v in cols.items()}
         groups = jnp.asarray(np.asarray(info, np.int32))
         return stacked, groups, depth
+
+    def _margin_for_trees(self, X_dev, tree_ids: Sequence[int]):
+        stacked, groups, depth = self._stacked(slice(0, 0), tree_ids=tree_ids)
+        return predict_margin_delta(
+            X_dev,
+            stacked["feat"], stacked["thr"], stacked["dleft"],
+            stacked["left"], stacked["right"], stacked["value"],
+            groups, n_groups=self.n_groups, depth=depth,
+        )
 
     def _margin_delta_for(self, X_dev, tree_slice: slice):
         stacked, groups, depth = self._stacked(tree_slice)
@@ -454,13 +665,22 @@ class Booster:
 
         self._configure()
         X = jnp.asarray(data.host_dense(), jnp.float32)
+        if self.booster_kind == "gblinear":
+            if pred_leaf:
+                raise ValueError("pred_leaf is not defined for the gblinear booster")
+            if pred_interactions:
+                raise ValueError("pred_interactions is not supported for gblinear")
+            if pred_contribs:
+                return self._linear_contribs(data)
+            return self._predict_linear(data, output_margin, strict_shape)
         lo, hi = iteration_range
         n_rounds = self.num_boosted_rounds()
         if hi == 0:
             hi = n_rounds
         if self.best_iteration is not None and iteration_range == (0, 0) and not training:
             pass  # reference keeps all trees unless user slices
-        tree_slice = slice(lo * self.n_groups, hi * self.n_groups)
+        tpr = self.trees_per_round
+        tree_slice = slice(lo * tpr, hi * tpr)
         if pred_leaf:
             if not self.trees[tree_slice]:
                 return np.zeros((data.num_row(), 0), np.int32)
@@ -492,6 +712,45 @@ class Booster:
             out = out[:, 0]
         return out
 
+    def _linear_contribs(self, data: DMatrix) -> np.ndarray:
+        """Linear contributions: phi_f = w_f * x_f, bias column last
+        (reference: gblinear.cc PredictContribution)."""
+        self._configure()
+        X = np.nan_to_num(data.host_dense(), nan=0.0)
+        R, F = X.shape
+        K = self.n_groups
+        W = self.linear_weights if self.linear_weights is not None else np.zeros((F, K), np.float32)
+        b = self.linear_bias if self.linear_bias is not None else np.zeros(K, np.float32)
+        base = np.broadcast_to(self.base_score.reshape(-1), (K,))
+        out = np.zeros((R, K, F + 1), np.float64)
+        for k in range(K):
+            out[:, k, :F] = X * W[:, k][None, :]
+            out[:, k, F] = b[k] + base[k]
+        return out[:, 0, :] if K == 1 else out
+
+    def _predict_linear(self, data: DMatrix, output_margin: bool, strict_shape: bool):
+        import jax.numpy as jnp
+
+        from .models.gblinear import linear_predict
+
+        self._configure()
+        X = jnp.asarray(data.host_dense(), jnp.float32)
+        base = np.broadcast_to(self.base_score.reshape(-1), (self.n_groups,))
+        if self.linear_weights is None:
+            margin = np.broadcast_to(base, (data.num_row(), self.n_groups)).copy()
+        else:
+            margin = np.asarray(
+                linear_predict(X, jnp.asarray(self.linear_weights),
+                               jnp.asarray(self.linear_bias))
+            ) + base[None, :]
+        if output_margin:
+            out = margin
+        else:
+            out = np.asarray(self.objective.pred_transform(jnp.asarray(margin)))
+        if self.n_groups == 1 and not strict_shape:
+            out = out[:, 0]
+        return out
+
     def inplace_predict(self, data, iteration_range=(0, 0), predict_type="value",
                         missing=np.nan, validate_features=True, base_margin=None,
                         strict_shape=False):
@@ -505,9 +764,15 @@ class Booster:
         )
 
     # ------------------------------------------------------------------ model IO
+    @property
+    def trees_per_round(self) -> int:
+        return max(self.n_groups, 1) * max(self.num_parallel_tree, 1)
+
     def num_boosted_rounds(self) -> int:
         self._configure()
-        return len(self.trees) // max(self.n_groups, 1)
+        if self.booster_kind == "gblinear":
+            return getattr(self, "_linear_rounds", 0)
+        return len(self.trees) // self.trees_per_round
 
     def num_features(self) -> int:
         if getattr(self, "_num_feature", None):
@@ -535,27 +800,50 @@ class Booster:
     def save_raw_dict(self) -> dict:
         self._configure()
         n_feat = self.num_features()
-        trees = [t.to_json_dict(n_feat) for t in self.trees]
         base_margin = float(np.asarray(self.base_score).reshape(-1)[0])
         base = float(np.asarray(self.objective.margin_to_prob(np.float32(base_margin))))
         obj_conf = {"name": self.objective.name}
         if self.objective.name.startswith("multi:"):
             obj_conf["softmax_multiclass_param"] = {"num_class": str(self.num_class)}
-        model = {
-            "gbtree_model_param": {
-                "num_trees": str(len(self.trees)),
-                "num_parallel_tree": "1",
-            },
-            "trees": trees,
-            "tree_info": list(self.tree_info),
-        }
+        if self.booster_kind == "gblinear":
+            # reference schema: gblinear.cc SaveModel — feature-major weights,
+            # per-group bias at the end
+            W = self.linear_weights if self.linear_weights is not None else np.zeros(
+                (n_feat, self.n_groups), np.float32)
+            b = self.linear_bias if self.linear_bias is not None else np.zeros(
+                self.n_groups, np.float32)
+            gb = {
+                "model": {"weights": [float(x) for x in
+                                      np.concatenate([W.reshape(-1), b])],
+                          "param": {"num_feature": str(n_feat),
+                                    "num_output_group": str(self.n_groups),
+                                    "num_boosted_rounds": str(
+                                        getattr(self, "_linear_rounds", 0))}},
+                "name": "gblinear",
+            }
+        else:
+            trees = [t.to_json_dict(n_feat) for t in self.trees]
+            model = {
+                "gbtree_model_param": {
+                    "num_trees": str(len(self.trees)),
+                    "num_parallel_tree": str(self.num_parallel_tree),
+                },
+                "trees": trees,
+                "tree_info": list(self.tree_info),
+            }
+            if self.booster_kind == "dart":
+                gb = {"gbtree": {"model": model},
+                      "weight_drop": [float(w) for w in self.tree_weights],
+                      "name": "dart"}
+            else:
+                gb = {"model": model, "name": "gbtree"}
         return {
             "version": [3, 1, 0],
             "learner": {
                 "attributes": dict(self.attributes),
                 "feature_names": self.feature_names or [],
                 "feature_types": self.feature_types or [],
-                "gradient_booster": {"model": model, "name": "gbtree"},
+                "gradient_booster": gb,
                 "learner_model_param": {
                     "base_score": f"{base:.9E}",
                     "boost_from_average": "1",
@@ -596,9 +884,29 @@ class Booster:
             np.asarray(self.objective.prob_to_margin(base_prob), np.float32), (self.n_groups,)
         ).astype(np.float32).copy()
         self._num_feature = int(lmp.get("num_feature", "0")) or None
-        gb = learner["gradient_booster"]["model"]
-        self.trees = [RegTree.from_json_dict(t) for t in gb["trees"]]
-        self.tree_info = [int(i) for i in gb["tree_info"]]
+        gbooster = learner["gradient_booster"]
+        name = gbooster.get("name", "gbtree")
+        self.params.setdefault("booster", name)
+        self._invalidate_config(structural=False)
+        self._configure()
+        if name == "gblinear":
+            flat = np.asarray(gbooster["model"]["weights"], np.float32)
+            F = self._num_feature or (len(flat) // max(self.n_groups, 1) - 1)
+            K = max(self.n_groups, 1)
+            self.linear_weights = flat[: F * K].reshape(F, K)
+            self.linear_bias = flat[F * K : F * K + K]
+            self._linear_rounds = int(
+                gbooster["model"].get("param", {}).get("num_boosted_rounds", "0") or 0)
+            self.trees, self.tree_info, self.tree_weights = [], [], []
+        else:
+            gb = gbooster["gbtree"]["model"] if name == "dart" else gbooster["model"]
+            self.trees = [RegTree.from_json_dict(t) for t in gb["trees"]]
+            self.tree_info = [int(i) for i in gb["tree_info"]]
+            self.tree_weights = [float(w) for w in gbooster.get(
+                "weight_drop", [1.0] * len(self.trees))]
+            self.num_parallel_tree = int(
+                gb.get("gbtree_model_param", {}).get("num_parallel_tree", "1") or 1)
+            self.params.setdefault("num_parallel_tree", self.num_parallel_tree)
         self.attributes = dict(learner.get("attributes", {}))
         self.feature_names = learner.get("feature_names") or None
         self.feature_types = learner.get("feature_types") or None
@@ -630,13 +938,17 @@ class Booster:
         """Tree-slice (reference: Booster.__getitem__ / Learner::Slice)."""
         if not isinstance(val, slice):
             raise TypeError("Booster slicing requires a slice of rounds")
+        self._configure()
+        if self.booster_kind == "gblinear":
+            raise ValueError("Slice is not supported by the gblinear booster")
         lo = val.start or 0
         hi = val.stop if val.stop is not None else self.num_boosted_rounds()
         out = Booster(dict(self.params))
         out._configure()
-        k = out.n_groups
+        k = out.trees_per_round
         out.trees = self.trees[lo * k : hi * k]
         out.tree_info = self.tree_info[lo * k : hi * k]
+        out.tree_weights = list(self.tree_weights[lo * k : hi * k])
         out._base_margin_value = self._base_margin_value
         out._num_feature = getattr(self, "_num_feature", None)
         out.feature_names = self.feature_names

@@ -196,9 +196,11 @@ def predict_contribs(booster, data, tree_slice: slice, approx: bool = False) -> 
     out = np.zeros((R, K, F + 1), np.float64)
     trees = booster.trees[tree_slice]
     info = booster.tree_info[tree_slice]
+    wts = (booster.tree_weights[tree_slice]
+           if getattr(booster, "tree_weights", None) else [1.0] * len(trees))
     fn = saabas_values_tree if approx else shap_values_tree
-    for tree, grp in zip(trees, info):
-        out[:, grp, :] += fn(tree, X)
+    for tree, grp, w in zip(trees, info, wts):
+        out[:, grp, :] += w * fn(tree, X)  # DART weight_drop scaling
     base = np.asarray(booster.base_score).reshape(-1)
     out[:, :, F] += base[None, :K]
     return out[:, 0, :] if K == 1 else out
@@ -291,8 +293,12 @@ def predict_interactions(booster, data, tree_slice: slice) -> np.ndarray:
     R, F = X.shape
     K = booster.n_groups
     out = np.zeros((R, K, F + 1, F + 1), np.float64)
-    for tree, grp in zip(booster.trees[tree_slice], booster.tree_info[tree_slice]):
-        out[:, grp] += shap_interactions_tree(tree, X)
+    wts = (booster.tree_weights[tree_slice]
+           if getattr(booster, "tree_weights", None) else None)
+    for i, (tree, grp) in enumerate(
+            zip(booster.trees[tree_slice], booster.tree_info[tree_slice])):
+        w = wts[i] if wts else 1.0
+        out[:, grp] += w * shap_interactions_tree(tree, X)
     base = np.asarray(booster.base_score).reshape(-1)
     out[:, :, F, F] += base[None, :K]
     return out[:, 0] if K == 1 else out

@@ -77,7 +77,7 @@ def mphe(preds, labels, weights=None, slope: float = 1.0, **kw):
 
 @register_metric("logloss")
 def logloss(preds, labels, weights=None, **kw):
-    p = np.clip(preds, 1e-16, 1 - 1e-16)
+    p = np.clip(np.asarray(preds, np.float64), 1e-16, 1 - 1e-16)
     return _wmean(-(labels * np.log(p) + (1 - labels) * np.log(1 - p)), labels, weights)
 
 
@@ -132,7 +132,7 @@ def merror(preds, labels, weights=None, **kw):
 
 @register_metric("mlogloss")
 def mlogloss(preds, labels, weights=None, **kw):
-    p = np.clip(preds, 1e-16, 1 - 1e-16)
+    p = np.clip(np.asarray(preds, np.float64), 1e-16, 1 - 1e-16)
     ll = -np.log(p[np.arange(len(labels)), labels.astype(np.int64)])
     return _wmean(ll, labels, weights)
 

@@ -1,0 +1,51 @@
+"""Adaptive (quantile) leaf updates for absolute/quantile error objectives.
+
+Reference: src/objective/adaptive.cc/.cu (ObjFunction::UpdateTreeLeaf,
+objective.h:129): after the tree is grown and every row sits on its leaf,
+replace each leaf value with eta * alpha-quantile of the residuals
+(y - margin_before_tree) of its rows — the exact minimizer for pinball/L1
+loss that the second-order approximation cannot reach.
+
+TPU formulation: one lexicographic ``lax.sort`` by (leaf id, residual), then
+per-leaf quantile gather via searchsorted on the sorted leaf ids — no dynamic
+shapes, no per-leaf loops.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes",))
+def segment_quantile_leaf(pos, residual, valid, leaf_mask, alpha, eta,
+                          *, max_nodes: int):
+    """Per-leaf residual quantiles.
+
+    pos      : (R,) int32 — leaf node id per row (-1 padded)
+    residual : (R,) f32 — y - margin (before this tree)
+    valid    : (R,) bool
+    leaf_mask: (max_nodes,) bool — which heap slots are leaves
+    Returns (max_nodes,) f32 leaf values (eta-scaled), zeros for non-leaves.
+    """
+    R = pos.shape[0]
+    big = jnp.int32(max_nodes)
+    key = jnp.where(valid, pos, big)  # padded rows sort to the end
+    # lexicographic sort by (leaf, residual)
+    sk, sr = lax.sort((key, residual), num_keys=2)
+    # segment boundaries per node id
+    node_ids = jnp.arange(max_nodes, dtype=jnp.int32)
+    starts = jnp.searchsorted(sk, node_ids, side="left")
+    ends = jnp.searchsorted(sk, node_ids, side="right")
+    cnt = (ends - starts).astype(jnp.float32)
+    # linear-interpolated quantile index within each segment
+    q = alpha * jnp.maximum(cnt - 1.0, 0.0)
+    lo = jnp.floor(q).astype(jnp.int32)
+    frac = q - lo.astype(jnp.float32)
+    i0 = jnp.clip(starts + lo, 0, R - 1)
+    i1 = jnp.clip(starts + jnp.minimum(lo + 1, jnp.maximum(ends - starts - 1, 0)), 0, R - 1)
+    v = sr[i0] * (1.0 - frac) + sr[i1] * frac
+    ok = leaf_mask & (cnt > 0)
+    return jnp.where(ok, eta * v, 0.0)

@@ -33,6 +33,9 @@ class SplitParams(NamedTuple):
     lambda_: float
     alpha: float
     max_delta_step: float
+    # monotone_constraints: per-feature {-1,0,+1} (src/tree/constraints.cc);
+    # None disables the constrained evaluation path entirely
+    monotone: "object" = None
 
 
 class BestSplit(NamedTuple):
@@ -42,18 +45,29 @@ class BestSplit(NamedTuple):
     default_left: jnp.ndarray  # (N,) bool
     left_sum: jnp.ndarray  # (N, 2) (G, H) of left child
     right_sum: jnp.ndarray  # (N, 2)
+    left_weight: jnp.ndarray  # (N,) clipped child weights (monotone bounds)
+    right_weight: jnp.ndarray  # (N,)
 
 
 def _threshold_l1(g, alpha):
     return jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0)
 
 
-def calc_weight(G, H, p: SplitParams):
-    """Raw leaf weight -ThresholdL1(G)/(H+lambda), clipped (param.h CalcWeight)."""
+def calc_weight(G, H, p: SplitParams, lower=None, upper=None):
+    """Raw leaf weight -ThresholdL1(G)/(H+lambda), clipped (param.h CalcWeight);
+    optional [lower, upper] clamp implements monotone bounds propagation."""
     w = -_threshold_l1(G, p.alpha) / (H + p.lambda_)
     if p.max_delta_step > 0.0:
         w = jnp.clip(w, -p.max_delta_step, p.max_delta_step)
+    if lower is not None:
+        w = jnp.clip(w, lower, upper)
     return jnp.where(H <= 0.0, 0.0, w)
+
+
+def gain_given_weight(G, H, w, p: SplitParams):
+    """param.h CalcGainGivenWeight — used when weights are bound-clipped."""
+    ret = -(2.0 * _threshold_l1(G, p.alpha) * w + (H + p.lambda_) * w * w)
+    return jnp.where(H <= 0.0, 0.0, ret)
 
 
 def calc_gain(G, H, p: SplitParams):
@@ -69,14 +83,16 @@ def calc_gain(G, H, p: SplitParams):
 
 @functools.partial(jax.jit, static_argnames=("params",))
 def evaluate_splits(
-    hist, totals, n_bins, params: SplitParams, feature_mask=None
+    hist, totals, n_bins, params: SplitParams, feature_mask=None, node_bounds=None
 ) -> BestSplit:
     """Pick the best split per node.
 
     hist   : (N, F, B, 2) f32 — per-node per-feature bin (G, H) sums
     totals : (N, 2) f32 — node (G, H) including missing rows
     n_bins : (F,) int32 — valid bin count per feature (pads masked out)
-    feature_mask : optional (F,) or (N, F) bool — column sampling
+    feature_mask : optional (F,) or (N, F) bool — column sampling / interaction
+                   constraints (per-node allowed features)
+    node_bounds  : optional (N, 2) f32 [lower, upper] monotone weight bounds
     """
     N, F, B, _ = hist.shape
     cum = jnp.cumsum(hist, axis=2)  # (N,F,B,2): left sums for missing->right
@@ -86,22 +102,49 @@ def evaluate_splits(
     GL_r, HL_r = cum[..., 0], cum[..., 1]  # missing -> right
     GL_l, HL_l = GL_r + miss[:, :, None, 0], HL_r + miss[:, :, None, 1]  # missing -> left
 
-    parent_gain = calc_gain(totals[:, 0], totals[:, 1], params)[:, None, None]  # (N,1,1)
+    monotone = params.monotone is not None and any(c != 0 for c in params.monotone)
+    if monotone:
+        lo = node_bounds[:, 0][:, None, None] if node_bounds is not None else -jnp.inf
+        hi = node_bounds[:, 1][:, None, None] if node_bounds is not None else jnp.inf
+        cvec = jnp.asarray(params.monotone, jnp.int32)[None, :, None]  # (1,F,1)
+        w_parent = calc_weight(totals[:, 0], totals[:, 1], params,
+                               lo if node_bounds is None else node_bounds[:, 0],
+                               hi if node_bounds is None else node_bounds[:, 1])
+        parent_gain = gain_given_weight(totals[:, 0], totals[:, 1], w_parent, params)[
+            :, None, None
+        ]
+    else:
+        parent_gain = calc_gain(totals[:, 0], totals[:, 1], params)[:, None, None]
 
     def side_gain(GL, HL):
         GR = totals[:, None, None, 0] - GL
         HR = totals[:, None, None, 1] - HL
-        gain = calc_gain(GL, HL, params) + calc_gain(GR, HR, params) - parent_gain
+        if monotone:
+            # constrained evaluation (src/tree/constraints.cc / evaluate_splits.cu
+            # LossChangeMissing with ValueConstraint): child weights clipped to
+            # the node's bounds; monotone violation invalidates the split
+            wL = calc_weight(GL, HL, params, lo, hi)
+            wR = calc_weight(GR, HR, params, lo, hi)
+            gain = (
+                gain_given_weight(GL, HL, wL, params)
+                + gain_given_weight(GR, HR, wR, params)
+                - parent_gain
+            )
+            viol = ((cvec > 0) & (wL > wR)) | ((cvec < 0) & (wL < wR))
+            gain = jnp.where(viol, -jnp.inf, gain)
+        else:
+            wL = wR = None
+            gain = calc_gain(GL, HL, params) + calc_gain(GR, HR, params) - parent_gain
         valid = (
             (HL >= params.min_child_weight)
             & (HR >= params.min_child_weight)
             & (HL > 0.0)
             & (HR > 0.0)
         )
-        return jnp.where(valid, gain, -jnp.inf), GR, HR
+        return jnp.where(valid, gain, -jnp.inf), GR, HR, wL, wR
 
-    gain_r, GR_r, HR_r = side_gain(GL_r, HL_r)
-    gain_l, GR_l, HR_l = side_gain(GL_l, HL_l)
+    gain_r, GR_r, HR_r, wL_r, wR_r = side_gain(GL_r, HL_r)
+    gain_l, GR_l, HR_l, wL_l, wR_l = side_gain(GL_l, HL_l)
 
     # mask padded bins and the top bin (split there = empty right for dense features)
     bin_idx = jnp.arange(B, dtype=jnp.int32)
@@ -137,6 +180,13 @@ def evaluate_splits(
     GR = jnp.where(dleft, pick(GR_l), pick(GR_r))
     HR = jnp.where(dleft, pick(HR_l), pick(HR_r))
 
+    if monotone:
+        lw = jnp.where(dleft, pick(wL_l), pick(wL_r))
+        rw = jnp.where(dleft, pick(wR_l), pick(wR_r))
+    else:
+        lw = calc_weight(GL, HL, params)
+        rw = calc_weight(GR, HR, params)
+
     return BestSplit(
         gain=best_gain,
         feature=best_f,
@@ -144,4 +194,6 @@ def evaluate_splits(
         default_left=dleft,
         left_sum=jnp.stack([GL, HL], axis=1),
         right_sum=jnp.stack([GR, HR], axis=1),
+        left_weight=lw,
+        right_weight=rw,
     )

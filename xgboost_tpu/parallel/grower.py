@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.split import SplitParams
-from ..tree.grow import TreeState, init_tree_state, level_step, max_nodes_for_depth
+from ..tree.grow import (TreeState, init_tree_state, level_step,
+                         make_set_matrix, max_nodes_for_depth)
 from .mesh import DATA_AXIS
 
 
@@ -28,6 +29,7 @@ def _state_specs(data_axis: str):
         pos=P(data_axis),
         alive=P(), totals=P(), feat=P(), sbin=P(), thr=P(), dleft=P(),
         is_leaf=P(), leaf_val=P(), gain=P(), base_weight=P(), sum_hess=P(),
+        lower=P(), upper=P(), setcompat=P(), splits_left=P(),
     )
 
 
@@ -35,21 +37,33 @@ class ShardedHistTreeGrower:
     """Drop-in replacement for HistTreeGrower over a 1-D mesh."""
 
     def __init__(self, max_depth: int, params: SplitParams, mesh, *,
-                 hist_impl: str = "xla") -> None:
+                 hist_impl: str = "xla", interaction_sets=None,
+                 max_leaves: int = 0, lossguide: bool = False) -> None:
         self.max_depth = max_depth
         self.params = params
         self.mesh = mesh
         self.hist_impl = hist_impl
+        self.interaction_sets = interaction_sets
+        self.max_leaves = max_leaves
+        self.lossguide = lossguide
         self.max_nodes = max_nodes_for_depth(max_depth)
+        self._built_for = None
+
+    def _build(self, n_features: int) -> None:
+        if self._built_for == n_features:
+            return
         ax = DATA_AXIS
         sspec = _state_specs(ax)
+        n_sets = make_set_matrix(self.interaction_sets, n_features).shape[0]
 
         self._init_fn = jax.jit(
             jax.shard_map(
                 functools.partial(
-                    init_tree_state, max_nodes=self.max_nodes, axis_name=ax
+                    init_tree_state, max_nodes=self.max_nodes, axis_name=ax,
+                    n_sets=n_sets,
+                    max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
                 ),
-                mesh=mesh,
+                mesh=self.mesh,
                 in_specs=(P(ax, None), P(ax)),
                 out_specs=sspec,
             )
@@ -66,20 +80,24 @@ class ShardedHistTreeGrower:
                         last_level=(d == self.max_depth),
                         axis_name=ax,
                         hist_impl=self.hist_impl,
+                        lossguide=self.lossguide,
                     ),
-                    mesh=mesh,
-                    in_specs=(sspec, P(ax, None), P(ax, None), P(), P(), P()),
+                    mesh=self.mesh,
+                    in_specs=(sspec, P(ax, None), P(ax, None), P(), P(), P(), P()),
                     out_specs=sspec,
                 )
             )
+        self._built_for = n_features
 
     def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None) -> TreeState:
         F = bins.shape[1]
+        self._build(F)
         ones = jnp.ones((1, F), dtype=bool)
+        setmat = jnp.asarray(make_set_matrix(self.interaction_sets, F))
         state = self._init_fn(gpair, valid)
         for d in range(self.max_depth + 1):
             fm = ones if feature_masks is None else feature_masks(d, 1 << d)
-            state = self._level_fns[d](state, bins, gpair, cuts_pad, n_bins, fm)
+            state = self._level_fns[d](state, bins, gpair, cuts_pad, n_bins, fm, setmat)
         return state
 
     @staticmethod

@@ -46,17 +46,49 @@ class TreeState(NamedTuple):
     gain: jnp.ndarray  # (max_nodes,) f32 — loss_chg of the split
     base_weight: jnp.ndarray  # (max_nodes,) f32 — raw node weight
     sum_hess: jnp.ndarray  # (max_nodes,) f32
+    lower: jnp.ndarray  # (max_nodes,) f32 — monotone weight lower bound
+    upper: jnp.ndarray  # (max_nodes,) f32 — monotone weight upper bound
+    setcompat: jnp.ndarray  # (max_nodes, n_sets) bool — interaction sets alive
+    splits_left: jnp.ndarray  # (1,) int32 — remaining split budget (max_leaves)
 
 
 def max_nodes_for_depth(max_depth: int) -> int:
     return (1 << (max_depth + 1)) - 1
 
 
-@functools.partial(jax.jit, static_argnames=("max_nodes", "axis_name"))
-def init_tree_state(gpair, valid, *, max_nodes: int, axis_name: Optional[str] = None):
+def make_set_matrix(interaction_sets, n_features: int):
+    """(n_sets, F) bool membership matrix; unlisted features become singleton
+    sets (reference semantics: unlisted features cannot interact with listed
+    ones).  None -> a single all-True set (constraints disabled)."""
+    import numpy as np
+
+    if not interaction_sets:
+        return np.ones((1, n_features), dtype=bool)
+    listed = set()
+    rows = []
+    for grp in interaction_sets:
+        row = np.zeros(n_features, dtype=bool)
+        for f in grp:
+            row[f] = True
+            listed.add(int(f))
+        rows.append(row)
+    for f in range(n_features):
+        if f not in listed:
+            row = np.zeros(n_features, dtype=bool)
+            row[f] = True
+            rows.append(row)
+    return np.stack(rows)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_nodes", "axis_name", "n_sets", "max_splits")
+)
+def init_tree_state(gpair, valid, *, max_nodes: int, axis_name: Optional[str] = None,
+                    n_sets: int = 1, max_splits: int = 0):
     """Fresh state: all rows at the root; root totals (all)reduced.
 
     valid : (R_pad,) bool — False for padding rows.
+    max_splits: total split budget (max_leaves - 1); 0 = unlimited.
     """
     R = gpair.shape[0]
     pos = jnp.where(valid, 0, -1).astype(jnp.int32)
@@ -65,6 +97,7 @@ def init_tree_state(gpair, valid, *, max_nodes: int, axis_name: Optional[str] = 
         root = lax.psum(root, axis_name)
     mn = max_nodes
     totals = jnp.zeros((mn, 2), jnp.float32).at[0].set(root[0])
+    budget = max_splits if max_splits > 0 else jnp.iinfo(jnp.int32).max
     return TreeState(
         pos=pos,
         alive=jnp.zeros(mn, bool).at[0].set(True),
@@ -78,12 +111,17 @@ def init_tree_state(gpair, valid, *, max_nodes: int, axis_name: Optional[str] = 
         gain=jnp.zeros(mn, jnp.float32),
         base_weight=jnp.zeros(mn, jnp.float32),
         sum_hess=jnp.zeros(mn, jnp.float32),
+        lower=jnp.full(mn, -jnp.inf, jnp.float32),
+        upper=jnp.full(mn, jnp.inf, jnp.float32),
+        setcompat=jnp.ones((mn, n_sets), bool),
+        splits_left=jnp.full((1,), budget, jnp.int32),
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("depth", "params", "last_level", "axis_name", "hist_impl"),
+    static_argnames=("depth", "params", "last_level", "axis_name", "hist_impl",
+                     "lossguide"),
 )
 def level_step(
     state: TreeState,
@@ -92,12 +130,14 @@ def level_step(
     cuts_pad,
     n_bins,
     feature_mask,
+    set_matrix,
     *,
     depth: int,
     params: SplitParams,
     last_level: bool,
     axis_name: Optional[str] = None,
     hist_impl: str = "xla",
+    lossguide: bool = False,
 ):
     """Expand every alive node at ``depth``: hist -> best split -> apply.
 
@@ -112,7 +152,9 @@ def level_step(
     idx = node0 + jnp.arange(N, dtype=jnp.int32)
     totals_lvl = lax.dynamic_slice_in_dim(state.totals, node0, N, axis=0)
     alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, N, axis=0)
-    w = calc_weight(totals_lvl[:, 0], totals_lvl[:, 1], params)
+    lower_lvl = lax.dynamic_slice_in_dim(state.lower, node0, N, axis=0)
+    upper_lvl = lax.dynamic_slice_in_dim(state.upper, node0, N, axis=0)
+    w = calc_weight(totals_lvl[:, 0], totals_lvl[:, 1], params, lower_lvl, upper_lvl)
 
     if last_level:
         # no hist needed: every surviving node becomes a leaf
@@ -134,10 +176,31 @@ def level_step(
     if axis_name is not None:
         hist = lax.psum(hist, axis_name)  # the one distributed cost (SURVEY §3.1)
 
-    best = evaluate_splits(hist, totals_lvl, n_bins, params, feature_mask)
+    # interaction constraints: allowed feature set per node = union of the
+    # constraint sets still compatible with the node's path
+    # (reference: src/tree/constraints.cc FeatureInteractionConstraint)
+    compat_lvl = lax.dynamic_slice_in_dim(state.setcompat, node0, N, axis=0)
+    allowed = jnp.einsum("ns,sf->nf", compat_lvl.astype(jnp.float32),
+                         set_matrix.astype(jnp.float32)) > 0.0  # (N, F)
+    fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
+    fmask = allowed & fm
+
+    node_bounds = jnp.stack([lower_lvl, upper_lvl], axis=1)
+    best = evaluate_splits(hist, totals_lvl, n_bins, params, fmask, node_bounds)
 
     gamma_eps = max(params.gamma, _EPS)
     can_split = alive_lvl & (best.gain > gamma_eps)
+
+    # split budget (max_leaves): expand best-first under lossguide, node-order
+    # under depthwise (reference: src/tree/driver.h grow-policy queue)
+    budget = state.splits_left[0]
+    prio = best.gain if lossguide else -idx.astype(jnp.float32)
+    prio = jnp.where(can_split, prio, -jnp.inf)
+    order = jnp.argsort(-prio)
+    ranks = jnp.argsort(order).astype(jnp.int32)
+    can_split = can_split & (ranks < budget)
+    new_budget = budget - jnp.sum(can_split).astype(jnp.int32)
+
     new_leaf = alive_lvl & ~can_split
 
     thr_lvl = cuts_pad[best.feature, jnp.minimum(best.bin, B - 1)]
@@ -160,7 +223,30 @@ def level_step(
     st = st._replace(
         alive=st.alive.at[left_ids].set(can_split).at[right_ids].set(can_split),
         totals=st.totals.at[left_ids].set(best.left_sum).at[right_ids].set(best.right_sum),
+        splits_left=jnp.full((1,), new_budget, jnp.int32),
     )
+
+    # interaction compat narrows to sets containing the chosen feature
+    member = set_matrix.T[jnp.clip(best.feature, 0, set_matrix.shape[1] - 1)]  # (N, n_sets)
+    child_compat = compat_lvl & member
+    st = st._replace(
+        setcompat=st.setcompat.at[left_ids].set(child_compat).at[right_ids].set(child_compat)
+    )
+
+    if params.monotone is not None and any(c != 0 for c in params.monotone):
+        # bounds propagation: mid = (wL + wR)/2 splits the feasible interval
+        # (reference: constraints.cc ValueConstraint::SetChild)
+        cvec = jnp.asarray(params.monotone, jnp.int32)
+        c_at = cvec[jnp.clip(best.feature, 0, len(params.monotone) - 1)]
+        mid = 0.5 * (best.left_weight + best.right_weight)
+        l_lo = jnp.where(c_at < 0, mid, lower_lvl)
+        l_hi = jnp.where(c_at > 0, mid, upper_lvl)
+        r_lo = jnp.where(c_at > 0, mid, lower_lvl)
+        r_hi = jnp.where(c_at < 0, mid, upper_lvl)
+        st = st._replace(
+            lower=st.lower.at[left_ids].set(l_lo).at[right_ids].set(r_lo),
+            upper=st.upper.at[left_ids].set(l_hi).at[right_ids].set(r_hi),
+        )
 
     # --- position update (RowPartitioner analogue) ---
     pos = st.pos
@@ -216,20 +302,32 @@ class HistTreeGrower:
         *,
         axis_name: Optional[str] = None,
         hist_impl: str = "xla",
+        interaction_sets=None,
+        max_leaves: int = 0,
+        lossguide: bool = False,
     ) -> None:
         self.max_depth = max_depth
         self.params = params
         self.axis_name = axis_name
         self.hist_impl = hist_impl
+        self.interaction_sets = interaction_sets
+        self.max_leaves = max_leaves
+        self.lossguide = lossguide
         self.max_nodes = max_nodes_for_depth(max_depth)
+
+    def _set_matrix(self, n_features: int):
+        return make_set_matrix(self.interaction_sets, n_features)
 
     def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None) -> TreeState:
         """feature_masks: None, or callable (depth, n_nodes) -> (1|N, F) bool mask
         (the ColumnSampler hook: bytree/bylevel/bynode, src/common/random.h)."""
         F = bins.shape[1]
         ones = jnp.ones((1, F), dtype=bool)
+        setmat = jnp.asarray(self._set_matrix(F))
         state = init_tree_state(
-            gpair, valid, max_nodes=self.max_nodes, axis_name=self.axis_name
+            gpair, valid, max_nodes=self.max_nodes, axis_name=self.axis_name,
+            n_sets=setmat.shape[0],
+            max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
         )
         for d in range(self.max_depth + 1):
             fm = ones if feature_masks is None else feature_masks(d, 1 << d)
@@ -240,11 +338,13 @@ class HistTreeGrower:
                 cuts_pad,
                 n_bins,
                 fm,
+                setmat,
                 depth=d,
                 params=self.params,
                 last_level=(d == self.max_depth),
                 axis_name=self.axis_name,
                 hist_impl=self.hist_impl,
+                lossguide=self.lossguide,
             )
         return state
 
