@@ -1,0 +1,63 @@
+"""Survival objectives (reference: tests/python/test_survival.py,
+tests/cpp/objective/test_aft_obj.cc)."""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+
+
+@pytest.fixture(scope="module")
+def surv_data():
+    rng = np.random.default_rng(0)
+    R = 600
+    X = rng.normal(size=(R, 5)).astype(np.float32)
+    t = np.exp(X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.normal(size=R)).astype(np.float32)
+    cens = rng.random(R) < 0.3
+    return X, t, cens
+
+
+@pytest.mark.parametrize("dist", ["normal", "logistic", "extreme"])
+def test_aft_improves_and_correlates(surv_data, dist):
+    X, t, cens = surv_data
+    lo = t.copy()
+    hi = np.where(cens, np.inf, t).astype(np.float32)
+    d = xtb.DMatrix(X, label=t, label_lower_bound=lo, label_upper_bound=hi)
+    res = {}
+    bst = xtb.train(
+        {"objective": "survival:aft", "aft_loss_distribution": dist,
+         "max_depth": 3, "eta": 0.3}, d, 15,
+        evals=[(d, "t")], evals_result=res, verbose_eval=False,
+    )
+    nll = res["t"]["aft-nloglik"]
+    assert np.isfinite(nll).all()
+    assert nll[-1] < nll[0]
+    p = bst.predict(d)
+    assert np.corrcoef(np.log(p), np.log(t))[0, 1] > 0.85
+
+
+def test_aft_interval_censored(surv_data):
+    X, t, _ = surv_data
+    # interval censoring: [0.8t, 1.3t]
+    d = xtb.DMatrix(X, label=t, label_lower_bound=0.8 * t,
+                    label_upper_bound=1.3 * t)
+    res = {}
+    xtb.train({"objective": "survival:aft", "eval_metric":
+               "interval-regression-accuracy", "max_depth": 3}, d, 15,
+              evals=[(d, "t")], evals_result=res, verbose_eval=False)
+    acc = res["t"]["interval-regression-accuracy"]
+    assert acc[-1] > 0.6
+    assert acc[-1] > acc[0]
+
+
+def test_cox_partial_likelihood(surv_data):
+    X, t, cens = surv_data
+    y = np.where(cens, -t, t).astype(np.float32)
+    d = xtb.DMatrix(X, label=y)
+    res = {}
+    bst = xtb.train({"objective": "survival:cox", "max_depth": 3, "eta": 0.3},
+                    d, 15, evals=[(d, "t")], evals_result=res, verbose_eval=False)
+    nll = res["t"]["cox-nloglik"]
+    assert np.isfinite(nll).all() and nll[-1] < nll[0]
+    # higher survival time -> lower hazard
+    hz = bst.predict(d)
+    assert np.corrcoef(np.log(hz), np.log(t))[0, 1] < -0.5

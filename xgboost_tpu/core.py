@@ -159,6 +159,7 @@ class Booster:
             alpha=float(self.tparam.alpha),
             max_delta_step=float(self.tparam.max_delta_step),
             monotone=self.tparam.monotone_constraints,
+            max_cat_to_onehot=int(self.tparam.max_cat_to_onehot),
         )
         self._configured = True
 
@@ -271,6 +272,11 @@ class Booster:
         self._configure()
         cache = self._get_cache(dtrain)
         cache.ensure_train()
+        if hasattr(self.objective, "set_bounds"):
+            lo = dtrain.info.label_lower_bound
+            hi = dtrain.info.label_upper_bound
+            if lo is not None:
+                self.objective.set_bounds(lo, hi)
         if hasattr(self.objective, "set_group_info"):
             gp = dtrain.info.group_ptr
             if gp is None:
@@ -492,6 +498,7 @@ class Booster:
 
         new_margin = cache.margin
         n_new = 0
+        cat_mask_np = cache.dmat.cat_mask()
         for p_idx in range(max(self.num_parallel_tree, 1)):
             fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx, ell.n_features)
             # one independent subsample per parallel tree (reference: each
@@ -505,6 +512,7 @@ class Booster:
                     ell.cuts_pad,
                     ell.n_bins,
                     feature_masks=fmask_fn,
+                    cat_mask=cat_mask_np,
                 )
                 if adaptive:
                     # exact quantile leaves (ObjFunction::UpdateTreeLeaf,
@@ -572,8 +580,17 @@ class Booster:
                 preds = preds[:, 0]
             labels = dmat.get_label()
             weights = dmat.get_weight()
+            mkw = dict(group_ptr=dmat.info.group_ptr)
+            if dmat.info.label_lower_bound is not None:
+                mkw["y_lower"] = dmat.info.label_lower_bound
+                ub = dmat.info.label_upper_bound
+                mkw["y_upper"] = (np.full_like(mkw["y_lower"], np.inf)
+                                  if ub is None else ub)
+            if hasattr(self.objective, "dist"):
+                mkw["dist"] = self.objective.dist
+                mkw["sigma"] = self.objective.sigma
             for fn, mname in metrics:
-                v = fn(preds, labels, weights, group_ptr=dmat.info.group_ptr)
+                v = fn(preds, labels, weights, **mkw)
                 msgs.append(f"{name}-{mname}:{v:g}")
             if feval is not None:
                 res = feval(margin if output_margin else preds, dmat)
@@ -615,7 +632,11 @@ class Booster:
                    if self.tree_weights else [1.0] * len(trees))
         width = max((t.n_nodes for t in trees), default=1)
         depth = max((t.max_depth for t in trees), default=0) + 1
-        cols = {k: [] for k in ("feat", "thr", "dleft", "left", "right", "value")}
+        has_cat = any(t.has_categorical for t in trees)
+        cols = {k: [] for k in ("feat", "thr", "dleft", "left", "right", "value",
+                                "is_cat")}
+        cats = []
+        n_cats = max((t.max_category for t in trees), default=-1) + 1 if has_cat else 0
         for t, w in zip(trees, wts):
             arrs = t.padded_arrays(width)
             if w != 1.0:  # DART per-tree weight (gbtree.cc weight_drop_)
@@ -623,14 +644,28 @@ class Booster:
                 arrs["value"] = arrs["value"] * np.float32(w)
             for k in cols:
                 cols[k].append(arrs[k])
+            if has_cat:
+                cats.append(t.cat_matrix(width, n_cats))
         import jax.numpy as jnp
 
         stacked = {k: jnp.asarray(np.stack(v)) for k, v in cols.items()}
+        stacked["catm"] = jnp.asarray(np.stack(cats)) if has_cat else None
         groups = jnp.asarray(np.asarray(info, np.int32))
         return stacked, groups, depth
 
     def _margin_for_trees(self, X_dev, tree_ids: Sequence[int]):
         stacked, groups, depth = self._stacked(slice(0, 0), tree_ids=tree_ids)
+        return self._run_predict(X_dev, stacked, groups, depth)
+
+    def _run_predict(self, X_dev, stacked, groups, depth):
+        if stacked["catm"] is not None:
+            return predict_margin_delta(
+                X_dev,
+                stacked["feat"], stacked["thr"], stacked["dleft"],
+                stacked["left"], stacked["right"], stacked["value"],
+                groups, stacked["is_cat"], stacked["catm"],
+                n_groups=self.n_groups, depth=depth,
+            )
         return predict_margin_delta(
             X_dev,
             stacked["feat"], stacked["thr"], stacked["dleft"],
@@ -640,12 +675,7 @@ class Booster:
 
     def _margin_delta_for(self, X_dev, tree_slice: slice):
         stacked, groups, depth = self._stacked(tree_slice)
-        return predict_margin_delta(
-            X_dev,
-            stacked["feat"], stacked["thr"], stacked["dleft"],
-            stacked["left"], stacked["right"], stacked["value"],
-            groups, n_groups=self.n_groups, depth=depth,
-        )
+        return self._run_predict(X_dev, stacked, groups, depth)
 
     def predict(
         self,

@@ -62,7 +62,9 @@ def _to_numpy_2d(data: Any, missing: float = np.nan):
         for c in data.columns:
             col = data[c]
             if str(col.dtype) == "category":
-                cols.append(col.cat.codes.to_numpy().astype(np.float32))
+                codes = col.cat.codes.to_numpy().astype(np.float32)
+                codes[codes < 0] = np.nan  # pandas encodes NaN as -1
+                cols.append(codes)
                 feature_types.append("c")
             else:
                 cols.append(col.to_numpy().astype(np.float32))
@@ -201,6 +203,13 @@ class DMatrix:
         out[row_of, indices] = values
         return out
 
+    def cat_mask(self) -> Optional[np.ndarray]:
+        """(F,) bool — which features are categorical ('c' feature type)."""
+        ft = self.info.feature_types
+        if not ft or "c" not in ft:
+            return None
+        return np.asarray([t == "c" for t in ft], dtype=bool)
+
     # ---- binning ----
     def ensure_ellpack(self, max_bin: int = 256, sketch_weights: Optional[np.ndarray] = None,
                        ref: Optional["DMatrix"] = None) -> EllpackPage:
@@ -209,10 +218,12 @@ class DMatrix:
         if ref is not None and ref._ellpack is not None:
             cuts = ref._ellpack.cuts  # GetCutsFromRef (quantile_dmatrix.cc:19)
         elif self._kind == "dense":
-            cuts = sketch_dense(self._dense, max_bin, weights=sketch_weights)
+            cuts = sketch_dense(self._dense, max_bin, weights=sketch_weights,
+                                cat_mask=self.cat_mask())
         else:
             indptr, indices, values, (R, F) = self._csr
-            cuts = sketch_csr(indptr, indices, values, F, max_bin, weights=sketch_weights)
+            cuts = sketch_csr(indptr, indices, values, F, max_bin,
+                              weights=sketch_weights, cat_mask=self.cat_mask())
         if self._kind == "dense":
             self._ellpack = build_ellpack(self._dense, cuts)
         else:

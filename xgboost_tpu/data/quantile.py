@@ -112,11 +112,18 @@ def cuts_from_quantile_grid(
     )
 
 
+def categorical_cuts(n_cats: int) -> np.ndarray:
+    """Identity cuts for a categorical feature: code c lands in bin c
+    (cuts [1..n_cats]; searchsorted side='right' of code c gives c)."""
+    return np.arange(1, max(n_cats, 1) + 1, dtype=np.float32)
+
+
 def sketch_dense(
     X,
     max_bin: int,
     weights: Optional[np.ndarray] = None,
     use_device: bool = True,
+    cat_mask: Optional[np.ndarray] = None,
 ) -> HistogramCuts:
     """Build HistogramCuts from a dense (R, F) float matrix with NaN = missing.
 
@@ -130,6 +137,41 @@ def sketch_dense(
     Xn = np.asarray(X, dtype=np.float32) if not hasattr(X, "devices") else X
     R, F = Xn.shape
     n_cand = max(max_bin - 1, 1)
+
+    if cat_mask is not None and np.any(cat_mask):
+        # categorical columns get identity cuts; only numeric columns are
+        # sketched (reference: CatContainer ordinal encoding, cat_container)
+        Xh = np.asarray(Xn)
+        num_idx = np.nonzero(~cat_mask)[0]
+        base = (sketch_dense(Xh[:, num_idx], max_bin, weights=weights,
+                             use_device=use_device)
+                if len(num_idx) else None)
+        ptrs = [0]
+        values = []
+        mins = np.zeros(F, np.float32)
+        num_pos = {int(f): i for i, f in enumerate(num_idx)}
+        for f in range(F):
+            if cat_mask[f]:
+                col = Xh[:, f]
+                col = col[~np.isnan(col)]
+                n_cats = int(col.max()) + 1 if len(col) else 1
+                if n_cats > max_bin:
+                    raise ValueError(
+                        f"categorical feature {f} has {n_cats} categories; "
+                        f"raise max_bin (currently {max_bin})"
+                    )
+                seg = categorical_cuts(n_cats)
+                mins[f] = -1e-5
+            else:
+                seg = base.feature_cuts(num_pos[f])
+                mins[f] = base.min_vals[num_pos[f]]
+            values.append(seg)
+            ptrs.append(ptrs[-1] + len(seg))
+        return HistogramCuts(
+            cut_ptrs=np.asarray(ptrs, np.int32),
+            cut_values=np.concatenate(values).astype(np.float32),
+            min_vals=mins,
+        )
 
     if weights is not None:
         return _sketch_weighted_host(np.asarray(Xn, dtype=np.float32), max_bin, np.asarray(weights))
@@ -191,7 +233,8 @@ def _sketch_weighted_host(X: np.ndarray, max_bin: int, w: Optional[np.ndarray]) 
 
 
 def sketch_csr(indptr, indices, values, n_features: int, max_bin: int,
-               weights: Optional[np.ndarray] = None) -> HistogramCuts:
+               weights: Optional[np.ndarray] = None,
+               cat_mask: Optional[np.ndarray] = None) -> HistogramCuts:
     """Sketch a CSR matrix column-by-column on host (sparse ingest path).
 
     Implicit zeros in sparse input are treated as missing, matching the
@@ -212,11 +255,22 @@ def sketch_csr(indptr, indices, values, n_features: int, max_bin: int,
     starts = np.searchsorted(col_sorted, np.arange(n_features + 1))
     if weights is not None:
         row_of = np.repeat(np.arange(R), np.diff(indptr))[order]
+    cat_cuts = {}
     for f in range(n_features):
         seg = val_sorted[starts[f] : starts[f + 1]].astype(np.float32)
         keep = ~np.isnan(seg)
         vals = seg[keep]
         nvalid[f] = len(vals)
+        if cat_mask is not None and cat_mask[f]:
+            # NOTE: CSR categorical needs explicit storage — implicit zeros
+            # are missing, so category 0 must be stored explicitly
+            n_cats = int(vals.max()) + 1 if len(vals) else 1
+            if n_cats > max_bin:
+                raise ValueError(
+                    f"categorical feature {f} has {n_cats} categories; "
+                    f"raise max_bin (currently {max_bin})")
+            cat_cuts[f] = categorical_cuts(n_cats)
+            continue
         if len(vals) == 0:
             continue
         vmax[f], vmin[f] = vals.max(), vals.min()
@@ -229,4 +283,16 @@ def sketch_csr(indptr, indices, values, n_features: int, max_bin: int,
             cdf = np.cumsum(sw)
             idx = np.searchsorted(cdf, qs * cdf[-1], side="left")
             grid[f] = sv[np.clip(idx, 0, len(sv) - 1)].astype(np.float32)
-    return cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
+    base = cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
+    if not cat_cuts:
+        return base
+    ptrs, values_out = [0], []
+    mins = base.min_vals.copy()
+    for f in range(n_features):
+        seg = cat_cuts.get(f, base.feature_cuts(f))
+        if f in cat_cuts:
+            mins[f] = -1e-5
+        values_out.append(seg)
+        ptrs.append(ptrs[-1] + len(seg))
+    return HistogramCuts(np.asarray(ptrs, np.int32),
+                         np.concatenate(values_out).astype(np.float32), mins)

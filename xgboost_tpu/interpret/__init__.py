@@ -94,7 +94,7 @@ def _tree_shap_recurse(t, x, phi, node: int, p: _Path, length: int,
         return
     f = t["feat"][node]
     xv = x[f]
-    go_left = t["dleft"][node] if np.isnan(xv) else (xv < t["thr"][node])
+    go_left = _go_left(t, node, xv)
     hot, cold = (left, right) if go_left else (right, left)
     cover = t["cover"]
     rj = cover[node]
@@ -118,11 +118,27 @@ def _tree_arrays(tree) -> dict:
     value = np.where(tree.left_children == -1, tree.split_conditions, 0.0).astype(np.float64)
     cover = tree.sum_hessian.astype(np.float64)
     cover = np.maximum(cover, 1e-16)
+    st = tree.split_type if tree.split_type is not None else np.zeros(n, np.int32)
+    cats = {nid: frozenset(int(c) for c in arr)
+            for nid, arr in (tree.categories or {}).items()}
     return dict(
         left=tree.left_children, right=tree.right_children,
         feat=tree.split_indices, thr=tree.split_conditions.astype(np.float64),
         dleft=tree.default_left, value=value, cover=cover,
+        is_cat=(st == 1), cats=cats,
     )
+
+
+def _go_left(t, node: int, xv: float) -> bool:
+    """Split decision incl. categorical routing (common/categorical.h:
+    in right-set -> right; out-of-range -> left; missing -> default)."""
+    if np.isnan(xv):
+        return bool(t["dleft"][node])
+    if t["is_cat"][node]:
+        cats = t["cats"].get(int(node))
+        c = int(xv)
+        return not (cats is not None and c >= 0 and c in cats)
+    return xv < t["thr"][node]
 
 
 def _expected_value(t) -> float:
@@ -180,7 +196,7 @@ def saabas_values_tree(tree, X: np.ndarray, eta_scale: np.ndarray = None) -> np.
         while t["left"][node] >= 0:
             f = t["feat"][node]
             xv = X[r, f]
-            go_left = t["dleft"][node] if np.isnan(xv) else (xv < t["thr"][node])
+            go_left = _go_left(t, node, xv)
             nxt = t["left"][node] if go_left else t["right"][node]
             out[r, f] += nodeval[nxt] - nodeval[node]
             node = nxt
@@ -247,7 +263,7 @@ def _cond_recurse(t, x, phi, node, p, length, pz, po, pi, cond_f, cond_on, cond_
     if left >= 0 and t["feat"][node] == cond_f:
         f = cond_f
         xv = x[f]
-        go_left = t["dleft"][node] if np.isnan(xv) else (xv < t["thr"][node])
+        go_left = _go_left(t, node, xv)
         hot = left if go_left else t["right"][node]
         cold = t["right"][node] if go_left else left
         cover = t["cover"]
@@ -270,7 +286,7 @@ def _cond_recurse(t, x, phi, node, p, length, pz, po, pi, cond_f, cond_on, cond_
         return
     f = t["feat"][node]
     xv = x[f]
-    go_left = t["dleft"][node] if np.isnan(xv) else (xv < t["thr"][node])
+    go_left = _go_left(t, node, xv)
     hot = left if go_left else t["right"][node]
     cold = t["right"][node] if go_left else left
     cover = t["cover"]

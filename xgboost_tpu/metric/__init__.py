@@ -182,6 +182,56 @@ def aucpr(preds, labels, weights=None, **kw):
     return float(np.trapezoid(precision, recall))
 
 
+@register_metric("aft-nloglik")
+def aft_nloglik(preds, labels, weights=None, y_lower=None, y_upper=None,
+                dist="normal", sigma=1.0, **kw):
+    """(reference: src/metric/survival_metric.cu AFTNegLogLik) — preds are
+    exp(margin) (time scale); convert back to margin."""
+    import jax.numpy as jnp
+
+    from ..objective.survival import aft_neg_loglik
+
+    if y_lower is None:
+        y_lower = labels
+        y_upper = labels
+    m = np.log(np.maximum(np.asarray(preds, np.float64), 1e-16))
+    ll = np.asarray(aft_neg_loglik(jnp.asarray(m, jnp.float32),
+                                   jnp.asarray(y_lower, jnp.float32),
+                                   jnp.asarray(y_upper, jnp.float32), dist, sigma))
+    return _wmean(ll.astype(np.float64), labels, weights)
+
+
+@register_metric("interval-regression-accuracy")
+def interval_accuracy(preds, labels, weights=None, y_lower=None, y_upper=None, **kw):
+    """Fraction of predictions inside the label interval
+    (reference: survival_metric.cu IntervalRegressionAccuracy)."""
+    if y_lower is None:
+        y_lower = labels
+        y_upper = labels
+    p = np.asarray(preds, np.float64)
+    ok = (p >= y_lower) & (p <= np.where(np.isfinite(y_upper), y_upper, np.inf))
+    return _wmean(ok.astype(np.float64), labels, weights)
+
+
+@register_metric("cox-nloglik")
+def cox_nloglik(preds, labels, weights=None, **kw):
+    """Negative partial log-likelihood (reference: rank_metric.cc CoxNLoglik).
+    preds are exp(margin) hazard ratios."""
+    t = np.abs(labels).astype(np.float64)
+    event = labels > 0
+    r = np.asarray(preds, np.float64)
+    order = np.argsort(t, kind="stable")
+    r_s = r[order]
+    ev_s = event[order]
+    ts = t[order]
+    revcum = np.cumsum(r_s[::-1])[::-1]
+    g_start = np.searchsorted(ts, ts, side="left")
+    risk = revcum[g_start]  # Breslow: tie groups share the denominator
+    ll = np.sum(np.log(np.maximum(r_s, 1e-16))[ev_s] - np.log(np.maximum(risk, 1e-16))[ev_s])
+    n_ev = max(int(ev_s.sum()), 1)
+    return float(-ll / n_ev)
+
+
 def _dcg_at(rel, k, exp_gain=True):
     rel = rel[:k]
     gain = (2.0**rel - 1.0) if exp_gain else rel

@@ -30,6 +30,7 @@ class RegTree:
     sum_hessian: np.ndarray  # f32
     split_bins: Optional[np.ndarray] = None  # int32, internal (binned predict)
     split_type: Optional[np.ndarray] = None  # 0 numeric, 1 categorical
+    categories: Optional[dict] = None  # nid -> int32 array of cats routed RIGHT
 
     @property
     def n_nodes(self) -> int:
@@ -77,7 +78,10 @@ class RegTree:
             loss_changes=np.zeros(n, np.float32),
             sum_hessian=np.zeros(n, np.float32),
             split_bins=np.zeros(n, np.int32),
+            split_type=np.zeros(n, np.int32),
+            categories={},
         )
+        has_cat = getattr(gt, "is_cat", None) is not None
         for h in order:
             i = id_of[h]
             t.base_weights[i] = gt.base_weight[h]
@@ -92,9 +96,16 @@ class RegTree:
                 t.split_conditions[i] = gt.thr[h]
                 t.split_bins[i] = gt.sbin[h]
                 t.loss_changes[i] = gt.gain[h]
+                if has_cat and gt.is_cat[h]:
+                    t.split_type[i] = 1
+                    t.categories[i] = np.nonzero(gt.cat_set[h])[0].astype(np.int32)
             else:
                 t.split_conditions[i] = gt.leaf_val[h]
         return t
+
+    @property
+    def has_categorical(self) -> bool:
+        return bool(self.categories)
 
     # ---- padded arrays for the vectorized predictor ----
     def padded_arrays(self, width: int):
@@ -108,6 +119,8 @@ class RegTree:
 
         feat = np.where(self.left_children == -1, -1, self.split_indices).astype(np.int32)
         value = np.where(self.left_children == -1, self.split_conditions, 0.0).astype(np.float32)
+        st = (self.split_type if self.split_type is not None
+              else np.zeros(n, np.int32))
         return dict(
             feat=pad(feat, -1),
             thr=pad(np.where(self.left_children == -1, np.float32(0), self.split_conditions)),
@@ -115,12 +128,36 @@ class RegTree:
             left=pad(self.left_children, -1),
             right=pad(self.right_children, -1),
             value=pad(value),
+            is_cat=pad((st == 1)),
         )
+
+    def cat_matrix(self, width: int, n_cats: int) -> np.ndarray:
+        """(width, n_cats) bool membership matrix of right-routed categories."""
+        out = np.zeros((width, max(n_cats, 1)), dtype=bool)
+        if self.categories:
+            for nid, cats in self.categories.items():
+                cats = cats[cats < n_cats]
+                out[nid, cats] = True
+        return out
+
+    @property
+    def max_category(self) -> int:
+        if not self.categories:
+            return -1
+        return max((int(c.max()) for c in self.categories.values() if len(c)), default=-1)
 
     # ---- xgboost JSON schema (tree_model.cc SaveModel) ----
     def to_json_dict(self, n_features: int) -> dict:
         n = self.n_nodes
         st = self.split_type if self.split_type is not None else np.zeros(n, np.int32)
+        cat_nodes, cat_segs, cat_sizes, cat_flat = [], [], [], []
+        if self.categories:
+            for nid in sorted(self.categories):
+                cats = self.categories[nid]
+                cat_nodes.append(int(nid))
+                cat_segs.append(len(cat_flat))
+                cat_sizes.append(len(cats))
+                cat_flat.extend(int(c) for c in cats)
         return {
             "tree_param": {
                 "num_nodes": str(n),
@@ -134,10 +171,10 @@ class RegTree:
             "split_conditions": [float(x) for x in self.split_conditions],
             "split_type": st.tolist(),
             "default_left": self.default_left.astype(np.int32).tolist(),
-            "categories": [],
-            "categories_nodes": [],
-            "categories_segments": [],
-            "categories_sizes": [],
+            "categories": cat_flat,
+            "categories_nodes": cat_nodes,
+            "categories_segments": cat_segs,
+            "categories_sizes": cat_sizes,
             "base_weights": [float(x) for x in self.base_weights],
             "loss_changes": [float(x) for x in self.loss_changes],
             "sum_hessian": [float(x) for x in self.sum_hessian],
@@ -145,7 +182,14 @@ class RegTree:
 
     @staticmethod
     def from_json_dict(d: dict) -> "RegTree":
+        cats = {}
+        flat = d.get("categories", [])
+        for nid, seg, size in zip(d.get("categories_nodes", []),
+                                  d.get("categories_segments", []),
+                                  d.get("categories_sizes", [])):
+            cats[int(nid)] = np.asarray(flat[seg : seg + size], np.int32)
         return RegTree(
+            categories=cats or None,
             left_children=np.asarray(d["left_children"], np.int32),
             right_children=np.asarray(d["right_children"], np.int32),
             parents=np.asarray(d["parents"], np.int32),
@@ -171,6 +215,14 @@ class RegTree:
                 s = f"{indent}{nid}:leaf={self.split_conditions[nid]:.6g}"
                 if with_stats:
                     s += f",cover={self.sum_hessian[nid]:.6g}"
+            elif self.categories and nid in self.categories:
+                cats = ",".join(str(c) for c in self.categories[nid])
+                s = (
+                    f"{indent}{nid}:[{fname(self.split_indices[nid])}:{{{cats}}}] "
+                    f"yes={self.left_children[nid]},"
+                    f"no={self.right_children[nid]},missing="
+                    f"{self.left_children[nid] if self.default_left[nid] else self.right_children[nid]}"
+                )
             else:
                 s = (
                     f"{indent}{nid}:[{fname(self.split_indices[nid])}<"

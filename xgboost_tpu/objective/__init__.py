@@ -91,3 +91,4 @@ class ObjFunction:
 from . import regression  # noqa: E402,F401  (registers objectives)
 from . import multiclass  # noqa: E402,F401
 from . import ranking  # noqa: E402,F401
+from . import survival  # noqa: E402,F401

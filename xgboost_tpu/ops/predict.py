@@ -16,8 +16,12 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _traverse_one_tree(X, feat, thr, dleft, left, right, depth: int):
-    """Leaf node id per row for one tree. X: (R,F) f32 with NaN missing."""
+def _traverse_one_tree(X, feat, thr, dleft, left, right, depth: int,
+                       is_cat=None, catm=None):
+    """Leaf node id per row for one tree. X: (R,F) f32 with NaN missing.
+
+    Categorical nodes route by set membership (in-set -> right, out-of-range
+    -> left), matching common/categorical.h Decision."""
     R, F = X.shape
     nid = jnp.zeros(R, jnp.int32)
 
@@ -25,7 +29,16 @@ def _traverse_one_tree(X, feat, thr, dleft, left, right, depth: int):
         fi = feat[nid]  # (R,) int32, -1 at leaves
         leaf = fi < 0
         x = jnp.take_along_axis(X, jnp.clip(fi, 0, F - 1)[:, None], axis=1)[:, 0]
-        gol = jnp.where(jnp.isnan(x), dleft[nid], x < thr[nid])
+        gol_num = x < thr[nid]
+        if is_cat is None:
+            gol = jnp.where(jnp.isnan(x), dleft[nid], gol_num)
+        else:
+            Bc = catm.shape[1]
+            c = jnp.nan_to_num(x, nan=-1.0).astype(jnp.int32)
+            in_range = (c >= 0) & (c < Bc)
+            member = catm.reshape(-1)[nid * Bc + jnp.clip(c, 0, Bc - 1)] & in_range
+            gol = jnp.where(is_cat[nid], ~member, gol_num)
+            gol = jnp.where(jnp.isnan(x), dleft[nid], gol)
         nxt = jnp.where(gol, left[nid], right[nid])
         return jnp.where(leaf, nid, nxt)
 
@@ -34,24 +47,31 @@ def _traverse_one_tree(X, feat, thr, dleft, left, right, depth: int):
 
 @functools.partial(jax.jit, static_argnames=("n_groups", "depth"))
 def predict_margin_delta(X, feat, thr, dleft, left, right, value, groups,
-                         *, n_groups: int, depth: int):
+                         is_cat=None, catm=None, *, n_groups: int, depth: int):
     """Sum leaf values of a stack of trees into (R, n_groups) margin deltas.
 
     feat..value : (T, M) stacked padded tree arrays; groups: (T,) int32
     (tree_info group ids, reference src/gbm/gbtree_model.h).
+    is_cat (T, M) / catm (T, M, Bc): optional categorical routing tables.
     """
     R = X.shape[0]
 
     def body(margin, t):
-        f, th, dl, l, r, v, grp = t
-        nid = _traverse_one_tree(X, f, th, dl, l, r, depth)
+        if is_cat is None:
+            f, th, dl, l, r, v, grp = t
+            nid = _traverse_one_tree(X, f, th, dl, l, r, depth)
+        else:
+            f, th, dl, l, r, v, grp, ic, cm = t
+            nid = _traverse_one_tree(X, f, th, dl, l, r, depth, ic, cm)
         delta = v[nid]
         col = lax.dynamic_slice_in_dim(margin, grp, 1, axis=1)
         margin = lax.dynamic_update_slice_in_dim(margin, col + delta[:, None], grp, axis=1)
         return margin, None
 
     margin0 = jnp.zeros((R, n_groups), jnp.float32)
-    margin, _ = lax.scan(body, margin0, (feat, thr, dleft, left, right, value, groups))
+    xs = ((feat, thr, dleft, left, right, value, groups) if is_cat is None
+          else (feat, thr, dleft, left, right, value, groups, is_cat, catm))
+    margin, _ = lax.scan(body, margin0, xs)
     return margin
 
 

@@ -36,6 +36,8 @@ class SplitParams(NamedTuple):
     # monotone_constraints: per-feature {-1,0,+1} (src/tree/constraints.cc);
     # None disables the constrained evaluation path entirely
     monotone: "object" = None
+    # categorical split config (reference: src/tree/param.h max_cat_to_onehot)
+    max_cat_to_onehot: int = 4
 
 
 class BestSplit(NamedTuple):
@@ -47,6 +49,9 @@ class BestSplit(NamedTuple):
     right_sum: jnp.ndarray  # (N, 2)
     left_weight: jnp.ndarray  # (N,) clipped child weights (monotone bounds)
     right_weight: jnp.ndarray  # (N,)
+    is_cat: jnp.ndarray  # (N,) bool — categorical split chosen
+    cat_set: jnp.ndarray  # (N, B) bool — categories routed RIGHT (reference
+    #                        semantics: common/categorical.h Decision)
 
 
 def _threshold_l1(g, alpha):
@@ -83,7 +88,8 @@ def calc_gain(G, H, p: SplitParams):
 
 @functools.partial(jax.jit, static_argnames=("params",))
 def evaluate_splits(
-    hist, totals, n_bins, params: SplitParams, feature_mask=None, node_bounds=None
+    hist, totals, n_bins, params: SplitParams, feature_mask=None, node_bounds=None,
+    cat_mask=None,
 ) -> BestSplit:
     """Pick the best split per node.
 
@@ -95,11 +101,35 @@ def evaluate_splits(
     node_bounds  : optional (N, 2) f32 [lower, upper] monotone weight bounds
     """
     N, F, B, _ = hist.shape
-    cum = jnp.cumsum(hist, axis=2)  # (N,F,B,2): left sums for missing->right
+    has_cat = cat_mask is not None
+
+    if has_cat:
+        # Categorical features (reference: evaluate_splits.cu one-hot pass +
+        # sorted-partition pass, max_cat_to_onehot switch in param.h):
+        #  - partition: permute bins by grad/hess ratio, then the ordinary
+        #    prefix scan below IS the optimal-partition scan;
+        #  - one-hot (few categories): left = everything-but-c, expressed by
+        #    overriding the prefix sums with feat_sum - hist[c].
+        onehot_f = cat_mask & (n_bins < params.max_cat_to_onehot)  # (F,)
+        ratio = hist[..., 0] / (hist[..., 1] + _EPS)  # (N,F,B)
+        ratio = jnp.where(hist[..., 1] > 0, ratio, jnp.inf)  # empty cats last
+        bin_iota = jnp.arange(B, dtype=jnp.float32)
+        sort_key = jnp.where(cat_mask[None, :, None], ratio, bin_iota[None, None, :])
+        order = jnp.argsort(sort_key, axis=2)  # identity for numeric features
+        inv_order = jnp.argsort(order, axis=2).astype(jnp.int32)
+        hist_eval = jnp.take_along_axis(hist, order[..., None], axis=2)
+    else:
+        hist_eval = hist
+
+    cum = jnp.cumsum(hist_eval, axis=2)  # (N,F,B,2): left sums, missing->right
     feat_sum = cum[:, :, -1, :]  # (N,F,2) — uses all bins incl. top
     miss = totals[:, None, :] - feat_sum  # (N,F,2) missing-value stats
 
     GL_r, HL_r = cum[..., 0], cum[..., 1]  # missing -> right
+    if has_cat:
+        oh = onehot_f[None, :, None]
+        GL_r = jnp.where(oh, feat_sum[:, :, None, 0] - hist[..., 0], GL_r)
+        HL_r = jnp.where(oh, feat_sum[:, :, None, 1] - hist[..., 1], HL_r)
     GL_l, HL_l = GL_r + miss[:, :, None, 0], HL_r + miss[:, :, None, 1]  # missing -> left
 
     monotone = params.monotone is not None and any(c != 0 for c in params.monotone)
@@ -154,6 +184,10 @@ def evaluate_splits(
         jnp.abs(miss[:, :, 1:2]) > _EPS
     ).reshape(N, F, 1)
     ok = bin_ok[None, :, :] | top_ok
+    if has_cat:
+        # one-hot: every non-empty category is a valid candidate
+        ok = jnp.where(onehot_f[None, :, None],
+                       (bin_idx[None, None, :] < n_bins[None, :, None]), ok)
     if feature_mask is not None:
         fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
         ok = ok & fm[:, :, None]
@@ -187,6 +221,23 @@ def evaluate_splits(
         lw = calc_weight(GL, HL, params)
         rw = calc_weight(GR, HR, params)
 
+    if has_cat:
+        is_cat = cat_mask[best_f]  # (N,)
+        chosen_oh = onehot_f[best_f]
+        # categories routed RIGHT (common/categorical.h: in-set -> right):
+        #  one-hot: the single chosen category; partition: the sorted suffix
+        inv_at = jnp.take_along_axis(
+            inv_order, best_f[:, None, None], axis=1
+        )[:, 0, :]  # (N, B) rank of each bin in the sorted order
+        bb = jnp.arange(B, dtype=jnp.int32)[None, :]
+        in_range = bb < n_bins[best_f][:, None]
+        set_oh = (bb == best_b[:, None])
+        set_part = inv_at > best_b[:, None]
+        cat_set = jnp.where(chosen_oh[:, None], set_oh, set_part) & in_range & is_cat[:, None]
+    else:
+        is_cat = jnp.zeros(N, bool)
+        cat_set = jnp.zeros((N, B), bool)
+
     return BestSplit(
         gain=best_gain,
         feature=best_f,
@@ -196,4 +247,6 @@ def evaluate_splits(
         right_sum=jnp.stack([GR, HR], axis=1),
         left_weight=lw,
         right_weight=rw,
+        is_cat=is_cat,
+        cat_set=cat_set,
     )

@@ -30,6 +30,7 @@ def _state_specs(data_axis: str):
         alive=P(), totals=P(), feat=P(), sbin=P(), thr=P(), dleft=P(),
         is_leaf=P(), leaf_val=P(), gain=P(), base_weight=P(), sum_hess=P(),
         lower=P(), upper=P(), setcompat=P(), splits_left=P(),
+        is_cat=P(), cat_set=P(),
     )
 
 
@@ -49,8 +50,8 @@ class ShardedHistTreeGrower:
         self.max_nodes = max_nodes_for_depth(max_depth)
         self._built_for = None
 
-    def _build(self, n_features: int) -> None:
-        if self._built_for == n_features:
+    def _build(self, n_features: int, n_bin: int = 1, has_cat: bool = False) -> None:
+        if self._built_for == (n_features, n_bin, has_cat):
             return
         ax = DATA_AXIS
         sspec = _state_specs(ax)
@@ -60,7 +61,7 @@ class ShardedHistTreeGrower:
             jax.shard_map(
                 functools.partial(
                     init_tree_state, max_nodes=self.max_nodes, axis_name=ax,
-                    n_sets=n_sets,
+                    n_sets=n_sets, n_bin=n_bin,
                     max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
                 ),
                 mesh=self.mesh,
@@ -81,23 +82,27 @@ class ShardedHistTreeGrower:
                         axis_name=ax,
                         hist_impl=self.hist_impl,
                         lossguide=self.lossguide,
+                        has_cat=has_cat,
                     ),
                     mesh=self.mesh,
-                    in_specs=(sspec, P(ax, None), P(ax, None), P(), P(), P(), P()),
+                    in_specs=(sspec, P(ax, None), P(ax, None), P(), P(), P(), P(), P()),
                     out_specs=sspec,
                 )
             )
-        self._built_for = n_features
+        self._built_for = (n_features, n_bin, has_cat)
 
-    def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None) -> TreeState:
+    def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None,
+             cat_mask=None) -> TreeState:
         F = bins.shape[1]
-        self._build(F)
+        self._build(F, cuts_pad.shape[1], has_cat=cat_mask is not None)
         ones = jnp.ones((1, F), dtype=bool)
         setmat = jnp.asarray(make_set_matrix(self.interaction_sets, F))
+        cm = jnp.asarray(cat_mask) if cat_mask is not None else jnp.zeros(F, bool)
         state = self._init_fn(gpair, valid)
         for d in range(self.max_depth + 1):
             fm = ones if feature_masks is None else feature_masks(d, 1 << d)
-            state = self._level_fns[d](state, bins, gpair, cuts_pad, n_bins, fm, setmat)
+            state = self._level_fns[d](state, bins, gpair, cuts_pad, n_bins, fm,
+                                       setmat, cm)
         return state
 
     @staticmethod

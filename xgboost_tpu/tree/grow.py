@@ -50,6 +50,8 @@ class TreeState(NamedTuple):
     upper: jnp.ndarray  # (max_nodes,) f32 — monotone weight upper bound
     setcompat: jnp.ndarray  # (max_nodes, n_sets) bool — interaction sets alive
     splits_left: jnp.ndarray  # (1,) int32 — remaining split budget (max_leaves)
+    is_cat: jnp.ndarray  # (max_nodes,) bool — categorical split
+    cat_set: jnp.ndarray  # (max_nodes, B) bool — categories routed RIGHT
 
 
 def max_nodes_for_depth(max_depth: int) -> int:
@@ -81,10 +83,11 @@ def make_set_matrix(interaction_sets, n_features: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_nodes", "axis_name", "n_sets", "max_splits")
+    jax.jit, static_argnames=("max_nodes", "axis_name", "n_sets", "max_splits",
+                              "n_bin")
 )
 def init_tree_state(gpair, valid, *, max_nodes: int, axis_name: Optional[str] = None,
-                    n_sets: int = 1, max_splits: int = 0):
+                    n_sets: int = 1, max_splits: int = 0, n_bin: int = 1):
     """Fresh state: all rows at the root; root totals (all)reduced.
 
     valid : (R_pad,) bool — False for padding rows.
@@ -115,13 +118,15 @@ def init_tree_state(gpair, valid, *, max_nodes: int, axis_name: Optional[str] = 
         upper=jnp.full(mn, jnp.inf, jnp.float32),
         setcompat=jnp.ones((mn, n_sets), bool),
         splits_left=jnp.full((1,), budget, jnp.int32),
+        is_cat=jnp.zeros(mn, bool),
+        cat_set=jnp.zeros((mn, n_bin), bool),
     )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("depth", "params", "last_level", "axis_name", "hist_impl",
-                     "lossguide"),
+                     "lossguide", "has_cat"),
 )
 def level_step(
     state: TreeState,
@@ -131,6 +136,7 @@ def level_step(
     n_bins,
     feature_mask,
     set_matrix,
+    cat_mask,
     *,
     depth: int,
     params: SplitParams,
@@ -138,6 +144,7 @@ def level_step(
     axis_name: Optional[str] = None,
     hist_impl: str = "xla",
     lossguide: bool = False,
+    has_cat: bool = False,
 ):
     """Expand every alive node at ``depth``: hist -> best split -> apply.
 
@@ -186,7 +193,8 @@ def level_step(
     fmask = allowed & fm
 
     node_bounds = jnp.stack([lower_lvl, upper_lvl], axis=1)
-    best = evaluate_splits(hist, totals_lvl, n_bins, params, fmask, node_bounds)
+    best = evaluate_splits(hist, totals_lvl, n_bins, params, fmask, node_bounds,
+                           cat_mask=cat_mask if has_cat else None)
 
     gamma_eps = max(params.gamma, _EPS)
     can_split = alive_lvl & (best.gain > gamma_eps)
@@ -216,6 +224,8 @@ def level_step(
         gain=st.gain.at[idx].set(jnp.where(can_split, best.gain, 0.0)),
         base_weight=st.base_weight.at[idx].set(w),
         sum_hess=st.sum_hess.at[idx].set(totals_lvl[:, 1]),
+        is_cat=st.is_cat.at[idx].set(can_split & best.is_cat),
+        cat_set=st.cat_set.at[idx].set(best.cat_set & can_split[:, None]),
     )
 
     left_ids = 2 * idx + 1
@@ -260,7 +270,15 @@ def level_step(
     binval = jnp.take_along_axis(
         bins, jnp.clip(fr, 0, bins.shape[1] - 1)[:, None].astype(jnp.int32), axis=1
     )[:, 0].astype(jnp.int32)
-    goleft = jnp.where(binval >= B, dl, binval <= sb)  # sentinel B = missing
+    goleft_num = binval <= sb
+    if has_cat:
+        # categorical: in right-set -> right (common/categorical.h Decision)
+        flat = best.cat_set.reshape(-1)
+        member = flat[lc * B + jnp.clip(binval, 0, B - 1)]
+        goleft_split = jnp.where(best.is_cat[lc], ~member, goleft_num)
+    else:
+        goleft_split = goleft_num
+    goleft = jnp.where(binval >= B, dl, goleft_split)  # sentinel B = missing
     child = 2 * pos + 1 + jnp.where(goleft, 0, 1)
     st = st._replace(pos=jnp.where(in_lvl & can_r, child, pos))
 
@@ -279,6 +297,8 @@ def leaf_margin_delta(pos, leaf_val):
 class GrownTree(NamedTuple):
     """Host copy of a finished tree (heap layout)."""
 
+    is_cat: "object"
+    cat_set: "object"
     feat: "object"
     sbin: "object"
     thr: "object"
@@ -318,16 +338,22 @@ class HistTreeGrower:
     def _set_matrix(self, n_features: int):
         return make_set_matrix(self.interaction_sets, n_features)
 
-    def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None) -> TreeState:
+    def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None,
+             cat_mask=None) -> TreeState:
         """feature_masks: None, or callable (depth, n_nodes) -> (1|N, F) bool mask
-        (the ColumnSampler hook: bytree/bylevel/bynode, src/common/random.h)."""
+        (the ColumnSampler hook: bytree/bylevel/bynode, src/common/random.h).
+        cat_mask: optional (F,) bool marking categorical features."""
         F = bins.shape[1]
+        B = cuts_pad.shape[1]
         ones = jnp.ones((1, F), dtype=bool)
         setmat = jnp.asarray(self._set_matrix(F))
+        has_cat = cat_mask is not None
+        cm = jnp.asarray(cat_mask) if has_cat else jnp.zeros(F, bool)
         state = init_tree_state(
             gpair, valid, max_nodes=self.max_nodes, axis_name=self.axis_name,
             n_sets=setmat.shape[0],
             max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
+            n_bin=B,
         )
         for d in range(self.max_depth + 1):
             fm = ones if feature_masks is None else feature_masks(d, 1 << d)
@@ -339,12 +365,14 @@ class HistTreeGrower:
                 n_bins,
                 fm,
                 setmat,
+                cm,
                 depth=d,
                 params=self.params,
                 last_level=(d == self.max_depth),
                 axis_name=self.axis_name,
                 hist_impl=self.hist_impl,
                 lossguide=self.lossguide,
+                has_cat=has_cat,
             )
         return state
 
@@ -353,6 +381,8 @@ class HistTreeGrower:
         import numpy as np
 
         return GrownTree(
+            is_cat=np.asarray(state.is_cat),
+            cat_set=np.asarray(state.cat_set),
             feat=np.asarray(state.feat),
             sbin=np.asarray(state.sbin),
             thr=np.asarray(state.thr),
