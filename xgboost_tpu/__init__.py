@@ -15,6 +15,7 @@ __version__ = "0.1.0"
 from .config import config_context, get_config, set_config
 from .core import Booster
 from .data.dmatrix import DMatrix, MetaInfo, QuantileDMatrix
+from .data.extmem import DataIter, ExtMemQuantileDMatrix
 from .data.ellpack import EllpackPage
 from .data.quantile import HistogramCuts
 from .training import cv, train
@@ -30,6 +31,8 @@ __all__ = [
     "Booster",
     "DMatrix",
     "QuantileDMatrix",
+    "DataIter",
+    "ExtMemQuantileDMatrix",
     "MetaInfo",
     "EllpackPage",
     "HistogramCuts",

@@ -53,10 +53,32 @@ class _Cache:
         self.weights_version = 0  # DART tree-weight epoch this margin reflects
         self.raw_X: Optional[Any] = None  # lazily staged raw matrix for eval predict
 
+    @property
+    def is_extmem(self) -> bool:
+        return hasattr(self.dmat, "_pages")
+
     def ensure_train(self) -> None:
         """Build the binned page + padded label/weight/valid device arrays."""
         import jax.numpy as jnp
 
+        if self.is_extmem:
+            if getattr(self, "_extmem_ready", False):
+                return
+            d = self.dmat
+            R_pad = d.n_padded_total
+            self.valid = jnp.asarray(d.valid_mask())
+            lab = d.padded_labels()
+            self.labels = jnp.asarray(lab if lab is not None
+                                      else np.zeros(R_pad, np.float32))
+            w = d.padded_weights()
+            self.weights = None if w is None else jnp.asarray(w)
+            if self.margin is not None and self.margin.shape[0] != R_pad:
+                extra = R_pad - self.margin.shape[0]
+                self.margin = jnp.concatenate(
+                    [self.margin, jnp.zeros((extra, self.margin.shape[1]), jnp.float32)], 0)
+            self.n_padded = R_pad
+            self._extmem_ready = True
+            return
         if self.ellpack is not None:
             return
         self.ellpack = self.dmat.ensure_ellpack(max_bin=self.max_bin, ref=self.ref)
@@ -80,6 +102,11 @@ class _Cache:
 
         R_pad = self.n_padded
         user = self.dmat.info.base_margin
+        if user is not None and self.is_extmem:
+            m = self.dmat.padded_base_margin().reshape(R_pad, -1)
+            if m.shape[1] != K:
+                m = np.broadcast_to(m, (R_pad, K))
+            return jnp.asarray(m.astype(np.float32))
         if user is not None:
             m = np.asarray(user, np.float32).reshape(len(user), -1)
             if m.shape[1] != K:
@@ -202,11 +229,18 @@ class Booster:
             if self._base_score_param is not None:
                 prob = np.asarray(float(self._base_score_param), np.float32)
                 bm = np.asarray(self.objective.prob_to_margin(prob))
-            elif len(self.trees) == 0 and cache.ellpack is not None:
-                R = cache.ellpack.n_rows
+            elif len(self.trees) == 0 and (
+                cache.ellpack is not None
+                or (cache.is_extmem and getattr(cache, "_extmem_ready", False))
+            ):
+                import jax.numpy as jnp
+
+                v = np.asarray(cache.valid)
                 bm = np.asarray(
                     self.objective.init_estimation(
-                        cache.labels[:R], None if cache.weights is None else cache.weights[:R]
+                        jnp.asarray(np.asarray(cache.labels)[v]),
+                        None if cache.weights is None
+                        else jnp.asarray(np.asarray(cache.weights)[v]),
                     )
                 )
             else:
@@ -224,6 +258,8 @@ class Booster:
         training via xgb_model= and caches rebuilt mid-train."""
         import jax.numpy as jnp
 
+        if cache.is_extmem:
+            cache.ensure_train()
         self._ensure_base_margin(cache)
         if self.booster_kind == "gblinear":
             rounds = getattr(self, "_linear_rounds", 0)
@@ -242,9 +278,15 @@ class Booster:
             cache.weights_version = getattr(self, "_weights_version", 0)
         if cache.n_trees_applied < len(self.trees):
             new = slice(cache.n_trees_applied, len(self.trees))
-            if cache.raw_X is None:
-                cache.raw_X = jnp.asarray(self.dmat_host_dense(cache), jnp.float32)
-            delta = self._margin_delta_for(cache.raw_X, new)
+            if cache.is_extmem:
+                delta = jnp.asarray(self._predict_extmem(cache.dmat, new))
+                cache.margin = cache.margin + delta  # page-padded, aligned
+                cache.n_trees_applied = len(self.trees)
+                return
+            else:
+                if cache.raw_X is None:
+                    cache.raw_X = jnp.asarray(self.dmat_host_dense(cache), jnp.float32)
+                delta = self._margin_delta_for(cache.raw_X, new)
             pad = cache.margin.shape[0] - delta.shape[0]
             if pad:
                 delta = jnp.concatenate(
@@ -284,19 +326,20 @@ class Booster:
             if not hasattr(self.objective, "_gidx"):
                 self.objective.set_group_info(gp)
         self._sync_margin(cache)
-        R = cache.ellpack.n_rows
+        R = dtrain.num_row()
         if fobj is not None:
             # custom objectives receive RAW margins (reference: Booster.update
             # passes output_margin=True predictions to fobj, core.py:2277)
-            m = np.asarray(cache.margin[:R])
+            valid_np = np.asarray(cache.valid)
+            m = np.asarray(cache.margin)[valid_np]
             preds = m[:, 0] if self.n_groups == 1 else m
             grad, hess = fobj(preds, dtrain)
             grad = np.asarray(grad, np.float32).reshape(R, -1)
             hess = np.asarray(hess, np.float32).reshape(R, -1)
             K = grad.shape[1]
-            gpair = np.stack([grad, hess], axis=-1)  # (R, K, 2)
-            pad = cache.ellpack.n_padded - R
-            gpair = jnp.asarray(np.pad(gpair, ((0, pad), (0, 0), (0, 0))))
+            gp_dense = np.zeros((cache.margin.shape[0], K, 2), np.float32)
+            gp_dense[valid_np] = np.stack([grad, hess], axis=-1)
+            gpair = jnp.asarray(gp_dense)
         else:
             gpair = self.objective.get_gradient(
                 cache.margin, cache.labels, cache.weights, iteration
@@ -315,12 +358,13 @@ class Booster:
         cache = self._get_cache(dtrain)
         cache.ensure_train()
         self._sync_margin(cache)
-        R = cache.ellpack.n_rows
+        R = dtrain.num_row()
         g = np.asarray(grad, np.float32).reshape(R, -1)
         h = np.asarray(hess, np.float32).reshape(R, -1)
-        gpair = np.stack([g, h], axis=-1)
-        pad = cache.ellpack.n_padded - R
-        gpair = jnp.asarray(np.pad(gpair, ((0, pad), (0, 0), (0, 0))))
+        valid_np = np.asarray(cache.valid)
+        gp_dense = np.zeros((cache.margin.shape[0], g.shape[1], 2), np.float32)
+        gp_dense[valid_np] = np.stack([g, h], axis=-1)
+        gpair = jnp.asarray(gp_dense)
         gpair = gpair * cache.valid[:, None, None]
         if self.booster_kind == "gblinear":
             self._boost_linear(cache, gpair)
@@ -374,6 +418,95 @@ class Booster:
         self._linear_rounds = getattr(self, "_linear_rounds", 0) + 1
         cache.margin = self._linear_margin(cache)
         cache.n_trees_applied = self._linear_rounds
+
+    def _boost_trees_extmem(self, cache: _Cache, gpair, iteration: int) -> None:
+        """Streaming boost over host-resident pages (ExtMemQuantileDMatrix)."""
+        from .tree.stream import StreamingHistTreeGrower
+
+        d = cache.dmat
+        lossguide = self.tparam.grow_policy == "lossguide"
+        max_depth = self.tparam.max_depth
+        if max_depth <= 0:
+            max_depth = 10 if lossguide else 6
+        grower = StreamingHistTreeGrower(
+            max_depth, self._split_params,
+            interaction_sets=self.tparam.interaction_constraints,
+            max_leaves=self.tparam.max_leaves, lossguide=lossguide,
+        )
+        K = gpair.shape[1]
+        new_margin = cache.margin
+        cat_ft = d.info.feature_types
+        cat_mask_np = (np.asarray([t == "c" for t in cat_ft], bool)
+                       if cat_ft and "c" in cat_ft else None)
+        for p_idx in range(max(self.num_parallel_tree, 1)):
+            fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx, d.num_col())
+            gp_all = self._subsample_mask(gpair, iteration * 131 + p_idx)
+            for k in range(K):
+                state = grower.grow(
+                    d._pages, d.page_offsets(), gp_all[:, k, :], cache.valid,
+                    d.cuts_pad, d.n_bins, feature_masks=fmask_fn,
+                    cat_mask=cat_mask_np,
+                )
+                delta = leaf_margin_delta(state.pos, state.leaf_val)
+                new_margin = new_margin.at[:, k].add(delta)
+                tree = RegTree.from_grown(StreamingHistTreeGrower.to_host(state))
+                self.trees.append(tree)
+                self.tree_info.append(k)
+                self.tree_weights.append(1.0)
+        cache.margin = new_margin
+        cache.n_trees_applied = len(self.trees)
+
+    def _predict_extmem(self, data, tree_slice: slice) -> np.ndarray:
+        """Batched binned prediction over host pages (no raw data needed)."""
+        import jax.numpy as jnp
+
+        from .ops.predict import predict_margin_delta_binned
+
+        self._ensure_split_bins(tree_slice, data)
+        stacked, groups, depth = self._stacked(tree_slice)
+        Bw = data.cuts_pad.shape[1]
+        outs = []
+        for i, page in enumerate(data._pages):
+            dev = jnp.asarray(np.ascontiguousarray(page))
+            if stacked["catm"] is not None:
+                m = predict_margin_delta_binned(
+                    dev, stacked["feat"], stacked["sbin"], stacked["dleft"],
+                    stacked["left"], stacked["right"], stacked["value"], groups,
+                    stacked["is_cat"], stacked["catm"],
+                    n_groups=self.n_groups, depth=depth, n_bin=Bw)
+            else:
+                m = predict_margin_delta_binned(
+                    dev, stacked["feat"], stacked["sbin"], stacked["dleft"],
+                    stacked["left"], stacked["right"], stacked["value"], groups,
+                    n_groups=self.n_groups, depth=depth, n_bin=Bw)
+            outs.append(np.asarray(m))  # PAGE-PADDED layout (padding rows kept)
+        return np.concatenate(outs, axis=0)
+
+    def _ensure_split_bins(self, tree_slice: slice, data) -> None:
+        """Reconstruct split_bins for loaded models (split_bins is internal and
+        not serialized): thr == cuts[f][sbin] exactly, so sbin is recoverable
+        by an exact searchsorted against this matrix's cuts."""
+        cuts = data._cuts
+        for t in self.trees[tree_slice]:
+            if t.split_bins is not None:
+                continue
+            n = t.n_nodes
+            sbin = np.zeros(n, np.int32)
+            for nid in range(n):
+                if t.left_children[nid] == -1:
+                    continue
+                if t.split_type is not None and t.split_type[nid] == 1:
+                    continue  # categorical routes via the set, sbin unused
+                f = int(t.split_indices[nid])
+                seg = cuts.feature_cuts(f)
+                b = int(np.searchsorted(seg, t.split_conditions[nid], side="left"))
+                if b >= len(seg) or seg[b] != t.split_conditions[nid]:
+                    raise ValueError(
+                        "cannot map split threshold onto this matrix's bin "
+                        "cuts; was the model trained with different cuts?"
+                    )
+                sbin[nid] = b
+            t.split_bins = sbin
 
     def _rng(self, iteration: int, tag: int) -> np.random.Generator:
         seed = int(self.params.get("seed", 0))
@@ -430,6 +563,11 @@ class Booster:
     def _boost_trees(self, cache: _Cache, gpair, iteration: int) -> None:
         import jax.numpy as jnp
 
+        if cache.is_extmem:
+            if self.booster_kind == "dart":
+                raise ValueError("booster='dart' is not supported with "
+                                 "ExtMemQuantileDMatrix yet")
+            return self._boost_trees_extmem(cache, gpair, iteration)
         ell = cache.ellpack
         mono = self.tparam.monotone_constraints
         if mono is not None and len(mono) != ell.n_features:
@@ -616,6 +754,9 @@ class Booster:
 
         cache = self._get_cache(dmat)
         self._sync_margin(cache)
+        if cache.is_extmem:
+            cache.ensure_train()
+            return np.asarray(cache.margin)[np.asarray(cache.valid)]
         R = dmat.num_row()
         return np.asarray(cache.margin[:R])
 
@@ -634,7 +775,7 @@ class Booster:
         depth = max((t.max_depth for t in trees), default=0) + 1
         has_cat = any(t.has_categorical for t in trees)
         cols = {k: [] for k in ("feat", "thr", "dleft", "left", "right", "value",
-                                "is_cat")}
+                                "is_cat", "sbin")}
         cats = []
         n_cats = max((t.max_category for t in trees), default=-1) + 1 if has_cat else 0
         for t, w in zip(trees, wts):
@@ -694,7 +835,6 @@ class Booster:
         import jax.numpy as jnp
 
         self._configure()
-        X = jnp.asarray(data.host_dense(), jnp.float32)
         if self.booster_kind == "gblinear":
             if pred_leaf:
                 raise ValueError("pred_leaf is not defined for the gblinear booster")
@@ -711,6 +851,30 @@ class Booster:
             pass  # reference keeps all trees unless user slices
         tpr = self.trees_per_round
         tree_slice = slice(lo * tpr, hi * tpr)
+        if hasattr(data, "_pages"):  # external-memory: binned page predict
+            if pred_leaf or pred_contribs or pred_interactions:
+                raise ValueError(
+                    "pred_leaf/pred_contribs are not supported for "
+                    "ExtMemQuantileDMatrix; predict on an in-memory DMatrix"
+                )
+            base = np.broadcast_to(self.base_score.reshape(-1), (self.n_groups,))
+            if len(self.trees) and tree_slice.start < tree_slice.stop:
+                padded = self._predict_extmem(data, tree_slice)
+                margin = padded[data.valid_mask()] + base[None, :]
+            else:
+                margin = np.broadcast_to(base, (data.num_row(), self.n_groups)).copy()
+            if data.info.base_margin is not None:
+                um = np.asarray(data.info.base_margin, np.float32).reshape(
+                    data.num_row(), -1)
+                margin = margin - base[None, :] + um
+            if output_margin:
+                out = margin
+            else:
+                import jax.numpy as jnp
+
+                out = np.asarray(self.objective.pred_transform(jnp.asarray(margin)))
+            return out[:, 0] if self.n_groups == 1 and not strict_shape else out
+        X = jnp.asarray(data.host_dense(), jnp.float32)
         if pred_leaf:
             if not self.trees[tree_slice]:
                 return np.zeros((data.num_row(), 0), np.int32)

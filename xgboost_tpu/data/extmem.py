@@ -1,0 +1,275 @@
+"""External-memory data: DataIter + ExtMemQuantileDMatrix.
+
+Reference: python-package/xgboost/core.py:265 (DataIter callback protocol),
+src/data/extmem_quantile_dmatrix.{h,cc} (the modern external-memory path:
+binned Ellpack pages in a host cache, re-streamed to device every histogram
+pass) and src/data/ellpack_page_source.h:37-70 (EllpackCacheInfo/MemCache).
+
+TPU design: pass 1 streams user batches through the device sketcher and merges
+per-batch quantile grids (the fixed-size analogue of the reference's
+AllreduceV summary merge); pass 2 bins each batch on device and parks the
+compressed page in HOST RAM (optionally a disk-backed memmap — the
+``on_host=False`` spill path).  Training streams pages host->HBM with
+one-page-ahead prefetch (reference: n_prefetch_batches,
+sparse_page_source.h:293).
+"""
+from __future__ import annotations
+
+import tempfile
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .dmatrix import DMatrix, MetaInfo
+from .ellpack import _bin_dtype, build_ellpack
+from .quantile import HistogramCuts, cuts_from_quantile_grid, sketch_dense
+
+PAGE_ALIGN = 1024  # rows; keeps every page a whole number of hist row tiles
+
+
+class DataIter:
+    """User-defined batch iterator (reference: core.py:265).
+
+    Subclasses implement ``next(input_data)`` — call ``input_data(data=...,
+    label=..., weight=..., ...)`` and return 1, or return 0 at the end — and
+    ``reset()``.
+    """
+
+    def __init__(self, cache_prefix: Optional[str] = None,
+                 release_data: bool = True) -> None:
+        self.cache_prefix = cache_prefix
+        self.release_data = release_data
+
+    def next(self, input_data: Callable) -> int:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+def _iterate(it: DataIter):
+    """Drive a DataIter; yields dicts of the input_data kwargs per batch."""
+    it.reset()
+    while True:
+        got: List[dict] = []
+
+        def input_data(**kwargs):
+            got.append(kwargs)
+            return 1
+
+        status = it.next(input_data)
+        if not status:
+            break
+        if not got:
+            raise RuntimeError("DataIter.next returned 1 without calling input_data")
+        yield got[0]
+
+
+class ExtMemQuantileDMatrix(DMatrix):
+    """External-memory binned DMatrix (reference: core.py:1624,
+    extmem_quantile_dmatrix.h:29).
+
+    Pages live in host RAM (or disk when ``on_host=False``); device HBM only
+    ever holds one or two pages plus the histogram.
+    """
+
+    def __init__(self, data: DataIter, *, max_bin: int = 256,
+                 ref: Optional[DMatrix] = None, missing: float = np.nan,
+                 on_host: bool = True, enable_categorical: bool = False,
+                 cache_host_ratio: Optional[float] = None, **kwargs: Any) -> None:
+        if not isinstance(data, DataIter):
+            raise TypeError("ExtMemQuantileDMatrix requires a DataIter")
+        self._it = data
+        self.max_bin = max_bin
+        self.on_host = on_host
+        self._pages: List[np.ndarray] = []
+        self._page_rows: List[int] = []  # real rows per page
+        self._spill_dir = None if on_host else tempfile.mkdtemp(prefix="xtb_pages_")
+
+        # ---- pass 1: sketch (merge per-batch quantile grids) ----
+        grids, counts = [], []
+        labels, weights, margins, n_col = [], [], [], None
+        cat_mask = None
+        num_row = 0
+        for batch in _iterate(data):
+            X = np.asarray(batch["data"], dtype=np.float32)
+            num_row += X.shape[0]
+            if n_col is None:
+                n_col = X.shape[1]
+                ft = batch.get("feature_types")
+                if ft is not None:
+                    cat_mask = np.asarray([t == "c" for t in ft], bool)
+            if "label" in batch and batch["label"] is not None:
+                labels.append(np.asarray(batch["label"], np.float32))
+            if batch.get("weight") is not None:
+                weights.append(np.asarray(batch["weight"], np.float32))
+            if batch.get("base_margin") is not None:
+                margins.append(np.asarray(batch["base_margin"], np.float32))
+            if ref is None:
+                c = sketch_dense(X, max_bin, cat_mask=cat_mask)
+                grids.append(c)
+                counts.append(X.shape[0])
+
+        if ref is not None:
+            # GetCutsFromRef: reuse training cuts (quantile_dmatrix.cc:19);
+            # works for both in-core refs (lazy ellpack) and extmem refs
+            cuts = getattr(ref, "_cuts", None)
+            if cuts is None:
+                cuts = ref.ensure_ellpack(max_bin=max_bin).cuts
+        else:
+            cuts = _merge_batch_cuts(grids, counts, max_bin, cat_mask)
+        self._cuts = cuts
+
+        # metadata container
+        label = np.concatenate(labels) if labels else None
+        self.info = MetaInfo(num_row=num_row, num_col=n_col or 0)
+        if label is not None:
+            self.info.label = label
+        if weights:
+            self.info.weight = np.concatenate(weights)
+        if margins:
+            self.info.base_margin = np.concatenate(margins)
+        self.info.feature_types = (
+            ["c" if c else "q" for c in cat_mask] if cat_mask is not None else None
+        )
+
+        # ---- pass 2: bin pages on device, park them on host/disk ----
+        self._kind = "extmem"
+        self._dense = None
+        self._csr = None
+        self._ellpack = None
+        self._max_bin_built = max_bin
+        for bi, batch in enumerate(_iterate(data)):
+            X = np.asarray(batch["data"], dtype=np.float32)
+            page = build_ellpack(X, cuts, row_align=PAGE_ALIGN)
+            host_page = np.asarray(page.bins)
+            if not on_host:
+                path = f"{self._spill_dir}/page{bi}.npy"
+                mm = np.lib.format.open_memmap(
+                    path, mode="w+", dtype=host_page.dtype, shape=host_page.shape
+                )
+                mm[:] = host_page
+                mm.flush()
+                host_page = np.lib.format.open_memmap(path, mode="r")
+            self._pages.append(host_page)
+            self._page_rows.append(X.shape[0])
+        import jax.numpy as jnp
+
+        self.cuts_pad = jnp.asarray(cuts.padded())
+        self.n_bins = jnp.asarray(cuts.n_bins_array())
+        self.info.validate()
+
+    # geometry
+    @property
+    def n_padded_total(self) -> int:
+        return sum(p.shape[0] for p in self._pages)
+
+    def page_offsets(self) -> List[int]:
+        offs = [0]
+        for p in self._pages:
+            offs.append(offs[-1] + p.shape[0])
+        return offs
+
+    def num_row(self) -> int:
+        return self.info.num_row
+
+    def num_col(self) -> int:
+        return self.info.num_col
+
+    def valid_mask(self) -> np.ndarray:
+        out = np.zeros(self.n_padded_total, bool)
+        off = 0
+        for p, r in zip(self._pages, self._page_rows):
+            out[off : off + r] = True
+            off += p.shape[0]
+        return out
+
+    def padded_labels(self) -> Optional[np.ndarray]:
+        if self.info.label is None:
+            return None
+        out = np.zeros(self.n_padded_total, self.info.label.dtype)
+        off = 0
+        src = 0
+        for p, r in zip(self._pages, self._page_rows):
+            out[off : off + r] = self.info.label[src : src + r]
+            off += p.shape[0]
+            src += r
+        return out
+
+    def padded_weights(self) -> Optional[np.ndarray]:
+        return self._pad_rows(self.info.weight)
+
+    def padded_base_margin(self) -> Optional[np.ndarray]:
+        return self._pad_rows(self.info.base_margin)
+
+    def _pad_rows(self, arr: Optional[np.ndarray]) -> Optional[np.ndarray]:
+        if arr is None:
+            return None
+        shape = (self.n_padded_total,) + arr.shape[1:]
+        out = np.zeros(shape, np.float32)
+        off = 0
+        src = 0
+        for p, r in zip(self._pages, self._page_rows):
+            out[off : off + r] = arr[src : src + r]
+            off += p.shape[0]
+            src += r
+        return out
+
+    def host_dense(self) -> np.ndarray:
+        raise NotImplementedError(
+            "ExtMemQuantileDMatrix does not materialize raw data; "
+            "prediction streams the binned pages instead"
+        )
+
+    def ensure_ellpack(self, max_bin: int = 256, **kw):
+        raise NotImplementedError("external-memory pages are pre-binned")
+
+
+def _merge_batch_cuts(batch_cuts: Sequence[HistogramCuts], counts: Sequence[int],
+                      max_bin: int, cat_mask=None) -> HistogramCuts:
+    """Merge per-batch cut grids into global cuts: each batch's cut points are
+    weighted by its row count and the merged weighted quantiles re-extracted —
+    the fixed-size analogue of the reference's summary merge
+    (src/common/quantile.cc:397 AllreduceV of GK summaries)."""
+    if len(batch_cuts) == 1:
+        return batch_cuts[0]
+    F = batch_cuts[0].n_features
+    Q = max(max_bin - 1, 1)
+    grid = np.full((F, Q), np.inf, dtype=np.float32)
+    nvalid = np.zeros(F, np.int64)
+    vmax = np.full(F, -np.inf, np.float32)
+    vmin = np.full(F, np.inf, np.float32)
+    qs = np.arange(1, Q + 1, dtype=np.float64) / (Q + 1)
+    for f in range(F):
+        if cat_mask is not None and cat_mask[f]:
+            n_cats = max(c.n_bins(f) for c in batch_cuts)
+            grid[f, : n_cats - 1] = np.arange(1, n_cats, dtype=np.float32)
+            nvalid[f] = sum(counts)
+            vmax[f], vmin[f] = float(n_cats - 1), 0.0
+            continue
+        pts, wts = [], []
+        for c, cnt in zip(batch_cuts, counts):
+            seg = c.feature_cuts(f)[:-1]  # drop the open upper bound
+            if len(seg) == 0:
+                continue
+            pts.append(seg)
+            wts.append(np.full(len(seg), cnt / max(len(seg), 1), np.float64))
+            vmax[f] = max(vmax[f], seg[-1] if len(seg) else -np.inf)
+            vmin[f] = min(vmin[f], c.min_vals[f])
+        for c in batch_cuts:  # true max lives in the open upper bound
+            fc = c.feature_cuts(f)
+            if len(fc):
+                vmax[f] = max(vmax[f], fc[-1] / 1.01)
+        if not pts:
+            continue
+        allp = np.concatenate(pts)
+        allw = np.concatenate(wts)
+        order = np.argsort(allp, kind="stable")
+        sp, sw = allp[order], allw[order]
+        cdf = np.cumsum(sw)
+        idx = np.searchsorted(cdf, qs * cdf[-1], side="left")
+        grid[f] = sp[np.clip(idx, 0, len(sp) - 1)].astype(np.float32)
+        nvalid[f] = sum(counts)
+    vmax = np.where(np.isfinite(vmax), vmax, 0.0)
+    vmin = np.where(np.isfinite(vmin), vmin, 0.0)
+    return cuts_from_quantile_grid(grid, nvalid, vmax, vmin)

@@ -121,6 +121,8 @@ class RegTree:
         value = np.where(self.left_children == -1, self.split_conditions, 0.0).astype(np.float32)
         st = (self.split_type if self.split_type is not None
               else np.zeros(n, np.int32))
+        sbin = (self.split_bins if self.split_bins is not None
+                else np.zeros(n, np.int32))
         return dict(
             feat=pad(feat, -1),
             thr=pad(np.where(self.left_children == -1, np.float32(0), self.split_conditions)),
@@ -129,6 +131,7 @@ class RegTree:
             right=pad(self.right_children, -1),
             value=pad(value),
             is_cat=pad((st == 1)),
+            sbin=pad(sbin.astype(np.int32)),
         )
 
     def cat_matrix(self, width: int, n_cats: int) -> np.ndarray:

@@ -84,3 +84,55 @@ def predict_leaf_ids(X, feat, thr, dleft, left, right, *, depth: int):
 
     _, nids = lax.scan(body, None, (feat, thr, dleft, left, right))
     return nids.T
+
+
+@functools.partial(jax.jit, static_argnames=("n_groups", "depth", "n_bin"))
+def predict_margin_delta_binned(bins, feat, sbin, dleft, left, right, value,
+                                groups, is_cat=None, catm=None, *,
+                                n_groups: int, depth: int, n_bin: int):
+    """Ensemble margins over a BINNED page (external-memory predict path).
+
+    Routing uses stored split bins (RegTree.split_bins) so it reproduces the
+    training-time partition exactly; sentinel n_bin = missing.
+    """
+    R = bins.shape[0]
+
+    def traverse(f, sb, dl, l, r, ic, cm):
+        nid = jnp.zeros(R, jnp.int32)
+
+        def step(_, nid):
+            fi = f[nid]
+            leaf = fi < 0
+            b = jnp.take_along_axis(
+                bins, jnp.clip(fi, 0, bins.shape[1] - 1)[:, None].astype(jnp.int32),
+                axis=1)[:, 0].astype(jnp.int32)
+            gol_num = b <= sb[nid]
+            if ic is not None:
+                Bc = cm.shape[1]
+                member = cm.reshape(-1)[nid * Bc + jnp.clip(b, 0, Bc - 1)] & (b < Bc)
+                gol = jnp.where(ic[nid], ~member, gol_num)
+            else:
+                gol = gol_num
+            gol = jnp.where(b >= n_bin, dl[nid], gol)  # sentinel = missing
+            nxt = jnp.where(gol, l[nid], r[nid])
+            return jnp.where(leaf, nid, nxt)
+
+        return lax.fori_loop(0, depth, step, nid)
+
+    def body(margin, t):
+        if is_cat is None:
+            f, sb, dl, l, r, v, grp = t
+            nid = traverse(f, sb, dl, l, r, None, None)
+        else:
+            f, sb, dl, l, r, v, grp, ic, cm = t
+            nid = traverse(f, sb, dl, l, r, ic, cm)
+        delta = v[nid]
+        col = lax.dynamic_slice_in_dim(margin, grp, 1, axis=1)
+        margin = lax.dynamic_update_slice_in_dim(margin, col + delta[:, None], grp, axis=1)
+        return margin, None
+
+    margin0 = jnp.zeros((R, n_groups), jnp.float32)
+    xs = ((feat, sbin, dleft, left, right, value, groups) if is_cat is None
+          else (feat, sbin, dleft, left, right, value, groups, is_cat, catm))
+    margin, _ = lax.scan(body, margin0, xs)
+    return margin

@@ -123,6 +123,80 @@ def init_tree_state(gpair, valid, *, max_nodes: int, axis_name: Optional[str] = 
     )
 
 
+
+
+def _record_level(st: TreeState, best, idx, can_split, new_leaf, w, thr_lvl,
+                  totals_lvl, compat_lvl, member, new_budget, lower_lvl,
+                  upper_lvl, params: SplitParams):
+    """Apply one level's split decisions to the tree arrays (shared between
+    the in-core level_step and the external-memory streaming grower)."""
+    st = st._replace(
+        feat=st.feat.at[idx].set(jnp.where(can_split, best.feature, -1)),
+        sbin=st.sbin.at[idx].set(jnp.where(can_split, best.bin, 0)),
+        thr=st.thr.at[idx].set(jnp.where(can_split, thr_lvl, 0.0)),
+        dleft=st.dleft.at[idx].set(best.default_left),
+        is_leaf=st.is_leaf.at[idx].set(new_leaf),
+        leaf_val=st.leaf_val.at[idx].set(jnp.where(new_leaf, params.eta * w, 0.0)),
+        gain=st.gain.at[idx].set(jnp.where(can_split, best.gain, 0.0)),
+        base_weight=st.base_weight.at[idx].set(w),
+        sum_hess=st.sum_hess.at[idx].set(totals_lvl[:, 1]),
+        is_cat=st.is_cat.at[idx].set(can_split & best.is_cat),
+        cat_set=st.cat_set.at[idx].set(best.cat_set & can_split[:, None]),
+    )
+    left_ids = 2 * idx + 1
+    right_ids = 2 * idx + 2
+    st = st._replace(
+        alive=st.alive.at[left_ids].set(can_split).at[right_ids].set(can_split),
+        totals=st.totals.at[left_ids].set(best.left_sum).at[right_ids].set(best.right_sum),
+        splits_left=jnp.full((1,), new_budget, jnp.int32),
+    )
+    child_compat = compat_lvl & member
+    st = st._replace(
+        setcompat=st.setcompat.at[left_ids].set(child_compat).at[right_ids].set(child_compat)
+    )
+    if params.monotone is not None and any(c != 0 for c in params.monotone):
+        # bounds propagation: mid = (wL + wR)/2 splits the feasible interval
+        # (reference: constraints.cc ValueConstraint::SetChild)
+        cvec = jnp.asarray(params.monotone, jnp.int32)
+        c_at = cvec[jnp.clip(best.feature, 0, len(params.monotone) - 1)]
+        mid = 0.5 * (best.left_weight + best.right_weight)
+        l_lo = jnp.where(c_at < 0, mid, lower_lvl)
+        l_hi = jnp.where(c_at > 0, mid, upper_lvl)
+        r_lo = jnp.where(c_at > 0, mid, lower_lvl)
+        r_hi = jnp.where(c_at < 0, mid, upper_lvl)
+        st = st._replace(
+            lower=st.lower.at[left_ids].set(l_lo).at[right_ids].set(r_lo),
+            upper=st.upper.at[left_ids].set(l_hi).at[right_ids].set(r_hi),
+        )
+    return st
+
+
+def _update_positions(bins, pos, best, can_split, node0: int, N: int, B: int,
+                      has_cat: bool):
+    """Route rows of splitting nodes to their children (RowPartitioner
+    analogue) — per-row elementwise, safe to run per page shard."""
+    local = pos - node0
+    in_lvl = (local >= 0) & (local < N)
+    lc = jnp.clip(local, 0, N - 1)
+    can_r = can_split[lc]
+    fr = best.feature[lc]
+    sb = best.bin[lc]
+    dl = best.default_left[lc]
+    binval = jnp.take_along_axis(
+        bins, jnp.clip(fr, 0, bins.shape[1] - 1)[:, None].astype(jnp.int32), axis=1
+    )[:, 0].astype(jnp.int32)
+    goleft_num = binval <= sb
+    if has_cat:
+        # categorical: in right-set -> right (common/categorical.h Decision)
+        flat = best.cat_set.reshape(-1)
+        member = flat[lc * B + jnp.clip(binval, 0, B - 1)]
+        goleft_split = jnp.where(best.is_cat[lc], ~member, goleft_num)
+    else:
+        goleft_split = goleft_num
+    goleft = jnp.where(binval >= B, dl, goleft_split)  # sentinel B = missing
+    child = 2 * pos + 1 + jnp.where(goleft, 0, 1)
+    return jnp.where(in_lvl & can_r, child, pos)
+
 @functools.partial(
     jax.jit,
     static_argnames=("depth", "params", "last_level", "axis_name", "hist_impl",
@@ -212,76 +286,13 @@ def level_step(
     new_leaf = alive_lvl & ~can_split
 
     thr_lvl = cuts_pad[best.feature, jnp.minimum(best.bin, B - 1)]
-
-    st = state
-    st = st._replace(
-        feat=st.feat.at[idx].set(jnp.where(can_split, best.feature, -1)),
-        sbin=st.sbin.at[idx].set(jnp.where(can_split, best.bin, 0)),
-        thr=st.thr.at[idx].set(jnp.where(can_split, thr_lvl, 0.0)),
-        dleft=st.dleft.at[idx].set(best.default_left),
-        is_leaf=st.is_leaf.at[idx].set(new_leaf),
-        leaf_val=st.leaf_val.at[idx].set(jnp.where(new_leaf, params.eta * w, 0.0)),
-        gain=st.gain.at[idx].set(jnp.where(can_split, best.gain, 0.0)),
-        base_weight=st.base_weight.at[idx].set(w),
-        sum_hess=st.sum_hess.at[idx].set(totals_lvl[:, 1]),
-        is_cat=st.is_cat.at[idx].set(can_split & best.is_cat),
-        cat_set=st.cat_set.at[idx].set(best.cat_set & can_split[:, None]),
-    )
-
-    left_ids = 2 * idx + 1
-    right_ids = 2 * idx + 2
-    st = st._replace(
-        alive=st.alive.at[left_ids].set(can_split).at[right_ids].set(can_split),
-        totals=st.totals.at[left_ids].set(best.left_sum).at[right_ids].set(best.right_sum),
-        splits_left=jnp.full((1,), new_budget, jnp.int32),
-    )
-
-    # interaction compat narrows to sets containing the chosen feature
     member = set_matrix.T[jnp.clip(best.feature, 0, set_matrix.shape[1] - 1)]  # (N, n_sets)
-    child_compat = compat_lvl & member
+    st = _record_level(state, best, idx, can_split, new_leaf, w, thr_lvl,
+                       totals_lvl, compat_lvl, member, new_budget, lower_lvl,
+                       upper_lvl, params)
     st = st._replace(
-        setcompat=st.setcompat.at[left_ids].set(child_compat).at[right_ids].set(child_compat)
+        pos=_update_positions(bins, st.pos, best, can_split, node0, N, B, has_cat)
     )
-
-    if params.monotone is not None and any(c != 0 for c in params.monotone):
-        # bounds propagation: mid = (wL + wR)/2 splits the feasible interval
-        # (reference: constraints.cc ValueConstraint::SetChild)
-        cvec = jnp.asarray(params.monotone, jnp.int32)
-        c_at = cvec[jnp.clip(best.feature, 0, len(params.monotone) - 1)]
-        mid = 0.5 * (best.left_weight + best.right_weight)
-        l_lo = jnp.where(c_at < 0, mid, lower_lvl)
-        l_hi = jnp.where(c_at > 0, mid, upper_lvl)
-        r_lo = jnp.where(c_at > 0, mid, lower_lvl)
-        r_hi = jnp.where(c_at < 0, mid, upper_lvl)
-        st = st._replace(
-            lower=st.lower.at[left_ids].set(l_lo).at[right_ids].set(r_lo),
-            upper=st.upper.at[left_ids].set(l_hi).at[right_ids].set(r_hi),
-        )
-
-    # --- position update (RowPartitioner analogue) ---
-    pos = st.pos
-    local = pos - node0
-    in_lvl = (local >= 0) & (local < N)
-    lc = jnp.clip(local, 0, N - 1)
-    can_r = can_split[lc]
-    fr = best.feature[lc]
-    sb = best.bin[lc]
-    dl = best.default_left[lc]
-    binval = jnp.take_along_axis(
-        bins, jnp.clip(fr, 0, bins.shape[1] - 1)[:, None].astype(jnp.int32), axis=1
-    )[:, 0].astype(jnp.int32)
-    goleft_num = binval <= sb
-    if has_cat:
-        # categorical: in right-set -> right (common/categorical.h Decision)
-        flat = best.cat_set.reshape(-1)
-        member = flat[lc * B + jnp.clip(binval, 0, B - 1)]
-        goleft_split = jnp.where(best.is_cat[lc], ~member, goleft_num)
-    else:
-        goleft_split = goleft_num
-    goleft = jnp.where(binval >= B, dl, goleft_split)  # sentinel B = missing
-    child = 2 * pos + 1 + jnp.where(goleft, 0, 1)
-    st = st._replace(pos=jnp.where(in_lvl & can_r, child, pos))
-
     return st
 
 

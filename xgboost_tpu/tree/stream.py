@@ -1,0 +1,178 @@
+"""Streaming (external-memory) tree grower.
+
+Reference: the reference's external-memory training re-streams compressed
+Ellpack pages from the host cache through every BuildHist pass
+(updater_gpu_hist.cu:597 GetBatches inside the driver loop; prefetch window
+sparse_page_source.h:293).  Here each level makes ONE pass over the host
+pages: a page's rows are routed with the PREVIOUS level's split decisions and
+immediately accumulated into the current level's histogram, so the page is
+touched once per level; host->HBM transfer of page i+1 overlaps compute on
+page i (jax.device_put is async).
+
+Everything except the page loop reuses the in-core grower's pieces
+(evaluate_splits / _record_level / _update_positions), so the split semantics
+are bitwise identical to HistTreeGrower.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.histogram import build_histogram
+from ..ops.split import SplitParams, calc_weight, evaluate_splits
+from .grow import (TreeState, _record_level, _update_positions, init_tree_state,
+                   make_set_matrix, max_nodes_for_depth)
+
+_EPS = 1e-6
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("node0_prev", "n_prev", "node0", "n_nodes", "n_bin",
+                     "has_prev", "has_cat", "build"),
+)
+def _page_step(page_bins, gpair_seg, pos_seg, prev_best, prev_can, *,
+               node0_prev: int, n_prev: int, node0: int, n_nodes: int,
+               n_bin: int, has_prev: bool, has_cat: bool, build: bool = True):
+    """Route one page with the previous level's splits, then accumulate the
+    current level's histogram over it."""
+    if has_prev:
+        pos_seg = _update_positions(page_bins, pos_seg, prev_best, prev_can,
+                                    node0_prev, n_prev, n_bin, has_cat)
+    if build:
+        hist = build_histogram(page_bins, gpair_seg, pos_seg, node0=node0,
+                               n_nodes=n_nodes, n_bin=n_bin)
+    else:
+        hist = jnp.zeros((n_nodes, 1, 1, 2), jnp.float32)
+    return pos_seg, hist
+
+
+@functools.partial(
+    jax.jit, static_argnames=("depth", "params", "lossguide", "last_level"),
+)
+def _decide_level(state: TreeState, hist, n_bins, cuts_pad, feature_mask,
+                  set_matrix, cat_mask, *, depth: int, params: SplitParams,
+                  lossguide: bool, last_level: bool):
+    """evaluate + record for one level (no position update — pages do that)."""
+    node0 = (1 << depth) - 1
+    N = 1 << depth
+    B = cuts_pad.shape[1]
+    idx = node0 + jnp.arange(N, dtype=jnp.int32)
+    totals_lvl = lax.dynamic_slice_in_dim(state.totals, node0, N, axis=0)
+    alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, N, axis=0)
+    lower_lvl = lax.dynamic_slice_in_dim(state.lower, node0, N, axis=0)
+    upper_lvl = lax.dynamic_slice_in_dim(state.upper, node0, N, axis=0)
+    w = calc_weight(totals_lvl[:, 0], totals_lvl[:, 1], params, lower_lvl, upper_lvl)
+
+    if last_level:
+        return state._replace(
+            is_leaf=state.is_leaf.at[idx].set(alive_lvl),
+            leaf_val=state.leaf_val.at[idx].set(jnp.where(alive_lvl, params.eta * w, 0.0)),
+            base_weight=state.base_weight.at[idx].set(w),
+            sum_hess=state.sum_hess.at[idx].set(totals_lvl[:, 1]),
+        ), None, None
+
+    compat_lvl = lax.dynamic_slice_in_dim(state.setcompat, node0, N, axis=0)
+    allowed = jnp.einsum("ns,sf->nf", compat_lvl.astype(jnp.float32),
+                         set_matrix.astype(jnp.float32)) > 0.0
+    fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
+    node_bounds = jnp.stack([lower_lvl, upper_lvl], axis=1)
+    has_cat = bool(cat_mask.shape) and cat_mask.shape[0] > 0
+    best = evaluate_splits(hist, totals_lvl, n_bins, params, allowed & fm,
+                           node_bounds, cat_mask=cat_mask if has_cat else None)
+    gamma_eps = max(params.gamma, _EPS)
+    can_split = alive_lvl & (best.gain > gamma_eps)
+    budget = state.splits_left[0]
+    prio = best.gain if lossguide else -idx.astype(jnp.float32)
+    prio = jnp.where(can_split, prio, -jnp.inf)
+    ranks = jnp.argsort(jnp.argsort(-prio)).astype(jnp.int32)
+    can_split = can_split & (ranks < budget)
+    new_budget = budget - jnp.sum(can_split).astype(jnp.int32)
+    new_leaf = alive_lvl & ~can_split
+    thr_lvl = cuts_pad[best.feature, jnp.minimum(best.bin, B - 1)]
+    member = set_matrix.T[jnp.clip(best.feature, 0, set_matrix.shape[1] - 1)]
+    st = _record_level(state, best, idx, can_split, new_leaf, w, thr_lvl,
+                       totals_lvl, compat_lvl, member, new_budget, lower_lvl,
+                       upper_lvl, params)
+    return st, best, can_split
+
+
+class StreamingHistTreeGrower:
+    """Grow one tree over host-resident binned pages (ExtMemQuantileDMatrix)."""
+
+    def __init__(self, max_depth: int, params: SplitParams, *,
+                 interaction_sets=None, max_leaves: int = 0,
+                 lossguide: bool = False) -> None:
+        self.max_depth = max_depth
+        self.params = params
+        self.interaction_sets = interaction_sets
+        self.max_leaves = max_leaves
+        self.lossguide = lossguide
+        self.max_nodes = max_nodes_for_depth(max_depth)
+
+    def grow(self, pages: List, page_offsets: List[int], gpair, valid,
+             cuts_pad, n_bins, feature_masks=None, cat_mask=None) -> TreeState:
+        F = pages[0].shape[1]
+        B = cuts_pad.shape[1]
+        has_cat = cat_mask is not None
+        cm = jnp.asarray(cat_mask) if has_cat else jnp.zeros(0, bool)
+        setmat = jnp.asarray(make_set_matrix(self.interaction_sets, F))
+        ones = jnp.ones((1, F), dtype=bool)
+        state = init_tree_state(
+            gpair, valid, max_nodes=self.max_nodes,
+            n_sets=setmat.shape[0],
+            max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
+            n_bin=B,
+        )
+        prev_best, prev_can, prev_d = None, None, -1
+        n_pages = len(pages)
+        for d in range(self.max_depth + 1):
+            build = d < self.max_depth  # last level only finalizes leaves
+            node0 = (1 << d) - 1
+            N = 1 << d
+            hist_acc = None
+            # prefetch pipeline: page i+1 ships while page i computes
+            next_dev = jax.device_put(np.ascontiguousarray(pages[0])) if n_pages else None
+            pos = state.pos
+            for i in range(n_pages):
+                dev = next_dev
+                if i + 1 < n_pages:
+                    next_dev = jax.device_put(np.ascontiguousarray(pages[i + 1]))
+                lo, hi = page_offsets[i], page_offsets[i + 1]
+                seg_len = hi - lo
+                pos_seg = lax.dynamic_slice_in_dim(pos, lo, seg_len)
+                gp_seg = lax.dynamic_slice_in_dim(gpair, lo, seg_len)
+                pos_seg, h = _page_step(
+                    dev, gp_seg, pos_seg, prev_best, prev_can,
+                    node0_prev=(1 << prev_d) - 1 if prev_d >= 0 else 0,
+                    n_prev=1 << max(prev_d, 0), node0=node0, n_nodes=N,
+                    n_bin=B, has_prev=prev_best is not None, has_cat=has_cat,
+                    build=build,
+                )
+                pos = lax.dynamic_update_slice_in_dim(pos, pos_seg, lo, axis=0)
+                if build:
+                    hist_acc = h if hist_acc is None else hist_acc + h
+            state = state._replace(pos=pos)
+            fm = ones if feature_masks is None else feature_masks(d, N)
+            if hist_acc is None:  # last level: dummy hist, leaves only
+                hist_acc = jnp.zeros((N, F, B, 2), jnp.float32)
+            state, best, can = _decide_level(
+                state, hist_acc, n_bins, cuts_pad, fm, setmat, cm,
+                depth=d, params=self.params, lossguide=self.lossguide,
+                last_level=(d == self.max_depth),
+            )
+            prev_best, prev_can, prev_d = best, can, d
+        return state
+
+    @staticmethod
+    def to_host(state: TreeState):
+        from .grow import HistTreeGrower
+
+        return HistTreeGrower.to_host(state)
+
