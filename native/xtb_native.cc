@@ -1,0 +1,251 @@
+// Native host runtime for xgboost_tpu.
+//
+// The reference keeps its data plumbing in C++ (text parsers in
+// dmlc-core/src/data, CSR adapters src/data/adapter.h, GK quantile summaries
+// src/common/quantile.h) while the device code does the math.  Same split
+// here: JAX/XLA owns the TPU compute path; this library owns the host-side
+// hot loops — libsvm/CSV parsing into CSR and a streaming weighted quantile
+// summary (merge-prune, GK-style) used by the external-memory sketcher.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 dependency).
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// libsvm parser: "label [qid:q] idx:val idx:val ..." lines -> CSR
+// ---------------------------------------------------------------------------
+struct CSROut {
+  std::vector<int64_t> indptr;
+  std::vector<int32_t> indices;
+  std::vector<float> values;
+  std::vector<float> labels;
+  std::vector<int64_t> qids;
+  int32_t n_features = 0;
+  bool has_qid = false;
+};
+
+static inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+void* xtb_parse_libsvm(const char* data, int64_t len) {
+  auto* out = new CSROut();
+  out->indptr.push_back(0);
+  const char* p = data;
+  const char* end = data + len;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!line_end) line_end = end;
+    p = skip_ws(p, line_end);
+    if (p < line_end && *p != '#') {
+      char* next = nullptr;
+      float label = strtof(p, &next);
+      if (next == p) { p = line_end + 1; continue; }
+      out->labels.push_back(label);
+      p = next;
+      while (p < line_end) {
+        p = skip_ws(p, line_end);
+        if (p >= line_end || *p == '#') break;
+        // qid:N or idx:val
+        if (line_end - p > 4 && strncmp(p, "qid:", 4) == 0) {
+          out->has_qid = true;
+          out->qids.push_back(strtoll(p + 4, &next, 10));
+          p = next;
+          continue;
+        }
+        long idx = strtol(p, &next, 10);
+        if (next == p || next >= line_end || *next != ':') break;
+        p = next + 1;
+        float v = strtof(p, &next);
+        if (next == p) break;
+        p = next;
+        out->indices.push_back(static_cast<int32_t>(idx));
+        out->values.push_back(v);
+        if (idx + 1 > out->n_features) out->n_features = idx + 1;
+      }
+      out->indptr.push_back(static_cast<int64_t>(out->indices.size()));
+    }
+    p = line_end + 1;
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// CSV parser: numeric CSV (optional NaN blanks) -> dense row-major f32
+// ---------------------------------------------------------------------------
+struct DenseOut {
+  std::vector<float> data;
+  int64_t rows = 0;
+  int32_t cols = 0;
+};
+
+void* xtb_parse_csv(const char* data, int64_t len, int skip_header) {
+  auto* out = new DenseOut();
+  const char* p = data;
+  const char* end = data + len;
+  if (skip_header && p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    p = nl ? nl + 1 : end;
+  }
+  std::vector<float> row;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!line_end) line_end = end;
+    const char* content = skip_ws(p, line_end);
+    if (content < line_end) {  // blank/whitespace-only lines never set cols
+      row.clear();
+      const char* q = p;
+      while (q <= line_end) {
+        const char* field_end = q;
+        while (field_end < line_end && *field_end != ',') ++field_end;
+        const char* f = skip_ws(q, field_end);
+        if (f == field_end) {
+          row.push_back(NAN);
+        } else {
+          char* next = nullptr;
+          float v = strtof(f, &next);
+          row.push_back(next == f ? NAN : v);
+        }
+        if (field_end >= line_end) break;
+        q = field_end + 1;
+      }
+      if (out->cols == 0) out->cols = static_cast<int32_t>(row.size());
+      // ragged rows are padded with NaN / truncated, never silently dropped
+      row.resize(out->cols, NAN);
+      out->data.insert(out->data.end(), row.begin(), row.end());
+      out->rows += 1;
+    }
+    p = line_end + 1;
+  }
+  return out;
+}
+
+// ---- accessors / lifetime ----
+int64_t xtb_csr_rows(void* h) { return static_cast<CSROut*>(h)->indptr.size() - 1; }
+int64_t xtb_csr_nnz(void* h) { return static_cast<CSROut*>(h)->indices.size(); }
+int32_t xtb_csr_cols(void* h) { return static_cast<CSROut*>(h)->n_features; }
+int32_t xtb_csr_has_qid(void* h) { return static_cast<CSROut*>(h)->has_qid ? 1 : 0; }
+int64_t xtb_csr_qid_count(void* h) { return static_cast<CSROut*>(h)->qids.size(); }
+void xtb_csr_copy(void* h, int64_t* indptr, int32_t* indices, float* values,
+                  float* labels, int64_t* qids) {
+  auto* o = static_cast<CSROut*>(h);
+  memcpy(indptr, o->indptr.data(), o->indptr.size() * sizeof(int64_t));
+  memcpy(indices, o->indices.data(), o->indices.size() * sizeof(int32_t));
+  memcpy(values, o->values.data(), o->values.size() * sizeof(float));
+  memcpy(labels, o->labels.data(), o->labels.size() * sizeof(float));
+  if (o->has_qid && qids) memcpy(qids, o->qids.data(), o->qids.size() * sizeof(int64_t));
+}
+void xtb_csr_free(void* h) { delete static_cast<CSROut*>(h); }
+
+int64_t xtb_dense_rows(void* h) { return static_cast<DenseOut*>(h)->rows; }
+int32_t xtb_dense_cols(void* h) { return static_cast<DenseOut*>(h)->cols; }
+void xtb_dense_copy(void* h, float* dst) {
+  auto* o = static_cast<DenseOut*>(h);
+  memcpy(dst, o->data.data(), o->data.size() * sizeof(float));
+}
+void xtb_dense_free(void* h) { delete static_cast<DenseOut*>(h); }
+
+// ---------------------------------------------------------------------------
+// Streaming weighted quantile summary (GK-style merge-prune).
+// One summary per feature; Push batches, Prune to a budget, query quantiles.
+// Mirrors the role of WQuantileSketch (src/common/quantile.h:565) without
+// copying its structure: entries keep (value, weight); prune resamples the
+// weighted CDF at uniform ranks.
+// ---------------------------------------------------------------------------
+struct QuantileSummary {
+  std::vector<std::pair<float, double>> entries;  // (value, weight), sorted
+  size_t budget;
+  double total = 0.0;
+
+  explicit QuantileSummary(size_t b) : budget(b) {}
+
+  void push(const float* vals, const float* wts, int64_t n) {
+    std::vector<std::pair<float, double>> batch;
+    batch.reserve(n);
+    for (int64_t i = 0; i < n; ++i) {
+      float v = vals[i];
+      if (std::isnan(v)) continue;
+      double w = wts ? static_cast<double>(wts[i]) : 1.0;
+      if (w <= 0) continue;
+      batch.emplace_back(v, w);
+      total += w;
+    }
+    std::sort(batch.begin(), batch.end());
+    // merge two sorted runs
+    std::vector<std::pair<float, double>> merged;
+    merged.reserve(entries.size() + batch.size());
+    std::merge(entries.begin(), entries.end(), batch.begin(), batch.end(),
+               std::back_inserter(merged));
+    entries.swap(merged);
+    if (entries.size() > budget * 2) prune();
+  }
+
+  void prune() {
+    if (entries.size() <= budget) return;
+    // collapse duplicates, then resample the weighted CDF at uniform ranks
+    std::vector<std::pair<float, double>> uniq;
+    uniq.reserve(entries.size());
+    for (auto& e : entries) {
+      if (!uniq.empty() && uniq.back().first == e.first) {
+        uniq.back().second += e.second;
+      } else {
+        uniq.push_back(e);
+      }
+    }
+    if (uniq.size() <= budget) { entries.swap(uniq); return; }
+    std::vector<double> cdf(uniq.size());
+    double acc = 0;
+    for (size_t i = 0; i < uniq.size(); ++i) { acc += uniq[i].second; cdf[i] = acc; }
+    std::vector<std::pair<float, double>> pruned;
+    pruned.reserve(budget);
+    double prev_rank = 0.0;
+    size_t j = 0;
+    for (size_t k = 1; k <= budget; ++k) {
+      double target = acc * static_cast<double>(k) / budget;
+      while (j + 1 < uniq.size() && cdf[j] < target) ++j;
+      double w = cdf[j] - prev_rank;
+      if (w > 0 || pruned.empty() || pruned.back().first != uniq[j].first) {
+        pruned.emplace_back(uniq[j].first, w > 0 ? w : 0.0);
+      }
+      prev_rank = cdf[j];
+      if (j + 1 < uniq.size()) ++j;
+      else break;
+    }
+    entries.swap(pruned);
+  }
+
+  void query(const double* qs, int n_q, float* out) {
+    // no forced prune: an unpruned summary answers exactly (matches the
+    // in-core inverted-CDF quantiles when the data fit in the budget)
+    double acc = 0;
+    std::vector<double> cdf(entries.size());
+    for (size_t i = 0; i < entries.size(); ++i) { acc += entries[i].second; cdf[i] = acc; }
+    for (int k = 0; k < n_q; ++k) {
+      double target = qs[k] * acc;
+      size_t lo = std::lower_bound(cdf.begin(), cdf.end(), target) - cdf.begin();
+      if (lo >= entries.size()) lo = entries.empty() ? 0 : entries.size() - 1;
+      out[k] = entries.empty() ? 0.0f : entries[lo].first;
+    }
+  }
+};
+
+void* xtb_summary_new(int64_t budget) { return new QuantileSummary(budget); }
+void xtb_summary_push(void* h, const float* vals, const float* wts, int64_t n) {
+  static_cast<QuantileSummary*>(h)->push(vals, wts, n);
+}
+void xtb_summary_query(void* h, const double* qs, int32_t n_q, float* out) {
+  static_cast<QuantileSummary*>(h)->query(qs, n_q, out);
+}
+double xtb_summary_total(void* h) { return static_cast<QuantileSummary*>(h)->total; }
+void xtb_summary_free(void* h) { delete static_cast<QuantileSummary*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,103 @@
+"""Model IO: schema shape, UBJSON, pickling, file ingestion
+(reference: tests/python/test_model_compatibility.py, test_pickling.py)."""
+import json
+import pickle
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.testing.data import make_binary
+from xgboost_tpu.utils.ubjson import dump_ubjson, load_ubjson
+
+
+def test_json_schema_fields(tmp_path):
+    X, y = make_binary(300, 5, seed=0)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3}, d, 3,
+                    verbose_eval=False)
+    f = str(tmp_path / "m.json")
+    bst.save_model(f)
+    with open(f) as fh:
+        obj = json.load(fh)
+    # xgboost model schema essentials (doc/model.schema)
+    learner = obj["learner"]
+    assert learner["objective"]["name"] == "binary:logistic"
+    assert "base_score" in learner["learner_model_param"]
+    model = learner["gradient_booster"]["model"]
+    assert len(model["trees"]) == 3
+    t0 = model["trees"][0]
+    for key in ("left_children", "right_children", "parents", "split_indices",
+                "split_conditions", "default_left", "base_weights",
+                "loss_changes", "sum_hessian", "categories", "split_type"):
+        assert key in t0, key
+    assert int(t0["tree_param"]["num_nodes"]) == len(t0["left_children"])
+
+
+def test_ubjson_roundtrip_types():
+    obj = {"a": [1, 2, 3], "b": 1.5, "c": "hi", "d": True, "e": None,
+           "f": {"g": [0.25, -1.0]}, "big": list(range(300))}
+    from io import BytesIO
+
+    buf = BytesIO()
+    dump_ubjson(obj, buf)
+    buf.seek(0)
+    back = load_ubjson(buf)
+    assert back["a"] == [1, 2, 3]
+    assert back["b"] == 1.5
+    assert back["c"] == "hi"
+    assert back["d"] is True
+    assert back["e"] is None
+    assert back["big"][299] == 299
+
+
+def test_pickle_roundtrip():
+    X, y = make_binary(300, 5, seed=1)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3}, d, 4,
+                    verbose_eval=False)
+    blob = pickle.dumps(bst)
+    b2 = pickle.loads(blob)
+    np.testing.assert_allclose(b2.predict(xtb.DMatrix(X)), bst.predict(xtb.DMatrix(X)),
+                               rtol=1e-6)
+
+
+def test_sklearn_pickle():
+    X, y = make_binary(200, 4, seed=2)
+    clf = xtb.XGBClassifier(n_estimators=3, max_depth=2)
+    clf.fit(X, y.astype(int))
+    c2 = pickle.loads(pickle.dumps(clf))
+    np.testing.assert_array_equal(c2.predict(X), clf.predict(X))
+
+
+def test_libsvm_and_csv_ingestion(tmp_path):
+    # libsvm with qid
+    f = tmp_path / "d.libsvm"
+    f.write_text("1 qid:0 0:1.5 2:2.0\n0 qid:0 1:0.5\n2 qid:1 0:-1 2:3\n")
+    d = xtb.DMatrix(str(f))
+    assert d.num_row() == 3 and d.num_col() == 3
+    np.testing.assert_array_equal(d.get_label(), [1, 0, 2])
+    assert d.info.group_ptr is not None  # qid became groups
+    # csv
+    c = tmp_path / "d.csv"
+    c.write_text("1.0,2.0,3.0\n4.0,,6.0\n")
+    dc = xtb.DMatrix(str(c))
+    assert dc.num_row() == 2 and dc.num_col() == 3
+    assert np.isnan(dc.host_dense()[1, 1])
+
+
+def test_agaricus_from_reference_data():
+    """BASELINE config #1: the reference's own demo file trains to ~0 error."""
+    d = xtb.DMatrix("/root/reference/demo/data/agaricus.txt.train")
+    dt = xtb.DMatrix("/root/reference/demo/data/agaricus.txt.test")
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3, "eta": 1.0},
+                    d, 5, verbose_eval=False)
+    p = bst.predict(dt)
+    err = float(((p > 0.5) != dt.get_label()).mean())
+    assert err < 0.01, err
+
+
+def test_config_context():
+    with xtb.config_context(verbosity=0):
+        assert xtb.get_config()["verbosity"] == 0
+    assert xtb.get_config()["verbosity"] == 1

@@ -19,6 +19,7 @@ from .data.extmem import DataIter, ExtMemQuantileDMatrix
 from .data.ellpack import EllpackPage
 from .data.quantile import HistogramCuts
 from .training import cv, train
+from . import collective, tracker
 from .callback import (
     EarlyStopping,
     EvaluationMonitor,
@@ -46,6 +47,11 @@ __all__ = [
     "EvaluationMonitor",
     "LearningRateScheduler",
     "TrainingCheckPoint",
+    "collective",
+    "tracker",
+    "plot_importance",
+    "plot_tree",
+    "to_graphviz",
     "XGBModel",
     "XGBClassifier",
     "XGBRegressor",
@@ -55,10 +61,14 @@ __all__ = [
 ]
 
 
-def __getattr__(name):  # lazy sklearn wrappers (heavy import)
+def __getattr__(name):  # lazy heavy imports
     if name in ("XGBModel", "XGBClassifier", "XGBRegressor", "XGBRanker",
                 "XGBRFClassifier", "XGBRFRegressor"):
         from . import sklearn as _sk
 
         return getattr(_sk, name)
+    if name in ("plot_importance", "plot_tree", "to_graphviz"):
+        from . import plotting as _pl
+
+        return getattr(_pl, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

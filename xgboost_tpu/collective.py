@@ -1,0 +1,174 @@
+"""Collective communication API (reference: python-package/xgboost/collective.py,
+src/collective/ — the rabit-descended flat API).
+
+On TPU the mesh IS the communicator: jax.distributed supplies rendezvous
+(replacing the RabitTracker socket bootstrap, tracker.h:141) and XLA
+collectives carry the data, so ``init``/``CommunicatorContext`` configure
+jax.distributed while ``allreduce``/``broadcast`` run tiny jitted psum/select
+programs over the live devices.  Single-process (no distributed init) is the
+identity backend — mirroring how the reference degrades to world_size == 1.
+"""
+from __future__ import annotations
+
+import contextlib
+from enum import IntEnum
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "init", "finalize", "get_rank", "get_world_size", "is_distributed",
+    "communicator_print", "get_processor_name", "broadcast", "allreduce",
+    "signal_error", "Op", "CommunicatorContext",
+]
+
+_INITIALIZED = False
+
+
+class Op(IntEnum):
+    """Reduce ops (reference: Op enum, src/collective/comm.h:186)."""
+
+    MAX = 0
+    MIN = 1
+    SUM = 2
+    BITWISE_AND = 3
+    BITWISE_OR = 4
+    BITWISE_XOR = 5
+
+
+def init(**args: Any) -> None:
+    """Initialize the collective (reference: collective.py:94 init).
+
+    Accepts the reference's args and maps the distributed ones onto
+    jax.distributed.initialize; a no-op when single-process.
+    """
+    global _INITIALIZED
+    coordinator = args.get("dmlc_tracker_uri") or args.get("coordinator_address")
+    n_proc = args.get("dmlc_nworker")
+    if n_proc is None:
+        n_proc = args.get("num_processes")
+    rank = args.get("dmlc_task_id")  # 0 is a valid rank: no `or` chains
+    if rank is None:
+        rank = args.get("process_id")
+    if coordinator is not None:
+        import jax
+
+        port = args.get("dmlc_tracker_port")
+        addr = f"{coordinator}:{port}" if port else str(coordinator)
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(n_proc) if n_proc is not None else None,
+            process_id=int(rank) if rank is not None else None,
+        )
+    _INITIALIZED = True
+
+
+def finalize() -> None:
+    global _INITIALIZED
+    if _INITIALIZED:
+        try:
+            import jax
+
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        _INITIALIZED = False
+
+
+def get_rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_distributed() -> bool:
+    return get_world_size() > 1
+
+
+def get_processor_name() -> str:
+    import socket
+
+    return socket.gethostname()
+
+
+def communicator_print(msg: str) -> None:
+    print(f"[{get_rank()}] {msg}", flush=True)
+
+
+def allreduce(data: np.ndarray, op: Op = Op.SUM) -> np.ndarray:
+    """Allreduce across processes (reference: collective.py allreduce).
+
+    Uses psum/pmin/pmax over all devices via a one-shot pmapped program; the
+    single-process case is an exact identity.
+    """
+    data = np.asarray(data)
+    if not is_distributed():
+        return data.copy()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()), ("d",))
+    fn = {Op.SUM: jax.lax.psum, Op.MAX: jax.lax.pmax, Op.MIN: jax.lax.pmin}.get(op)
+    if fn is None:
+        raise NotImplementedError(f"allreduce op {op!r} not supported on TPU")
+
+    sharded = jax.jit(
+        jax.shard_map(lambda x: fn(x, "d"), mesh=mesh,
+                      in_specs=P(), out_specs=P()),
+    )
+    # each process contributes its copy once: scale by devices per process
+    local_devices = jax.local_device_count()
+    contrib = data / local_devices if op == Op.SUM else data
+    return np.asarray(sharded(jnp.asarray(contrib)))
+
+
+def broadcast(data: Any, root: int) -> Any:
+    """Broadcast python object from root (reference: collective.py broadcast)."""
+    if not is_distributed():
+        return data
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    is_root = get_rank() == root
+    payload = np.frombuffer(pickle.dumps(data), dtype=np.uint8) if is_root else None
+    # two-step: fixed-shape length broadcast, then the padded payload
+    n = multihost_utils.broadcast_one_to_all(
+        np.asarray([len(payload) if is_root else 0], np.int64), is_source=is_root
+    )
+    size = int(n[0])
+    buf = np.zeros(size, np.uint8)
+    if is_root:
+        buf[:] = payload
+    out = multihost_utils.broadcast_one_to_all(buf, is_source=is_root)
+    return pickle.loads(bytes(np.asarray(out)))
+
+
+def signal_error(msg: str = "") -> None:
+    """Fail-fast error signal (reference: collective.py:319 signal_error —
+    the tracker broadcasts the failure and every worker exits)."""
+    import sys
+
+    communicator_print(f"collective error: {msg}")
+    sys.exit(1)
+
+
+class CommunicatorContext:
+    """with-block wrapper (reference: collective.py:358)."""
+
+    def __init__(self, **args: Any) -> None:
+        self.args = args
+
+    def __enter__(self) -> Dict[str, Any]:
+        init(**self.args)
+        return self.args
+
+    def __exit__(self, *exc: Any) -> None:
+        finalize()

@@ -11,6 +11,7 @@ train and validation mirrors GetCutsFromRef (src/data/quantile_dmatrix.cc:19).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 
 import numpy as np
@@ -44,6 +45,28 @@ class MetaInfo:
                 )
         if self.group_ptr is not None and self.group_ptr[-1] != self.num_row:
             raise ValueError("group sizes must sum to num_row")
+
+
+def _load_uri(uri: str):
+    """DMatrix::Load (data.h:610): 'path', 'path?format=libsvm|csv'.
+
+    Parsing runs in the native C++ library (native/xtb_native.cc) with a
+    Python fallback — the analogue of dmlc-core's text parsers."""
+    from ..utils.native import parse_csv, parse_libsvm
+
+    path, _, query = uri.partition("?")
+    fmt = None
+    for part in query.split("&"):
+        if part.startswith("format="):
+            fmt = part.split("=", 1)[1]
+    if fmt is None:
+        fmt = "csv" if path.endswith(".csv") else "libsvm"
+    if fmt == "csv":
+        arr = parse_csv(path)
+        return ("dense", arr), None, None, None, None
+    indptr, indices, values, labels, qids, n_col = parse_libsvm(path)
+    return (("csr", (indptr, indices, values, (len(indptr) - 1, n_col))),
+            None, None, labels, qids)
 
 
 def _to_numpy_2d(data: Any, missing: float = np.nan):
@@ -110,7 +133,12 @@ class DMatrix:
         enable_categorical: bool = False,
         silent: bool = False,
     ) -> None:
-        (kind, payload), auto_names, auto_types = _to_numpy_2d(data, missing)
+        auto_label = auto_qid = None
+        if isinstance(data, (str, os.PathLike)):
+            (kind, payload), auto_names, auto_types, auto_label, auto_qid = _load_uri(
+                os.fspath(data))
+        else:
+            (kind, payload), auto_names, auto_types = _to_numpy_2d(data, missing)
         self._kind = kind
         if kind == "dense":
             self._dense: Optional[np.ndarray] = payload
@@ -121,6 +149,10 @@ class DMatrix:
             self._csr = payload
             num_row, num_col = payload[3]
         self.info = MetaInfo(num_row=num_row, num_col=num_col)
+        if label is None and auto_label is not None:
+            self.set_label(auto_label)  # labels embedded in the data file
+        if qid is None and auto_qid is not None:
+            self.set_qid(auto_qid)
         if label is not None:
             self.set_label(label)
         if weight is not None:

@@ -16,13 +16,13 @@ sparse_page_source.h:293).
 from __future__ import annotations
 
 import tempfile
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional
 
 import numpy as np
 
 from .dmatrix import DMatrix, MetaInfo
-from .ellpack import _bin_dtype, build_ellpack
-from .quantile import HistogramCuts, cuts_from_quantile_grid, sketch_dense
+from .ellpack import build_ellpack
+from .quantile import HistogramCuts, cuts_from_quantile_grid
 
 PAGE_ALIGN = 1024  # rows; keeps every page a whole number of hist row tiles
 
@@ -86,11 +86,16 @@ class ExtMemQuantileDMatrix(DMatrix):
         self._page_rows: List[int] = []  # real rows per page
         self._spill_dir = None if on_host else tempfile.mkdtemp(prefix="xtb_pages_")
 
-        # ---- pass 1: sketch (merge per-batch quantile grids) ----
-        grids, counts = [], []
+        # ---- pass 1: sketch (native streaming GK-style summaries per feature,
+        # the role of WQuantileSketch, src/common/quantile.h:565) ----
+        from ..utils.native import StreamingQuantileSummary
+
+        summaries = None
         labels, weights, margins, n_col = [], [], [], None
         cat_mask = None
+        cat_max = None
         num_row = 0
+        vmin = vmax = None
         for batch in _iterate(data):
             X = np.asarray(batch["data"], dtype=np.float32)
             num_row += X.shape[0]
@@ -99,6 +104,12 @@ class ExtMemQuantileDMatrix(DMatrix):
                 ft = batch.get("feature_types")
                 if ft is not None:
                     cat_mask = np.asarray([t == "c" for t in ft], bool)
+                cat_max = np.zeros(n_col, np.int64)
+                vmin = np.full(n_col, np.inf, np.float32)
+                vmax = np.full(n_col, -np.inf, np.float32)
+                if ref is None:
+                    summaries = [StreamingQuantileSummary(max(8 * max_bin, 512))
+                                 for _ in range(n_col)]
             if "label" in batch and batch["label"] is not None:
                 labels.append(np.asarray(batch["label"], np.float32))
             if batch.get("weight") is not None:
@@ -106,9 +117,23 @@ class ExtMemQuantileDMatrix(DMatrix):
             if batch.get("base_margin") is not None:
                 margins.append(np.asarray(batch["base_margin"], np.float32))
             if ref is None:
-                c = sketch_dense(X, max_bin, cat_mask=cat_mask)
-                grids.append(c)
-                counts.append(X.shape[0])
+                w_b = (np.asarray(batch["weight"], np.float32)
+                       if batch.get("weight") is not None else None)
+                import warnings
+
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    # fmin/fmax ignore NaN from all-NaN batch columns
+                    vmin = np.fmin(vmin, np.nanmin(X, axis=0))
+                    vmax = np.fmax(vmax, np.nanmax(X, axis=0))
+                for f in range(n_col):
+                    if cat_mask is not None and cat_mask[f]:
+                        col = X[:, f]
+                        col = col[~np.isnan(col)]
+                        if len(col):
+                            cat_max[f] = max(cat_max[f], int(col.max()))
+                    else:
+                        summaries[f].push(X[:, f], w_b)
 
         if ref is not None:
             # GetCutsFromRef: reuse training cuts (quantile_dmatrix.cc:19);
@@ -117,7 +142,26 @@ class ExtMemQuantileDMatrix(DMatrix):
             if cuts is None:
                 cuts = ref.ensure_ellpack(max_bin=max_bin).cuts
         else:
-            cuts = _merge_batch_cuts(grids, counts, max_bin, cat_mask)
+            Q = max(max_bin - 1, 1)
+            qs = np.arange(1, Q + 1, dtype=np.float64) / (Q + 1)
+            grid = np.full((n_col, Q), np.inf, np.float32)
+            nvalid = np.zeros(n_col, np.int64)
+            for f in range(n_col):
+                if cat_mask is not None and cat_mask[f]:
+                    n_cats = int(cat_max[f]) + 1
+                    if n_cats > max_bin:
+                        raise ValueError(
+                            f"categorical feature {f} has {n_cats} categories; "
+                            f"raise max_bin (currently {max_bin})")
+                    grid[f, : n_cats - 1] = np.arange(1, n_cats, dtype=np.float32)
+                    nvalid[f] = num_row
+                    vmin[f], vmax[f] = 0.0, float(n_cats - 1)
+                elif summaries[f].total_weight() > 0:
+                    grid[f] = summaries[f].query(qs)
+                    nvalid[f] = num_row
+            vmin = np.where(np.isfinite(vmin), vmin, 0.0)
+            vmax = np.where(np.isfinite(vmax), vmax, 0.0)
+            cuts = cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
         self._cuts = cuts
 
         # metadata container
@@ -225,51 +269,3 @@ class ExtMemQuantileDMatrix(DMatrix):
         raise NotImplementedError("external-memory pages are pre-binned")
 
 
-def _merge_batch_cuts(batch_cuts: Sequence[HistogramCuts], counts: Sequence[int],
-                      max_bin: int, cat_mask=None) -> HistogramCuts:
-    """Merge per-batch cut grids into global cuts: each batch's cut points are
-    weighted by its row count and the merged weighted quantiles re-extracted —
-    the fixed-size analogue of the reference's summary merge
-    (src/common/quantile.cc:397 AllreduceV of GK summaries)."""
-    if len(batch_cuts) == 1:
-        return batch_cuts[0]
-    F = batch_cuts[0].n_features
-    Q = max(max_bin - 1, 1)
-    grid = np.full((F, Q), np.inf, dtype=np.float32)
-    nvalid = np.zeros(F, np.int64)
-    vmax = np.full(F, -np.inf, np.float32)
-    vmin = np.full(F, np.inf, np.float32)
-    qs = np.arange(1, Q + 1, dtype=np.float64) / (Q + 1)
-    for f in range(F):
-        if cat_mask is not None and cat_mask[f]:
-            n_cats = max(c.n_bins(f) for c in batch_cuts)
-            grid[f, : n_cats - 1] = np.arange(1, n_cats, dtype=np.float32)
-            nvalid[f] = sum(counts)
-            vmax[f], vmin[f] = float(n_cats - 1), 0.0
-            continue
-        pts, wts = [], []
-        for c, cnt in zip(batch_cuts, counts):
-            seg = c.feature_cuts(f)[:-1]  # drop the open upper bound
-            if len(seg) == 0:
-                continue
-            pts.append(seg)
-            wts.append(np.full(len(seg), cnt / max(len(seg), 1), np.float64))
-            vmax[f] = max(vmax[f], seg[-1] if len(seg) else -np.inf)
-            vmin[f] = min(vmin[f], c.min_vals[f])
-        for c in batch_cuts:  # true max lives in the open upper bound
-            fc = c.feature_cuts(f)
-            if len(fc):
-                vmax[f] = max(vmax[f], fc[-1] / 1.01)
-        if not pts:
-            continue
-        allp = np.concatenate(pts)
-        allw = np.concatenate(wts)
-        order = np.argsort(allp, kind="stable")
-        sp, sw = allp[order], allw[order]
-        cdf = np.cumsum(sw)
-        idx = np.searchsorted(cdf, qs * cdf[-1], side="left")
-        grid[f] = sp[np.clip(idx, 0, len(sp) - 1)].astype(np.float32)
-        nvalid[f] = sum(counts)
-    vmax = np.where(np.isfinite(vmax), vmax, 0.0)
-    vmin = np.where(np.isfinite(vmin), vmin, 0.0)
-    return cuts_from_quantile_grid(grid, nvalid, vmax, vmin)

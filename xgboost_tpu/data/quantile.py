@@ -184,8 +184,11 @@ def sketch_dense(
         nvalid = jnp.sum(~jnp.isnan(Xd), axis=0)  # (F,)
         # quantile candidate ranks: ceil(i/ncand * nvalid) - style positions
         qs = (jnp.arange(1, n_cand + 1, dtype=jnp.float32) / (n_cand + 1))
-        pos = jnp.clip((qs[None, :] * nvalid[:, None].astype(jnp.float32)).astype(jnp.int32),
-                       0, jnp.maximum(nvalid[:, None] - 1, 0))
+        # inverted-CDF ranks: ceil(q*n) - 1 (matches np.quantile inverted_cdf
+        # and the native streaming summary, so every sketch path agrees)
+        pos = jnp.clip(
+            jnp.ceil(qs[None, :] * nvalid[:, None].astype(jnp.float32)).astype(jnp.int32) - 1,
+            0, jnp.maximum(nvalid[:, None] - 1, 0))
         grid = jnp.take_along_axis(sortd.T, pos, axis=1)  # (F, n_cand)
         vmax = jnp.take_along_axis(sortd.T, jnp.maximum(nvalid[:, None] - 1, 0), axis=1)[:, 0]
         vmin = sortd[0]

@@ -1,0 +1,62 @@
+"""Tracker shim (reference: python-package/xgboost/tracker.py RabitTracker,
+src/collective/tracker.cc).
+
+The reference tracker is a socket rendezvous server assigning (rank, world,
+ring neighbors).  Under JAX that role belongs to the jax.distributed
+coordinator, so this class only carries the coordinator address/port in the
+reference's env-var vocabulary — existing dask-style launch scripts keep
+working, with the coordinator service doing the actual bootstrap.
+"""
+from __future__ import annotations
+
+import socket
+from typing import Dict, Union
+
+
+def get_host_ip(host_ip: str = "auto") -> str:
+    if host_ip and host_ip != "auto":
+        return host_ip
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+    except Exception:
+        ip = "127.0.0.1"
+    finally:
+        s.close()
+    return ip
+
+
+class RabitTracker:
+    """Coordinator-address holder with the reference's surface
+    (tracker.py:17): worker_args(), start(), wait_for()."""
+
+    def __init__(self, n_workers: int, host_ip: str = "auto", port: int = 0,
+                 sortby: str = "host", timeout: int = 0) -> None:
+        self.n_workers = n_workers
+        self.host_ip = get_host_ip(host_ip)
+        if port == 0:
+            with socket.socket() as s:
+                s.bind((self.host_ip, 0))
+                port = s.getsockname()[1]
+        self.port = port
+        self._started = False
+
+    def start(self) -> None:
+        # jax.distributed's coordinator is started lazily by process 0 inside
+        # jax.distributed.initialize; nothing to spawn here
+        self._started = True
+
+    def worker_args(self) -> Dict[str, Union[str, int]]:
+        """Env passed to workers (consumed by collective.init)."""
+        return {
+            "dmlc_tracker_uri": self.host_ip,
+            "dmlc_tracker_port": self.port,
+            "dmlc_nworker": self.n_workers,
+        }
+
+    def wait_for(self, timeout: int = 0) -> None:
+        self._started = False
+
+    def free(self) -> None:
+        self._started = False

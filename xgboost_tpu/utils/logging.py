@@ -1,0 +1,43 @@
+"""Logging (reference: include/xgboost/logging.h:39-63 ConsoleLogger with
+verbosity 0-3, XGBRegisterLogCallback redirection)."""
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+from ..config import get_config
+
+_CALLBACK: Optional[Callable[[str], None]] = None
+
+SILENT, WARNING, INFO, DEBUG = 0, 1, 2, 3
+
+
+def register_log_callback(fn: Optional[Callable[[str], None]]) -> None:
+    """Redirect log lines into the host application
+    (reference: XGBRegisterLogCallback)."""
+    global _CALLBACK
+    _CALLBACK = fn
+
+
+def _emit(msg: str) -> None:
+    if _CALLBACK is not None:
+        _CALLBACK(msg)
+    else:
+        print(msg, file=sys.stderr, flush=True)
+
+
+def log(level: int, msg: str) -> None:
+    if get_config().get("verbosity", 1) >= level:
+        _emit(msg)
+
+
+def warning(msg: str) -> None:
+    log(WARNING, f"WARNING: {msg}")
+
+
+def info(msg: str) -> None:
+    log(INFO, msg)
+
+
+def debug(msg: str) -> None:
+    log(DEBUG, msg)

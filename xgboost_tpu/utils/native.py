@@ -1,0 +1,237 @@
+"""ctypes bindings for the native host runtime (native/xtb_native.cc).
+
+The reference's host-side hot loops are C++ (dmlc-core text parsers, CSR
+adapters src/data/adapter.h:538 FileAdapter, GK summaries
+src/common/quantile.h); ours live in one small C-ABI library loaded here.
+Pure-Python fallbacks keep everything working when the .so hasn't been built
+(``make -C native``) — the library auto-builds on first use when a toolchain
+is present.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _native_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native")
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    so = os.path.join(_native_dir(), "libxtb_native.so")
+    src = os.path.join(_native_dir(), "xtb_native.cc")
+    stale = (not os.path.exists(so)
+             or (os.path.exists(src)
+                 and os.path.getmtime(src) > os.path.getmtime(so)))
+    if stale:
+        try:
+            subprocess.run(["make", "-C", _native_dir()], capture_output=True,
+                           timeout=120, check=True)
+        except Exception:
+            if not os.path.exists(so):
+                return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    c = ctypes
+    lib.xtb_parse_libsvm.restype = c.c_void_p
+    lib.xtb_parse_libsvm.argtypes = [c.c_char_p, c.c_int64]
+    lib.xtb_parse_csv.restype = c.c_void_p
+    lib.xtb_parse_csv.argtypes = [c.c_char_p, c.c_int64, c.c_int]
+    lib.xtb_csr_rows.restype = c.c_int64
+    lib.xtb_csr_nnz.restype = c.c_int64
+    lib.xtb_csr_cols.restype = c.c_int32
+    lib.xtb_csr_has_qid.restype = c.c_int32
+    lib.xtb_csr_qid_count.restype = c.c_int64
+    for f in (lib.xtb_csr_rows, lib.xtb_csr_nnz, lib.xtb_csr_cols,
+              lib.xtb_csr_has_qid, lib.xtb_csr_qid_count, lib.xtb_csr_free,
+              lib.xtb_dense_free):
+        f.argtypes = [c.c_void_p]
+    lib.xtb_csr_copy.argtypes = [c.c_void_p] + [c.c_void_p] * 5
+    lib.xtb_dense_rows.restype = c.c_int64
+    lib.xtb_dense_rows.argtypes = [c.c_void_p]
+    lib.xtb_dense_cols.restype = c.c_int32
+    lib.xtb_dense_cols.argtypes = [c.c_void_p]
+    lib.xtb_dense_copy.argtypes = [c.c_void_p, c.c_void_p]
+    lib.xtb_summary_new.restype = c.c_void_p
+    lib.xtb_summary_new.argtypes = [c.c_int64]
+    lib.xtb_summary_push.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64]
+    lib.xtb_summary_query.argtypes = [c.c_void_p, c.c_void_p, c.c_int32, c.c_void_p]
+    lib.xtb_summary_total.restype = c.c_double
+    lib.xtb_summary_total.argtypes = [c.c_void_p]
+    lib.xtb_summary_free.argtypes = [c.c_void_p]
+    _LIB = lib
+    return lib
+
+
+def parse_libsvm(path: str):
+    """Parse a libsvm file -> (indptr, indices, values, labels, qid|None, n_col).
+
+    Native fast path; pure-Python fallback parses the same grammar.
+    """
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    lib = load_native()
+    if lib is not None:
+        h = lib.xtb_parse_libsvm(raw, len(raw))
+        try:
+            rows = lib.xtb_csr_rows(h)
+            nnz = lib.xtb_csr_nnz(h)
+            cols = lib.xtb_csr_cols(h)
+            has_qid = bool(lib.xtb_csr_has_qid(h))
+            if has_qid and lib.xtb_csr_qid_count(h) != rows:
+                raise ValueError(
+                    f"libsvm file has qid on only {lib.xtb_csr_qid_count(h)} "
+                    f"of {rows} rows; qid must cover every row")
+            indptr = np.empty(rows + 1, np.int64)
+            indices = np.empty(nnz, np.int32)
+            values = np.empty(nnz, np.float32)
+            labels = np.empty(rows, np.float32)
+            qids = np.empty(rows, np.int64) if has_qid else None
+            lib.xtb_csr_copy(
+                h, indptr.ctypes.data, indices.ctypes.data, values.ctypes.data,
+                labels.ctypes.data, qids.ctypes.data if has_qid else None)
+            return indptr, indices, values, labels, qids, cols
+        finally:
+            lib.xtb_csr_free(h)
+    # fallback
+    indptr, indices, values, labels, qids = [0], [], [], [], []
+    n_col = 0
+    has_qid = False
+    for line in raw.decode("utf-8", "ignore").splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        labels.append(float(parts[0]))
+        for tok in parts[1:]:
+            if tok.startswith("#"):
+                break
+            k, v = tok.split(":", 1)
+            if k == "qid":
+                has_qid = True
+                qids.append(int(v))
+                continue
+            idx = int(k)
+            indices.append(idx)
+            values.append(float(v))
+            n_col = max(n_col, idx + 1)
+        indptr.append(len(indices))
+    if has_qid and len(qids) != len(labels):
+        raise ValueError(
+            f"libsvm file has qid on only {len(qids)} of {len(labels)} rows; "
+            f"qid must cover every row")
+    return (np.asarray(indptr, np.int64), np.asarray(indices, np.int32),
+            np.asarray(values, np.float32), np.asarray(labels, np.float32),
+            np.asarray(qids, np.int64) if has_qid else None, n_col)
+
+
+def parse_csv(path: str, skip_header: Optional[bool] = None) -> np.ndarray:
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    if skip_header is None:
+        # sniff: a first line whose first field isn't numeric is a header
+        first = raw.split(b"\n", 1)[0].split(b",", 1)[0].strip()
+        try:
+            float(first)
+            skip_header = False
+        except ValueError:
+            skip_header = bool(first)
+    lib = load_native()
+    if lib is not None:
+        h = lib.xtb_parse_csv(raw, len(raw), int(skip_header))
+        try:
+            rows = lib.xtb_dense_rows(h)
+            cols = lib.xtb_dense_cols(h)
+            out = np.empty((rows, cols), np.float32)
+            lib.xtb_dense_copy(h, out.ctypes.data)
+            return out
+        finally:
+            lib.xtb_dense_free(h)
+    return np.genfromtxt(path, delimiter=",", dtype=np.float32,
+                         skip_header=int(skip_header))
+
+
+class StreamingQuantileSummary:
+    """Per-feature streaming weighted quantile summary (GK-style merge-prune).
+
+    Native-backed when available; numpy fallback keeps semantics identical.
+    Used by the external-memory sketcher to merge batches without holding data.
+    """
+
+    def __init__(self, budget: int = 2048):
+        self.budget = budget
+        self._lib = load_native()
+        if self._lib is not None:
+            self._h = self._lib.xtb_summary_new(budget)
+        else:
+            self._vals = np.zeros(0, np.float32)
+            self._wts = np.zeros(0, np.float64)
+
+    def push(self, values: np.ndarray, weights: Optional[np.ndarray] = None):
+        values = np.ascontiguousarray(values, np.float32)
+        if self._lib is not None:
+            w = None if weights is None else np.ascontiguousarray(weights, np.float32)
+            self._lib.xtb_summary_push(
+                self._h, values.ctypes.data,
+                w.ctypes.data if w is not None else None, len(values))
+            return
+        keep = ~np.isnan(values)
+        v = values[keep]
+        w = (np.ones(len(v)) if weights is None
+             else np.asarray(weights, np.float64)[keep])
+        self._vals = np.concatenate([self._vals, v])
+        self._wts = np.concatenate([self._wts, w])
+        if len(self._vals) > 2 * self.budget:
+            self._prune()
+
+    def _prune(self):
+        order = np.argsort(self._vals, kind="stable")
+        v, w = self._vals[order], self._wts[order]
+        cdf = np.cumsum(w)
+        targets = cdf[-1] * np.arange(1, self.budget + 1) / self.budget
+        idx = np.searchsorted(cdf, targets, side="left")
+        idx = np.clip(idx, 0, len(v) - 1)
+        uniq, first = np.unique(idx, return_index=True)
+        seg_w = np.diff(np.concatenate([[0.0], cdf[uniq]]))
+        self._vals = v[uniq].astype(np.float32)
+        self._wts = seg_w
+    def total_weight(self) -> float:
+        if self._lib is not None:
+            return float(self._lib.xtb_summary_total(self._h))
+        return float(self._wts.sum())
+
+    def query(self, qs: np.ndarray) -> np.ndarray:
+        qs = np.ascontiguousarray(qs, np.float64)
+        out = np.empty(len(qs), np.float32)
+        if self._lib is not None:
+            self._lib.xtb_summary_query(self._h, qs.ctypes.data, len(qs),
+                                        out.ctypes.data)
+            return out
+        if len(self._vals) == 0:
+            return np.zeros(len(qs), np.float32)
+        order = np.argsort(self._vals, kind="stable")
+        v, w = self._vals[order], self._wts[order]
+        cdf = np.cumsum(w)
+        idx = np.searchsorted(cdf, qs * cdf[-1], side="left")
+        return v[np.clip(idx, 0, len(v) - 1)].astype(np.float32)
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None and getattr(self, "_h", None):
+            try:
+                self._lib.xtb_summary_free(self._h)
+            except Exception:
+                pass

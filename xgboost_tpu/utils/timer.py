@@ -1,0 +1,53 @@
+"""Per-label cumulative timers (reference: src/common/timer.h:45 Monitor).
+
+The reference brackets every hot method with Monitor::Start/Stop and emits
+NVTX ranges under USE_NVTX; here Start/Stop also opens a jax.profiler
+TraceAnnotation so the same labels show up in TPU profiler traces.
+Printed at verbosity >= 3 like the reference (timer.cc).
+"""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+from ..config import get_config
+
+
+class Monitor:
+    def __init__(self, label: str = "") -> None:
+        self.label = label
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+        self._open: Dict[str, float] = {}
+        self._annotations: Dict[str, object] = {}
+
+    def start(self, name: str) -> None:
+        self._open[name] = time.perf_counter()
+        try:
+            import jax.profiler
+
+            ann = jax.profiler.TraceAnnotation(f"{self.label}.{name}")
+            ann.__enter__()
+            self._annotations[name] = ann
+        except Exception:
+            pass
+
+    def stop(self, name: str) -> None:
+        t0 = self._open.pop(name, None)
+        if t0 is not None:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+        ann = self._annotations.pop(name, None)
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
+
+    def print_statistics(self) -> None:
+        if get_config().get("verbosity", 1) < 3 or not self.totals:
+            return
+        print(f"======== Monitor ({self.label}) ========")
+        for name in sorted(self.totals):
+            print(f"{name}: {self.totals[name]*1e3:.3f}ms, {self.counts[name]} calls")
