@@ -62,3 +62,20 @@ def test_dryrun_multichip_runs(eight_devices):
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_booster_n_devices_matches_single(eight_devices):
+    """End-to-end train() over the 8-device mesh == single-device training."""
+    import xgboost_tpu as xtb
+    from xgboost_tpu.testing.data import make_binary
+
+    X, y = make_binary(1200, 6, seed=11)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.5}
+    b1 = xtb.train(params, xtb.DMatrix(X, label=y), 5, verbose_eval=False)
+    b8 = xtb.train({**params, "n_devices": 8}, xtb.DMatrix(X, label=y), 5,
+                   verbose_eval=False)
+    p1, p8 = b1.predict(xtb.DMatrix(X)), b8.predict(xtb.DMatrix(X))
+    np.testing.assert_allclose(p1, p8, rtol=5e-4, atol=1e-5)
+    for t1, t8 in zip(b1.trees, b8.trees):
+        np.testing.assert_array_equal(t1.split_indices, t8.split_indices)
+        np.testing.assert_array_equal(t1.left_children, t8.left_children)

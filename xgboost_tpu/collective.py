@@ -110,23 +110,19 @@ def allreduce(data: np.ndarray, op: Op = Op.SUM) -> np.ndarray:
     data = np.asarray(data)
     if not is_distributed():
         return data.copy()
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental import multihost_utils
 
-    mesh = Mesh(np.asarray(jax.devices()), ("d",))
-    fn = {Op.SUM: jax.lax.psum, Op.MAX: jax.lax.pmax, Op.MIN: jax.lax.pmin}.get(op)
-    if fn is None:
-        raise NotImplementedError(f"allreduce op {op!r} not supported on TPU")
-
-    sharded = jax.jit(
-        jax.shard_map(lambda x: fn(x, "d"), mesh=mesh,
-                      in_specs=P(), out_specs=P()),
-    )
-    # each process contributes its copy once: scale by devices per process
-    local_devices = jax.local_device_count()
-    contrib = data / local_devices if op == Op.SUM else data
-    return np.asarray(sharded(jnp.asarray(contrib)))
+    # gather every process's contribution (host-local arrays are NOT globally
+    # addressable, so a psum over a replicated operand would be wrong), then
+    # reduce on host — exact for every Op incl. the bitwise ones
+    gathered = np.asarray(multihost_utils.process_allgather(data))
+    red = {Op.SUM: np.sum, Op.MAX: np.max, Op.MIN: np.min,
+           Op.BITWISE_AND: np.bitwise_and.reduce,
+           Op.BITWISE_OR: np.bitwise_or.reduce,
+           Op.BITWISE_XOR: np.bitwise_xor.reduce}.get(op)
+    if red is None:
+        raise NotImplementedError(f"allreduce op {op!r} not supported")
+    return red(gathered, axis=0).astype(data.dtype)
 
 
 def broadcast(data: Any, root: int) -> Any:

@@ -42,10 +42,12 @@ class _Cache:
     lazily on first training touch (finding: eval sets only need the raw
     feature matrix for the predictor)."""
 
-    def __init__(self, dmat: DMatrix, max_bin: int, ref: Optional[DMatrix] = None):
+    def __init__(self, dmat: DMatrix, max_bin: int, ref: Optional[DMatrix] = None,
+                 mesh=None):
         self.dmat = dmat
         self.max_bin = max_bin
         self.ref = ref
+        self.mesh = mesh
         self.ellpack = None
         self.n_padded = dmat.num_row()  # grows to the padded size on ensure_train
         self.margin: Optional[Any] = None  # (n_padded, K) device
@@ -82,6 +84,14 @@ class _Cache:
         if self.ellpack is not None:
             return
         self.ellpack = self.dmat.ensure_ellpack(max_bin=self.max_bin, ref=self.ref)
+        if self.mesh is not None:
+            from .parallel import shard_rows
+
+            # sharded COPY kept on the cache; the DMatrix's page stays intact
+            # for later single-device training on the same matrix
+            (self.bins,) = shard_rows(self.mesh, self.ellpack.bins)
+        else:
+            self.bins = self.ellpack.bins
         R_pad = self.ellpack.n_padded
         R = self.ellpack.n_rows
         self.valid = jnp.arange(R_pad) < R
@@ -164,6 +174,13 @@ class Booster:
         if booster not in ("gbtree", "dart", "gblinear"):
             raise ValueError(f"unknown booster {booster}")
         self.booster_kind = booster
+        # multi-chip data parallelism: n_devices = int | "all" (SURVEY §2 L1:
+        # row sharding + histogram psum is the reference's whole comm pattern)
+        nd = p.get("n_devices", 1)
+        if isinstance(nd, bool) or (not isinstance(nd, int) and nd != "all"):
+            raise ValueError(f"n_devices must be an int or 'all', got {nd!r}")
+        self.n_devices = nd if isinstance(nd, int) else -1  # -1 = all
+        self._mesh = None
         self.num_parallel_tree = int(p.get("num_parallel_tree", 1))
         if not hasattr(self, "tree_weights"):
             self.tree_weights: List[float] = []
@@ -218,7 +235,8 @@ class Booster:
         self._configure()
         key = id(dmat)
         if key not in self._caches:
-            self._caches[key] = _Cache(dmat, self.tparam.max_bin, ref=ref)
+            self._caches[key] = _Cache(dmat, self.tparam.max_bin, ref=ref,
+                                       mesh=self._get_mesh())
             if getattr(self, "_num_feature", None) is None:
                 self._num_feature = dmat.num_col()
         return self._caches[key]
@@ -560,6 +578,20 @@ class Booster:
         mask = jax.random.bernoulli(key, self.tparam.subsample, (gpair.shape[0],))
         return gpair * mask[:, None, None]
 
+    def _get_mesh(self):
+        if self.n_devices == 1:
+            return None
+        if self._mesh is None:
+            import jax
+
+            from .parallel import make_mesh
+
+            n = self.n_devices if self.n_devices > 0 else jax.device_count()
+            if n <= 1:
+                return None
+            self._mesh = make_mesh(n)
+        return self._mesh
+
     def _boost_trees(self, cache: _Cache, gpair, iteration: int) -> None:
         import jax.numpy as jnp
 
@@ -567,6 +599,10 @@ class Booster:
             if self.booster_kind == "dart":
                 raise ValueError("booster='dart' is not supported with "
                                  "ExtMemQuantileDMatrix yet")
+            if self._get_mesh() is not None:
+                raise NotImplementedError(
+                    "n_devices > 1 with ExtMemQuantileDMatrix is not wired up "
+                    "yet; shard the DataIter across processes instead")
             return self._boost_trees_extmem(cache, gpair, iteration)
         ell = cache.ellpack
         mono = self.tparam.monotone_constraints
@@ -581,14 +617,38 @@ class Booster:
             # lossguide with unbounded depth: cap at 10 heap levels for static
             # shapes (deeper growth is a planned extension)
             max_depth = 10 if lossguide else 6
-        grower = HistTreeGrower(
-            max_depth,
-            self._split_params,
-            hist_impl=str(self.params.get("_hist_impl", "xla")),
-            interaction_sets=self.tparam.interaction_constraints,
-            max_leaves=self.tparam.max_leaves,
-            lossguide=lossguide,
-        )
+        mesh = self._get_mesh()
+        gkey = (max_depth, id(mesh), self._split_params,
+                self.tparam.interaction_constraints, self.tparam.max_leaves,
+                lossguide, str(self.params.get("_hist_impl", "xla")))
+        if not hasattr(self, "_grower_cache"):
+            self._grower_cache = {}
+        grower = self._grower_cache.get(gkey)
+        if grower is None:
+            if mesh is not None:
+                from .parallel import ShardedHistTreeGrower
+
+                # cached: ShardedHistTreeGrower wraps fresh shard_map jits, so
+                # rebuilding per round would recompile every level program
+                grower = ShardedHistTreeGrower(
+                    max_depth,
+                    self._split_params,
+                    mesh,
+                    hist_impl=str(self.params.get("_hist_impl", "xla")),
+                    interaction_sets=self.tparam.interaction_constraints,
+                    max_leaves=self.tparam.max_leaves,
+                    lossguide=lossguide,
+                )
+            else:
+                grower = HistTreeGrower(
+                    max_depth,
+                    self._split_params,
+                    hist_impl=str(self.params.get("_hist_impl", "xla")),
+                    interaction_sets=self.tparam.interaction_constraints,
+                    max_leaves=self.tparam.max_leaves,
+                    lossguide=lossguide,
+                )
+            self._grower_cache[gkey] = grower
         K = gpair.shape[1]
         adaptive = (
             hasattr(self.objective, "adaptive_leaf") and self.objective.adaptive_leaf()
@@ -644,7 +704,7 @@ class Booster:
             gp = self._subsample_mask(gpair, iteration * 131 + p_idx)
             for k in range(K):
                 state = grower.grow(
-                    ell.bins,
+                    cache.bins,
                     gp[:, k, :],
                     cache.valid,
                     ell.cuts_pad,

@@ -66,6 +66,23 @@ def plot_importance(booster, ax=None, height: float = 0.2,
     return ax
 
 
+def _attr_str(params: Optional[dict]) -> str:
+    if not params:
+        return ""
+    return "".join(f', {k}="{v}"' for k, v in params.items())
+
+
+def _read_fmap(fmap: str):
+    """featmap.txt: '<id>\t<name>\t<type>' per line (reference format)."""
+    names = {}
+    with open(fmap) as fh:
+        for line in fh:
+            parts = line.strip().split("\t")
+            if len(parts) >= 2:
+                names[int(parts[0])] = parts[1]
+    return names
+
+
 def to_graphviz(booster, fmap: str = "", num_trees: int = 0, rankdir: str = "UT",
                 yes_color: str = "#0000FF", no_color: str = "#FF0000",
                 condition_node_params: Optional[dict] = None,
@@ -75,15 +92,23 @@ def to_graphviz(booster, fmap: str = "", num_trees: int = 0, rankdir: str = "UT"
         booster = booster.get_booster()
     tree = booster.trees[num_trees]
     names = booster.feature_names
+    fmap_names = _read_fmap(fmap) if fmap else {}
 
     def fname(fid):
+        if fid in fmap_names:
+            return fmap_names[fid]
         return names[fid] if names else f"f{fid}"
 
+    cond_attrs = _attr_str(condition_node_params)
+    leaf_attrs = _attr_str(leaf_node_params) or ', shape="box"'
+    graph_attrs = "".join(f'  {k}="{v}";\n' for k, v in kwargs.items())
     lines = [f"digraph tree_{num_trees} {{", f'  rankdir="{rankdir}";']
+    if graph_attrs:
+        lines.append(graph_attrs.rstrip("\n"))
     for nid in range(tree.n_nodes):
         if tree.is_leaf(nid):
             lines.append(
-                f'  n{nid} [label="leaf={tree.split_conditions[nid]:.6g}", shape=box];'
+                f'  n{nid} [label="leaf={tree.split_conditions[nid]:.6g}"{leaf_attrs}];'
             )
         else:
             if tree.categories and nid in tree.categories:
@@ -91,7 +116,7 @@ def to_graphviz(booster, fmap: str = "", num_trees: int = 0, rankdir: str = "UT"
                 cond = f"{fname(tree.split_indices[nid])}:{{{cats}}}"
             else:
                 cond = f"{fname(tree.split_indices[nid])}<{tree.split_conditions[nid]:.6g}"
-            lines.append(f'  n{nid} [label="{cond}"];')
+            lines.append(f'  n{nid} [label="{cond}"{cond_attrs}];')
             yes, no = tree.left_children[nid], tree.right_children[nid]
             miss = yes if tree.default_left[nid] else no
             ylab = "yes, missing" if miss == yes else "yes"

@@ -193,6 +193,8 @@ class StreamingQuantileSummary:
         v = values[keep]
         w = (np.ones(len(v)) if weights is None
              else np.asarray(weights, np.float64)[keep])
+        pos = w > 0  # native path drops non-positive weights; keep parity
+        v, w = v[pos], w[pos]
         self._vals = np.concatenate([self._vals, v])
         self._wts = np.concatenate([self._wts, w])
         if len(self._vals) > 2 * self.budget:
