@@ -90,3 +90,20 @@ def test_num_parallel_tree_forest():
     rf.fit(X, y)
     pred = rf.predict(X)
     assert np.corrcoef(pred, y)[0, 1] > 0.9
+
+
+def test_gradient_based_sampling():
+    """(reference: src/tree/gpu_hist/sampler.cuh GradientBasedSampler)"""
+    X, y = make_regression(1500, 6, seed=13)
+    d = xtb.DMatrix(X, label=y)
+    res_u, res_g = {}, {}
+    xtb.train({"objective": "reg:squarederror", "subsample": 0.3,
+               "sampling_method": "uniform", "max_depth": 4, "seed": 1}, d, 12,
+              evals=[(d, "t")], evals_result=res_u, verbose_eval=False)
+    xtb.train({"objective": "reg:squarederror", "subsample": 0.3,
+               "sampling_method": "gradient_based", "max_depth": 4, "seed": 1},
+              d, 12, evals=[(d, "t")], evals_result=res_g, verbose_eval=False)
+    assert np.isfinite(res_g["t"]["rmse"]).all()
+    # both must learn; gradient-based usually at least matches uniform
+    assert res_g["t"]["rmse"][-1] < res_g["t"]["rmse"][0] * 0.7
+    assert res_u["t"]["rmse"][-1] < res_u["t"]["rmse"][0] * 0.7

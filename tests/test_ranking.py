@@ -1,0 +1,58 @@
+"""Learning-to-rank (reference: tests/python/test_ranking.py,
+testing/data.py:813 make_ltr)."""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.metric import ndcg
+from xgboost_tpu.testing.data import make_ltr
+
+
+@pytest.fixture(scope="module")
+def ltr():
+    X, y, qid = make_ltr(40, 30, 8, seed=0)
+    return X, y, qid
+
+
+@pytest.mark.parametrize("obj", ["rank:ndcg", "rank:pairwise", "rank:map"])
+def test_rank_objectives_improve(ltr, obj):
+    X, y, qid = ltr
+    d = xtb.DMatrix(X, label=y, qid=qid)
+    res = {}
+    xtb.train({"objective": obj, "max_depth": 4, "eta": 0.3,
+               "lambdarank_num_pair_per_sample": 2}, d, 20,
+              evals=[(d, "t")], evals_result=res, verbose_eval=False)
+    metric = list(res["t"].keys())[0]
+    vals = res["t"][metric]
+    assert np.isfinite(vals).all()
+    assert vals[-1] > vals[0]  # ndcg/map are maximized
+
+
+def test_rank_requires_groups(ltr):
+    X, y, _ = ltr
+    d = xtb.DMatrix(X, label=y)  # no qid: degenerates to one big group
+    bst = xtb.train({"objective": "rank:ndcg", "max_depth": 3}, d, 3,
+                    verbose_eval=False)
+    assert np.isfinite(bst.predict(d)).all()
+
+
+def test_ranker_sklearn_with_eval(ltr):
+    X, y, qid = ltr
+    half = len(y) // 2
+    rk = xtb.XGBRanker(n_estimators=10, max_depth=3)
+    rk.fit(X[:half], y[:half], qid=qid[:half],
+           eval_set=[(X[half:], y[half:])], eval_qid=[qid[half:]])
+    assert rk.evals_result_  # eval history recorded
+    d = xtb.DMatrix(X, label=y, qid=qid)
+    score = ndcg(rk.predict(X), y, group_ptr=d.info.group_ptr)
+    assert score > 0.85
+
+
+def test_ndcg_at_k_metric(ltr):
+    X, y, qid = ltr
+    d = xtb.DMatrix(X, label=y, qid=qid)
+    res = {}
+    xtb.train({"objective": "rank:ndcg", "eval_metric": ["ndcg@5", "map@5"],
+               "max_depth": 3}, d, 5, evals=[(d, "t")], evals_result=res,
+              verbose_eval=False)
+    assert "ndcg@5" in res["t"] and "map@5" in res["t"]

@@ -104,8 +104,9 @@ def communicator_print(msg: str) -> None:
 def allreduce(data: np.ndarray, op: Op = Op.SUM) -> np.ndarray:
     """Allreduce across processes (reference: collective.py allreduce).
 
-    Uses psum/pmin/pmax over all devices via a one-shot pmapped program; the
-    single-process case is an exact identity.
+    Gathers each process's contribution (multihost process_allgather) and
+    reduces on host — exact for sum/min/max and the bitwise ops; the
+    single-process case is an identity copy.
     """
     data = np.asarray(data)
     if not is_distributed():

@@ -179,6 +179,8 @@ class Booster:
         nd = p.get("n_devices", 1)
         if isinstance(nd, bool) or (not isinstance(nd, int) and nd != "all"):
             raise ValueError(f"n_devices must be an int or 'all', got {nd!r}")
+        if isinstance(nd, int) and nd < 1:
+            raise ValueError(f"n_devices must be >= 1, got {nd}")
         self.n_devices = nd if isinstance(nd, int) else -1  # -1 = all
         self._mesh = None
         self.num_parallel_tree = int(p.get("num_parallel_tree", 1))
@@ -363,10 +365,17 @@ class Booster:
                 cache.margin, cache.labels, cache.weights, iteration
             )  # (R_pad, K, 2)
         gpair = gpair * cache.valid[:, None, None]
+        from .utils import observer
+
+        if observer.enabled():
+            observer.observe_margin(cache.margin, iteration)
+            observer.observe_gradients(gpair, iteration)
         if self.booster_kind == "gblinear":
             self._boost_linear(cache, gpair)
         else:
             self._boost_trees(cache, gpair, iteration)
+        if observer.enabled() and self.trees:
+            observer.observe_tree(self.trees[-1], iteration)
 
     def boost(self, dtrain: DMatrix, grad, hess, iteration: int = 0) -> None:
         """Custom-gradient boost (reference: XGBoosterBoostOneIter)."""
@@ -566,15 +575,32 @@ class Booster:
         return per_level
 
     def _subsample_mask(self, gpair, iteration: int):
-        """Row subsampling: zeroed gpairs drop rows from hist + leaves
-        (reference: src/tree/hist/sampler.cc uniform path)."""
+        """Row subsampling: zeroed gpairs drop rows from hist + leaves.
+
+        uniform: Bernoulli(subsample) (reference: src/tree/hist/sampler.cc).
+        gradient_based: keep-probability proportional to the gradient norm
+        sqrt(g^2 + lambda h^2) with 1/p reweighting so histogram sums stay
+        unbiased (reference: src/tree/gpu_hist/sampler.cuh:129-135, the
+        Ou 2020 out-of-core sampler).
+        """
         import jax
+        import jax.numpy as jnp
 
         if self.tparam.subsample >= 1.0:
             return gpair
         key = jax.random.PRNGKey(
             (int(self.params.get("seed", 0)) * 7919 + iteration) % (2**31)
         )
+        if self.tparam.sampling_method == "gradient_based":
+            lam = float(self.tparam.lambda_)
+            norm = jnp.sqrt(gpair[..., 0] ** 2 + lam * gpair[..., 1] ** 2)
+            norm = jnp.max(norm, axis=1)  # (R_pad,) across output groups
+            total = jnp.maximum(jnp.sum(norm), 1e-12)
+            target = self.tparam.subsample * jnp.sum(norm > 0)
+            p = jnp.clip(norm * target / total, 0.0, 1.0)
+            keep = jax.random.uniform(key, p.shape) < p
+            scale = jnp.where(keep, 1.0 / jnp.maximum(p, 1e-12), 0.0)
+            return gpair * scale[:, None, None]
         mask = jax.random.bernoulli(key, self.tparam.subsample, (gpair.shape[0],))
         return gpair * mask[:, None, None]
 
@@ -589,6 +615,10 @@ class Booster:
             n = self.n_devices if self.n_devices > 0 else jax.device_count()
             if n <= 1:
                 return None
+            if 1024 % n != 0:  # pages are row-aligned to 1024 (data/ellpack.py)
+                raise ValueError(
+                    f"n_devices={n} must divide the 1024-row page alignment "
+                    f"(use a power of two up to 1024)")
             self._mesh = make_mesh(n)
         return self._mesh
 

@@ -17,17 +17,23 @@ from . import ObjFunction, register_objective
 
 
 def make_group_layout(group_ptr: np.ndarray):
-    """Host: CSR group_ptr -> padded (G, S) row-index matrix + mask."""
+    """Host: CSR group_ptr -> padded (G, S) row-index matrix + mask + the
+    inverse map row -> flat (g*S + s) slot (rows appear exactly once, so the
+    padded-grid gradients come back to row order with a GATHER, no scatter —
+    TPU scatter-adds are serialized)."""
     sizes = np.diff(group_ptr)
     G = len(sizes)
     S = int(sizes.max()) if G else 1
     idx = np.zeros((G, S), dtype=np.int32)
     mask = np.zeros((G, S), dtype=bool)
+    inv = np.zeros(int(group_ptr[-1]), dtype=np.int32)
     for g in range(G):
         n = sizes[g]
-        idx[g, :n] = np.arange(group_ptr[g], group_ptr[g + 1])
+        rows = np.arange(group_ptr[g], group_ptr[g + 1])
+        idx[g, :n] = rows
         mask[g, :n] = True
-    return idx, mask
+        inv[rows] = g * S + np.arange(n)
+    return idx, mask, inv
 
 
 class _LambdaRankBase(ObjFunction):
@@ -37,9 +43,10 @@ class _LambdaRankBase(ObjFunction):
         self._layout = None  # set by learner via set_group_info
 
     def set_group_info(self, group_ptr: np.ndarray) -> None:
-        idx, mask = make_group_layout(group_ptr)
+        idx, mask, inv = make_group_layout(group_ptr)
         self._gidx = jnp.asarray(idx)
         self._gmask = jnp.asarray(mask)
+        self._ginv = jnp.asarray(inv)
 
     def default_metric(self):
         return "ndcg"
@@ -57,6 +64,7 @@ class _LambdaRankBase(ObjFunction):
             labels.astype(jnp.float32),
             self._gidx,
             self._gmask,
+            self._ginv,
             key,
             self.num_pair,
             self._use_ndcg_weight(),
@@ -72,7 +80,7 @@ import functools
 
 
 @functools.partial(jax.jit, static_argnames=("num_pair", "ndcg_weight"))
-def _lambda_gradients(pred, y, gidx, gmask, key, num_pair: int, ndcg_weight: bool):
+def _lambda_gradients(pred, y, gidx, gmask, ginv, key, num_pair: int, ndcg_weight: bool):
     R = pred.shape[0]
     G, S = gidx.shape
     s = pred[gidx]  # (G, S)
@@ -125,12 +133,11 @@ def _lambda_gradients(pred, y, gidx, gmask, key, num_pair: int, ndcg_weight: boo
         )
         hess_g = hess_g + jnp.where((better | worse) & gmask, jnp.where(better, h_b, h_w), 0.0)
 
-    # scatter padded grads back to rows (padded slots masked to row 0 w/ zero)
-    flat_idx = jnp.where(gmask, gidx, 0).reshape(-1)
-    gflat = jnp.where(gmask, grad_g, 0.0).reshape(-1)
-    hflat = jnp.where(gmask, hess_g, 0.0).reshape(-1)
-    grad = jnp.zeros(R, jnp.float32).at[flat_idx].add(gflat)
-    hess = jnp.zeros(R, jnp.float32).at[flat_idx].add(hflat)
+    # rows back from the padded grid via the precomputed inverse map — a pure
+    # gather (each row owns exactly one (g, s) slot); no scatter on TPU.
+    # ginv covers the real rows; the padded tail (R_pad - R_real) stays zero.
+    grad = jnp.pad(grad_g.reshape(-1)[ginv], (0, R - ginv.shape[0]))
+    hess = jnp.pad(hess_g.reshape(-1)[ginv], (0, R - ginv.shape[0]))
     return grad, hess
 
 

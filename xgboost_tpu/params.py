@@ -101,6 +101,9 @@ class TrainParam:
             raise ValueError("max_bin must be >= 2")
         if self.grow_policy not in ("depthwise", "lossguide"):
             raise ValueError("grow_policy must be 'depthwise' or 'lossguide'")
+        if self.sampling_method not in ("uniform", "gradient_based"):
+            raise ValueError(
+                "sampling_method must be 'uniform' or 'gradient_based'")
 
     def split_static(self) -> Tuple[float, ...]:
         """Hashable static subset consumed by the jitted split evaluator."""

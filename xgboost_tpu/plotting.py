@@ -69,7 +69,9 @@ def plot_importance(booster, ax=None, height: float = 0.2,
 def _attr_str(params: Optional[dict]) -> str:
     if not params:
         return ""
-    return "".join(f', {k}="{v}"' for k, v in params.items())
+    return "".join(
+        ', {}="{}"'.format(k, str(v).replace('"', r'\"')) for k, v in params.items()
+    )
 
 
 def _read_fmap(fmap: str):
@@ -100,7 +102,7 @@ def to_graphviz(booster, fmap: str = "", num_trees: int = 0, rankdir: str = "UT"
         return names[fid] if names else f"f{fid}"
 
     cond_attrs = _attr_str(condition_node_params)
-    leaf_attrs = _attr_str(leaf_node_params) or ', shape="box"'
+    leaf_attrs = _attr_str({"shape": "box", **(leaf_node_params or {})})
     graph_attrs = "".join(f'  {k}="{v}";\n' for k, v in kwargs.items())
     lines = [f"digraph tree_{num_trees} {{", f'  rankdir="{rankdir}";']
     if graph_attrs:

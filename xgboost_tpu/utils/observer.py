@@ -1,0 +1,54 @@
+"""TrainingObserver: diff-friendly debug dumps of training internals.
+
+Reference: src/common/observer.h:38 — under XGBOOST_USE_DEBUG_OUTPUT the
+reference prints gradients/predictions/trees each iteration for cross-build
+diffing.  Enable here with XGBOOST_TPU_DEBUG_OBSERVER=1 (or observe(True));
+the Booster calls into this after every boosting round.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+import numpy as np
+
+_ENABLED: Optional[bool] = None
+
+
+def enabled() -> bool:
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = os.environ.get("XGBOOST_TPU_DEBUG_OBSERVER", "0") in ("1", "true")
+    return _ENABLED
+
+
+def observe(on: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def _dump(name: str, arr, limit: int = 16) -> None:
+    a = np.asarray(arr).reshape(-1)
+    head = ", ".join(f"{v:.6g}" for v in a[:limit])
+    print(f"[observer] {name}: n={a.size} sum={a.sum():.6g} head=[{head}]",
+          file=sys.stderr, flush=True)
+
+
+def observe_gradients(gpair, iteration: int) -> None:
+    if enabled():
+        _dump(f"iter{iteration}.grad", np.asarray(gpair)[..., 0])
+        _dump(f"iter{iteration}.hess", np.asarray(gpair)[..., 1])
+
+
+def observe_margin(margin, iteration: int) -> None:
+    if enabled():
+        _dump(f"iter{iteration}.margin", margin)
+
+
+def observe_tree(tree, iteration: int) -> None:
+    if enabled():
+        print(f"[observer] iter{iteration}.tree nodes={tree.n_nodes} "
+              f"leaves={tree.num_leaves}", file=sys.stderr, flush=True)
+        _dump(f"iter{iteration}.leaf_values",
+              tree.split_conditions[tree.left_children == -1])
