@@ -63,6 +63,78 @@ def test_node_sums_matches_numpy():
         np.testing.assert_allclose(sums[n], ref, rtol=1e-4, atol=1e-4)
 
 
+def test_stride_selects_left_children():
+    """stride=2 (subtraction trick) == every other slot of the full build."""
+    bins, gpair, pos = _mk(R=2048, F=5, B=16, n_nodes=8, node0=7, seed=5)
+    full = np.asarray(
+        build_histogram(jnp.asarray(bins), jnp.asarray(gpair), jnp.asarray(pos),
+                        node0=7, n_nodes=8, n_bin=16)
+    )
+    left = np.asarray(
+        build_histogram(jnp.asarray(bins), jnp.asarray(gpair), jnp.asarray(pos),
+                        node0=7, n_nodes=4, n_bin=16, stride=2)
+    )
+    np.testing.assert_allclose(left, full[0::2], rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_row_padding():
+    """Rows not a multiple of the 512 tile are padded internally (the round-1
+    R % 512 assert is gone)."""
+    from xgboost_tpu.ops.hist_pallas import build_histogram_pallas
+
+    bins, gpair, pos = _mk(R=700, F=3, B=8, n_nodes=2, node0=1, seed=7)
+    xla = np.asarray(
+        build_histogram(jnp.asarray(bins), jnp.asarray(gpair), jnp.asarray(pos),
+                        node0=1, n_nodes=2, n_bin=8)
+    )
+    pallas = np.asarray(
+        build_histogram_pallas(jnp.asarray(bins), jnp.asarray(gpair),
+                               jnp.asarray(pos), node0=1, n_nodes=2, n_bin=8,
+                               interpret=True)
+    )
+    np.testing.assert_allclose(pallas, xla, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("sparsity", [0.0, 0.4])
+def test_subtraction_trick_same_trees(sparsity):
+    """Trees grown with the subtraction trick (right sibling = parent - left)
+    choose the same splits as a direct rebuild of every node histogram
+    (updater_gpu_hist.cu:309 SubtractHist)."""
+    from xgboost_tpu.data.ellpack import build_ellpack
+    from xgboost_tpu.data.quantile import sketch_dense
+    from xgboost_tpu.ops.split import SplitParams
+    from xgboost_tpu.tree.grow import HistTreeGrower
+
+    rng = np.random.default_rng(11)
+    R, F = 3000, 8
+    X = rng.normal(size=(R, F)).astype(np.float32)
+    if sparsity:
+        X[rng.random((R, F)) < sparsity] = np.nan
+    y = (np.nan_to_num(X[:, 0] * X[:, 1]) + np.nan_to_num(X[:, 2]) > 0)
+    grad = (0.5 - y.astype(np.float32))
+    gpair_np = np.stack([grad, np.full(R, 0.25, np.float32)], axis=1)
+
+    cuts = sketch_dense(X, 16, use_device=False)
+    ell = build_ellpack(X, cuts, row_align=64)
+    gp = np.zeros((ell.n_padded, 2), np.float32)
+    gp[:R] = gpair_np
+    gp_j = jnp.asarray(gp)
+    valid = jnp.arange(ell.n_padded) < R
+    params = SplitParams(eta=0.3, gamma=0.0, min_child_weight=1.0,
+                         lambda_=1.0, alpha=0.0, max_delta_step=0.0)
+
+    states = {}
+    for sub in (True, False):
+        g = HistTreeGrower(6, params, subtract=sub)
+        states[sub] = HistTreeGrower.to_host(
+            g.grow(ell.bins, gp_j, valid, ell.cuts_pad, ell.n_bins))
+    np.testing.assert_array_equal(states[True].feat, states[False].feat)
+    np.testing.assert_array_equal(states[True].sbin, states[False].sbin)
+    np.testing.assert_array_equal(states[True].is_leaf, states[False].is_leaf)
+    np.testing.assert_allclose(states[True].leaf_val, states[False].leaf_val,
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_missing_sentinel_excluded():
     R, F, B = 512, 3, 8
     bins = np.full((R, F), B, np.int16)  # everything missing

@@ -341,47 +341,72 @@ class Booster:
                 self.objective.set_bounds(lo, hi)
         if hasattr(self.objective, "set_group_info"):
             gp = dtrain.info.group_ptr
+            # keyed on the DMatrix and a set_group version counter (NOT array
+            # id(): the allocator can reuse addresses) so continued training
+            # with different query groups rebuilds the layout
+            owner = (id(dtrain), getattr(dtrain, "group_version", 0))
             if gp is None:
                 gp = np.array([0, dtrain.num_row()], np.int64)
-            if not hasattr(self.objective, "_gidx"):
+            if getattr(self.objective, "_gidx_owner", None) != owner:
                 self.objective.set_group_info(gp)
+                self.objective._gidx_owner = owner
         self._sync_margin(cache)
-        R = dtrain.num_row()
-        if fobj is not None:
+        drop_idx = self._select_dart_drops(iteration)
+        if drop_idx:
+            # DART drop round: the gradient must be evaluated on the reduced
+            # margin, which _boost_trees builds — skip the full-margin pass
+            # so a custom fobj is invoked exactly once
+            gpair = None
+        elif fobj is not None:
             # custom objectives receive RAW margins (reference: Booster.update
             # passes output_margin=True predictions to fobj, core.py:2277)
-            valid_np = np.asarray(cache.valid)
-            m = np.asarray(cache.margin)[valid_np]
-            preds = m[:, 0] if self.n_groups == 1 else m
-            grad, hess = fobj(preds, dtrain)
-            grad = np.asarray(grad, np.float32).reshape(R, -1)
-            hess = np.asarray(hess, np.float32).reshape(R, -1)
-            K = grad.shape[1]
-            gp_dense = np.zeros((cache.margin.shape[0], K, 2), np.float32)
-            gp_dense[valid_np] = np.stack([grad, hess], axis=-1)
-            gpair = jnp.asarray(gp_dense)
+            gpair = self._fobj_gpair(cache, fobj, cache.margin, dtrain)
         else:
             gpair = self.objective.get_gradient(
                 cache.margin, cache.labels, cache.weights, iteration
             )  # (R_pad, K, 2)
-        gpair = gpair * cache.valid[:, None, None]
+        if gpair is not None:
+            gpair = gpair * cache.valid[:, None, None]
         from .utils import observer
 
         if observer.enabled():
             observer.observe_margin(cache.margin, iteration)
-            observer.observe_gradients(gpair, iteration)
+            if gpair is not None:
+                observer.observe_gradients(gpair, iteration)
         if self.booster_kind == "gblinear":
             self._boost_linear(cache, gpair)
         else:
-            self._boost_trees(cache, gpair, iteration)
+            self._boost_trees(cache, gpair, iteration, fobj=fobj,
+                              drop_idx=drop_idx)
         if observer.enabled() and self.trees:
             observer.observe_tree(self.trees[-1], iteration)
+
+    def _fobj_gpair(self, cache, fobj, margin, dmat):
+        """Densify a custom objective's (grad, hess) over the padded rows."""
+        import jax.numpy as jnp
+
+        valid_np = np.asarray(cache.valid).astype(bool)
+        m = np.asarray(margin)[valid_np]
+        preds = m[:, 0] if self.n_groups == 1 else m
+        grad, hess = fobj(preds, dmat)
+        R = int(valid_np.sum())
+        grad = np.asarray(grad, np.float32).reshape(R, -1)
+        hess = np.asarray(hess, np.float32).reshape(R, -1)
+        gp_dense = np.zeros((margin.shape[0], grad.shape[1], 2), np.float32)
+        gp_dense[valid_np] = np.stack([grad, hess], axis=-1)
+        return jnp.asarray(gp_dense)
 
     def boost(self, dtrain: DMatrix, grad, hess, iteration: int = 0) -> None:
         """Custom-gradient boost (reference: XGBoosterBoostOneIter)."""
         import jax.numpy as jnp
 
         self._configure()
+        if self._select_dart_drops(iteration):
+            # this round actually drops trees: gradients would have to be
+            # re-evaluated on the reduced margin, impossible with raw values
+            raise NotImplementedError(
+                "boost() with raw grad/hess cannot honour a DART dropout "
+                "round; use update(fobj=...) or set rate_drop=0")
         cache = self._get_cache(dtrain)
         cache.ensure_train()
         self._sync_margin(cache)
@@ -622,7 +647,36 @@ class Booster:
             self._mesh = make_mesh(n)
         return self._mesh
 
-    def _boost_trees(self, cache: _Cache, gpair, iteration: int) -> None:
+    def _select_dart_drops(self, iteration: int) -> List[int]:
+        """Draw the round's dropped-tree set (gbtree.cc Dart::DropTrees).
+        Deterministic per iteration; empty when dropout does not fire."""
+        if not (self.booster_kind == "dart" and self.trees
+                and self.rate_drop > 0.0):
+            return []
+        rng = self._rng(iteration, 97)
+        if rng.random() < self.skip_drop:
+            return []
+        n = len(self.trees)
+        if self.sample_type == "weighted":
+            wts = np.asarray(self.tree_weights, np.float64)
+            prob = wts / max(wts.sum(), 1e-16)
+            k_drop = int(rng.binomial(n, self.rate_drop))
+            if k_drop == 0 and self.one_drop:
+                k_drop = 1
+            if k_drop == 0:
+                return []
+            return list(rng.choice(n, size=min(k_drop, n), replace=False,
+                                   p=prob))
+        mask = rng.random(n) < self.rate_drop
+        drop_idx = list(np.nonzero(mask)[0])
+        if not drop_idx and self.one_drop:
+            drop_idx = [int(rng.integers(0, n))]
+        return drop_idx
+
+    def _boost_trees(self, cache: _Cache, gpair, iteration: int,
+                     fobj=None, drop_idx=()) -> None:
+        """gpair may be None when drop_idx is non-empty (DART round): the
+        gradient is then computed here, on the dropout-reduced margin."""
         import jax.numpy as jnp
 
         if cache.is_extmem:
@@ -679,33 +733,12 @@ class Booster:
                     lossguide=lossguide,
                 )
             self._grower_cache[gkey] = grower
-        K = gpair.shape[1]
         adaptive = (
             hasattr(self.objective, "adaptive_leaf") and self.objective.adaptive_leaf()
         )
 
         # ---- DART dropout (reference: gbtree.cc Dart::DoBoost + DropTrees) ----
-        dart = self.booster_kind == "dart"
-        drop_idx: List[int] = []
         drop_margin = None
-        if dart and self.trees and self.rate_drop > 0.0:
-            rng = self._rng(iteration, 97)
-            if rng.random() >= self.skip_drop:
-                n = len(self.trees)
-                if self.sample_type == "weighted":
-                    wts = np.asarray(self.tree_weights, np.float64)
-                    prob = wts / max(wts.sum(), 1e-16)
-                    k_drop = int(rng.binomial(n, self.rate_drop))
-                    if k_drop == 0 and self.one_drop:
-                        k_drop = 1
-                    if k_drop > 0:
-                        drop_idx = list(rng.choice(n, size=min(k_drop, n),
-                                                   replace=False, p=prob))
-                else:
-                    mask = rng.random(n) < self.rate_drop
-                    drop_idx = list(np.nonzero(mask)[0])
-                    if not drop_idx and self.one_drop:
-                        drop_idx = [int(rng.integers(0, n))]
         if drop_idx:
             import jax.numpy as jnp
 
@@ -718,12 +751,22 @@ class Booster:
                     [drop_margin, jnp.zeros((pad, drop_margin.shape[1]), jnp.float32)],
                     axis=0,
                 )
-            # gradients computed on the margin WITHOUT dropped trees
+            # gradients computed on the margin WITHOUT dropped trees (the
+            # caller skipped its own gradient pass, so a custom fobj runs
+            # exactly once per round)
             reduced = cache.margin - drop_margin
-            gpair = self.objective.get_gradient(
-                reduced, cache.labels, cache.weights, iteration
-            ) * cache.valid[:, None, None]
+            if fobj is not None:
+                # custom objective: invoke on the reduced RAW margin
+                # (advisor round-1: silently falling back to the built-in
+                # objective trained the drop round on the wrong loss)
+                gpair = self._fobj_gpair(cache, fobj, reduced, cache.dmat)
+            else:
+                gpair = self.objective.get_gradient(
+                    reduced, cache.labels, cache.weights, iteration
+                )
+            gpair = gpair * cache.valid[:, None, None]
 
+        K = gpair.shape[1]
         new_margin = cache.margin
         n_new = 0
         cat_mask_np = cache.dmat.cat_mask()
@@ -817,6 +860,8 @@ class Booster:
             if hasattr(self.objective, "dist"):
                 mkw["dist"] = self.objective.dist
                 mkw["sigma"] = self.objective.sigma
+            if "huber_slope" in self.params:
+                mkw["slope"] = float(self.params["huber_slope"])
             for fn, mname in metrics:
                 v = fn(preds, labels, weights, **mkw)
                 msgs.append(f"{name}-{mname}:{v:g}")
@@ -1106,7 +1151,8 @@ class Booster:
                 "name": "gblinear",
             }
         else:
-            trees = [t.to_json_dict(n_feat) for t in self.trees]
+            trees = [t.to_json_dict(n_feat, tree_id=i)
+                     for i, t in enumerate(self.trees)]
             model = {
                 "gbtree_model_param": {
                     "num_trees": str(len(self.trees)),
@@ -1121,10 +1167,16 @@ class Booster:
                       "name": "dart"}
             else:
                 gb = {"model": model, "name": "gbtree"}
+        # exact f32 margin stashed as an attribute (string map — upstream
+        # ignores unknown keys): prob<->margin transforms do not round-trip
+        # bitwise in f32, so reloading from base_score alone perturbs margins
+        attrs = dict(self.attributes)
+        attrs["base_margin_exact"] = " ".join(
+            repr(float(v)) for v in np.asarray(self.base_score).reshape(-1))
         return {
             "version": [3, 1, 0],
             "learner": {
-                "attributes": dict(self.attributes),
+                "attributes": attrs,
                 "feature_names": self.feature_names or [],
                 "feature_types": self.feature_types or [],
                 "gradient_booster": gb,
@@ -1163,10 +1215,17 @@ class Booster:
             self.params["num_class"] = nc
         self._invalidate_config()
         self._configure()
-        base_prob = np.float32(float(lmp["base_score"]))
-        self._base_margin_value = np.broadcast_to(
-            np.asarray(self.objective.prob_to_margin(base_prob), np.float32), (self.n_groups,)
-        ).astype(np.float32).copy()
+        exact = learner.get("attributes", {}).get("base_margin_exact")
+        if exact is not None:
+            vals = np.asarray([float(v) for v in str(exact).split()], np.float32)
+            self._base_margin_value = np.broadcast_to(
+                vals if vals.size > 1 else vals.reshape(-1)[0],
+                (self.n_groups,)).astype(np.float32).copy()
+        else:
+            base_prob = np.float32(float(lmp["base_score"]))
+            self._base_margin_value = np.broadcast_to(
+                np.asarray(self.objective.prob_to_margin(base_prob), np.float32),
+                (self.n_groups,)).astype(np.float32).copy()
         self._num_feature = int(lmp.get("num_feature", "0")) or None
         gbooster = learner["gradient_booster"]
         name = gbooster.get("name", "gbtree")
@@ -1192,6 +1251,7 @@ class Booster:
                 gb.get("gbtree_model_param", {}).get("num_parallel_tree", "1") or 1)
             self.params.setdefault("num_parallel_tree", self.num_parallel_tree)
         self.attributes = dict(learner.get("attributes", {}))
+        self.attributes.pop("base_margin_exact", None)
         self.feature_names = learner.get("feature_names") or None
         self.feature_types = learner.get("feature_types") or None
 
@@ -1247,7 +1307,8 @@ class Booster:
 
     def get_dump(self, fmap: str = "", with_stats: bool = False, dump_format: str = "text"):
         if dump_format == "json":
-            return [json.dumps(t.to_json_dict(self.num_features())) for t in self.trees]
+            return [json.dumps(t.to_json_dict(self.num_features(), tree_id=i))
+                    for i, t in enumerate(self.trees)]
         return [t.dump_text(self.feature_names, with_stats) for t in self.trees]
 
     def get_score(self, fmap: str = "", importance_type: str = "weight") -> Dict[str, float]:

@@ -195,6 +195,7 @@ class DMatrix:
     def set_group(self, group: Any) -> None:
         g = np.asarray(group, dtype=np.int64)
         self.info.group_ptr = np.concatenate([[0], np.cumsum(g)]).astype(np.int64)
+        self._bump_group_version()
 
     def set_qid(self, qid: Any) -> None:
         q = np.asarray(qid)
@@ -202,6 +203,12 @@ class DMatrix:
             return
         change = np.nonzero(np.diff(q) != 0)[0] + 1
         self.info.group_ptr = np.concatenate([[0], change, [len(q)]]).astype(np.int64)
+        self._bump_group_version()
+
+    def _bump_group_version(self) -> None:
+        """Monotone counter so Booster caches keyed on the group layout
+        cannot alias after allocator address reuse."""
+        self.group_version = getattr(self, "group_version", 0) + 1
 
     # ---- shape ----
     def num_row(self) -> int:

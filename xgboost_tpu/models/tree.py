@@ -150,7 +150,7 @@ class RegTree:
         return max((int(c.max()) for c in self.categories.values() if len(c)), default=-1)
 
     # ---- xgboost JSON schema (tree_model.cc SaveModel) ----
-    def to_json_dict(self, n_features: int) -> dict:
+    def to_json_dict(self, n_features: int, tree_id: int = 0) -> dict:
         n = self.n_nodes
         st = self.split_type if self.split_type is not None else np.zeros(n, np.int32)
         cat_nodes, cat_segs, cat_sizes, cat_flat = [], [], [], []
@@ -162,6 +162,8 @@ class RegTree:
                 cat_sizes.append(len(cats))
                 cat_flat.extend(int(c) for c in cats)
         return {
+            # GBTreeModel::LoadModel CHECKs trees[t]["id"] == t (gbtree_model.cc)
+            "id": int(tree_id),
             "tree_param": {
                 "num_nodes": str(n),
                 "num_feature": str(n_features),

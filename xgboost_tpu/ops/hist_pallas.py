@@ -33,7 +33,7 @@ _FEAT_GROUP = 4
 
 
 def _hist_kernel(bins_ref, gpair_ref, pos_ref, out_ref, *, node0: int,
-                 n_nodes: int, n_bin: int, feat_group: int):
+                 n_nodes: int, n_bin: int, feat_group: int, stride: int):
     i = pl.program_id(1)  # row-tile index (innermost)
 
     @pl.when(i == 0)
@@ -42,7 +42,7 @@ def _hist_kernel(bins_ref, gpair_ref, pos_ref, out_ref, *, node0: int,
 
     pos = pos_ref[:, 0]  # (T,)
     gpair = gpair_ref[:, :2]  # (T, 2)
-    nodes = node0 + jax.lax.iota(jnp.int32, n_nodes)
+    nodes = node0 + stride * jax.lax.iota(jnp.int32, n_nodes)
     nodemask = (pos[:, None] == nodes[None, :]).astype(jnp.float32)  # (T, N)
     T = gpair.shape[0]
     gm = (nodemask[:, :, None] * gpair[:, None, :]).reshape(T, n_nodes * 2)
@@ -60,24 +60,31 @@ def _hist_kernel(bins_ref, gpair_ref, pos_ref, out_ref, *, node0: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("node0", "n_nodes", "n_bin", "interpret")
+    jax.jit, static_argnames=("node0", "n_nodes", "n_bin", "interpret", "stride")
 )
 def build_histogram_pallas(bins, gpair, pos, *, node0: int, n_nodes: int,
-                           n_bin: int, interpret: bool = False):
+                           n_bin: int, interpret: bool = False, stride: int = 1):
     """hist (n_nodes, F, B, 2) — drop-in for ops/histogram.build_histogram.
 
     bins (R_pad, F) int (sentinel == n_bin for missing), gpair (R_pad, 2) f32,
-    pos (R_pad,) int32.  R_pad must be a multiple of the 512 row tile.
+    pos (R_pad,) int32.  Rows are padded up to the 512 row tile internally
+    (pad rows carry pos = -1, matching no node).
     """
     R, F = bins.shape
     T = _ROW_TILE
     FG = _FEAT_GROUP
-    assert R % T == 0, f"rows {R} not a multiple of the {T} row tile"
+    if R % T:
+        pad = T - R % T
+        bins = jnp.pad(bins, ((0, pad), (0, 0)), constant_values=n_bin)
+        gpair = jnp.pad(gpair, ((0, pad), (0, 0)))
+        pos = jnp.pad(pos, (0, pad), constant_values=-1)
+        R += pad
     n_fg = (F + FG - 1) // FG
     F_pad = n_fg * FG
 
     kernel = functools.partial(
-        _hist_kernel, node0=node0, n_nodes=n_nodes, n_bin=n_bin, feat_group=FG
+        _hist_kernel, node0=node0, n_nodes=n_nodes, n_bin=n_bin, feat_group=FG,
+        stride=stride,
     )
     out = pl.pallas_call(
         kernel,

@@ -34,7 +34,8 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _hist_chunk(bins_c, gpair_c, pos_c, node0: int, n_nodes: int, n_bin: int):
+def _hist_chunk(bins_c, gpair_c, pos_c, node0: int, n_nodes: int, n_bin: int,
+                stride: int = 1):
     """One row-chunk's contribution: (T,F) bins -> (N,F,B,C) partial histogram."""
     T, F = bins_c.shape
     C = gpair_c.shape[1]
@@ -42,7 +43,7 @@ def _hist_chunk(bins_c, gpair_c, pos_c, node0: int, n_nodes: int, n_bin: int):
         jnp.float32
     )  # (T, F, B); missing sentinel B compares false everywhere
     nodemask = (
-        pos_c[:, None] == (node0 + jnp.arange(n_nodes, dtype=pos_c.dtype))
+        pos_c[:, None] == (node0 + stride * jnp.arange(n_nodes, dtype=pos_c.dtype))
     ).astype(jnp.float32)  # (T, N)
     gm = (nodemask[:, :, None] * gpair_c[:, None, :]).reshape(T, n_nodes * C)
     out = jnp.dot(
@@ -51,26 +52,30 @@ def _hist_chunk(bins_c, gpair_c, pos_c, node0: int, n_nodes: int, n_bin: int):
     return out.reshape(F, n_bin, n_nodes, C).transpose(2, 0, 1, 3)
 
 
-@functools.partial(jax.jit, static_argnames=("node0", "n_nodes", "n_bin", "chunk"))
+@functools.partial(jax.jit,
+                   static_argnames=("node0", "n_nodes", "n_bin", "chunk", "stride"))
 def build_histogram(
-    bins, gpair, pos, *, node0: int, n_nodes: int, n_bin: int, chunk: int = 2048
+    bins, gpair, pos, *, node0: int, n_nodes: int, n_bin: int, chunk: int = 2048,
+    stride: int = 1
 ):
-    """hist (n_nodes, F, B, C) for the node batch [node0, node0+n_nodes).
+    """hist (n_nodes, F, B, C) for nodes node0 + stride*[0, n_nodes).
 
     bins  : (R_pad, F) int   — local bin indices, sentinel == n_bin for missing
     gpair : (R_pad, C) f32   — C=2 (grad, hess); padded rows must be zero
     pos   : (R_pad,) int32   — per-row node id (-1 for padded rows)
+    stride: 2 selects every other heap slot — the left-children of a level,
+            for the subtraction trick (right sibling = parent - left).
     """
     R, F = bins.shape
     C = gpair.shape[1]
     if R <= chunk:
-        return _hist_chunk(bins, gpair, pos, node0, n_nodes, n_bin)
+        return _hist_chunk(bins, gpair, pos, node0, n_nodes, n_bin, stride)
     n_chunks = R // chunk
     rem = R - n_chunks * chunk
 
     def body(acc, xs):
         b, g, p = xs
-        return acc + _hist_chunk(b, g, p, node0, n_nodes, n_bin), None
+        return acc + _hist_chunk(b, g, p, node0, n_nodes, n_bin, stride), None
 
     acc0 = jnp.zeros((n_nodes, F, n_bin, C), dtype=jnp.float32)
     xs = (
@@ -80,7 +85,8 @@ def build_histogram(
     )
     acc, _ = lax.scan(body, acc0, xs)
     if rem:
-        acc = acc + _hist_chunk(bins[-rem:], gpair[-rem:], pos[-rem:], node0, n_nodes, n_bin)
+        acc = acc + _hist_chunk(bins[-rem:], gpair[-rem:], pos[-rem:], node0,
+                                n_nodes, n_bin, stride)
     return acc
 
 

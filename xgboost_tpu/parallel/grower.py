@@ -72,22 +72,35 @@ class ShardedHistTreeGrower:
 
         self._level_fns = {}
         for d in range(self.max_depth + 1):
+            last = d == self.max_depth
+            subtract = d > 0 and not last
+            base = functools.partial(
+                level_step,
+                depth=d,
+                params=self.params,
+                last_level=last,
+                axis_name=ax,
+                hist_impl=self.hist_impl,
+                lossguide=self.lossguide,
+                has_cat=has_cat,
+                subtract=subtract,
+            )
+            row_specs = (sspec, P(ax, None), P(ax, None), P(), P(), P(), P(), P())
+            if last:
+                # hist neither consumed nor produced on the last level
+                def fn(state, bins, gpair, cuts, nb, fm, sm, cmm, _b=base):
+                    st, _ = _b(state, bins, gpair, cuts, nb, fm, sm, cmm)
+                    return st
+
+                in_specs, out_specs = row_specs, sspec
+            elif subtract:
+                # hist_prev is replicated (already psummed at its own level)
+                fn, in_specs, out_specs = base, row_specs + (P(),), (sspec, P())
+            else:
+                fn, in_specs, out_specs = base, row_specs, (sspec, P())
             self._level_fns[d] = jax.jit(
-                jax.shard_map(
-                    functools.partial(
-                        level_step,
-                        depth=d,
-                        params=self.params,
-                        last_level=(d == self.max_depth),
-                        axis_name=ax,
-                        hist_impl=self.hist_impl,
-                        lossguide=self.lossguide,
-                        has_cat=has_cat,
-                    ),
-                    mesh=self.mesh,
-                    in_specs=(sspec, P(ax, None), P(ax, None), P(), P(), P(), P(), P()),
-                    out_specs=sspec,
-                )
+                jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                              out_specs=out_specs)
             )
         self._built_for = (n_features, n_bin, has_cat)
 
@@ -99,10 +112,20 @@ class ShardedHistTreeGrower:
         setmat = jnp.asarray(make_set_matrix(self.interaction_sets, F))
         cm = jnp.asarray(cat_mask) if cat_mask is not None else jnp.zeros(F, bool)
         state = self._init_fn(gpair, valid)
+        hist_prev = None
         for d in range(self.max_depth + 1):
             fm = ones if feature_masks is None else feature_masks(d, 1 << d)
-            state = self._level_fns[d](state, bins, gpair, cuts_pad, n_bins, fm,
-                                       setmat, cm)
+            if d == self.max_depth:
+                state = self._level_fns[d](state, bins, gpair, cuts_pad, n_bins,
+                                           fm, setmat, cm)
+            elif d == 0:
+                state, hist_prev = self._level_fns[d](state, bins, gpair,
+                                                      cuts_pad, n_bins, fm,
+                                                      setmat, cm)
+            else:
+                state, hist_prev = self._level_fns[d](state, bins, gpair,
+                                                      cuts_pad, n_bins, fm,
+                                                      setmat, cm, hist_prev)
         return state
 
     @staticmethod

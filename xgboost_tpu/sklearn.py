@@ -241,12 +241,25 @@ class XGBModel:
         d = DMatrix(X, missing=self.missing, base_margin=base_margin)
         return self.get_booster().predict(
             d, output_margin=output_margin,
-            iteration_range=iteration_range or (0, 0),
+            iteration_range=self._iteration_range(iteration_range),
         )
+
+    def _iteration_range(self, iteration_range):
+        """Default to (0, best_iteration+1) after early stopping; upstream
+        treats both None and hi == 0 as "unspecified"
+        (reference: sklearn.py _get_iteration_range)."""
+        if iteration_range is not None and iteration_range[1] != 0:
+            return iteration_range
+        best = getattr(self._Booster, "best_iteration", None)
+        if best is not None:
+            return (0, int(best) + 1)
+        return (0, 0)
 
     def apply(self, X, iteration_range=None):
         d = DMatrix(X, missing=self.missing)
-        return self.get_booster().predict(d, pred_leaf=True)
+        return self.get_booster().predict(
+            d, pred_leaf=True,
+            iteration_range=self._iteration_range(iteration_range))
 
     def save_model(self, fname) -> None:
         self.get_booster().save_model(fname)

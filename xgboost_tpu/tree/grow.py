@@ -200,7 +200,7 @@ def _update_positions(bins, pos, best, can_split, node0: int, N: int, B: int,
 @functools.partial(
     jax.jit,
     static_argnames=("depth", "params", "last_level", "axis_name", "hist_impl",
-                     "lossguide", "has_cat"),
+                     "lossguide", "has_cat", "subtract"),
 )
 def level_step(
     state: TreeState,
@@ -211,6 +211,7 @@ def level_step(
     feature_mask,
     set_matrix,
     cat_mask,
+    hist_prev=None,
     *,
     depth: int,
     params: SplitParams,
@@ -219,12 +220,20 @@ def level_step(
     hist_impl: str = "xla",
     lossguide: bool = False,
     has_cat: bool = False,
+    subtract: bool = False,
 ):
     """Expand every alive node at ``depth``: hist -> best split -> apply.
 
     Mirrors one driver iteration of the reference
     (updater_gpu_hist.cu:626-646: PartitionAndBuildHist + ReduceHist +
     EvaluateSplits + ApplySplit), with the node batch = the whole level.
+
+    Returns ``(state, hist)`` — ``hist`` (N, F, B, C) feeds the next level's
+    subtraction trick (updater_gpu_hist.cu:309 SubtractHist): with
+    ``subtract=True`` and ``hist_prev`` = the parent level's histogram, only
+    left children (even level offsets) are built by matmul and each right
+    sibling is derived as ``parent - left`` — halving both the hist FLOPs and
+    (multi-chip) the psum payload.  ``hist`` is None on the last level.
     """
     node0 = (1 << depth) - 1
     N = 1 << depth
@@ -246,16 +255,29 @@ def level_step(
             ),
             base_weight=state.base_weight.at[idx].set(w),
             sum_hess=state.sum_hess.at[idx].set(totals_lvl[:, 1]),
-        )
+        ), None
 
     if hist_impl == "pallas":
-        from ..ops.hist_pallas import build_histogram_pallas
-
-        hist = build_histogram_pallas(bins, gpair, state.pos, node0=node0, n_nodes=N, n_bin=B)
+        from ..ops.hist_pallas import build_histogram_pallas as _build
     else:
-        hist = build_histogram(bins, gpair, state.pos, node0=node0, n_nodes=N, n_bin=B)
-    if axis_name is not None:
-        hist = lax.psum(hist, axis_name)  # the one distributed cost (SURVEY §3.1)
+        _build = build_histogram
+    if subtract:
+        half = N // 2
+        # left children sit at even offsets 2j (heap id node0 + 2j); parent j
+        # of the previous level maps to offsets (2j, 2j+1)
+        left = _build(bins, gpair, state.pos, node0=node0, n_nodes=half,
+                      n_bin=B, stride=2)
+        if axis_name is not None:
+            left = lax.psum(left, axis_name)
+        right = hist_prev - left
+        hist = jnp.stack([left, right], axis=1).reshape(N, *left.shape[1:])
+        # zero slots whose parent did not split (their "derived" hist would
+        # otherwise inherit the whole parent histogram)
+        hist = hist * alive_lvl[:, None, None, None]
+    else:
+        hist = _build(bins, gpair, state.pos, node0=node0, n_nodes=N, n_bin=B)
+        if axis_name is not None:
+            hist = lax.psum(hist, axis_name)  # the distributed cost (SURVEY §3.1)
 
     # interaction constraints: allowed feature set per node = union of the
     # constraint sets still compatible with the node's path
@@ -293,7 +315,7 @@ def level_step(
     st = st._replace(
         pos=_update_positions(bins, st.pos, best, can_split, node0, N, B, has_cat)
     )
-    return st
+    return st, hist
 
 
 @jax.jit
@@ -336,6 +358,7 @@ class HistTreeGrower:
         interaction_sets=None,
         max_leaves: int = 0,
         lossguide: bool = False,
+        subtract: bool = True,
     ) -> None:
         self.max_depth = max_depth
         self.params = params
@@ -344,6 +367,7 @@ class HistTreeGrower:
         self.interaction_sets = interaction_sets
         self.max_leaves = max_leaves
         self.lossguide = lossguide
+        self.subtract = subtract
         self.max_nodes = max_nodes_for_depth(max_depth)
 
     def _set_matrix(self, n_features: int):
@@ -366,9 +390,10 @@ class HistTreeGrower:
             max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
             n_bin=B,
         )
+        hist_prev = None
         for d in range(self.max_depth + 1):
             fm = ones if feature_masks is None else feature_masks(d, 1 << d)
-            state = level_step(
+            state, hist_prev = level_step(
                 state,
                 bins,
                 gpair,
@@ -377,6 +402,7 @@ class HistTreeGrower:
                 fm,
                 setmat,
                 cm,
+                hist_prev,
                 depth=d,
                 params=self.params,
                 last_level=(d == self.max_depth),
@@ -384,6 +410,7 @@ class HistTreeGrower:
                 hist_impl=self.hist_impl,
                 lossguide=self.lossguide,
                 has_cat=has_cat,
+                subtract=(self.subtract and d > 0 and hist_prev is not None),
             )
         return state
 

@@ -35,19 +35,21 @@ _EPS = 1e-6
 @functools.partial(
     jax.jit,
     static_argnames=("node0_prev", "n_prev", "node0", "n_nodes", "n_bin",
-                     "has_prev", "has_cat", "build"),
+                     "has_prev", "has_cat", "build", "stride"),
 )
 def _page_step(page_bins, gpair_seg, pos_seg, prev_best, prev_can, *,
                node0_prev: int, n_prev: int, node0: int, n_nodes: int,
-               n_bin: int, has_prev: bool, has_cat: bool, build: bool = True):
+               n_bin: int, has_prev: bool, has_cat: bool, build: bool = True,
+               stride: int = 1):
     """Route one page with the previous level's splits, then accumulate the
-    current level's histogram over it."""
+    current level's histogram over it (stride=2: left children only, for the
+    subtraction trick)."""
     if has_prev:
         pos_seg = _update_positions(page_bins, pos_seg, prev_best, prev_can,
                                     node0_prev, n_prev, n_bin, has_cat)
     if build:
         hist = build_histogram(page_bins, gpair_seg, pos_seg, node0=node0,
-                               n_nodes=n_nodes, n_bin=n_bin)
+                               n_nodes=n_nodes, n_bin=n_bin, stride=stride)
     else:
         hist = jnp.zeros((n_nodes, 1, 1, 2), jnp.float32)
     return pos_seg, hist
@@ -131,11 +133,14 @@ class StreamingHistTreeGrower:
             n_bin=B,
         )
         prev_best, prev_can, prev_d = None, None, -1
+        hist_prev = None
         n_pages = len(pages)
         for d in range(self.max_depth + 1):
             build = d < self.max_depth  # last level only finalizes leaves
+            subtract = build and d > 0 and hist_prev is not None
             node0 = (1 << d) - 1
             N = 1 << d
+            n_build = (N // 2) if subtract else N
             hist_acc = None
             # prefetch pipeline: page i+1 ships while page i computes
             next_dev = jax.device_put(np.ascontiguousarray(pages[0])) if n_pages else None
@@ -151,9 +156,9 @@ class StreamingHistTreeGrower:
                 pos_seg, h = _page_step(
                     dev, gp_seg, pos_seg, prev_best, prev_can,
                     node0_prev=(1 << prev_d) - 1 if prev_d >= 0 else 0,
-                    n_prev=1 << max(prev_d, 0), node0=node0, n_nodes=N,
+                    n_prev=1 << max(prev_d, 0), node0=node0, n_nodes=n_build,
                     n_bin=B, has_prev=prev_best is not None, has_cat=has_cat,
-                    build=build,
+                    build=build, stride=2 if subtract else 1,
                 )
                 pos = lax.dynamic_update_slice_in_dim(pos, pos_seg, lo, axis=0)
                 if build:
@@ -162,6 +167,15 @@ class StreamingHistTreeGrower:
             fm = ones if feature_masks is None else feature_masks(d, N)
             if hist_acc is None:  # last level: dummy hist, leaves only
                 hist_acc = jnp.zeros((N, F, B, 2), jnp.float32)
+            elif subtract:
+                # SubtractHist: right sibling = parent - left (grow.level_step)
+                right = hist_prev - hist_acc
+                hist_acc = jnp.stack([hist_acc, right], axis=1).reshape(
+                    N, *hist_acc.shape[1:])
+                alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, N)
+                hist_acc = hist_acc * alive_lvl[:, None, None, None]
+            if build:
+                hist_prev = hist_acc
             state, best, can = _decide_level(
                 state, hist_acc, n_bins, cuts_pad, fm, setmat, cm,
                 depth=d, params=self.params, lossguide=self.lossguide,
