@@ -1,0 +1,64 @@
+#!/bin/bash
+# Build the reference dmlc/xgboost as a CPU-only oracle for parity testing.
+#
+# The reference repo ships an empty dmlc-core submodule and this environment
+# has no network, so the build uses the from-scratch dmlc API shim in
+# oracle/dmlc_shim/ (see its headers for the covered surface).
+#
+# Outputs (all outside the reference tree, which stays untouched):
+#   /tmp/xgb_oracle_build/lib/libxgboost.so   — the oracle C library
+#   /tmp/xgb_oracle/xgboost/                  — shadow python package
+#     (per-file symlinks into /root/reference/python-package/xgboost plus a
+#      real lib/ dir holding the .so, which libpath.py picks up first)
+#
+# Usage:  bash oracle/build_oracle.sh   (idempotent; ~40 min cold on 1 core)
+#         then: PYTHONPATH=/tmp/xgb_oracle python -c "import xgboost"
+set -euo pipefail
+
+REF=/root/reference
+SHIM=$(cd "$(dirname "$0")/dmlc_shim" && pwd)
+BUILD=/tmp/xgb_oracle_build
+PKG=/tmp/xgb_oracle
+
+mkdir -p "$BUILD"
+cd "$BUILD"
+if [ ! -f build.ninja ]; then
+  cmake "$REF" -GNinja \
+    -DCMAKE_BUILD_TYPE=Release \
+    -DUSE_CUDA=OFF -DUSE_NCCL=OFF -DUSE_OPENMP=ON \
+    -DBUILD_WITH_SYSTEM_DMLC=ON "-Ddmlc_DIR=$SHIM/cmake"
+fi
+ninja
+
+# the reference CMake pins its library output inside the source tree; move
+# the artifact out and leave the reference pristine
+if [ -d "$REF/lib" ]; then
+  mkdir -p "$BUILD/lib"
+  for f in "$REF"/lib/libxgboost.so.*; do
+    [ -f "$f" ] && [ ! -L "$f" ] && mv "$f" "$BUILD/lib/"
+  done
+  rm -rf "$REF/lib"
+  ln -sf "$(ls "$BUILD"/lib/libxgboost.so.* | head -1)" "$BUILD/lib/libxgboost.so"
+fi
+
+# shadow python package: symlink every package file, add a real lib/ with
+# the shared library where libpath.py looks first
+rm -rf "$PKG"
+mkdir -p "$PKG/xgboost/lib"
+for f in "$REF"/python-package/xgboost/* ; do
+  ln -s "$f" "$PKG/xgboost/$(basename "$f")"
+done
+ln -s "$BUILD/lib/libxgboost.so" "$PKG/xgboost/lib/libxgboost.so"
+
+PYTHONPATH="$PKG" python - <<'EOF'
+import xgboost, numpy as np
+print("oracle xgboost", xgboost.__version__, "at", xgboost.__file__)
+X = np.random.default_rng(0).normal(size=(100, 4))
+y = (X[:, 0] > 0).astype(float)
+bst = xgboost.train({"objective": "binary:logistic", "max_depth": 3,
+                     "verbosity": 0}, xgboost.DMatrix(X, label=y), 5)
+p = bst.predict(xgboost.DMatrix(X))
+assert p.shape == (100,) and np.isfinite(p).all()
+print("oracle smoke train/predict OK")
+EOF
+echo "oracle ready: PYTHONPATH=$PKG"

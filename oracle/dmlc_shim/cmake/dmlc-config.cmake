@@ -1,0 +1,18 @@
+# find_package(dmlc) config for the shim: builds the shim sources into a
+# static lib and exposes it as target `dmlc` (the reference's CMakeLists
+# links `dmlc` directly when BUILD_WITH_SYSTEM_DMLC=ON).
+if(TARGET dmlc)
+  return()
+endif()
+
+get_filename_component(_dmlc_shim_root "${CMAKE_CURRENT_LIST_DIR}/.." ABSOLUTE)
+
+add_library(dmlc STATIC "${_dmlc_shim_root}/src/shim.cc")
+target_include_directories(dmlc PUBLIC "${_dmlc_shim_root}/include")
+target_compile_features(dmlc PUBLIC cxx_std_14)
+set_property(TARGET dmlc PROPERTY POSITION_INDEPENDENT_CODE ON)
+
+set(dmlc_FOUND TRUE)
+set(dmlc-LIBRARIES dmlc)
+set(dmlc_LIBRARIES dmlc)
+set(dmlc_INCLUDE_DIRS "${_dmlc_shim_root}/include")

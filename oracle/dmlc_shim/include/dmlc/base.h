@@ -1,0 +1,78 @@
+/* Minimal from-scratch reimplementation of the dmlc-core public API surface
+ * that the reference xgboost sources compile against.  Written for the
+ * oracle build only (the reference repo ships an empty dmlc-core submodule
+ * and this environment has no network access).  Covers exactly the symbols
+ * the reference uses — see oracle/README.md for the inventory.
+ */
+#ifndef DMLC_BASE_H_
+#define DMLC_BASE_H_
+
+#include <cassert>  // transitively expected by reference headers via dmlc
+#include <chrono>   // (ditto)
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#ifndef DMLC_USE_CXX11
+#define DMLC_USE_CXX11 1
+#endif
+
+#if defined(__GNUC__) || defined(__clang__)
+#define DMLC_ATTRIBUTE_UNUSED __attribute__((unused))
+#else
+#define DMLC_ATTRIBUTE_UNUSED
+#endif
+
+#ifndef DMLC_CXX11_THREAD_LOCAL
+#define DMLC_CXX11_THREAD_LOCAL 1
+#endif
+
+#ifndef DMLC_LOG_FATAL_THROW
+#define DMLC_LOG_FATAL_THROW 1
+#endif
+
+#define DMLC_STRINGIZE_DETAIL(x) #x
+#define DMLC_STRINGIZE(x) DMLC_STRINGIZE_DETAIL(x)
+
+/* Type-trait declaration used by parameter/serializer machinery. */
+#define DMLC_DECLARE_TRAITS(Trait, Type, Value)            \
+  template <>                                              \
+  struct Trait<Type> {                                     \
+    static const bool value = Value;                       \
+  }
+
+#include <type_traits>
+
+namespace dmlc {
+
+using index_t = unsigned;
+using real_t = float;
+
+/*! \brief POD trait, specializable via DMLC_DECLARE_TRAITS */
+template <typename T>
+struct is_pod {
+  static const bool value =
+      std::is_trivial<T>::value && std::is_standard_layout<T>::value;
+};
+
+/*! \brief safe data-pointer access for possibly-empty containers */
+template <typename T>
+inline T* BeginPtr(std::vector<T>& vec) {  // NOLINT
+  return vec.empty() ? nullptr : &vec[0];
+}
+template <typename T>
+inline const T* BeginPtr(const std::vector<T>& vec) {
+  return vec.empty() ? nullptr : &vec[0];
+}
+inline char* BeginPtr(std::string& str) {  // NOLINT
+  return str.empty() ? nullptr : &str[0];
+}
+inline const char* BeginPtr(const std::string& str) {
+  return str.empty() ? nullptr : &str[0];
+}
+
+}  // namespace dmlc
+
+#endif  // DMLC_BASE_H_

@@ -1,0 +1,59 @@
+/* Common utilities (dmlc shim for the oracle build): OMPException collects
+ * exceptions thrown inside OpenMP regions and rethrows them on the host
+ * thread, plus a string splitter.
+ */
+#ifndef DMLC_COMMON_H_
+#define DMLC_COMMON_H_
+
+#include <exception>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "./logging.h"
+
+namespace dmlc {
+
+inline std::vector<std::string> Split(const std::string& s, char delim) {
+  std::vector<std::string> ret;
+  std::string item;
+  std::istringstream is(s);
+  while (std::getline(is, item, delim)) {
+    ret.push_back(item);
+  }
+  return ret;
+}
+
+/*! \brief exception trampoline across OpenMP parallel regions */
+class OMPException {
+ public:
+  template <typename Function, typename... Parameters>
+  void Run(Function f, Parameters... params) {
+    try {
+      f(params...);
+    } catch (std::exception&) {  // covers dmlc::Error (: runtime_error)
+      std::lock_guard<std::mutex> lock(mutex_);
+      if (!caught_) {
+        caught_ = std::current_exception();
+      }
+    }
+  }
+
+  void Rethrow() {
+    if (caught_) {
+      std::exception_ptr ex = caught_;
+      caught_ = nullptr;
+      std::rethrow_exception(ex);
+    }
+  }
+
+ private:
+  std::exception_ptr caught_{nullptr};
+  std::mutex mutex_;
+};
+
+}  // namespace dmlc
+
+#endif  // DMLC_COMMON_H_

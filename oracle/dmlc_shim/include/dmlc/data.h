@@ -1,0 +1,55 @@
+/* Data iteration / text parsing (dmlc shim for the oracle build).
+ * Provides DataIter, RowBlock, and a Parser with a functional LIBSVM text
+ * parser behind Parser<uint32_t>::Create (format "auto"/"libsvm").
+ */
+#ifndef DMLC_DATA_H_
+#define DMLC_DATA_H_
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "./base.h"
+#include "./logging.h"
+
+namespace dmlc {
+
+/*! \brief pull-style data iterator */
+template <typename DType>
+class DataIter {
+ public:
+  virtual ~DataIter() = default;
+  virtual void BeforeFirst() = 0;
+  virtual bool Next() = 0;
+  virtual const DType& Value() const = 0;
+};
+
+/*! \brief one CSR batch of parsed rows */
+template <typename IndexType, typename DType = real_t>
+struct RowBlock {
+  size_t size{0};
+  const size_t* offset{nullptr};
+  const DType* label{nullptr};
+  const DType* weight{nullptr};
+  const uint64_t* qid{nullptr};
+  const IndexType* field{nullptr};
+  const IndexType* index{nullptr};
+  const DType* value{nullptr};
+};
+
+/*! \brief text data parser; Create opens a local libsvm file */
+template <typename IndexType, typename DType = real_t>
+class Parser : public DataIter<RowBlock<IndexType, DType>> {
+ public:
+  ~Parser() override = default;
+  /*! \brief bytes consumed so far (progress reporting) */
+  virtual size_t BytesRead() const = 0;
+  static Parser<IndexType, DType>* Create(const char* uri, unsigned part_index,
+                                          unsigned num_parts,
+                                          const char* type);
+};
+
+}  // namespace dmlc
+
+#endif  // DMLC_DATA_H_

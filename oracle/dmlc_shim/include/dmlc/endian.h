@@ -1,0 +1,44 @@
+/* Endianness helpers (dmlc shim for the oracle build). */
+#ifndef DMLC_ENDIAN_H_
+#define DMLC_ENDIAN_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#include "./base.h"
+
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
+#define DMLC_LITTLE_ENDIAN 1
+#else
+#define DMLC_LITTLE_ENDIAN 0
+#endif
+
+/*! \brief whether serialization can skip endian swap (little-endian host) */
+#define DMLC_IO_NO_ENDIAN_SWAP DMLC_LITTLE_ENDIAN
+
+namespace dmlc {
+
+/*! \brief in-place byte swap of n elements of size elem_bytes */
+inline void ByteSwap(void* data, size_t elem_bytes, size_t num_elems) {
+  auto* d = static_cast<unsigned char*>(data);
+  for (size_t i = 0; i < num_elems; ++i) {
+    for (size_t j = 0; j < elem_bytes / 2; ++j) {
+      unsigned char t = d[i * elem_bytes + j];
+      d[i * elem_bytes + j] = d[i * elem_bytes + elem_bytes - 1 - j];
+      d[i * elem_bytes + elem_bytes - 1 - j] = t;
+    }
+  }
+}
+
+/*! \brief value byte swap */
+template <typename T>
+inline T ByteSwap(T v) {
+  T ret = v;
+  ByteSwap(&ret, sizeof(T), 1);
+  return ret;
+}
+
+}  // namespace dmlc
+
+#endif  // DMLC_ENDIAN_H_

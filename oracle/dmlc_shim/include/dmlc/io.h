@@ -1,0 +1,272 @@
+/* Stream abstraction (dmlc shim for the oracle build): binary Stream with
+ * templated Read/Write of PODs / strings / vectors, SeekStream, local-file
+ * Stream::Create, std::istream/ostream adapters, and io::URI parsing.
+ */
+#ifndef DMLC_IO_H_
+#define DMLC_IO_H_
+
+#include <cstdint>
+#include <cstring>
+#include <istream>
+#include <map>
+#include <ostream>
+#include <streambuf>
+#include <string>
+#include <type_traits>
+#include <utility>
+#include <vector>
+
+#include "./base.h"
+#include "./logging.h"
+
+namespace dmlc {
+
+class Stream {
+ public:
+  virtual ~Stream() = default;
+  /*! \brief read up to size bytes, returns bytes actually read */
+  virtual size_t Read(void* ptr, size_t size) = 0;
+  /*! \brief write size bytes, returns bytes written */
+  virtual size_t Write(const void* ptr, size_t size) = 0;
+
+  /*! \brief open a stream for a local path ("r"/"w"/"a"; binary always) */
+  static Stream* Create(const char* uri, const char* flag,
+                        bool allow_null = false);
+
+  // ---- typed helpers (serializer) ----
+  template <typename T>
+  inline void Write(const T& data);
+  template <typename T>
+  inline bool Read(T* out_data);
+
+  /*! \brief write raw little-endian array */
+  template <typename T>
+  inline void WriteArray(const T* data, size_t num) {
+    Write(static_cast<const void*>(data), sizeof(T) * num);
+  }
+  template <typename T>
+  inline bool ReadArray(T* data, size_t num) {
+    return Read(static_cast<void*>(data), sizeof(T) * num) ==
+           sizeof(T) * num;
+  }
+};
+
+/*! \brief seekable stream */
+class SeekStream : public Stream {
+ public:
+  ~SeekStream() override = default;
+  virtual void Seek(size_t pos) = 0;
+  virtual size_t Tell() = 0;
+  static SeekStream* CreateForRead(const char* uri, bool allow_null = false);
+};
+
+/*! \brief interface of objects that can serialize themselves */
+class Serializable {
+ public:
+  virtual ~Serializable() = default;
+  virtual void Save(Stream* fo) const = 0;
+  virtual void Load(Stream* fi) = 0;
+};
+
+namespace serializer {
+
+template <typename T, typename Enable = void>
+struct Handler;
+
+/* PODs: raw bytes */
+template <typename T>
+struct Handler<T, std::enable_if_t<std::is_trivially_copyable<T>::value &&
+                                   !std::is_pointer<T>::value>> {
+  static void Write(Stream* strm, const T& data) {
+    strm->Write(&data, sizeof(T));
+  }
+  static bool Read(Stream* strm, T* data) {
+    return strm->Read(data, sizeof(T)) == sizeof(T);
+  }
+};
+
+template <>
+struct Handler<std::string, void> {
+  static void Write(Stream* strm, const std::string& data) {
+    uint64_t sz = data.length();
+    strm->Write(&sz, sizeof(sz));
+    if (sz) strm->Write(data.data(), sz);
+  }
+  static bool Read(Stream* strm, std::string* data) {
+    uint64_t sz;
+    if (strm->Read(&sz, sizeof(sz)) != sizeof(sz)) return false;
+    data->resize(sz);
+    return sz == 0 || strm->Read(&(*data)[0], sz) == sz;
+  }
+};
+
+template <typename T>
+struct Handler<std::vector<T>,
+               std::enable_if_t<std::is_trivially_copyable<T>::value>> {
+  static void Write(Stream* strm, const std::vector<T>& data) {
+    uint64_t sz = data.size();
+    strm->Write(&sz, sizeof(sz));
+    if (sz) strm->Write(data.data(), sz * sizeof(T));
+  }
+  static bool Read(Stream* strm, std::vector<T>* data) {
+    uint64_t sz;
+    if (strm->Read(&sz, sizeof(sz)) != sizeof(sz)) return false;
+    data->resize(sz);
+    return sz == 0 ||
+           strm->Read(data->data(), sz * sizeof(T)) == sz * sizeof(T);
+  }
+};
+
+template <typename T>
+struct Handler<std::vector<T>,
+               std::enable_if_t<!std::is_trivially_copyable<T>::value>> {
+  static void Write(Stream* strm, const std::vector<T>& data) {
+    uint64_t sz = data.size();
+    strm->Write(&sz, sizeof(sz));
+    for (const auto& v : data) Handler<T>::Write(strm, v);
+  }
+  static bool Read(Stream* strm, std::vector<T>* data) {
+    uint64_t sz;
+    if (strm->Read(&sz, sizeof(sz)) != sizeof(sz)) return false;
+    data->resize(sz);
+    for (auto& v : *data) {
+      if (!Handler<T>::Read(strm, &v)) return false;
+    }
+    return true;
+  }
+};
+
+template <typename K, typename V>
+struct Handler<std::pair<K, V>, void> {
+  static void Write(Stream* strm, const std::pair<K, V>& data) {
+    Handler<K>::Write(strm, data.first);
+    Handler<V>::Write(strm, data.second);
+  }
+  static bool Read(Stream* strm, std::pair<K, V>* data) {
+    return Handler<K>::Read(strm, &data->first) &&
+           Handler<V>::Read(strm, &data->second);
+  }
+};
+
+template <typename K, typename V>
+struct Handler<std::map<K, V>, void> {
+  static void Write(Stream* strm, const std::map<K, V>& data) {
+    uint64_t sz = data.size();
+    strm->Write(&sz, sizeof(sz));
+    for (const auto& kv : data) {
+      Handler<K>::Write(strm, kv.first);
+      Handler<V>::Write(strm, kv.second);
+    }
+  }
+  static bool Read(Stream* strm, std::map<K, V>* data) {
+    uint64_t sz;
+    if (strm->Read(&sz, sizeof(sz)) != sizeof(sz)) return false;
+    data->clear();
+    for (uint64_t i = 0; i < sz; ++i) {
+      std::pair<K, V> kv;
+      if (!Handler<K>::Read(strm, &kv.first)) return false;
+      if (!Handler<V>::Read(strm, &kv.second)) return false;
+      data->emplace(std::move(kv));
+    }
+    return true;
+  }
+};
+
+}  // namespace serializer
+
+template <typename T>
+inline void Stream::Write(const T& data) {
+  serializer::Handler<T>::Write(this, data);
+}
+template <typename T>
+inline bool Stream::Read(T* out_data) {
+  return serializer::Handler<T>::Read(this, out_data);
+}
+
+// ---- std::iostream adapters over Stream ----
+namespace io {
+
+/*! \brief minimal URI parse: [protocol://][host]/path */
+struct URI {
+  std::string protocol;
+  std::string host;
+  std::string name;
+  URI() = default;
+  explicit URI(const char* uri) {
+    const char* p = std::strstr(uri, "://");
+    if (p == nullptr) {
+      name = uri;
+    } else {
+      protocol = std::string(uri, p - uri + 3);
+      const char* h = p + 3;
+      const char* path = std::strchr(h, '/');
+      if (path == nullptr) {
+        host = h;
+      } else {
+        host = std::string(h, path - h);
+        name = path;
+      }
+    }
+  }
+  std::string str() const { return protocol + host + name; }
+};
+
+class StreamBufAdapter : public std::streambuf {
+ public:
+  explicit StreamBufAdapter(Stream* stream) : stream_(stream) {}
+
+ protected:
+  int_type underflow() override {
+    size_t n = stream_->Read(buffer_, sizeof(buffer_));
+    if (n == 0) return traits_type::eof();
+    setg(buffer_, buffer_, buffer_ + n);
+    return traits_type::to_int_type(*gptr());
+  }
+  int_type overflow(int_type c) override {
+    if (c != traits_type::eof()) {
+      char ch = traits_type::to_char_type(c);
+      stream_->Write(&ch, 1);
+    }
+    return c;
+  }
+  std::streamsize xsputn(const char* s, std::streamsize n) override {
+    stream_->Write(s, n);
+    return n;
+  }
+
+ private:
+  Stream* stream_;
+  char buffer_[4096];
+};
+
+}  // namespace io
+
+/*! \brief std::istream reading from a dmlc::Stream */
+class istream : public std::basic_istream<char> {  // NOLINT
+ public:
+  explicit istream(Stream* stream, size_t buf_size = 4096)
+      : std::basic_istream<char>(nullptr), buf_(stream) {
+    (void)buf_size;
+    this->rdbuf(&buf_);
+  }
+
+ private:
+  io::StreamBufAdapter buf_;
+};
+
+/*! \brief std::ostream writing to a dmlc::Stream */
+class ostream : public std::basic_ostream<char> {  // NOLINT
+ public:
+  explicit ostream(Stream* stream, size_t buf_size = 4096)
+      : std::basic_ostream<char>(nullptr), buf_(stream) {
+    (void)buf_size;
+    this->rdbuf(&buf_);
+  }
+
+ private:
+  io::StreamBufAdapter buf_;
+};
+
+}  // namespace dmlc
+
+#endif  // DMLC_IO_H_

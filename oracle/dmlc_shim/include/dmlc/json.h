@@ -1,0 +1,105 @@
+/* Minimal JSON reader (dmlc shim for the oracle build).  The reference uses
+ * dmlc::JSONReader once, to parse graphviz kwargs of shape
+ * map<string, map<string, string>> (tree_model.cc GraphvizGenerator).
+ * Values may be strings, numbers, or booleans; all are surfaced as strings.
+ */
+#ifndef DMLC_JSON_H_
+#define DMLC_JSON_H_
+
+#include <cctype>
+#include <istream>
+#include <map>
+#include <sstream>
+#include <string>
+
+#include "./logging.h"
+
+namespace dmlc {
+
+class JSONReader {
+ public:
+  explicit JSONReader(std::istream* is) : is_(is) {}
+
+  template <typename T>
+  void Read(T* out) {
+    ReadValue(out);
+  }
+
+ private:
+  std::istream* is_;
+
+  int PeekNonSpace() {
+    int c = is_->peek();
+    while (c != EOF && std::isspace(c)) {
+      is_->get();
+      c = is_->peek();
+    }
+    return c;
+  }
+  void Expect(char want) {
+    int c = PeekNonSpace();
+    if (c != want) {
+      throw Error(std::string("JSON parse error: expected '") + want + "'");
+    }
+    is_->get();
+  }
+
+  void ReadValue(std::string* out) {
+    int c = PeekNonSpace();
+    if (c == '"') {
+      is_->get();
+      std::ostringstream os;
+      while ((c = is_->get()) != EOF && c != '"') {
+        if (c == '\\') {
+          int e = is_->get();
+          switch (e) {
+            case 'n': os << '\n'; break;
+            case 't': os << '\t'; break;
+            case '"': os << '"'; break;
+            case '\\': os << '\\'; break;
+            default: os << static_cast<char>(e);
+          }
+        } else {
+          os << static_cast<char>(c);
+        }
+      }
+      *out = os.str();
+    } else {  // bare token: number / true / false / null
+      std::ostringstream os;
+      while ((c = is_->peek()) != EOF && c != ',' && c != '}' && c != ']' &&
+             !std::isspace(c)) {
+        os << static_cast<char>(is_->get());
+      }
+      *out = os.str();
+    }
+  }
+
+  template <typename V>
+  void ReadValue(std::map<std::string, V>* out) {
+    out->clear();
+    Expect('{');
+    if (PeekNonSpace() == '}') {
+      is_->get();
+      return;
+    }
+    while (true) {
+      std::string key;
+      ReadValue(&key);
+      Expect(':');
+      V val;
+      ReadValue(&val);
+      (*out)[key] = val;
+      int c = PeekNonSpace();
+      if (c == ',') {
+        is_->get();
+        continue;
+      }
+      Expect('}');
+      break;
+    }
+  }
+};
+
+}  // namespace dmlc
+
+#endif  // DMLC_JSON_H_

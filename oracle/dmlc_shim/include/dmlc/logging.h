@@ -1,0 +1,184 @@
+/* glog-style logging/check macros for the oracle build (dmlc shim).
+ * LogMessageFatal throws dmlc::Error so the reference's C API boundary
+ * (XGB_API_BEGIN/END catching dmlc::Error) works unchanged.
+ */
+#ifndef DMLC_LOGGING_H_
+#define DMLC_LOGGING_H_
+
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <iostream>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+
+#include "./base.h"
+
+namespace dmlc {
+
+/*! \brief exception thrown by LOG(FATAL) / failed CHECKs */
+struct Error : public std::runtime_error {
+  explicit Error(const std::string& s) : std::runtime_error(s) {}
+};
+
+class DateLogger {
+ public:
+  const char* HumanDate() {
+    std::time_t t = std::time(nullptr);
+    std::tm now{};
+#if defined(_WIN32)
+    localtime_s(&now, &t);
+#else
+    localtime_r(&t, &now);
+#endif
+    std::snprintf(buffer_, sizeof(buffer_), "%02d:%02d:%02d", now.tm_hour,
+                  now.tm_min, now.tm_sec);
+    return buffer_;
+  }
+
+ private:
+  char buffer_[16];
+};
+
+class LogMessage {
+ public:
+  LogMessage(const char* file, int line) {
+    log_stream_ << "[" << DateLogger().HumanDate() << "] " << file << ":"
+                << line << ": ";
+  }
+  ~LogMessage() { std::cerr << log_stream_.str() << std::endl; }
+  std::ostream& stream() { return log_stream_; }
+
+ protected:
+  std::ostringstream log_stream_;
+
+ private:
+  LogMessage(const LogMessage&) = delete;
+  void operator=(const LogMessage&) = delete;
+};
+
+/*! \brief fatal message: collects the stream and throws dmlc::Error */
+class LogMessageFatal {
+ public:
+  LogMessageFatal(const char* file, int line) {
+    log_stream_ << file << ":" << line << ": ";
+  }
+  std::ostream& stream() { return log_stream_; }
+  ~LogMessageFatal() noexcept(false) {
+#if DMLC_LOG_FATAL_THROW
+    throw Error(log_stream_.str());
+#else
+    std::cerr << log_stream_.str() << std::endl;
+    std::abort();
+#endif
+  }
+
+ private:
+  std::ostringstream log_stream_;
+  LogMessageFatal(const LogMessageFatal&) = delete;
+  void operator=(const LogMessageFatal&) = delete;
+};
+
+/*! \brief customized logging target (the reference redirects this to its
+ *  ConsoleLogger in src/logging.cc via DMLC_LOG_CUSTOMIZE) */
+class CustomLogMessage {
+ public:
+  CustomLogMessage(const char* file, int line) {
+    log_stream_ << "[" << DateLogger().HumanDate() << "] " << file << ":"
+                << line << ": ";
+  }
+  ~CustomLogMessage() { Log(log_stream_.str()); }
+  std::ostream& stream() { return log_stream_; }
+  /*! \brief implemented by the client (src/logging.cc in the reference) */
+  static void Log(const std::string& msg);
+
+ protected:
+  std::ostringstream log_stream_;
+};
+
+#if defined(DMLC_LOG_CUSTOMIZE) && DMLC_LOG_CUSTOMIZE
+using LogMessageInfo = CustomLogMessage;
+#else
+using LogMessageInfo = LogMessage;
+#endif
+
+/*! \brief helper so `CHECK(x) << ...` has a sink when the check passes */
+class LogMessageVoidify {
+ public:
+  void operator&(std::ostream&) {}
+};
+
+}  // namespace dmlc
+
+#if defined(__GNUC__) || defined(__clang__)
+#define DMLC_EXPECT_TRUE(x) __builtin_expect(!!(x), 1)
+#define DMLC_EXPECT_FALSE(x) __builtin_expect(!!(x), 0)
+#else
+#define DMLC_EXPECT_TRUE(x) (x)
+#define DMLC_EXPECT_FALSE(x) (x)
+#endif
+
+#define CHECK(x)                                            \
+  if (DMLC_EXPECT_FALSE(!(x)))                              \
+  ::dmlc::LogMessageFatal(__FILE__, __LINE__).stream()      \
+      << "Check failed: " #x << ": "
+
+#define CHECK_BINARY_OP(op, x, y)                           \
+  if (DMLC_EXPECT_FALSE(!((x)op(y))))                       \
+  ::dmlc::LogMessageFatal(__FILE__, __LINE__).stream()      \
+      << "Check failed: " #x " " #op " " #y << ": "
+
+#define CHECK_LT(x, y) CHECK_BINARY_OP(<, x, y)
+#define CHECK_GT(x, y) CHECK_BINARY_OP(>, x, y)
+#define CHECK_LE(x, y) CHECK_BINARY_OP(<=, x, y)
+#define CHECK_GE(x, y) CHECK_BINARY_OP(>=, x, y)
+#define CHECK_EQ(x, y) CHECK_BINARY_OP(==, x, y)
+#define CHECK_NE(x, y) CHECK_BINARY_OP(!=, x, y)
+#define CHECK_NOTNULL(x)                                                     \
+  ((x) == nullptr                                                            \
+   ? (::dmlc::LogMessageFatal(__FILE__, __LINE__).stream()                   \
+          << "Check notnull: " #x << ": ",                                   \
+      (x))                                                                   \
+   : (x))
+
+#if defined(NDEBUG)
+#define DCHECK(x) \
+  while (false) CHECK(x)
+#define DCHECK_LT(x, y) \
+  while (false) CHECK_LT(x, y)
+#define DCHECK_GT(x, y) \
+  while (false) CHECK_GT(x, y)
+#define DCHECK_LE(x, y) \
+  while (false) CHECK_LE(x, y)
+#define DCHECK_GE(x, y) \
+  while (false) CHECK_GE(x, y)
+#define DCHECK_EQ(x, y) \
+  while (false) CHECK_EQ(x, y)
+#define DCHECK_NE(x, y) \
+  while (false) CHECK_NE(x, y)
+#else
+#define DCHECK(x) CHECK(x)
+#define DCHECK_LT(x, y) CHECK_LT(x, y)
+#define DCHECK_GT(x, y) CHECK_GT(x, y)
+#define DCHECK_LE(x, y) CHECK_LE(x, y)
+#define DCHECK_GE(x, y) CHECK_GE(x, y)
+#define DCHECK_EQ(x, y) CHECK_EQ(x, y)
+#define DCHECK_NE(x, y) CHECK_NE(x, y)
+#endif
+
+#define LOG_FATAL ::dmlc::LogMessageFatal(__FILE__, __LINE__)
+#define LOG_ERROR ::dmlc::LogMessage(__FILE__, __LINE__)
+#define LOG_WARNING ::dmlc::LogMessage(__FILE__, __LINE__)
+#define LOG_INFO ::dmlc::LogMessageInfo(__FILE__, __LINE__)
+#define LOG_DEBUG LOG_INFO
+
+#ifndef LOG
+#define LOG(severity) LOG_##severity.stream()
+#endif
+
+#define LOG_IF(severity, condition) \
+  !(condition) ? (void)0 : ::dmlc::LogMessageVoidify() & LOG(severity)
+
+#endif  // DMLC_LOGGING_H_

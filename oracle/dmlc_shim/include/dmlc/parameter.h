@@ -1,0 +1,443 @@
+/* Parameter reflection DSL (dmlc shim for the oracle build).
+ *
+ * Provides the dmlc::Parameter<T> CRTP base plus the DMLC_DECLARE_PARAMETER /
+ * DMLC_DECLARE_FIELD / DMLC_DECLARE_ALIAS / DMLC_REGISTER_PARAMETER macros,
+ * with the exact protected FieldEntry surface the reference's
+ * include/xgboost/parameter.h enum-class specialization subclasses
+ * (is_enum_, default_value_, has_default_, Set, add_enum, Init).
+ *
+ * Field access works through byte offsets from the declaring instance, so a
+ * manager built once per parameter type can set fields on any instance.
+ */
+#ifndef DMLC_PARAMETER_H_
+#define DMLC_PARAMETER_H_
+
+#include <algorithm>
+#include <cmath>
+#include <cstddef>
+#include <cstdint>
+#include <iostream>
+#include <limits>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <type_traits>
+#include <utility>
+#include <vector>
+
+#include "./base.h"
+#include "./logging.h"
+
+namespace dmlc {
+
+struct ParamError : public Error {
+  explicit ParamError(const std::string& s) : Error(s) {}
+};
+
+/*! \brief field metadata for help/dump */
+struct ParamFieldInfo {
+  std::string name;
+  std::string type;
+  std::string type_info_str;
+  std::string description;
+};
+
+namespace parameter {
+
+/*! \brief polymorphic accessor for one declared field */
+class FieldAccessEntry {
+ public:
+  virtual ~FieldAccessEntry() = default;
+  /*! \brief set field on instance at head from string */
+  virtual void Set(void* head, const std::string& value) const = 0;
+  /*! \brief read field on instance at head as string */
+  virtual std::string GetStringValue(const void* head) const = 0;
+  /*! \brief set field to its default; throw if it has none */
+  virtual void SetDefault(void* head) const = 0;
+  virtual ParamFieldInfo GetFieldInfo() const = 0;
+
+  bool has_default_{false};
+  std::string key_;
+  std::string description_;
+};
+
+class ParamManager;
+
+/*! \brief typed field entry (generic arithmetic / string) */
+template <typename TEntry, typename DType>
+class FieldEntryBase : public FieldAccessEntry {
+ public:
+  void Set(void* head, const std::string& value) const override {
+    std::istringstream is(value);
+    DType tmp;
+    if (!(is >> tmp)) {
+      throw ParamError("Invalid value \"" + value + "\" for parameter \"" +
+                       key_ + "\"");
+    }
+    this->self().Check(tmp);
+    this->Ref(head) = tmp;
+  }
+  std::string GetStringValue(const void* head) const override {
+    std::ostringstream os;
+    os << this->CRef(head);
+    return os.str();
+  }
+  void SetDefault(void* head) const override {
+    if (!has_default_) {
+      throw ParamError("Required parameter \"" + key_ + "\" is not set");
+    }
+    this->Ref(head) = default_value_;
+  }
+  ParamFieldInfo GetFieldInfo() const override {
+    ParamFieldInfo info;
+    info.name = key_;
+    info.type = "param";
+    info.description = description_;
+    return info;
+  }
+
+  TEntry& set_default(const DType& v) {
+    default_value_ = v;
+    has_default_ = true;
+    return this->self_mut();
+  }
+  TEntry& describe(const std::string& d) {
+    description_ = d;
+    return this->self_mut();
+  }
+  void Init(const std::string& key, void* head, DType& ref) {  // NOLINT
+    key_ = key;
+    offset_ = reinterpret_cast<char*>(&ref) - reinterpret_cast<char*>(head);
+  }
+  void Check(const DType&) const {}
+
+ protected:
+  DType& Ref(void* head) const {
+    return *reinterpret_cast<DType*>(static_cast<char*>(head) + offset_);
+  }
+  const DType& CRef(const void* head) const {
+    return *reinterpret_cast<const DType*>(
+        static_cast<const char*>(head) + offset_);
+  }
+  const TEntry& self() const { return *static_cast<const TEntry*>(this); }
+  TEntry& self_mut() { return *static_cast<TEntry*>(this); }
+
+  ptrdiff_t offset_{0};
+  DType default_value_{};
+};
+
+/*! \brief arithmetic entry: adds range checking */
+template <typename TEntry, typename DType>
+class FieldEntryNumeric : public FieldEntryBase<TEntry, DType> {
+ public:
+  TEntry& set_lower_bound(DType v) {
+    lower_ = v;
+    has_lower_ = true;
+    return this->self_mut();
+  }
+  TEntry& set_upper_bound(DType v) {
+    upper_ = v;
+    has_upper_ = true;
+    return this->self_mut();
+  }
+  TEntry& set_range(DType lo, DType hi) {
+    set_lower_bound(lo);
+    return set_upper_bound(hi);
+  }
+  void Check(const DType& v) const {
+    if ((has_lower_ && v < lower_) || (has_upper_ && v > upper_)) {
+      std::ostringstream os;
+      os << "value " << v << " for parameter \"" << this->key_
+         << "\" exceeds bound [";
+      if (has_lower_) os << lower_; else os << "-inf";
+      os << ", ";
+      if (has_upper_) os << upper_; else os << "inf";
+      os << "]";
+      throw ParamError(os.str());
+    }
+  }
+  void Set(void* head, const std::string& value) const override {
+    DType cast{};
+    std::istringstream is(value);
+    is >> cast;
+    if (is.fail() || !is.eof()) {
+      // fallback accepts "1e3"-style for integral fields; long double keeps
+      // 64-bit integers (e.g. a SIZE_MAX default) exact through the round trip
+      std::istringstream is2(value);
+      long double tmp;
+      if (!(is2 >> tmp) ||
+          (std::is_integral<DType>::value && tmp != std::floor(tmp))) {
+        // reject "6.5" for an int field, like real dmlc; the fallback only
+        // admits integral-valued scientific notation ("1e3")
+        throw ParamError("Invalid value \"" + value + "\" for parameter \"" +
+                         this->key_ + "\"");
+      }
+      cast = static_cast<DType>(tmp);
+    }
+    this->Check(cast);
+    this->Ref(head) = cast;
+  }
+
+ protected:
+  bool has_lower_{false}, has_upper_{false};
+  DType lower_{}, upper_{};
+};
+
+/* generic entry: any type with istream>>/ostream<< operators (e.g. the
+ * reference's ParamArray fields) */
+template <typename DType, typename Enable = void>
+class FieldEntry : public FieldEntryBase<FieldEntry<DType, Enable>, DType> {};
+
+template <typename DType>
+class FieldEntry<DType,
+                 std::enable_if_t<std::is_arithmetic<DType>::value &&
+                                  !std::is_same<DType, bool>::value>>
+    : public FieldEntryNumeric<FieldEntry<DType>, DType> {};
+
+/*! \brief int entry with optional enum-string mapping (subclassed by the
+ *  reference's DECLARE_FIELD_ENUM_CLASS) */
+template <>
+class FieldEntry<int, void> : public FieldEntryNumeric<FieldEntry<int>, int> {
+ public:
+  FieldEntry<int>& add_enum(const std::string& key, int value) {
+    enum_map_[key] = value;
+    enum_back_[value] = key;
+    is_enum_ = true;
+    return *this;
+  }
+  void Set(void* head, const std::string& value) const override {
+    if (is_enum_) {
+      // strings only, rejected before any mutation (real dmlc rejects raw
+      // numerics for enum fields too)
+      auto it = enum_map_.find(value);
+      if (it == enum_map_.end()) {
+        std::ostringstream os;
+        os << "Invalid value \"" << value << "\" for parameter \""
+           << this->key_ << "\". Valid values: {";
+        for (const auto& kv : enum_map_) os << kv.first << ", ";
+        os << "}";
+        throw ParamError(os.str());
+      }
+      this->Ref(head) = it->second;
+      return;
+    }
+    FieldEntryNumeric<FieldEntry<int>, int>::Set(head, value);
+  }
+  std::string GetStringValue(const void* head) const override {
+    if (is_enum_) {
+      auto it = enum_back_.find(this->CRef(head));
+      if (it != enum_back_.end()) return it->second;
+    }
+    return FieldEntryNumeric<FieldEntry<int>, int>::GetStringValue(head);
+  }
+
+ protected:
+  bool is_enum_{false};
+  std::map<std::string, int> enum_map_;
+  std::map<int, std::string> enum_back_;
+};
+
+template <>
+class FieldEntry<bool, void> : public FieldEntryBase<FieldEntry<bool>, bool> {
+ public:
+  void Set(void* head, const std::string& value) const override {
+    std::string v = value;
+    std::transform(v.begin(), v.end(), v.begin(), ::tolower);
+    if (v == "true" || v == "1") {
+      this->Ref(head) = true;
+    } else if (v == "false" || v == "0") {
+      this->Ref(head) = false;
+    } else {
+      throw ParamError("Invalid boolean \"" + value + "\" for parameter \"" +
+                       key_ + "\"");
+    }
+  }
+  std::string GetStringValue(const void* head) const override {
+    return this->CRef(head) ? "1" : "0";
+  }
+};
+
+template <>
+class FieldEntry<std::string, void>
+    : public FieldEntryBase<FieldEntry<std::string>, std::string> {
+ public:
+  void Set(void* head, const std::string& value) const override {
+    this->Ref(head) = value;  // whole string, including spaces
+  }
+  std::string GetStringValue(const void* head) const override {
+    return this->CRef(head);
+  }
+};
+
+/*! \brief per-type manager: declared fields + aliases */
+class ParamManager {
+ public:
+  ~ParamManager() {
+    for (auto& kv : entries_) delete kv.second;
+  }
+  FieldAccessEntry* Find(const std::string& key) const {
+    auto it = entries_.find(key);
+    if (it != entries_.end()) return it->second;
+    auto al = aliases_.find(key);
+    if (al != aliases_.end()) {
+      auto it2 = entries_.find(al->second);
+      if (it2 != entries_.end()) return it2->second;
+    }
+    return nullptr;
+  }
+  void AddEntry(const std::string& key, FieldAccessEntry* e) {
+    if (entries_.count(key)) {
+      delete e;
+      return;  // re-declare (multiple singleton races) is a no-op
+    }
+    entries_[key] = e;
+    order_.push_back(key);
+  }
+  void AddAlias(const std::string& field, const std::string& alias) {
+    aliases_[alias] = field;
+  }
+  void SetDefaults(void* head) const {
+    for (const auto& k : order_) entries_.at(k)->SetDefault(head);
+  }
+  const std::vector<std::string>& Order() const { return order_; }
+  const std::map<std::string, FieldAccessEntry*>& Entries() const {
+    return entries_;
+  }
+  std::string name;
+
+ private:
+  std::map<std::string, FieldAccessEntry*> entries_;
+  std::map<std::string, std::string> aliases_;
+  std::vector<std::string> order_;
+};
+
+template <typename PType>
+struct ParamManagerSingleton {
+  ParamManager manager;
+  explicit ParamManagerSingleton(const std::string& param_name) {
+    PType param;
+    manager.name = param_name;
+    param.__DECLARE__(this);
+  }
+};
+
+}  // namespace parameter
+
+/*! \brief CRTP base of all parameter structs */
+template <typename PType>
+struct Parameter {
+ public:
+  template <typename Container>
+  inline void Init(const Container& kwargs) {
+    RunUpdate(kwargs, /*init=*/true, /*allow_unknown=*/false, nullptr);
+  }
+  template <typename Container>
+  inline std::vector<std::pair<std::string, std::string>> InitAllowUnknown(
+      const Container& kwargs) {
+    std::vector<std::pair<std::string, std::string>> unknown;
+    RunUpdate(kwargs, /*init=*/true, /*allow_unknown=*/true, &unknown);
+    return unknown;
+  }
+  template <typename Container>
+  inline std::vector<std::pair<std::string, std::string>> UpdateAllowUnknown(
+      const Container& kwargs) {
+    std::vector<std::pair<std::string, std::string>> unknown;
+    RunUpdate(kwargs, /*init=*/false, /*allow_unknown=*/true, &unknown);
+    return unknown;
+  }
+  /*! \brief all fields rendered to strings */
+  inline std::map<std::string, std::string> __DICT__() const {
+    std::map<std::string, std::string> ret;
+    auto* m = PType::__MANAGER__();
+    const void* head = static_cast<const void*>(self());
+    for (const auto& kv : m->Entries()) {
+      ret[kv.first] = kv.second->GetStringValue(head);
+    }
+    return ret;
+  }
+  inline std::vector<ParamFieldInfo> __FIELDS__() const {
+    std::vector<ParamFieldInfo> ret;
+    auto* m = PType::__MANAGER__();
+    for (const auto& k : m->Order()) {
+      ret.push_back(m->Entries().at(k)->GetFieldInfo());
+    }
+    return ret;
+  }
+
+ protected:
+  /* helper used by the DMLC_DECLARE_FIELD macro expansion */
+  template <typename DType>
+  inline parameter::FieldEntry<DType>& DECLARE(
+      parameter::ParamManagerSingleton<PType>* manager, const std::string& key,
+      DType& ref) {  // NOLINT
+    auto* e = new parameter::FieldEntry<DType>();
+    e->Init(key, static_cast<void*>(this), ref);
+    manager->manager.AddEntry(key, e);
+    return *e;
+  }
+
+ private:
+  const PType* self() const { return static_cast<const PType*>(this); }
+  PType* self_mut() { return static_cast<PType*>(this); }
+
+  template <typename Container>
+  void RunUpdate(const Container& kwargs, bool init, bool allow_unknown,
+                 std::vector<std::pair<std::string, std::string>>* unknown) {
+    auto* m = PType::__MANAGER__();
+    void* head = static_cast<void*>(self_mut());
+    if (init) {
+      // defaults first so unmentioned optional fields are well-defined;
+      // required fields must appear in kwargs
+      for (const auto& key : m->Order()) {
+        auto* e = m->Find(key);
+        if (e->has_default_) {
+          e->SetDefault(head);
+        } else {
+          bool provided = false;
+          for (const auto& kv : kwargs) {
+            if (m->Find(kv.first) == e) {
+              provided = true;
+              break;
+            }
+          }
+          if (!provided) e->SetDefault(head);  // throws "required"
+        }
+      }
+    }
+    for (const auto& kv : kwargs) {
+      auto* e = m->Find(kv.first);
+      if (e == nullptr) {
+        if (!allow_unknown) {
+          throw ParamError("Unknown parameter \"" + kv.first + "\"");
+        }
+        if (unknown) unknown->emplace_back(kv.first, kv.second);
+        continue;
+      }
+      e->Set(head, kv.second);
+    }
+  }
+};
+
+}  // namespace dmlc
+
+#define DMLC_DECLARE_PARAMETER(PType)                          \
+  static ::dmlc::parameter::ParamManager* __MANAGER__();       \
+  inline void __DECLARE__(                                     \
+      ::dmlc::parameter::ParamManagerSingleton<PType>* manager)
+
+#define DMLC_DECLARE_FIELD(FieldName) \
+  this->DECLARE(manager, #FieldName, FieldName)
+
+#define DMLC_DECLARE_ALIAS(FieldName, AliasName) \
+  manager->manager.AddAlias(#FieldName, #AliasName)
+
+#define DMLC_REGISTER_PARAMETER(PType)                                     \
+  ::dmlc::parameter::ParamManager* PType::__MANAGER__() {                  \
+    static ::dmlc::parameter::ParamManagerSingleton<PType> inst(#PType);   \
+    return &inst.manager;                                                  \
+  }                                                                        \
+  static DMLC_ATTRIBUTE_UNUSED ::dmlc::parameter::ParamManager&            \
+      __make__##PType##ParamManager__ = (*PType::__MANAGER__())
+
+#endif  // DMLC_PARAMETER_H_

@@ -1,0 +1,140 @@
+/* Global factory registry (dmlc shim for the oracle build). */
+#ifndef DMLC_REGISTRY_H_
+#define DMLC_REGISTRY_H_
+
+#include <map>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "./base.h"
+#include "./logging.h"
+
+namespace dmlc {
+
+template <typename EntryType>
+class Registry {
+ public:
+  /*! \brief singleton, defined by DMLC_REGISTRY_ENABLE in one TU */
+  static Registry* Get();
+
+  static const std::vector<const EntryType*>& List() { return Get()->list_; }
+
+  static std::vector<std::string> ListAllNames() {
+    auto& fmap = Get()->fmap_;
+    std::vector<std::string> names;
+    names.reserve(fmap.size());
+    for (const auto& kv : fmap) names.push_back(kv.first);
+    return names;
+  }
+
+  static const EntryType* Find(const std::string& name) {
+    auto& fmap = Get()->fmap_;
+    auto it = fmap.find(name);
+    return it == fmap.end() ? nullptr : it->second;
+  }
+
+  inline EntryType& AddAlias(const std::string& key_name,
+                             const std::string& alias) {
+    EntryType* e = fmap_.at(key_name);
+    if (fmap_.count(alias)) {
+      CHECK_EQ(e, fmap_.at(alias)) << "Trying to register alias " << alias
+                                   << " for key " << key_name
+                                   << " but " << alias << " is taken";
+    } else {
+      fmap_[alias] = e;
+    }
+    return *e;
+  }
+
+  inline EntryType& __REGISTER__(const std::string& name) {  // NOLINT
+    CHECK_EQ(fmap_.count(name), 0U) << name << " already registered";
+    auto* e = new EntryType();
+    e->name = name;
+    fmap_[name] = e;
+    list_.push_back(e);
+    return *e;
+  }
+
+  inline EntryType& __REGISTER_OR_GET__(const std::string& name) {  // NOLINT
+    auto it = fmap_.find(name);
+    if (it != fmap_.end()) return *it->second;
+    return __REGISTER__(name);
+  }
+
+  ~Registry() {
+    for (auto* e : list_) delete e;
+  }
+
+ private:
+  std::vector<const EntryType*> list_;
+  std::map<std::string, EntryType*> fmap_;
+};
+
+/*!
+ * \brief base class of a registry entry carrying a factory function.
+ *  EntryType uses CRTP; FunctionType is the factory signature.
+ */
+template <typename EntryType, typename FunctionType>
+class FunctionRegEntryBase {
+ public:
+  std::string name;
+  std::string description;
+  std::vector<std::pair<std::string, std::string>> arguments;
+  FunctionType body;
+  std::string return_type;
+
+  inline EntryType& set_body(FunctionType body_) {
+    this->body = body_;
+    return this->self();
+  }
+  inline EntryType& describe(const std::string& d) {
+    this->description = d;
+    return this->self();
+  }
+  inline EntryType& add_argument(const std::string& arg_name,
+                                 const std::string& type,
+                                 const std::string& d) {
+    arguments.emplace_back(arg_name, type + " — " + d);
+    return this->self();
+  }
+  inline EntryType& add_arguments(
+      const std::vector<std::pair<std::string, std::string>>& args) {
+    arguments.insert(arguments.end(), args.begin(), args.end());
+    return this->self();
+  }
+  inline EntryType& set_return_type(const std::string& t) {
+    return_type = t;
+    return this->self();
+  }
+
+ protected:
+  inline EntryType& self() { return *static_cast<EntryType*>(this); }
+};
+
+}  // namespace dmlc
+
+/*! \brief instantiate the registry singleton for EntryType (one TU) */
+#define DMLC_REGISTRY_ENABLE(EntryType)                   \
+  template <>                                             \
+  ::dmlc::Registry<EntryType>* ::dmlc::Registry<EntryType>::Get() { \
+    static ::dmlc::Registry<EntryType> inst;              \
+    return &inst;                                         \
+  }
+
+#define DMLC_REGISTRY_REGISTER(EntryType, EntryTypeName, Name)         \
+  static DMLC_ATTRIBUTE_UNUSED EntryType& __make_##EntryTypeName##_##Name##__ = \
+      ::dmlc::Registry<EntryType>::Get()->__REGISTER__(#Name)
+
+/* file tags: in full dmlc-core these force linkage of registration TUs when
+ * static-linking; a shared-library build keeps all TUs, so they are no-ops
+ * beyond declaring/calling a dummy symbol. */
+#define DMLC_REGISTRY_FILE_TAG(UniqueTag) \
+  int __dmlc_registry_file_tag_##UniqueTag##__() { return 0; }
+
+#define DMLC_REGISTRY_LINK_TAG(UniqueTag)                          \
+  int __dmlc_registry_file_tag_##UniqueTag##__();                  \
+  static int DMLC_ATTRIBUTE_UNUSED __reg_file_tag_##UniqueTag##__ = \
+      __dmlc_registry_file_tag_##UniqueTag##__()
+
+#endif  // DMLC_REGISTRY_H_

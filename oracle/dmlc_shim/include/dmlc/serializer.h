@@ -1,0 +1,5 @@
+/* serializer handlers live in io.h in this shim (dmlc shim, oracle build) */
+#ifndef DMLC_SERIALIZER_H_
+#define DMLC_SERIALIZER_H_
+#include "./io.h"
+#endif  // DMLC_SERIALIZER_H_

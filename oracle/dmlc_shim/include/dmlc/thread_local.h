@@ -1,0 +1,18 @@
+/* Thread-local singleton store (dmlc shim for the oracle build). */
+#ifndef DMLC_THREAD_LOCAL_H_
+#define DMLC_THREAD_LOCAL_H_
+
+namespace dmlc {
+
+template <typename T>
+class ThreadLocalStore {
+ public:
+  static T* Get() {
+    static thread_local T inst;
+    return &inst;
+  }
+};
+
+}  // namespace dmlc
+
+#endif  // DMLC_THREAD_LOCAL_H_

@@ -1,0 +1,17 @@
+/* Wall-clock timer (dmlc shim for the oracle build). */
+#ifndef DMLC_TIMER_H_
+#define DMLC_TIMER_H_
+
+#include <chrono>
+
+namespace dmlc {
+
+inline double GetTime() {
+  return std::chrono::duration<double>(
+             std::chrono::high_resolution_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace dmlc
+
+#endif  // DMLC_TIMER_H_

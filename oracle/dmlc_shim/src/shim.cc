@@ -1,0 +1,191 @@
+/* Out-of-line pieces of the dmlc shim (oracle build): local-file Stream,
+ * and a LIBSVM text parser behind Parser<uint32_t>::Create.
+ */
+#include <dmlc/data.h>
+#include <dmlc/io.h>
+#include <dmlc/logging.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace dmlc {
+
+namespace {
+
+class LocalFileStream : public SeekStream {
+ public:
+  LocalFileStream(const char* path, const char* flag) {
+    std::string mode;
+    for (const char* f = flag; *f; ++f) {
+      if (*f == 'r' || *f == 'w' || *f == 'a' || *f == '+') mode += *f;
+    }
+    mode += 'b';
+    fp_ = std::fopen(path, mode.c_str());
+  }
+  ~LocalFileStream() override {
+    if (fp_) std::fclose(fp_);
+  }
+  bool ok() const { return fp_ != nullptr; }
+  size_t Read(void* ptr, size_t size) override {
+    return std::fread(ptr, 1, size, fp_);
+  }
+  size_t Write(const void* ptr, size_t size) override {
+    size_t n = std::fwrite(ptr, 1, size, fp_);
+    CHECK_EQ(n, size) << "short write";
+    return n;
+  }
+  void Seek(size_t pos) override {
+    std::fseek(fp_, static_cast<long>(pos), SEEK_SET);  // NOLINT
+  }
+  size_t Tell() override { return static_cast<size_t>(std::ftell(fp_)); }
+
+ private:
+  std::FILE* fp_{nullptr};
+};
+
+std::string StripProtocol(const char* uri) {
+  io::URI parsed(uri);
+  CHECK(parsed.protocol.empty() || parsed.protocol == "file://")
+      << "dmlc shim Stream only supports local files, got: " << uri;
+  return parsed.protocol.empty() ? parsed.name : parsed.host + parsed.name;
+}
+
+}  // namespace
+
+Stream* Stream::Create(const char* uri, const char* flag, bool allow_null) {
+  auto path = StripProtocol(uri);
+  auto fs = std::make_unique<LocalFileStream>(path.c_str(), flag);
+  if (!fs->ok()) {
+    if (allow_null) return nullptr;
+    LOG(FATAL) << "Failed to open \"" << path << "\" with flag " << flag;
+  }
+  return fs.release();
+}
+
+SeekStream* SeekStream::CreateForRead(const char* uri, bool allow_null) {
+  auto path = StripProtocol(uri);
+  auto fs = std::make_unique<LocalFileStream>(path.c_str(), "r");
+  if (!fs->ok()) {
+    if (allow_null) return nullptr;
+    LOG(FATAL) << "Failed to open \"" << path << "\" for read";
+  }
+  return fs.release();
+}
+
+namespace {
+
+/* LIBSVM text parser: "label [qid:q] idx:val idx:val ...".  Single batch of
+ * the whole (partition of the) file — the reference's FileAdapter streams
+ * whatever batch granularity the parser provides.
+ */
+class LibSVMParser : public Parser<uint32_t, real_t> {
+ public:
+  LibSVMParser(const std::string& path, unsigned part_index,
+               unsigned num_parts)
+      : path_(path), part_(part_index), nparts_(num_parts) {}
+
+  void BeforeFirst() override { at_end_ = false; }
+
+  bool Next() override {
+    if (at_end_) return false;
+    Load();
+    at_end_ = true;
+    return block_.size > 0;
+  }
+
+  const RowBlock<uint32_t, real_t>& Value() const override { return block_; }
+  size_t BytesRead() const override { return bytes_read_; }
+
+ private:
+  void Load() {
+    if (loaded_) {
+      FillBlock();
+      return;
+    }
+    std::ifstream ifs(path_);
+    CHECK(ifs) << "Failed to open " << path_;
+    // partition by line count: part k takes lines with (line % nparts) == k
+    std::string line;
+    size_t lineno = 0;
+    offset_.push_back(0);
+    while (std::getline(ifs, line)) {
+      bytes_read_ += line.size() + 1;
+      size_t ln = lineno++;
+      if (nparts_ > 1 && (ln % nparts_) != part_) continue;
+      const char* p = line.c_str();
+      char* end = nullptr;
+      // skip blank / comment lines
+      while (*p == ' ' || *p == '\t') ++p;
+      if (*p == '\0' || *p == '#') continue;
+      float lbl = std::strtof(p, &end);
+      CHECK_NE(p, end) << "Malformed libsvm line: " << line;
+      p = end;
+      label_.push_back(lbl);
+      while (true) {
+        while (*p == ' ' || *p == '\t') ++p;
+        if (*p == '\0' || *p == '#') break;
+        if (std::strncmp(p, "qid:", 4) == 0) {
+          p += 4;
+          qid_.push_back(std::strtoull(p, &end, 10));
+          p = end;
+          continue;
+        }
+        char* colon = nullptr;
+        unsigned long idx = std::strtoul(p, &colon, 10);  // NOLINT
+        CHECK(colon && *colon == ':') << "Malformed libsvm pair in: " << line;
+        p = colon + 1;
+        float val = std::strtof(p, &end);
+        p = end;
+        index_.push_back(static_cast<uint32_t>(idx));
+        value_.push_back(val);
+      }
+      offset_.push_back(index_.size());
+    }
+    loaded_ = true;
+    FillBlock();
+  }
+
+  void FillBlock() {
+    block_.size = label_.size();
+    block_.offset = offset_.data();
+    block_.label = label_.data();
+    block_.weight = nullptr;
+    block_.qid = qid_.size() == label_.size() ? qid_.data() : nullptr;
+    block_.index = index_.data();
+    block_.value = value_.data();
+  }
+
+  std::string path_;
+  unsigned part_, nparts_;
+  bool at_end_{false}, loaded_{false};
+  size_t bytes_read_{0};
+  std::vector<size_t> offset_;
+  std::vector<float> label_, value_;
+  std::vector<uint64_t> qid_;
+  std::vector<uint32_t> index_;
+  RowBlock<uint32_t, real_t> block_;
+};
+
+std::string StripFormatArgs(const std::string& uri) {
+  // dmlc URIs may carry "?format=libsvm&..." suffixes
+  return uri.substr(0, uri.find('?'));
+}
+
+}  // namespace
+
+template <>
+Parser<uint32_t, real_t>* Parser<uint32_t, real_t>::Create(
+    const char* uri, unsigned part_index, unsigned num_parts,
+    const char* type) {
+  std::string t(type);
+  CHECK(t == "auto" || t == "libsvm")
+      << "dmlc shim parser supports libsvm only, got: " << t;
+  auto path = StripProtocol(StripFormatArgs(uri).c_str());
+  return new LibSVMParser(path, part_index, num_parts);
+}
+
+}  // namespace dmlc

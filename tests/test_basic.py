@@ -166,3 +166,36 @@ def test_cv_runs():
     out = xtb.cv({"objective": "binary:logistic", "max_depth": 2}, d,
                  num_boost_round=5, nfold=3, as_pandas=False, verbose_eval=False)
     assert len(out["test-logloss-mean"]) == 5
+
+
+def test_streamed_sparse_predict_bounded_memory():
+    """Large sparse CSR predicts through fixed row windows with no full
+    densification (reference: gpu_predictor.cu SparsePage loader split);
+    values must equal the dense path exactly."""
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(0)
+    F = 1000
+    Xtr = rng.normal(size=(500, F)).astype(np.float32)
+    ytr = (Xtr[:, 0] > 0).astype(np.float32)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3},
+                    xtb.DMatrix(Xtr, label=ytr), 3, verbose_eval=False)
+
+    R = 80_000  # R*F > _PREDICT_BUFFER_ELEMS -> streamed path
+    nnz = 200_000
+    rows = rng.integers(0, R, nnz)
+    cols = rng.integers(0, F, nnz)
+    vals = rng.normal(size=nnz).astype(np.float32)
+    big = sp.csr_matrix((vals, (rows, cols)), shape=(R, F))
+    d_big = xtb.DMatrix(big)
+    assert bst._use_streamed_predict(d_big)
+    p_big = bst.predict(d_big)
+    assert p_big.shape == (R,) and np.all(np.isfinite(p_big))
+
+    # exactness vs the dense path on a head slice
+    head = 512
+    d_head = xtb.DMatrix(big[:head].toarray())
+    d_head_X = np.asarray(d_head.host_dense())
+    d_head_X[d_head_X == 0.0] = np.nan  # CSR implicit zeros are missing
+    p_head = bst.predict(xtb.DMatrix(d_head_X))
+    np.testing.assert_array_equal(p_big[:head], p_head)

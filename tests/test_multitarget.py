@@ -1,0 +1,111 @@
+"""Vector-leaf trees — multi_strategy="multi_output_tree"
+(reference: tests/python/test_multi_target.py pattern; model schema
+multi_target_tree_model.cc)."""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+
+
+def _multi_data(seed=0, n=1500, f=8, k=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    W = rng.normal(size=(f, k)).astype(np.float32)
+    Y = (X @ W + 0.1 * rng.normal(size=(n, k))).astype(np.float32)
+    return X, Y
+
+
+def test_multi_output_tree_trains_and_fits():
+    X, Y = _multi_data()
+    d = xtb.DMatrix(X, label=Y)
+    params = {"objective": "reg:squarederror", "num_target": 3,
+              "multi_strategy": "multi_output_tree", "max_depth": 5,
+              "eta": 0.3, "eval_metric": "rmse"}
+    res = {}
+    bst = xtb.train(params, d, 20, evals=[(d, "t")], evals_result=res,
+                    verbose_eval=False)
+    p = bst.predict(d)
+    assert p.shape == Y.shape
+    # one vector tree per round
+    assert len(bst.trees) == 20
+    assert bst.trees[0].n_targets == 3
+    rmse = float(np.sqrt(np.mean((p - Y) ** 2)))
+    base = float(np.sqrt(np.mean((Y - Y.mean(0)) ** 2)))
+    assert rmse < 0.5 * base, (rmse, base)
+    assert res["t"]["rmse"][-1] < res["t"]["rmse"][0]
+
+
+def test_multi_output_tree_close_to_one_per_target():
+    """Vector-leaf and one-tree-per-target share the gain formulation, so on
+    CORRELATED targets (where one split structure serves all outputs — the
+    case multi_output_tree exists for) their fits land close (the reference's
+    test_multi_target strategy-parity check)."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(1500, 8)).astype(np.float32)
+    base = (X[:, 0] * 1.5 + X[:, 1] ** 2).astype(np.float32)
+    scales = np.asarray([1.0, 0.8, 1.2], np.float32)
+    Y = base[:, None] * scales[None, :] + 0.05 * rng.normal(
+        size=(1500, 3)).astype(np.float32)
+    d1 = xtb.DMatrix(X, label=Y)
+    d2 = xtb.DMatrix(X, label=Y)
+    common = {"objective": "reg:squarederror", "num_target": 3,
+              "max_depth": 4, "eta": 0.3}
+    b_multi = xtb.train({**common, "multi_strategy": "multi_output_tree"},
+                        d1, 15, verbose_eval=False)
+    b_per = xtb.train({**common, "multi_strategy": "one_output_per_tree"},
+                      d2, 15, verbose_eval=False)
+    pm = b_multi.predict(d1)
+    pp = b_per.predict(d2)
+    rm = np.sqrt(np.mean((pm - Y) ** 2))
+    rp = np.sqrt(np.mean((pp - Y) ** 2))
+    assert abs(rm - rp) < 0.25 * max(rm, rp), (rm, rp)
+
+
+def test_multi_output_tree_save_load_roundtrip(tmp_path):
+    X, Y = _multi_data(seed=5, n=600)
+    d = xtb.DMatrix(X, label=Y)
+    bst = xtb.train({"objective": "reg:squarederror", "num_target": 3,
+                     "multi_strategy": "multi_output_tree", "max_depth": 4},
+                    d, 5, verbose_eval=False)
+    p = bst.predict(xtb.DMatrix(X))
+    fn = str(tmp_path / "multi.json")
+    bst.save_model(fn)
+    b2 = xtb.Booster()
+    b2.load_model(fn)
+    p2 = b2.predict(xtb.DMatrix(X))
+    np.testing.assert_array_equal(p, p2)
+    # schema: vector-leaf fields present (multi_target_tree_model.cc SaveModel)
+    import json
+
+    with open(fn) as fh:
+        m = json.load(fh)
+    t0 = m["learner"]["gradient_booster"]["model"]["trees"][0]
+    assert t0["tree_param"]["size_leaf_vector"] == "3"
+    n_leaves = sum(1 for c in t0["left_children"] if c == -1)
+    assert len(t0["leaf_weights"]) == n_leaves * 3
+    assert len(t0["base_weights"]) == len(t0["left_children"]) * 3
+
+
+def test_multi_output_softprob():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(1200, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+    d = xtb.DMatrix(X, label=y.astype(np.float32))
+    bst = xtb.train({"objective": "multi:softprob", "num_class": 3,
+                     "multi_strategy": "multi_output_tree", "max_depth": 4},
+                    d, 10, verbose_eval=False)
+    p = bst.predict(d)
+    assert p.shape == (1200, 3)
+    np.testing.assert_allclose(p.sum(1), 1.0, rtol=1e-5)
+    acc = np.mean(np.argmax(p, 1) == y)
+    assert acc > 0.8, acc
+
+
+def test_multi_output_tree_unsupported_combos():
+    X, Y = _multi_data(n=300)
+    d = xtb.DMatrix(X, label=Y)
+    with pytest.raises(NotImplementedError):
+        xtb.train({"objective": "reg:squarederror", "num_target": 3,
+                   "multi_strategy": "multi_output_tree", "max_depth": 3,
+                   "grow_policy": "lossguide", "max_leaves": 8},
+                  d, 2, verbose_eval=False)

@@ -19,7 +19,7 @@ import numpy as np
 __all__ = [
     "init", "finalize", "get_rank", "get_world_size", "is_distributed",
     "communicator_print", "get_processor_name", "broadcast", "allreduce",
-    "signal_error", "Op", "CommunicatorContext",
+    "allgather", "signal_error", "Op", "CommunicatorContext",
 ]
 
 _INITIALIZED = False
@@ -124,6 +124,33 @@ def allreduce(data: np.ndarray, op: Op = Op.SUM) -> np.ndarray:
     if red is None:
         raise NotImplementedError(f"allreduce op {op!r} not supported")
     return red(gathered, axis=0).astype(data.dtype)
+
+
+def allgather(data: np.ndarray) -> np.ndarray:
+    """Gather each process's (identically-shaped) array: (world, *shape).
+
+    The building block of the distributed quantile-sketch merge
+    (reference: src/common/quantile.cc:397 AllreduceV of summaries)."""
+    data = np.asarray(data)
+    if not is_distributed():
+        return data[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(data))
+
+
+def allgather_ragged(data: np.ndarray) -> np.ndarray:
+    """Concatenate 1-D/2-D row-arrays of differing per-process lengths
+    (pad-to-max allgather, then trim)."""
+    data = np.asarray(data)
+    if not is_distributed():
+        return data
+    sizes = allgather(np.asarray([data.shape[0]], np.int64))[:, 0]
+    width = int(sizes.max())
+    pad = np.zeros((width,) + data.shape[1:], data.dtype)
+    pad[: data.shape[0]] = data
+    stacked = allgather(pad)  # (world, width, ...)
+    return np.concatenate([stacked[k, : sizes[k]] for k in range(len(sizes))])
 
 
 def broadcast(data: Any, root: int) -> Any:

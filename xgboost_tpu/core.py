@@ -43,11 +43,12 @@ class _Cache:
     feature matrix for the predictor)."""
 
     def __init__(self, dmat: DMatrix, max_bin: int, ref: Optional[DMatrix] = None,
-                 mesh=None):
+                 mesh=None, distributed: bool = False):
         self.dmat = dmat
         self.max_bin = max_bin
         self.ref = ref
         self.mesh = mesh
+        self.distributed = distributed
         self.ellpack = None
         self.n_padded = dmat.num_row()  # grows to the padded size on ensure_train
         self.margin: Optional[Any] = None  # (n_padded, K) device
@@ -83,7 +84,9 @@ class _Cache:
             return
         if self.ellpack is not None:
             return
-        self.ellpack = self.dmat.ensure_ellpack(max_bin=self.max_bin, ref=self.ref)
+        self.ellpack = self.dmat.ensure_ellpack(max_bin=self.max_bin,
+                                                ref=self.ref,
+                                                distributed=self.distributed)
         if self.mesh is not None:
             from .parallel import shard_rows
 
@@ -184,6 +187,11 @@ class Booster:
         self.n_devices = nd if isinstance(nd, int) else -1  # -1 = all
         self._mesh = None
         self.num_parallel_tree = int(p.get("num_parallel_tree", 1))
+        # vector-leaf trees (multi_target_tree_model.h): one tree carries all
+        # K outputs when multi_strategy="multi_output_tree"
+        self.multi_strategy = str(p.get("multi_strategy", "one_output_per_tree"))
+        if self.multi_strategy not in ("one_output_per_tree", "multi_output_tree"):
+            raise ValueError(f"unknown multi_strategy {self.multi_strategy!r}")
         if not hasattr(self, "tree_weights"):
             self.tree_weights: List[float] = []
         if not hasattr(self, "linear_weights"):
@@ -238,7 +246,8 @@ class Booster:
         key = id(dmat)
         if key not in self._caches:
             self._caches[key] = _Cache(dmat, self.tparam.max_bin, ref=ref,
-                                       mesh=self._get_mesh())
+                                       mesh=self._get_mesh(),
+                                       distributed=self._process_parallel())
             if getattr(self, "_num_feature", None) is None:
                 self._num_feature = dmat.num_col()
         return self._caches[key]
@@ -256,11 +265,22 @@ class Booster:
                 import jax.numpy as jnp
 
                 v = np.asarray(cache.valid)
+                lab = np.asarray(cache.labels)[v]
+                wts = (None if cache.weights is None
+                       else np.asarray(cache.weights)[v])
+                if self._process_parallel():
+                    # InitEstimation must agree across workers (the reference
+                    # allreduces inside FitStump, fit_stump.cc:52); gather the
+                    # shards so every process estimates on the global labels
+                    from . import collective
+
+                    lab = collective.allgather_ragged(lab)
+                    if wts is not None:
+                        wts = collective.allgather_ragged(wts)
                 bm = np.asarray(
                     self.objective.init_estimation(
-                        jnp.asarray(np.asarray(cache.labels)[v]),
-                        None if cache.weights is None
-                        else jnp.asarray(np.asarray(cache.weights)[v]),
+                        jnp.asarray(lab),
+                        None if wts is None else jnp.asarray(wts),
                     )
                 )
             else:
@@ -303,6 +323,9 @@ class Booster:
                 cache.margin = cache.margin + delta  # page-padded, aligned
                 cache.n_trees_applied = len(self.trees)
                 return
+            elif self._use_streamed_predict(cache.dmat):
+                # large sparse eval/train matrix: never cache a dense copy
+                delta = jnp.asarray(self._margin_delta_streamed(cache.dmat, new))
             else:
                 if cache.raw_X is None:
                     cache.raw_X = jnp.asarray(self.dmat_host_dense(cache), jnp.float32)
@@ -484,6 +507,7 @@ class Booster:
             max_depth, self._split_params,
             interaction_sets=self.tparam.interaction_constraints,
             max_leaves=self.tparam.max_leaves, lossguide=lossguide,
+            mesh=self._get_mesh(),
         )
         K = gpair.shape[1]
         new_margin = cache.margin
@@ -629,6 +653,14 @@ class Booster:
         mask = jax.random.bernoulli(key, self.tparam.subsample, (gpair.shape[0],))
         return gpair * mask[:, None, None]
 
+    def _process_parallel(self) -> bool:
+        """True when training spans multiple processes (jax.distributed):
+        each process holds a row shard and histograms cross processes via the
+        host collective (the reference's rabit/NCCL role)."""
+        from . import collective
+
+        return collective.is_distributed()
+
     def _get_mesh(self):
         if self.n_devices == 1:
             return None
@@ -646,6 +678,55 @@ class Booster:
                     f"(use a power of two up to 1024)")
             self._mesh = make_mesh(n)
         return self._mesh
+
+    def _boost_multi_target(self, cache: _Cache, gpair, iteration: int,
+                            K: int, scalar_grower, cat_mask_np) -> None:
+        """One vector-leaf tree per round: 2K-channel histogram, summed-gain
+        splits, K-vector leaves (multi_target_tree_model.h,
+        multi_evaluate_splits.cu)."""
+        from .tree.grow_multi import (MultiTargetTreeGrower,
+                                      leaf_margin_delta_multi)
+
+        if self.booster_kind == "dart":
+            raise NotImplementedError(
+                "multi_strategy='multi_output_tree' with DART is not supported")
+        if cat_mask_np is not None and np.any(cat_mask_np):
+            raise NotImplementedError(
+                "multi_output_tree with categorical features is not supported "
+                "yet (same restriction as early reference versions)")
+        mono = self.tparam.monotone_constraints
+        if mono is not None and any(c != 0 for c in mono):
+            raise NotImplementedError(
+                "multi_output_tree with monotone constraints is not supported")
+        if self._get_mesh() is not None or self._process_parallel():
+            raise NotImplementedError(
+                "multi_output_tree is single-device in this round")
+        if self.tparam.grow_policy == "lossguide" or self.tparam.max_leaves > 0:
+            raise NotImplementedError(
+                "multi_output_tree supports depthwise growth only")
+        ell = cache.ellpack
+        mkey = ("multi", scalar_grower.max_depth, self._split_params, K)
+        grower = self._grower_cache.get(mkey)
+        if grower is None:
+            grower = MultiTargetTreeGrower(scalar_grower.max_depth,
+                                           self._split_params, K)
+            self._grower_cache[mkey] = grower
+        new_margin = cache.margin
+        for p_idx in range(max(self.num_parallel_tree, 1)):
+            fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx,
+                                           ell.n_features)
+            gp = self._subsample_mask(gpair, iteration * 131 + p_idx)
+            state = grower.grow(cache.bins, gp, cache.valid, ell.cuts_pad,
+                                ell.n_bins, feature_masks=fmask_fn)
+            delta = leaf_margin_delta_multi(state.pos, state.leaf_val)
+            new_margin = new_margin + delta
+            tree = RegTree.from_grown_multi(
+                MultiTargetTreeGrower.to_host(state), K)
+            self.trees.append(tree)
+            self.tree_info.append(0)
+            self.tree_weights.append(1.0)
+        cache.margin = new_margin
+        cache.n_trees_applied = len(self.trees)
 
     def _select_dart_drops(self, iteration: int) -> List[int]:
         """Draw the round's dropped-tree set (gbtree.cc Dart::DropTrees).
@@ -683,10 +764,11 @@ class Booster:
             if self.booster_kind == "dart":
                 raise ValueError("booster='dart' is not supported with "
                                  "ExtMemQuantileDMatrix yet")
-            if self._get_mesh() is not None:
+            if self._process_parallel():
                 raise NotImplementedError(
-                    "n_devices > 1 with ExtMemQuantileDMatrix is not wired up "
-                    "yet; shard the DataIter across processes instead")
+                    "ExtMemQuantileDMatrix with multi-process training is not "
+                    "wired up yet (no cross-process histogram reduce on the "
+                    "streaming path); use in-memory row shards per process")
             return self._boost_trees_extmem(cache, gpair, iteration)
         ell = cache.ellpack
         mono = self.tparam.monotone_constraints
@@ -702,14 +784,30 @@ class Booster:
             # shapes (deeper growth is a planned extension)
             max_depth = 10 if lossguide else 6
         mesh = self._get_mesh()
+        proc_par = self._process_parallel()
         gkey = (max_depth, id(mesh), self._split_params,
                 self.tparam.interaction_constraints, self.tparam.max_leaves,
-                lossguide, str(self.params.get("_hist_impl", "xla")))
+                lossguide, str(self.params.get("_hist_impl", "xla")), proc_par)
         if not hasattr(self, "_grower_cache"):
             self._grower_cache = {}
         grower = self._grower_cache.get(gkey)
         if grower is None:
-            if mesh is not None:
+            if proc_par:
+                if mesh is not None:
+                    raise NotImplementedError(
+                        "n_devices > 1 within a process is not combined with "
+                        "multi-process training yet; give each process one "
+                        "device (process-level data parallelism)")
+                from .parallel.process import ProcessHistTreeGrower
+
+                grower = ProcessHistTreeGrower(
+                    max_depth,
+                    self._split_params,
+                    interaction_sets=self.tparam.interaction_constraints,
+                    max_leaves=self.tparam.max_leaves,
+                    lossguide=lossguide,
+                )
+            elif mesh is not None:
                 from .parallel import ShardedHistTreeGrower
 
                 # cached: ShardedHistTreeGrower wraps fresh shard_map jits, so
@@ -770,6 +868,9 @@ class Booster:
         new_margin = cache.margin
         n_new = 0
         cat_mask_np = cache.dmat.cat_mask()
+        if self.multi_strategy == "multi_output_tree" and K > 1:
+            return self._boost_multi_target(cache, gpair, iteration, K,
+                                            grower, cat_mask_np)
         for p_idx in range(max(self.num_parallel_tree, 1)):
             fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx, ell.n_features)
             # one independent subsample per parallel tree (reference: each
@@ -844,6 +945,7 @@ class Booster:
         self._configure()
         msgs = [f"[{iteration}]"]
         metrics = self._eval_metric_list()
+        proc_par = self._process_parallel()
         for dmat, name in evals:
             margin = self._eval_margin(dmat)
             preds = np.asarray(self.objective.pred_transform(margin))
@@ -857,6 +959,26 @@ class Booster:
                 ub = dmat.info.label_upper_bound
                 mkw["y_upper"] = (np.full_like(mkw["y_lower"], np.inf)
                                   if ub is None else ub)
+            if proc_par:
+                # distributed eval: every rank must report the GLOBAL metric
+                # (the reference allreduces per-metric partials; gathering the
+                # shards is exact for every metric incl. AUC/NDCG and keeps
+                # early stopping in lockstep across workers)
+                from . import collective
+
+                preds = collective.allgather_ragged(np.asarray(preds))
+                labels = collective.allgather_ragged(np.asarray(labels))
+                if weights is not None:
+                    weights = collective.allgather_ragged(np.asarray(weights))
+                if mkw.get("group_ptr") is not None:
+                    sizes = np.diff(mkw["group_ptr"]).astype(np.int64)
+                    all_sizes = collective.allgather_ragged(sizes)
+                    mkw["group_ptr"] = np.concatenate(
+                        [[0], np.cumsum(all_sizes)]).astype(np.int64)
+                for key in ("y_lower", "y_upper"):
+                    if key in mkw:
+                        mkw[key] = collective.allgather_ragged(
+                            np.asarray(mkw[key]))
             if hasattr(self.objective, "dist"):
                 mkw["dist"] = self.objective.dist
                 mkw["sigma"] = self.objective.sigma
@@ -909,8 +1031,10 @@ class Booster:
         width = max((t.n_nodes for t in trees), default=1)
         depth = max((t.max_depth for t in trees), default=0) + 1
         has_cat = any(t.has_categorical for t in trees)
-        cols = {k: [] for k in ("feat", "thr", "dleft", "left", "right", "value",
-                                "is_cat", "sbin")}
+        is_multi = any(t.leaf_vector is not None for t in trees)
+        keys = ("feat", "thr", "dleft", "left", "right", "value", "is_cat",
+                "sbin") + (("value_vec",) if is_multi else ())
+        cols = {k: [] for k in keys}
         cats = []
         n_cats = max((t.max_category for t in trees), default=-1) + 1 if has_cat else 0
         for t, w in zip(trees, wts):
@@ -934,6 +1058,13 @@ class Booster:
         return self._run_predict(X_dev, stacked, groups, depth)
 
     def _run_predict(self, X_dev, stacked, groups, depth):
+        if "value_vec" in stacked:
+            from .ops.predict import predict_margin_delta_multi
+
+            return predict_margin_delta_multi(
+                X_dev, stacked["feat"], stacked["thr"], stacked["dleft"],
+                stacked["left"], stacked["right"], stacked["value_vec"],
+                depth=depth)
         if stacked["catm"] is not None:
             return predict_margin_delta(
                 X_dev,
@@ -949,9 +1080,43 @@ class Booster:
             groups, n_groups=self.n_groups, depth=depth,
         )
 
+    # past this many dense f32 elements (256 MB) sparse inputs are predicted
+    # in fixed-size row windows instead of one dense device matrix
+    _PREDICT_BUFFER_ELEMS = 1 << 26
+
     def _margin_delta_for(self, X_dev, tree_slice: slice):
         stacked, groups, depth = self._stacked(tree_slice)
         return self._run_predict(X_dev, stacked, groups, depth)
+
+    def _use_streamed_predict(self, data: DMatrix) -> bool:
+        """Sparse matrices whose dense form would not fit the predict buffer
+        stream through fixed row windows (the role of the SparsePage loader
+        vs dense loader split, gpu_predictor.cu:43-90)."""
+        if getattr(data, "_kind", "dense") != "csr":
+            return False
+        R, F = data.num_row(), data.num_col()
+        return R * F > self._PREDICT_BUFFER_ELEMS
+
+    def _margin_delta_streamed(self, data: DMatrix, tree_slice: slice) -> np.ndarray:
+        """Margin delta over a sparse matrix in bounded memory: densify one
+        fixed-shape row window at a time (padded so every window hits the same
+        compiled program) and accumulate on host."""
+        import jax.numpy as jnp
+
+        stacked, groups, depth = self._stacked(tree_slice)
+        R, F = data.num_row(), data.num_col()
+        win = max(1024, int((1 << 22) // max(F, 1)))  # ~16 MB dense window
+        out = np.empty((R, self.n_groups), np.float32)
+        for lo in range(0, R, win):
+            hi = min(lo + win, R)
+            chunk = data.host_dense_rows(lo, hi)
+            if hi - lo < win:  # pad the tail window to the static shape
+                chunk = np.pad(chunk, ((0, win - (hi - lo)), (0, 0)),
+                               constant_values=np.nan)
+            delta = self._run_predict(jnp.asarray(chunk, jnp.float32),
+                                      stacked, groups, depth)
+            out[lo:hi] = np.asarray(delta)[: hi - lo]
+        return out
 
     def predict(
         self,
@@ -1009,8 +1174,13 @@ class Booster:
 
                 out = np.asarray(self.objective.pred_transform(jnp.asarray(margin)))
             return out[:, 0] if self.n_groups == 1 and not strict_shape else out
-        X = jnp.asarray(data.host_dense(), jnp.float32)
+        streamed = self._use_streamed_predict(data)
+        X = None if streamed else jnp.asarray(data.host_dense(), jnp.float32)
         if pred_leaf:
+            if streamed:
+                raise ValueError(
+                    "pred_leaf on a large sparse matrix would materialize the "
+                    "dense form; predict in row slices instead")
             if not self.trees[tree_slice]:
                 return np.zeros((data.num_row(), 0), np.int32)
             stacked, groups, depth = self._stacked(tree_slice)
@@ -1027,7 +1197,10 @@ class Booster:
             return predict_contribs(self, data, tree_slice, approx=approx_contribs)
         base = np.broadcast_to(self.base_score.reshape(-1), (self.n_groups,))
         if len(self.trees) and tree_slice.start < tree_slice.stop:
-            margin = np.asarray(self._margin_delta_for(X, tree_slice)) + base[None, :]
+            if streamed:
+                margin = self._margin_delta_streamed(data, tree_slice) + base[None, :]
+            else:
+                margin = np.asarray(self._margin_delta_for(X, tree_slice)) + base[None, :]
         else:
             margin = np.broadcast_to(base, (data.num_row(), self.n_groups)).copy()
         if data.info.base_margin is not None:
@@ -1095,6 +1268,9 @@ class Booster:
     # ------------------------------------------------------------------ model IO
     @property
     def trees_per_round(self) -> int:
+        if getattr(self, "multi_strategy", "") == "multi_output_tree" \
+                and self.n_groups > 1:
+            return max(self.num_parallel_tree, 1)  # one vector tree per round
         return max(self.n_groups, 1) * max(self.num_parallel_tree, 1)
 
     def num_boosted_rounds(self) -> int:
@@ -1129,8 +1305,16 @@ class Booster:
     def save_raw_dict(self) -> dict:
         self._configure()
         n_feat = self.num_features()
-        base_margin = float(np.asarray(self.base_score).reshape(-1)[0])
-        base = float(np.asarray(self.objective.margin_to_prob(np.float32(base_margin))))
+        base_margins = np.asarray(self.base_score, np.float32).reshape(-1)
+        base_probs = [
+            float(np.asarray(self.objective.margin_to_prob(np.float32(m))))
+            for m in base_margins
+        ]
+        if len(base_probs) > 1 and not np.allclose(base_probs, base_probs[0]):
+            # per-group offsets: upstream ≥3.x bracketed-vector form
+            base = "[" + ",".join(f"{p:.9E}" for p in base_probs) + "]"
+        else:
+            base = f"{base_probs[0]:.9E}"
         obj_conf = {"name": self.objective.name}
         if self.objective.name.startswith("multi:"):
             obj_conf["softmax_multiclass_param"] = {"num_class": str(self.num_class)}
@@ -1181,11 +1365,12 @@ class Booster:
                 "feature_types": self.feature_types or [],
                 "gradient_booster": gb,
                 "learner_model_param": {
-                    "base_score": f"{base:.9E}",
+                    "base_score": base,
                     "boost_from_average": "1",
                     "num_class": str(self.num_class),
                     "num_feature": str(n_feat),
-                    "num_target": "1",
+                    "num_target": str(self.n_groups if self.num_class == 0
+                                      else 1),
                 },
                 "objective": obj_conf,
             },
@@ -1213,6 +1398,9 @@ class Booster:
         nc = int(lmp.get("num_class", "0"))
         if nc > 0:
             self.params["num_class"] = nc
+        nt = int(lmp.get("num_target", "1") or 1)
+        if nt > 1:
+            self.params["num_target"] = nt
         self._invalidate_config()
         self._configure()
         exact = learner.get("attributes", {}).get("base_margin_exact")
@@ -1222,7 +1410,20 @@ class Booster:
                 vals if vals.size > 1 else vals.reshape(-1)[0],
                 (self.n_groups,)).astype(np.float32).copy()
         else:
-            base_prob = np.float32(float(lmp["base_score"]))
+            # upstream ≥3.x may write a bracketed array "[4.5E-1]" (vector
+            # leaf support, learner.cc LearnerModelParamLegacy); accept both
+            raw = str(lmp["base_score"]).strip().strip("[]()")
+            probs = np.asarray([float(v) for v in raw.replace(",", " ").split()],
+                               np.float32)
+            if probs.size == 0:
+                raise ValueError(
+                    f"Cannot parse base_score {lmp['base_score']!r}")
+            if probs.size not in (1, self.n_groups):
+                raise ValueError(
+                    f"base_score has {probs.size} entries but the model has "
+                    f"{self.n_groups} output groups (multi-target vector "
+                    "leaves are not supported yet)")
+            base_prob = probs if probs.size > 1 else probs.reshape(-1)[0]
             self._base_margin_value = np.broadcast_to(
                 np.asarray(self.objective.prob_to_margin(base_prob), np.float32),
                 (self.n_groups,)).astype(np.float32).copy()
@@ -1250,6 +1451,9 @@ class Booster:
             self.num_parallel_tree = int(
                 gb.get("gbtree_model_param", {}).get("num_parallel_tree", "1") or 1)
             self.params.setdefault("num_parallel_tree", self.num_parallel_tree)
+            if any(t.leaf_vector is not None for t in self.trees):
+                self.params["multi_strategy"] = "multi_output_tree"
+                self.multi_strategy = "multi_output_tree"
         self.attributes = dict(learner.get("attributes", {}))
         self.attributes.pop("base_margin_exact", None)
         self.feature_names = learner.get("feature_names") or None

@@ -236,10 +236,20 @@ class DMatrix:
         """Dense f32 view with NaN missing (prediction walks raw values)."""
         if self._dense is not None:
             return self._dense
+        return self.host_dense_rows(0, self.num_row())
+
+    def host_dense_rows(self, lo: int, hi: int) -> np.ndarray:
+        """Densify only rows [lo, hi) — the bounded-memory window used by the
+        streamed predictor (reference: gpu_predictor.cu:43-90 splits a
+        SparsePage loader from the dense loader for the same reason)."""
+        if self._dense is not None:
+            return self._dense[lo:hi]
         indptr, indices, values, (R, F) = self._csr
-        out = np.full((R, F), np.nan, dtype=np.float32)
-        row_of = np.repeat(np.arange(R), np.diff(indptr))
-        out[row_of, indices] = values
+        hi = min(hi, R)
+        out = np.full((hi - lo, F), np.nan, dtype=np.float32)
+        a, b = indptr[lo], indptr[hi]
+        row_of = np.repeat(np.arange(lo, hi), np.diff(indptr[lo : hi + 1])) - lo
+        out[row_of, indices[a:b]] = values[a:b]
         return out
 
     def cat_mask(self) -> Optional[np.ndarray]:
@@ -251,18 +261,28 @@ class DMatrix:
 
     # ---- binning ----
     def ensure_ellpack(self, max_bin: int = 256, sketch_weights: Optional[np.ndarray] = None,
-                       ref: Optional["DMatrix"] = None) -> EllpackPage:
+                       ref: Optional["DMatrix"] = None,
+                       distributed: bool = False) -> EllpackPage:
         if self._ellpack is not None and self._max_bin_built == max_bin:
             return self._ellpack
         if ref is not None and ref._ellpack is not None:
             cuts = ref._ellpack.cuts  # GetCutsFromRef (quantile_dmatrix.cc:19)
+        elif distributed and self._kind == "dense":
+            # every process holds a row shard: merge the per-shard quantile
+            # summaries into shared cuts (quantile.cc:397 AllreduceV analogue)
+            from .quantile import sketch_distributed
+
+            cuts = sketch_distributed(self._dense, max_bin,
+                                      weights=sketch_weights,
+                                      cat_mask=self.cat_mask())
         elif self._kind == "dense":
             cuts = sketch_dense(self._dense, max_bin, weights=sketch_weights,
                                 cat_mask=self.cat_mask())
         else:
             indptr, indices, values, (R, F) = self._csr
             cuts = sketch_csr(indptr, indices, values, F, max_bin,
-                              weights=sketch_weights, cat_mask=self.cat_mask())
+                              weights=sketch_weights, cat_mask=self.cat_mask(),
+                              distributed=distributed)
         if self._kind == "dense":
             self._ellpack = build_ellpack(self._dense, cuts)
         else:

@@ -118,6 +118,31 @@ def categorical_cuts(n_cats: int) -> np.ndarray:
     return np.arange(1, max(n_cats, 1) + 1, dtype=np.float32)
 
 
+def _assemble_cuts(F: int, max_bin: int, cat_n_cats, num_seg) -> HistogramCuts:
+    """Stitch per-feature cut segments: identity cuts for categorical
+    features (cat_n_cats: {feature -> n_cats}), ``num_seg(f) -> (seg, min)``
+    for numeric ones.  Shared by every sketch flavour."""
+    ptrs, values = [0], []
+    mins = np.zeros(F, np.float32)
+    for f in range(F):
+        if f in cat_n_cats:
+            n_cats = cat_n_cats[f]
+            if n_cats > max_bin:
+                raise ValueError(
+                    f"categorical feature {f} has {n_cats} categories; "
+                    f"raise max_bin (currently {max_bin})")
+            seg = categorical_cuts(n_cats)
+            mins[f] = -1e-5
+        else:
+            seg, mins[f] = num_seg(f)
+        values.append(seg)
+        ptrs.append(ptrs[-1] + len(seg))
+    return HistogramCuts(
+        np.asarray(ptrs, np.int32),
+        np.concatenate(values).astype(np.float32) if values else np.zeros(0, np.float32),
+        mins)
+
+
 def sketch_dense(
     X,
     max_bin: int,
@@ -146,32 +171,15 @@ def sketch_dense(
         base = (sketch_dense(Xh[:, num_idx], max_bin, weights=weights,
                              use_device=use_device)
                 if len(num_idx) else None)
-        ptrs = [0]
-        values = []
-        mins = np.zeros(F, np.float32)
+        cat_n_cats = {}
+        for f in np.nonzero(cat_mask)[0]:
+            col = Xh[:, f]
+            col = col[~np.isnan(col)]
+            cat_n_cats[int(f)] = int(col.max()) + 1 if len(col) else 1
         num_pos = {int(f): i for i, f in enumerate(num_idx)}
-        for f in range(F):
-            if cat_mask[f]:
-                col = Xh[:, f]
-                col = col[~np.isnan(col)]
-                n_cats = int(col.max()) + 1 if len(col) else 1
-                if n_cats > max_bin:
-                    raise ValueError(
-                        f"categorical feature {f} has {n_cats} categories; "
-                        f"raise max_bin (currently {max_bin})"
-                    )
-                seg = categorical_cuts(n_cats)
-                mins[f] = -1e-5
-            else:
-                seg = base.feature_cuts(num_pos[f])
-                mins[f] = base.min_vals[num_pos[f]]
-            values.append(seg)
-            ptrs.append(ptrs[-1] + len(seg))
-        return HistogramCuts(
-            cut_ptrs=np.asarray(ptrs, np.int32),
-            cut_values=np.concatenate(values).astype(np.float32),
-            min_vals=mins,
-        )
+        return _assemble_cuts(
+            F, max_bin, cat_n_cats,
+            lambda f: (base.feature_cuts(num_pos[f]), base.min_vals[num_pos[f]]))
 
     if weights is not None:
         return _sketch_weighted_host(np.asarray(Xn, dtype=np.float32), max_bin, np.asarray(weights))
@@ -203,6 +211,15 @@ def sketch_dense(
 
 
 def _sketch_weighted_host(X: np.ndarray, max_bin: int, w: Optional[np.ndarray]) -> HistogramCuts:
+    return cuts_from_quantile_grid(*_host_grid(X, max_bin, w)[:4])
+
+
+def _host_grid(X: np.ndarray, max_bin: int, w: Optional[np.ndarray]):
+    """Per-feature quantile candidate grid (F, max_bin-1) + stats — the
+    fixed-size 'summary' exchanged by the distributed sketch merge.
+    Returns (grid, nvalid, vmax, vmin, mass); mass is the per-feature total
+    sample weight (== nvalid when unweighted), the quantity that weights this
+    shard's candidates in the merge."""
     R, F = X.shape
     n_cand = max(max_bin - 1, 1)
     grid = np.full((F, n_cand), np.inf, dtype=np.float32)
@@ -232,17 +249,112 @@ def _sketch_weighted_host(X: np.ndarray, max_bin: int, w: Optional[np.ndarray]) 
             else:
                 idx = np.searchsorted(cdf, qs * tot, side="left")
                 grid[f] = sv[np.clip(idx, 0, len(sv) - 1)].astype(np.float32)
+    if w is None:
+        mass = nvalid.astype(np.float64)
+    else:
+        wq = np.asarray(w, np.float64)
+        mass = np.array([wq[~np.isnan(X[:, f])].sum() for f in range(F)])
+    return grid, nvalid, vmax, vmin, mass
+
+
+def merge_quantile_grids(grids: np.ndarray, nvalids: np.ndarray,
+                         vmaxs: np.ndarray, vmins: np.ndarray,
+                         max_bin: int,
+                         masses: Optional[np.ndarray] = None) -> HistogramCuts:
+    """Merge per-worker quantile grids into shared cuts.
+
+    The TPU-shaped analogue of the reference's summary allreduce
+    (src/common/quantile.cc:397-442 SketchContainer::AllReduce): instead of
+    merging GK summaries with rank bounds, every worker contributes a
+    fixed-size quantile grid whose k-th worker candidates each carry an equal
+    share of that worker's total sample-weight mass (masses[k,f], == nvalid
+    when unweighted); the merged cuts are inverted-CDF quantiles of the
+    weighted union.  Deterministic given the gathered inputs, so every worker
+    computes bitwise-identical cuts.
+
+    grids: (W, F, Q), nvalids/masses: (W, F), vmaxs/vmins: (W, F).
+    """
+    W, F, Q = grids.shape
+    if masses is None:
+        masses = nvalids.astype(np.float64)
+    n_cand = max(max_bin - 1, 1)
+    qs = np.arange(1, n_cand + 1, dtype=np.float64) / (n_cand + 1)
+    grid = np.full((F, n_cand), np.inf, dtype=np.float32)
+    nvalid = nvalids.sum(axis=0).astype(np.int64)
+    vmax = np.zeros(F, dtype=np.float32)
+    vmin = np.zeros(F, dtype=np.float32)
+    for f in range(F):
+        has = nvalids[:, f] > 0
+        if not has.any():
+            continue
+        vmax[f] = vmaxs[has, f].max()
+        vmin[f] = vmins[has, f].min()
+        cand_list, w_list = [], []
+        for k in np.nonzero(has)[0]:
+            c = grids[k, f]
+            c = c[np.isfinite(c)]
+            if len(c) == 0:
+                continue
+            cand_list.append(c.astype(np.float64))
+            w_list.append(np.full(len(c), masses[k, f] / len(c), np.float64))
+        cand = np.concatenate(cand_list)
+        wts = np.concatenate(w_list)
+        order = np.argsort(cand, kind="stable")
+        sv, sw = cand[order], wts[order]
+        cdf = np.cumsum(sw)
+        idx = np.searchsorted(cdf, qs * cdf[-1], side="left")
+        grid[f] = sv[np.clip(idx, 0, len(sv) - 1)].astype(np.float32)
     return cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
+
+
+def sketch_distributed(X, max_bin: int, weights: Optional[np.ndarray] = None,
+                       cat_mask: Optional[np.ndarray] = None) -> HistogramCuts:
+    """Shared cuts across processes, each holding a row shard of X.
+
+    Local fixed-size grid -> collective.allgather -> deterministic merge;
+    categorical features take identity cuts sized by the global category max.
+    """
+    from .. import collective
+
+    Xh = np.asarray(X, dtype=np.float32)
+    F = Xh.shape[1]
+    if cat_mask is not None and np.any(cat_mask):
+        num_idx = np.nonzero(~np.asarray(cat_mask))[0]
+        base = (sketch_distributed(Xh[:, num_idx], max_bin, weights=weights)
+                if len(num_idx) else None)
+        # global category count via MAX-allreduce of local maxima
+        local_max = np.full(F, -1.0, np.float32)
+        for f in np.nonzero(cat_mask)[0]:
+            col = Xh[:, f]
+            col = col[~np.isnan(col)]
+            if len(col):
+                local_max[f] = col.max()
+        global_max = collective.allreduce(local_max, collective.Op.MAX)
+        cat_n_cats = {int(f): (int(global_max[f]) + 1 if global_max[f] >= 0 else 1)
+                      for f in np.nonzero(cat_mask)[0]}
+        num_pos = {int(f): i for i, f in enumerate(num_idx)}
+        return _assemble_cuts(
+            F, max_bin, cat_n_cats,
+            lambda f: (base.feature_cuts(num_pos[f]), base.min_vals[num_pos[f]]))
+
+    grid, nvalid, vmax, vmin, mass = _host_grid(Xh, max_bin, weights)
+    return merge_quantile_grids(
+        collective.allgather(grid), collective.allgather(nvalid),
+        collective.allgather(vmax), collective.allgather(vmin), max_bin,
+        masses=collective.allgather(mass))
 
 
 def sketch_csr(indptr, indices, values, n_features: int, max_bin: int,
                weights: Optional[np.ndarray] = None,
-               cat_mask: Optional[np.ndarray] = None) -> HistogramCuts:
+               cat_mask: Optional[np.ndarray] = None,
+               distributed: bool = False) -> HistogramCuts:
     """Sketch a CSR matrix column-by-column on host (sparse ingest path).
 
     Implicit zeros in sparse input are treated as missing, matching the
     reference's sparse DMatrix semantics (only stored entries are sketched,
     src/common/hist_util.cc SketchOnDMatrix walks nonzeros).
+    ``distributed=True``: this process holds a row shard — the per-feature
+    grids are merged across processes without ever densifying the shard.
     """
     R = len(indptr) - 1
     n_cand = max(max_bin - 1, 1)
@@ -250,6 +362,8 @@ def sketch_csr(indptr, indices, values, n_features: int, max_bin: int,
     nvalid = np.zeros(n_features, dtype=np.int64)
     vmax = np.zeros(n_features, dtype=np.float32)
     vmin = np.zeros(n_features, dtype=np.float32)
+    mass = np.zeros(n_features, dtype=np.float64)
+    cat_local_max = np.full(n_features, -1.0, np.float32)
     qs = np.arange(1, n_cand + 1, dtype=np.float64) / (n_cand + 1)
     # bucket values per column
     order = np.argsort(indices, kind="stable")
@@ -258,44 +372,49 @@ def sketch_csr(indptr, indices, values, n_features: int, max_bin: int,
     starts = np.searchsorted(col_sorted, np.arange(n_features + 1))
     if weights is not None:
         row_of = np.repeat(np.arange(R), np.diff(indptr))[order]
-    cat_cuts = {}
+    is_cat = np.zeros(n_features, bool) if cat_mask is None else np.asarray(cat_mask)
     for f in range(n_features):
         seg = val_sorted[starts[f] : starts[f + 1]].astype(np.float32)
         keep = ~np.isnan(seg)
         vals = seg[keep]
-        nvalid[f] = len(vals)
-        if cat_mask is not None and cat_mask[f]:
+        if is_cat[f]:
             # NOTE: CSR categorical needs explicit storage — implicit zeros
-            # are missing, so category 0 must be stored explicitly
-            n_cats = int(vals.max()) + 1 if len(vals) else 1
-            if n_cats > max_bin:
-                raise ValueError(
-                    f"categorical feature {f} has {n_cats} categories; "
-                    f"raise max_bin (currently {max_bin})")
-            cat_cuts[f] = categorical_cuts(n_cats)
+            # are missing, so category 0 must be stored explicitly.
+            # nvalid stays 0: cat features are excluded from the numeric
+            # grid merge (their cuts come from the category max below)
+            if len(vals):
+                cat_local_max[f] = vals.max()
             continue
+        nvalid[f] = len(vals)
         if len(vals) == 0:
             continue
         vmax[f], vmin[f] = vals.max(), vals.min()
         if weights is None:
+            mass[f] = len(vals)
             grid[f] = np.quantile(vals, qs, method="inverted_cdf").astype(np.float32)
         else:
             wf = weights[row_of[starts[f] : starts[f + 1]]][keep].astype(np.float64)
             o = np.argsort(vals, kind="stable")
             sv, sw = vals[o], wf[o]
             cdf = np.cumsum(sw)
+            mass[f] = cdf[-1]
             idx = np.searchsorted(cdf, qs * cdf[-1], side="left")
             grid[f] = sv[np.clip(idx, 0, len(sv) - 1)].astype(np.float32)
-    base = cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
-    if not cat_cuts:
+    if distributed:
+        from .. import collective
+
+        base = merge_quantile_grids(
+            collective.allgather(grid), collective.allgather(nvalid),
+            collective.allgather(vmax), collective.allgather(vmin), max_bin,
+            masses=collective.allgather(mass))
+        cat_global_max = collective.allreduce(cat_local_max, collective.Op.MAX)
+    else:
+        base = cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
+        cat_global_max = cat_local_max
+    if not is_cat.any():
         return base
-    ptrs, values_out = [0], []
-    mins = base.min_vals.copy()
-    for f in range(n_features):
-        seg = cat_cuts.get(f, base.feature_cuts(f))
-        if f in cat_cuts:
-            mins[f] = -1e-5
-        values_out.append(seg)
-        ptrs.append(ptrs[-1] + len(seg))
-    return HistogramCuts(np.asarray(ptrs, np.int32),
-                         np.concatenate(values_out).astype(np.float32), mins)
+    cat_n_cats = {int(f): (int(cat_global_max[f]) + 1 if cat_global_max[f] >= 0 else 1)
+                  for f in np.nonzero(is_cat)[0]}
+    return _assemble_cuts(
+        n_features, max_bin, cat_n_cats,
+        lambda f: (base.feature_cuts(f), base.min_vals[f]))

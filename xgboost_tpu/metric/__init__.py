@@ -44,6 +44,8 @@ def _w(labels, weights):
 
 def _wmean(err, labels, weights):
     w = _w(labels if err.ndim == 1 else err[:, 0], weights)
+    if err.ndim == 2:  # multi-target: mean over rows x targets
+        return float(np.sum(err * w[:, None]) / (np.sum(w) * err.shape[1]))
     return float(np.sum(err * w) / np.sum(w))
 
 

@@ -31,6 +31,10 @@ class RegTree:
     split_bins: Optional[np.ndarray] = None  # int32, internal (binned predict)
     split_type: Optional[np.ndarray] = None  # 0 numeric, 1 categorical
     categories: Optional[dict] = None  # nid -> int32 array of cats routed RIGHT
+    # vector leaves (multi_target_tree_model.h): (n, K) per-node value/weight;
+    # None for scalar trees.  Leaves' split_conditions are 0 when set.
+    leaf_vector: Optional[np.ndarray] = None
+    base_weight_vec: Optional[np.ndarray] = None
 
     @property
     def n_nodes(self) -> int:
@@ -107,6 +111,68 @@ class RegTree:
     def has_categorical(self) -> bool:
         return bool(self.categories)
 
+    @property
+    def n_targets(self) -> int:
+        return 1 if self.leaf_vector is None else self.leaf_vector.shape[1]
+
+    # ---- construction from the vector-leaf grower ----
+    @staticmethod
+    def from_grown_multi(gt, n_targets: int) -> "RegTree":
+        """Compact a grow_multi.GrownMultiTree (heap arrays, K-wide values)."""
+        order: list = []
+        id_of = {0: 0}
+        queue = [0]
+        while queue:
+            h = queue.pop(0)
+            order.append(h)
+            if gt.feat[h] >= 0 and not gt.is_leaf[h]:
+                for c in (2 * h + 1, 2 * h + 2):
+                    id_of[c] = len(order) + len(queue)
+                    queue.append(c)
+        n = len(order)
+        K = n_targets
+        t = RegTree(
+            left_children=np.full(n, -1, np.int32),
+            right_children=np.full(n, -1, np.int32),
+            parents=np.full(n, -1, np.int32),
+            split_indices=np.zeros(n, np.int32),
+            split_conditions=np.zeros(n, np.float32),
+            default_left=np.zeros(n, bool),
+            base_weights=np.zeros(n, np.float32),
+            loss_changes=np.zeros(n, np.float32),
+            sum_hessian=np.zeros(n, np.float32),
+            split_bins=np.zeros(n, np.int32),
+            split_type=np.zeros(n, np.int32),
+            categories={},
+            leaf_vector=np.zeros((n, K), np.float32),
+            base_weight_vec=np.zeros((n, K), np.float32),
+        )
+        leaf_rank = 0
+        for h in order:
+            i = id_of[h]
+            t.base_weight_vec[i] = gt.base_weight[h]
+            t.base_weights[i] = gt.base_weight[h][0]
+            t.sum_hessian[i] = gt.sum_hess[h]
+            t.default_left[i] = gt.dleft[h]
+            if gt.feat[h] >= 0 and not gt.is_leaf[h]:
+                t.left_children[i] = id_of[2 * h + 1]
+                t.right_children[i] = id_of[2 * h + 2]
+                t.parents[id_of[2 * h + 1]] = i
+                t.parents[id_of[2 * h + 2]] = i
+                t.split_indices[i] = gt.feat[h]
+                t.split_conditions[i] = gt.thr[h]
+                t.split_bins[i] = gt.sbin[h]
+                t.loss_changes[i] = gt.gain[h]
+            else:
+                t.leaf_vector[i] = gt.leaf_val[h]
+        # reference invariant (multi_target_tree_model.cc SetLeaves): a
+        # leaf's right_children slot holds its index into leaf_weights
+        for i in range(n):
+            if t.left_children[i] == -1:
+                t.right_children[i] = leaf_rank
+                leaf_rank += 1
+        return t
+
     # ---- padded arrays for the vectorized predictor ----
     def padded_arrays(self, width: int):
         n = self.n_nodes
@@ -123,7 +189,7 @@ class RegTree:
               else np.zeros(n, np.int32))
         sbin = (self.split_bins if self.split_bins is not None
                 else np.zeros(n, np.int32))
-        return dict(
+        out = dict(
             feat=pad(feat, -1),
             thr=pad(np.where(self.left_children == -1, np.float32(0), self.split_conditions)),
             dleft=pad(self.default_left.astype(np.bool_)),
@@ -133,6 +199,11 @@ class RegTree:
             is_cat=pad((st == 1)),
             sbin=pad(sbin.astype(np.int32)),
         )
+        if self.leaf_vector is not None:
+            vv = np.zeros((width, self.n_targets), np.float32)
+            vv[:n] = self.leaf_vector
+            out["value_vec"] = vv
+        return out
 
     def cat_matrix(self, width: int, n_cats: int) -> np.ndarray:
         """(width, n_cats) bool membership matrix of right-routed categories."""
@@ -161,13 +232,13 @@ class RegTree:
                 cat_segs.append(len(cat_flat))
                 cat_sizes.append(len(cats))
                 cat_flat.extend(int(c) for c in cats)
-        return {
+        out = {
             # GBTreeModel::LoadModel CHECKs trees[t]["id"] == t (gbtree_model.cc)
             "id": int(tree_id),
             "tree_param": {
                 "num_nodes": str(n),
                 "num_feature": str(n_features),
-                "size_leaf_vector": "1",
+                "size_leaf_vector": str(self.n_targets),
             },
             "left_children": self.left_children.tolist(),
             "right_children": self.right_children.tolist(),
@@ -184,6 +255,21 @@ class RegTree:
             "loss_changes": [float(x) for x in self.loss_changes],
             "sum_hessian": [float(x) for x in self.sum_hessian],
         }
+        if self.leaf_vector is not None:
+            # vector-leaf schema (multi_target_tree_model.cc SaveModel):
+            # base_weights is n*K row-major; leaf_weights is n_leaves*K with
+            # each leaf's index stored in its right_children slot (the
+            # reference reuses the right child as the leaf-weight mapping,
+            # SetLeaves / LeafValue's lidx = right_[nidx])
+            out["base_weights"] = [float(x)
+                                   for x in self.base_weight_vec.reshape(-1)]
+            leaf_ids = np.nonzero(self.left_children == -1)[0]
+            n_leaves = len(leaf_ids)
+            lw = np.zeros((n_leaves, self.n_targets), np.float32)
+            for nid in leaf_ids:
+                lw[int(self.right_children[nid])] = self.leaf_vector[nid]
+            out["leaf_weights"] = [float(x) for x in lw.reshape(-1)]
+        return out
 
     @staticmethod
     def from_json_dict(d: dict) -> "RegTree":
@@ -193,7 +279,27 @@ class RegTree:
                                   d.get("categories_segments", []),
                                   d.get("categories_sizes", [])):
             cats[int(nid)] = np.asarray(flat[seg : seg + size], np.int32)
+        n = len(d["left_children"])
+        K = int(d.get("tree_param", {}).get("size_leaf_vector", "1") or 1)
+        leaf_vector = base_weight_vec = None
+        base_weights = np.asarray(
+            d.get("base_weights", np.zeros(n)), np.float32)
+        if K > 1:
+            base_weight_vec = base_weights.reshape(n, K)
+            base_weights = base_weight_vec[:, 0]
+            left = np.asarray(d["left_children"], np.int32)
+            right = np.asarray(d["right_children"], np.int32)
+            leaf_ids = np.nonzero(left == -1)[0]
+            lw = np.asarray(d.get("leaf_weights", []), np.float32).reshape(
+                len(leaf_ids), K)
+            leaf_vector = np.zeros((n, K), np.float32)
+            # right_children holds each leaf's index into leaf_weights
+            # (multi_target_tree_model.cc LeafValue: lidx = right_[nidx])
+            for nid in leaf_ids:
+                leaf_vector[nid] = lw[int(right[nid])]
         return RegTree(
+            leaf_vector=leaf_vector,
+            base_weight_vec=base_weight_vec,
             categories=cats or None,
             left_children=np.asarray(d["left_children"], np.int32),
             right_children=np.asarray(d["right_children"], np.int32),
@@ -201,7 +307,7 @@ class RegTree:
             split_indices=np.asarray(d["split_indices"], np.int32),
             split_conditions=np.asarray(d["split_conditions"], np.float32),
             default_left=np.asarray(d["default_left"]).astype(bool),
-            base_weights=np.asarray(d.get("base_weights", np.zeros(len(d["left_children"]))), np.float32),
+            base_weights=base_weights,
             loss_changes=np.asarray(d.get("loss_changes", np.zeros(len(d["left_children"]))), np.float32),
             sum_hessian=np.asarray(d.get("sum_hessian", np.zeros(len(d["left_children"]))), np.float32),
             split_type=np.asarray(d.get("split_type", np.zeros(len(d["left_children"])))).astype(np.int32),

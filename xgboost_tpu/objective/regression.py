@@ -28,10 +28,20 @@ def _pack(grad, hess, weights):
 
 
 class _Elementwise(ObjFunction):
-    def _grad(self, pred, y):  # -> (grad, hess), 1-D
+    def _grad(self, pred, y):  # -> (grad, hess), elementwise (any shape)
         raise NotImplementedError
 
+    def n_groups(self) -> int:
+        # multi-output regression: one output column per target
+        # (reference: LearnerModelParam num_target / MultiStrategy)
+        return max(int(self.params.get("num_target", 1) or 1), 1)
+
     def get_gradient(self, preds, labels, weights, iteration: int = 0):
+        K = self.n_groups()
+        if K > 1:
+            y = labels.astype(jnp.float32).reshape(labels.shape[0], -1)
+            g, h = self._grad(preds, y)  # elementwise on (R, K)
+            return _pack(g, h, weights)
         pred = preds[:, 0] if preds.ndim == 2 else preds
         g, h = self._grad(pred, labels.astype(jnp.float32))
         return _pack(g, h, weights)
@@ -43,6 +53,11 @@ class SquaredError(_Elementwise):
         return pred - y, jnp.ones_like(pred)
 
     def init_estimation(self, labels, weights):
+        if labels.ndim == 2:  # per-target mean (fit_stump.cc multi-target)
+            w = (jnp.ones(labels.shape[0]) if weights is None
+                 else weights).astype(jnp.float32)
+            return jnp.sum(labels * w[:, None], axis=0) / jnp.maximum(
+                jnp.sum(w), 1e-6)
         w = jnp.ones_like(labels) if weights is None else weights
         return jnp.sum(labels * w) / jnp.maximum(jnp.sum(w), 1e-6)
 

@@ -76,6 +76,28 @@ def predict_margin_delta(X, feat, thr, dleft, left, right, value, groups,
 
 
 @functools.partial(jax.jit, static_argnames=("depth",))
+def predict_margin_delta_multi(X, feat, thr, dleft, left, right, value_vec,
+                               *, depth: int):
+    """Vector-leaf ensemble margins: every tree adds its leaf's K-vector to
+    all outputs (reference: MultiTargetTree prediction,
+    cpu_predictor.cc PredictBatchByBlockKernel vector-leaf path).
+
+    value_vec: (T, M, K) padded per-node leaf vectors."""
+    R = X.shape[0]
+    K = value_vec.shape[2]
+
+    def body(margin, t):
+        f, th, dl, l, r, v = t
+        nid = _traverse_one_tree(X, f, th, dl, l, r, depth)
+        return margin + v[nid], None
+
+    margin0 = jnp.zeros((R, K), jnp.float32)
+    margin, _ = lax.scan(body, margin0,
+                         (feat, thr, dleft, left, right, value_vec))
+    return margin
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
 def predict_leaf_ids(X, feat, thr, dleft, left, right, *, depth: int):
     """(R, T) leaf indices (reference: Predictor::PredictLeaf)."""
     def body(_, t):

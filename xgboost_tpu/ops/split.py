@@ -54,6 +54,21 @@ class BestSplit(NamedTuple):
     #                        semantics: common/categorical.h Decision)
 
 
+class BestSplitMulti(NamedTuple):
+    """Vector-leaf split decision (reference: multi_evaluate_splits.cu /
+    HistMultiEvaluator): one (feature, bin) for all targets, per-target
+    child statistics."""
+
+    gain: jnp.ndarray  # (N,)
+    feature: jnp.ndarray  # (N,) int32
+    bin: jnp.ndarray  # (N,) int32
+    default_left: jnp.ndarray  # (N,) bool
+    left_sum: jnp.ndarray  # (N, K, 2)
+    right_sum: jnp.ndarray  # (N, K, 2)
+    left_weight: jnp.ndarray  # (N, K)
+    right_weight: jnp.ndarray  # (N, K)
+
+
 def _threshold_l1(g, alpha):
     return jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0)
 
@@ -84,6 +99,93 @@ def calc_gain(G, H, p: SplitParams):
     # CalcGainGivenWeight: -(2 G w + (H + lambda) w^2), with L1 adjustment
     ret = -(2.0 * _threshold_l1(G, p.alpha) * w + (H + p.lambda_) * w * w)
     return jnp.where(H <= 0.0, 0.0, ret)
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def evaluate_splits_multi(hist, totals, n_bins, params: SplitParams,
+                          feature_mask=None) -> BestSplitMulti:
+    """Best split per node for vector-leaf trees.
+
+    hist   : (N, F, B, K, 2) f32 — per-target bin (G, H) sums
+    totals : (N, K, 2) f32 — per-target node totals (incl. missing rows)
+
+    Gain is the SUM of per-target gains for a shared (feature, bin) — the
+    reference's multi-target objective (multi_evaluate_splits.cu accumulates
+    per-target CalcGain under one split).  min_child_weight applies to the
+    mean per-target hessian, matching the "average tree" reading used by the
+    CPU HistMultiEvaluator.  Monotone/categorical are handled by the caller
+    (unsupported for multi-target in round 2, like the reference's own
+    multi_output_tree restrictions).
+    """
+    N, F, B, K, _ = hist.shape
+
+    cum = jnp.cumsum(hist, axis=2)  # (N,F,B,K,2) left sums; missing -> right
+    feat_sum = cum[:, :, -1]  # (N,F,K,2)
+    miss = totals[:, None] - feat_sum  # (N,F,K,2)
+
+    GL_r, HL_r = cum[..., 0], cum[..., 1]  # (N,F,B,K) missing -> right
+    GL_l = GL_r + miss[:, :, None, :, 0]
+    HL_l = HL_r + miss[:, :, None, :, 1]
+
+    parent_gain = calc_gain(totals[..., 0], totals[..., 1], params).sum(-1)[
+        :, None, None]  # (N,1,1)
+
+    def side_gain(GL, HL):
+        GR = totals[:, None, None, :, 0] - GL
+        HR = totals[:, None, None, :, 1] - HL
+        gain = (calc_gain(GL, HL, params) + calc_gain(GR, HR, params)).sum(-1) \
+            - parent_gain  # (N,F,B)
+        HLm, HRm = HL.mean(-1), HR.mean(-1)
+        valid = ((HLm >= params.min_child_weight)
+                 & (HRm >= params.min_child_weight)
+                 & (HLm > 0.0) & (HRm > 0.0))
+        return jnp.where(valid, gain, -jnp.inf), GR, HR
+
+    gain_r, GR_r, HR_r = side_gain(GL_r, HL_r)
+    gain_l, GR_l, HR_l = side_gain(GL_l, HL_l)
+
+    bin_idx = jnp.arange(B, dtype=jnp.int32)
+    bin_ok = bin_idx[None, :] < (n_bins[:, None] - 1)  # (F,B)
+    top_ok = (bin_idx[None, :] == (n_bins[:, None] - 1)) & (
+        jnp.abs(miss[..., 1]).sum(-1)[:, :, None] > _EPS)
+    ok = bin_ok[None] | top_ok
+    if feature_mask is not None:
+        fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
+        ok = ok & fm[:, :, None]
+    gain_r = jnp.where(ok, gain_r, -jnp.inf)
+    gain_l = jnp.where(ok, gain_l, -jnp.inf)
+    use_left = gain_l >= gain_r
+    gain = jnp.where(use_left, gain_l, gain_r)
+
+    flat = gain.reshape(N, F * B)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    best_f = (best // B).astype(jnp.int32)
+    best_b = (best % B).astype(jnp.int32)
+
+    def pick(arr):  # (N,F,B,K) -> (N,K) at the best (feature, bin)
+        return jnp.take_along_axis(
+            arr.reshape(N, F * B, K), best[:, None, None], axis=1)[:, 0]
+
+    def pick2(arr):  # (N,F,B) -> (N,)
+        return jnp.take_along_axis(arr.reshape(N, F * B), best[:, None], axis=1)[:, 0]
+
+    dleft = pick2(use_left)
+    GL = jnp.where(dleft[:, None], pick(GL_l), pick(GL_r))
+    HL = jnp.where(dleft[:, None], pick(HL_l), pick(HL_r))
+    GR = jnp.where(dleft[:, None], pick(GR_l), pick(GR_r))
+    HR = jnp.where(dleft[:, None], pick(HR_l), pick(HR_r))
+
+    return BestSplitMulti(
+        gain=best_gain,
+        feature=best_f,
+        bin=best_b,
+        default_left=dleft,
+        left_sum=jnp.stack([GL, HL], axis=-1),
+        right_sum=jnp.stack([GR, HR], axis=-1),
+        left_weight=calc_weight(GL, HL, params),
+        right_weight=calc_weight(GR, HR, params),
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("params",))

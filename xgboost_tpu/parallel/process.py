@@ -1,0 +1,115 @@
+"""Multi-process (multi-host) tree grower.
+
+The reference's distributed training (SURVEY §3.4; dask/__init__.py:722
+_train_async -> rabit allreduce inside each updater) keeps every worker on
+its own row shard and reduces exactly three things: the root gradient sum,
+the per-level histograms, and eval metrics.  This grower reproduces that
+shape for the multi-*process* case: each process runs the jitted device
+pieces (histogram build, split decide, position update — shared with the
+in-core growers) on its local rows, and the fixed-size histogram crosses
+processes through ``collective.allreduce`` between the build and decide
+steps, the role NCCL allreduce plays in updater_gpu_hist.cu:598.  The root
+gradient sum is reduced here too; eval metrics are globalized in
+``Booster.eval_set`` (shard gather), so early stopping stays in lockstep.
+
+Within a process the single-chip path is used; combine with the shard_map
+grower by giving each process its own chip(s) (process-level DP x chip-level
+DP).  Determinism: the host allreduce is an ordered f32 sum over the gathered
+(world, ...) stack, so every process sees bitwise-identical histograms and
+grows bitwise-identical trees — the property the reference engineers via
+quantised integer allreduce (quantiser.cuh).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import collective
+from ..ops.histogram import build_histogram, node_sums
+from ..ops.split import SplitParams
+from ..tree.grow import (TreeState, init_tree_state, make_set_matrix,
+                         max_nodes_for_depth)
+from ..tree.stream import _decide_level, _page_step
+
+
+class ProcessHistTreeGrower:
+    """Drop-in for HistTreeGrower when jax.process_count() > 1 (or when the
+    host-level collective is initialized for a CPU multi-process test)."""
+
+    def __init__(self, max_depth: int, params: SplitParams, *,
+                 interaction_sets=None, max_leaves: int = 0,
+                 lossguide: bool = False, subtract: bool = True) -> None:
+        self.max_depth = max_depth
+        self.params = params
+        self.interaction_sets = interaction_sets
+        self.max_leaves = max_leaves
+        self.lossguide = lossguide
+        self.subtract = subtract
+        self.max_nodes = max_nodes_for_depth(max_depth)
+
+    def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None,
+             cat_mask=None) -> TreeState:
+        F = bins.shape[1]
+        B = cuts_pad.shape[1]
+        has_cat = cat_mask is not None
+        cm = jnp.asarray(cat_mask) if has_cat else jnp.zeros(0, bool)
+        setmat = jnp.asarray(make_set_matrix(self.interaction_sets, F))
+        ones = jnp.ones((1, F), dtype=bool)
+        state = init_tree_state(
+            gpair, valid, max_nodes=self.max_nodes, n_sets=setmat.shape[0],
+            max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
+            n_bin=B,
+        )
+        # root totals: GlobalSum across processes (updater_gpu_hist.cu:581)
+        root = collective.allreduce(
+            np.asarray(node_sums(gpair, state.pos, node0=0, n_nodes=1)))
+        state = state._replace(
+            totals=state.totals.at[0].set(jnp.asarray(root[0])))
+
+        prev_best, prev_can, prev_d = None, None, -1
+        hist_prev = None
+        for d in range(self.max_depth + 1):
+            build = d < self.max_depth
+            subtract = self.subtract and build and d > 0 and hist_prev is not None
+            node0 = (1 << d) - 1
+            N = 1 << d
+            n_build = (N // 2) if subtract else N
+            pos, h = _page_step(
+                bins, gpair, state.pos, prev_best, prev_can,
+                node0_prev=(1 << prev_d) - 1 if prev_d >= 0 else 0,
+                n_prev=1 << max(prev_d, 0), node0=node0, n_nodes=n_build,
+                n_bin=B, has_prev=prev_best is not None, has_cat=has_cat,
+                build=build, stride=2 if subtract else 1,
+            )
+            state = state._replace(pos=pos)
+            if build:
+                # the one cross-process exchange per level (AllReduceHist)
+                hist = jnp.asarray(collective.allreduce(np.asarray(h)))
+                if subtract:
+                    right = hist_prev - hist
+                    hist = jnp.stack([hist, right], axis=1).reshape(
+                        N, *hist.shape[1:])
+                    alive_lvl = jax.lax.dynamic_slice_in_dim(
+                        state.alive, node0, N)
+                    hist = hist * alive_lvl[:, None, None, None]
+                hist_prev = hist
+            else:
+                hist = jnp.zeros((N, F, B, 2), jnp.float32)
+            fm = ones if feature_masks is None else feature_masks(d, N)
+            state, best, can = _decide_level(
+                state, hist, n_bins, cuts_pad, fm, setmat, cm,
+                depth=d, params=self.params, lossguide=self.lossguide,
+                last_level=(d == self.max_depth),
+            )
+            prev_best, prev_can, prev_d = best, can, d
+        return state
+
+    @staticmethod
+    def to_host(state: TreeState):
+        from ..tree.grow import HistTreeGrower
+
+        return HistTreeGrower.to_host(state)

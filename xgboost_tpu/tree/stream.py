@@ -110,13 +110,26 @@ class StreamingHistTreeGrower:
 
     def __init__(self, max_depth: int, params: SplitParams, *,
                  interaction_sets=None, max_leaves: int = 0,
-                 lossguide: bool = False) -> None:
+                 lossguide: bool = False, mesh=None) -> None:
         self.max_depth = max_depth
         self.params = params
         self.interaction_sets = interaction_sets
         self.max_leaves = max_leaves
         self.lossguide = lossguide
+        # multi-device: pages are row-sharded over the mesh at transfer time
+        # and GSPMD partitions the histogram matmul (hist reduce = the XLA
+        # collective the reference gets from NCCL AllReduceHist); page rows
+        # are PAGE_ALIGN(=1024)-aligned so every shard is equal
+        self.mesh = mesh
         self.max_nodes = max_nodes_for_depth(max_depth)
+
+    def _put_page(self, page_np):
+        arr = np.ascontiguousarray(page_np)
+        if self.mesh is None:
+            return jax.device_put(arr)
+        from ..parallel.mesh import row2d_sharding
+
+        return jax.device_put(arr, row2d_sharding(self.mesh))
 
     def grow(self, pages: List, page_offsets: List[int], gpair, valid,
              cuts_pad, n_bins, feature_masks=None, cat_mask=None) -> TreeState:
@@ -143,12 +156,12 @@ class StreamingHistTreeGrower:
             n_build = (N // 2) if subtract else N
             hist_acc = None
             # prefetch pipeline: page i+1 ships while page i computes
-            next_dev = jax.device_put(np.ascontiguousarray(pages[0])) if n_pages else None
+            next_dev = self._put_page(pages[0]) if n_pages else None
             pos = state.pos
             for i in range(n_pages):
                 dev = next_dev
                 if i + 1 < n_pages:
-                    next_dev = jax.device_put(np.ascontiguousarray(pages[i + 1]))
+                    next_dev = self._put_page(pages[i + 1])
                 lo, hi = page_offsets[i], page_offsets[i + 1]
                 seg_len = hi - lo
                 pos_seg = lax.dynamic_slice_in_dim(pos, lo, seg_len)
