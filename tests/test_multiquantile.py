@@ -1,0 +1,88 @@
+"""Multi-quantile / multi-expectile objectives + the pre/ams/expectile
+metrics (reference: quantile_obj.cu, regression_obj.cu ExpectileRegression,
+rank_metric.cc EvalPrecision/EvalAMS, elementwise_metric.cu ExpectileError)."""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.metric import create_metric
+
+
+def test_multi_quantile_training():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 6)).astype(np.float32)
+    y = (X[:, 0] * 2 + rng.normal(scale=1.0, size=2000)).astype(np.float32)
+    d = xtb.DMatrix(X, label=y)
+    alphas = [0.1, 0.5, 0.9]
+    res = {}
+    bst = xtb.train({"objective": "reg:quantileerror",
+                     "quantile_alpha": alphas, "max_depth": 4, "eta": 0.3},
+                    d, 15, evals=[(d, "t")], evals_result=res,
+                    verbose_eval=False)
+    p = bst.predict(d)
+    assert p.shape == (2000, 3)
+    # quantile ordering holds on average and empirical coverage is sane
+    cov = [(y <= p[:, k]).mean() for k in range(3)]
+    assert cov[0] < cov[1] < cov[2]
+    assert abs(cov[0] - 0.1) < 0.1 and abs(cov[2] - 0.9) < 0.1
+    assert res["t"]["quantile"][-1] < res["t"]["quantile"][0]
+
+
+def test_multi_expectile_training():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(1500, 5)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * rng.normal(size=1500)).astype(np.float32)
+    d = xtb.DMatrix(X, label=y)
+    res = {}
+    bst = xtb.train({"objective": "reg:expectileerror",
+                     "expectile_alpha": [0.2, 0.5, 0.8], "max_depth": 4},
+                    d, 15, evals=[(d, "t")], evals_result=res,
+                    verbose_eval=False)
+    p = bst.predict(d)
+    assert p.shape == (1500, 3)
+    # expectile direction: higher alpha -> higher prediction
+    assert p[:, 0].mean() < p[:, 1].mean() < p[:, 2].mean()
+    assert res["t"]["expectile"][-1] < res["t"]["expectile"][0]
+
+
+def test_single_quantile_still_scalar():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(800, 4)).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    bst = xtb.train({"objective": "reg:quantileerror", "quantile_alpha": 0.5,
+                     "max_depth": 3}, xtb.DMatrix(X, label=y), 5,
+                    verbose_eval=False)
+    assert bst.predict(xtb.DMatrix(X)).ndim == 1
+
+
+def test_precision_metric():
+    fn, _ = create_metric("pre@3")
+    preds = np.array([9, 8, 7, 6, 5, 4], np.float64)
+    labels = np.array([1, 0, 1, 1, 0, 1], np.float64)
+    # top-3 by pred = labels [1,0,1] -> 2/3
+    assert abs(fn(preds, labels) - 2 / 3) < 1e-12
+    # two groups
+    gp = np.array([0, 3, 6])
+    v = fn(preds, labels, group_ptr=gp)
+    assert abs(v - ((2 / 3 + 2 / 3) / 2)) < 1e-12
+
+
+def test_ams_metric():
+    fn, _ = create_metric("ams@0.5")
+    rng = np.random.default_rng(3)
+    preds = rng.random(1000)
+    labels = (preds + 0.3 * rng.random(1000) > 0.8).astype(np.float64)
+    v = fn(preds, labels)
+    assert v > 0.0 and np.isfinite(v)
+    # informative ranking scores higher than random ranking
+    v_rand = fn(rng.random(1000), labels)
+    assert v > v_rand
+
+
+def test_expectile_metric_matches_formula():
+    fn, _ = create_metric("expectile@0.8")
+    preds = np.array([1.0, 2.0, 3.0])
+    labels = np.array([2.0, 2.0, 2.0])
+    diff = preds - labels
+    err = np.where(diff >= 0, 0.2, 0.8) * diff ** 2
+    assert abs(fn(preds, labels) - err.mean()) < 1e-12

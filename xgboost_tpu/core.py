@@ -894,7 +894,7 @@ class Booster:
                     residual = cache.labels - new_margin[:, k]
                     new_leaf = segment_quantile_leaf(
                         state.pos, residual, cache.valid, state.is_leaf,
-                        float(self.objective.adaptive_alpha()),
+                        float(self.objective.adaptive_alpha(k)),
                         float(self.tparam.eta), max_nodes=grower.max_nodes,
                     )
                     state = state._replace(leaf_val=new_leaf)
@@ -984,6 +984,8 @@ class Booster:
                 mkw["sigma"] = self.objective.sigma
             if "huber_slope" in self.params:
                 mkw["slope"] = float(self.params["huber_slope"])
+            if hasattr(self.objective, "_alphas") and self.n_groups > 1:
+                mkw["alphas"] = self.objective._alphas()
             for fn, mname in metrics:
                 v = fn(preds, labels, weights, **mkw)
                 msgs.append(f"{name}-{mname}:{v:g}")

@@ -205,7 +205,11 @@ def saabas_values_tree(tree, X: np.ndarray, eta_scale: np.ndarray = None) -> np.
 
 def predict_contribs(booster, data, tree_slice: slice, approx: bool = False) -> np.ndarray:
     """(R, F+1) or (R, K, F+1) contributions summing to the margin
-    (reference: Booster.predict(pred_contribs=True), core.py:2424)."""
+    (reference: Booster.predict(pred_contribs=True), core.py:2424).
+
+    Exact SHAP runs on the batched device kernel (interpret/device.py, the
+    role of shap.cu) whenever the ensemble qualifies; categorical trees and
+    the Saabas approximation use the host walk."""
     X = data.host_dense().astype(np.float64)
     R, F = X.shape
     K = booster.n_groups
@@ -214,6 +218,18 @@ def predict_contribs(booster, data, tree_slice: slice, approx: bool = False) -> 
     info = booster.tree_info[tree_slice]
     wts = (booster.tree_weights[tree_slice]
            if getattr(booster, "tree_weights", None) else [1.0] * len(trees))
+    if not approx:
+        from .device import device_shap_supported, shap_values_device
+
+        if trees and device_shap_supported(trees):
+            for grp in range(K):
+                g_trees = [t for t, g in zip(trees, info) if g == grp]
+                g_wts = [w for w, g in zip(wts, info) if g == grp]
+                if g_trees:
+                    out[:, grp, :] += shap_values_device(g_trees, g_wts, X)
+            base = np.asarray(booster.base_score).reshape(-1)
+            out[:, :, F] += base[None, :K]
+            return out[:, 0, :] if K == 1 else out
     fn = saabas_values_tree if approx else shap_values_tree
     for tree, grp, w in zip(trees, info, wts):
         out[:, grp, :] += w * fn(tree, X)  # DART weight_drop scaling

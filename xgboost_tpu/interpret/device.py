@@ -1,0 +1,206 @@
+"""Batched device TreeSHAP.
+
+TPU-native equivalent of the reference's warp-parallel GPU TreeSHAP
+(src/predictor/interpretability/shap.cu:439-908, the GPUTreeShap design):
+every root->leaf path is extracted once on host into fixed-shape tables,
+then a jitted kernel evaluates ALL (row, path) pairs at once.
+
+Math (Lundberg 2018, path-dependent): a leaf L reached through unique
+features 1..m, each with "zero fraction" z_i (product of cover ratios) and
+row-dependent "one fraction" o_i in {0,1}, contributes
+
+    phi_i += v_L * (o_i - z_i) * sum_k  e_k(i) * k! (m-1-k)! / m!
+
+where e_k(i) are elementary-symmetric coefficients of prod_{j!=i}(z_j+o_j t).
+Paths are bucketed by m so every kernel has static shapes; inside a bucket
+the per-element polynomial is rebuilt by excluding element i (O(m^2) per
+element — numerically safer than the divide-out in shap.cu, and m <= depth
+so the unrolled loops stay tiny).  Rows and paths batch into one big
+elementwise program; the final feature scatter is a dupe-accumulating
+`.at[].add`.
+
+Categorical trees fall back to the host implementation (interpret/__init__).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _leaf_paths(tree) -> List[dict]:
+    """Walk root->leaf; per leaf return node-level arrays + unique-slot map."""
+    t_left = tree.left_children
+    t_right = tree.right_children
+    t_feat = tree.split_indices
+    t_thr = tree.split_conditions
+    t_dleft = tree.default_left
+    cover = np.maximum(tree.sum_hessian.astype(np.float64), 1e-16)
+    leaf_val = np.where(t_left == -1, tree.split_conditions, 0.0)
+
+    out: List[dict] = []
+
+    def rec(node: int, nodes: list):
+        if t_left[node] == -1:
+            # condense duplicate features into unique slots
+            slots: Dict[int, int] = {}
+            z_mult: List[float] = []
+            node_feat, node_thr, node_dleft, node_dir, node_slot = [], [], [], [], []
+            for (nid, go_left) in nodes:
+                f = int(t_feat[nid])
+                child = t_left[nid] if go_left else t_right[nid]
+                frac = cover[child] / cover[nid]
+                if f not in slots:
+                    slots[f] = len(z_mult)
+                    z_mult.append(frac)
+                else:
+                    z_mult[slots[f]] *= frac
+                node_feat.append(f)
+                node_thr.append(float(t_thr[nid]))
+                node_dleft.append(bool(t_dleft[nid]))
+                node_dir.append(bool(go_left))
+                node_slot.append(slots[f])
+            out.append(dict(
+                node_feat=np.asarray(node_feat, np.int32),
+                node_thr=np.asarray(node_thr, np.float32),
+                node_dleft=np.asarray(node_dleft, bool),
+                node_dir=np.asarray(node_dir, bool),
+                node_slot=np.asarray(node_slot, np.int32),
+                z=np.asarray(z_mult, np.float64),
+                slot_feat=np.asarray(
+                    sorted(slots, key=slots.get), np.int32),
+                v=float(leaf_val[node]),
+            ))
+            return
+        rec(int(t_left[node]), nodes + [(node, True)])
+        rec(int(t_right[node]), nodes + [(node, False)])
+
+    if t_left[0] == -1:  # stump: all mass at the bias
+        return []
+    rec(0, [])
+    return out
+
+
+def _bucket_paths(paths: List[dict], tree_weight: float):
+    """Group per-leaf paths by unique length m -> stacked fixed-shape arrays."""
+    buckets: Dict[Tuple[int, int], List[dict]] = {}
+    for p in paths:
+        m = len(p["z"])
+        D = len(p["node_feat"])
+        buckets.setdefault((m, D), []).append(p)
+    out = {}
+    for (m, D), plist in buckets.items():
+        # every path in a bucket has exactly D nodes and m unique slots, so
+        # the stacks need no padding or validity masks
+        out[(m, D)] = dict(
+            node_feat=np.stack([p["node_feat"] for p in plist]),
+            node_thr=np.stack([p["node_thr"] for p in plist]),
+            node_dleft=np.stack([p["node_dleft"] for p in plist]),
+            node_dir=np.stack([p["node_dir"] for p in plist]),
+            node_slot=np.stack([p["node_slot"] for p in plist]),
+            z=np.stack([p["z"] for p in plist]).astype(np.float32),
+            slot_feat=np.stack([p["slot_feat"] for p in plist]),
+            v=np.asarray([p["v"] * tree_weight for p in plist], np.float32),
+        )
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("m", "n_feat"))
+def _bucket_phi(X, node_feat, node_thr, node_dleft, node_dir,
+                node_slot, z, slot_feat, v, wk, *, m: int, n_feat: int):
+    """(R, F+1) SHAP contribution of one bucket of paths.
+
+    X (R, F); path tables (P, D)/(P, m); wk (m,) = k!(m-1-k)!/m!.
+    """
+    R = X.shape[0]
+    P, D = node_feat.shape
+
+    xv = X[:, node_feat.reshape(-1)].reshape(R, P, D)
+    gol = jnp.where(jnp.isnan(xv), node_dleft[None], xv < node_thr[None])
+    ok = gol == node_dir[None]  # (R,P,D)
+
+    # one fraction per unique slot: AND of its nodes' decisions
+    bad = jnp.zeros((R, P, m), bool)
+    pidx = jnp.arange(P)[None, :, None]
+    ridx = jnp.arange(R)[:, None, None]
+    bad = bad.at[ridx, pidx, node_slot[None]].max(~ok)
+    o = (~bad).astype(jnp.float32)  # (R, P, m)
+
+    zf = z[None]  # (1, P, m)
+    phis = []
+    for i in range(m):
+        # poly of the other elements: c[k] coefficients, built in f32
+        c = [jnp.ones((R, P))] + [jnp.zeros((R, P))] * (m - 1)
+        for j in range(m):
+            if j == i:
+                continue
+            zj = zf[..., j]
+            oj = o[..., j]
+            nc = []
+            for k in range(m):
+                term = c[k] * zj
+                if k > 0:
+                    term = term + c[k - 1] * oj
+                nc.append(term)
+            c = nc
+        W = sum(wk[k] * c[k] for k in range(m))  # (R, P)
+        phis.append((o[..., i] - zf[..., i]) * v[None] * W)
+    phi_elems = jnp.stack(phis, axis=-1)  # (R, P, m)
+
+    out = jnp.zeros((R, n_feat + 1), jnp.float32)
+    flat_feat = slot_feat.reshape(-1)  # (P*m,)
+    out = out.at[:, flat_feat].add(phi_elems.reshape(R, P * m))
+    return out
+
+
+def shap_values_device(trees, tree_weights, X: np.ndarray,
+                       budget_elems: int = 1 << 24) -> np.ndarray:
+    """(R, F+1) summed exact SHAP values of scalar, non-categorical trees.
+
+    Host extracts path tables once per ensemble; rows stream in chunks sized
+    so R_chunk x paths x depth stays near ``budget_elems`` regardless of
+    ensemble size, and the tail chunk is padded to the same static shape (one
+    compiled program per bucket).
+    """
+    from . import _expected_value, _tree_arrays
+
+    R, F = X.shape
+    out = np.zeros((R, F + 1), np.float64)
+
+    # merge buckets across trees (same (m, D) shapes share one kernel call)
+    merged: Dict[Tuple[int, int], List[dict]] = {}
+    for tree, w in zip(trees, tree_weights):
+        out[:, F] += w * _expected_value(_tree_arrays(tree))
+        for key, b in _bucket_paths(_leaf_paths(tree), w).items():
+            merged.setdefault(key, []).append(b)
+
+    for (m, D), parts in sorted(merged.items()):
+        b = {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+        P = b["v"].shape[0]
+        wk = np.asarray(
+            [math.factorial(k) * math.factorial(m - 1 - k) / math.factorial(m)
+             for k in range(m)], np.float32)
+        args = tuple(jnp.asarray(b[k]) for k in
+                     ("node_feat", "node_thr", "node_dleft", "node_dir",
+                      "node_slot", "z", "slot_feat", "v"))
+        row_chunk = int(min(R, max(256, budget_elems // max(P * D, 1))))
+        for lo in range(0, R, row_chunk):
+            hi = min(lo + row_chunk, R)
+            chunk = X[lo:hi]
+            if hi - lo < row_chunk:  # pad tail to the static chunk shape
+                chunk = np.pad(chunk, ((0, row_chunk - (hi - lo)), (0, 0)),
+                               constant_values=np.nan)
+            contrib = _bucket_phi(jnp.asarray(chunk, jnp.float32), *args,
+                                  jnp.asarray(wk), m=m, n_feat=F)
+            out[lo:hi] += np.asarray(contrib, np.float64)[: hi - lo]
+    return out
+
+
+def device_shap_supported(trees) -> bool:
+    """Device path covers scalar-leaf, non-categorical ensembles."""
+    return all(not t.has_categorical and t.leaf_vector is None for t in trees)

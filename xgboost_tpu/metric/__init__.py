@@ -120,10 +120,106 @@ def tweedie_nloglik(preds, labels, weights=None, at: float = 1.5, **kw):
     return _wmean(-a + b, labels, weights)
 
 
+def _pick_alpha_col(p, alphas, at):
+    """For multi-alpha predictions: an explicit `metric@level` selects the
+    matching trained column; no explicit level means average across levels."""
+    if at is None:
+        return p, np.asarray(alphas, np.float64)[None, :]
+    a = np.asarray(alphas, np.float64)
+    k = int(np.argmin(np.abs(a - at)))
+    return p[:, k], float(a[k])
+
+
 @register_metric("quantile")
-def quantile_loss(preds, labels, weights=None, at: float = 0.5, **kw):
-    u = labels - preds
+def quantile_loss(preds, labels, weights=None, at=None, alphas=None, **kw):
+    """Pinball loss; (R, Q) preds with `alphas` = multi-quantile training
+    (quantile_obj.cu: mean over samples x quantile levels, or the requested
+    level's column when the metric carries an explicit @level)."""
+    p = np.asarray(preds, np.float64)
+    if p.ndim == 2 and alphas is not None:
+        p, a = _pick_alpha_col(p, alphas, at)
+        if p.ndim == 2:
+            u = labels[:, None] - p
+            return _wmean(np.where(u >= 0, a * u, (a - 1) * u), labels, weights)
+        at = a
+    at = 0.5 if at is None else at
+    u = labels - p
     return _wmean(np.where(u >= 0, at * u, (at - 1) * u), labels, weights)
+
+
+@register_metric("expectile")
+def expectile_loss(preds, labels, weights=None, alphas=None, at=None, **kw):
+    """Asymmetric squared loss (elementwise_metric.cu ExpectileError):
+    |alpha - I(diff<0)| * diff^2, averaged over samples (x expectiles), or
+    the requested level's column under an explicit @level."""
+    p = np.asarray(preds, np.float64)
+    if p.ndim == 2 and alphas is not None:
+        p, a = _pick_alpha_col(p, alphas, at)
+        if p.ndim == 2:
+            diff = p - labels[:, None]
+            return _wmean(np.where(diff >= 0, 1.0 - a, a) * diff ** 2,
+                          labels, weights)
+        at = a
+    at = 0.5 if at is None else at
+    diff = p - labels
+    return _wmean(np.where(diff >= 0, 1.0 - at, at) * diff ** 2,
+                  labels, weights)
+
+
+@register_metric("pre")
+def precision_at(preds, labels, weights=None, group_ptr=None, at: float = 0,
+                 **kw):
+    """Precision@k (rank_metric.cc EvalPrecision): per group, the label mass
+    of the top-k ranked docs over k; group-weighted mean."""
+    if group_ptr is None:
+        group_ptr = np.array([0, len(labels)])
+    k = int(at) if at else 10
+    n_groups = len(group_ptr) - 1
+    vals, ws = [], []
+    for g in range(n_groups):
+        lo, hi = group_ptr[g], group_ptr[g + 1]
+        if hi <= lo:
+            continue
+        y = labels[lo:hi]
+        order = np.argsort(-preds[lo:hi], kind="stable")
+        n = min(k, hi - lo)
+        wg = 1.0 if weights is None else float(
+            weights[g if len(weights) == n_groups else lo])
+        vals.append(float(np.sum(y[order[:n]])) * wg / n)
+        ws.append(wg)
+    return float(np.sum(vals) / np.sum(ws)) if vals else 0.0
+
+
+@register_metric("ams")
+def ams(preds, labels, weights=None, at: float = 1.0, **kw):
+    """Approximate median significance (rank_metric.cc EvalAMS): rank all
+    rows by prediction, take the top `ratio` fraction, score
+    sqrt(2((s+b+br)ln(1+s/(b+br))-s)) with regularisation br=10."""
+    n = len(labels)
+    w = _w(labels, weights)
+    order = np.argsort(-np.asarray(preds, np.float64), kind="stable")
+    ntop = int(at * n) or n
+    br = 10.0
+    top = order[: min(ntop, n - 1)]
+    pos = labels[top] > 0.5
+    s_tp = float(np.sum(w[top][pos]))
+    b_fp = float(np.sum(w[top][~pos]))
+    if ntop >= n:
+        # scan variant: best prefix AMS over distinct-threshold cut points
+        ps = np.cumsum(np.where(labels[order] > 0.5, w[order], 0.0))
+        bs = np.cumsum(np.where(labels[order] > 0.5, 0.0, w[order]))
+        sp = np.asarray(preds, np.float64)[order]
+        distinct = np.empty(len(sp), bool)
+        distinct[:-1] = sp[:-1] != sp[1:]
+        distinct[-1] = False
+        cand = np.nonzero(distinct)[0]
+        if len(cand) == 0:
+            return 0.0
+        a = np.sqrt(2 * ((ps[cand] + bs[cand] + br)
+                         * np.log1p(ps[cand] / (bs[cand] + br)) - ps[cand]))
+        return float(np.max(a))
+    return float(np.sqrt(2 * ((s_tp + b_fp + br)
+                              * np.log1p(s_tp / (b_fp + br)) - s_tp)))
 
 
 @register_metric("merror")

@@ -133,6 +133,7 @@ KNOWN_LEARNER_KEYS = {
     "lambdarank_unbiased", "lambdarank_bias_norm",
     # survival / quantile
     "aft_loss_distribution", "aft_loss_distribution_scale", "quantile_alpha",
+    "expectile_alpha",
     # tweedie / huber
     "tweedie_variance_power", "huber_slope",
     "scale_pos_weight", "enable_categorical", "missing", "validate_parameters",
