@@ -1,0 +1,106 @@
+/* Demo: train + predict through the xgboost_tpu C ABI from plain C
+ * (reference: demo/c-api/basic/c-api-demo.c pattern).
+ *
+ *   gcc capi_demo.c -L. -lxtb_capi -o capi_demo
+ *   PYTHONPATH=/root/repo LD_LIBRARY_PATH=. ./capi_demo
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void* DMatrixHandle;
+typedef void* BoosterHandle;
+typedef uint64_t bst_ulong;
+
+extern const char* XGBGetLastError(void);
+extern int XGBoostVersion(int*, int*, int*);
+extern int XGDMatrixCreateFromMat(const float*, bst_ulong, bst_ulong, float,
+                                  DMatrixHandle*);
+extern int XGDMatrixSetFloatInfo(DMatrixHandle, const char*, const float*,
+                                 bst_ulong);
+extern int XGDMatrixNumRow(DMatrixHandle, bst_ulong*);
+extern int XGDMatrixFree(DMatrixHandle);
+extern int XGBoosterCreate(const DMatrixHandle[], bst_ulong, BoosterHandle*);
+extern int XGBoosterSetParam(BoosterHandle, const char*, const char*);
+extern int XGBoosterUpdateOneIter(BoosterHandle, int, DMatrixHandle);
+extern int XGBoosterEvalOneIter(BoosterHandle, int, DMatrixHandle[],
+                                const char*[], bst_ulong, const char**);
+extern int XGBoosterPredict(BoosterHandle, DMatrixHandle, int, unsigned, int,
+                            bst_ulong*, const float**);
+extern int XGBoosterSaveModel(BoosterHandle, const char*);
+extern int XGBoosterLoadModel(BoosterHandle, const char*);
+extern int XGBoosterFree(BoosterHandle);
+
+#define CHECK(call)                                                   \
+  do {                                                                \
+    if ((call) != 0) {                                                \
+      fprintf(stderr, "FAILED %s: %s\n", #call, XGBGetLastError());   \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (0)
+
+int main(void) {
+  int maj, min, patch;
+  CHECK(XGBoostVersion(&maj, &min, &patch));
+  printf("xgboost_tpu C API %d.%d.%d\n", maj, min, patch);
+
+  enum { R = 400, F = 4 };
+  static float data[R * F];
+  static float label[R];
+  unsigned s = 42;
+  for (int i = 0; i < R; ++i) {
+    float sum = 0.f;
+    for (int j = 0; j < F; ++j) {
+      s = s * 1664525u + 1013904223u;
+      float v = (float)(s >> 8) / (float)(1 << 24) - 0.5f;
+      data[i * F + j] = v;
+      sum += v;
+    }
+    label[i] = sum > 0.f ? 1.0f : 0.0f;
+  }
+
+  DMatrixHandle dtrain;
+  CHECK(XGDMatrixCreateFromMat(data, R, F, -999.0f, &dtrain));
+  CHECK(XGDMatrixSetFloatInfo(dtrain, "label", label, R));
+  bst_ulong nrow;
+  CHECK(XGDMatrixNumRow(dtrain, &nrow));
+  printf("rows: %llu\n", (unsigned long long)nrow);
+
+  BoosterHandle booster;
+  CHECK(XGBoosterCreate(&dtrain, 1, &booster));
+  CHECK(XGBoosterSetParam(booster, "objective", "binary:logistic"));
+  CHECK(XGBoosterSetParam(booster, "max_depth", "3"));
+  CHECK(XGBoosterSetParam(booster, "eta", "0.3"));
+
+  const char* names[1] = {"train"};
+  DMatrixHandle sets[1] = {dtrain};
+  for (int it = 0; it < 5; ++it) {
+    CHECK(XGBoosterUpdateOneIter(booster, it, dtrain));
+    const char* msg = NULL;
+    CHECK(XGBoosterEvalOneIter(booster, it, sets, names, 1, &msg));
+    printf("%s\n", msg);
+  }
+
+  bst_ulong len = 0;
+  const float* preds = NULL;
+  CHECK(XGBoosterPredict(booster, dtrain, 0, 0, 0, &len, &preds));
+  printf("preds[0..2]: %f %f %f (n=%llu)\n", preds[0], preds[1], preds[2],
+         (unsigned long long)len);
+
+  CHECK(XGBoosterSaveModel(booster, "/tmp/capi_model.json"));
+  BoosterHandle loaded;
+  CHECK(XGBoosterCreate(NULL, 0, &loaded));
+  CHECK(XGBoosterLoadModel(loaded, "/tmp/capi_model.json"));
+  bst_ulong len2 = 0;
+  const float* preds2 = NULL;
+  CHECK(XGBoosterPredict(loaded, dtrain, 0, 0, 0, &len2, &preds2));
+  int ok = len == len2;
+  for (bst_ulong i = 0; ok && i < len; ++i) ok = preds[i] == preds2[i];
+  printf("save/load predictions identical: %s\n", ok ? "yes" : "NO");
+
+  CHECK(XGBoosterFree(booster));
+  CHECK(XGBoosterFree(loaded));
+  CHECK(XGDMatrixFree(dtrain));
+  printf("C API DEMO OK\n");
+  return ok ? 0 : 1;
+}

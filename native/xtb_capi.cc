@@ -1,0 +1,463 @@
+// C ABI for xgboost_tpu — the entry point for non-Python bindings.
+//
+// Reference: include/xgboost/c_api.h (the XGB_DLL surface) and
+// src/c_api/c_api.cc.  The reference marshals C buffers into its C++
+// Learner; here the runtime boundary is the same C ABI, but the compute
+// engine is the JAX package, reached through an embedded CPython
+// interpreter (xgboost_tpu/capi_glue.py holds the Python half).  Handles
+// are strong PyObject references; every call holds the GIL and converts
+// Python exceptions into the XGBGetLastError contract (c_api_error.h).
+//
+// Build: native/Makefile (links libpython via python3-config --embed).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#define XTB_DLL extern "C" __attribute__((visibility("default")))
+
+typedef void* DMatrixHandle;
+typedef void* BoosterHandle;
+typedef uint64_t bst_ulong;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+std::once_flag g_init_flag;
+PyObject* g_glue = nullptr;  // xgboost_tpu.capi_glue module
+
+void InitPython() {
+  std::call_once(g_init_flag, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL the embedded init leaves held, so every API call's
+      // PyGILState_Ensure/Release pair actually acquires and drops it —
+      // otherwise a second host thread deadlocks forever on its first call
+      PyEval_SaveThread();
+    }
+  });
+}
+
+// RAII GIL hold that works both embedded and when loaded into a live
+// interpreter (the ctypes test path).
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void CaptureError() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+PyObject* Glue() {
+  if (g_glue == nullptr) {
+    g_glue = PyImport_ImportModule("xgboost_tpu.capi_glue");
+  }
+  return g_glue;  // nullptr with a pending Python error on failure
+}
+
+// Call glue.<method>(fmt-args); returns a NEW reference or nullptr.
+PyObject* CallGlue(const char* method, const char* fmt, ...) {
+  PyObject* mod = Glue();
+  if (mod == nullptr) return nullptr;
+  PyObject* fn = PyObject_GetAttrString(mod, method);
+  if (fn == nullptr) return nullptr;
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  if (args == nullptr) {
+    Py_DECREF(fn);
+    return nullptr;
+  }
+  PyObject* ret = PyObject_CallObject(fn, args);
+  Py_DECREF(args);
+  Py_DECREF(fn);
+  return ret;
+}
+
+}  // namespace
+
+#define API_BEGIN()  \
+  InitPython();      \
+  Gil gil;           \
+  try {
+#define API_END()                               \
+  }                                             \
+  catch (...) {                                 \
+    g_last_error = "unexpected C++ exception";  \
+    return -1;                                  \
+  }
+#define FAIL_IF_NULL(obj) \
+  if ((obj) == nullptr) { \
+    CaptureError();       \
+    return -1;            \
+  }
+
+XTB_DLL const char* XGBGetLastError() { return g_last_error.c_str(); }
+
+XTB_DLL int XGBoostVersion(int* major, int* minor, int* patch) {
+  if (major) *major = 3;
+  if (minor) *minor = 1;
+  if (patch) *patch = 0;
+  return 0;
+}
+
+// ---------------------------------------------------------------- DMatrix
+XTB_DLL int XGDMatrixCreateFromMat(const float* data, bst_ulong nrow,
+                                   bst_ulong ncol, float missing,
+                                   DMatrixHandle* out) {
+  API_BEGIN();
+  PyObject* d = CallGlue("dmatrix_from_mat", "(KKKd)",
+                         (unsigned long long)(uintptr_t)data,
+                         (unsigned long long)nrow, (unsigned long long)ncol,
+                         (double)missing);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixCreateFromCSREx(const bst_ulong* indptr,
+                                     const unsigned* indices,
+                                     const float* data, bst_ulong nindptr,
+                                     bst_ulong nelem, bst_ulong num_col,
+                                     DMatrixHandle* out) {
+  API_BEGIN();
+  PyObject* d = CallGlue("dmatrix_from_csr", "(KKKKKK)",
+                         (unsigned long long)(uintptr_t)indptr,
+                         (unsigned long long)(uintptr_t)indices,
+                         (unsigned long long)(uintptr_t)data,
+                         (unsigned long long)nindptr,
+                         (unsigned long long)nelem,
+                         (unsigned long long)num_col);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixSetFloatInfo(DMatrixHandle handle, const char* field,
+                                  const float* array, bst_ulong len) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_set_float_info", "(OsKK)",
+                         (PyObject*)handle, field,
+                         (unsigned long long)(uintptr_t)array,
+                         (unsigned long long)len);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixSetUIntInfo(DMatrixHandle handle, const char* field,
+                                 const unsigned* array, bst_ulong len) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_set_uint_info", "(OsKK)",
+                         (PyObject*)handle, field,
+                         (unsigned long long)(uintptr_t)array,
+                         (unsigned long long)len);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixNumRow(DMatrixHandle handle, bst_ulong* out) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_num_row", "(O)", (PyObject*)handle);
+  FAIL_IF_NULL(r);
+  *out = (bst_ulong)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixNumCol(DMatrixHandle handle, bst_ulong* out) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_num_col", "(O)", (PyObject*)handle);
+  FAIL_IF_NULL(r);
+  *out = (bst_ulong)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixFree(DMatrixHandle handle) {
+  API_BEGIN();
+  Py_XDECREF((PyObject*)handle);
+  return 0;
+  API_END();
+}
+
+// ---------------------------------------------------------------- Booster
+XTB_DLL int XGBoosterCreate(const DMatrixHandle dmats[], bst_ulong len,
+                            BoosterHandle* out) {
+  API_BEGIN();
+  PyObject* list = PyList_New((Py_ssize_t)len);
+  FAIL_IF_NULL(list);
+  for (bst_ulong i = 0; i < len; ++i) {
+    PyObject* o = (PyObject*)dmats[i];
+    Py_INCREF(o);
+    PyList_SET_ITEM(list, (Py_ssize_t)i, o);
+  }
+  PyObject* b = CallGlue("booster_create", "(O)", list);
+  Py_DECREF(list);
+  FAIL_IF_NULL(b);
+  *out = b;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterFree(BoosterHandle handle) {
+  API_BEGIN();
+  Py_XDECREF((PyObject*)handle);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterSetParam(BoosterHandle handle, const char* name,
+                              const char* value) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_set_param", "(Oss)", (PyObject*)handle,
+                         name, value);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterUpdateOneIter(BoosterHandle handle, int iter,
+                                   DMatrixHandle dtrain) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_update_one_iter", "(OiO)",
+                         (PyObject*)handle, iter, (PyObject*)dtrain);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterBoostOneIter(BoosterHandle handle, DMatrixHandle dtrain,
+                                  float* grad, float* hess, bst_ulong len) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_boost_one_iter", "(OOKKK)",
+                         (PyObject*)handle, (PyObject*)dtrain,
+                         (unsigned long long)(uintptr_t)grad,
+                         (unsigned long long)(uintptr_t)hess,
+                         (unsigned long long)len);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterEvalOneIter(BoosterHandle handle, int iter,
+                                 DMatrixHandle dmats[],
+                                 const char* evnames[], bst_ulong len,
+                                 const char** out_result) {
+  API_BEGIN();
+  PyObject* dl = PyList_New((Py_ssize_t)len);
+  FAIL_IF_NULL(dl);
+  PyObject* nl = PyList_New((Py_ssize_t)len);
+  if (nl == nullptr) {
+    Py_DECREF(dl);
+    CaptureError();
+    return -1;
+  }
+  for (bst_ulong i = 0; i < len; ++i) {
+    PyObject* o = (PyObject*)dmats[i];
+    Py_INCREF(o);
+    PyList_SET_ITEM(dl, (Py_ssize_t)i, o);
+    PyObject* name = PyUnicode_FromString(evnames[i]);
+    if (name == nullptr) {  // e.g. invalid UTF-8 from the C caller
+      Py_DECREF(dl);
+      Py_DECREF(nl);
+      CaptureError();
+      return -1;
+    }
+    PyList_SET_ITEM(nl, (Py_ssize_t)i, name);
+  }
+  PyObject* r = CallGlue("booster_eval_one_iter", "(OiOO)",
+                         (PyObject*)handle, iter, dl, nl);
+  Py_DECREF(dl);
+  Py_DECREF(nl);
+  FAIL_IF_NULL(r);
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &n) != 0) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  // the bytes object is pinned on the booster by the glue; this borrowed
+  // view stays valid until the next eval call on the same handle
+  *out_result = buf;
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterPredict(BoosterHandle handle, DMatrixHandle dmat,
+                             int option_mask, unsigned ntree_limit,
+                             int training, bst_ulong* out_len,
+                             const float** out_result) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_predict", "(OOiIi)", (PyObject*)handle,
+                         (PyObject*)dmat, option_mask, ntree_limit, training);
+  FAIL_IF_NULL(r);
+  unsigned long long n = 0, addr = 0;
+  if (!PyArg_ParseTuple(r, "KK", &n, &addr)) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  Py_DECREF(r);
+  *out_len = (bst_ulong)n;
+  *out_result = (const float*)(uintptr_t)addr;  // pinned on the booster
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterSaveModel(BoosterHandle handle, const char* fname) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_save_model", "(Os)", (PyObject*)handle,
+                         fname);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterLoadModel(BoosterHandle handle, const char* fname) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_load_model", "(Os)", (PyObject*)handle,
+                         fname);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterSaveModelToBuffer(BoosterHandle handle,
+                                       const char* config, bst_ulong* out_len,
+                                       const char** out_dptr) {
+  API_BEGIN();
+  // config is '{"format": "json"|"ubj"}' (c_api.cc); default ubj
+  const char* fmt = (config && std::strstr(config, "json")) ? "json" : "ubj";
+  PyObject* r = CallGlue("booster_save_raw", "(Os)", (PyObject*)handle, fmt);
+  FAIL_IF_NULL(r);
+  unsigned long long n = 0;
+  PyObject* bytes_obj = nullptr;
+  if (!PyArg_ParseTuple(r, "KO", &n, &bytes_obj)) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t bn = 0;
+  if (PyBytes_AsStringAndSize(bytes_obj, &buf, &bn) != 0) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  *out_len = (bst_ulong)n;
+  *out_dptr = buf;  // pinned on the booster by the glue
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterLoadModelFromBuffer(BoosterHandle handle, const void* buf,
+                                         bst_ulong len) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_load_raw", "(OKK)", (PyObject*)handle,
+                         (unsigned long long)(uintptr_t)buf,
+                         (unsigned long long)len);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterGetAttr(BoosterHandle handle, const char* key,
+                             const char** out, int* success) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_get_attr", "(Os)", (PyObject*)handle, key);
+  FAIL_IF_NULL(r);
+  if (r == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    char* buf = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(r, &buf, &n) != 0) {
+      Py_DECREF(r);
+      CaptureError();
+      return -1;
+    }
+    *success = 1;
+    *out = buf;  // pinned on the booster by the glue
+  }
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterSetAttr(BoosterHandle handle, const char* key,
+                             const char* value) {
+  API_BEGIN();
+  PyObject* r = (value == nullptr)
+                    ? CallGlue("booster_set_attr", "(OsO)", (PyObject*)handle,
+                               key, Py_None)
+                    : CallGlue("booster_set_attr", "(Oss)", (PyObject*)handle,
+                               key, value);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterBoostedRounds(BoosterHandle handle, int* out) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_num_boosted_rounds", "(O)",
+                         (PyObject*)handle);
+  FAIL_IF_NULL(r);
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterGetNumFeature(BoosterHandle handle, bst_ulong* out) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_num_features", "(O)", (PyObject*)handle);
+  FAIL_IF_NULL(r);
+  *out = (bst_ulong)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}

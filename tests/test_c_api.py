@@ -1,0 +1,182 @@
+"""C ABI tests (reference: include/xgboost/c_api.h surface,
+demo/c-api/basic pattern, tests/python/test_basic.py ctypes round-trips).
+
+Two layers: (a) ctypes against libxtb_capi.so loaded into this interpreter
+(the shim detects the live interpreter and skips embedding), (b) a real
+compiled C program driving train/eval/predict/save/load end-to-end.
+"""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(__file__), os.pardir, "native")
+LIB = os.path.abspath(os.path.join(NATIVE, "libxtb_capi.so"))
+
+
+def _ensure_lib():
+    if not os.path.exists(LIB):
+        r = subprocess.run(["make", "libxtb_capi.so"], cwd=NATIVE,
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            pytest.skip(f"cannot build libxtb_capi.so: {r.stderr[-500:]}")
+    return LIB
+
+
+@pytest.fixture(scope="module")
+def capi():
+    lib = ctypes.CDLL(_ensure_lib())
+    lib.XGBGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.XGBGetLastError().decode()
+
+
+def test_ctypes_train_predict_roundtrip(capi, tmp_path):
+    rng = np.random.default_rng(0)
+    R, F = 300, 5
+    X = rng.normal(size=(R, F)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromMat(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(R), ctypes.c_uint64(F), ctypes.c_float(np.nan),
+        ctypes.byref(dmat)))
+    _check(capi, capi.XGDMatrixSetFloatInfo(
+        dmat, b"label", y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(R)))
+    nrow = ctypes.c_uint64()
+    _check(capi, capi.XGDMatrixNumRow(dmat, ctypes.byref(nrow)))
+    assert nrow.value == R
+
+    booster = ctypes.c_void_p()
+    arr = (ctypes.c_void_p * 1)(dmat)
+    _check(capi, capi.XGBoosterCreate(arr, ctypes.c_uint64(1),
+                                      ctypes.byref(booster)))
+    _check(capi, capi.XGBoosterSetParam(booster, b"objective",
+                                        b"binary:logistic"))
+    _check(capi, capi.XGBoosterSetParam(booster, b"max_depth", b"3"))
+    for it in range(4):
+        _check(capi, capi.XGBoosterUpdateOneIter(booster, it, dmat))
+
+    msg = ctypes.c_char_p()
+    names = (ctypes.c_char_p * 1)(b"train")
+    _check(capi, capi.XGBoosterEvalOneIter(booster, 3, arr, names,
+                                           ctypes.c_uint64(1),
+                                           ctypes.byref(msg)))
+    assert b"train-logloss" in msg.value
+
+    out_len = ctypes.c_uint64()
+    out_ptr = ctypes.POINTER(ctypes.c_float)()
+    _check(capi, capi.XGBoosterPredict(booster, dmat, 0, 0, 0,
+                                       ctypes.byref(out_len),
+                                       ctypes.byref(out_ptr)))
+    preds = np.ctypeslib.as_array(out_ptr, shape=(out_len.value,)).copy()
+    assert preds.shape == (R,)
+
+    # parity with the python API on the same data
+    import xgboost_tpu as xtb
+
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3}, d, 4,
+                    verbose_eval=False)
+    np.testing.assert_allclose(preds, bst.predict(d), rtol=1e-5, atol=1e-6)
+
+    # save via C, load via python
+    path = str(tmp_path / "capi.json").encode()
+    _check(capi, capi.XGBoosterSaveModel(booster, path))
+    b2 = xtb.Booster()
+    b2.load_model(path.decode())
+    np.testing.assert_allclose(b2.predict(d), preds, rtol=1e-6, atol=1e-7)
+
+    # margin + leaf prediction option masks
+    _check(capi, capi.XGBoosterPredict(booster, dmat, 1, 0, 0,
+                                       ctypes.byref(out_len),
+                                       ctypes.byref(out_ptr)))
+    margins = np.ctypeslib.as_array(out_ptr, shape=(out_len.value,)).copy()
+    np.testing.assert_allclose(
+        1.0 / (1.0 + np.exp(-margins)), preds, rtol=1e-5, atol=1e-6)
+
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGDMatrixFree(dmat))
+
+
+def test_ctypes_error_contract(capi):
+    booster = ctypes.c_void_p()
+    _check(capi, capi.XGBoosterCreate(None, ctypes.c_uint64(0),
+                                      ctypes.byref(booster)))
+    rc = capi.XGBoosterLoadModel(booster, b"/nonexistent/model.json")
+    assert rc == -1
+    assert len(capi.XGBGetLastError()) > 0
+    _check(capi, capi.XGBoosterFree(booster))
+
+
+def test_c_program_end_to_end(tmp_path):
+    """Compile and run the plain-C demo: the 'a C program trains and
+    predicts' acceptance test."""
+    _ensure_lib()
+    demo = os.path.join(NATIVE, "capi_demo.c")
+    exe = str(tmp_path / "capi_demo")
+    r = subprocess.run(["gcc", demo, "-L" + NATIVE, "-lxtb_capi", "-o", exe],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"cc unavailable: {r.stderr[-400:]}")
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(NATIVE),
+               LD_LIBRARY_PATH=NATIVE, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe], env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "C API DEMO OK" in out.stdout
+    assert "save/load predictions identical: yes" in out.stdout
+
+
+def test_ctypes_model_buffer_roundtrip(capi):
+    rng = np.random.default_rng(1)
+    R, F = 200, 4
+    X = rng.normal(size=(R, F)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromMat(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(R), ctypes.c_uint64(F), ctypes.c_float(np.nan),
+        ctypes.byref(dmat)))
+    _check(capi, capi.XGDMatrixSetFloatInfo(
+        dmat, b"label", y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(R)))
+    booster = ctypes.c_void_p()
+    arr = (ctypes.c_void_p * 1)(dmat)
+    _check(capi, capi.XGBoosterCreate(arr, ctypes.c_uint64(1),
+                                      ctypes.byref(booster)))
+    _check(capi, capi.XGBoosterSetParam(booster, b"objective",
+                                        b"binary:logistic"))
+    for it in range(3):
+        _check(capi, capi.XGBoosterUpdateOneIter(booster, it, dmat))
+
+    for cfg in (b'{"format": "ubj"}', b'{"format": "json"}'):
+        blen = ctypes.c_uint64()
+        bptr = ctypes.c_char_p()
+        _check(capi, capi.XGBoosterSaveModelToBuffer(
+            booster, cfg, ctypes.byref(blen), ctypes.byref(bptr)))
+        raw = ctypes.string_at(bptr, blen.value)
+        b2 = ctypes.c_void_p()
+        _check(capi, capi.XGBoosterCreate(None, ctypes.c_uint64(0),
+                                          ctypes.byref(b2)))
+        _check(capi, capi.XGBoosterLoadModelFromBuffer(
+            b2, raw, ctypes.c_uint64(len(raw))))
+        n1, p1 = ctypes.c_uint64(), ctypes.POINTER(ctypes.c_float)()
+        n2, p2 = ctypes.c_uint64(), ctypes.POINTER(ctypes.c_float)()
+        _check(capi, capi.XGBoosterPredict(booster, dmat, 0, 0, 0,
+                                           ctypes.byref(n1), ctypes.byref(p1)))
+        _check(capi, capi.XGBoosterPredict(b2, dmat, 0, 0, 0,
+                                           ctypes.byref(n2), ctypes.byref(p2)))
+        a1 = np.ctypeslib.as_array(p1, shape=(n1.value,)).copy()
+        a2 = np.ctypeslib.as_array(p2, shape=(n2.value,)).copy()
+        np.testing.assert_array_equal(a1, a2)
+        _check(capi, capi.XGBoosterFree(b2))
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGDMatrixFree(dmat))

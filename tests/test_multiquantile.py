@@ -86,3 +86,27 @@ def test_expectile_metric_matches_formula():
     diff = preds - labels
     err = np.where(diff >= 0, 0.2, 0.8) * diff ** 2
     assert abs(fn(preds, labels) - err.mean()) < 1e-12
+
+
+def test_generic_metric_on_multiquantile_model():
+    """rmse (a non-alpha-aware metric) on a multi-quantile model broadcasts
+    labels per level instead of crashing."""
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(600, 4)).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    res = {}
+    xtb.train({"objective": "reg:quantileerror", "quantile_alpha": [0.2, 0.8],
+               "eval_metric": ["rmse", "quantile"], "max_depth": 3},
+              xtb.DMatrix(X, label=y), 3, evals=[(xtb.DMatrix(X, label=y), "t")],
+              evals_result=res, verbose_eval=False)
+    assert np.isfinite(res["t"]["rmse"][-1])
+    assert np.isfinite(res["t"]["quantile"][-1])
+
+
+def test_untrained_metric_level_raises():
+    from xgboost_tpu.metric import create_metric
+
+    fn, _ = create_metric("quantile@0.25")
+    preds = np.zeros((10, 3))
+    with pytest.raises(ValueError, match="not trained"):
+        fn(preds, np.zeros(10), alphas=[0.1, 0.5, 0.9])

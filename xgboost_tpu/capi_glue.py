@@ -1,0 +1,171 @@
+"""Python side of the C API (reference: include/xgboost/c_api.h,
+src/c_api/c_api.cc).
+
+native/xtb_capi.cc embeds CPython and calls these helpers with raw buffer
+addresses; everything heavy (array construction, training, prediction)
+happens here so the C layer stays a thin ABI shim.  Results that must
+outlive a call (prediction buffers, eval strings) are pinned on the owning
+handle object, mirroring the reference's per-handle XGBAPIThreadLocalEntry
+return-buffer convention (c_api.cc).
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from .core import Booster
+from .data.dmatrix import DMatrix
+
+_F32 = ctypes.POINTER(ctypes.c_float)
+
+
+def _buf(addr: int, n: int, dtype) -> np.ndarray:
+    """Copy n elements of dtype from a raw address into a numpy array."""
+    ctype = np.ctypeslib.as_ctypes_type(dtype)
+    arr = np.ctypeslib.as_array(
+        ctypes.cast(addr, ctypes.POINTER(ctype)), shape=(n,))
+    return np.array(arr, dtype=dtype)  # copy: the caller's buffer may die
+
+
+def dmatrix_from_mat(addr: int, nrow: int, ncol: int, missing: float) -> DMatrix:
+    X = _buf(addr, nrow * ncol, np.float32).reshape(nrow, ncol)
+    return DMatrix(X, missing=missing)
+
+
+def dmatrix_from_csr(indptr_addr: int, indices_addr: int, data_addr: int,
+                     n_indptr: int, nnz: int, ncol: int) -> DMatrix:
+    import scipy.sparse as sp
+
+    indptr = _buf(indptr_addr, n_indptr, np.uint64).astype(np.int64)
+    indices = _buf(indices_addr, nnz, np.uint32).astype(np.int64)
+    data = _buf(data_addr, nnz, np.float32)
+    csr = sp.csr_matrix((data, indices, indptr), shape=(n_indptr - 1, ncol))
+    return DMatrix(csr)
+
+
+def dmatrix_set_float_info(d: DMatrix, field: str, addr: int, n: int) -> None:
+    vals = _buf(addr, n, np.float32)
+    if field == "label":
+        d.set_label(vals)
+    elif field == "weight":
+        d.set_weight(vals)
+    elif field == "base_margin":
+        d.set_base_margin(vals)
+    elif field == "label_lower_bound":
+        d.info.label_lower_bound = vals
+    elif field == "label_upper_bound":
+        d.info.label_upper_bound = vals
+    else:
+        raise ValueError(f"unknown float field {field!r}")
+
+
+def dmatrix_set_uint_info(d: DMatrix, field: str, addr: int, n: int) -> None:
+    vals = _buf(addr, n, np.uint32)
+    if field == "group":
+        d.set_group(vals.astype(np.int64))
+    else:
+        raise ValueError(f"unknown uint field {field!r}")
+
+
+def dmatrix_num_row(d: DMatrix) -> int:
+    return int(d.num_row())
+
+
+def dmatrix_num_col(d: DMatrix) -> int:
+    return int(d.num_col())
+
+
+def booster_create(dmats: List[DMatrix]) -> Booster:
+    return Booster(cache=list(dmats))
+
+
+def booster_set_param(b: Booster, name: str, value: Optional[str]) -> None:
+    b.set_param(name, value)
+
+
+def booster_update_one_iter(b: Booster, it: int, dtrain: DMatrix) -> None:
+    b.update(dtrain, it)
+
+
+def booster_boost_one_iter(b: Booster, dtrain: DMatrix, grad_addr: int,
+                           hess_addr: int, n: int) -> None:
+    b.boost(dtrain, _buf(grad_addr, n, np.float32),
+            _buf(hess_addr, n, np.float32))
+
+
+def booster_eval_one_iter(b: Booster, it: int, dmats: List[DMatrix],
+                          names: List[str]) -> bytes:
+    msg = b.eval_set(list(zip(dmats, names)), it)
+    out = msg.encode()
+    b._capi_eval_str = out  # pinned (c_api.cc ret_str convention)
+    return out
+
+
+def booster_predict(b: Booster, d: DMatrix, option_mask: int,
+                    ntree_limit: int, training: int):
+    """Legacy XGBoosterPredict semantics (c_api.cc):
+    option_mask 1 = margin, 2 = contribs, 4 = approx contribs, 8 = leaf,
+    16 = interactions; ntree_limit counts TREES and converts to boosting
+    rounds via trees_per_round (c_api.cc GetIterationFromTreeLimit)."""
+    if ntree_limit:
+        b._configure()
+        tpr = max(b.trees_per_round, 1)
+        it_range = (0, -(-int(ntree_limit) // tpr))  # ceil division
+    else:
+        it_range = (0, 0)
+    kw = dict(iteration_range=it_range, training=bool(training))
+    if option_mask & 8:
+        out = b.predict(d, pred_leaf=True, **kw)
+    elif option_mask & 16:
+        out = b.predict(d, pred_interactions=True, **kw)
+    elif option_mask & 4:
+        out = b.predict(d, pred_contribs=True, approx_contribs=True, **kw)
+    elif option_mask & 2:
+        out = b.predict(d, pred_contribs=True, **kw)
+    else:
+        out = b.predict(d, output_margin=bool(option_mask & 1), **kw)
+    out = np.ascontiguousarray(np.asarray(out, np.float32).reshape(-1))
+    b._capi_pred_buf = out  # keep alive until the next predict on b
+    return int(out.size), int(out.ctypes.data)
+
+
+def booster_save_model(b: Booster, path: str) -> None:
+    b.save_model(path)
+
+
+def booster_load_model(b: Booster, path: str) -> None:
+    b.load_model(path)
+
+
+def booster_save_raw(b: Booster, raw_format: str) -> tuple:
+    buf = bytes(b.save_raw(raw_format))
+    b._capi_raw_buf = buf
+    return len(buf), buf
+
+
+def booster_load_raw(b: Booster, addr: int, n: int) -> None:
+    b.load_model(bytes(_buf(addr, n, np.uint8)))
+
+
+def booster_get_attr(b: Booster, name: str):
+    v = b.attr(name)
+    if v is None:
+        return None
+    out = v.encode()
+    b._capi_attr_str = out
+    return out
+
+
+def booster_set_attr(b: Booster, name: str, value: Optional[str]) -> None:
+    b.set_attr(**{name: value})
+
+
+def booster_num_boosted_rounds(b: Booster) -> int:
+    return int(b.num_boosted_rounds())
+
+
+def booster_num_features(b: Booster) -> int:
+    return int(b.num_features())

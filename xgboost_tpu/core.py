@@ -338,9 +338,38 @@ class Booster:
             cache.margin = cache.margin + delta
             cache.n_trees_applied = len(self.trees)
 
-    @staticmethod
-    def dmat_host_dense(cache: _Cache) -> np.ndarray:
-        return cache.dmat.host_dense()
+    def dmat_host_dense(self, cache: _Cache) -> np.ndarray:
+        return self._host_dense_recoded(cache.dmat)
+
+    def _host_dense_recoded(self, data: DMatrix) -> np.ndarray:
+        """Raw matrix with categorical codes remapped onto the TRAINING
+        frame's category ordering (encoder/ordinal.h Recode): a frame whose
+        pandas categories differ train->inference would otherwise route its
+        codes through the wrong split sets silently."""
+        X = data.host_dense()
+        train_cats = getattr(self, "_cat_categories", None)
+        data_cats = getattr(data, "cat_categories", None)
+        if not train_cats or not data_cats or train_cats == {
+                int(k): list(v) for k, v in data_cats.items()}:
+            return X
+        X = np.array(X, copy=True)
+        for f, train_vals in train_cats.items():
+            new_vals = data_cats.get(f)
+            if new_vals is None or list(new_vals) == list(train_vals):
+                continue
+            lookup = {v: i for i, v in enumerate(train_vals)}
+            codes = X[:, f]
+            remapped = np.full_like(codes, np.nan)
+            for new_code, v in enumerate(new_vals):
+                hit = codes == new_code
+                if v in lookup:
+                    remapped[hit] = lookup[v]
+                elif hit.any():
+                    raise ValueError(
+                        f"feature {f} has category {v!r} not seen in "
+                        "training (encoder recode)")
+            X[:, f] = remapped
+        return X
 
     @property
     def base_score(self) -> np.ndarray:
@@ -373,6 +402,21 @@ class Booster:
             if getattr(self.objective, "_gidx_owner", None) != owner:
                 self.objective.set_group_info(gp)
                 self.objective._gidx_owner = owner
+        if getattr(dtrain, "cat_categories", None):
+            cats = {int(k): list(v) for k, v in dtrain.cat_categories.items()}
+            if getattr(self, "_cat_categories", None) is None:
+                # remember the training frame's category->code mapping so
+                # frames with different orderings recode at inference
+                # (reference: src/encoder/ordinal.h:350 Recode)
+                self._cat_categories = cats
+            elif cats != self._cat_categories:
+                # the binned page would be built from the RAW (mismatched)
+                # codes while margins are recoded — fail loudly instead of
+                # training trees against the wrong code space
+                raise ValueError(
+                    "continued training requires the training frame's "
+                    "category ordering; re-declare the categorical columns "
+                    "with the original categories")
         self._sync_margin(cache)
         drop_idx = self._select_dart_drops(iteration)
         if drop_idx:
@@ -453,7 +497,7 @@ class Booster:
         from .models.gblinear import linear_predict
 
         if cache.raw_X is None:
-            cache.raw_X = jnp.asarray(cache.dmat.host_dense(), jnp.float32)
+            cache.raw_X = jnp.asarray(self._host_dense_recoded(cache.dmat), jnp.float32)
         base = jnp.asarray(self._base_margin_value)[None, :]
         m = linear_predict(cache.raw_X, jnp.asarray(self.linear_weights),
                            jnp.asarray(self.linear_bias)) + base
@@ -474,7 +518,7 @@ class Booster:
             self.linear_weights = np.zeros((F, K), np.float32)
             self.linear_bias = np.zeros(K, np.float32)
         if cache.raw_X is None:
-            cache.raw_X = jnp.asarray(cache.dmat.host_dense(), jnp.float32)
+            cache.raw_X = jnp.asarray(self._host_dense_recoded(cache.dmat), jnp.float32)
         Xz = jnp.nan_to_num(cache.raw_X, nan=0.0)
         updater = str(self.params.get("updater", "coord_descent"))
         W = jnp.asarray(self.linear_weights)
@@ -841,7 +885,7 @@ class Booster:
             import jax.numpy as jnp
 
             if cache.raw_X is None:
-                cache.raw_X = jnp.asarray(cache.dmat.host_dense(), jnp.float32)
+                cache.raw_X = jnp.asarray(self._host_dense_recoded(cache.dmat), jnp.float32)
             drop_margin = self._margin_for_trees(cache.raw_X, drop_idx)
             pad = cache.margin.shape[0] - drop_margin.shape[0]
             if pad:
@@ -987,7 +1031,20 @@ class Booster:
             if hasattr(self.objective, "_alphas") and self.n_groups > 1:
                 mkw["alphas"] = self.objective._alphas()
             for fn, mname in metrics:
-                v = fn(preds, labels, weights, **mkw)
+                kw = dict(mkw)
+                lab = labels
+                if "alphas" in kw:
+                    import inspect
+
+                    base_fn = getattr(fn, "__wrapped__", fn)
+                    if "alphas" not in inspect.signature(base_fn).parameters:
+                        # generic elementwise metric on a multi-alpha model:
+                        # tile labels so (R, Q) preds broadcast per level
+                        kw.pop("alphas")
+                        if np.ndim(preds) == 2 and np.ndim(lab) == 1:
+                            lab = np.repeat(np.asarray(lab)[:, None],
+                                            preds.shape[1], axis=1)
+                v = fn(preds, lab, weights, **kw)
                 msgs.append(f"{name}-{mname}:{v:g}")
             if feval is not None:
                 res = feval(margin if output_margin else preds, dmat)
@@ -1177,7 +1234,7 @@ class Booster:
                 out = np.asarray(self.objective.pred_transform(jnp.asarray(margin)))
             return out[:, 0] if self.n_groups == 1 and not strict_shape else out
         streamed = self._use_streamed_predict(data)
-        X = None if streamed else jnp.asarray(data.host_dense(), jnp.float32)
+        X = None if streamed else jnp.asarray(self._host_dense_recoded(data), jnp.float32)
         if pred_leaf:
             if streamed:
                 raise ValueError(
@@ -1220,7 +1277,7 @@ class Booster:
         """Linear contributions: phi_f = w_f * x_f, bias column last
         (reference: gblinear.cc PredictContribution)."""
         self._configure()
-        X = np.nan_to_num(data.host_dense(), nan=0.0)
+        X = np.nan_to_num(self._host_dense_recoded(data), nan=0.0)
         R, F = X.shape
         K = self.n_groups
         W = self.linear_weights if self.linear_weights is not None else np.zeros((F, K), np.float32)
@@ -1238,7 +1295,7 @@ class Booster:
         from .models.gblinear import linear_predict
 
         self._configure()
-        X = jnp.asarray(data.host_dense(), jnp.float32)
+        X = jnp.asarray(self._host_dense_recoded(data), jnp.float32)
         base = np.broadcast_to(self.base_score.reshape(-1), (self.n_groups,))
         if self.linear_weights is None:
             margin = np.broadcast_to(base, (data.num_row(), self.n_groups)).copy()
@@ -1359,6 +1416,10 @@ class Booster:
         attrs = dict(self.attributes)
         attrs["base_margin_exact"] = " ".join(
             repr(float(v)) for v in np.asarray(self.base_score).reshape(-1))
+        if getattr(self, "_cat_categories", None):
+            # training categories, for inference-time recode (the role of
+            # the reference's cat container in the model blob)
+            attrs["cat_categories"] = json.dumps(self._cat_categories)
         return {
             "version": [3, 1, 0],
             "learner": {
@@ -1380,7 +1441,14 @@ class Booster:
 
     def load_model(self, fname: Union[str, os.PathLike, bytes, bytearray]) -> None:
         if isinstance(fname, (bytes, bytearray)):
-            obj = json.loads(fname)
+            try:
+                obj = json.loads(fname)
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                import io
+
+                from .utils.ubjson import load_ubjson
+
+                obj = load_ubjson(io.BytesIO(bytes(fname)))
         else:
             fname = os.fspath(fname)
             if fname.endswith(".ubj"):
@@ -1458,6 +1526,10 @@ class Booster:
                 self.multi_strategy = "multi_output_tree"
         self.attributes = dict(learner.get("attributes", {}))
         self.attributes.pop("base_margin_exact", None)
+        cc = self.attributes.pop("cat_categories", None)
+        if cc:
+            self._cat_categories = {int(k): list(v)
+                                    for k, v in json.loads(cc).items()}
         self.feature_names = learner.get("feature_names") or None
         self.feature_types = learner.get("feature_types") or None
 

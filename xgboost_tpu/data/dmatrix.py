@@ -82,18 +82,24 @@ def _to_numpy_2d(data: Any, missing: float = np.nan):
         feature_names = [str(c) for c in data.columns]
         feature_types = []
         cols = []
-        for c in data.columns:
+        cat_categories = {}
+        for fi, c in enumerate(data.columns):
             col = data[c]
             if str(col.dtype) == "category":
                 codes = col.cat.codes.to_numpy().astype(np.float32)
                 codes[codes < 0] = np.nan  # pandas encodes NaN as -1
                 cols.append(codes)
                 feature_types.append("c")
+                # category VALUES, for train->inference recode
+                # (reference: src/encoder/ordinal.h Recode)
+                cat_categories[fi] = [
+                    v.item() if hasattr(v, "item") else v
+                    for v in col.cat.categories.tolist()]
             else:
                 cols.append(col.to_numpy().astype(np.float32))
                 feature_types.append("q" if col.dtype.kind == "f" else "int")
         arr = np.stack(cols, axis=1) if cols else np.zeros((len(data), 0), np.float32)
-        return ("dense", arr), feature_names, feature_types
+        return ("dense", arr, cat_categories), feature_names, feature_types
     # scipy sparse
     if hasattr(data, "tocsr"):
         csr = data.tocsr()
@@ -134,11 +140,15 @@ class DMatrix:
         silent: bool = False,
     ) -> None:
         auto_label = auto_qid = None
+        self.cat_categories = None  # {feature idx -> category values} (pandas)
         if isinstance(data, (str, os.PathLike)):
             (kind, payload), auto_names, auto_types, auto_label, auto_qid = _load_uri(
                 os.fspath(data))
         else:
-            (kind, payload), auto_names, auto_types = _to_numpy_2d(data, missing)
+            (kind, *rest), auto_names, auto_types = _to_numpy_2d(data, missing)
+            payload = rest[0]
+            if len(rest) > 1 and rest[1]:
+                self.cat_categories = rest[1]
         self._kind = kind
         if kind == "dense":
             self._dense: Optional[np.ndarray] = payload

@@ -209,8 +209,9 @@ def predict_contribs(booster, data, tree_slice: slice, approx: bool = False) -> 
 
     Exact SHAP runs on the batched device kernel (interpret/device.py, the
     role of shap.cu) whenever the ensemble qualifies; categorical trees and
-    the Saabas approximation use the host walk."""
-    X = data.host_dense().astype(np.float64)
+    the Saabas approximation use the host walk (which needs f64; the device
+    path slices f32 chunks itself, so no full f64 copy is made for it)."""
+    X = booster._host_dense_recoded(data)
     R, F = X.shape
     K = booster.n_groups
     out = np.zeros((R, K, F + 1), np.float64)
@@ -230,6 +231,7 @@ def predict_contribs(booster, data, tree_slice: slice, approx: bool = False) -> 
             base = np.asarray(booster.base_score).reshape(-1)
             out[:, :, F] += base[None, :K]
             return out[:, 0, :] if K == 1 else out
+    X = X.astype(np.float64)  # the host walk accumulates in f64
     fn = saabas_values_tree if approx else shap_values_tree
     for tree, grp, w in zip(trees, info, wts):
         out[:, grp, :] += w * fn(tree, X)  # DART weight_drop scaling
@@ -321,7 +323,7 @@ def _cond_recurse(t, x, phi, node, p, length, pz, po, pi, cond_f, cond_on, cond_
 
 
 def predict_interactions(booster, data, tree_slice: slice) -> np.ndarray:
-    X = data.host_dense().astype(np.float64)
+    X = booster._host_dense_recoded(data).astype(np.float64)
     R, F = X.shape
     K = booster.n_groups
     out = np.zeros((R, K, F + 1, F + 1), np.float64)

@@ -30,7 +30,9 @@ def create_metric(name: str):
     fn = _REGISTRY[base]
     if "@" in name:
         arg = float(name.split("@")[1])
-        return lambda *a, **k: fn(*a, at=arg, **k), name
+        wrapper = lambda *a, **k: fn(*a, at=arg, **k)  # noqa: E731
+        wrapper.__wrapped__ = fn  # callers introspect the real signature
+        return wrapper, name
     return fn, name
 
 
@@ -127,6 +129,9 @@ def _pick_alpha_col(p, alphas, at):
         return p, np.asarray(alphas, np.float64)[None, :]
     a = np.asarray(alphas, np.float64)
     k = int(np.argmin(np.abs(a - at)))
+    if abs(a[k] - at) > 1e-6:
+        raise ValueError(
+            f"metric level {at} was not trained; trained levels: {a.tolist()}")
     return p[:, k], float(a[k])
 
 
