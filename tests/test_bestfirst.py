@@ -1,0 +1,100 @@
+"""Global best-first (lossguide) growth — tree/bestfirst.py
+(reference: src/tree/driver.h priority queue; round-1 verdict Weak #10:
+per-level budget approximation + depth-10 heap cap)."""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+
+
+def _skewed_data(n=4000, seed=0):
+    """Data that rewards a deep chain on one feature: best-first should
+    follow the gain, not the level structure."""
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(0, 1, size=(n, 4)).astype(np.float32)
+    # piecewise-constant staircase in x0 with many steps -> deep chain
+    y = np.floor(X[:, 0] * 40).astype(np.float32)
+    return X, y
+
+
+def test_bestfirst_exceeds_depth_ten():
+    """With max_depth=0 (unbounded) and a leaf budget, lossguide can grow
+    past the round-1 heap cap of 10 levels."""
+    X, y = _skewed_data()
+    bst = xtb.train({"objective": "reg:squarederror", "max_depth": 0,
+                     "grow_policy": "lossguide", "max_leaves": 40,
+                     "eta": 1.0, "max_bin": 64},
+                    xtb.DMatrix(X, label=y), 1, verbose_eval=False)
+    t = bst.trees[0]
+    assert t.num_leaves <= 40
+    assert t.max_depth > 10, t.max_depth  # impossible in the heap layout
+    # and it actually fits the staircase
+    p = bst.predict(xtb.DMatrix(X))
+    assert np.mean((p - y) ** 2) < np.var(y) * 0.05
+
+
+def test_bestfirst_budget_and_quality():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(3000, 8)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + X[:, 2] > 0).astype(np.float32)
+    d = xtb.DMatrix(X, label=y)
+    res = {}
+    bst = xtb.train({"objective": "binary:logistic", "grow_policy": "lossguide",
+                     "max_leaves": 16, "max_depth": 0, "eta": 0.3,
+                     "eval_metric": "logloss"},
+                    d, 10, evals=[(d, "t")], evals_result=res,
+                    verbose_eval=False)
+    for t in bst.trees:
+        assert t.num_leaves <= 16
+    assert res["t"]["logloss"][-1] < res["t"]["logloss"][0]
+
+
+def test_bestfirst_respects_max_depth():
+    X, y = _skewed_data(seed=2)
+    bst = xtb.train({"objective": "reg:squarederror", "max_depth": 4,
+                     "grow_policy": "lossguide", "max_leaves": 64,
+                     "max_bin": 64},
+                    xtb.DMatrix(X, label=y), 1, verbose_eval=False)
+    assert bst.trees[0].max_depth <= 4
+
+
+def test_bestfirst_matches_depthwise_on_balanced_data():
+    """With a generous budget, best-first should reach the quality of
+    depthwise on data with no depth skew."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2000, 6)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] ** 2 > 0.5).astype(np.float32)
+    d1 = xtb.DMatrix(X, label=y)
+    d2 = xtb.DMatrix(X, label=y)
+    b_dw = xtb.train({"objective": "binary:logistic", "max_depth": 5,
+                      "eta": 0.3}, d1, 8, verbose_eval=False)
+    b_bf = xtb.train({"objective": "binary:logistic", "grow_policy":
+                      "lossguide", "max_leaves": 32, "max_depth": 0,
+                      "eta": 0.3}, d2, 8, verbose_eval=False)
+    p1 = b_dw.predict(d1)
+    p2 = b_bf.predict(d2)
+    ll1 = -np.mean(y * np.log(np.clip(p1, 1e-7, 1))
+                   + (1 - y) * np.log(np.clip(1 - p1, 1e-7, 1)))
+    ll2 = -np.mean(y * np.log(np.clip(p2, 1e-7, 1))
+                   + (1 - y) * np.log(np.clip(1 - p2, 1e-7, 1)))
+    assert ll2 < ll1 * 1.25, (ll1, ll2)
+
+
+def test_bestfirst_save_load_and_adaptive():
+    """Serialization round-trip + adaptive (quantile) leaves on the
+    best-first path."""
+    X, y = _skewed_data(n=1500, seed=4)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "reg:quantileerror", "quantile_alpha": 0.5,
+                     "grow_policy": "lossguide", "max_leaves": 12,
+                     "max_depth": 0, "max_bin": 64},
+                    d, 4, verbose_eval=False)
+    p = bst.predict(d)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        fn = td + "/bf.json"
+        bst.save_model(fn)
+        b2 = xtb.Booster()
+        b2.load_model(fn)
+        np.testing.assert_array_equal(b2.predict(xtb.DMatrix(X)), p)

@@ -822,21 +822,38 @@ class Booster:
                 f"{ell.n_features} features"
             )
         lossguide = self.tparam.grow_policy == "lossguide"
-        max_depth = self.tparam.max_depth
-        if max_depth <= 0:
-            # lossguide with unbounded depth: cap at 10 heap levels for static
-            # shapes (deeper growth is a planned extension)
-            max_depth = 10 if lossguide else 6
         mesh = self._get_mesh()
         proc_par = self._process_parallel()
+        # true global best-first for lossguide with a leaf budget (driver.h
+        # priority queue): unbounded depth, node-table layout
+        best_first = (lossguide and self.tparam.max_leaves > 1
+                      and mesh is None and not proc_par)
+        max_depth = self.tparam.max_depth
+        if max_depth <= 0:
+            if best_first:
+                max_depth = 0  # depth bounded only by the leaf budget
+            else:
+                # level-synchronous lossguide: cap at 10 heap levels for
+                # static shapes (the best-first path has no such cap)
+                max_depth = 10 if lossguide else 6
         gkey = (max_depth, id(mesh), self._split_params,
                 self.tparam.interaction_constraints, self.tparam.max_leaves,
-                lossguide, str(self.params.get("_hist_impl", "xla")), proc_par)
+                lossguide, str(self.params.get("_hist_impl", "xla")), proc_par,
+                best_first)
         if not hasattr(self, "_grower_cache"):
             self._grower_cache = {}
         grower = self._grower_cache.get(gkey)
         if grower is None:
-            if proc_par:
+            if best_first:
+                from .tree.bestfirst import BestFirstGrower
+
+                grower = BestFirstGrower(
+                    max_depth,
+                    self._split_params,
+                    max_leaves=self.tparam.max_leaves,
+                    interaction_sets=self.tparam.interaction_constraints,
+                )
+            elif proc_par:
                 if mesh is not None:
                     raise NotImplementedError(
                         "n_devices > 1 within a process is not combined with "
@@ -930,21 +947,40 @@ class Booster:
                     feature_masks=fmask_fn,
                     cat_mask=cat_mask_np,
                 )
+                pos = state.pos
+                if best_first:
+                    tree, leaf_val = grower.to_regtree(state, ell.cuts_pad)
+                else:
+                    tree = None
+                    leaf_val = state.leaf_val
                 if adaptive:
+                    if best_first:
+                        is_leaf = jnp.zeros(grower.n_slots, bool).at[
+                            : tree.n_nodes].set(
+                                jnp.asarray(tree.left_children == -1))
+                        n_slots = grower.n_slots
+                    else:
+                        is_leaf, n_slots = state.is_leaf, grower.max_nodes
                     # exact quantile leaves (ObjFunction::UpdateTreeLeaf,
                     # src/objective/adaptive.cc)
                     from .ops.adaptive import segment_quantile_leaf
 
                     residual = cache.labels - new_margin[:, k]
-                    new_leaf = segment_quantile_leaf(
-                        state.pos, residual, cache.valid, state.is_leaf,
+                    leaf_val = segment_quantile_leaf(
+                        pos, residual, cache.valid, is_leaf,
                         float(self.objective.adaptive_alpha(k)),
-                        float(self.tparam.eta), max_nodes=grower.max_nodes,
+                        float(self.tparam.eta), max_nodes=n_slots,
                     )
-                    state = state._replace(leaf_val=new_leaf)
-                delta = leaf_margin_delta(state.pos, state.leaf_val)
+                    if best_first:
+                        lv = np.asarray(leaf_val)[: tree.n_nodes]
+                        lm = tree.left_children == -1
+                        tree.split_conditions[lm] = lv[lm]
+                    else:
+                        state = state._replace(leaf_val=leaf_val)
+                delta = leaf_margin_delta(pos, leaf_val)
                 new_margin = new_margin.at[:, k].add(delta)
-                tree = RegTree.from_grown(HistTreeGrower.to_host(state))
+                if tree is None:
+                    tree = RegTree.from_grown(HistTreeGrower.to_host(state))
                 self.trees.append(tree)
                 self.tree_info.append(k)
                 self.tree_weights.append(1.0)

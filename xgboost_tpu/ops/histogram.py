@@ -66,6 +66,13 @@ def build_histogram(
     stride: 2 selects every other heap slot — the left-children of a level,
             for the subtraction trick (right sibling = parent - left).
     """
+    return _hist_accumulate(bins, gpair, pos, node0, n_nodes, n_bin, chunk,
+                            stride)
+
+
+def _hist_accumulate(bins, gpair, pos, node0, n_nodes, n_bin, chunk, stride):
+    """Fixed-order chunked accumulation shared by the static- and
+    traced-node0 entry points (node0 may be an int or a traced scalar)."""
     R, F = bins.shape
     C = gpair.shape[1]
     if R <= chunk:
@@ -88,6 +95,19 @@ def build_histogram(
         acc = acc + _hist_chunk(bins[-rem:], gpair[-rem:], pos[-rem:], node0,
                                 n_nodes, n_bin, stride)
     return acc
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "n_bin", "chunk"))
+def build_histogram_at(bins, gpair, pos, node0, *, n_nodes: int, n_bin: int,
+                       chunk: int = 2048):
+    """build_histogram with a TRACED starting node id.
+
+    The best-first grower expands one node pair at a time with fresh ids;
+    a static node0 would recompile the kernel per expansion, so here node0
+    is an operand (it only feeds the node-mask comparison, never a shape).
+    """
+    node0 = jnp.asarray(node0, jnp.int32)
+    return _hist_accumulate(bins, gpair, pos, node0, n_nodes, n_bin, chunk, 1)
 
 
 @functools.partial(jax.jit, static_argnames=("node0", "n_nodes"))
