@@ -1,0 +1,62 @@
+"""Launcher (dask-role) + polars adapter tests."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+
+
+def test_polars_adapter():
+    pl = pytest.importorskip("polars")
+    rng = np.random.default_rng(0)
+    n = 800
+    df = pl.DataFrame({
+        "x": rng.normal(size=n).astype(np.float32),
+        "c": pl.Series(rng.choice(["a", "b", "c"], size=n),
+                       dtype=pl.Categorical),
+    })
+    y = (df["x"].to_numpy() > 0).astype(np.float32)
+    d = xtb.DMatrix(df, label=y, enable_categorical=True)
+    assert d.num_col() == 2
+    assert d.info.feature_types == ["q", "c"]
+    assert d.cat_categories == {1: ["a", "b", "c"]}
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3}, d, 3,
+                    verbose_eval=False)
+    p = bst.predict(d)
+    assert np.isfinite(p).all()
+    # AUC sanity on the numeric signal
+    order = p.argsort()
+    assert y[order[-100:]].mean() > y[order[:100]].mean()
+
+
+def _launcher_worker(rank, world):
+    import numpy as np
+
+    import xgboost_tpu as xtb
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    Xs, ys = X[rank::world], y[rank::world]
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "max_bin": 32}, xtb.DMatrix(Xs, label=ys), 2,
+                    verbose_eval=False)
+    out = os.environ["LAUNCHER_TEST_OUT"]
+    with open(f"{out}.rank{rank}", "w") as fh:
+        fh.write("".join(bst.get_dump()))
+
+
+def test_run_distributed(tmp_path):
+    from xgboost_tpu.launcher import run_distributed
+
+    out = str(tmp_path / "dump")
+    os.environ["LAUNCHER_TEST_OUT"] = out
+    try:
+        run_distributed(_launcher_worker, 2, platform="cpu", timeout=600)
+    finally:
+        os.environ.pop("LAUNCHER_TEST_OUT", None)
+    d0 = open(out + ".rank0").read()
+    d1 = open(out + ".rank1").read()
+    assert d0 == d1 and len(d0) > 0

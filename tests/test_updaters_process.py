@@ -1,0 +1,130 @@
+"""prune / refresh / sync updaters + process_type=update
+(reference: updater_prune.cc, updater_refresh.cc, updater_sync.cc,
+tests/python/test_updaters.py::test_process_type)."""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+
+
+def _data(seed=0, n=1500, f=6):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.3 * rng.normal(size=n)).astype(np.float32)
+    return X, y
+
+
+def test_refresh_recomputes_leafs_on_new_data():
+    X, y = _data(seed=0)
+    X2, y2 = _data(seed=1)
+    d1 = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "reg:squarederror", "max_depth": 4,
+                     "eta": 0.5}, d1, 5, verbose_eval=False)
+    dumps_before = bst.get_dump()
+    preds_before = bst.predict(xtb.DMatrix(X2))
+
+    # refresh the SAME model against new data: structure identical,
+    # leaf values move toward the new labels
+    d2 = xtb.DMatrix(X2, label=y2)
+    bst.set_param({"process_type": "update", "updater": "refresh"})
+    for it in range(5):
+        bst.update(d2, it)
+    dumps_after = bst.get_dump()
+    assert len(dumps_after) == len(dumps_before)
+
+    def structure(dump):
+        return [ln.split("]")[0] for ln in dump.splitlines() if "[" in ln]
+
+    for a, b in zip(dumps_before, dumps_after):
+        assert structure(a) == structure(b)
+    preds_after = bst.predict(xtb.DMatrix(X2))
+    mse_before = np.mean((preds_before - y2) ** 2)
+    mse_after = np.mean((preds_after - y2) ** 2)
+    assert mse_after < mse_before, (mse_before, mse_after)
+
+
+def test_refresh_leaf_false_keeps_predictions():
+    X, y = _data(seed=2)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "reg:squarederror", "max_depth": 3}, d, 3,
+                    verbose_eval=False)
+    p0 = bst.predict(d)
+    bst.set_param({"process_type": "update", "updater": "refresh",
+                   "refresh_leaf": "0"})
+    for it in range(3):
+        bst.update(d, it)
+    np.testing.assert_allclose(bst.predict(d), p0, rtol=1e-6)
+
+
+def test_prune_collapses_low_gain_splits():
+    X, y = _data(seed=3)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "reg:squarederror", "max_depth": 6,
+                     "gamma": 0.0}, d, 3, verbose_eval=False)
+    leaves_before = [t.num_leaves for t in bst.trees]
+    # re-prune with a large gamma: many splits fall below the bar
+    bst.set_param({"process_type": "update", "updater": "prune",
+                   "gamma": 1e6})
+    for it in range(3):
+        bst.update(d, it)
+    leaves_after = [t.num_leaves for t in bst.trees]
+    assert all(a < b for a, b in zip(leaves_after, leaves_before))
+    # with an absurd gamma everything collapses to stumps
+    assert all(a == 1 for a in leaves_after)
+    # predictions remain finite and the model still works
+    assert np.isfinite(bst.predict(d)).all()
+
+
+def test_prune_respects_kept_gains():
+    X, y = _data(seed=4)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "reg:squarederror", "max_depth": 4,
+                     "gamma": 0.0}, d, 2, verbose_eval=False)
+    p0 = bst.predict(d)
+    bst.set_param({"process_type": "update", "updater": "prune",
+                   "gamma": 0.0})
+    for it in range(2):
+        bst.update(d, it)
+    # nothing below gamma=0 (all recorded gains > 0): identical model
+    np.testing.assert_allclose(bst.predict(d), p0, rtol=1e-6)
+
+
+def test_update_requires_updater_param():
+    X, y = _data(seed=5, n=300)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "reg:squarederror", "max_depth": 3}, d, 2,
+                    verbose_eval=False)
+    bst.set_param("process_type", "update")
+    with pytest.raises(ValueError, match="updater"):
+        bst.update(d, 0)
+    bst.set_param("updater", "refresh")
+    bst.update(d, 0)
+    bst.update(d, 1)
+    with pytest.raises(ValueError, match="exceeds"):
+        bst.update(d, 2)
+
+
+def test_approx_tree_method():
+    """tree_method='approx': per-iteration hessian-weighted re-sketch
+    (reference: updater_approx.cc grow_histmaker) reaches hist-level quality
+    and re-centers cuts as hessians concentrate (binary logistic)."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(2500, 6)).astype(np.float32)
+    logits = X[:, 0] * 2 + X[:, 1]
+    y = (logits + rng.normal(scale=0.5, size=2500) > 0).astype(np.float32)
+    res_a, res_h = {}, {}
+    xtb.train({"objective": "binary:logistic", "tree_method": "approx",
+               "max_depth": 4, "eta": 0.3, "max_bin": 64,
+               "eval_metric": "logloss"},
+              xtb.DMatrix(X, label=y), 8,
+              evals=[(xtb.DMatrix(X, label=y), "t")], evals_result=res_a,
+              verbose_eval=False)
+    xtb.train({"objective": "binary:logistic", "tree_method": "hist",
+               "max_depth": 4, "eta": 0.3, "max_bin": 64,
+               "eval_metric": "logloss"},
+              xtb.DMatrix(X, label=y), 8,
+              evals=[(xtb.DMatrix(X, label=y), "t")], evals_result=res_h,
+              verbose_eval=False)
+    la, lh = res_a["t"]["logloss"][-1], res_h["t"]["logloss"][-1]
+    assert la < res_a["t"]["logloss"][0]
+    assert abs(la - lh) < 0.05, (la, lh)

@@ -187,6 +187,23 @@ class Booster:
         self.n_devices = nd if isinstance(nd, int) else -1  # -1 = all
         self._mesh = None
         self.num_parallel_tree = int(p.get("num_parallel_tree", 1))
+        # process_type=update re-processes an existing model's trees with
+        # the non-growing updaters (gbtree.cc InitUpdater)
+        self.tree_method = str(p.get("tree_method", "hist"))
+        if self.tree_method in ("auto", "gpu_hist", "exact"):
+            # exact walks raw values row-by-row (CPU-only in the reference
+            # too, updater_colmaker.cc); the binned updaters are the TPU
+            # path, so exact maps to hist like the reference's GPU configs
+            self.tree_method = "hist"
+        if self.tree_method not in ("hist", "approx"):
+            raise ValueError(f"unknown tree_method {self.tree_method!r}")
+        self.process_type = str(p.get("process_type", "default"))
+        if self.process_type not in ("default", "update"):
+            raise ValueError(f"unknown process_type {self.process_type!r}")
+        upd = p.get("updater")
+        self.updater_seq = ([u.strip() for u in str(upd).split(",") if u.strip()]
+                            if upd else None)
+        self.refresh_leaf = str(p.get("refresh_leaf", "1")).lower() in ("1", "true")
         # vector-leaf trees (multi_target_tree_model.h): one tree carries all
         # K outputs when multi_strategy="multi_output_tree"
         self.multi_strategy = str(p.get("multi_strategy", "one_output_per_tree"))
@@ -417,6 +434,17 @@ class Booster:
                     "continued training requires the training frame's "
                     "category ordering; re-declare the categorical columns "
                     "with the original categories")
+        if self.process_type == "update":
+            # the update flow keeps its own running margin over the already-
+            # updated prefix; the full-model margin/gradient pass below would
+            # be recomputed work that is then discarded
+            if fobj is not None:
+                raise NotImplementedError(
+                    "process_type='update' with a custom objective is not "
+                    "supported (refresh recomputes gradients internally)")
+            self._ensure_base_margin(cache)
+            self._update_existing_trees(cache, iteration)
+            return
         self._sync_margin(cache)
         drop_idx = self._select_dart_drops(iteration)
         if drop_idx:
@@ -468,6 +496,11 @@ class Booster:
         import jax.numpy as jnp
 
         self._configure()
+        if self.process_type == "update":
+            raise NotImplementedError(
+                "boost() with raw grad/hess cannot drive process_type="
+                "'update' (the refresh updater recomputes gradients per "
+                "round); use update() instead")
         if self._select_dart_drops(iteration):
             # this round actually drops trees: gradients would have to be
             # re-evaluated on the reduced margin, impossible with raw values
@@ -772,6 +805,96 @@ class Booster:
         cache.margin = new_margin
         cache.n_trees_applied = len(self.trees)
 
+    def _update_existing_trees(self, cache: _Cache, iteration: int) -> None:
+        """process_type=update: run the non-growing updater sequence over
+        one boosting round's worth of existing trees (gbtree.cc DoBoost with
+        process_type=kUpdate; updaters prune/refresh/sync).
+
+        Boosting semantics match the reference: round i's gradients come
+        from a margin holding only the already-UPDATED trees 0..i-1 — the
+        not-yet-updated tail of the old model is excluded, exactly as in
+        ordinary boosting."""
+        import jax.numpy as jnp
+
+        from .models.updaters import prune_tree, refresh_tree, sync_trees
+
+        if not self.updater_seq:
+            raise ValueError(
+                "process_type='update' requires updater=..., e.g. "
+                "updater='refresh,prune'")
+        bad = set(self.updater_seq) - {"prune", "refresh", "sync"}
+        if bad:
+            raise ValueError(f"unsupported updater(s) for process_type="
+                             f"'update': {sorted(bad)}")
+        tpr = self.trees_per_round
+        start = iteration * tpr
+        if start >= len(self.trees):
+            raise ValueError(
+                f"process_type='update' round {iteration} exceeds the "
+                f"model's {len(self.trees) // tpr} boosted rounds")
+        if cache.raw_X is None:
+            cache.raw_X = jnp.asarray(self._host_dense_recoded(cache.dmat),
+                                      jnp.float32)
+        if getattr(cache, "_upd_margin_round", None) != iteration:
+            # (re)build the margin of the already-updated prefix — correct
+            # for a fresh cache at any starting round, not just round 0
+            margin = cache.base_margin_init(self._base_margin_value,
+                                            self.n_groups)
+            if start > 0:
+                delta = self._margin_for_trees(cache.raw_X,
+                                               list(range(0, start)))
+                pad = margin.shape[0] - delta.shape[0]
+                if pad:
+                    delta = jnp.concatenate(
+                        [delta, jnp.zeros((pad, delta.shape[1]), jnp.float32)],
+                        axis=0)
+                margin = margin + delta
+            cache._upd_margin = margin
+        gpair = self.objective.get_gradient(
+            cache._upd_margin, cache.labels, cache.weights, iteration
+        ) * cache.valid[:, None, None]
+        gp = np.asarray(gpair)
+        valid = np.asarray(cache.valid).astype(bool)
+        X = np.asarray(cache.raw_X)
+        reduce = None
+        if self._process_parallel():
+            from . import collective
+
+            reduce = collective.allreduce
+        for tid in range(start, min(start + tpr, len(self.trees))):
+            k = self.tree_info[tid]
+            tree = self.trees[tid]
+            for upd in self.updater_seq:
+                if upd == "refresh":
+                    tree = refresh_tree(
+                        tree, X, gp[valid, k, 0], gp[valid, k, 1],
+                        eta=float(self.tparam.eta),
+                        lambda_=float(self.tparam.lambda_),
+                        alpha=float(self.tparam.alpha),
+                        refresh_leaf=self.refresh_leaf,
+                        reduce=reduce)
+                elif upd == "prune":
+                    tree, _ = prune_tree(
+                        tree, gamma=float(self.tparam.gamma),
+                        eta=float(self.tparam.eta),
+                        max_depth=max(int(self.tparam.max_depth), 0))
+            self.trees[tid] = tree
+        if "sync" in self.updater_seq:
+            self.trees, self.tree_info, self.tree_weights = sync_trees(
+                self.trees, self.tree_info, self.tree_weights)
+        # advance the running margin by this round's UPDATED trees
+        delta = self._margin_for_trees(
+            cache.raw_X, list(range(start, min(start + tpr, len(self.trees)))))
+        pad = cache._upd_margin.shape[0] - delta.shape[0]
+        if pad:
+            delta = jnp.concatenate(
+                [delta, jnp.zeros((pad, delta.shape[1]), jnp.float32)], axis=0)
+        cache._upd_margin = cache._upd_margin + delta
+        cache._upd_margin_round = iteration + 1
+        # structure/values changed: every cached margin must rebuild (the
+        # weights_version mismatch makes _sync_margin start from scratch)
+        self._weights_version = getattr(self, "_weights_version", 0) + 1
+
     def _select_dart_drops(self, iteration: int) -> List[int]:
         """Draw the round's dropped-tree set (gbtree.cc Dart::DropTrees).
         Deterministic per iteration; empty when dropout does not fire."""
@@ -930,8 +1053,45 @@ class Booster:
         n_new = 0
         cat_mask_np = cache.dmat.cat_mask()
         if self.multi_strategy == "multi_output_tree" and K > 1:
+            if self.tree_method == "approx":
+                raise NotImplementedError(
+                    "tree_method='approx' with multi_output_tree is not "
+                    "supported yet")
             return self._boost_multi_target(cache, gpair, iteration, K,
                                             grower, cat_mask_np)
+        bins_use, cuts_use, nbins_use = cache.bins, ell.cuts_pad, ell.n_bins
+        if self.tree_method == "approx":
+            # grow_histmaker (updater_approx.cc): fresh hessian-weighted
+            # sketch every iteration, then the same hist machinery; cut
+            # width pinned to max_bin so the jitted level programs are
+            # shared across rounds
+            from .data.ellpack import build_ellpack
+            from .data.quantile import sketch_dense, sketch_distributed
+
+            valid_np = np.asarray(cache.valid).astype(bool)
+            hess_w = np.asarray(gpair)[..., 1].sum(axis=1)[valid_np]
+            Xh = self._host_dense_recoded(cache.dmat)
+            if self._process_parallel():
+                # per-shard grids must merge or workers bin against
+                # different value ranges (quantile.cc AllreduceV role)
+                cuts = sketch_distributed(Xh, self.tparam.max_bin,
+                                          weights=hess_w.astype(np.float64),
+                                          cat_mask=cache.dmat.cat_mask())
+            else:
+                cuts = sketch_dense(Xh, self.tparam.max_bin,
+                                    weights=hess_w.astype(np.float64),
+                                    use_device=False,
+                                    cat_mask=cache.dmat.cat_mask())
+            ell_iter = build_ellpack(Xh, cuts, row_align=1024)
+            if ell_iter.n_padded != cache.bins.shape[0]:
+                raise AssertionError("approx page padding mismatch")
+            bins_use = jnp.asarray(ell_iter.bins)
+            cuts_use = jnp.asarray(cuts.padded(self.tparam.max_bin))
+            nbins_use = jnp.asarray(cuts.n_bins_array())
+            if self._get_mesh() is not None:
+                from .parallel import shard_rows
+
+                (bins_use,) = shard_rows(self._get_mesh(), bins_use)
         for p_idx in range(max(self.num_parallel_tree, 1)):
             fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx, ell.n_features)
             # one independent subsample per parallel tree (reference: each
@@ -939,17 +1099,17 @@ class Booster:
             gp = self._subsample_mask(gpair, iteration * 131 + p_idx)
             for k in range(K):
                 state = grower.grow(
-                    cache.bins,
+                    bins_use,
                     gp[:, k, :],
                     cache.valid,
-                    ell.cuts_pad,
-                    ell.n_bins,
+                    cuts_use,
+                    nbins_use,
                     feature_masks=fmask_fn,
                     cat_mask=cat_mask_np,
                 )
                 pos = state.pos
                 if best_first:
-                    tree, leaf_val = grower.to_regtree(state, ell.cuts_pad)
+                    tree, leaf_val = grower.to_regtree(state, cuts_use)
                 else:
                     tree = None
                     leaf_val = state.leaf_val

@@ -77,6 +77,29 @@ def _to_numpy_2d(data: Any, missing: float = np.nan):
     """
     feature_names = None
     feature_types = None
+    # polars (columnar adapter; reference: ColumnarAdapter src/data/adapter.h
+    # + python-package data.py _from_polars)
+    if type(data).__module__.split(".")[0] == "polars":
+        import polars as pl
+
+        feature_names = list(data.columns)
+        feature_types = []
+        cols = []
+        cat_categories = {}
+        for fi, c in enumerate(data.columns):
+            s = data[c]
+            if s.dtype in (pl.Categorical, pl.Enum):
+                cat_categories[fi] = [str(v) for v in
+                                      s.cat.get_categories().to_list()]
+                codes = s.to_physical().cast(pl.Float32).to_numpy().copy()
+                cols.append(codes)
+                feature_types.append("c")
+            else:
+                cols.append(s.cast(pl.Float32).to_numpy().copy())
+                feature_types.append("q")
+        arr = (np.stack(cols, axis=1) if cols
+               else np.zeros((len(data), 0), np.float32))
+        return ("dense", arr, cat_categories), feature_names, feature_types
     # pandas
     if hasattr(data, "iloc") and hasattr(data, "columns"):
         feature_names = [str(c) for c in data.columns]

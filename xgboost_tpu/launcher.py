@@ -1,0 +1,125 @@
+"""Multi-process training launcher — the role of the reference's dask/spark
+launchers (python-package/xgboost/dask/__init__.py:722 _train_async: one
+worker per data shard, rabit rendezvous, identical models out).
+
+There is no dask in the TPU stack: jax.distributed is the rendezvous and the
+collective, so the launcher's job reduces to spawning one process per worker
+with the coordinator address wired through ``collective.init``.  Each worker
+runs ``fn(rank, world_size)``; inside, build a DMatrix on the worker's shard
+and call ``xgboost_tpu.train`` — cuts merge through the distributed sketch
+and histograms allreduce per level, so every worker returns the same model
+(tested in tests/test_multiprocess.py).
+
+Example worker::
+
+    def worker(rank, world):
+        import xgboost_tpu as xtb
+        X, y = load_shard(rank, world)
+        bst = xtb.train(params, xtb.DMatrix(X, label=y), 100)
+        if rank == 0:
+            bst.save_model("model.ubj")
+
+    from xgboost_tpu.launcher import run_distributed
+    run_distributed(worker, num_workers=4)
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Callable, Optional
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+_CHILD = r"""
+import pickle, sys
+import jax
+
+platform = sys.argv[4]
+if platform:
+    jax.config.update("jax_platforms", platform)
+if sys.argv[6]:
+    sys.path.insert(0, sys.argv[6])  # make fn's defining module importable
+from xgboost_tpu import collective
+
+rank = int(sys.argv[1])
+world = int(sys.argv[2])
+port = sys.argv[3]
+collective.init(coordinator_address=f"127.0.0.1:{port}",
+                num_processes=world, process_id=rank)
+with open(sys.argv[5], "rb") as fh:
+    fn = pickle.load(fh)
+try:
+    fn(rank, world)
+finally:
+    collective.finalize()
+"""
+
+
+def run_distributed(fn: Callable[[int, int], None], num_workers: int,
+                    *, coordinator_port: Optional[int] = None,
+                    platform: Optional[str] = None,
+                    timeout: float = 3600.0) -> None:
+    """Spawn ``num_workers`` processes, each running ``fn(rank, world)``
+    under an initialized collective.  ``fn`` must be picklable (a module-
+    level function).  ``platform`` overrides jax_platforms in the workers
+    (e.g. "cpu" for tests; the sitecustomize freeze means the env var alone
+    is not enough).  Raises on the first failing worker."""
+    port = coordinator_port or _free_port()
+    with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as fh:
+        pickle.dump(fn, fh)
+        fn_path = fh.name
+    mod = sys.modules.get(getattr(fn, "__module__", ""), None)
+    mod_dir = (os.path.dirname(os.path.abspath(mod.__file__))
+               if mod is not None and getattr(mod, "__file__", None) else "")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    import time
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD, str(rank), str(num_workers),
+             str(port), platform or "", fn_path, mod_dir],
+            env=env)
+        for rank in range(num_workers)
+    ]
+    try:
+        deadline = time.monotonic() + timeout
+        errs = []
+        pending = dict(enumerate(procs))
+        while pending:
+            for rank, p in list(pending.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del pending[rank]
+                if rc != 0:
+                    errs.append(rank)
+            if errs:
+                # fail fast: peers would otherwise block in rendezvous or a
+                # collective forever, waiting for the dead worker
+                for p in pending.values():
+                    p.kill()
+                raise RuntimeError(f"worker(s) {errs} exited non-zero; "
+                                   "remaining workers killed")
+            if pending and time.monotonic() > deadline:
+                for p in pending.values():
+                    p.kill()
+                raise TimeoutError(
+                    f"worker(s) {sorted(pending)} still running after "
+                    f"{timeout}s; killed")
+            if pending:
+                time.sleep(0.2)
+    finally:
+        try:
+            os.unlink(fn_path)
+        except OSError:
+            pass
