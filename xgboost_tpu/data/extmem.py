@@ -27,6 +27,57 @@ from .quantile import HistogramCuts, cuts_from_quantile_grid
 PAGE_ALIGN = 1024  # rows; keeps every page a whole number of hist row tiles
 
 
+class CompressedPage:
+    """Zstd-compressed binned page, in host RAM or spilled to disk.
+
+    The role of the reference's page compression (compressed_iterator.h
+    bit-packing + device_compression.cu nvCOMP): binned codes are tiny-
+    alphabet integers, so entropy coding crushes them (subsuming manual
+    bit-packing) and every histogram pass pays one decompress on the host
+    side of the H2D copy.  Transparent to consumers: ``shape``/``dtype``
+    attributes plus ``__array__`` (``np.ascontiguousarray``/``jnp.asarray``
+    decompress on touch).
+    """
+
+    __slots__ = ("shape", "dtype", "_blob", "_path", "nbytes_compressed")
+
+    def __init__(self, arr: np.ndarray, path: Optional[str] = None):
+        import zstandard as zstd
+
+        raw = np.ascontiguousarray(arr)
+        blob = zstd.ZstdCompressor(level=3).compress(raw.tobytes())
+        self.shape = raw.shape
+        self.dtype = raw.dtype
+        self.nbytes_compressed = len(blob)
+        if path is not None:
+            with open(path, "wb") as fh:
+                fh.write(blob)
+            self._blob, self._path = None, path
+        else:
+            self._blob, self._path = blob, None
+
+    def __array__(self, dtype=None, copy=None):
+        import zstandard as zstd
+
+        blob = self._blob
+        if blob is None:
+            with open(self._path, "rb") as fh:
+                blob = fh.read()
+        out = np.frombuffer(
+            zstd.ZstdDecompressor().decompress(blob), dtype=self.dtype
+        ).reshape(self.shape)
+        return out if dtype is None else out.astype(dtype)
+
+
+def _zstd_available() -> bool:
+    try:
+        import zstandard  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
 class DataIter:
     """User-defined batch iterator (reference: core.py:265).
 
@@ -76,13 +127,27 @@ class ExtMemQuantileDMatrix(DMatrix):
     def __init__(self, data: DataIter, *, max_bin: int = 256,
                  ref: Optional[DMatrix] = None, missing: float = np.nan,
                  on_host: bool = True, enable_categorical: bool = False,
-                 cache_host_ratio: Optional[float] = None, **kwargs: Any) -> None:
+                 cache_host_ratio: Optional[float] = None,
+                 compress: bool = True, **kwargs: Any) -> None:
         if not isinstance(data, DataIter):
             raise TypeError("ExtMemQuantileDMatrix requires a DataIter")
         self._it = data
         self.max_bin = max_bin
         self.on_host = on_host
-        self._pages: List[np.ndarray] = []
+        # compression defaults on, matching the reference (Ellpack pages are
+        # always compressed_iterator-packed there; decompression here costs
+        # one host pass per page touch, the trade the extmem path exists
+        # for); degrades gracefully when zstandard is unavailable
+        if compress and not _zstd_available():
+            import warnings
+
+            warnings.warn("zstandard not installed; external-memory pages "
+                          "will be stored uncompressed")
+            compress = False
+        self.compress = compress
+        # plain ndarrays (or memmaps) when compress=False, CompressedPage
+        # otherwise — consumers only use shape/dtype/__array__
+        self._pages: List[Any] = []
         self._page_rows: List[int] = []  # real rows per page
         self._spill_dir = None if on_host else tempfile.mkdtemp(prefix="xtb_pages_")
 
@@ -187,7 +252,11 @@ class ExtMemQuantileDMatrix(DMatrix):
             X = np.asarray(batch["data"], dtype=np.float32)
             page = build_ellpack(X, cuts, row_align=PAGE_ALIGN)
             host_page = np.asarray(page.bins)
-            if not on_host:
+            if compress:
+                path = (f"{self._spill_dir}/page{bi}.zst"
+                        if not on_host else None)
+                host_page = CompressedPage(host_page, path=path)
+            elif not on_host:
                 path = f"{self._spill_dir}/page{bi}.npy"
                 mm = np.lib.format.open_memmap(
                     path, mode="w+", dtype=host_page.dtype, shape=host_page.shape

@@ -155,13 +155,13 @@ class StreamingHistTreeGrower:
             N = 1 << d
             n_build = (N // 2) if subtract else N
             hist_acc = None
-            # prefetch pipeline: page i+1 ships while page i computes
+            # prefetch pipeline: page i's compute is DISPATCHED (async jit)
+            # before page i+1 is decompressed/shipped, so the host-side
+            # decompress of compressed pages overlaps device compute
             next_dev = self._put_page(pages[0]) if n_pages else None
             pos = state.pos
             for i in range(n_pages):
                 dev = next_dev
-                if i + 1 < n_pages:
-                    next_dev = self._put_page(pages[i + 1])
                 lo, hi = page_offsets[i], page_offsets[i + 1]
                 seg_len = hi - lo
                 pos_seg = lax.dynamic_slice_in_dim(pos, lo, seg_len)
@@ -173,6 +173,8 @@ class StreamingHistTreeGrower:
                     n_bin=B, has_prev=prev_best is not None, has_cat=has_cat,
                     build=build, stride=2 if subtract else 1,
                 )
+                if i + 1 < n_pages:
+                    next_dev = self._put_page(pages[i + 1])
                 pos = lax.dynamic_update_slice_in_dim(pos, pos_seg, lo, axis=0)
                 if build:
                     hist_acc = h if hist_acc is None else hist_acc + h
