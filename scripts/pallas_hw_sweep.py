@@ -1,0 +1,80 @@
+"""Validate + tune the Pallas histogram kernel on real TPU hardware.
+
+Compares ops/hist_pallas.build_histogram_pallas against the XLA einsum
+reference (ops/histogram.build_histogram) for parity and speed at
+HIGGS-bench shapes, sweeping row-tile / feature-group sizes.
+
+Run:  python scripts/pallas_hw_sweep.py [rows]
+Writes results as JSON lines to stderr-readable stdout.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def bench_one(fn, *args, iters=5, **kw):
+    out = fn(*args, **kw)
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kw)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / iters, out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import xgboost_tpu.ops.hist_pallas as hp
+    from xgboost_tpu.ops.histogram import build_histogram
+
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    F, B = 28, 256
+    rng = np.random.default_rng(0)
+    bins = jnp.asarray(rng.integers(0, B, size=(R, F), dtype=np.int32))
+    gpair = jnp.asarray(rng.normal(size=(R, 2)).astype(np.float32))
+
+    dev = jax.devices()[0]
+    print(f"device={dev} R={R} F={F} B={B}", flush=True)
+
+    for n_nodes, depth in [(8, 3), (32, 6)]:
+        pos = jnp.asarray(
+            rng.integers(n_nodes - 1, 2 * n_nodes - 1, size=R, dtype=np.int32)
+        )
+        node0, stride = n_nodes - 1, 1
+
+        t_ein, h_ref = bench_one(
+            build_histogram, bins, gpair, pos,
+            node0=node0, n_nodes=n_nodes, n_bin=B,
+        )
+        print(f"[N={n_nodes}] einsum: {t_ein*1e3:.1f} ms", flush=True)
+
+        for row_tile in (256, 512, 1024, 2048):
+            for fg in (1, 2, 4, 7, 14):
+                hp._ROW_TILE, hp._FEAT_GROUP = row_tile, fg
+                # tile sizes are module globals, not jit keys — force retrace
+                hp.build_histogram_pallas.clear_cache()
+                try:
+                    t, h = bench_one(
+                        hp.build_histogram_pallas, bins, gpair, pos,
+                        node0=node0, n_nodes=n_nodes, n_bin=B,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    print(f"[N={n_nodes}] pallas T={row_tile} FG={fg}: "
+                          f"FAIL {type(e).__name__}: {str(e)[:120]}", flush=True)
+                    continue
+                ok = bool(jnp.allclose(h, h_ref, atol=1e-3, rtol=1e-5))
+                print(
+                    f"[N={n_nodes}] pallas T={row_tile} FG={fg}: "
+                    f"{t*1e3:.1f} ms  parity={'OK' if ok else 'MISMATCH'}  "
+                    f"speedup={t_ein/t:.2f}x",
+                    flush=True,
+                )
+
+
+if __name__ == "__main__":
+    main()

@@ -211,7 +211,7 @@ print(json.dumps({{"shape": list(p.shape), "head": p[:20].reshape(-1).tolist()}}
 
 
 def test_ranking_quality_parity(tmp_path):
-    """LambdaMART rank:ndcg: final train ndcg@8 within 0.03 of the
+    """LambdaMART rank:ndcg: final train ndcg@8 within 0.05 of the
     reference on identical grouped data."""
     rng = np.random.default_rng(23)
     n_groups, per = 120, 12

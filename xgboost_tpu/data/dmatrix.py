@@ -69,14 +69,43 @@ def _load_uri(uri: str):
             None, None, labels, qids)
 
 
+def _is_jax_array(data: Any) -> bool:
+    return type(data).__module__.split(".")[0] in ("jax", "jaxlib") and hasattr(
+        data, "devices"
+    )
+
+
+def _normalize_dense(arr, missing: float, xp):
+    """1-D promotion + custom-missing -> NaN, shared by the host (xp=numpy)
+    and device (xp=jax.numpy) ingest paths so their semantics cannot drift."""
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    missing_is_nan = missing is None or (
+        isinstance(missing, (float, np.floating)) and np.isnan(missing))
+    if not missing_is_nan:
+        arr = xp.where(arr == missing, xp.nan, arr)
+    return arr
+
+
 def _to_numpy_2d(data: Any, missing: float = np.nan):
     """Dispatch user input -> (dense ndarray | csr triple, feature names/types).
 
     Mirrors the adapter dispatch of the reference (src/data/adapter.h,
     python-package/xgboost/data.py): numpy, pandas, scipy CSR/CSC, list.
+    Device arrays never pass through here — DMatrix keeps jax.Array input
+    on device (the CudfAdapter/CupyAdapter role, src/data/device_adapter.cuh).
     """
     feature_names = None
     feature_types = None
+    # torch / other dlpack producers: zero-copy host view (reference:
+    # src/data/array_interface.h dlpack ingestion).  Zero-copy contract:
+    # the caller must not mutate the buffer before training first touches
+    # this DMatrix (binning is lazy for plain DMatrix).
+    if not isinstance(data, np.ndarray) and hasattr(data, "__dlpack__"):
+        try:
+            data = np.from_dlpack(data)
+        except (TypeError, RuntimeError, BufferError):
+            pass  # fall through to np.asarray
     # polars (columnar adapter; reference: ColumnarAdapter src/data/adapter.h
     # + python-package data.py _from_polars)
     if type(data).__module__.split(".")[0] == "polars":
@@ -128,11 +157,7 @@ def _to_numpy_2d(data: Any, missing: float = np.nan):
         csr = data.tocsr()
         return ("csr", (np.asarray(csr.indptr), np.asarray(csr.indices),
                         np.asarray(csr.data, dtype=np.float32), csr.shape)), None, None
-    arr = np.asarray(data, dtype=np.float32)
-    if arr.ndim == 1:
-        arr = arr[:, None]
-    if not (missing is None or (isinstance(missing, float) and np.isnan(missing))):
-        arr = np.where(arr == missing, np.nan, arr)
+    arr = _normalize_dense(np.asarray(data, dtype=np.float32), missing, np)
     return ("dense", arr), feature_names, feature_types
 
 
@@ -164,9 +189,20 @@ class DMatrix:
     ) -> None:
         auto_label = auto_qid = None
         self.cat_categories = None  # {feature idx -> category values} (pandas)
+        self._jax_X = None  # device-resident input (zero-copy jax.Array ingest)
         if isinstance(data, (str, os.PathLike)):
             (kind, payload), auto_names, auto_types, auto_label, auto_qid = _load_uri(
                 os.fspath(data))
+        elif _is_jax_array(data):
+            # zero-copy device ingest: keep the array on device; host numpy is
+            # materialized lazily only if a host path (raw predict, slice)
+            # needs it (reference: device adapters skip the host round-trip,
+            # src/data/device_adapter.cuh:67)
+            import jax.numpy as jnp
+
+            self._jax_X = _normalize_dense(
+                jnp.asarray(data, dtype=jnp.float32), missing, jnp)
+            kind, payload, auto_names, auto_types = "dense", None, None, None
         else:
             (kind, *rest), auto_names, auto_types = _to_numpy_2d(data, missing)
             payload = rest[0]
@@ -176,7 +212,8 @@ class DMatrix:
         if kind == "dense":
             self._dense: Optional[np.ndarray] = payload
             self._csr = None
-            num_row, num_col = payload.shape
+            num_row, num_col = (payload.shape if payload is not None
+                                else self._jax_X.shape)
         else:
             self._dense = None
             self._csr = payload
@@ -269,14 +306,17 @@ class DMatrix:
         """Dense f32 view with NaN missing (prediction walks raw values)."""
         if self._dense is not None:
             return self._dense
+        if self._jax_X is not None:  # lazy device -> host materialization
+            self._dense = np.asarray(self._jax_X)
+            return self._dense
         return self.host_dense_rows(0, self.num_row())
 
     def host_dense_rows(self, lo: int, hi: int) -> np.ndarray:
         """Densify only rows [lo, hi) — the bounded-memory window used by the
         streamed predictor (reference: gpu_predictor.cu:43-90 splits a
         SparsePage loader from the dense loader for the same reason)."""
-        if self._dense is not None:
-            return self._dense[lo:hi]
+        if self._dense is not None or self._jax_X is not None:
+            return self.host_dense()[lo:hi]
         indptr, indices, values, (R, F) = self._csr
         hi = min(hi, R)
         out = np.full((hi - lo, F), np.nan, dtype=np.float32)
@@ -284,6 +324,17 @@ class DMatrix:
         row_of = np.repeat(np.arange(lo, hi), np.diff(indptr[lo : hi + 1])) - lo
         out[row_of, indices[a:b]] = values[a:b]
         return out
+
+    def _device_dense(self):
+        """Device f32 view of dense data, uploaded at most once — the sketch
+        and the Ellpack build share it instead of each shipping X over the
+        host->device link (at tunnel bandwidths that transfer dominates
+        QuantileDMatrix construction)."""
+        if self._jax_X is None:
+            import jax.numpy as jnp
+
+            self._jax_X = jnp.asarray(self._dense, dtype=jnp.float32)
+        return self._jax_X
 
     def cat_mask(self) -> Optional[np.ndarray]:
         """(F,) bool — which features are categorical ('c' feature type)."""
@@ -305,19 +356,30 @@ class DMatrix:
             # summaries into shared cuts (quantile.cc:397 AllreduceV analogue)
             from .quantile import sketch_distributed
 
-            cuts = sketch_distributed(self._dense, max_bin,
+            cuts = sketch_distributed(self.host_dense(), max_bin,
                                       weights=sketch_weights,
                                       cat_mask=self.cat_mask())
         elif self._kind == "dense":
-            cuts = sketch_dense(self._dense, max_bin, weights=sketch_weights,
-                                cat_mask=self.cat_mask())
+            # weighted / categorical sketches run on host — feed them the
+            # host array when we already have one rather than bouncing the
+            # device upload back down
+            cm = self.cat_mask()
+            host_sketch = sketch_weights is not None or (
+                cm is not None and cm.any())
+            # host sketches get the cached host copy (one D2H transfer, reused
+            # by later host paths) instead of bouncing the device array down
+            sk_X = self.host_dense() if host_sketch else self._device_dense()
+            cuts = sketch_dense(sk_X, max_bin, weights=sketch_weights,
+                                cat_mask=cm)
         else:
             indptr, indices, values, (R, F) = self._csr
             cuts = sketch_csr(indptr, indices, values, F, max_bin,
                               weights=sketch_weights, cat_mask=self.cat_mask(),
                               distributed=distributed)
         if self._kind == "dense":
-            self._ellpack = build_ellpack(self._dense, cuts)
+            self._ellpack = build_ellpack(self._device_dense(), cuts)
+            if self._dense is not None:
+                self._jax_X = None  # binned; drop the duplicate device copy
         else:
             indptr, indices, values, (R, F) = self._csr
             self._ellpack = build_ellpack_csr(indptr, indices, values, F, cuts)
@@ -328,7 +390,7 @@ class DMatrix:
         """Row slice (reference: XGDMatrixSliceDMatrix) — used by cv()."""
         idx = np.asarray(rindex, dtype=np.int64)
         if self._kind == "dense":
-            out = DMatrix(self._dense[idx])
+            out = DMatrix(self.host_dense()[idx])
         else:
             import scipy.sparse as sp
 
