@@ -1,0 +1,24 @@
+#!/bin/bash
+# Patient TPU-tunnel watcher: probe every 5 min; when the axon relay heals,
+# run the Pallas histogram hardware sweep once and exit.
+LOG=/tmp/tpu_watcher.log
+SWEEP_LOG=/tmp/pallas_sweep_hw.log
+echo "watcher start $(date)" >> "$LOG"
+while true; do
+  python - <<'EOF' >> "$LOG" 2>&1
+import jax
+d = jax.devices()
+assert d[0].platform == "tpu", d
+import jax.numpy as jnp
+x = jnp.ones((128, 128))
+assert float((x @ x)[0, 0]) == 128.0
+print("PROBE-OK", d)
+EOF
+  if [ $? -eq 0 ]; then
+    echo "tunnel healthy $(date); running sweep" >> "$LOG"
+    PYTHONPATH=/root/repo:/root/.axon_site python /root/repo/scripts/pallas_hw_sweep.py 2000000 > "$SWEEP_LOG" 2>&1
+    echo "sweep exit=$? $(date)" >> "$LOG"
+    exit 0
+  fi
+  sleep 300
+done

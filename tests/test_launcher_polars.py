@@ -31,6 +31,47 @@ def test_polars_adapter():
     assert y[order[-100:]].mean() > y[order[:100]].mean()
 
 
+def test_arrow_adapter():
+    pa = pytest.importorskip("pyarrow")
+    rng = np.random.default_rng(0)
+    n = 800
+    x = rng.normal(size=n).astype(np.float32)
+    x[::17] = np.nan
+    cats = rng.choice(["a", "b", "c"], size=n)
+    tab = pa.table({
+        "x": pa.array(x),
+        "i": pa.array(rng.integers(0, 5, size=n), type=pa.int32()),
+        "c": pa.array(cats).dictionary_encode(),
+    })
+    y = (np.nan_to_num(x) > 0).astype(np.float32)
+    d = xtb.DMatrix(tab, label=y, enable_categorical=True)
+    assert d.num_col() == 3
+    assert d.info.feature_types == ["q", "int", "c"]
+    assert d.info.feature_names == ["x", "i", "c"]
+    # arrow dictionaries keep first-appearance order; values round-trip
+    assert sorted(d.cat_categories[2]) == ["a", "b", "c"]
+    assert np.isnan(d.host_dense()[::17, 0]).all()
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3}, d, 3,
+                    verbose_eval=False)
+    p = bst.predict(d)
+    assert np.isfinite(p).all()
+    order = p.argsort()
+    assert y[order[-100:]].mean() > y[order[:100]].mean()
+
+    # custom missing sentinel must convert to NaN on the columnar path too
+    t2 = pa.table({"x": pa.array([1.0, -999.0, 3.0], type=pa.float32())})
+    d3 = xtb.DMatrix(t2, missing=-999.0)
+    h = d3.host_dense()[:, 0]
+    assert h[0] == 1.0 and np.isnan(h[1]) and h[2] == 3.0
+
+    # RecordBatch goes through the same adapter
+    rb = tab.to_batches()[0]
+    d2 = xtb.DMatrix(rb, label=y[: rb.num_rows], enable_categorical=True)
+    assert d2.num_col() == 3
+    np.testing.assert_array_equal(
+        np.isnan(d2.host_dense()), np.isnan(d.host_dense()[: rb.num_rows]))
+
+
 def _launcher_worker(rank, world):
     import numpy as np
 

@@ -69,14 +69,14 @@ collective.finalize()
 """
 
 
-def test_two_process_training_identical_trees(tmp_path):
+def _run_two_process(child_src):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     procs = [
-        subprocess.Popen([sys.executable, "-c", CHILD, str(rank), str(port)],
+        subprocess.Popen([sys.executable, "-c", child_src, str(rank), str(port)],
                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                          text=True, env=env)
         for rank in range(2)
@@ -87,8 +87,13 @@ def test_two_process_training_identical_trees(tmp_path):
         assert p.returncode == 0, f"worker failed:\n{err[-4000:]}"
         line = [ln for ln in out.splitlines() if ln.startswith("RESULT")][-1]
         outs.append(json.loads(line[len("RESULT"):]))
+    return sorted(outs, key=lambda o: o["rank"])
 
-    r0, r1 = sorted(outs, key=lambda o: o["rank"])
+
+def test_two_process_training_identical_trees(tmp_path):
+    outs = _run_two_process(CHILD)
+
+    r0, r1 = outs
     # shared cuts: the distributed sketch merge must agree bitwise
     np.testing.assert_array_equal(r0["cut_values"], r1["cut_values"])
     # identical trees on both workers (the reference's rabit guarantee)
@@ -116,4 +121,123 @@ def test_two_process_training_identical_trees(tmp_path):
     # distributed (merged-sketch) cuts differ slightly from single-node cuts,
     # so trees need not match the single-process run — but predictions should
     # land in the same ballpark
+    assert np.all(np.abs(np.asarray(r0["preds_head"]) - full_head) < 0.25)
+
+
+CHILD_EXTMEM = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+rank = int(sys.argv[1]); port = sys.argv[2]
+
+from xgboost_tpu import collective
+collective.init(coordinator_address=f"127.0.0.1:{port}",
+                num_processes=2, process_id=rank)
+
+import numpy as np
+import xgboost_tpu as xtb
+from xgboost_tpu.data.extmem import DataIter, ExtMemQuantileDMatrix
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(4000, 8)).astype(np.float32)
+X[rng.random(X.shape) < 0.1] = np.nan
+y = (np.nan_to_num(X[:, 0]) * 1.5 + np.nan_to_num(X[:, 1]) > 0).astype(np.float32)
+Xs, ys = X[rank::2], y[rank::2]          # disjoint row shards
+
+class ShardIter(DataIter):
+    def __init__(self):
+        super().__init__()
+        self._i = 0
+    def next(self, input_data):
+        if self._i >= 2:                  # 2 pages per process
+            return 0
+        lo = self._i * 1000; hi = lo + 1000
+        input_data(data=Xs[lo:hi], label=ys[lo:hi])
+        self._i += 1
+        return 1
+    def reset(self):
+        self._i = 0
+
+d = ExtMemQuantileDMatrix(ShardIter(), max_bin=64)
+bst = xtb.train({"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+                 "max_bin": 64}, d, 3, verbose_eval=False)
+dump = bst.get_dump(dump_format="json")
+preds = bst.predict(d)
+
+import hashlib
+print("RESULT" + json.dumps({
+    "rank": rank,
+    "cut_values": np.asarray(d._cuts.cut_values).tolist(),
+    "dump_hash": hashlib.md5("".join(dump).encode()).hexdigest(),
+    "dump0": dump[0],
+    "preds_head": preds[:5].tolist(),
+}))
+collective.finalize()
+"""
+
+
+CHILD_MULTI = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+rank = int(sys.argv[1]); port = sys.argv[2]
+
+from xgboost_tpu import collective
+collective.init(coordinator_address=f"127.0.0.1:{port}",
+                num_processes=2, process_id=rank)
+
+import numpy as np
+import xgboost_tpu as xtb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(2000, 6)).astype(np.float32)
+W = rng.normal(size=(6, 3)).astype(np.float32)
+Y = (X @ W).astype(np.float32)
+Xs, Ys = X[rank::2], Y[rank::2]
+
+d = xtb.DMatrix(Xs, label=Ys)
+bst = xtb.train({"objective": "reg:squarederror", "num_target": 3,
+                 "multi_strategy": "multi_output_tree", "max_depth": 4,
+                 "eta": 0.3, "max_bin": 64}, d, 3, verbose_eval=False)
+dump = bst.get_dump(dump_format="json")
+
+import hashlib
+print("RESULT" + json.dumps({
+    "rank": rank,
+    "dump_hash": hashlib.md5("".join(dump).encode()).hexdigest(),
+    "preds_head": bst.predict(d)[:3].tolist(),
+}))
+collective.finalize()
+"""
+
+
+def test_two_process_multitarget_identical_trees():
+    """Vector-leaf trees x multi-process: the 2K-channel histogram allreduce
+    must produce bitwise-identical trees on every rank."""
+    r0, r1 = _run_two_process(CHILD_MULTI)
+    assert r0["dump_hash"] == r1["dump_hash"]
+
+
+def test_two_process_extmem_training_identical_trees():
+    """extmem x multi-process: each worker streams its own page shard; the
+    per-level histogram allreduce must make trees bitwise identical across
+    ranks (the reference's extmem path runs unchanged under rabit —
+    updater_gpu_hist.cu:601)."""
+    r0, r1 = _run_two_process(CHILD_EXTMEM)
+    np.testing.assert_array_equal(r0["cut_values"], r1["cut_values"])
+    assert r0["dump_hash"] == r1["dump_hash"]
+    assert r0["dump0"] == r1["dump0"]
+
+    # quality: the 2-process extmem model must roughly match in-memory
+    # training over the union of the shards
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(4000, 8)).astype(np.float32)
+    X[rng.random(X.shape) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) * 1.5 + np.nan_to_num(X[:, 1]) > 0).astype(np.float32)
+    import xgboost_tpu as xtb
+
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 4,
+                     "eta": 0.3, "max_bin": 64},
+                    xtb.DMatrix(X, label=y), 3, verbose_eval=False)
+    full_head = bst.predict(xtb.DMatrix(X[0::2]))[:5]
     assert np.all(np.abs(np.asarray(r0["preds_head"]) - full_head) < 0.25)

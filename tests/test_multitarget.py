@@ -107,5 +107,40 @@ def test_multi_output_tree_unsupported_combos():
     with pytest.raises(NotImplementedError):
         xtb.train({"objective": "reg:squarederror", "num_target": 3,
                    "multi_strategy": "multi_output_tree", "max_depth": 3,
-                   "grow_policy": "lossguide", "max_leaves": 8},
+                   "booster": "dart"},
                   d, 2, verbose_eval=False)
+
+
+def test_multi_output_tree_lossguide_max_leaves():
+    """lossguide + max_leaves budget on vector-leaf trees: the leaf count is
+    capped and the model still fits (driver.h grow-policy queue semantics)."""
+    X, Y = _multi_data()
+    d = xtb.DMatrix(X, label=Y)
+    bst = xtb.train({"objective": "reg:squarederror", "num_target": 3,
+                     "multi_strategy": "multi_output_tree", "max_depth": 6,
+                     "grow_policy": "lossguide", "max_leaves": 8, "eta": 0.3},
+                    d, 5, verbose_eval=False)
+    for t in bst.trees:
+        n_leaves = int(np.sum(t.left_children == -1))
+        assert n_leaves <= 8
+    p = bst.predict(d)
+    rmse = float(np.sqrt(np.mean((p - Y) ** 2)))
+    base = float(np.sqrt(np.mean((Y - Y.mean(0)) ** 2)))
+    assert rmse < 0.9 * base
+
+
+def test_multi_output_tree_mesh_matches_single(eight_devices):
+    """Vector-leaf training over the 8-device mesh == single device
+    (the multi-target AllReduceHist psum is deterministic)."""
+    X, Y = _multi_data(n=1024)
+    params = {"objective": "reg:squarederror", "num_target": 3,
+              "multi_strategy": "multi_output_tree", "max_depth": 4,
+              "eta": 0.3}
+    b1 = xtb.train(params, xtb.DMatrix(X, label=Y), 4, verbose_eval=False)
+    b8 = xtb.train({**params, "n_devices": 8}, xtb.DMatrix(X, label=Y), 4,
+                   verbose_eval=False)
+    p1, p8 = b1.predict(xtb.DMatrix(X)), b8.predict(xtb.DMatrix(X))
+    np.testing.assert_allclose(p1, p8, rtol=5e-4, atol=1e-5)
+    for t1, t8 in zip(b1.trees, b8.trees):
+        np.testing.assert_array_equal(t1.split_indices, t8.split_indices)
+        np.testing.assert_array_equal(t1.left_children, t8.left_children)

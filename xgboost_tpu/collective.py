@@ -3,26 +3,28 @@ src/collective/ — the rabit-descended flat API).
 
 On TPU the mesh IS the communicator: jax.distributed supplies rendezvous
 (replacing the RabitTracker socket bootstrap, tracker.h:141) and XLA
-collectives carry the data, so ``init``/``CommunicatorContext`` configure
-jax.distributed while ``allreduce``/``broadcast`` run tiny jitted psum/select
-programs over the live devices.  Single-process (no distributed init) is the
-identity backend — mirroring how the reference degrades to world_size == 1.
+collectives carry the data.  The flat functions below dispatch through a thin
+swappable **backend trait** — the role of the reference's ``Coll`` interface +
+``CommGroup`` backend select (src/collective/coll.h:23, comm_group.cc:99) — so
+single-process, multi-process (jax.distributed), and the in-process test fake
+(src/collective/in_memory_communicator.h:18) stay interchangeable without the
+callers (growers, sketch merge, metrics) knowing which one is live.
 """
 from __future__ import annotations
 
 import contextlib
+import threading
 from enum import IntEnum
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 __all__ = [
     "init", "finalize", "get_rank", "get_world_size", "is_distributed",
     "communicator_print", "get_processor_name", "broadcast", "allreduce",
-    "allgather", "signal_error", "Op", "CommunicatorContext",
+    "allgather", "allgather_ragged", "signal_error", "Op",
+    "CommunicatorContext", "CollBackend",
 ]
-
-_INITIALIZED = False
 
 
 class Op(IntEnum):
@@ -36,55 +38,256 @@ class Op(IntEnum):
     BITWISE_XOR = 5
 
 
-def init(**args: Any) -> None:
-    """Initialize the collective (reference: collective.py:94 init).
+_REDUCERS = {
+    Op.SUM: np.sum, Op.MAX: np.max, Op.MIN: np.min,
+    Op.BITWISE_AND: np.bitwise_and.reduce,
+    Op.BITWISE_OR: np.bitwise_or.reduce,
+    Op.BITWISE_XOR: np.bitwise_xor.reduce,
+}
 
-    Accepts the reference's args and maps the distributed ones onto
-    jax.distributed.initialize; a no-op when single-process.
-    """
-    global _INITIALIZED
-    coordinator = args.get("dmlc_tracker_uri") or args.get("coordinator_address")
-    n_proc = args.get("dmlc_nworker")
-    if n_proc is None:
-        n_proc = args.get("num_processes")
-    rank = args.get("dmlc_task_id")  # 0 is a valid rank: no `or` chains
-    if rank is None:
-        rank = args.get("process_id")
-    if coordinator is not None:
+
+def _reduce_stacked(gathered: np.ndarray, op: Op, dtype) -> np.ndarray:
+    red = _REDUCERS.get(op)
+    if red is None:
+        raise NotImplementedError(f"allreduce op {op!r} not supported")
+    return red(gathered, axis=0).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Backend trait (Coll, coll.h:23)
+# ---------------------------------------------------------------------------
+
+
+class CollBackend:
+    """Abstract collective backend: rank/world + allgather is the complete
+    primitive set — allreduce and broadcast are derived (an ordered host
+    reduction over the gathered stack is what makes multi-worker training
+    bitwise deterministic, the property the reference engineers with
+    quantised integer allreduce, quantiser.cuh:52)."""
+
+    def rank(self) -> int:
+        raise NotImplementedError
+
+    def world_size(self) -> int:
+        raise NotImplementedError
+
+    def allgather(self, data: np.ndarray) -> np.ndarray:
+        """(world, *data.shape) — every worker's identically-shaped array."""
+        raise NotImplementedError
+
+    def allreduce(self, data: np.ndarray, op: Op) -> np.ndarray:
+        return _reduce_stacked(self.allgather(data), op, data.dtype)
+
+    def broadcast_bytes(self, payload: Optional[bytes], root: int) -> bytes:
+        """Default: length-prefixed gather-based broadcast."""
+        me = self.rank()
+        n = np.asarray([len(payload) if me == root else 0], np.int64)
+        size = int(self.allgather(n)[root, 0])
+        buf = np.zeros(size, np.uint8)
+        if me == root:
+            buf[:] = np.frombuffer(payload, np.uint8)
+        return bytes(self.allgather(buf)[root])
+
+    def shutdown(self) -> None:
+        pass
+
+
+class SingleProcessBackend(CollBackend):
+    """world_size == 1 identity (the reference degrades the same way)."""
+
+    def rank(self) -> int:
+        return 0
+
+    def world_size(self) -> int:
+        return 1
+
+    def allgather(self, data: np.ndarray) -> np.ndarray:
+        return np.asarray(data)[None]
+
+    def allreduce(self, data: np.ndarray, op: Op) -> np.ndarray:
+        return np.asarray(data).copy()
+
+    def broadcast_bytes(self, payload, root):
+        return payload
+
+
+class JaxDistributedBackend(CollBackend):
+    """Multi-process backend over jax.distributed + host allgather
+    (the RabitComm/NCCLComm role; rendezvous = jax coordinator service)."""
+
+    def __init__(self, **args: Any) -> None:
+        coordinator = (args.get("dmlc_tracker_uri")
+                       or args.get("coordinator_address"))
+        n_proc = args.get("dmlc_nworker")
+        if n_proc is None:
+            n_proc = args.get("num_processes")
+        rank = args.get("dmlc_task_id")  # 0 is a valid rank: no `or` chains
+        if rank is None:
+            rank = args.get("process_id")
+        if coordinator is not None:
+            import jax
+
+            port = args.get("dmlc_tracker_port")
+            addr = f"{coordinator}:{port}" if port else str(coordinator)
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=int(n_proc) if n_proc is not None else None,
+                process_id=int(rank) if rank is not None else None,
+            )
+
+    def rank(self) -> int:
         import jax
 
-        port = args.get("dmlc_tracker_port")
-        addr = f"{coordinator}:{port}" if port else str(coordinator)
-        jax.distributed.initialize(
-            coordinator_address=addr,
-            num_processes=int(n_proc) if n_proc is not None else None,
-            process_id=int(rank) if rank is not None else None,
-        )
-    _INITIALIZED = True
+        return jax.process_index()
 
+    def world_size(self) -> int:
+        import jax
 
-def finalize() -> None:
-    global _INITIALIZED
-    if _INITIALIZED:
+        return jax.process_count()
+
+    def allgather(self, data: np.ndarray) -> np.ndarray:
+        if self.world_size() == 1:
+            return np.asarray(data)[None]
+        from jax.experimental import multihost_utils
+
+        # gather every process's contribution (host-local arrays are NOT
+        # globally addressable, so a psum over a replicated operand would be
+        # wrong), then reduce on host — exact for every Op incl. bitwise
+        return np.asarray(multihost_utils.process_allgather(data))
+
+    def broadcast_bytes(self, payload: Optional[bytes], root: int) -> bytes:
+        if self.world_size() == 1:
+            return payload
+        from jax.experimental import multihost_utils
+
+        is_root = self.rank() == root
+        arr = (np.frombuffer(payload, np.uint8) if is_root else None)
+        n = multihost_utils.broadcast_one_to_all(
+            np.asarray([len(arr) if is_root else 0], np.int64),
+            is_source=is_root)
+        buf = np.zeros(int(n[0]), np.uint8)
+        if is_root:
+            buf[:] = arr
+        out = multihost_utils.broadcast_one_to_all(buf, is_source=is_root)
+        return bytes(np.asarray(out))
+
+    def shutdown(self) -> None:
         try:
             import jax
 
             jax.distributed.shutdown()
         except Exception:
             pass
-        _INITIALIZED = False
+
+
+class _InMemoryGroup:
+    """Shared rendezvous state for thread workers in one process."""
+
+    def __init__(self, world: int) -> None:
+        self.world = world
+        self.barrier = threading.Barrier(world)
+        self.slots: List[Optional[np.ndarray]] = [None] * world
+
+
+_INMEM_GROUPS: Dict[str, _InMemoryGroup] = {}
+_INMEM_LOCK = threading.Lock()
+
+
+class InMemoryBackend(CollBackend):
+    """In-process multi-worker fake: N threads, shared-memory exchange
+    (reference: src/collective/in_memory_communicator.h:18 +
+    in_memory_handler.h:68 — used by the thread-worker test harness,
+    tests/cpp/collective/test_worker.h:155).  Select with
+    ``dmlc_communicator='in-memory'`` plus world size/rank/group args."""
+
+    def __init__(self, world: int, rank: int, group: str = "default") -> None:
+        self._world = world
+        self._rank = rank
+        self._group_name = group
+        with _INMEM_LOCK:
+            g = _INMEM_GROUPS.get(group)
+            # a failed cohort leaves its barrier aborted; a fresh cohort
+            # must not inherit the poisoned group
+            if g is None or g.world != world or g.barrier.broken:
+                g = _INMEM_GROUPS[group] = _InMemoryGroup(world)
+        self._group = g
+
+    def rank(self) -> int:
+        return self._rank
+
+    def world_size(self) -> int:
+        return self._world
+
+    def allgather(self, data: np.ndarray) -> np.ndarray:
+        g = self._group
+        g.slots[self._rank] = np.asarray(data)
+        g.barrier.wait()  # all slots filled
+        out = np.stack([np.asarray(s) for s in g.slots])
+        g.barrier.wait()  # everyone copied before slots are reused
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Flat API (communicator-inl.h role) over the selected backend
+# ---------------------------------------------------------------------------
+
+# thread-local so in-memory thread workers each see their own rank; falls
+# back to the process-wide backend for ordinary (one worker per process) use
+_TLS = threading.local()
+_PROCESS_BACKEND: Optional[CollBackend] = None
+# argless construction skips jax.distributed.initialize: used only to QUERY
+# rank/world when someone else (a launcher) already initialized jax
+_DEFAULT = JaxDistributedBackend()
+
+
+def _backend() -> CollBackend:
+    b = getattr(_TLS, "backend", None)
+    if b is not None:
+        return b
+    if _PROCESS_BACKEND is not None:
+        return _PROCESS_BACKEND
+    # not init()-ed: report jax.distributed state if someone else set it up
+    return _DEFAULT
+
+
+def init(**args: Any) -> None:
+    """Initialize the collective (reference: collective.py:94 init).
+
+    Backend select (comm_group.cc:99): ``dmlc_communicator`` /
+    ``xgboost_communicator`` = 'in-memory' picks the in-process fake
+    (args: in_memory_world_size / in_memory_rank / in_memory_group);
+    anything else maps the reference's rabit args onto jax.distributed.
+    """
+    global _PROCESS_BACKEND
+    kind = (args.get("dmlc_communicator")
+            or args.get("xgboost_communicator") or "").replace("_", "-")
+    if kind == "in-memory":
+        world = int(args.get("in_memory_world_size", 1))
+        rank = int(args.get("in_memory_rank", 0))
+        group = str(args.get("in_memory_group", "default"))
+        _TLS.backend = InMemoryBackend(world, rank, group)
+        return
+    _PROCESS_BACKEND = JaxDistributedBackend(**args)
+
+
+def finalize() -> None:
+    global _PROCESS_BACKEND
+    b = getattr(_TLS, "backend", None)
+    if b is not None:
+        b.shutdown()
+        _TLS.backend = None
+        return
+    if _PROCESS_BACKEND is not None:
+        _PROCESS_BACKEND.shutdown()
+        _PROCESS_BACKEND = None
 
 
 def get_rank() -> int:
-    import jax
-
-    return jax.process_index()
+    return _backend().rank()
 
 
 def get_world_size() -> int:
-    import jax
-
-    return jax.process_count()
+    return _backend().world_size()
 
 
 def is_distributed() -> bool:
@@ -102,45 +305,21 @@ def communicator_print(msg: str) -> None:
 
 
 def allreduce(data: np.ndarray, op: Op = Op.SUM) -> np.ndarray:
-    """Allreduce across processes (reference: collective.py allreduce).
-
-    Gathers each process's contribution (multihost process_allgather) and
-    reduces on host — exact for sum/min/max and the bitwise ops; the
-    single-process case is an identity copy.
-    """
-    data = np.asarray(data)
-    if not is_distributed():
-        return data.copy()
-    from jax.experimental import multihost_utils
-
-    # gather every process's contribution (host-local arrays are NOT globally
-    # addressable, so a psum over a replicated operand would be wrong), then
-    # reduce on host — exact for every Op incl. the bitwise ones
-    gathered = np.asarray(multihost_utils.process_allgather(data))
-    red = {Op.SUM: np.sum, Op.MAX: np.max, Op.MIN: np.min,
-           Op.BITWISE_AND: np.bitwise_and.reduce,
-           Op.BITWISE_OR: np.bitwise_or.reduce,
-           Op.BITWISE_XOR: np.bitwise_xor.reduce}.get(op)
-    if red is None:
-        raise NotImplementedError(f"allreduce op {op!r} not supported")
-    return red(gathered, axis=0).astype(data.dtype)
+    """Allreduce across workers (reference: collective.py allreduce) —
+    exact and identically ordered on every worker."""
+    return _backend().allreduce(np.asarray(data), op)
 
 
 def allgather(data: np.ndarray) -> np.ndarray:
-    """Gather each process's (identically-shaped) array: (world, *shape).
+    """Gather each worker's (identically-shaped) array: (world, *shape).
 
     The building block of the distributed quantile-sketch merge
     (reference: src/common/quantile.cc:397 AllreduceV of summaries)."""
-    data = np.asarray(data)
-    if not is_distributed():
-        return data[None]
-    from jax.experimental import multihost_utils
-
-    return np.asarray(multihost_utils.process_allgather(data))
+    return _backend().allgather(np.asarray(data))
 
 
 def allgather_ragged(data: np.ndarray) -> np.ndarray:
-    """Concatenate 1-D/2-D row-arrays of differing per-process lengths
+    """Concatenate 1-D/2-D row-arrays of differing per-worker lengths
     (pad-to-max allgather, then trim)."""
     data = np.asarray(data)
     if not is_distributed():
@@ -154,25 +333,14 @@ def allgather_ragged(data: np.ndarray) -> np.ndarray:
 
 
 def broadcast(data: Any, root: int) -> Any:
-    """Broadcast python object from root (reference: collective.py broadcast)."""
+    """Broadcast a python object from root (reference: collective.py broadcast)."""
     if not is_distributed():
         return data
     import pickle
 
-    from jax.experimental import multihost_utils
-
-    is_root = get_rank() == root
-    payload = np.frombuffer(pickle.dumps(data), dtype=np.uint8) if is_root else None
-    # two-step: fixed-shape length broadcast, then the padded payload
-    n = multihost_utils.broadcast_one_to_all(
-        np.asarray([len(payload) if is_root else 0], np.int64), is_source=is_root
-    )
-    size = int(n[0])
-    buf = np.zeros(size, np.uint8)
-    if is_root:
-        buf[:] = payload
-    out = multihost_utils.broadcast_one_to_all(buf, is_source=is_root)
-    return pickle.loads(bytes(np.asarray(out)))
+    b = _backend()
+    payload = pickle.dumps(data) if b.rank() == root else None
+    return pickle.loads(b.broadcast_bytes(payload, root))
 
 
 def signal_error(msg: str = "") -> None:

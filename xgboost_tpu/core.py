@@ -585,6 +585,7 @@ class Booster:
             interaction_sets=self.tparam.interaction_constraints,
             max_leaves=self.tparam.max_leaves, lossguide=lossguide,
             mesh=self._get_mesh(),
+            distributed=self._process_parallel(),
         )
         K = gpair.shape[1]
         new_margin = cache.margin
@@ -775,18 +776,35 @@ class Booster:
         if mono is not None and any(c != 0 for c in mono):
             raise NotImplementedError(
                 "multi_output_tree with monotone constraints is not supported")
-        if self._get_mesh() is not None or self._process_parallel():
+        mesh = self._get_mesh()
+        proc_par = self._process_parallel()
+        if mesh is not None and proc_par:
             raise NotImplementedError(
-                "multi_output_tree is single-device in this round")
-        if self.tparam.grow_policy == "lossguide" or self.tparam.max_leaves > 0:
-            raise NotImplementedError(
-                "multi_output_tree supports depthwise growth only")
+                "n_devices > 1 within a process is not combined with "
+                "multi-process multi-target training yet")
+        lossguide = self.tparam.grow_policy == "lossguide"
+        # level-synchronous growth only here (no best-first node table), so
+        # resolve the depth cap locally — the scalar grower may be a
+        # BestFirstGrower whose max_depth of 0 means "unbounded"
+        max_depth = self.tparam.max_depth
+        if max_depth <= 0:
+            max_depth = 10 if lossguide else 6
         ell = cache.ellpack
-        mkey = ("multi", scalar_grower.max_depth, self._split_params, K)
+        mkey = ("multi", max_depth, self._split_params, K,
+                id(mesh), proc_par, lossguide, self.tparam.max_leaves)
         grower = self._grower_cache.get(mkey)
         if grower is None:
-            grower = MultiTargetTreeGrower(scalar_grower.max_depth,
-                                           self._split_params, K)
+            if mesh is not None:
+                from .parallel.grower import ShardedMultiTargetGrower
+
+                grower = ShardedMultiTargetGrower(
+                    max_depth, self._split_params, K, mesh,
+                    max_leaves=self.tparam.max_leaves, lossguide=lossguide)
+            else:
+                grower = MultiTargetTreeGrower(
+                    max_depth, self._split_params, K,
+                    max_leaves=self.tparam.max_leaves, lossguide=lossguide,
+                    distributed=proc_par)
             self._grower_cache[mkey] = grower
         new_margin = cache.margin
         for p_idx in range(max(self.num_parallel_tree, 1)):
@@ -931,11 +949,11 @@ class Booster:
             if self.booster_kind == "dart":
                 raise ValueError("booster='dart' is not supported with "
                                  "ExtMemQuantileDMatrix yet")
-            if self._process_parallel():
+            if self._process_parallel() and self._get_mesh() is not None:
                 raise NotImplementedError(
-                    "ExtMemQuantileDMatrix with multi-process training is not "
-                    "wired up yet (no cross-process histogram reduce on the "
-                    "streaming path); use in-memory row shards per process")
+                    "n_devices > 1 within a process is not combined with "
+                    "multi-process external-memory training yet; give each "
+                    "process one device")
             return self._boost_trees_extmem(cache, gpair, iteration)
         ell = cache.ellpack
         mono = self.tparam.monotone_constraints

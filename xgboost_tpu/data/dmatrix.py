@@ -106,6 +106,42 @@ def _to_numpy_2d(data: Any, missing: float = np.nan):
             data = np.from_dlpack(data)
         except (TypeError, RuntimeError, BufferError):
             pass  # fall through to np.asarray
+    # pyarrow Table / RecordBatch (columnar adapter; reference:
+    # ColumnarAdapter src/data/adapter.h:437 + data.py _from_arrow)
+    if type(data).__module__.split(".")[0] == "pyarrow":
+        import pyarrow as pa
+
+        feature_names = [str(c) for c in data.schema.names]
+        feature_types = []
+        cols = []
+        cat_categories = {}
+        for fi, name in enumerate(data.schema.names):
+            col = data.column(name)
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks()
+            if pa.types.is_dictionary(col.type):
+                # dictionary-encoded = categorical: physical codes train the
+                # tree, the dictionary VALUES persist for train->infer recode
+                # (reference: src/encoder/ordinal.h Recode)
+                cat_categories[fi] = [
+                    v.as_py() for v in col.dictionary]
+                codes = col.indices.to_numpy(zero_copy_only=False).astype(
+                    np.float32)
+                if col.null_count:
+                    codes[np.asarray(col.is_null())] = np.nan
+                cols.append(codes)
+                feature_types.append("c")
+            else:
+                vals = col.to_numpy(zero_copy_only=False).astype(np.float32)
+                if col.null_count:
+                    vals[np.asarray(col.is_null())] = np.nan
+                cols.append(vals)
+                feature_types.append(
+                    "q" if pa.types.is_floating(col.type) else "int")
+        arr = (np.stack(cols, axis=1) if cols
+               else np.zeros((data.num_rows, 0), np.float32))
+        return (("dense", _normalize_dense(arr, missing, np), cat_categories),
+                feature_names, feature_types)
     # polars (columnar adapter; reference: ColumnarAdapter src/data/adapter.h
     # + python-package data.py _from_polars)
     if type(data).__module__.split(".")[0] == "polars":
@@ -128,7 +164,8 @@ def _to_numpy_2d(data: Any, missing: float = np.nan):
                 feature_types.append("q")
         arr = (np.stack(cols, axis=1) if cols
                else np.zeros((len(data), 0), np.float32))
-        return ("dense", arr, cat_categories), feature_names, feature_types
+        return (("dense", _normalize_dense(arr, missing, np), cat_categories),
+                feature_names, feature_types)
     # pandas
     if hasattr(data, "iloc") and hasattr(data, "columns"):
         feature_names = [str(c) for c in data.columns]
@@ -151,7 +188,8 @@ def _to_numpy_2d(data: Any, missing: float = np.nan):
                 cols.append(col.to_numpy().astype(np.float32))
                 feature_types.append("q" if col.dtype.kind == "f" else "int")
         arr = np.stack(cols, axis=1) if cols else np.zeros((len(data), 0), np.float32)
-        return ("dense", arr, cat_categories), feature_names, feature_types
+        return (("dense", _normalize_dense(arr, missing, np), cat_categories),
+                feature_names, feature_types)
     # scipy sparse
     if hasattr(data, "tocsr"):
         csr = data.tocsr()

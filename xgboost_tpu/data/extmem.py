@@ -207,26 +207,43 @@ class ExtMemQuantileDMatrix(DMatrix):
             if cuts is None:
                 cuts = ref.ensure_ellpack(max_bin=max_bin).cuts
         else:
+            from .. import collective
+            from .quantile import _assemble_cuts, merge_quantile_grids
+
             Q = max(max_bin - 1, 1)
             qs = np.arange(1, Q + 1, dtype=np.float64) / (Q + 1)
             grid = np.full((n_col, Q), np.inf, np.float32)
             nvalid = np.zeros(n_col, np.int64)
+            mass = np.zeros(n_col, np.float64)
             for f in range(n_col):
                 if cat_mask is not None and cat_mask[f]:
-                    n_cats = int(cat_max[f]) + 1
-                    if n_cats > max_bin:
-                        raise ValueError(
-                            f"categorical feature {f} has {n_cats} categories; "
-                            f"raise max_bin (currently {max_bin})")
-                    grid[f, : n_cats - 1] = np.arange(1, n_cats, dtype=np.float32)
-                    nvalid[f] = num_row
-                    vmin[f], vmax[f] = 0.0, float(n_cats - 1)
-                elif summaries[f].total_weight() > 0:
+                    continue  # identity cuts assembled below, from global max
+                if summaries[f].total_weight() > 0:
                     grid[f] = summaries[f].query(qs)
                     nvalid[f] = num_row
+                    mass[f] = summaries[f].total_weight()
             vmin = np.where(np.isfinite(vmin), vmin, 0.0)
             vmax = np.where(np.isfinite(vmax), vmax, 0.0)
-            cuts = cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
+            if collective.is_distributed():
+                # each process sketched only its DataIter shard: merge the
+                # fixed-size per-shard grids into shared cuts, exactly like
+                # the in-memory distributed path (quantile.cc:397 analogue)
+                base = merge_quantile_grids(
+                    collective.allgather(grid), collective.allgather(nvalid),
+                    collective.allgather(vmax), collective.allgather(vmin),
+                    max_bin, masses=collective.allgather(mass))
+                if cat_max is not None:
+                    cat_max = collective.allreduce(cat_max, collective.Op.MAX)
+            else:
+                base = cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
+            if cat_mask is not None and cat_mask.any():
+                cat_n_cats = {int(f): int(cat_max[f]) + 1
+                              for f in np.nonzero(cat_mask)[0]}
+                cuts = _assemble_cuts(
+                    n_col, max_bin, cat_n_cats,
+                    lambda f: (base.feature_cuts(f), base.min_vals[f]))
+            else:
+                cuts = base
         self._cuts = cuts
 
         # metadata container

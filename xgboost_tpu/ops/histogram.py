@@ -110,6 +110,20 @@ def build_histogram_at(bins, gpair, pos, node0, *, n_nodes: int, n_bin: int,
     return _hist_accumulate(bins, gpair, pos, node0, n_nodes, n_bin, chunk, 1)
 
 
+def combine_sibling_hists(left, hist_prev, alive_lvl):
+    """Subtraction trick assembly, shared by every grower flavour
+    (updater_gpu_hist.cu:309 SubtractHist): given the built left-children
+    histogram ``left`` (N/2, ...) and the parent level's ``hist_prev``
+    (N/2, ...), derive each right sibling as parent - left and interleave to
+    the (N, ...) level layout.  Slots whose parent did not split are zeroed
+    (their "derived" hist would otherwise inherit the whole parent
+    histogram).  Works for scalar (N,F,B,2) and multi-target (N,F,B,K,2)."""
+    right = hist_prev - left
+    N = 2 * left.shape[0]
+    hist = jnp.stack([left, right], axis=1).reshape(N, *left.shape[1:])
+    return hist * alive_lvl.reshape((N,) + (1,) * (hist.ndim - 1))
+
+
 @functools.partial(jax.jit, static_argnames=("node0", "n_nodes"))
 def node_sums(gpair, pos, *, node0: int, n_nodes: int):
     """Per-node gradient totals: (N, C) — masked segment sum, MXU-friendly.

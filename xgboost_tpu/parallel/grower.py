@@ -133,3 +133,103 @@ class ShardedHistTreeGrower:
         from ..tree.grow import HistTreeGrower
 
         return HistTreeGrower.to_host(state)
+
+
+class ShardedMultiTargetGrower:
+    """Vector-leaf trees over a 1-D mesh: shard_map(level_step_multi) with
+    the 2K-channel histogram crossing shards in one psum (the multi-target
+    AllReduceHist; reference: MultiTargetHistBuilder under rabit,
+    src/tree/updater_quantile_hist.cc:156)."""
+
+    def __init__(self, max_depth: int, params: SplitParams, n_targets: int,
+                 mesh, *, max_leaves: int = 0, lossguide: bool = False) -> None:
+        from ..tree.grow_multi import MultiTreeState  # noqa: F401
+
+        self.max_depth = max_depth
+        self.params = params
+        self.n_targets = n_targets
+        self.mesh = mesh
+        self.max_leaves = max_leaves
+        self.lossguide = lossguide
+        self.max_nodes = max_nodes_for_depth(max_depth)
+        self._built_for = None
+
+    def _state_specs(self, ax):
+        from ..tree.grow_multi import MultiTreeState
+
+        return MultiTreeState(
+            pos=P(ax), alive=P(), totals=P(), feat=P(), sbin=P(), thr=P(),
+            dleft=P(), is_leaf=P(), leaf_val=P(), gain=P(), base_weight=P(),
+            sum_hess=P(), splits_left=P(),
+        )
+
+    def _build(self, n_features: int, n_bin: int) -> None:
+        if self._built_for == (n_features, n_bin):
+            return
+        from ..tree.grow_multi import init_multi_state, level_step_multi
+
+        ax = DATA_AXIS
+        sspec = self._state_specs(ax)
+        self._init_fn = jax.jit(
+            jax.shard_map(
+                functools.partial(
+                    init_multi_state, max_nodes=self.max_nodes,
+                    n_targets=self.n_targets, axis_name=ax,
+                    max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
+                ),
+                mesh=self.mesh,
+                in_specs=(P(ax, None, None), P(ax)),
+                out_specs=sspec,
+            )
+        )
+        self._level_fns = {}
+        for d in range(self.max_depth + 1):
+            last = d == self.max_depth
+            subtract = d > 0 and not last
+            base = functools.partial(
+                level_step_multi, depth=d, params=self.params,
+                last_level=last, n_targets=self.n_targets,
+                subtract_on=subtract, axis_name=ax, lossguide=self.lossguide,
+            )
+            row_specs = (sspec, P(ax, None), P(ax, None, None), P(), P(), P())
+            if last:
+                def fn(state, bins, gpair, cuts, nb, fm, _b=base):
+                    st, _ = _b(state, bins, gpair, cuts, nb, fm)
+                    return st
+
+                in_specs, out_specs = row_specs, sspec
+            elif subtract:
+                fn, in_specs, out_specs = base, row_specs + (P(),), (sspec, P())
+            else:
+                fn, in_specs, out_specs = base, row_specs, (sspec, P())
+            self._level_fns[d] = jax.jit(
+                jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                              out_specs=out_specs)
+            )
+        self._built_for = (n_features, n_bin)
+
+    def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None):
+        F = bins.shape[1]
+        self._build(F, cuts_pad.shape[1])
+        ones = jnp.ones((1, F), dtype=bool)
+        state = self._init_fn(gpair, valid)
+        hist_prev = None
+        for d in range(self.max_depth + 1):
+            fm = ones if feature_masks is None else feature_masks(d, 1 << d)
+            if d == self.max_depth:
+                state = self._level_fns[d](state, bins, gpair, cuts_pad,
+                                           n_bins, fm)
+            elif d == 0:
+                state, hist_prev = self._level_fns[d](state, bins, gpair,
+                                                      cuts_pad, n_bins, fm)
+            else:
+                state, hist_prev = self._level_fns[d](state, bins, gpair,
+                                                      cuts_pad, n_bins, fm,
+                                                      hist_prev)
+        return state
+
+    @staticmethod
+    def to_host(state):
+        from ..tree.grow_multi import MultiTargetTreeGrower
+
+        return MultiTargetTreeGrower.to_host(state)

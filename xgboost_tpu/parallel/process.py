@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import collective
-from ..ops.histogram import build_histogram, node_sums
+from ..ops.histogram import combine_sibling_hists
 from ..ops.split import SplitParams
 from ..tree.grow import (TreeState, init_tree_state, make_set_matrix,
                          max_nodes_for_depth)
@@ -65,10 +65,9 @@ class ProcessHistTreeGrower:
             n_bin=B,
         )
         # root totals: GlobalSum across processes (updater_gpu_hist.cu:581)
-        root = collective.allreduce(
-            np.asarray(node_sums(gpair, state.pos, node0=0, n_nodes=1)))
-        state = state._replace(
-            totals=state.totals.at[0].set(jnp.asarray(root[0])))
+        from ..tree.grow import sync_root_totals
+
+        state = sync_root_totals(state)
 
         prev_best, prev_can, prev_d = None, None, -1
         hist_prev = None
@@ -90,12 +89,9 @@ class ProcessHistTreeGrower:
                 # the one cross-process exchange per level (AllReduceHist)
                 hist = jnp.asarray(collective.allreduce(np.asarray(h)))
                 if subtract:
-                    right = hist_prev - hist
-                    hist = jnp.stack([hist, right], axis=1).reshape(
-                        N, *hist.shape[1:])
                     alive_lvl = jax.lax.dynamic_slice_in_dim(
                         state.alive, node0, N)
-                    hist = hist * alive_lvl[:, None, None, None]
+                    hist = combine_sibling_hists(hist, hist_prev, alive_lvl)
                 hist_prev = hist
             else:
                 hist = jnp.zeros((N, F, B, 2), jnp.float32)

@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.histogram import build_histogram, node_sums
+from ..ops.histogram import (build_histogram, combine_sibling_hists,
+                             node_sums)
 from ..ops.split import BestSplit, SplitParams, calc_weight, evaluate_splits
 
 _EPS = 1e-6
@@ -123,6 +124,18 @@ def init_tree_state(gpair, valid, *, max_nodes: int, axis_name: Optional[str] = 
     )
 
 
+
+
+def sync_root_totals(state):
+    """Multi-process root GlobalSum (updater_gpu_hist.cu:581): the local root
+    totals computed by init_*_state cross processes once.  Works for both the
+    scalar TreeState ((mn, 2) totals) and MultiTreeState ((mn, K, 2))."""
+    import numpy as np
+
+    from .. import collective
+
+    root = collective.allreduce(np.asarray(state.totals[:1]))
+    return state._replace(totals=state.totals.at[0].set(jnp.asarray(root[0])))
 
 
 def _record_level(st: TreeState, best, idx, can_split, new_leaf, w, thr_lvl,
@@ -269,11 +282,7 @@ def level_step(
                       n_bin=B, stride=2)
         if axis_name is not None:
             left = lax.psum(left, axis_name)
-        right = hist_prev - left
-        hist = jnp.stack([left, right], axis=1).reshape(N, *left.shape[1:])
-        # zero slots whose parent did not split (their "derived" hist would
-        # otherwise inherit the whole parent histogram)
-        hist = hist * alive_lvl[:, None, None, None]
+        hist = combine_sibling_hists(left, hist_prev, alive_lvl)
     else:
         hist = _build(bins, gpair, state.pos, node0=node0, n_nodes=N, n_bin=B)
         if axis_name is not None:

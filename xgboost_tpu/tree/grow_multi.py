@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.histogram import build_histogram
+from ..ops.histogram import build_histogram, combine_sibling_hists
 from ..ops.split import SplitParams, calc_weight, evaluate_splits_multi
 from .grow import _update_positions, max_nodes_for_depth
 
@@ -42,15 +42,20 @@ class MultiTreeState(NamedTuple):
     splits_left: jnp.ndarray  # (1,) int32
 
 
-@functools.partial(jax.jit, static_argnames=("max_nodes", "n_targets"))
-def init_multi_state(gpair, valid, *, max_nodes: int, n_targets: int):
+@functools.partial(jax.jit, static_argnames=("max_nodes", "n_targets",
+                                             "axis_name", "max_splits"))
+def init_multi_state(gpair, valid, *, max_nodes: int, n_targets: int,
+                     axis_name: Optional[str] = None, max_splits: int = 0):
     """gpair: (R_pad, K, 2).  All rows at the root."""
     R = gpair.shape[0]
     K = n_targets
     pos = jnp.where(valid, 0, -1).astype(jnp.int32)
     mask = (pos == 0).astype(jnp.float32)
     root = jnp.einsum("r,rkc->kc", mask, gpair)  # (K, 2)
+    if axis_name is not None:
+        root = lax.psum(root, axis_name)
     mn = max_nodes
+    budget = max_splits if max_splits > 0 else jnp.iinfo(jnp.int32).max
     return MultiTreeState(
         pos=pos,
         alive=jnp.zeros(mn, bool).at[0].set(True),
@@ -64,62 +69,64 @@ def init_multi_state(gpair, valid, *, max_nodes: int, n_targets: int):
         gain=jnp.zeros(mn, jnp.float32),
         base_weight=jnp.zeros((mn, K), jnp.float32),
         sum_hess=jnp.zeros(mn, jnp.float32),
-        splits_left=jnp.full((1,), jnp.iinfo(jnp.int32).max, jnp.int32),
+        splits_left=jnp.full((1,), budget, jnp.int32),
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("depth", "params", "last_level", "n_targets", "subtract_on"),
-)
-def level_step_multi(state: MultiTreeState, bins, gpair, cuts_pad, n_bins,
-                     feature_mask, hist_prev=None, *, depth: int,
-                     params: SplitParams, last_level: bool, n_targets: int,
-                     subtract_on: bool = False):
-    """One level: 2K-channel hist -> summed-gain split -> apply.
+class _ScalarBest(NamedTuple):
+    # the subset of split fields the scalar partitioner needs
+    feature: jnp.ndarray
+    bin: jnp.ndarray
+    default_left: jnp.ndarray
+    is_cat: jnp.ndarray
+    cat_set: jnp.ndarray
 
-    Returns (state, hist) with hist (N, F, B, K, 2) for the next level's
-    subtraction trick (right sibling = parent - left)."""
+
+def _finalize_leaves_multi(state, params, depth: int):
+    """Last level: every surviving node becomes a leaf."""
+    node0 = (1 << depth) - 1
+    N = 1 << depth
+    idx = node0 + jnp.arange(N, dtype=jnp.int32)
+    totals_lvl = lax.dynamic_slice_in_dim(state.totals, node0, N, axis=0)
+    alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, N, axis=0)
+    w = calc_weight(totals_lvl[..., 0], totals_lvl[..., 1], params)
+    return state._replace(
+        is_leaf=state.is_leaf.at[idx].set(alive_lvl),
+        leaf_val=state.leaf_val.at[idx].set(
+            jnp.where(alive_lvl[:, None], params.eta * w, 0.0)),
+        base_weight=state.base_weight.at[idx].set(w),
+        sum_hess=state.sum_hess.at[idx].set(totals_lvl[..., 1].mean(-1)),
+    )
+
+
+def _decide_body(state: MultiTreeState, hist, bins, cuts_pad, n_bins,
+                 feature_mask, *, depth: int, params: SplitParams,
+                 lossguide: bool):
+    """evaluate + record + partition for one level, given the FINAL (already
+    reduced + sibling-combined) level histogram (N, F, B, K, 2)."""
     node0 = (1 << depth) - 1
     N = 1 << depth
     B = cuts_pad.shape[1]
-    K = n_targets
-    R = gpair.shape[0]
-
     idx = node0 + jnp.arange(N, dtype=jnp.int32)
     totals_lvl = lax.dynamic_slice_in_dim(state.totals, node0, N, axis=0)
     alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, N, axis=0)
     w = calc_weight(totals_lvl[..., 0], totals_lvl[..., 1], params)  # (N,K)
-
-    if last_level:
-        return state._replace(
-            is_leaf=state.is_leaf.at[idx].set(alive_lvl),
-            leaf_val=state.leaf_val.at[idx].set(
-                jnp.where(alive_lvl[:, None], params.eta * w, 0.0)),
-            base_weight=state.base_weight.at[idx].set(w),
-            sum_hess=state.sum_hess.at[idx].set(totals_lvl[..., 1].mean(-1)),
-        ), None
-
-    gflat = gpair.reshape(R, K * 2)  # channels [g0,h0,g1,h1,...]
-    if subtract_on:
-        half = N // 2
-        left = build_histogram(bins, gflat, state.pos, node0=node0,
-                               n_nodes=half, n_bin=B, stride=2)
-        left = left.reshape(half, bins.shape[1], B, K, 2)
-        right = hist_prev - left
-        hist = jnp.stack([left, right], axis=1).reshape(
-            N, bins.shape[1], B, K, 2)
-        hist = hist * alive_lvl[:, None, None, None, None]
-    else:
-        hist = build_histogram(bins, gflat, state.pos, node0=node0,
-                               n_nodes=N, n_bin=B)
-        hist = hist.reshape(N, bins.shape[1], B, K, 2)
 
     fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
     best = evaluate_splits_multi(hist, totals_lvl, n_bins, params, fm)
 
     gamma_eps = max(params.gamma, _EPS)
     can_split = alive_lvl & (best.gain > gamma_eps)
+
+    # split budget (max_leaves): best-first under lossguide, node-order under
+    # depthwise — same driver semantics as the scalar level_step (driver.h)
+    budget = state.splits_left[0]
+    prio = best.gain if lossguide else -idx.astype(jnp.float32)
+    prio = jnp.where(can_split, prio, -jnp.inf)
+    ranks = jnp.argsort(jnp.argsort(-prio)).astype(jnp.int32)
+    can_split = can_split & (ranks < budget)
+    new_budget = budget - jnp.sum(can_split).astype(jnp.int32)
+
     new_leaf = alive_lvl & ~can_split
     thr_lvl = cuts_pad[best.feature, jnp.minimum(best.bin, B - 1)]
 
@@ -134,6 +141,7 @@ def level_step_multi(state: MultiTreeState, bins, gpair, cuts_pad, n_bins,
         gain=state.gain.at[idx].set(jnp.where(can_split, best.gain, 0.0)),
         base_weight=state.base_weight.at[idx].set(w),
         sum_hess=state.sum_hess.at[idx].set(totals_lvl[..., 1].mean(-1)),
+        splits_left=jnp.full((1,), new_budget, jnp.int32),
     )
     left_ids = 2 * idx + 1
     right_ids = 2 * idx + 2
@@ -142,19 +150,79 @@ def level_step_multi(state: MultiTreeState, bins, gpair, cuts_pad, n_bins,
         totals=st.totals.at[left_ids].set(best.left_sum)
                         .at[right_ids].set(best.right_sum),
     )
-
-    # reuse the scalar partitioner: it only needs scalar split fields
-    class _B(NamedTuple):
-        feature: jnp.ndarray
-        bin: jnp.ndarray
-        default_left: jnp.ndarray
-        is_cat: jnp.ndarray
-        cat_set: jnp.ndarray
-
-    bb = _B(best.feature, best.bin, best.default_left,
-            jnp.zeros(N, bool), jnp.zeros((N, B), bool))
+    bb = _ScalarBest(best.feature, best.bin, best.default_left,
+                     jnp.zeros(N, bool), jnp.zeros((N, B), bool))
     st = st._replace(
         pos=_update_positions(bins, st.pos, bb, can_split, node0, N, B, False))
+    return st
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("node0", "n_nodes", "n_bin", "n_targets", "stride"),
+)
+def build_level_hist_multi(bins, gpair, pos, *, node0: int, n_nodes: int,
+                           n_bin: int, n_targets: int, stride: int = 1):
+    """Local 2K-channel level histogram (n_nodes, F, B, K, 2) — the piece a
+    multi-process grower allreduces before deciding."""
+    R, K = gpair.shape[0], n_targets
+    h = build_histogram(bins, gpair.reshape(R, K * 2), pos, node0=node0,
+                        n_nodes=n_nodes, n_bin=n_bin, stride=stride)
+    return h.reshape(n_nodes, bins.shape[1], n_bin, K, 2)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("depth", "params", "n_targets", "lossguide"),
+)
+def decide_level_multi(state: MultiTreeState, hist, bins, cuts_pad, n_bins,
+                       feature_mask, *, depth: int, params: SplitParams,
+                       n_targets: int, lossguide: bool = False):
+    return _decide_body(state, hist, bins, cuts_pad, n_bins, feature_mask,
+                        depth=depth, params=params, lossguide=lossguide)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("depth", "params", "last_level", "n_targets",
+                     "subtract_on", "axis_name", "lossguide"),
+)
+def level_step_multi(state: MultiTreeState, bins, gpair, cuts_pad, n_bins,
+                     feature_mask, hist_prev=None, *, depth: int,
+                     params: SplitParams, last_level: bool, n_targets: int,
+                     subtract_on: bool = False,
+                     axis_name: Optional[str] = None, lossguide: bool = False):
+    """One level: 2K-channel hist -> summed-gain split -> apply.
+
+    Returns (state, hist) with hist (N, F, B, K, 2) for the next level's
+    subtraction trick (right sibling = parent - left).  ``axis_name``: rows
+    are sharded over that mesh axis and the histogram crosses shards in one
+    psum (the multi-target AllReduceHist)."""
+    node0 = (1 << depth) - 1
+    N = 1 << depth
+    B = cuts_pad.shape[1]
+    K = n_targets
+
+    if last_level:
+        return _finalize_leaves_multi(state, params, depth), None
+
+    alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, N, axis=0)
+    if subtract_on:
+        half = N // 2
+        left = build_level_hist_multi(bins, gpair, state.pos, node0=node0,
+                                      n_nodes=half, n_bin=B, n_targets=K,
+                                      stride=2)
+        if axis_name is not None:
+            left = lax.psum(left, axis_name)
+        hist = combine_sibling_hists(left, hist_prev, alive_lvl)
+    else:
+        hist = build_level_hist_multi(bins, gpair, state.pos, node0=node0,
+                                      n_nodes=N, n_bin=B, n_targets=K)
+        if axis_name is not None:
+            hist = lax.psum(hist, axis_name)
+
+    st = _decide_body(state, hist, bins, cuts_pad, n_bins, feature_mask,
+                      depth=depth, params=params, lossguide=lossguide)
     return st, hist
 
 
@@ -179,32 +247,74 @@ class GrownMultiTree(NamedTuple):
 
 
 class MultiTargetTreeGrower:
-    """Host driver for vector-leaf trees (one jitted level per depth)."""
+    """Host driver for vector-leaf trees (one jitted level per depth).
+
+    ``distributed=True``: every process holds a row shard; the level
+    histogram crosses processes through ``collective.allreduce`` between
+    build and decide (the rabit AllReduceHist role for the reference's
+    MultiTargetHistBuilder, updater_quantile_hist.cc:156)."""
 
     def __init__(self, max_depth: int, params: SplitParams, n_targets: int,
-                 *, subtract: bool = True) -> None:
+                 *, subtract: bool = True, max_leaves: int = 0,
+                 lossguide: bool = False, distributed: bool = False) -> None:
         self.max_depth = max_depth
         self.params = params
         self.n_targets = n_targets
         self.subtract = subtract
+        self.max_leaves = max_leaves
+        self.lossguide = lossguide
+        self.distributed = distributed
         self.max_nodes = max_nodes_for_depth(max_depth)
 
     def grow(self, bins, gpair, valid, cuts_pad, n_bins,
              feature_masks=None) -> MultiTreeState:
+        import numpy as np
+
         F = bins.shape[1]
+        B = cuts_pad.shape[1]
+        K = self.n_targets
         ones = jnp.ones((1, F), dtype=bool)
-        state = init_multi_state(gpair, valid, max_nodes=self.max_nodes,
-                                 n_targets=self.n_targets)
+        state = init_multi_state(
+            gpair, valid, max_nodes=self.max_nodes, n_targets=K,
+            max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0)
+        if self.distributed:
+            from .grow import sync_root_totals
+
+            state = sync_root_totals(state)
         hist_prev = None
         for d in range(self.max_depth + 1):
             fm = ones if feature_masks is None else feature_masks(d, 1 << d)
-            out = level_step_multi(
-                state, bins, gpair, cuts_pad, n_bins, fm, hist_prev,
-                depth=d, params=self.params,
-                last_level=(d == self.max_depth), n_targets=self.n_targets,
-                subtract_on=(self.subtract and d > 0 and hist_prev is not None),
-            )
-            state, hist_prev = out
+            if d == self.max_depth:
+                state, hist_prev = level_step_multi(
+                    state, bins, gpair, cuts_pad, n_bins, fm, None,
+                    depth=d, params=self.params, last_level=True,
+                    n_targets=K, lossguide=self.lossguide)
+                continue
+            subtract = self.subtract and d > 0 and hist_prev is not None
+            if self.distributed:
+                from .. import collective
+
+                node0, N = (1 << d) - 1, 1 << d
+                n_build = (N // 2) if subtract else N
+                h = build_level_hist_multi(
+                    bins, gpair, state.pos, node0=node0, n_nodes=n_build,
+                    n_bin=B, n_targets=K, stride=2 if subtract else 1)
+                h = jnp.asarray(collective.allreduce(np.asarray(h)))
+                if subtract:
+                    alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, N)
+                    hist = combine_sibling_hists(h, hist_prev, alive_lvl)
+                else:
+                    hist = h
+                state = decide_level_multi(
+                    state, hist, bins, cuts_pad, n_bins, fm, depth=d,
+                    params=self.params, n_targets=K, lossguide=self.lossguide)
+                hist_prev = hist
+            else:
+                state, hist_prev = level_step_multi(
+                    state, bins, gpair, cuts_pad, n_bins, fm, hist_prev,
+                    depth=d, params=self.params, last_level=False,
+                    n_targets=K, subtract_on=subtract,
+                    lossguide=self.lossguide)
         return state
 
     @staticmethod

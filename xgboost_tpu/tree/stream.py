@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.histogram import build_histogram
+from ..ops.histogram import build_histogram, combine_sibling_hists
 from ..ops.split import SplitParams, calc_weight, evaluate_splits
 from .grow import (TreeState, _record_level, _update_positions, init_tree_state,
                    make_set_matrix, max_nodes_for_depth)
@@ -110,7 +110,8 @@ class StreamingHistTreeGrower:
 
     def __init__(self, max_depth: int, params: SplitParams, *,
                  interaction_sets=None, max_leaves: int = 0,
-                 lossguide: bool = False, mesh=None) -> None:
+                 lossguide: bool = False, mesh=None,
+                 distributed: bool = False) -> None:
         self.max_depth = max_depth
         self.params = params
         self.interaction_sets = interaction_sets
@@ -121,6 +122,11 @@ class StreamingHistTreeGrower:
         # collective the reference gets from NCCL AllReduceHist); page rows
         # are PAGE_ALIGN(=1024)-aligned so every shard is equal
         self.mesh = mesh
+        # multi-process: every process streams its own page shard; the
+        # accumulated level histogram crosses processes once per level
+        # (the AllReduceHist of the reference's extmem path —
+        # updater_gpu_hist.cu:601 runs unchanged under rabit there)
+        self.distributed = distributed
         self.max_nodes = max_nodes_for_depth(max_depth)
 
     def _put_page(self, page_np):
@@ -145,6 +151,10 @@ class StreamingHistTreeGrower:
             max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
             n_bin=B,
         )
+        if self.distributed:
+            from .grow import sync_root_totals
+
+            state = sync_root_totals(state)
         prev_best, prev_can, prev_d = None, None, -1
         hist_prev = None
         n_pages = len(pages)
@@ -180,15 +190,18 @@ class StreamingHistTreeGrower:
                     hist_acc = h if hist_acc is None else hist_acc + h
             state = state._replace(pos=pos)
             fm = ones if feature_masks is None else feature_masks(d, N)
+            if hist_acc is not None and self.distributed:
+                # one cross-process exchange per level, after the local page
+                # accumulation and before the sibling subtraction
+                from .. import collective
+
+                hist_acc = jnp.asarray(collective.allreduce(np.asarray(hist_acc)))
             if hist_acc is None:  # last level: dummy hist, leaves only
                 hist_acc = jnp.zeros((N, F, B, 2), jnp.float32)
             elif subtract:
                 # SubtractHist: right sibling = parent - left (grow.level_step)
-                right = hist_prev - hist_acc
-                hist_acc = jnp.stack([hist_acc, right], axis=1).reshape(
-                    N, *hist_acc.shape[1:])
                 alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, N)
-                hist_acc = hist_acc * alive_lvl[:, None, None, None]
+                hist_acc = combine_sibling_hists(hist_acc, hist_prev, alive_lvl)
             if build:
                 hist_prev = hist_acc
             state, best, can = _decide_level(
