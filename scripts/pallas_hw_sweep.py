@@ -53,27 +53,28 @@ def main():
         )
         print(f"[N={n_nodes}] einsum: {t_ein*1e3:.1f} ms", flush=True)
 
-        for row_tile in (256, 512, 1024, 2048):
-            for fg in (1, 2, 4, 7, 14):
-                hp._ROW_TILE, hp._FEAT_GROUP = row_tile, fg
-                # tile sizes are module globals, not jit keys — force retrace
-                hp.build_histogram_pallas.clear_cache()
-                try:
-                    t, h = bench_one(
-                        hp.build_histogram_pallas, bins, gpair, pos,
-                        node0=node0, n_nodes=n_nodes, n_bin=B,
-                    )
-                except Exception as e:  # noqa: BLE001
-                    print(f"[N={n_nodes}] pallas T={row_tile} FG={fg}: "
-                          f"FAIL {type(e).__name__}: {str(e)[:120]}", flush=True)
-                    continue
-                ok = bool(jnp.allclose(h, h_ref, atol=1e-3, rtol=1e-5))
-                print(
-                    f"[N={n_nodes}] pallas T={row_tile} FG={fg}: "
-                    f"{t*1e3:.1f} ms  parity={'OK' if ok else 'MISMATCH'}  "
-                    f"speedup={t_ein/t:.2f}x",
-                    flush=True,
+        configs = [(0, 0)]  # autotuned (choose_tiles)
+        configs += [(t, fg) for t in (256, 512, 1024, 2048)
+                    for fg in (1, 2, 4, 8, 16)]
+        for row_tile, fg in configs:
+            label = f"T={row_tile} FG={fg}" if row_tile else "autotune"
+            try:
+                t, h = bench_one(
+                    hp.build_histogram_pallas, bins, gpair, pos,
+                    node0=node0, n_nodes=n_nodes, n_bin=B,
+                    row_tile=row_tile, feat_group=fg,
                 )
+            except Exception as e:  # noqa: BLE001
+                print(f"[N={n_nodes}] pallas {label}: "
+                      f"FAIL {type(e).__name__}: {str(e)[:120]}", flush=True)
+                continue
+            ok = bool(jnp.allclose(h, h_ref, atol=1e-3, rtol=1e-5))
+            print(
+                f"[N={n_nodes}] pallas {label}: "
+                f"{t*1e3:.1f} ms  parity={'OK' if ok else 'MISMATCH'}  "
+                f"speedup={t_ein/t:.2f}x",
+                flush=True,
+            )
 
 
 if __name__ == "__main__":

@@ -61,13 +61,14 @@ def test_inmemory_thread_workers_identical_trees():
     results, errors = {}, {}
     threads = [
         threading.Thread(target=_worker,
-                         args=(r, world, results, errors, "t4"))
+                         args=(r, world, results, errors, "t4"), daemon=True)
         for r in range(world)
     ]
     for t in threads:
         t.start()
     for t in threads:
         t.join(timeout=600)
+    assert not any(t.is_alive() for t in threads), "worker deadlocked"
     assert not errors, errors
     dumps = [results[r] for r in range(world)]
     assert all(d == dumps[0] for d in dumps[1:])

@@ -64,6 +64,17 @@ def test_arrow_adapter():
     h = d3.host_dense()[:, 0]
     assert h[0] == 1.0 and np.isnan(h[1]) and h[2] == 3.0
 
+    # ...but the sentinel must NOT touch categorical dictionary codes: a
+    # sentinel of 0.0 may not wipe out category code 0
+    t3 = pa.table({
+        "x": pa.array([1.0, 0.0, 3.0], type=pa.float32()),
+        "c": pa.array(["a", "b", "a"]).dictionary_encode(),
+    })
+    d4 = xtb.DMatrix(t3, missing=0.0, enable_categorical=True)
+    h4 = d4.host_dense()
+    assert np.isnan(h4[1, 0])          # numeric sentinel converted
+    assert not np.isnan(h4[:, 1]).any()  # category codes untouched
+
     # RecordBatch goes through the same adapter
     rb = tab.to_batches()[0]
     d2 = xtb.DMatrix(rb, label=y[: rb.num_rows], enable_categorical=True)

@@ -267,6 +267,15 @@ def init(**args: Any) -> None:
         group = str(args.get("in_memory_group", "default"))
         _TLS.backend = InMemoryBackend(world, rank, group)
         return
+    if kind == "federated":
+        from .federated import FederatedBackend
+
+        # reference parameter names: plugin/federated/federated_comm.cc
+        _TLS.backend = FederatedBackend(
+            str(args["federated_server_address"]),
+            int(args["federated_world_size"]),
+            int(args["federated_rank"]))
+        return
     _PROCESS_BACKEND = JaxDistributedBackend(**args)
 
 

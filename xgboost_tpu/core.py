@@ -571,15 +571,23 @@ class Booster:
         cache.margin = self._linear_margin(cache)
         cache.n_trees_applied = self._linear_rounds
 
+    def _resolve_max_depth(self, lossguide: bool) -> int:
+        """Default depth cap for the level-synchronous growers when
+        max_depth<=0: 10 heap levels under lossguide (static shapes), 6
+        depthwise (the reference's default max_depth).  The best-first
+        grower resolves 0 as "unbounded" instead and does not use this."""
+        md = self.tparam.max_depth
+        if md <= 0:
+            md = 10 if lossguide else 6
+        return md
+
     def _boost_trees_extmem(self, cache: _Cache, gpair, iteration: int) -> None:
         """Streaming boost over host-resident pages (ExtMemQuantileDMatrix)."""
         from .tree.stream import StreamingHistTreeGrower
 
         d = cache.dmat
         lossguide = self.tparam.grow_policy == "lossguide"
-        max_depth = self.tparam.max_depth
-        if max_depth <= 0:
-            max_depth = 10 if lossguide else 6
+        max_depth = self._resolve_max_depth(lossguide)
         grower = StreamingHistTreeGrower(
             max_depth, self._split_params,
             interaction_sets=self.tparam.interaction_constraints,
@@ -786,9 +794,7 @@ class Booster:
         # level-synchronous growth only here (no best-first node table), so
         # resolve the depth cap locally — the scalar grower may be a
         # BestFirstGrower whose max_depth of 0 means "unbounded"
-        max_depth = self.tparam.max_depth
-        if max_depth <= 0:
-            max_depth = 10 if lossguide else 6
+        max_depth = self._resolve_max_depth(lossguide)
         ell = cache.ellpack
         mkey = ("multi", max_depth, self._split_params, K,
                 id(mesh), proc_par, lossguide, self.tparam.max_leaves)
@@ -971,12 +977,8 @@ class Booster:
                       and mesh is None and not proc_par)
         max_depth = self.tparam.max_depth
         if max_depth <= 0:
-            if best_first:
-                max_depth = 0  # depth bounded only by the leaf budget
-            else:
-                # level-synchronous lossguide: cap at 10 heap levels for
-                # static shapes (the best-first path has no such cap)
-                max_depth = 10 if lossguide else 6
+            # best-first: depth bounded only by the leaf budget
+            max_depth = 0 if best_first else self._resolve_max_depth(lossguide)
         gkey = (max_depth, id(mesh), self._split_params,
                 self.tparam.interaction_constraints, self.tparam.max_leaves,
                 lossguide, str(self.params.get("_hist_impl", "xla")), proc_par,

@@ -75,15 +75,24 @@ def _is_jax_array(data: Any) -> bool:
     )
 
 
-def _normalize_dense(arr, missing: float, xp):
+def _normalize_dense(arr, missing: float, xp, feature_types=None):
     """1-D promotion + custom-missing -> NaN, shared by the host (xp=numpy)
-    and device (xp=jax.numpy) ingest paths so their semantics cannot drift."""
+    and device (xp=jax.numpy) ingest paths so their semantics cannot drift.
+
+    ``feature_types``: when given (columnar adapters), the sentinel applies
+    to NUMERIC columns only — categorical columns already hold dictionary
+    CODES whose values are unrelated to the user's sentinel (a sentinel of
+    0.0 must not wipe out category 0)."""
     if arr.ndim == 1:
         arr = arr[:, None]
     missing_is_nan = missing is None or (
         isinstance(missing, (float, np.floating)) and np.isnan(missing))
     if not missing_is_nan:
-        arr = xp.where(arr == missing, xp.nan, arr)
+        hit = arr == missing
+        if feature_types is not None:
+            num_col = np.asarray([t != "c" for t in feature_types], bool)
+            hit = hit & num_col[None, :]
+        arr = xp.where(hit, xp.nan, arr)
     return arr
 
 
@@ -140,7 +149,9 @@ def _to_numpy_2d(data: Any, missing: float = np.nan):
                     "q" if pa.types.is_floating(col.type) else "int")
         arr = (np.stack(cols, axis=1) if cols
                else np.zeros((data.num_rows, 0), np.float32))
-        return (("dense", _normalize_dense(arr, missing, np), cat_categories),
+        return (("dense",
+                 _normalize_dense(arr, missing, np, feature_types),
+                 cat_categories),
                 feature_names, feature_types)
     # polars (columnar adapter; reference: ColumnarAdapter src/data/adapter.h
     # + python-package data.py _from_polars)
@@ -164,7 +175,9 @@ def _to_numpy_2d(data: Any, missing: float = np.nan):
                 feature_types.append("q")
         arr = (np.stack(cols, axis=1) if cols
                else np.zeros((len(data), 0), np.float32))
-        return (("dense", _normalize_dense(arr, missing, np), cat_categories),
+        return (("dense",
+                 _normalize_dense(arr, missing, np, feature_types),
+                 cat_categories),
                 feature_names, feature_types)
     # pandas
     if hasattr(data, "iloc") and hasattr(data, "columns"):
@@ -188,7 +201,9 @@ def _to_numpy_2d(data: Any, missing: float = np.nan):
                 cols.append(col.to_numpy().astype(np.float32))
                 feature_types.append("q" if col.dtype.kind == "f" else "int")
         arr = np.stack(cols, axis=1) if cols else np.zeros((len(data), 0), np.float32)
-        return (("dense", _normalize_dense(arr, missing, np), cat_categories),
+        return (("dense",
+                 _normalize_dense(arr, missing, np, feature_types),
+                 cat_categories),
                 feature_names, feature_types)
     # scipy sparse
     if hasattr(data, "tocsr"):

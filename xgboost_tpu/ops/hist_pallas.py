@@ -28,8 +28,43 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_ROW_TILE = 512
-_FEAT_GROUP = 4
+# sweep overrides (scripts/pallas_hw_sweep.py); None = VMEM-budget autotune
+_ROW_TILE = None
+_FEAT_GROUP = None
+
+# Per-core VMEM working budget.  v5e/v5p expose ~128 MiB of VMEM; leaving
+# headroom for the compiler's own temporaries and double-buffering slack,
+# 64 MiB is the planning number (the role of the reference's CacheManager
+# L1/L2 detection for CPU hist blocking, src/common/cache_manager.h — there
+# the cache sizes block the CPU hist loop, here the VMEM budget blocks the
+# MXU hist kernel).
+_VMEM_BUDGET = 64 * 2**20
+
+
+def choose_tiles(n_features: int, n_bin: int, n_nodes: int,
+                 bin_itemsize: int = 1,
+                 vmem_budget: int = _VMEM_BUDGET) -> tuple:
+    """Pick (row_tile, feat_group) that fits the VMEM budget.
+
+    Working set per grid step:
+      - persistent out block: FG * B * 2N * 4 bytes (lives across row tiles)
+      - double-buffered inputs: 2 * T * (FG*itemsize + 8 + 4)
+      - scratch (one feature at a time in the unrolled loop):
+        onehot T*B*4 + node-masked gpair T*2N*4 + nodemask T*N*4
+    Preference order: biggest row tile first (deeper MXU K dim), then the
+    widest feature group that still fits — the shapes the hardware sweep
+    showed to matter most.  Always returns something runnable (1, 256).
+    """
+    for t in (2048, 1024, 512, 256):
+        for fg in (16, 8, 4, 2, 1):
+            if fg > max(n_features, 1):
+                continue
+            out_b = fg * n_bin * 2 * n_nodes * 4
+            in_b = 2 * t * (fg * bin_itemsize + 8 + 4)
+            scratch = t * n_bin * 4 + t * 2 * n_nodes * 4 + t * n_nodes * 4
+            if out_b + in_b + scratch <= vmem_budget:
+                return t, fg
+    return 256, 1
 
 
 def _hist_kernel(bins_ref, gpair_ref, pos_ref, out_ref, *, node0: int,
@@ -60,19 +95,28 @@ def _hist_kernel(bins_ref, gpair_ref, pos_ref, out_ref, *, node0: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("node0", "n_nodes", "n_bin", "interpret", "stride")
+    jax.jit, static_argnames=("node0", "n_nodes", "n_bin", "interpret",
+                              "stride", "row_tile", "feat_group")
 )
 def build_histogram_pallas(bins, gpair, pos, *, node0: int, n_nodes: int,
-                           n_bin: int, interpret: bool = False, stride: int = 1):
+                           n_bin: int, interpret: bool = False, stride: int = 1,
+                           row_tile: int = 0, feat_group: int = 0):
     """hist (n_nodes, F, B, 2) — drop-in for ops/histogram.build_histogram.
 
     bins (R_pad, F) int (sentinel == n_bin for missing), gpair (R_pad, 2) f32,
-    pos (R_pad,) int32.  Rows are padded up to the 512 row tile internally
-    (pad rows carry pos = -1, matching no node).
+    pos (R_pad,) int32.  Rows are padded up to the row tile internally
+    (pad rows carry pos = -1, matching no node).  ``row_tile``/``feat_group``
+    of 0 select the VMEM-budget autotune (choose_tiles); the module globals
+    remain overridable for sweeps.
     """
     R, F = bins.shape
-    T = _ROW_TILE
-    FG = _FEAT_GROUP
+    # explicit kwargs > module-global sweep override > autotune; a partial
+    # override (one of the two) autotunes only the missing dimension
+    T = row_tile or _ROW_TILE
+    FG = feat_group or _FEAT_GROUP
+    if not (T and FG):
+        at, afg = choose_tiles(F, n_bin, n_nodes, bins.dtype.itemsize)
+        T, FG = T or at, FG or afg
     if R % T:
         pad = T - R % T
         bins = jnp.pad(bins, ((0, pad), (0, 0)), constant_values=n_bin)
