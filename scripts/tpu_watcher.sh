@@ -1,11 +1,20 @@
 #!/bin/bash
-# Patient TPU-tunnel watcher: probe every 5 min; when the axon relay heals,
-# run the Pallas histogram hardware sweep once and exit.
+# Patient TPU-tunnel watcher: probe (with timeout — a wedged relay hangs
+# jax.devices() forever) every 5 min; when the axon relay heals, run the
+# HIGGS bench and then the Pallas histogram hardware sweep.  Retries until
+# BOTH complete: the relay has been observed to wedge mid-run (probe OK,
+# first train compile UNAVAILABLE), so success of the probe alone proves
+# nothing.  Never runs two TPU clients concurrently.
 LOG=/tmp/tpu_watcher.log
+BENCH_OUT=/tmp/bench_tpu.json
+BENCH_LOG=/tmp/bench_tpu.log
 SWEEP_LOG=/tmp/pallas_sweep_hw.log
 echo "watcher start $(date)" >> "$LOG"
+bench_done=""
+if [ -s "$BENCH_OUT" ] && grep -q Mrow "$BENCH_OUT" \
+    && ! grep -q "CPU FALLBACK" "$BENCH_OUT"; then bench_done=1; fi
 while true; do
-  python - <<'EOF' >> "$LOG" 2>&1
+  timeout 90 python - <<'EOF' >> "$LOG" 2>&1
 import jax
 d = jax.devices()
 assert d[0].platform == "tpu", d
@@ -15,10 +24,27 @@ assert float((x @ x)[0, 0]) == 128.0
 print("PROBE-OK", d)
 EOF
   if [ $? -eq 0 ]; then
-    echo "tunnel healthy $(date); running sweep" >> "$LOG"
-    PYTHONPATH=/root/repo:/root/.axon_site python /root/repo/scripts/pallas_hw_sweep.py 2000000 > "$SWEEP_LOG" 2>&1
-    echo "sweep exit=$? $(date)" >> "$LOG"
-    exit 0
+    if [ -z "$bench_done" ]; then
+      echo "tunnel healthy $(date); running bench" >> "$LOG"
+      cd /root/repo && timeout 2400 python bench.py > "$BENCH_OUT.tmp" 2> "$BENCH_LOG"
+      rc=$?
+      echo "bench exit=$rc $(date)" >> "$LOG"
+      if [ $rc -eq 0 ] && grep -q Mrow "$BENCH_OUT.tmp" \
+          && ! grep -q "CPU FALLBACK" "$BENCH_OUT.tmp"; then
+        mv "$BENCH_OUT.tmp" "$BENCH_OUT"
+        bench_done=1
+      fi
+      sleep 30
+      continue  # re-probe before the sweep
+    fi
+    echo "running pallas sweep $(date)" >> "$LOG"
+    PYTHONPATH=/root/repo:/root/.axon_site timeout 2400 python /root/repo/scripts/pallas_hw_sweep.py 2000000 > "$SWEEP_LOG" 2>&1
+    rc=$?
+    echo "sweep exit=$rc $(date)" >> "$LOG"
+    if [ $rc -eq 0 ]; then
+      echo "ALL DONE $(date)" >> "$LOG"
+      exit 0
+    fi
   fi
   sleep 300
 done

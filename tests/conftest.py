@@ -33,3 +33,14 @@ def eight_devices():
     if len(devs) < 8:
         pytest.skip("needs 8 virtual devices")
     return devs
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _bound_compile_cache():
+    """Drop compiled executables between test modules.  A full-suite run
+    accumulates hundreds of jitted level programs; XLA:CPU has been observed
+    to segfault inside backend_compile_and_load near the end of the suite
+    (whole-suite run 2026-07-29), and clearing per module bounds the live
+    executable count at a small recompile cost."""
+    yield
+    jax.clear_caches()

@@ -1,0 +1,217 @@
+"""tree_method="exact" — the grow_colmaker role (updater_colmaker.cc).
+
+Reference test pattern: tests/python/test_updaters.py exercises exact on
+small dense data and compares training quality across tree methods.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.metric import auc as _auc
+
+
+def _data(seed=0, n=1500, f=8, sparsity=0.0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    if sparsity:
+        X[rng.random((n, f)) < sparsity] = np.nan
+    logit = np.nan_to_num(X[:, 0]) * 1.5 + np.nan_to_num(X[:, 1]) ** 2 - 1.0
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    return X, y
+
+
+@pytest.mark.parametrize("sparsity", [0.0, 0.3])
+def test_exact_learns(sparsity):
+    X, y = _data(sparsity=sparsity)
+    Xt, yt = _data(seed=5, sparsity=sparsity)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "tree_method": "exact"}
+    bst = xtb.train(params, xtb.DMatrix(X, label=y), 20, verbose_eval=False)
+    a = _auc(bst.predict(xtb.DMatrix(Xt)), yt)
+    assert a > 0.85, a
+
+
+def test_exact_close_to_hist():
+    """With max_bin large enough, hist approaches exact; quality must agree."""
+    X, y = _data(seed=2)
+    Xt, yt = _data(seed=7)
+    out = {}
+    for tm in ("exact", "hist"):
+        params = {"objective": "binary:logistic", "max_depth": 5, "eta": 0.3,
+                  "tree_method": tm, "max_bin": 512}
+        bst = xtb.train(params, xtb.DMatrix(X, label=y), 15, verbose_eval=False)
+        out[tm] = _auc(bst.predict(xtb.DMatrix(Xt)), yt)
+    assert abs(out["exact"] - out["hist"]) < 0.02, out
+
+
+def test_exact_regression_with_gamma_and_colsample():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(1200, 10)).astype(np.float32)
+    yv = X[:, 0] * 2 + np.sin(X[:, 1]) + rng.normal(scale=0.1, size=1200)
+    params = {"objective": "reg:squarederror", "max_depth": 5, "eta": 0.3,
+              "tree_method": "exact", "gamma": 1.0, "colsample_bytree": 0.8,
+              "subsample": 0.9}
+    bst = xtb.train(params, xtb.DMatrix(X, label=yv.astype(np.float32)), 25,
+                    verbose_eval=False)
+    pred = bst.predict(xtb.DMatrix(X))
+    rmse = float(np.sqrt(np.mean((pred - yv) ** 2)))
+    assert rmse < 0.6, rmse
+    # gamma pruning really engages: like the reference's TreePruner, only
+    # leaf-pair parents are candidates — none of them may keep a < gamma split
+    for t in bst.trees:
+        lc, rc = t.left_children, t.right_children
+        for nid in range(t.n_nodes):
+            if lc[nid] == -1:
+                continue
+            if lc[lc[nid]] == -1 and lc[rc[nid]] == -1:
+                assert t.loss_changes[nid] >= 1.0 - 1e-6
+
+
+def test_exact_adaptive_quantile_leaves():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(800, 6)).astype(np.float32)
+    yv = (X[:, 0] + rng.normal(scale=0.2, size=800)).astype(np.float32)
+    params = {"objective": "reg:absoluteerror", "max_depth": 4, "eta": 0.5,
+              "tree_method": "exact"}
+    bst = xtb.train(params, xtb.DMatrix(X, label=yv), 20, verbose_eval=False)
+    mae = float(np.mean(np.abs(bst.predict(xtb.DMatrix(X)) - yv)))
+    assert mae < 0.4, mae
+
+
+def test_exact_model_roundtrip(tmp_path):
+    X, y = _data(seed=9)
+    params = {"objective": "binary:logistic", "max_depth": 4,
+              "tree_method": "exact"}
+    bst = xtb.train(params, xtb.DMatrix(X, label=y), 5, verbose_eval=False)
+    p = tmp_path / "m.json"
+    bst.save_model(str(p))
+    bst2 = xtb.Booster(model_file=str(p))
+    np.testing.assert_allclose(
+        bst.predict(xtb.DMatrix(X)), bst2.predict(xtb.DMatrix(X)), rtol=1e-6)
+
+
+def test_exact_missingness_signal_split():
+    """colmaker's end-of-enumeration candidate: a constant-valued sparse
+    column whose NaN pattern IS the label must still be splittable."""
+    rng = np.random.default_rng(6)
+    X = np.ones((400, 2), np.float32)
+    X[:, 1] = rng.normal(size=400)
+    miss = rng.random(400) < 0.5
+    X[miss, 0] = np.nan
+    y = miss.astype(np.float32)
+    bst = xtb.train({"objective": "binary:logistic", "tree_method": "exact",
+                     "max_depth": 3, "eta": 0.5},
+                    xtb.DMatrix(X, label=y), 5, verbose_eval=False)
+    t = bst.trees[0]
+    assert t.n_nodes > 1, "missing-vs-present split was not found"
+    assert t.split_indices[0] == 0
+    p = bst.predict(xtb.DMatrix(X))
+    assert float(np.mean((p > 0.5) == (y > 0.5))) > 0.99
+
+
+def test_exact_max_leaves_bounds_unbounded_depth():
+    X, y = _data(n=600)
+    params = {"objective": "binary:logistic", "tree_method": "exact",
+              "max_depth": 0, "max_leaves": 8, "eta": 0.5,
+              "min_child_weight": 0.0}
+    bst = xtb.train(params, xtb.DMatrix(X, label=y), 3, verbose_eval=False)
+    for t in bst.trees:
+        assert t.num_leaves <= 8, t.num_leaves
+
+
+def test_exact_max_delta_step_clips_leaves():
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(500, 4)).astype(np.float32)
+    y = (rng.random(500) < 0.02).astype(np.float32)  # unbalanced
+    params = {"objective": "binary:logistic", "tree_method": "exact",
+              "max_depth": 4, "eta": 1.0, "max_delta_step": 0.7}
+    bst = xtb.train(params, xtb.DMatrix(X, label=y), 3, verbose_eval=False)
+    for t in bst.trees:
+        leaves = t.left_children == -1
+        # leaf values = eta * clipped weight, |w| <= max_delta_step
+        assert np.all(np.abs(t.split_conditions[leaves]) <= 0.7 + 1e-6)
+
+
+def test_exact_extmem_raises():
+    from xgboost_tpu.data.extmem import DataIter, ExtMemQuantileDMatrix
+
+    X, y = _data(n=400)
+
+    class It(DataIter):
+        def __init__(self):
+            super().__init__()
+            self._i = 0
+
+        def next(self, input_data):
+            if self._i >= 2:
+                return 0
+            s = slice(self._i * 200, (self._i + 1) * 200)
+            input_data(data=X[s], label=y[s])
+            self._i += 1
+            return 1
+
+        def reset(self):
+            self._i = 0
+
+    d = ExtMemQuantileDMatrix(It(), max_bin=64)
+    with pytest.raises(NotImplementedError):
+        xtb.train({"tree_method": "exact", "objective": "binary:logistic"},
+                  d, 2, verbose_eval=False)
+
+
+def test_exact_unsupported_raise():
+    X, y = _data(n=200)
+    d = xtb.DMatrix(X, label=y)
+    with pytest.raises((NotImplementedError, ValueError)):
+        xtb.train({"tree_method": "exact", "monotone_constraints": "(1,0,0,0,0,0,0,0)",
+                   "objective": "binary:logistic"}, d, 2, verbose_eval=False)
+    with pytest.raises(ValueError):
+        xtb.train({"tree_method": "exact", "grow_policy": "lossguide",
+                   "objective": "binary:logistic"}, d, 2, verbose_eval=False)
+
+
+ORACLE_PKG = "/tmp/xgb_oracle"
+HAVE_ORACLE = os.path.exists(os.path.join(ORACLE_PKG, "xgboost", "lib",
+                                          "libxgboost.so"))
+
+
+@pytest.mark.skipif(not HAVE_ORACLE,
+                    reason="oracle not built (run oracle/build_oracle.sh)")
+def test_exact_oracle_parity(tmp_path):
+    """Same data, tree_method=exact both sides: held-out AUC within 0.01 and
+    identical root split feature on a clean signal."""
+    X, y = _data(seed=11, n=2500)
+    Xt, yt = _data(seed=12, n=2500)
+    for name, arr in (("X", X), ("y", y), ("Xt", Xt), ("yt", yt)):
+        np.save(tmp_path / f"{name}.npy", arr)
+    params = {"objective": "binary:logistic", "max_depth": 5, "eta": 0.3,
+              "eval_metric": "auc", "tree_method": "exact"}
+    env = dict(os.environ, PYTHONPATH=ORACLE_PKG, JAX_PLATFORMS="cpu")
+    code = f"""
+import json, numpy as np, xgboost
+X = np.load({str(tmp_path / 'X.npy')!r}); y = np.load({str(tmp_path / 'y.npy')!r})
+Xt = np.load({str(tmp_path / 'Xt.npy')!r}); yt = np.load({str(tmp_path / 'yt.npy')!r})
+ev = {{}}
+bst = xgboost.train({params!r}, xgboost.DMatrix(X, label=y), 20,
+                    evals=[(xgboost.DMatrix(Xt, label=yt), "t")],
+                    evals_result=ev, verbose_eval=False)
+root_feat = json.loads(bst.get_dump(dump_format="json")[0])["split"]
+print(json.dumps({{"auc": ev["t"]["auc"][-1], "root": root_feat}}))
+"""
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-3000:]
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+
+    ev = {}
+    bst = xtb.train(params, xtb.DMatrix(X, label=y), 20,
+                    evals=[(xtb.DMatrix(Xt, label=yt), "t")],
+                    evals_result=ev, verbose_eval=False)
+    assert abs(ev["t"]["auc"][-1] - res["auc"]) < 0.01, (ev["t"]["auc"][-1], res)
+    ours_root = f"f{bst.trees[0].split_indices[0]}"
+    assert ours_root == res["root"], (ours_root, res["root"])

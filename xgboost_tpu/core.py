@@ -60,6 +60,22 @@ class _Cache:
     def is_extmem(self) -> bool:
         return hasattr(self.dmat, "_pages")
 
+    def ensure_train_raw(self) -> None:
+        """Label/weight/valid arrays WITHOUT sketching or binning: the exact
+        updater walks raw host values, so the quantile sketch + Ellpack +
+        device upload would be pure wasted startup cost."""
+        import jax.numpy as jnp
+
+        if self.ellpack is not None or getattr(self, "_raw_ready", False):
+            return  # binned arrays already cover the raw path's needs
+        R = self.dmat.num_row()
+        self.valid = jnp.ones(R, bool)
+        self.labels = jnp.asarray(self.dmat.get_label())
+        w = self.dmat.get_weight()
+        self.weights = None if w is None else jnp.asarray(w)
+        self.n_padded = R
+        self._raw_ready = True
+
     def ensure_train(self) -> None:
         """Build the binned page + padded label/weight/valid device arrays."""
         import jax.numpy as jnp
@@ -190,12 +206,9 @@ class Booster:
         # process_type=update re-processes an existing model's trees with
         # the non-growing updaters (gbtree.cc InitUpdater)
         self.tree_method = str(p.get("tree_method", "hist"))
-        if self.tree_method in ("auto", "gpu_hist", "exact"):
-            # exact walks raw values row-by-row (CPU-only in the reference
-            # too, updater_colmaker.cc); the binned updaters are the TPU
-            # path, so exact maps to hist like the reference's GPU configs
+        if self.tree_method in ("auto", "gpu_hist"):
             self.tree_method = "hist"
-        if self.tree_method not in ("hist", "approx"):
+        if self.tree_method not in ("hist", "approx", "exact"):
             raise ValueError(f"unknown tree_method {self.tree_method!r}")
         self.process_type = str(p.get("process_type", "default"))
         if self.process_type not in ("default", "update"):
@@ -277,6 +290,7 @@ class Booster:
                 bm = np.asarray(self.objective.prob_to_margin(prob))
             elif len(self.trees) == 0 and (
                 cache.ellpack is not None
+                or getattr(cache, "_raw_ready", False)
                 or (cache.is_extmem and getattr(cache, "_extmem_ready", False))
             ):
                 import jax.numpy as jnp
@@ -402,7 +416,10 @@ class Booster:
 
         self._configure()
         cache = self._get_cache(dtrain)
-        cache.ensure_train()
+        if self.tree_method == "exact" and not cache.is_extmem:
+            cache.ensure_train_raw()
+        else:
+            cache.ensure_train()
         if hasattr(self.objective, "set_bounds"):
             lo = dtrain.info.label_lower_bound
             hi = dtrain.info.label_upper_bound
@@ -508,7 +525,10 @@ class Booster:
                 "boost() with raw grad/hess cannot honour a DART dropout "
                 "round; use update(fobj=...) or set rate_drop=0")
         cache = self._get_cache(dtrain)
-        cache.ensure_train()
+        if self.tree_method == "exact" and not cache.is_extmem:
+            cache.ensure_train_raw()
+        else:
+            cache.ensure_train()
         self._sync_margin(cache)
         R = dtrain.num_row()
         g = np.asarray(grad, np.float32).reshape(R, -1)
@@ -665,7 +685,9 @@ class Booster:
                 if b >= len(seg) or seg[b] != t.split_conditions[nid]:
                     raise ValueError(
                         "cannot map split threshold onto this matrix's bin "
-                        "cuts; was the model trained with different cuts?"
+                        "cuts; was the model trained with different cuts, or "
+                        "with tree_method='exact' (raw-value thresholds)? "
+                        "Use an in-memory DMatrix for prediction."
                     )
                 sbin[nid] = b
             t.split_bins = sbin
@@ -764,6 +786,128 @@ class Booster:
                     f"(use a power of two up to 1024)")
             self._mesh = make_mesh(n)
         return self._mesh
+
+    def _boost_trees_exact_loop(self, cache: _Cache, gpair, iteration: int,
+                                fobj, drop_idx) -> None:
+        """The tree_method='exact' boosting round: host colmaker growth,
+        reusing the DART / parallel-forest / column-sample machinery of the
+        hist path without its sketch/Ellpack/jitted-grower startup."""
+        drop_margin = None
+        if drop_idx:
+            gpair, drop_margin = self._dart_gpair(cache, drop_idx, fobj,
+                                                  iteration)
+        K = gpair.shape[1]
+        if self.multi_strategy == "multi_output_tree" and K > 1:
+            raise NotImplementedError(
+                "tree_method='exact' with multi_output_tree is not "
+                "supported yet")
+        new_margin = cache.margin
+        n_new = 0
+        n_features = cache.dmat.num_col()
+        for p_idx in range(max(self.num_parallel_tree, 1)):
+            fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx,
+                                           n_features)
+            gp = self._subsample_mask(gpair, iteration * 131 + p_idx)
+            for k in range(K):
+                tree, delta = self._grow_exact_one(cache, gp, k, fmask_fn,
+                                                   new_margin)
+                new_margin = new_margin.at[:, k].add(delta)
+                self.trees.append(tree)
+                self.tree_info.append(k)
+                self.tree_weights.append(1.0)
+                n_new += 1
+        if drop_idx:
+            new_margin = self._dart_commit(cache, new_margin, n_new,
+                                           drop_idx, drop_margin)
+        cache.margin = new_margin
+        cache.n_trees_applied = len(self.trees)
+
+    def _grow_exact_one(self, cache: _Cache, gp, k: int, fmask_fn,
+                        new_margin=None):
+        """One tree_method="exact" round: host greedy enumeration over raw
+        values (updater_colmaker.cc ColMaker) chained with the pruner the
+        way the reference chains "grow_colmaker,prune"; returns
+        (RegTree, margin delta padded to the cache layout)."""
+        from .models.updaters import prune_tree
+        from .tree.exact import grow_exact
+
+        tp = self.tparam
+        if self._process_parallel() or self._get_mesh() is not None:
+            raise NotImplementedError(
+                "tree_method='exact' is single-host only (the reference "
+                "forbids exact under dask/distributed training too)")
+        if cache.dmat.cat_mask() is not None and np.any(cache.dmat.cat_mask()):
+            raise NotImplementedError(
+                "tree_method='exact' does not support categorical features "
+                "(same as the reference updater)")
+        if tp.monotone_constraints is not None or tp.interaction_constraints:
+            raise NotImplementedError(
+                "constraints are not supported with tree_method='exact'; "
+                "use hist or approx")
+        if tp.grow_policy == "lossguide":
+            raise ValueError("tree_method='exact' only supports depthwise "
+                             "growth (driver.h lossguide needs hist/approx)")
+        # X and its column argsort are round-invariant: cache both (the
+        # colmaker builds its SortedCSC once per Update too); reuse the DART
+        # path's device copy rather than recoding a second host copy
+        if getattr(cache, "exact_X", None) is None:
+            cache.exact_X = (np.asarray(cache.raw_X)
+                             if cache.raw_X is not None
+                             else self._host_dense_recoded(cache.dmat))
+            cache.exact_order = np.argsort(cache.exact_X, axis=0,
+                                           kind="stable").astype(np.int32)
+        X = cache.exact_X
+        R = X.shape[0]
+        gh = np.asarray(gp[:R, k, :], np.float64)
+        tree, pos = grow_exact(
+            X, gh[:, 0], gh[:, 1],
+            max_depth=int(tp.max_depth), max_leaves=int(tp.max_leaves),
+            lambda_=float(tp.lambda_), alpha=float(tp.alpha),
+            min_child_weight=float(tp.min_child_weight),
+            max_delta_step=float(tp.max_delta_step),
+            eta=float(tp.eta), feature_masks=fmask_fn,
+            col_order=cache.exact_order,
+        )
+        tree, n_pruned = prune_tree(tree, gamma=float(tp.gamma),
+                                    eta=float(tp.eta))
+        if n_pruned:
+            # node ids changed: re-route rows through the pruned tree
+            from .models.updaters import _route_masks
+
+            masks = _route_masks(tree, X)
+            leaf_ids = np.nonzero(tree.left_children == -1)[0]
+            pos = np.zeros(R, np.int32)
+            for nid in leaf_ids:
+                pos[masks[nid]] = nid
+        if (hasattr(self.objective, "adaptive_leaf")
+                and self.objective.adaptive_leaf()):
+            # ObjFunction::UpdateTreeLeaf (src/objective/adaptive.cc):
+            # refit each leaf to the weighted alpha-quantile of residuals
+            # (against the RUNNING margin so num_parallel_tree>1 members
+            # see earlier members' contributions, like the hist path)
+            labels = np.asarray(cache.labels)[:R]
+            margin_src = cache.margin if new_margin is None else new_margin
+            margin_k = np.asarray(margin_src)[:R, k]
+            residual = labels - margin_k
+            valid = np.asarray(cache.valid)[:R].astype(bool)
+            alpha_q = float(self.objective.adaptive_alpha(k))
+            w = (np.asarray(cache.weights)[:R]
+                 if cache.weights is not None else None)
+            for nid in np.nonzero(tree.left_children == -1)[0]:
+                m = (pos == nid) & valid
+                if not np.any(m):
+                    continue
+                res = residual[m]
+                if w is None:
+                    q = np.quantile(res, alpha_q)
+                else:
+                    srt = np.argsort(res)
+                    cw = np.cumsum(w[m][srt])
+                    q = res[srt][np.searchsorted(cw, alpha_q * cw[-1])]
+                tree.split_conditions[nid] = np.float32(float(tp.eta) * q)
+        delta = np.zeros(cache.margin.shape[0], np.float32)
+        delta[:R] = tree.split_conditions[pos]
+        return tree, delta
 
     def _boost_multi_target(self, cache: _Cache, gpair, iteration: int,
                             K: int, scalar_grower, cat_mask_np) -> None:
@@ -952,6 +1096,11 @@ class Booster:
         import jax.numpy as jnp
 
         if cache.is_extmem:
+            if self.tree_method == "exact":
+                raise NotImplementedError(
+                    "tree_method='exact' needs raw in-memory values; it is "
+                    "not supported with ExtMemQuantileDMatrix (the reference "
+                    "restricts exact to SimpleDMatrix too)")
             if self.booster_kind == "dart":
                 raise ValueError("booster='dart' is not supported with "
                                  "ExtMemQuantileDMatrix yet")
@@ -961,6 +1110,17 @@ class Booster:
                     "multi-process external-memory training yet; give each "
                     "process one device")
             return self._boost_trees_extmem(cache, gpair, iteration)
+        exact = self.tree_method == "exact"
+        if exact:
+            # the exact branch walks raw host values: no sketch, no Ellpack,
+            # no jitted grower — building them here would be pure waste
+            if self.tparam.max_depth <= 0 and self.tparam.max_leaves <= 0:
+                raise ValueError(
+                    "tree_method='exact' with max_depth=0 needs a positive "
+                    "max_leaves to bound the tree")
+            self._boost_trees_exact_loop(cache, gpair, iteration, fobj,
+                                         drop_idx)
+            return
         ell = cache.ellpack
         mono = self.tparam.monotone_constraints
         if mono is not None and len(mono) != ell.n_features:
@@ -1042,41 +1202,18 @@ class Booster:
         # ---- DART dropout (reference: gbtree.cc Dart::DoBoost + DropTrees) ----
         drop_margin = None
         if drop_idx:
-            import jax.numpy as jnp
-
-            if cache.raw_X is None:
-                cache.raw_X = jnp.asarray(self._host_dense_recoded(cache.dmat), jnp.float32)
-            drop_margin = self._margin_for_trees(cache.raw_X, drop_idx)
-            pad = cache.margin.shape[0] - drop_margin.shape[0]
-            if pad:
-                drop_margin = jnp.concatenate(
-                    [drop_margin, jnp.zeros((pad, drop_margin.shape[1]), jnp.float32)],
-                    axis=0,
-                )
-            # gradients computed on the margin WITHOUT dropped trees (the
-            # caller skipped its own gradient pass, so a custom fobj runs
-            # exactly once per round)
-            reduced = cache.margin - drop_margin
-            if fobj is not None:
-                # custom objective: invoke on the reduced RAW margin
-                # (advisor round-1: silently falling back to the built-in
-                # objective trained the drop round on the wrong loss)
-                gpair = self._fobj_gpair(cache, fobj, reduced, cache.dmat)
-            else:
-                gpair = self.objective.get_gradient(
-                    reduced, cache.labels, cache.weights, iteration
-                )
-            gpair = gpair * cache.valid[:, None, None]
+            gpair, drop_margin = self._dart_gpair(cache, drop_idx, fobj,
+                                                  iteration)
 
         K = gpair.shape[1]
         new_margin = cache.margin
         n_new = 0
         cat_mask_np = cache.dmat.cat_mask()
         if self.multi_strategy == "multi_output_tree" and K > 1:
-            if self.tree_method == "approx":
+            if self.tree_method in ("approx", "exact"):
                 raise NotImplementedError(
-                    "tree_method='approx' with multi_output_tree is not "
-                    "supported yet")
+                    f"tree_method={self.tree_method!r} with multi_output_tree "
+                    "is not supported yet")
             return self._boost_multi_target(cache, gpair, iteration, K,
                                             grower, cat_mask_np)
         bins_use, cuts_use, nbins_use = cache.bins, ell.cuts_pad, ell.n_bins
@@ -1167,36 +1304,69 @@ class Booster:
                 n_new += 1
 
         if drop_idx:
-            # normalize (Dart::NormalizeTrees): with k dropped and lr=eta,
-            # 'tree': new *= 1/(k+lr), dropped *= k/(k+lr)
-            # 'forest': new *= 1/(1+lr), dropped *= lr... per reference: /(1+lr)
-            import jax.numpy as jnp
-
-            k_d = len(drop_idx)
-            lr = float(self.tparam.eta)
-            if self.normalize_type == "forest":
-                new_w = 1.0 / (1.0 + lr)
-                factor = 1.0 / (1.0 + lr)
-            else:
-                new_w = 1.0 / (k_d + lr)
-                factor = k_d / (k_d + lr)
-            for t in range(len(self.trees) - n_new, len(self.trees)):
-                self.tree_weights[t] = new_w
-            for t in drop_idx:
-                self.tree_weights[t] *= factor
-            # margin: dropped trees shrank by `factor`, new trees contribute
-            # scaled by new_w; rebuild incrementally
-            new_contrib = new_margin - cache.margin  # unscaled new trees
-            new_margin = (
-                cache.margin
-                - (1.0 - factor) * drop_margin
-                + new_w * new_contrib
-            )
-            self._weights_version = getattr(self, "_weights_version", 0) + 1
-            cache.weights_version = self._weights_version
+            new_margin = self._dart_commit(cache, new_margin, n_new,
+                                           drop_idx, drop_margin)
 
         cache.margin = new_margin
         cache.n_trees_applied = len(self.trees)
+
+    def _dart_gpair(self, cache: _Cache, drop_idx, fobj, iteration: int):
+        """Gradients for a DART drop round, computed on the margin WITHOUT
+        the dropped trees (gbtree.cc Dart::DoBoost; the caller skipped its
+        own gradient pass so a custom fobj runs exactly once per round)."""
+        import jax.numpy as jnp
+
+        if cache.raw_X is None:
+            cache.raw_X = jnp.asarray(self._host_dense_recoded(cache.dmat),
+                                      jnp.float32)
+        drop_margin = self._margin_for_trees(cache.raw_X, drop_idx)
+        pad = cache.margin.shape[0] - drop_margin.shape[0]
+        if pad:
+            drop_margin = jnp.concatenate(
+                [drop_margin,
+                 jnp.zeros((pad, drop_margin.shape[1]), jnp.float32)],
+                axis=0,
+            )
+        reduced = cache.margin - drop_margin
+        if fobj is not None:
+            # custom objective: invoke on the reduced RAW margin (advisor
+            # round-1: silently falling back to the built-in objective
+            # trained the drop round on the wrong loss)
+            gpair = self._fobj_gpair(cache, fobj, reduced, cache.dmat)
+        else:
+            gpair = self.objective.get_gradient(
+                reduced, cache.labels, cache.weights, iteration
+            )
+        return gpair * cache.valid[:, None, None], drop_margin
+
+    def _dart_commit(self, cache: _Cache, new_margin, n_new: int, drop_idx,
+                     drop_margin):
+        """DART post-round rescale (Dart::NormalizeTrees): with k dropped and
+        lr=eta — 'tree': new *= 1/(k+lr), dropped *= k/(k+lr); 'forest':
+        both /(1+lr).  Returns the rebuilt margin."""
+        k_d = len(drop_idx)
+        lr = float(self.tparam.eta)
+        if self.normalize_type == "forest":
+            new_w = 1.0 / (1.0 + lr)
+            factor = 1.0 / (1.0 + lr)
+        else:
+            new_w = 1.0 / (k_d + lr)
+            factor = k_d / (k_d + lr)
+        for t in range(len(self.trees) - n_new, len(self.trees)):
+            self.tree_weights[t] = new_w
+        for t in drop_idx:
+            self.tree_weights[t] *= factor
+        # margin: dropped trees shrank by `factor`, new trees contribute
+        # scaled by new_w; rebuild incrementally
+        new_contrib = new_margin - cache.margin  # unscaled new trees
+        new_margin = (
+            cache.margin
+            - (1.0 - factor) * drop_margin
+            + new_w * new_contrib
+        )
+        self._weights_version = getattr(self, "_weights_version", 0) + 1
+        cache.weights_version = self._weights_version
+        return new_margin
 
     # ------------------------------------------------------------------ eval
     def eval_set(self, evals: Sequence[Tuple[DMatrix, str]], iteration: int = 0,

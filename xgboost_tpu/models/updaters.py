@@ -147,8 +147,10 @@ def prune_tree(tree: RegTree, *, gamma: float, eta: float,
             [tree.loss_changes[i] if left[i] != -1 else 0.0 for i in order],
             np.float32),
         sum_hessian=tree.sum_hessian[order].astype(np.float32),
+        # preserve None: exact-grown trees deliberately carry no split_bins
+        # so binned predict paths fail loudly instead of mis-routing
         split_bins=(tree.split_bins[order].astype(np.int32)
-                    if tree.split_bins is not None else np.zeros(m, np.int32)),
+                    if tree.split_bins is not None else None),
         split_type=(tree.split_type[order].astype(np.int32)
                     if tree.split_type is not None else np.zeros(m, np.int32)),
         categories={remap[k]: v for k, v in (tree.categories or {}).items()
