@@ -27,6 +27,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running multi-process tests")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

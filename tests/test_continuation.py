@@ -1,0 +1,94 @@
+"""Training continuation (xgb_model=) and the margin-sync paths behind it.
+
+Reference: training.py resumes from a Booster; UpdatePredictionCache keeps
+margins in lockstep with committed trees (include/xgboost/cache.h:26).  The
+cached-margin rebuild has three routes — binned page (training matrix),
+streamed raw windows (large CSR), dense raw — and continuation must produce
+the same model through any of them.
+"""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+
+
+def _data(seed=0, n=1200, f=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    X[rng.random((n, f)) < 0.2] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) ** 2 - 1 +
+         0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    return X, y
+
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+          "max_bin": 64}
+
+
+def _trees_equal(a, b):
+    assert len(a) == len(b)
+    for ta, tb in zip(a, b):
+        np.testing.assert_array_equal(ta.left_children, tb.left_children)
+        np.testing.assert_array_equal(ta.split_indices, tb.split_indices)
+        np.testing.assert_allclose(ta.split_conditions, tb.split_conditions,
+                                   rtol=0, atol=0)
+
+
+def test_continuation_identity_same_booster():
+    """5 + 5 rounds on the same Booster == 10 straight rounds: the binned
+    margin sync must reproduce the training margins exactly."""
+    X, y = _data()
+    d = xtb.DMatrix(X, label=y)
+    full = xtb.train(PARAMS, d, 10, verbose_eval=False)
+
+    d2 = xtb.DMatrix(X, label=y)
+    half = xtb.train(PARAMS, d2, 5, verbose_eval=False)
+    # fresh DMatrix for the second leg -> a new cache whose margin is
+    # rebuilt through _sync_margin (the binned route: ellpack + split_bins)
+    d3 = xtb.DMatrix(X, label=y)
+    cont = xtb.train(PARAMS, d3, 5, verbose_eval=False, xgb_model=half)
+    _trees_equal(full.trees, cont.trees)
+
+
+def test_continuation_identity_after_reload(tmp_path):
+    """Loaded models carry no split_bins: continuation goes through the raw
+    margin route and must still match (thr == cut values exactly)."""
+    X, y = _data(seed=3)
+    d = xtb.DMatrix(X, label=y)
+    full = xtb.train(PARAMS, d, 8, verbose_eval=False)
+
+    half = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 4, verbose_eval=False)
+    p = tmp_path / "half.ubj"
+    half.save_model(str(p))
+    cont = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 4, verbose_eval=False,
+                     xgb_model=str(p))
+    _trees_equal(full.trees, cont.trees)
+
+
+def test_continuation_exact_updater():
+    X, y = _data(seed=5)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "tree_method": "exact"}
+    full = xtb.train(params, xtb.DMatrix(X, label=y), 8, verbose_eval=False)
+    half = xtb.train(params, xtb.DMatrix(X, label=y), 4, verbose_eval=False)
+    cont = xtb.train(params, xtb.DMatrix(X, label=y), 4, verbose_eval=False,
+                     xgb_model=half)
+    _trees_equal(full.trees, cont.trees)
+
+
+def test_eval_during_continuation():
+    """eval_set on the training matrix stays consistent across the leg
+    boundary (prediction-cache semantics)."""
+    X, y = _data(seed=7)
+    res = {}
+    d = xtb.DMatrix(X, label=y)
+    full = xtb.train({**PARAMS, "eval_metric": "logloss"}, d, 10,
+                     evals=[(d, "t")], evals_result=res, verbose_eval=False)
+    res2 = {}
+    half = xtb.train({**PARAMS, "eval_metric": "logloss"},
+                     xtb.DMatrix(X, label=y), 5, verbose_eval=False)
+    xtb.train({**PARAMS, "eval_metric": "logloss"}, xtb.DMatrix(X, label=y),
+              5, evals=[(xtb.DMatrix(X, label=y), "t")], evals_result=res2,
+              verbose_eval=False, xgb_model=half)
+    np.testing.assert_allclose(res["t"]["logloss"][-1],
+                               res2["t"]["logloss"][-1], rtol=1e-5)

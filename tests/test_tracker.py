@@ -1,0 +1,182 @@
+"""RabitTracker rendezvous + error fan-out (reference: tracker.cc
+Bootstrap/CMD::kError, comm.cc:340 error watcher, tracker.py RabitTracker).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from xgboost_tpu.tracker import RabitTracker, recv_msg, send_msg
+
+
+def test_rendezvous_protocol_assigns_sorted_ranks():
+    """Raw-socket fake workers: ranks assigned by host sort, world correct,
+    coordinator shared."""
+    tr = RabitTracker(n_workers=3, host_ip="127.0.0.1")
+    tr.start()
+    results = {}
+
+    def worker(host_tag, idx):
+        s = socket.create_connection(("127.0.0.1", tr.port), timeout=30)
+        send_msg(s, {"cmd": "start", "host": host_tag})
+        reply = recv_msg(s)
+        results[idx] = (host_tag, reply)
+        send_msg(s, {"cmd": "shutdown"})
+        s.close()
+
+    # connect in reverse host order to prove sorting
+    threads = []
+    for idx, tag in enumerate(["hostC", "hostA", "hostB"]):
+        t = threading.Thread(target=worker, args=(tag, idx))
+        t.start()
+        threads.append(t)
+        time.sleep(0.2)  # deterministic arrival order
+    for t in threads:
+        t.join(30)
+    tr.wait_for(timeout=30)
+    by_host = {tag: r for (tag, r) in results.values()}
+    assert by_host["hostA"]["rank"] == 0
+    assert by_host["hostB"]["rank"] == 1
+    assert by_host["hostC"]["rank"] == 2
+    coords = {r["coordinator"] for (_t, r) in results.values()}
+    assert len(coords) == 1
+    assert all(r["world"] == 3 for (_t, r) in results.values())
+    tr.free()
+
+
+def test_wait_for_raises_on_worker_error():
+    tr = RabitTracker(n_workers=2, host_ip="127.0.0.1")
+    tr.start()
+    aborted = {}
+
+    def ok_worker():
+        s = socket.create_connection(("127.0.0.1", tr.port), timeout=30)
+        send_msg(s, {"cmd": "start", "host": "a"})
+        recv_msg(s)
+        msg = recv_msg(s)  # blocks until the abort fan-out
+        aborted["msg"] = msg
+        s.close()
+
+    def bad_worker():
+        s = socket.create_connection(("127.0.0.1", tr.port), timeout=30)
+        send_msg(s, {"cmd": "start", "host": "b"})
+        recv_msg(s)
+        time.sleep(0.3)
+        send_msg(s, {"cmd": "error", "msg": "synthetic failure"})
+        s.close()
+
+    t1 = threading.Thread(target=ok_worker)
+    t2 = threading.Thread(target=bad_worker)
+    t1.start(); t2.start()
+    with pytest.raises(RuntimeError, match="synthetic failure"):
+        tr.wait_for(timeout=30)
+    t1.join(30); t2.join(30)
+    assert aborted["msg"]["cmd"] == "abort"
+    tr.free()
+
+
+TRAIN_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+uri, port = sys.argv[1], int(sys.argv[2])
+
+from xgboost_tpu import collective
+# tracker mode: NO pre-assigned rank — the tracker hands one out
+collective.init(dmlc_tracker_uri=uri, dmlc_tracker_port=port, dmlc_nworker=2)
+rank = collective.get_rank()
+assert collective.get_world_size() == 2
+
+import numpy as np
+import xgboost_tpu as xtb
+rng = np.random.default_rng(0)
+X = rng.normal(size=(2000, 6)).astype(np.float32)
+y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+Xs, ys = X[rank::2], y[rank::2]
+bst = xtb.train({"objective": "binary:logistic", "max_depth": 3,
+                 "max_bin": 32}, xtb.DMatrix(Xs, label=ys), 2,
+                verbose_eval=False)
+import hashlib
+dump = "".join(bst.get_dump(dump_format="json"))
+print("RESULT" + json.dumps({"rank": rank,
+                             "hash": hashlib.md5(dump.encode()).hexdigest()}))
+collective.finalize()
+"""
+
+ABORT_CHILD = r"""
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+uri, port, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+from xgboost_tpu import collective
+collective.init(dmlc_tracker_uri=uri, dmlc_tracker_port=port, dmlc_nworker=2)
+if mode == "fail":
+    time.sleep(1.0)
+    collective.signal_error("boom")  # exits 1 after telling the tracker
+else:
+    time.sleep(900)  # hung worker: only the abort fan-out can end it
+"""
+
+
+@pytest.mark.slow
+def test_tracker_mode_end_to_end_training():
+    """Full flow: RabitTracker.start -> workers init via worker_args with no
+    rank -> jax.distributed rendezvous through the tracker-supplied
+    coordinator -> identical models -> wait_for returns."""
+    tr = RabitTracker(n_workers=2, host_ip="127.0.0.1")
+    tr.start()
+    args = tr.worker_args()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", TRAIN_CHILD, str(args["dmlc_tracker_uri"]),
+         str(args["dmlc_tracker_port"])],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for _ in range(2)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT")][-1]
+        outs.append(json.loads(line[len("RESULT"):]))
+    tr.wait_for(timeout=60)
+    assert {o["rank"] for o in outs} == {0, 1}
+    assert outs[0]["hash"] == outs[1]["hash"]
+    tr.free()
+
+
+@pytest.mark.slow
+def test_error_fanout_kills_hung_worker():
+    """One worker fails -> tracker aborts the other (which would otherwise
+    sleep 300s) -> wait_for raises.  The reference's fail-fast elastic
+    path end to end."""
+    tr = RabitTracker(n_workers=2, host_ip="127.0.0.1")
+    tr.start()
+    args = tr.worker_args()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    t0 = time.time()
+    hang = subprocess.Popen(
+        [sys.executable, "-c", ABORT_CHILD, str(args["dmlc_tracker_uri"]),
+         str(args["dmlc_tracker_port"]), "hang"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+    fail = subprocess.Popen(
+        [sys.executable, "-c", ABORT_CHILD, str(args["dmlc_tracker_uri"]),
+         str(args["dmlc_tracker_port"]), "fail"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+    with pytest.raises(RuntimeError, match="boom"):
+        # generous ceiling: a loaded 1-core box needs ~2 min just for two
+        # jax imports + distributed init; uncontended this fires in ~10s
+        tr.wait_for(timeout=280)
+    assert fail.wait(timeout=120) == 1
+    rc = hang.wait(timeout=120)  # killed by the abort watcher, NOT the sleep
+    assert rc == 255, rc
+    assert time.time() - t0 < 600, "hung worker was not aborted promptly"
+    tr.free()

@@ -116,21 +116,37 @@ class JaxDistributedBackend(CollBackend):
     (the RabitComm/NCCLComm role; rendezvous = jax coordinator service)."""
 
     def __init__(self, **args: Any) -> None:
-        coordinator = (args.get("dmlc_tracker_uri")
-                       or args.get("coordinator_address"))
-        n_proc = args.get("dmlc_nworker")
-        if n_proc is None:
-            n_proc = args.get("num_processes")
-        rank = args.get("dmlc_task_id")  # 0 is a valid rank: no `or` chains
-        if rank is None:
-            rank = args.get("process_id")
+        self._tracker = None
+        if args.get("dmlc_tracker_uri") and args.get("dmlc_tracker_port"):
+            # tracker mode (reference flow): dmlc_* args address a
+            # RabitTracker rendezvous server, which assigns the rank,
+            # relays rank 0's jax.distributed coordinator address, and
+            # stays connected as the error channel (TrackerClient watcher).
+            # dmlc_task_id is a sort hint (sortby="task"), not a rank.
+            from .tracker import TrackerClient
+
+            self._tracker = TrackerClient(
+                str(args["dmlc_tracker_uri"]),
+                int(args["dmlc_tracker_port"]),
+                task_id=str(args.get("dmlc_task_id", "")))
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=self._tracker.coordinator,
+                num_processes=self._tracker.world,
+                process_id=self._tracker.rank,
+            )
+            return
+        # direct mode: the caller runs its own rendezvous and passes the
+        # jax coordinator address + pre-assigned rank (launcher.py flow)
+        coordinator = args.get("coordinator_address")
+        n_proc = args.get("num_processes")
+        rank = args.get("process_id")
         if coordinator is not None:
             import jax
 
-            port = args.get("dmlc_tracker_port")
-            addr = f"{coordinator}:{port}" if port else str(coordinator)
             jax.distributed.initialize(
-                coordinator_address=addr,
+                coordinator_address=str(coordinator),
                 num_processes=int(n_proc) if n_proc is not None else None,
                 process_id=int(rank) if rank is not None else None,
             )
@@ -172,6 +188,9 @@ class JaxDistributedBackend(CollBackend):
         return bytes(np.asarray(out))
 
     def shutdown(self) -> None:
+        if self._tracker is not None:
+            self._tracker.shutdown()
+            self._tracker = None
         try:
             import jax
 
@@ -354,10 +373,21 @@ def broadcast(data: Any, root: int) -> Any:
 
 def signal_error(msg: str = "") -> None:
     """Fail-fast error signal (reference: collective.py:319 signal_error —
-    the tracker broadcasts the failure and every worker exits)."""
+    the tracker broadcasts the failure and every worker exits).
+
+    MUST NOT synchronize: get_rank() would trigger jax backend init, which
+    under jax.distributed runs a cross-process topology barrier — blocking
+    forever when a peer is already wedged, i.e. exactly when this function
+    is called.  The reference keeps a dedicated error socket for the same
+    reason (comm.cc:503 SignalError writes the tracker port directly)."""
     import sys
 
-    communicator_print(f"collective error: {msg}")
+    b = _backend()
+    tracker = getattr(b, "_tracker", None)
+    rank = getattr(tracker, "rank", "?")
+    print(f"[{rank}] collective error: {msg}", flush=True)
+    if tracker is not None:
+        tracker.signal_error(msg or "signal_error")
     sys.exit(1)
 
 

@@ -357,16 +357,39 @@ class Booster:
             elif self._use_streamed_predict(cache.dmat):
                 # large sparse eval/train matrix: never cache a dense copy
                 delta = jnp.asarray(self._margin_delta_streamed(cache.dmat, new))
+                pad = cache.margin.shape[0] - delta.shape[0]
+                if pad:
+                    delta = jnp.concatenate(
+                        [delta, jnp.zeros((pad, delta.shape[1]), jnp.float32)],
+                        axis=0)
+                cache.margin = cache.margin + delta
+                cache.n_trees_applied = len(self.trees)
+                return
+            elif (cache.ellpack is not None and self._get_mesh() is None
+                  and all(t.split_bins is not None
+                          and t.leaf_vector is None
+                          for t in self.trees[new])):
+                # binned pages already on device: route through them instead
+                # of materializing a second raw f32 copy (the reference's
+                # UpdatePredictionCache also reuses the training partition);
+                # loaded models without split_bins fall through to raw.
+                # Accumulating INTO the existing margin keeps the training
+                # loop's f32 addition order: a rebuilt cache is bitwise-
+                # identical to the incrementally-updated one, so continued
+                # training (xgb_model=) equals one straight run exactly
+                cache.margin = self._margin_delta_binned_cache(
+                    cache, new, init=cache.margin)
+                cache.n_trees_applied = len(self.trees)
+                return
             else:
                 if cache.raw_X is None:
                     cache.raw_X = jnp.asarray(self.dmat_host_dense(cache), jnp.float32)
-                delta = self._margin_delta_for(cache.raw_X, new)
-            pad = cache.margin.shape[0] - delta.shape[0]
-            if pad:
-                delta = jnp.concatenate(
-                    [delta, jnp.zeros((pad, delta.shape[1]), jnp.float32)], axis=0
-                )
-            cache.margin = cache.margin + delta
+                R_raw = cache.raw_X.shape[0]
+                m = self._margin_delta_for(cache.raw_X, new,
+                                           init=cache.margin[:R_raw])
+                if R_raw != cache.margin.shape[0]:
+                    m = jnp.concatenate([m, cache.margin[R_raw:]], axis=0)
+                cache.margin = m
             cache.n_trees_applied = len(self.trees)
 
     def dmat_host_dense(self, cache: _Cache) -> np.ndarray:
@@ -637,6 +660,25 @@ class Booster:
                 self.tree_weights.append(1.0)
         cache.margin = new_margin
         cache.n_trees_applied = len(self.trees)
+
+    def _margin_delta_binned_cache(self, cache: _Cache, tree_slice: slice,
+                                   init=None):
+        """Margin over the cache's resident binned page (page-padded layout,
+        rows align with cache.margin).  With ``init`` the result REPLACES the
+        margin (accumulated in training order — bitwise-faithful rebuild)."""
+        from .ops.predict import predict_margin_delta_binned
+
+        stacked, groups, depth = self._stacked(tree_slice)
+        Bw = cache.ellpack.cuts_pad.shape[1]
+        args = (cache.bins, stacked["feat"], stacked["sbin"],
+                stacked["dleft"], stacked["left"], stacked["right"],
+                stacked["value"], groups)
+        if stacked["catm"] is not None:
+            args += (stacked["is_cat"], stacked["catm"])
+        else:
+            args += (None, None)
+        return predict_margin_delta_binned(
+            *args, init, n_groups=self.n_groups, depth=depth, n_bin=Bw)
 
     def _predict_extmem(self, data, tree_slice: slice) -> np.ndarray:
         """Batched binned prediction over host pages (no raw data needed)."""
@@ -1502,36 +1544,36 @@ class Booster:
         stacked, groups, depth = self._stacked(slice(0, 0), tree_ids=tree_ids)
         return self._run_predict(X_dev, stacked, groups, depth)
 
-    def _run_predict(self, X_dev, stacked, groups, depth):
+    def _run_predict(self, X_dev, stacked, groups, depth, init=None):
         if "value_vec" in stacked:
             from .ops.predict import predict_margin_delta_multi
 
             return predict_margin_delta_multi(
                 X_dev, stacked["feat"], stacked["thr"], stacked["dleft"],
                 stacked["left"], stacked["right"], stacked["value_vec"],
-                depth=depth)
+                init, depth=depth)
         if stacked["catm"] is not None:
             return predict_margin_delta(
                 X_dev,
                 stacked["feat"], stacked["thr"], stacked["dleft"],
                 stacked["left"], stacked["right"], stacked["value"],
-                groups, stacked["is_cat"], stacked["catm"],
+                groups, stacked["is_cat"], stacked["catm"], init,
                 n_groups=self.n_groups, depth=depth,
             )
         return predict_margin_delta(
             X_dev,
             stacked["feat"], stacked["thr"], stacked["dleft"],
             stacked["left"], stacked["right"], stacked["value"],
-            groups, n_groups=self.n_groups, depth=depth,
+            groups, init=init, n_groups=self.n_groups, depth=depth,
         )
 
     # past this many dense f32 elements (256 MB) sparse inputs are predicted
     # in fixed-size row windows instead of one dense device matrix
     _PREDICT_BUFFER_ELEMS = 1 << 26
 
-    def _margin_delta_for(self, X_dev, tree_slice: slice):
+    def _margin_delta_for(self, X_dev, tree_slice: slice, init=None):
         stacked, groups, depth = self._stacked(tree_slice)
-        return self._run_predict(X_dev, stacked, groups, depth)
+        return self._run_predict(X_dev, stacked, groups, depth, init=init)
 
     def _use_streamed_predict(self, data: DMatrix) -> bool:
         """Sparse matrices whose dense form would not fit the predict buffer

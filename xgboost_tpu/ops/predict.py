@@ -47,12 +47,17 @@ def _traverse_one_tree(X, feat, thr, dleft, left, right, depth: int,
 
 @functools.partial(jax.jit, static_argnames=("n_groups", "depth"))
 def predict_margin_delta(X, feat, thr, dleft, left, right, value, groups,
-                         is_cat=None, catm=None, *, n_groups: int, depth: int):
+                         is_cat=None, catm=None, init=None, *,
+                         n_groups: int, depth: int):
     """Sum leaf values of a stack of trees into (R, n_groups) margin deltas.
 
     feat..value : (T, M) stacked padded tree arrays; groups: (T,) int32
     (tree_info group ids, reference src/gbm/gbtree_model.h).
     is_cat (T, M) / catm (T, M, Bc): optional categorical routing tables.
+    init: optional (R, n_groups) starting margin — accumulating INTO it
+    reproduces the training loop's exact f32 addition order, so rebuilt
+    prediction caches are bitwise-identical to incrementally-updated ones
+    (continuation via xgb_model= yields the same model as one straight run).
     """
     R = X.shape[0]
 
@@ -68,7 +73,8 @@ def predict_margin_delta(X, feat, thr, dleft, left, right, value, groups,
         margin = lax.dynamic_update_slice_in_dim(margin, col + delta[:, None], grp, axis=1)
         return margin, None
 
-    margin0 = jnp.zeros((R, n_groups), jnp.float32)
+    margin0 = (jnp.zeros((R, n_groups), jnp.float32) if init is None
+               else init.astype(jnp.float32))
     xs = ((feat, thr, dleft, left, right, value, groups) if is_cat is None
           else (feat, thr, dleft, left, right, value, groups, is_cat, catm))
     margin, _ = lax.scan(body, margin0, xs)
@@ -77,12 +83,13 @@ def predict_margin_delta(X, feat, thr, dleft, left, right, value, groups,
 
 @functools.partial(jax.jit, static_argnames=("depth",))
 def predict_margin_delta_multi(X, feat, thr, dleft, left, right, value_vec,
-                               *, depth: int):
+                               init=None, *, depth: int):
     """Vector-leaf ensemble margins: every tree adds its leaf's K-vector to
     all outputs (reference: MultiTargetTree prediction,
     cpu_predictor.cc PredictBatchByBlockKernel vector-leaf path).
 
-    value_vec: (T, M, K) padded per-node leaf vectors."""
+    value_vec: (T, M, K) padded per-node leaf vectors.  ``init``: optional
+    starting margin (see predict_margin_delta)."""
     R = X.shape[0]
     K = value_vec.shape[2]
 
@@ -91,7 +98,8 @@ def predict_margin_delta_multi(X, feat, thr, dleft, left, right, value_vec,
         nid = _traverse_one_tree(X, f, th, dl, l, r, depth)
         return margin + v[nid], None
 
-    margin0 = jnp.zeros((R, K), jnp.float32)
+    margin0 = (jnp.zeros((R, K), jnp.float32) if init is None
+               else init.astype(jnp.float32))
     margin, _ = lax.scan(body, margin0,
                          (feat, thr, dleft, left, right, value_vec))
     return margin
@@ -110,12 +118,14 @@ def predict_leaf_ids(X, feat, thr, dleft, left, right, *, depth: int):
 
 @functools.partial(jax.jit, static_argnames=("n_groups", "depth", "n_bin"))
 def predict_margin_delta_binned(bins, feat, sbin, dleft, left, right, value,
-                                groups, is_cat=None, catm=None, *,
+                                groups, is_cat=None, catm=None, init=None, *,
                                 n_groups: int, depth: int, n_bin: int):
     """Ensemble margins over a BINNED page (external-memory predict path).
 
     Routing uses stored split bins (RegTree.split_bins) so it reproduces the
-    training-time partition exactly; sentinel n_bin = missing.
+    training-time partition exactly; sentinel n_bin = missing.  ``init``:
+    optional starting margin (see predict_margin_delta — bitwise-faithful
+    prediction-cache rebuilds).
     """
     R = bins.shape[0]
 
@@ -153,7 +163,8 @@ def predict_margin_delta_binned(bins, feat, sbin, dleft, left, right, value,
         margin = lax.dynamic_update_slice_in_dim(margin, col + delta[:, None], grp, axis=1)
         return margin, None
 
-    margin0 = jnp.zeros((R, n_groups), jnp.float32)
+    margin0 = (jnp.zeros((R, n_groups), jnp.float32) if init is None
+               else init.astype(jnp.float32))
     xs = ((feat, sbin, dleft, left, right, value, groups) if is_cat is None
           else (feat, sbin, dleft, left, right, value, groups, is_cat, catm))
     margin, _ = lax.scan(body, margin0, xs)

@@ -1,16 +1,49 @@
-"""Tracker shim (reference: python-package/xgboost/tracker.py RabitTracker,
-src/collective/tracker.cc).
+"""Rendezvous tracker (reference: python-package/xgboost/tracker.py
+RabitTracker binding src/collective/tracker.cc).
 
-The reference tracker is a socket rendezvous server assigning (rank, world,
-ring neighbors).  Under JAX that role belongs to the jax.distributed
-coordinator, so this class only carries the coordinator address/port in the
-reference's env-var vocabulary — existing dask-style launch scripts keep
-working, with the coordinator service doing the actual bootstrap.
+A real socket rendezvous server, not a shim: workers connect without knowing
+their rank, the tracker assigns (rank, world) — sorted by host like the
+reference's ``sortby="host"`` — and hands every worker the jax.distributed
+coordinator address (the tracker allocates the port; rank 0 starts the
+coordinator service inside ``jax.distributed.initialize``).  The persistent
+tracker connection doubles as the ERROR CHANNEL: a worker reporting failure
+(``collective.signal_error``) makes the tracker fan an abort out to every
+other worker, whose watcher thread exits the process — the reference's
+fail-fast elastic path (tracker.cc:345 CMD::kError handling +
+comm.cc:340-376 detached error watcher calling std::exit).
+
+Wire format: 4-byte big-endian length + JSON object.
 """
 from __future__ import annotations
 
+import json
 import socket
-from typing import Dict, Union
+import struct
+import threading
+from typing import Dict, List, Optional, Union
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    payload = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict]:
+    """One length-prefixed JSON message; None on clean EOF."""
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack(">I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return json.loads(buf.decode())
 
 
 def get_host_ip(host_ip: str = "auto") -> str:
@@ -28,27 +61,112 @@ def get_host_ip(host_ip: str = "auto") -> str:
 
 
 class RabitTracker:
-    """Coordinator-address holder with the reference's surface
-    (tracker.py:17): worker_args(), start(), wait_for()."""
+    """Socket rendezvous + error fan-out (reference surface: tracker.py:17 —
+    start(), worker_args(), wait_for(), free())."""
 
     def __init__(self, n_workers: int, host_ip: str = "auto", port: int = 0,
                  sortby: str = "host", timeout: int = 0) -> None:
         self.n_workers = n_workers
         self.host_ip = get_host_ip(host_ip)
-        if port == 0:
-            with socket.socket() as s:
-                s.bind((self.host_ip, 0))
-                port = s.getsockname()[1]
-        self.port = port
-        self._started = False
+        self.sortby = sortby
+        self.timeout = timeout
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host_ip, port))
+        self.port = self._listener.getsockname()[1]
+        self._conns: List[socket.socket] = []
+        self._done = threading.Event()
+        self._error: Optional[str] = None
+        self._n_finished = 0
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
 
+    # ------------------------------------------------------------- serving
     def start(self) -> None:
-        # jax.distributed's coordinator is started lazily by process 0 inside
-        # jax.distributed.initialize; nothing to spawn here
-        self._started = True
+        self._listener.listen(self.n_workers)
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
 
+    def _serve(self) -> None:
+        pending = []  # (sort_key, arrival, conn)
+        arrival = 0
+        try:
+            while len(pending) < self.n_workers:
+                conn, _addr = self._listener.accept()
+                # a stray connection (port scan, health check) must neither
+                # consume a worker slot nor block the accept loop: bound the
+                # handshake and drop anything that is not a start message
+                conn.settimeout(30.0)
+                try:
+                    msg = recv_msg(conn)
+                except (OSError, ValueError):
+                    msg = None
+                if not msg or msg.get("cmd") != "start":
+                    conn.close()
+                    continue
+                conn.settimeout(None)
+                key = (str(msg.get("task_id", "")) if self.sortby == "task"
+                       else str(msg.get("host", "")))
+                pending.append((key, arrival, conn))
+                arrival += 1
+        except OSError:
+            return  # freed while accepting
+        pending.sort(key=lambda t: (t[0], t[1]))
+        self._conns = [c for (_k, _a, c) in pending]
+        # rank 0 hosts the jax.distributed coordinator (it must BIND the
+        # address, so the port cannot be allocated here on the tracker's
+        # machine — multi-host topologies put them on different hosts):
+        # two-phase bootstrap, rank 0 reports its coordinator address first
+        r0_conn = self._conns[0]
+        send_msg(r0_conn, {"rank": 0, "world": self.n_workers,
+                           "coordinator": None})
+        reply = recv_msg(r0_conn)
+        if not reply or reply.get("cmd") != "coordinator":
+            for c in self._conns:
+                c.close()
+            return
+        coordinator = str(reply["addr"])
+        for rank, conn in enumerate(self._conns[1:], start=1):
+            send_msg(conn, {"rank": rank, "world": self.n_workers,
+                            "coordinator": coordinator})
+        for rank, conn in enumerate(self._conns):
+            t = threading.Thread(target=self._watch_worker,
+                                 args=(conn, rank), daemon=True)
+            t.start()
+
+    def _watch_worker(self, conn: socket.socket, rank: int) -> None:
+        while True:
+            try:
+                msg = recv_msg(conn)
+            except OSError:
+                msg = None
+            if msg is None or msg.get("cmd") == "shutdown":
+                break
+            if msg.get("cmd") == "error":
+                # fan the failure out: every other worker aborts
+                # (tracker.cc:345; workers' watchers exit on receipt)
+                with self._lock:
+                    if self._error is None:
+                        self._error = (f"worker {rank}: "
+                                       f"{msg.get('msg', 'unknown error')}")
+                        for other in self._conns:
+                            if other is not conn:
+                                try:
+                                    send_msg(other, {"cmd": "abort",
+                                                     "msg": self._error})
+                                except OSError:
+                                    pass
+                self._done.set()
+                break
+        with self._lock:
+            self._n_finished += 1
+            if self._n_finished >= self.n_workers:
+                self._done.set()
+
+    # ------------------------------------------------------------- client API
     def worker_args(self) -> Dict[str, Union[str, int]]:
-        """Env passed to workers (consumed by collective.init)."""
+        """Env for workers (consumed by collective.init tracker mode: no
+        pre-assigned rank — the tracker hands one out)."""
         return {
             "dmlc_tracker_uri": self.host_ip,
             "dmlc_tracker_port": self.port,
@@ -56,7 +174,93 @@ class RabitTracker:
         }
 
     def wait_for(self, timeout: int = 0) -> None:
-        self._started = False
+        ok = self._done.wait(timeout or self.timeout or None)
+        if not ok:
+            raise TimeoutError("tracker wait_for timed out")
+        if self._error is not None:
+            raise RuntimeError(f"tracker: training failed — {self._error}")
 
     def free(self) -> None:
-        self._started = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._done.set()
+
+
+class TrackerClient:
+    """Worker-side tracker connection: rendezvous + background abort watcher
+    (the comm.cc:340-376 detached watcher thread role)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0,
+                 retries: int = 5, task_id: str = "") -> None:
+        import time
+
+        last = None
+        for attempt in range(max(retries, 1)):
+            try:
+                self._sock = socket.create_connection((host, int(port)),
+                                                      timeout=timeout)
+                break
+            except OSError as e:  # connect retry (comm.h:23 kRetry role);
+                last = e          # backoff so workers racing the tracker's
+                time.sleep(min(2.0 ** attempt, 10.0))  # start() can win
+        else:
+            raise ConnectionError(f"cannot reach tracker {host}:{port}: {last}")
+        self._sock.settimeout(None)
+        send_msg(self._sock, {"cmd": "start", "host": socket.gethostname(),
+                              "task_id": task_id})
+        reply = recv_msg(self._sock)
+        if not reply or "rank" not in reply:
+            raise ConnectionError("tracker rejected the start handshake")
+        self.rank = int(reply["rank"])
+        self.world = int(reply["world"])
+        if reply.get("coordinator") is None:
+            # rank 0: host the jax coordinator — allocate a port on THIS
+            # machine and report it back (bind-then-close is a small TOCTOU
+            # window; jax.distributed offers no way to hand over a bound
+            # socket, so the race is accepted and retried at a higher level)
+            my_ip = get_host_ip()
+            with socket.socket() as s:
+                s.bind((my_ip, 0))
+                self.coordinator = f"{my_ip}:{s.getsockname()[1]}"
+            send_msg(self._sock, {"cmd": "coordinator",
+                                  "addr": self.coordinator})
+        else:
+            self.coordinator = str(reply["coordinator"])
+        self._watcher = threading.Thread(target=self._watch, daemon=True)
+        self._watcher.start()
+
+    def _watch(self) -> None:
+        while True:
+            try:
+                msg = recv_msg(self._sock)
+            except OSError:
+                return
+            if msg is None:
+                return
+            if msg.get("cmd") == "abort":
+                import os
+                import sys
+
+                print(f"[rank {self.rank}] aborting: peer failure — "
+                      f"{msg.get('msg', '')}", file=sys.stderr, flush=True)
+                os._exit(255)  # reference: std::exit(-1) in the watcher
+
+    def signal_error(self, msg: str) -> None:
+        try:
+            send_msg(self._sock, {"cmd": "error", "msg": msg})
+        except OSError:
+            pass
+
+    def shutdown(self) -> None:
+        try:
+            send_msg(self._sock, {"cmd": "shutdown"})
+            self._sock.close()
+        except OSError:
+            pass
