@@ -92,3 +92,35 @@ def test_eval_during_continuation():
               verbose_eval=False, xgb_model=half)
     np.testing.assert_allclose(res["t"]["logloss"][-1],
                                res2["t"]["logloss"][-1], rtol=1e-5)
+
+
+def test_continuation_different_max_bin_no_stale_bins(tmp_path):
+    """Advisor (r2, high): in-memory continuation with a CHANGED max_bin must
+    not route the old trees' split_bins through the new cache's ellpack —
+    stale bins index different cuts and silently corrupt every gradient of
+    the continued training.  Ground truth: the reloaded-model continuation,
+    which carries no split_bins and always rebuilds via raw thresholds."""
+    X, y = _data(seed=11)
+    half = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 5, verbose_eval=False)
+    p = tmp_path / "half.json"
+    half.save_model(str(p))
+
+    p2 = dict(PARAMS, max_bin=16)
+    cont = xtb.train(p2, xtb.DMatrix(X, label=y), 3, verbose_eval=False,
+                     xgb_model=half)
+    cont_raw = xtb.train(p2, xtb.DMatrix(X, label=y), 3, verbose_eval=False,
+                         xgb_model=str(p))
+    _trees_equal(cont.trees, cont_raw.trees)
+
+
+def test_continuation_fresh_dmatrix_keeps_binned_route():
+    """Same data + same max_bin through a fresh DMatrix: the cuts objects
+    differ but their values are identical, so split_bins must REBIND onto the
+    new cuts (exact searchsorted) and keep the fast binned margin route."""
+    X, y = _data(seed=13)
+    half = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 5, verbose_eval=False)
+    d3 = xtb.DMatrix(X, label=y)
+    cont = xtb.train(PARAMS, d3, 1, verbose_eval=False, xgb_model=half)
+    ell = d3.ensure_ellpack(max_bin=PARAMS["max_bin"])
+    # the first 5 trees were rebound onto d3's cuts; the 6th was grown there
+    assert all(t.cuts_token == ell.cuts.token for t in cont.trees)

@@ -26,6 +26,12 @@ def test_rendezvous_protocol_assigns_sorted_ranks():
         s = socket.create_connection(("127.0.0.1", tr.port), timeout=30)
         send_msg(s, {"cmd": "start", "host": host_tag})
         reply = recv_msg(s)
+        if reply.get("coordinator") is None:
+            # two-phase bootstrap: rank 0 hosts the jax coordinator and must
+            # report its address before the tracker releases other ranks
+            assert reply["rank"] == 0
+            send_msg(s, {"cmd": "coordinator", "addr": "127.0.0.1:45678"})
+            reply = dict(reply, coordinator="127.0.0.1:45678")
         results[idx] = (host_tag, reply)
         send_msg(s, {"cmd": "shutdown"})
         s.close()
@@ -58,7 +64,11 @@ def test_wait_for_raises_on_worker_error():
     def ok_worker():
         s = socket.create_connection(("127.0.0.1", tr.port), timeout=30)
         send_msg(s, {"cmd": "start", "host": "a"})
-        recv_msg(s)
+        reply = recv_msg(s)
+        # host "a" sorts first -> rank 0 -> must complete the coordinator
+        # handshake or the tracker never releases rank 1
+        assert reply["rank"] == 0 and reply["coordinator"] is None
+        send_msg(s, {"cmd": "coordinator", "addr": "127.0.0.1:45678"})
         msg = recv_msg(s)  # blocks until the abort fan-out
         aborted["msg"] = msg
         s.close()

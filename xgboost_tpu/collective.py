@@ -137,6 +137,14 @@ class JaxDistributedBackend(CollBackend):
                 process_id=self._tracker.rank,
             )
             return
+        if args.get("dmlc_tracker_uri") or args.get("dmlc_tracker_port"):
+            # partially-specified rendezvous must fail loudly, not silently
+            # run single-process (a worker that meant to join a job and
+            # didn't would train on its shard alone and produce a wrong model)
+            raise ValueError(
+                "tracker rendezvous needs BOTH dmlc_tracker_uri and "
+                f"dmlc_tracker_port; got uri={args.get('dmlc_tracker_uri')!r} "
+                f"port={args.get('dmlc_tracker_port')!r}")
         # direct mode: the caller runs its own rendezvous and passes the
         # jax coordinator address + pre-assigned rank (launcher.py flow)
         coordinator = args.get("coordinator_address")

@@ -255,7 +255,12 @@ class Booster:
         self._configured = False
         if structural:
             self._caches.clear()
-            self._base_margin_value = None
+            # a TRAINED model's base score is model state, not configuration
+            # (learner.cc saves it with the model; continuation never
+            # re-estimates): clearing it here would silently rebuild every
+            # continued-training margin from base 0
+            if not self.trees and getattr(self, "linear_weights", None) is None:
+                self._base_margin_value = None
 
     def set_param(self, params, value=None) -> None:
         if isinstance(params, str):
@@ -368,7 +373,8 @@ class Booster:
             elif (cache.ellpack is not None and self._get_mesh() is None
                   and all(t.split_bins is not None
                           and t.leaf_vector is None
-                          for t in self.trees[new])):
+                          for t in self.trees[new])
+                  and self._try_rebind_split_bins(new, cache.ellpack.cuts)):
                 # binned pages already on device: route through them instead
                 # of materializing a second raw f32 copy (the reference's
                 # UpdatePredictionCache also reuses the training partition);
@@ -655,6 +661,7 @@ class Booster:
                 delta = leaf_margin_delta(state.pos, state.leaf_val)
                 new_margin = new_margin.at[:, k].add(delta)
                 tree = RegTree.from_grown(StreamingHistTreeGrower.to_host(state))
+                tree.cuts_token = d._cuts.token
                 self.trees.append(tree)
                 self.tree_info.append(k)
                 self.tree_weights.append(1.0)
@@ -706,13 +713,28 @@ class Booster:
             outs.append(np.asarray(m))  # PAGE-PADDED layout (padding rows kept)
         return np.concatenate(outs, axis=0)
 
-    def _ensure_split_bins(self, tree_slice: slice, data) -> None:
+    def _try_rebind_split_bins(self, tree_slice: slice, cuts) -> bool:
+        """Gate for the binned margin route: True iff every tree's split_bins
+        verifiably index THESE cuts.  Trees grown against a different cuts
+        object (continued training on a new DMatrix / changed max_bin) are
+        re-mapped exactly when possible; unmappable thresholds mean the cuts
+        genuinely differ and the caller must take the raw-threshold route."""
+        if all(t.cuts_token == cuts.token for t in self.trees[tree_slice]):
+            return True
+        try:
+            self._ensure_split_bins(tree_slice, cuts=cuts)
+        except ValueError:
+            return False
+        return True
+
+    def _ensure_split_bins(self, tree_slice: slice, data=None, *, cuts=None) -> None:
         """Reconstruct split_bins for loaded models (split_bins is internal and
         not serialized): thr == cuts[f][sbin] exactly, so sbin is recoverable
         by an exact searchsorted against this matrix's cuts."""
-        cuts = data._cuts
+        if cuts is None:
+            cuts = data._cuts
         for t in self.trees[tree_slice]:
-            if t.split_bins is not None:
+            if t.split_bins is not None and t.cuts_token == cuts.token:
                 continue
             n = t.n_nodes
             sbin = np.zeros(n, np.int32)
@@ -733,6 +755,7 @@ class Booster:
                     )
                 sbin[nid] = b
             t.split_bins = sbin
+            t.cuts_token = cuts.token
 
     def _rng(self, iteration: int, tag: int) -> np.random.Generator:
         seed = int(self.params.get("seed", 0))
@@ -1009,6 +1032,7 @@ class Booster:
             new_margin = new_margin + delta
             tree = RegTree.from_grown_multi(
                 MultiTargetTreeGrower.to_host(state), K)
+            tree.cuts_token = ell.cuts.token
             self.trees.append(tree)
             self.tree_info.append(0)
             self.tree_weights.append(1.0)
@@ -1259,6 +1283,7 @@ class Booster:
             return self._boost_multi_target(cache, gpair, iteration, K,
                                             grower, cat_mask_np)
         bins_use, cuts_use, nbins_use = cache.bins, ell.cuts_pad, ell.n_bins
+        cuts_token_use = ell.cuts.token
         if self.tree_method == "approx":
             # grow_histmaker (updater_approx.cc): fresh hessian-weighted
             # sketch every iteration, then the same hist machinery; cut
@@ -1287,6 +1312,10 @@ class Booster:
             bins_use = jnp.asarray(ell_iter.bins)
             cuts_use = jnp.asarray(cuts.padded(self.tparam.max_bin))
             nbins_use = jnp.asarray(cuts.n_bins_array())
+            # these trees' split_bins index the per-iteration sketch, NOT the
+            # resident ellpack: stamping the ellpack's token would falsely
+            # certify the binned cached-margin route
+            cuts_token_use = cuts.token
             if self._get_mesh() is not None:
                 from .parallel import shard_rows
 
@@ -1340,6 +1369,7 @@ class Booster:
                 new_margin = new_margin.at[:, k].add(delta)
                 if tree is None:
                     tree = RegTree.from_grown(HistTreeGrower.to_host(state))
+                tree.cuts_token = cuts_token_use
                 self.trees.append(tree)
                 self.tree_info.append(k)
                 self.tree_weights.append(1.0)

@@ -20,9 +20,18 @@ Cut semantics match the reference (hist_util.cc):
 from __future__ import annotations
 
 import dataclasses
+import secrets
 from typing import List, Optional, Sequence
 
 import numpy as np
+
+
+def _fresh_cuts_token() -> int:
+    """Collision-proof across processes: cuts_token survives Booster pickling,
+    so a process-local counter could falsely match an unpickled model's trees
+    against an unrelated DMatrix's cuts (each process's first cuts would share
+    token 1) and reuse stale split_bins."""
+    return secrets.randbits(63)
 
 
 @dataclasses.dataclass
@@ -37,6 +46,10 @@ class HistogramCuts:
     cut_ptrs: np.ndarray
     cut_values: np.ndarray
     min_vals: np.ndarray
+    # process-unique identity: trees grown against these cuts record the token
+    # so binned predict routes can verify their split_bins index THESE cuts
+    # (a Booster continued on a different DMatrix must not reuse stale bins)
+    token: int = dataclasses.field(default_factory=_fresh_cuts_token)
 
     @property
     def n_features(self) -> int:

@@ -35,6 +35,10 @@ class RegTree:
     # None for scalar trees.  Leaves' split_conditions are 0 when set.
     leaf_vector: Optional[np.ndarray] = None
     base_weight_vec: Optional[np.ndarray] = None
+    # identity of the HistogramCuts the split_bins index (not serialized);
+    # binned predict routes must verify it matches the resident page's cuts —
+    # continued training on a different DMatrix would otherwise mis-route
+    cuts_token: Optional[int] = None
 
     @property
     def n_nodes(self) -> int:

@@ -151,6 +151,7 @@ def prune_tree(tree: RegTree, *, gamma: float, eta: float,
         # so binned predict paths fail loudly instead of mis-routing
         split_bins=(tree.split_bins[order].astype(np.int32)
                     if tree.split_bins is not None else None),
+        cuts_token=tree.cuts_token,
         split_type=(tree.split_type[order].astype(np.int32)
                     if tree.split_type is not None else np.zeros(m, np.int32)),
         categories={remap[k]: v for k, v in (tree.categories or {}).items()

@@ -45,12 +45,14 @@ def _weight(G, H, lambda_: float, alpha: float, max_delta_step: float):
 
 def _score(G, H, lambda_: float, alpha: float, max_delta_step: float = 0.0):
     """param.h CalcGain: closed form when the weight is unclipped, else
-    CalcGainGivenWeight at the clipped optimum."""
+    CalcGainGivenWeight (param.h:245 — RAW grad, alpha enters as -2a|w|)
+    at the clipped optimum; the two agree when the clip is inactive."""
     t = _thr_l1(G, alpha)
     if max_delta_step == 0.0:
         return t * t / (H + lambda_)
     w = _weight(G, H, lambda_, alpha, max_delta_step)
-    return -(2.0 * t * w + (H + lambda_) * w * w)
+    return -(2.0 * G * w + (H + lambda_) * w * w
+             + 2.0 * alpha * np.abs(w))
 
 
 def grow_exact(
@@ -66,7 +68,7 @@ def grow_exact(
     max_delta_step: float = 0.0,
     eta: float = 0.3,
     feature_masks: Optional[Callable] = None,
-    min_split_loss_eps: float = 1e-10,
+    min_split_loss_eps: float = 1e-6,  # colmaker kRtEps acceptance gate
     col_order: Optional[np.ndarray] = None,
 ) -> Tuple["RegTree", np.ndarray]:
     """Grow one tree depth-wise with exact split enumeration.
@@ -259,7 +261,11 @@ def grow_exact(
             if s < 0 or best_feat[s] < 0:
                 continue
             f = int(best_feat[s])
-            thr_v = float(best_thr[s])
+            # route AND store in f32: the reference computes the midpoint in
+            # f32 ((fvalue+last)*0.5f); routing with the f64 midpoint while
+            # storing f32 could send boundary rows left at train time but
+            # right at predict time
+            thr_v = float(np.float32(best_thr[s]))
             dleft = bool(best_dleft[s])
             l_id, r_id = len(left), len(left) + 1
             for arrs, vals in ((left, (-1, -1)), (right, (-1, -1)),
