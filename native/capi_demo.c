@@ -6,6 +6,8 @@
  */
 #include <stdint.h>
 #include <stdio.h>
+#include <string.h>
+#include <stdint.h>
 #include <stdlib.h>
 
 typedef void* DMatrixHandle;
@@ -30,6 +32,16 @@ extern int XGBoosterPredict(BoosterHandle, DMatrixHandle, int, unsigned, int,
 extern int XGBoosterSaveModel(BoosterHandle, const char*);
 extern int XGBoosterLoadModel(BoosterHandle, const char*);
 extern int XGBoosterFree(BoosterHandle);
+extern int XGBoosterSaveJsonConfig(BoosterHandle, bst_ulong*, const char**);
+extern int XGBoosterSerializeToBuffer(BoosterHandle, bst_ulong*,
+                                      const char**);
+extern int XGBoosterUnserializeFromBuffer(BoosterHandle, const void*,
+                                          bst_ulong);
+extern int XGBoosterPredictFromDense(BoosterHandle, const char*, const char*,
+                                     DMatrixHandle, const bst_ulong**,
+                                     bst_ulong*, const float**);
+extern int XGBoosterDumpModelEx(BoosterHandle, const char*, int, const char*,
+                                bst_ulong*, const char***);
 
 #define CHECK(call)                                                   \
   do {                                                                \
@@ -98,9 +110,57 @@ int main(void) {
   for (bst_ulong i = 0; ok && i < len; ++i) ok = preds[i] == preds2[i];
   printf("save/load predictions identical: %s\n", ok ? "yes" : "NO");
 
+  /* round-3 surface: config IO, serialization, inplace predict, dump */
+  bst_ulong clen = 0;
+  const char* cstr = NULL;
+  CHECK(XGBoosterSaveJsonConfig(booster, &clen, &cstr));
+  int has_obj = strstr(cstr, "binary:logistic") != NULL;
+  printf("json config carries objective: %s\n", has_obj ? "yes" : "NO");
+
+  bst_ulong blen = 0;
+  const char* blob = NULL;
+  CHECK(XGBoosterSerializeToBuffer(booster, &blen, &blob));
+  BoosterHandle restored;
+  CHECK(XGBoosterCreate(NULL, 0, &restored));
+  CHECK(XGBoosterUnserializeFromBuffer(restored, blob, blen));
+  bst_ulong len3 = 0;
+  const float* preds3 = NULL;
+  CHECK(XGBoosterPredict(restored, dtrain, 0, 0, 0, &len3, &preds3));
+  int ok2 = len == len3;
+  for (bst_ulong i = 0; ok2 && i < len; ++i) ok2 = preds[i] == preds3[i];
+  printf("serialize/unserialize predictions identical: %s\n",
+         ok2 ? "yes" : "NO");
+  CHECK(XGBoosterFree(restored));
+
+  /* preds points at the handle's pinned buffer; the next predict on the
+   * same handle invalidates it (reference thread-local entry semantics) */
+  static float preds_copy[R];
+  for (bst_ulong i = 0; i < len; ++i) preds_copy[i] = preds[i];
+
+  char aif[256];
+  snprintf(aif, sizeof(aif),
+           "{\"data\": [%llu, true], \"shape\": [%d, %d], "
+           "\"typestr\": \"<f4\", \"version\": 3}",
+           (unsigned long long)(uintptr_t)data, R, F);
+  bst_ulong const* pshape = NULL;
+  bst_ulong pdim = 0;
+  const float* ppreds = NULL;
+  CHECK(XGBoosterPredictFromDense(booster, aif, "{\"type\": 0}", NULL,
+                                  &pshape, &pdim, &ppreds));
+  int ok3 = pdim == 1 && pshape[0] == (bst_ulong)R;
+  for (bst_ulong i = 0; ok3 && i < len; ++i) ok3 = preds_copy[i] == ppreds[i];
+  printf("inplace dense predict identical: %s\n", ok3 ? "yes" : "NO");
+
+  bst_ulong ndump = 0;
+  const char** dumps = NULL;
+  CHECK(XGBoosterDumpModelEx(booster, "", 1, "json", &ndump, &dumps));
+  printf("dumped %llu trees, tree0 starts: %.20s\n",
+         (unsigned long long)ndump, dumps[0]);
+
   CHECK(XGBoosterFree(booster));
   CHECK(XGBoosterFree(loaded));
   CHECK(XGDMatrixFree(dtrain));
+  if (!(ok && ok2 && ok3)) return 1;
   printf("C API DEMO OK\n");
-  return ok ? 0 : 1;
+  return 0;
 }

@@ -12,6 +12,8 @@
 
 #include <Python.h>
 
+#include <dlfcn.h>
+
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -72,9 +74,42 @@ void CaptureError() {
   Py_XDECREF(tb);
 }
 
+// Directory that holds the xgboost_tpu package: the parent of the directory
+// containing this shared object (native/ lives inside the repo root).
+std::string PackageRoot() {
+  Dl_info info;
+  if (dladdr(reinterpret_cast<void*>(&PackageRoot), &info) == 0 ||
+      info.dli_fname == nullptr) {
+    return "";
+  }
+  std::string p(info.dli_fname);
+  auto slash = p.rfind('/');
+  if (slash == std::string::npos) return "";
+  p.erase(slash);  // strip libxtb_capi.so -> .../native
+  slash = p.rfind('/');
+  if (slash == std::string::npos) return "";
+  p.erase(slash);  // strip native -> repo root
+  return p;
+}
+
 PyObject* Glue() {
   if (g_glue == nullptr) {
     g_glue = PyImport_ImportModule("xgboost_tpu.capi_glue");
+    if (g_glue == nullptr) {
+      // Embedded interpreters launched from an arbitrary cwd won't have the
+      // package on sys.path; locate it relative to this shared object.
+      std::string root = PackageRoot();
+      if (!root.empty()) {
+        PyErr_Clear();
+        PyObject* sys_path = PySys_GetObject("path");  // borrowed
+        PyObject* dir = PyUnicode_FromString(root.c_str());
+        if (sys_path != nullptr && dir != nullptr) {
+          PyList_Append(sys_path, dir);
+        }
+        Py_XDECREF(dir);
+        g_glue = PyImport_ImportModule("xgboost_tpu.capi_glue");
+      }
+    }
   }
   return g_glue;  // nullptr with a pending Python error on failure
 }
@@ -457,6 +492,931 @@ XTB_DLL int XGBoosterGetNumFeature(BoosterHandle handle, bst_ulong* out) {
   PyObject* r = CallGlue("booster_num_features", "(O)", (PyObject*)handle);
   FAIL_IF_NULL(r);
   *out = (bst_ulong)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+// ====================================================================
+// Round-3 surface expansion (reference c_api.h): array-interface
+// ingestion, inplace predict, DataIter callbacks, dump/slice/feature
+// info, config IO, global config, collective + tracker C API.
+
+namespace {
+
+// glue returned (len, addr-of-char**) — unpack into the caller's out params
+int StrArrayResult(PyObject* r, bst_ulong* out_len, const char*** out) {
+  unsigned long long n = 0, addr = 0;
+  if (!PyArg_ParseTuple(r, "KK", &n, &addr)) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  Py_DECREF(r);
+  *out_len = (bst_ulong)n;
+  *out = (const char**)(uintptr_t)addr;
+  return 0;
+}
+
+// glue returned (len, addr) of a pinned numeric buffer
+template <typename T>
+int ArrayResult(PyObject* r, bst_ulong* out_len, T const** out) {
+  unsigned long long n = 0, addr = 0;
+  if (!PyArg_ParseTuple(r, "KK", &n, &addr)) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  Py_DECREF(r);
+  *out_len = (bst_ulong)n;
+  *out = (T const*)(uintptr_t)addr;
+  return 0;
+}
+
+// glue returned (shape_addr, dim, result_addr) for a prediction
+int PredictResult(PyObject* r, bst_ulong const** out_shape, bst_ulong* out_dim,
+                  float const** out_result) {
+  unsigned long long shape_addr = 0, dim = 0, res_addr = 0;
+  if (!PyArg_ParseTuple(r, "KKK", &shape_addr, &dim, &res_addr)) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  Py_DECREF(r);
+  *out_shape = (bst_ulong const*)(uintptr_t)shape_addr;
+  *out_dim = (bst_ulong)dim;
+  *out_result = (float const*)(uintptr_t)res_addr;
+  return 0;
+}
+
+// build a Python list[str] from char** (nullptr-safe)
+PyObject* StrList(const char** strs, bst_ulong n) {
+  PyObject* l = PyList_New((Py_ssize_t)n);
+  if (l == nullptr) return nullptr;
+  for (bst_ulong i = 0; i < n; ++i) {
+    PyObject* s = PyUnicode_FromString(strs[i] ? strs[i] : "");
+    if (s == nullptr) {
+      Py_DECREF(l);
+      return nullptr;
+    }
+    PyList_SET_ITEM(l, (Py_ssize_t)i, s);
+  }
+  return l;
+}
+
+}  // namespace
+
+XTB_DLL int XGBuildInfo(char const** out) {
+  API_BEGIN();
+  PyObject* r = CallGlue("build_info", "()");
+  FAIL_IF_NULL(r);
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &n) != 0) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  *out = buf;  // pinned module-globally by the glue
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBSetGlobalConfig(char const* config) {
+  API_BEGIN();
+  PyObject* r = CallGlue("set_global_config", "(s)", config);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBGetGlobalConfig(char const** out_config) {
+  API_BEGIN();
+  PyObject* r = CallGlue("get_global_config", "()");
+  FAIL_IF_NULL(r);
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &n) != 0) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  *out_config = buf;
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+// log callback: stored for ABI completeness; Python-side logging writes to
+// stderr directly (the reference registers it into its ConsoleLogger)
+namespace {
+void (*g_log_callback)(const char*) = nullptr;
+}
+XTB_DLL int XGBRegisterLogCallback(void (*callback)(const char*)) {
+  g_log_callback = callback;
+  return 0;
+}
+
+// ---------------------------------------------------------------- DMatrix
+XTB_DLL int XGDMatrixCreateFromDense(char const* data, char const* config,
+                                     DMatrixHandle* out) {
+  API_BEGIN();
+  PyObject* d = CallGlue("dmatrix_from_dense", "(ss)", data, config);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixCreateFromCSR(char const* indptr, char const* indices,
+                                   char const* data, bst_ulong ncol,
+                                   char const* config, DMatrixHandle* out) {
+  API_BEGIN();
+  PyObject* d = CallGlue("dmatrix_from_csr_ai", "(sssKs)", indptr, indices,
+                         data, (unsigned long long)ncol, config);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixCreateFromMat_omp(const float* data, bst_ulong nrow,
+                                       bst_ulong ncol, float missing,
+                                       DMatrixHandle* out, int) {
+  return XGDMatrixCreateFromMat(data, nrow, ncol, missing, out);
+}
+
+XTB_DLL int XGDMatrixCreateFromURI(char const* config, DMatrixHandle* out) {
+  API_BEGIN();
+  PyObject* d = CallGlue("dmatrix_from_uri", "(s)", config);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixSliceDMatrixEx(DMatrixHandle handle, const int* idxset,
+                                    bst_ulong len, DMatrixHandle* out,
+                                    int allow_groups) {
+  API_BEGIN();
+  PyObject* d = CallGlue("dmatrix_slice", "(OKKi)", (PyObject*)handle,
+                         (unsigned long long)(uintptr_t)idxset,
+                         (unsigned long long)len, allow_groups);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixSliceDMatrix(DMatrixHandle handle, const int* idxset,
+                                  bst_ulong len, DMatrixHandle* out) {
+  return XGDMatrixSliceDMatrixEx(handle, idxset, len, out, 0);
+}
+
+XTB_DLL int XGDMatrixSaveBinary(DMatrixHandle handle, const char* fname,
+                                int silent) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_save_binary", "(Osi)", (PyObject*)handle,
+                         fname, silent);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixSetStrFeatureInfo(DMatrixHandle handle, const char* field,
+                                       const char** features,
+                                       const bst_ulong size) {
+  API_BEGIN();
+  PyObject* l = StrList(features, size);
+  FAIL_IF_NULL(l);
+  PyObject* r = CallGlue("dmatrix_set_str_feature_info", "(OsO)",
+                         (PyObject*)handle, field, l);
+  Py_DECREF(l);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixGetStrFeatureInfo(DMatrixHandle handle, const char* field,
+                                       bst_ulong* size,
+                                       const char*** out_features) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_get_str_feature_info", "(Os)",
+                         (PyObject*)handle, field);
+  FAIL_IF_NULL(r);
+  return StrArrayResult(r, size, out_features);
+  API_END();
+}
+
+XTB_DLL int XGDMatrixGetFloatInfo(const DMatrixHandle handle,
+                                  const char* field, bst_ulong* out_len,
+                                  const float** out_dptr) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_get_float_info", "(Os)", (PyObject*)handle,
+                         field);
+  FAIL_IF_NULL(r);
+  return ArrayResult<float>(r, out_len, out_dptr);
+  API_END();
+}
+
+XTB_DLL int XGDMatrixGetUIntInfo(const DMatrixHandle handle, const char* field,
+                                 bst_ulong* out_len,
+                                 const unsigned** out_dptr) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_get_uint_info", "(Os)", (PyObject*)handle,
+                         field);
+  FAIL_IF_NULL(r);
+  return ArrayResult<unsigned>(r, out_len, out_dptr);
+  API_END();
+}
+
+XTB_DLL int XGDMatrixNumNonMissing(DMatrixHandle handle, bst_ulong* out) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_num_nonmissing", "(O)", (PyObject*)handle);
+  FAIL_IF_NULL(r);
+  *out = (bst_ulong)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixDataSplitMode(DMatrixHandle handle, bst_ulong* out) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_data_split_mode", "(O)", (PyObject*)handle);
+  FAIL_IF_NULL(r);
+  *out = (bst_ulong)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixGetDataAsCSR(DMatrixHandle const handle,
+                                  char const* config, bst_ulong* out_indptr,
+                                  unsigned* out_indices, float* out_data) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_get_data_as_csr", "(Os)", (PyObject*)handle,
+                         config);
+  FAIL_IF_NULL(r);
+  unsigned long long ip = 0, ix = 0, va = 0, n_indptr = 0, nnz = 0;
+  if (!PyArg_ParseTuple(r, "KKKKK", &ip, &ix, &va, &n_indptr, &nnz)) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  Py_DECREF(r);
+  std::memcpy(out_indptr, (void*)(uintptr_t)ip, n_indptr * sizeof(bst_ulong));
+  std::memcpy(out_indices, (void*)(uintptr_t)ix, nnz * sizeof(unsigned));
+  std::memcpy(out_data, (void*)(uintptr_t)va, nnz * sizeof(float));
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixGetQuantileCut(DMatrixHandle const handle,
+                                    char const* config,
+                                    char const** out_indptr,
+                                    char const** out_data) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_get_quantile_cut", "(Os)", (PyObject*)handle,
+                         config);
+  FAIL_IF_NULL(r);
+  PyObject *ip = nullptr, *va = nullptr;
+  if (!PyArg_ParseTuple(r, "OO", &ip, &va)) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  char *ipb = nullptr, *vab = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(ip, &ipb, &n) != 0 ||
+      PyBytes_AsStringAndSize(va, &vab, &n) != 0) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  *out_indptr = ipb;  // pinned on the DMatrix by the glue
+  *out_data = vab;
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+// -------------------------------------------- proxy + iterator callbacks
+typedef void* DataIterHandle;
+typedef int XGDMatrixCallbackNext(DataIterHandle iter);
+typedef void DataIterResetCallback(DataIterHandle handle);
+
+XTB_DLL int XGProxyDMatrixCreate(DMatrixHandle* out) {
+  API_BEGIN();
+  PyObject* p = CallGlue("proxy_create", "()");
+  FAIL_IF_NULL(p);
+  *out = p;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGProxyDMatrixSetDataDense(DMatrixHandle handle,
+                                       char const* data) {
+  API_BEGIN();
+  PyObject* r = CallGlue("proxy_set_dense", "(Os)", (PyObject*)handle, data);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGProxyDMatrixSetDataCSR(DMatrixHandle handle, char const* indptr,
+                                     char const* indices, char const* data,
+                                     bst_ulong ncol) {
+  API_BEGIN();
+  PyObject* r = CallGlue("proxy_set_csr", "(OsssK)", (PyObject*)handle, indptr,
+                         indices, data, (unsigned long long)ncol);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixCreateFromCallback(DataIterHandle iter,
+                                        DMatrixHandle proxy,
+                                        DataIterResetCallback* reset,
+                                        XGDMatrixCallbackNext* next,
+                                        char const* config,
+                                        DMatrixHandle* out) {
+  API_BEGIN();
+  PyObject* d = CallGlue("dmatrix_from_callback", "(KOKKs)",
+                         (unsigned long long)(uintptr_t)iter, (PyObject*)proxy,
+                         (unsigned long long)(uintptr_t)reset,
+                         (unsigned long long)(uintptr_t)next, config);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGQuantileDMatrixCreateFromCallback(
+    DataIterHandle iter, DMatrixHandle proxy, DataIterHandle ref,
+    DataIterResetCallback* reset, XGDMatrixCallbackNext* next,
+    char const* config, DMatrixHandle* out) {
+  API_BEGIN();
+  PyObject* refobj = ref ? (PyObject*)ref : Py_None;
+  PyObject* d = CallGlue("quantile_dmatrix_from_callback", "(KOOKKs)",
+                         (unsigned long long)(uintptr_t)iter, (PyObject*)proxy,
+                         refobj, (unsigned long long)(uintptr_t)reset,
+                         (unsigned long long)(uintptr_t)next, config);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGExtMemQuantileDMatrixCreateFromCallback(
+    DataIterHandle iter, DMatrixHandle proxy, DataIterHandle ref,
+    DataIterResetCallback* reset, XGDMatrixCallbackNext* next,
+    char const* config, DMatrixHandle* out) {
+  API_BEGIN();
+  PyObject* refobj = ref ? (PyObject*)ref : Py_None;
+  PyObject* d = CallGlue("extmem_quantile_dmatrix_from_callback", "(KOOKKs)",
+                         (unsigned long long)(uintptr_t)iter, (PyObject*)proxy,
+                         refobj, (unsigned long long)(uintptr_t)reset,
+                         (unsigned long long)(uintptr_t)next, config);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
+}
+
+// ---------------------------------------------------------------- Booster
+XTB_DLL int XGBoosterReset(BoosterHandle handle) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_reset", "(O)", (PyObject*)handle);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterSlice(BoosterHandle handle, int begin_layer,
+                           int end_layer, int step, BoosterHandle* out) {
+  API_BEGIN();
+  PyObject* b = CallGlue("booster_slice", "(Oiii)", (PyObject*)handle,
+                         begin_layer, end_layer, step);
+  FAIL_IF_NULL(b);
+  *out = b;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterTrainOneIter(BoosterHandle handle, DMatrixHandle dtrain,
+                                  int iter, char const* grad,
+                                  char const* hess) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_train_one_iter", "(OOiss)",
+                         (PyObject*)handle, (PyObject*)dtrain, iter, grad,
+                         hess);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterPredictFromDMatrix(BoosterHandle handle,
+                                        DMatrixHandle dmat,
+                                        char const* config,
+                                        bst_ulong const** out_shape,
+                                        bst_ulong* out_dim,
+                                        float const** out_result) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_predict_from_dmatrix", "(OOs)",
+                         (PyObject*)handle, (PyObject*)dmat, config);
+  FAIL_IF_NULL(r);
+  return PredictResult(r, out_shape, out_dim, out_result);
+  API_END();
+}
+
+XTB_DLL int XGBoosterPredictFromDense(BoosterHandle handle,
+                                      char const* values, char const* config,
+                                      DMatrixHandle m,
+                                      bst_ulong const** out_shape,
+                                      bst_ulong* out_dim,
+                                      const float** out_result) {
+  API_BEGIN();
+  PyObject* meta = m ? (PyObject*)m : Py_None;
+  PyObject* r = CallGlue("booster_inplace_predict_dense", "(OssO)",
+                         (PyObject*)handle, values, config, meta);
+  FAIL_IF_NULL(r);
+  return PredictResult(r, out_shape, out_dim, out_result);
+  API_END();
+}
+
+XTB_DLL int XGBoosterPredictFromCSR(BoosterHandle handle, char const* indptr,
+                                    char const* indices, char const* values,
+                                    bst_ulong ncol, char const* config,
+                                    DMatrixHandle m,
+                                    bst_ulong const** out_shape,
+                                    bst_ulong* out_dim,
+                                    const float** out_result) {
+  API_BEGIN();
+  PyObject* meta = m ? (PyObject*)m : Py_None;
+  PyObject* r = CallGlue("booster_inplace_predict_csr", "(OsssKsO)",
+                         (PyObject*)handle, indptr, indices, values,
+                         (unsigned long long)ncol, config, meta);
+  FAIL_IF_NULL(r);
+  return PredictResult(r, out_shape, out_dim, out_result);
+  API_END();
+}
+
+XTB_DLL int XGBoosterSerializeToBuffer(BoosterHandle handle,
+                                       bst_ulong* out_len,
+                                       const char** out_dptr) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_serialize", "(O)", (PyObject*)handle);
+  FAIL_IF_NULL(r);
+  unsigned long long n = 0;
+  PyObject* bytes_obj = nullptr;
+  if (!PyArg_ParseTuple(r, "KO", &n, &bytes_obj)) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t bn = 0;
+  if (PyBytes_AsStringAndSize(bytes_obj, &buf, &bn) != 0) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  *out_len = (bst_ulong)n;
+  *out_dptr = buf;
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterUnserializeFromBuffer(BoosterHandle handle,
+                                           const void* buf, bst_ulong len) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_unserialize", "(OKK)", (PyObject*)handle,
+                         (unsigned long long)(uintptr_t)buf,
+                         (unsigned long long)len);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterSaveJsonConfig(BoosterHandle handle, bst_ulong* out_len,
+                                    char const** out_str) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_save_json_config", "(O)", (PyObject*)handle);
+  FAIL_IF_NULL(r);
+  unsigned long long n = 0;
+  PyObject* bytes_obj = nullptr;
+  if (!PyArg_ParseTuple(r, "KO", &n, &bytes_obj)) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t bn = 0;
+  if (PyBytes_AsStringAndSize(bytes_obj, &buf, &bn) != 0) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  *out_len = (bst_ulong)n;
+  *out_str = buf;
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterLoadJsonConfig(BoosterHandle handle,
+                                    char const* config) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_load_json_config", "(Os)", (PyObject*)handle,
+                         config);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterDumpModelEx(BoosterHandle handle, const char* fmap,
+                                 int with_stats, const char* format,
+                                 bst_ulong* out_len,
+                                 const char*** out_dump_array) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_dump_model", "(Osis)", (PyObject*)handle,
+                         fmap ? fmap : "", with_stats,
+                         format ? format : "text");
+  FAIL_IF_NULL(r);
+  return StrArrayResult(r, out_len, out_dump_array);
+  API_END();
+}
+
+XTB_DLL int XGBoosterDumpModel(BoosterHandle handle, const char* fmap,
+                               int with_stats, bst_ulong* out_len,
+                               const char*** out_dump_array) {
+  return XGBoosterDumpModelEx(handle, fmap, with_stats, "text", out_len,
+                              out_dump_array);
+}
+
+XTB_DLL int XGBoosterDumpModelExWithFeatures(
+    BoosterHandle handle, int fnum, const char** fname, const char** ftype,
+    int with_stats, const char* format, bst_ulong* out_len,
+    const char*** out_models) {
+  API_BEGIN();
+  PyObject* names = StrList(fname, (bst_ulong)fnum);
+  FAIL_IF_NULL(names);
+  PyObject* types = StrList(ftype, (bst_ulong)fnum);
+  if (types == nullptr) {
+    Py_DECREF(names);
+    CaptureError();
+    return -1;
+  }
+  PyObject* r = CallGlue("booster_dump_model", "(OsisOO)", (PyObject*)handle,
+                         "", with_stats, format ? format : "text", names,
+                         types);
+  Py_DECREF(names);
+  Py_DECREF(types);
+  FAIL_IF_NULL(r);
+  return StrArrayResult(r, out_len, out_models);
+  API_END();
+}
+
+XTB_DLL int XGBoosterDumpModelWithFeatures(BoosterHandle handle, int fnum,
+                                           const char** fname,
+                                           const char** ftype, int with_stats,
+                                           bst_ulong* out_len,
+                                           const char*** out_models) {
+  return XGBoosterDumpModelExWithFeatures(handle, fnum, fname, ftype,
+                                          with_stats, "text", out_len,
+                                          out_models);
+}
+
+XTB_DLL int XGBoosterGetAttrNames(BoosterHandle handle, bst_ulong* out_len,
+                                  const char*** out) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_get_attr_names", "(O)", (PyObject*)handle);
+  FAIL_IF_NULL(r);
+  return StrArrayResult(r, out_len, out);
+  API_END();
+}
+
+XTB_DLL int XGBoosterSetStrFeatureInfo(BoosterHandle handle,
+                                       const char* field,
+                                       const char** features,
+                                       const bst_ulong size) {
+  API_BEGIN();
+  PyObject* l = StrList(features, size);
+  FAIL_IF_NULL(l);
+  PyObject* r = CallGlue("booster_set_str_feature_info", "(OsO)",
+                         (PyObject*)handle, field, l);
+  Py_DECREF(l);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterGetStrFeatureInfo(BoosterHandle handle,
+                                       const char* field, bst_ulong* len,
+                                       const char*** out_features) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_get_str_feature_info", "(Os)",
+                         (PyObject*)handle, field);
+  FAIL_IF_NULL(r);
+  return StrArrayResult(r, len, out_features);
+  API_END();
+}
+
+XTB_DLL int XGBoosterFeatureScore(BoosterHandle handle, const char* config,
+                                  bst_ulong* out_n_features,
+                                  char const*** out_features,
+                                  bst_ulong* out_dim,
+                                  bst_ulong const** out_shape,
+                                  float const** out_scores) {
+  API_BEGIN();
+  PyObject* r = CallGlue("booster_feature_score", "(Os)", (PyObject*)handle,
+                         config);
+  FAIL_IF_NULL(r);
+  unsigned long long n = 0, feat_addr = 0, shape_addr = 0, dim = 0,
+                     score_addr = 0;
+  if (!PyArg_ParseTuple(r, "KKKKK", &n, &feat_addr, &shape_addr, &dim,
+                        &score_addr)) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  Py_DECREF(r);
+  *out_n_features = (bst_ulong)n;
+  *out_features = (char const**)(uintptr_t)feat_addr;
+  *out_dim = (bst_ulong)dim;
+  *out_shape = (bst_ulong const*)(uintptr_t)shape_addr;
+  *out_scores = (float const*)(uintptr_t)score_addr;
+  return 0;
+  API_END();
+}
+
+// ------------------------------------------------- collective + tracker
+typedef void* TrackerHandle;
+
+XTB_DLL int XGTrackerCreate(char const* config, TrackerHandle* handle) {
+  API_BEGIN();
+  PyObject* t = CallGlue("tracker_create", "(s)", config);
+  FAIL_IF_NULL(t);
+  *handle = t;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGTrackerWorkerArgs(TrackerHandle handle, char const** args) {
+  API_BEGIN();
+  PyObject* r = CallGlue("tracker_worker_args", "(O)", (PyObject*)handle);
+  FAIL_IF_NULL(r);
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &n) != 0) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  *args = buf;
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGTrackerRun(TrackerHandle handle, char const* config) {
+  API_BEGIN();
+  PyObject* r = CallGlue("tracker_run", "(Os)", (PyObject*)handle,
+                         config ? config : "{}");
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGTrackerWaitFor(TrackerHandle handle, char const* config) {
+  API_BEGIN();
+  PyObject* r = CallGlue("tracker_wait_for", "(Os)", (PyObject*)handle,
+                         config ? config : "{}");
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGTrackerFree(TrackerHandle handle) {
+  API_BEGIN();
+  PyObject* r = CallGlue("tracker_free", "(O)", (PyObject*)handle);
+  FAIL_IF_NULL(r);  // handle stays alive on failure so a retry is safe
+  Py_XDECREF((PyObject*)handle);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGCommunicatorInit(char const* config) {
+  API_BEGIN();
+  PyObject* r = CallGlue("communicator_init", "(s)", config ? config : "{}");
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGCommunicatorFinalize(void) {
+  API_BEGIN();
+  PyObject* r = CallGlue("communicator_finalize", "()");
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGCommunicatorGetRank(void) {
+  InitPython();
+  Gil gil;
+  PyObject* r = CallGlue("communicator_get_rank", "()");
+  if (r == nullptr) {
+    CaptureError();
+    return 0;
+  }
+  int rank = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return rank;
+}
+
+XTB_DLL int XGCommunicatorGetWorldSize(void) {
+  InitPython();
+  Gil gil;
+  PyObject* r = CallGlue("communicator_get_world_size", "()");
+  if (r == nullptr) {
+    CaptureError();
+    return 1;
+  }
+  int n = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return n;
+}
+
+XTB_DLL int XGCommunicatorIsDistributed(void) {
+  InitPython();
+  Gil gil;
+  PyObject* r = CallGlue("communicator_is_distributed", "()");
+  if (r == nullptr) {
+    CaptureError();
+    return 0;
+  }
+  int v = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return v;
+}
+
+XTB_DLL int XGCommunicatorPrint(char const* message) {
+  API_BEGIN();
+  PyObject* r = CallGlue("communicator_print", "(s)", message);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGCommunicatorGetProcessorName(const char** name_str) {
+  API_BEGIN();
+  PyObject* r = CallGlue("communicator_get_processor_name", "()");
+  FAIL_IF_NULL(r);
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &n) != 0) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  *name_str = buf;
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGCommunicatorBroadcast(void* send_receive_buffer, size_t size,
+                                    int root) {
+  API_BEGIN();
+  PyObject* r = CallGlue("communicator_broadcast", "(KKi)",
+                         (unsigned long long)(uintptr_t)send_receive_buffer,
+                         (unsigned long long)size, root);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGCommunicatorAllreduce(void* send_receive_buffer, size_t count,
+                                    int data_type, int op) {
+  API_BEGIN();
+  PyObject* r = CallGlue("communicator_allreduce", "(KKii)",
+                         (unsigned long long)(uintptr_t)send_receive_buffer,
+                         (unsigned long long)count, data_type, op);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+// -------------------- columnar / CSC / info-interface (round-3 tail)
+XTB_DLL int XGDMatrixCreateFromColumnar(char const* data, char const* config,
+                                        DMatrixHandle* out) {
+  API_BEGIN();
+  PyObject* d = CallGlue("dmatrix_from_columnar", "(ss)", data, config);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixCreateFromCSC(char const* indptr, char const* indices,
+                                   char const* data, bst_ulong nrow,
+                                   char const* config, DMatrixHandle* out) {
+  API_BEGIN();
+  PyObject* d = CallGlue("dmatrix_from_csc_ai", "(sssKs)", indptr, indices,
+                         data, (unsigned long long)nrow, config);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGProxyDMatrixSetDataColumnar(DMatrixHandle handle,
+                                          char const* data) {
+  API_BEGIN();
+  PyObject* r = CallGlue("proxy_set_columnar", "(Os)", (PyObject*)handle,
+                         data);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGBoosterPredictFromColumnar(BoosterHandle handle,
+                                         char const* values,
+                                         char const* config, DMatrixHandle m,
+                                         bst_ulong const** out_shape,
+                                         bst_ulong* out_dim,
+                                         const float** out_result) {
+  API_BEGIN();
+  PyObject* meta = m ? (PyObject*)m : Py_None;
+  PyObject* r = CallGlue("booster_inplace_predict_columnar", "(OssO)",
+                         (PyObject*)handle, values, config, meta);
+  FAIL_IF_NULL(r);
+  return PredictResult(r, out_shape, out_dim, out_result);
+  API_END();
+}
+
+XTB_DLL int XGDMatrixSetInfoFromInterface(DMatrixHandle handle,
+                                          char const* field,
+                                          char const* data) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_set_info_from_interface", "(Oss)",
+                         (PyObject*)handle, field, data);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixSetDenseInfo(DMatrixHandle handle, const char* field,
+                                  void const* data, bst_ulong size,
+                                  int type) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_set_dense_info", "(OsKKi)",
+                         (PyObject*)handle, field,
+                         (unsigned long long)(uintptr_t)data,
+                         (unsigned long long)size, type);
+  FAIL_IF_NULL(r);
+  Py_DECREF(r);
+  return 0;
+  API_END();
+}
+
+XTB_DLL int XGDMatrixGetInfoRef(DMatrixHandle handle, const char* field,
+                                const char** out_array) {
+  API_BEGIN();
+  PyObject* r = CallGlue("dmatrix_get_info_ref", "(Os)", (PyObject*)handle,
+                         field);
+  FAIL_IF_NULL(r);
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &n) != 0) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  *out_array = buf;
   Py_DECREF(r);
   return 0;
   API_END();

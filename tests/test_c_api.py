@@ -180,3 +180,673 @@ def test_ctypes_model_buffer_roundtrip(capi):
         _check(capi, capi.XGBoosterFree(b2))
     _check(capi, capi.XGBoosterFree(booster))
     _check(capi, capi.XGDMatrixFree(dmat))
+
+
+# ===================================================================
+# Round-3 surface: array-interface ingestion, inplace predict, slices,
+# feature info, dumps, config IO, callbacks, collective, tracker.
+
+def _aif(arr: np.ndarray) -> bytes:
+    """JSON __array_interface__ for a contiguous numpy array."""
+    import json
+    arr = np.ascontiguousarray(arr)
+    return json.dumps({"data": [arr.ctypes.data, True],
+                       "shape": list(arr.shape),
+                       "typestr": arr.dtype.str, "version": 3}).encode()
+
+
+def _mkdata(seed=0, R=250, F=6):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(R, F)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    return X, y
+
+
+def _train_booster(capi, dmat, rounds=4):
+    booster = ctypes.c_void_p()
+    arr = (ctypes.c_void_p * 1)(dmat)
+    _check(capi, capi.XGBoosterCreate(arr, ctypes.c_uint64(1),
+                                      ctypes.byref(booster)))
+    _check(capi, capi.XGBoosterSetParam(booster, b"objective",
+                                        b"binary:logistic"))
+    _check(capi, capi.XGBoosterSetParam(booster, b"max_depth", b"3"))
+    for it in range(rounds):
+        _check(capi, capi.XGBoosterUpdateOneIter(booster, it, dmat))
+    return booster
+
+
+def test_ctypes_array_interface_dense_csr(capi):
+    X, y = _mkdata(2)
+    d1 = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromDense(
+        _aif(X), b'{"missing": NaN}', ctypes.byref(d1)))
+    nrow, ncol = ctypes.c_uint64(), ctypes.c_uint64()
+    _check(capi, capi.XGDMatrixNumRow(d1, ctypes.byref(nrow)))
+    _check(capi, capi.XGDMatrixNumCol(d1, ctypes.byref(ncol)))
+    assert (nrow.value, ncol.value) == X.shape
+
+    import scipy.sparse as sp
+    csr = sp.csr_matrix(np.where(np.abs(X) < 1.0, 0, X))
+    ip = csr.indptr.astype(np.uint64)  # keep buffers alive across the call
+    ix = csr.indices.astype(np.uint32)
+    d2 = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromCSR(
+        _aif(ip), _aif(ix), _aif(csr.data),
+        ctypes.c_uint64(X.shape[1]), b'{"missing": NaN}', ctypes.byref(d2)))
+    _check(capi, capi.XGDMatrixNumRow(d2, ctypes.byref(nrow)))
+    assert nrow.value == X.shape[0]
+    nm = ctypes.c_uint64()
+    _check(capi, capi.XGDMatrixNumNonMissing(d2, ctypes.byref(nm)))
+    assert nm.value == csr.nnz
+    mode = ctypes.c_uint64()
+    _check(capi, capi.XGDMatrixDataSplitMode(d2, ctypes.byref(mode)))
+    assert mode.value == 0
+    _check(capi, capi.XGDMatrixFree(d1))
+    _check(capi, capi.XGDMatrixFree(d2))
+
+
+def test_ctypes_inplace_predict(capi):
+    X, y = _mkdata(3)
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromMat(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(X.shape[0]), ctypes.c_uint64(X.shape[1]),
+        ctypes.c_float(np.nan), ctypes.byref(dmat)))
+    _check(capi, capi.XGDMatrixSetFloatInfo(
+        dmat, b"label", y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(len(y))))
+    booster = _train_booster(capi, dmat)
+
+    shape_p = ctypes.POINTER(ctypes.c_uint64)()
+    dim = ctypes.c_uint64()
+    res = ctypes.POINTER(ctypes.c_float)()
+    # reference predict config (c_api.h PredictFromDense)
+    cfg = b'{"type": 0, "training": false, "iteration_begin": 0, "iteration_end": 0, "missing": NaN}'
+    _check(capi, capi.XGBoosterPredictFromDense(
+        booster, _aif(X), cfg, None, ctypes.byref(shape_p),
+        ctypes.byref(dim), ctypes.byref(res)))
+    assert dim.value == 1 and shape_p[0] == X.shape[0]
+    dense_preds = np.ctypeslib.as_array(res, shape=(X.shape[0],)).copy()
+
+    _check(capi, capi.XGBoosterPredictFromDMatrix(
+        booster, dmat, cfg, ctypes.byref(shape_p), ctypes.byref(dim),
+        ctypes.byref(res)))
+    dm_preds = np.ctypeslib.as_array(res, shape=(shape_p[0],)).copy()
+    np.testing.assert_array_equal(dense_preds, dm_preds)
+
+    import scipy.sparse as sp
+    csr = sp.csr_matrix(X)  # same values, sparse route
+    ip = csr.indptr.astype(np.uint64)  # keep buffers alive across the call
+    ix = csr.indices.astype(np.uint32)
+    _check(capi, capi.XGBoosterPredictFromCSR(
+        booster, _aif(ip), _aif(ix), _aif(csr.data),
+        ctypes.c_uint64(X.shape[1]), cfg, None, ctypes.byref(shape_p),
+        ctypes.byref(dim), ctypes.byref(res)))
+    csr_preds = np.ctypeslib.as_array(res, shape=(X.shape[0],)).copy()
+    np.testing.assert_allclose(csr_preds, dense_preds, rtol=1e-6)
+
+    # margin type through the config
+    cfg_m = b'{"type": 1, "iteration_begin": 0, "iteration_end": 0}'
+    _check(capi, capi.XGBoosterPredictFromDMatrix(
+        booster, dmat, cfg_m, ctypes.byref(shape_p), ctypes.byref(dim),
+        ctypes.byref(res)))
+    margins = np.ctypeslib.as_array(res, shape=(shape_p[0],)).copy()
+    np.testing.assert_allclose(1 / (1 + np.exp(-margins)), dense_preds,
+                               rtol=1e-5, atol=1e-6)
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGDMatrixFree(dmat))
+
+
+def test_ctypes_slice_and_info(capi):
+    X, y = _mkdata(4)
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromDense(
+        _aif(X), b'{"missing": NaN}', ctypes.byref(dmat)))
+    _check(capi, capi.XGDMatrixSetFloatInfo(
+        dmat, b"label", y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(len(y))))
+    idx = np.arange(0, 100, dtype=np.int32)
+    sl = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixSliceDMatrix(
+        dmat, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        ctypes.c_uint64(len(idx)), ctypes.byref(sl)))
+    nrow = ctypes.c_uint64()
+    _check(capi, capi.XGDMatrixNumRow(sl, ctypes.byref(nrow)))
+    assert nrow.value == 100
+
+    # float info get round-trips the label
+    flen = ctypes.c_uint64()
+    fptr = ctypes.POINTER(ctypes.c_float)()
+    _check(capi, capi.XGDMatrixGetFloatInfo(sl, b"label", ctypes.byref(flen),
+                                            ctypes.byref(fptr)))
+    lab = np.ctypeslib.as_array(fptr, shape=(flen.value,)).copy()
+    np.testing.assert_array_equal(lab, y[:100])
+
+    # str feature info on the dmatrix
+    names = [f"f{i}".encode() for i in range(X.shape[1])]
+    arr = (ctypes.c_char_p * len(names))(*names)
+    _check(capi, capi.XGDMatrixSetStrFeatureInfo(
+        dmat, b"feature_name", arr, ctypes.c_uint64(len(names))))
+    n = ctypes.c_uint64()
+    sptr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(capi, capi.XGDMatrixGetStrFeatureInfo(
+        dmat, b"feature_name", ctypes.byref(n), ctypes.byref(sptr)))
+    assert [sptr[i] for i in range(n.value)] == names
+
+    # booster slice: first 2 of 4 rounds
+    booster = _train_booster(capi, dmat)
+    half = ctypes.c_void_p()
+    _check(capi, capi.XGBoosterSlice(booster, 0, 2, 1, ctypes.byref(half)))
+    rounds = ctypes.c_int()
+    _check(capi, capi.XGBoosterBoostedRounds(half, ctypes.byref(rounds)))
+    assert rounds.value == 2
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGBoosterFree(half))
+    _check(capi, capi.XGDMatrixFree(sl))
+    _check(capi, capi.XGDMatrixFree(dmat))
+
+
+def test_ctypes_save_binary_uri_roundtrip(capi, tmp_path):
+    X, y = _mkdata(5)
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromDense(
+        _aif(X), b'{"missing": NaN}', ctypes.byref(dmat)))
+    _check(capi, capi.XGDMatrixSetFloatInfo(
+        dmat, b"label", y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(len(y))))
+    path = str(tmp_path / "dm.bin")
+    _check(capi, capi.XGDMatrixSaveBinary(dmat, path.encode(), 1))
+    import json
+    d2 = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromURI(
+        json.dumps({"uri": path}).encode(), ctypes.byref(d2)))
+    flen = ctypes.c_uint64()
+    fptr = ctypes.POINTER(ctypes.c_float)()
+    _check(capi, capi.XGDMatrixGetFloatInfo(d2, b"label", ctypes.byref(flen),
+                                            ctypes.byref(fptr)))
+    np.testing.assert_array_equal(
+        np.ctypeslib.as_array(fptr, shape=(flen.value,)), y)
+    _check(capi, capi.XGDMatrixFree(dmat))
+    _check(capi, capi.XGDMatrixFree(d2))
+
+
+def test_ctypes_dump_attrs_feature_score(capi):
+    X, y = _mkdata(6)
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromDense(
+        _aif(X), b'{"missing": NaN}', ctypes.byref(dmat)))
+    _check(capi, capi.XGDMatrixSetFloatInfo(
+        dmat, b"label", y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(len(y))))
+    booster = _train_booster(capi, dmat)
+
+    n = ctypes.c_uint64()
+    dumps = ctypes.POINTER(ctypes.c_char_p)()
+    _check(capi, capi.XGBoosterDumpModelEx(booster, b"", 1, b"json",
+                                           ctypes.byref(n),
+                                           ctypes.byref(dumps)))
+    assert n.value == 4
+    import json
+    tree0 = json.loads(dumps[0])
+    assert "children" in tree0 or "leaf" in tree0
+
+    fnames = [f"feat{i}".encode() for i in range(X.shape[1])]
+    ftypes = [b"float"] * X.shape[1]
+    fn = (ctypes.c_char_p * len(fnames))(*fnames)
+    ft = (ctypes.c_char_p * len(ftypes))(*ftypes)
+    _check(capi, capi.XGBoosterDumpModelExWithFeatures(
+        booster, len(fnames), fn, ft, 0, b"text", ctypes.byref(n),
+        ctypes.byref(dumps)))
+    assert b"feat0" in dumps[0]
+
+    _check(capi, capi.XGBoosterSetAttr(booster, b"best_iteration", b"3"))
+    _check(capi, capi.XGBoosterGetAttrNames(booster, ctypes.byref(n),
+                                            ctypes.byref(dumps)))
+    assert b"best_iteration" in [dumps[i] for i in range(n.value)]
+
+    nf = ctypes.c_uint64()
+    feats = ctypes.POINTER(ctypes.c_char_p)()
+    dim = ctypes.c_uint64()
+    shape = ctypes.POINTER(ctypes.c_uint64)()
+    scores = ctypes.POINTER(ctypes.c_float)()
+    _check(capi, capi.XGBoosterFeatureScore(
+        booster, b'{"importance_type": "gain"}', ctypes.byref(nf),
+        ctypes.byref(feats), ctypes.byref(dim), ctypes.byref(shape),
+        ctypes.byref(scores)))
+    assert nf.value > 0 and shape[0] == nf.value
+    vals = np.ctypeslib.as_array(scores, shape=(nf.value,))
+    assert (vals > 0).all()
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGDMatrixFree(dmat))
+
+
+def test_ctypes_config_serialize_roundtrip(capi):
+    X, y = _mkdata(7)
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromDense(
+        _aif(X), b'{"missing": NaN}', ctypes.byref(dmat)))
+    _check(capi, capi.XGDMatrixSetFloatInfo(
+        dmat, b"label", y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(len(y))))
+    booster = _train_booster(capi, dmat)
+
+    clen = ctypes.c_uint64()
+    cstr = ctypes.c_char_p()
+    _check(capi, capi.XGBoosterSaveJsonConfig(booster, ctypes.byref(clen),
+                                              ctypes.byref(cstr)))
+    import json
+    cfg = json.loads(ctypes.string_at(cstr, clen.value))
+    assert cfg["learner"]["learner_train_param"]["objective"] == "binary:logistic"
+
+    blen = ctypes.c_uint64()
+    bptr = ctypes.c_char_p()
+    _check(capi, capi.XGBoosterSerializeToBuffer(booster, ctypes.byref(blen),
+                                                 ctypes.byref(bptr)))
+    blob = ctypes.string_at(bptr, blen.value)
+    b2 = ctypes.c_void_p()
+    _check(capi, capi.XGBoosterCreate(None, ctypes.c_uint64(0),
+                                      ctypes.byref(b2)))
+    _check(capi, capi.XGBoosterUnserializeFromBuffer(
+        b2, blob, ctypes.c_uint64(len(blob))))
+    # restored booster predicts identically AND kept its config
+    n1, p1 = ctypes.c_uint64(), ctypes.POINTER(ctypes.c_float)()
+    _check(capi, capi.XGBoosterPredict(booster, dmat, 0, 0, 0,
+                                       ctypes.byref(n1), ctypes.byref(p1)))
+    a1 = np.ctypeslib.as_array(p1, shape=(n1.value,)).copy()
+    n2, p2 = ctypes.c_uint64(), ctypes.POINTER(ctypes.c_float)()
+    _check(capi, capi.XGBoosterPredict(b2, dmat, 0, 0, 0,
+                                       ctypes.byref(n2), ctypes.byref(p2)))
+    np.testing.assert_array_equal(
+        a1, np.ctypeslib.as_array(p2, shape=(n2.value,)))
+    _check(capi, capi.XGBoosterLoadJsonConfig(b2, json.dumps(cfg).encode()))
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGBoosterFree(b2))
+    _check(capi, capi.XGDMatrixFree(dmat))
+
+
+def test_ctypes_quantile_cut_and_csr_export(capi):
+    X, y = _mkdata(8)
+    import json
+    import xgboost_tpu as xtb
+
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromDense(
+        _aif(X), b'{"missing": NaN}', ctypes.byref(dmat)))
+    _check(capi, capi.XGDMatrixSetFloatInfo(
+        dmat, b"label", y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(len(y))))
+    booster = _train_booster(capi, dmat, rounds=2)
+
+    ip_j, va_j = ctypes.c_char_p(), ctypes.c_char_p()
+    _check(capi, capi.XGDMatrixGetQuantileCut(dmat, b"{}", ctypes.byref(ip_j),
+                                              ctypes.byref(va_j)))
+    ip_spec = json.loads(ip_j.value)
+    va_spec = json.loads(va_j.value)
+    n_ptrs = ip_spec["shape"][0]
+    assert n_ptrs == X.shape[1] + 1
+    cut_vals = np.ctypeslib.as_array(
+        ctypes.cast(va_spec["data"][0], ctypes.POINTER(ctypes.c_float)),
+        shape=(va_spec["shape"][0],)).copy()
+    assert np.isfinite(cut_vals).all()
+
+    nm = ctypes.c_uint64()
+    _check(capi, capi.XGDMatrixNumNonMissing(dmat, ctypes.byref(nm)))
+    indptr = np.zeros(X.shape[0] + 1, np.uint64)
+    indices = np.zeros(nm.value, np.uint32)
+    data = np.zeros(nm.value, np.float32)
+    _check(capi, capi.XGDMatrixGetDataAsCSR(
+        dmat, b"{}",
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_float))))
+    assert indptr[-1] == nm.value
+    # row 0 reconstructs exactly
+    r0 = np.full(X.shape[1], np.nan, np.float32)
+    r0[indices[: int(indptr[1])]] = data[: int(indptr[1])]
+    np.testing.assert_array_equal(r0, X[0])
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGDMatrixFree(dmat))
+
+
+def test_ctypes_iterator_callbacks(capi):
+    """XGProxyDMatrixCreate + XGQuantileDMatrixCreateFromCallback +
+    XGDMatrixCreateFromCallback + the extmem variant, driven by C function
+    pointers created here via ctypes."""
+    X, y = _mkdata(9, R=400)
+    batches = [(X[:150], y[:150]), (X[150:300], y[150:300]),
+               (X[300:], y[300:])]
+
+    proxy = ctypes.c_void_p()
+    _check(capi, capi.XGProxyDMatrixCreate(ctypes.byref(proxy)))
+
+    state = {"i": 0, "keep": []}
+    RESET = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+    NEXT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+
+    def _reset(_):
+        state["i"] = 0
+
+    def _next(_):
+        if state["i"] >= len(batches):
+            return 0
+        bx, by = batches[state["i"]]
+        bx = np.ascontiguousarray(bx)
+        by = np.ascontiguousarray(by)
+        state["keep"] = [bx, by]  # alive until the glue copies
+        rc = capi.XGProxyDMatrixSetDataDense(proxy, _aif(bx))
+        assert rc == 0, capi.XGBGetLastError()
+        rc = capi.XGDMatrixSetFloatInfo(
+            proxy, b"label", by.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_uint64(len(by)))
+        assert rc == 0, capi.XGBGetLastError()
+        state["i"] += 1
+        return 1
+
+    reset_cb, next_cb = RESET(_reset), NEXT(_next)
+    cfg = b'{"missing": NaN, "max_bin": 32}'
+
+    qdm = ctypes.c_void_p()
+    _check(capi, capi.XGQuantileDMatrixCreateFromCallback(
+        None, proxy, None, reset_cb, next_cb, cfg, ctypes.byref(qdm)))
+    nrow = ctypes.c_uint64()
+    _check(capi, capi.XGDMatrixNumRow(qdm, ctypes.byref(nrow)))
+    assert nrow.value == 400
+
+    raw = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromCallback(
+        None, proxy, reset_cb, next_cb, b'{"missing": NaN}',
+        ctypes.byref(raw)))
+    _check(capi, capi.XGDMatrixNumRow(raw, ctypes.byref(nrow)))
+    assert nrow.value == 400
+
+    ext = ctypes.c_void_p()
+    _check(capi, capi.XGExtMemQuantileDMatrixCreateFromCallback(
+        None, proxy, None, reset_cb, next_cb, cfg, ctypes.byref(ext)))
+
+    # training on the quantile matrix works and matches python QDM training
+    booster = ctypes.c_void_p()
+    arr = (ctypes.c_void_p * 1)(qdm)
+    _check(capi, capi.XGBoosterCreate(arr, ctypes.c_uint64(1),
+                                      ctypes.byref(booster)))
+    _check(capi, capi.XGBoosterSetParam(booster, b"objective",
+                                        b"binary:logistic"))
+    _check(capi, capi.XGBoosterSetParam(booster, b"max_bin", b"32"))
+    for it in range(3):
+        _check(capi, capi.XGBoosterUpdateOneIter(booster, it, qdm))
+    n1, p1 = ctypes.c_uint64(), ctypes.POINTER(ctypes.c_float)()
+    _check(capi, capi.XGBoosterPredict(booster, qdm, 0, 0, 0,
+                                       ctypes.byref(n1), ctypes.byref(p1)))
+    preds = np.ctypeslib.as_array(p1, shape=(n1.value,)).copy()
+
+    import xgboost_tpu as xtb
+    qd = xtb.QuantileDMatrix(X, label=y, max_bin=32)
+    bst = xtb.train({"objective": "binary:logistic", "max_bin": 32}, qd, 3,
+                    verbose_eval=False)
+    np.testing.assert_allclose(preds, bst.predict(qd), rtol=1e-5, atol=1e-6)
+
+    for h in (qdm, raw, ext, proxy):
+        _check(capi, capi.XGDMatrixFree(h))
+    _check(capi, capi.XGBoosterFree(booster))
+
+
+def test_ctypes_train_one_iter_custom_grad(capi):
+    X, y = _mkdata(10)
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromDense(
+        _aif(X), b'{"missing": NaN}', ctypes.byref(dmat)))
+    booster = ctypes.c_void_p()
+    arr = (ctypes.c_void_p * 1)(dmat)
+    _check(capi, capi.XGBoosterCreate(arr, ctypes.c_uint64(1),
+                                      ctypes.byref(booster)))
+    _check(capi, capi.XGBoosterSetParam(booster, b"max_depth", b"3"))
+    pred = np.zeros(len(y), np.float32)
+    for it in range(2):
+        grad = (1 / (1 + np.exp(-pred)) - y).astype(np.float32)
+        p = 1 / (1 + np.exp(-pred))
+        hess = (p * (1 - p)).astype(np.float32)
+        _check(capi, capi.XGBoosterTrainOneIter(booster, dmat, it,
+                                                _aif(grad), _aif(hess)))
+        shape_p = ctypes.POINTER(ctypes.c_uint64)()
+        dim = ctypes.c_uint64()
+        res = ctypes.POINTER(ctypes.c_float)()
+        _check(capi, capi.XGBoosterPredictFromDMatrix(
+            booster, dmat, b'{"type": 1}', ctypes.byref(shape_p),
+            ctypes.byref(dim), ctypes.byref(res)))
+        pred = np.ctypeslib.as_array(res, shape=(len(y),)).copy()
+    rounds = ctypes.c_int()
+    _check(capi, capi.XGBoosterBoostedRounds(booster, ctypes.byref(rounds)))
+    assert rounds.value == 2
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGDMatrixFree(dmat))
+
+
+def test_ctypes_globals_and_collective_single(capi):
+    info = ctypes.c_char_p()
+    _check(capi, capi.XGBuildInfo(ctypes.byref(info)))
+    import json
+    assert json.loads(info.value)["USE_TPU"] is True
+
+    _check(capi, capi.XGBSetGlobalConfig(b'{"verbosity": 2}'))
+    out = ctypes.c_char_p()
+    _check(capi, capi.XGBGetGlobalConfig(ctypes.byref(out)))
+    assert json.loads(out.value)["verbosity"] == 2
+    _check(capi, capi.XGBSetGlobalConfig(b'{"verbosity": 1}'))
+
+    # single-process communicator contract
+    _check(capi, capi.XGCommunicatorInit(b"{}"))
+    assert capi.XGCommunicatorGetRank() == 0
+    assert capi.XGCommunicatorGetWorldSize() == 1
+    assert capi.XGCommunicatorIsDistributed() == 0
+    name = ctypes.c_char_p()
+    _check(capi, capi.XGCommunicatorGetProcessorName(ctypes.byref(name)))
+    assert len(name.value) > 0
+    buf = np.arange(8, dtype=np.float64)
+    _check(capi, capi.XGCommunicatorAllreduce(
+        buf.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(8), 2, 2))
+    np.testing.assert_array_equal(buf, np.arange(8))  # sum over world=1
+    bbuf = np.frombuffer(bytearray(b"hello-bc"), dtype=np.uint8).copy()
+    _check(capi, capi.XGCommunicatorBroadcast(
+        bbuf.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(8), 0))
+    assert bbuf.tobytes() == b"hello-bc"
+    _check(capi, capi.XGCommunicatorFinalize())
+
+
+def test_ctypes_tracker(capi):
+    import json
+    import threading
+
+    tr = ctypes.c_void_p()
+    _check(capi, capi.XGTrackerCreate(
+        b'{"n_workers": 1, "host": "127.0.0.1"}', ctypes.byref(tr)))
+    _check(capi, capi.XGTrackerRun(tr, b"{}"))
+    args_p = ctypes.c_char_p()
+    _check(capi, capi.XGTrackerWorkerArgs(tr, ctypes.byref(args_p)))
+    args = json.loads(args_p.value)
+    assert args["dmlc_tracker_uri"] == "127.0.0.1"
+
+    from xgboost_tpu.tracker import TrackerClient
+
+    def client():
+        c = TrackerClient(args["dmlc_tracker_uri"],
+                          int(args["dmlc_tracker_port"]))
+        assert c.rank == 0 and c.world == 1
+        c.shutdown()
+
+    t = threading.Thread(target=client)
+    t.start()
+    _check(capi, capi.XGTrackerWaitFor(tr, b'{"timeout": 30}'))
+    t.join(30)
+    _check(capi, capi.XGTrackerFree(tr))
+
+
+def test_ctypes_columnar_csc_inforef(capi):
+    X, y = _mkdata(11)
+    import json
+
+    # columnar: one array-interface per column
+    cols = [np.ascontiguousarray(X[:, j]) for j in range(X.shape[1])]
+    col_json = json.dumps([json.loads(_aif(c)) for c in cols]).encode()
+    d1 = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromColumnar(
+        col_json, b'{"missing": NaN}', ctypes.byref(d1)))
+    nrow = ctypes.c_uint64()
+    _check(capi, capi.XGDMatrixNumRow(d1, ctypes.byref(nrow)))
+    assert nrow.value == X.shape[0]
+
+    import scipy.sparse as sp
+    csc = sp.csc_matrix(np.where(np.abs(X) < 0.5, 0, X))
+    ip = csc.indptr.astype(np.uint64)
+    ix = csc.indices.astype(np.uint32)
+    d2 = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromCSC(
+        _aif(ip), _aif(ix), _aif(csc.data), ctypes.c_uint64(X.shape[0]),
+        b'{"missing": NaN}', ctypes.byref(d2)))
+    ncol = ctypes.c_uint64()
+    _check(capi, capi.XGDMatrixNumCol(d2, ctypes.byref(ncol)))
+    assert ncol.value == X.shape[1]
+
+    # info from array interface + reference-counted view back out
+    _check(capi, capi.XGDMatrixSetInfoFromInterface(d1, b"label", _aif(y)))
+    ref = ctypes.c_char_p()
+    _check(capi, capi.XGDMatrixGetInfoRef(d1, b"label", ctypes.byref(ref)))
+    spec = json.loads(ref.value)
+    back = np.ctypeslib.as_array(
+        ctypes.cast(spec["data"][0], ctypes.POINTER(ctypes.c_float)),
+        shape=tuple(spec["shape"])).copy()
+    np.testing.assert_array_equal(back, y)
+
+    # deprecated raw-pointer info setter
+    w = np.abs(X[:, 1]) + 1
+    _check(capi, capi.XGDMatrixSetDenseInfo(
+        d1, b"weight", w.astype(np.float32).ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_uint64(len(w)), 1))
+    flen = ctypes.c_uint64()
+    fptr = ctypes.POINTER(ctypes.c_float)()
+    _check(capi, capi.XGDMatrixGetFloatInfo(d1, b"weight", ctypes.byref(flen),
+                                            ctypes.byref(fptr)))
+    assert flen.value == len(w)
+
+    # columnar inplace predict == dense inplace predict
+    _check(capi, capi.XGDMatrixSetInfoFromInterface(d1, b"label", _aif(y)))
+    booster = _train_booster(capi, d1, rounds=2)
+    shape_p = ctypes.POINTER(ctypes.c_uint64)()
+    dim = ctypes.c_uint64()
+    res = ctypes.POINTER(ctypes.c_float)()
+    _check(capi, capi.XGBoosterPredictFromColumnar(
+        booster, col_json, b'{"type": 0}', None, ctypes.byref(shape_p),
+        ctypes.byref(dim), ctypes.byref(res)))
+    p_col = np.ctypeslib.as_array(res, shape=(X.shape[0],)).copy()
+    _check(capi, capi.XGBoosterPredictFromDense(
+        booster, _aif(X), b'{"type": 0}', None, ctypes.byref(shape_p),
+        ctypes.byref(dim), ctypes.byref(res)))
+    p_dense = np.ctypeslib.as_array(res, shape=(X.shape[0],)).copy()
+    np.testing.assert_array_equal(p_col, p_dense)
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGDMatrixFree(d1))
+    _check(capi, capi.XGDMatrixFree(d2))
+
+
+def test_ctypes_csr_missing_filter_and_export_consistency(capi):
+    """Regression: CSR entries that mean 'missing' (NaN, or == the missing
+    sentinel) are filtered at construction (reference adapter.h
+    IsValidFunctor), so XGDMatrixNumNonMissing sizes exactly what
+    XGDMatrixGetDataAsCSR exports — callers allocate from the former."""
+    import scipy.sparse as sp
+
+    dense = np.array([[1.0, np.nan, 3.0],
+                      [0.0, 5.0, np.nan],
+                      [7.0, 0.0, 5.0]], np.float32)
+    csr = sp.csr_matrix(dense)  # explicit entries incl. the NaNs
+    ip = csr.indptr.astype(np.uint64)
+    ix = csr.indices.astype(np.uint32)
+
+    d = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromCSR(
+        _aif(ip), _aif(ix), _aif(csr.data), ctypes.c_uint64(3),
+        b'{"missing": NaN}', ctypes.byref(d)))
+    nm = ctypes.c_uint64()
+    _check(capi, capi.XGDMatrixNumNonMissing(d, ctypes.byref(nm)))
+    assert nm.value == 5  # 7 stored minus 2 NaNs
+
+    oip = ctypes.POINTER(ctypes.c_uint64)()
+    oix = ctypes.POINTER(ctypes.c_uint32)()
+    ova = ctypes.POINTER(ctypes.c_float)()
+    # buffers sized from NumNonMissing per the reference contract
+    out_ip = (ctypes.c_uint64 * 4)()
+    out_ix = (ctypes.c_uint32 * 5)()
+    out_va = (ctypes.c_float * 5)()
+    _check(capi, capi.XGDMatrixGetDataAsCSR(
+        d, b"{}", out_ip, out_ix, out_va))
+    assert out_ip[3] == 5
+    assert np.isfinite(np.ctypeslib.as_array(out_va, shape=(5,))).all()
+    _check(capi, capi.XGDMatrixFree(d))
+
+    # finite sentinel: 5.0 means missing -> dropped structurally
+    d2 = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromCSR(
+        _aif(ip), _aif(ix), _aif(csr.data), ctypes.c_uint64(3),
+        b'{"missing": 5.0}', ctypes.byref(d2)))
+    _check(capi, capi.XGDMatrixNumNonMissing(d2, ctypes.byref(nm)))
+    assert nm.value == 3  # also drops the two 5.0 entries
+    _check(capi, capi.XGDMatrixFree(d2))
+
+
+def test_ctypes_iterator_callback_group_info(capi):
+    """Regression: 'group' staged on the proxy via XGDMatrixSetUIntInfo
+    must reach the assembled QuantileDMatrix (it was silently dropped)."""
+    X, y = _mkdata(3, R=120, F=4)
+    halves = [(X[:60], y[:60], np.array([20, 40], np.uint32)),
+              (X[60:], y[60:], np.array([30, 30], np.uint32))]
+
+    proxy = ctypes.c_void_p()
+    _check(capi, capi.XGProxyDMatrixCreate(ctypes.byref(proxy)))
+    state = {"i": 0, "keep": []}
+    RESET = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+    NEXT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+
+    def _reset(_):
+        state["i"] = 0
+
+    def _next(_):
+        if state["i"] >= len(halves):
+            return 0
+        bx, by, bg = halves[state["i"]]
+        bx, by = np.ascontiguousarray(bx), np.ascontiguousarray(by)
+        state["keep"] = [bx, by, bg]
+        assert capi.XGProxyDMatrixSetDataDense(proxy, _aif(bx)) == 0
+        assert capi.XGDMatrixSetFloatInfo(
+            proxy, b"label",
+            by.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_uint64(len(by))) == 0
+        assert capi.XGDMatrixSetUIntInfo(
+            proxy, b"group",
+            bg.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            ctypes.c_uint64(len(bg))) == 0
+        state["i"] += 1
+        return 1
+
+    reset_cb, next_cb = RESET(_reset), NEXT(_next)
+    qdm = ctypes.c_void_p()
+    _check(capi, capi.XGQuantileDMatrixCreateFromCallback(
+        None, proxy, None, reset_cb, next_cb,
+        b'{"missing": NaN, "max_bin": 32}', ctypes.byref(qdm)))
+
+    ulen = ctypes.c_uint64()
+    uptr = ctypes.POINTER(ctypes.c_uint32)()
+    _check(capi, capi.XGDMatrixGetUIntInfo(qdm, b"group_ptr",
+                                           ctypes.byref(ulen),
+                                           ctypes.byref(uptr)))
+    got = np.ctypeslib.as_array(uptr, shape=(ulen.value,)).copy()
+    np.testing.assert_array_equal(got, [0, 20, 60, 90, 120])
+
+    # a ranking objective actually trains on it
+    booster = ctypes.c_void_p()
+    arr = (ctypes.c_void_p * 1)(qdm)
+    _check(capi, capi.XGBoosterCreate(arr, ctypes.c_uint64(1),
+                                      ctypes.byref(booster)))
+    _check(capi, capi.XGBoosterSetParam(booster, b"objective",
+                                        b"rank:pairwise"))
+    _check(capi, capi.XGBoosterUpdateOneIter(booster, 0, qdm))
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGDMatrixFree(qdm))
+    _check(capi, capi.XGDMatrixFree(proxy))

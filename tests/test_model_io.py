@@ -101,3 +101,48 @@ def test_config_context():
     with xtb.config_context(verbosity=0):
         assert xtb.get_config()["verbosity"] == 0
     assert xtb.get_config()["verbosity"] == 1
+
+
+def test_config_roundtrip_continuation():
+    """learner.cc:625 SaveConfig / :570 LoadConfig + :987 full-state Save:
+    train -> serialize -> restore in a fresh Booster -> continue == one
+    uninterrupted run, bitwise."""
+    rng = np.random.default_rng(21)
+    X = rng.normal(size=(900, 7)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 2] > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 5, "eta": 0.17,
+              "max_bin": 48, "lambda": 2.5, "gamma": 0.1,
+              "eval_metric": ["logloss", "auc"], "seed": 9}
+    full = xtb.train(params, xtb.DMatrix(X, label=y), 10, verbose_eval=False)
+
+    half = xtb.train(params, xtb.DMatrix(X, label=y), 5, verbose_eval=False)
+    blob = half.serialize()
+    fresh = xtb.Booster()
+    fresh.unserialize(bytes(blob))
+    # config restored: no params passed to the second leg at all
+    cont = xtb.train({}, xtb.DMatrix(X, label=y), 5, verbose_eval=False,
+                     xgb_model=fresh)
+    assert len(cont.trees) == len(full.trees)
+    for ta, tb in zip(full.trees, cont.trees):
+        np.testing.assert_array_equal(ta.left_children, tb.left_children)
+        np.testing.assert_array_equal(ta.split_conditions, tb.split_conditions)
+
+
+def test_save_config_shape_and_values():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    y = rng.normal(size=300).astype(np.float32)
+    bst = xtb.train({"objective": "reg:squarederror", "max_depth": 3,
+                     "eta": 0.11, "max_bin": 32}, xtb.DMatrix(X, label=y), 2,
+                    verbose_eval=False)
+    import json
+    cfg = json.loads(bst.save_config())
+    ln = cfg["learner"]
+    assert ln["learner_train_param"]["objective"] == "reg:squarederror"
+    assert ln["gradient_booster"]["name"] == "gbtree"
+    hp = ln["gradient_booster"]["updater"]["grow_quantile_histmaker"]["hist_train_param"]
+    assert hp["eta"] == "0.11" and hp["max_bin"] == "32"
+    # load_config applies values onto a fresh booster
+    b2 = xtb.Booster()
+    b2.load_config(bst.save_config())
+    assert b2.params["eta"] == "0.11" and int(b2.params["max_bin"]) == 32

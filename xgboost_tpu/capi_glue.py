@@ -35,6 +35,25 @@ def dmatrix_from_mat(addr: int, nrow: int, ncol: int, missing: float) -> DMatrix
     return DMatrix(X, missing=missing)
 
 
+def _drop_missing_csr(csr, missing: float):
+    """Remove entries that mean "missing" (NaN, or == missing when the
+    sentinel is finite) so the stored sparsity pattern IS the non-missing
+    set — the reference filters at construction (src/data/adapter.h
+    IsValidFunctor), which keeps XGDMatrixNumNonMissing consistent with
+    XGDMatrixGetDataAsCSR."""
+    import scipy.sparse as sp
+
+    coo = csr.tocoo()
+    vals = np.asarray(coo.data, np.float32)
+    keep = np.isfinite(vals)
+    if missing is not None and not np.isnan(missing):
+        keep &= vals != np.float32(missing)
+    if keep.all():
+        return csr
+    return sp.csr_matrix(
+        (vals[keep], (coo.row[keep], coo.col[keep])), shape=csr.shape)
+
+
 def dmatrix_from_csr(indptr_addr: int, indices_addr: int, data_addr: int,
                      n_indptr: int, nnz: int, ncol: int) -> DMatrix:
     import scipy.sparse as sp
@@ -43,11 +62,15 @@ def dmatrix_from_csr(indptr_addr: int, indices_addr: int, data_addr: int,
     indices = _buf(indices_addr, nnz, np.uint32).astype(np.int64)
     data = _buf(data_addr, nnz, np.float32)
     csr = sp.csr_matrix((data, indices, indptr), shape=(n_indptr - 1, ncol))
-    return DMatrix(csr)
+    return DMatrix(_drop_missing_csr(csr, np.nan))
 
 
-def dmatrix_set_float_info(d: DMatrix, field: str, addr: int, n: int) -> None:
+def dmatrix_set_float_info(d, field: str, addr: int, n: int) -> None:
     vals = _buf(addr, n, np.float32)
+    if isinstance(d, _ProxyDMatrix):
+        # iterator protocol: meta staged on the proxy rides into input_data
+        d.kwargs[field] = vals
+        return
     if field == "label":
         d.set_label(vals)
     elif field == "weight":
@@ -62,8 +85,11 @@ def dmatrix_set_float_info(d: DMatrix, field: str, addr: int, n: int) -> None:
         raise ValueError(f"unknown float field {field!r}")
 
 
-def dmatrix_set_uint_info(d: DMatrix, field: str, addr: int, n: int) -> None:
+def dmatrix_set_uint_info(d, field: str, addr: int, n: int) -> None:
     vals = _buf(addr, n, np.uint32)
+    if isinstance(d, _ProxyDMatrix):
+        d.kwargs[field] = vals
+        return
     if field == "group":
         d.set_group(vals.astype(np.int64))
     else:
@@ -169,3 +195,812 @@ def booster_num_boosted_rounds(b: Booster) -> int:
 
 def booster_num_features(b: Booster) -> int:
     return int(b.num_features())
+
+
+# =====================================================================
+# Round-3 surface expansion: array-interface ingestion, inplace predict,
+# DataIter callbacks, dump/slice/feature-info, config IO, collective +
+# tracker C API (reference: include/xgboost/c_api.h; src/c_api/c_api.cc,
+# src/c_api/coll_c_api.cc).
+
+def _from_array_interface(spec) -> np.ndarray:
+    """Decode a JSON-encoded numpy __array_interface__ (the reference's
+    ArrayInterface, src/data/array_interface.h) into a host copy."""
+    if isinstance(spec, (str, bytes)):
+        spec = json.loads(spec)
+    dt = np.dtype(str(spec["typestr"]))
+    shape = tuple(int(s) for s in spec["shape"])
+    n = int(np.prod(shape)) if shape else 1
+    if spec.get("strides") not in (None, []):
+        raise ValueError("strided array interface is not supported; pass a "
+                         "C-contiguous array")
+    addr = int(spec["data"][0])
+    ctype = ctypes.c_char * (n * dt.itemsize)
+    raw = ctype.from_address(addr)
+    return np.frombuffer(bytes(raw), dtype=dt).reshape(shape).copy()
+
+
+def _pin_str_array(owner, tag: str, strings):
+    """Build a NUL-terminated char** pinned on ``owner``; returns
+    (len, address).  The reference keeps such returns in per-handle
+    thread-local entries (c_api.cc XGBAPIThreadLocalEntry)."""
+    bufs = [str(s).encode() for s in strings]
+    arr = (ctypes.c_char_p * len(bufs))(*bufs)
+    setattr(owner, tag, (bufs, arr))  # keep both alive
+    return len(bufs), ctypes.addressof(arr) if bufs else 0
+
+
+def _pin_array(owner, tag: str, arr: np.ndarray):
+    arr = np.ascontiguousarray(arr)
+    setattr(owner, tag, arr)
+    return int(arr.size), int(arr.ctypes.data)
+
+
+def _cfg(config) -> dict:
+    return json.loads(config) if config else {}
+
+
+# ------------------------------------------------------------- DMatrix
+def dmatrix_from_dense(data_json: str, config: str) -> DMatrix:
+    c = _cfg(config)
+    X = _from_array_interface(data_json).astype(np.float32)
+    return DMatrix(X, missing=float(c.get("missing", np.nan)))
+
+
+def dmatrix_from_csr_ai(indptr_j: str, indices_j: str, data_j: str,
+                        ncol: int, config: str) -> DMatrix:
+    import scipy.sparse as sp
+
+    c = _cfg(config)
+    indptr = _from_array_interface(indptr_j).astype(np.int64)
+    indices = _from_array_interface(indices_j).astype(np.int64)
+    data = _from_array_interface(data_j).astype(np.float32)
+    missing = float(c.get("missing", np.nan))
+    csr = sp.csr_matrix((data, indices, indptr),
+                        shape=(len(indptr) - 1, int(ncol)))
+    return DMatrix(_drop_missing_csr(csr, missing))
+
+
+def dmatrix_from_uri(config: str) -> DMatrix:
+    c = _cfg(config)
+    uri = c["uri"]
+    try:  # XGDMatrixSaveBinary snapshots round-trip through the URI loader
+        with np.load(uri, allow_pickle=False) as z:
+            return _dmatrix_from_npz(z)
+    except (OSError, ValueError):
+        pass
+    return DMatrix(str(uri))
+
+
+def _dmatrix_from_npz(z) -> DMatrix:
+    if "csr_indptr" in z:
+        import scipy.sparse as sp
+
+        X = sp.csr_matrix((z["csr_data"], z["csr_indices"], z["csr_indptr"]),
+                          shape=tuple(z["shape"]))
+    else:
+        X = z["dense"]
+    d = DMatrix(X)
+    for field in ("label", "weight", "base_margin", "label_lower_bound",
+                  "label_upper_bound", "group_ptr"):
+        if field in z:
+            setattr(d.info, field, z[field])
+    if "feature_names" in z:
+        d.info.feature_names = [str(s) for s in z["feature_names"]]
+    if "feature_types" in z:
+        d.info.feature_types = [str(s) for s in z["feature_types"]]
+    return d
+
+
+def dmatrix_save_binary(d: DMatrix, fname: str, silent: int) -> None:
+    """Own snapshot format (npz): the reference's binary DMatrix format is
+    version-locked internal state, not a portability contract."""
+    out = {}
+    if d._kind == "dense":
+        out["dense"] = d.host_dense()
+    else:
+        indptr, indices, values, shape = d._csr
+        out.update(csr_indptr=indptr, csr_indices=indices, csr_data=values,
+                   shape=np.asarray(shape))
+    info = d.info
+    for field in ("label", "weight", "base_margin", "label_lower_bound",
+                  "label_upper_bound", "group_ptr"):
+        v = getattr(info, field, None)
+        if v is not None:
+            out[field] = np.asarray(v)
+    if info.feature_names:
+        out["feature_names"] = np.asarray(info.feature_names, dtype="U")
+    if info.feature_types:
+        out["feature_types"] = np.asarray(info.feature_types, dtype="U")
+    with open(fname, "wb") as fh:  # file object: np.savez won't append .npz
+        np.savez(fh, **out)
+
+
+def dmatrix_slice(d: DMatrix, idx_addr: int, n: int,
+                  allow_groups: int) -> DMatrix:
+    idx = _buf(idx_addr, n, np.int32).astype(np.int64)
+    if not allow_groups and d.info.group_ptr is not None:
+        # the plain slice API refuses grouped matrices like the reference
+        # (c_api.cc CHECK on group); the Ex variant opts in
+        raise ValueError("slicing a DMatrix with query groups requires "
+                         "XGDMatrixSliceDMatrixEx with allow_groups=1")
+    return d.slice(idx)
+
+
+def dmatrix_set_str_feature_info(d: DMatrix, field: str, names) -> None:
+    if field == "feature_name":
+        d.info.feature_names = [str(s) for s in names] or None
+    elif field == "feature_type":
+        d.info.feature_types = [str(s) for s in names] or None
+    else:
+        raise ValueError(f"unknown string feature field {field!r}")
+
+
+def dmatrix_get_str_feature_info(d: DMatrix, field: str):
+    if field == "feature_name":
+        vals = d.info.feature_names or []
+    elif field == "feature_type":
+        vals = d.info.feature_types or []
+    else:
+        raise ValueError(f"unknown string feature field {field!r}")
+    return _pin_str_array(d, "_capi_strinfo", vals)
+
+
+def dmatrix_get_float_info(d: DMatrix, field: str):
+    v = getattr(d.info, field, None)
+    if field not in ("label", "weight", "base_margin", "label_lower_bound",
+                     "label_upper_bound", "feature_weights"):
+        raise ValueError(f"unknown float field {field!r}")
+    arr = (np.zeros(0, np.float32) if v is None
+           else np.asarray(v, np.float32).reshape(-1))
+    return _pin_array(d, "_capi_finfo", arr)
+
+
+def dmatrix_get_uint_info(d: DMatrix, field: str):
+    if field != "group_ptr":
+        raise ValueError(f"unknown uint field {field!r}")
+    v = d.info.group_ptr
+    arr = (np.zeros(0, np.uint32) if v is None
+           else np.asarray(v, np.uint32).reshape(-1))
+    return _pin_array(d, "_capi_uinfo", arr)
+
+
+def dmatrix_num_nonmissing(d: DMatrix) -> int:
+    if d._kind == "dense":
+        return int(np.isfinite(d.host_dense()).sum())
+    indptr, _i, values, _s = d._csr
+    return int(np.isfinite(values).sum())
+
+
+def dmatrix_data_split_mode(d: DMatrix) -> int:
+    return 0  # kRow; column split is not supported on this runtime
+
+
+def dmatrix_get_data_as_csr(d: DMatrix, config: str):
+    if d._kind == "dense":
+        import scipy.sparse as sp
+
+        X = d.host_dense()
+        mask = np.isfinite(X)
+        # build from the mask directly so real zeros stay explicit
+        rows, cols = np.nonzero(mask)
+        csr = sp.csr_matrix((X[rows, cols], (rows, cols)), shape=X.shape)
+        indptr, indices, values = csr.indptr, csr.indices, csr.data
+    else:
+        indptr, indices, values, _shape = d._csr
+        finite = np.isfinite(np.asarray(values, np.float32))
+        if not finite.all():
+            # keep the export consistent with XGDMatrixNumNonMissing when
+            # the stored pattern still carries explicit-NaN entries
+            cum = np.concatenate([[0], np.cumsum(finite)])
+            indptr = cum[np.asarray(indptr, np.int64)]
+            indices = np.asarray(indices)[finite]
+            values = np.asarray(values)[finite]
+    ip = np.ascontiguousarray(indptr, np.uint64)
+    ix = np.ascontiguousarray(indices, np.uint32)
+    va = np.ascontiguousarray(values, np.float32)
+    d._capi_csr = (ip, ix, va)
+    return (int(ip.ctypes.data), int(ix.ctypes.data), int(va.ctypes.data),
+            int(ip.size), int(va.size))
+
+
+def dmatrix_get_quantile_cut(d: DMatrix, config: str):
+    cuts = getattr(d, "_cuts", None)
+    if cuts is None:
+        ell = getattr(d, "_ellpack", None)
+        if ell is None:
+            raise ValueError(
+                "DMatrix carries no quantile cuts; construct a "
+                "QuantileDMatrix or train first (reference: "
+                "XGDMatrixGetQuantileCut requires a binned matrix)")
+        cuts = ell.cuts
+    indptr = np.ascontiguousarray(cuts.cut_ptrs, np.uint64)
+    values = np.ascontiguousarray(cuts.cut_values, np.float32)
+    d._capi_qcut = (indptr, values)
+    ip_json = json.dumps({"data": [int(indptr.ctypes.data), True],
+                          "shape": [int(indptr.size)], "typestr": "<u8",
+                          "version": 3}).encode()
+    va_json = json.dumps({"data": [int(values.ctypes.data), True],
+                          "shape": [int(values.size)], "typestr": "<f4",
+                          "version": 3}).encode()
+    d._capi_qcut_json = (ip_json, va_json)
+    return ip_json, va_json
+
+
+# ---------------------------------------------- proxy + DataIter callbacks
+class _ProxyDMatrix:
+    """Staging slot filled by XGProxyDMatrixSetData* between iterator
+    callbacks (reference: src/data/proxy_dmatrix.h)."""
+
+    def __init__(self) -> None:
+        self.data = None
+        self.kwargs = {}
+
+    def set_dense(self, array_if: str) -> None:
+        self.data = _from_array_interface(array_if).astype(np.float32)
+
+    def set_csr(self, indptr_j: str, indices_j: str, data_j: str,
+                ncol: int) -> None:
+        import scipy.sparse as sp
+
+        indptr = _from_array_interface(indptr_j).astype(np.int64)
+        indices = _from_array_interface(indices_j).astype(np.int64)
+        data = _from_array_interface(data_j).astype(np.float32)
+        self.data = sp.csr_matrix((data, indices, indptr),
+                                  shape=(len(indptr) - 1, int(ncol)))
+
+    def set_info(self, field: str, addr: int, n: int, dtype) -> None:
+        self.kwargs[field] = _buf(addr, n, dtype)
+
+
+def proxy_create() -> "_ProxyDMatrix":
+    return _ProxyDMatrix()
+
+
+def proxy_set_dense(p: "_ProxyDMatrix", array_if: str) -> None:
+    p.set_dense(array_if)
+
+
+def proxy_set_csr(p: "_ProxyDMatrix", indptr_j: str, indices_j: str,
+                  data_j: str, ncol: int) -> None:
+    p.set_csr(indptr_j, indices_j, data_j, ncol)
+
+
+from .data.extmem import DataIter as _DataIter  # noqa: E402
+
+
+class _CCallbackIter(_DataIter):
+    """Adapts the C iterator protocol (reset/next function pointers +
+    proxy handle) onto the Python DataIter protocol."""
+
+    def __init__(self, iter_addr: int, proxy: "_ProxyDMatrix",
+                 reset_addr: int, next_addr: int,
+                 cache_prefix=None) -> None:
+        super().__init__(cache_prefix=cache_prefix)
+        self._reset_fn = ctypes.CFUNCTYPE(None, ctypes.c_void_p)(reset_addr)
+        self._next_fn = ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_void_p)(next_addr)
+        self._iter_addr = iter_addr
+        self._proxy = proxy
+
+    def reset(self) -> None:
+        self._reset_fn(self._iter_addr)
+
+    def next(self, input_data) -> int:
+        self._proxy.data = None
+        self._proxy.kwargs = {}
+        status = int(self._next_fn(self._iter_addr))
+        if not status:
+            return 0
+        if self._proxy.data is None:
+            raise RuntimeError("iterator next() returned 1 without staging "
+                               "data on the proxy DMatrix")
+        input_data(data=self._proxy.data, **self._proxy.kwargs)
+        return 1
+
+
+def _iter_batches(it: "_CCallbackIter"):
+    from .data.extmem import _iterate
+
+    batches = list(_iterate(it))
+    if not batches:
+        raise ValueError("iterator produced no batches")
+    return batches
+
+
+def _assemble_from_batches(batches, missing: float) -> DMatrix:
+    import scipy.sparse as sp
+
+    mats = [b["data"] for b in batches]
+    if any(sp.issparse(m) for m in mats):
+        X = sp.vstack([sp.csr_matrix(m) for m in mats])
+    else:
+        X = np.concatenate([np.asarray(m) for m in mats], axis=0)
+    kw = {}
+    for field in ("label", "weight", "base_margin", "label_lower_bound",
+                  "label_upper_bound", "group", "qid"):
+        if all(field in b for b in batches):
+            kw[field] = np.concatenate(
+                [np.asarray(b[field]).reshape(len(b[field]), -1)
+                 for b in batches]).squeeze()
+        elif any(field in b for b in batches):
+            raise ValueError(
+                f"iterator staged {field!r} on some batches but not all")
+    d = DMatrix(X, missing=missing, **{k: v for k, v in kw.items()
+                                       if k == "label"})
+    if "weight" in kw:
+        d.set_weight(kw["weight"])
+    if "base_margin" in kw:
+        d.set_base_margin(kw["base_margin"])
+    if "label_lower_bound" in kw:
+        d.info.label_lower_bound = np.asarray(kw["label_lower_bound"],
+                                              np.float32)
+    if "label_upper_bound" in kw:
+        d.info.label_upper_bound = np.asarray(kw["label_upper_bound"],
+                                              np.float32)
+    # group arrives as per-batch COUNT vectors (each batch's groups are
+    # contiguous), qid as per-row ids — both concatenate directly
+    if "qid" in kw:
+        d.set_qid(kw["qid"])
+    elif "group" in kw:
+        d.set_group(np.asarray(kw["group"], np.int64))
+    return d
+
+
+def dmatrix_from_callback(iter_addr: int, proxy, reset_addr: int,
+                          next_addr: int, config: str) -> DMatrix:
+    """XGDMatrixCreateFromCallback: raw-path external iterator.  This
+    runtime keeps raw pages in host RAM (jax re-uploads per batch), so the
+    batches are assembled into one host matrix (the cache_prefix spill of
+    the reference's SparsePageDMatrix has no equivalent raw path here —
+    binned extmem lives in XGExtMemQuantileDMatrixCreateFromCallback)."""
+    c = _cfg(config)
+    it = _CCallbackIter(iter_addr, proxy, reset_addr, next_addr,
+                        cache_prefix=c.get("cache_prefix"))
+    return _assemble_from_batches(_iter_batches(it),
+                                  float(c.get("missing", np.nan)))
+
+
+def quantile_dmatrix_from_callback(iter_addr: int, proxy, ref,
+                                   reset_addr: int, next_addr: int,
+                                   config: str) -> DMatrix:
+    from .data.dmatrix import QuantileDMatrix
+
+    c = _cfg(config)
+    it = _CCallbackIter(iter_addr, proxy, reset_addr, next_addr)
+    base = _assemble_from_batches(_iter_batches(it),
+                                  float(c.get("missing", np.nan)))
+    if base._kind == "dense":
+        raw = base.host_dense()
+    else:
+        import scipy.sparse as sp
+
+        indptr, indices, values, shape = base._csr
+        raw = sp.csr_matrix((values, indices, indptr), shape=shape)
+    q = QuantileDMatrix(raw, max_bin=int(c.get("max_bin", 256)), ref=ref)
+    q.info = base.info
+    return q
+
+
+def extmem_quantile_dmatrix_from_callback(iter_addr: int, proxy, ref,
+                                          reset_addr: int, next_addr: int,
+                                          config: str) -> DMatrix:
+    from .data.extmem import ExtMemQuantileDMatrix
+
+    c = _cfg(config)
+    it = _CCallbackIter(iter_addr, proxy, reset_addr, next_addr,
+                        cache_prefix=c.get("cache_prefix"))
+    return ExtMemQuantileDMatrix(
+        it, max_bin=int(c.get("max_bin", 256)), ref=ref,
+        missing=float(c.get("missing", np.nan)),
+        on_host=bool(c.get("on_host", True)))
+
+
+# ------------------------------------------------------------- Booster
+def booster_reset(b: Booster) -> None:
+    b._caches.clear()
+
+
+def booster_slice(b: Booster, begin: int, end: int, step: int) -> Booster:
+    if end == 0:
+        end = b.num_boosted_rounds()
+    return b[begin:end:(step or 1)]
+
+
+def booster_train_one_iter(b: Booster, dtrain: DMatrix, it: int,
+                           grad_j: str, hess_j: str) -> None:
+    grad = _from_array_interface(grad_j).astype(np.float32)
+    hess = _from_array_interface(hess_j).astype(np.float32)
+    b.boost(dtrain, grad.reshape(grad.shape[0], -1),
+            hess.reshape(hess.shape[0], -1))
+
+
+def _predict_with_config(b: Booster, d: DMatrix, c: dict):
+    t = int(c.get("type", 0))
+    it_range = (int(c.get("iteration_begin", 0)),
+                int(c.get("iteration_end", 0)))
+    kw = dict(iteration_range=it_range,
+              training=bool(c.get("training", False)))
+    if t == 6:
+        out = b.predict(d, pred_leaf=True, **kw)
+    elif t in (4, 5):
+        out = b.predict(d, pred_interactions=True,
+                        approx_contribs=(t == 5), **kw)
+    elif t in (2, 3):
+        out = b.predict(d, pred_contribs=True, approx_contribs=(t == 3), **kw)
+    else:
+        out = b.predict(d, output_margin=(t == 1), **kw)
+    out = np.asarray(out, np.float32)
+    if bool(c.get("strict_shape", False)) and out.ndim == 1:
+        out = out.reshape(-1, 1)
+    shape = np.asarray(out.shape, np.uint64)
+    flat = np.ascontiguousarray(out.reshape(-1))
+    b._capi_pred_buf = flat
+    b._capi_pred_shape = shape
+    return (int(shape.ctypes.data), int(shape.size),
+            int(flat.ctypes.data))
+
+
+def booster_predict_from_dmatrix(b: Booster, d: DMatrix, config: str):
+    return _predict_with_config(b, d, _cfg(config))
+
+
+def booster_inplace_predict_dense(b: Booster, values_j: str, config: str,
+                                  meta: Optional[DMatrix]):
+    c = _cfg(config)
+    X = _from_array_interface(values_j).astype(np.float32)
+    missing = float(c.get("missing", np.nan))
+    if not np.isnan(missing):
+        X = np.where(X == missing, np.nan, X)
+    d = DMatrix(X)
+    if meta is not None:
+        d.info = meta.info
+    return _predict_with_config(b, d, c)
+
+
+def booster_inplace_predict_csr(b: Booster, indptr_j: str, indices_j: str,
+                                values_j: str, ncol: int, config: str,
+                                meta: Optional[DMatrix]):
+    import scipy.sparse as sp
+
+    c = _cfg(config)
+    indptr = _from_array_interface(indptr_j).astype(np.int64)
+    indices = _from_array_interface(indices_j).astype(np.int64)
+    values = _from_array_interface(values_j).astype(np.float32)
+    missing = float(c.get("missing", np.nan))
+    csr = sp.csr_matrix((values, indices, indptr),
+                        shape=(len(indptr) - 1, int(ncol)))
+    d = DMatrix(_drop_missing_csr(csr, missing))
+    if meta is not None:
+        d.info = meta.info
+    return _predict_with_config(b, d, c)
+
+
+def booster_serialize(b: Booster):
+    buf = bytes(b.serialize())
+    b._capi_serial_buf = buf
+    return len(buf), buf
+
+
+def booster_unserialize(b: Booster, addr: int, n: int) -> None:
+    b.unserialize(bytes(_buf(addr, n, np.uint8)))
+
+
+def booster_save_json_config(b: Booster):
+    out = b.save_config().encode()
+    b._capi_config_str = out
+    return len(out), out
+
+
+def booster_load_json_config(b: Booster, config: str) -> None:
+    b.load_config(config)
+
+
+def booster_dump_model(b: Booster, fmap: str, with_stats: int, fmt: str,
+                       fnames=None, ftypes=None):
+    if fnames:
+        # display names for THIS dump only — the reference builds a local
+        # FeatureMap and leaves the learner untouched
+        names = list(fnames)
+        fmt = fmt or "text"
+        if fmt == "json":
+            dumps = [t.dump_json(names, bool(with_stats)) for t in b.trees]
+        else:
+            dumps = [t.dump_text(names, bool(with_stats)) for t in b.trees]
+    else:
+        dumps = b.get_dump(fmap=fmap or "", with_stats=bool(with_stats),
+                           dump_format=fmt or "text")
+    return _pin_str_array(b, "_capi_dump", dumps)
+
+
+def booster_get_attr_names(b: Booster):
+    return _pin_str_array(b, "_capi_attr_names", sorted(b.attributes))
+
+
+def booster_set_str_feature_info(b: Booster, field: str, names) -> None:
+    if field == "feature_name":
+        b.feature_names = [str(s) for s in names] or None
+    elif field == "feature_type":
+        b.feature_types = [str(s) for s in names] or None
+    else:
+        raise ValueError(f"unknown string feature field {field!r}")
+
+
+def booster_get_str_feature_info(b: Booster, field: str):
+    if field == "feature_name":
+        vals = b.feature_names or []
+    elif field == "feature_type":
+        vals = b.feature_types or []
+    else:
+        raise ValueError(f"unknown string feature field {field!r}")
+    return _pin_str_array(b, "_capi_feat_strinfo", vals)
+
+
+def booster_feature_score(b: Booster, config: str):
+    c = _cfg(config)
+    imp = b.get_score(importance_type=str(c.get("importance_type", "weight")))
+    feats = sorted(imp)
+    scores = np.asarray([imp[f] for f in feats], np.float32)
+    n, feat_addr = _pin_str_array(b, "_capi_score_feats", feats)
+    shape = np.asarray([len(feats)], np.uint64)
+    b._capi_score_shape = shape
+    b._capi_score_vals = scores
+    return (n, feat_addr, int(shape.ctypes.data), 1,
+            int(scores.ctypes.data))
+
+
+# ------------------------------------------------------------- globals
+_build_info_str = None
+
+
+def build_info() -> bytes:
+    global _build_info_str
+    if _build_info_str is None:
+        import jax
+
+        _build_info_str = json.dumps({
+            "USE_TPU": True, "USE_CUDA": False, "USE_NCCL": False,
+            "USE_FEDERATED": True, "JAX_VERSION": jax.__version__,
+            "libc": "glibc", "BUILTIN_PREFETCH_PRESENT": True,
+        }).encode()
+    return _build_info_str
+
+
+_global_config_str = None
+
+
+def set_global_config(config: str) -> None:
+    from . import config as _config
+
+    _config.set_config(**json.loads(config))
+
+
+def get_global_config() -> bytes:
+    global _global_config_str
+    from . import config as _config
+
+    _global_config_str = json.dumps(_config.get_config()).encode()
+    return _global_config_str
+
+
+# ------------------------------------------------- collective + tracker
+def communicator_init(config: str) -> None:
+    from . import collective
+
+    c = _cfg(config)
+    collective.init(**{k.lower(): v for k, v in c.items()})
+
+
+def communicator_finalize() -> None:
+    from . import collective
+
+    collective.finalize()
+
+
+def communicator_get_rank() -> int:
+    from . import collective
+
+    return collective.get_rank()
+
+
+def communicator_get_world_size() -> int:
+    from . import collective
+
+    return collective.get_world_size()
+
+
+def communicator_is_distributed() -> int:
+    from . import collective
+
+    return int(collective.is_distributed())
+
+
+def communicator_print(msg: str) -> None:
+    from . import collective
+
+    collective.communicator_print(msg)
+
+
+_procname_buf = None
+
+
+def communicator_get_processor_name() -> bytes:
+    global _procname_buf
+    from . import collective
+
+    _procname_buf = collective.get_processor_name().encode()
+    return _procname_buf
+
+
+def communicator_broadcast(addr: int, size: int, root: int) -> None:
+    from . import collective
+
+    buf = _buf(addr, size, np.uint8)
+    out = collective.broadcast(buf.tobytes(), root)
+    ctypes.memmove(addr, bytes(out), size)
+
+
+_ALLREDUCE_DTYPES = {0: np.float16, 1: np.float32, 2: np.float64,
+                     4: np.int8, 5: np.int16, 6: np.int32, 7: np.int64,
+                     8: np.uint8, 9: np.uint16, 10: np.uint32, 11: np.uint64}
+
+
+def communicator_allreduce(addr: int, count: int, data_type: int,
+                           op: int) -> None:
+    from . import collective
+
+    dt = _ALLREDUCE_DTYPES[int(data_type)]
+    buf = _buf(addr, count, dt)
+    out = np.asarray(collective.allreduce(buf, collective.Op(op)), dt)
+    ctypes.memmove(addr, np.ascontiguousarray(out).ctypes.data,
+                   count * np.dtype(dt).itemsize)
+
+
+def tracker_create(config: str):
+    from .tracker import RabitTracker
+
+    c = _cfg(config)
+    return RabitTracker(
+        n_workers=int(c.get("n_workers", c.get("n_trees", 0)) or 0),
+        host_ip=str(c.get("host", c.get("host_ip", "auto")) or "auto"),
+        port=int(c.get("port", 0) or 0),
+        sortby=str(c.get("sortby", "host")),
+        timeout=int(c.get("timeout", 0) or 0))
+
+
+def tracker_worker_args(t) -> bytes:
+    out = json.dumps({k: str(v) for k, v in t.worker_args().items()}).encode()
+    t._capi_args_str = out
+    return out
+
+
+def tracker_run(t, config: str) -> None:
+    t.start()
+
+
+def tracker_wait_for(t, config: str) -> None:
+    c = _cfg(config)
+    t.wait_for(timeout=int(c.get("timeout", 0) or 0))
+
+
+def tracker_free(t) -> None:
+    t.free()
+
+
+# ---- columnar / CSC / info-interface ingestion ----
+def _columnar_to_dense(data_json) -> np.ndarray:
+    """Columnar table = JSON list of per-column __array_interface__ objects
+    (reference: src/data/adapter.h ColumnarAdapter, arrow layout)."""
+    cols = json.loads(data_json) if isinstance(data_json, (str, bytes)) else data_json
+    out = []
+    for spec in cols:
+        if isinstance(spec, dict) and "mask" in spec:
+            vals = _from_array_interface(spec).reshape(-1).astype(np.float32)
+            mask_spec = spec["mask"]
+            bits = _from_array_interface(mask_spec).reshape(-1)
+            valid = np.unpackbits(bits.view(np.uint8),
+                                  bitorder="little")[: len(vals)].astype(bool)
+            vals = np.where(valid, vals, np.nan)
+        else:
+            vals = _from_array_interface(spec).reshape(-1).astype(np.float32)
+        out.append(vals)
+    return np.stack(out, axis=1)
+
+
+def dmatrix_from_columnar(data_json: str, config: str) -> DMatrix:
+    c = _cfg(config)
+    return DMatrix(_columnar_to_dense(data_json),
+                   missing=float(c.get("missing", np.nan)))
+
+
+def proxy_set_columnar(p: "_ProxyDMatrix", data_json: str) -> None:
+    p.data = _columnar_to_dense(data_json)
+
+
+def booster_inplace_predict_columnar(b: Booster, values_j: str, config: str,
+                                     meta: Optional[DMatrix]):
+    c = _cfg(config)
+    d = DMatrix(_columnar_to_dense(values_j))
+    if meta is not None:
+        d.info = meta.info
+    return _predict_with_config(b, d, c)
+
+
+def dmatrix_from_csc_ai(indptr_j: str, indices_j: str, data_j: str,
+                        nrow: int, config: str) -> DMatrix:
+    import scipy.sparse as sp
+
+    c = _cfg(config)
+    indptr = _from_array_interface(indptr_j).astype(np.int64)
+    indices = _from_array_interface(indices_j).astype(np.int64)
+    data = _from_array_interface(data_j).astype(np.float32)
+    missing = float(c.get("missing", np.nan))
+    csc = sp.csc_matrix((data, indices, indptr),
+                        shape=(int(nrow), len(indptr) - 1))
+    return DMatrix(_drop_missing_csr(csc.tocsr(), missing))
+
+
+_INFO_FLOAT_FIELDS = ("label", "weight", "base_margin", "label_lower_bound",
+                      "label_upper_bound", "feature_weights")
+
+
+def dmatrix_set_info_from_interface(d: DMatrix, field: str,
+                                    data_json: str) -> None:
+    arr = _from_array_interface(data_json)
+    if field in _INFO_FLOAT_FIELDS:
+        dmatrix_set_float_info_values(d, field, arr.astype(np.float32))
+    elif field in ("group", "qid"):
+        if field == "qid":
+            d.set_qid(arr.astype(np.int64).reshape(-1))
+        else:
+            d.set_group(arr.astype(np.int64).reshape(-1))
+    else:
+        raise ValueError(f"unknown info field {field!r}")
+
+
+def dmatrix_set_float_info_values(d: DMatrix, field: str,
+                                  vals: np.ndarray) -> None:
+    if field == "label":
+        d.set_label(vals)
+    elif field == "weight":
+        d.set_weight(vals)
+    elif field == "base_margin":
+        d.set_base_margin(vals)
+    elif field == "feature_weights":
+        d.info.feature_weights = vals
+    else:
+        setattr(d.info, field, vals)
+
+
+def dmatrix_set_dense_info(d: DMatrix, field: str, addr: int, n: int,
+                           dtype_code: int) -> None:
+    # xgboost::DataType: 1=f32 2=f64 3=u32 4=u64
+    dt = {1: np.float32, 2: np.float64, 3: np.uint32, 4: np.uint64}[dtype_code]
+    arr = _buf(addr, n, dt)
+    if field in ("group", "qid"):
+        if field == "qid":
+            d.set_qid(arr.astype(np.int64))
+        else:
+            d.set_group(arr.astype(np.int64))
+    else:
+        dmatrix_set_float_info_values(d, field, arr.astype(np.float32))
+
+
+def dmatrix_get_info_ref(d: DMatrix, field: str) -> bytes:
+    """Array-interface JSON view of an info field (XGDMatrixGetInfoRef)."""
+    if field in _INFO_FLOAT_FIELDS:
+        v = getattr(d.info, field, None)
+        arr = (np.zeros(0, np.float32) if v is None
+               else np.ascontiguousarray(v, np.float32))
+    elif field == "group_ptr":
+        v = d.info.group_ptr
+        arr = (np.zeros(0, np.uint64) if v is None
+               else np.ascontiguousarray(v, np.uint64))
+    else:
+        raise ValueError(f"unknown info field {field!r}")
+    d._capi_inforef = arr
+    out = json.dumps({"data": [int(arr.ctypes.data), True],
+                      "shape": [int(arr.size)], "typestr": arr.dtype.str,
+                      "version": 3}).encode()
+    d._capi_inforef_json = out
+    return out

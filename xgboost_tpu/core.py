@@ -1819,19 +1819,22 @@ class Booster:
             with open(fname, "w") as fh:
                 json.dump(obj, fh)
 
-    def save_raw_dict(self) -> dict:
-        self._configure()
-        n_feat = self.num_features()
+    def _base_score_str(self) -> str:
+        """base_score in probability space, reference model-JSON form
+        (scalar, or upstream ≥3.x bracketed vector for per-group offsets)."""
         base_margins = np.asarray(self.base_score, np.float32).reshape(-1)
         base_probs = [
             float(np.asarray(self.objective.margin_to_prob(np.float32(m))))
             for m in base_margins
         ]
         if len(base_probs) > 1 and not np.allclose(base_probs, base_probs[0]):
-            # per-group offsets: upstream ≥3.x bracketed-vector form
-            base = "[" + ",".join(f"{p:.9E}" for p in base_probs) + "]"
-        else:
-            base = f"{base_probs[0]:.9E}"
+            return "[" + ",".join(f"{p:.9E}" for p in base_probs) + "]"
+        return f"{base_probs[0]:.9E}"
+
+    def save_raw_dict(self) -> dict:
+        self._configure()
+        n_feat = self.num_features()
+        base = self._base_score_str()
         obj_conf = {"name": self.objective.name}
         if self.objective.name.startswith("multi:"):
             obj_conf["softmax_multiclass_param"] = {"num_class": str(self.num_class)}
@@ -2003,6 +2006,193 @@ class Booster:
         dump_ubjson(obj, buf)
         return bytearray(buf.getvalue())
 
+    # ---- training-configuration IO (reference: learner.cc:625 SaveConfig /
+    # :570 LoadConfig; C API XGBoosterSaveJsonConfig, c_api.cc:1379 area).
+    # The model files above carry the MODEL; these carry the training
+    # configuration, so a restored process continues training identically.
+    def _config_dict(self) -> dict:
+        import dataclasses as _dc
+
+        from .params import KNOWN_LEARNER_KEYS, TrainParam
+
+        self._configure()
+
+        def s(v):
+            if isinstance(v, bool):
+                return "1" if v else "0"
+            if isinstance(v, (list, tuple, dict)):
+                return json.dumps(v)
+            return str(v)
+
+        params = {k: v for k, v in self.params.items() if v is not None}
+        tree_keys = {("lambda" if f.name == "lambda_" else f.name)
+                     for f in _dc.fields(TrainParam)}
+        hist_param = {}
+        for k in sorted(tree_keys):
+            v = getattr(self.tparam, "lambda_" if k == "lambda" else k)
+            if v is not None:
+                hist_param[k] = s(v)
+        placed = set(tree_keys)
+
+        def take(section: dict, key: str, default=None) -> None:
+            if key in params:
+                section[key] = s(params[key])
+                placed.add(key)
+            elif default is not None:
+                section[key] = s(default)
+
+        learner_train = {"booster": self.booster_kind,
+                         "objective": self.objective.name}
+        placed |= {"booster", "objective"}
+        take(learner_train, "disable_default_eval_metric", 0)
+        take(learner_train, "multi_strategy",
+             getattr(self, "multi_strategy", "one_output_per_tree"))
+
+        generic = {}
+        take(generic, "device", "tpu")
+        take(generic, "seed", 0)
+        take(generic, "seed_per_iteration", 0)
+        take(generic, "nthread", 0)
+        take(generic, "validate_parameters", 0)
+
+        gb: dict = {"name": self.booster_kind}
+        if self.booster_kind == "gblinear":
+            lin = {}
+            for k in ("updater", "feature_selector", "top_k", "eta"):
+                take(lin, k)
+            lin["lambda"] = hist_param.get("lambda", "0")
+            lin["alpha"] = hist_param.get("alpha", "0")
+            gb["gblinear_train_param"] = lin
+        else:
+            gbt = {"num_parallel_tree": s(self.num_parallel_tree)}
+            placed.add("num_parallel_tree")
+            take(gbt, "process_type", "default")
+            take(gbt, "tree_method", "hist")
+            take(gbt, "updater")
+            gb["gbtree_train_param"] = gbt
+            gb["updater"] = {
+                "grow_quantile_histmaker": {"hist_train_param": hist_param}}
+            if self.booster_kind == "dart":
+                dart = {}
+                for k in ("rate_drop", "one_drop", "skip_drop",
+                          "sample_type", "normalize_type"):
+                    take(dart, k)
+                gb["dart_train_param"] = dart
+
+        obj_sec: dict = {"name": self.objective.name}
+        obj_keys = ("scale_pos_weight", "num_class", "tweedie_variance_power",
+                    "huber_slope", "quantile_alpha", "expectile_alpha",
+                    "aft_loss_distribution", "aft_loss_distribution_scale",
+                    "lambdarank_num_pair_per_sample", "lambdarank_pair_method",
+                    "ndcg_exp_gain", "lambdarank_unbiased",
+                    "lambdarank_bias_norm")
+        for k in obj_keys:
+            take(obj_sec, k)
+
+        metric_names = params.get("eval_metric")
+        if metric_names is None:
+            metrics = []
+        elif isinstance(metric_names, (list, tuple)):
+            metrics = [{"name": str(m)} for m in metric_names]
+        else:
+            metrics = [{"name": str(metric_names)}]
+        placed.add("eval_metric")
+
+        # user-set params not covered by a named section ride in
+        # generic_param (the reference Context also carries a grab-bag of
+        # runtime keys there) so load_config restores EVERYTHING
+        for k in sorted(params):
+            if k not in placed and k in (KNOWN_LEARNER_KEYS | tree_keys):
+                generic[k] = s(params[k])
+
+        return {
+            "version": [3, 1, 0],
+            "learner": {
+                "generic_param": generic,
+                "gradient_booster": gb,
+                "learner_model_param": {
+                    "base_score": ("5E-1" if self._base_margin_value is None
+                                   else self._base_score_str()),
+                    "num_class": str(self.num_class),
+                    "num_feature": str(self.num_features()),
+                    "num_target": str(self.n_groups if self.num_class == 0
+                                      else 1),
+                },
+                "learner_train_param": learner_train,
+                "metrics": metrics,
+                "objective": obj_sec,
+            },
+        }
+
+    def save_config(self) -> str:
+        """Current training configuration as a JSON string (reference:
+        Booster.save_config / XGBoosterSaveJsonConfig)."""
+        return json.dumps(self._config_dict())
+
+    def load_config(self, config: Union[str, bytes, dict]) -> None:
+        """Restore a save_config() snapshot (reference learner.cc:570
+        LoadConfig): collects every parameter leaf from the reference-shaped
+        sections and applies it, so continued training behaves identically."""
+        import dataclasses as _dc
+
+        from .params import KNOWN_LEARNER_KEYS, TrainParam
+
+        obj = config if isinstance(config, dict) else json.loads(config)
+        learner = obj.get("learner", obj)
+        tree_keys = {("lambda" if f.name == "lambda_" else f.name)
+                     for f in _dc.fields(TrainParam)}
+        known = KNOWN_LEARNER_KEYS | tree_keys
+        collected: Dict[str, Any] = {}
+
+        def walk(d: dict) -> None:
+            for k, v in d.items():
+                if k == "learner_model_param":
+                    continue  # model state, not configuration
+                if isinstance(v, dict):
+                    walk(v)
+                elif k != "name" and isinstance(v, (str, int, float, bool)):
+                    if k in known:
+                        collected[k] = v
+
+        walk(learner)
+        metrics = learner.get("metrics") or []
+        names = [m["name"] if isinstance(m, dict) else str(m) for m in metrics]
+        if names:
+            collected["eval_metric"] = names
+        else:
+            collected.pop("eval_metric", None)
+        booster_name = learner.get("gradient_booster", {}).get("name")
+        if booster_name:
+            collected["booster"] = booster_name
+        if collected:
+            self.set_param(collected)
+
+    def serialize(self) -> bytearray:
+        """Full-state snapshot {"Model": ..., "Config": ...} in UBJSON
+        (reference learner.cc:987 Save; C API XGBoosterSerializeToBuffer,
+        learner.cc:992): model + training configuration in one buffer."""
+        from io import BytesIO
+
+        from .utils.ubjson import dump_ubjson
+
+        snap = {"Model": self.save_raw_dict(), "Config": self._config_dict()}
+        buf = BytesIO()
+        dump_ubjson(snap, buf)
+        return bytearray(buf.getvalue())
+
+    def unserialize(self, buf: Union[bytes, bytearray]) -> None:
+        """Restore a serialize() snapshot (learner.cc:1003 Load)."""
+        import io
+
+        from .utils.ubjson import load_ubjson
+
+        try:
+            snap = json.loads(buf)
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            snap = load_ubjson(io.BytesIO(bytes(buf)))
+        self.load_model_dict(snap["Model"])
+        self.load_config(snap["Config"])
+
     # attributes API (reference: core.py attr/set_attr)
     def attr(self, key: str) -> Optional[str]:
         return self.attributes.get(key)
@@ -2042,10 +2232,22 @@ class Booster:
         return self[0 : self.num_boosted_rounds()]
 
     def get_dump(self, fmap: str = "", with_stats: bool = False, dump_format: str = "text"):
+        names = self.feature_names
+        if fmap:
+            # feature-map file: "<id>\t<name>\t<type>" per line
+            # (reference: src/common/feature_map.h LoadText)
+            names = list(names or [f"f{i}" for i in range(self.num_features())])
+            with open(fmap) as fh:
+                for line in fh:
+                    parts = line.split()
+                    if len(parts) >= 2:
+                        fid = int(parts[0])
+                        while len(names) <= fid:
+                            names.append(f"f{len(names)}")
+                        names[fid] = parts[1]
         if dump_format == "json":
-            return [json.dumps(t.to_json_dict(self.num_features(), tree_id=i))
-                    for i, t in enumerate(self.trees)]
-        return [t.dump_text(self.feature_names, with_stats) for t in self.trees]
+            return [t.dump_json(names, with_stats) for t in self.trees]
+        return [t.dump_text(names, with_stats) for t in self.trees]
 
     def get_score(self, fmap: str = "", importance_type: str = "weight") -> Dict[str, float]:
         """Feature importance (reference: core.py get_score)."""

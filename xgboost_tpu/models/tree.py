@@ -354,3 +354,36 @@ class RegTree:
 
         rec(0, 0)
         return "\n".join(lines) + "\n"
+
+    def dump_json(self, feature_names: Optional[List[str]] = None,
+                  with_stats: bool = False) -> str:
+        """Reference dump format (tree_model.cc JsonGenerator): nested
+        nodeid/split/children objects — distinct from the model schema."""
+        import json as _json
+
+        def fname(fid: int) -> str:
+            return feature_names[fid] if feature_names else f"f{fid}"
+
+        def rec(nid: int, depth: int) -> dict:
+            if self.is_leaf(nid):
+                d = {"nodeid": int(nid),
+                     "leaf": float(self.split_conditions[nid])}
+                if with_stats:
+                    d["cover"] = float(self.sum_hessian[nid])
+                return d
+            yes, no = int(self.left_children[nid]), int(self.right_children[nid])
+            d = {"nodeid": int(nid), "depth": int(depth),
+                 "split": fname(int(self.split_indices[nid]))}
+            if self.categories and nid in self.categories:
+                d["split_condition"] = [int(c) for c in self.categories[nid]]
+            else:
+                d["split_condition"] = float(self.split_conditions[nid])
+            d.update(yes=yes, no=no,
+                     missing=yes if self.default_left[nid] else no)
+            if with_stats:
+                d.update(gain=float(self.loss_changes[nid]),
+                         cover=float(self.sum_hessian[nid]))
+            d["children"] = [rec(yes, depth + 1), rec(no, depth + 1)]
+            return d
+
+        return _json.dumps(rec(0, 0))
