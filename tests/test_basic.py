@@ -199,3 +199,33 @@ def test_streamed_sparse_predict_bounded_memory():
     d_head_X[d_head_X == 0.0] = np.nan  # CSR implicit zeros are missing
     p_head = bst.predict(xtb.DMatrix(d_head_X))
     np.testing.assert_array_equal(p_big[:head], p_head)
+
+
+def test_feature_weights_bias_column_sampling():
+    """feature_weights drives weighted column sampling (reference:
+    src/common/random.h WeightedSamplingWithoutReplacement) — zero-weight
+    features are never drawn, heavier features are drawn more often."""
+    rng = np.random.default_rng(0)
+    F = 6
+    X = rng.normal(size=(300, F)).astype(np.float32)
+    y = (X[:, 4] + X[:, 5] > 0).astype(np.float32)
+    fw = np.array([0.0, 0.0, 1.0, 1.0, 4.0, 4.0], np.float32)
+    d = xtb.DMatrix(X, label=y, feature_weights=fw)
+
+    bst = xtb.train({"colsample_bytree": 0.5, "max_depth": 2},
+                    d, 2, verbose_eval=False)
+    counts = np.zeros(F)
+    for it in range(300):
+        fmask = bst._feature_masks(it, 0, F, fw)
+        m = np.asarray(fmask(0, 1))[0]
+        assert m.sum() == 3  # exactly max(1, 0.5*6) features
+        counts += m
+    assert counts[0] == 0 and counts[1] == 0
+    assert counts[4] > counts[2] and counts[5] > counts[3]
+
+    # wrong length / negative weights rejected
+    import pytest
+    with pytest.raises(ValueError):
+        bst._feature_masks(0, 0, F, np.ones(F - 1))
+    with pytest.raises(ValueError):
+        bst._feature_masks(0, 0, F, -np.ones(F))

@@ -215,3 +215,50 @@ print(json.dumps({{"auc": ev["t"]["auc"][-1], "root": root_feat}}))
     assert abs(ev["t"]["auc"][-1] - res["auc"]) < 0.01, (ev["t"]["auc"][-1], res)
     ours_root = f"f{bst.trees[0].split_indices[0]}"
     assert ours_root == res["root"], (ours_root, res["root"])
+
+
+def test_exact_two_process_matches_single():
+    """Distributed exact (updater_sync.cc role): every rank gathers the full
+    row set, trees grow from identical inputs, rank 0 broadcasts — the
+    2-worker model must equal the single-process model bitwise."""
+    import threading
+
+    from xgboost_tpu import collective
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(900, 5)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.2 * rng.normal(size=900)).astype(np.float32)
+
+    params = {"objective": "reg:squarederror", "tree_method": "exact",
+              "max_depth": 4, "eta": 0.5}
+    single = xtb.train(params, xtb.DMatrix(X, label=y), 3, verbose_eval=False)
+    want = "".join(single.get_dump(dump_format="json"))
+
+    results, errors = {}, {}
+
+    def worker(rank, world):
+        try:
+            with collective.CommunicatorContext(
+                    dmlc_communicator="in-memory",
+                    in_memory_world_size=world, in_memory_rank=rank,
+                    in_memory_group="exact2"):
+                lo, hi = (0, 450) if rank == 0 else (450, 900)
+                d = xtb.DMatrix(X[lo:hi], label=y[lo:hi])
+                bst = xtb.train(params, d, 3, verbose_eval=False)
+                results[rank] = "".join(bst.get_dump(dump_format="json"))
+        except Exception as e:  # noqa: BLE001
+            errors[rank] = e
+            try:
+                collective._TLS.backend._group.barrier.abort()
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(r, 2), daemon=True)
+               for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    assert not any(t.is_alive() for t in threads), "worker deadlocked"
+    assert not errors, errors
+    assert results[0] == results[1] == want

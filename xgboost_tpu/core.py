@@ -650,7 +650,8 @@ class Booster:
         cat_mask_np = (np.asarray([t == "c" for t in cat_ft], bool)
                        if cat_ft and "c" in cat_ft else None)
         for p_idx in range(max(self.num_parallel_tree, 1)):
-            fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx, d.num_col())
+            fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx, d.num_col(),
+                                           d.info.feature_weights)
             gp_all = self._subsample_mask(gpair, iteration * 131 + p_idx)
             for k in range(K):
                 state = grower.grow(
@@ -761,36 +762,67 @@ class Booster:
         seed = int(self.params.get("seed", 0))
         return np.random.default_rng((seed * 1_000_003 + iteration * 131 + tag) % (2**63))
 
-    def _feature_masks(self, iteration: int, group: int, n_features: int):
-        """ColumnSampler (reference: src/common/random.h ColumnSampler)."""
+    def _feature_masks(self, iteration: int, group: int, n_features: int,
+                       feature_weights=None):
+        """ColumnSampler (reference: src/common/random.h ColumnSampler):
+        each level samples exactly max(1, frac*n_avail) of the surviving
+        features without replacement; with ``feature_weights`` set the draw
+        is weighted (WeightedSamplingWithoutReplacement — the
+        Efraimidis-Spirakis exponential-key method)."""
         tp = self.tparam
+        fw = None
+        if feature_weights is not None:
+            # validate unconditionally (accept-and-ignore is how the silent
+            # no-op the reference never had slips back in)
+            fw = np.asarray(feature_weights, np.float64).reshape(-1)
+            if fw.shape[0] != n_features:
+                raise ValueError(
+                    f"feature_weights has {fw.shape[0]} entries for "
+                    f"{n_features} features")
+            if (fw < 0).any():
+                raise ValueError("feature_weights must be non-negative")
+            if not (fw > 0).any():
+                raise ValueError("feature_weights sums to zero")
         if tp.colsample_bytree >= 1.0 and tp.colsample_bylevel >= 1.0 and tp.colsample_bynode >= 1.0:
             return None
         rng = self._rng(iteration, 17 + group)
 
-        def sample(prev_mask, frac, shape):
+        def sample(prev_mask, frac):
             if frac >= 1.0:
                 return prev_mask
-            m = prev_mask & (rng.random(shape if isinstance(shape, tuple) else (shape,)) < frac)
-            # guarantee at least one feature (reference ColumnSampler resamples)
-            bad = ~m.any(axis=-1)
-            if np.any(bad):
-                choices = rng.integers(0, n_features, size=int(np.sum(bad)))
-                if m.ndim == 1:
-                    m[choices[0]] = True
-                else:
-                    m[np.nonzero(bad)[0], choices] = True
-            return m
+            m2 = np.atleast_2d(prev_mask)
+            rows, F = m2.shape
+            # exponential keys / weight, k smallest per row = a weighted
+            # (uniform when fw is None) draw of k features w/o replacement,
+            # vectorized across nodes
+            w_row = np.ones(F, np.float64) if fw is None else fw
+            with np.errstate(divide="ignore"):
+                keys = rng.exponential(size=(rows, F)) / w_row
+            keys = np.where(m2 & (w_row > 0), keys, np.inf)
+            n_ok = np.isfinite(keys).sum(axis=1)
+            if np.any(n_ok == 0):
+                raise ValueError(
+                    "feature_weights leaves no sampleable feature")
+            k = np.minimum(
+                np.maximum(1, (frac * m2.sum(axis=1)).astype(np.int64)),
+                n_ok)
+            order = np.argsort(keys, axis=1, kind="stable")
+            ranks = np.empty_like(order)
+            np.put_along_axis(
+                ranks, order,
+                np.broadcast_to(np.arange(F), (rows, F)).copy(), axis=1)
+            out = ranks < k[:, None]
+            return out if prev_mask.ndim == 2 else out[0]
 
-        tree_mask = sample(np.ones(n_features, bool), tp.colsample_bytree, n_features)
+        tree_mask = sample(np.ones(n_features, bool), tp.colsample_bytree)
 
         def per_level(depth: int, n_nodes: int):
             import jax.numpy as jnp
 
-            m = sample(tree_mask.copy(), tp.colsample_bylevel, n_features)
+            m = sample(tree_mask, tp.colsample_bylevel)
             if tp.colsample_bynode < 1.0:
                 mm = np.broadcast_to(m, (n_nodes, n_features)).copy()
-                mm = sample(mm, tp.colsample_bynode, (n_nodes, n_features))
+                mm = sample(mm, tp.colsample_bynode)
                 return jnp.asarray(mm)
             return jnp.asarray(m[None, :])
 
@@ -871,7 +903,8 @@ class Booster:
         n_features = cache.dmat.num_col()
         for p_idx in range(max(self.num_parallel_tree, 1)):
             fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx,
-                                           n_features)
+                                           n_features,
+                                           cache.dmat.info.feature_weights)
             gp = self._subsample_mask(gpair, iteration * 131 + p_idx)
             for k in range(K):
                 tree, delta = self._grow_exact_one(cache, gp, k, fmask_fn,
@@ -897,10 +930,11 @@ class Booster:
         from .tree.exact import grow_exact
 
         tp = self.tparam
-        if self._process_parallel() or self._get_mesh() is not None:
+        proc = self._process_parallel()
+        if self._get_mesh() is not None:
             raise NotImplementedError(
-                "tree_method='exact' is single-host only (the reference "
-                "forbids exact under dask/distributed training too)")
+                "tree_method='exact' is host-side greedy enumeration; an "
+                "in-process device mesh gives it nothing — use hist")
         if cache.dmat.cat_mask() is not None and np.any(cache.dmat.cat_mask()):
             raise NotImplementedError(
                 "tree_method='exact' does not support categorical features "
@@ -916,14 +950,42 @@ class Booster:
         # colmaker builds its SortedCSC once per Update too); reuse the DART
         # path's device copy rather than recoding a second host copy
         if getattr(cache, "exact_X", None) is None:
-            cache.exact_X = (np.asarray(cache.raw_X)
-                             if cache.raw_X is not None
-                             else self._host_dense_recoded(cache.dmat))
+            X_local = (np.asarray(cache.raw_X)
+                       if cache.raw_X is not None
+                       else self._host_dense_recoded(cache.dmat))
+            if proc:
+                # distributed exact, the updater_sync.cc pattern: every rank
+                # sees the FULL row set (exact is a small-data method — the
+                # reference steers big data to hist), trees are grown from
+                # identical inputs and rank 0's copy is broadcast so the
+                # model is bitwise-identical everywhere
+                from . import collective
+
+                sizes = collective.allgather(
+                    np.asarray([X_local.shape[0]], np.int64))[:, 0]
+                cache.exact_row_start = int(
+                    sizes[: collective.get_rank()].sum())
+                cache.exact_n_local = int(X_local.shape[0])
+                cache.exact_X = collective.allgather_ragged(X_local)
+            else:
+                cache.exact_X = X_local
             cache.exact_order = np.argsort(cache.exact_X, axis=0,
                                            kind="stable").astype(np.int32)
         X = cache.exact_X
         R = X.shape[0]
-        gh = np.asarray(gp[:R, k, :], np.float64)
+        R_local = getattr(cache, "exact_n_local", R)
+        row_start = getattr(cache, "exact_row_start", 0)
+
+        def gather_rows(a: np.ndarray) -> np.ndarray:
+            if not proc:
+                return a
+            from . import collective
+
+            return collective.allgather_ragged(np.asarray(a))
+
+        gh = np.asarray(
+            gather_rows(np.asarray(gp[:R_local, k, :], np.float64)),
+            np.float64)
         tree, pos = grow_exact(
             X, gh[:, 0], gh[:, 1],
             max_depth=int(tp.max_depth), max_leaves=int(tp.max_leaves),
@@ -950,14 +1012,20 @@ class Booster:
             # refit each leaf to the weighted alpha-quantile of residuals
             # (against the RUNNING margin so num_parallel_tree>1 members
             # see earlier members' contributions, like the hist path)
-            labels = np.asarray(cache.labels)[:R]
+            if getattr(cache, "exact_adaptive_meta", None) is None:
+                # labels/valid/weights are round-invariant: gather once
+                cache.exact_adaptive_meta = (
+                    gather_rows(np.asarray(cache.labels)[:R_local]),
+                    gather_rows(
+                        np.asarray(cache.valid)[:R_local]).astype(bool),
+                    (gather_rows(np.asarray(cache.weights)[:R_local])
+                     if cache.weights is not None else None),
+                )
+            labels, valid, w = cache.exact_adaptive_meta
             margin_src = cache.margin if new_margin is None else new_margin
-            margin_k = np.asarray(margin_src)[:R, k]
+            margin_k = gather_rows(np.asarray(margin_src)[:R_local, k])
             residual = labels - margin_k
-            valid = np.asarray(cache.valid)[:R].astype(bool)
             alpha_q = float(self.objective.adaptive_alpha(k))
-            w = (np.asarray(cache.weights)[:R]
-                 if cache.weights is not None else None)
             for nid in np.nonzero(tree.left_children == -1)[0]:
                 m = (pos == nid) & valid
                 if not np.any(m):
@@ -970,8 +1038,18 @@ class Booster:
                     cw = np.cumsum(w[m][srt])
                     q = res[srt][np.searchsorted(cw, alpha_q * cw[-1])]
                 tree.split_conditions[nid] = np.float32(float(tp.eta) * q)
+        if proc:
+            # sync role (updater_sync.cc TreeSyncher): rank 0's tree is
+            # authoritative — identical by construction, broadcast makes it
+            # bitwise-guaranteed
+            from . import collective
+            from .models.tree import RegTree
+
+            tree = RegTree.from_json_dict(
+                collective.broadcast(tree.to_json_dict(0, 0), 0))
         delta = np.zeros(cache.margin.shape[0], np.float32)
-        delta[:R] = tree.split_conditions[pos]
+        delta[:R_local] = tree.split_conditions[pos][
+            row_start:row_start + R_local]
         return tree, delta
 
     def _boost_multi_target(self, cache: _Cache, gpair, iteration: int,
@@ -1024,7 +1102,8 @@ class Booster:
         new_margin = cache.margin
         for p_idx in range(max(self.num_parallel_tree, 1)):
             fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx,
-                                           ell.n_features)
+                                           ell.n_features,
+                                           cache.dmat.info.feature_weights)
             gp = self._subsample_mask(gpair, iteration * 131 + p_idx)
             state = grower.grow(cache.bins, gp, cache.valid, ell.cuts_pad,
                                 ell.n_bins, feature_masks=fmask_fn)
@@ -1321,7 +1400,8 @@ class Booster:
 
                 (bins_use,) = shard_rows(self._get_mesh(), bins_use)
         for p_idx in range(max(self.num_parallel_tree, 1)):
-            fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx, ell.n_features)
+            fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx, ell.n_features,
+                                           cache.dmat.info.feature_weights)
             # one independent subsample per parallel tree (reference: each
             # member of the forest draws its own rows)
             gp = self._subsample_mask(gpair, iteration * 131 + p_idx)
@@ -1461,26 +1541,6 @@ class Booster:
                 ub = dmat.info.label_upper_bound
                 mkw["y_upper"] = (np.full_like(mkw["y_lower"], np.inf)
                                   if ub is None else ub)
-            if proc_par:
-                # distributed eval: every rank must report the GLOBAL metric
-                # (the reference allreduces per-metric partials; gathering the
-                # shards is exact for every metric incl. AUC/NDCG and keeps
-                # early stopping in lockstep across workers)
-                from . import collective
-
-                preds = collective.allgather_ragged(np.asarray(preds))
-                labels = collective.allgather_ragged(np.asarray(labels))
-                if weights is not None:
-                    weights = collective.allgather_ragged(np.asarray(weights))
-                if mkw.get("group_ptr") is not None:
-                    sizes = np.diff(mkw["group_ptr"]).astype(np.int64)
-                    all_sizes = collective.allgather_ragged(sizes)
-                    mkw["group_ptr"] = np.concatenate(
-                        [[0], np.cumsum(all_sizes)]).astype(np.int64)
-                for key in ("y_lower", "y_upper"):
-                    if key in mkw:
-                        mkw[key] = collective.allgather_ragged(
-                            np.asarray(mkw[key]))
             if hasattr(self.objective, "dist"):
                 mkw["dist"] = self.objective.dist
                 mkw["sigma"] = self.objective.sigma
@@ -1502,7 +1562,17 @@ class Booster:
                         if np.ndim(preds) == 2 and np.ndim(lab) == 1:
                             lab = np.repeat(np.asarray(lab)[:, None],
                                             preds.shape[1], axis=1)
-                v = fn(preds, lab, weights, **kw)
+                if proc_par:
+                    # distributed eval: every rank reports the GLOBAL metric
+                    # via per-metric partial-sum allreduce (the reference's
+                    # aggregator.h GlobalSum/GlobalRatio design) — O(local)
+                    # memory per rank, early stopping stays in lockstep
+                    from .metric import distributed_reduction
+
+                    with distributed_reduction():
+                        v = fn(preds, lab, weights, **kw)
+                else:
+                    v = fn(preds, lab, weights, **kw)
                 msgs.append(f"{name}-{mname}:{v:g}")
             if feval is not None:
                 res = feval(margin if output_margin else preds, dmat)

@@ -8,11 +8,40 @@ partials exactly like the reference's allreduce-of-partials design.
 """
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, Optional
 
 import numpy as np
 
 _REGISTRY: Dict[str, Callable] = {}
+
+_DIST = threading.local()
+
+
+class distributed_reduction:
+    """While active (per thread), metric helpers allreduce their partial
+    sums across the collective, so every rank reports the GLOBAL metric
+    from O(local) memory — the reference's allreduce-of-partials design
+    (src/collective/aggregator.h GlobalSum/GlobalRatio,
+    src/metric/elementwise_metric.cu, auc.cc:124-126)."""
+
+    def __enter__(self):
+        _DIST.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _DIST.on = False
+        return False
+
+
+def _reduce_sums(*vals: float):
+    """allreduce-SUM scalars when distributed reduction is active."""
+    if not getattr(_DIST, "on", False):
+        return vals
+    from .. import collective
+
+    out = collective.allreduce(np.asarray(vals, np.float64))
+    return tuple(float(v) for v in out)
 
 
 def register_metric(name: str):
@@ -47,8 +76,11 @@ def _w(labels, weights):
 def _wmean(err, labels, weights):
     w = _w(labels if err.ndim == 1 else err[:, 0], weights)
     if err.ndim == 2:  # multi-target: mean over rows x targets
-        return float(np.sum(err * w[:, None]) / (np.sum(w) * err.shape[1]))
-    return float(np.sum(err * w) / np.sum(w))
+        s, wsum = _reduce_sums(float(np.sum(err * w[:, None])),
+                               float(np.sum(w)))
+        return s / (wsum * err.shape[1])
+    s, wsum = _reduce_sums(float(np.sum(err * w)), float(np.sum(w)))
+    return s / wsum
 
 
 @register_metric("rmse")
@@ -192,7 +224,8 @@ def precision_at(preds, labels, weights=None, group_ptr=None, at: float = 0,
             weights[g if len(weights) == n_groups else lo])
         vals.append(float(np.sum(y[order[:n]])) * wg / n)
         ws.append(wg)
-    return float(np.sum(vals) / np.sum(ws)) if vals else 0.0
+    s, wsum = _reduce_sums(float(np.sum(vals)), float(np.sum(ws)))
+    return s / wsum if wsum > 0 else 0.0
 
 
 @register_metric("ams")
@@ -214,17 +247,23 @@ def ams(preds, labels, weights=None, at: float = 1.0, **kw):
         ps = np.cumsum(np.where(labels[order] > 0.5, w[order], 0.0))
         bs = np.cumsum(np.where(labels[order] > 0.5, 0.0, w[order]))
         sp = np.asarray(preds, np.float64)[order]
-        distinct = np.empty(len(sp), bool)
-        distinct[:-1] = sp[:-1] != sp[1:]
-        distinct[-1] = False
+        distinct = np.zeros(len(sp), bool)
+        if len(sp):
+            distinct[:-1] = sp[:-1] != sp[1:]
         cand = np.nonzero(distinct)[0]
-        if len(cand) == 0:
-            return 0.0
-        a = np.sqrt(2 * ((ps[cand] + bs[cand] + br)
-                         * np.log1p(ps[cand] / (bs[cand] + br)) - ps[cand]))
-        return float(np.max(a))
-    return float(np.sqrt(2 * ((s_tp + b_fp + br)
-                              * np.log1p(s_tp / (b_fp + br)) - s_tp)))
+        # all-tied shard contributes 0, but must still join the allreduce
+        best = (0.0 if len(cand) == 0 else float(np.max(
+            np.sqrt(2 * ((ps[cand] + bs[cand] + br)
+                         * np.log1p(ps[cand] / (bs[cand] + br)) - ps[cand])))))
+        num, den = _reduce_sums(best, 1.0)
+        return num / den
+    # distributed: AMS needs the global score order; per-rank values are
+    # averaged (the top-fraction cut is rank-local, like the reference's
+    # rank-local EvalAMS)
+    num, den = _reduce_sums(
+        float(np.sqrt(2 * ((s_tp + b_fp + br)
+                           * np.log1p(s_tp / (b_fp + br)) - s_tp))), 1.0)
+    return num / den
 
 
 @register_metric("merror")
@@ -255,17 +294,23 @@ def auc(preds, labels, weights=None, group_ptr=None, **kw):
     ss, yy, ww = s[order], y[order], w[order]
     uniq, first = np.unique(ss, return_index=True)
     grp = np.searchsorted(uniq, ss)
-    pos_w = np.sum(ww[yy])
-    neg_w = np.sum(ww[~yy])
-    if pos_w == 0 or neg_w == 0:
-        return 0.5
+    pos_w = float(np.sum(ww[yy]))
+    neg_w = float(np.sum(ww[~yy]))
     # each positive scores (neg weight strictly below) + (tied neg weight)/2
     cw_neg = np.cumsum(ww * (~yy))
     below = np.concatenate([[0.0], cw_neg])[first[grp]]
     ties_neg = np.zeros(len(uniq))
     np.add.at(ties_neg, grp, ww * (~yy))
     score = below + ties_neg[grp] / 2.0
-    return float(np.sum(ww[yy] * score[yy]) / (pos_w * neg_w))
+    area = float(np.sum(ww[yy] * score[yy]))
+    # distributed: the reference's merge is GlobalRatio(area, fp*tp)
+    # (auc.cc:345 + aggregator.h:52) — allreduce BOTH the local pair area
+    # and the local pos*neg pair mass, i.e. a pair-count-weighted average
+    # of per-rank AUCs; O(local) memory, upstream-identical semantics
+    area, pairs = _reduce_sums(area, pos_w * neg_w)
+    if pairs == 0:
+        return 0.5
+    return min(area / pairs, 1.0)
 
 
 @register_metric("aucpr")
@@ -273,16 +318,25 @@ def aucpr(preds, labels, weights=None, **kw):
     s = np.asarray(preds, dtype=np.float64)
     y = labels > 0.5
     w = _w(labels, weights)
-    order = np.argsort(-s, kind="stable")
-    yy, ww = y[order], w[order]
-    tp = np.cumsum(ww * yy)
-    fp = np.cumsum(ww * ~yy)
-    pos = tp[-1]
-    if pos == 0:
-        return 0.0
-    precision = tp / np.maximum(tp + fp, 1e-16)
-    recall = tp / pos
-    return float(np.trapezoid(precision, recall))
+    # a degenerate shard (empty, or single-class) has zero pair mass and
+    # contributes nothing to the merge — but it MUST still enter the
+    # allreduce, or the cohort's collectives desynchronize
+    local, pairs = 0.0, 0.0
+    if len(s):
+        order = np.argsort(-s, kind="stable")
+        yy, ww = y[order], w[order]
+        tp = np.cumsum(ww * yy)
+        fp = np.cumsum(ww * ~yy)
+        pos, neg = float(tp[-1]), float(fp[-1])
+        if pos > 0 and neg > 0:
+            precision = tp / np.maximum(tp + fp, 1e-16)
+            recall = tp / pos
+            local = float(np.trapezoid(precision, recall))
+            # pair-mass weight: the Curve-template merge shape
+            # (auc.cc:345 GlobalRatio(auc, fp*tp))
+            pairs = pos * neg
+    num, den = _reduce_sums(local * pairs, pairs)
+    return num / den if den > 0 else 0.0
 
 
 @register_metric("aft-nloglik")
@@ -331,8 +385,11 @@ def cox_nloglik(preds, labels, weights=None, **kw):
     g_start = np.searchsorted(ts, ts, side="left")
     risk = revcum[g_start]  # Breslow: tie groups share the denominator
     ll = np.sum(np.log(np.maximum(r_s, 1e-16))[ev_s] - np.log(np.maximum(risk, 1e-16))[ev_s])
-    n_ev = max(int(ev_s.sum()), 1)
-    return float(-ll / n_ev)
+    # distributed: risk sets are rank-local (the full ordering would need a
+    # gather); partial (sum, events) allreduce matches the objective's
+    # per-shard partial-likelihood treatment
+    num, den = _reduce_sums(float(-ll), float(ev_s.sum()))
+    return num / max(den, 1.0)
 
 
 def _dcg_at(rel, k, exp_gain=True):
@@ -360,7 +417,11 @@ def ndcg(preds, labels, weights=None, group_ptr=None, at: float = 0, **kw):
         idcg = _dcg_at(np.sort(y)[::-1], kk)
         vals.append(dcg / idcg if idcg > 0 else 1.0)
         ws.append(1.0 if weights is None else weights[g if len(weights) == len(group_ptr) - 1 else lo])
-    return float(np.average(vals, weights=ws)) if vals else 1.0
+    # per-group partials allreduce (rank_metric.cc via GlobalRatio):
+    # (sum of weighted group scores, sum of group weights)
+    num, den = _reduce_sums(float(np.dot(vals, ws)) if vals else 0.0,
+                            float(np.sum(ws)) if ws else 0.0)
+    return num / den if den > 0 else 1.0
 
 
 @register_metric("map")
@@ -381,4 +442,5 @@ def map_metric(preds, labels, weights=None, group_ptr=None, at: float = 0, **kw)
         denom = np.arange(1, len(yo) + 1)
         npos = yo.sum()
         vals.append(float(np.sum(yo * hits / denom) / npos) if npos > 0 else 0.0)
-    return float(np.mean(vals)) if vals else 0.0
+    num, den = _reduce_sums(float(np.sum(vals)), float(len(vals)))
+    return num / den if den > 0 else 0.0
