@@ -1,0 +1,7 @@
+#!/bin/bash
+# Full test suite + bench canary (SURVEY §4 nightly role).  The quick tier
+# (`pytest -m quick`, <3 min) is the per-commit gate; this is the deep one.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q --durations=25
+BENCH_FORCE_CPU=1 BENCH_ROWS=100000 BENCH_ROUNDS=5 python bench.py

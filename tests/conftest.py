@@ -30,6 +30,59 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running multi-process tests")
+    config.addinivalue_line(
+        "markers", "quick: fast smoke tier (`pytest -m quick` < 3 min) — "
+        "the reference's marker-tier role (SURVEY §4); full suite nightly")
+
+
+# Fast smoke tier: files whose tests are individually cheap, minus members
+# measured slow (> ~8 s single-core).  Keep `pytest -m quick` under 3 min:
+# it is the per-commit gate; the full suite is the nightly/per-milestone one.
+_QUICK_FILES = {
+    "test_basic.py", "test_model_io.py", "test_boosters.py",
+    "test_bestfirst.py", "test_exact.py", "test_grower_parity.py",
+    "test_collective_backend.py", "test_constraints.py",
+    "test_continuation.py", "test_device_ingest.py", "test_hist_kernels.py",
+    "test_multiquantile.py", "test_ranking.py", "test_survival.py",
+    "test_categorical.py", "test_shap.py",
+}
+_QUICK_DENY = {
+    # measured > ~8 s (full-suite --durations)
+    "test_streamed_sparse_predict_bounded_memory", "test_pandas_input",
+    "test_base_margin_and_weights", "test_max_leaves_budget",
+    "test_monotone_increasing_decreasing", "test_quantile_objective_coverage",
+    "test_interaction_constraints_respected", "test_num_parallel_tree_forest",
+    "test_bestfirst_matches_depthwise_on_balanced_data",
+    "test_lossguide_distributed_global_bestfirst",
+    "test_exact_close_to_hist", "test_exact_two_process_matches_single",
+    "test_onehot_vs_partition_regimes", "test_categorical_training_improves",
+    "test_category_recode_between_frames", "test_unseen_category_goes_left",
+    "test_device_shap_throughput", "test_device_shap_matches_host",
+    "test_jax_array_input_matches_numpy", "test_subtraction_trick_same_trees",
+    "test_single_quantile_still_scalar", "test_multi_quantile_training",
+    "test_multi_expectile_training", "test_rank_objectives_improve",
+    "test_aft_improves_and_correlates", "test_inmemory_thread_workers_identical_trees",
+    "test_feature_weights_bias_column_sampling",
+    "test_config_roundtrip_continuation", "test_iteration_range_and_slice",
+    "test_aft_interval_censored", "test_custom_objective",
+    "test_categorical_save_load_exact", "test_torch_dlpack_input",
+    "test_continuation_identity_same_booster",
+    "test_bestfirst_budget_and_quality", "test_gradient_based_sampling",
+    "test_deterministic_across_runs", "test_adaptive_leaf_mae",
+    "test_rank_requires_groups", "test_dart_trains_and_roundtrips",
+    "test_exact_oracle_parity", "test_continuation_identity_after_reload",
+    "test_ranker_sklearn_with_eval", "test_dart_weighted_sampling",
+    "test_categorical_nan_uses_default_direction",
+    "test_cox_partial_likelihood",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        fname = os.path.basename(str(item.fspath))
+        base = item.name.split("[")[0]
+        if fname in _QUICK_FILES and base not in _QUICK_DENY:
+            item.add_marker(pytest.mark.quick)
 
 
 @pytest.fixture(scope="session")

@@ -1277,9 +1277,11 @@ class Booster:
         mesh = self._get_mesh()
         proc_par = self._process_parallel()
         # true global best-first for lossguide with a leaf budget (driver.h
-        # priority queue): unbounded depth, node-table layout
-        best_first = (lossguide and self.tparam.max_leaves > 1
-                      and mesh is None and not proc_par)
+        # priority queue): unbounded depth, node-table layout — under mesh
+        # sharding (GSPMD hist psum) and process parallelism (host
+        # AllReduceHist per expansion) alike, so distributed lossguide grows
+        # the same trees as single-device
+        best_first = lossguide and self.tparam.max_leaves > 1
         max_depth = self.tparam.max_depth
         if max_depth <= 0:
             # best-first: depth bounded only by the leaf budget
@@ -1295,11 +1297,18 @@ class Booster:
             if best_first:
                 from .tree.bestfirst import BestFirstGrower
 
+                if proc_par and mesh is not None:
+                    raise NotImplementedError(
+                        "n_devices > 1 within a process is not combined "
+                        "with multi-process training yet; give each process "
+                        "one device")
                 grower = BestFirstGrower(
                     max_depth,
                     self._split_params,
                     max_leaves=self.tparam.max_leaves,
                     interaction_sets=self.tparam.interaction_constraints,
+                    distributed=proc_par,
+                    mesh=mesh,
                 )
             elif proc_par:
                 if mesh is not None:
@@ -1434,8 +1443,22 @@ class Booster:
                     from .ops.adaptive import segment_quantile_leaf
 
                     residual = cache.labels - new_margin[:, k]
+                    q_pos, q_res, q_valid = pos, residual, cache.valid
+                    if proc_par:
+                        # the quantile must see the GLOBAL leaf population
+                        # or ranks refit different leaf values from their
+                        # local shards (adaptive.cc runs under the
+                        # collective); gather like the exact path does
+                        from . import collective
+
+                        q_pos = jnp.asarray(collective.allgather_ragged(
+                            np.asarray(pos)))
+                        q_res = jnp.asarray(collective.allgather_ragged(
+                            np.asarray(residual)))
+                        q_valid = jnp.asarray(collective.allgather_ragged(
+                            np.asarray(cache.valid)))
                     leaf_val = segment_quantile_leaf(
-                        pos, residual, cache.valid, is_leaf,
+                        q_pos, q_res, q_valid, is_leaf,
                         float(self.objective.adaptive_alpha(k)),
                         float(self.tparam.eta), max_nodes=n_slots,
                     )

@@ -64,13 +64,12 @@ class BFState(NamedTuple):
 
 @functools.partial(jax.jit, static_argnames=("params", "max_depth", "has_cat",
                                              "n"))
-def _eval_nodes(state: BFState, bins, gpair, cuts_pad, n_bins, feature_mask,
+def _eval_nodes(state: BFState, hist, cuts_pad, n_bins, feature_mask,
                 set_matrix, cat_mask, i0, *, n: int, params: SplitParams,
                 max_depth: int, has_cat: bool):
-    """Compute split candidates for the (consecutive) node ids [i0, i0+n)."""
+    """Compute split candidates for the (consecutive) node ids [i0, i0+n)
+    from their (already cross-rank-reduced) histogram."""
     ids = i0 + jnp.arange(n, dtype=jnp.int32)
-    hist = build_histogram_at(bins, gpair, state.pos, i0,
-                              n_nodes=n, n_bin=cuts_pad.shape[1])
     totals = state.totals[ids]
     compat = state.setcompat[ids]
     allowed = jnp.einsum("ns,sf->nf", compat.astype(jnp.float32),
@@ -164,7 +163,8 @@ class BestFirstGrower:
     """Lossguide driver: host loop of device expansions (driver.h pop/push)."""
 
     def __init__(self, max_depth: int, params: SplitParams, *,
-                 max_leaves: int, interaction_sets=None) -> None:
+                 max_leaves: int, interaction_sets=None,
+                 distributed: bool = False, mesh=None) -> None:
         from .grow import make_set_matrix
 
         assert max_leaves > 1
@@ -174,6 +174,24 @@ class BestFirstGrower:
         self.interaction_sets = interaction_sets
         self._make_set_matrix = make_set_matrix
         self.n_slots = 2 * max_leaves  # any L-leaf binary tree: 2L-1 nodes
+        # distributed=True: row shards live in other PROCESSES — the per-
+        # expansion histogram goes through the host collective (the
+        # AllReduceHist exchange), after which every rank's driver pops the
+        # same node.  mesh: rows sharded over in-process devices — inputs are
+        # placed row-sharded and GSPMD inserts the psum inside the hist
+        # matmul itself (driver.h queue semantics, global across shards,
+        # either way).
+        self.distributed = distributed
+        self.mesh = mesh
+
+    def _node_hist(self, bins, gpair, pos, i0, n, n_bin):
+        hist = build_histogram_at(bins, gpair, pos, i0, n_nodes=n,
+                                  n_bin=n_bin)
+        if self.distributed:
+            from .. import collective
+
+            hist = jnp.asarray(collective.allreduce(np.asarray(hist)))
+        return hist
 
     def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None,
              cat_mask=None) -> BFState:
@@ -190,8 +208,16 @@ class BestFirstGrower:
               else feature_masks(0, 1))
         n_sets = setmat.shape[0]
 
+        if self.mesh is not None:
+            from ..parallel import shard_rows
+
+            bins, gpair, valid = shard_rows(self.mesh, bins, gpair, valid)
         pos = jnp.where(valid, 0, -1).astype(jnp.int32)
         root = node_sums(gpair, pos, node0=0, n_nodes=1)[0]
+        if self.distributed:
+            from .. import collective
+
+            root = jnp.asarray(collective.allreduce(np.asarray(root)))
         state = BFState(
             pos=pos,
             parent=jnp.full(N, -1, jnp.int32),
@@ -219,7 +245,8 @@ class BestFirstGrower:
             cand_is_cat=jnp.zeros(N, bool),
             cand_cat_set=jnp.zeros((N, B), bool),
         )
-        state = _eval_nodes(state, bins, gpair, cuts_pad, n_bins, fm, setmat,
+        hist0 = self._node_hist(bins, gpair, state.pos, jnp.int32(0), 1, B)
+        state = _eval_nodes(state, hist0, cuts_pad, n_bins, fm, setmat,
                             cm, jnp.int32(0), n=1, params=self.params,
                             max_depth=self.max_depth, has_cat=has_cat)
 
@@ -237,8 +264,10 @@ class BestFirstGrower:
                                  self.params, monotone)
             fme = (jnp.ones((1, F), bool) if feature_masks is None
                    else feature_masks(0, 2))
+            hist2 = self._node_hist(bins, gpair, state.pos,
+                                    jnp.int32(l_id), 2, B)
             state = _eval_nodes(
-                state, bins, gpair, cuts_pad, n_bins, fme, setmat, cm,
+                state, hist2, cuts_pad, n_bins, fme, setmat, cm,
                 jnp.int32(l_id), n=2, params=self.params,
                 max_depth=self.max_depth, has_cat=has_cat)
             n_nodes += 2
