@@ -49,6 +49,7 @@ __all__ = [
     "TrainingCheckPoint",
     "collective",
     "tracker",
+    "train_distributed",
     "plot_importance",
     "plot_tree",
     "to_graphviz",
@@ -71,4 +72,8 @@ def __getattr__(name):  # lazy heavy imports
         from . import plotting as _pl
 
         return getattr(_pl, name)
+    if name == "train_distributed":
+        from .distributed import train_distributed
+
+        return train_distributed
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,229 @@
+"""Cluster training driver — the dask/spark frontend role.
+
+Reference shape: python-package/xgboost/dask/__init__.py:267 (DaskDMatrix
+carries per-worker data refs), :722 _train_async (start the tracker, launch
+one training task per worker under a CommunicatorContext built from the
+tracker's worker args, collect rank 0's booster + eval history).
+
+There is no dask scheduler in the TPU stack, so the driver does the
+_train_async choreography directly: ``train_distributed(params, parts, ...)``
+starts a :class:`~xgboost_tpu.tracker.RabitTracker`, spawns one worker
+process per data part, each worker rendezvouses through the tracker (rank
+assigned by the tracker, jax.distributed underneath), builds its DMatrix
+from its part, trains — cuts merge through the distributed sketch,
+histograms allreduce per level — and rank 0's model comes back to the
+caller as ``{"booster": Booster, "history": evals_result}``, the reference
+dask ``train()`` return shape.
+
+Data parts (one per worker) may be:
+
+- a ``(X, y)`` tuple or ``{"data": X, "label": y, "weight": ..., ...}``
+  dict of arrays (shipped to the worker by pickle, one file per part —
+  each worker reads only its own shard),
+- a URI string (the worker calls ``DMatrix(uri)`` — libsvm/npz), or
+- a zero-arg callable returning one of the above (runs IN the worker, the
+  dask-delayed role: use this when data must be loaded worker-locally;
+  must be picklable, i.e. defined at module level).
+
+This driver is SINGLE-HOST: it spawns local subprocesses and exchanges
+results through a local temp directory.  It exists for multi-process
+scale-out on one machine and as the reference ``dask.train`` surface.  On
+a multi-host TPU pod, start one process per host yourself (any job
+launcher), call ``collective.init`` with the tracker's ``worker_args()``
+(or jax.distributed direct mode) in each, and train — that is the same
+path the workers here take, minus the local spawn.  The default
+``platform="cpu"`` keeps local multi-worker runs off the (single) TPU.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import pickle
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence
+
+from .core import Booster
+
+_CHILD = r"""
+import json, pickle, sys, traceback
+import jax
+
+platform = sys.argv[1]
+if platform:
+    jax.config.update("jax_platforms", platform)
+uri, port, world = sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
+tmp, syspaths = sys.argv[5], sys.argv[6]
+for p in reversed(syspaths.split(chr(31))):
+    if p:
+        sys.path.insert(0, p)
+
+from xgboost_tpu import collective
+
+with collective.CommunicatorContext(dmlc_tracker_uri=uri,
+                                    dmlc_tracker_port=port,
+                                    dmlc_nworker=world):
+    rank = collective.get_rank()
+    try:
+        import os
+        with open(os.path.join(tmp, "spec.pkl"), "rb") as fh:
+            spec = pickle.load(fh)
+        with open(os.path.join(tmp, f"part_{rank}.pkl"), "rb") as fh:
+            part = pickle.load(fh)  # only this rank's shard
+
+        import xgboost_tpu as xtb
+        from xgboost_tpu.distributed import _make_dmatrix
+
+        dtrain = _make_dmatrix(part)
+        evals = [(dtrain, "train")] if spec["eval_train"] else []
+        history = {}
+        bst = xtb.train(spec["params"], dtrain, spec["num_boost_round"],
+                        evals=evals, evals_result=history,
+                        verbose_eval=spec["verbose_eval"],
+                        **spec["train_kwargs"])
+        if rank == 0:
+            with open(os.path.join(tmp, "result.bin"), "wb") as fh:
+                raw = bytes(bst.save_raw())
+                head = json.dumps({
+                    "history": history,
+                    "best_iteration": getattr(bst, "best_iteration", None),
+                }).encode()
+                fh.write(len(head).to_bytes(8, "little") + head + raw)
+    except BaseException as e:
+        traceback.print_exc()
+        # fan the failure out through the tracker so peers blocked in a
+        # collective abort instead of hanging to the driver timeout
+        try:
+            collective.signal_error(f"worker rank {rank}: {e!r}")
+        except Exception:
+            pass
+        raise
+print("WORKER-DONE", flush=True)
+"""
+
+
+def _make_dmatrix(part: Any):
+    """Resolve one worker's data ref into a DMatrix (DaskDMatrix role)."""
+    from .data.dmatrix import DMatrix
+
+    if callable(part):
+        part = part()
+    if isinstance(part, DMatrix):
+        return part
+    if isinstance(part, str):
+        return DMatrix(part)
+    if isinstance(part, tuple):
+        X, y = part
+        return DMatrix(X, label=y)
+    if isinstance(part, dict):
+        kw = dict(part)
+        return DMatrix(kw.pop("data"), **kw)
+    raise TypeError(f"cannot build a DMatrix from part of type {type(part)}")
+
+
+def train_distributed(params: Dict[str, Any], parts: Sequence[Any],
+                      num_boost_round: int = 10, *,
+                      eval_train: bool = False,
+                      verbose_eval: bool = False,
+                      platform: Optional[str] = "cpu",
+                      host_ip: str = "127.0.0.1",
+                      timeout: int = 1200,
+                      train_kwargs: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+    """Train one model over ``len(parts)`` local workers; returns
+    ``{"booster": Booster, "history": dict, "best_iteration": ...}``
+    (the reference dask ``train()`` contract, dask/__init__.py:930)."""
+    world = len(parts)
+    if world == 0:
+        raise ValueError("parts is empty — need one data part per worker")
+
+    from .tracker import RabitTracker
+
+    tracker = RabitTracker(n_workers=world, host_ip=host_ip)
+    tracker.start()
+    args = tracker.worker_args()
+
+    tmp = tempfile.mkdtemp(prefix="xtb_dist_")
+    procs: List[subprocess.Popen] = []
+    logs: List[Any] = []
+    try:
+        with open(os.path.join(tmp, "spec.pkl"), "wb") as fh:
+            pickle.dump({
+                "params": dict(params),
+                "num_boost_round": int(num_boost_round),
+                "eval_train": bool(eval_train),
+                "verbose_eval": verbose_eval,
+                "train_kwargs": dict(train_kwargs or {}),
+            }, fh)
+        # tracker assigns ranks by connection order (sorted): any part can
+        # end up at any rank, so every part file must be present; each
+        # worker reads ONLY part_<its rank>
+        for i, part in enumerate(parts):
+            with open(os.path.join(tmp, f"part_{i}.pkl"), "wb") as fh:
+                pickle.dump(part, fh)
+
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # children pick their own device counts
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        # callable parts unpickle in the worker: the defining module's
+        # directory must be importable there (plain-pickle rule, as in dask)
+        sys_paths = [repo_root]
+        for part in parts:
+            fn = part.func if isinstance(part, functools.partial) else part
+            if callable(fn):
+                mod = sys.modules.get(getattr(fn, "__module__", ""), None)
+                f = getattr(mod, "__file__", None)
+                if f:
+                    d = os.path.dirname(os.path.abspath(f))
+                    if d not in sys_paths:
+                        sys_paths.append(d)
+
+        for i in range(world):
+            # file-backed output: PIPE would deadlock a chatty worker whose
+            # buffer fills while the driver waits on a sibling
+            log = open(os.path.join(tmp, f"worker_{i}.log"), "w+")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _CHILD, platform or "",
+                 str(args["dmlc_tracker_uri"]), str(args["dmlc_tracker_port"]),
+                 str(world), tmp, chr(31).join(sys_paths)],
+                stdout=log, stderr=subprocess.STDOUT, env=env))
+        errs = []
+        for i, p in enumerate(procs):
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+                errs.append(f"worker {i}: timed out after {timeout}s")
+                continue
+            if p.returncode != 0:
+                logs[i].seek(0)
+                errs.append(f"worker {i} (exit {p.returncode}):\n"
+                            + logs[i].read()[-2000:])
+        if errs:
+            raise RuntimeError("distributed training failed:\n"
+                               + "\n---\n".join(errs))
+        tracker.wait_for(timeout=60)
+
+        with open(os.path.join(tmp, "result.bin"), "rb") as fh:
+            blob = fh.read()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+        tracker.free()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    n = int.from_bytes(blob[:8], "little")
+    meta = json.loads(blob[8:8 + n].decode())
+    bst = Booster(params)
+    bst.load_model(bytearray(blob[8 + n:]))
+    return {"booster": bst, "history": meta["history"],
+            "best_iteration": meta["best_iteration"]}
