@@ -81,6 +81,122 @@ def _init_devices_with_watchdog(timeout_s: float = 120.0):
     return jax.devices(), True
 
 
+def _median_time(fn, reps: int = 5) -> float:
+    """Median wall seconds of fn() with device completion; one warmup call
+    first so compile time never lands in the samples.  (Shared: the
+    scripts/ benches import this.)"""
+    import jax
+
+    jax.block_until_ready(fn())  # compile/warmup
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def _hist_flops_per_round(R: int, F: int, B: int, depth: int) -> float:
+    """MXU FLOPs of one boosting round's histogram matmuls: each level's
+    build is (F*B, R) @ (R, N*2) = 2*R*F*B*N*2 FLOPs; with the subtraction
+    trick levels d>0 build only the 2^(d-1) left children."""
+    total = 0.0
+    for d in range(depth):
+        n_build = 1 if d == 0 else 2 ** (d - 1)
+        total += 2.0 * R * F * B * n_build * 2
+    return total
+
+
+def phase_bench(cpu_fallback: bool, train_s: float) -> dict:
+    """Standalone per-phase timings at bench shapes + an MFU estimate
+    (VERDICT r2 #1a/#1c): histogram (XLA + Pallas/Mosaic), split scan,
+    position rewrite, H2D.  The Pallas timing doubles as the Mosaic
+    lowering proof — interpret=False, so on TPU a compile failure here is
+    loud, not hidden behind the interpret-mode tests."""
+    import jax
+    import jax.numpy as jnp
+
+    from xgboost_tpu.ops.histogram import build_histogram
+    from xgboost_tpu.ops.split import SplitParams, evaluate_splits
+
+    R = min(N_ROWS, 1 << 21)
+    F, B, depth = N_FEATURES, MAX_BIN, MAX_DEPTH
+    N = 2 ** (depth - 1)  # widest built level (subtraction trick)
+    rng = np.random.default_rng(0)
+    bins_np = rng.integers(0, B, size=(R, F)).astype(np.uint8)
+    gp_np = rng.normal(size=(R, 2)).astype(np.float32)
+    pos_np = rng.integers((1 << (depth - 1)) - 1, (1 << depth) - 1,
+                          size=R).astype(np.int32)
+    phases = {}
+
+    t0 = time.perf_counter()
+    bins = jax.block_until_ready(jax.device_put(bins_np))
+    phases["h2d_bins_s"] = time.perf_counter() - t0
+    gp = jax.device_put(gp_np)
+    pos = jax.device_put(pos_np)
+    root_pos = jnp.zeros(R, jnp.int32)
+
+    phases["hist_root_xla_s"] = _median_time(lambda: build_histogram(
+        bins, gp, root_pos, node0=0, n_nodes=1, n_bin=B))
+    # the widest level the train loop actually builds: with the subtraction
+    # trick only the 2^(depth-2) LEFT children (stride 2) are computed
+    n_build = max(N // 2, 1)
+    node0 = (1 << (depth - 1)) - 1
+    phases["hist_level_xla_s"] = _median_time(lambda: build_histogram(
+        bins, gp, pos, node0=node0, n_nodes=n_build, n_bin=B, stride=2))
+
+    if cpu_fallback:
+        phases["pallas_mosaic_lowering"] = "skipped: CPU backend (Mosaic is TPU-only)"
+    else:
+        try:
+            from xgboost_tpu.ops.hist_pallas import build_histogram_pallas
+
+            phases["hist_level_pallas_s"] = _median_time(
+                lambda: build_histogram_pallas(
+                    bins, gp, pos, node0=node0, n_nodes=n_build, n_bin=B,
+                    interpret=False, stride=2))
+            phases["pallas_mosaic_lowering"] = "ok"
+        except Exception as e:  # noqa: BLE001 — report, never kill the bench
+            phases["pallas_mosaic_lowering"] = (
+                f"FAILED: {type(e).__name__}: {e}"[:300])
+
+    hist = build_histogram(bins, gp, pos, node0=node0, n_nodes=N, n_bin=B)
+    totals = hist.sum(axis=(1,)).sum(axis=1) / F  # (N, 2) approximation
+    params = SplitParams(eta=0.1, gamma=0.0, min_child_weight=1.0,
+                         lambda_=1.0, alpha=0.0, max_delta_step=0.0)
+    nb = jnp.full(F, B, jnp.int32)
+    phases["split_eval_s"] = _median_time(
+        lambda: evaluate_splits(hist, totals, nb, params))
+
+    # position rewrite (RowPartitioner role): per-row gather of the split
+    # feature's bin + elementwise route
+    feat = jnp.zeros(2 * N, jnp.int32)
+    sbin = jnp.full(2 * N, B // 2, jnp.int32)
+
+    @jax.jit
+    def _route(pos, bins):
+        f = feat[jnp.clip(pos, 0, 2 * N - 1)]
+        bv = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        return jnp.where(bv <= sbin[jnp.clip(pos, 0, 2 * N - 1)],
+                         2 * pos + 1, 2 * pos + 2)
+
+    phases["pos_rewrite_s"] = _median_time(lambda: _route(pos, bins))
+
+    # MFU of the measured train loop: hist matmul FLOPs over wall time.
+    # Peak default: TPU v5e bf16 197 TFLOPS (the bench runs f32 on the MXU,
+    # so this is a conservative denominator); override via BENCH_PEAK_FLOPS.
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS",
+                                1e12 if cpu_fallback else 197e12))
+    flops_round = _hist_flops_per_round(N_ROWS, F, B, depth)
+    phases["hist_flops_per_round"] = flops_round
+    phases["mfu_vs_peak"] = (flops_round * N_ROUNDS) / train_s / peak
+    # roofline check from the standalone level timing
+    phases["hist_level_tflops"] = (
+        2.0 * R * F * B * n_build * 2 / phases["hist_level_xla_s"] / 1e12)
+    return phases
+
+
 def main() -> None:
     global N_ROWS, N_ROUNDS
 
@@ -133,6 +249,20 @@ def main() -> None:
     auc_v = _auc(preds, y[idx])
     log(f"train: {train_s:.2f}s for {N_ROUNDS} rounds; sample AUC={auc_v:.4f}")
     assert auc_v > 0.75, f"model failed to learn (AUC={auc_v})"
+
+    if os.environ.get("BENCH_PHASES", "1") != "0":
+        try:
+            phases = phase_bench(cpu_fallback, train_s)
+            log("per-phase timings + MFU: " + json.dumps(
+                {k: (round(v, 6) if isinstance(v, float) else v)
+                 for k, v in phases.items()}))
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "bench_phases.json"), "w") as fh:
+                json.dump({"cpu_fallback": cpu_fallback, "rows": N_ROWS,
+                           "features": N_FEATURES, "max_bin": MAX_BIN,
+                           "depth": MAX_DEPTH, **phases}, fh, indent=1)
+        except Exception as e:  # noqa: BLE001 — phases must not kill the bench
+            log(f"phase bench failed: {type(e).__name__}: {e}")
 
     throughput = N_ROWS * N_ROUNDS / train_s
     size = (f"{N_ROWS // 10**6}M" if N_ROWS >= 10**6 else f"{N_ROWS // 1000}k")

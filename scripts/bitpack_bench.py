@@ -1,0 +1,75 @@
+"""Bit-packed Ellpack experiment (VERDICT r2 missing #4 / next #7).
+
+The reference packs bin indices to ceil(log2(n_bins)) bits in HBM
+(src/common/compressed_iterator.h, src/data/ellpack_page.cuh:26); this repo
+stores u8/u16.  Question: would 4-bit packing (max_bin<=16) pay on the TPU
+hist kernel?
+
+Measures build_histogram at max_bin 256/64/16 with (a) the resident u8
+layout and (b) a simulated 4-bit packed layout (two bins per byte, unpacked
+with shift/mask on the fly before the one-hot matmul — exactly what a
+packed kernel would do).  Run on CPU XLA for the shape of the answer and on
+the TPU chip (python scripts/bitpack_bench.py, no JAX_PLATFORMS override)
+for the real number; results go into docs/bitpack.md.
+"""
+import functools
+import json
+import sys
+
+import jax
+
+if "--tpu" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+from bench import _median_time as timed  # noqa: E402 — shared timing helper
+from xgboost_tpu.ops.histogram import _hist_accumulate  # noqa: E402
+from xgboost_tpu.ops.histogram import build_histogram  # noqa: E402
+
+R, F = 1 << 20, 28
+N_NODES = 8
+
+
+def _unpack4(packed):
+    """(R, F/2) u8 -> (R, F) u8: two 4-bit bins per byte."""
+    lo = packed & 0xF
+    hi = packed >> 4
+    return jnp.stack([lo, hi], axis=-1).reshape(packed.shape[0], -1)
+
+
+@functools.partial(jax.jit, static_argnames=("n_bin",))
+def _packed_hist(packed, gp, pos, *, n_bin):
+    """ONE XLA program: unpack fused ahead of the one-hot matmul — what a
+    packed kernel would do (no (R, F) u8 round-trip through HBM)."""
+    return _hist_accumulate(_unpack4(packed), gp, pos, 0, N_NODES, n_bin,
+                            2048, 1)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    gp = jnp.asarray(rng.normal(size=(R, 2)).astype(np.float32))
+    pos = jnp.asarray(rng.integers(0, N_NODES, size=R).astype(np.int32))
+    results = {"platform": jax.devices()[0].platform, "rows": R,
+               "features": F, "n_nodes": N_NODES}
+    for B in (256, 64, 16):
+        bins_np = rng.integers(0, B, size=(R, F)).astype(np.uint8)
+        bins = jnp.asarray(bins_np)
+        t_u8 = timed(lambda: build_histogram(
+            bins, gp, pos, node0=0, n_nodes=N_NODES, n_bin=B))
+        results[f"u8_B{B}_s"] = round(t_u8, 5)
+        if B <= 16:
+            packed_np = (bins_np[:, 0::2] | (bins_np[:, 1::2] << 4))
+            packed = jnp.asarray(packed_np)
+            t_p4 = timed(lambda: _packed_hist(packed, gp, pos, n_bin=B))
+            results[f"packed4_B{B}_s"] = round(t_p4, 5)
+            results[f"packed4_B{B}_speedup"] = round(t_u8 / t_p4, 3)
+        # HBM-traffic roofline: bins bytes per level vs matmul FLOPs
+        results[f"flops_per_bins_byte_B{B}"] = 2 * B * N_NODES * 2
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    main()
