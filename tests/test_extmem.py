@@ -143,3 +143,78 @@ def test_page_compression(tmp_path, batches):
                                     compress=True, on_host=False)
     b_d = xtb.train(params, d_d, 4, verbose_eval=False)
     assert b_d.get_dump() == b_u.get_dump()
+
+
+class _ArrayIter(xtb.DataIter):
+    def __init__(self, batches):
+        super().__init__()
+        self._b, self._i = batches, 0
+
+    def reset(self):
+        self._i = 0
+
+    def next(self, input_data):
+        if self._i >= len(self._b):
+            return 0
+        input_data(**self._b[self._i])
+        self._i += 1
+        return 1
+
+
+def test_sparse_page_dmatrix_raw_predict_and_training():
+    """SparsePageDMatrix (sparse_page_dmatrix.h role): raw CSR pages spill,
+    training runs through the binned replay, and prediction streams the RAW
+    pages with exact float thresholds — including with a model trained on
+    different cuts (the flow binned extmem cannot serve)."""
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(1200, 5)).astype(np.float32)
+    X[rng.random(X.shape) < 0.15] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0).astype(np.float32)
+    batches = [{"data": X[i * 400:(i + 1) * 400],
+                "label": y[i * 400:(i + 1) * 400]} for i in range(3)]
+
+    d = xtb.SparsePageDMatrix(_ArrayIter(batches), max_bin=32)
+    assert d.num_row() == 1200 and d.num_col() == 5
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "max_bin": 32}, d, 3, verbose_eval=False)
+    np.testing.assert_array_equal(bst.predict(d),
+                                  bst.predict(xtb.DMatrix(X)))
+
+    # a model trained on DIFFERENT cuts predicts on the raw pages exactly
+    other = xtb.train({"objective": "binary:logistic", "max_depth": 3,
+                       "max_bin": 17}, xtb.DMatrix(X, label=y), 2,
+                      verbose_eval=False)
+    np.testing.assert_array_equal(other.predict(d),
+                                  other.predict(xtb.DMatrix(X)))
+
+
+def test_sparse_page_dmatrix_scipy_batches_and_sentinel():
+    """CSR batches keep explicit valid zeros; a finite missing sentinel is
+    filtered structurally at ingestion."""
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(7)
+    dense = rng.normal(size=(600, 4)).astype(np.float32)
+    dense[rng.random(dense.shape) < 0.3] = 0.0  # explicit zeros stay valid
+    y = (dense[:, 0] > 0).astype(np.float32)
+    batches = [{"data": sp.csr_matrix(dense[:300]), "label": y[:300]},
+               {"data": sp.csr_matrix(dense[300:]), "label": y[300:]}]
+    d = xtb.SparsePageDMatrix(_ArrayIter(batches), max_bin=16)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 2,
+                     "max_bin": 16}, d, 2, verbose_eval=False)
+    # scipy ingest drops nothing finite; but CSR absent entries ARE missing
+    Xnan = np.where(dense == 0.0, np.nan, dense)
+    np.testing.assert_array_equal(bst.predict(d),
+                                  bst.predict(xtb.DMatrix(Xnan)))
+
+    # finite sentinel: -1 means missing, dropped at ingestion
+    dense2 = np.abs(rng.normal(size=(200, 3)).astype(np.float32))
+    dense2[rng.random(dense2.shape) < 0.2] = -1.0
+    d2 = xtb.SparsePageDMatrix(
+        _ArrayIter([{"data": dense2, "label": (dense2[:, 0] > 0.5).astype(np.float32)}]),
+        missing=-1.0, max_bin=16)
+    b2 = xtb.train({"objective": "binary:logistic", "max_depth": 2,
+                    "max_bin": 16}, d2, 2, verbose_eval=False)
+    X2 = np.where(dense2 == -1.0, np.nan, dense2)
+    np.testing.assert_array_equal(b2.predict(d2),
+                                  b2.predict(xtb.DMatrix(X2)))

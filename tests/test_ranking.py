@@ -56,3 +56,64 @@ def test_ndcg_at_k_metric(ltr):
                "max_depth": 3}, d, 5, evals=[(d, "t")], evals_result=res,
               verbose_eval=False)
     assert "ndcg@5" in res["t"] and "map@5" in res["t"]
+
+
+def test_metric_name_suffix_parsing():
+    """``base[@n][-]`` parsing (reference: ranking_utils.cc:138
+    ParseMetricName): truncation + the minus convention for degenerate
+    groups (rank_metric.cc:382,:443)."""
+    from xgboost_tpu.metric import create_metric
+
+    s = np.array([0.9, 0.1, 0.8, 0.2], np.float64)
+    # group 2 has no relevant doc: ndcg scores it 1 by default, 0 with '-'
+    y = np.array([2.0, 1.0, 0.0, 0.0], np.float64)
+    gp = np.array([0, 2, 4])
+    for name, want in [("ndcg@2", 1.0), ("ndcg@2-", 0.5),
+                       ("map", 1.0), ("map-", 0.5)]:
+        fn, reported = create_metric(name)
+        assert reported == name
+        got = fn(s, y, None, group_ptr=gp)
+        np.testing.assert_allclose(got, want, err_msg=name)
+
+    fn, _ = create_metric("error@0.3")
+    assert fn(np.array([0.4, 0.2]), np.array([1.0, 0.0]), None) == 0.0
+
+    with pytest.raises(ValueError, match="Unknown metric"):
+        create_metric("nope@2")
+
+
+def test_aucpr_grouped_ranking_variant():
+    """aucpr with query groups = mean of per-group PR areas over valid
+    groups (auc.cc ranking Curve path), not one pooled curve."""
+    from xgboost_tpu.metric import aucpr
+
+    rng = np.random.default_rng(0)
+    n_g, g_sz = 8, 30
+    y = (rng.random(n_g * g_sz) < 0.3).astype(np.float64)
+    s = y * 0.5 + rng.random(n_g * g_sz) * 0.5  # informative scores
+    gp = np.arange(0, n_g * g_sz + 1, g_sz)
+    grouped = aucpr(s, y, group_ptr=gp)
+    pooled = aucpr(s, y)
+    per_group = np.mean([aucpr(s[lo:hi], y[lo:hi])
+                         for lo, hi in zip(gp[:-1], gp[1:])])
+    np.testing.assert_allclose(grouped, per_group, rtol=1e-12)
+    assert grouped != pooled  # actually a different quantity
+
+
+def test_metric_suffix_validation_and_group_weights():
+    from xgboost_tpu.metric import aucpr, create_metric
+
+    # '-' only exists for rank metrics; '@' needs a number
+    for bad in ("rmse-", "auc-", "error@0.3-", "ndcg@-"):
+        with pytest.raises(ValueError):
+            create_metric(bad)
+
+    # grouped aucpr accepts per-group weights (the ndcg/map convention)
+    rng = np.random.default_rng(2)
+    y = (rng.random(60) < 0.4).astype(np.float64)
+    s = y * 0.4 + rng.random(60) * 0.6
+    gp = np.array([0, 20, 40, 60])
+    wg = np.array([1.0, 2.0, 3.0])
+    got = aucpr(s, y, weights=wg, group_ptr=gp)
+    per = [aucpr(s[lo:hi], y[lo:hi]) for lo, hi in zip(gp[:-1], gp[1:])]
+    np.testing.assert_allclose(got, np.average(per, weights=wg), rtol=1e-12)

@@ -15,7 +15,8 @@ __version__ = "0.1.0"
 from .config import config_context, get_config, set_config
 from .core import Booster
 from .data.dmatrix import DMatrix, MetaInfo, QuantileDMatrix
-from .data.extmem import DataIter, ExtMemQuantileDMatrix
+from .data.extmem import (DataIter, ExtMemQuantileDMatrix,
+                          SparsePageDMatrix)
 from .data.ellpack import EllpackPage
 from .data.quantile import HistogramCuts
 from .training import cv, train
@@ -34,6 +35,7 @@ __all__ = [
     "QuantileDMatrix",
     "DataIter",
     "ExtMemQuantileDMatrix",
+    "SparsePageDMatrix",
     "MetaInfo",
     "EllpackPage",
     "HistogramCuts",

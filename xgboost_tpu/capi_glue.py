@@ -549,16 +549,38 @@ def _assemble_from_batches(batches, missing: float) -> DMatrix:
 
 def dmatrix_from_callback(iter_addr: int, proxy, reset_addr: int,
                           next_addr: int, config: str) -> DMatrix:
-    """XGDMatrixCreateFromCallback: raw-path external iterator.  This
-    runtime keeps raw pages in host RAM (jax re-uploads per batch), so the
-    batches are assembled into one host matrix (the cache_prefix spill of
-    the reference's SparsePageDMatrix has no equivalent raw path here —
-    binned extmem lives in XGExtMemQuantileDMatrixCreateFromCallback)."""
+    """XGDMatrixCreateFromCallback: raw-path external iterator, backed by
+    SparsePageDMatrix (sparse_page_dmatrix.h role) — raw CSR pages spill
+    (zstd / disk with cache_prefix-style on_host=False), training replays
+    them through the binned extmem passes, prediction streams the raw
+    pages with exact thresholds."""
+    from .data.extmem import SparsePageDMatrix
+
     c = _cfg(config)
     it = _CCallbackIter(iter_addr, proxy, reset_addr, next_addr,
                         cache_prefix=c.get("cache_prefix"))
-    return _assemble_from_batches(_iter_batches(it),
-                                  float(c.get("missing", np.nan)))
+    d = SparsePageDMatrix(it, missing=float(c.get("missing", np.nan)),
+                          max_bin=int(c.get("max_bin", 256)),
+                          on_host=c.get("cache_prefix") is None)
+    # meta the binned ingestion doesn't collect: group/qid/label bounds
+    # staged on the proxy per batch
+    for field, setter in (("qid", d.set_qid),
+                          ("group", lambda v: d.set_group(
+                              np.asarray(v, np.int64)))):
+        vals = [m[field] for m in d._raw_meta if field in m]
+        if vals:
+            if len(vals) != len(d._raw_meta):
+                raise ValueError(
+                    f"iterator staged {field!r} on some batches but not all")
+            setter(np.concatenate([np.asarray(v).reshape(-1) for v in vals]))
+            break  # qid wins; group counts concatenate after it
+    for field in ("label_lower_bound", "label_upper_bound"):
+        vals = [m[field] for m in d._raw_meta if field in m]
+        if vals:
+            setattr(d.info, field,
+                    np.concatenate([np.asarray(v, np.float32).reshape(-1)
+                                    for v in vals]))
+    return d
 
 
 def quantile_dmatrix_from_callback(iter_addr: int, proxy, ref,

@@ -1769,8 +1769,20 @@ class Booster:
                 )
             base = np.broadcast_to(self.base_score.reshape(-1), (self.n_groups,))
             if len(self.trees) and tree_slice.start < tree_slice.stop:
-                padded = self._predict_extmem(data, tree_slice)
-                margin = padded[data.valid_mask()] + base[None, :]
+                if getattr(data, "has_raw_pages", False):
+                    # SparsePageDMatrix: raw-value traversal page by page —
+                    # exact float thresholds, works for any model (incl.
+                    # ones trained on other cuts or with tree_method=exact)
+                    import jax.numpy as jnp
+
+                    margin = np.concatenate([
+                        np.asarray(self._margin_delta_for(
+                            jnp.asarray(pg), tree_slice))
+                        for pg in data.raw_dense_pages()
+                    ]) + base[None, :]
+                else:
+                    padded = self._predict_extmem(data, tree_slice)
+                    margin = padded[data.valid_mask()] + base[None, :]
             else:
                 margin = np.broadcast_to(base, (data.num_row(), self.n_groups)).copy()
             if data.info.base_margin is not None:

@@ -355,3 +355,125 @@ class ExtMemQuantileDMatrix(DMatrix):
         raise NotImplementedError("external-memory pages are pre-binned")
 
 
+
+
+class _RawPageReplayIter(DataIter):
+    """Replays a SparsePageDMatrix's stored raw pages (densified, missing as
+    NaN) into the binned-extmem two-pass ingestion."""
+
+    def __init__(self, owner: "SparsePageDMatrix") -> None:
+        super().__init__()
+        self._owner = owner
+        self._i = 0
+
+    def reset(self) -> None:
+        self._i = 0
+
+    def next(self, input_data) -> int:
+        if self._i >= len(self._owner._raw_pages):
+            return 0
+        X = self._owner._raw_page_dense(self._i)
+        input_data(data=X, **self._owner._raw_meta[self._i])
+        self._i += 1
+        return 1
+
+
+class SparsePageDMatrix(ExtMemQuantileDMatrix):
+    """Raw-CSR external-memory DMatrix (reference: SparsePageDMatrix,
+    src/data/sparse_page_dmatrix.h:64): the iterator's batches spill as RAW
+    CSR pages (zstd, host RAM or disk), so raw-value flows work out of
+    core — prediction streams page-by-page with exact float thresholds
+    against ANY model, not just one trained on this matrix's cuts.
+    Training reuses the binned extmem machinery by replaying the raw pages
+    through the quantile/Ellpack passes (the reference's hist path over
+    SparsePage batches fills the same role)."""
+
+    def __init__(self, data: DataIter, *, missing: float = np.nan,
+                 max_bin: int = 256, ref: Optional[DMatrix] = None,
+                 on_host: bool = True, compress: bool = True,
+                 **kwargs: Any) -> None:
+        import scipy.sparse as sp
+
+        if not isinstance(data, DataIter):
+            raise TypeError("SparsePageDMatrix requires a DataIter")
+        use_zstd = compress and _zstd_available()
+        raw_pages: List[Any] = []
+        raw_meta: List[dict] = []
+        spill = None if on_host else tempfile.mkdtemp(prefix="xtb_raw_")
+        n_col = None
+        for batch in _iterate(data):
+            X = batch["data"]
+            if sp.issparse(X):
+                csr = sp.csr_matrix(X).astype(np.float32)
+                vals = csr.data
+                keep = np.isfinite(vals)
+                if missing is not None and not np.isnan(missing):
+                    keep &= vals != np.float32(missing)
+                if not keep.all():
+                    coo = csr.tocoo()
+                    csr = sp.csr_matrix(
+                        (coo.data[keep], (coo.row[keep], coo.col[keep])),
+                        shape=csr.shape)
+            else:
+                Xd = np.asarray(X, np.float32)
+                mask = np.isfinite(Xd)
+                if missing is not None and not np.isnan(missing):
+                    mask &= Xd != np.float32(missing)
+                rows, cols = np.nonzero(mask)  # keeps explicit valid zeros
+                csr = sp.csr_matrix((Xd[rows, cols], (rows, cols)),
+                                    shape=Xd.shape)
+            if n_col is None:
+                n_col = csr.shape[1]
+            elif csr.shape[1] != n_col:
+                raise ValueError("batches disagree on feature count")
+
+            def _store(arr, tag, i=len(raw_pages)):
+                arr = np.ascontiguousarray(arr)
+                if use_zstd:
+                    path = (None if spill is None else
+                            f"{spill}/p{i}_{tag}.zst")
+                    return CompressedPage(arr, path)
+                if spill is not None:
+                    # on_host=False without zstd: memmap spill, same
+                    # fallback the binned pages use
+                    path = f"{spill}/p{i}_{tag}.npy"
+                    mm = np.lib.format.open_memmap(
+                        path, mode="w+", dtype=arr.dtype, shape=arr.shape)
+                    mm[:] = arr
+                    mm.flush()
+                    return np.lib.format.open_memmap(path, mode="r")
+                return arr
+
+            raw_pages.append((_store(csr.indptr.astype(np.int64), "ip"),
+                              _store(csr.indices.astype(np.int32), "ix"),
+                              _store(csr.data.astype(np.float32), "va"),
+                              csr.shape))
+            raw_meta.append({k: np.asarray(v) for k, v in batch.items()
+                             if k != "data" and v is not None})
+        if not raw_pages:
+            raise ValueError("iterator produced no batches")
+        self._raw_pages = raw_pages
+        self._raw_meta = raw_meta
+        self.has_raw_pages = True
+        # binned representation for training: replay the raw pages (missing
+        # is already structural NaN, so the sentinel is normalized away)
+        super().__init__(_RawPageReplayIter(self), max_bin=max_bin, ref=ref,
+                         missing=np.nan, on_host=on_host, compress=compress,
+                         **kwargs)
+
+    def _raw_page_dense(self, i: int) -> np.ndarray:
+        """Densify raw page i: absent entries are NaN (missing)."""
+        import scipy.sparse as sp
+
+        ip, ix, va, shape = self._raw_pages[i]
+        csr = sp.csr_matrix((np.asarray(va), np.asarray(ix), np.asarray(ip)),
+                            shape=shape)
+        X = np.full(shape, np.nan, np.float32)
+        coo = csr.tocoo()
+        X[coo.row, coo.col] = coo.data
+        return X
+
+    def raw_dense_pages(self):
+        """Yield each raw page densified (rows_i, F) — bounded memory."""
+        for i in range(len(self._raw_pages)):
+            yield self._raw_page_dense(i)

@@ -15,6 +15,10 @@ import numpy as np
 
 _REGISTRY: Dict[str, Callable] = {}
 
+# rank metrics carrying the reference's trailing-minus convention
+# (degenerate groups score 0 instead of 1 — ranking_utils.cc ParseMetricName)
+_MINUS_METRICS = {"ndcg", "map", "pre"}
+
 _DIST = threading.local()
 
 
@@ -53,13 +57,35 @@ def register_metric(name: str):
 
 
 def create_metric(name: str):
-    base = name.split("@")[0]
+    """Resolve ``base[@n][-]`` (reference: ranking_utils.cc:138
+    ParseMetricName): ``@n`` truncates ranking metrics at n, a trailing
+    ``-`` flips degenerate-group scoring from 1 to 0 (``ndcg@5-``,
+    ``map-``)."""
+    base, minus, arg = name, False, None
+    if "@" in name:
+        base, param = name.split("@", 1)
+        if param.endswith("-"):
+            minus, param = True, param[:-1]
+        if not param:
+            raise ValueError(f"Invalid metric name {name!r}: '@' needs a "
+                             "numeric truncation/threshold")
+        arg = float(param)
+    elif base.endswith("-") and base[:-1] in _MINUS_METRICS:
+        minus, base = True, base[:-1]
     if base not in _REGISTRY:
         raise ValueError(f"Unknown metric {name!r}. Known: {sorted(_REGISTRY)}")
+    if minus and base not in _MINUS_METRICS:
+        # the '-' convention only exists for rank metrics (ranking_utils.cc)
+        raise ValueError(f"Unknown metric {name!r}: the '-' suffix applies "
+                         f"only to {sorted(_MINUS_METRICS)}")
     fn = _REGISTRY[base]
-    if "@" in name:
-        arg = float(name.split("@")[1])
-        wrapper = lambda *a, **k: fn(*a, at=arg, **k)  # noqa: E731
+    if arg is not None or minus:
+        extra = {}
+        if arg is not None:
+            extra["at"] = arg
+        if minus:
+            extra["minus"] = True
+        wrapper = lambda *a, **k: fn(*a, **{**extra, **k})  # noqa: E731
         wrapper.__wrapped__ = fn  # callers introspect the real signature
         return wrapper, name
     return fn, name
@@ -313,28 +339,48 @@ def auc(preds, labels, weights=None, group_ptr=None, **kw):
     return min(area / pairs, 1.0)
 
 
+def _pr_area(s, y, w):
+    """(PR-AUC, pair mass) of one score/label slice; (0, 0) if degenerate."""
+    if len(s) == 0:
+        return 0.0, 0.0
+    order = np.argsort(-s, kind="stable")
+    yy, ww = y[order], w[order]
+    tp = np.cumsum(ww * yy)
+    fp = np.cumsum(ww * ~yy)
+    pos, neg = float(tp[-1]), float(fp[-1])
+    if pos <= 0 or neg <= 0:
+        return 0.0, 0.0
+    precision = tp / np.maximum(tp + fp, 1e-16)
+    recall = tp / pos
+    return float(np.trapezoid(precision, recall)), pos * neg
+
+
 @register_metric("aucpr")
-def aucpr(preds, labels, weights=None, **kw):
+def aucpr(preds, labels, weights=None, group_ptr=None, **kw):
     s = np.asarray(preds, dtype=np.float64)
     y = labels > 0.5
     w = _w(labels, weights)
+    if group_ptr is not None and len(group_ptr) > 2:
+        # ranking variant (auc.cc RankingAUC for the PR curve): weighted
+        # mean of per-group PR-AUCs over valid groups,
+        # GlobalRatio(sum, valid); weights may be per-group or per-row
+        n_groups = len(group_ptr) - 1
+        group_w = weights is not None and len(weights) == n_groups
+        total, valid = 0.0, 0.0
+        for g in range(n_groups):
+            lo, hi = group_ptr[g], group_ptr[g + 1]
+            w_rows = np.ones(hi - lo, np.float64) if group_w else w[lo:hi]
+            area, pairs = _pr_area(s[lo:hi], y[lo:hi], w_rows)
+            if pairs > 0:
+                wg = float(weights[g]) if group_w else 1.0
+                total += area * wg
+                valid += wg
+        num, den = _reduce_sums(total, valid)
+        return num / den if den > 0 else 0.0
     # a degenerate shard (empty, or single-class) has zero pair mass and
     # contributes nothing to the merge — but it MUST still enter the
     # allreduce, or the cohort's collectives desynchronize
-    local, pairs = 0.0, 0.0
-    if len(s):
-        order = np.argsort(-s, kind="stable")
-        yy, ww = y[order], w[order]
-        tp = np.cumsum(ww * yy)
-        fp = np.cumsum(ww * ~yy)
-        pos, neg = float(tp[-1]), float(fp[-1])
-        if pos > 0 and neg > 0:
-            precision = tp / np.maximum(tp + fp, 1e-16)
-            recall = tp / pos
-            local = float(np.trapezoid(precision, recall))
-            # pair-mass weight: the Curve-template merge shape
-            # (auc.cc:345 GlobalRatio(auc, fp*tp))
-            pairs = pos * neg
+    local, pairs = _pr_area(s, y, w)
     num, den = _reduce_sums(local * pairs, pairs)
     return num / den if den > 0 else 0.0
 
@@ -399,8 +445,11 @@ def _dcg_at(rel, k, exp_gain=True):
 
 
 @register_metric("ndcg")
-def ndcg(preds, labels, weights=None, group_ptr=None, at: float = 0, **kw):
-    """(reference: src/metric/rank_metric.cc NDCG; exp gain by default)."""
+def ndcg(preds, labels, weights=None, group_ptr=None, at: float = 0,
+         minus: bool = False, **kw):
+    """(reference: src/metric/rank_metric.cc NDCG; exp gain by default;
+    ``minus`` (the ``ndcg@n-`` suffix) scores all-irrelevant groups 0
+    instead of 1 — rank_metric.cc:382)."""
     if group_ptr is None:
         group_ptr = np.array([0, len(labels)])
     k = int(at) if at else None
@@ -415,7 +464,7 @@ def ndcg(preds, labels, weights=None, group_ptr=None, at: float = 0, **kw):
         order = np.argsort(-s, kind="stable")
         dcg = _dcg_at(y[order], kk)
         idcg = _dcg_at(np.sort(y)[::-1], kk)
-        vals.append(dcg / idcg if idcg > 0 else 1.0)
+        vals.append(dcg / idcg if idcg > 0 else (0.0 if minus else 1.0))
         ws.append(1.0 if weights is None else weights[g if len(weights) == len(group_ptr) - 1 else lo])
     # per-group partials allreduce (rank_metric.cc via GlobalRatio):
     # (sum of weighted group scores, sum of group weights)
@@ -425,7 +474,10 @@ def ndcg(preds, labels, weights=None, group_ptr=None, at: float = 0, **kw):
 
 
 @register_metric("map")
-def map_metric(preds, labels, weights=None, group_ptr=None, at: float = 0, **kw):
+def map_metric(preds, labels, weights=None, group_ptr=None, at: float = 0,
+               minus: bool = False, **kw):
+    """(reference: rank_metric.cc MAP; groups without a relevant doc score
+    1 by default, 0 under the ``map-`` minus suffix — rank_metric.cc:443)."""
     if group_ptr is None:
         group_ptr = np.array([0, len(labels)])
     k = int(at) if at else None
@@ -441,6 +493,7 @@ def map_metric(preds, labels, weights=None, group_ptr=None, at: float = 0, **kw)
         hits = np.cumsum(yo)
         denom = np.arange(1, len(yo) + 1)
         npos = yo.sum()
-        vals.append(float(np.sum(yo * hits / denom) / npos) if npos > 0 else 0.0)
+        vals.append(float(np.sum(yo * hits / denom) / npos) if npos > 0
+                    else (0.0 if minus else 1.0))
     num, den = _reduce_sums(float(np.sum(vals)), float(len(vals)))
     return num / den if den > 0 else 0.0
