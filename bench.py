@@ -13,6 +13,13 @@ workload: public gpu_hist results put HIGGS-class training at roughly
 100-130 M row·rounds/s on top-end NVIDIA parts (BASELINE.md: the reference
 repo itself publishes no absolute numbers); we use 110 M row·rounds/s.
 vs_baseline > 1.0 means faster than that estimate.
+
+CPU-fallback caveat (the canary number when the TPU tunnel is wedged): on
+CPU the round is bound by MATERIALIZING the (chunk, F*B) one-hot operand,
+not by the matmul — measured ~0.8 GF/s on skinny root builds vs ~23 GF/s
+on wide levels, flat in n_nodes.  That term is exactly what the Pallas
+kernel fuses into VMEM on TPU, so the CPU number tracks regressions but
+must not be read as a TPU performance proxy.
 """
 from __future__ import annotations
 

@@ -143,6 +143,7 @@ def test_lossguide_distributed_global_bestfirst(eight_devices):
             with collective.CommunicatorContext(
                     dmlc_communicator="in-memory", in_memory_world_size=2,
                     in_memory_rank=rank, in_memory_group="bf2"):
+                _grp = collective._TLS.backend._group
                 lo, hi = (0, 1024) if rank == 0 else (1024, 2048)
                 d = xtb.DMatrix(X[lo:hi], label=y[lo:hi])
                 b = xtb.train(params, d, 3, verbose_eval=False)
@@ -151,7 +152,7 @@ def test_lossguide_distributed_global_bestfirst(eight_devices):
         except Exception as e:  # noqa: BLE001
             errors[rank] = e
             try:
-                collective._TLS.backend._group.barrier.abort()
+                _grp.barrier.abort()
             except Exception:
                 pass
 
@@ -193,6 +194,7 @@ def test_lossguide_distributed_adaptive_leaves_rank_identical():
             with collective.CommunicatorContext(
                     dmlc_communicator="in-memory", in_memory_world_size=2,
                     in_memory_rank=rank, in_memory_group="bfad"):
+                _grp = collective._TLS.backend._group
                 lo, hi = (0, 512) if rank == 0 else (512, 1024)
                 d = xtb.DMatrix(X[lo:hi], label=y[lo:hi])
                 b = xtb.train(params, d, 2, verbose_eval=False)
@@ -200,7 +202,7 @@ def test_lossguide_distributed_adaptive_leaves_rank_identical():
         except Exception as e:  # noqa: BLE001
             errors[rank] = e
             try:
-                collective._TLS.backend._group.barrier.abort()
+                _grp.barrier.abort()
             except Exception:
                 pass
 

@@ -27,6 +27,7 @@ def _worker(rank, world, results, errors, group):
                 dmlc_communicator="in-memory",
                 in_memory_world_size=world, in_memory_rank=rank,
                 in_memory_group=group):
+            _grp = collective._TLS.backend._group
             assert collective.get_rank() == rank
             assert collective.get_world_size() == world
             assert collective.is_distributed()
@@ -51,7 +52,7 @@ def _worker(rank, world, results, errors, group):
         errors[rank] = e
         # unblock peers stuck on the barrier
         try:
-            collective._TLS.backend._group.barrier.abort()
+            _grp.barrier.abort()
         except Exception:
             pass
 
@@ -72,3 +73,43 @@ def test_inmemory_thread_workers_identical_trees():
     assert not errors, errors
     dumps = [results[r] for r in range(world)]
     assert all(d == dumps[0] for d in dumps[1:])
+
+
+def test_aggregator_sugar():
+    """GlobalSum/GlobalMax/GlobalRatio (aggregator.h role), single and
+    2-worker in-memory."""
+    # single-process identities
+    np.testing.assert_array_equal(collective.global_sum(np.asarray([2.0, 3.0])),
+                                  [2.0, 3.0])
+    assert int(collective.global_max(np.asarray([7]))[0]) == 7
+    assert collective.global_ratio(3.0, 4.0) == 0.75
+    assert np.isnan(collective.global_ratio(1.0, 0.0))
+
+    results, errors = {}, {}
+
+    def worker(rank):
+        try:
+            with collective.CommunicatorContext(
+                    dmlc_communicator="in-memory", in_memory_world_size=2,
+                    in_memory_rank=rank, in_memory_group="agg"):
+                _grp = collective._TLS.backend._group
+                s = collective.global_sum(np.asarray([float(rank + 1)]))
+                m = collective.global_max(np.asarray([rank]))
+                r = collective.global_ratio(float(rank), 1.0)
+                results[rank] = (float(s[0]), int(m[0]), r)
+        except Exception as e:  # noqa: BLE001
+            errors[rank] = e
+            try:
+                _grp.barrier.abort()
+            except Exception:
+                pass
+
+    import threading
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert results[0] == results[1] == (3.0, 1, 0.5)

@@ -242,6 +242,7 @@ def test_exact_two_process_matches_single():
                     dmlc_communicator="in-memory",
                     in_memory_world_size=world, in_memory_rank=rank,
                     in_memory_group="exact2"):
+                _grp = collective._TLS.backend._group
                 lo, hi = (0, 450) if rank == 0 else (450, 900)
                 d = xtb.DMatrix(X[lo:hi], label=y[lo:hi])
                 bst = xtb.train(params, d, 3, verbose_eval=False)
@@ -249,7 +250,7 @@ def test_exact_two_process_matches_single():
         except Exception as e:  # noqa: BLE001
             errors[rank] = e
             try:
-                collective._TLS.backend._group.barrier.abort()
+                _grp.barrier.abort()
             except Exception:
                 pass
 

@@ -292,6 +292,7 @@ def test_distributed_metric_partial_reduction_matches_single():
                     dmlc_communicator="in-memory",
                     in_memory_world_size=world, in_memory_rank=rank,
                     in_memory_group="metric2"):
+                _grp = collective._TLS.backend._group
                 lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
                 b = xtb.Booster(params)
                 b.load_model(raw)
@@ -306,7 +307,7 @@ def test_distributed_metric_partial_reduction_matches_single():
         except Exception as e:  # noqa: BLE001
             errors[rank] = e
             try:
-                collective._TLS.backend._group.barrier.abort()
+                _grp.barrier.abort()
             except Exception:
                 pass
 

@@ -23,6 +23,7 @@ __all__ = [
     "init", "finalize", "get_rank", "get_world_size", "is_distributed",
     "communicator_print", "get_processor_name", "broadcast", "allreduce",
     "allgather", "allgather_ragged", "signal_error", "Op",
+    "global_sum", "global_max", "global_ratio",
     "CommunicatorContext", "CollBackend",
 ]
 
@@ -366,6 +367,25 @@ def allgather_ragged(data: np.ndarray) -> np.ndarray:
     pad[: data.shape[0]] = data
     stacked = allgather(pad)  # (world, width, ...)
     return np.concatenate([stacked[k, : sizes[k]] for k in range(len(sizes))])
+
+
+def global_sum(values: np.ndarray) -> np.ndarray:
+    """Allreduce-SUM sugar (reference: src/collective/aggregator.h:33
+    GlobalSum)."""
+    return allreduce(np.asarray(values), Op.SUM)
+
+
+def global_max(value) -> np.ndarray:
+    """Allreduce-MAX sugar (aggregator.h:23 GlobalMax)."""
+    return allreduce(np.asarray(value), Op.MAX)
+
+
+def global_ratio(dividend: float, divisor: float) -> float:
+    """sum(dividend) / sum(divisor) across workers; NaN when the global
+    divisor is <= 0 (aggregator.h:52 GlobalRatio — the merge shape every
+    distributed metric uses)."""
+    out = allreduce(np.asarray([dividend, divisor], np.float64), Op.SUM)
+    return float(out[0] / out[1]) if out[1] > 0 else float("nan")
 
 
 def broadcast(data: Any, root: int) -> Any:

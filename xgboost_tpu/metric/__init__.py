@@ -44,7 +44,7 @@ def _reduce_sums(*vals: float):
         return vals
     from .. import collective
 
-    out = collective.allreduce(np.asarray(vals, np.float64))
+    out = collective.global_sum(np.asarray(vals, np.float64))
     return tuple(float(v) for v in out)
 
 
