@@ -6,19 +6,19 @@
 # oracle/dmlc_shim/ (see its headers for the covered surface).
 #
 # Outputs (all outside the reference tree, which stays untouched):
-#   /tmp/xgb_oracle_build/lib/libxgboost.so   — the oracle C library
-#   /tmp/xgb_oracle/xgboost/                  — shadow python package
+#   /root/oracle_build/build/lib/libxgboost.so   — the oracle C library
+#   /root/oracle_build/pkg/xgboost/                  — shadow python package
 #     (per-file symlinks into /root/reference/python-package/xgboost plus a
 #      real lib/ dir holding the .so, which libpath.py picks up first)
 #
 # Usage:  bash oracle/build_oracle.sh   (idempotent; ~40 min cold on 1 core)
-#         then: PYTHONPATH=/tmp/xgb_oracle python -c "import xgboost"
+#         then: PYTHONPATH=/root/oracle_build/pkg python -c "import xgboost"
 set -euo pipefail
 
 REF=/root/reference
 SHIM=$(cd "$(dirname "$0")/dmlc_shim" && pwd)
-BUILD=/tmp/xgb_oracle_build
-PKG=/tmp/xgb_oracle
+BUILD=/root/oracle_build/build
+PKG=/root/oracle_build/pkg
 
 mkdir -p "$BUILD"
 cd "$BUILD"

@@ -85,6 +85,22 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.quick)
 
 
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """A silently-skipping oracle must be LOUD (VERDICT r3 weak #2): every
+    'oracle-verified' parity claim is unverifiable while the oracle binary
+    is missing, so say so in the suite summary, unmissably."""
+    from xgboost_tpu.testing import HAVE_ORACLE, ORACLE_PKG
+
+    if not HAVE_ORACLE:
+        terminalreporter.write_sep(
+            "=", "ORACLE MISSING — parity UNVERIFIED", red=True, bold=True)
+        terminalreporter.write_line(
+            f"The reference-xgboost oracle is not built ({ORACLE_PKG}); every "
+            "test_oracle_parity/test_exact oracle check SKIPPED.\n"
+            "Rebuild with: bash oracle/build_oracle.sh   (~40 min, durable "
+            "under /root/oracle_build)")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

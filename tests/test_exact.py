@@ -175,9 +175,7 @@ def test_exact_unsupported_raise():
                    "objective": "binary:logistic"}, d, 2, verbose_eval=False)
 
 
-ORACLE_PKG = "/tmp/xgb_oracle"
-HAVE_ORACLE = os.path.exists(os.path.join(ORACLE_PKG, "xgboost", "lib",
-                                          "libxgboost.so"))
+from xgboost_tpu.testing import HAVE_ORACLE, ORACLE_PKG  # noqa: E402
 
 
 @pytest.mark.skipif(not HAVE_ORACLE,

@@ -20,9 +20,7 @@ import sys
 import numpy as np
 import pytest
 
-ORACLE_PKG = "/tmp/xgb_oracle"
-HAVE_ORACLE = os.path.exists(os.path.join(ORACLE_PKG, "xgboost", "lib",
-                                          "libxgboost.so"))
+from xgboost_tpu.testing import HAVE_ORACLE, ORACLE_PKG  # noqa: E402
 
 pytestmark = pytest.mark.skipif(
     not HAVE_ORACLE, reason="oracle not built (run oracle/build_oracle.sh)")

@@ -399,10 +399,19 @@ class DMatrix:
     # ---- binning ----
     def ensure_ellpack(self, max_bin: int = 256, sketch_weights: Optional[np.ndarray] = None,
                        ref: Optional["DMatrix"] = None,
-                       distributed: bool = False) -> EllpackPage:
-        if self._ellpack is not None and self._max_bin_built == max_bin:
+                       distributed: bool = False,
+                       row_align: int = 1024) -> EllpackPage:
+        if (self._ellpack is not None and self._max_bin_built == max_bin
+                and self._ellpack.n_padded % row_align == 0):
             return self._ellpack
-        if ref is not None and ref._ellpack is not None:
+        if self._ellpack is not None and self._max_bin_built == max_bin:
+            # alignment-only rebuild (n_devices changed): reuse the built
+            # cuts — re-sketching would waste the work and, distributed, a
+            # rank whose padding already divides row_align would take the
+            # cache hit above while its peers re-enter the sketch
+            # collectives alone (desync)
+            cuts = self._ellpack.cuts
+        elif ref is not None and ref._ellpack is not None:
             cuts = ref._ellpack.cuts  # GetCutsFromRef (quantile_dmatrix.cc:19)
         elif distributed and self._kind == "dense":
             # every process holds a row shard: merge the per-shard quantile
@@ -430,12 +439,14 @@ class DMatrix:
                               weights=sketch_weights, cat_mask=self.cat_mask(),
                               distributed=distributed)
         if self._kind == "dense":
-            self._ellpack = build_ellpack(self._device_dense(), cuts)
+            self._ellpack = build_ellpack(self._device_dense(), cuts,
+                                          row_align=row_align)
             if self._dense is not None:
                 self._jax_X = None  # binned; drop the duplicate device copy
         else:
             indptr, indices, values, (R, F) = self._csr
-            self._ellpack = build_ellpack_csr(indptr, indices, values, F, cuts)
+            self._ellpack = build_ellpack_csr(indptr, indices, values, F, cuts,
+                                              row_align=row_align)
         self._max_bin_built = max_bin
         return self._ellpack
 

@@ -360,10 +360,13 @@ def aucpr(preds, labels, weights=None, group_ptr=None, **kw):
     s = np.asarray(preds, dtype=np.float64)
     y = labels > 0.5
     w = _w(labels, weights)
-    if group_ptr is not None and len(group_ptr) > 2:
+    if group_ptr is not None and len(group_ptr) > 1:
         # ranking variant (auc.cc RankingAUC for the PR curve): weighted
         # mean of per-group PR-AUCs over valid groups,
-        # GlobalRatio(sum, valid); weights may be per-group or per-row
+        # GlobalRatio(sum, valid); weights may be per-group or per-row.
+        # Branch on group STRUCTURE, not local group count: a rank whose
+        # shard holds a single query group must still contribute per-group
+        # partials to the same allreduce as its peers (ADVICE r3).
         n_groups = len(group_ptr) - 1
         group_w = weights is not None and len(weights) == n_groups
         total, valid = 0.0, 0.0
@@ -481,7 +484,7 @@ def map_metric(preds, labels, weights=None, group_ptr=None, at: float = 0,
     if group_ptr is None:
         group_ptr = np.array([0, len(labels)])
     k = int(at) if at else None
-    vals = []
+    vals, ws = [], []
     for g in range(len(group_ptr) - 1):
         lo, hi = group_ptr[g], group_ptr[g + 1]
         if hi <= lo:
@@ -495,5 +498,10 @@ def map_metric(preds, labels, weights=None, group_ptr=None, at: float = 0,
         npos = yo.sum()
         vals.append(float(np.sum(yo * hits / denom) / npos) if npos > 0
                     else (0.0 if minus else 1.0))
-    num, den = _reduce_sums(float(np.sum(vals)), float(len(vals)))
+        # group weights, like ndcg (rank_metric.cc EvalRank::Eval weights
+        # each group's contribution; ADVICE r3: map previously ignored them)
+        ws.append(1.0 if weights is None
+                  else weights[g if len(weights) == len(group_ptr) - 1 else lo])
+    num, den = _reduce_sums(float(np.dot(vals, ws)) if vals else 0.0,
+                            float(np.sum(ws)) if ws else 0.0)
     return num / den if den > 0 else 0.0

@@ -97,17 +97,21 @@ def _hist_accumulate(bins, gpair, pos, node0, n_nodes, n_bin, chunk, stride):
     return acc
 
 
-@functools.partial(jax.jit, static_argnames=("n_nodes", "n_bin", "chunk"))
+@functools.partial(jax.jit, static_argnames=("n_nodes", "n_bin", "chunk",
+                                             "stride"))
 def build_histogram_at(bins, gpair, pos, node0, *, n_nodes: int, n_bin: int,
-                       chunk: int = 2048):
+                       chunk: int = 2048, stride: int = 1):
     """build_histogram with a TRACED starting node id.
 
-    The best-first grower expands one node pair at a time with fresh ids;
-    a static node0 would recompile the kernel per expansion, so here node0
-    is an operand (it only feeds the node-mask comparison, never a shape).
+    The best-first grower expands one node pair at a time with fresh ids,
+    and the padded level step walks depths with one compiled program; a
+    static node0 would recompile the kernel per expansion/depth, so here
+    node0 is an operand (it only feeds the node-mask comparison, never a
+    shape).
     """
     node0 = jnp.asarray(node0, jnp.int32)
-    return _hist_accumulate(bins, gpair, pos, node0, n_nodes, n_bin, chunk, 1)
+    return _hist_accumulate(bins, gpair, pos, node0, n_nodes, n_bin, chunk,
+                            stride)
 
 
 def combine_sibling_hists(left, hist_prev, alive_lvl):

@@ -19,7 +19,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.split import SplitParams
 from ..tree.grow import (TreeState, init_tree_state, level_step,
-                         make_set_matrix, max_nodes_for_depth)
+                         level_step_padded, make_set_matrix,
+                         max_nodes_for_depth)
 from .mesh import DATA_AXIS
 
 
@@ -70,10 +71,30 @@ class ShardedHistTreeGrower:
             )
         )
 
+        row_specs = (sspec, P(ax, None), P(ax, None), P(), P(), P(), P(), P())
         self._level_fns = {}
-        for d in range(self.max_depth + 1):
+        # one shared padded interior program for all depths 1..max_depth-1
+        # (same compile-wall fix as HistTreeGrower; hist psum rides inside
+        # level_step_padded via axis_name) — per-depth programs only for the
+        # root and the leaf-finalize level, plus the pallas fallback.
+        self._padded = self.hist_impl != "pallas" and self.max_depth >= 2
+        if self._padded:
+            W = 1 << (self.max_depth - 1)
+            pad_base = functools.partial(
+                level_step_padded, width=W, params=self.params, axis_name=ax,
+                hist_impl=self.hist_impl, lossguide=self.lossguide,
+                has_cat=has_cat, subtract=True,
+            )
+            self._interior_fn = jax.jit(
+                jax.shard_map(pad_base, mesh=self.mesh,
+                              in_specs=row_specs + (P(), P()),
+                              out_specs=(sspec, P()))
+            )
+        depths = ((0, self.max_depth) if self._padded
+                  else range(self.max_depth + 1))
+        for d in depths:
             last = d == self.max_depth
-            subtract = d > 0 and not last
+            subtract = d > 0 and not last and not self._padded
             base = functools.partial(
                 level_step,
                 depth=d,
@@ -85,7 +106,6 @@ class ShardedHistTreeGrower:
                 has_cat=has_cat,
                 subtract=subtract,
             )
-            row_specs = (sspec, P(ax, None), P(ax, None), P(), P(), P(), P(), P())
             if last:
                 # hist neither consumed nor produced on the last level
                 def fn(state, bins, gpair, cuts, nb, fm, sm, cmm, _b=base):
@@ -112,6 +132,26 @@ class ShardedHistTreeGrower:
         setmat = jnp.asarray(make_set_matrix(self.interaction_sets, F))
         cm = jnp.asarray(cat_mask) if cat_mask is not None else jnp.zeros(F, bool)
         state = self._init_fn(gpair, valid)
+        if self._padded:
+            from ..tree.grow import HistTreeGrower
+
+            md = self.max_depth
+            W = 1 << (md - 1)
+            fm = ones if feature_masks is None else feature_masks(0, 1)
+            state, hist = self._level_fns[0](state, bins, gpair, cuts_pad,
+                                             n_bins, fm, setmat, cm)
+            hist_pad = jnp.zeros((W,) + hist.shape[1:],
+                                 hist.dtype).at[:1].set(hist)
+            for d in range(1, md):
+                fm = (ones if feature_masks is None
+                      else HistTreeGrower._pad_mask(feature_masks(d, 1 << d), W))
+                state, hist_pad = self._interior_fn(
+                    state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
+                    hist_pad, jnp.int32((1 << d) - 1))
+            fm = ones if feature_masks is None else feature_masks(md, 1 << md)
+            state = self._level_fns[md](state, bins, gpair, cuts_pad, n_bins,
+                                        fm, setmat, cm)
+            return state
         hist_prev = None
         for d in range(self.max_depth + 1):
             fm = ones if feature_masks is None else feature_masks(d, 1 << d)

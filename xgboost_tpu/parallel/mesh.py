@@ -33,12 +33,19 @@ def init_distributed(coordinator_address: Optional[str] = None,
 
 
 def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None):
-    """1-D data-parallel mesh over the first n devices."""
+    """1-D data-parallel mesh over the first n LOCAL devices.
+
+    Local, not global: within one process the mesh carries chip-level data
+    parallelism (GSPMD psum over ICI); ACROSS processes histograms ride the
+    host collective (collective.allreduce) — composing the two is the
+    reference's multi-host rabit × per-device NCCL layering
+    (src/collective/comm.cuh:51).  Single-process, local == global.
+    """
     import jax
     from jax.sharding import Mesh
 
     if devices is None:
-        devices = jax.devices()
+        devices = jax.local_devices()
     if n_devices is not None:
         if n_devices > len(devices):
             raise ValueError(f"requested {n_devices} devices, have {len(devices)}")

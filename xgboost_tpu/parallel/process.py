@@ -42,13 +42,21 @@ class ProcessHistTreeGrower:
 
     def __init__(self, max_depth: int, params: SplitParams, *,
                  interaction_sets=None, max_leaves: int = 0,
-                 lossguide: bool = False, subtract: bool = True) -> None:
+                 lossguide: bool = False, subtract: bool = True,
+                 mesh=None) -> None:
         self.max_depth = max_depth
         self.params = params
         self.interaction_sets = interaction_sets
         self.max_leaves = max_leaves
         self.lossguide = lossguide
         self.subtract = subtract
+        # process-DP x chip-DP composition (the reference's multi-host rabit
+        # x per-device NCCL layering, src/collective/comm.cuh:51; dask one-
+        # GPU-per-worker generalized): rows are sharded over the process's
+        # LOCAL mesh, GSPMD partitions the jitted page step (hist partials
+        # psum over local chips), and the replicated local hist then crosses
+        # processes through the ordered host allreduce below.
+        self.mesh = mesh
         self.max_nodes = max_nodes_for_depth(max_depth)
 
     def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None,
@@ -64,6 +72,15 @@ class ProcessHistTreeGrower:
             max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
             n_bin=B,
         )
+        if self.mesh is not None:
+            # chip-level row sharding within this process; jit/GSPMD then
+            # partitions _page_step (position update stays elementwise-
+            # sharded, the hist contraction all-reduces over local chips)
+            from .mesh import row_sharding, shard_rows
+
+            bins, gpair = shard_rows(self.mesh, bins, gpair)
+            state = state._replace(
+                pos=jax.device_put(state.pos, row_sharding(self.mesh)))
         # root totals: GlobalSum across processes (updater_gpu_hist.cu:581)
         from ..tree.grow import sync_root_totals
 

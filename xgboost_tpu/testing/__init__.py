@@ -1,1 +1,11 @@
 
+"""Shared test-support surface (reference: python-package/xgboost/testing)."""
+import os
+
+# The reference-xgboost oracle the parity suites train against (built by
+# oracle/build_oracle.sh; durable under /root so /tmp wipes can't silently
+# disable parity checking).  Single source of truth for every consumer:
+# tests/test_oracle_parity.py, tests/test_exact.py, tests/conftest.py.
+ORACLE_PKG = "/root/oracle_build/pkg"
+HAVE_ORACLE = os.path.exists(os.path.join(ORACLE_PKG, "xgboost", "lib",
+                                          "libxgboost.so"))

@@ -327,6 +327,121 @@ def level_step(
     return st, hist
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("width", "params", "axis_name", "hist_impl",
+                     "lossguide", "has_cat", "subtract"),
+)
+def level_step_padded(
+    state: TreeState,
+    bins,
+    gpair,
+    cuts_pad,
+    n_bins,
+    feature_mask,
+    set_matrix,
+    cat_mask,
+    hist_prev,
+    node0,
+    *,
+    width: int,
+    params: SplitParams,
+    axis_name: Optional[str] = None,
+    hist_impl: str = "xla",
+    lossguide: bool = False,
+    has_cat: bool = False,
+    subtract: bool = True,
+):
+    """``level_step`` with the node dimension PADDED to a fixed ``width`` and
+    a TRACED ``node0`` — ONE compiled program serves every interior depth
+    (VERDICT r3 #4: the per-depth compile wall).
+
+    ``width`` = 2**(max_depth-1), the widest interior level.  Padding is
+    cheap by design: the histogram one-hot matmul cost is flat in the node
+    count on CPU (operand materialization dominates) and the extra output
+    columns ride the same MXU tile on TPU (2*width <= 128 for depth <= 7).
+
+    Correctness of the padding (garbage level offsets j >= 2**depth):
+    - their heap slots overlay only DEEPER levels' ids, whose real writes
+      happen at later steps, strictly after every garbage write;
+    - within one step, left/right child scatter indices are all distinct
+      (odd/even disjoint), so garbage and real writes never collide;
+    - garbage rows match no ``pos`` (row positions only ever hold ids of
+      levels <= current), so their histograms, and hence gains, are zero and
+      ``alive`` is False — they can never split or consume ``max_leaves``
+      budget (their priority is -inf, which cannot outrank any real
+      candidate's finite priority).
+
+    ``hist_prev``/returned ``hist`` use the padded level-offset layout
+    (width, F, B, C); row j = heap node ``node0 + j``.
+    """
+    from ..ops.histogram import build_histogram_at
+
+    W = width
+    B = cuts_pad.shape[1]
+    node0 = jnp.asarray(node0, jnp.int32)
+
+    idx = node0 + jnp.arange(W, dtype=jnp.int32)
+    totals_lvl = lax.dynamic_slice_in_dim(state.totals, node0, W, axis=0)
+    alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, W, axis=0)
+    lower_lvl = lax.dynamic_slice_in_dim(state.lower, node0, W, axis=0)
+    upper_lvl = lax.dynamic_slice_in_dim(state.upper, node0, W, axis=0)
+    w = calc_weight(totals_lvl[:, 0], totals_lvl[:, 1], params, lower_lvl,
+                    upper_lvl)
+
+    if hist_impl == "pallas":
+        raise NotImplementedError(
+            "padded level sharing currently uses the XLA hist path; "
+            "hist_impl='pallas' keeps per-depth level_step")
+    if subtract:
+        half = W // 2
+        left = build_histogram_at(bins, gpair, state.pos, node0,
+                                  n_nodes=half, n_bin=B, stride=2)
+        if axis_name is not None:
+            left = lax.psum(left, axis_name)
+        hist = combine_sibling_hists(left, hist_prev[:half], alive_lvl)
+    else:
+        hist = build_histogram_at(bins, gpair, state.pos, node0,
+                                  n_nodes=W, n_bin=B)
+        if axis_name is not None:
+            hist = lax.psum(hist, axis_name)
+
+    compat_lvl = lax.dynamic_slice_in_dim(state.setcompat, node0, W, axis=0)
+    allowed = jnp.einsum("ns,sf->nf", compat_lvl.astype(jnp.float32),
+                         set_matrix.astype(jnp.float32)) > 0.0
+    fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
+    fmask = allowed & fm
+
+    node_bounds = jnp.stack([lower_lvl, upper_lvl], axis=1)
+    best = evaluate_splits(hist, totals_lvl, n_bins, params, fmask,
+                           node_bounds,
+                           cat_mask=cat_mask if has_cat else None)
+
+    gamma_eps = max(params.gamma, _EPS)
+    can_split = alive_lvl & (best.gain > gamma_eps)
+
+    budget = state.splits_left[0]
+    prio = best.gain if lossguide else -idx.astype(jnp.float32)
+    prio = jnp.where(can_split, prio, -jnp.inf)
+    order = jnp.argsort(-prio)
+    ranks = jnp.argsort(order).astype(jnp.int32)
+    can_split = can_split & (ranks < budget)
+    new_budget = budget - jnp.sum(can_split).astype(jnp.int32)
+
+    new_leaf = alive_lvl & ~can_split
+
+    thr_lvl = cuts_pad[best.feature, jnp.minimum(best.bin, B - 1)]
+    member = set_matrix.T[jnp.clip(best.feature, 0, set_matrix.shape[1] - 1)]
+    st = _record_level(state, best, idx, can_split, new_leaf, w, thr_lvl,
+                       totals_lvl, compat_lvl, member, new_budget, lower_lvl,
+                       upper_lvl, params)
+    st = st._replace(
+        pos=_update_positions(bins, st.pos, best, can_split, node0, W, B,
+                              has_cat)
+    )
+    return st, hist
+
+
 @jax.jit
 def leaf_margin_delta(pos, leaf_val):
     """Per-row margin update from the finished tree — the prediction-cache
@@ -368,6 +483,7 @@ class HistTreeGrower:
         max_leaves: int = 0,
         lossguide: bool = False,
         subtract: bool = True,
+        padded_levels: bool = True,
     ) -> None:
         self.max_depth = max_depth
         self.params = params
@@ -377,6 +493,10 @@ class HistTreeGrower:
         self.max_leaves = max_leaves
         self.lossguide = lossguide
         self.subtract = subtract
+        # one shared compiled program for all interior depths (padded node
+        # dim + traced node0) instead of one per depth — kills the compile
+        # wall.  Pallas hist keeps per-depth steps (static node0 kernel).
+        self.padded_levels = padded_levels and hist_impl != "pallas"
         self.max_nodes = max_nodes_for_depth(max_depth)
 
     def _set_matrix(self, n_features: int):
@@ -399,29 +519,53 @@ class HistTreeGrower:
             max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
             n_bin=B,
         )
-        hist_prev = None
-        for d in range(self.max_depth + 1):
-            fm = ones if feature_masks is None else feature_masks(d, 1 << d)
-            state, hist_prev = level_step(
-                state,
-                bins,
-                gpair,
-                cuts_pad,
-                n_bins,
-                fm,
-                setmat,
-                cm,
-                hist_prev,
-                depth=d,
-                params=self.params,
-                last_level=(d == self.max_depth),
-                axis_name=self.axis_name,
-                hist_impl=self.hist_impl,
-                lossguide=self.lossguide,
-                has_cat=has_cat,
-                subtract=(self.subtract and d > 0 and hist_prev is not None),
-            )
+        md = self.max_depth
+        common = dict(params=self.params, axis_name=self.axis_name,
+                      lossguide=self.lossguide, has_cat=has_cat)
+        if not self.padded_levels or md < 2:
+            hist_prev = None
+            for d in range(md + 1):
+                fm = ones if feature_masks is None else feature_masks(d, 1 << d)
+                state, hist_prev = level_step(
+                    state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
+                    hist_prev, depth=d, last_level=(d == md),
+                    hist_impl=self.hist_impl,
+                    subtract=(self.subtract and d > 0 and hist_prev is not None),
+                    **common)
+            return state
+
+        # 3 compiled programs regardless of depth: root, shared padded
+        # interior (traced node0), leaf finalize
+        fm = ones if feature_masks is None else feature_masks(0, 1)
+        state, hist = level_step(
+            state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm, None,
+            depth=0, last_level=False, hist_impl=self.hist_impl,
+            subtract=False, **common)
+        W = 1 << (md - 1)
+        hist_pad = jnp.zeros((W,) + hist.shape[1:], hist.dtype).at[:1].set(hist)
+        for d in range(1, md):
+            fm = (ones if feature_masks is None
+                  else self._pad_mask(feature_masks(d, 1 << d), W))
+            state, hist_pad = level_step_padded(
+                state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
+                hist_pad, (1 << d) - 1, width=W, subtract=self.subtract,
+                hist_impl=self.hist_impl, **common)
+        fm = ones if feature_masks is None else feature_masks(md, 1 << md)
+        state, _ = level_step(
+            state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm, None,
+            depth=md, last_level=True, hist_impl=self.hist_impl,
+            subtract=False, **common)
         return state
+
+    @staticmethod
+    def _pad_mask(fm, W: int):
+        """Pad a (N, F) per-node feature mask to the fixed (W, F) level width
+        (False rows can never split); (1, F) masks broadcast unchanged."""
+        fm = jnp.asarray(fm)
+        if fm.ndim == 2 and 1 < fm.shape[0] < W:
+            fm = jnp.concatenate(
+                [fm, jnp.zeros((W - fm.shape[0], fm.shape[1]), bool)], axis=0)
+        return fm
 
     @staticmethod
     def to_host(state: TreeState) -> GrownTree:
