@@ -224,6 +224,12 @@ class Booster:
         self.updater_seq = ([u.strip() for u in str(upd).split(",") if u.strip()]
                             if upd else None)
         self.refresh_leaf = str(p.get("refresh_leaf", "1")).lower() in ("1", "true")
+        # fixed-point limb histograms (ops/quantise.py): bitwise-identical
+        # trees on every chip x process topology — the reference's
+        # GradientQuantiser behaviour (src/tree/gpu_hist/quantiser.cuh),
+        # exposed as an opt-in because the f32 path is the faster default
+        self.deterministic_histogram = str(
+            p.get("deterministic_histogram", "0")).lower() in ("1", "true")
         # vector-leaf trees (multi_target_tree_model.h): one tree carries all
         # K outputs when multi_strategy="multi_output_tree"
         self.multi_strategy = str(p.get("multi_strategy", "one_output_per_tree"))
@@ -1075,6 +1081,10 @@ class Booster:
         if self.booster_kind == "dart":
             raise NotImplementedError(
                 "multi_strategy='multi_output_tree' with DART is not supported")
+        if self.deterministic_histogram:
+            raise NotImplementedError(
+                "deterministic_histogram is not supported with "
+                "multi_output_tree yet")
         if cat_mask_np is not None and np.any(cat_mask_np):
             raise NotImplementedError(
                 "multi_output_tree with categorical features is not supported "
@@ -1253,6 +1263,10 @@ class Booster:
         import jax.numpy as jnp
 
         if cache.is_extmem:
+            if self.deterministic_histogram:
+                raise NotImplementedError(
+                    "deterministic_histogram is not supported with "
+                    "external-memory training yet")
             if self.tree_method == "exact":
                 raise NotImplementedError(
                     "tree_method='exact' needs raw in-memory values; it is "
@@ -1268,6 +1282,10 @@ class Booster:
                     "process one device")
             return self._boost_trees_extmem(cache, gpair, iteration)
         exact = self.tree_method == "exact"
+        if exact and self.deterministic_histogram:
+            raise NotImplementedError(
+                "deterministic_histogram applies to histogram growers; "
+                "tree_method='exact' has no histogram")
         if exact:
             # the exact branch walks raw host values: no sketch, no Ellpack,
             # no jitted grower — building them here would be pure waste
@@ -1298,10 +1316,11 @@ class Booster:
         if max_depth <= 0:
             # best-first: depth bounded only by the leaf budget
             max_depth = 0 if best_first else self._resolve_max_depth(lossguide)
+        det = self.deterministic_histogram
         gkey = (max_depth, id(mesh), self._split_params,
                 self.tparam.interaction_constraints, self.tparam.max_leaves,
                 lossguide, str(self.params.get("_hist_impl", "xla")), proc_par,
-                best_first)
+                best_first, det)
         if not hasattr(self, "_grower_cache"):
             self._grower_cache = {}
         grower = self._grower_cache.get(gkey)
@@ -1309,6 +1328,10 @@ class Booster:
             if best_first:
                 from .tree.bestfirst import BestFirstGrower
 
+                if det:
+                    raise NotImplementedError(
+                        "deterministic_histogram is not supported with the "
+                        "best-first (lossguide + max_leaves) grower yet")
                 if proc_par and mesh is not None:
                     raise NotImplementedError(
                         "n_devices > 1 within a process is not combined "
@@ -1336,6 +1359,7 @@ class Booster:
                     max_leaves=self.tparam.max_leaves,
                     lossguide=lossguide,
                     mesh=mesh,
+                    quantised=det,
                 )
             elif mesh is not None:
                 from .parallel import ShardedHistTreeGrower
@@ -1350,6 +1374,7 @@ class Booster:
                     interaction_sets=self.tparam.interaction_constraints,
                     max_leaves=self.tparam.max_leaves,
                     lossguide=lossguide,
+                    quantised=det,
                 )
             else:
                 grower = HistTreeGrower(
@@ -1359,6 +1384,7 @@ class Booster:
                     interaction_sets=self.tparam.interaction_constraints,
                     max_leaves=self.tparam.max_leaves,
                     lossguide=lossguide,
+                    quantised=det,
                 )
             self._grower_cache[gkey] = grower
         adaptive = (

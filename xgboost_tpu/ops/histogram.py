@@ -20,9 +20,12 @@ Two implementations:
    into VMEM so the (rows, F*B) operand never touches HBM — the production
    TPU kernel.
 
-Determinism: float32 accumulation in a fixed sequential chunk order — the role
-played by fixed-point gradient quantisation in the reference
-(src/tree/gpu_hist/quantiser.cuh:52) is filled by the absence of atomics.
+Determinism: float32 accumulation in a fixed sequential chunk order — within
+one topology, the role played by fixed-point gradient quantisation in the
+reference (src/tree/gpu_hist/quantiser.cuh:52) is filled by the absence of
+atomics.  For bitwise reproducibility ACROSS topologies (any chip/process
+layout), ``deterministic_histogram=True`` switches to exact int8-limb
+histograms with integer reductions — see ops/quantise.py.
 """
 from __future__ import annotations
 

@@ -40,7 +40,8 @@ class ShardedHistTreeGrower:
 
     def __init__(self, max_depth: int, params: SplitParams, mesh, *,
                  hist_impl: str = "xla", interaction_sets=None,
-                 max_leaves: int = 0, lossguide: bool = False) -> None:
+                 max_leaves: int = 0, lossguide: bool = False,
+                 quantised: bool = False) -> None:
         self.max_depth = max_depth
         self.params = params
         self.mesh = mesh
@@ -48,6 +49,10 @@ class ShardedHistTreeGrower:
         self.interaction_sets = interaction_sets
         self.max_leaves = max_leaves
         self.lossguide = lossguide
+        # fixed-point limb histograms (ops/quantise.py): int psum is exact,
+        # so trees are bitwise-identical for ANY chip count — the
+        # GradientQuantiser contract (src/tree/gpu_hist/quantiser.cuh)
+        self.quantised = quantised
         self.max_nodes = max_nodes_for_depth(max_depth)
         self._built_for = None
 
@@ -71,7 +76,12 @@ class ShardedHistTreeGrower:
             )
         )
 
-        row_specs = (sspec, P(ax, None), P(ax, None), P(), P(), P(), P(), P())
+        q = self.quantised
+        # quantised: the gpair slot carries (R, C, 3) int8 limbs and every
+        # level fn takes a trailing replicated rho (per-channel scale)
+        gspec = P(ax, None, None) if q else P(ax, None)
+        row_specs = (sspec, P(ax, None), gspec, P(), P(), P(), P(), P())
+        rho_specs = (P(),) if q else ()
         self._level_fns = {}
         # one shared padded interior program for all depths 1..max_depth-1
         # (same compile-wall fix as HistTreeGrower; hist psum rides inside
@@ -83,11 +93,11 @@ class ShardedHistTreeGrower:
             pad_base = functools.partial(
                 level_step_padded, width=W, params=self.params, axis_name=ax,
                 hist_impl=self.hist_impl, lossguide=self.lossguide,
-                has_cat=has_cat, subtract=True,
+                has_cat=has_cat, subtract=True, quantised=q,
             )
             self._interior_fn = jax.jit(
                 jax.shard_map(pad_base, mesh=self.mesh,
-                              in_specs=row_specs + (P(), P()),
+                              in_specs=row_specs + (P(), P()) + rho_specs,
                               out_specs=(sspec, P()))
             )
         depths = ((0, self.max_depth) if self._padded
@@ -105,19 +115,30 @@ class ShardedHistTreeGrower:
                 lossguide=self.lossguide,
                 has_cat=has_cat,
                 subtract=subtract,
+                quantised=q,
             )
             if last:
                 # hist neither consumed nor produced on the last level
-                def fn(state, bins, gpair, cuts, nb, fm, sm, cmm, _b=base):
+                def fn(state, bins, gpair, cuts, nb, fm, sm, cmm, *r, _b=base):
                     st, _ = _b(state, bins, gpair, cuts, nb, fm, sm, cmm)
                     return st
 
-                in_specs, out_specs = row_specs, sspec
+                in_specs, out_specs = row_specs + rho_specs, sspec
             elif subtract:
                 # hist_prev is replicated (already psummed at its own level)
-                fn, in_specs, out_specs = base, row_specs + (P(),), (sspec, P())
+                fn = base
+                in_specs = row_specs + (P(),) + rho_specs
+                out_specs = (sspec, P())
             else:
-                fn, in_specs, out_specs = base, row_specs, (sspec, P())
+                if q:
+                    def fn(state, bins, gq, cuts, nb, fm, sm, cmm, rho,
+                           _b=base):
+                        return _b(state, bins, gq, cuts, nb, fm, sm, cmm,
+                                  None, rho)
+                else:
+                    fn = base
+                in_specs = row_specs + rho_specs
+                out_specs = (sspec, P())
             self._level_fns[d] = jax.jit(
                 jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                               out_specs=out_specs)
@@ -132,6 +153,19 @@ class ShardedHistTreeGrower:
         setmat = jnp.asarray(make_set_matrix(self.interaction_sets, F))
         cm = jnp.asarray(cat_mask) if cat_mask is not None else jnp.zeros(F, bool)
         state = self._init_fn(gpair, valid)
+        rho_args = ()
+        if self.quantised:
+            from ..ops.quantise import (check_row_budget, local_rho,
+                                        quantise_gpair, quantised_root_state)
+
+            check_row_budget(gpair.shape[0])
+            # jit over the already-sharded gpair: GSPMD's all-reduce-max and
+            # integer root reduce are exact, so rho and the root totals are
+            # identical on every topology
+            rho = local_rho(gpair, valid)
+            gpair = quantise_gpair(gpair, rho)
+            state = quantised_root_state(state, gpair, rho)
+            rho_args = (rho,)
         if self._padded:
             from ..tree.grow import HistTreeGrower
 
@@ -139,7 +173,8 @@ class ShardedHistTreeGrower:
             W = 1 << (md - 1)
             fm = ones if feature_masks is None else feature_masks(0, 1)
             state, hist = self._level_fns[0](state, bins, gpair, cuts_pad,
-                                             n_bins, fm, setmat, cm)
+                                             n_bins, fm, setmat, cm,
+                                             *rho_args)
             hist_pad = jnp.zeros((W,) + hist.shape[1:],
                                  hist.dtype).at[:1].set(hist)
             for d in range(1, md):
@@ -147,25 +182,26 @@ class ShardedHistTreeGrower:
                       else HistTreeGrower._pad_mask(feature_masks(d, 1 << d), W))
                 state, hist_pad = self._interior_fn(
                     state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
-                    hist_pad, jnp.int32((1 << d) - 1))
+                    hist_pad, jnp.int32((1 << d) - 1), *rho_args)
             fm = ones if feature_masks is None else feature_masks(md, 1 << md)
             state = self._level_fns[md](state, bins, gpair, cuts_pad, n_bins,
-                                        fm, setmat, cm)
+                                        fm, setmat, cm, *rho_args)
             return state
         hist_prev = None
         for d in range(self.max_depth + 1):
             fm = ones if feature_masks is None else feature_masks(d, 1 << d)
             if d == self.max_depth:
                 state = self._level_fns[d](state, bins, gpair, cuts_pad, n_bins,
-                                           fm, setmat, cm)
+                                           fm, setmat, cm, *rho_args)
             elif d == 0:
                 state, hist_prev = self._level_fns[d](state, bins, gpair,
                                                       cuts_pad, n_bins, fm,
-                                                      setmat, cm)
+                                                      setmat, cm, *rho_args)
             else:
                 state, hist_prev = self._level_fns[d](state, bins, gpair,
                                                       cuts_pad, n_bins, fm,
-                                                      setmat, cm, hist_prev)
+                                                      setmat, cm, hist_prev,
+                                                      *rho_args)
         return state
 
     @staticmethod

@@ -43,7 +43,7 @@ class ProcessHistTreeGrower:
     def __init__(self, max_depth: int, params: SplitParams, *,
                  interaction_sets=None, max_leaves: int = 0,
                  lossguide: bool = False, subtract: bool = True,
-                 mesh=None) -> None:
+                 mesh=None, quantised: bool = False) -> None:
         self.max_depth = max_depth
         self.params = params
         self.interaction_sets = interaction_sets
@@ -57,6 +57,12 @@ class ProcessHistTreeGrower:
         # psum over local chips), and the replicated local hist then crosses
         # processes through the ordered host allreduce below.
         self.mesh = mesh
+        # fixed-point limb histograms (ops/quantise.py): the chip psum and
+        # the cross-process reduction both run on exact integers, so trees
+        # are bitwise-identical across ANY process x chip topology — the
+        # reference's GradientQuantiser + integer-rabit contract
+        # (src/tree/gpu_hist/quantiser.cuh)
+        self.quantised = quantised
         self.max_nodes = max_nodes_for_depth(max_depth)
 
     def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None,
@@ -84,7 +90,22 @@ class ProcessHistTreeGrower:
         # root totals: GlobalSum across processes (updater_gpu_hist.cu:581)
         from ..tree.grow import sync_root_totals
 
-        state = sync_root_totals(state)
+        rho = None
+        if self.quantised:
+            from ..ops.quantise import (check_row_budget, local_rho,
+                                        quantise_gpair, quantised_root_state)
+
+            check_row_budget(gpair.shape[0])
+            # global per-channel scale: chip max via GSPMD (exact), process
+            # max via host allreduce (exact) — identical on every topology
+            r_loc = local_rho(gpair, valid)
+            rho = jnp.asarray(collective.allreduce(np.asarray(r_loc),
+                                                   collective.Op.MAX))
+            gpair = quantise_gpair(gpair, rho)  # (R, C, 3) int8 limbs
+            state = quantised_root_state(state, gpair, rho,
+                                         process_reduce=True)
+        else:
+            state = sync_root_totals(state)
 
         prev_best, prev_can, prev_d = None, None, -1
         hist_prev = None
@@ -100,21 +121,30 @@ class ProcessHistTreeGrower:
                 n_prev=1 << max(prev_d, 0), node0=node0, n_nodes=n_build,
                 n_bin=B, has_prev=prev_best is not None, has_cat=has_cat,
                 build=build, stride=2 if subtract else 1,
+                quantised=self.quantised,
             )
             state = state._replace(pos=pos)
             if build:
-                # the one cross-process exchange per level (AllReduceHist)
-                hist = jnp.asarray(collective.allreduce(np.asarray(h)))
+                # the one cross-process exchange per level (AllReduceHist);
+                # quantised: limbs reduce in int64 on host — exact, so the
+                # exchange is order-invariant (integer-rabit role)
+                if self.quantised:
+                    from ..ops.quantise import allreduce_limbs, dequantise
+
+                    hist = allreduce_limbs(h)
+                else:
+                    hist = jnp.asarray(collective.allreduce(np.asarray(h)))
                 if subtract:
                     alive_lvl = jax.lax.dynamic_slice_in_dim(
                         state.alive, node0, N)
                     hist = combine_sibling_hists(hist, hist_prev, alive_lvl)
                 hist_prev = hist
+                hist_f = (dequantise(hist, rho) if self.quantised else hist)
             else:
-                hist = jnp.zeros((N, F, B, 2), jnp.float32)
+                hist_f = jnp.zeros((N, F, B, 2), jnp.float32)
             fm = ones if feature_masks is None else feature_masks(d, N)
             state, best, can = _decide_level(
-                state, hist, n_bins, cuts_pad, fm, setmat, cm,
+                state, hist_f, n_bins, cuts_pad, fm, setmat, cm,
                 depth=d, params=self.params, lossguide=self.lossguide,
                 last_level=(d == self.max_depth),
             )

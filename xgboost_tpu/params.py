@@ -137,7 +137,7 @@ KNOWN_LEARNER_KEYS = {
     # tweedie / huber
     "tweedie_variance_power", "huber_slope",
     "scale_pos_weight", "enable_categorical", "missing", "validate_parameters",
-    "n_devices", "process_type", "refresh_leaf",
+    "n_devices", "process_type", "refresh_leaf", "deterministic_histogram",
 }
 
 

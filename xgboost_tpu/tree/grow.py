@@ -213,7 +213,7 @@ def _update_positions(bins, pos, best, can_split, node0: int, N: int, B: int,
 @functools.partial(
     jax.jit,
     static_argnames=("depth", "params", "last_level", "axis_name", "hist_impl",
-                     "lossguide", "has_cat", "subtract"),
+                     "lossguide", "has_cat", "subtract", "quantised"),
 )
 def level_step(
     state: TreeState,
@@ -225,6 +225,7 @@ def level_step(
     set_matrix,
     cat_mask,
     hist_prev=None,
+    rho=None,
     *,
     depth: int,
     params: SplitParams,
@@ -234,6 +235,7 @@ def level_step(
     lossguide: bool = False,
     has_cat: bool = False,
     subtract: bool = False,
+    quantised: bool = False,
 ):
     """Expand every alive node at ``depth``: hist -> best split -> apply.
 
@@ -270,7 +272,21 @@ def level_step(
             sum_hess=state.sum_hess.at[idx].set(totals_lvl[:, 1]),
         ), None
 
-    if hist_impl == "pallas":
+    if quantised:
+        # gpair here is the (R, C, 3) int8 limb array: integer builds and
+        # psums are exact/order-invariant, so hist bits are topology-free
+        # (the reference's GradientQuantiser contract, quantiser.cuh:52)
+        from ..ops.quantise import dequantise, hist_accumulate_q
+
+        if hist_impl == "pallas":
+            raise NotImplementedError(
+                "deterministic_histogram with hist_impl='pallas' is not "
+                "supported yet — the Pallas kernel accumulates f32")
+
+        def _build(b, g, p, *, node0, n_nodes, n_bin, stride=1):
+            return hist_accumulate_q(b, g, p, node0, n_nodes, n_bin,
+                                     stride=stride)
+    elif hist_impl == "pallas":
         from ..ops.hist_pallas import build_histogram_pallas as _build
     else:
         _build = build_histogram
@@ -287,6 +303,10 @@ def level_step(
         hist = _build(bins, gpair, state.pos, node0=node0, n_nodes=N, n_bin=B)
         if axis_name is not None:
             hist = lax.psum(hist, axis_name)  # the distributed cost (SURVEY §3.1)
+    if quantised:
+        hist_eval = dequantise(hist, rho)  # the ONE rounding step
+    else:
+        hist_eval = hist
 
     # interaction constraints: allowed feature set per node = union of the
     # constraint sets still compatible with the node's path
@@ -298,7 +318,8 @@ def level_step(
     fmask = allowed & fm
 
     node_bounds = jnp.stack([lower_lvl, upper_lvl], axis=1)
-    best = evaluate_splits(hist, totals_lvl, n_bins, params, fmask, node_bounds,
+    best = evaluate_splits(hist_eval, totals_lvl, n_bins, params, fmask,
+                           node_bounds,
                            cat_mask=cat_mask if has_cat else None)
 
     gamma_eps = max(params.gamma, _EPS)
@@ -330,7 +351,7 @@ def level_step(
 @functools.partial(
     jax.jit,
     static_argnames=("width", "params", "axis_name", "hist_impl",
-                     "lossguide", "has_cat", "subtract"),
+                     "lossguide", "has_cat", "subtract", "quantised"),
 )
 def level_step_padded(
     state: TreeState,
@@ -343,6 +364,7 @@ def level_step_padded(
     cat_mask,
     hist_prev,
     node0,
+    rho=None,
     *,
     width: int,
     params: SplitParams,
@@ -351,6 +373,7 @@ def level_step_padded(
     lossguide: bool = False,
     has_cat: bool = False,
     subtract: bool = True,
+    quantised: bool = False,
 ):
     """``level_step`` with the node dimension PADDED to a fixed ``width`` and
     a TRACED ``node0`` — ONE compiled program serves every interior depth
@@ -393,18 +416,25 @@ def level_step_padded(
         raise NotImplementedError(
             "padded level sharing currently uses the XLA hist path; "
             "hist_impl='pallas' keeps per-depth level_step")
+    if quantised:
+        from ..ops.quantise import build_histogram_q, dequantise
+
+        _build_at = build_histogram_q
+    else:
+        _build_at = build_histogram_at
     if subtract:
         half = W // 2
-        left = build_histogram_at(bins, gpair, state.pos, node0,
-                                  n_nodes=half, n_bin=B, stride=2)
+        left = _build_at(bins, gpair, state.pos, node0,
+                         n_nodes=half, n_bin=B, stride=2)
         if axis_name is not None:
             left = lax.psum(left, axis_name)
         hist = combine_sibling_hists(left, hist_prev[:half], alive_lvl)
     else:
-        hist = build_histogram_at(bins, gpair, state.pos, node0,
-                                  n_nodes=W, n_bin=B)
+        hist = _build_at(bins, gpair, state.pos, node0,
+                         n_nodes=W, n_bin=B)
         if axis_name is not None:
             hist = lax.psum(hist, axis_name)
+    hist_eval = dequantise(hist, rho) if quantised else hist
 
     compat_lvl = lax.dynamic_slice_in_dim(state.setcompat, node0, W, axis=0)
     allowed = jnp.einsum("ns,sf->nf", compat_lvl.astype(jnp.float32),
@@ -413,7 +443,7 @@ def level_step_padded(
     fmask = allowed & fm
 
     node_bounds = jnp.stack([lower_lvl, upper_lvl], axis=1)
-    best = evaluate_splits(hist, totals_lvl, n_bins, params, fmask,
+    best = evaluate_splits(hist_eval, totals_lvl, n_bins, params, fmask,
                            node_bounds,
                            cat_mask=cat_mask if has_cat else None)
 
@@ -484,6 +514,7 @@ class HistTreeGrower:
         lossguide: bool = False,
         subtract: bool = True,
         padded_levels: bool = True,
+        quantised: bool = False,
     ) -> None:
         self.max_depth = max_depth
         self.params = params
@@ -493,6 +524,10 @@ class HistTreeGrower:
         self.max_leaves = max_leaves
         self.lossguide = lossguide
         self.subtract = subtract
+        # fixed-point limb histograms: bitwise-identical trees on EVERY
+        # topology (chips x processes) — the GradientQuantiser contract
+        # (src/tree/gpu_hist/quantiser.cuh); see ops/quantise.py
+        self.quantised = quantised
         # one shared compiled program for all interior depths (padded node
         # dim + traced node0) instead of one per depth — kills the compile
         # wall.  Pallas hist keeps per-depth steps (static node0 kernel).
@@ -519,16 +554,29 @@ class HistTreeGrower:
             max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
             n_bin=B,
         )
+        rho = None
+        if self.quantised:
+            from ..ops.quantise import (check_row_budget, local_rho,
+                                        quantise_gpair, quantised_root_state)
+
+            check_row_budget(gpair.shape[0])
+            rho = local_rho(gpair, valid)
+            if self.axis_name is not None:
+                rho = lax.pmax(rho, self.axis_name)
+            gpair = quantise_gpair(gpair, rho)  # (R, C, 3) int8 limbs
+            state = quantised_root_state(state, gpair, rho,
+                                         axis_name=self.axis_name)
         md = self.max_depth
         common = dict(params=self.params, axis_name=self.axis_name,
-                      lossguide=self.lossguide, has_cat=has_cat)
+                      lossguide=self.lossguide, has_cat=has_cat,
+                      quantised=self.quantised)
         if not self.padded_levels or md < 2:
             hist_prev = None
             for d in range(md + 1):
                 fm = ones if feature_masks is None else feature_masks(d, 1 << d)
                 state, hist_prev = level_step(
                     state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
-                    hist_prev, depth=d, last_level=(d == md),
+                    hist_prev, rho, depth=d, last_level=(d == md),
                     hist_impl=self.hist_impl,
                     subtract=(self.subtract and d > 0 and hist_prev is not None),
                     **common)
@@ -538,7 +586,7 @@ class HistTreeGrower:
         # interior (traced node0), leaf finalize
         fm = ones if feature_masks is None else feature_masks(0, 1)
         state, hist = level_step(
-            state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm, None,
+            state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm, None, rho,
             depth=0, last_level=False, hist_impl=self.hist_impl,
             subtract=False, **common)
         W = 1 << (md - 1)
@@ -548,11 +596,11 @@ class HistTreeGrower:
                   else self._pad_mask(feature_masks(d, 1 << d), W))
             state, hist_pad = level_step_padded(
                 state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
-                hist_pad, (1 << d) - 1, width=W, subtract=self.subtract,
+                hist_pad, (1 << d) - 1, rho, width=W, subtract=self.subtract,
                 hist_impl=self.hist_impl, **common)
         fm = ones if feature_masks is None else feature_masks(md, 1 << md)
         state, _ = level_step(
-            state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm, None,
+            state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm, None, rho,
             depth=md, last_level=True, hist_impl=self.hist_impl,
             subtract=False, **common)
         return state

@@ -35,19 +35,25 @@ _EPS = 1e-6
 @functools.partial(
     jax.jit,
     static_argnames=("node0_prev", "n_prev", "node0", "n_nodes", "n_bin",
-                     "has_prev", "has_cat", "build", "stride"),
+                     "has_prev", "has_cat", "build", "stride", "quantised"),
 )
 def _page_step(page_bins, gpair_seg, pos_seg, prev_best, prev_can, *,
                node0_prev: int, n_prev: int, node0: int, n_nodes: int,
                n_bin: int, has_prev: bool, has_cat: bool, build: bool = True,
-               stride: int = 1):
+               stride: int = 1, quantised: bool = False):
     """Route one page with the previous level's splits, then accumulate the
     current level's histogram over it (stride=2: left children only, for the
-    subtraction trick)."""
+    subtraction trick).  quantised: gpair_seg carries (T, C, 3) int8 limbs
+    and the histogram is exact int32 (ops/quantise.py)."""
     if has_prev:
         pos_seg = _update_positions(page_bins, pos_seg, prev_best, prev_can,
                                     node0_prev, n_prev, n_bin, has_cat)
-    if build:
+    if build and quantised:
+        from ..ops.quantise import hist_accumulate_q
+
+        hist = hist_accumulate_q(page_bins, gpair_seg, pos_seg, node0,
+                                 n_nodes, n_bin, stride=stride)
+    elif build:
         hist = build_histogram(page_bins, gpair_seg, pos_seg, node0=node0,
                                n_nodes=n_nodes, n_bin=n_bin, stride=stride)
     else:
