@@ -38,6 +38,29 @@ class distributed_reduction:
         return False
 
 
+# python-loop host metrics win below this group count (jit dispatch
+# overhead); MSLR-scale evals sit far above it
+_MIN_DEVICE_GROUPS = 64
+
+
+def _use_device_rank(group_ptr, preds, kw) -> bool:
+    """Route large-cohort ranking evals to the segment-vectorized device
+    metrics (device_rank.py); small evals keep the python-loop oracle.
+    ``use_device_rank`` in kw forces either way (tests).
+
+    Distributed evals always take the host path unless forced: routing on
+    the rank-LOCAL group count would let peers pick different precisions
+    for the same allreduce (the aucpr branch-on-structure lesson), making
+    the reported global metric sharding-dependent."""
+    forced = kw.get("use_device_rank")
+    if forced is not None:
+        return bool(forced)
+    if getattr(_DIST, "on", False):
+        return False
+    return (np.ndim(preds) == 1
+            and len(group_ptr) - 1 >= _MIN_DEVICE_GROUPS)
+
+
 def _reduce_sums(*vals: float):
     """allreduce-SUM scalars when distributed reduction is active."""
     if not getattr(_DIST, "on", False):
@@ -237,6 +260,12 @@ def precision_at(preds, labels, weights=None, group_ptr=None, at: float = 0,
     if group_ptr is None:
         group_ptr = np.array([0, len(labels)])
     k = int(at) if at else 10
+    if _use_device_rank(group_ptr, preds, kw):
+        from .device_rank import precision_pair
+
+        n, d = precision_pair(preds, labels, group_ptr, weights, k)
+        num, den = _reduce_sums(n, d)
+        return num / den if den > 0 else 0.0
     n_groups = len(group_ptr) - 1
     vals, ws = [], []
     for g in range(n_groups):
@@ -456,6 +485,14 @@ def ndcg(preds, labels, weights=None, group_ptr=None, at: float = 0,
     if group_ptr is None:
         group_ptr = np.array([0, len(labels)])
     k = int(at) if at else None
+    if _use_device_rank(group_ptr, preds, kw):
+        # segment-vectorized device path (device_rank.py) — no python loop;
+        # host loop below stays as the parity oracle
+        from .device_rank import ndcg_pair
+
+        n, d = ndcg_pair(preds, labels, group_ptr, weights, k or 0, minus)
+        num, den = _reduce_sums(n, d)
+        return num / den if den > 0 else 1.0
     vals, ws = [], []
     for g in range(len(group_ptr) - 1):
         lo, hi = group_ptr[g], group_ptr[g + 1]
@@ -484,6 +521,12 @@ def map_metric(preds, labels, weights=None, group_ptr=None, at: float = 0,
     if group_ptr is None:
         group_ptr = np.array([0, len(labels)])
     k = int(at) if at else None
+    if _use_device_rank(group_ptr, preds, kw):
+        from .device_rank import map_pair
+
+        n, d = map_pair(preds, labels, group_ptr, weights, k or 0, minus)
+        num, den = _reduce_sums(n, d)
+        return num / den if den > 0 else 0.0
     vals, ws = [], []
     for g in range(len(group_ptr) - 1):
         lo, hi = group_ptr[g], group_ptr[g + 1]
