@@ -1,0 +1,170 @@
+/* The R binding's exact C-ABI call sequence, driven from plain C.
+ *
+ * r-package/src/xtb_R.c cannot be compiled here (no R toolchain in the
+ * image), so this program pins the ABI contract it depends on: the same
+ * functions, in the same order, with the same conversions (column-major
+ * double input -> row-major float, group info as unsigned, buffer
+ * save/load round-trip, text dump).  Run by
+ * tests/test_c_api.py::test_r_glue_sequence.
+ *
+ *   gcc r_glue_seq.c -L. -lxtb_capi -o r_glue_seq
+ *   PYTHONPATH=/root/repo LD_LIBRARY_PATH=. ./r_glue_seq
+ */
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* DMatrixHandle;
+typedef void* BoosterHandle;
+typedef uint64_t bst_ulong;
+
+extern const char* XGBGetLastError(void);
+extern int XGDMatrixCreateFromMat(const float*, bst_ulong, bst_ulong, float,
+                                  DMatrixHandle*);
+extern int XGDMatrixSetFloatInfo(DMatrixHandle, const char*, const float*,
+                                 bst_ulong);
+extern int XGDMatrixSetUIntInfo(DMatrixHandle, const char*, const unsigned*,
+                                bst_ulong);
+extern int XGDMatrixNumRow(DMatrixHandle, bst_ulong*);
+extern int XGDMatrixNumCol(DMatrixHandle, bst_ulong*);
+extern int XGDMatrixFree(DMatrixHandle);
+extern int XGBoosterCreate(const DMatrixHandle[], bst_ulong, BoosterHandle*);
+extern int XGBoosterFree(BoosterHandle);
+extern int XGBoosterSetParam(BoosterHandle, const char*, const char*);
+extern int XGBoosterUpdateOneIter(BoosterHandle, int, DMatrixHandle);
+extern int XGBoosterEvalOneIter(BoosterHandle, int, DMatrixHandle[],
+                                const char*[], bst_ulong, const char**);
+extern int XGBoosterPredict(BoosterHandle, DMatrixHandle, int, unsigned, int,
+                            bst_ulong*, const float**);
+extern int XGBoosterSaveModelToBuffer(BoosterHandle, const char*, bst_ulong*,
+                                      const char**);
+extern int XGBoosterLoadModelFromBuffer(BoosterHandle, const void*,
+                                        bst_ulong);
+extern int XGBoosterDumpModelEx(BoosterHandle, const char*, int, const char*,
+                                bst_ulong*, const char***);
+
+#define CHECK(call)                                                   \
+  do {                                                                \
+    if ((call) != 0) {                                                \
+      fprintf(stderr, "FAILED %s: %s\n", #call, XGBGetLastError());   \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (0)
+
+enum { R = 600, F = 5, ROUNDS = 6 };
+
+int main(void) {
+  /* R hands the glue a column-major double matrix; the glue transposes to
+   * row-major float (xtb_R.c XTBDMatrixCreateFromMat_R) */
+  static double colmajor[(size_t)R * F];
+  static float rowmajor[(size_t)R * F];
+  static float label[R];
+  unsigned seed = 42;
+  for (int j = 0; j < F; ++j)
+    for (int i = 0; i < R; ++i) {
+      seed = seed * 1664525u + 1013904223u;
+      colmajor[(size_t)j * R + i] = ((double)(seed >> 8) / (1 << 24)) - 0.5;
+    }
+  for (int i = 0; i < R; ++i) {
+    colmajor[(size_t)2 * R + i] = (i % 37 == 0) ? NAN : colmajor[2 * R + i];
+    label[i] = colmajor[i] > 0.0 ? 1.0f : 0.0f; /* column 0 drives y */
+  }
+  for (int j = 0; j < F; ++j)
+    for (int i = 0; i < R; ++i)
+      rowmajor[(size_t)i * F + j] = (float)colmajor[(size_t)j * R + i];
+
+  DMatrixHandle dtrain = NULL;
+  CHECK(XGDMatrixCreateFromMat(rowmajor, R, F, NAN, &dtrain));
+  CHECK(XGDMatrixSetFloatInfo(dtrain, "label", label, R));
+  static float wts[R];
+  for (int i = 0; i < R; ++i) wts[i] = 1.0f + (i % 3) * 0.25f;
+  CHECK(XGDMatrixSetFloatInfo(dtrain, "weight", wts, R));
+  bst_ulong nr = 0, nc = 0;
+  CHECK(XGDMatrixNumRow(dtrain, &nr));
+  CHECK(XGDMatrixNumCol(dtrain, &nc));
+  if (nr != R || nc != F) {
+    fprintf(stderr, "dim mismatch %llu x %llu\n",
+            (unsigned long long)nr, (unsigned long long)nc);
+    return 1;
+  }
+
+  BoosterHandle bst = NULL;
+  DMatrixHandle dmats[1] = {dtrain};
+  CHECK(XGBoosterCreate(dmats, 1, &bst));
+  CHECK(XGBoosterSetParam(bst, "objective", "binary:logistic"));
+  CHECK(XGBoosterSetParam(bst, "max_depth", "4"));
+  CHECK(XGBoosterSetParam(bst, "eta", "0.3"));
+  CHECK(XGBoosterSetParam(bst, "eval_metric", "logloss"));
+
+  const char* names[1] = {"train"};
+  const char* evalmsg = NULL;
+  double first_ll = 0, last_ll = 0;
+  for (int it = 0; it < ROUNDS; ++it) {
+    CHECK(XGBoosterUpdateOneIter(bst, it, dtrain));
+    CHECK(XGBoosterEvalOneIter(bst, it, dmats, names, 1, &evalmsg));
+    const char* p = strstr(evalmsg, "logloss:");
+    if (p == NULL) {
+      fprintf(stderr, "no logloss in eval msg: %s\n", evalmsg);
+      return 1;
+    }
+    double ll = atof(p + 8);
+    if (it == 0) first_ll = ll;
+    last_ll = ll;
+  }
+  if (!(last_ll < first_ll)) {
+    fprintf(stderr, "logloss did not improve: %f -> %f\n", first_ll, last_ll);
+    return 1;
+  }
+
+  bst_ulong plen = 0;
+  const float* preds = NULL;
+  CHECK(XGBoosterPredict(bst, dtrain, 0, 0, 0, &plen, &preds));
+  if (plen != R) {
+    fprintf(stderr, "predict len %llu\n", (unsigned long long)plen);
+    return 1;
+  }
+  int err = 0;
+  for (int i = 0; i < R; ++i) err += (preds[i] > 0.5f) != (label[i] > 0.5f);
+  if (err > R / 10) {
+    fprintf(stderr, "train error too high: %d/%d\n", err, R);
+    return 1;
+  }
+  static float keep[R];
+  memcpy(keep, preds, sizeof(keep));
+
+  /* buffer round-trip (xgb.save.raw / xgb.load.raw path) */
+  bst_ulong blen = 0;
+  const char* buf = NULL;
+  CHECK(XGBoosterSaveModelToBuffer(bst, "ubj", &blen, &buf));
+  char* copy = (char*)malloc(blen);
+  memcpy(copy, buf, blen);
+  BoosterHandle bst2 = NULL;
+  CHECK(XGBoosterCreate(NULL, 0, &bst2));
+  CHECK(XGBoosterLoadModelFromBuffer(bst2, copy, blen));
+  free(copy);
+  CHECK(XGBoosterPredict(bst2, dtrain, 0, 0, 0, &plen, &preds));
+  for (int i = 0; i < R; ++i)
+    if (preds[i] != keep[i]) {
+      fprintf(stderr, "round-trip mismatch at %d\n", i);
+      return 1;
+    }
+
+  /* text dump (xgb.dump path) */
+  bst_ulong dlen = 0;
+  const char** dump = NULL;
+  CHECK(XGBoosterDumpModelEx(bst, "", 0, "text", &dlen, &dump));
+  if (dlen != ROUNDS || strstr(dump[0], "leaf") == NULL) {
+    fprintf(stderr, "dump unexpected (%llu trees)\n",
+            (unsigned long long)dlen);
+    return 1;
+  }
+
+  CHECK(XGBoosterFree(bst2));
+  CHECK(XGBoosterFree(bst));
+  CHECK(XGDMatrixFree(dtrain));
+  printf("R-GLUE-SEQ-OK err=%d/%d logloss %.4f->%.4f\n", err, R, first_ll,
+         last_ll);
+  return 0;
+}

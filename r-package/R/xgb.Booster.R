@@ -1,0 +1,81 @@
+# xgb.train / predict / save / load — the reference R training surface
+# (R-package/R/xgb.train.R, xgb.Booster.R) over the xtb C ABI.
+
+#' Train a gradient-boosted model.
+#'
+#' @param params named list of booster parameters
+#'   (e.g. list(objective = "binary:logistic", max_depth = 4, eta = 0.3)).
+#' @param data an xgb.DMatrix.
+#' @param nrounds number of boosting rounds.
+#' @param evals named list of xgb.DMatrix to evaluate each round.
+#' @param verbose print eval lines when TRUE.
+xgb.train <- function(params = list(), data, nrounds = 10,
+                      evals = list(), verbose = TRUE) {
+  stopifnot(inherits(data, "xgb.DMatrix"))
+  if (length(evals) > 0 &&
+      (is.null(names(evals)) || any(names(evals) == "")))
+    stop("evals must be a fully named list, e.g. list(train = dtrain)")
+  dmats <- c(list(data), unname(evals))
+  handle <- .Call(XTBBoosterCreate_R, lapply(dmats, function(d) d$handle))
+  for (nm in names(params))
+    .Call(XTBBoosterSetParam_R, handle, nm, as.character(params[[nm]]))
+  bst <- structure(list(handle = handle, params = params,
+                        nrounds = nrounds),
+                   class = "xgb.Booster")
+  eval_names <- names(evals)
+  for (i in seq_len(nrounds) - 1L) {
+    .Call(XTBBoosterUpdateOneIter_R, handle, i, data$handle)
+    if (length(evals) > 0) {
+      msg <- .Call(XTBBoosterEvalOneIter_R, handle, i,
+                   lapply(unname(evals), function(d) d$handle), eval_names)
+      if (isTRUE(verbose)) message(msg)
+    }
+  }
+  bst
+}
+
+#' @export
+predict.xgb.Booster <- function(object, newdata, outputmargin = FALSE,
+                                ntreelimit = 0, ...) {
+  if (!inherits(newdata, "xgb.DMatrix")) newdata <- xgb.DMatrix(newdata)
+  mask <- if (isTRUE(outputmargin)) 1L else 0L
+  .Call(XTBBoosterPredict_R, object$handle, newdata$handle, mask,
+        as.integer(ntreelimit), 0L)
+}
+
+#' Save a model to JSON/UBJSON (by file extension).
+xgb.save <- function(model, fname) {
+  .Call(XTBBoosterSaveModel_R, model$handle, fname)
+  invisible(TRUE)
+}
+
+#' Load a model from file.
+xgb.load <- function(fname) {
+  handle <- .Call(XTBBoosterCreate_R, list())
+  .Call(XTBBoosterLoadModel_R, handle, fname)
+  structure(list(handle = handle, params = list()), class = "xgb.Booster")
+}
+
+#' Serialize a model to a raw vector ("json" or "ubj").
+xgb.save.raw <- function(model, raw_format = "ubj") {
+  .Call(XTBBoosterSaveModelToRaw_R, model$handle, raw_format)
+}
+
+#' Restore a model from a raw vector.
+xgb.load.raw <- function(raw) {
+  handle <- .Call(XTBBoosterCreate_R, list())
+  .Call(XTBBoosterLoadModelFromRaw_R, handle, raw)
+  structure(list(handle = handle, params = list()), class = "xgb.Booster")
+}
+
+#' Dump the trees as text or json strings.
+xgb.dump <- function(model, with_stats = FALSE, dump_format = "text") {
+  .Call(XTBBoosterDumpModel_R, model$handle, "", as.integer(with_stats),
+        dump_format)
+}
+
+#' @export
+print.xgb.Booster <- function(x, ...) {
+  cat("xgboost.tpu booster,", length(xgb.dump(x)), "trees\n")
+  invisible(x)
+}

@@ -1,0 +1,41 @@
+# xgb.DMatrix — R-side data container (reference surface:
+# R-package/R/xgb.DMatrix.R; implementation is fresh over the xtb C ABI).
+
+#' Construct an xgb.DMatrix from a numeric matrix.
+#'
+#' @param data numeric matrix (rows = examples).  NA marks missing.
+#' @param label optional numeric label vector.
+#' @param weight optional per-row weight vector.
+#' @param base_margin optional per-row starting margin.
+#' @param group optional query-group sizes for ranking.
+#' @param missing value to treat as missing (default NA).
+xgb.DMatrix <- function(data, label = NULL, weight = NULL,
+                        base_margin = NULL, group = NULL, missing = NA) {
+  if (!is.matrix(data)) data <- as.matrix(data)
+  storage.mode(data) <- "double"
+  handle <- .Call(XTBDMatrixCreateFromMat_R, data, as.numeric(missing))
+  dmat <- structure(list(handle = handle), class = "xgb.DMatrix")
+  if (!is.null(label))
+    .Call(XTBDMatrixSetInfo_R, handle, "label", as.numeric(label))
+  if (!is.null(weight))
+    .Call(XTBDMatrixSetInfo_R, handle, "weight", as.numeric(weight))
+  if (!is.null(base_margin))
+    .Call(XTBDMatrixSetInfo_R, handle, "base_margin",
+          as.numeric(base_margin))
+  if (!is.null(group))
+    .Call(XTBDMatrixSetInfo_R, handle, "group", as.numeric(group))
+  dmat
+}
+
+xgb.DMatrix.num.row <- function(dmat) {
+  .Call(XTBDMatrixNumRow_R, dmat$handle)
+}
+
+xgb.DMatrix.num.col <- function(dmat) {
+  .Call(XTBDMatrixNumCol_R, dmat$handle)
+}
+
+#' @export
+dim.xgb.DMatrix <- function(x) {
+  c(xgb.DMatrix.num.row(x), xgb.DMatrix.num.col(x))
+}

@@ -1,0 +1,37 @@
+# Smoke: train/predict/save/load through the R binding
+# (reference: R-package/demo/basic_walkthrough.R shape, synthetic
+# agaricus-like binary data).
+#
+# Run:  (cd native && make capi)
+#       PYTHONPATH=/root/repo Rscript r-package/demo/agaricus_smoke.R
+# (after R CMD INSTALL r-package)
+library(xgboost.tpu)
+
+set.seed(1)
+n <- 1000; f <- 8
+x <- matrix(rnorm(n * f), n, f)
+x[sample(length(x), n)] <- NA                 # missing values
+y <- as.numeric(ifelse(is.na(x[, 1]), 0, x[, 1]) > 0)
+
+dtrain <- xgb.DMatrix(x, label = y)
+stopifnot(all(dim(dtrain) == c(n, f)))
+
+bst <- xgb.train(list(objective = "binary:logistic", max_depth = 4,
+                      eta = 0.3, eval_metric = "logloss"),
+                 dtrain, nrounds = 10, evals = list(train = dtrain))
+
+p <- predict(bst, dtrain)
+err <- mean((p > 0.5) != y)
+cat(sprintf("train error: %.4f\n", err))
+stopifnot(err < 0.1)
+
+f1 <- tempfile(fileext = ".json")
+xgb.save(bst, f1)
+bst2 <- xgb.load(f1)
+stopifnot(max(abs(predict(bst2, dtrain) - p)) == 0)
+
+raw <- xgb.save.raw(bst, "ubj")
+bst3 <- xgb.load.raw(raw)
+stopifnot(max(abs(predict(bst3, dtrain) - p)) == 0)
+
+cat("R binding smoke: OK (", length(xgb.dump(bst)), "trees )\n")

@@ -1,0 +1,276 @@
+/* R .Call glue over the xgboost_tpu C ABI (libxtb_capi.so).
+ *
+ * Role of the reference's R-package/src/xgboost_R.cc, written fresh for
+ * this ABI: every entry converts R objects (column-major double matrices,
+ * numeric vectors, character scalars) to the row-major float buffers the
+ * XGB* C functions take, wraps handles in R external pointers with
+ * finalizers, and turns non-zero return codes into R errors carrying
+ * XGBGetLastError().
+ *
+ * Build: R CMD INSTALL links this against libxtb_capi.so (see Makevars);
+ * the identical call SEQUENCE is exercised C-side by
+ * native/r_glue_seq.c (tests/test_c_api.py::test_r_glue_sequence) so the
+ * ABI contract stays pinned even on machines without R.
+ */
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <R.h>
+#include <Rinternals.h>
+
+typedef void* DMatrixHandle;
+typedef void* BoosterHandle;
+typedef uint64_t bst_ulong;
+
+extern const char* XGBGetLastError(void);
+extern int XGDMatrixCreateFromMat(const float*, bst_ulong, bst_ulong, float,
+                                  DMatrixHandle*);
+extern int XGDMatrixSetFloatInfo(DMatrixHandle, const char*, const float*,
+                                 bst_ulong);
+extern int XGDMatrixSetUIntInfo(DMatrixHandle, const char*,
+                                const unsigned*, bst_ulong);
+extern int XGDMatrixNumRow(DMatrixHandle, bst_ulong*);
+extern int XGDMatrixNumCol(DMatrixHandle, bst_ulong*);
+extern int XGDMatrixFree(DMatrixHandle);
+extern int XGBoosterCreate(const DMatrixHandle[], bst_ulong, BoosterHandle*);
+extern int XGBoosterFree(BoosterHandle);
+extern int XGBoosterSetParam(BoosterHandle, const char*, const char*);
+extern int XGBoosterUpdateOneIter(BoosterHandle, int, DMatrixHandle);
+extern int XGBoosterEvalOneIter(BoosterHandle, int, DMatrixHandle[],
+                                const char*[], bst_ulong, const char**);
+extern int XGBoosterPredict(BoosterHandle, DMatrixHandle, int, unsigned, int,
+                            bst_ulong*, const float**);
+extern int XGBoosterSaveModel(BoosterHandle, const char*);
+extern int XGBoosterLoadModel(BoosterHandle, const char*);
+extern int XGBoosterSaveModelToBuffer(BoosterHandle, const char*, bst_ulong*,
+                                      const char**);
+extern int XGBoosterLoadModelFromBuffer(BoosterHandle, const void*,
+                                        bst_ulong);
+extern int XGBoosterDumpModelEx(BoosterHandle, const char*, int, const char*,
+                                bst_ulong*, const char***);
+
+#define XTB_CHECK(call)                                                    \
+  do {                                                                     \
+    if ((call) != 0) Rf_error("xgboost.tpu: %s", XGBGetLastError());       \
+  } while (0)
+
+/* ---------------------------------------------------------- handles --- */
+
+static void dmatrix_finalizer(SEXP ext) {
+  DMatrixHandle h = R_ExternalPtrAddr(ext);
+  if (h != NULL) {
+    XGDMatrixFree(h);
+    R_ClearExternalPtr(ext);
+  }
+}
+
+static void booster_finalizer(SEXP ext) {
+  BoosterHandle h = R_ExternalPtrAddr(ext);
+  if (h != NULL) {
+    XGBoosterFree(h);
+    R_ClearExternalPtr(ext);
+  }
+}
+
+static SEXP wrap_handle(void* h, R_CFinalizer_t fin) {
+  SEXP ext = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ext, fin, TRUE);
+  UNPROTECT(1);
+  return ext;
+}
+
+/* ---------------------------------------------------------- DMatrix --- */
+
+SEXP XTBDMatrixCreateFromMat_R(SEXP mat, SEXP missing) {
+  int nrow = Rf_nrows(mat), ncol = Rf_ncols(mat);
+  double* src = REAL(mat);
+  float* buf = (float*)malloc((size_t)nrow * ncol * sizeof(float));
+  if (buf == NULL) Rf_error("xgboost.tpu: out of memory");
+  /* R matrices are column-major; the ABI takes row-major */
+  for (int j = 0; j < ncol; ++j)
+    for (int i = 0; i < nrow; ++i)
+      buf[(size_t)i * ncol + j] = (float)src[(size_t)j * nrow + i];
+  DMatrixHandle h = NULL;
+  int rc = XGDMatrixCreateFromMat(buf, (bst_ulong)nrow, (bst_ulong)ncol,
+                                  (float)Rf_asReal(missing), &h);
+  free(buf);
+  if (rc != 0) Rf_error("xgboost.tpu: %s", XGBGetLastError());
+  return wrap_handle(h, dmatrix_finalizer);
+}
+
+SEXP XTBDMatrixSetInfo_R(SEXP handle, SEXP name, SEXP vec) {
+  DMatrixHandle h = R_ExternalPtrAddr(handle);
+  const char* field = CHAR(Rf_asChar(name));
+  R_xlen_t n = Rf_xlength(vec);
+  if (strcmp(field, "group") == 0) {
+    unsigned* buf = (unsigned*)malloc(n * sizeof(unsigned));
+    if (buf == NULL) Rf_error("xgboost.tpu: out of memory");
+    for (R_xlen_t i = 0; i < n; ++i) buf[i] = (unsigned)REAL(vec)[i];
+    int rc = XGDMatrixSetUIntInfo(h, field, buf, (bst_ulong)n);
+    free(buf);
+    XTB_CHECK(rc);
+  } else {
+    float* buf = (float*)malloc(n * sizeof(float));
+    if (buf == NULL) Rf_error("xgboost.tpu: out of memory");
+    for (R_xlen_t i = 0; i < n; ++i) buf[i] = (float)REAL(vec)[i];
+    int rc = XGDMatrixSetFloatInfo(h, field, buf, (bst_ulong)n);
+    free(buf);
+    XTB_CHECK(rc);
+  }
+  return R_NilValue;
+}
+
+SEXP XTBDMatrixNumRow_R(SEXP handle) {
+  bst_ulong n = 0;
+  XTB_CHECK(XGDMatrixNumRow(R_ExternalPtrAddr(handle), &n));
+  return Rf_ScalarInteger((int)n);
+}
+
+SEXP XTBDMatrixNumCol_R(SEXP handle) {
+  bst_ulong n = 0;
+  XTB_CHECK(XGDMatrixNumCol(R_ExternalPtrAddr(handle), &n));
+  return Rf_ScalarInteger((int)n);
+}
+
+/* ---------------------------------------------------------- Booster --- */
+
+SEXP XTBBoosterCreate_R(SEXP dmats) {
+  R_xlen_t n = Rf_xlength(dmats);
+  DMatrixHandle* arr =
+      (DMatrixHandle*)malloc((n ? n : 1) * sizeof(DMatrixHandle));
+  if (arr == NULL) Rf_error("xgboost.tpu: out of memory");
+  for (R_xlen_t i = 0; i < n; ++i)
+    arr[i] = R_ExternalPtrAddr(VECTOR_ELT(dmats, i));
+  BoosterHandle h = NULL;
+  int rc = XGBoosterCreate(arr, (bst_ulong)n, &h);
+  free(arr);
+  if (rc != 0) Rf_error("xgboost.tpu: %s", XGBGetLastError());
+  return wrap_handle(h, booster_finalizer);
+}
+
+SEXP XTBBoosterSetParam_R(SEXP handle, SEXP name, SEXP val) {
+  XTB_CHECK(XGBoosterSetParam(R_ExternalPtrAddr(handle),
+                              CHAR(Rf_asChar(name)), CHAR(Rf_asChar(val))));
+  return R_NilValue;
+}
+
+SEXP XTBBoosterUpdateOneIter_R(SEXP handle, SEXP iter, SEXP dtrain) {
+  XTB_CHECK(XGBoosterUpdateOneIter(R_ExternalPtrAddr(handle),
+                                   Rf_asInteger(iter),
+                                   R_ExternalPtrAddr(dtrain)));
+  return R_NilValue;
+}
+
+SEXP XTBBoosterEvalOneIter_R(SEXP handle, SEXP iter, SEXP dmats,
+                             SEXP names) {
+  R_xlen_t n = Rf_xlength(dmats);
+  if (TYPEOF(names) != STRSXP || Rf_xlength(names) != n)
+    Rf_error("xgboost.tpu: eval names must be a character vector matching "
+             "the eval list");
+  DMatrixHandle* arr =
+      (DMatrixHandle*)malloc((n ? n : 1) * sizeof(DMatrixHandle));
+  const char** nm = (const char**)malloc((n ? n : 1) * sizeof(char*));
+  if (arr == NULL || nm == NULL) {
+    free(arr);
+    free(nm);
+    Rf_error("xgboost.tpu: out of memory");
+  }
+  for (R_xlen_t i = 0; i < n; ++i) {
+    arr[i] = R_ExternalPtrAddr(VECTOR_ELT(dmats, i));
+    nm[i] = CHAR(STRING_ELT(names, i));
+  }
+  const char* out = NULL;
+  int rc = XGBoosterEvalOneIter(R_ExternalPtrAddr(handle),
+                                Rf_asInteger(iter), arr, nm, (bst_ulong)n,
+                                &out);
+  free(arr);
+  free(nm);
+  if (rc != 0) Rf_error("xgboost.tpu: %s", XGBGetLastError());
+  return Rf_mkString(out ? out : "");
+}
+
+SEXP XTBBoosterPredict_R(SEXP handle, SEXP dmat, SEXP option_mask,
+                         SEXP ntree_limit, SEXP training) {
+  bst_ulong len = 0;
+  const float* res = NULL;
+  XTB_CHECK(XGBoosterPredict(R_ExternalPtrAddr(handle),
+                             R_ExternalPtrAddr(dmat),
+                             Rf_asInteger(option_mask),
+                             (unsigned)Rf_asInteger(ntree_limit),
+                             Rf_asInteger(training), &len, &res));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)len));
+  for (bst_ulong i = 0; i < len; ++i) REAL(out)[i] = (double)res[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP XTBBoosterSaveModel_R(SEXP handle, SEXP fname) {
+  XTB_CHECK(XGBoosterSaveModel(R_ExternalPtrAddr(handle),
+                               CHAR(Rf_asChar(fname))));
+  return R_NilValue;
+}
+
+SEXP XTBBoosterLoadModel_R(SEXP handle, SEXP fname) {
+  XTB_CHECK(XGBoosterLoadModel(R_ExternalPtrAddr(handle),
+                               CHAR(Rf_asChar(fname))));
+  return R_NilValue;
+}
+
+SEXP XTBBoosterSaveModelToRaw_R(SEXP handle, SEXP format) {
+  bst_ulong len = 0;
+  const char* buf = NULL;
+  XTB_CHECK(XGBoosterSaveModelToBuffer(R_ExternalPtrAddr(handle),
+                                       CHAR(Rf_asChar(format)), &len, &buf));
+  SEXP out = PROTECT(Rf_allocVector(RAWSXP, (R_xlen_t)len));
+  memcpy(RAW(out), buf, len);
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP XTBBoosterLoadModelFromRaw_R(SEXP handle, SEXP raw) {
+  XTB_CHECK(XGBoosterLoadModelFromBuffer(R_ExternalPtrAddr(handle),
+                                         RAW(raw),
+                                         (bst_ulong)Rf_xlength(raw)));
+  return R_NilValue;
+}
+
+SEXP XTBBoosterDumpModel_R(SEXP handle, SEXP fmap, SEXP with_stats,
+                           SEXP format) {
+  bst_ulong len = 0;
+  const char** dump = NULL;
+  XTB_CHECK(XGBoosterDumpModelEx(R_ExternalPtrAddr(handle),
+                                 CHAR(Rf_asChar(fmap)),
+                                 Rf_asInteger(with_stats),
+                                 CHAR(Rf_asChar(format)), &len, &dump));
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, (R_xlen_t)len));
+  for (bst_ulong i = 0; i < len; ++i)
+    SET_STRING_ELT(out, (R_xlen_t)i, Rf_mkChar(dump[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+/* ----------------------------------------------------- registration --- */
+
+static const R_CallMethodDef CallEntries[] = {
+    {"XTBDMatrixCreateFromMat_R", (DL_FUNC)&XTBDMatrixCreateFromMat_R, 2},
+    {"XTBDMatrixSetInfo_R", (DL_FUNC)&XTBDMatrixSetInfo_R, 3},
+    {"XTBDMatrixNumRow_R", (DL_FUNC)&XTBDMatrixNumRow_R, 1},
+    {"XTBDMatrixNumCol_R", (DL_FUNC)&XTBDMatrixNumCol_R, 1},
+    {"XTBBoosterCreate_R", (DL_FUNC)&XTBBoosterCreate_R, 1},
+    {"XTBBoosterSetParam_R", (DL_FUNC)&XTBBoosterSetParam_R, 3},
+    {"XTBBoosterUpdateOneIter_R", (DL_FUNC)&XTBBoosterUpdateOneIter_R, 3},
+    {"XTBBoosterEvalOneIter_R", (DL_FUNC)&XTBBoosterEvalOneIter_R, 4},
+    {"XTBBoosterPredict_R", (DL_FUNC)&XTBBoosterPredict_R, 5},
+    {"XTBBoosterSaveModel_R", (DL_FUNC)&XTBBoosterSaveModel_R, 2},
+    {"XTBBoosterLoadModel_R", (DL_FUNC)&XTBBoosterLoadModel_R, 2},
+    {"XTBBoosterSaveModelToRaw_R", (DL_FUNC)&XTBBoosterSaveModelToRaw_R, 2},
+    {"XTBBoosterLoadModelFromRaw_R", (DL_FUNC)&XTBBoosterLoadModelFromRaw_R,
+     2},
+    {"XTBBoosterDumpModel_R", (DL_FUNC)&XTBBoosterDumpModel_R, 4},
+    {NULL, NULL, 0}};
+
+void R_init_xgboost_tpu(DllInfo* dll) {
+  R_registerRoutines(dll, NULL, CallEntries, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}

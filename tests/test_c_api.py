@@ -850,3 +850,23 @@ def test_ctypes_iterator_callback_group_info(capi):
     _check(capi, capi.XGBoosterFree(booster))
     _check(capi, capi.XGDMatrixFree(qdm))
     _check(capi, capi.XGDMatrixFree(proxy))
+
+
+def test_r_glue_sequence(tmp_path):
+    """The R binding's exact C-ABI call sequence (r-package/src/xtb_R.c),
+    driven from plain C: column-major double -> row-major float conversion,
+    weight info, per-round EvalOneIter, predict, ubj buffer round-trip, and
+    text dump.  Pins the ABI contract for machines without an R toolchain."""
+    _ensure_lib()
+    src = os.path.join(NATIVE, "r_glue_seq.c")
+    exe = str(tmp_path / "r_glue_seq")
+    r = subprocess.run(["gcc", src, "-L" + NATIVE, "-lxtb_capi", "-lm",
+                        "-o", exe], capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"cc unavailable: {r.stderr[-400:]}")
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(NATIVE),
+               LD_LIBRARY_PATH=NATIVE, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe], env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "R-GLUE-SEQ-OK" in out.stdout

@@ -1,0 +1,359 @@
+"""Dask frontend — distributed training driven by a dask cluster.
+
+Reference shape: python-package/xgboost/dask/__init__.py — ``DaskDMatrix``
+(:267) pins partition references to the workers that hold them; ``train``
+(:832 -> _train_async:722) starts a RabitTracker, runs one training task on
+every holding worker under a ``CommunicatorContext`` built from the
+tracker's args, and returns rank 0's booster + eval history; ``predict``
+(:1212) maps the model over partitions worker-locally.
+
+The TPU port keeps that choreography but swaps the engine: inside each dask
+worker the communicator is ``collective.init`` (tracker rendezvous ->
+jax.distributed), cuts merge through the distributed sketch, and the
+per-level histogram allreduce rides the host collective — with chip-level
+GSPMD meshes composable per worker via ``n_devices`` (the reference's
+one-GPU-per-worker becomes one-mesh-per-worker).
+
+Two data paths into :class:`DaskDMatrix`:
+
+- dask collections (dask.array / dask.dataframe), when dask is installed:
+  partitions are persisted and mapped to their holding workers
+  (``client.who_has``), never moved — the reference's no-repartition rule;
+- an explicit list of pre-partitioned parts (numpy tuples/dicts), assigned
+  round-robin over the cluster's workers.  This path has no dask
+  dependency, so the full train/predict choreography (tracker rendezvous,
+  per-worker training, rank-0 result marshaling) is exercised by
+  tests/test_dask.py against a subprocess-backed stand-in client; the thin
+  collection-mapping adapter is the only code that needs a real dask.
+
+``client`` may be any object with the small surface used here:
+``scheduler_info() / submit(fn, *args, workers=, pure=) / gather(futures)``
+— the subset of ``distributed.Client`` the reference itself relies on.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core import Booster
+
+__all__ = ["DaskDMatrix", "DaskQuantileDMatrix", "train", "predict",
+           "DaskXGBRegressor", "DaskXGBClassifier"]
+
+
+def _worker_addrs(client) -> List[str]:
+    info = client.scheduler_info()
+    addrs = sorted(info["workers"])
+    if not addrs:
+        raise RuntimeError("dask cluster has no workers")
+    return addrs
+
+
+def _is_dask_collection(data) -> bool:
+    try:
+        import dask
+
+        return dask.is_dask_collection(data)
+    except ImportError:
+        return False
+
+
+class DaskDMatrix:
+    """Per-worker partition references (reference: dask/__init__.py:267).
+
+    Does NOT move data between workers; with pre-partitioned list input the
+    parts are assigned round-robin (they are shipped to the assigned worker
+    by the task that trains there).
+    """
+
+    def __init__(self, client, data, label=None, *, weight=None,
+                 base_margin=None, group=None, missing=None,
+                 feature_names=None, feature_types=None,
+                 enable_categorical: bool = False,
+                 max_bin: Optional[int] = None) -> None:
+        self.client = client
+        self.max_bin = max_bin
+        self.feature_names = feature_names
+        self.feature_types = feature_types
+        self.enable_categorical = enable_categorical
+        self.missing = missing
+        # parts_by_worker: {addr: [part dict | future-of-part, ...]}
+        if isinstance(data, (list, tuple)) and not _is_dask_collection(data):
+            self._parts_by_worker = self._assign_listed_parts(
+                client, list(data), label, weight, base_margin, group)
+        else:
+            self._parts_by_worker = self._map_dask_collections(
+                client, data, label, weight, base_margin, group)
+        if not self._parts_by_worker:
+            raise ValueError("DaskDMatrix holds no data partitions")
+
+    @staticmethod
+    def _assign_listed_parts(client, parts, label, weight, base_margin,
+                             group) -> Dict[str, List[Any]]:
+        if (label is not None or weight is not None
+                or base_margin is not None or group is not None):
+            raise ValueError(
+                "with pre-partitioned list input, pack label/weight/group/… "
+                "into each part: (X, y) tuple or {'data':, 'label':, ...} "
+                "dict")
+        out: Dict[str, List[Any]] = {}
+        addrs = _worker_addrs(client)
+        for i, part in enumerate(parts):
+            if isinstance(part, tuple):
+                part = {"data": part[0], "label": part[1]}
+            # _pidx: global partition index, so predict() can reassemble
+            # its output in the caller's partition order
+            out.setdefault(addrs[i % len(addrs)], []).append(
+                {**part, "_pidx": i})
+        return out
+
+    @staticmethod
+    def _map_dask_collections(client, data, label, weight, base_margin,
+                              group) -> Dict[str, List[Any]]:
+        """dask collections -> {holding worker: [future-of-part-dict]}
+        (persist + who_has; the no-repartition rule)."""
+        import dask
+        from distributed import wait
+
+        def to_futures(coll):
+            if coll is None:
+                return None
+            coll = coll.persist()
+            wait(coll)
+            if hasattr(coll, "to_delayed"):
+                delayed = list(np.asarray(coll.to_delayed()).flatten())
+            else:  # dataframe
+                delayed = coll.to_delayed()
+            return client.compute(delayed)
+
+        xs = to_futures(data)
+        ys = to_futures(label)
+        ws = to_futures(weight)
+        ms = to_futures(base_margin)
+        gs = to_futures(group)
+        n = len(xs)
+        for other, name in ((ys, "label"), (ws, "weight"),
+                            (ms, "base_margin"), (gs, "group")):
+            if other is not None and len(other) != n:
+                raise ValueError(
+                    f"{name} has {len(other)} partitions, data has {n} — "
+                    "align the chunking (the reference has the same rule)")
+        wait(xs)
+        who = client.who_has(xs)
+        out: Dict[str, List[Any]] = {}
+        for i, xf in enumerate(xs):
+            holders = who.get(xf.key) or who.get(str(xf.key))
+            addr = sorted(holders)[0] if holders else _worker_addrs(client)[0]
+            part = {"data": xf, "_pidx": i}
+            if ys is not None:
+                part["label"] = ys[i]
+            if ws is not None:
+                part["weight"] = ws[i]
+            if ms is not None:
+                part["base_margin"] = ms[i]
+            if gs is not None:
+                part["group"] = gs[i]
+            out.setdefault(addr, []).append(part)
+        return out
+
+    @property
+    def num_partitions(self) -> int:
+        return sum(len(v) for v in self._parts_by_worker.values())
+
+
+class DaskQuantileDMatrix(DaskDMatrix):
+    """Quantile variant (reference: dask/__init__.py:585) — same partition
+    mapping; the per-worker QuantileDMatrix is built at training time with
+    the distributed sketch merging cuts across workers."""
+
+
+def _concat_parts(parts: Sequence[Dict[str, Any]], dmatrix_kw: Dict[str, Any]):
+    """Worker-local: resolve + concatenate this worker's partitions into one
+    DMatrix (reference dask concat path, dask/__init__.py:514).  Delegates
+    the dict-part -> DMatrix semantics to distributed._make_dmatrix so the
+    two frontends cannot drift."""
+    from .distributed import _make_dmatrix
+
+    fields: Dict[str, List[np.ndarray]] = {}
+    for p in parts:
+        for k, v in p.items():
+            if k != "_pidx":
+                fields.setdefault(k, []).append(np.asarray(v))
+    part = {k: np.concatenate(v, axis=0) for k, v in fields.items()}
+    part.update({k: v for k, v in dmatrix_kw.items() if v is not None})
+    return _make_dmatrix(part)
+
+
+def _dask_worker_train(tracker_uri: str, tracker_port: int, world: int,
+                       params: Dict[str, Any], num_boost_round: int,
+                       spec: Dict[str, Any], parts: List[Dict[str, Any]]):
+    """One dask worker's training task (the body of _train_async:768's
+    dispatched_train).  Runs under the tracker-rendezvoused communicator;
+    only rank 0 returns the model."""
+    import xgboost_tpu as xtb
+    from xgboost_tpu import collective
+
+    with collective.CommunicatorContext(dmlc_tracker_uri=tracker_uri,
+                                        dmlc_tracker_port=tracker_port,
+                                        dmlc_nworker=world):
+        rank = collective.get_rank()
+        try:
+            dtrain = _concat_parts(parts, spec.get("dmatrix_kw", {}))
+            evals = ([(dtrain, "train")] if spec.get("eval_train") else [])
+            history: Dict[str, Any] = {}
+            bst = xtb.train(params, dtrain, num_boost_round,
+                            evals=evals, evals_result=history,
+                            verbose_eval=spec.get("verbose_eval", False),
+                            **spec.get("train_kwargs", {}))
+            if rank != 0:
+                return None
+            return {
+                "raw": bytes(bst.save_raw()),
+                "history": history,
+                "best_iteration": getattr(bst, "best_iteration", None),
+            }
+        except BaseException as e:
+            # fan out through the tracker so peers blocked in a collective
+            # abort instead of hanging to the dask timeout
+            try:
+                collective.signal_error(f"dask worker rank {rank}: {e!r}")
+            except Exception:
+                pass
+            raise
+
+
+def train(client, params: Dict[str, Any], dtrain: DaskDMatrix,
+          num_boost_round: int = 10, *, evals=None,
+          eval_train: bool = False, verbose_eval: bool = False,
+          **train_kwargs) -> Dict[str, Any]:
+    """Train over the workers holding ``dtrain``'s partitions; returns
+    ``{"booster", "history", "best_iteration"}`` (the reference dask
+    ``train()`` contract, dask/__init__.py:930)."""
+    if evals:
+        raise NotImplementedError(
+            "dask train() currently evaluates on dtrain only "
+            "(eval_train=True); per-DaskDMatrix evals are not wired yet")
+    from .tracker import RabitTracker, get_host_ip
+
+    parts_by_worker = dtrain._parts_by_worker
+    addrs = sorted(parts_by_worker)
+    world = len(addrs)
+    tracker = RabitTracker(n_workers=world, host_ip=get_host_ip("auto"))
+    tracker.start()
+    args = tracker.worker_args()
+    spec = {
+        "eval_train": bool(eval_train),
+        "verbose_eval": verbose_eval,
+        "train_kwargs": train_kwargs,
+        "dmatrix_kw": {
+            "feature_names": dtrain.feature_names,
+            "feature_types": dtrain.feature_types,
+            "missing": dtrain.missing,
+            "enable_categorical": dtrain.enable_categorical or None,
+        },
+    }
+    p = dict(params)
+    if dtrain.max_bin is not None:
+        p.setdefault("max_bin", dtrain.max_bin)
+    futures = [
+        client.submit(_dask_worker_train,
+                      str(args["dmlc_tracker_uri"]),
+                      int(args["dmlc_tracker_port"]), world, p,
+                      int(num_boost_round), spec, parts_by_worker[addr],
+                      workers=[addr], pure=False)
+        for addr in addrs
+    ]
+    try:
+        results = client.gather(futures)
+    finally:
+        tracker.free()
+    out = next((r for r in results if r is not None), None)
+    if out is None:
+        raise RuntimeError("no worker returned a model (rank 0 missing)")
+    bst = Booster(params=params)
+    bst.load_model(bytearray(out["raw"]))
+    if out.get("best_iteration") is not None:
+        bst.best_iteration = out["best_iteration"]
+    return {"booster": bst, "history": out["history"],
+            "best_iteration": out.get("best_iteration")}
+
+
+def _dask_worker_predict(raw: bytes, part: Dict[str, Any],
+                         output_margin: bool):
+    import xgboost_tpu as xtb
+
+    bst = Booster()
+    bst.load_model(bytearray(raw))
+    d = _concat_parts([part], {})
+    return np.asarray(bst.predict(d, output_margin=output_margin))
+
+
+def predict(client, model, data, *, output_margin: bool = False) -> np.ndarray:
+    """Partition-parallel prediction (reference: dask/__init__.py:1212).
+    ``model`` is a Booster or the dict returned by :func:`train`.  Returns
+    the concatenated prediction in partition order."""
+    bst = model["booster"] if isinstance(model, dict) else model
+    raw = bytes(bst.save_raw())
+    if isinstance(data, DaskDMatrix):
+        futures, pidx = [], []
+        for addr in sorted(data._parts_by_worker):
+            for part in data._parts_by_worker[addr]:
+                futures.append(client.submit(
+                    _dask_worker_predict, raw, part, output_margin,
+                    workers=[addr], pure=False))
+                pidx.append(part.get("_pidx", len(pidx)))
+        parts_out = client.gather(futures)
+        # reassemble in the caller's partition order, not worker order
+        ordered = [p for _, p in sorted(zip(pidx, parts_out),
+                                        key=lambda t: t[0])]
+        return np.concatenate(ordered, axis=0)
+    raise TypeError("predict expects a DaskDMatrix")
+
+
+class _DaskSklearnBase:
+    """Minimal dask sklearn wrappers (DaskScikitLearnBase role,
+    dask/__init__.py:1434)."""
+
+    _objective = "reg:squarederror"
+
+    def __init__(self, *, client=None, n_estimators: int = 100,
+                 **params) -> None:
+        self.client = client
+        self.n_estimators = n_estimators
+        self.params = params
+        self._result: Optional[Dict[str, Any]] = None
+
+    def fit(self, X, y=None, **kw):
+        d = (X if isinstance(X, DaskDMatrix)
+             else DaskDMatrix(self.client, X, y))
+        p = dict(self.params)  # refit-safe: never mutate the constructor's
+        p.setdefault("objective", self._objective)
+        self._result = train(self.client, p, d, self.n_estimators, **kw)
+        return self
+
+    @property
+    def booster_(self) -> Booster:
+        if self._result is None:
+            raise AttributeError("model is not fitted yet")
+        return self._result["booster"]
+
+    def predict(self, X):
+        return predict(self.client, self._result, X)
+
+
+class DaskXGBRegressor(_DaskSklearnBase):
+    _objective = "reg:squarederror"
+
+
+class DaskXGBClassifier(_DaskSklearnBase):
+    _objective = "binary:logistic"
+
+    def predict_proba(self, X):
+        p = predict(self.client, self._result, X)
+        return np.stack([1.0 - p, p], axis=1) if p.ndim == 1 else p
+
+    def predict(self, X):
+        p = predict(self.client, self._result, X)
+        return (p > 0.5).astype(np.int64) if p.ndim == 1 else np.argmax(p, 1)
