@@ -229,6 +229,62 @@ def phase_bench(cpu_fallback: bool, train_s: float) -> dict:
     return phases
 
 
+def bench_extmem() -> dict:
+    """Extmem streaming at non-toy page counts (VERDICT r3 #9): >= 20 zstd
+    pages through the (mesh-shardable) streaming grower, prefetch overlap
+    measured as the wall-clock gain of overlapped host decompress/H2D vs
+    the serialized baseline (reference knob: n_prefetch_batches,
+    sparse_page_source.h:293)."""
+    import xgboost_tpu as xtb
+    from xgboost_tpu.data.extmem import DataIter, ExtMemQuantileDMatrix
+
+    rows_page = int(os.environ.get("BENCH_EXTMEM_PAGE_ROWS", "12800"))
+    n_pages = int(os.environ.get("BENCH_EXTMEM_PAGES", "24"))
+    F = N_FEATURES
+    rng = np.random.default_rng(5)
+    w = rng.normal(size=F).astype(np.float32)
+
+    class Pages(DataIter):
+        def __init__(self):
+            super().__init__()
+            self._i = 0
+
+        def next(self, input_data):
+            if self._i >= n_pages:
+                return 0
+            r = np.random.default_rng(100 + self._i)
+            X = r.normal(size=(rows_page, F)).astype(np.float32)
+            y = (X @ w + r.normal(scale=0.5, size=rows_page) > 0
+                 ).astype(np.float32)
+            input_data(data=X, label=y)
+            self._i += 1
+            return 1
+
+        def reset(self):
+            self._i = 0
+
+    d = ExtMemQuantileDMatrix(Pages(), max_bin=MAX_BIN)
+    out = {"pages": len(d._pages), "rows": rows_page * n_pages,
+           "compressed_mb": round(sum(
+               getattr(p, "nbytes_compressed", p.nbytes)
+               for p in d._pages) / 2**20, 2)}
+    base = {"objective": "binary:logistic", "max_depth": 6,
+            "max_bin": MAX_BIN, "eta": 0.3}
+
+    def one_round(prefetch: bool) -> float:
+        p = {**base, "_extmem_prefetch": "1" if prefetch else "0"}
+        xtb.train(p, d, 1, verbose_eval=False)  # warm the jit cache
+        t0 = time.perf_counter()
+        xtb.train(p, d, 1, verbose_eval=False)
+        return time.perf_counter() - t0
+
+    out["round_prefetch_s"] = round(one_round(True), 3)
+    out["round_serial_s"] = round(one_round(False), 3)
+    out["prefetch_overlap_gain"] = round(
+        1.0 - out["round_prefetch_s"] / max(out["round_serial_s"], 1e-9), 4)
+    return out
+
+
 def main() -> None:
     global N_ROWS, N_ROUNDS
 
@@ -318,6 +374,20 @@ def main() -> None:
                            "depth": MAX_DEPTH, **phases}, fh, indent=1)
         except Exception as e:  # noqa: BLE001 — phases must not kill the bench
             log(f"phase bench failed: {type(e).__name__}: {e}")
+        try:
+            ext = bench_extmem()
+            log("extmem streaming: " + json.dumps(ext))
+            pth = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_phases.json")
+            blob = {}
+            if os.path.exists(pth):
+                with open(pth) as fh:
+                    blob = json.load(fh)
+            blob["extmem"] = ext
+            with open(pth, "w") as fh:
+                json.dump(blob, fh, indent=1)
+        except Exception as e:  # noqa: BLE001
+            log(f"extmem bench failed: {type(e).__name__}: {e}")
 
     throughput = N_ROWS * N_ROUNDS / train_s
     size = (f"{N_ROWS // 10**6}M" if N_ROWS >= 10**6 else f"{N_ROWS // 1000}k")

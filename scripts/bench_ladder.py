@@ -1,0 +1,172 @@
+"""BASELINE ladder configs #2-#4 vs the reference oracle on identical data.
+
+Runs the three headline training configs from BASELINE.md — HIGGS-class
+binary (11M x 28), covertype-class multiclass (581k x 54, 7 classes), and
+MSLR-class ranking (30k+ queries) — through BOTH this framework and the
+reference oracle (/root/oracle_build, hist method), on the SAME synthetic
+stand-in arrays (zero-egress image: the real datasets cannot be fetched;
+shapes, sparsity and label structure mirror them).  Records wall-clock and
+quality (AUC / merror / ndcg@10 computed by ONE metric implementation —
+ours, oracle-parity-tested — over both models' predictions) into
+BENCH_LADDER.json.
+
+Scale: `LADDER_SCALE` (fraction of full rows, default 0.05 on CPU / 1.0 on
+TPU) bounds single-core CPU runtime; the recorded rows are what actually
+ran, and `scale` says how far from the full shape that is.  The TPU
+watcher runs this at full scale in its final stage.
+
+Usage:  python scripts/bench_ladder.py [out.json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ORACLE_PKG = "/root/oracle_build/pkg"
+
+FULL_CONFIGS = [
+    # BASELINE.md ladder #2: HIGGS 11M x 28, binary:logistic, AUC
+    dict(name="higgs_binary", rows=11_000_000, cols=28, kind="binary",
+         objective="binary:logistic", metric="auc", rounds=5,
+         params=dict(max_depth=8, eta=0.3, max_bin=256)),
+    # ladder #3: covertype 581k x 54, 7 classes, multi:softprob, merror
+    dict(name="covertype_softprob", rows=581_012, cols=54, kind="multi",
+         classes=7, objective="multi:softprob", metric="merror", rounds=5,
+         params=dict(max_depth=8, eta=0.3, max_bin=256)),
+    # ladder #4: MSLR-WEB30K 3.77M docs / 31k queries, rank:ndcg, ndcg@10
+    dict(name="mslr_ndcg", rows=3_771_125, cols=136, kind="rank",
+         groups=31_531, objective="rank:ndcg", metric="ndcg@10", rounds=5,
+         params=dict(max_depth=8, eta=0.3, max_bin=256)),
+]
+
+
+def make_data(cfg, scale: float, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    R = max(int(cfg["rows"] * scale), 10_000)
+    F = cfg["cols"]
+    X = rng.normal(size=(R, F)).astype(np.float32)
+    X[rng.random((R, F)) < 0.02] = np.nan  # HIGGS-like light missingness
+    lin = (np.nan_to_num(X[:, 0]) * 1.2 - np.nan_to_num(X[:, 1])
+           + 0.5 * np.nan_to_num(X[:, 2]) * np.nan_to_num(X[:, 3]))
+    if cfg["kind"] == "binary":
+        y = (lin + rng.normal(scale=0.5, size=R) > 0).astype(np.float32)
+        return R, X, y, None
+    if cfg["kind"] == "multi":
+        K = cfg["classes"]
+        z = lin + rng.normal(scale=0.5, size=R)
+        y = np.clip(((z - z.min()) / (np.ptp(z) + 1e-9) * K).astype(np.int64),
+                    0, K - 1).astype(np.float32)
+        return R, X, y, None
+    # ranking: ~120 docs/query like MSLR; graded 0-4 relevance
+    G = max(int(cfg["groups"] * scale), 100)
+    sizes = rng.integers(40, 200, size=G)
+    R = int(sizes.sum())
+    X = rng.normal(size=(R, cfg["cols"])).astype(np.float32)
+    rel = np.clip((X[:, 0] + 0.5 * rng.normal(size=R) + 2.0).astype(np.int64),
+                  0, 4).astype(np.float32)
+    return R, X, rel, sizes.astype(np.int64)
+
+
+def eval_quality(metric, preds, y, group_sizes):
+    from xgboost_tpu.metric import create_metric
+
+    fn, _name = create_metric(metric)  # returns (callable, resolved name)
+    kw = {}
+    if group_sizes is not None:
+        kw["group_ptr"] = np.concatenate([[0], np.cumsum(group_sizes)])
+    return float(fn(np.asarray(preds), np.asarray(y, np.float64), **kw))
+
+
+def run_ours(cfg, X, y, group_sizes):
+    import xgboost_tpu as xtb
+
+    d = xtb.DMatrix(X, label=y)
+    if group_sizes is not None:
+        d.set_group(group_sizes)
+    p = {"objective": cfg["objective"], **cfg["params"]}
+    if cfg["kind"] == "multi":
+        p["num_class"] = cfg["classes"]
+    # warm the jit cache (and the ellpack build) so the timed run measures
+    # steady-state boosting, not XLA compilation — the reference's kernels
+    # are AOT, so this is the like-for-like comparison
+    xtb.train(p, d, 1, verbose_eval=False)
+    t0 = time.perf_counter()
+    bst = xtb.train(p, d, cfg["rounds"], verbose_eval=False)
+    # predictions force full materialization (train is async under jit)
+    preds = np.asarray(bst.predict(d))
+    dt = time.perf_counter() - t0
+    return dt, preds
+
+
+def run_oracle(cfg, X, y, group_sizes):
+    sys.path.insert(0, ORACLE_PKG)
+    import xgboost as xgb  # the oracle build
+
+    d = xgb.DMatrix(X, label=y, missing=np.nan)
+    if group_sizes is not None:
+        d.set_group(group_sizes)
+    p = {"objective": cfg["objective"], "tree_method": "hist",
+         "nthread": os.cpu_count(), **cfg["params"]}
+    if cfg["kind"] == "multi":
+        p["num_class"] = cfg["classes"]
+    t0 = time.perf_counter()
+    bst = xgb.train(p, d, num_boost_round=cfg["rounds"])
+    preds = np.asarray(bst.predict(d))
+    dt = time.perf_counter() - t0
+    return dt, preds
+
+
+def main() -> None:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_LADDER.json"
+    import jax
+
+    # sitecustomize freezes jax_platforms=axon at interpreter startup; the
+    # env var alone cannot override it post-import (tests/conftest.py has
+    # the same rule).  Never touch the tunnel unless explicitly asked.
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
+    platform = jax.devices()[0].platform
+    scale = float(os.environ.get("LADDER_SCALE",
+                                 "1.0" if platform == "tpu" else "0.05"))
+    rows_out = []
+    for cfg in FULL_CONFIGS:
+        R, X, y, groups = make_data(cfg, scale)
+        print(f"[{cfg['name']}] rows={R} cols={cfg['cols']} "
+              f"rounds={cfg['rounds']} scale={scale}", flush=True)
+        ours_s, ours_pred = run_ours(cfg, X, y, groups)
+        ours_q = eval_quality(cfg["metric"], ours_pred, y, groups)
+        print(f"  ours:   {ours_s:8.1f}s  {cfg['metric']}={ours_q:.5f}",
+              flush=True)
+        try:
+            orc_s, orc_pred = run_oracle(cfg, X, y, groups)
+            orc_q = eval_quality(cfg["metric"], orc_pred, y, groups)
+            print(f"  oracle: {orc_s:8.1f}s  {cfg['metric']}={orc_q:.5f}",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"  oracle FAILED: {e!r}", flush=True)
+            orc_s, orc_q = None, None
+        rows_out.append(dict(
+            config=cfg["name"], rows=R, cols=cfg["cols"],
+            full_rows=cfg["rows"], scale=scale, rounds=cfg["rounds"],
+            objective=cfg["objective"], metric=cfg["metric"],
+            platform=platform,
+            ours_wall_s=round(ours_s, 2), ours_quality=round(ours_q, 6),
+            oracle_wall_s=None if orc_s is None else round(orc_s, 2),
+            oracle_quality=None if orc_q is None else round(orc_q, 6),
+            speed_vs_oracle=(None if orc_s is None
+                             else round(orc_s / ours_s, 4)),
+        ))
+        with open(out_path, "w") as fh:  # checkpoint after each config
+            json.dump(rows_out, fh, indent=1)
+    print(json.dumps({"ladder": rows_out}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

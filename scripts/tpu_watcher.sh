@@ -75,6 +75,11 @@ while true; do
     rc=$?
     echo "sweep exit=$rc $(date)" >> "$LOG"
     if [ $rc -eq 0 ]; then
+      echo "running BASELINE ladder (full scale) $(date)" >> "$LOG"
+      cd /root/repo && LADDER_SCALE=1.0 timeout 5400 \
+        python scripts/bench_ladder.py "$OUT/BENCH_LADDER_tpu.json" \
+        > "$OUT/ladder_tpu.log" 2>&1
+      echo "ladder exit=$? $(date)" >> "$LOG"
       echo "ALL DONE $(date)" >> "$LOG"
       exit 0
     fi

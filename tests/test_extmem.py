@@ -218,3 +218,63 @@ def test_sparse_page_dmatrix_scipy_batches_and_sentinel():
     X2 = np.where(dense2 == -1.0, np.nan, dense2)
     np.testing.assert_array_equal(b2.predict(d2),
                                   b2.predict(xtb.DMatrix(X2)))
+
+
+@pytest.mark.slow
+def test_extmem_twenty_pages_mesh_parity(eight_devices):
+    """>= 20 zstd pages streamed through the 8-chip sharded grower
+    (VERDICT r4 #9): training must match the in-memory mesh model on the
+    same rows, and the prefetch=off mode must produce identical trees
+    (overlap is a scheduling property, never a numerical one)."""
+    import hashlib
+
+    import xgboost_tpu as xtb
+    from xgboost_tpu.data.extmem import DataIter, ExtMemQuantileDMatrix
+
+    n_pages, rows_page, F = 20, 1024, 6
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=F).astype(np.float32)
+    X_all = rng.normal(size=(n_pages * rows_page, F)).astype(np.float32)
+    y_all = (X_all @ w + rng.normal(scale=0.4, size=len(X_all)) > 0
+             ).astype(np.float32)
+
+    class Pages(DataIter):
+        def __init__(self):
+            super().__init__()
+            self._i = 0
+
+        def next(self, input_data):
+            if self._i >= n_pages:
+                return 0
+            lo = self._i * rows_page
+            input_data(data=X_all[lo:lo + rows_page],
+                       label=y_all[lo:lo + rows_page])
+            self._i += 1
+            return 1
+
+        def reset(self):
+            self._i = 0
+
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "max_bin": 32, "n_devices": 8}
+    d = ExtMemQuantileDMatrix(Pages(), max_bin=32)
+    assert len(d._pages) == n_pages
+
+    def h(bst):
+        return hashlib.md5(
+            "".join(bst.get_dump(dump_format="json")).encode()).hexdigest()
+
+    bst = xtb.train(params, d, 3, verbose_eval=False)
+    bst_serial = xtb.train({**params, "_extmem_prefetch": "0"}, d, 3,
+                           verbose_eval=False)
+    assert h(bst) == h(bst_serial)  # prefetch is numerically transparent
+
+    # quality parity vs in-memory mesh training on the same rows (cuts
+    # differ: streamed sketch merges per-page grids), so compare quality
+    bst_mem = xtb.train(params, xtb.DMatrix(X_all, label=y_all), 3,
+                        verbose_eval=False)
+    p_ext = bst.predict(d)
+    p_mem = bst_mem.predict(xtb.DMatrix(X_all))
+    err_ext = np.mean((p_ext > 0.5) != y_all)
+    err_mem = np.mean((p_mem > 0.5) != y_all)
+    assert err_ext <= err_mem + 0.02, (err_ext, err_mem)

@@ -125,3 +125,40 @@ def test_leaf_positions_match_rows():
     state = grower.grow(ell.bins, jnp.asarray(gp), valid, ell.cuts_pad, ell.n_bins)
     delta_dev = np.asarray(leaf_margin_delta(state.pos, state.leaf_val))[:n]
     np.testing.assert_allclose(delta_dev, delta_ref, rtol=1e-2, atol=5e-4)
+
+
+def test_padded_levels_parity_deep():
+    """The shared padded interior program (compile-wall fix) must grow
+    identical trees to per-depth programs at depth > 5 — on CPU the default
+    flips to per-depth for speed, so pin the padded path explicitly."""
+    import hashlib
+
+    import xgboost_tpu as xtb
+    from xgboost_tpu.data.dmatrix import DMatrix
+    from xgboost_tpu.ops.split import SplitParams
+    from xgboost_tpu.tree.grow import HistTreeGrower
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(3000, 6)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.3 * rng.normal(size=3000) > 0).astype(np.float32)
+    d = DMatrix(X, label=y)
+    ell = d.ensure_ellpack(max_bin=32)
+    bins = jnp.asarray(ell.bins)
+    R = bins.shape[0]
+    valid = jnp.arange(R) < 3000
+    gp = np.zeros((R, 2), np.float32)
+    gp[:3000, 0] = 0.5 - y
+    gp[:3000, 1] = 0.25
+    gp = jnp.asarray(gp)
+    params = SplitParams(eta=0.3, gamma=0.0, min_child_weight=1.0,
+                         lambda_=1.0, alpha=0.0, max_delta_step=0.0)
+
+    args = (bins, gp, valid, jnp.asarray(ell.cuts_pad),
+            jnp.asarray(ell.n_bins))
+    t_pad = HistTreeGrower(7, params, padded_levels=True).grow(*args)
+    t_per = HistTreeGrower(7, params, padded_levels=False).grow(*args)
+    for name in ("feat", "sbin", "thr", "leaf_val", "is_leaf"):
+        np.testing.assert_array_equal(np.asarray(getattr(t_pad, name)),
+                                      np.asarray(getattr(t_per, name)),
+                                      err_msg=name)

@@ -96,3 +96,22 @@ def test_booster_n_devices_non_pow2(eight_devices):
     np.testing.assert_allclose(p1, p3, rtol=5e-4, atol=1e-5)
     for t1, t3 in zip(b1.trees, b3.trees):
         np.testing.assert_array_equal(t1.split_indices, t3.split_indices)
+
+
+@pytest.mark.slow
+def test_mesh_scan_chunking_above_chunk_size(eight_devices):
+    """>2048 rows per device forces the chunked scan inside shard_map
+    (regression: the scan carry must enter with the shard-varying type —
+    seeding with zeros used to fail jax's varying-axes check, and this
+    path was never reached by the small mesh tests)."""
+    import xgboost_tpu as xtb
+    from xgboost_tpu.testing.data import make_binary
+
+    X, y = make_binary(8 * 2600, 6, seed=11)   # 2600 rows/device > chunk
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.5,
+              "max_bin": 32}
+    b8 = xtb.train({**params, "n_devices": 8}, xtb.DMatrix(X, label=y), 2,
+                   verbose_eval=False)
+    b1 = xtb.train(params, xtb.DMatrix(X, label=y), 2, verbose_eval=False)
+    for t1, t8 in zip(b1.trees, b8.trees):
+        np.testing.assert_array_equal(t1.split_indices, t8.split_indices)

@@ -15,12 +15,15 @@ def ltr():
 
 
 @pytest.mark.parametrize("obj", ["rank:ndcg", "rank:pairwise", "rank:map"])
-def test_rank_objectives_improve(ltr, obj):
+@pytest.mark.parametrize("method", ["topk", "mean"])
+def test_rank_objectives_improve(ltr, obj, method):
     X, y, qid = ltr
     d = xtb.DMatrix(X, label=y, qid=qid)
     res = {}
+    # defaults mirror the reference (ranking_utils.h): topk truncates at
+    # k=32, mean samples 1 random different-label pair per doc per round
     xtb.train({"objective": obj, "max_depth": 4, "eta": 0.3,
-               "lambdarank_num_pair_per_sample": 2}, d, 20,
+               "lambdarank_pair_method": method}, d, 20,
               evals=[(d, "t")], evals_result=res, verbose_eval=False)
     metric = list(res["t"].keys())[0]
     vals = res["t"][metric]
@@ -178,3 +181,36 @@ def test_device_rank_mslr_scale_speed():
     assert v1 == v2
     assert 0.0 < v2 <= 1.0
     assert dt < 1.0, f"device ndcg took {dt:.2f}s at MSLR scale"
+
+
+def test_rank_mean_multi_pair_normalized(ltr):
+    """mean method with num_pair > 1: gradients are averaged over the
+    sampled pairs (1/n_pairs, lambdarank_obj.cc:230), so more pairs reduce
+    sampling noise without inflating the step size — and training still
+    improves the metric."""
+    X, y, qid = ltr
+    d = xtb.DMatrix(X, label=y, qid=qid)
+    res = {}
+    xtb.train({"objective": "rank:ndcg", "max_depth": 4, "eta": 0.3,
+               "lambdarank_pair_method": "mean",
+               "lambdarank_num_pair_per_sample": 4}, d, 20,
+              evals=[(d, "t")], evals_result=res, verbose_eval=False)
+    vals = res["t"]["ndcg"]
+    assert np.isfinite(vals).all() and vals[-1] > vals[0]
+
+    # the 1/n_pairs normalization bounds the per-round gradient magnitude:
+    # a 4-pair gradient must not be ~4x the 1-pair gradient
+    import jax.numpy as jnp
+
+    from xgboost_tpu.objective import create_objective
+
+    ptr = np.concatenate([[0], np.cumsum(np.bincount(qid))])
+    g = {}
+    for npair in (1, 4):
+        obj = create_objective("rank:ndcg", {
+            "lambdarank_pair_method": "mean",
+            "lambdarank_num_pair_per_sample": npair})
+        obj.set_group_info(ptr)
+        gp = obj.get_gradient(jnp.zeros(len(y)), jnp.asarray(y), None, 0)
+        g[npair] = float(jnp.abs(gp[:, 0, 0]).sum())
+    assert g[4] < 2.0 * g[1], g

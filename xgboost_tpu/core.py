@@ -664,6 +664,10 @@ class Booster:
             max_leaves=self.tparam.max_leaves, lossguide=lossguide,
             mesh=self._get_mesh(),
             distributed=self._process_parallel(),
+            # bench hook: "_extmem_prefetch": 0 serializes page transfer
+            # against compute so the prefetch-overlap gain is measurable
+            prefetch=str(self.params.get("_extmem_prefetch", "1")).lower()
+            in ("1", "true"),
         )
         K = gpair.shape[1]
         new_margin = cache.margin

@@ -87,11 +87,15 @@ def _hist_accumulate(bins, gpair, pos, node0, n_nodes, n_bin, chunk, stride):
         b, g, p = xs
         return acc + _hist_chunk(b, g, p, node0, n_nodes, n_bin, stride), None
 
-    acc0 = jnp.zeros((n_nodes, F, n_bin, C), dtype=jnp.float32)
+    # seed the carry with chunk 0 (not zeros): under shard_map the chunk
+    # contributions vary over the data axis, and a scan carry must enter
+    # with the same varying type it leaves with
+    acc0 = _hist_chunk(bins[:chunk], gpair[:chunk], pos[:chunk], node0,
+                       n_nodes, n_bin, stride)
     xs = (
-        bins[: n_chunks * chunk].reshape(n_chunks, chunk, F),
-        gpair[: n_chunks * chunk].reshape(n_chunks, chunk, C),
-        pos[: n_chunks * chunk].reshape(n_chunks, chunk),
+        bins[chunk: n_chunks * chunk].reshape(n_chunks - 1, chunk, F),
+        gpair[chunk: n_chunks * chunk].reshape(n_chunks - 1, chunk, C),
+        pos[chunk: n_chunks * chunk].reshape(n_chunks - 1, chunk),
     )
     acc, _ = lax.scan(body, acc0, xs)
     if rem:

@@ -111,11 +111,15 @@ def hist_accumulate_q(bins, gq, pos, node0, n_nodes: int, n_bin: int,
         b, g, p = xs
         return acc + _hist_chunk_q(b, g, p, node0, n_nodes, n_bin, stride), None
 
+    # carry seeded with chunk 0: under shard_map the contributions vary
+    # over the data axis and the scan carry type must match (histogram.py
+    # _hist_accumulate has the same rule)
     C, L = gq.shape[1], gq.shape[2]
-    acc0 = jnp.zeros((n_nodes, F, n_bin, C, L), jnp.int32)
-    xs = (bins[: n_chunks * chunk].reshape(n_chunks, chunk, F),
-          gq[: n_chunks * chunk].reshape(n_chunks, chunk, C, L),
-          pos[: n_chunks * chunk].reshape(n_chunks, chunk))
+    acc0 = _hist_chunk_q(bins[:chunk], gq[:chunk], pos[:chunk], node0,
+                         n_nodes, n_bin, stride)
+    xs = (bins[chunk: n_chunks * chunk].reshape(n_chunks - 1, chunk, F),
+          gq[chunk: n_chunks * chunk].reshape(n_chunks - 1, chunk, C, L),
+          pos[chunk: n_chunks * chunk].reshape(n_chunks - 1, chunk))
     acc, _ = lax.scan(body, acc0, xs)
     if rem:
         acc = acc + _hist_chunk_q(bins[-rem:], gq[-rem:], pos[-rem:], node0,

@@ -87,7 +87,11 @@ class ShardedHistTreeGrower:
         # (same compile-wall fix as HistTreeGrower; hist psum rides inside
         # level_step_padded via axis_name) — per-depth programs only for the
         # root and the leaf-finalize level, plus the pallas fallback.
-        self._padded = self.hist_impl != "pallas" and self.max_depth >= 2
+        # Same platform rule as HistTreeGrower (shared helper).
+        from ..tree.grow import default_padded_levels
+
+        self._padded = (self.hist_impl != "pallas" and self.max_depth >= 2
+                        and default_padded_levels(self.max_depth))
         if self._padded:
             W = 1 << (self.max_depth - 1)
             pad_base = functools.partial(

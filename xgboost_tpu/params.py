@@ -131,6 +131,7 @@ KNOWN_LEARNER_KEYS = {
     # ranking
     "lambdarank_num_pair_per_sample", "lambdarank_pair_method", "ndcg_exp_gain",
     "lambdarank_unbiased", "lambdarank_bias_norm",
+    "lambdarank_normalization", "lambdarank_score_normalization",
     # survival / quantile
     "aft_loss_distribution", "aft_loss_distribution_scale", "quantile_alpha",
     "expectile_alpha",
@@ -144,4 +145,7 @@ KNOWN_LEARNER_KEYS = {
 def split_unknown(params: Dict[str, Any]) -> List[str]:
     p = canonicalize(params)
     tree_keys = {("lambda" if f.name == "lambda_" else f.name) for f in dataclasses.fields(TrainParam)}
-    return [k for k in p if k not in tree_keys and k not in KNOWN_LEARNER_KEYS]
+    # leading-underscore keys are internal hooks (_hist_impl,
+    # _extmem_prefetch, ...), deliberately outside the public surface
+    return [k for k in p if k not in tree_keys
+            and k not in KNOWN_LEARNER_KEYS and not k.startswith("_")]

@@ -498,6 +498,14 @@ class GrownTree(NamedTuple):
     totals: "object"
 
 
+def default_padded_levels(max_depth: int) -> bool:
+    """Platform rule for sharing ONE padded interior level program across
+    depths: on accelerators the padding rides the 128-lane MXU tile for
+    free and killing the per-depth compile wall matters; on CPU the matmul
+    pays the full padded width, so deep trees keep per-depth programs."""
+    return jax.default_backend() != "cpu" or max_depth <= 5
+
+
 class HistTreeGrower:
     """Host driver looping jitted level steps (reference: GPUHistMaker::Update,
     src/tree/updater_gpu_hist.cu:703)."""
@@ -513,7 +521,7 @@ class HistTreeGrower:
         max_leaves: int = 0,
         lossguide: bool = False,
         subtract: bool = True,
-        padded_levels: bool = True,
+        padded_levels: Optional[bool] = None,
         quantised: bool = False,
     ) -> None:
         self.max_depth = max_depth
@@ -530,7 +538,15 @@ class HistTreeGrower:
         self.quantised = quantised
         # one shared compiled program for all interior depths (padded node
         # dim + traced node0) instead of one per depth — kills the compile
-        # wall.  Pallas hist keeps per-depth steps (static node0 kernel).
+        # wall.  Padding costs FLOPs at the narrow depths (every interior
+        # level is built at the widest level's width): on the MXU the extra
+        # output columns ride the same 128-lane tile (2**(md-1) <= 128 for
+        # md <= 8), but on CPU the matmul pays the full padded width, so
+        # deep CPU trees default to per-depth programs (compile there is
+        # cheap relative to step time).  Pallas keeps per-depth steps
+        # (static node0 kernel).
+        if padded_levels is None:
+            padded_levels = default_padded_levels(max_depth)
         self.padded_levels = padded_levels and hist_impl != "pallas"
         self.max_nodes = max_nodes_for_depth(max_depth)
 

@@ -117,7 +117,7 @@ class StreamingHistTreeGrower:
     def __init__(self, max_depth: int, params: SplitParams, *,
                  interaction_sets=None, max_leaves: int = 0,
                  lossguide: bool = False, mesh=None,
-                 distributed: bool = False) -> None:
+                 distributed: bool = False, prefetch: bool = True) -> None:
         self.max_depth = max_depth
         self.params = params
         self.interaction_sets = interaction_sets
@@ -133,6 +133,10 @@ class StreamingHistTreeGrower:
         # (the AllReduceHist of the reference's extmem path —
         # updater_gpu_hist.cu:601 runs unchanged under rabit there)
         self.distributed = distributed
+        # prefetch=False serializes decompress/H2D against device compute
+        # (measurement baseline for the overlap gain; reference knob:
+        # n_prefetch_batches=0, sparse_page_source.h:293)
+        self.prefetch = prefetch
         self.max_nodes = max_nodes_for_depth(max_depth)
 
     def _put_page(self, page_np):
@@ -190,6 +194,12 @@ class StreamingHistTreeGrower:
                     build=build, stride=2 if subtract else 1,
                 )
                 if i + 1 < n_pages:
+                    if not self.prefetch:
+                        # serialize: page i's compute must finish before
+                        # page i+1's host decompress starts (pos_seg too —
+                        # on the last level h is a constant dummy while the
+                        # position routing still runs)
+                        jax.block_until_ready((pos_seg, h))
                     next_dev = self._put_page(pages[i + 1])
                 pos = lax.dynamic_update_slice_in_dim(pos, pos_seg, lo, axis=0)
                 if build:
