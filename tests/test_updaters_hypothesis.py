@@ -68,3 +68,87 @@ def test_binary_objective_sweep(params, seed):
     ll = res["t"]["logloss"]
     assert np.isfinite(ll).all()
     assert ll[-1] <= ll[0] * 1.05
+
+
+_objectives = st.sampled_from([
+    ("binary:logistic", "logloss"),
+    ("reg:squarederror", "rmse"),
+    ("reg:absoluteerror", "mae"),
+    ("reg:pseudohubererror", "mphe"),
+    ("count:poisson", "poisson-nloglik"),
+])
+
+
+@settings(deadline=None, max_examples=10,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(obj_metric=_objectives, params=_params, seed=st.integers(0, 2))
+def test_objective_param_sweep(obj_metric, params, seed):
+    """Objectives x tree params: adaptive-leaf (mae), CoV-transformed
+    (poisson), and plain second-order objectives all stay finite and
+    non-divergent under the full param grid."""
+    obj, metric = obj_metric
+    X, y = _dataset(seed, n=250)
+    if obj == "binary:logistic":
+        y = (y > 0).astype(np.float32)
+    elif obj == "count:poisson":
+        y = np.abs(y) + 0.1
+    d = xtb.DMatrix(X, label=y)
+    res = {}
+    xtb.train({**params, "objective": obj}, d, 6,
+              evals=[(d, "t")], evals_result=res, verbose_eval=False)
+    vals = res["t"][metric]
+    assert np.isfinite(vals).all()
+    assert vals[-1] <= vals[0] * 1.1 + 1e-6
+
+
+@settings(deadline=None, max_examples=8,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(params=_params, seed=st.integers(0, 2),
+       n_cat=st.sampled_from([3, 12, 40]))
+def test_categorical_param_sweep(params, seed, n_cat):
+    """Categorical features under the full param grid (one-hot and sorted
+    partition regimes both exercised by varying cardinality vs
+    max_cat_to_onehot); trees must respect depth/leaf caps and predictions
+    must stay finite."""
+    rng = np.random.default_rng(seed)
+    n = 300
+    Xn = rng.normal(size=(n, 3)).astype(np.float32)
+    c = rng.integers(0, n_cat, size=n)
+    effect = rng.normal(size=n_cat)[c].astype(np.float32)
+    y = (Xn[:, 0] + effect + 0.2 * rng.normal(size=n)).astype(np.float32)
+    X = np.column_stack([Xn, c.astype(np.float32)])
+    d = xtb.DMatrix(X, label=y, feature_types=["q", "q", "q", "c"],
+                    enable_categorical=True)
+    res = {}
+    # the bin table must hold every category (same rule as the reference)
+    params = {**params, "max_bin": max(params["max_bin"], n_cat)}
+    bst = xtb.train({**params, "objective": "reg:squarederror"}, d, 6,
+                    evals=[(d, "t")], evals_result=res, verbose_eval=False)
+    assert np.isfinite(res["t"]["rmse"]).all()
+    assert np.isfinite(bst.predict(d)).all()
+    for t in bst.trees:
+        if params["max_leaves"]:
+            assert t.num_leaves <= params["max_leaves"]
+
+
+@settings(deadline=None, max_examples=8,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(params=_params, seed=st.integers(0, 2))
+def test_model_io_roundtrip_sweep(params, seed):
+    """Every config's model must round-trip through BOTH serialization
+    formats bit-exactly (reference: test_model_io.py round-trip sweep)."""
+    import os
+    import tempfile
+
+    X, y = _dataset(seed, n=200)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({**params, "objective": "reg:squarederror"}, d, 3,
+                    verbose_eval=False)
+    p0 = bst.predict(d)
+    with tempfile.TemporaryDirectory() as tmp:
+        for ext in ("json", "ubj"):
+            path = os.path.join(tmp, f"m.{ext}")
+            bst.save_model(path)
+            b2 = xtb.Booster()
+            b2.load_model(path)
+            np.testing.assert_array_equal(b2.predict(d), p0)

@@ -78,29 +78,40 @@ class _Reader:
     def __init__(self, fh: BinaryIO):
         self.fh = fh
 
-    def tag(self) -> bytes:
-        t = self.fh.read(1)
-        if not t:
+    def _read_exact(self, n: int) -> bytes:
+        """Short reads become EOFError, not struct.error — truncated or
+        corrupt model buffers must fail with a clean python-level error
+        (tests/test_model_io_fuzz.py)."""
+        if n < 0:
+            raise ValueError("UBJSON: negative length")
+        b = self.fh.read(n)
+        if len(b) != n:
             raise EOFError("unexpected end of UBJSON stream")
-        return t
+        return b
+
+    def tag(self) -> bytes:
+        return self._read_exact(1)
 
     def read_int(self, t: bytes) -> int:
         fmt = _INT_FMT[t]
-        return struct.unpack(fmt, self.fh.read(struct.calcsize(fmt)))[0]
+        return struct.unpack(fmt, self._read_exact(struct.calcsize(fmt)))[0]
 
     def read_len(self) -> int:
-        return self.read_int(self.tag())
+        n = self.read_int(self.tag())
+        if n < 0:
+            raise ValueError("UBJSON: negative length")
+        return n
 
     def read_str(self) -> str:
         n = self.read_len()
-        return self.fh.read(n).decode("utf-8")
+        return self._read_exact(n).decode("utf-8")
 
     def value(self, t: bytes) -> Any:
         if t in _INT_FMT:
             return self.read_int(t)
         if t in _FLOAT_FMT:
             fmt = _FLOAT_FMT[t]
-            return struct.unpack(fmt, self.fh.read(struct.calcsize(fmt)))[0]
+            return struct.unpack(fmt, self._read_exact(struct.calcsize(fmt)))[0]
         if t == b"S":
             return self.read_str()
         if t == b"T":
@@ -129,14 +140,14 @@ class _Reader:
             if typ in _FLOAT_FMT:
                 fmt = _FLOAT_FMT[typ]
                 sz = struct.calcsize(fmt)
-                arr = np.frombuffer(self.fh.read(sz * count), dtype=fmt).astype(
+                arr = np.frombuffer(self._read_exact(sz * count), dtype=fmt).astype(
                     np.float32 if typ == b"d" else np.float64
                 )
                 return arr.tolist()
             if typ in _INT_FMT:
                 fmt = _INT_FMT[typ]
                 sz = struct.calcsize(fmt)
-                return np.frombuffer(self.fh.read(sz * count), dtype=fmt).tolist()
+                return np.frombuffer(self._read_exact(sz * count), dtype=fmt).tolist()
             raise ValueError(f"UBJSON: bad array type {typ!r}")
         out = []
         if count is not None:
@@ -156,7 +167,7 @@ class _Reader:
                 return out
             # key: length tag already read
             n = self.read_int(t)
-            key = self.fh.read(n).decode("utf-8")
+            key = self._read_exact(n).decode("utf-8")
             out[key] = self.value(self.tag())
 
 
