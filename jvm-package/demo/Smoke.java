@@ -1,0 +1,59 @@
+import java.util.HashMap;
+import java.util.Map;
+
+import ml.dmlc.xgboost_tpu.java.Booster;
+import ml.dmlc.xgboost_tpu.java.DMatrix;
+import ml.dmlc.xgboost_tpu.java.XGBoost;
+
+/** Train/predict/serialize smoke through the JVM binding (run on a
+ * machine with a JDK — see ../README.md). */
+public final class Smoke {
+  public static void main(String[] args) throws Exception {
+    int n = 1000, f = 8;
+    java.util.Random rnd = new java.util.Random(1);
+    float[] data = new float[n * f];
+    float[] label = new float[n];
+    for (int i = 0; i < n; ++i) {
+      for (int j = 0; j < f; ++j) {
+        data[i * f + j] = (float) rnd.nextGaussian();
+      }
+      if (i % 17 == 0) {
+        data[i * f] = Float.NaN;
+      }
+      label[i] = (!Float.isNaN(data[i * f]) && data[i * f] > 0) ? 1f : 0f;
+    }
+    try (DMatrix dtrain = new DMatrix(data, n, f)) {
+      dtrain.setLabel(label);
+      Map<String, Object> params = new HashMap<>();
+      params.put("objective", "binary:logistic");
+      params.put("max_depth", 4);
+      params.put("eta", 0.3);
+      params.put("eval_metric", "logloss");
+      Map<String, DMatrix> evals = new HashMap<>();
+      evals.put("train", dtrain);
+      try (Booster booster = XGBoost.train(dtrain, params, 10, evals)) {
+        float[] preds = booster.predict(dtrain);
+        int err = 0;
+        for (int i = 0; i < n; ++i) {
+          if ((preds[i] > 0.5f) != (label[i] > 0.5f)) {
+            ++err;
+          }
+        }
+        System.out.println("train error: " + (double) err / n);
+        if (err > n / 10) {
+          throw new AssertionError("model failed to learn");
+        }
+        byte[] raw = booster.toByteArray("ubj");
+        try (Booster loaded = Booster.loadModel(raw)) {
+          float[] p2 = loaded.predict(dtrain);
+          for (int i = 0; i < n; ++i) {
+            if (p2[i] != preds[i]) {
+              throw new AssertionError("round-trip mismatch at " + i);
+            }
+          }
+        }
+        System.out.println("JVM binding smoke: OK");
+      }
+    }
+  }
+}

@@ -1,0 +1,101 @@
+package ml.dmlc.xgboost_tpu.java;
+
+import java.util.Map;
+
+/**
+ * Trained model handle (reference surface: xgboost4j.java.Booster over the
+ * same XGBooster* C entries).
+ */
+public class Booster implements AutoCloseable {
+  long handle;
+
+  Booster(long handle) {
+    this.handle = handle;
+  }
+
+  public static Booster create(Map<String, Object> params, DMatrix[] cache)
+      throws XGBoostError {
+    long[] dmats = new long[cache == null ? 0 : cache.length];
+    for (int i = 0; i < dmats.length; ++i) {
+      dmats[i] = cache[i].handle;
+    }
+    long[] out = new long[1];
+    XGBoostError.check(XGBoostJNI.XGBoosterCreate(dmats, out));
+    Booster b = new Booster(out[0]);
+    try {
+      if (params != null) {
+        for (Map.Entry<String, Object> e : params.entrySet()) {
+          b.setParam(e.getKey(), String.valueOf(e.getValue()));
+        }
+      }
+      return b;
+    } catch (XGBoostError | RuntimeException e) {
+      b.close();
+      throw e;
+    }
+  }
+
+  public void setParam(String name, String value) throws XGBoostError {
+    XGBoostError.check(XGBoostJNI.XGBoosterSetParam(handle, name, value));
+  }
+
+  public void update(DMatrix dtrain, int iter) throws XGBoostError {
+    XGBoostError.check(
+        XGBoostJNI.XGBoosterUpdateOneIter(handle, iter, dtrain.handle));
+  }
+
+  public String evalSet(DMatrix[] evalMatrixs, String[] evalNames, int iter)
+      throws XGBoostError {
+    long[] dmats = new long[evalMatrixs.length];
+    for (int i = 0; i < dmats.length; ++i) {
+      dmats[i] = evalMatrixs[i].handle;
+    }
+    String[] out = new String[1];
+    XGBoostError.check(
+        XGBoostJNI.XGBoosterEvalOneIter(handle, iter, dmats, evalNames, out));
+    return out[0];
+  }
+
+  public float[] predict(DMatrix dmat) throws XGBoostError {
+    return predict(dmat, false, 0);
+  }
+
+  public float[] predict(DMatrix dmat, boolean outputMargin, int ntreeLimit)
+      throws XGBoostError {
+    float[][] out = new float[1][];
+    XGBoostError.check(XGBoostJNI.XGBoosterPredict(
+        handle, dmat.handle, outputMargin ? 1 : 0, ntreeLimit, out));
+    return out[0];
+  }
+
+  /** Serialize to ubj/json bytes (the byte-array model exchange the JVM
+   * ecosystem uses for spark checkpointing). */
+  public byte[] toByteArray(String format) throws XGBoostError {
+    byte[][] out = new byte[1][];
+    XGBoostError.check(
+        XGBoostJNI.XGBoosterSaveModelToBuffer(handle, format, out));
+    return out[0];
+  }
+
+  public static Booster loadModel(byte[] buf) throws XGBoostError {
+    long[] out = new long[1];
+    XGBoostError.check(XGBoostJNI.XGBoosterCreate(new long[0], out));
+    Booster b = new Booster(out[0]);
+    try {
+      XGBoostError.check(
+          XGBoostJNI.XGBoosterLoadModelFromBuffer(b.handle, buf));
+      return b;
+    } catch (XGBoostError | RuntimeException e) {
+      b.close();
+      throw e;
+    }
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      XGBoostJNI.XGBoosterFree(handle);
+      handle = 0;
+    }
+  }
+}

@@ -1,0 +1,59 @@
+package ml.dmlc.xgboost_tpu.java;
+
+/**
+ * Data container (reference surface: xgboost4j.java.DMatrix, backed by the
+ * same XGDMatrix* C entries).  Row-major float input; NaN = missing.
+ */
+public class DMatrix implements AutoCloseable {
+  long handle;
+
+  public DMatrix(float[] data, int nrow, int ncol) throws XGBoostError {
+    this(data, nrow, ncol, Float.NaN);
+  }
+
+  public DMatrix(float[] data, int nrow, int ncol, float missing)
+      throws XGBoostError {
+    if (data.length != (long) nrow * ncol) {
+      throw new IllegalArgumentException(
+          "data.length " + data.length + " != nrow*ncol " + (long) nrow * ncol);
+    }
+    long[] out = new long[1];
+    XGBoostError.check(
+        XGBoostJNI.XGDMatrixCreateFromMat(data, nrow, ncol, missing, out));
+    handle = out[0];
+  }
+
+  public void setLabel(float[] labels) throws XGBoostError {
+    XGBoostError.check(
+        XGBoostJNI.XGDMatrixSetFloatInfo(handle, "label", labels));
+  }
+
+  public void setWeight(float[] weights) throws XGBoostError {
+    XGBoostError.check(
+        XGBoostJNI.XGDMatrixSetFloatInfo(handle, "weight", weights));
+  }
+
+  public void setBaseMargin(float[] margin) throws XGBoostError {
+    XGBoostError.check(
+        XGBoostJNI.XGDMatrixSetFloatInfo(handle, "base_margin", margin));
+  }
+
+  public void setGroup(int[] group) throws XGBoostError {
+    XGBoostError.check(
+        XGBoostJNI.XGDMatrixSetUIntInfo(handle, "group", group));
+  }
+
+  public long rowNum() throws XGBoostError {
+    long[] out = new long[1];
+    XGBoostError.check(XGBoostJNI.XGDMatrixNumRow(handle, out));
+    return out[0];
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      XGBoostJNI.XGDMatrixFree(handle);
+      handle = 0;
+    }
+  }
+}

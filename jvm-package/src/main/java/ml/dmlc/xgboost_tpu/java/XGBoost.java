@@ -1,0 +1,38 @@
+package ml.dmlc.xgboost_tpu.java;
+
+import java.util.Map;
+
+/**
+ * Training entry points (reference surface: xgboost4j.java.XGBoost.train).
+ */
+public final class XGBoost {
+  private XGBoost() {}
+
+  public static Booster train(DMatrix dtrain, Map<String, Object> params,
+                              int numRounds, Map<String, DMatrix> evals)
+      throws XGBoostError {
+    Booster booster = Booster.create(params, new DMatrix[] {dtrain});
+    try {
+      DMatrix[] evalMats = new DMatrix[evals == null ? 0 : evals.size()];
+      String[] evalNames = new String[evalMats.length];
+      int i = 0;
+      if (evals != null) {
+        for (Map.Entry<String, DMatrix> e : evals.entrySet()) {
+          evalNames[i] = e.getKey();
+          evalMats[i] = e.getValue();
+          ++i;
+        }
+      }
+      for (int iter = 0; iter < numRounds; ++iter) {
+        booster.update(dtrain, iter);
+        if (evalMats.length > 0) {
+          System.out.println(booster.evalSet(evalMats, evalNames, iter));
+        }
+      }
+      return booster;
+    } catch (XGBoostError | RuntimeException e) {
+      booster.close(); // don't leak the native handle on a failed train
+      throw e;
+    }
+  }
+}

@@ -1,0 +1,14 @@
+package ml.dmlc.xgboost_tpu.java;
+
+/** Error carrying XGBGetLastError() (xgboost4j.java.XGBoostError role). */
+public class XGBoostError extends Exception {
+  public XGBoostError(String message) {
+    super(message);
+  }
+
+  static void check(int ret) throws XGBoostError {
+    if (ret != 0) {
+      throw new XGBoostError(XGBoostJNI.XGBGetLastError());
+    }
+  }
+}

@@ -1,0 +1,54 @@
+package ml.dmlc.xgboost_tpu.java;
+
+/**
+ * Raw JNI surface over the xgboost_tpu C ABI (the reference's
+ * xgboost4j.java.XGBoostJNI role).  All methods return the C ABI status
+ * code; callers wrap non-zero codes in {@link XGBoostError} with
+ * {@link #XGBGetLastError()}.
+ *
+ * Native library: libxgboost_tpu_jni.so (see src/native/xgboost_tpu_jni.c
+ * for the build line; requires a JDK and the prebuilt libxtb_capi.so).
+ */
+final class XGBoostJNI {
+  static {
+    System.loadLibrary("xgboost_tpu_jni");
+  }
+
+  private XGBoostJNI() {}
+
+  static native String XGBGetLastError();
+
+  static native int XGDMatrixCreateFromMat(float[] data, long nrow,
+                                           long ncol, float missing,
+                                           long[] out);
+
+  static native int XGDMatrixSetFloatInfo(long handle, String field,
+                                          float[] values);
+
+  static native int XGDMatrixSetUIntInfo(long handle, String field,
+                                         int[] values);
+
+  static native int XGDMatrixNumRow(long handle, long[] out);
+
+  static native int XGDMatrixFree(long handle);
+
+  static native int XGBoosterCreate(long[] dmats, long[] out);
+
+  static native int XGBoosterFree(long handle);
+
+  static native int XGBoosterSetParam(long handle, String name, String value);
+
+  static native int XGBoosterUpdateOneIter(long handle, int iter,
+                                           long dtrain);
+
+  static native int XGBoosterEvalOneIter(long handle, int iter, long[] dmats,
+                                         String[] names, String[] out);
+
+  static native int XGBoosterPredict(long handle, long dmat, int optionMask,
+                                     int ntreeLimit, float[][] out);
+
+  static native int XGBoosterSaveModelToBuffer(long handle, String format,
+                                               byte[][] out);
+
+  static native int XGBoosterLoadModelFromBuffer(long handle, byte[] buf);
+}

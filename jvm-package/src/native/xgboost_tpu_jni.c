@@ -1,0 +1,213 @@
+/* JNI glue over the xgboost_tpu C ABI (libxtb_capi.so) — the role of the
+ * reference's jvm-packages/xgboost4j/src/native/xgboost4j.cpp, written
+ * fresh for this ABI.
+ *
+ * Every entry converts JVM arrays (float[]/double is row-major already —
+ * no transpose, unlike R), wraps handles as jlong, and returns the C
+ * return code; Java-side XGBoostError carries XGBGetLastError().
+ *
+ * Build (needs a JDK for jni.h; none ships in this image):
+ *   gcc -shared -fPIC -I$JAVA_HOME/include -I$JAVA_HOME/include/linux \
+ *       xgboost_tpu_jni.c -L../../../native -lxtb_capi \
+ *       -o libxgboost_tpu_jni.so
+ * The exact C-ABI call sequence this file makes is pinned by
+ * native/jni_glue_seq.c (tests/test_c_api.py::test_jni_glue_sequence), so
+ * the contract is CI-verified even without a JDK.
+ */
+#include <jni.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* DMatrixHandle;
+typedef void* BoosterHandle;
+typedef uint64_t bst_ulong;
+
+extern const char* XGBGetLastError(void);
+extern int XGDMatrixCreateFromMat(const float*, bst_ulong, bst_ulong, float,
+                                  DMatrixHandle*);
+extern int XGDMatrixSetFloatInfo(DMatrixHandle, const char*, const float*,
+                                 bst_ulong);
+extern int XGDMatrixSetUIntInfo(DMatrixHandle, const char*, const unsigned*,
+                                bst_ulong);
+extern int XGDMatrixNumRow(DMatrixHandle, bst_ulong*);
+extern int XGDMatrixFree(DMatrixHandle);
+extern int XGBoosterCreate(const DMatrixHandle[], bst_ulong, BoosterHandle*);
+extern int XGBoosterFree(BoosterHandle);
+extern int XGBoosterSetParam(BoosterHandle, const char*, const char*);
+extern int XGBoosterUpdateOneIter(BoosterHandle, int, DMatrixHandle);
+extern int XGBoosterEvalOneIter(BoosterHandle, int, DMatrixHandle[],
+                                const char*[], bst_ulong, const char**);
+extern int XGBoosterPredict(BoosterHandle, DMatrixHandle, int, unsigned, int,
+                            bst_ulong*, const float**);
+extern int XGBoosterSaveModelToBuffer(BoosterHandle, const char*, bst_ulong*,
+                                      const char**);
+extern int XGBoosterLoadModelFromBuffer(BoosterHandle, const void*,
+                                        bst_ulong);
+
+#define JNI_SIG(ret, name) \
+  JNIEXPORT ret JNICALL Java_ml_dmlc_xgboost_1tpu_java_XGBoostJNI_##name
+
+JNI_SIG(jstring, XGBGetLastError)(JNIEnv* env, jclass cls) {
+  return (*env)->NewStringUTF(env, XGBGetLastError());
+}
+
+JNI_SIG(jint, XGDMatrixCreateFromMat)(JNIEnv* env, jclass cls,
+                                      jfloatArray jdata, jlong nrow,
+                                      jlong ncol, jfloat missing,
+                                      jlongArray jout) {
+  jfloat* data = (*env)->GetFloatArrayElements(env, jdata, NULL);
+  DMatrixHandle h = NULL;
+  int rc = XGDMatrixCreateFromMat((const float*)data, (bst_ulong)nrow,
+                                  (bst_ulong)ncol, missing, &h);
+  (*env)->ReleaseFloatArrayElements(env, jdata, data, JNI_ABORT);
+  jlong out = (jlong)(intptr_t)h;
+  (*env)->SetLongArrayRegion(env, jout, 0, 1, &out);
+  return rc;
+}
+
+JNI_SIG(jint, XGDMatrixSetFloatInfo)(JNIEnv* env, jclass cls, jlong handle,
+                                     jstring jfield, jfloatArray jvec) {
+  const char* field = (*env)->GetStringUTFChars(env, jfield, NULL);
+  jfloat* vec = (*env)->GetFloatArrayElements(env, jvec, NULL);
+  jsize n = (*env)->GetArrayLength(env, jvec);
+  int rc = XGDMatrixSetFloatInfo((DMatrixHandle)(intptr_t)handle, field,
+                                 (const float*)vec, (bst_ulong)n);
+  (*env)->ReleaseFloatArrayElements(env, jvec, vec, JNI_ABORT);
+  (*env)->ReleaseStringUTFChars(env, jfield, field);
+  return rc;
+}
+
+JNI_SIG(jint, XGDMatrixSetUIntInfo)(JNIEnv* env, jclass cls, jlong handle,
+                                    jstring jfield, jintArray jvec) {
+  const char* field = (*env)->GetStringUTFChars(env, jfield, NULL);
+  jint* vec = (*env)->GetIntArrayElements(env, jvec, NULL);
+  jsize n = (*env)->GetArrayLength(env, jvec);
+  int rc = XGDMatrixSetUIntInfo((DMatrixHandle)(intptr_t)handle, field,
+                                (const unsigned*)vec, (bst_ulong)n);
+  (*env)->ReleaseIntArrayElements(env, jvec, vec, JNI_ABORT);
+  (*env)->ReleaseStringUTFChars(env, jfield, field);
+  return rc;
+}
+
+JNI_SIG(jint, XGDMatrixNumRow)(JNIEnv* env, jclass cls, jlong handle,
+                               jlongArray jout) {
+  bst_ulong n = 0;
+  int rc = XGDMatrixNumRow((DMatrixHandle)(intptr_t)handle, &n);
+  jlong out = (jlong)n;
+  (*env)->SetLongArrayRegion(env, jout, 0, 1, &out);
+  return rc;
+}
+
+JNI_SIG(jint, XGDMatrixFree)(JNIEnv* env, jclass cls, jlong handle) {
+  return XGDMatrixFree((DMatrixHandle)(intptr_t)handle);
+}
+
+JNI_SIG(jint, XGBoosterCreate)(JNIEnv* env, jclass cls, jlongArray jdmats,
+                               jlongArray jout) {
+  jsize n = (*env)->GetArrayLength(env, jdmats);
+  jlong* dm = (*env)->GetLongArrayElements(env, jdmats, NULL);
+  DMatrixHandle* arr =
+      (DMatrixHandle*)malloc((n ? n : 1) * sizeof(DMatrixHandle));
+  for (jsize i = 0; i < n; ++i) arr[i] = (DMatrixHandle)(intptr_t)dm[i];
+  BoosterHandle h = NULL;
+  int rc = XGBoosterCreate(arr, (bst_ulong)n, &h);
+  free(arr);
+  (*env)->ReleaseLongArrayElements(env, jdmats, dm, JNI_ABORT);
+  jlong out = (jlong)(intptr_t)h;
+  (*env)->SetLongArrayRegion(env, jout, 0, 1, &out);
+  return rc;
+}
+
+JNI_SIG(jint, XGBoosterFree)(JNIEnv* env, jclass cls, jlong handle) {
+  return XGBoosterFree((BoosterHandle)(intptr_t)handle);
+}
+
+JNI_SIG(jint, XGBoosterSetParam)(JNIEnv* env, jclass cls, jlong handle,
+                                 jstring jname, jstring jval) {
+  const char* name = (*env)->GetStringUTFChars(env, jname, NULL);
+  const char* val = (*env)->GetStringUTFChars(env, jval, NULL);
+  int rc = XGBoosterSetParam((BoosterHandle)(intptr_t)handle, name, val);
+  (*env)->ReleaseStringUTFChars(env, jval, val);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  return rc;
+}
+
+JNI_SIG(jint, XGBoosterUpdateOneIter)(JNIEnv* env, jclass cls, jlong handle,
+                                      jint iter, jlong dtrain) {
+  return XGBoosterUpdateOneIter((BoosterHandle)(intptr_t)handle, iter,
+                                (DMatrixHandle)(intptr_t)dtrain);
+}
+
+JNI_SIG(jint, XGBoosterEvalOneIter)(JNIEnv* env, jclass cls, jlong handle,
+                                    jint iter, jlongArray jdmats,
+                                    jobjectArray jnames,
+                                    jobjectArray jout) {
+  jsize n = (*env)->GetArrayLength(env, jdmats);
+  jlong* dm = (*env)->GetLongArrayElements(env, jdmats, NULL);
+  DMatrixHandle* arr =
+      (DMatrixHandle*)malloc((n ? n : 1) * sizeof(DMatrixHandle));
+  const char** nm = (const char**)malloc((n ? n : 1) * sizeof(char*));
+  jstring* js = (jstring*)malloc((n ? n : 1) * sizeof(jstring));
+  for (jsize i = 0; i < n; ++i) {
+    arr[i] = (DMatrixHandle)(intptr_t)dm[i];
+    js[i] = (jstring)(*env)->GetObjectArrayElement(env, jnames, i);
+    nm[i] = (*env)->GetStringUTFChars(env, js[i], NULL);
+  }
+  const char* msg = NULL;
+  int rc = XGBoosterEvalOneIter((BoosterHandle)(intptr_t)handle, iter, arr,
+                                nm, (bst_ulong)n, &msg);
+  for (jsize i = 0; i < n; ++i)
+    (*env)->ReleaseStringUTFChars(env, js[i], nm[i]);
+  free(js);
+  free(nm);
+  free(arr);
+  (*env)->ReleaseLongArrayElements(env, jdmats, dm, JNI_ABORT);
+  (*env)->SetObjectArrayElement(
+      env, jout, 0, (*env)->NewStringUTF(env, msg ? msg : ""));
+  return rc;
+}
+
+JNI_SIG(jint, XGBoosterPredict)(JNIEnv* env, jclass cls, jlong handle,
+                                jlong dmat, jint option_mask,
+                                jint ntree_limit, jobjectArray jout) {
+  bst_ulong len = 0;
+  const float* res = NULL;
+  int rc = XGBoosterPredict((BoosterHandle)(intptr_t)handle,
+                            (DMatrixHandle)(intptr_t)dmat, option_mask,
+                            (unsigned)ntree_limit, 0, &len, &res);
+  if (rc == 0) {
+    jfloatArray arr = (*env)->NewFloatArray(env, (jsize)len);
+    (*env)->SetFloatArrayRegion(env, arr, 0, (jsize)len, res);
+    (*env)->SetObjectArrayElement(env, jout, 0, arr);
+  }
+  return rc;
+}
+
+JNI_SIG(jint, XGBoosterSaveModelToBuffer)(JNIEnv* env, jclass cls,
+                                          jlong handle, jstring jformat,
+                                          jobjectArray jout) {
+  const char* format = (*env)->GetStringUTFChars(env, jformat, NULL);
+  bst_ulong len = 0;
+  const char* buf = NULL;
+  int rc = XGBoosterSaveModelToBuffer((BoosterHandle)(intptr_t)handle,
+                                      format, &len, &buf);
+  (*env)->ReleaseStringUTFChars(env, jformat, format);
+  if (rc == 0) {
+    jbyteArray arr = (*env)->NewByteArray(env, (jsize)len);
+    (*env)->SetByteArrayRegion(env, arr, 0, (jsize)len,
+                               (const jbyte*)buf);
+    (*env)->SetObjectArrayElement(env, jout, 0, arr);
+  }
+  return rc;
+}
+
+JNI_SIG(jint, XGBoosterLoadModelFromBuffer)(JNIEnv* env, jclass cls,
+                                            jlong handle, jbyteArray jbuf) {
+  jbyte* buf = (*env)->GetByteArrayElements(env, jbuf, NULL);
+  jsize n = (*env)->GetArrayLength(env, jbuf);
+  int rc = XGBoosterLoadModelFromBuffer((BoosterHandle)(intptr_t)handle,
+                                        buf, (bst_ulong)n);
+  (*env)->ReleaseByteArrayElements(env, jbuf, buf, JNI_ABORT);
+  return rc;
+}

@@ -1,0 +1,134 @@
+/* The JVM binding's exact C-ABI call sequence, driven from plain C.
+ *
+ * jvm-package/src/native/xgboost_tpu_jni.c cannot compile here (no JDK in
+ * the image), so this program pins the contract it depends on: row-major
+ * float ingest (JVM arrays need no transpose), label/weight float info,
+ * GROUP as unsigned info with a ranking objective, per-round eval,
+ * predict, and the ubj buffer round-trip used for spark checkpointing.
+ * Run by tests/test_c_api.py::test_jni_glue_sequence.
+ */
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* DMatrixHandle;
+typedef void* BoosterHandle;
+typedef uint64_t bst_ulong;
+
+extern const char* XGBGetLastError(void);
+extern int XGDMatrixCreateFromMat(const float*, bst_ulong, bst_ulong, float,
+                                  DMatrixHandle*);
+extern int XGDMatrixSetFloatInfo(DMatrixHandle, const char*, const float*,
+                                 bst_ulong);
+extern int XGDMatrixSetUIntInfo(DMatrixHandle, const char*, const unsigned*,
+                                bst_ulong);
+extern int XGDMatrixNumRow(DMatrixHandle, bst_ulong*);
+extern int XGDMatrixFree(DMatrixHandle);
+extern int XGBoosterCreate(const DMatrixHandle[], bst_ulong, BoosterHandle*);
+extern int XGBoosterFree(BoosterHandle);
+extern int XGBoosterSetParam(BoosterHandle, const char*, const char*);
+extern int XGBoosterUpdateOneIter(BoosterHandle, int, DMatrixHandle);
+extern int XGBoosterEvalOneIter(BoosterHandle, int, DMatrixHandle[],
+                                const char*[], bst_ulong, const char**);
+extern int XGBoosterPredict(BoosterHandle, DMatrixHandle, int, unsigned, int,
+                            bst_ulong*, const float**);
+extern int XGBoosterSaveModelToBuffer(BoosterHandle, const char*, bst_ulong*,
+                                      const char**);
+extern int XGBoosterLoadModelFromBuffer(BoosterHandle, const void*,
+                                        bst_ulong);
+
+#define CHECK(call)                                                   \
+  do {                                                                \
+    if ((call) != 0) {                                                \
+      fprintf(stderr, "FAILED %s: %s\n", #call, XGBGetLastError());   \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (0)
+
+enum { G = 24, DOCS = 25, F = 6, ROUNDS = 4 };
+
+int main(void) {
+  /* ranking setup: G query groups x DOCS docs, graded 0-3 relevance */
+  enum { R = G * DOCS };
+  static float data[(size_t)R * F];
+  static float label[R];
+  static float weight[R];
+  static unsigned group[G];
+  unsigned seed = 7;
+  for (int i = 0; i < R; ++i) {
+    for (int j = 0; j < F; ++j) {
+      seed = seed * 1664525u + 1013904223u;
+      data[(size_t)i * F + j] = ((float)(seed >> 8) / (1 << 24)) - 0.5f;
+    }
+    float s = data[(size_t)i * F];
+    label[i] = s > 0.25f ? 3.0f : (s > 0.0f ? 2.0f : (s > -0.25f ? 1.0f : 0.0f));
+    weight[i] = 1.0f;
+  }
+  for (int g = 0; g < G; ++g) group[g] = DOCS;
+
+  DMatrixHandle d = NULL;
+  CHECK(XGDMatrixCreateFromMat(data, R, F, NAN, &d));
+  CHECK(XGDMatrixSetFloatInfo(d, "label", label, R));
+  CHECK(XGDMatrixSetFloatInfo(d, "weight", weight, R));
+  CHECK(XGDMatrixSetUIntInfo(d, "group", group, G));
+  bst_ulong nr = 0;
+  CHECK(XGDMatrixNumRow(d, &nr));
+  if (nr != R) return 1;
+
+  BoosterHandle bst = NULL;
+  DMatrixHandle dmats[1] = {d};
+  CHECK(XGBoosterCreate(dmats, 1, &bst));
+  CHECK(XGBoosterSetParam(bst, "objective", "rank:ndcg"));
+  CHECK(XGBoosterSetParam(bst, "max_depth", "3"));
+  CHECK(XGBoosterSetParam(bst, "eta", "0.3"));
+  CHECK(XGBoosterSetParam(bst, "eval_metric", "ndcg@5"));
+
+  const char* names[1] = {"train"};
+  const char* msg = NULL;
+  double first = 0, last = 0;
+  for (int it = 0; it < ROUNDS; ++it) {
+    CHECK(XGBoosterUpdateOneIter(bst, it, d));
+    CHECK(XGBoosterEvalOneIter(bst, it, dmats, names, 1, &msg));
+    const char* p = strstr(msg, "ndcg@5:");
+    if (p == NULL) {
+      fprintf(stderr, "no ndcg@5 in eval: %s\n", msg);
+      return 1;
+    }
+    double v = atof(p + 7);
+    if (it == 0) first = v;
+    last = v;
+  }
+  if (!(last > first) && !(last > 0.99)) {
+    /* separable labels can saturate ndcg@5 at 1.0 after round one */
+    fprintf(stderr, "ndcg did not improve: %f -> %f\n", first, last);
+    return 1;
+  }
+
+  bst_ulong plen = 0;
+  const float* preds = NULL;
+  CHECK(XGBoosterPredict(bst, d, 0, 0, 0, &plen, &preds));
+  if (plen != R) return 1;
+  static float keep[R];
+  memcpy(keep, preds, sizeof(keep));
+
+  bst_ulong blen = 0;
+  const char* buf = NULL;
+  CHECK(XGBoosterSaveModelToBuffer(bst, "ubj", &blen, &buf));
+  char* copy = (char*)malloc(blen);
+  memcpy(copy, buf, blen);
+  BoosterHandle b2 = NULL;
+  CHECK(XGBoosterCreate(NULL, 0, &b2));
+  CHECK(XGBoosterLoadModelFromBuffer(b2, copy, blen));
+  free(copy);
+  CHECK(XGBoosterPredict(b2, d, 0, 0, 0, &plen, &preds));
+  for (bst_ulong i = 0; i < plen; ++i)
+    if (preds[i] != keep[i]) return 1;
+
+  CHECK(XGBoosterFree(b2));
+  CHECK(XGBoosterFree(bst));
+  CHECK(XGDMatrixFree(d));
+  printf("JNI-GLUE-SEQ-OK ndcg %.4f->%.4f\n", first, last);
+  return 0;
+}

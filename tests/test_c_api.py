@@ -870,3 +870,24 @@ def test_r_glue_sequence(tmp_path):
                          timeout=600)
     assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
     assert "R-GLUE-SEQ-OK" in out.stdout
+
+
+def test_jni_glue_sequence(tmp_path):
+    """The JVM binding's exact C-ABI call sequence
+    (jvm-package/src/native/xgboost_tpu_jni.c), driven from plain C:
+    row-major ingest, group info + rank:ndcg training with per-round eval,
+    predict, ubj buffer round-trip.  Pins the ABI contract for machines
+    without a JDK."""
+    _ensure_lib()
+    src = os.path.join(NATIVE, "jni_glue_seq.c")
+    exe = str(tmp_path / "jni_glue_seq")
+    r = subprocess.run(["gcc", src, "-L" + NATIVE, "-lxtb_capi", "-lm",
+                        "-o", exe], capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"cc unavailable: {r.stderr[-400:]}")
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(NATIVE),
+               LD_LIBRARY_PATH=NATIVE, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe], env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "JNI-GLUE-SEQ-OK" in out.stdout
