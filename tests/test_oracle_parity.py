@@ -277,3 +277,55 @@ print(json.dumps({{"pinball": pin, "coverage": float((y <= p).mean())}}))
     assert abs(pin - res["pinball"]) < 0.15 * max(pin, res["pinball"]), \
         (pin, res["pinball"])
     assert abs(cov - res["coverage"]) < 0.05, (cov, res["coverage"])
+
+
+@pytest.mark.skipif(
+    not HAVE_ORACLE, reason="oracle not built (run oracle/build_oracle.sh)")
+def test_interactions_parity(tmp_path):
+    """SHAP interaction values vs the reference oracle on the same model
+    (regression: the previous conditional-walker implementation deviated
+    from the reference's quadrature formulation by up to 0.67 per cell)."""
+    src = r"""
+import json, sys
+import numpy as np
+sys.path.insert(0, "%(oracle)s")
+import xgboost as xgb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(60, 5)).astype(np.float32)
+X[rng.random(X.shape) < 0.1] = np.nan
+bst = xgb.Booster(model_file="%(model)s")
+out = bst.predict(xgb.DMatrix(X), pred_interactions=True)
+np.save("%(out)s", out)
+"""
+    import subprocess
+    import sys as _sys
+
+    import xgboost_tpu as xtb
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(60, 5)).astype(np.float32)
+    X[rng.random(X.shape) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) * np.nan_to_num(X[:, 1])
+         + np.nan_to_num(X[:, 2]) > 0).astype(np.float32)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 4,
+                     "eta": 0.3}, xtb.DMatrix(X, label=y), 4,
+                    verbose_eval=False)
+    model = str(tmp_path / "m.json")
+    outp = str(tmp_path / "oi.npy")
+    bst.save_model(model)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [_sys.executable, "-c",
+         src % {"oracle": ORACLE_PKG, "model": model, "out": outp}],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    oracle = np.load(outp)
+
+    from xgboost_tpu.interpret import predict_interactions
+
+    for dev in (False, True):
+        ours = predict_interactions(bst, xtb.DMatrix(X), slice(None),
+                                    use_device=dev)
+        np.testing.assert_allclose(ours, oracle, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"use_device={dev}")

@@ -138,3 +138,39 @@ def test_device_shap_throughput():
     margins = bst.predict(d, output_margin=True)
     np.testing.assert_allclose(contribs.sum(1), margins, rtol=1e-3, atol=1e-3)
     assert elapsed < 120, f"device SHAP too slow: {elapsed:.1f}s"
+
+
+def test_interactions_device_matches_host(small_model):
+    """Batched device interaction kernel vs the python-loop host oracle
+    (both verified cell-exact against the reference; see
+    test_oracle_parity.py::test_interactions_parity)."""
+    bst, d, X = small_model
+    from xgboost_tpu.interpret import predict_interactions
+
+    host = predict_interactions(bst, d, slice(None), use_device=False)
+    dev = predict_interactions(bst, d, slice(None), use_device=True)
+    np.testing.assert_allclose(dev, host, rtol=1e-4, atol=1e-5)
+
+
+def test_interactions_categorical_host_path():
+    """Categorical trees fall back to the cat-aware host implementation;
+    rows must still sum to the SHAP contributions."""
+    import pandas as pd
+
+    rng = np.random.default_rng(4)
+    n = 300
+    codes = rng.integers(0, 5, n)
+    num = rng.normal(size=n).astype(np.float32)
+    y = ((codes % 2 == 0) + num * 0.5 + 0.1 * rng.normal(size=n)).astype(
+        np.float32)
+    df = pd.DataFrame({
+        "c": pd.Categorical.from_codes(codes, list("abcde")),
+        "x": num,
+    })
+    d = xtb.DMatrix(df, label=y, enable_categorical=True)
+    bst = xtb.train({"objective": "reg:squarederror", "max_depth": 3,
+                     "max_cat_to_onehot": 1}, d, 3, verbose_eval=False)
+    inter = bst.predict(d, pred_interactions=True)
+    contribs = bst.predict(d, pred_contribs=True)
+    np.testing.assert_allclose(inter.sum(axis=2), contribs,
+                               rtol=1e-4, atol=1e-5)

@@ -240,21 +240,90 @@ def predict_contribs(booster, data, tree_slice: slice, approx: bool = False) -> 
     return out[:, 0, :] if K == 1 else out
 
 
-def shap_interactions_tree(tree, X: np.ndarray) -> np.ndarray:
-    """(R, F+1, F+1) interaction values via the off/on conditional trick
-    (Lundberg 2018 §4; reference: PredictInteractionContributions)."""
-    R, F = X.shape
+def _leaf_paths_host(tree):
+    """Per-leaf path tables with NODE IDS kept, so the per-row decision can
+    go through the categorical-aware _go_left (unlike the device tables,
+    which inline numeric thresholds only)."""
     t = _tree_arrays(tree)
-    used = np.unique(tree.split_indices[tree.left_children >= 0])
+    cover = np.maximum(t["cover"].astype(np.float64), 1e-16)
+    out = []
+
+    def rec(node, nodes):
+        if t["left"][node] < 0:
+            slots = {}
+            z = []
+            entries = []  # (node_id, went_left, slot)
+            for nid, go_left in nodes:
+                f = int(t["feat"][nid])
+                child = t["left"][nid] if go_left else t["right"][nid]
+                frac = cover[child] / cover[nid]
+                if f not in slots:
+                    slots[f] = len(z)
+                    z.append(frac)
+                else:
+                    z[slots[f]] *= frac
+                entries.append((nid, go_left, slots[f]))
+            out.append(dict(entries=entries, z=np.asarray(z),
+                            slot_feat=np.asarray(
+                                sorted(slots, key=slots.get), np.int64),
+                            v=float(t["value"][node])))
+            return
+        rec(int(t["left"][node]), nodes + [(node, True)])
+        rec(int(t["right"][node]), nodes + [(node, False)])
+
+    if t["left"][0] >= 0:
+        rec(0, [])
+    return t, out
+
+
+def shap_interactions_tree(tree, X: np.ndarray) -> np.ndarray:
+    """(R, F+1, F+1) interaction values — per-path pair formula verified
+    cell-exact against the reference oracle (quadrature formulation,
+    src/predictor/interpretability/shap.cc ExtractQuadratureInteractionDelta):
+
+        phi_ij += v/2 * (o_i - z_i)(o_j - z_j)
+                  * sum_k k!(m-2-k)!/(m-1)! * e_k^{(-i,-j)}
+
+    per ordered slot pair (i, j) of each leaf path (no symmetric add — the
+    ordered loop covers both orientations); diagonals are the SHAP values
+    minus the off-diagonal row sums; the bias row/column stay empty except
+    [F, F] (the reference's convention).  This python-loop version is the
+    cat-aware oracle for the batched device kernel
+    (interpret/device.py shap_interactions_device)."""
+    import math as _math
+
+    R, F = X.shape
+    t, paths = _leaf_paths_host(tree)
     out = np.zeros((R, F + 1, F + 1), np.float64)
-    base = shap_values_tree(tree, X)  # unconditional
-    for f in used:
-        on = _conditional_shap(tree, X, int(f), True)
-        off = _conditional_shap(tree, X, int(f), False)
-        diff = (on - off) / 2.0  # (R, F+1)
-        for r in range(R):
-            out[r, f, :] += diff[r]
-            out[r, :, f] += diff[r]
+    base = shap_values_tree(tree, X)
+    for r in range(R):
+        x = X[r]
+        for p in paths:
+            m = len(p["z"])
+            if m < 2:
+                continue
+            o = np.ones(m)
+            for nid, went_left, slot in p["entries"]:
+                if _go_left(t, nid, x[t["feat"][nid]]) != went_left:
+                    o[slot] = 0.0
+            z = p["z"]
+            sf = p["slot_feat"]
+            omz = o - z
+            for i in range(m):
+                for j in range(i + 1, m):
+                    # elementary-symmetric coeffs excluding slots i, j
+                    c = [1.0] + [0.0] * (m - 2)
+                    for e in range(m):
+                        if e in (i, j):
+                            continue
+                        c = [c[k] * z[e] + (c[k - 1] * o[e] if k else 0.0)
+                             for k in range(m - 1)]
+                    W = sum(_math.factorial(k) * _math.factorial(m - 2 - k)
+                            / _math.factorial(m - 1) * c[k]
+                            for k in range(m - 1))
+                    term = 0.5 * p["v"] * omz[i] * omz[j] * W
+                    out[r, sf[i], sf[j]] += term
+                    out[r, sf[j], sf[i]] += term
     # main effects on the diagonal: phi_i - sum_j!=i interactions
     for r in range(R):
         for f in range(F + 1):
@@ -262,77 +331,34 @@ def shap_interactions_tree(tree, X: np.ndarray) -> np.ndarray:
     return out
 
 
-def _conditional_shap(tree, X, cond_f: int, cond_on: bool) -> np.ndarray:
-    """SHAP values conditioned on feature cond_f being present/absent —
-    computed by rerouting the tree walk at nodes splitting on cond_f."""
-    R, F = X.shape
-    t = _tree_arrays(tree)
-    out = np.zeros((R, F + 1), np.float64)
-    maxd = tree.max_depth + 2
-    for r in range(R):
-        phi = np.zeros(F + 1, np.float64)
-        _cond_recurse(t, X[r], phi, 0, _Path(maxd + 1), 0, 1.0, 1.0, -1, cond_f, cond_on, 1.0)
-        out[r] = phi
-    return out
-
-
-def _cond_recurse(t, x, phi, node, p, length, pz, po, pi, cond_f, cond_on, cond_w):
-    left = t["left"][node]
-    if left >= 0 and t["feat"][node] == cond_f:
-        f = cond_f
-        xv = x[f]
-        go_left = _go_left(t, node, xv)
-        hot = left if go_left else t["right"][node]
-        cold = t["right"][node] if go_left else left
-        cover = t["cover"]
-        if cond_on:
-            _cond_recurse(t, x, phi, hot, p, length, pz, po, pi, cond_f, cond_on, cond_w)
-        else:
-            rj = cover[node]
-            _cond_recurse(t, x, phi, hot, p, length, pz * cover[hot] / rj, po, pi,
-                          cond_f, cond_on, cond_w * cover[hot] / rj)
-            _cond_recurse(t, x, phi, cold, p, length, pz * cover[cold] / rj, po, pi,
-                          cond_f, cond_on, cond_w * cover[cold] / rj)
-        return
-    p2 = p.copy(length)
-    l2 = _extend(p2, length, pz, po, pi)
-    if left < 0:
-        v = t["value"][node]
-        for i in range(1, l2):
-            w = _unwound_sum(p2, l2, i)
-            phi[p2.feat[i]] += w * (p2.one[i] - p2.zero[i]) * v
-        return
-    f = t["feat"][node]
-    xv = x[f]
-    go_left = _go_left(t, node, xv)
-    hot = left if go_left else t["right"][node]
-    cold = t["right"][node] if go_left else left
-    cover = t["cover"]
-    rj = cover[node]
-    iz = io = 1.0
-    k = -1
-    for i in range(1, l2):
-        if p2.feat[i] == f:
-            k = i
-            break
-    if k >= 0:
-        iz, io = p2.zero[k], p2.one[k]
-        l2 = _unwind(p2, l2, k)
-    _cond_recurse(t, x, phi, hot, p2, l2, iz * cover[hot] / rj, io, f, cond_f, cond_on, cond_w)
-    _cond_recurse(t, x, phi, cold, p2, l2, iz * cover[cold] / rj, 0.0, f, cond_f, cond_on, cond_w)
-
-
-def predict_interactions(booster, data, tree_slice: slice) -> np.ndarray:
-    X = booster._host_dense_recoded(data).astype(np.float64)
+def predict_interactions(booster, data, tree_slice: slice,
+                         use_device=None) -> np.ndarray:
+    X = booster._host_dense_recoded(data)
     R, F = X.shape
     K = booster.n_groups
     out = np.zeros((R, K, F + 1, F + 1), np.float64)
     wts = (booster.tree_weights[tree_slice]
            if getattr(booster, "tree_weights", None) else None)
-    for i, (tree, grp) in enumerate(
-            zip(booster.trees[tree_slice], booster.tree_info[tree_slice])):
-        w = wts[i] if wts else 1.0
-        out[:, grp] += w * shap_interactions_tree(tree, X)
+    trees = booster.trees[tree_slice]
+    infos = booster.tree_info[tree_slice]
+    ws = [wts[i] if wts else 1.0 for i in range(len(trees))]
+
+    from .device import device_shap_supported, shap_interactions_device
+
+    # batched device kernel for non-categorical scalar ensembles at size
+    # (the python recursion is the oracle; reference: shap.cu interactions)
+    if use_device is None:
+        use_device = device_shap_supported(trees) and R >= 128
+    if use_device and device_shap_supported(trees):
+        for grp in range(K):
+            tg = [t for t, g in zip(trees, infos) if g == grp]
+            wg = [w for w, g in zip(ws, infos) if g == grp]
+            if tg:
+                out[:, grp] += shap_interactions_device(tg, wg, X)
+    else:
+        X = X.astype(np.float64)  # the host walkers run in f64
+        for tree, grp, w in zip(trees, infos, ws):
+            out[:, grp] += w * shap_interactions_tree(tree, X)
     base = np.asarray(booster.base_score).reshape(-1)
     out[:, :, F, F] += base[None, :K]
     return out[:, 0] if K == 1 else out

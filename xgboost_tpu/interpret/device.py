@@ -204,3 +204,116 @@ def shap_values_device(trees, tree_weights, X: np.ndarray,
 def device_shap_supported(trees) -> bool:
     """Device path covers scalar-leaf, non-categorical ensembles."""
     return all(not t.has_categorical and t.leaf_vector is None for t in trees)
+
+
+@functools.partial(jax.jit, static_argnames=("m", "n_feat"))
+def _bucket_interactions(X, node_feat, node_thr, node_dleft, node_dir,
+                         node_slot, z, slot_feat, v, wk1, *, m: int,
+                         n_feat: int):
+    """(R, F+1, F+1) off-diagonal interaction contributions of one bucket.
+
+    The pairwise form of the conditional trick (Lundberg 2018 §4;
+    reference: PredictInteractionContributions -> this repo's host
+    shap_interactions_tree): only paths CONTAINING the conditioning
+    feature contribute to (shap|on - shap|off), and per path the
+    contribution for the ordered slot pair (s, j) is
+
+        term(s, j) = v/2 * (o_s - z_s) * (o_j - z_j)
+                     * sum_k wk_{m-1}[k] * c_k^{(-s, -j)}
+
+    with c^{(-s,-j)} the elementary-symmetric coefficients excluding both
+    slots.  term is symmetric in (s, j), so each unordered pair is built
+    once and scattered into both [f_s, f_j] and [f_j, f_s]; the bias
+    row/column stay empty except the diagonal (the reference's
+    convention, verified cell-exact against the oracle).
+
+    wk1: (m-1,) = k!(m-2-k)!/(m-1)! — the m-1-element Shapley weights.
+    """
+    R = X.shape[0]
+    P, D = node_feat.shape
+
+    xv = X[:, node_feat.reshape(-1)].reshape(R, P, D)
+    gol = jnp.where(jnp.isnan(xv), node_dleft[None], xv < node_thr[None])
+    ok = gol == node_dir[None]
+    bad = jnp.zeros((R, P, m), bool)
+    pidx = jnp.arange(P)[None, :, None]
+    ridx = jnp.arange(R)[:, None, None]
+    bad = bad.at[ridx, pidx, node_slot[None]].max(~ok)
+    o = (~bad).astype(jnp.float32)  # (R, P, m)
+    zf = jnp.broadcast_to(z[None], o.shape)  # (R, P, m)
+    omz = o - zf
+
+    out = jnp.zeros((R, n_feat + 1, n_feat + 1), jnp.float32)
+    for s in range(m):
+        for j in range(s + 1, m):
+            # poly over the other m-2 elements, f32, unrolled
+            c = [jnp.ones((R, P))] + [jnp.zeros((R, P))] * max(m - 2, 0)
+            for e in range(m):
+                if e == s or e == j:
+                    continue
+                ze = zf[..., e]
+                oe = o[..., e]
+                nc = []
+                for k in range(m - 1):
+                    term = c[k] * ze
+                    if k > 0:
+                        term = term + c[k - 1] * oe
+                    nc.append(term)
+                c = nc
+            W = sum(wk1[k] * c[k] for k in range(m - 1))
+            term = 0.5 * v[None] * omz[..., s] * omz[..., j] * W
+            # one build per unordered pair (term is s<->j symmetric);
+            # scatter covers both orientations
+            out = out.at[:, slot_feat[:, s], slot_feat[:, j]].add(term)
+            out = out.at[:, slot_feat[:, j], slot_feat[:, s]].add(term)
+    return out
+
+
+def shap_interactions_device(trees, tree_weights, X: np.ndarray,
+                             budget_elems: int = 1 << 22) -> np.ndarray:
+    """(R, F+1, F+1) summed exact SHAP interactions of scalar,
+    non-categorical trees — the batched-device analogue of the reference's
+    GPU PredictInteractionContributions (shap.cu interactions path).
+
+    Off-diagonals come from the pairwise kernel; diagonals are fixed up
+    with the device SHAP values: phi_ff = phi_f - sum_{j != f} phi_fj.
+    """
+    R, F = X.shape
+    out = np.zeros((R, F + 1, F + 1), np.float64)
+
+    merged: Dict[Tuple[int, int], List[dict]] = {}
+    for tree, w in zip(trees, tree_weights):
+        for key, b in _bucket_paths(_leaf_paths(tree), w).items():
+            merged.setdefault(key, []).append(b)
+
+    for (m, D), parts in sorted(merged.items()):
+        if m < 2:
+            continue  # single-feature paths have no pairs
+        b = {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+        P = b["v"].shape[0]
+        m1 = m - 1
+        wk1 = np.asarray(
+            [math.factorial(k) * math.factorial(m1 - 1 - k)
+             / math.factorial(m1) for k in range(m1)], np.float32)
+        args = tuple(jnp.asarray(b[k]) for k in
+                     ("node_feat", "node_thr", "node_dleft", "node_dir",
+                      "node_slot", "z", "slot_feat", "v"))
+        # m^2 pair terms per element: budget accordingly
+        row_chunk = int(min(R, max(64, budget_elems // max(P * m * m, 1))))
+        for lo in range(0, R, row_chunk):
+            hi = min(lo + row_chunk, R)
+            chunk = X[lo:hi]
+            if hi - lo < row_chunk:
+                chunk = np.pad(chunk, ((0, row_chunk - (hi - lo)), (0, 0)),
+                               constant_values=np.nan)
+            contrib = _bucket_interactions(
+                jnp.asarray(chunk, jnp.float32), *args, jnp.asarray(wk1),
+                m=m, n_feat=F)
+            out[lo:hi] += np.asarray(contrib, np.float64)[: hi - lo]
+
+    # diagonal: phi_f minus the off-diagonal row sum (host convention)
+    phi = shap_values_device(trees, tree_weights, X)
+    for f in range(F + 1):
+        row_sum = out[:, f, :].sum(axis=1) - out[:, f, f]
+        out[:, f, f] = phi[:, f] - row_sum
+    return out
