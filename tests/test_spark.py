@@ -1,0 +1,143 @@
+"""PySpark frontend protocol (xgboost_tpu/spark.py) without pyspark.
+
+The estimator's partition training body is the dask worker's (shared code
+path tested end-to-end with real subprocess workers in tests/test_dask.py);
+here we drive the spark-specific pieces — row marshaling, the barrier
+mapPartitions body, and parameter plumbing — through the same
+subprocess-pair harness, plus the clean gating error without pyspark.
+Reference pattern: tests/test_distributed/test_with_spark/test_spark_local.py.
+"""
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.spark import (SparkXGBClassifier, SparkXGBRanker,
+                               _partition_train_fn, _rows_to_parts)
+
+
+def _rows(X, y, qid=None):
+    # plain dicts: picklable into the worker subprocesses without this
+    # test module on their path (pyspark Rows support the same [] access)
+    out = []
+    for i in range(len(y)):
+        r = {"features": X[i], "label": float(y[i])}
+        if qid is not None:
+            r["qid"] = int(qid[i])
+        out.append(r)
+    return out
+
+
+def test_rows_to_parts_marshaling():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(30, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    qid = np.repeat([0, 1, 2], 10)
+    part = _rows_to_parts(_rows(X, y, qid), "features", "label", None, "qid")
+    np.testing.assert_array_equal(part["data"], X)
+    np.testing.assert_array_equal(part["label"], y)
+    np.testing.assert_array_equal(part["group"], [10, 10, 10])
+
+    with pytest.raises(ValueError, match="empty partition"):
+        _rows_to_parts([], "features", "label", None, None)
+    with pytest.raises(ValueError, match="sorted"):
+        _rows_to_parts(_rows(X, y, qid[::-1]), "features", "label", None,
+                       "qid")
+
+
+def test_estimator_param_plumbing():
+    clf = SparkXGBClassifier(num_workers=2, max_depth=4, eta=0.3)
+    p = clf._train_params()
+    assert p["objective"] == "binary:logistic" and p["max_depth"] == 4
+    with pytest.raises(ValueError, match="qid_col"):
+        SparkXGBRanker(num_workers=1)
+    with pytest.raises(ValueError, match="num_workers"):
+        SparkXGBClassifier(num_workers=0)
+
+
+_RUNNER = r"""
+import pickle, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+path = sys.argv[1]
+with open(path, "rb") as fh:
+    fn_path, args, rows = pickle.load(fh)
+import importlib
+mod = importlib.import_module("xgboost_tpu.spark")
+fn = mod._partition_train_fn(*args)
+out = list(fn(rows))
+with open(path + ".out", "wb") as fh:
+    pickle.dump(out, fh)
+"""
+
+
+@pytest.mark.slow
+def test_barrier_partition_fn_two_workers():
+    """The mapPartitions body run as two real processes rendezvousing
+    through a real tracker: rank 0 yields the model, rank 1 yields
+    nothing, and the model has learned."""
+    from xgboost_tpu.tracker import RabitTracker
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 6)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+
+    tracker = RabitTracker(n_workers=2, host_ip="127.0.0.1")
+    tracker.start()
+    targs = tracker.worker_args()
+    spec = {"eval_train": False, "verbose_eval": False, "train_kwargs": {},
+            "dmatrix_kw": {}}
+    fnargs = (str(targs["dmlc_tracker_uri"]),
+              int(targs["dmlc_tracker_port"]), 2,
+              {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+               "max_bin": 32}, 3, spec, "features", "label", None, None)
+
+    tmp = tempfile.mkdtemp(prefix="xtb_spark_")
+    procs = []
+    for rank in range(2):
+        rows = _rows(X[rank::2], y[rank::2])
+        path = os.path.join(tmp, f"p{rank}.pkl")
+        with open(path, "wb") as fh:
+            pickle.dump((None, fnargs, rows), fh)
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        log = open(path + ".log", "w")
+        procs.append((subprocess.Popen(
+            [sys.executable, "-c", _RUNNER, path], stdout=log,
+            stderr=subprocess.STDOUT, env=env), path))
+    outs = []
+    for p, path in procs:
+        p.wait(timeout=600)
+        assert p.returncode == 0, open(path + ".log").read()[-3000:]
+        with open(path + ".out", "rb") as fh:
+            outs.append(pickle.load(fh))
+    tracker.free()
+
+    models = [o for o in outs if o]
+    assert len(models) == 1  # exactly rank 0 yields
+    out = models[0][0]
+    assert "history" in out and "best_iteration" in out
+    bst = xtb.Booster()
+    bst.load_model(bytearray(out["raw"]))
+    preds = bst.predict(xtb.DMatrix(X))
+    assert np.mean((preds > 0.5) != y) < 0.1
+
+
+def test_missing_pyspark_is_clean():
+    try:
+        import pyspark  # noqa: F401
+
+        pytest.skip("pyspark installed")
+    except ImportError:
+        pass
+    clf = SparkXGBClassifier(num_workers=1)
+    with pytest.raises(ImportError, match="pyspark"):
+        clf.fit(None)

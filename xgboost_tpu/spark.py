@@ -1,0 +1,270 @@
+"""PySpark estimator frontend — distributed training on a Spark cluster.
+
+Reference shape: python-package/xgboost/spark/ — ``SparkXGBClassifier`` /
+``SparkXGBRegressor`` / ``SparkXGBRanker`` estimators (estimator.py:80,249,
+437) whose ``_fit`` (core.py:1000) repartitions the DataFrame to
+``num_workers``, starts a tracker, runs one barrier-mode training task per
+partition under a ``CommunicatorContext`` built from the tracker's args,
+and returns rank 0's booster wrapped in a pyspark Model whose
+``transform`` maps prediction over partitions.
+
+The TPU port keeps that choreography and swaps the engine (tracker
+rendezvous -> jax.distributed; distributed sketch; histogram allreduce
+over the host collective; chip-level GSPMD per worker via ``n_devices``).
+The partition-level training body is SHARED with the dask frontend
+(:func:`xgboost_tpu.dask._dask_worker_train`), so the protocol tested
+there (tests/test_dask.py, real subprocess workers + tracker) covers this
+module's core; the pyspark-facing adapter below needs a live Spark
+cluster and is gated on the import.
+
+Usage (with pyspark installed)::
+
+    from xgboost_tpu.spark import SparkXGBClassifier
+    clf = SparkXGBClassifier(features_col="features", label_col="label",
+                             num_workers=4, max_depth=6)
+    model = clf.fit(df)
+    pred_df = model.transform(df)
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .core import Booster
+
+__all__ = ["SparkXGBRegressor", "SparkXGBClassifier", "SparkXGBRanker"]
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+
+        return pyspark
+    except ImportError as e:  # pragma: no cover - exercised only sans spark
+        raise ImportError(
+            "xgboost_tpu.spark needs pyspark. The estimator layer is a thin "
+            "adapter over the tested distributed core (xgboost_tpu.dask / "
+            "distributed.py); install pyspark to use it, or call "
+            "train_distributed / dask.train directly.") from e
+
+
+def _rows_to_parts(rows, features_col: str, label_col: str,
+                   weight_col: Optional[str], qid_col: Optional[str]):
+    """Worker-local: partition rows -> the dict part _dask_worker_train
+    consumes (data/label/weight[/group])."""
+    feats: List[np.ndarray] = []
+    labels: List[float] = []
+    weights: List[float] = []
+    qids: List[int] = []
+    for row in rows:
+        v = row[features_col]
+        # pyspark ml Vector or array column
+        arr = np.asarray(v.toArray() if hasattr(v, "toArray") else v,
+                         np.float32)
+        feats.append(arr)
+        labels.append(float(row[label_col]))
+        if weight_col is not None:
+            weights.append(float(row[weight_col]))
+        if qid_col is not None:
+            qids.append(int(row[qid_col]))
+    if not feats:
+        raise ValueError(
+            "empty partition: repartition the DataFrame so every worker "
+            "holds rows (the reference has the same requirement)")
+    part: Dict[str, Any] = {
+        "data": np.stack(feats),
+        "label": np.asarray(labels, np.float32),
+    }
+    if weight_col is not None:
+        part["weight"] = np.asarray(weights, np.float32)
+    if qid_col is not None:
+        q = np.asarray(qids, np.int64)
+        if not (np.diff(q) >= 0).all():
+            raise ValueError("qid column must be sorted within partitions")
+        _, counts = np.unique(q, return_counts=True)
+        part["group"] = counts
+    return part
+
+
+def _partition_train_fn(tracker_uri: str, tracker_port: int, world: int,
+                        params: Dict[str, Any], num_boost_round: int,
+                        spec: Dict[str, Any], features_col: str,
+                        label_col: str, weight_col: Optional[str],
+                        qid_col: Optional[str]):
+    """Returns the barrier-mode mapPartitions body (core.py:1039 role).
+    Module-level for picklability; the training choreography is the dask
+    worker's (shared code path -> shared test coverage)."""
+
+    def fn(rows):
+        from .dask import _dask_worker_train
+
+        part = _rows_to_parts(rows, features_col, label_col, weight_col,
+                              qid_col)
+        out = _dask_worker_train(tracker_uri, tracker_port, world, params,
+                                 num_boost_round, spec, [part])
+        # only rank 0 yields the serialized model (full result dict, so
+        # best_iteration survives like the dask path)
+        if out is not None:
+            out = dict(out)
+            out["raw"] = bytearray(out["raw"])
+            yield out
+
+    return fn
+
+
+class _SparkXGBEstimator:
+    """pyspark.ml Estimator shape (reference: core.py _SparkXGBEstimator).
+
+    Construction and parameter handling are pure python (usable and
+    testable without pyspark); ``fit`` needs a live SparkSession.
+    """
+
+    _objective = "reg:squarederror"
+
+    def __init__(self, *, features_col: str = "features",
+                 label_col: str = "label", prediction_col: str = "prediction",
+                 weight_col: Optional[str] = None,
+                 qid_col: Optional[str] = None, num_workers: int = 1,
+                 num_boost_round: int = 100, **xgb_params: Any) -> None:
+        self.features_col = features_col
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+        self.weight_col = weight_col
+        self.qid_col = qid_col
+        self.num_workers = int(num_workers)
+        self.num_boost_round = int(num_boost_round)
+        self.xgb_params = dict(xgb_params)
+        if self.num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+
+    def _train_params(self) -> Dict[str, Any]:
+        p = dict(self.xgb_params)
+        p.setdefault("objective", self._objective)
+        return p
+
+    def fit(self, dataset):
+        _require_pyspark()
+        from .tracker import RabitTracker, get_host_ip
+
+        world = self.num_workers
+        if self.qid_col is not None:
+            # ranking: a query group must live whole inside one partition
+            # and arrive sorted (the reference repartitions/sorts by qid
+            # unless allow_group_split; spark/core.py _prepare_input)
+            df = (dataset.repartition(world, dataset[self.qid_col])
+                  .sortWithinPartitions(self.qid_col))
+        else:
+            df = dataset.repartition(world)
+        tracker = RabitTracker(n_workers=world, host_ip=get_host_ip("auto"))
+        tracker.start()
+        args = tracker.worker_args()
+        spec = {"eval_train": False, "verbose_eval": False,
+                "train_kwargs": {}, "dmatrix_kw": {}}
+        fn = _partition_train_fn(
+            str(args["dmlc_tracker_uri"]), int(args["dmlc_tracker_port"]),
+            world, self._train_params(), self.num_boost_round, spec,
+            self.features_col, self.label_col, self.weight_col, self.qid_col)
+        try:
+            # barrier mode: all partitions must schedule together or the
+            # tracker rendezvous deadlocks (reference: core.py:1131)
+            results = df.rdd.barrier().mapPartitions(fn).collect()
+        finally:
+            tracker.free()
+        if not results:
+            raise RuntimeError("no worker returned a model (rank 0 missing)")
+        out = results[0]
+        bst = Booster(params=self._train_params())
+        bst.load_model(bytearray(out["raw"]))
+        if out.get("best_iteration") is not None:
+            bst.best_iteration = out["best_iteration"]
+        return self._make_model(bst, out["history"])
+
+    def _make_model(self, booster: Booster, history) -> "_SparkXGBModel":
+        return _SparkXGBModel(booster, history, self)
+
+
+class _SparkXGBModel:
+    """pyspark.ml Model shape: ``transform`` adds the prediction column by
+    partition-parallel inference (core.py _SparkXGBModel.transform)."""
+
+    def __init__(self, booster: Booster, history, est: _SparkXGBEstimator):
+        self.booster = booster
+        self.training_history = history
+        self._est = est
+
+    def get_booster(self) -> Booster:
+        return self.booster
+
+    @staticmethod
+    def _postprocess(preds: np.ndarray) -> np.ndarray:
+        """Raw model output -> the prediction column (regressor:
+        identity; classifier override emits class labels)."""
+        return preds
+
+    def transform(self, dataset):
+        _require_pyspark()
+        from pyspark.sql.functions import pandas_udf
+
+        raw = bytes(self.booster.save_raw())
+        features_col = self._est.features_col
+        post = type(self)._postprocess
+
+        @pandas_udf("double")
+        def _predict(col):
+            import pandas as pd
+
+            import xgboost_tpu as xtb
+
+            # per-process booster cache: pandas_udf fires once per Arrow
+            # batch, and re-parsing the model each batch would dominate
+            # large scoring jobs (reference uses an executor-cached model)
+            b = getattr(_predict, "_bst", None)
+            if b is None:
+                b = Booster()
+                b.load_model(bytearray(raw))
+                _predict._bst = b
+            X = np.stack([np.asarray(
+                v.toArray() if hasattr(v, "toArray") else v, np.float32)
+                for v in col])
+            out = post(np.asarray(b.predict(xtb.DMatrix(X))))
+            return pd.Series(np.asarray(out, np.float64))
+
+        return dataset.withColumn(self._est.prediction_col,
+                                  _predict(dataset[features_col]))
+
+
+class SparkXGBRegressor(_SparkXGBEstimator):
+    """reference: estimator.py:80."""
+
+    _objective = "reg:squarederror"
+
+
+class _SparkXGBClassifierModel(_SparkXGBModel):
+    @staticmethod
+    def _postprocess(preds: np.ndarray) -> np.ndarray:
+        # class labels like the reference model (probabilities stay
+        # reachable via get_booster().predict)
+        if preds.ndim == 2:  # multi:softprob
+            return np.argmax(preds, axis=1).astype(np.float64)
+        return (preds > 0.5).astype(np.float64)
+
+
+class SparkXGBClassifier(_SparkXGBEstimator):
+    """reference: estimator.py:249."""
+
+    _objective = "binary:logistic"
+
+    def _make_model(self, booster, history):
+        return _SparkXGBClassifierModel(booster, history, self)
+
+
+class SparkXGBRanker(_SparkXGBEstimator):
+    """reference: estimator.py:437 (requires qid_col)."""
+
+    _objective = "rank:ndcg"
+
+    def __init__(self, **kw) -> None:
+        super().__init__(**kw)
+        if self.qid_col is None:
+            raise ValueError("SparkXGBRanker requires qid_col")
