@@ -229,3 +229,32 @@ def test_feature_weights_bias_column_sampling():
         bst._feature_masks(0, 0, F, np.ones(F - 1))
     with pytest.raises(ValueError):
         bst._feature_masks(0, 0, F, -np.ones(F))
+
+
+def test_device_sketch_path_covered(monkeypatch):
+    """The accelerator sketch path (device sort + stride subsample) must
+    stay CI-covered on the CPU backend via the force flag, and agree with
+    the exact host grid within the subsample tolerance."""
+    import os
+
+    from xgboost_tpu.data.quantile import sketch_dense
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(3000, 4)).astype(np.float32)
+    X[rng.random(X.shape) < 0.05] = np.nan
+
+    host = sketch_dense(X, 32, use_device=True)  # CPU -> exact host grid
+    monkeypatch.setenv("XTB_FORCE_DEVICE_SKETCH", "1")
+    dev = sketch_dense(X, 32, use_device=True)   # forced device code path
+    np.testing.assert_allclose(np.asarray(dev.cut_values),
+                               np.asarray(host.cut_values),
+                               rtol=1e-5, atol=1e-6)
+
+    # subsampled regime (R > 2**19): quantiles stay close, extremes exact
+    Xl = rng.normal(size=(1 << 19 | 4096, 2)).astype(np.float32)
+    host_l = sketch_dense(Xl, 16, use_device=False)
+    dev_l = sketch_dense(Xl, 16, use_device=True)
+    hv = np.asarray(host_l.cut_values).reshape(2, -1)
+    dv = np.asarray(dev_l.cut_values).reshape(2, -1)
+    assert np.max(np.abs(hv - dv)) < 0.05  # ~1/sqrt(2**19) quantile noise
+    np.testing.assert_allclose(hv[:, -1], dv[:, -1], rtol=1e-6)  # max exact
