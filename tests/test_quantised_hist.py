@@ -155,3 +155,98 @@ def test_quantised_unsupported_combinations_raise():
         xtb.train({"deterministic_histogram": True, "grow_policy": "lossguide",
                    "max_leaves": 8, "max_depth": 0,
                    "objective": "binary:logistic"}, d, 1, verbose_eval=False)
+
+
+def test_quantised_extmem_bitwise_across_device_counts(eight_devices):
+    """External-memory streaming x deterministic_histogram: page-order,
+    chip-count, and process-count all reduce in exact integers, so extmem
+    training is bit-identical across topologies too."""
+    from xgboost_tpu.data.extmem import DataIter, ExtMemQuantileDMatrix
+
+    X, y = _data(n=4096)
+
+    class Pages(DataIter):
+        def __init__(self):
+            super().__init__()
+            self._i = 0
+
+        def next(self, input_data):
+            if self._i >= 4:
+                return 0
+            lo = self._i * 1024
+            input_data(data=X[lo:lo + 1024], label=y[lo:lo + 1024])
+            self._i += 1
+            return 1
+
+        def reset(self):
+            self._i = 0
+
+    def run(nd):
+        d = ExtMemQuantileDMatrix(Pages(), max_bin=32)
+        bst = xtb.train({"objective": "binary:logistic", "max_depth": 4,
+                         "eta": 0.3, "max_bin": 32, "n_devices": nd,
+                         "deterministic_histogram": True}, d, 3,
+                        verbose_eval=False)
+        return _dump_hash(bst)
+
+    assert run(1) == run(8)
+
+
+def test_quantised_extmem_process_times_chip(eight_devices):
+    """Extmem streaming under 2 fake processes x chips: the distributed
+    quantised branches (rho MAX allreduce, per-level limb allreduce,
+    quantised root) must keep topologies bit-identical, mirroring the
+    in-memory composed test."""
+    from xgboost_tpu.data.extmem import DataIter, ExtMemQuantileDMatrix
+
+    X, y = _data(n=4096)
+    results, errors = {}, {}
+
+    def make_iter(Xs, ys):
+        class Pages(DataIter):
+            def __init__(self):
+                super().__init__()
+                self._i = 0
+
+            def next(self, input_data):
+                if self._i >= 2:
+                    return 0
+                lo = self._i * (len(ys) // 2)
+                hi = lo + len(ys) // 2
+                input_data(data=Xs[lo:hi], label=ys[lo:hi])
+                self._i += 1
+                return 1
+
+            def reset(self):
+                self._i = 0
+
+        return Pages()
+
+    def worker(rank, nd, tag):
+        try:
+            with collective.CommunicatorContext(
+                    dmlc_communicator="in-memory", in_memory_world_size=2,
+                    in_memory_rank=rank, in_memory_group=f"qext-{tag}"):
+                d = ExtMemQuantileDMatrix(make_iter(X[rank::2], y[rank::2]),
+                                          max_bin=32)
+                bst = xtb.train({"objective": "binary:logistic",
+                                 "max_depth": 3, "eta": 0.3, "max_bin": 32,
+                                 "n_devices": nd,
+                                 "deterministic_histogram": True}, d, 2,
+                                verbose_eval=False)
+                results[(tag, rank)] = _dump_hash(bst)
+        except Exception as e:  # noqa: BLE001
+            errors[(tag, rank)] = e
+
+    for tag, nd in (("mesh", 4), ("flat", 1)):
+        ts = [threading.Thread(target=worker, args=(r, nd, tag))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=600)
+        assert not any(t.is_alive() for t in ts), "worker deadlocked"
+    assert not errors, errors
+    assert results[("mesh", 0)] == results[("mesh", 1)]
+    assert results[("flat", 0)] == results[("flat", 1)]
+    assert results[("mesh", 0)] == results[("flat", 0)]

@@ -668,6 +668,7 @@ class Booster:
             # against compute so the prefetch-overlap gain is measurable
             prefetch=str(self.params.get("_extmem_prefetch", "1")).lower()
             in ("1", "true"),
+            quantised=self.deterministic_histogram,
         )
         K = gpair.shape[1]
         new_margin = cache.margin
@@ -1267,10 +1268,6 @@ class Booster:
         import jax.numpy as jnp
 
         if cache.is_extmem:
-            if self.deterministic_histogram:
-                raise NotImplementedError(
-                    "deterministic_histogram is not supported with "
-                    "external-memory training yet")
             if self.tree_method == "exact":
                 raise NotImplementedError(
                     "tree_method='exact' needs raw in-memory values; it is "
@@ -1279,11 +1276,10 @@ class Booster:
             if self.booster_kind == "dart":
                 raise ValueError("booster='dart' is not supported with "
                                  "ExtMemQuantileDMatrix yet")
-            if self._process_parallel() and self._get_mesh() is not None:
-                raise NotImplementedError(
-                    "n_devices > 1 within a process is not combined with "
-                    "multi-process external-memory training yet; give each "
-                    "process one device")
+            # process-DP x chip-DP composes here too: pages GSPMD-shard
+            # over the local mesh inside _page_step and the level histogram
+            # crosses processes via the host allreduce (the same layering
+            # as ProcessHistTreeGrower; exact under deterministic_histogram)
             return self._boost_trees_extmem(cache, gpair, iteration)
         exact = self.tree_method == "exact"
         if exact and self.deterministic_histogram:

@@ -52,6 +52,14 @@ class _LambdaRankBase(ObjFunction):
                                        np_default))
         self.group_norm = str(params.get("lambdarank_normalization",
                                          "1")).lower() in ("1", "true")
+        if str(params.get("lambdarank_unbiased", "0")).lower() in ("1",
+                                                                   "true"):
+            # position-bias EM debiasing (lambdarank_obj.h t_plus/t_minus)
+            # is not implemented; silently ignoring it would train a
+            # different model than the user asked for
+            raise NotImplementedError(
+                "lambdarank_unbiased=True (position-bias debiasing) is not "
+                "supported yet")
         self.score_norm = str(params.get("lambdarank_score_normalization",
                                          "1")).lower() in ("1", "true")
         self._layout = None  # set by learner via set_group_info

@@ -191,6 +191,31 @@ def check_row_budget(n_rows: int) -> None:
             "rows over more processes, or use the default f32 histogram.")
 
 
+def prepare_quantised(gpair, valid, state, *, distributed: bool = False,
+                      axis_name=None):
+    """The shared quantised-training entry sequence used by every grower
+    flavour (single-chip, shard_map mesh, process, streaming): row-budget
+    check, global per-channel scale (chip max via GSPMD/psum is exact;
+    process max via host MAX allreduce), gradient limb quantisation, and
+    the exactly-reduced root totals.  Returns (gq, rho, state).
+    """
+    check_row_budget(gpair.shape[0])
+    rho = local_rho(gpair, valid)
+    if axis_name is not None:
+        rho = jax.lax.pmax(rho, axis_name)
+    if distributed:
+        import numpy as np
+
+        from .. import collective
+
+        rho = jnp.asarray(collective.allreduce(np.asarray(rho),
+                                               collective.Op.MAX))
+    gq = quantise_gpair(gpair, rho)
+    state = quantised_root_state(state, gq, rho, axis_name=axis_name,
+                                 process_reduce=distributed)
+    return gq, rho, state
+
+
 def allreduce_limbs(hist_q) -> "jnp.ndarray":
     """Cross-process exact limb reduction: gather int32 limbs, sum in int64
     on host (order-free), and hand the int64 limbs back — dequantise casts

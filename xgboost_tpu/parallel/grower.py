@@ -159,16 +159,12 @@ class ShardedHistTreeGrower:
         state = self._init_fn(gpair, valid)
         rho_args = ()
         if self.quantised:
-            from ..ops.quantise import (check_row_budget, local_rho,
-                                        quantise_gpair, quantised_root_state)
+            from ..ops.quantise import prepare_quantised
 
-            check_row_budget(gpair.shape[0])
             # jit over the already-sharded gpair: GSPMD's all-reduce-max and
             # integer root reduce are exact, so rho and the root totals are
             # identical on every topology
-            rho = local_rho(gpair, valid)
-            gpair = quantise_gpair(gpair, rho)
-            state = quantised_root_state(state, gpair, rho)
+            gpair, rho, state = prepare_quantised(gpair, valid, state)
             rho_args = (rho,)
         if self._padded:
             from ..tree.grow import HistTreeGrower

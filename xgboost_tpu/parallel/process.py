@@ -92,18 +92,10 @@ class ProcessHistTreeGrower:
 
         rho = None
         if self.quantised:
-            from ..ops.quantise import (check_row_budget, local_rho,
-                                        quantise_gpair, quantised_root_state)
+            from ..ops.quantise import prepare_quantised
 
-            check_row_budget(gpair.shape[0])
-            # global per-channel scale: chip max via GSPMD (exact), process
-            # max via host allreduce (exact) — identical on every topology
-            r_loc = local_rho(gpair, valid)
-            rho = jnp.asarray(collective.allreduce(np.asarray(r_loc),
-                                                   collective.Op.MAX))
-            gpair = quantise_gpair(gpair, rho)  # (R, C, 3) int8 limbs
-            state = quantised_root_state(state, gpair, rho,
-                                         process_reduce=True)
+            gpair, rho, state = prepare_quantised(gpair, valid, state,
+                                                  distributed=True)
         else:
             state = sync_root_totals(state)
 

@@ -572,16 +572,10 @@ class HistTreeGrower:
         )
         rho = None
         if self.quantised:
-            from ..ops.quantise import (check_row_budget, local_rho,
-                                        quantise_gpair, quantised_root_state)
+            from ..ops.quantise import prepare_quantised
 
-            check_row_budget(gpair.shape[0])
-            rho = local_rho(gpair, valid)
-            if self.axis_name is not None:
-                rho = lax.pmax(rho, self.axis_name)
-            gpair = quantise_gpair(gpair, rho)  # (R, C, 3) int8 limbs
-            state = quantised_root_state(state, gpair, rho,
-                                         axis_name=self.axis_name)
+            gpair, rho, state = prepare_quantised(
+                gpair, valid, state, axis_name=self.axis_name)
         md = self.max_depth
         common = dict(params=self.params, axis_name=self.axis_name,
                       lossguide=self.lossguide, has_cat=has_cat,

@@ -117,7 +117,8 @@ class StreamingHistTreeGrower:
     def __init__(self, max_depth: int, params: SplitParams, *,
                  interaction_sets=None, max_leaves: int = 0,
                  lossguide: bool = False, mesh=None,
-                 distributed: bool = False, prefetch: bool = True) -> None:
+                 distributed: bool = False, prefetch: bool = True,
+                 quantised: bool = False) -> None:
         self.max_depth = max_depth
         self.params = params
         self.interaction_sets = interaction_sets
@@ -137,6 +138,10 @@ class StreamingHistTreeGrower:
         # (measurement baseline for the overlap gain; reference knob:
         # n_prefetch_batches=0, sparse_page_source.h:293)
         self.prefetch = prefetch
+        # fixed-point limb histograms (ops/quantise.py): page accumulation,
+        # chip psum and the cross-process reduce are exact integer sums, so
+        # external-memory training is bit-identical on any topology too
+        self.quantised = quantised
         self.max_nodes = max_nodes_for_depth(max_depth)
 
     def _put_page(self, page_np):
@@ -161,7 +166,13 @@ class StreamingHistTreeGrower:
             max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
             n_bin=B,
         )
-        if self.distributed:
+        rho = None
+        if self.quantised:
+            from ..ops.quantise import prepare_quantised
+
+            gpair, rho, state = prepare_quantised(
+                gpair, valid, state, distributed=self.distributed)
+        elif self.distributed:
             from .grow import sync_root_totals
 
             state = sync_root_totals(state)
@@ -192,6 +203,7 @@ class StreamingHistTreeGrower:
                     n_prev=1 << max(prev_d, 0), node0=node0, n_nodes=n_build,
                     n_bin=B, has_prev=prev_best is not None, has_cat=has_cat,
                     build=build, stride=2 if subtract else 1,
+                    quantised=self.quantised,
                 )
                 if i + 1 < n_pages:
                     if not self.prefetch:
@@ -209,19 +221,32 @@ class StreamingHistTreeGrower:
             if hist_acc is not None and self.distributed:
                 # one cross-process exchange per level, after the local page
                 # accumulation and before the sibling subtraction
-                from .. import collective
+                if self.quantised:
+                    from ..ops.quantise import allreduce_limbs
 
-                hist_acc = jnp.asarray(collective.allreduce(np.asarray(hist_acc)))
+                    hist_acc = allreduce_limbs(hist_acc)
+                else:
+                    from .. import collective
+
+                    hist_acc = jnp.asarray(
+                        collective.allreduce(np.asarray(hist_acc)))
             if hist_acc is None:  # last level: dummy hist, leaves only
                 hist_acc = jnp.zeros((N, F, B, 2), jnp.float32)
             elif subtract:
                 # SubtractHist: right sibling = parent - left (grow.level_step)
+                # — exact in limb space when quantised (integer subtract)
                 alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, N)
                 hist_acc = combine_sibling_hists(hist_acc, hist_prev, alive_lvl)
             if build:
                 hist_prev = hist_acc
+            if self.quantised and build:
+                from ..ops.quantise import dequantise
+
+                hist_f = dequantise(hist_acc, rho)  # the ONE rounding step
+            else:
+                hist_f = hist_acc
             state, best, can = _decide_level(
-                state, hist_acc, n_bins, cuts_pad, fm, setmat, cm,
+                state, hist_f, n_bins, cuts_pad, fm, setmat, cm,
                 depth=d, params=self.params, lossguide=self.lossguide,
                 last_level=(d == self.max_depth),
             )
