@@ -145,3 +145,38 @@ def test_missing_sentinel_excluded():
                         node0=0, n_nodes=1, n_bin=B)
     )
     assert np.all(hist == 0.0)
+
+
+def test_matmul_and_scatter_impls_agree(monkeypatch):
+    """Both histogram implementations stay CI-covered on any backend via
+    the XTB_HIST_IMPL override, and agree to f32 rounding (bitwise for the
+    quantised int path) — including stride, traced node0, and the
+    above-chunk scan branch."""
+    import jax.numpy as jnp
+
+    # the UNJITTED accumulators: the env override is read at trace time, so
+    # a cached jit entry point would ignore a flip between two calls
+    from xgboost_tpu.ops.histogram import _hist_accumulate
+    from xgboost_tpu.ops.quantise import (hist_accumulate_q, local_rho,
+                                          quantise_gpair)
+
+    rng = np.random.default_rng(9)
+    R, F, B, N = 3000, 5, 16, 4
+    bins = jnp.asarray(rng.integers(0, B + 1, size=(R, F)).astype(np.int32))
+    gp = jnp.asarray(rng.normal(size=(R, 2)).astype(np.float32))
+    pos = jnp.asarray(rng.integers(-1, 2 * N, size=R).astype(np.int32))
+    rho = local_rho(gp, jnp.ones(R, bool))
+    gq = quantise_gpair(gp, rho)
+
+    outs = {}
+    for impl in ("matmul", "scatter"):
+        monkeypatch.setenv("XTB_HIST_IMPL", impl)
+        outs[impl] = (
+            np.asarray(_hist_accumulate(bins, gp, pos, jnp.int32(3), N, B,
+                                        512, 2)),
+            np.asarray(hist_accumulate_q(bins, gq, pos, jnp.int32(1), N, B,
+                                         chunk=512)),
+        )
+    np.testing.assert_allclose(outs["matmul"][0], outs["scatter"][0],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(outs["matmul"][1], outs["scatter"][1])

@@ -73,9 +73,84 @@ def build_histogram(
                             stride)
 
 
+def hist_impl_override():
+    """Test hook: XTB_HIST_IMPL=matmul|scatter forces the implementation
+    regardless of backend, so the TPU matmul path keeps CPU CI coverage
+    (tests/test_hist_kernels.py) and vice versa."""
+    import os
+
+    v = os.environ.get("XTB_HIST_IMPL", "").lower()
+    return v if v in ("matmul", "scatter") else None
+
+
+def _use_scatter() -> bool:
+    forced = hist_impl_override()
+    if forced is not None:
+        return forced == "scatter"
+    return jax.default_backend() == "cpu"
+
+
+def scatter_hist_driver(bins, values, pos, node0, n_nodes, n_bin, stride,
+                        out_cols, dtype, row_chunk: int = 1 << 18):
+    """Shared CPU scatter-add scaffolding (flat index construction, stride
+    and missing-sentinel masking, chunk-0-outside-the-scan carry rule) for
+    the f32 and quantised-limb histograms: O(R*F) adds instead of the
+    matmul's O(R*F*B) MACs (~150x faster on one core; XLA's CPU scatter is
+    sequential, hence deterministic).  The TPU path keeps the one-hot
+    matmul: on the MXU the matmul wins and scatter serializes (the round-1
+    design decision this fallback deliberately inverts).
+
+    values: (R, out_cols) already in the accumulator dtype.
+    """
+    R, F = bins.shape
+    M = n_nodes * F * n_bin
+
+    def chunk_add(flat, sl):
+        b, g, p = sl
+        local = p - node0
+        if stride != 1:
+            ok = (local >= 0) & (local % stride == 0) \
+                & (local // stride < n_nodes)
+            node = jnp.where(ok, local // stride, 0)
+        else:
+            ok = (local >= 0) & (local < n_nodes)
+            node = jnp.where(ok, local, 0)
+        idx = (node[:, None] * (F * n_bin)
+               + jnp.arange(F, dtype=jnp.int32)[None, :] * n_bin
+               + jnp.minimum(b.astype(jnp.int32), n_bin - 1))
+        # missing sentinel (bin == n_bin) and out-of-level rows add zero
+        w = (ok[:, None] & (b.astype(jnp.int32) < n_bin)).astype(dtype)
+        vals = g[:, None, :] * w[:, :, None]          # (T, F, out_cols)
+        return flat.at[idx.reshape(-1)].add(vals.reshape(-1, out_cols))
+
+    flat = jnp.zeros((M, out_cols), dtype)
+    if R <= row_chunk:
+        flat = chunk_add(flat, (bins, values, pos))
+    else:
+        n_chunks = R // row_chunk
+        rem = R - n_chunks * row_chunk
+        # chunk 0 outside the scan: the carry must already have the
+        # shard-varying type under shard_map (same rule as the matmul path)
+        flat = chunk_add(flat, (bins[:row_chunk], values[:row_chunk],
+                                pos[:row_chunk]))
+        xs = (bins[row_chunk: n_chunks * row_chunk].reshape(
+                  n_chunks - 1, row_chunk, F),
+              values[row_chunk: n_chunks * row_chunk].reshape(
+                  n_chunks - 1, row_chunk, out_cols),
+              pos[row_chunk: n_chunks * row_chunk].reshape(
+                  n_chunks - 1, row_chunk))
+        flat, _ = lax.scan(lambda a, sl: (chunk_add(a, sl), None), flat, xs)
+        if rem:
+            flat = chunk_add(flat, (bins[-rem:], values[-rem:], pos[-rem:]))
+    return flat.reshape(n_nodes, F, n_bin, out_cols)
+
+
 def _hist_accumulate(bins, gpair, pos, node0, n_nodes, n_bin, chunk, stride):
     """Fixed-order chunked accumulation shared by the static- and
     traced-node0 entry points (node0 may be an int or a traced scalar)."""
+    if _use_scatter():
+        return scatter_hist_driver(bins, gpair, pos, node0, n_nodes, n_bin,
+                                   stride, gpair.shape[1], jnp.float32)
     R, F = bins.shape
     C = gpair.shape[1]
     if R <= chunk:

@@ -101,6 +101,14 @@ def hist_accumulate_q(bins, gq, pos, node0, n_nodes: int, n_bin: int,
                       chunk: int = 2048, stride: int = 1):
     """Chunked exact int32 limb-histogram accumulation (any chunk order
     produces identical bits — integer addition is associative)."""
+    from .histogram import _use_scatter, scatter_hist_driver
+
+    if _use_scatter():
+        C, L = gq.shape[1], gq.shape[2]
+        flat = scatter_hist_driver(
+            bins, gq.reshape(gq.shape[0], C * L).astype(jnp.int32), pos,
+            node0, n_nodes, n_bin, stride, C * L, jnp.int32)
+        return flat.reshape(flat.shape[:3] + (C, L))
     R, F = bins.shape
     if R <= chunk:
         return _hist_chunk_q(bins, gq, pos, node0, n_nodes, n_bin, stride)
