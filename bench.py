@@ -222,7 +222,13 @@ def phase_bench(cpu_fallback: bool, train_s: float) -> dict:
                                 1e12 if cpu_fallback else 197e12))
     flops_round = _hist_flops_per_round(N_ROWS, F, B, depth)
     phases["hist_flops_per_round"] = flops_round
-    phases["mfu_vs_peak"] = (flops_round * N_ROUNDS) / train_s / peak
+    if cpu_fallback:
+        # the CPU backend runs the scatter-add hist: O(R*F) adds, not the
+        # matmul's FLOPs — an MFU against matmul FLOPs would be fiction
+        phases["mfu_vs_peak"] = ("n/a on CPU (scatter-add hist does "
+                                 "O(R*F) adds, not matmul FLOPs)")
+    else:
+        phases["mfu_vs_peak"] = (flops_round * N_ROUNDS) / train_s / peak
     # roofline check from the standalone level timing
     phases["hist_level_tflops"] = (
         2.0 * R * F * B * n_build * 2 / phases["hist_level_xla_s"] / 1e12)
@@ -266,8 +272,8 @@ def bench_extmem() -> dict:
     d = ExtMemQuantileDMatrix(Pages(), max_bin=MAX_BIN)
     out = {"pages": len(d._pages), "rows": rows_page * n_pages,
            "compressed_mb": round(sum(
-               getattr(p, "nbytes_compressed", p.nbytes)
-               for p in d._pages) / 2**20, 2)}
+               p.nbytes_compressed if hasattr(p, "nbytes_compressed")
+               else p.nbytes for p in d._pages) / 2**20, 2)}
     base = {"objective": "binary:logistic", "max_depth": 6,
             "max_bin": MAX_BIN, "eta": 0.3}
 
@@ -296,7 +302,10 @@ def main() -> None:
     else:
         devices, cpu_fallback = _init_devices_with_watchdog()
     if cpu_fallback and "BENCH_ROWS" not in os.environ and BENCH_TIER == "full":
-        N_ROWS, N_ROUNDS = 100_000, 5  # keep the fallback run short
+        # the CPU scatter-add hist (ops/histogram.py) trains ~65x faster
+        # than the r1-r3 matmul fallback, so the fallback shape no longer
+        # needs to shrink below the HIGGS ladder scale (r3 VERDICT weak #7)
+        N_ROWS, N_ROUNDS = 1_000_000, 10
 
     import jax
 
