@@ -44,6 +44,12 @@ extern int XGBoosterLoadModelFromBuffer(BoosterHandle, const void*,
                                         bst_ulong);
 extern int XGBoosterDumpModelEx(BoosterHandle, const char*, int, const char*,
                                 bst_ulong*, const char***);
+extern int XGDMatrixGetFloatInfo(const DMatrixHandle, const char*,
+                                 bst_ulong*, const float**);
+extern int XGDMatrixSliceDMatrixEx(DMatrixHandle, const int*, bst_ulong,
+                                   DMatrixHandle*, int);
+extern int XGBoosterSetAttr(BoosterHandle, const char*, const char*);
+extern int XGBoosterGetAttr(BoosterHandle, const char*, const char**, int*);
 
 #define CHECK(call)                                                   \
   do {                                                                \
@@ -161,6 +167,68 @@ int main(void) {
     return 1;
   }
 
+  /* --- the xgb.cv / setinfo / attr surface (r-package/R/xgb.cv.R) --- */
+
+  /* getinfo round-trip */
+  bst_ulong ln = 0;
+  const float* lab = NULL;
+  CHECK(XGDMatrixGetFloatInfo(dtrain, "label", &ln, &lab));
+  if (ln != R || lab[0] != label[0]) {
+    fprintf(stderr, "getinfo label mismatch\n");
+    return 1;
+  }
+
+  /* fold slice (xgb.slice.DMatrix): odd rows as a validation fold */
+  static int idx[R / 2];
+  for (int i = 0; i < R / 2; ++i) idx[i] = 2 * i + 1;
+  DMatrixHandle dfold = NULL;
+  CHECK(XGDMatrixSliceDMatrixEx(dtrain, idx, R / 2, &dfold, 0));
+  CHECK(XGDMatrixNumRow(dfold, &nr));
+  if (nr != R / 2) {
+    fprintf(stderr, "slice rows %llu\n", (unsigned long long)nr);
+    return 1;
+  }
+  bst_ulong fln = 0;
+  const float* flab = NULL;
+  CHECK(XGDMatrixGetFloatInfo(dfold, "label", &fln, &flab));
+  if (fln != R / 2 || flab[0] != label[1]) { /* meta info rode along */
+    fprintf(stderr, "slice label mismatch\n");
+    return 1;
+  }
+
+  /* repeated eval_metric SetParam appends (xgb.cv metrics vector) */
+  BoosterHandle bcv = NULL;
+  DMatrixHandle cvmats[2] = {dtrain, dfold};
+  CHECK(XGBoosterCreate(cvmats, 2, &bcv));
+  CHECK(XGBoosterSetParam(bcv, "objective", "binary:logistic"));
+  CHECK(XGBoosterSetParam(bcv, "eval_metric", "logloss"));
+  CHECK(XGBoosterSetParam(bcv, "eval_metric", "auc"));
+  const char* cvnames[2] = {"train", "test"};
+  CHECK(XGBoosterUpdateOneIter(bcv, 0, dtrain));
+  CHECK(XGBoosterEvalOneIter(bcv, 0, cvmats, cvnames, 2, &evalmsg));
+  if (strstr(evalmsg, "test-logloss:") == NULL ||
+      strstr(evalmsg, "test-auc:") == NULL) {
+    fprintf(stderr, "appended metrics missing in eval: %s\n", evalmsg);
+    return 1;
+  }
+
+  /* best-iteration attrs (xgb.train early stopping) */
+  CHECK(XGBoosterSetAttr(bcv, "best_iteration", "3"));
+  const char* attr = NULL;
+  int ok = 0;
+  CHECK(XGBoosterGetAttr(bcv, "best_iteration", &attr, &ok));
+  if (!ok || strcmp(attr, "3") != 0) {
+    fprintf(stderr, "attr round-trip failed\n");
+    return 1;
+  }
+  CHECK(XGBoosterGetAttr(bcv, "never_set", &attr, &ok));
+  if (ok) {
+    fprintf(stderr, "missing attr reported present\n");
+    return 1;
+  }
+
+  CHECK(XGBoosterFree(bcv));
+  CHECK(XGDMatrixFree(dfold));
   CHECK(XGBoosterFree(bst2));
   CHECK(XGBoosterFree(bst));
   CHECK(XGDMatrixFree(dtrain));

@@ -1,0 +1,108 @@
+// XLA FFI handlers for the native host kernels (CPU backend).
+//
+// The jitted CPU training programs reach these via jax.ffi.ffi_call —
+// zero-copy XLA custom calls, the same mechanism the reference uses to hand
+// work to its C++ updaters through the Python/C boundary (role analogue of
+// src/c_api + updater dispatch; the kernels themselves are in
+// xtb_kernels.h).  Built separately from libxtb_native.so because this
+// translation unit needs the jaxlib FFI headers (make -C native ffi).
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+#include "xtb_kernels.h"
+
+namespace ffi = xla::ffi;
+
+// hist: (bins[R,F] u8|u16|i32, gpair[R,C] f32, pos[R] i32, node0[1] i32)
+//       + attr stride -> out[N,F,B,C] f32
+static ffi::Error XtbHistImpl(ffi::AnyBuffer bins,
+                              ffi::Buffer<ffi::F32> gpair,
+                              ffi::Buffer<ffi::S32> pos,
+                              ffi::Buffer<ffi::S32> node0, int32_t stride,
+                              ffi::ResultBuffer<ffi::F32> out) {
+  auto bd = bins.dimensions();
+  auto od = out->dimensions();
+  if (bd.size() != 2 || od.size() != 4) {
+    return ffi::Error::InvalidArgument("xtb_hist: bad ranks");
+  }
+  const int64_t R = bd[0];
+  const int32_t F = static_cast<int32_t>(bd[1]);
+  const int32_t N = static_cast<int32_t>(od[0]);
+  const int32_t B = static_cast<int32_t>(od[2]);
+  const int32_t C = static_cast<int32_t>(od[3]);
+  const int32_t n0 = node0.typed_data()[0];
+  switch (bins.element_type()) {
+    case ffi::U8:
+      xtb_hist_build_impl(
+          static_cast<const uint8_t*>(bins.untyped_data()),
+          gpair.typed_data(), pos.typed_data(), R, F, B, n0, N, stride, C,
+          out->typed_data());
+      break;
+    case ffi::U16:
+      xtb_hist_build_impl(
+          static_cast<const uint16_t*>(bins.untyped_data()),
+          gpair.typed_data(), pos.typed_data(), R, F, B, n0, N, stride, C,
+          out->typed_data());
+      break;
+    case ffi::S32:
+      xtb_hist_build_impl(
+          static_cast<const int32_t*>(bins.untyped_data()),
+          gpair.typed_data(), pos.typed_data(), R, F, B, n0, N, stride, C,
+          out->typed_data());
+      break;
+    default:
+      return ffi::Error::InvalidArgument("xtb_hist: unsupported bin dtype");
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(XtbHist, XtbHistImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Attr<int32_t>("stride")
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+// split: (hist[N,F,B,2] f32, totals[N,2] f32, n_bins[F] i32, fmask[N,F] u8)
+//        + attrs (lam, alpha, mcw, mds)
+//        -> (gain f32, feat i32, bin i32, dleft u8, GL f32, HL f32), each [N]
+static ffi::Error XtbSplitImpl(
+    ffi::Buffer<ffi::F32> hist, ffi::Buffer<ffi::F32> totals,
+    ffi::Buffer<ffi::S32> n_bins, ffi::Buffer<ffi::U8> fmask, float lam,
+    float alpha, float mcw, float mds, ffi::ResultBuffer<ffi::F32> gain,
+    ffi::ResultBuffer<ffi::S32> feat, ffi::ResultBuffer<ffi::S32> bin,
+    ffi::ResultBuffer<ffi::U8> dleft, ffi::ResultBuffer<ffi::F32> GL,
+    ffi::ResultBuffer<ffi::F32> HL) {
+  auto hd = hist.dimensions();
+  if (hd.size() != 4 || hd[3] != 2) {
+    return ffi::Error::InvalidArgument("xtb_split: bad hist shape");
+  }
+  const int32_t N = static_cast<int32_t>(hd[0]);
+  const int32_t F = static_cast<int32_t>(hd[1]);
+  const int32_t B = static_cast<int32_t>(hd[2]);
+  xtb_split_scan_impl(hist.typed_data(), totals.typed_data(),
+                      n_bins.typed_data(), fmask.typed_data(), N, F, B, lam,
+                      alpha, mcw, mds, gain->typed_data(), feat->typed_data(),
+                      bin->typed_data(), dleft->typed_data(),
+                      GL->typed_data(), HL->typed_data());
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(XtbSplit, XtbSplitImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::U8>>()
+                                  .Attr<float>("lam")
+                                  .Attr<float>("alpha")
+                                  .Attr<float>("mcw")
+                                  .Attr<float>("mds")
+                                  .Ret<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>()
+                                  .Ret<ffi::Buffer<ffi::S32>>()
+                                  .Ret<ffi::Buffer<ffi::U8>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());

@@ -1,0 +1,188 @@
+// Shared host-kernel bodies for xgboost_tpu's native runtime.
+//
+// Included by xtb_native.cc (plain C ABI for ctypes consumers and tests)
+// and xtb_ffi.cc (XLA FFI handlers — the zero-copy path the jitted CPU
+// training programs call).  Role analogue of the reference's CPU hist
+// updater hot loops (src/common/hist_util.cc BuildHist,
+// src/tree/hist/evaluate_splits.h EnumerateSplit), re-designed around the
+// elementwise `pos` row routing used by the JAX growers instead of the
+// reference's physical row partitions.
+#ifndef XTB_KERNELS_H_
+#define XTB_KERNELS_H_
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+// ---------------------------------------------------------------------------
+// Gradient histogram build — one pass over all rows; each row's F adds land
+// in its node's block (F*n_bin*C floats, cache-resident at bench shapes).
+// stride=2 selects left children only (heap offsets 2j) for the subtraction
+// trick; pos ids outside [node0, node0+stride*n_nodes) add nothing; a bin
+// value >= n_bin is the missing sentinel.  Sequential row order ->
+// deterministic within a topology (same contract as the XLA scatter path).
+// ---------------------------------------------------------------------------
+template <typename BinT>
+inline void xtb_hist_build_impl(const BinT* bins, const float* gpair,
+                                const int32_t* pos, int64_t R, int32_t F,
+                                int32_t n_bin, int32_t node0, int32_t n_nodes,
+                                int32_t stride, int32_t C, float* out) {
+  const size_t node_sz = static_cast<size_t>(F) * n_bin * C;
+  memset(out, 0, n_nodes * node_sz * sizeof(float));
+  for (int64_t r = 0; r < R; ++r) {
+    int32_t local = pos[r] - node0;
+    if (local < 0) continue;
+    int32_t node;
+    if (stride == 2) {
+      if (local & 1) continue;
+      node = local >> 1;
+    } else if (stride == 1) {
+      node = local;
+    } else {
+      if (local % stride != 0) continue;
+      node = local / stride;
+    }
+    if (node >= n_nodes) continue;
+    const BinT* br = bins + r * F;
+    float* ob = out + node * node_sz;
+    if (C == 2) {
+      const float g = gpair[r * 2], h = gpair[r * 2 + 1];
+      for (int32_t f = 0; f < F; ++f) {
+        int32_t b = static_cast<int32_t>(br[f]);
+        if (b < n_bin) {
+          float* p = ob + (static_cast<size_t>(f) * n_bin + b) * 2;
+          p[0] += g;
+          p[1] += h;
+        }
+      }
+    } else {
+      const float* gr = gpair + r * C;
+      for (int32_t f = 0; f < F; ++f) {
+        int32_t b = static_cast<int32_t>(br[f]);
+        if (b < n_bin) {
+          float* p = ob + (static_cast<size_t>(f) * n_bin + b) * C;
+          for (int32_t c = 0; c < C; ++c) p[c] += gr[c];
+        }
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Split gain scan (numeric features, no monotone constraints) — one bin pass
+// per (node, feature) instead of the XLA formulation's ~15 materialized
+// (N,F,B) temporaries.  Mirrors ops/split.py evaluate_splits exactly: both
+// missing directions scored, first-occurrence argmax in (feature, bin)
+// order, same f32 arithmetic.
+// ---------------------------------------------------------------------------
+inline float xtb_thr_l1(float g, float alpha) {
+  float a = fabsf(g) - alpha;
+  if (a < 0.0f) a = 0.0f;
+  return g < 0.0f ? -a : a;
+}
+
+struct XtbGainParams {
+  float lambda_, alpha, min_child_weight, max_delta_step;
+};
+
+inline float xtb_calc_gain(float G, float H, const XtbGainParams& p) {
+  if (H <= 0.0f) return 0.0f;
+  float t = xtb_thr_l1(G, p.alpha);
+  if (p.max_delta_step == 0.0f) return t * t / (H + p.lambda_);
+  float w = -t / (H + p.lambda_);
+  if (w > p.max_delta_step) w = p.max_delta_step;
+  if (w < -p.max_delta_step) w = -p.max_delta_step;
+  return -(2.0f * t * w + (H + p.lambda_) * w * w);
+}
+
+inline void xtb_split_scan_impl(const float* hist, const float* totals,
+                                const int32_t* n_bins, const uint8_t* fmask,
+                                int32_t N, int32_t F, int32_t B, float lambda_,
+                                float alpha, float min_child_weight,
+                                float max_delta_step, float* out_gain,
+                                int32_t* out_feat, int32_t* out_bin,
+                                uint8_t* out_dleft, float* out_GL,
+                                float* out_HL) {
+  const float kEps = 1e-6f;
+  const XtbGainParams p{lambda_, alpha, min_child_weight, max_delta_step};
+  for (int32_t n = 0; n < N; ++n) {
+    const float totG = totals[n * 2], totH = totals[n * 2 + 1];
+    const float parent = xtb_calc_gain(totG, totH, p);
+    float best_gain = -INFINITY, best_GL = 0.0f, best_HL = 0.0f;
+    int32_t best_f = 0, best_b = 0;
+    bool best_dl = true, any = false;
+    for (int32_t f = 0; f < F; ++f) {
+      if (!fmask[n * F + f]) continue;
+      const int32_t nb = n_bins[f];
+      const float* hf = hist + (static_cast<size_t>(n) * F + f) * B * 2;
+      float gsum = 0.0f, hsum = 0.0f;
+      for (int32_t b = 0; b < B; ++b) {
+        gsum += hf[2 * b];
+        hsum += hf[2 * b + 1];
+      }
+      const float missG = totG - gsum, missH = totH - hsum;
+      const bool has_miss = fabsf(missH) > kEps;
+      float glr = 0.0f, hlr = 0.0f;
+      const int32_t bmax = nb < B ? nb : B;
+      for (int32_t b = 0; b < bmax; ++b) {
+        glr += hf[2 * b];
+        hlr += hf[2 * b + 1];
+        const bool ok = (b < nb - 1) || (b == nb - 1 && has_miss);
+        if (!ok) continue;
+        float g2 = -INFINITY;
+        bool dl2 = true;
+        {  // missing -> right
+          const float GR = totG - glr, HR = totH - hlr;
+          if (hlr >= min_child_weight && HR >= min_child_weight &&
+              hlr > 0.0f && HR > 0.0f) {
+            g2 = xtb_calc_gain(glr, hlr, p) + xtb_calc_gain(GR, HR, p) -
+                 parent;
+            dl2 = false;
+          }
+        }
+        const float gll = glr + missG, hll = hlr + missH;
+        {  // missing -> left
+          const float GR = totG - gll, HR = totH - hll;
+          if (hll >= min_child_weight && HR >= min_child_weight &&
+              hll > 0.0f && HR > 0.0f) {
+            const float gl_gain = xtb_calc_gain(gll, hll, p) +
+                                  xtb_calc_gain(GR, HR, p) - parent;
+            if (gl_gain >= g2) {
+              g2 = gl_gain;
+              dl2 = true;
+            }
+          }
+        }
+        if (g2 > best_gain) {
+          best_gain = g2;
+          best_f = f;
+          best_b = b;
+          best_dl = dl2;
+          best_GL = dl2 ? gll : glr;
+          best_HL = dl2 ? hll : hlr;
+          any = true;
+        }
+      }
+    }
+    if (!any) {
+      // match the XLA argmax over an all -inf tensor: flat index 0 ->
+      // (feature 0, bin 0), missing -> left
+      const float* h0 = hist + static_cast<size_t>(n) * F * B * 2;
+      float gsum = 0.0f, hsum = 0.0f;
+      for (int32_t b = 0; b < B; ++b) {
+        gsum += h0[2 * b];
+        hsum += h0[2 * b + 1];
+      }
+      best_GL = h0[0] + (totG - gsum);
+      best_HL = h0[1] + (totH - hsum);
+    }
+    out_gain[n] = best_gain;
+    out_feat[n] = best_f;
+    out_bin[n] = best_b;
+    out_dleft[n] = best_dl ? 1 : 0;
+    out_GL[n] = best_GL;
+    out_HL[n] = best_HL;
+  }
+}
+
+#endif  // XTB_KERNELS_H_

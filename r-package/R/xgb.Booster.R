@@ -1,6 +1,42 @@
 # xgb.train / predict / save / load — the reference R training surface
 # (R-package/R/xgb.train.R, xgb.Booster.R) over the xtb C ABI.
 
+#' Parse one "[i]\tname-metric:value\t..." eval line into a named numeric
+#' vector (names like "train-logloss") — shared by xgb.train early stopping
+#' and xgb.cv's per-fold aggregation.
+xgb.parse.eval <- function(msg) {
+  toks <- strsplit(sub("^\\[[0-9]+\\]\\s*", "", msg), "[\t ]+")[[1]]
+  toks <- toks[nzchar(toks)]
+  kv <- regmatches(toks, regexpr(":", toks), invert = TRUE)
+  vals <- vapply(kv, function(p) as.numeric(p[2]), numeric(1))
+  names(vals) <- vapply(kv, function(p) p[1], character(1))
+  vals
+}
+
+#' TRUE when a metric name means "bigger is better" (reference:
+#' R-package/R/callbacks.R early-stop maximize auto-detection; mape is the
+#' error metric the "map" prefix must NOT capture).
+xgb.metric.maximize <- function(metric) {
+  m <- sub("^.*-", "", metric)
+  grepl("^(auc|aucpr|map|ndcg|pre)", m) && !grepl("^mape", m)
+}
+
+#' One early-stopping step, shared by xgb.train and xgb.cv.
+#' state: list(best_score, best_iter); returns the updated state with
+#' $stop = TRUE once `rounds` iterations passed without improvement.
+xgb.early.stop.update <- function(state, score, metric_name, i, rounds,
+                                  maximize = NULL) {
+  mx <- if (is.null(maximize)) xgb.metric.maximize(metric_name) else maximize
+  better <- is.na(state$best_score) ||
+    (if (mx) score > state$best_score else score < state$best_score)
+  if (better) {
+    state$best_score <- score
+    state$best_iter <- i
+  }
+  state$stop <- !better && (i - state$best_iter >= rounds)
+  state
+}
+
 #' Train a gradient-boosted model.
 #'
 #' @param params named list of booster parameters
@@ -9,12 +45,24 @@
 #' @param nrounds number of boosting rounds.
 #' @param evals named list of xgb.DMatrix to evaluate each round.
 #' @param verbose print eval lines when TRUE.
+#' @param early_stopping_rounds stop when the LAST metric on the LAST evals
+#'   entry has not improved for this many rounds (reference semantics:
+#'   xgb.train.R early stopping on the final watchlist member).  The best
+#'   round lands in $best_iteration / $best_score (1-based, R convention)
+#'   and in the "best_iteration" booster attr (0-based round id — the
+#'   cross-language attr convention shared with the Python package, so
+#'   attr-driven consumers like ntreelimit agree across bindings).
+#' @param maximize direction for early stopping; NULL auto-detects from the
+#'   metric name (auc/map/ndcg/pre maximize, everything else minimizes).
 xgb.train <- function(params = list(), data, nrounds = 10,
-                      evals = list(), verbose = TRUE) {
+                      evals = list(), verbose = TRUE,
+                      early_stopping_rounds = NULL, maximize = NULL) {
   stopifnot(inherits(data, "xgb.DMatrix"))
   if (length(evals) > 0 &&
       (is.null(names(evals)) || any(names(evals) == "")))
     stop("evals must be a fully named list, e.g. list(train = dtrain)")
+  if (!is.null(early_stopping_rounds) && length(evals) == 0)
+    stop("early_stopping_rounds needs at least one evals entry")
   dmats <- c(list(data), unname(evals))
   handle <- .Call(XTBBoosterCreate_R, lapply(dmats, function(d) d$handle))
   for (nm in names(params))
@@ -23,15 +71,46 @@ xgb.train <- function(params = list(), data, nrounds = 10,
                         nrounds = nrounds),
                    class = "xgb.Booster")
   eval_names <- names(evals)
+  log <- list()
+  es <- list(best_score = NA_real_, best_iter = -1L, stop = FALSE)
   for (i in seq_len(nrounds) - 1L) {
     .Call(XTBBoosterUpdateOneIter_R, handle, i, data$handle)
     if (length(evals) > 0) {
       msg <- .Call(XTBBoosterEvalOneIter_R, handle, i,
                    lapply(unname(evals), function(d) d$handle), eval_names)
       if (isTRUE(verbose)) message(msg)
+      vals <- xgb.parse.eval(msg)
+      log[[length(log) + 1L]] <- vals
+      if (!is.null(early_stopping_rounds)) {
+        es <- xgb.early.stop.update(es, vals[[length(vals)]],
+                                    names(vals)[length(vals)], i,
+                                    early_stopping_rounds, maximize)
+        if (es$stop) {
+          if (isTRUE(verbose))
+            message(sprintf("early stop: best round %d (%s = %g)",
+                            es$best_iter + 1L, names(vals)[length(vals)],
+                            es$best_score))
+          break
+        }
+      }
     }
   }
+  if (length(log) > 0)
+    bst$evaluation_log <- do.call(rbind, log)
+  if (es$best_iter >= 0L) {
+    bst$best_iteration <- es$best_iter + 1L
+    bst$best_score <- es$best_score
+    .Call(XTBBoosterSetAttr_R, handle, "best_iteration",
+          as.character(es$best_iter))
+    .Call(XTBBoosterSetAttr_R, handle, "best_score",
+          as.character(es$best_score))
+  }
   bst
+}
+
+#' Read a booster attribute set during training (e.g. "best_iteration").
+xgb.attr <- function(model, name) {
+  .Call(XTBBoosterGetAttr_R, model$handle, name)
 }
 
 #' @export

@@ -27,6 +27,38 @@ xgb.DMatrix <- function(data, label = NULL, weight = NULL,
   dmat
 }
 
+#' Set a meta-info field on an xgb.DMatrix after construction
+#' (reference surface: R-package/R/xgb.DMatrix.R setinfo).
+#' Supported fields: label, weight, base_margin, group,
+#' label_lower_bound, label_upper_bound, feature_weights.
+setinfo <- function(object, ...) UseMethod("setinfo")
+
+#' @export
+setinfo.xgb.DMatrix <- function(object, name, info, ...) {
+  stopifnot(is.character(name), length(name) == 1L)
+  .Call(XTBDMatrixSetInfo_R, object$handle, name, as.numeric(info))
+  invisible(TRUE)
+}
+
+#' Read a meta-info field back (label, weight, base_margin, ...).
+getinfo <- function(object, ...) UseMethod("getinfo")
+
+#' @export
+getinfo.xgb.DMatrix <- function(object, name, ...) {
+  .Call(XTBDMatrixGetInfo_R, object$handle, name)
+}
+
+#' Take a row subset as a new xgb.DMatrix (1-based row ids, like the
+#' reference's xgb.slice.DMatrix).  Meta info (labels, weights, margins)
+#' rides along; set allow_groups = TRUE when slicing a ranking matrix by
+#' whole query groups.
+xgb.slice.DMatrix <- function(dmat, idxset, allow_groups = FALSE) {
+  stopifnot(inherits(dmat, "xgb.DMatrix"))
+  handle <- .Call(XTBDMatrixSlice_R, dmat$handle,
+                  as.integer(idxset) - 1L, as.integer(allow_groups))
+  structure(list(handle = handle), class = "xgb.DMatrix")
+}
+
 xgb.DMatrix.num.row <- function(dmat) {
   .Call(XTBDMatrixNumRow_R, dmat$handle)
 }

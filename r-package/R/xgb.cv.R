@@ -1,0 +1,106 @@
+# xgb.cv — k-fold cross-validation over the C ABI
+# (reference surface: R-package/R/xgb.cv.R; implementation is fresh — fold
+# DMatrices come from XGDMatrixSliceDMatrix so meta info rides along).
+
+#' K-fold cross-validation.
+#'
+#' @param params booster parameters (see xgb.train).
+#' @param data an xgb.DMatrix carrying labels (and any weights/margins).
+#' @param nrounds boosting rounds per fold.
+#' @param nfold number of folds.
+#' @param stratified stratify folds by label (classification); default
+#'   stratifies when the objective name contains "logistic" or "softmax"/
+#'   "softprob", matching the reference's heuristic.
+#' @param folds optional explicit list of validation-row index vectors
+#'   (1-based); overrides nfold/stratified.
+#' @param metrics optional extra eval metrics (character vector; each is
+#'   appended via SetParam("eval_metric", ...) — the last one drives early
+#'   stopping).
+#' @param early_stopping_rounds stop all folds when the mean test metric
+#'   has not improved for this many rounds.
+#' @param maximize direction for early stopping (NULL = auto from name).
+#' @param verbose print the aggregated eval line each round.
+#' @return list with $evaluation_log (mean/std per round), $folds, and
+#'   $best_iteration when early stopping fired.
+xgb.cv <- function(params = list(), data, nrounds = 10, nfold = 5,
+                   stratified = NULL, folds = NULL, metrics = NULL,
+                   early_stopping_rounds = NULL, maximize = NULL,
+                   verbose = TRUE) {
+  stopifnot(inherits(data, "xgb.DMatrix"))
+  n <- xgb.DMatrix.num.row(data)
+  if (is.null(folds)) {
+    if (is.null(stratified)) {
+      obj <- if (is.null(params$objective)) "" else params$objective
+      stratified <- grepl("logistic|softmax|softprob", obj)
+    }
+    if (stratified) {
+      y <- getinfo(data, "label")
+      # sample.int, NOT sample(x): a single-row class would otherwise hit
+      # R's length-1 sample() expansion and corrupt the fold indices
+      idx <- unlist(lapply(split(seq_len(n), y),
+                           function(x) x[sample.int(length(x))]),
+                    use.names = FALSE)
+    } else {
+      idx <- sample.int(n)
+    }
+    folds <- split(idx, rep_len(seq_len(nfold), n))
+  }
+  sessions <- lapply(folds, function(test_idx) {
+    train_idx <- setdiff(seq_len(n), test_idx)
+    dtrain <- xgb.slice.DMatrix(data, train_idx)
+    dtest <- xgb.slice.DMatrix(data, test_idx)
+    handle <- .Call(XTBBoosterCreate_R, list(dtrain$handle, dtest$handle))
+    for (nm in names(params))
+      .Call(XTBBoosterSetParam_R, handle, nm, as.character(params[[nm]]))
+    # repeated SetParam("eval_metric", ...) appends (ABI contract)
+    for (m in metrics)
+      .Call(XTBBoosterSetParam_R, handle, "eval_metric", as.character(m))
+    list(handle = handle, dtrain = dtrain, dtest = dtest)
+  })
+  log <- list()
+  es <- list(best_score = NA_real_, best_iter = -1L, stop = FALSE)
+  for (i in seq_len(nrounds) - 1L) {
+    per_fold <- lapply(sessions, function(s) {
+      .Call(XTBBoosterUpdateOneIter_R, s$handle, i, s$dtrain$handle)
+      xgb.parse.eval(.Call(XTBBoosterEvalOneIter_R, s$handle, i,
+                           list(s$dtrain$handle, s$dtest$handle),
+                           c("train", "test")))
+    })
+    m <- do.call(rbind, per_fold)
+    row <- c(apply(m, 2, mean), apply(m, 2, stats::sd))
+    names(row) <- c(paste0(colnames(m), "_mean"),
+                    paste0(colnames(m), "_std"))
+    log[[length(log) + 1L]] <- row
+    if (isTRUE(verbose))
+      message(sprintf("[%d]\t%s", i, paste(
+        sprintf("%s:%.6f", names(row), row), collapse = "\t")))
+    if (!is.null(early_stopping_rounds)) {
+      test_cols <- grep("^test-.*_mean$", names(row))
+      metric_name <- sub("_mean$", "",
+                         names(row)[test_cols[length(test_cols)]])
+      es <- xgb.early.stop.update(es, row[[test_cols[length(test_cols)]]],
+                                  metric_name, i, early_stopping_rounds,
+                                  maximize)
+      if (es$stop) {
+        if (isTRUE(verbose))
+          message(sprintf("early stop: best round %d", es$best_iter + 1L))
+        break
+      }
+    }
+  }
+  out <- list(evaluation_log = do.call(rbind, log), folds = folds,
+              params = params)
+  if (es$best_iter >= 0L) {
+    out$best_iteration <- es$best_iter + 1L
+    out$best_score <- es$best_score
+  }
+  class(out) <- "xgb.cv.synchronous"
+  out
+}
+
+#' @export
+print.xgb.cv.synchronous <- function(x, ...) {
+  cat("xgboost.tpu cv,", nrow(x$evaluation_log), "rounds,",
+      length(x$folds), "folds\n")
+  invisible(x)
+}

@@ -1,0 +1,33 @@
+# xgb.importance — feature importance from the model dump
+# (reference surface: R-package/R/xgb.importance.R; computed R-side from
+# xgb.dump(with_stats = TRUE) text, the same source the reference parses).
+
+#' Per-feature Gain / Cover / Frequency importance.
+#'
+#' @param model an xgb.Booster.
+#' @param feature_names optional names; defaults to f0..fN ids from the dump.
+#' @return data.frame sorted by Gain share (columns sum to 1).
+xgb.importance <- function(model, feature_names = NULL) {
+  dump <- xgb.dump(model, with_stats = TRUE, dump_format = "text")
+  lines <- unlist(strsplit(dump, "\n"), use.names = FALSE)
+  splits <- grep("\\[f[0-9]+[<]", lines, value = TRUE)
+  feat <- sub("^.*\\[(f[0-9]+)[<].*$", "\\1", splits)
+  gain <- as.numeric(sub("^.*gain=([-0-9.eE+]+).*$", "\\1", splits))
+  cover <- as.numeric(sub("^.*cover=([-0-9.eE+]+).*$", "\\1", splits))
+  if (length(feat) == 0)
+    return(data.frame(Feature = character(), Gain = numeric(),
+                      Cover = numeric(), Frequency = numeric()))
+  agg_g <- tapply(gain, feat, sum)
+  agg_c <- tapply(cover, feat, sum)
+  agg_f <- table(feat)
+  nm <- names(agg_g)
+  if (!is.null(feature_names)) {
+    ids <- as.integer(sub("^f", "", nm)) + 1L
+    nm <- feature_names[ids]
+  }
+  out <- data.frame(Feature = nm,
+                    Gain = as.numeric(agg_g) / sum(agg_g),
+                    Cover = as.numeric(agg_c) / sum(agg_c),
+                    Frequency = as.numeric(agg_f) / sum(agg_f))
+  out[order(-out$Gain), , drop = FALSE]
+}

@@ -35,3 +35,31 @@ bst3 <- xgb.load.raw(raw)
 stopifnot(max(abs(predict(bst3, dtrain) - p)) == 0)
 
 cat("R binding smoke: OK (", length(xgb.dump(bst)), "trees )\n")
+
+# --- cross-validation (xgb.cv) ------------------------------------------
+cv <- xgb.cv(list(objective = "binary:logistic", max_depth = 3,
+                  eta = 0.3, eval_metric = "logloss"),
+             dtrain, nrounds = 8, nfold = 3,
+             early_stopping_rounds = 3, verbose = FALSE)
+stopifnot(nrow(cv$evaluation_log) >= 1,
+          "test-logloss_mean" %in% colnames(cv$evaluation_log))
+
+# --- setinfo / getinfo ---------------------------------------------------
+setinfo(dtrain, "weight", runif(n, 0.5, 2))
+stopifnot(length(getinfo(dtrain, "weight")) == n)
+stopifnot(all(abs(getinfo(dtrain, "label") - y) < 1e-7))
+
+# --- weighted ranking with early stopping --------------------------------
+gsize <- rep(20, n / 20)
+drank <- xgb.DMatrix(x, label = sample(0:4, n, TRUE), group = gsize)
+brk <- xgb.train(list(objective = "rank:ndcg", eval_metric = "ndcg@5",
+                      max_depth = 3), drank, nrounds = 8,
+                 evals = list(train = drank),
+                 early_stopping_rounds = 3, verbose = FALSE)
+stopifnot(!is.null(brk$evaluation_log))
+
+# --- importance ----------------------------------------------------------
+imp <- xgb.importance(bst)
+stopifnot(nrow(imp) >= 1, abs(sum(imp$Gain) - 1) < 1e-6)
+
+cat("R deep-surface smoke OK\n")

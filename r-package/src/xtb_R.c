@@ -49,6 +49,12 @@ extern int XGBoosterLoadModelFromBuffer(BoosterHandle, const void*,
                                         bst_ulong);
 extern int XGBoosterDumpModelEx(BoosterHandle, const char*, int, const char*,
                                 bst_ulong*, const char***);
+extern int XGDMatrixGetFloatInfo(const DMatrixHandle, const char*,
+                                 bst_ulong*, const float**);
+extern int XGDMatrixSliceDMatrixEx(DMatrixHandle, const int*, bst_ulong,
+                                   DMatrixHandle*, int);
+extern int XGBoosterSetAttr(BoosterHandle, const char*, const char*);
+extern int XGBoosterGetAttr(BoosterHandle, const char*, const char**, int*);
 
 #define XTB_CHECK(call)                                                    \
   do {                                                                     \
@@ -250,6 +256,46 @@ SEXP XTBBoosterDumpModel_R(SEXP handle, SEXP fmap, SEXP with_stats,
   return out;
 }
 
+SEXP XTBDMatrixGetInfo_R(SEXP handle, SEXP name) {
+  bst_ulong len = 0;
+  const float* ptr = NULL;
+  XTB_CHECK(XGDMatrixGetFloatInfo(R_ExternalPtrAddr(handle),
+                                  CHAR(Rf_asChar(name)), &len, &ptr));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)len));
+  for (bst_ulong i = 0; i < len; ++i) REAL(out)[i] = (double)ptr[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP XTBDMatrixSlice_R(SEXP handle, SEXP idx, SEXP allow_groups) {
+  /* idx: 0-based integer row ids (xgb.slice.DMatrix converts from R's
+     1-based).  allow_groups mirrors the reference's slice flag (needed when
+     slicing a ranking DMatrix by whole groups). */
+  int n = Rf_length(idx);
+  DMatrixHandle out = NULL;
+  XTB_CHECK(XGDMatrixSliceDMatrixEx(R_ExternalPtrAddr(handle), INTEGER(idx),
+                                    (bst_ulong)n, &out,
+                                    Rf_asInteger(allow_groups)));
+  return wrap_handle(out, dmatrix_finalizer);
+}
+
+SEXP XTBBoosterSetAttr_R(SEXP handle, SEXP key, SEXP val) {
+  XTB_CHECK(XGBoosterSetAttr(R_ExternalPtrAddr(handle),
+                             CHAR(Rf_asChar(key)),
+                             val == R_NilValue ? NULL
+                                               : CHAR(Rf_asChar(val))));
+  return R_NilValue;
+}
+
+SEXP XTBBoosterGetAttr_R(SEXP handle, SEXP key) {
+  const char* out = NULL;
+  int ok = 0;
+  XTB_CHECK(XGBoosterGetAttr(R_ExternalPtrAddr(handle),
+                             CHAR(Rf_asChar(key)), &out, &ok));
+  if (!ok) return R_NilValue;
+  return Rf_mkString(out);
+}
+
 /* ----------------------------------------------------- registration --- */
 
 static const R_CallMethodDef CallEntries[] = {
@@ -268,6 +314,10 @@ static const R_CallMethodDef CallEntries[] = {
     {"XTBBoosterLoadModelFromRaw_R", (DL_FUNC)&XTBBoosterLoadModelFromRaw_R,
      2},
     {"XTBBoosterDumpModel_R", (DL_FUNC)&XTBBoosterDumpModel_R, 4},
+    {"XTBDMatrixGetInfo_R", (DL_FUNC)&XTBDMatrixGetInfo_R, 2},
+    {"XTBDMatrixSlice_R", (DL_FUNC)&XTBDMatrixSlice_R, 3},
+    {"XTBBoosterSetAttr_R", (DL_FUNC)&XTBBoosterSetAttr_R, 3},
+    {"XTBBoosterGetAttr_R", (DL_FUNC)&XTBBoosterGetAttr_R, 2},
     {NULL, NULL, 0}};
 
 void R_init_xgboost_tpu(DllInfo* dll) {

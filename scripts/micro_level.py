@@ -1,0 +1,94 @@
+"""Microbench level_step components at ladder shapes (CPU).
+
+Times the jitted hist build, split evaluation and position update separately
+at covertype (58k x 54, B=257) and HIGGS-slice (1.1M x 28) shapes, so the
+ladder gap (BENCH_LADDER.json) can be attributed before optimising.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench(fn, *args, n=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    from xgboost_tpu.ops.histogram import build_histogram
+    from xgboost_tpu.ops.split import SplitParams, evaluate_splits
+
+    for name, R, F, B in [("covertype", 58368, 54, 257),
+                          ("higgs", 1101824, 28, 257)]:
+        rng = np.random.default_rng(0)
+        bins = jnp.asarray(rng.integers(0, B - 1, size=(R, F)), jnp.int32)
+        gpair = jnp.asarray(rng.normal(size=(R, 2)), jnp.float32)
+        print(f"== {name}: R={R} F={F} B={B}")
+        for depth in (0, 3, 7):
+            N = 1 << depth
+            node0 = N - 1
+            pos = jnp.asarray(
+                rng.integers(node0, node0 + N, size=R), jnp.int32)
+            t = bench(lambda b=bins, g=gpair, p=pos, n0=node0, nn=N:
+                      build_histogram(b, g, p, node0=n0, n_nodes=nn, n_bin=B))
+            print(f"  hist  d={depth} N={N}: {t*1e3:8.2f} ms")
+            # subtraction-trick variant: half the nodes, stride 2
+            if depth > 0:
+                t = bench(lambda b=bins, g=gpair, p=pos, n0=node0, nn=N // 2:
+                          build_histogram(b, g, p, node0=n0, n_nodes=nn,
+                                          n_bin=B, stride=2))
+                print(f"  hist- d={depth} N={N//2} s2: {t*1e3:8.2f} ms")
+        # split eval at the widest level
+        params = SplitParams(eta=0.3, lambda_=1.0, alpha=0.0, gamma=0.0,
+                             min_child_weight=1.0, max_delta_step=0.0,
+                             monotone=None, max_cat_to_onehot=4)
+        for N in (128, 256):
+            hist = jnp.asarray(rng.normal(size=(N, F, B, 2)), jnp.float32)
+            totals = jnp.asarray(hist.sum(axis=(1, 2)) / F)
+            n_bins = jnp.full(F, B - 1, jnp.int32)
+            fmask = jnp.ones((N, F), bool)
+            bounds = jnp.stack([jnp.full(N, -jnp.inf), jnp.full(N, jnp.inf)],
+                               axis=1).astype(jnp.float32)
+            t = bench(lambda h=hist, tt=totals, nb=n_bins, fm=fmask, bd=bounds:
+                      evaluate_splits(h, tt, nb, params, fm, bd))
+            print(f"  split N={N}: {t*1e3:8.2f} ms")
+        # position update
+        from xgboost_tpu.tree.grow import _update_positions
+        from xgboost_tpu.ops.split import BestSplit
+
+        N = 128
+        node0 = N - 1
+        pos = jnp.asarray(rng.integers(node0, node0 + N, size=R), jnp.int32)
+        best = BestSplit(
+            feature=jnp.zeros(N, jnp.int32), bin=jnp.full(N, 100, jnp.int32),
+            gain=jnp.ones(N, jnp.float32), default_left=jnp.ones(N, bool),
+            left_sum=jnp.zeros((N, 2), jnp.float32),
+            right_sum=jnp.zeros((N, 2), jnp.float32),
+            left_weight=jnp.zeros(N, jnp.float32),
+            right_weight=jnp.zeros(N, jnp.float32),
+            is_cat=jnp.zeros(N, bool), cat_set=jnp.zeros((N, B), bool))
+        can = jnp.ones(N, bool)
+        f = jax.jit(lambda b, p: _update_positions(b, p, best, can, node0, N,
+                                                   B, False))
+        t = bench(f, bins, pos)
+        print(f"  posupd N={N}: {t*1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Profile the ladder covertype config (58k x 54, 7 classes) on CPU.
+
+Coarse wall-clock attribution of one ladder run: where do the 30s go?
+Usage: python scripts/profile_covertype.py [--cprofile]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from scripts.bench_ladder import FULL_CONFIGS, make_data
+
+
+def main():
+    cfg = FULL_CONFIGS[1]
+    R, X, y, groups = make_data(cfg, 0.1)
+    print(f"rows={R} cols={cfg['cols']} classes={cfg['classes']}")
+
+    import xgboost_tpu as xtb
+
+    p = {"objective": cfg["objective"], "num_class": cfg["classes"],
+         **cfg["params"]}
+
+    t0 = time.perf_counter()
+    d = xtb.DMatrix(X, label=y)
+    t1 = time.perf_counter()
+    print(f"DMatrix build: {t1 - t0:.2f}s")
+
+    # warmup (compile)
+    xtb.train(p, d, 1, verbose_eval=False)
+    t2 = time.perf_counter()
+    print(f"warmup round (compile): {t2 - t1:.2f}s")
+
+    if "--cprofile" in sys.argv:
+        import cProfile
+        import pstats
+
+        pr = cProfile.Profile()
+        pr.enable()
+        bst = xtb.train(p, d, cfg["rounds"], verbose_eval=False)
+        np.asarray(bst.predict(d))
+        pr.disable()
+        st = pstats.Stats(pr)
+        st.sort_stats("cumulative").print_stats(40)
+    else:
+        t3 = time.perf_counter()
+        bst = xtb.train(p, d, cfg["rounds"], verbose_eval=False)
+        t4 = time.perf_counter()
+        print(f"train 5 rounds: {t4 - t3:.2f}s")
+        preds = np.asarray(bst.predict(d))
+        t5 = time.perf_counter()
+        print(f"predict: {t5 - t4:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,53 @@ def test_quantised_hist_matches_int64_reference():
     assert (np.abs(recon - gpair) <= 1.0001 * step[None, :]).all()
 
 
+def test_quantised_pallas_kernel_bitwise_matches_xla():
+    """The int8 x int8 -> int32 Pallas kernel (interpret mode off-TPU) must
+    produce bitwise-identical limb histograms to the XLA accumulation —
+    integer sums are exact, so ANY disagreement is a bug, not noise."""
+    import jax.numpy as jnp
+
+    from xgboost_tpu.ops.hist_pallas import build_histogram_pallas_q
+    from xgboost_tpu.ops.quantise import (hist_accumulate_q, local_rho,
+                                          quantise_gpair)
+
+    rng = np.random.default_rng(11)
+    R, F, B, N = 2500, 5, 16, 4
+    bins = rng.integers(0, B + 1, size=(R, F)).astype(np.int32)
+    gpair = rng.normal(size=(R, 2)).astype(np.float32)
+    valid = np.ones(R, bool)
+    rho = local_rho(jnp.asarray(gpair), jnp.asarray(valid))
+    gq = quantise_gpair(jnp.asarray(gpair), rho)
+    for node0, n_nodes, stride in ((0, N, 1), (N - 1, N // 2, 2)):
+        pos = jnp.asarray(
+            rng.integers(node0 - 1, node0 + 2 * n_nodes, size=R), jnp.int32)
+        ref = np.asarray(hist_accumulate_q(
+            jnp.asarray(bins), gq, pos, jnp.int32(node0), n_nodes, B,
+            chunk=512, stride=stride))
+        got = np.asarray(build_histogram_pallas_q(
+            jnp.asarray(bins), gq, pos, node0=node0, n_nodes=n_nodes,
+            n_bin=B, stride=stride, interpret=True, row_tile=512,
+            feat_group=2))
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_quantised_pallas_training_bitwise():
+    """deterministic_histogram=True with hist_impl='pallas' (the production
+    TPU kernel) grows byte-identical trees to the XLA quantised path —
+    VERDICT r4 #4: the determinism contract and the fast kernel at once."""
+    X, y = _data(n=1200, f=5)
+
+    def run(impl):
+        p = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+             "max_bin": 16, "deterministic_histogram": True}
+        if impl:
+            p["_hist_impl"] = impl
+        bst = xtb.train(p, xtb.DMatrix(X, label=y), 2, verbose_eval=False)
+        return _dump_hash(bst)
+
+    assert run("pallas") == run(None)
+
+
 def test_quantised_bitwise_across_device_counts(eight_devices):
     """1 device vs 8-chip mesh: identical tree bits (the f32 path only
     guarantees this structurally at shallow depth)."""

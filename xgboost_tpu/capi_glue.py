@@ -109,6 +109,16 @@ def booster_create(dmats: List[DMatrix]) -> Booster:
 
 
 def booster_set_param(b: Booster, name: str, value: Optional[str]) -> None:
+    if name == "eval_metric" and value is not None:
+        # repeated SetParam("eval_metric", ...) calls APPEND (reference
+        # Learner::SetParam semantics — c_api consumers configure multiple
+        # metrics exactly this way, e.g. the R binding's metrics vector)
+        cur = b.params.get("eval_metric")
+        if cur is not None:
+            lst = cur if isinstance(cur, list) else [cur]
+            if value not in lst:
+                b.set_param(name, lst + [value])
+            return
     b.set_param(name, value)
 
 

@@ -278,6 +278,29 @@ def _backend() -> CollBackend:
     return _DEFAULT
 
 
+def _reconcile_native_kernels() -> None:
+    """All ranks must run the SAME split/hist implementation: the native FFI
+    scan differs from the XLA formulation in the last f32 ulp, and every
+    process redundantly evaluates splits on the allreduced histogram — a
+    rank missing the kernels (failed build, no toolchain) picking the XLA
+    path while its peers take the native one could choose a different
+    near-tie split and silently diverge the trees.  Allreduce-MIN the local
+    availability; if anyone lacks it, everyone vetoes (utils/native.py)."""
+    import jax
+
+    from .utils import native
+
+    if jax.default_backend() != "cpu" or get_world_size() <= 1:
+        return
+    ok = np.asarray([1 if native.load_ffi() else 0], np.int32)
+    unanimous = int(allreduce(ok, Op.MIN)[0])
+    if not unanimous and not native.FFI_DISTRIBUTED_VETO:
+        native.FFI_DISTRIBUTED_VETO = True
+        # programs traced before the veto have the native custom calls baked
+        # in; drop them so every post-init trace takes the XLA path
+        jax.clear_caches()
+
+
 def init(**args: Any) -> None:
     """Initialize the collective (reference: collective.py:94 init).
 
@@ -294,6 +317,7 @@ def init(**args: Any) -> None:
         rank = int(args.get("in_memory_rank", 0))
         group = str(args.get("in_memory_group", "default"))
         _TLS.backend = InMemoryBackend(world, rank, group)
+        _reconcile_native_kernels()
         return
     if kind == "federated":
         from .federated import FederatedBackend
@@ -305,6 +329,7 @@ def init(**args: Any) -> None:
             int(args["federated_rank"]))
         return
     _PROCESS_BACKEND = JaxDistributedBackend(**args)
+    _reconcile_native_kernels()
 
 
 def finalize() -> None:

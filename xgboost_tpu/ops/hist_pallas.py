@@ -43,14 +43,16 @@ _VMEM_BUDGET = 64 * 2**20
 
 def choose_tiles(n_features: int, n_bin: int, n_nodes: int,
                  bin_itemsize: int = 1,
-                 vmem_budget: int = _VMEM_BUDGET) -> tuple:
+                 vmem_budget: int = _VMEM_BUDGET, out_ch: int = 2) -> tuple:
     """Pick (row_tile, feat_group) that fits the VMEM budget.
 
     Working set per grid step:
-      - persistent out block: FG * B * 2N * 4 bytes (lives across row tiles)
+      - persistent out block: FG * B * out_ch*N * 4 bytes (lives across row
+        tiles; out_ch = 2 for the f32 (g,h) kernel, 6 for the quantised
+        (g,h) x 3-limb kernel)
       - double-buffered inputs: 2 * T * (FG*itemsize + 8 + 4)
       - scratch (one feature at a time in the unrolled loop):
-        onehot T*B*4 + node-masked gpair T*2N*4 + nodemask T*N*4
+        onehot T*B*4 + node-masked gpair T*out_ch*N*4 + nodemask T*N*4
     Preference order: biggest row tile first (deeper MXU K dim), then the
     widest feature group that still fits — the shapes the hardware sweep
     showed to matter most.  Always returns something runnable (1, 256).
@@ -59,9 +61,10 @@ def choose_tiles(n_features: int, n_bin: int, n_nodes: int,
         for fg in (16, 8, 4, 2, 1):
             if fg > max(n_features, 1):
                 continue
-            out_b = fg * n_bin * 2 * n_nodes * 4
+            out_b = fg * n_bin * out_ch * n_nodes * 4
             in_b = 2 * t * (fg * bin_itemsize + 8 + 4)
-            scratch = t * n_bin * 4 + t * 2 * n_nodes * 4 + t * n_nodes * 4
+            scratch = (t * n_bin * 4 + t * out_ch * n_nodes * 4
+                       + t * n_nodes * 4)
             if out_b + in_b + scratch <= vmem_budget:
                 return t, fg
     return 256, 1
@@ -99,7 +102,7 @@ def _hist_kernel(bins_ref, gpair_ref, pos_ref, out_ref, *, node0: int,
                               "stride", "row_tile", "feat_group")
 )
 def build_histogram_pallas(bins, gpair, pos, *, node0: int, n_nodes: int,
-                           n_bin: int, interpret: bool = False, stride: int = 1,
+                           n_bin: int, interpret=None, stride: int = 1,
                            row_tile: int = 0, feat_group: int = 0):
     """hist (n_nodes, F, B, 2) — drop-in for ops/histogram.build_histogram.
 
@@ -109,6 +112,10 @@ def build_histogram_pallas(bins, gpair, pos, *, node0: int, n_nodes: int,
     of 0 select the VMEM-budget autotune (choose_tiles); the module globals
     remain overridable for sweeps.
     """
+    if interpret is None:
+        # auto: lower to Mosaic on TPU, run the Pallas interpreter elsewhere
+        # so the hist_impl="pallas" grower path works (slowly) off-TPU
+        interpret = jax.default_backend() != "tpu"
     R, F = bins.shape
     # explicit kwargs > module-global sweep override > autotune; a partial
     # override (one of the two) autotunes only the missing dimension
@@ -156,4 +163,107 @@ def build_histogram_pallas(bins, gpair, pos, *, node0: int, n_nodes: int,
     )(bins, gpair, pos[:, None].astype(jnp.int32))
     # (F_pad, B, 2N) -> (N, F, B, 2)
     hist = out[:F].reshape(F, n_bin, n_nodes, 2).transpose(2, 0, 1, 3)
+    return hist
+
+
+def _hist_kernel_q(bins_ref, gq_ref, pos_ref, out_ref, *, node0: int,
+                   n_nodes: int, n_bin: int, feat_group: int, stride: int,
+                   n_ch: int):
+    """Quantised variant: int8 one-hot x int8 limb operand -> int32 MXU
+    accumulation.  Integer partial sums are exact and associative, so the
+    kernel output is bitwise identical for ANY grid order or topology — the
+    reference's GradientQuantiser contract (quantiser.cuh:52) inside the
+    production kernel."""
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    pos = pos_ref[:, 0]  # (T,)
+    gq = gq_ref[:, :n_ch]  # (T, C*3) int8 limbs
+    nodes = node0 + stride * jax.lax.iota(jnp.int32, n_nodes)
+    nodemask = (pos[:, None] == nodes[None, :]).astype(jnp.int8)  # (T, N)
+    T = gq.shape[0]
+    # 0/1 mask times a limb is the limb: product stays int8-safe
+    gm = (nodemask[:, :, None] * gq[:, None, :]).reshape(T, n_nodes * n_ch)
+
+    bin_ids = jax.lax.iota(jnp.int32, n_bin)
+    for f in range(feat_group):  # static unroll
+        b = bins_ref[:, f].astype(jnp.int32)
+        onehot = (b[:, None] == bin_ids[None, :]).astype(jnp.int8)  # (T, B)
+        acc = jax.lax.dot_general(
+            onehot, gm,
+            dimension_numbers=(((0,), (0,)), ((), ())),  # (B, N*n_ch)
+            preferred_element_type=jnp.int32,
+        )
+        out_ref[f] = out_ref[f] + acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("node0", "n_nodes", "n_bin", "interpret",
+                              "stride", "row_tile", "feat_group")
+)
+def build_histogram_pallas_q(bins, gq, pos, *, node0: int, n_nodes: int,
+                             n_bin: int, interpret=None,
+                             stride: int = 1, row_tile: int = 0,
+                             feat_group: int = 0):
+    """Quantised Pallas histogram: (n_nodes, F, B, C, 3) int32 — drop-in for
+    ops/quantise.hist_accumulate_q on TPU, keeping the bitwise
+    topology-free determinism contract inside the fused VMEM kernel.
+
+    gq (R_pad, C, 3) int8 signed base-256 limbs (ops/quantise.quantise_gpair).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    R, F = bins.shape
+    C, L = gq.shape[1], gq.shape[2]
+    n_ch = C * L
+    gq = gq.reshape(R, n_ch)
+    T = row_tile or _ROW_TILE
+    FG = feat_group or _FEAT_GROUP
+    if not (T and FG):
+        at, afg = choose_tiles(F, n_bin, n_nodes, bins.dtype.itemsize,
+                               out_ch=n_ch)
+        T, FG = T or at, FG or afg
+    if R % T:
+        pad = T - R % T
+        bins = jnp.pad(bins, ((0, pad), (0, 0)), constant_values=n_bin)
+        gq = jnp.pad(gq, ((0, pad), (0, 0)))
+        pos = jnp.pad(pos, (0, pad), constant_values=-1)
+        R += pad
+    n_fg = (F + FG - 1) // FG
+    F_pad = n_fg * FG
+
+    kernel = functools.partial(
+        _hist_kernel_q, node0=node0, n_nodes=n_nodes, n_bin=n_bin,
+        feat_group=FG, stride=stride, n_ch=n_ch,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_fg, R // T),
+        in_specs=[
+            pl.BlockSpec((T, FG), lambda fg, i: (i, fg), memory_space=pltpu.VMEM),
+            pl.BlockSpec((T, n_ch), lambda fg, i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((T, 1), lambda fg, i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (FG, n_bin, n_ch * n_nodes), lambda fg, i: (fg, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((F_pad, n_bin, n_ch * n_nodes),
+                                       jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * R * F_pad * n_bin * n_ch * n_nodes,
+            bytes_accessed=R * F_pad * bins.dtype.itemsize + R * n_ch * n_fg
+            + F_pad * n_bin * n_ch * n_nodes * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(bins, gq, pos[:, None].astype(jnp.int32))
+    # (F_pad, B, N*C*L) -> (N, F, B, C, L)
+    hist = out[:F].reshape(F, n_bin, n_nodes, C, L).transpose(2, 0, 1, 3, 4)
     return hist

@@ -74,20 +74,66 @@ def build_histogram(
 
 
 def hist_impl_override():
-    """Test hook: XTB_HIST_IMPL=matmul|scatter forces the implementation
-    regardless of backend, so the TPU matmul path keeps CPU CI coverage
-    (tests/test_hist_kernels.py) and vice versa."""
+    """Test hook: XTB_HIST_IMPL=matmul|scatter|native forces the
+    implementation regardless of backend, so the TPU matmul path keeps CPU
+    CI coverage (tests/test_hist_kernels.py) and vice versa."""
     import os
 
     v = os.environ.get("XTB_HIST_IMPL", "").lower()
-    return v if v in ("matmul", "scatter") else None
+    return v if v in ("matmul", "scatter", "native") else None
+
+
+def _native_hist_available() -> bool:
+    from ..utils import native
+
+    return native.ffi_usable()
+
+
+def _host_impl():
+    """Implementation for the CPU backend: the native C++ row-pass kernel
+    (native/xtb_kernels.h via an XLA FFI custom call, ~5-10x the XLA
+    scatter's add rate) when the handler library is present, else the XLA
+    scatter driver."""
+    forced = hist_impl_override()
+    if forced == "native":
+        # the forced hook must still register the FFI targets (and is the
+        # one place where failure should be loud, not a silent fallback)
+        from ..utils import native
+
+        if not native.load_ffi():
+            raise RuntimeError(
+                "XTB_HIST_IMPL=native but the FFI kernel library could not "
+                "be built/loaded (see native/Makefile `make ffi`)")
+        return "native"
+    if forced is not None:
+        return forced
+    if jax.default_backend() != "cpu":
+        return "matmul"
+    return "native" if _native_hist_available() else "scatter"
 
 
 def _use_scatter() -> bool:
-    forced = hist_impl_override()
-    if forced is not None:
-        return forced == "scatter"
-    return jax.default_backend() == "cpu"
+    return _host_impl() in ("scatter", "native")
+
+
+def _native_hist(bins, gpair, pos, node0, n_nodes, n_bin, stride):
+    """XLA FFI custom call into the native hist kernel (CPU backend only).
+
+    node0 may be traced (the padded shared level program) — it rides as an
+    operand.  Works under shard_map: the custom call fires per shard on that
+    shard's rows, exactly the partial-histogram semantics the psum expects."""
+    import numpy as np
+
+    R, F = bins.shape
+    C = gpair.shape[1]
+    if bins.dtype not in (jnp.uint8, jnp.uint16, jnp.int32):
+        bins = bins.astype(jnp.int32)
+    call = jax.ffi.ffi_call(
+        "xtb_hist",
+        jax.ShapeDtypeStruct((n_nodes, F, n_bin, C), jnp.float32))
+    return call(bins, gpair.astype(jnp.float32), pos.astype(jnp.int32),
+                jnp.asarray(node0, jnp.int32).reshape(1),
+                stride=np.int32(stride))
 
 
 def scatter_hist_driver(bins, values, pos, node0, n_nodes, n_bin, stride,
@@ -148,7 +194,10 @@ def scatter_hist_driver(bins, values, pos, node0, n_nodes, n_bin, stride,
 def _hist_accumulate(bins, gpair, pos, node0, n_nodes, n_bin, chunk, stride):
     """Fixed-order chunked accumulation shared by the static- and
     traced-node0 entry points (node0 may be an int or a traced scalar)."""
-    if _use_scatter():
+    impl = _host_impl()
+    if impl == "native":
+        return _native_hist(bins, gpair, pos, node0, n_nodes, n_bin, stride)
+    if impl == "scatter":
         return scatter_hist_driver(bins, gpair, pos, node0, n_nodes, n_bin,
                                    stride, gpair.shape[1], jnp.float32)
     R, F = bins.shape

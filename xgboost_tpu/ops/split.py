@@ -188,6 +188,64 @@ def evaluate_splits_multi(hist, totals, n_bins, params: SplitParams,
     )
 
 
+def _native_split_ok(params: SplitParams) -> bool:
+    """The native one-pass gain scan covers the numeric, unconstrained case
+    (the ladder benchmarks); categorical and monotone keep the XLA path."""
+    import os
+
+    if os.environ.get("XTB_NO_NATIVE_SPLIT", ""):
+        return False
+    if jax.default_backend() != "cpu":
+        return False
+    if params.monotone is not None and any(c != 0 for c in params.monotone):
+        return False
+    from ..utils import native
+
+    return native.ffi_usable()
+
+
+def _evaluate_splits_native(hist, totals, n_bins, params: SplitParams,
+                            feature_mask) -> BestSplit:
+    """XLA FFI custom call into xtb_split_scan — one bin pass per (node,
+    feature) instead of the XLA formulation's ~15 materialized (N,F,B)
+    temporaries.  Same decisions (both missing directions scored,
+    first-occurrence argmax in (feature, bin) order)."""
+    import numpy as np
+
+    N, F, B, _ = hist.shape
+    fm = (jnp.ones((N, F), bool) if feature_mask is None
+          else jnp.broadcast_to(
+              feature_mask if feature_mask.ndim == 2 else feature_mask[None],
+              (N, F)))
+    shapes = (jax.ShapeDtypeStruct((N,), jnp.float32),
+              jax.ShapeDtypeStruct((N,), jnp.int32),
+              jax.ShapeDtypeStruct((N,), jnp.int32),
+              jax.ShapeDtypeStruct((N,), jnp.uint8),
+              jax.ShapeDtypeStruct((N,), jnp.float32),
+              jax.ShapeDtypeStruct((N,), jnp.float32))
+    call = jax.ffi.ffi_call("xtb_split", shapes)
+    gain, feat, bin_, dleft, GL, HL = call(
+        hist.astype(jnp.float32), totals.astype(jnp.float32),
+        n_bins.astype(jnp.int32), fm.astype(jnp.uint8),
+        lam=np.float32(params.lambda_), alpha=np.float32(params.alpha),
+        mcw=np.float32(params.min_child_weight),
+        mds=np.float32(params.max_delta_step))
+    GR = totals[:, 0] - GL
+    HR = totals[:, 1] - HL
+    return BestSplit(
+        gain=gain,
+        feature=feat,
+        bin=bin_,
+        default_left=dleft.astype(bool),
+        left_sum=jnp.stack([GL, HL], axis=1),
+        right_sum=jnp.stack([GR, HR], axis=1),
+        left_weight=calc_weight(GL, HL, params),
+        right_weight=calc_weight(GR, HR, params),
+        is_cat=jnp.zeros(N, bool),
+        cat_set=jnp.zeros((N, B), bool),
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("params",))
 def evaluate_splits(
     hist, totals, n_bins, params: SplitParams, feature_mask=None, node_bounds=None,
@@ -204,6 +262,9 @@ def evaluate_splits(
     """
     N, F, B, _ = hist.shape
     has_cat = cat_mask is not None
+    if not has_cat and _native_split_ok(params):
+        return _evaluate_splits_native(hist, totals, n_bins, params,
+                                       feature_mask)
 
     if has_cat:
         # Categorical features (reference: evaluate_splits.cu one-hot pass +

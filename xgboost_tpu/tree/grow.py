@@ -279,13 +279,18 @@ def level_step(
         from ..ops.quantise import dequantise, hist_accumulate_q
 
         if hist_impl == "pallas":
-            raise NotImplementedError(
-                "deterministic_histogram with hist_impl='pallas' is not "
-                "supported yet — the Pallas kernel accumulates f32")
+            # int8 x int8 -> int32 MXU kernel: the determinism contract and
+            # the production kernel at once (VERDICT r4 #4)
+            from ..ops.hist_pallas import build_histogram_pallas_q
 
-        def _build(b, g, p, *, node0, n_nodes, n_bin, stride=1):
-            return hist_accumulate_q(b, g, p, node0, n_nodes, n_bin,
-                                     stride=stride)
+            def _build(b, g, p, *, node0, n_nodes, n_bin, stride=1):
+                return build_histogram_pallas_q(
+                    b, g, p, node0=node0, n_nodes=n_nodes, n_bin=n_bin,
+                    stride=stride)
+        else:
+            def _build(b, g, p, *, node0, n_nodes, n_bin, stride=1):
+                return hist_accumulate_q(b, g, p, node0, n_nodes, n_bin,
+                                         stride=stride)
     elif hist_impl == "pallas":
         from ..ops.hist_pallas import build_histogram_pallas as _build
     else:

@@ -73,8 +73,133 @@ def load_native() -> Optional[ctypes.CDLL]:
     lib.xtb_summary_total.restype = c.c_double
     lib.xtb_summary_total.argtypes = [c.c_void_p]
     lib.xtb_summary_free.argtypes = [c.c_void_p]
+    lib.xtb_hist_build.argtypes = [
+        c.c_void_p, c.c_int32, c.c_void_p, c.c_void_p, c.c_int64, c.c_int32,
+        c.c_int32, c.c_int32, c.c_int32, c.c_int32, c.c_int32, c.c_void_p]
+    lib.xtb_split_scan.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int32, c.c_int32,
+        c.c_int32, c.c_float, c.c_float, c.c_float, c.c_float,
+        c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+        c.c_void_p]
     _LIB = lib
     return lib
+
+
+_BIN_KIND = {np.dtype(np.uint8): 0, np.dtype(np.uint16): 1,
+             np.dtype(np.int32): 2}
+
+_FFI_READY: Optional[bool] = None
+
+# Distributed veto: when a multi-process communicator finds the FFI kernels
+# unavailable on ANY rank, every rank must take the XLA formulations —
+# split gains differ from the native scan in the last ulp, and
+# heterogeneous per-rank impls could pick different near-tie splits on the
+# redundant per-process evaluation (collective.py flips this at init).
+FFI_DISTRIBUTED_VETO = False
+
+
+def load_ffi() -> bool:
+    """Build/load the XLA FFI handler library and register its targets.
+
+    Returns True when ``xtb_hist`` / ``xtb_split`` are registered as CPU
+    custom calls (jax.ffi).  The pure_callback route is NOT used as a
+    fallback — jax 0.9's CPU host-callback deadlocks on large operands —
+    callers fall back to the XLA scatter/cumsum formulations instead."""
+    global _FFI_READY
+    if _FFI_READY is not None:
+        return _FFI_READY
+    _FFI_READY = False
+    nd = _native_dir()
+    so = os.path.join(nd, "libxtb_ffi.so")
+    srcs = [os.path.join(nd, n) for n in ("xtb_ffi.cc", "xtb_kernels.h")]
+    try:
+        stale = (not os.path.exists(so)
+                 or any(os.path.exists(s)
+                        and os.path.getmtime(s) > os.path.getmtime(so)
+                        for s in srcs))
+        if stale:
+            # serialize concurrent builders (multi-process training on one
+            # host): the Makefile writes via a temp + rename, the flock
+            # makes sure only one make runs and the rest wait for it
+            import fcntl
+
+            with open(os.path.join(nd, ".ffi_build.lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                try:
+                    subprocess.run(["make", "-C", nd, "ffi"],
+                                   capture_output=True, timeout=180,
+                                   check=True)
+                finally:
+                    fcntl.flock(lk, fcntl.LOCK_UN)
+        import ctypes as c
+
+        import jax
+
+        lib = c.CDLL(so)
+        jax.ffi.register_ffi_target(
+            "xtb_hist", jax.ffi.pycapsule(lib.XtbHist), platform="cpu")
+        jax.ffi.register_ffi_target(
+            "xtb_split", jax.ffi.pycapsule(lib.XtbSplit), platform="cpu")
+        _FFI_READY = True
+    except Exception:
+        _FFI_READY = False
+    return _FFI_READY
+
+
+def ffi_usable() -> bool:
+    """load_ffi() minus the distributed veto — the gate compute paths use."""
+    return not FFI_DISTRIBUTED_VETO and load_ffi()
+
+
+def hist_build(bins: np.ndarray, gpair: np.ndarray, pos: np.ndarray,
+               node0: int, n_nodes: int, n_bin: int, stride: int
+               ) -> np.ndarray:
+    """Native gradient histogram: (R,F) bins x (R,C) gpair -> (N,F,B,C) f32.
+
+    Caller guarantees the lib is loaded (check load_native() first) and that
+    ``bins.dtype`` is uint8/uint16/int32 (the Ellpack dtypes)."""
+    lib = load_native()
+    R, F = bins.shape
+    C = gpair.shape[1]
+    bins = np.ascontiguousarray(bins)
+    gpair = np.ascontiguousarray(gpair, np.float32)
+    pos = np.ascontiguousarray(pos, np.int32)
+    out = np.empty((n_nodes, F, n_bin, C), np.float32)
+    lib.xtb_hist_build(
+        bins.ctypes.data, _BIN_KIND[bins.dtype], gpair.ctypes.data,
+        pos.ctypes.data, R, F, n_bin, int(node0), n_nodes, stride, C,
+        out.ctypes.data)
+    return out
+
+
+def split_scan(hist: np.ndarray, totals: np.ndarray, n_bins: np.ndarray,
+               fmask: np.ndarray, lambda_: float, alpha: float,
+               min_child_weight: float, max_delta_step: float):
+    """Native split gain scan over (N,F,B,2) f32 hist (numeric features).
+
+    Returns (gain f32, feature i32, bin i32, dleft u8, GL f32, HL f32),
+    each (N,) — the chosen-direction left-child sums included so the caller
+    derives the rest without re-walking bins."""
+    lib = load_native()
+    N, F, B, _ = hist.shape
+    hist = np.ascontiguousarray(hist, np.float32)
+    totals = np.ascontiguousarray(totals, np.float32)
+    n_bins = np.ascontiguousarray(n_bins, np.int32)
+    fmask = np.ascontiguousarray(
+        np.broadcast_to(fmask, (N, F)), np.uint8)
+    gain = np.empty(N, np.float32)
+    feat = np.empty(N, np.int32)
+    bin_ = np.empty(N, np.int32)
+    dleft = np.empty(N, np.uint8)
+    GL = np.empty(N, np.float32)
+    HL = np.empty(N, np.float32)
+    lib.xtb_split_scan(
+        hist.ctypes.data, totals.ctypes.data, n_bins.ctypes.data,
+        fmask.ctypes.data, N, F, B, float(lambda_), float(alpha),
+        float(min_child_weight), float(max_delta_step), gain.ctypes.data,
+        feat.ctypes.data, bin_.ctypes.data, dleft.ctypes.data,
+        GL.ctypes.data, HL.ctypes.data)
+    return gain, feat, bin_, dleft, GL, HL
 
 
 def parse_libsvm(path: str):
