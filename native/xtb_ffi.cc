@@ -44,6 +44,12 @@ static ffi::Error XtbHistImpl(ffi::AnyBuffer bins,
           gpair.typed_data(), pos.typed_data(), R, F, B, n0, N, stride, C,
           out->typed_data());
       break;
+    case ffi::S16:
+      xtb_hist_build_impl(
+          static_cast<const int16_t*>(bins.untyped_data()),
+          gpair.typed_data(), pos.typed_data(), R, F, B, n0, N, stride, C,
+          out->typed_data());
+      break;
     case ffi::S32:
       xtb_hist_build_impl(
           static_cast<const int32_t*>(bins.untyped_data()),
@@ -89,6 +95,132 @@ static ffi::Error XtbSplitImpl(
                       GL->typed_data(), HL->typed_data());
   return ffi::Error::Success();
 }
+
+// predict (raw values): (X[R,F] f32, feat[T,M] i32, thr f32, dleft u8,
+// left i32, right i32, value[T,M] or [T,M,K] f32, groups[T] i32,
+// is_cat[T,M] u8, catm[T,M,Bc] u8, init[R,K] f32) + attrs (depth, has_cat)
+// -> out[R,K] f32
+static ffi::Error XtbPredictImpl(
+    ffi::Buffer<ffi::F32> X, ffi::Buffer<ffi::S32> feat,
+    ffi::Buffer<ffi::F32> thr, ffi::Buffer<ffi::U8> dleft,
+    ffi::Buffer<ffi::S32> left, ffi::Buffer<ffi::S32> right,
+    ffi::AnyBuffer value, ffi::Buffer<ffi::S32> groups,
+    ffi::Buffer<ffi::U8> is_cat, ffi::Buffer<ffi::U8> catm,
+    ffi::Buffer<ffi::F32> init, int32_t depth, int32_t has_cat,
+    ffi::ResultBuffer<ffi::F32> out) {
+  auto xd = X.dimensions();
+  auto fd = feat.dimensions();
+  auto od = out->dimensions();
+  auto vd = value.dimensions();
+  if (xd.size() != 2 || fd.size() != 2 || od.size() != 2 ||
+      value.element_type() != ffi::F32) {
+    return ffi::Error::InvalidArgument("xtb_predict: bad shapes");
+  }
+  const int64_t R = xd[0];
+  const int32_t F = static_cast<int32_t>(xd[1]);
+  const int32_t T = static_cast<int32_t>(fd[0]);
+  const int32_t M = static_cast<int32_t>(fd[1]);
+  const int32_t K = static_cast<int32_t>(od[1]);
+  const int32_t K_leaf =
+      vd.size() == 3 ? static_cast<int32_t>(vd[2]) : 1;
+  const int32_t Bc =
+      catm.dimensions().size() == 3
+          ? static_cast<int32_t>(catm.dimensions()[2]) : 1;
+  xtb_predict_raw_impl(
+      X.typed_data(), R, F, feat.typed_data(), thr.typed_data(),
+      dleft.typed_data(), left.typed_data(), right.typed_data(),
+      static_cast<const float*>(value.untyped_data()), groups.typed_data(),
+      T, M, depth, K, K_leaf, has_cat, is_cat.typed_data(),
+      catm.typed_data(), Bc, init.typed_data(), out->typed_data());
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(XtbPredict, XtbPredictImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::U8>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::U8>>()
+                                  .Arg<ffi::Buffer<ffi::U8>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Attr<int32_t>("depth")
+                                  .Attr<int32_t>("has_cat")
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+// predict over a binned page: bins[R,F] u8|u16|i32 + sbin routing
+static ffi::Error XtbPredictBinnedImpl(
+    ffi::AnyBuffer bins, ffi::Buffer<ffi::S32> feat,
+    ffi::Buffer<ffi::S32> sbin, ffi::Buffer<ffi::U8> dleft,
+    ffi::Buffer<ffi::S32> left, ffi::Buffer<ffi::S32> right,
+    ffi::Buffer<ffi::F32> value, ffi::Buffer<ffi::S32> groups,
+    ffi::Buffer<ffi::U8> is_cat, ffi::Buffer<ffi::U8> catm,
+    ffi::Buffer<ffi::F32> init, int32_t depth, int32_t has_cat,
+    int32_t n_bin, ffi::ResultBuffer<ffi::F32> out) {
+  auto bd = bins.dimensions();
+  auto fd = feat.dimensions();
+  auto od = out->dimensions();
+  if (bd.size() != 2 || fd.size() != 2 || od.size() != 2) {
+    return ffi::Error::InvalidArgument("xtb_predict_binned: bad shapes");
+  }
+  const int64_t R = bd[0];
+  const int32_t F = static_cast<int32_t>(bd[1]);
+  const int32_t T = static_cast<int32_t>(fd[0]);
+  const int32_t M = static_cast<int32_t>(fd[1]);
+  const int32_t K = static_cast<int32_t>(od[1]);
+  const int32_t Bc =
+      catm.dimensions().size() == 3
+          ? static_cast<int32_t>(catm.dimensions()[2]) : 1;
+#define XTB_PB(TYPE)                                                        \
+  xtb_predict_binned_impl(static_cast<const TYPE*>(bins.untyped_data()), R, \
+                          F, n_bin, feat.typed_data(), sbin.typed_data(),   \
+                          dleft.typed_data(), left.typed_data(),            \
+                          right.typed_data(), value.typed_data(),           \
+                          groups.typed_data(), T, M, depth, K, has_cat,     \
+                          is_cat.typed_data(), catm.typed_data(), Bc,       \
+                          init.typed_data(), out->typed_data())
+  switch (bins.element_type()) {
+    case ffi::U8:
+      XTB_PB(uint8_t);
+      break;
+    case ffi::U16:
+      XTB_PB(uint16_t);
+      break;
+    case ffi::S16:
+      XTB_PB(int16_t);
+      break;
+    case ffi::S32:
+      XTB_PB(int32_t);
+      break;
+    default:
+      return ffi::Error::InvalidArgument(
+          "xtb_predict_binned: unsupported bin dtype");
+  }
+#undef XTB_PB
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(XtbPredictBinned, XtbPredictBinnedImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::U8>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::U8>>()
+                                  .Arg<ffi::Buffer<ffi::U8>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Attr<int32_t>("depth")
+                                  .Attr<int32_t>("has_cat")
+                                  .Attr<int32_t>("n_bin")
+                                  .Ret<ffi::Buffer<ffi::F32>>());
 
 XLA_FFI_DEFINE_HANDLER_SYMBOL(XtbSplit, XtbSplitImpl,
                               ffi::Ffi::Bind()

@@ -185,4 +185,89 @@ inline void xtb_split_scan_impl(const float* hist, const float* totals,
   }
 }
 
+// ---------------------------------------------------------------------------
+// Ensemble margin prediction — rows outer, trees inner, so each row's f32
+// adds happen in tree order (bitwise-identical to the XLA scan in
+// ops/predict.py, which the prediction-cache continuation contract relies
+// on) and each X row is read once while the small tree arrays stay hot.
+// Mirrors ops/predict.py predict_margin_delta semantics exactly: fixed
+// `depth` steps with stick-at-leaf, NaN -> default-left, categorical
+// in-set -> right.  K_leaf == 1 adds the scalar leaf to column groups[t];
+// K_leaf > 1 adds the leaf vector to all K columns (multi-target trees).
+// ---------------------------------------------------------------------------
+inline void xtb_predict_raw_impl(
+    const float* X, int64_t R, int32_t F, const int32_t* feat,
+    const float* thr, const uint8_t* dleft, const int32_t* left,
+    const int32_t* right, const float* value, const int32_t* groups,
+    int32_t T, int32_t M, int32_t depth, int32_t K, int32_t K_leaf,
+    int32_t has_cat, const uint8_t* is_cat, const uint8_t* catm, int32_t Bc,
+    const float* init, float* out) {
+  memcpy(out, init, static_cast<size_t>(R) * K * sizeof(float));
+  for (int64_t r = 0; r < R; ++r) {
+    const float* xr = X + r * F;
+    float* orow = out + r * K;
+    for (int32_t t = 0; t < T; ++t) {
+      const size_t base = static_cast<size_t>(t) * M;
+      int32_t nid = 0;
+      for (int32_t d = 0; d < depth; ++d) {
+        const int32_t fi = feat[base + nid];
+        if (fi < 0) break;
+        const float x = xr[fi];
+        const bool miss = std::isnan(x);
+        bool gol;
+        if (has_cat && is_cat[base + nid]) {
+          const int32_t c = miss ? -1 : static_cast<int32_t>(x);
+          const bool member =
+              c >= 0 && c < Bc && catm[(base + nid) * Bc + c];
+          gol = miss ? (dleft[base + nid] != 0) : !member;
+        } else {
+          gol = miss ? (dleft[base + nid] != 0) : (x < thr[base + nid]);
+        }
+        nid = gol ? left[base + nid] : right[base + nid];
+      }
+      if (K_leaf == 1) {
+        orow[groups[t]] += value[base + nid];
+      } else {
+        const float* v = value + (base + nid) * K_leaf;
+        for (int32_t k = 0; k < K_leaf; ++k) orow[k] += v[k];
+      }
+    }
+  }
+}
+
+// Binned variant (split_bins routing over an Ellpack page; sentinel
+// b >= n_bin = missing) — ops/predict.py predict_margin_delta_binned.
+template <typename BinT>
+inline void xtb_predict_binned_impl(
+    const BinT* bins, int64_t R, int32_t F, int32_t n_bin,
+    const int32_t* feat, const int32_t* sbin, const uint8_t* dleft,
+    const int32_t* left, const int32_t* right, const float* value,
+    const int32_t* groups, int32_t T, int32_t M, int32_t depth, int32_t K,
+    int32_t has_cat, const uint8_t* is_cat, const uint8_t* catm, int32_t Bc,
+    const float* init, float* out) {
+  memcpy(out, init, static_cast<size_t>(R) * K * sizeof(float));
+  for (int64_t r = 0; r < R; ++r) {
+    const BinT* br = bins + r * F;
+    float* orow = out + r * K;
+    for (int32_t t = 0; t < T; ++t) {
+      const size_t base = static_cast<size_t>(t) * M;
+      int32_t nid = 0;
+      for (int32_t d = 0; d < depth; ++d) {
+        const int32_t fi = feat[base + nid];
+        if (fi < 0) break;
+        const int32_t b = static_cast<int32_t>(br[fi]);
+        bool gol;
+        if (has_cat && is_cat[base + nid]) {
+          gol = !(b < Bc && catm[(base + nid) * Bc + b]);
+        } else {
+          gol = b <= sbin[base + nid];
+        }
+        if (b >= n_bin) gol = dleft[base + nid] != 0;
+        nid = gol ? left[base + nid] : right[base + nid];
+      }
+      orow[groups[t]] += value[base + nid];
+    }
+  }
+}
+
 #endif  // XTB_KERNELS_H_

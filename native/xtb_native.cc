@@ -238,45 +238,6 @@ struct QuantileSummary {
   }
 };
 
-}  // extern "C" — kernel bodies live in xtb_kernels.h (shared with the
-// XLA FFI handlers in xtb_ffi.cc)
-
-#include "xtb_kernels.h"
-
-extern "C" {
-
-// bin_kind: 0 = uint8, 1 = uint16, 2 = int32 (Ellpack picks the smallest
-// dtype that fits max_bin — data/ellpack.py _bin_dtype)
-void xtb_hist_build(const void* bins, int32_t bin_kind, const float* gpair,
-                    const int32_t* pos, int64_t R, int32_t F, int32_t n_bin,
-                    int32_t node0, int32_t n_nodes, int32_t stride, int32_t C,
-                    float* out) {
-  switch (bin_kind) {
-    case 0:
-      xtb_hist_build_impl(static_cast<const uint8_t*>(bins), gpair, pos, R, F,
-                          n_bin, node0, n_nodes, stride, C, out);
-      break;
-    case 1:
-      xtb_hist_build_impl(static_cast<const uint16_t*>(bins), gpair, pos, R,
-                          F, n_bin, node0, n_nodes, stride, C, out);
-      break;
-    default:
-      xtb_hist_build_impl(static_cast<const int32_t*>(bins), gpair, pos, R, F,
-                          n_bin, node0, n_nodes, stride, C, out);
-  }
-}
-
-void xtb_split_scan(const float* hist, const float* totals,
-                    const int32_t* n_bins, const uint8_t* fmask, int32_t N,
-                    int32_t F, int32_t B, float lambda_, float alpha,
-                    float min_child_weight, float max_delta_step,
-                    float* out_gain, int32_t* out_feat, int32_t* out_bin,
-                    uint8_t* out_dleft, float* out_GL, float* out_HL) {
-  xtb_split_scan_impl(hist, totals, n_bins, fmask, N, F, B, lambda_, alpha,
-                      min_child_weight, max_delta_step, out_gain, out_feat,
-                      out_bin, out_dleft, out_GL, out_HL);
-}
-
 void* xtb_summary_new(int64_t budget) { return new QuantileSummary(budget); }
 void xtb_summary_push(void* h, const float* vals, const float* wts, int64_t n) {
   static_cast<QuantileSummary*>(h)->push(vals, wts, n);

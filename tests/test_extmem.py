@@ -278,3 +278,67 @@ def test_extmem_twenty_pages_mesh_parity(eight_devices):
     err_ext = np.mean((p_ext > 0.5) != y_all)
     err_mem = np.mean((p_mem > 0.5) != y_all)
     assert err_ext <= err_mem + 0.02, (err_ext, err_mem)
+
+
+def test_prefetch_overlap_under_simulated_transfer(monkeypatch):
+    """Prefetch must actually overlap page transfer with page compute
+    (VERDICT r4 #6).  The CPU backend has no real H2D DMA, so a synthetic
+    per-byte latency (XTB_EXTMEM_SIM_TRANSFER_MS_PER_MB) stands in: the
+    sleep in _put_page yields the core while XLA's async-dispatched page
+    compute proceeds — the same concurrency shape as device compute under
+    a real transfer.  The matmul hist impl keeps compute comparable to the
+    simulated transfer (the TPU-like compute profile); gain is measured as
+    serialized wall / prefetch wall over identical trees."""
+    import time
+
+    from xgboost_tpu.data.extmem import DataIter, ExtMemQuantileDMatrix
+
+    rng = np.random.default_rng(5)
+    n_pages, rows_page, F = 4, 16384, 64
+    X_all = rng.normal(size=(n_pages * rows_page, F)).astype(np.float32)
+    y_all = (X_all[:, 0] + 0.3 * rng.normal(size=len(X_all)) > 0).astype(
+        np.float32)
+
+    class Pages(DataIter):
+        def __init__(self):
+            super().__init__()
+            self._i = 0
+
+        def next(self, input_data):
+            if self._i >= n_pages:
+                return 0
+            lo = self._i * rows_page
+            input_data(data=X_all[lo:lo + rows_page],
+                       label=y_all[lo:lo + rows_page])
+            self._i += 1
+            return 1
+
+        def reset(self):
+            self._i = 0
+
+    # pages are uint8-binned (16384 x 64 = 1 MB); 400 ms/MB puts the
+    # simulated transfer in the same band as the per-page matmul compute
+    # (~0.4 s each) — the regime where overlap shows, like a TPU fed over
+    # PCIe.  The sleep must dominate the (non-overlappable, host-side)
+    # zstd decompress for the measurement to isolate transfer overlap.
+    monkeypatch.setenv("XTB_HIST_IMPL", "matmul")
+    monkeypatch.setenv("XTB_EXTMEM_SIM_TRANSFER_MS_PER_MB", "400")
+    d = ExtMemQuantileDMatrix(Pages(), max_bin=64)
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+              "max_bin": 64}
+
+    def run(prefetch: str):
+        p = {**params, "_extmem_prefetch": prefetch}
+        xtb.train(p, d, 1, verbose_eval=False)  # compile warmup
+        t0 = time.perf_counter()
+        bst = xtb.train(p, d, 2, verbose_eval=False)
+        import jax
+
+        jax.block_until_ready(bst._caches[id(d)].margin)
+        return time.perf_counter() - t0, bst
+
+    wall_pre, bst_pre = run("1")
+    wall_ser, bst_ser = run("0")
+    assert bst_pre.get_dump() == bst_ser.get_dump()  # transparency
+    gain = wall_ser / wall_pre
+    assert gain > 1.2, (wall_ser, wall_pre, gain)

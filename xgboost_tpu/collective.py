@@ -327,6 +327,7 @@ def init(**args: Any) -> None:
             str(args["federated_server_address"]),
             int(args["federated_world_size"]),
             int(args["federated_rank"]))
+        _reconcile_native_kernels()
         return
     _PROCESS_BACKEND = JaxDistributedBackend(**args)
     _reconcile_native_kernels()

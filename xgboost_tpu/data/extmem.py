@@ -39,7 +39,9 @@ class CompressedPage:
     decompress on touch).
     """
 
-    __slots__ = ("shape", "dtype", "_blob", "_path", "nbytes_compressed")
+    # __weakref__ so the page cache can hang its eviction finalizer here
+    __slots__ = ("shape", "dtype", "_blob", "_path", "nbytes_compressed",
+                 "__weakref__")
 
     def __init__(self, arr: np.ndarray, path: Optional[str] = None):
         import zstandard as zstd
@@ -59,6 +61,9 @@ class CompressedPage:
     def __array__(self, dtype=None, copy=None):
         import zstandard as zstd
 
+        cached = _host_page_cache_get(self)
+        if cached is not None:
+            return cached if dtype is None else cached.astype(dtype)
         blob = self._blob
         if blob is None:
             with open(self._path, "rb") as fh:
@@ -66,7 +71,97 @@ class CompressedPage:
         out = np.frombuffer(
             zstd.ZstdDecompressor().decompress(blob), dtype=self.dtype
         ).reshape(self.shape)
+        _host_page_cache_put(self, out)
         return out if dtype is None else out.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Page cache (LRU, one shared byte budget, weakref-evicted).
+#
+# The reference keeps recently-used uncompressed pages host-resident too
+# (sparse_page_source.h cache + the cache_host_ratio knob): streaming
+# training touches every page once per LEVEL, so without this each of the
+# depth x rounds passes pays the full zstd decode again.  Two entry kinds
+# share ONE budget (XTB_EXTMEM_HOST_CACHE_MB, default 1024; 0 disables):
+#  - "host": the decompressed numpy bins (populated by __array__);
+#  - "dev":  the committed jax.Array on the CPU backend, where device
+#    memory IS host memory (tree/stream.py skips the per-level memcpy).
+# TPU never uses the "dev" kind — streaming exists because HBM cannot hold
+# the pages.  Entries hold no strong reference to the owning page; a
+# weakref finalizer evicts them when the page (and so its DMatrix) dies.
+# ---------------------------------------------------------------------------
+import weakref
+from collections import OrderedDict
+
+_PAGE_CACHE: "OrderedDict" = OrderedDict()  # (id(page), kind) -> array
+_PAGE_CACHE_BYTES = 0
+
+
+def _host_cache_budget() -> int:
+    import os
+
+    try:
+        mb = float(os.environ.get("XTB_EXTMEM_HOST_CACHE_MB", "1024"))
+    except ValueError:
+        mb = 1024.0
+    return int(mb * 2**20)
+
+
+def _page_cache_evict_page(pid: int) -> None:
+    global _PAGE_CACHE_BYTES
+    for kind in ("host", "dev"):
+        arr = _PAGE_CACHE.pop((pid, kind), None)
+        if arr is not None:
+            _PAGE_CACHE_BYTES -= arr.nbytes
+
+
+def _page_cache_get(page, kind: str):
+    hit = _PAGE_CACHE.get((id(page), kind))
+    if hit is not None:
+        _PAGE_CACHE.move_to_end((id(page), kind))
+    return hit
+
+
+def _page_cache_put(page, kind: str, arr) -> None:
+    global _PAGE_CACHE_BYTES
+    budget = _host_cache_budget()
+    if arr.nbytes > budget or (id(page), kind) in _PAGE_CACHE:
+        return
+    try:
+        weakref.finalize(page, _page_cache_evict_page, id(page))
+    except TypeError:
+        return  # not weakref-able: never cache (no safe eviction)
+    _PAGE_CACHE[(id(page), kind)] = arr
+    _PAGE_CACHE_BYTES += arr.nbytes
+    while _PAGE_CACHE_BYTES > budget and _PAGE_CACHE:
+        _, old = _PAGE_CACHE.popitem(last=False)
+        _PAGE_CACHE_BYTES -= old.nbytes
+
+
+def _host_page_cache_get(page):
+    return _page_cache_get(page, "host")
+
+
+def _host_page_cache_put(page, arr: np.ndarray) -> None:
+    _page_cache_put(page, "host", arr)
+
+
+def device_page_cache_get_or_put(page, make):
+    """CPU-backend committed-page cache (tree/stream.py _put_page): holds
+    the jax.Array so the per-level device_put memcpy disappears, under the
+    same shared budget as the decompress cache.  Never used on TPU."""
+    hit = _page_cache_get(page, "dev")
+    if hit is not None:
+        return hit
+    arr = make()
+    # the committed array supersedes the decompressed numpy copy — same
+    # bytes on the CPU backend, no reason to hold both
+    global _PAGE_CACHE_BYTES
+    host = _PAGE_CACHE.pop((id(page), "host"), None)
+    if host is not None:
+        _PAGE_CACHE_BYTES -= host.nbytes
+    _page_cache_put(page, "dev", arr)
+    return arr
 
 
 def _zstd_available() -> bool:

@@ -126,7 +126,7 @@ def _native_hist(bins, gpair, pos, node0, n_nodes, n_bin, stride):
 
     R, F = bins.shape
     C = gpair.shape[1]
-    if bins.dtype not in (jnp.uint8, jnp.uint16, jnp.int32):
+    if bins.dtype not in (jnp.uint8, jnp.uint16, jnp.int16, jnp.int32):
         bins = bins.astype(jnp.int32)
     call = jax.ffi.ffi_call(
         "xtb_hist",

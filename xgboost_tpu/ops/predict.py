@@ -45,6 +45,45 @@ def _traverse_one_tree(X, feat, thr, dleft, left, right, depth: int,
     return lax.fori_loop(0, depth, step, nid)
 
 
+def _native_predict_ok() -> bool:
+    """CPU-backend gate for the native traversal kernels (same per-host
+    agreement rules as the hist/split kernels — utils/native.py)."""
+    import os
+
+    if os.environ.get("XTB_NO_NATIVE_PREDICT", ""):
+        return False
+    if jax.default_backend() != "cpu":
+        return False
+    from ..utils import native
+
+    return native.ffi_usable()
+
+
+def _predict_native(X, feat, thr, dleft, left, right, value, groups,
+                    is_cat, catm, init, n_groups: int, depth: int):
+    """FFI custom call into xtb_predict_raw_impl — rows outer, trees inner,
+    per-row adds in tree order (bitwise-identical to the XLA scan)."""
+    import numpy as np
+
+    R = X.shape[0]
+    T, M = feat.shape
+    has_cat = is_cat is not None
+    ic = (is_cat.astype(jnp.uint8) if has_cat
+          else jnp.zeros((T, M), jnp.uint8))
+    cm = (catm.astype(jnp.uint8) if has_cat
+          else jnp.zeros((T, M, 1), jnp.uint8))
+    init_arr = (jnp.zeros((R, n_groups), jnp.float32) if init is None
+                else init.astype(jnp.float32))
+    call = jax.ffi.ffi_call(
+        "xtb_predict", jax.ShapeDtypeStruct((R, n_groups), jnp.float32))
+    return call(X.astype(jnp.float32), feat.astype(jnp.int32),
+                thr.astype(jnp.float32), dleft.astype(jnp.uint8),
+                left.astype(jnp.int32), right.astype(jnp.int32),
+                value.astype(jnp.float32), groups.astype(jnp.int32),
+                ic, cm, init_arr,
+                depth=np.int32(depth), has_cat=np.int32(has_cat))
+
+
 @functools.partial(jax.jit, static_argnames=("n_groups", "depth"))
 def predict_margin_delta(X, feat, thr, dleft, left, right, value, groups,
                          is_cat=None, catm=None, init=None, *,
@@ -59,6 +98,9 @@ def predict_margin_delta(X, feat, thr, dleft, left, right, value, groups,
     prediction caches are bitwise-identical to incrementally-updated ones
     (continuation via xgb_model= yields the same model as one straight run).
     """
+    if _native_predict_ok():
+        return _predict_native(X, feat, thr, dleft, left, right, value,
+                               groups, is_cat, catm, init, n_groups, depth)
     R = X.shape[0]
 
     def body(margin, t):
@@ -90,6 +132,13 @@ def predict_margin_delta_multi(X, feat, thr, dleft, left, right, value_vec,
 
     value_vec: (T, M, K) padded per-node leaf vectors.  ``init``: optional
     starting margin (see predict_margin_delta)."""
+    if _native_predict_ok():
+        # K_leaf > 1 makes the kernel add each leaf vector to all K columns;
+        # groups is unused on that path
+        T = feat.shape[0]
+        return _predict_native(X, feat, thr, dleft, left, right, value_vec,
+                               jnp.zeros(T, jnp.int32), None, None, init,
+                               value_vec.shape[2], depth)
     R = X.shape[0]
     K = value_vec.shape[2]
 
@@ -127,6 +176,30 @@ def predict_margin_delta_binned(bins, feat, sbin, dleft, left, right, value,
     optional starting margin (see predict_margin_delta — bitwise-faithful
     prediction-cache rebuilds).
     """
+    if _native_predict_ok():
+        import numpy as np
+
+        R = bins.shape[0]
+        T, M = feat.shape
+        has_cat = is_cat is not None
+        ic = (is_cat.astype(jnp.uint8) if has_cat
+              else jnp.zeros((T, M), jnp.uint8))
+        cm = (catm.astype(jnp.uint8) if has_cat
+              else jnp.zeros((T, M, 1), jnp.uint8))
+        init_arr = (jnp.zeros((R, n_groups), jnp.float32) if init is None
+                    else init.astype(jnp.float32))
+        b = bins
+        if b.dtype not in (jnp.uint8, jnp.uint16, jnp.int16, jnp.int32):
+            b = b.astype(jnp.int32)
+        call = jax.ffi.ffi_call(
+            "xtb_predict_binned",
+            jax.ShapeDtypeStruct((R, n_groups), jnp.float32))
+        return call(b, feat.astype(jnp.int32), sbin.astype(jnp.int32),
+                    dleft.astype(jnp.uint8), left.astype(jnp.int32),
+                    right.astype(jnp.int32), value.astype(jnp.float32),
+                    groups.astype(jnp.int32), ic, cm, init_arr,
+                    depth=np.int32(depth), has_cat=np.int32(has_cat),
+                    n_bin=np.int32(n_bin))
     R = bins.shape[0]
 
     def traverse(f, sb, dl, l, r, ic, cm):

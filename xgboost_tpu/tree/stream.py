@@ -32,6 +32,17 @@ from .grow import (TreeState, _record_level, _update_positions, init_tree_state,
 _EPS = 1e-6
 
 
+def _sim_transfer_ms_per_mb() -> float:
+    """Test hook: XTB_EXTMEM_SIM_TRANSFER_MS_PER_MB injects a synthetic
+    per-byte transfer latency into _put_page (see comment there)."""
+    import os
+
+    try:
+        return float(os.environ.get("XTB_EXTMEM_SIM_TRANSFER_MS_PER_MB", "0"))
+    except ValueError:
+        return 0.0
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("node0_prev", "n_prev", "node0", "n_nodes", "n_bin",
@@ -145,12 +156,40 @@ class StreamingHistTreeGrower:
         self.max_nodes = max_nodes_for_depth(max_depth)
 
     def _put_page(self, page_np):
+        sim_active = _sim_transfer_ms_per_mb() > 0.0
+        if (self.mesh is None and not sim_active
+                and jax.default_backend() == "cpu"):
+            # CPU backend: "device" memory IS host memory, so re-staging the
+            # same immutable page every level just burns memcpy — keep the
+            # committed array (budgeted LRU beside the decompress cache).
+            # On TPU this cache must NOT exist (streaming exists because
+            # HBM cannot hold the pages), and the simulated-transfer
+            # harness disables it to preserve TPU-like streaming.
+            from ..data.extmem import device_page_cache_get_or_put
+
+            return device_page_cache_get_or_put(
+                page_np, lambda: jax.device_put(
+                    np.ascontiguousarray(page_np)))
         arr = np.ascontiguousarray(page_np)
         if self.mesh is None:
-            return jax.device_put(arr)
-        from ..parallel.mesh import row2d_sharding
+            out = jax.device_put(arr)
+        else:
+            from ..parallel.mesh import row2d_sharding
 
-        return jax.device_put(arr, row2d_sharding(self.mesh))
+            out = jax.device_put(arr, row2d_sharding(self.mesh))
+        sim = _sim_transfer_ms_per_mb()
+        if sim > 0.0:
+            # Simulated H2D latency (VERDICT r4 #6): a sleep proportional to
+            # page bytes stands in for the DMA the CPU backend doesn't have.
+            # sleep yields the core, so XLA's async-dispatched page compute
+            # proceeds underneath exactly like device compute under a real
+            # transfer — making overlap_gain measurable without TPU.  The
+            # TPU measurement itself is bench.py's extmem phase (prefetch
+            # vs serialized round), unchanged.
+            import time
+
+            time.sleep(arr.nbytes / 1e6 * sim / 1e3)
+        return out
 
     def grow(self, pages: List, page_offsets: List[int], gpair, valid,
              cuts_pad, n_bins, feature_masks=None, cat_mask=None) -> TreeState:

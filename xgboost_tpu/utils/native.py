@@ -73,20 +73,9 @@ def load_native() -> Optional[ctypes.CDLL]:
     lib.xtb_summary_total.restype = c.c_double
     lib.xtb_summary_total.argtypes = [c.c_void_p]
     lib.xtb_summary_free.argtypes = [c.c_void_p]
-    lib.xtb_hist_build.argtypes = [
-        c.c_void_p, c.c_int32, c.c_void_p, c.c_void_p, c.c_int64, c.c_int32,
-        c.c_int32, c.c_int32, c.c_int32, c.c_int32, c.c_int32, c.c_void_p]
-    lib.xtb_split_scan.argtypes = [
-        c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int32, c.c_int32,
-        c.c_int32, c.c_float, c.c_float, c.c_float, c.c_float,
-        c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
-        c.c_void_p]
     _LIB = lib
     return lib
 
-
-_BIN_KIND = {np.dtype(np.uint8): 0, np.dtype(np.uint16): 1,
-             np.dtype(np.int32): 2}
 
 _FFI_READY: Optional[bool] = None
 
@@ -136,10 +125,12 @@ def load_ffi() -> bool:
         import jax
 
         lib = c.CDLL(so)
-        jax.ffi.register_ffi_target(
-            "xtb_hist", jax.ffi.pycapsule(lib.XtbHist), platform="cpu")
-        jax.ffi.register_ffi_target(
-            "xtb_split", jax.ffi.pycapsule(lib.XtbSplit), platform="cpu")
+        for name, sym in (("xtb_hist", lib.XtbHist),
+                          ("xtb_split", lib.XtbSplit),
+                          ("xtb_predict", lib.XtbPredict),
+                          ("xtb_predict_binned", lib.XtbPredictBinned)):
+            jax.ffi.register_ffi_target(name, jax.ffi.pycapsule(sym),
+                                        platform="cpu")
         _FFI_READY = True
     except Exception:
         _FFI_READY = False
@@ -149,57 +140,6 @@ def load_ffi() -> bool:
 def ffi_usable() -> bool:
     """load_ffi() minus the distributed veto — the gate compute paths use."""
     return not FFI_DISTRIBUTED_VETO and load_ffi()
-
-
-def hist_build(bins: np.ndarray, gpair: np.ndarray, pos: np.ndarray,
-               node0: int, n_nodes: int, n_bin: int, stride: int
-               ) -> np.ndarray:
-    """Native gradient histogram: (R,F) bins x (R,C) gpair -> (N,F,B,C) f32.
-
-    Caller guarantees the lib is loaded (check load_native() first) and that
-    ``bins.dtype`` is uint8/uint16/int32 (the Ellpack dtypes)."""
-    lib = load_native()
-    R, F = bins.shape
-    C = gpair.shape[1]
-    bins = np.ascontiguousarray(bins)
-    gpair = np.ascontiguousarray(gpair, np.float32)
-    pos = np.ascontiguousarray(pos, np.int32)
-    out = np.empty((n_nodes, F, n_bin, C), np.float32)
-    lib.xtb_hist_build(
-        bins.ctypes.data, _BIN_KIND[bins.dtype], gpair.ctypes.data,
-        pos.ctypes.data, R, F, n_bin, int(node0), n_nodes, stride, C,
-        out.ctypes.data)
-    return out
-
-
-def split_scan(hist: np.ndarray, totals: np.ndarray, n_bins: np.ndarray,
-               fmask: np.ndarray, lambda_: float, alpha: float,
-               min_child_weight: float, max_delta_step: float):
-    """Native split gain scan over (N,F,B,2) f32 hist (numeric features).
-
-    Returns (gain f32, feature i32, bin i32, dleft u8, GL f32, HL f32),
-    each (N,) — the chosen-direction left-child sums included so the caller
-    derives the rest without re-walking bins."""
-    lib = load_native()
-    N, F, B, _ = hist.shape
-    hist = np.ascontiguousarray(hist, np.float32)
-    totals = np.ascontiguousarray(totals, np.float32)
-    n_bins = np.ascontiguousarray(n_bins, np.int32)
-    fmask = np.ascontiguousarray(
-        np.broadcast_to(fmask, (N, F)), np.uint8)
-    gain = np.empty(N, np.float32)
-    feat = np.empty(N, np.int32)
-    bin_ = np.empty(N, np.int32)
-    dleft = np.empty(N, np.uint8)
-    GL = np.empty(N, np.float32)
-    HL = np.empty(N, np.float32)
-    lib.xtb_split_scan(
-        hist.ctypes.data, totals.ctypes.data, n_bins.ctypes.data,
-        fmask.ctypes.data, N, F, B, float(lambda_), float(alpha),
-        float(min_child_weight), float(max_delta_step), gain.ctypes.data,
-        feat.ctypes.data, bin_.ctypes.data, dleft.ctypes.data,
-        GL.ctypes.data, HL.ctypes.data)
-    return gain, feat, bin_, dleft, GL, HL
 
 
 def parse_libsvm(path: str):
