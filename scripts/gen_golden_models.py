@@ -1,0 +1,133 @@
+"""Generate golden models from the reference oracle build.
+
+One model per family — binary, multi:softprob, dart, gblinear, categorical,
+multi-target (vector leaf), rank:ndcg, survival:aft — trained by the REAL
+reference (/root/oracle_build) on small deterministic data, saved as JSON
+under tests/data/models/ together with the training data and the oracle's
+own predictions.  tests/test_golden_models.py loads each committed model
+and pins predict parity, so model-format compatibility with released
+reference versions is tested WITHOUT needing the oracle at test time
+(reference: tests/python/test_model_compatibility.py + generate_models.py).
+
+Run (oracle required):  python scripts/gen_golden_models.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "data", "models")
+
+GEN = r"""
+import json, sys
+import numpy as np
+sys.path.insert(0, "/root/oracle_build/pkg")
+import xgboost as xgb
+
+out_dir = sys.argv[1]
+rng = np.random.default_rng(7)
+R, F = 500, 6
+X = rng.normal(size=(R, F)).astype(np.float32)
+X[rng.random((R, F)) < 0.1] = np.nan
+ybin = (np.nan_to_num(X[:, 0]) + 0.3 * np.nan_to_num(X[:, 1]) > 0).astype(np.float32)
+ymult = np.clip((np.nan_to_num(X[:, 0]) + 2.0).astype(np.int64), 0, 3).astype(np.float32)
+yreg = (np.nan_to_num(X[:, 0]) * 2 + np.nan_to_num(X[:, 2])).astype(np.float32)
+
+np.save(out_dir + "/golden_X.npy", X)
+
+def save(name, params, label, extra_dm=None, n_rounds=5, multi_target=False):
+    kw = dict(label=label) if not multi_target else dict(label=label)
+    d = xgb.DMatrix(X, missing=np.nan, **kw)
+    if extra_dm:
+        extra_dm(d)
+    bst = xgb.train(params, d, num_boost_round=n_rounds)
+    bst.save_model(f"{out_dir}/{name}.json")
+    pred = bst.predict(d, output_margin=True)
+    np.save(f"{out_dir}/{name}_margin.npy", np.asarray(pred, np.float32))
+    print(name, "ok")
+
+save("binary", {"objective": "binary:logistic", "max_depth": 4,
+                "eta": 0.3, "tree_method": "hist"}, ybin)
+save("multiclass", {"objective": "multi:softprob", "num_class": 4,
+                    "max_depth": 3, "eta": 0.3, "tree_method": "hist"}, ymult)
+save("dart", {"booster": "dart", "objective": "binary:logistic",
+              "max_depth": 3, "eta": 0.3, "rate_drop": 0.0,
+              "tree_method": "hist"}, ybin)
+save("gblinear", {"booster": "gblinear", "objective": "reg:squarederror",
+                  "eta": 0.5, "lambda": 0.1}, yreg, n_rounds=8)
+save("rank_ndcg", {"objective": "rank:ndcg", "max_depth": 3, "eta": 0.3,
+                   "tree_method": "hist"},
+     np.clip(ybin * 3 + ymult, 0, 4),
+     extra_dm=lambda d: d.set_group([50] * (R // 50)))
+
+# categorical: pandas categorical column
+import pandas as pd
+df = pd.DataFrame({
+    "a": pd.Categorical(rng.integers(0, 5, R)),
+    "b": X[:, 1], "c": X[:, 2]})
+dc = xgb.DMatrix(df, label=ybin, enable_categorical=True, missing=np.nan)
+bst = xgb.train({"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+                 "tree_method": "hist"}, dc, num_boost_round=5)
+bst.save_model(out_dir + "/categorical.json")
+np.save(out_dir + "/categorical_margin.npy",
+        np.asarray(bst.predict(dc, output_margin=True), np.float32))
+df.to_parquet(out_dir + "/categorical_X.parquet")
+print("categorical ok")
+
+# multi-target vector-leaf
+ymt = np.stack([yreg, -yreg * 0.5], axis=1)
+dmt = xgb.DMatrix(X, label=ymt, missing=np.nan)
+bst = xgb.train({"objective": "reg:squarederror", "max_depth": 3,
+                 "eta": 0.3, "tree_method": "hist",
+                 "multi_strategy": "multi_output_tree"}, dmt,
+                num_boost_round=4)
+bst.save_model(out_dir + "/multitarget.json")
+np.save(out_dir + "/multitarget_margin.npy",
+        np.asarray(bst.predict(dmt, output_margin=True), np.float32))
+print("multitarget ok")
+
+# survival AFT
+ylo = np.abs(yreg) + 1.0
+yhi = ylo + np.where(rng.random(R) < 0.3, np.inf, 0.5)
+da = xgb.DMatrix(X, missing=np.nan)
+da.set_float_info("label_lower_bound", ylo)
+da.set_float_info("label_upper_bound", yhi)
+bst = xgb.train({"objective": "survival:aft", "max_depth": 3, "eta": 0.3,
+                 "aft_loss_distribution": "normal",
+                 "aft_loss_distribution_scale": 1.0,
+                 "tree_method": "hist"}, da, num_boost_round=4)
+bst.save_model(out_dir + "/aft.json")
+np.save(out_dir + "/aft_margin.npy",
+        np.asarray(bst.predict(da, output_margin=True), np.float32))
+np.save(out_dir + "/aft_bounds.npy", np.stack([ylo, yhi]))
+print("aft ok")
+
+np.save(out_dir + "/golden_labels.npy",
+        np.stack([ybin, ymult, yreg]))
+with open(out_dir + "/MANIFEST.json", "w") as fh:
+    json.dump({"oracle_version": xgb.__version__,
+               "models": ["binary", "multiclass", "dart", "gblinear",
+                          "rank_ndcg", "categorical", "multitarget",
+                          "aft"]}, fh, indent=1)
+print("manifest ok, oracle", xgb.__version__)
+"""
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as fh:
+        fh.write(GEN)
+        path = fh.name
+    subprocess.run([sys.executable, path, OUT], check=True, env=env)
+    os.unlink(path)
+    print("golden models written to", OUT)
+
+
+if __name__ == "__main__":
+    main()

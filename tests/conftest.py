@@ -44,7 +44,7 @@ _QUICK_FILES = {
     "test_collective_backend.py", "test_constraints.py",
     "test_continuation.py", "test_device_ingest.py", "test_hist_kernels.py",
     "test_multiquantile.py", "test_ranking.py", "test_survival.py",
-    "test_categorical.py", "test_shap.py",
+    "test_categorical.py", "test_shap.py", "test_golden_models.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)
