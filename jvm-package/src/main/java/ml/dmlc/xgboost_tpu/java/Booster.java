@@ -68,6 +68,17 @@ public class Booster implements AutoCloseable {
     return out[0];
   }
 
+  public void setAttr(String key, String value) throws XGBoostError {
+    XGBoostError.check(XGBoostJNI.XGBoosterSetAttr(handle, key, value));
+  }
+
+  /** null when the attribute was never set (reference getAttr contract). */
+  public String getAttr(String key) throws XGBoostError {
+    String[] out = new String[1];
+    XGBoostError.check(XGBoostJNI.XGBoosterGetAttr(handle, key, out));
+    return out[0];
+  }
+
   /** Serialize to ubj/json bytes (the byte-array model exchange the JVM
    * ecosystem uses for spark checkpointing). */
   public byte[] toByteArray(String format) throws XGBoostError {

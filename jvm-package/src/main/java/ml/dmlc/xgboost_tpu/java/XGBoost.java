@@ -11,6 +11,26 @@ public final class XGBoost {
   public static Booster train(DMatrix dtrain, Map<String, Object> params,
                               int numRounds, Map<String, DMatrix> evals)
       throws XGBoostError {
+    return train(dtrain, params, numRounds, evals, 0, null);
+  }
+
+  /**
+   * Train with early stopping (reference surface: xgboost4j XGBoost.train
+   * earlyStoppingRounds): stops when the LAST metric on the LAST evals
+   * entry has not improved for earlyStoppingRounds rounds; the best round
+   * lands in the "best_iteration" / "best_score" booster attrs (0-based
+   * round id, the convention shared with the Python and R bindings).
+   * maximize == null auto-detects from the metric name (auc/map/ndcg/pre
+   * maximize, everything else — including mape — minimizes).
+   */
+  public static Booster train(DMatrix dtrain, Map<String, Object> params,
+                              int numRounds, Map<String, DMatrix> evals,
+                              int earlyStoppingRounds, Boolean maximize)
+      throws XGBoostError {
+    if (earlyStoppingRounds > 0 && (evals == null || evals.isEmpty())) {
+      throw new IllegalArgumentException(
+          "earlyStoppingRounds needs at least one evals entry");
+    }
     Booster booster = Booster.create(params, new DMatrix[] {dtrain});
     try {
       DMatrix[] evalMats = new DMatrix[evals == null ? 0 : evals.size()];
@@ -23,11 +43,38 @@ public final class XGBoost {
           ++i;
         }
       }
+      double bestScore = Double.NaN;
+      int bestIter = -1;
       for (int iter = 0; iter < numRounds; ++iter) {
         booster.update(dtrain, iter);
         if (evalMats.length > 0) {
-          System.out.println(booster.evalSet(evalMats, evalNames, iter));
+          String msg = booster.evalSet(evalMats, evalNames, iter);
+          System.out.println(msg);
+          if (earlyStoppingRounds > 0) {
+            // "[i]\tname-metric:value\t..." — track the final field
+            String[] parts = msg.trim().split("[\t ]+");
+            String last = parts[parts.length - 1];
+            int colon = last.lastIndexOf(':');
+            double score = Double.parseDouble(last.substring(colon + 1));
+            String metric = last.substring(0, colon);
+            String bare = metric.substring(metric.lastIndexOf('-') + 1);
+            boolean mx = maximize != null ? maximize
+                : (bare.matches("^(auc|aucpr|map|ndcg|pre).*")
+                   && !bare.startsWith("mape"));
+            boolean better = Double.isNaN(bestScore)
+                || (mx ? score > bestScore : score < bestScore);
+            if (better) {
+              bestScore = score;
+              bestIter = iter;
+            } else if (iter - bestIter >= earlyStoppingRounds) {
+              break;
+            }
+          }
         }
+      }
+      if (bestIter >= 0) {
+        booster.setAttr("best_iteration", String.valueOf(bestIter));
+        booster.setAttr("best_score", String.valueOf(bestScore));
       }
       return booster;
     } catch (XGBoostError | RuntimeException e) {

@@ -51,4 +51,8 @@ final class XGBoostJNI {
                                                byte[][] out);
 
   static native int XGBoosterLoadModelFromBuffer(long handle, byte[] buf);
+
+  static native int XGBoosterSetAttr(long handle, String key, String value);
+
+  static native int XGBoosterGetAttr(long handle, String key, String[] out);
 }

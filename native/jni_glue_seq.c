@@ -38,6 +38,8 @@ extern int XGBoosterSaveModelToBuffer(BoosterHandle, const char*, bst_ulong*,
                                       const char**);
 extern int XGBoosterLoadModelFromBuffer(BoosterHandle, const void*,
                                         bst_ulong);
+extern int XGBoosterSetAttr(BoosterHandle, const char*, const char*);
+extern int XGBoosterGetAttr(BoosterHandle, const char*, const char**, int*);
 
 #define CHECK(call)                                                   \
   do {                                                                \
@@ -125,6 +127,19 @@ int main(void) {
   CHECK(XGBoosterPredict(b2, d, 0, 0, 0, &plen, &preds));
   for (bst_ulong i = 0; i < plen; ++i)
     if (preds[i] != keep[i]) return 1;
+
+  /* early-stopping attrs (XGBoost.train earlyStoppingRounds path) */
+  CHECK(XGBoosterSetAttr(bst, "best_iteration", "2"));
+  CHECK(XGBoosterSetAttr(bst, "best_score", "0.9871"));
+  const char* attr = NULL;
+  int ok = 0;
+  CHECK(XGBoosterGetAttr(bst, "best_iteration", &attr, &ok));
+  if (!ok || strcmp(attr, "2") != 0) {
+    fprintf(stderr, "attr round-trip failed\n");
+    return 1;
+  }
+  CHECK(XGBoosterGetAttr(bst, "unset_attr", &attr, &ok));
+  if (ok) return 1;
 
   CHECK(XGBoosterFree(b2));
   CHECK(XGBoosterFree(bst));

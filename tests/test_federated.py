@@ -94,3 +94,69 @@ def test_federated_collective_primitives():
         assert out[1] == out[0]
     finally:
         tracker.shutdown()
+
+
+def test_federated_tls_round_trip():
+    """TLS mode (reference: plugin/federated secure channel params): a
+    self-signed server cert, secure tracker port, and workers dialing with
+    federated_server_cert_path must exchange exactly like plaintext."""
+    import datetime
+    import tempfile
+
+    pytest.importorskip("grpc")
+    cryptography = pytest.importorskip("cryptography")  # noqa: F841
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName("localhost"),
+                 x509.IPAddress(__import__("ipaddress").ip_address(
+                     "127.0.0.1"))]), critical=False)
+            .sign(key, hashes.SHA256()))
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+
+    from xgboost_tpu.federated import FederatedBackend, FederatedTracker
+
+    tracker = FederatedTracker(2, server_key=key_pem, server_cert=cert_pem)
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".pem") as cf:
+            cf.write(cert_pem)
+            cf.flush()
+            results = {}
+
+            def worker(rank):
+                b = FederatedBackend(f"localhost:{tracker.port}", 2, rank,
+                                     server_cert_path=cf.name)
+                try:
+                    results[rank] = b.allgather(
+                        np.arange(3, dtype=np.float64) + rank)
+                finally:
+                    b.shutdown()
+
+            ts = [threading.Thread(target=worker, args=(r,))
+                  for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in ts)
+        want = np.stack([np.arange(3.0), np.arange(3.0) + 1])
+        for r in range(2):
+            np.testing.assert_array_equal(results[r], want)
+    finally:
+        tracker.shutdown()

@@ -180,3 +180,67 @@ def test_matmul_and_scatter_impls_agree(monkeypatch):
     np.testing.assert_allclose(outs["matmul"][0], outs["scatter"][0],
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_array_equal(outs["matmul"][1], outs["scatter"][1])
+
+
+def test_pallas_bench_shape_tiles_interpret():
+    """VERDICT r4 #1a: exercise the EXACT tile geometry choose_tiles picks
+    for the bench shapes (HIGGS 1Mx28 / covertype 58kx54, max_bin 256 ->
+    B=257; (row_tile, feat_group) = (2048, 16) for both, verified below) in
+    interpret mode — so the first real-TPU heal window runs a geometry the
+    suite has already validated numerically, not a toy one.  Rows are
+    reduced to 3 row tiles (tile geometry, padding and the cross-tile
+    accumulate are row-count-invariant); the ragged final tile is included
+    on purpose."""
+    import numpy as np
+
+    from xgboost_tpu.ops.hist_pallas import (build_histogram_pallas,
+                                             choose_tiles)
+    from xgboost_tpu.ops.histogram import build_histogram
+
+    B = 257
+    for F, n_nodes, stride in ((28, 16, 2), (28, 32, 1), (54, 64, 2)):
+        T, FG = choose_tiles(F, B, n_nodes, 1)
+        assert (T, FG) == (2048, 16), (F, n_nodes, T, FG)
+        rng = np.random.default_rng(F)
+        R = 2 * T + 517  # two full tiles + ragged remainder
+        bins = jnp.asarray(rng.integers(0, B + 1, size=(R, F)), jnp.int32)
+        gpair = jnp.asarray(rng.normal(size=(R, 2)), jnp.float32)
+        node0 = n_nodes - 1 if stride == 1 else 2 * n_nodes - 1
+        pos = jnp.asarray(
+            rng.integers(node0, node0 + stride * n_nodes, size=R), jnp.int32)
+        got = build_histogram_pallas(
+            bins, gpair, pos, node0=node0, n_nodes=n_nodes, n_bin=B,
+            stride=stride, interpret=True, row_tile=T, feat_group=FG)
+        want = build_histogram(bins, gpair, pos, node0=node0,
+                               n_nodes=n_nodes, n_bin=B, stride=stride)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-4)
+
+
+def test_pallas_quantised_bench_shape_tiles_interpret():
+    """Same geometry pin for the quantised (int8 limb) kernel — bitwise."""
+    import numpy as np
+
+    from xgboost_tpu.ops.hist_pallas import (build_histogram_pallas_q,
+                                             choose_tiles)
+    from xgboost_tpu.ops.quantise import (hist_accumulate_q, local_rho,
+                                          quantise_gpair)
+
+    B, F, n_nodes = 257, 28, 16
+    T, FG = choose_tiles(F, B, n_nodes, 1, out_ch=6)
+    assert (T, FG) == (2048, 16)
+    rng = np.random.default_rng(3)
+    R = 2 * T + 301
+    bins = jnp.asarray(rng.integers(0, B + 1, size=(R, F)), jnp.int32)
+    gpair = jnp.asarray(rng.normal(size=(R, 2)), jnp.float32)
+    rho = local_rho(gpair, jnp.ones(R, bool))
+    gq = quantise_gpair(gpair, rho)
+    node0 = 2 * n_nodes - 1
+    pos = jnp.asarray(rng.integers(node0, node0 + 2 * n_nodes, size=R),
+                      jnp.int32)
+    got = build_histogram_pallas_q(
+        bins, gq, pos, node0=node0, n_nodes=n_nodes, n_bin=B, stride=2,
+        interpret=True, row_tile=T, feat_group=FG)
+    want = hist_accumulate_q(bins, gq, pos, jnp.int32(node0), n_nodes, B,
+                             stride=2)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -326,7 +326,10 @@ def init(**args: Any) -> None:
         _TLS.backend = FederatedBackend(
             str(args["federated_server_address"]),
             int(args["federated_world_size"]),
-            int(args["federated_rank"]))
+            int(args["federated_rank"]),
+            server_cert_path=str(args.get("federated_server_cert_path", "")),
+            client_key_path=str(args.get("federated_client_key_path", "")),
+            client_cert_path=str(args.get("federated_client_cert_path", "")))
         _reconcile_native_kernels()
         return
     _PROCESS_BACKEND = JaxDistributedBackend(**args)

@@ -52,7 +52,10 @@ class FederatedTracker:
     all ``world_size`` ranks have contributed.
     """
 
-    def __init__(self, world_size: int, port: int = 0) -> None:
+    def __init__(self, world_size: int, port: int = 0, *,
+                 server_key: Optional[bytes] = None,
+                 server_cert: Optional[bytes] = None,
+                 client_ca: Optional[bytes] = None) -> None:
         import grpc
 
         self.world_size = world_size
@@ -67,7 +70,20 @@ class FederatedTracker:
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=world_size + 4))
         self._server.add_generic_rpc_handlers((handler,))
-        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+        if server_key is not None and server_cert is not None:
+            # TLS (mutual when client_ca given) — the reference federated
+            # plugin's secure mode (federated_tracker.h:22 reads
+            # server-key/server-cert/client-cert paths the same way)
+            creds = grpc.ssl_server_credentials(
+                [(server_key, server_cert)],
+                root_certificates=client_ca,
+                require_client_auth=client_ca is not None)
+            self.port = self._server.add_secure_port(
+                f"127.0.0.1:{port}", creds)
+        else:
+            # plaintext: test/loopback use ONLY — aggregate statistics
+            # (histograms, sketch grids) still cross the wire readable
+            self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
         self._server.start()
 
     @property
@@ -106,13 +122,33 @@ class FederatedBackend(CollBackend):
     allgather relayed through the tracker; reductions happen locally on the
     gathered stack (identical on every worker -> deterministic trees)."""
 
-    def __init__(self, server_address: str, world_size: int, rank: int) -> None:
+    def __init__(self, server_address: str, world_size: int, rank: int,
+                 server_cert_path: str = "", client_key_path: str = "",
+                 client_cert_path: str = "") -> None:
+        """TLS: pass the reference's parameter trio
+        (federated_comm.cc: federated_server_cert_path /
+        federated_client_key_path / federated_client_cert_path) to dial a
+        secure tracker; with none given the channel is PLAINTEXT — fine for
+        loopback tests, not for cross-site federation."""
         import grpc
 
         self._world = int(world_size)
         self._rank = int(rank)
         self._seq = 0
-        self._channel = grpc.insecure_channel(server_address)
+        if server_cert_path:
+            def _read(p):
+                with open(p, "rb") as fh:
+                    return fh.read()
+
+            creds = grpc.ssl_channel_credentials(
+                root_certificates=_read(server_cert_path),
+                private_key=_read(client_key_path) if client_key_path
+                else None,
+                certificate_chain=_read(client_cert_path) if client_cert_path
+                else None)
+            self._channel = grpc.secure_channel(server_address, creds)
+        else:
+            self._channel = grpc.insecure_channel(server_address)
         self._call = self._channel.unary_unary(
             _METHOD, request_serializer=_IDENT, response_deserializer=_IDENT)
 
