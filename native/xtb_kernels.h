@@ -13,6 +13,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 // ---------------------------------------------------------------------------
 // Gradient histogram build — one pass over all rows; each row's F adds land
@@ -107,6 +108,21 @@ inline void xtb_split_scan_impl(const float* hist, const float* totals,
   const XtbGainParams p{lambda_, alpha, min_child_weight, max_delta_step};
   for (int32_t n = 0; n < N; ++n) {
     const float totG = totals[n * 2], totH = totals[n * 2 + 1];
+    if (totG == 0.0f && totH == 0.0f) {
+      // dead heap slot (padded shared level program): its histogram is
+      // zeroed by construction (combine_sibling_hists masks non-alive
+      // slots; the hist kernels memset), every candidate is invalid, and
+      // the XLA all--inf fallback lands at (feature 0, bin 0) with zero
+      // sums — emit that directly instead of walking F*B bins, so node
+      // padding costs nothing in the scan
+      out_gain[n] = -INFINITY;
+      out_feat[n] = 0;
+      out_bin[n] = 0;
+      out_dleft[n] = 1;
+      out_GL[n] = 0.0f;
+      out_HL[n] = 0.0f;
+      continue;
+    }
     const float parent = xtb_calc_gain(totG, totH, p);
     float best_gain = -INFINITY, best_GL = 0.0f, best_HL = 0.0f;
     int32_t best_f = 0, best_b = 0;

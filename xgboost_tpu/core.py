@@ -1453,12 +1453,56 @@ class Booster:
                 from .parallel import shard_rows
 
                 (bins_use,) = shard_rows(self._get_mesh(), bins_use)
+        # Lockstep class batching (opt-in, _lockstep=1): the K independent
+        # per-class trees of a round advance level-by-level together in ONE
+        # jitted program per level, sharing the split scan and position
+        # rewrite (the reference's all-targets-per-pass shape,
+        # src/tree/hist/histogram.h:44).  Bitwise-identical trees to the
+        # sequential loop (tests/test_lockstep.py).  Default OFF: on the
+        # CPU backend the K-stacked level intermediates measured ~1.5x
+        # SLOWER than the sequential padded-level grower at covertype
+        # shapes; the batched formulation is aimed at the TPU matmul path,
+        # where the class axis widens the MXU output tile — to be
+        # re-evaluated on hardware.
+        lockstep_ok = (
+            K > 1 and mesh is None and not proc_par and not best_first
+            and not det and cat_mask_np is None and not adaptive
+            and str(self.params.get("_hist_impl", "xla")) == "xla"
+            and str(self.params.get("_lockstep", "0")).lower()
+            in ("1", "true"))
         for p_idx in range(max(self.num_parallel_tree, 1)):
             fmask_fn = self._feature_masks(iteration * 131 + p_idx, p_idx, ell.n_features,
                                            cache.dmat.info.feature_weights)
             # one independent subsample per parallel tree (reference: each
             # member of the forest draws its own rows)
             gp = self._subsample_mask(gpair, iteration * 131 + p_idx)
+            if lockstep_ok and fmask_fn is None:
+                from .tree.grow_lockstep import (LockstepHistGrower,
+                                                 leaf_margin_delta_k)
+
+                lk_key = ("lockstep", max_depth, self._split_params,
+                          self.tparam.interaction_constraints,
+                          self.tparam.max_leaves, lossguide)
+                lk = self._grower_cache.get(lk_key)
+                if lk is None:
+                    lk = LockstepHistGrower(
+                        max_depth, self._split_params,
+                        interaction_sets=self.tparam.interaction_constraints,
+                        max_leaves=self.tparam.max_leaves,
+                        lossguide=lossguide)
+                    self._grower_cache[lk_key] = lk
+                state = lk.grow(bins_use, gp, cache.valid, cuts_use,
+                                nbins_use)
+                new_margin = new_margin + leaf_margin_delta_k(
+                    state.pos, state.leaf_val).T
+                for k in range(K):
+                    tree = RegTree.from_grown(lk.to_host_class(state, k))
+                    tree.cuts_token = cuts_token_use
+                    self.trees.append(tree)
+                    self.tree_info.append(k)
+                    self.tree_weights.append(1.0)
+                    n_new += 1
+                continue
             for k in range(K):
                 state = grower.grow(
                     bins_use,

@@ -245,6 +245,41 @@ def build_histogram_at(bins, gpair, pos, node0, *, n_nodes: int, n_bin: int,
                             stride)
 
 
+@functools.partial(jax.jit, static_argnames=("n_nodes", "n_bin", "stride"))
+def build_histogram_multi(bins, gpair_rkc, pos_k, node0, *, n_nodes: int,
+                          n_bin: int, stride: int = 1):
+    """Class-batched histogram: (K, N, F, B, C) for K trees grown in
+    lockstep over the SAME bins (multi:softprob one-tree-per-class).
+
+    bins      : (R, F) int — shared binned page
+    gpair_rkc : (R, K, C) f32 — per-class gradient pairs
+    pos_k     : (K, R) int32 — per-class row routing
+    node0     : traced scalar (padded shared level program compatible)
+
+    The level's K histograms ride ONE jitted program (one dispatch, one
+    downstream split scan — the reference's all-targets-per-pass shape,
+    src/tree/hist/histogram.h:44).  On CPU the K class hists are built by
+    K sequential native calls INSIDE that program rather than a fused
+    row pass: a fused row-pass kernel was prototyped and measured ~40%
+    SLOWER at covertype shapes (interleaving K node blocks per row blows
+    the L2 working set), so it was dropped; the sequential calls keep one
+    class's blocks hot and are bitwise-identical to the per-class grower
+    by construction.  The XLA fallback vmaps the one-hot matmul — on the
+    MXU the K axis just widens the output tile, the shape the TPU wants.
+    """
+    K = gpair_rkc.shape[1]
+    node0 = jnp.asarray(node0, jnp.int32)
+    if _host_impl() == "native":
+        return jnp.stack([
+            _native_hist(bins, gpair_rkc[:, k, :], pos_k[k], node0,
+                         n_nodes, n_bin, stride)
+            for k in range(K)])
+    gpair_krc = jnp.moveaxis(gpair_rkc, 1, 0)  # (K, R, C)
+    return jax.vmap(
+        lambda g, p: _hist_accumulate(bins, g, p, node0, n_nodes, n_bin,
+                                      2048, stride))(gpair_krc, pos_k)
+
+
 def combine_sibling_hists(left, hist_prev, alive_lvl):
     """Subtraction trick assembly, shared by every grower flavour
     (updater_gpu_hist.cu:309 SubtractHist): given the built left-children

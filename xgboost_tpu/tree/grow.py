@@ -517,7 +517,13 @@ def default_padded_levels(max_depth: int) -> bool:
         return True
     from ..ops.histogram import _use_scatter
 
-    return _use_scatter()
+    # native/scatter row-pass kernels: padding costs only the padded hist
+    # output blocks (memset + accumulate traffic, 2**(md-1)*F*B*2 floats
+    # per level) and the scan over dead slots is short-circuited in the
+    # native kernel — a clear win at the bench depth 6, but at depth 8 the
+    # 128-wide buffers measurably outweigh the saved compiles, so deep CPU
+    # trees keep per-depth programs
+    return _use_scatter() and max_depth <= 6
 
 
 class HistTreeGrower:

@@ -1,0 +1,280 @@
+"""Class-batched (lockstep) depthwise growing for one-tree-per-class
+boosting (multi:softmax / multi:softprob).
+
+The default loop grows the K per-class trees of a round sequentially, so
+every tree pays its own full row pass per level.  Here the K INDEPENDENT
+trees advance level-by-level together: one shared pass over the bins feeds
+all K histograms (ops/histogram.build_histogram_multi — the reference's
+all-targets-per-pass design, src/tree/hist/histogram.h:44), one split scan
+scores all K x N nodes, and one vectorized position rewrite routes all K
+`pos` arrays.  Per-class results are BITWISE identical to the sequential
+grower (the native kernel adds in the same row order per class; split
+decisions are per-(class, node) with unchanged tie-breaking), which
+tests/test_lockstep.py pins via dump-hash equality.
+
+State layout: grow.TreeState arrays with a leading K axis (pos (K, R),
+node arrays (K, max_nodes, ...)).  Scope: numeric features, f32 hists,
+single-device — the per-class fallback covers categorical / quantised /
+sharded / best-first.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.histogram import (build_histogram_multi, combine_sibling_hists,
+                             node_sums)
+from ..ops.split import SplitParams, calc_weight, evaluate_splits
+from .grow import (GrownTree, TreeState, make_set_matrix,
+                   max_nodes_for_depth)
+
+_EPS = 1e-6
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes", "n_sets",
+                                             "max_splits", "n_bin"))
+def init_lockstep_state(gpair_rkc, valid, *, max_nodes: int, n_sets: int = 1,
+                        max_splits: int = 0, n_bin: int = 1):
+    """Fresh K-tree state: all rows at every class's root."""
+    R, K, _ = gpair_rkc.shape
+    pos_row = jnp.where(valid, 0, -1).astype(jnp.int32)
+    pos = jnp.broadcast_to(pos_row, (K, R))
+    # root totals via the SAME masked-matmul reduction the sequential
+    # grower uses (init_tree_state -> node_sums): a plain jnp.sum reduces
+    # in a different f32 order and the last-ulp root difference propagates
+    # into every level's missing-value stats — breaking bitwise parity
+    root = jnp.stack([
+        node_sums(gpair_rkc[:, k, :], pos_row, node0=0, n_nodes=1)[0]
+        for k in range(K)])  # (K, 2)
+    mn = max_nodes
+    totals = jnp.zeros((K, mn, 2), jnp.float32).at[:, 0].set(root)
+    budget = max_splits if max_splits > 0 else jnp.iinfo(jnp.int32).max
+    return TreeState(
+        pos=pos,
+        alive=jnp.zeros((K, mn), bool).at[:, 0].set(True),
+        totals=totals,
+        feat=jnp.full((K, mn), -1, jnp.int32),
+        sbin=jnp.zeros((K, mn), jnp.int32),
+        thr=jnp.zeros((K, mn), jnp.float32),
+        dleft=jnp.ones((K, mn), bool),
+        is_leaf=jnp.zeros((K, mn), bool),
+        leaf_val=jnp.zeros((K, mn), jnp.float32),
+        gain=jnp.zeros((K, mn), jnp.float32),
+        base_weight=jnp.zeros((K, mn), jnp.float32),
+        sum_hess=jnp.zeros((K, mn), jnp.float32),
+        lower=jnp.full((K, mn), -jnp.inf, jnp.float32),
+        upper=jnp.full((K, mn), jnp.inf, jnp.float32),
+        setcompat=jnp.ones((K, mn, n_sets), bool),
+        splits_left=jnp.full((K,), budget, jnp.int32),
+        is_cat=jnp.zeros((K, mn), bool),
+        cat_set=jnp.zeros((K, mn, n_bin), bool),
+    )
+
+
+def _update_positions_k(bins, pos, best_feat, best_bin, best_dleft,
+                        can_split, node0: int, N: int, n_bin: int):
+    """Vectorized-over-classes position rewrite (numeric features)."""
+    local = pos - node0  # (K, R)
+    in_lvl = (local >= 0) & (local < N)
+    lc = jnp.clip(local, 0, N - 1)
+    can_r = jnp.take_along_axis(can_split, lc, axis=1)
+    fr = jnp.take_along_axis(best_feat, lc, axis=1)
+    sb = jnp.take_along_axis(best_bin, lc, axis=1)
+    dl = jnp.take_along_axis(best_dleft, lc, axis=1)
+    F = bins.shape[1]
+    binval = jax.vmap(
+        lambda f: jnp.take_along_axis(
+            bins, jnp.clip(f, 0, F - 1)[:, None].astype(jnp.int32),
+            axis=1)[:, 0].astype(jnp.int32))(fr)  # (K, R)
+    goleft = jnp.where(binval >= n_bin, dl, binval <= sb)
+    child = 2 * pos + 1 + jnp.where(goleft, 0, 1)
+    return jnp.where(in_lvl & can_r, child, pos)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("depth", "params", "last_level", "lossguide",
+                     "subtract"),
+)
+def level_step_lockstep(state: TreeState, bins, gpair_rkc, cuts_pad, n_bins,
+                        feature_mask, set_matrix, hist_prev=None, *,
+                        depth: int, params: SplitParams, last_level: bool,
+                        lossguide: bool = False, subtract: bool = False):
+    """One level for all K trees at once (grow.level_step, K-vectorized)."""
+    node0 = (1 << depth) - 1
+    N = 1 << depth
+    B = cuts_pad.shape[1]
+    K = gpair_rkc.shape[1]
+
+    idx = node0 + jnp.arange(N, dtype=jnp.int32)
+    totals_lvl = lax.dynamic_slice_in_dim(state.totals, node0, N, axis=1)
+    alive_lvl = lax.dynamic_slice_in_dim(state.alive, node0, N, axis=1)
+    lower_lvl = lax.dynamic_slice_in_dim(state.lower, node0, N, axis=1)
+    upper_lvl = lax.dynamic_slice_in_dim(state.upper, node0, N, axis=1)
+    w = calc_weight(totals_lvl[..., 0], totals_lvl[..., 1], params,
+                    lower_lvl, upper_lvl)
+
+    if last_level:
+        return state._replace(
+            is_leaf=state.is_leaf.at[:, idx].set(alive_lvl),
+            leaf_val=state.leaf_val.at[:, idx].set(
+                jnp.where(alive_lvl, params.eta * w, 0.0)),
+            base_weight=state.base_weight.at[:, idx].set(w),
+            sum_hess=state.sum_hess.at[:, idx].set(totals_lvl[..., 1]),
+        ), None
+
+    if subtract:
+        half = N // 2
+        left = build_histogram_multi(bins, gpair_rkc, state.pos, node0,
+                                     n_nodes=half, n_bin=B, stride=2)
+        hist = jax.vmap(combine_sibling_hists)(left, hist_prev, alive_lvl)
+    else:
+        hist = build_histogram_multi(bins, gpair_rkc, state.pos, node0,
+                                     n_nodes=N, n_bin=B)
+
+    compat_lvl = lax.dynamic_slice_in_dim(state.setcompat, node0, N, axis=1)
+    allowed = jnp.einsum("kns,sf->knf", compat_lvl.astype(jnp.float32),
+                         set_matrix.astype(jnp.float32)) > 0.0
+    fm = feature_mask if feature_mask.ndim == 2 else feature_mask[None, :]
+    fmask = (allowed & fm[None]).reshape(K * N, -1)
+
+    node_bounds = jnp.stack([lower_lvl, upper_lvl], axis=-1).reshape(
+        K * N, 2)
+    F = bins.shape[1]
+    best = evaluate_splits(hist.reshape(K * N, F, B, 2),
+                           totals_lvl.reshape(K * N, 2), n_bins, params,
+                           fmask, node_bounds)
+
+    def kn(a):
+        return a.reshape(K, N, *a.shape[1:])
+
+    b_gain, b_feat, b_bin = kn(best.gain), kn(best.feature), kn(best.bin)
+    b_dleft = kn(best.default_left)
+    b_left, b_right = kn(best.left_sum), kn(best.right_sum)
+    b_lw, b_rw = kn(best.left_weight), kn(best.right_weight)
+
+    gamma_eps = max(params.gamma, _EPS)
+    can_split = alive_lvl & (b_gain > gamma_eps)
+
+    budget = state.splits_left  # (K,)
+    prio = b_gain if lossguide else jnp.broadcast_to(
+        -idx.astype(jnp.float32)[None], b_gain.shape)
+    prio = jnp.where(can_split, prio, -jnp.inf)
+    ranks = jnp.argsort(jnp.argsort(-prio, axis=1), axis=1).astype(jnp.int32)
+    can_split = can_split & (ranks < budget[:, None])
+    new_budget = budget - jnp.sum(can_split, axis=1).astype(jnp.int32)
+    new_leaf = alive_lvl & ~can_split
+
+    thr_lvl = cuts_pad[b_feat, jnp.minimum(b_bin, B - 1)]
+    member = set_matrix.T[jnp.clip(b_feat, 0, set_matrix.shape[1] - 1)]
+
+    st = state._replace(
+        feat=state.feat.at[:, idx].set(jnp.where(can_split, b_feat, -1)),
+        sbin=state.sbin.at[:, idx].set(jnp.where(can_split, b_bin, 0)),
+        thr=state.thr.at[:, idx].set(jnp.where(can_split, thr_lvl, 0.0)),
+        dleft=state.dleft.at[:, idx].set(b_dleft),
+        is_leaf=state.is_leaf.at[:, idx].set(new_leaf),
+        leaf_val=state.leaf_val.at[:, idx].set(
+            jnp.where(new_leaf, params.eta * w, 0.0)),
+        gain=state.gain.at[:, idx].set(jnp.where(can_split, b_gain, 0.0)),
+        base_weight=state.base_weight.at[:, idx].set(w),
+        sum_hess=state.sum_hess.at[:, idx].set(totals_lvl[..., 1]),
+        splits_left=new_budget,
+    )
+    left_ids = 2 * idx + 1
+    right_ids = 2 * idx + 2
+    st = st._replace(
+        alive=st.alive.at[:, left_ids].set(can_split)
+                      .at[:, right_ids].set(can_split),
+        totals=st.totals.at[:, left_ids].set(b_left)
+                        .at[:, right_ids].set(b_right),
+    )
+    child_compat = compat_lvl & member
+    st = st._replace(
+        setcompat=st.setcompat.at[:, left_ids].set(child_compat)
+                              .at[:, right_ids].set(child_compat))
+    if params.monotone is not None and any(c != 0 for c in params.monotone):
+        cvec = jnp.asarray(params.monotone, jnp.int32)
+        c_at = cvec[jnp.clip(b_feat, 0, len(params.monotone) - 1)]
+        mid = 0.5 * (b_lw + b_rw)
+        l_lo = jnp.where(c_at < 0, mid, lower_lvl)
+        l_hi = jnp.where(c_at > 0, mid, upper_lvl)
+        r_lo = jnp.where(c_at > 0, mid, lower_lvl)
+        r_hi = jnp.where(c_at < 0, mid, upper_lvl)
+        st = st._replace(
+            lower=st.lower.at[:, left_ids].set(l_lo)
+                          .at[:, right_ids].set(r_lo),
+            upper=st.upper.at[:, left_ids].set(l_hi)
+                          .at[:, right_ids].set(r_hi))
+    st = st._replace(
+        pos=_update_positions_k(bins, st.pos, b_feat, b_bin, b_dleft,
+                                can_split, node0, N, B))
+    return st, hist
+
+
+class LockstepHistGrower:
+    """Grow the K per-class trees of one boosting round in lockstep."""
+
+    def __init__(self, max_depth: int, params: SplitParams, *,
+                 interaction_sets=None, max_leaves: int = 0,
+                 lossguide: bool = False, subtract: bool = True) -> None:
+        self.max_depth = max_depth
+        self.params = params
+        self.interaction_sets = interaction_sets
+        self.max_leaves = max_leaves
+        self.lossguide = lossguide
+        self.subtract = subtract
+        self.max_nodes = max_nodes_for_depth(max_depth)
+
+    def grow(self, bins, gpair_rkc, valid, cuts_pad, n_bins,
+             feature_masks=None) -> TreeState:
+        F = bins.shape[1]
+        B = cuts_pad.shape[1]
+        ones = jnp.ones((1, F), dtype=bool)
+        setmat = jnp.asarray(make_set_matrix(self.interaction_sets, F))
+        state = init_lockstep_state(
+            gpair_rkc, valid, max_nodes=self.max_nodes,
+            n_sets=setmat.shape[0],
+            max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
+            n_bin=B)
+        hist_prev = None
+        md = self.max_depth
+        for d in range(md + 1):
+            fm = ones if feature_masks is None else feature_masks(d, 1 << d)
+            state, hist_prev = level_step_lockstep(
+                state, bins, gpair_rkc, cuts_pad, n_bins, fm, setmat,
+                hist_prev, depth=d, params=self.params,
+                last_level=(d == md), lossguide=self.lossguide,
+                subtract=(self.subtract and d > 0 and hist_prev is not None))
+        return state
+
+    @staticmethod
+    def to_host_class(state: TreeState, k: int) -> GrownTree:
+        import numpy as np
+
+        return GrownTree(
+            is_cat=np.asarray(state.is_cat[k]),
+            cat_set=np.asarray(state.cat_set[k]),
+            feat=np.asarray(state.feat[k]),
+            sbin=np.asarray(state.sbin[k]),
+            thr=np.asarray(state.thr[k]),
+            dleft=np.asarray(state.dleft[k]),
+            is_leaf=np.asarray(state.is_leaf[k]),
+            leaf_val=np.asarray(state.leaf_val[k]),
+            gain=np.asarray(state.gain[k]),
+            base_weight=np.asarray(state.base_weight[k]),
+            sum_hess=np.asarray(state.sum_hess[k]),
+            totals=np.asarray(state.totals[k]),
+        )
+
+
+@jax.jit
+def leaf_margin_delta_k(pos, leaf_val):
+    """(K, R) margin deltas from K finished trees (prediction-cache path)."""
+    safe = jnp.clip(pos, 0, leaf_val.shape[1] - 1)
+    vals = jnp.take_along_axis(leaf_val, safe, axis=1)
+    return jnp.where(pos >= 0, vals, 0.0)
