@@ -71,6 +71,58 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(XtbHist, XtbHistImpl,
                                   .Attr<int32_t>("stride")
                                   .Ret<ffi::Buffer<ffi::F32>>());
 
+// quantised limb hist: (bins[R,F], limbs[R,CL] i8, pos[R] i32, node0[1] i32)
+//                      + attr stride -> out[N,F,B,CL] i32
+static ffi::Error XtbHistQImpl(ffi::AnyBuffer bins,
+                               ffi::Buffer<ffi::S8> limbs,
+                               ffi::Buffer<ffi::S32> pos,
+                               ffi::Buffer<ffi::S32> node0, int32_t stride,
+                               ffi::ResultBuffer<ffi::S32> out) {
+  auto bd = bins.dimensions();
+  auto od = out->dimensions();
+  if (bd.size() != 2 || od.size() != 4) {
+    return ffi::Error::InvalidArgument("xtb_hist_q: bad ranks");
+  }
+  const int64_t R = bd[0];
+  const int32_t F = static_cast<int32_t>(bd[1]);
+  const int32_t N = static_cast<int32_t>(od[0]);
+  const int32_t B = static_cast<int32_t>(od[2]);
+  const int32_t CL = static_cast<int32_t>(od[3]);
+  const int32_t n0 = node0.typed_data()[0];
+#define XTB_HQ(TYPE)                                                       \
+  xtb_hist_q_impl(static_cast<const TYPE*>(bins.untyped_data()),           \
+                  limbs.typed_data(), pos.typed_data(), R, F, B, n0, N,    \
+                  stride, CL, out->typed_data())
+  switch (bins.element_type()) {
+    case ffi::U8:
+      XTB_HQ(uint8_t);
+      break;
+    case ffi::U16:
+      XTB_HQ(uint16_t);
+      break;
+    case ffi::S16:
+      XTB_HQ(int16_t);
+      break;
+    case ffi::S32:
+      XTB_HQ(int32_t);
+      break;
+    default:
+      return ffi::Error::InvalidArgument(
+          "xtb_hist_q: unsupported bin dtype");
+  }
+#undef XTB_HQ
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(XtbHistQ, XtbHistQImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .Arg<ffi::Buffer<ffi::S8>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Attr<int32_t>("stride")
+                                  .Ret<ffi::Buffer<ffi::S32>>());
+
 // split: (hist[N,F,B,2] f32, totals[N,2] f32, n_bins[F] i32, fmask[N,F] u8)
 //        + attrs (lam, alpha, mcw, mds)
 //        -> (gain f32, feat i32, bin i32, dleft u8, GL f32, HL f32), each [N]

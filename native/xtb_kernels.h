@@ -70,6 +70,48 @@ inline void xtb_hist_build_impl(const BinT* bins, const float* gpair,
 }
 
 // ---------------------------------------------------------------------------
+// Quantised limb-histogram build: int8 signed base-256 limbs accumulated in
+// int32 (ops/quantise.py layout: values (R, C*3) with C=2 channels x 3
+// limbs).  Integer sums are exact and associative, so ANY accumulation
+// order yields identical bits — this kernel exists purely to give the
+// deterministic_histogram contract the same row-pass speed as the f32
+// path on CPU (the XLA int scatter it replaces is ~10x slower).
+// ---------------------------------------------------------------------------
+template <typename BinT>
+inline void xtb_hist_q_impl(const BinT* bins, const int8_t* limbs,
+                            const int32_t* pos, int64_t R, int32_t F,
+                            int32_t n_bin, int32_t node0, int32_t n_nodes,
+                            int32_t stride, int32_t CL, int32_t* out) {
+  const size_t node_sz = static_cast<size_t>(F) * n_bin * CL;
+  memset(out, 0, n_nodes * node_sz * sizeof(int32_t));
+  for (int64_t r = 0; r < R; ++r) {
+    int32_t local = pos[r] - node0;
+    if (local < 0) continue;
+    int32_t node;
+    if (stride == 2) {
+      if (local & 1) continue;
+      node = local >> 1;
+    } else if (stride == 1) {
+      node = local;
+    } else {
+      if (local % stride != 0) continue;
+      node = local / stride;
+    }
+    if (node >= n_nodes) continue;
+    const BinT* br = bins + r * F;
+    const int8_t* lr = limbs + r * CL;
+    int32_t* ob = out + node * node_sz;
+    for (int32_t f = 0; f < F; ++f) {
+      int32_t b = static_cast<int32_t>(br[f]);
+      if (b < n_bin) {
+        int32_t* p = ob + (static_cast<size_t>(f) * n_bin + b) * CL;
+        for (int32_t c = 0; c < CL; ++c) p[c] += lr[c];
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
 // Split gain scan (numeric features, no monotone constraints) — one bin pass
 // per (node, feature) instead of the XLA formulation's ~15 materialized
 // (N,F,B) temporaries.  Mirrors ops/split.py evaluate_splits exactly: both

@@ -101,9 +101,28 @@ def hist_accumulate_q(bins, gq, pos, node0, n_nodes: int, n_bin: int,
                       chunk: int = 2048, stride: int = 1):
     """Chunked exact int32 limb-histogram accumulation (any chunk order
     produces identical bits — integer addition is associative)."""
-    from .histogram import _use_scatter, scatter_hist_driver
+    from .histogram import _host_impl, scatter_hist_driver
 
-    if _use_scatter():
+    impl = _host_impl()
+    if impl == "native":
+        # native int32 limb row pass (native/xtb_kernels.h xtb_hist_q):
+        # exactness makes the accumulation order irrelevant, so the
+        # deterministic contract rides the same kernel speed as f32
+        import numpy as np
+
+        R, F = bins.shape
+        C, L = gq.shape[1], gq.shape[2]
+        b = bins
+        if b.dtype not in (jnp.uint8, jnp.uint16, jnp.int16, jnp.int32):
+            b = b.astype(jnp.int32)
+        call = jax.ffi.ffi_call(
+            "xtb_hist_q",
+            jax.ShapeDtypeStruct((n_nodes, F, n_bin, C * L), jnp.int32))
+        flat = call(b, gq.reshape(R, C * L), pos.astype(jnp.int32),
+                    jnp.asarray(node0, jnp.int32).reshape(1),
+                    stride=np.int32(stride))
+        return flat.reshape(n_nodes, F, n_bin, C, L)
+    if impl == "scatter":
         C, L = gq.shape[1], gq.shape[2]
         flat = scatter_hist_driver(
             bins, gq.reshape(gq.shape[0], C * L).astype(jnp.int32), pos,

@@ -126,6 +126,7 @@ def load_ffi() -> bool:
 
         lib = c.CDLL(so)
         for name, sym in (("xtb_hist", lib.XtbHist),
+                          ("xtb_hist_q", lib.XtbHistQ),
                           ("xtb_split", lib.XtbSplit),
                           ("xtb_predict", lib.XtbPredict),
                           ("xtb_predict_binned", lib.XtbPredictBinned)):
