@@ -123,6 +123,38 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(XtbHistQ, XtbHistQImpl,
                                   .Attr<int32_t>("stride")
                                   .Ret<ffi::Buffer<ffi::S32>>());
 
+// lambdarank top-k: (s[R] f32, y[R] f32, gptr[G+1] i32)
+//                   + attrs (k, ndcg_weight, score_norm, group_norm)
+//                   -> (grad[R] f32, hess[R] f32)
+static ffi::Error XtbLambdaRankImpl(
+    ffi::Buffer<ffi::F32> s, ffi::Buffer<ffi::F32> y,
+    ffi::Buffer<ffi::S32> gptr, int32_t k, int32_t ndcg_weight,
+    int32_t score_norm, int32_t group_norm,
+    ffi::ResultBuffer<ffi::F32> grad, ffi::ResultBuffer<ffi::F32> hess) {
+  const int64_t R = s.element_count();
+  const int32_t G = static_cast<int32_t>(gptr.element_count()) - 1;
+  if (G < 0 || y.element_count() != R) {
+    return ffi::Error::InvalidArgument("xtb_lambdarank: bad shapes");
+  }
+  xtb_lambdarank_topk_impl(s.typed_data(), y.typed_data(),
+                           gptr.typed_data(), G, R, k, ndcg_weight,
+                           score_norm, group_norm, grad->typed_data(),
+                           hess->typed_data());
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(XtbLambdaRank, XtbLambdaRankImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::S32>>()
+                                  .Attr<int32_t>("k")
+                                  .Attr<int32_t>("ndcg_weight")
+                                  .Attr<int32_t>("score_norm")
+                                  .Attr<int32_t>("group_norm")
+                                  .Ret<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
 // split: (hist[N,F,B,2] f32, totals[N,2] f32, n_bins[F] i32, fmask[N,F] u8)
 //        + attrs (lam, alpha, mcw, mds)
 //        -> (gain f32, feat i32, bin i32, dleft u8, GL f32, HL f32), each [N]

@@ -328,4 +328,92 @@ inline void xtb_predict_binned_impl(
   }
 }
 
+// ---------------------------------------------------------------------------
+// LambdaMART top-k pair gradients (the reference's default pair method,
+// lambdarank_obj.h MakePairs truncation branch + LambdaGrad:91).  Works
+// directly on CSR query groups — no padded (G, k, S) pair tensors, so the
+// CPU path skips the XLA formulation's hundreds of MB of masked
+// intermediates per round.  Semantics mirror ops side-by-side
+// (_lambda_gradients_topk in objective/ranking.py): stable sort by
+// descending score, each of the top-k ranked docs pairs with every doc
+// ranked below it, |delta ndcg|/idcg pair weight, optional score-diff
+// normalization (skipped while all scores in the group are equal),
+// hessian doubled, per-group log2(1+sum_lambda)/sum_lambda rescale.
+// ---------------------------------------------------------------------------
+#include <algorithm>
+
+inline void xtb_lambdarank_topk_impl(
+    const float* s, const float* y, const int32_t* gptr, int32_t n_groups,
+    int64_t R, int32_t k, int32_t ndcg_weight, int32_t score_norm,
+    int32_t group_norm, float* out_grad, float* out_hess) {
+  memset(out_grad, 0, R * sizeof(float));
+  memset(out_hess, 0, R * sizeof(float));
+  std::vector<int32_t> order;
+  std::vector<float> gain, disc, lam_acc, hess_acc;
+  for (int32_t g = 0; g < n_groups; ++g) {
+    const int32_t lo = gptr[g], hi = gptr[g + 1];
+    const int32_t n = hi - lo;
+    if (n <= 1) continue;
+    order.resize(n);
+    for (int32_t i = 0; i < n; ++i) order[i] = lo + i;
+    std::stable_sort(order.begin(), order.end(),
+                     [&](int32_t a, int32_t b) { return s[a] > s[b]; });
+    gain.resize(n);
+    disc.resize(n);
+    for (int32_t i = 0; i < n; ++i) {
+      gain[i] = exp2f(y[order[i]]) - 1.0f;
+      disc[i] = 1.0f / log2f(2.0f + static_cast<float>(i));
+    }
+    // idcg over gains sorted descending
+    std::vector<float> ideal(gain);
+    std::sort(ideal.begin(), ideal.end(), std::greater<float>());
+    float idcg = 0.0f;
+    for (int32_t i = 0; i < n; ++i) idcg += ideal[i] * disc[i];
+    if (idcg < 1e-10f) idcg = 1e-10f;
+    const bool spread = s[order[0]] != s[order[n - 1]];
+
+    lam_acc.assign(n, 0.0f);
+    hess_acc.assign(n, 0.0f);
+    float sum_lambda = 0.0f;
+    const int32_t kk = k < n ? k : n;
+    for (int32_t i = 0; i < kk; ++i) {
+      const float si = s[order[i]], gi = gain[i];
+      for (int32_t j = i + 1; j < n; ++j) {
+        const float gj = gain[j];
+        if (gi == gj) continue;
+        const bool high_is_i = gi > gj;
+        const float s_high = high_is_i ? si : s[order[j]];
+        const float s_low = high_is_i ? s[order[j]] : si;
+        const float sig = 1.0f / (1.0f + expf(-(s_high - s_low)));
+        float delta = 1.0f;
+        if (ndcg_weight) {
+          delta = fabsf((gi - gj) * (disc[i] - disc[j])) / idcg;
+        }
+        if (score_norm && spread) {
+          delta = delta / (fabsf(s_high - s_low) + 0.01f);
+        }
+        const float lam = (sig - 1.0f) * delta;  // high doc's gradient
+        float h = sig * (1.0f - sig) * delta;
+        if (h < 1e-16f) h = 1e-16f;
+        h *= 2.0f;
+        const float sgn_i = high_is_i ? 1.0f : -1.0f;
+        lam_acc[i] += lam * sgn_i;
+        lam_acc[j] -= lam * sgn_i;
+        hess_acc[i] += h;
+        hess_acc[j] += h;
+        sum_lambda += -2.0f * lam;
+      }
+    }
+    float norm = 1.0f;
+    if (group_norm && sum_lambda > 0.0f) {
+      float d = sum_lambda > 1e-16f ? sum_lambda : 1e-16f;
+      norm = log2f(1.0f + sum_lambda) / d;
+    }
+    for (int32_t i = 0; i < n; ++i) {
+      out_grad[order[i]] = lam_acc[i] * norm;
+      out_hess[order[i]] = hess_acc[i] * norm;
+    }
+  }
+}
+
 #endif  // XTB_KERNELS_H_

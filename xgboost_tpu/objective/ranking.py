@@ -69,6 +69,7 @@ class _LambdaRankBase(ObjFunction):
         self._gidx = jnp.asarray(idx)
         self._gmask = jnp.asarray(mask)
         self._ginv = jnp.asarray(inv)
+        self._gptr = jnp.asarray(np.asarray(group_ptr, np.int32))
 
     def default_metric(self):
         return "ndcg"
@@ -81,11 +82,18 @@ class _LambdaRankBase(ObjFunction):
             raise ValueError(f"{self.name} requires group/qid information")
         pred = preds[:, 0] if preds.ndim == 2 else preds
         if self.pair_method == "topk":
-            grad, hess = _lambda_gradients_topk(
-                pred, labels.astype(jnp.float32), self._gidx, self._gmask,
-                self._ginv, k=self.num_pair,
-                ndcg_weight=self._use_ndcg_weight(),
-                score_norm=self.score_norm, group_norm=self.group_norm)
+            if _native_lambdarank_ok():
+                grad, hess = _lambda_gradients_topk_native(
+                    pred, labels.astype(jnp.float32), self._gptr,
+                    k=self.num_pair, ndcg_weight=self._use_ndcg_weight(),
+                    score_norm=self.score_norm,
+                    group_norm=self.group_norm)
+            else:
+                grad, hess = _lambda_gradients_topk(
+                    pred, labels.astype(jnp.float32), self._gidx,
+                    self._gmask, self._ginv, k=self.num_pair,
+                    ndcg_weight=self._use_ndcg_weight(),
+                    score_norm=self.score_norm, group_norm=self.group_norm)
         else:
             key = jax.random.PRNGKey(iteration)
             grad, hess = _lambda_gradients(
@@ -107,6 +115,45 @@ class _LambdaRankBase(ObjFunction):
 
 
 import functools
+
+
+def _native_lambdarank_ok() -> bool:
+    """CPU gate for the native CSR-group top-k pair pass — the padded
+    (G, k, S) pair tensors below cost hundreds of MB of masked
+    intermediates per round that the sequential kernel never materializes
+    (~4x at MSLR shapes).  Same per-host agreement story as the other
+    kernels (utils/native.py)."""
+    import os
+
+    if os.environ.get("XTB_NO_NATIVE_LAMBDARANK", ""):
+        return False
+    if jax.default_backend() != "cpu":
+        return False
+    from ..utils import native
+
+    return native.ffi_usable()
+
+
+@functools.partial(jax.jit, static_argnames=("k", "ndcg_weight", "score_norm",
+                                             "group_norm"))
+def _lambda_gradients_topk_native(pred, y, gptr, *, k: int,
+                                  ndcg_weight: bool, score_norm: bool,
+                                  group_norm: bool):
+    """FFI custom call into xtb_lambdarank_topk_impl — semantics mirror
+    _lambda_gradients_topk (same sort order incl. stable ties, pair set,
+    LambdaGrad weights, group normalization); gradients agree to f32
+    tolerance (tests/test_native_parity.py pins it)."""
+    import numpy as np
+
+    R = pred.shape[0]
+    shapes = (jax.ShapeDtypeStruct((R,), jnp.float32),
+              jax.ShapeDtypeStruct((R,), jnp.float32))
+    call = jax.ffi.ffi_call("xtb_lambdarank", shapes)
+    return call(pred.astype(jnp.float32), y.astype(jnp.float32),
+                gptr.astype(jnp.int32), k=np.int32(k),
+                ndcg_weight=np.int32(ndcg_weight),
+                score_norm=np.int32(score_norm),
+                group_norm=np.int32(group_norm))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "ndcg_weight", "score_norm",

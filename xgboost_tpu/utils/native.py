@@ -129,7 +129,8 @@ def load_ffi() -> bool:
                           ("xtb_hist_q", lib.XtbHistQ),
                           ("xtb_split", lib.XtbSplit),
                           ("xtb_predict", lib.XtbPredict),
-                          ("xtb_predict_binned", lib.XtbPredictBinned)):
+                          ("xtb_predict_binned", lib.XtbPredictBinned),
+                          ("xtb_lambdarank", lib.XtbLambdaRank)):
             jax.ffi.register_ffi_target(name, jax.ffi.pycapsule(sym),
                                         platform="cpu")
         _FFI_READY = True
