@@ -8,6 +8,17 @@
 // are strong PyObject references; every call holds the GIL and converts
 // Python exceptions into the XGBGetLastError contract (c_api_error.h).
 //
+// CONCURRENCY CONTRACT: every entry point acquires the embedded
+// interpreter's GIL for its full duration (API_BEGIN's Gil guard), so the
+// ABI is thread-SAFE but thread-SERIALIZED — N host threads predicting
+// through this library get correct results at single-thread throughput
+// (tests/test_c_api.py test_concurrent_predict_serialized_but_correct).
+// The reference's C API serves truly concurrent predict from one learner
+// (src/c_api/c_api.cc thread-safe Learner); here the supported concurrent
+// path is xgboost_tpu.serving.ServingEngine, which batches concurrent
+// callers into single dispatches instead of multiplying threads
+// (docs/serving.md).
+//
 // Build: native/Makefile (links libpython via python3-config --embed).
 
 #include <Python.h>
@@ -494,6 +505,47 @@ XTB_DLL int XGBoosterGetNumFeature(BoosterHandle handle, bst_ulong* out) {
   *out = (bst_ulong)PyLong_AsUnsignedLongLong(r);
   Py_DECREF(r);
   return 0;
+  API_END();
+}
+
+// Shared body for the categories-export pair (reference:
+// include/xgboost/c_api.h XGBoosterGetCategories / XGDMatrixGetCategories,
+// src/data/cat_container.h).  The reference returns an Arrow-C-schema
+// struct; this ABI returns the mapping as a JSON object
+// {"feature": [values...]} — "null" when no categorical features exist.
+// The buffer is pinned on the handle: valid until the NEXT Get*Categories
+// call on the same handle (which replaces it) or the handle is freed; no
+// *Free call (the ret_str convention of XGBoosterEvalOneIter).
+static int GetCategoriesImpl(const char* glue_method, void* handle,
+                             const char** out_json) {
+  PyObject* r = CallGlue(glue_method, "(O)", (PyObject*)handle);
+  if (r == nullptr) {
+    CaptureError();
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &n) != 0) {
+    Py_DECREF(r);
+    CaptureError();
+    return -1;
+  }
+  *out_json = buf;  // pinned on the handle by the glue
+  Py_DECREF(r);
+  return 0;
+}
+
+XTB_DLL int XGBoosterGetCategories(BoosterHandle handle,
+                                   const char** out_json) {
+  API_BEGIN();
+  return GetCategoriesImpl("booster_get_categories", handle, out_json);
+  API_END();
+}
+
+XTB_DLL int XGDMatrixGetCategories(DMatrixHandle handle,
+                                   const char** out_json) {
+  API_BEGIN();
+  return GetCategoriesImpl("dmatrix_get_categories", handle, out_json);
   API_END();
 }
 

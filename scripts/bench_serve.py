@@ -1,0 +1,151 @@
+"""Serving-engine latency/throughput benchmark -> BENCH_SERVE.json.
+
+Measures the ISSUE-1 acceptance numbers on the CPU backend: p50/p99
+request latency and rows/s at batch sizes {1, 64, 4096} through the
+ServingEngine's pre-compiled bucket path (direct mode isolates per-request
+cost from batching delay), plus one concurrent section — 4 threads of
+batch-1 traffic through the micro-batcher — whose engine metrics snapshot
+(batch-size histogram, queue peak, compiles_steady) is persisted verbatim.
+``compiles_steady`` MUST be 0 in the emitted artifact: a recompile in the
+timed loop is a serving regression, and the suite's smoke test
+(tests/test_serving.py) fails on the same gauge.
+
+Usage:  python scripts/bench_serve.py [out.json]   (default BENCH_SERVE.json)
+Knobs:  BENCH_SERVE_ROUNDS / _DEPTH / _FEATURES for model size,
+        BENCH_SERVE_ITERS to scale the timed loops.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+BATCH_SIZES = (1, 64, 4096)
+ITERS = {1: 400, 64: 200, 4096: 30}
+
+
+def train_model(rounds: int, depth: int, features: int):
+    import xgboost_tpu as xtb
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(20_000, features)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] - 0.25 * X[:, 2] > 0).astype(np.float32)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": depth,
+                     "max_bin": 256}, xtb.DMatrix(X, label=y), rounds,
+                    verbose_eval=False)
+    return bst, X
+
+
+def bench_direct(eng, X, batch: int, iters: int) -> dict:
+    """Per-request latency through the pre-compiled direct path."""
+    rng = np.random.default_rng(batch)
+    rows = [X[rng.integers(0, len(X) - batch + 1)
+              or 0:][:batch] for _ in range(8)]
+    for r in rows[:2]:  # shape warm-up (bucket already compiled by warmup())
+        eng.predict("bench", r, direct=True)
+    lat = np.empty(iters)
+    t_all0 = time.perf_counter()
+    for i in range(iters):
+        t0 = time.perf_counter()
+        eng.predict("bench", rows[i % len(rows)], direct=True)
+        lat[i] = time.perf_counter() - t0
+    wall = time.perf_counter() - t_all0
+    p50, p99 = np.percentile(lat, [50, 99])
+    return {
+        "batch": batch,
+        "iters": iters,
+        "p50_ms": round(float(p50) * 1e3, 4),
+        "p99_ms": round(float(p99) * 1e3, 4),
+        "rows_per_s": round(batch * iters / wall, 1),
+    }
+
+
+def bench_concurrent(eng, X, n_threads: int = 4, per_thread: int = 100):
+    """Batch-1 traffic from N threads through the micro-batcher: the
+    coalescing path the engine exists for."""
+    barrier = threading.Barrier(n_threads)
+    errors = []
+
+    def worker(tid):
+        rng = np.random.default_rng(tid)
+        try:
+            barrier.wait(30)
+            for _ in range(per_thread):
+                eng.predict("bench", X[rng.integers(0, len(X))][None, :])
+        except BaseException as e:  # pragma: no cover
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    wall = time.perf_counter() - t0
+    snap = eng.metrics_snapshot()
+    return {
+        "threads": n_threads,
+        "requests": n_threads * per_thread,
+        "wall_s": round(wall, 3),
+        "requests_per_s": round(n_threads * per_thread / wall, 1),
+        "errors": errors,
+        "engine_metrics": snap,
+    }
+
+
+def main(out_path: str) -> int:
+    import jax
+
+    from xgboost_tpu.serving import ServingEngine
+
+    rounds = int(os.environ.get("BENCH_SERVE_ROUNDS", "20"))
+    depth = int(os.environ.get("BENCH_SERVE_DEPTH", "6"))
+    features = int(os.environ.get("BENCH_SERVE_FEATURES", "28"))
+    scale = float(os.environ.get("BENCH_SERVE_ITERS", "1"))
+
+    bst, X = train_model(rounds, depth, features)
+    report = {
+        "bench": "serving_engine",
+        "platform": jax.default_backend(),
+        "generated_unix": int(time.time()),
+        "model": {"rounds": rounds, "max_depth": depth, "features": features,
+                  "objective": "binary:logistic"},
+        "config": {"warmup_buckets": [1, 64, 4096], "max_batch": 4096,
+                   "max_delay_us": 2000},
+        "results": [],
+    }
+    with ServingEngine(max_batch=4096, max_delay_us=2000,
+                       warmup_buckets=(1, 64, 4096)) as eng:
+        eng.add_model("bench", bst)  # compiles every benchmarked bucket
+        for b in BATCH_SIZES:
+            iters = max(10, int(ITERS[b] * scale))
+            r = bench_direct(eng, X, b, iters)
+            report["results"].append(r)
+            print(f"batch={b:5d}  p50={r['p50_ms']:.3f}ms  "
+                  f"p99={r['p99_ms']:.3f}ms  rows/s={r['rows_per_s']:.0f}")
+        report["concurrent"] = bench_concurrent(eng, X)
+        steady = report["concurrent"]["engine_metrics"]["compiles_steady"]
+        print(f"concurrent: {report['concurrent']['requests_per_s']:.0f} "
+              f"req/s over {report['concurrent']['threads']} threads, "
+              f"steady-state compiles={steady}")
+
+    with open(out_path, "w") as fh:
+        json.dump(report, fh, indent=2)
+        fh.write("\n")
+    print(f"wrote {out_path}")
+    if steady:
+        print("FAIL: engine recompiled after warm-up", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "BENCH_SERVE.json"))

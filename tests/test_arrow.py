@@ -1,0 +1,78 @@
+"""Arrow columnar ingestion (xgboost_tpu/data/arrow.py): pyarrow Table /
+RecordBatch -> column-major float32 with null -> NaN, dictionary columns as
+categoricals (ISSUE 1 satellite; reference: ColumnarAdapter
+src/data/adapter.h:437 + python-package data.py arrow dispatch).
+"""
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+import xgboost_tpu as xtb  # noqa: E402
+from xgboost_tpu.data.arrow import is_arrow  # noqa: E402
+
+
+def test_is_arrow_detects_without_import():
+    t = pa.table({"a": [1.0, 2.0]})
+    assert is_arrow(t)
+    assert is_arrow(t.to_batches()[0])
+    assert not is_arrow(np.zeros((2, 2)))
+    assert not is_arrow([[1.0, 2.0]])
+
+
+def test_table_nulls_become_nan():
+    t = pa.table({
+        "x": pa.array([1.0, None, 3.0], pa.float64()),
+        "y": pa.array([None, 5, 6], pa.int64()),
+    })
+    d = xtb.DMatrix(t)
+    X = d.host_dense()
+    assert X.dtype == np.float32 and X.shape == (3, 2)
+    assert np.isnan(X[1, 0]) and np.isnan(X[0, 1])
+    np.testing.assert_array_equal(X[[0, 2], 0], [1.0, 3.0])
+    np.testing.assert_array_equal(X[1:, 1], [5.0, 6.0])
+    assert d.feature_names == ["x", "y"]
+    assert d.feature_types == ["q", "int"]
+
+
+def test_record_batch_and_chunked_table_agree():
+    data = {"a": [0.5, 1.5, 2.5, 3.5], "b": [1, 2, 3, 4]}
+    table = pa.concat_tables(  # 2 chunks: exercises combine_chunks
+        [pa.table({k: v[:2] for k, v in data.items()}),
+         pa.table({k: v[2:] for k, v in data.items()})])
+    batch = pa.table(data).to_batches()[0]
+    np.testing.assert_array_equal(xtb.DMatrix(table).host_dense(),
+                                  xtb.DMatrix(batch).host_dense())
+
+
+def test_dictionary_column_is_categorical():
+    cat = pa.array(["lo", "hi", "lo", None, "mid"]).dictionary_encode()
+    t = pa.table({"level": cat, "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    d = xtb.DMatrix(t)
+    assert d.feature_types == ["c", "q"]
+    codes = d.host_dense()[:, 0]
+    assert np.isnan(codes[3])  # null category -> missing
+    # physical codes index the dictionary values, exported by name
+    cats = d.get_categories()
+    assert cats == {"level": ["lo", "hi", "mid"]}
+    np.testing.assert_array_equal(codes[[0, 1, 2, 4]], [0.0, 1.0, 0.0, 2.0])
+
+
+def test_custom_missing_applies_to_numeric_only():
+    cat = pa.array(["a", "b", "a"]).dictionary_encode()
+    t = pa.table({"c": cat, "v": [-1.0, 2.0, -1.0]})
+    X = xtb.DMatrix(t, missing=-1.0).host_dense()
+    assert np.isnan(X[0, 1]) and np.isnan(X[2, 1])  # sentinel -> NaN
+    np.testing.assert_array_equal(X[:, 0], [0.0, 1.0, 0.0])  # codes untouched
+
+
+def test_train_predict_roundtrip_from_arrow():
+    rng = np.random.default_rng(7)
+    Xn = rng.normal(size=(128, 3)).astype(np.float32)
+    y = (Xn[:, 0] > 0).astype(np.float32)
+    t = pa.table({f"f{i}": Xn[:, i] for i in range(3)})
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3},
+                    xtb.DMatrix(t, label=y), 3, verbose_eval=False)
+    out_arrow = bst.predict(xtb.DMatrix(t))
+    out_numpy = bst.predict(xtb.DMatrix(Xn))
+    np.testing.assert_array_equal(out_arrow, out_numpy)

@@ -891,3 +891,129 @@ def test_jni_glue_sequence(tmp_path):
                          timeout=600)
     assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
     assert "JNI-GLUE-SEQ-OK" in out.stdout
+
+
+# ===================================================================
+# Serving-era surface: concurrency contract + categories export.
+
+def test_concurrent_predict_serialized_but_correct(capi):
+    """The C ABI's documented concurrency contract (native/xtb_capi.cc):
+    every entry point holds the embedded interpreter's GIL, so N host
+    threads are SERIALIZED but must stay CORRECT.  Each thread drives its
+    own booster handle (prediction buffers pin per-handle, as in the
+    reference where the returned buffer lives until the next call on the
+    same handle) loaded from one shared model buffer; all predictions must
+    be bitwise-identical to the single-threaded result.  Truly concurrent
+    serving belongs to xgboost_tpu.serving (docs/serving.md)."""
+    import threading
+
+    X, y = _mkdata(13)
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromMat(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(X.shape[0]), ctypes.c_uint64(X.shape[1]),
+        ctypes.c_float(np.nan), ctypes.byref(dmat)))
+    _check(capi, capi.XGDMatrixSetFloatInfo(
+        dmat, b"label", y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(len(y))))
+    booster = _train_booster(capi, dmat)
+    blen, bptr = ctypes.c_uint64(), ctypes.c_char_p()
+    _check(capi, capi.XGBoosterSaveModelToBuffer(
+        booster, b'{"format": "ubj"}', ctypes.byref(blen), ctypes.byref(bptr)))
+    raw = ctypes.string_at(bptr, blen.value)
+
+    n0, p0 = ctypes.c_uint64(), ctypes.POINTER(ctypes.c_float)()
+    _check(capi, capi.XGBoosterPredict(booster, dmat, 0, 0, 0,
+                                       ctypes.byref(n0), ctypes.byref(p0)))
+    ref = np.ctypeslib.as_array(p0, shape=(n0.value,)).copy()
+
+    N_THREADS, N_CALLS = 4, 6
+    results, errors = {}, []
+    barrier = threading.Barrier(N_THREADS)
+
+    def worker(tid):
+        try:
+            h = ctypes.c_void_p()
+            _check(capi, capi.XGBoosterCreate(None, ctypes.c_uint64(0),
+                                              ctypes.byref(h)))
+            _check(capi, capi.XGBoosterLoadModelFromBuffer(
+                h, raw, ctypes.c_uint64(len(raw))))
+            barrier.wait(30)
+            outs = []
+            for _ in range(N_CALLS):
+                n, p = ctypes.c_uint64(), ctypes.POINTER(ctypes.c_float)()
+                _check(capi, capi.XGBoosterPredict(h, dmat, 0, 0, 0,
+                                                   ctypes.byref(n),
+                                                   ctypes.byref(p)))
+                outs.append(np.ctypeslib.as_array(
+                    p, shape=(n.value,)).copy())
+            results[tid] = outs
+            _check(capi, capi.XGBoosterFree(h))
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert not errors, errors[0]
+    assert len(results) == N_THREADS
+    for outs in results.values():
+        for out in outs:
+            np.testing.assert_array_equal(out, ref)
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGDMatrixFree(dmat))
+
+
+def test_ctypes_get_categories(capi, tmp_path):
+    """XGBoosterGetCategories / XGDMatrixGetCategories (reference:
+    include/xgboost/c_api.h + src/data/cat_container.h; this ABI returns
+    the mapping as JSON, "null" without categorical features)."""
+    import json
+
+    X, y = _mkdata(14)
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromDense(
+        _aif(X), b'{"missing": NaN}', ctypes.byref(dmat)))
+    out = ctypes.c_char_p()
+    _check(capi, capi.XGDMatrixGetCategories(dmat, ctypes.byref(out)))
+    assert json.loads(out.value) is None  # purely numeric input
+
+    _check(capi, capi.XGDMatrixSetFloatInfo(
+        dmat, b"label", y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(len(y))))
+    booster = _train_booster(capi, dmat, rounds=2)
+    _check(capi, capi.XGBoosterGetCategories(booster, ctypes.byref(out)))
+    assert json.loads(out.value) is None  # trained without categories
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGDMatrixFree(dmat))
+
+    # a model trained on a categorical frame exports its mapping through
+    # the ABI after a file round-trip
+    pd = pytest.importorskip("pandas")
+    import xgboost_tpu as xtb
+
+    rng = np.random.default_rng(14)
+    n = 400
+    col = rng.choice(["red", "green", "blue"], size=n)
+    df = pd.DataFrame({
+        "c": pd.Categorical(col, categories=["red", "green", "blue"]),
+        "x": rng.normal(size=n).astype(np.float32),
+    })
+    yy = (col == "red").astype(np.float32)
+    d = xtb.DMatrix(df, label=yy, enable_categorical=True)
+    assert d.get_categories() == {"c": ["red", "green", "blue"]}
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3}, d, 2,
+                    verbose_eval=False)
+    path = str(tmp_path / "cat.json")
+    bst.save_model(path)
+
+    b2 = ctypes.c_void_p()
+    _check(capi, capi.XGBoosterCreate(None, ctypes.c_uint64(0),
+                                      ctypes.byref(b2)))
+    _check(capi, capi.XGBoosterLoadModel(b2, path.encode()))
+    _check(capi, capi.XGBoosterGetCategories(b2, ctypes.byref(out)))
+    assert json.loads(out.value) == {"c": ["red", "green", "blue"]}
+    _check(capi, capi.XGBoosterFree(b2))

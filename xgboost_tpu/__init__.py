@@ -51,6 +51,7 @@ __all__ = [
     "TrainingCheckPoint",
     "collective",
     "tracker",
+    "serving",
     "train_distributed",
     "plot_importance",
     "plot_tree",
@@ -74,6 +75,12 @@ def __getattr__(name):  # lazy heavy imports
         from . import plotting as _pl
 
         return getattr(_pl, name)
+    if name == "serving":
+        # importlib, not `from . import serving`: the fromlist resolution
+        # getattr's the package for "serving" and would re-enter this hook
+        import importlib
+
+        return importlib.import_module(".serving", __name__)
     if name == "train_distributed":
         from .distributed import train_distributed
 

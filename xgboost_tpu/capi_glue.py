@@ -207,6 +207,20 @@ def booster_num_features(b: Booster) -> int:
     return int(b.num_features())
 
 
+def booster_get_categories(b: Booster) -> bytes:
+    """JSON category mapping (reference: XGBoosterGetCategories,
+    src/data/cat_container.h) — ``null`` when trained without categories."""
+    out = json.dumps(b.get_categories()).encode()
+    b._capi_categories_buf = out  # pinned: the C caller borrows the pointer
+    return out
+
+
+def dmatrix_get_categories(d: DMatrix) -> bytes:
+    out = json.dumps(d.get_categories()).encode()
+    d._capi_categories_buf = out
+    return out
+
+
 # =====================================================================
 # Round-3 surface expansion: array-interface ingestion, inplace predict,
 # DataIter callbacks, dump/slice/feature-info, config IO, collective +

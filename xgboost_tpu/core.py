@@ -27,7 +27,7 @@ from .data.dmatrix import DMatrix
 from .metric import create_metric
 from .models.tree import RegTree
 from .objective import ObjFunction, create_objective
-from .ops.predict import predict_leaf_ids, predict_margin_delta
+from .ops.predict import predict_leaf_ids
 from .ops.split import SplitParams
 from .params import TrainParam, canonicalize, split_unknown
 from .tree.grow import HistTreeGrower, leaf_margin_delta
@@ -419,30 +419,11 @@ class Booster:
         frame's category ordering (encoder/ordinal.h Recode): a frame whose
         pandas categories differ train->inference would otherwise route its
         codes through the wrong split sets silently."""
-        X = data.host_dense()
-        train_cats = getattr(self, "_cat_categories", None)
-        data_cats = getattr(data, "cat_categories", None)
-        if not train_cats or not data_cats or train_cats == {
-                int(k): list(v) for k, v in data_cats.items()}:
-            return X
-        X = np.array(X, copy=True)
-        for f, train_vals in train_cats.items():
-            new_vals = data_cats.get(f)
-            if new_vals is None or list(new_vals) == list(train_vals):
-                continue
-            lookup = {v: i for i, v in enumerate(train_vals)}
-            codes = X[:, f]
-            remapped = np.full_like(codes, np.nan)
-            for new_code, v in enumerate(new_vals):
-                hit = codes == new_code
-                if v in lookup:
-                    remapped[hit] = lookup[v]
-                elif hit.any():
-                    raise ValueError(
-                        f"feature {f} has category {v!r} not seen in "
-                        "training (encoder recode)")
-            X[:, f] = remapped
-        return X
+        from .data.dmatrix import recode_dense
+
+        return recode_dense(data.host_dense(),
+                            getattr(self, "_cat_categories", None),
+                            getattr(data, "cat_categories", None))
 
     @property
     def base_score(self) -> np.ndarray:
@@ -493,6 +474,11 @@ class Booster:
                     "continued training requires the training frame's "
                     "category ordering; re-declare the categorical columns "
                     "with the original categories")
+        if self.feature_names is None and dtrain.feature_names:
+            # inherit the training frame's column names (reference python
+            # package: train() carries dtrain.feature_names onto the booster)
+            # so dumps, importance and get_categories key by name
+            self.feature_names = list(dtrain.feature_names)
         if self.process_type == "update":
             # the update flow keeps its own running margin over the already-
             # updated prefix; the full-model margin/gradient pass below would
@@ -1737,7 +1723,11 @@ class Booster:
             info = self.tree_info[tree_slice]
             wts = (self.tree_weights[tree_slice]
                    if self.tree_weights else [1.0] * len(trees))
-        width = max((t.n_nodes for t in trees), default=1)
+        from .ops.predict import bucket_width
+
+        # pow2 node-pad width: stacked shape (and the compiled program) stays
+        # put as trees drift in size across rounds (ops/predict.py bucket cache)
+        width = bucket_width(max((t.n_nodes for t in trees), default=1))
         depth = max((t.max_depth for t in trees), default=0) + 1
         has_cat = any(t.has_categorical for t in trees)
         is_multi = any(t.leaf_vector is not None for t in trees)
@@ -1767,27 +1757,24 @@ class Booster:
         return self._run_predict(X_dev, stacked, groups, depth)
 
     def _run_predict(self, X_dev, stacked, groups, depth, init=None):
-        if "value_vec" in stacked:
-            from .ops.predict import predict_margin_delta_multi
+        """Dispatch one stacked-ensemble margin pass through the shared row
+        bucket cache (ops/predict.py): rows pad to the bucket shape so repeat
+        callers — eval sets, serving, continuation — reuse compiled programs;
+        a batch already at its bucket shape is passed through untouched."""
+        from .ops.predict import bucket_rows, pad_margin, pad_rows
 
-            return predict_margin_delta_multi(
-                X_dev, stacked["feat"], stacked["thr"], stacked["dleft"],
-                stacked["left"], stacked["right"], stacked["value_vec"],
-                init, depth=depth)
-        if stacked["catm"] is not None:
-            return predict_margin_delta(
-                X_dev,
-                stacked["feat"], stacked["thr"], stacked["dleft"],
-                stacked["left"], stacked["right"], stacked["value"],
-                groups, stacked["is_cat"], stacked["catm"], init,
-                n_groups=self.n_groups, depth=depth,
-            )
-        return predict_margin_delta(
-            X_dev,
-            stacked["feat"], stacked["thr"], stacked["dleft"],
-            stacked["left"], stacked["right"], stacked["value"],
-            groups, init=init, n_groups=self.n_groups, depth=depth,
-        )
+        R = X_dev.shape[0]
+        bucket = bucket_rows(R)
+        X_dev = pad_rows(X_dev, bucket)
+        init = pad_margin(init, bucket)
+        out = self._run_predict_padded(X_dev, stacked, groups, depth, init)
+        return out if bucket == R else out[:R]
+
+    def _run_predict_padded(self, X_dev, stacked, groups, depth, init=None):
+        from .ops.predict import run_stacked_margin
+
+        return run_stacked_margin(X_dev, stacked, groups, depth,
+                                  self.n_groups, init)
 
     # past this many dense f32 elements (256 MB) sparse inputs are predicted
     # in fixed-size row windows instead of one dense device matrix
@@ -1973,6 +1960,28 @@ class Booster:
         if self.n_groups == 1 and not strict_shape:
             out = out[:, 0]
         return out
+
+    def inference_snapshot(self):
+        """Freeze this booster into an immutable, device-resident
+        :class:`xgboost_tpu.serving.InferenceSnapshot` — the unit the serving
+        engine registers, batches over, and LRU-caches.  Mutating the booster
+        afterwards (continued training, set_attr) does not affect snapshots
+        already taken."""
+        from .serving.snapshot import InferenceSnapshot
+
+        return InferenceSnapshot.from_booster(self)
+
+    def get_categories(self) -> Optional[Dict[str, list]]:
+        """Train-time category mapping ``{feature name (or index): values}``
+        for categorical features, or None when the model was trained without
+        frame-level categories (reference: ``XGBoosterGetCategories``,
+        src/data/cat_container.h).  Inference frames are recoded against this
+        mapping; exporting it lets non-Python consumers do the same."""
+        from .data.dmatrix import categories_by_name
+
+        self._configure()
+        return categories_by_name(getattr(self, "_cat_categories", None),
+                                  self.feature_names)
 
     def inplace_predict(self, data, iteration_range=(0, 0), predict_type="value",
                         missing=np.nan, validate_features=True, base_margin=None,

@@ -1,0 +1,71 @@
+"""Arrow columnar ingestion: pyarrow Table/RecordBatch -> column-major numpy.
+
+The reference ingests Arrow through a zero-copy columnar adapter
+(src/data/adapter.h:437 ColumnarAdapter; python-package/xgboost/data.py
+arrow dispatch).  Here the contract is narrower but the semantics match:
+
+- numeric columns copy to float32 with nulls -> NaN (the missing sentinel
+  of the whole pipeline);
+- dictionary-encoded columns are categoricals: the physical CODES feed the
+  tree (feature_type "c") and the dictionary VALUES persist on the DMatrix
+  for train->inference recode (reference: src/encoder/ordinal.h Recode,
+  exported via ``DMatrix.get_categories``/``Booster.get_categories``).
+
+DMatrix construction dispatches here (``_to_numpy_2d`` in dmatrix.py) for
+anything whose module root is ``pyarrow``; pyarrow itself is imported only
+inside that dispatch, so the dependency stays optional.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def is_arrow(data: Any) -> bool:
+    """True for pyarrow Table / RecordBatch (no pyarrow import needed)."""
+    return type(data).__module__.split(".")[0] == "pyarrow" and hasattr(
+        data, "schema")
+
+
+def arrow_to_columnar(data: Any, missing: float, normalize_dense):
+    """Convert an arrow Table/RecordBatch to the dmatrix payload triple
+    ``(("dense", array, cat_categories), feature_names, feature_types)``.
+
+    ``normalize_dense`` is dmatrix.py's shared sentinel->NaN normalizer so
+    arrow rows obey exactly the host-ingest missing semantics (the custom
+    ``missing`` value applies to numeric columns only — categorical codes
+    are unrelated to the user's sentinel)."""
+    import pyarrow as pa
+
+    feature_names = [str(c) for c in data.schema.names]
+    feature_types = []
+    cols = []
+    cat_categories = {}
+    for fi, name in enumerate(data.schema.names):
+        col = data.column(name)
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks()
+        if pa.types.is_dictionary(col.type):
+            # dictionary-encoded = categorical: physical codes train the
+            # tree, the dictionary VALUES persist for train->infer recode
+            cat_categories[fi] = [v.as_py() for v in col.dictionary]
+            codes = col.indices.to_numpy(zero_copy_only=False).astype(
+                np.float32)
+            if col.null_count:
+                codes[np.asarray(col.is_null())] = np.nan
+            cols.append(codes)
+            feature_types.append("c")
+        else:
+            vals = col.to_numpy(zero_copy_only=False).astype(np.float32)
+            if col.null_count:
+                vals[np.asarray(col.is_null())] = np.nan
+            cols.append(vals)
+            feature_types.append(
+                "q" if pa.types.is_floating(col.type) else "int")
+    arr = (np.stack(cols, axis=1) if cols
+           else np.zeros((data.num_rows, 0), np.float32))
+    return (("dense",
+             normalize_dense(arr, missing, np, feature_types),
+             cat_categories),
+            feature_names, feature_types)

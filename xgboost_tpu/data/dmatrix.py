@@ -96,6 +96,53 @@ def _normalize_dense(arr, missing: float, xp, feature_types=None):
     return arr
 
 
+def categories_by_name(cat_categories: Optional[dict],
+                       feature_names: Optional[Sequence[str]],
+                       ) -> Optional[Dict[str, list]]:
+    """Render a ``{feature index -> category values}`` mapping with feature
+    NAMES as keys (index string when unnamed) — the single implementation
+    behind every ``get_categories`` surface (DMatrix, Booster,
+    InferenceSnapshot; reference: src/data/cat_container.h)."""
+    if not cat_categories:
+        return None
+    names = feature_names
+    return {
+        (names[fi] if names and fi < len(names) else str(fi)): list(vals)
+        for fi, vals in sorted(cat_categories.items())
+    }
+
+
+def recode_dense(X: np.ndarray, train_cats: Optional[dict],
+                 data_cats: Optional[dict]) -> np.ndarray:
+    """Remap categorical codes in a dense matrix from ``data_cats`` (the
+    frame the matrix was built from) onto ``train_cats`` (the TRAINING
+    frame's category->code mapping; reference: encoder/ordinal.h Recode).
+    Returns ``X`` untouched when the orderings already agree; raises on a
+    category never seen in training.  Shared by Booster prediction and the
+    serving snapshot so both route codes through the same split sets."""
+    if not train_cats or not data_cats or train_cats == {
+            int(k): list(v) for k, v in data_cats.items()}:
+        return X
+    X = np.array(X, copy=True)
+    for f, train_vals in train_cats.items():
+        new_vals = data_cats.get(f)
+        if new_vals is None or list(new_vals) == list(train_vals):
+            continue
+        lookup = {v: i for i, v in enumerate(train_vals)}
+        codes = X[:, f]
+        remapped = np.full_like(codes, np.nan)
+        for new_code, v in enumerate(new_vals):
+            hit = codes == new_code
+            if v in lookup:
+                remapped[hit] = lookup[v]
+            elif hit.any():
+                raise ValueError(
+                    f"feature {f} has category {v!r} not seen in "
+                    "training (encoder recode)")
+        X[:, f] = remapped
+    return X
+
+
 def _to_numpy_2d(data: Any, missing: float = np.nan):
     """Dispatch user input -> (dense ndarray | csr triple, feature names/types).
 
@@ -117,42 +164,10 @@ def _to_numpy_2d(data: Any, missing: float = np.nan):
             pass  # fall through to np.asarray
     # pyarrow Table / RecordBatch (columnar adapter; reference:
     # ColumnarAdapter src/data/adapter.h:437 + data.py _from_arrow)
-    if type(data).__module__.split(".")[0] == "pyarrow":
-        import pyarrow as pa
+    from .arrow import arrow_to_columnar, is_arrow
 
-        feature_names = [str(c) for c in data.schema.names]
-        feature_types = []
-        cols = []
-        cat_categories = {}
-        for fi, name in enumerate(data.schema.names):
-            col = data.column(name)
-            if isinstance(col, pa.ChunkedArray):
-                col = col.combine_chunks()
-            if pa.types.is_dictionary(col.type):
-                # dictionary-encoded = categorical: physical codes train the
-                # tree, the dictionary VALUES persist for train->infer recode
-                # (reference: src/encoder/ordinal.h Recode)
-                cat_categories[fi] = [
-                    v.as_py() for v in col.dictionary]
-                codes = col.indices.to_numpy(zero_copy_only=False).astype(
-                    np.float32)
-                if col.null_count:
-                    codes[np.asarray(col.is_null())] = np.nan
-                cols.append(codes)
-                feature_types.append("c")
-            else:
-                vals = col.to_numpy(zero_copy_only=False).astype(np.float32)
-                if col.null_count:
-                    vals[np.asarray(col.is_null())] = np.nan
-                cols.append(vals)
-                feature_types.append(
-                    "q" if pa.types.is_floating(col.type) else "int")
-        arr = (np.stack(cols, axis=1) if cols
-               else np.zeros((data.num_rows, 0), np.float32))
-        return (("dense",
-                 _normalize_dense(arr, missing, np, feature_types),
-                 cat_categories),
-                feature_names, feature_types)
+    if is_arrow(data):
+        return arrow_to_columnar(data, missing, _normalize_dense)
     # polars (columnar adapter; reference: ColumnarAdapter src/data/adapter.h
     # + python-package data.py _from_polars)
     if type(data).__module__.split(".")[0] == "polars":
@@ -355,6 +370,14 @@ class DMatrix:
         return self.info.feature_types
 
     # ---- raw views for prediction ----
+    def get_categories(self) -> Optional[dict]:
+        """Category values per categorical feature, keyed by feature name (or
+        index when unnamed), as captured from the input frame (pandas/polars/
+        arrow dictionary columns).  None for purely numeric inputs
+        (reference: ``XGDMatrixGetCategories``, src/data/cat_container.h)."""
+        return categories_by_name(self.cat_categories,
+                                  self.info.feature_names)
+
     def host_dense(self) -> np.ndarray:
         """Dense f32 view with NaN missing (prediction walks raw values)."""
         if self._dense is not None:

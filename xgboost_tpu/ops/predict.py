@@ -15,6 +15,96 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# --------------------------------------------------------------- bucket cache
+# Shared shape-bucket policy for every margin-predict caller (training eval,
+# Booster.predict, the serving engine).  jax.jit specializes per shape, so
+# without bucketing each distinct row count compiles a fresh program; with it,
+# steady-state traffic lands on a handful of padded shapes that all hit the
+# same jit cache (the role of the reference GPU predictor's fixed thread-block
+# geometry, gpu_predictor.cu).  Rows are padded with NaN — traversal is
+# row-independent, so the pad rows change nothing and are sliced off.
+
+_MIN_ROW_BUCKET = 8
+# past this, pow2 padding could waste up to 2x; fall back to chunk multiples
+_POW2_ROW_CEILING = 4096
+
+
+def round_up_pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def bucket_rows(n: int) -> int:
+    """Padded row count for a batch of ``n`` rows: power-of-two buckets up to
+    4096, then multiples of 4096 (bounded <0.1% overhead at scale)."""
+    n = int(n)
+    if n <= _MIN_ROW_BUCKET:
+        return _MIN_ROW_BUCKET
+    if n <= _POW2_ROW_CEILING:
+        return round_up_pow2(n)
+    c = _POW2_ROW_CEILING
+    return ((n + c - 1) // c) * c
+
+
+def bucket_width(w: int) -> int:
+    """Padded node count for a stacked tree ensemble.  Trees grown across
+    rounds drift in node count; rounding the pad width to a power of two keeps
+    the stacked (T, M) shape — and therefore the compiled program — stable, so
+    training-eval stops retracing every time a round yields a bushier tree."""
+    return round_up_pow2(max(int(w), 2))
+
+
+def pad_rows(X, bucket: int):
+    """Pad a (R, F) batch with NaN rows up to ``bucket``.  No-op (no copy, no
+    retrace) when the row count already matches the compiled shape."""
+    R = X.shape[0]
+    if R == bucket:
+        return X
+    return jnp.pad(X, ((0, bucket - R), (0, 0)), constant_values=jnp.nan)
+
+
+def pad_margin(init, bucket: int):
+    """Pad an optional (R, K) starting margin to the row bucket with zeros."""
+    if init is None:
+        return None
+    R = init.shape[0]
+    if R == bucket:
+        return init
+    return jnp.pad(init, ((0, bucket - R), (0, 0)))
+
+
+def predict_cache_size() -> int:
+    """Total compiled-program count across the predict entry points — the
+    serving engine's recompile gauge (zero growth after warm-up is the SLO)."""
+    return sum(
+        f._cache_size()
+        for f in (predict_margin_delta, predict_margin_delta_multi,
+                  predict_leaf_ids, predict_margin_delta_binned)
+    )
+
+
+def run_stacked_margin(X_dev, stacked, groups, depth: int, n_groups: int,
+                       init=None):
+    """Dispatch a bucket-padded (B, F) batch through the jitted margin kernel
+    matching the stacked-ensemble layout (multi-target value vectors,
+    categorical masks, or plain scalar leaves).  The single place the stacked
+    dict's field contract is interpreted — Booster prediction and the serving
+    snapshot both route here so their outputs stay bitwise-identical."""
+    if "value_vec" in stacked:
+        return predict_margin_delta_multi(
+            X_dev, stacked["feat"], stacked["thr"], stacked["dleft"],
+            stacked["left"], stacked["right"], stacked["value_vec"],
+            init, depth=depth)
+    if stacked["catm"] is not None:
+        return predict_margin_delta(
+            X_dev, stacked["feat"], stacked["thr"], stacked["dleft"],
+            stacked["left"], stacked["right"], stacked["value"],
+            groups, stacked["is_cat"], stacked["catm"], init,
+            n_groups=n_groups, depth=depth)
+    return predict_margin_delta(
+        X_dev, stacked["feat"], stacked["thr"], stacked["dleft"],
+        stacked["left"], stacked["right"], stacked["value"],
+        groups, init=init, n_groups=n_groups, depth=depth)
+
 
 def _traverse_one_tree(X, feat, thr, dleft, left, right, depth: int,
                        is_cat=None, catm=None):

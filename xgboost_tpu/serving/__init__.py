@@ -1,0 +1,38 @@
+"""xgboost_tpu.serving — batched, multi-model inference engine.
+
+The production serving layer over the predictor (docs/serving.md):
+
+- :class:`ServingEngine` — pre-compiled padded-bucket predict programs,
+  dynamic micro-batching, per-model metrics with latency quantiles.
+- :class:`ServeConfig` — SLO knobs (max_batch, max_delay_us, residency cap,
+  warm-up buckets).
+- :class:`ModelRegistry` — versioned LRU model residency with pinning.
+- :class:`InferenceSnapshot` — immutable device-resident view of a trained
+  Booster (``Booster.inference_snapshot()``).
+- :class:`MicroBatcher` / :class:`ServingMetrics` — the coalescing and
+  observability building blocks, usable standalone.
+
+Quick start::
+
+    import xgboost_tpu as xtb
+    from xgboost_tpu.serving import ServingEngine
+
+    eng = ServingEngine(max_delay_us=1000)
+    eng.add_model("ctr", booster)           # or a .json/.ubj path
+    probs = eng.predict("ctr", rows)        # N threads may call this
+    print(eng.metrics_snapshot()["models"]["ctr"]["latency_ms"])
+"""
+from .batcher import MicroBatcher
+from .engine import ServeConfig, ServingEngine
+from .metrics import ServingMetrics
+from .registry import ModelRegistry
+from .snapshot import InferenceSnapshot
+
+__all__ = [
+    "ServingEngine",
+    "ServeConfig",
+    "ModelRegistry",
+    "InferenceSnapshot",
+    "MicroBatcher",
+    "ServingMetrics",
+]

@@ -1,0 +1,159 @@
+"""Dynamic micro-batcher: coalesce concurrent predict requests per model.
+
+Admission policy (the standard dynamic-batching contract, cf. arXiv:1806.11248
+§6 where prediction throughput comes from batching rows, not threads): a
+request joins the queue of its (model, options) key and the worker launches a
+batch as soon as EITHER the queued rows reach ``max_batch`` OR the oldest
+request has waited ``max_delay_us``.  Batches concatenate request rows in FIFO
+order, run once through the engine's compiled bucket program, and the result
+is split back per caller — so N concurrent callers cost one traced program
+execution instead of N, and tail latency is bounded by the admission delay
+plus one batch execution.
+
+A single worker thread executes all batches.  That is deliberate: the JAX/C
+ABI dispatch path serializes on the interpreter anyway (docs/serving.md), so
+extra executor threads would only add context switches; ordering through one
+worker also keeps results deterministic.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Deque, Dict, List
+
+import numpy as np
+
+
+class _Request:
+    __slots__ = ("X", "future", "t_enqueue_ns", "ctx")
+
+    def __init__(self, X: np.ndarray, ctx: Any) -> None:
+        self.X = X
+        self.future: Future = Future()
+        self.t_enqueue_ns = time.perf_counter_ns()
+        self.ctx = ctx
+
+
+class MicroBatcher:
+    """``submit(key, X, ctx)`` -> Future of the per-request result rows.
+
+    ``execute(key, X, ctx)`` is the engine callback running one coalesced
+    batch; ``ctx`` is an opaque per-key context (resolved model snapshot +
+    options) taken from the first request of the batch.
+    """
+
+    def __init__(self, execute: Callable[[Any, np.ndarray, Any], np.ndarray],
+                 *, max_batch: int = 4096, max_delay_us: int = 2000,
+                 metrics=None) -> None:
+        self._execute = execute
+        self.max_batch = int(max_batch)
+        self.max_delay_ns = int(max_delay_us) * 1000
+        self._metrics = metrics
+        self._queues: Dict[Any, Deque[_Request]] = {}
+        self._rows: Dict[Any, int] = {}  # running per-key queued-row counts
+        self._cv = threading.Condition()
+        self._closed = False
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="xtb-serve-batcher")
+        self._worker.start()
+
+    # ------------------------------------------------------------------ API
+    def submit(self, key: Any, X: np.ndarray, ctx: Any = None) -> Future:
+        req = _Request(X, ctx)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._queues.setdefault(key, deque()).append(req)
+            self._rows[key] = self._rows.get(key, 0) + len(X)
+            if self._metrics is not None:
+                self._metrics.queue_delta(len(X))
+            self._cv.notify()
+        return req.future
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+        self._worker.join()
+
+    # ---------------------------------------------------------------- worker
+    def _drain(self, key: Any) -> List[_Request]:
+        """Pop FIFO requests up to max_batch rows (always at least one, so an
+        oversized single request still runs as its own batch)."""
+        q = self._queues[key]
+        batch, rows = [], 0
+        while q and (not batch or rows + len(q[0].X) <= self.max_batch):
+            r = q.popleft()
+            batch.append(r)
+            rows += len(r.X)
+        if q:
+            self._rows[key] -= rows
+        else:
+            del self._queues[key]
+            del self._rows[key]
+        if self._metrics is not None:
+            self._metrics.queue_delta(-rows)
+        return batch
+
+    def _run_batch(self, key: Any, batch: List[_Request]) -> None:
+        # the whole prepare/execute/account sequence is guarded: an escaped
+        # exception would kill the sole worker thread and leave every pending
+        # and future submit() hanging on a future nobody will ever resolve
+        try:
+            X = (batch[0].X if len(batch) == 1
+                 else np.concatenate([r.X for r in batch], axis=0))
+            t0 = time.perf_counter_ns()
+            out = self._execute(key, X, batch[0].ctx)
+            exec_ns = time.perf_counter_ns() - t0
+            if self._metrics is not None:
+                label = key[0] if isinstance(key, tuple) else str(key)
+                self._metrics.observe_batch(label, len(X), len(batch),
+                                            exec_ns)
+        except BaseException as e:  # fan the failure out to every caller
+            for r in batch:
+                if not r.future.set_running_or_notify_cancel():
+                    continue
+                r.future.set_exception(e)
+            return
+        off = 0
+        for r in batch:
+            n = len(r.X)
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_result(out[off:off + n])
+            off += n
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    # scan EVERY key: a queue that reached max_batch launches
+                    # now even while another key's delay window is still open
+                    # (oldest-key-only evaluation would park a full batch
+                    # behind a lone slow-filling key for the whole delay);
+                    # among ready keys the oldest head keeps FIFO fairness
+                    now = time.perf_counter_ns()
+                    key, key_t, earliest = None, None, None
+                    for k, q in self._queues.items():
+                        if not q:
+                            continue
+                        head_t = q[0].t_enqueue_ns
+                        deadline = head_t + self.max_delay_ns
+                        rows = self._rows[k]
+                        if (rows >= self.max_batch or deadline <= now
+                                or self._closed):
+                            if key_t is None or head_t < key_t:
+                                key, key_t = k, head_t
+                        elif earliest is None or deadline < earliest:
+                            earliest = deadline
+                    if key is not None:
+                        batch = self._drain(key)
+                        break
+                    if earliest is None:  # nothing queued at all
+                        if self._closed:
+                            return
+                        self._cv.wait()
+                    else:
+                        self._cv.wait(timeout=(earliest - now) / 1e9)
+            self._run_batch(key, batch)

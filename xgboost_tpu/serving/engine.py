@@ -1,0 +1,353 @@
+"""ServingEngine: batched multi-model inference with latency SLOs.
+
+The production predict path the ROADMAP north star asks for.  Three ideas:
+
+1. **Pre-compiled bucket programs.**  Requests pad to power-of-two row
+   buckets (ops/predict.py ``bucket_rows``) and run through the same jitted
+   entry points training eval uses, so the compiled-program cache is shared
+   engine-wide and — after ``warmup()`` — steady-state traffic never traces.
+   ``compile_cache_size()`` is the regression gauge: it must not grow once
+   warm (the test suite asserts this under an N-thread hammer).
+2. **Dynamic micro-batching.**  Concurrent callers coalesce per
+   (model, version, options) key up to ``max_batch`` rows / ``max_delay_us``
+   (batcher.py), one worker executes, results split per caller.  This is how
+   the engine sidesteps both the embedded-CPython C-ABI GIL serialization
+   (docs/serving.md) and JAX dispatch contention: threads cost one batch.
+3. **Hot-model residency.**  Snapshots live in a ModelRegistry with LRU
+   eviction + version pinning; stacked tree tensors stay device-resident for
+   the model's residency lifetime (registry.py).
+
+On accelerator backends the engine donates a per-(model, bucket) scratch
+buffer into each call so XLA writes margins into recycled device memory
+(steady state allocates nothing per request); CPU ignores donation, so the
+path self-disables there.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ..ops.predict import (_MIN_ROW_BUCKET, _POW2_ROW_CEILING, bucket_rows,
+                           pad_rows, predict_cache_size)
+from .batcher import MicroBatcher
+from .metrics import ServingMetrics
+from .registry import ModelRegistry
+from .snapshot import InferenceSnapshot
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """SLO knobs (docs/serving.md has the tuning guide)."""
+
+    max_batch: int = 4096        # admission: batch launches at this many rows
+    max_delay_us: int = 2000     # admission: ... or when the oldest waited this
+    max_models: int = 8          # LRU residency cap (registry)
+    # row buckets compiled up front; None = every bucket the ADMISSION policy
+    # can produce (<= max_batch rows), so default-config batched traffic never
+    # compiles at steady state.  A single request LARGER than max_batch runs
+    # as its own oversized batch and still compiles on first hit — warm its
+    # bucket explicitly if such requests are part of the SLO.  An explicit
+    # tuple trades warm-up time for first-hit compiles.
+    warmup_buckets: Optional[Tuple[int, ...]] = None
+    use_batcher: bool = True     # False = every predict() runs inline
+    donate_buffers: bool = True  # donate scratch on non-CPU backends
+
+    def __post_init__(self) -> None:
+        if self.max_batch < 1 or self.max_delay_us < 0:
+            raise ValueError("max_batch >= 1 and max_delay_us >= 0 required")
+
+    def resolved_warmup_buckets(self) -> Tuple[int, ...]:
+        if self.warmup_buckets is not None:
+            return self.warmup_buckets
+        top = bucket_rows(self.max_batch)
+        out, b = [], _MIN_ROW_BUCKET
+        while b < min(top, _POW2_ROW_CEILING):
+            out.append(b)
+            b *= 2
+        while b < top:  # past the pow2 ceiling buckets step by the ceiling
+            out.append(b)
+            b += _POW2_ROW_CEILING
+        out.append(top)
+        return tuple(out)
+
+
+class _Program:
+    """Per-snapshot compiled-call wrapper holding the donation scratch."""
+
+    def __init__(self, snap: InferenceSnapshot, donate: bool) -> None:
+        import jax
+
+        self.snap = snap
+        self.donate = donate and jax.default_backend() != "cpu"
+        self._scratch = {}  # bucket -> recycled (B, K) device buffer
+        # donated-path callers hold this from margin_padded through their
+        # host copy-out: the buffer pushed to _scratch is the CALLER'S result,
+        # so a second thread (warmup racing the batcher worker on the same
+        # program) must not pop and donate it until the caller has drained it
+        self.donate_lock = threading.Lock()
+        self.seen_shapes = set()  # (bucket, F, margin) served at least once
+        self._base_dev = None
+        if self.donate:  # pragma: no cover - accelerator-only path
+            def _margin_into(scratch, Xp):
+                del scratch  # memory-only donation: XLA reuses the buffer
+                return snap.margin_padded(Xp)
+
+            self._fn = jax.jit(_margin_into, donate_argnums=(0,))
+
+    def base_dev(self):
+        if self._base_dev is None:
+            import jax.numpy as jnp
+
+            self._base_dev = jnp.asarray(self.snap.base_score)
+        return self._base_dev
+
+    def margin_padded(self, Xp, donate: bool = True):
+        if not (self.donate and donate):
+            return self.snap.margin_padded(Xp)
+        import jax.numpy as jnp  # pragma: no cover - accelerator-only path
+
+        B = Xp.shape[0]
+        scratch = self._scratch.pop(B, None)
+        if scratch is None:
+            scratch = jnp.zeros((B, self.snap.n_groups), jnp.float32)
+        out = self._fn(scratch, Xp)
+        # recycle: the caller holds donate_lock until its result is copied to
+        # host, so the next donated call cannot reuse this buffer early
+        self._scratch[B] = out
+        return out
+
+
+class ServingEngine:
+    def __init__(self, config: Optional[ServeConfig] = None, **overrides,
+                 ) -> None:
+        if config is None:
+            config = ServeConfig(**overrides)
+        elif overrides:
+            config = dataclasses.replace(config, **overrides)
+        self.config = config
+        self.metrics = ServingMetrics()
+        self.registry = ModelRegistry(max_models=config.max_models)
+        self._batcher: Optional[MicroBatcher] = (
+            MicroBatcher(self._execute, max_batch=config.max_batch,
+                         max_delay_us=config.max_delay_us,
+                         metrics=self.metrics)
+            if config.use_batcher else None)
+        self._warming = 0  # >0 while warmup() runs (attributes its compiles)
+        self._warm_lock = threading.Lock()  # += / -= are not atomic
+        self._prog_lock = threading.Lock()
+        self._closed = False
+
+    # ----------------------------------------------------------- model admin
+    def add_model(self, name: str, source, *, version: Optional[int] = None,
+                  warmup: bool = True) -> int:
+        """Register a Booster (or .json/.ubj model path) and optionally
+        pre-compile its warm-up bucket programs."""
+        v = self.registry.register(name, source, version=version)
+        if warmup:
+            self.warmup(name, version=v)
+        return v
+
+    def warmup(self, name: str, version: Optional[int] = None,
+               buckets: Optional[Tuple[int, ...]] = None) -> int:
+        """Compile the padded-bucket programs (margin + transformed output)
+        for ``buckets`` so steady-state requests never trace.  Returns the
+        number of programs compiled."""
+        snap, v = self.registry.get(name, version)
+        before = self.compile_cache_size()
+        with self._warm_lock:
+            self._warming += 1
+        try:
+            for b in sorted(set(buckets
+                                or self.config.resolved_warmup_buckets())):
+                X = np.full((int(b), max(snap.num_features, 1)), np.nan,
+                            np.float32)
+                key = (name, v, False)
+                self._execute(key, X, (snap, False))
+                self._execute((name, v, True), X, (snap, True))
+                prog = self._prog(snap)
+                if prog.donate:  # pragma: no cover - accelerator-only path
+                    # the batcher worker serves through the DONATED jit
+                    # variant (a per-program cache) — compile it now too, or
+                    # the first real batch per bucket pays the trace warmup
+                    # was meant to absorb
+                    import jax.numpy as jnp
+
+                    with prog.donate_lock:
+                        np.asarray(prog.margin_padded(jnp.asarray(X),
+                                                      donate=True))
+        finally:
+            with self._warm_lock:
+                self._warming -= 1
+        compiled = self.compile_cache_size() - before
+        self.metrics.compiles_warmup += compiled
+        return compiled
+
+    def pin(self, name: str, version: int) -> None:
+        self.registry.pin(name, version)
+
+    def unpin(self, name: str) -> None:
+        self.registry.unpin(name)
+
+    # -------------------------------------------------------------- predict
+    def predict(self, name: str, X, *, version: Optional[int] = None,
+                output_margin: bool = False, direct: bool = False,
+                ) -> np.ndarray:
+        """Predict rows of ``X`` with ``name`` (latest / pinned version).
+
+        Goes through the micro-batcher unless ``direct=True`` (or the engine
+        was built with ``use_batcher=False``).  Output matches
+        ``Booster.predict``: (R,) for single-group models, else (R, K) —
+        except DMatrix ``base_margin``, which the engine rejects (it cannot
+        ride a coalesced batch; use ``Booster.predict`` for that)."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        t0 = time.perf_counter_ns()
+        try:
+            # inside the guarded region so unknown/evicted-version failures
+            # land in the per-model error counter too
+            snap, v = self.registry.get(name, version)
+            key = (name, v, bool(output_margin))
+            Xn = self._as_batch(snap, X)
+            if direct or self._batcher is None:
+                out = self._execute(key, Xn, (snap, output_margin))
+            else:
+                out = self._batcher.submit(key, Xn,
+                                           (snap, output_margin)).result()
+        except BaseException:
+            self.metrics.observe_error(name)
+            raise
+        self.metrics.observe_request(name, len(Xn),
+                                     time.perf_counter_ns() - t0)
+        # squeeze from the OUTPUT width, not the submit-time snapshot: a
+        # same-version hot-swap between submit and execute serves the new
+        # snapshot, whose group count may differ from the one resolved above
+        return out[:, 0] if out.shape[1] == 1 else out
+
+    # ------------------------------------------------------------ internals
+    @staticmethod
+    def _as_batch(snap: InferenceSnapshot, X) -> np.ndarray:
+        if hasattr(X, "host_dense"):  # DMatrix: recode cats like Booster.predict
+            if getattr(X.info, "base_margin", None) is not None:
+                raise ValueError(
+                    "the serving engine does not apply DMatrix base_margin "
+                    "(a per-request starting margin cannot ride a coalesced "
+                    "batch); use Booster.predict for margin-adjusted scoring")
+            X = snap.host_dense_recoded(X)
+        X = np.asarray(X, np.float32)
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2:
+            raise ValueError(f"expected (rows, features), got shape {X.shape}")
+        if snap.num_features and X.shape[1] != snap.num_features:
+            raise ValueError(
+                f"feature shape mismatch: model has {snap.num_features} "
+                f"features, input has {X.shape[1]}")
+        return X
+
+    def _prog(self, snap: InferenceSnapshot) -> _Program:
+        prog = getattr(snap, "_serve_prog", None)
+        if prog is None:
+            # locked check-then-set: warmup() and the batcher worker can hit
+            # a fresh snapshot at once, and two _Program wrappers would mean
+            # two donated jit caches and two donate_locks
+            with self._prog_lock:
+                prog = getattr(snap, "_serve_prog", None)
+                if prog is None:
+                    prog = _Program(snap, self.config.donate_buffers)
+                    snap._serve_prog = prog  # rides the registry lifetime
+        return prog
+
+    def _execute(self, key: Any, X: np.ndarray, ctx) -> np.ndarray:
+        """Run one (possibly coalesced) batch.  Called by the batcher worker
+        or inline for direct predicts; returns host (R, K) outputs."""
+        import jax.numpy as jnp
+
+        snap, output_margin = ctx
+        # re-resolve at execute time: a register() hot-swap of this (name,
+        # version) between submit and execute must serve the CURRENT snapshot
+        # for the whole coalesced batch, not whichever request queued first;
+        # fall back to the submit-time snapshot if it was evicted meanwhile —
+        # or if the replacement's feature count no longer matches this batch
+        # (requests were validated against the submit-time snapshot; running
+        # mismatched columns through the new trees would return garbage, JAX
+        # clamps out-of-bounds feature gathers instead of erroring)
+        try:
+            cur, _ = self.registry.get(key[0], key[1])
+            if not cur.num_features or cur.num_features == X.shape[1]:
+                snap = cur
+        except KeyError:
+            pass
+        prog = self._prog(snap)
+        R = X.shape[0]
+        bucket = bucket_rows(R)
+        # the compile gauge walks four jit caches under the registry lock; a
+        # (bucket, margin) pair this program has already served cannot compile
+        # again, so skip the probe on known-warm shapes (the hot path)
+        probe_key = (bucket, X.shape[1], bool(output_margin))
+        probe = probe_key not in prog.seen_shapes
+        before = self.compile_cache_size() if probe else 0
+        Xd = pad_rows(jnp.asarray(X, dtype=jnp.float32), bucket)
+        # scratch donation recycles the previous result buffer, which is only
+        # safe from the single batcher worker (direct predicts from N threads
+        # could donate a buffer another caller is still copying to host);
+        # donate_lock is held through the host copy so a concurrent warmup()
+        # on the same program cannot re-donate this result mid-drain
+        on_worker = (self._batcher is not None
+                     and threading.current_thread() is self._batcher._worker)
+        if prog.donate and on_worker:  # pragma: no cover - accelerator-only
+            with prog.donate_lock:
+                margin = prog.margin_padded(Xd, donate=True) \
+                    + prog.base_dev()[None, :]
+                out = margin if output_margin else snap.transform(margin)
+                host = np.asarray(out)
+        else:
+            margin = prog.margin_padded(Xd, donate=False) \
+                + prog.base_dev()[None, :]
+            out = margin if output_margin else snap.transform(margin)
+            host = np.asarray(out)
+        if probe:
+            # strictly positive: a concurrent eviction can shrink the gauge
+            # mid-window, and a negative delta must not cancel real compiles
+            grew = self.compile_cache_size() - before
+            if grew > 0 and not self._warming:
+                self.metrics.note_steady_compiles(grew)
+            prog.seen_shapes.add(probe_key)
+        return host[:R] if bucket != R else host
+
+    # ---------------------------------------------------------------- admin
+    def compile_cache_size(self) -> int:
+        """Compiled predict programs alive.  Flat after warm-up == the
+        no-retrace SLO holds.  The gauge is PROCESS-global (the jit cache is
+        shared with training eval and any other engine), so a process that
+        trains while serving can grow it — and compiles_steady — without a
+        serving retrace; in mixed processes treat a bump as a prompt to
+        check, not proof of regression (docs/serving.md)."""
+        donated = sum(
+            prog._fn._cache_size()
+            for prog in self.registry.serve_programs()
+            if prog.donate)  # pragma: no cover - accelerator-only term
+        return predict_cache_size() + donated
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["compiled_programs"] = self.compile_cache_size()
+        snap["resident_models"] = len(self.registry)
+        snap["resident_bytes"] = self.registry.resident_bytes()
+        return snap
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._batcher is not None:
+            self._batcher.close()
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,156 @@
+"""Serving observability: per-model counters, queue depth, batch-size
+histogram, and latency quantiles.
+
+Role model: the reference exposes none of this (its C API returns raw
+buffers and leaves observability to the host process); a serving engine
+needs its SLO signals built in.  Everything here is lock-cheap — counters
+under a mutex, latencies in a fixed ring buffer — so the hot path pays
+O(1) per request.  ``snapshot()`` renders the current state as a plain
+dict (the shape ``scripts/bench_serve.py`` persists into BENCH_SERVE.json)
+and ``utils/observer.py`` can stream it for diff-friendly debugging.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..ops.predict import round_up_pow2
+from ..utils import observer
+
+_RING = 2048  # latency samples kept per model (reservoir of the recent past)
+
+
+class _ModelStats:
+    __slots__ = ("requests", "rows", "errors", "batches", "batch_hist",
+                 "lat_ns", "lat_idx", "lat_n", "exec_ns", "batched_rows")
+
+    def __init__(self) -> None:
+        self.requests = 0
+        self.rows = 0
+        self.errors = 0
+        self.batches = 0
+        self.batch_hist: Dict[int, int] = {}  # pow2 batch-rows bucket -> count
+        self.lat_ns = np.zeros(_RING, np.int64)  # request latency ring
+        self.lat_idx = 0
+        self.lat_n = 0
+        self.exec_ns = 0  # total device-execute time (batch granularity)
+        self.batched_rows = 0  # rows covered by exec_ns (direct rows are not)
+
+    def add_latency(self, ns: int) -> None:
+        self.lat_ns[self.lat_idx] = ns
+        self.lat_idx = (self.lat_idx + 1) % _RING
+        self.lat_n = min(self.lat_n + 1, _RING)
+
+    def quantiles_ms(self):
+        if self.lat_n == 0:
+            return {"p50": None, "p95": None, "p99": None}
+        lat = self.lat_ns[: self.lat_n] / 1e6
+        p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+        return {"p50": float(p50), "p95": float(p95), "p99": float(p99)}
+
+
+class ServingMetrics:
+    """Thread-safe metrics registry shared by engine + batcher."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._models: Dict[str, _ModelStats] = {}
+        self._queue_rows = 0  # rows waiting in the micro-batcher (gauge)
+        self._queue_peak = 0
+        self.compiles_warmup = 0  # programs compiled during warm-up
+        self.compiles_steady = 0  # programs compiled after warm-up (SLO: 0)
+
+    def _stats(self, model: str) -> _ModelStats:
+        s = self._models.get(model)
+        if s is None:
+            s = self._models.setdefault(model, _ModelStats())
+        return s
+
+    # ------------------------------------------------------------- hot path
+    def observe_request(self, model: str, rows: int, latency_ns: int) -> None:
+        with self._lock:
+            s = self._stats(model)
+            s.requests += 1
+            s.rows += int(rows)
+            s.add_latency(int(latency_ns))
+
+    def observe_batch(self, model: str, rows: int, n_requests: int,
+                      exec_ns: int) -> None:
+        with self._lock:
+            s = self._stats(model)
+            s.batches += 1
+            s.exec_ns += int(exec_ns)
+            s.batched_rows += int(rows)
+            b = round_up_pow2(rows)
+            s.batch_hist[b] = s.batch_hist.get(b, 0) + 1
+
+    def observe_error(self, model: str) -> None:
+        with self._lock:
+            self._stats(model).errors += 1
+
+    def queue_delta(self, d_rows: int) -> None:
+        with self._lock:
+            self._queue_rows = max(0, self._queue_rows + int(d_rows))
+            self._queue_peak = max(self._queue_peak, self._queue_rows)
+
+    def note_steady_compiles(self, n: int) -> None:
+        """Record programs compiled OUTSIDE warm-up — the no-retrace SLO
+        counter (a warm engine must keep this at zero).  Attribution is
+        best-effort under concurrent COLD paths: each caller's before/after
+        gauge window can include another thread's compiles (over-count), and
+        a steady compile landing during someone else's warmup() is credited
+        to warm-up instead.  A warm engine serializes batches through one
+        worker and compiles nothing, so the zero-is-zero reading — the one
+        the SLO and the tests rely on — is exact."""
+        with self._lock:
+            self.compiles_steady += int(n)
+
+    # ------------------------------------------------------------- read side
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._queue_rows
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            models = {}
+            for name, s in self._models.items():
+                q = s.quantiles_ms()
+                total_s = s.exec_ns / 1e9
+                models[name] = {
+                    "requests": s.requests,
+                    "rows": s.rows,
+                    "errors": s.errors,
+                    "batches": s.batches,
+                    "batch_size_hist": {str(k): v for k, v in
+                                        sorted(s.batch_hist.items())},
+                    "latency_ms": q,
+                    # throughput over BATCHED traffic only: exec_ns is
+                    # accumulated per coalesced batch, so direct (un-timed)
+                    # predict rows must not inflate the numerator
+                    "rows_per_s": (s.batched_rows / total_s)
+                    if total_s > 0 else None,
+                }
+            return {
+                "queue_depth": self._queue_rows,
+                "queue_peak": self._queue_peak,
+                "compiles_warmup": self.compiles_warmup,
+                "compiles_steady": self.compiles_steady,
+                "models": models,
+            }
+
+    def export(self, tag: str = "serving") -> dict:
+        """Snapshot + stream through the TrainingObserver channel when the
+        debug observer is enabled (utils/observer.py)."""
+        snap = self.snapshot()
+        observer.observe_serving(snap, tag=tag)
+        return snap
+
+    def reset_latencies(self, model: Optional[str] = None) -> None:
+        with self._lock:
+            targets = ([self._models[model]] if model in self._models
+                       else list(self._models.values()) if model is None
+                       else [])
+            for s in targets:
+                s.lat_idx = s.lat_n = 0

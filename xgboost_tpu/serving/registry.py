@@ -1,0 +1,176 @@
+"""Model registry: versioned snapshots with LRU residency and pinning.
+
+The hot-model residency policy of arXiv:1603.02754's cache-conscious design
+applied at model granularity: at most ``max_models`` snapshots keep their
+stacked tree tensors device-resident; the least-recently-served unpinned
+entry is evicted when a new model loads.  Versions are monotonically
+numbered per name; ``pin`` freezes the version ``get`` resolves to (the
+rollout/rollback knob) and pinned entries are never evicted.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .snapshot import InferenceSnapshot
+
+
+class _Entry:
+    __slots__ = ("snapshot", "pinned", "tick")
+
+    def __init__(self, snapshot: InferenceSnapshot) -> None:
+        self.snapshot = snapshot
+        self.pinned = False
+        self.tick = 0
+
+
+def _load_booster(source):
+    """Booster passthrough, or load from a JSON/UBJSON model file."""
+    from ..core import Booster
+
+    if isinstance(source, Booster):
+        return source
+    if isinstance(source, (str, os.PathLike)):
+        return Booster(model_file=os.fspath(source))
+    raise TypeError(
+        f"model source must be a Booster or a .json/.ubj path, got "
+        f"{type(source).__name__}")
+
+
+class ModelRegistry:
+    def __init__(self, max_models: int = 8) -> None:
+        if max_models < 1:
+            raise ValueError("max_models must be >= 1")
+        self.max_models = int(max_models)
+        self._lock = threading.RLock()
+        self._entries: Dict[Tuple[str, int], _Entry] = {}
+        self._latest: Dict[str, int] = {}
+        self._pinned_version: Dict[str, int] = {}
+        self._clock = 0
+        self.evictions = 0
+
+    # ----------------------------------------------------------------- util
+    def _touch(self, e: _Entry) -> None:
+        self._clock += 1
+        e.tick = self._clock
+
+    def _evict_for_capacity(self) -> None:
+        while len(self._entries) >= self.max_models:
+            victims = [(e.tick, key) for key, e in self._entries.items()
+                       if not e.pinned]
+            if not victims:
+                raise RuntimeError(
+                    f"registry full ({self.max_models} models, all pinned); "
+                    "unpin or raise ServeConfig.max_models")
+            _, key = min(victims)
+            del self._entries[key]
+            self.evictions += 1
+            # evicting the latest version must not orphan still-resident
+            # older ones (same invariant remove() maintains): keep get(name)
+            # resolving to the highest surviving version
+            name, version = key
+            if self._latest.get(name) == version:
+                remaining = [v for n, v in self._entries if n == name]
+                if remaining:
+                    self._latest[name] = max(remaining)
+                else:
+                    self._latest.pop(name, None)
+
+    # ------------------------------------------------------------------ API
+    def register(self, name: str, source, version: Optional[int] = None,
+                 ) -> int:
+        """Snapshot ``source`` (Booster or model path) under ``name``.
+        Returns the version number (auto-incremented when not given)."""
+        booster = _load_booster(source)
+        snap = InferenceSnapshot.from_booster(booster)
+        with self._lock:
+            if version is None:
+                version = self._latest.get(name, 0) + 1
+            version = int(version)
+            if (name, version) not in self._entries:
+                self._evict_for_capacity()
+            e = _Entry(snap)
+            # replacing a pinned version keeps the pin (the replacement is
+            # what get() now resolves to; it must not become LRU-evictable)
+            e.pinned = self._pinned_version.get(name) == version
+            self._entries[(name, version)] = e
+            self._latest[name] = max(self._latest.get(name, 0), version)
+            self._touch(e)
+            return version
+
+    def get(self, name: str, version: Optional[int] = None,
+            ) -> Tuple[InferenceSnapshot, int]:
+        with self._lock:
+            if version is None:
+                version = self._pinned_version.get(
+                    name, self._latest.get(name))
+            if version is None:
+                raise KeyError(f"unknown model {name!r}")
+            e = self._entries.get((name, int(version)))
+            if e is None:
+                raise KeyError(
+                    f"model {name!r} version {version} is not resident "
+                    "(never registered, or LRU-evicted); re-register it")
+            self._touch(e)
+            return e.snapshot, int(version)
+
+    def pin(self, name: str, version: int) -> None:
+        """Resolve ``get(name)`` to ``version`` and shield it from eviction."""
+        with self._lock:
+            key = (name, int(version))
+            if key not in self._entries:
+                raise KeyError(f"cannot pin absent model {key}")
+            # at most one pinned version per name
+            old = self._pinned_version.get(name)
+            if old is not None and (name, old) in self._entries:
+                self._entries[(name, old)].pinned = False
+            self._pinned_version[name] = int(version)
+            self._entries[key].pinned = True
+
+    def unpin(self, name: str) -> None:
+        with self._lock:
+            v = self._pinned_version.pop(name, None)
+            if v is not None and (name, v) in self._entries:
+                self._entries[(name, v)].pinned = False
+
+    def remove(self, name: str, version: Optional[int] = None) -> None:
+        with self._lock:
+            keys = [k for k in self._entries
+                    if k[0] == name and (version is None or k[1] == version)]
+            for k in keys:
+                del self._entries[k]
+            # keep get(name) resolving to the highest surviving version —
+            # removing the latest must not orphan still-resident older ones
+            remaining = [v for n, v in self._entries if n == name]
+            if remaining:
+                self._latest[name] = max(remaining)
+            else:
+                self._latest.pop(name, None)
+            if version is None or self._pinned_version.get(name) == version:
+                self._pinned_version.pop(name, None)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted({k[0] for k in self._entries})
+
+    def versions(self, name: str) -> List[int]:
+        with self._lock:
+            return sorted(v for n, v in self._entries if n == name)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(e.snapshot.nbytes for e in self._entries.values())
+
+    def serve_programs(self) -> list:
+        """The _Program wrappers riding resident snapshots (engine-owned;
+        exposed so the engine can fold their donated-jit caches into its
+        compile gauge)."""
+        with self._lock:
+            progs = [getattr(e.snapshot, "_serve_prog", None)
+                     for e in self._entries.values()]
+        return [p for p in progs if p is not None]

@@ -1,0 +1,116 @@
+"""Frozen inference snapshot of a trained Booster.
+
+The serving analogue of the reference's thread-safe Learner handle
+(src/c_api/c_api.cc keeps one Learner per BoosterHandle and predicts from
+many threads): everything prediction needs — the stacked padded tree
+tensors, group routing, base score, and the objective transform — is copied
+OUT of the live Booster into immutable device-resident arrays, so serving
+never races training-side mutation (continued training, attribute writes)
+and never touches a DMatrix cache.  The stacked layout is the cache-conscious
+structure-of-arrays form of arXiv:1603.02754 §4 applied to inference: one
+(T, M) tensor per node field, resident in device memory for the model's
+lifetime in the registry.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..ops.predict import (bucket_rows, pad_margin, pad_rows,
+                           run_stacked_margin)
+
+
+class InferenceSnapshot:
+    """Immutable view of one model version, ready for compiled predict."""
+
+    def __init__(self, *, stacked, groups, depth: int, n_groups: int,
+                 base_score: np.ndarray, objective, num_features: int,
+                 feature_names=None, cat_categories=None,
+                 n_trees: int = 0) -> None:
+        self.stacked = stacked          # dict of device arrays, or None (stump)
+        self.groups = groups
+        self.depth = depth
+        self.n_groups = n_groups
+        self.base_score = np.asarray(base_score, np.float32).reshape(-1)
+        self.objective = objective
+        self.num_features = num_features
+        self.feature_names = list(feature_names) if feature_names else None
+        self.cat_categories = cat_categories  # train-time {feat -> categories}
+        self.n_trees = n_trees
+
+    # ------------------------------------------------------------ construct
+    @classmethod
+    def from_booster(cls, booster) -> "InferenceSnapshot":
+        booster._configure()
+        if booster.booster_kind == "gblinear":
+            raise NotImplementedError(
+                "serving snapshots cover tree boosters (gbtree/dart); "
+                "gblinear is a single matmul — serve it directly")
+        n_trees = len(booster.trees)
+        if n_trees:
+            # _stacked materializes on the default device (jnp.asarray), so
+            # the first predict pays no host->device copy
+            stacked, groups, depth = booster._stacked(slice(0, n_trees))
+        else:
+            stacked, groups, depth = None, None, 0
+        base = np.broadcast_to(
+            np.asarray(booster.base_score, np.float32).reshape(-1),
+            (booster.n_groups,)).copy()
+        return cls(
+            stacked=stacked, groups=groups, depth=depth,
+            n_groups=booster.n_groups, base_score=base,
+            objective=booster.objective,
+            num_features=booster.num_features(),
+            feature_names=booster.feature_names,
+            cat_categories=getattr(booster, "_cat_categories", None),
+            n_trees=n_trees,
+        )
+
+    # -------------------------------------------------------------- predict
+    def margin_padded(self, X_dev, init=None):
+        """Raw ensemble margin for an already-bucket-padded (B, F) batch.
+        Routes through the SAME jitted entry points as training eval, so the
+        engine and the Booster share one compiled-program cache."""
+        if self.stacked is None:
+            import jax.numpy as jnp
+
+            base = jnp.zeros((X_dev.shape[0], self.n_groups), jnp.float32)
+            return base if init is None else base + init
+        return run_stacked_margin(X_dev, self.stacked, self.groups,
+                                  self.depth, self.n_groups, init)
+
+    def margin(self, X_dev, init=None):
+        """Bucket-pad, predict, slice — the direct (non-engine) entry."""
+        R = X_dev.shape[0]
+        bucket = bucket_rows(R)
+        out = self.margin_padded(pad_rows(X_dev, bucket),
+                                 pad_margin(init, bucket))
+        return out if bucket == R else out[:R]
+
+    def transform(self, margin):
+        return self.objective.pred_transform(margin)
+
+    @property
+    def nbytes(self) -> int:
+        if self.stacked is None:
+            return 0
+        return int(sum(v.nbytes for v in self.stacked.values()
+                       if v is not None))
+
+    def host_dense_recoded(self, dmat) -> np.ndarray:
+        """DMatrix -> dense rows with categorical codes remapped onto the
+        TRAIN-time category ordering (the same encoder/ordinal.h Recode step
+        Booster.predict applies) — serving a frame whose pandas/arrow
+        category order differs from training must not mis-route codes."""
+        from ..data.dmatrix import recode_dense
+
+        return recode_dense(dmat.host_dense(), self.cat_categories,
+                            getattr(dmat, "cat_categories", None))
+
+    def get_categories(self) -> Optional[dict]:
+        """Train-time category mapping keyed by feature name (or index when
+        unnamed) — the XGBoosterGetCategories payload."""
+        from ..data.dmatrix import categories_by_name
+
+        return categories_by_name(self.cat_categories, self.feature_names)

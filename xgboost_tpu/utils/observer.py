@@ -46,6 +46,27 @@ def observe_margin(margin, iteration: int) -> None:
         _dump(f"iter{iteration}.margin", margin)
 
 
+def observe_serving(snapshot: dict, tag: str = "serving") -> None:
+    """Stream a ServingMetrics snapshot (serving/metrics.py) in the same
+    diff-friendly one-line-per-signal format as the training dumps."""
+    if not enabled():
+        return
+    print(f"[observer] {tag}: queue_depth={snapshot.get('queue_depth')} "
+          f"queue_peak={snapshot.get('queue_peak')} "
+          f"compiles_warmup={snapshot.get('compiles_warmup')} "
+          f"compiles_steady={snapshot.get('compiles_steady')}",
+          file=sys.stderr, flush=True)
+    for name, m in sorted(snapshot.get("models", {}).items()):
+        lat = m.get("latency_ms") or {}
+        fmt = lambda v: "n/a" if v is None else f"{v:.3f}"  # noqa: E731
+        print(f"[observer] {tag}.{name}: requests={m.get('requests')} "
+              f"rows={m.get('rows')} errors={m.get('errors')} "
+              f"batches={m.get('batches')} "
+              f"p50={fmt(lat.get('p50'))}ms p95={fmt(lat.get('p95'))}ms "
+              f"p99={fmt(lat.get('p99'))}ms",
+              file=sys.stderr, flush=True)
+
+
 def observe_tree(tree, iteration: int) -> None:
     if enabled():
         print(f"[observer] iter{iteration}.tree nodes={tree.n_nodes} "
