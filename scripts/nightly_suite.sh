@@ -4,4 +4,39 @@
 set -e
 cd "$(dirname "$0")/.."
 python -m pytest tests/ -q --durations=25
+
+# telemetry smoke: a short traced training run must leave a parseable JSONL
+# whose span names cover the per-round phases (docs/observability.md)
+TRACE_OUT=$(mktemp /tmp/xtb_telemetry_smoke.XXXXXX.jsonl)
+XGBOOST_TPU_TRACE="$TRACE_OUT" JAX_PLATFORMS=cpu python - "$TRACE_OUT" <<'EOF'
+import json, sys
+import numpy as np
+import xgboost_tpu as xtb
+from xgboost_tpu import telemetry
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(2000, 12)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+d = xtb.DMatrix(X, label=y)
+cb = telemetry.TelemetryCallback()
+xtb.train({"objective": "binary:logistic", "max_depth": 4}, d, 5,
+          evals=[(d, "train")], callbacks=[cb], verbose_eval=False)
+telemetry.trace.flush()
+
+events = [json.loads(l) for l in open(sys.argv[1])]  # every line must parse
+assert events, "trace is empty"
+assert all(set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+           for e in events), "malformed trace event"
+names = "\n".join(sorted({e["name"] for e in events}))
+for needle in ("build_hist", "eval_split", "update_tree", "eval.",
+               "update.gradient"):
+    assert needle in names, f"phase {needle!r} missing from trace:\n{names}"
+assert len(cb.history) == 5 and cb.compiles_steady == 0, \
+    f"steady-state retraces: {cb.compiles_steady}"
+assert "xtb_phase_seconds_bucket" in telemetry.render_prometheus()
+print(f"telemetry smoke OK: {len(events)} events, "
+      f"{len(names.splitlines())} span names, 0 steady compiles")
+EOF
+rm -f "$TRACE_OUT"
+
 BENCH_FORCE_CPU=1 BENCH_ROWS=100000 BENCH_ROUNDS=5 python bench.py

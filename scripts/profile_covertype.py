@@ -1,7 +1,13 @@
 """Profile the ladder covertype config (58k x 54, 7 classes) on CPU.
 
 Coarse wall-clock attribution of one ladder run: where do the 30s go?
-Usage: python scripts/profile_covertype.py [--cprofile]
+Emits a chrome://tracing-compatible JSONL span trace alongside the
+timings (docs/observability.md) — per-level grow spans, gradient, eval,
+and every XLA compile — plus a per-phase summary from the telemetry
+histogram.
+
+Usage: python scripts/profile_covertype.py [--cprofile] [--trace PATH]
+       (default trace path: covertype_trace.jsonl in the CWD)
 """
 from __future__ import annotations
 
@@ -27,6 +33,16 @@ def main():
     print(f"rows={R} cols={cfg['cols']} classes={cfg['classes']}")
 
     import xgboost_tpu as xtb
+    from xgboost_tpu import telemetry
+
+    trace_path = "covertype_trace.jsonl"
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace") + 1
+        if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+            sys.exit("--trace requires a path argument")
+        trace_path = sys.argv[i]
+    telemetry.trace.configure(trace_path)
+    telemetry.enable()
 
     p = {"objective": cfg["objective"], "num_class": cfg["classes"],
          **cfg["params"]}
@@ -37,9 +53,11 @@ def main():
     print(f"DMatrix build: {t1 - t0:.2f}s")
 
     # warmup (compile)
-    xtb.train(p, d, 1, verbose_eval=False)
+    with telemetry.compile_delta() as warm:
+        xtb.train(p, d, 1, verbose_eval=False)
     t2 = time.perf_counter()
-    print(f"warmup round (compile): {t2 - t1:.2f}s")
+    print(f"warmup round (compile): {t2 - t1:.2f}s  "
+          f"[{warm.count} XLA compiles]")
 
     if "--cprofile" in sys.argv:
         import cProfile
@@ -54,12 +72,23 @@ def main():
         st.sort_stats("cumulative").print_stats(40)
     else:
         t3 = time.perf_counter()
-        bst = xtb.train(p, d, cfg["rounds"], verbose_eval=False)
+        with telemetry.compile_delta() as steady:
+            bst = xtb.train(p, d, cfg["rounds"], verbose_eval=False)
         t4 = time.perf_counter()
-        print(f"train 5 rounds: {t4 - t3:.2f}s")
+        print(f"train 5 rounds: {t4 - t3:.2f}s  "
+              f"[{steady.count} XLA compiles]")
         preds = np.asarray(bst.predict(d))
         t5 = time.perf_counter()
         print(f"predict: {t5 - t4:.2f}s")
+
+    print("\nper-phase attribution (cumulative, incl. warmup):")
+    for name, tot in sorted(telemetry.phase_totals().items(),
+                            key=lambda kv: -kv[1]["seconds"]):
+        print(f"  {name:<32} {tot['seconds']:8.3f}s  "
+              f"{tot['count']:6d} calls")
+    telemetry.trace.flush()
+    print(f"\ntrace: {trace_path}  "
+          "(jq -s '{traceEvents: .}' -> chrome://tracing)")
 
 
 if __name__ == "__main__":

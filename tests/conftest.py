@@ -45,7 +45,8 @@ _QUICK_FILES = {
     "test_continuation.py", "test_device_ingest.py", "test_hist_kernels.py",
     "test_multiquantile.py", "test_ranking.py", "test_survival.py",
     "test_categorical.py", "test_shap.py", "test_golden_models.py",
-    "test_serving.py", "test_arrow.py",
+    "test_serving.py", "test_arrow.py", "test_telemetry.py",
+    "test_timer_observer.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)

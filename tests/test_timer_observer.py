@@ -1,0 +1,146 @@
+"""Dedicated coverage for utils/timer.Monitor and utils/observer (neither
+had a test file): totals/counts accumulation, start/stop re-entrancy,
+verbosity-gated printing, and the observer's enable/disable + dump formats."""
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.utils import observer
+from xgboost_tpu.utils.timer import Monitor
+
+
+@pytest.fixture(autouse=True)
+def _observer_reset():
+    """observer.observe() flips module state: restore the env-derived
+    default afterwards so other tests see their expected gating."""
+    yield
+    observer._ENABLED = None
+
+
+# ====================================================================
+# Monitor
+
+def test_monitor_totals_and_counts_accumulate():
+    m = Monitor("t")
+    for _ in range(3):
+        m.start("phase")
+        m.stop("phase")
+    m.start("other")
+    m.stop("other")
+    assert m.counts["phase"] == 3 and m.counts["other"] == 1
+    assert m.totals["phase"] >= 0 and m.totals["other"] >= 0
+
+
+def test_monitor_reentrant_start_keeps_stack():
+    """A second start(name) before stop(name) used to overwrite the open
+    timestamp (and leak its TraceAnnotation); the per-label stack closes
+    each bracket independently."""
+    import time
+
+    m = Monitor("t")
+    m.start("op")
+    time.sleep(0.01)
+    m.start("op")  # nested bracket
+    m.stop("op")   # closes the INNER (short) bracket
+    inner = m.totals["op"]
+    m.stop("op")   # closes the OUTER (>= 10ms) bracket
+    assert m.counts["op"] == 2
+    assert m.totals["op"] - inner >= 0.009
+    assert not m._open["op"]  # nothing left open
+
+
+def test_monitor_unmatched_stop_is_ignored():
+    m = Monitor("t")
+    m.stop("never_started")
+    assert m.counts == {} or m.counts.get("never_started", 0) == 0
+
+
+def test_monitor_print_gated_by_verbosity(capsys):
+    m = Monitor("lbl")
+    m.start("a")
+    m.stop("a")
+    with xtb.config_context(verbosity=1):
+        m.print_statistics()
+    assert capsys.readouterr().out == ""  # below the gate: silent
+    with xtb.config_context(verbosity=3):
+        m.print_statistics()
+    out = capsys.readouterr().out
+    assert "Monitor (lbl)" in out and "a:" in out and "1 calls" in out
+
+
+def test_monitor_empty_prints_nothing_even_verbose(capsys):
+    with xtb.config_context(verbosity=3):
+        Monitor("empty").print_statistics()
+    assert capsys.readouterr().out == ""
+
+
+# ====================================================================
+# observer
+
+def test_observer_enable_disable_and_env(monkeypatch):
+    observer.observe(True)
+    assert observer.enabled()
+    observer.observe(False)
+    assert not observer.enabled()
+    # unset state re-reads the environment
+    observer._ENABLED = None
+    monkeypatch.setenv("XGBOOST_TPU_DEBUG_OBSERVER", "1")
+    assert observer.enabled()
+    observer._ENABLED = None
+    monkeypatch.setenv("XGBOOST_TPU_DEBUG_OBSERVER", "0")
+    assert not observer.enabled()
+
+
+def test_observer_gradient_and_margin_dump_format(capsys):
+    observer.observe(True)
+    gpair = np.stack([np.arange(4, dtype=np.float32),
+                      np.ones(4, np.float32)], axis=-1)[:, None, :]
+    observer.observe_gradients(gpair, iteration=2)
+    observer.observe_margin(np.full(4, 0.5, np.float32), iteration=2)
+    err = capsys.readouterr().err
+    assert "[observer] iter2.grad: n=4 sum=6" in err
+    assert "[observer] iter2.hess: n=4 sum=4" in err
+    assert "[observer] iter2.margin: n=4 sum=2" in err
+    observer.observe(False)
+    observer.observe_margin(np.zeros(2), iteration=3)
+    assert capsys.readouterr().err == ""  # disabled: no stream
+
+
+def test_observer_tree_dump(capsys):
+    observer.observe(True)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 2},
+                    xtb.DMatrix(X, label=y), 1, verbose_eval=False)
+    observer.observe_tree(bst.trees[-1], iteration=0)
+    err = capsys.readouterr().err
+    assert "[observer] iter0.tree nodes=" in err
+    assert "leaves=" in err and "iter0.leaf_values" in err
+
+
+def test_observer_serving_dump_format(capsys):
+    observer.observe(True)
+    snap = {"queue_depth": 0, "queue_peak": 3, "compiles_warmup": 2,
+            "compiles_steady": 0,
+            "models": {"m": {"requests": 5, "rows": 9, "errors": 0,
+                             "batches": 2,
+                             "latency_ms": {"p50": 1.0, "p95": 2.0,
+                                            "p99": None}}}}
+    observer.observe_serving(snap, tag="t")
+    err = capsys.readouterr().err
+    assert "[observer] t: queue_depth=0 queue_peak=3" in err
+    assert "[observer] t.m: requests=5 rows=9" in err
+    assert "p99=n/a" in err  # None renders as n/a
+
+
+def test_observer_streams_during_training(capsys):
+    observer.observe(True)
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(150, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    xtb.train({"objective": "binary:logistic", "max_depth": 2},
+              xtb.DMatrix(X, label=y), 1, verbose_eval=False)
+    err = capsys.readouterr().err
+    assert "iter0.grad" in err and "iter0.margin" in err
+    assert "iter0.tree" in err

@@ -20,7 +20,8 @@ from .data.extmem import (DataIter, ExtMemQuantileDMatrix,
 from .data.ellpack import EllpackPage
 from .data.quantile import HistogramCuts
 from .training import cv, train
-from . import collective, tracker
+from . import collective, telemetry, tracker
+from .telemetry import TelemetryCallback
 from .callback import (
     EarlyStopping,
     EvaluationMonitor,
@@ -49,7 +50,9 @@ __all__ = [
     "EvaluationMonitor",
     "LearningRateScheduler",
     "TrainingCheckPoint",
+    "TelemetryCallback",
     "collective",
+    "telemetry",
     "tracker",
     "serving",
     "train_distributed",

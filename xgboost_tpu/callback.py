@@ -67,7 +67,10 @@ class CallbackContainer:
 
     def after_iteration(self, model, epoch, dtrain, evals) -> bool:
         if evals:
-            msg = model.eval_set(evals, epoch, feval=self.metric)
+            from .telemetry import span
+
+            with span("eval.eval_set"):
+                msg = model.eval_set(evals, epoch, feval=self.metric)
             self.update_history(msg)
         return any(cb.after_iteration(model, epoch, self.history) for cb in self.callbacks)
 
@@ -121,6 +124,8 @@ class EarlyStopping(TrainingCallback):
         log = evals_log[data]
         metric = self.metric_name or list(log.keys())[-1]
         score = log[metric][-1]
+        if isinstance(score, (tuple, list)):  # cv (mean, std): stop on mean
+            score = score[0]
         maximize = self._is_maximize(metric)
         if not self.best_scores:
             improved = True
@@ -145,22 +150,63 @@ class EarlyStopping(TrainingCallback):
 
 
 class EvaluationMonitor(TrainingCallback):
-    """Print eval results each round (reference: callback.py:511)."""
+    """Log eval results each round (reference: callback.py:511).
+
+    ``rank``: only that rank prints under multi-process training (the
+    reference's printer_rank — every worker logging the same line N times
+    is noise).  ``show_stdv``: render cv (mean, std) scores as
+    ``mean+std``.  ``logger=None`` routes through ``utils.logging``
+    (respects ``register_log_callback`` redirection and verbosity=0
+    silencing); pass a callable to capture lines directly."""
 
     def __init__(self, rank: int = 0, period: int = 1, show_stdv: bool = False,
-                 logger: Callable[[str], None] = print):
+                 logger: Optional[Callable[[str], None]] = None):
+        self.printer_rank = int(rank)
         self.period = max(period, 1)
+        self.show_stdv = show_stdv
         self.logger = logger
+        self._latest: Optional[str] = None
+
+    def _fmt_metric(self, data: str, metric: str, score: _Score) -> str:
+        if isinstance(score, (tuple, list)) and len(score) == 2:
+            if self.show_stdv:
+                return f"\t{data}-{metric}:{score[0]:.5f}+{score[1]:.5f}"
+            score = score[0]
+        return f"\t{data}-{metric}:{score:.5f}"
+
+    def _emit(self, msg: str) -> None:
+        if self.logger is not None:
+            self.logger(msg)
+        else:
+            from .utils import logging as _logging
+
+            _logging.console(msg)
 
     def after_iteration(self, model, epoch, evals_log) -> bool:
-        if not evals_log or epoch % self.period:
+        if not evals_log:
+            return False
+        from . import collective
+
+        if collective.get_rank() != self.printer_rank:
             return False
         msg = f"[{epoch}]"
         for data, metrics in evals_log.items():
             for metric, hist in metrics.items():
-                msg += f"\t{data}-{metric}:{hist[-1]:.5f}"
-        self.logger(msg)
+                msg += self._fmt_metric(data, metric, hist[-1])
+        if epoch % self.period:
+            # off-period round: keep the line so after_training can flush
+            # the FINAL scores (reference caches _latest the same way)
+            self._latest = msg
+        else:
+            self._emit(msg)
+            self._latest = None
         return False
+
+    def after_training(self, model):
+        if self._latest is not None:
+            self._emit(self._latest)
+            self._latest = None
+        return model
 
 
 class TrainingCheckPoint(TrainingCallback):

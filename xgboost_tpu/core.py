@@ -30,6 +30,7 @@ from .objective import ObjFunction, create_objective
 from .ops.predict import predict_leaf_ids
 from .ops.split import SplitParams
 from .params import TrainParam, canonicalize, split_unknown
+from .telemetry import span
 from .tree.grow import HistTreeGrower, leaf_margin_delta
 
 __all__ = ["Booster"]
@@ -497,14 +498,18 @@ class Booster:
             # margin, which _boost_trees builds — skip the full-margin pass
             # so a custom fobj is invoked exactly once
             gpair = None
-        elif fobj is not None:
-            # custom objectives receive RAW margins (reference: Booster.update
-            # passes output_margin=True predictions to fobj, core.py:2277)
-            gpair = self._fobj_gpair(cache, fobj, cache.margin, dtrain)
         else:
-            gpair = self.objective.get_gradient(
-                cache.margin, cache.labels, cache.weights, iteration
-            )  # (R_pad, K, 2)
+            with span("update.gradient"):
+                if fobj is not None:
+                    # custom objectives receive RAW margins (reference:
+                    # Booster.update passes output_margin=True predictions
+                    # to fobj, core.py:2277)
+                    gpair = self._fobj_gpair(cache, fobj, cache.margin,
+                                             dtrain)
+                else:
+                    gpair = self.objective.get_gradient(
+                        cache.margin, cache.labels, cache.weights, iteration
+                    )  # (R_pad, K, 2)
         if gpair is not None:
             gpair = gpair * cache.valid[:, None, None]
         from .utils import observer
@@ -513,11 +518,12 @@ class Booster:
             observer.observe_margin(cache.margin, iteration)
             if gpair is not None:
                 observer.observe_gradients(gpair, iteration)
-        if self.booster_kind == "gblinear":
-            self._boost_linear(cache, gpair)
-        else:
-            self._boost_trees(cache, gpair, iteration, fobj=fobj,
-                              drop_idx=drop_idx)
+        with span("update.update_tree"):
+            if self.booster_kind == "gblinear":
+                self._boost_linear(cache, gpair)
+            else:
+                self._boost_trees(cache, gpair, iteration, fobj=fobj,
+                                  drop_idx=drop_idx)
         if observer.enabled() and self.trees:
             observer.observe_tree(self.trees[-1], iteration)
 
@@ -1627,7 +1633,8 @@ class Booster:
         metrics = self._eval_metric_list()
         proc_par = self._process_parallel()
         for dmat, name in evals:
-            margin = self._eval_margin(dmat)
+            with span("eval.predict"):
+                margin = self._eval_margin(dmat)
             preds = np.asarray(self.objective.pred_transform(margin))
             if self.n_groups == 1:
                 preds = preds[:, 0]

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.split import SplitParams
+from ..telemetry import span
 from ..tree.grow import (TreeState, init_tree_state, level_step,
                          level_step_padded, make_set_matrix,
                          max_nodes_for_depth)
@@ -166,42 +167,52 @@ class ShardedHistTreeGrower:
             # identical on every topology
             gpair, rho, state = prepare_quantised(gpair, valid, state)
             rho_args = (rho,)
+        # same fused-level span name as HistTreeGrower (each sharded level
+        # program is hist psum + split eval + position rewrite in one call)
+        _LEVEL = "grow.build_hist+eval_split"
         if self._padded:
             from ..tree.grow import HistTreeGrower
 
             md = self.max_depth
             W = 1 << (md - 1)
             fm = ones if feature_masks is None else feature_masks(0, 1)
-            state, hist = self._level_fns[0](state, bins, gpair, cuts_pad,
-                                             n_bins, fm, setmat, cm,
-                                             *rho_args)
+            with span(_LEVEL):
+                state, hist = self._level_fns[0](state, bins, gpair, cuts_pad,
+                                                 n_bins, fm, setmat, cm,
+                                                 *rho_args)
             hist_pad = jnp.zeros((W,) + hist.shape[1:],
                                  hist.dtype).at[:1].set(hist)
             for d in range(1, md):
                 fm = (ones if feature_masks is None
                       else HistTreeGrower._pad_mask(feature_masks(d, 1 << d), W))
-                state, hist_pad = self._interior_fn(
-                    state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
-                    hist_pad, jnp.int32((1 << d) - 1), *rho_args)
+                with span(_LEVEL):
+                    state, hist_pad = self._interior_fn(
+                        state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
+                        hist_pad, jnp.int32((1 << d) - 1), *rho_args)
             fm = ones if feature_masks is None else feature_masks(md, 1 << md)
-            state = self._level_fns[md](state, bins, gpair, cuts_pad, n_bins,
-                                        fm, setmat, cm, *rho_args)
+            with span(_LEVEL):
+                state = self._level_fns[md](state, bins, gpair, cuts_pad,
+                                            n_bins, fm, setmat, cm, *rho_args)
             return state
         hist_prev = None
         for d in range(self.max_depth + 1):
             fm = ones if feature_masks is None else feature_masks(d, 1 << d)
-            if d == self.max_depth:
-                state = self._level_fns[d](state, bins, gpair, cuts_pad, n_bins,
-                                           fm, setmat, cm, *rho_args)
-            elif d == 0:
-                state, hist_prev = self._level_fns[d](state, bins, gpair,
-                                                      cuts_pad, n_bins, fm,
-                                                      setmat, cm, *rho_args)
-            else:
-                state, hist_prev = self._level_fns[d](state, bins, gpair,
-                                                      cuts_pad, n_bins, fm,
-                                                      setmat, cm, hist_prev,
-                                                      *rho_args)
+            with span(_LEVEL):
+                if d == self.max_depth:
+                    state = self._level_fns[d](state, bins, gpair, cuts_pad,
+                                               n_bins, fm, setmat, cm,
+                                               *rho_args)
+                elif d == 0:
+                    state, hist_prev = self._level_fns[d](state, bins, gpair,
+                                                          cuts_pad, n_bins, fm,
+                                                          setmat, cm,
+                                                          *rho_args)
+                else:
+                    state, hist_prev = self._level_fns[d](state, bins, gpair,
+                                                          cuts_pad, n_bins, fm,
+                                                          setmat, cm,
+                                                          hist_prev,
+                                                          *rho_args)
         return state
 
     @staticmethod

@@ -25,6 +25,8 @@ from typing import Any, Callable, Deque, Dict, List
 
 import numpy as np
 
+from ..telemetry import spans as _spans
+
 
 class _Request:
     __slots__ = ("X", "future", "t_enqueue_ns", "ctx")
@@ -105,6 +107,13 @@ class MicroBatcher:
             X = (batch[0].X if len(batch) == 1
                  else np.concatenate([r.X for r in batch], axis=0))
             t0 = time.perf_counter_ns()
+            if _spans.enabled():
+                # admission wait of the batch head: enqueue -> launch (the
+                # other latency component besides serve.execute); recorded
+                # with the TRUE start timestamp so trace spans line up
+                _spans.record_phase("serve.batch_wait",
+                                    batch[0].t_enqueue_ns,
+                                    t0 - batch[0].t_enqueue_ns)
             out = self._execute(key, X, batch[0].ctx)
             exec_ns = time.perf_counter_ns() - t0
             if self._metrics is not None:

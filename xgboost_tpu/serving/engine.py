@@ -33,6 +33,7 @@ import numpy as np
 
 from ..ops.predict import (_MIN_ROW_BUCKET, _POW2_ROW_CEILING, bucket_rows,
                            pad_rows, predict_cache_size)
+from ..telemetry import span
 from .batcher import MicroBatcher
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
@@ -298,17 +299,18 @@ class ServingEngine:
         # on the same program cannot re-donate this result mid-drain
         on_worker = (self._batcher is not None
                      and threading.current_thread() is self._batcher._worker)
-        if prog.donate and on_worker:  # pragma: no cover - accelerator-only
-            with prog.donate_lock:
-                margin = prog.margin_padded(Xd, donate=True) \
+        with span("serve.execute"):
+            if prog.donate and on_worker:  # pragma: no cover - accelerator-only
+                with prog.donate_lock:
+                    margin = prog.margin_padded(Xd, donate=True) \
+                        + prog.base_dev()[None, :]
+                    out = margin if output_margin else snap.transform(margin)
+                    host = np.asarray(out)
+            else:
+                margin = prog.margin_padded(Xd, donate=False) \
                     + prog.base_dev()[None, :]
                 out = margin if output_margin else snap.transform(margin)
                 host = np.asarray(out)
-        else:
-            margin = prog.margin_padded(Xd, donate=False) \
-                + prog.base_dev()[None, :]
-            out = margin if output_margin else snap.transform(margin)
-            host = np.asarray(out)
         if probe:
             # strictly positive: a concurrent eviction can shrink the gauge
             # mid-window, and a negative delta must not cancel real compiles

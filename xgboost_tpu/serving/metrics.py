@@ -1,13 +1,27 @@
 """Serving observability: per-model counters, queue depth, batch-size
-histogram, and latency quantiles.
+histogram, and latency quantiles — rebased onto the telemetry registry.
 
 Role model: the reference exposes none of this (its C API returns raw
 buffers and leaves observability to the host process); a serving engine
 needs its SLO signals built in.  Everything here is lock-cheap — counters
 under a mutex, latencies in a fixed ring buffer — so the hot path pays
 O(1) per request.  ``snapshot()`` renders the current state as a plain
-dict (the shape ``scripts/bench_serve.py`` persists into BENCH_SERVE.json)
-and ``utils/observer.py`` can stream it for diff-friendly debugging.
+dict (the shape ``scripts/bench_serve.py`` persists into BENCH_SERVE.json
+— bitwise-stable across the telemetry rebase) and ``utils/observer.py``
+can stream it for diff-friendly debugging.
+
+Registry rebase (telemetry/registry.py): every mutation also feeds the
+process-default registry — ``xtb_serve_requests_total{model=}``,
+``xtb_serve_rows_total``, ``xtb_serve_errors_total``,
+``xtb_serve_batches_total``, ``xtb_serve_batch_rows`` (histogram),
+``xtb_serve_latency_seconds`` (histogram), ``xtb_serve_exec_seconds_total``,
+``xtb_serve_queue_rows`` / ``xtb_serve_queue_peak`` (gauges), and
+``xtb_compiles_steady{scope="serve"}`` — so ``telemetry.render_prometheus()``
+exposes serving alongside training with no extra wiring.  The local ints
+remain the source of truth for ``snapshot()``: registry series are
+process-cumulative (every engine in the process adds to them, Prometheus
+counter semantics), while each ServingMetrics instance reports its own
+engine exactly as before.
 """
 from __future__ import annotations
 
@@ -17,16 +31,70 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..ops.predict import round_up_pow2
+from ..telemetry.registry import get_registry
 from ..utils import observer
 
 _RING = 2048  # latency samples kept per model (reservoir of the recent past)
 
+# pow2 row buckets 1..4096 then +Inf: the admission policy's natural shape
+_BATCH_BUCKETS = tuple(float(1 << i) for i in range(13))
+# request latencies: 10us .. ~40s exponential
+_LATENCY_BUCKETS = tuple(1e-5 * (4.0 ** i) for i in range(12))
+
+
+class _Instruments:
+    """Registry families for the serving subsystem (created once per
+    process, shared by every engine)."""
+
+    _singleton = None
+
+    def __init__(self) -> None:
+        reg = get_registry()
+        self.requests = reg.counter(
+            "xtb_serve_requests_total", "predict requests", ("model",))
+        self.rows = reg.counter(
+            "xtb_serve_rows_total", "rows predicted", ("model",))
+        self.errors = reg.counter(
+            "xtb_serve_errors_total", "failed predict requests", ("model",))
+        self.batches = reg.counter(
+            "xtb_serve_batches_total", "coalesced batches executed",
+            ("model",))
+        self.exec_seconds = reg.counter(
+            "xtb_serve_exec_seconds_total",
+            "device-execute seconds (batch granularity)", ("model",))
+        self.batch_rows = reg.histogram(
+            "xtb_serve_batch_rows", "rows per coalesced batch", ("model",),
+            buckets=_BATCH_BUCKETS)
+        self.latency = reg.histogram(
+            "xtb_serve_latency_seconds", "request latency", ("model",),
+            buckets=_LATENCY_BUCKETS)
+        self.queue_rows = reg.gauge(
+            "xtb_serve_queue_rows", "rows waiting in the micro-batcher")
+        self.queue_peak = reg.gauge(
+            "xtb_serve_queue_peak", "high-water mark of queued rows")
+        self.compiles_warmup = reg.counter(
+            "xtb_compiles_warmup",
+            "programs compiled during engine warm-up", ("scope",)
+        ).labels("serve")
+        self.compiles_steady = reg.counter(
+            "xtb_compiles_steady",
+            "backend compiles after warm-up (SLO: 0)", ("scope",)
+        ).labels("serve")
+
+    @classmethod
+    def get(cls) -> "_Instruments":
+        if cls._singleton is None:
+            cls._singleton = cls()
+        return cls._singleton
+
 
 class _ModelStats:
     __slots__ = ("requests", "rows", "errors", "batches", "batch_hist",
-                 "lat_ns", "lat_idx", "lat_n", "exec_ns", "batched_rows")
+                 "lat_ns", "lat_idx", "lat_n", "exec_ns", "batched_rows",
+                 "reg_requests", "reg_rows", "reg_errors", "reg_batches",
+                 "reg_exec_seconds", "reg_batch_rows", "reg_latency")
 
-    def __init__(self) -> None:
+    def __init__(self, name: str, instruments: _Instruments) -> None:
         self.requests = 0
         self.rows = 0
         self.errors = 0
@@ -37,11 +105,20 @@ class _ModelStats:
         self.lat_n = 0
         self.exec_ns = 0  # total device-execute time (batch granularity)
         self.batched_rows = 0  # rows covered by exec_ns (direct rows are not)
+        ins = instruments  # this model's registry children, resolved once
+        self.reg_requests = ins.requests.labels(name)
+        self.reg_rows = ins.rows.labels(name)
+        self.reg_errors = ins.errors.labels(name)
+        self.reg_batches = ins.batches.labels(name)
+        self.reg_exec_seconds = ins.exec_seconds.labels(name)
+        self.reg_batch_rows = ins.batch_rows.labels(name)
+        self.reg_latency = ins.latency.labels(name)
 
     def add_latency(self, ns: int) -> None:
         self.lat_ns[self.lat_idx] = ns
         self.lat_idx = (self.lat_idx + 1) % _RING
         self.lat_n = min(self.lat_n + 1, _RING)
+        self.reg_latency.observe(ns / 1e9)
 
     def quantiles_ms(self):
         if self.lat_n == 0:
@@ -59,13 +136,40 @@ class ServingMetrics:
         self._models: Dict[str, _ModelStats] = {}
         self._queue_rows = 0  # rows waiting in the micro-batcher (gauge)
         self._queue_peak = 0
-        self.compiles_warmup = 0  # programs compiled during warm-up
-        self.compiles_steady = 0  # programs compiled after warm-up (SLO: 0)
+        self._compiles_warmup = 0  # programs compiled during warm-up
+        self._compiles_steady = 0  # programs compiled after warm-up (SLO: 0)
+        self._ins = _Instruments.get()
+
+    # compiles_* kept assignable/incrementable attributes for API compat
+    # (engine.warmup does `metrics.compiles_warmup += n`); positive deltas
+    # flow into the process-wide registry counters
+    @property
+    def compiles_warmup(self) -> int:
+        return self._compiles_warmup
+
+    @compiles_warmup.setter
+    def compiles_warmup(self, v: int) -> None:
+        d = int(v) - self._compiles_warmup
+        self._compiles_warmup = int(v)
+        if d > 0:
+            self._ins.compiles_warmup.inc(d)
+
+    @property
+    def compiles_steady(self) -> int:
+        return self._compiles_steady
+
+    @compiles_steady.setter
+    def compiles_steady(self, v: int) -> None:
+        d = int(v) - self._compiles_steady
+        self._compiles_steady = int(v)
+        if d > 0:
+            self._ins.compiles_steady.inc(d)
 
     def _stats(self, model: str) -> _ModelStats:
         s = self._models.get(model)
         if s is None:
-            s = self._models.setdefault(model, _ModelStats())
+            s = self._models.setdefault(model,
+                                        _ModelStats(model, self._ins))
         return s
 
     # ------------------------------------------------------------- hot path
@@ -75,6 +179,8 @@ class ServingMetrics:
             s.requests += 1
             s.rows += int(rows)
             s.add_latency(int(latency_ns))
+        s.reg_requests.inc()
+        s.reg_rows.inc(int(rows))
 
     def observe_batch(self, model: str, rows: int, n_requests: int,
                       exec_ns: int) -> None:
@@ -85,15 +191,29 @@ class ServingMetrics:
             s.batched_rows += int(rows)
             b = round_up_pow2(rows)
             s.batch_hist[b] = s.batch_hist.get(b, 0) + 1
+        s.reg_batches.inc()
+        s.reg_exec_seconds.inc(exec_ns / 1e9)
+        s.reg_batch_rows.observe(float(rows))
 
     def observe_error(self, model: str) -> None:
         with self._lock:
-            self._stats(model).errors += 1
+            s = self._stats(model)
+            s.errors += 1
+        s.reg_errors.inc()
 
     def queue_delta(self, d_rows: int) -> None:
         with self._lock:
-            self._queue_rows = max(0, self._queue_rows + int(d_rows))
+            prev = self._queue_rows
+            self._queue_rows = max(0, prev + int(d_rows))
             self._queue_peak = max(self._queue_peak, self._queue_rows)
+            # the process gauge accumulates DELTAS so several engines sum
+            # instead of overwriting each other (each engine's contribution
+            # is its clamped local depth, so the sum stays >= 0 and exact);
+            # published under the lock so a preempted stale writer cannot
+            # interleave.  The peak is raised via the atomic set_max — a
+            # read-then-set pair here could regress it across engines.
+            self._ins.queue_rows.inc(self._queue_rows - prev)
+            self._ins.queue_peak.set_max(self._ins.queue_rows.get())
 
     def note_steady_compiles(self, n: int) -> None:
         """Record programs compiled OUTSIDE warm-up — the no-retrace SLO
@@ -105,7 +225,9 @@ class ServingMetrics:
         worker and compiles nothing, so the zero-is-zero reading — the one
         the SLO and the tests rely on — is exact."""
         with self._lock:
-            self.compiles_steady += int(n)
+            self._compiles_steady += int(n)
+        if n > 0:
+            self._ins.compiles_steady.inc(int(n))
 
     # ------------------------------------------------------------- read side
     def queue_depth(self) -> int:
@@ -135,8 +257,8 @@ class ServingMetrics:
             return {
                 "queue_depth": self._queue_rows,
                 "queue_peak": self._queue_peak,
-                "compiles_warmup": self.compiles_warmup,
-                "compiles_steady": self.compiles_steady,
+                "compiles_warmup": self._compiles_warmup,
+                "compiles_steady": self._compiles_steady,
                 "models": models,
             }
 

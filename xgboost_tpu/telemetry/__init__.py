@@ -1,0 +1,54 @@
+"""xgboost_tpu.telemetry — unified observability for training and serving.
+
+One subsystem replaces the three disconnected mechanisms the repo grew
+(utils/timer.Monitor stderr prints, utils/observer debug dumps,
+serving/metrics counters with no export format):
+
+- **Registry** (registry.py): lock-cheap Counter/Gauge/Histogram families
+  with labels; ``serving/metrics.ServingMetrics`` feeds it, the span tracer
+  records into it, ``render_prometheus()`` exposes it.
+- **Spans** (spans.py): ``span("grow.build_hist")`` brackets the training
+  and serving hot paths — perf_counter histogram + JSONL trace event +
+  jax.profiler.TraceAnnotation, all behind one enabled flag
+  (``enable()`` / env ``XGBOOST_TPU_TRACE``), no-op by default.
+- **Retrace accounting** (compile.py): every XLA backend compile is counted
+  process-wide (``compiles_total()``, ``xtb_compiles_total``); a second
+  identical train() records zero — the guard tests/test_telemetry.py keeps.
+- **Exporters**: ``render_prometheus()`` text exposition and the
+  chrome://tracing JSONL writer gated by ``XGBOOST_TPU_TRACE=path``
+  (trace.py).
+- **TelemetryCallback** (callback.py): per-round phase timings, tree
+  stats, and compile deltas as an inspectable history.
+
+Quick start::
+
+    import xgboost_tpu as xtb
+    from xgboost_tpu import telemetry
+
+    telemetry.enable()                      # or XGBOOST_TPU_TRACE=run.jsonl
+    cb = telemetry.TelemetryCallback()
+    xtb.train(params, dtrain, 10, callbacks=[cb])
+    print(telemetry.render_prometheus())    # per-phase histograms, compiles
+    cb.history[1]["phases"]                 # round 1 attribution
+
+docs/observability.md is the guide.
+"""
+from __future__ import annotations
+
+from .registry import (Counter, Gauge, Histogram, Registry, get_registry,
+                       render_prometheus)
+from .spans import (PHASE_HISTOGRAM, Span, disable, enable, enabled,
+                    phase_totals, record_phase, span)
+from .compile import COMPILE_EVENT, compile_delta, compiles_total
+from . import trace
+from .callback import TelemetryCallback
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "get_registry",
+    "render_prometheus",
+    "span", "Span", "enable", "disable", "enabled", "record_phase",
+    "phase_totals", "PHASE_HISTOGRAM",
+    "compiles_total", "compile_delta", "COMPILE_EVENT",
+    "trace",
+    "TelemetryCallback",
+]

@@ -1,0 +1,129 @@
+"""TelemetryCallback: per-round phase timings, tree stats, and compile
+accounting as an inspectable history.
+
+A TrainingCallback (callback.py contract) that diffs the span histogram
+(spans.py PHASE_HISTOGRAM) and the compile counter around every boosting
+round, and reads the committed model for structural stats — so a training
+run leaves a round-by-round record of where the time went and whether any
+round retraced, without touching the training loop itself::
+
+    cb = TelemetryCallback()
+    xtb.train(params, d, 10, callbacks=[cb])
+    cb.history[3]["phases"]["grow.update_tree"]   # seconds in round 3
+    cb.history[3]["trees"][0]["leaves"]
+    cb.compiles_steady                            # SLO: 0 after round 0
+
+Round 0 is the warm-up round (every level program traces there); compiles
+in later rounds are steady-state retraces and feed the registry counter
+``xtb_compiles_steady{scope="train"}`` — the same no-retrace SLO gauge the
+serving engine keeps (serving/metrics.py), scoped per subsystem.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ..callback import TrainingCallback
+from . import compile as _compile
+from . import spans
+from .registry import get_registry
+
+__all__ = ["TelemetryCallback"]
+
+
+class TelemetryCallback(TrainingCallback):
+    """Records per-round telemetry into ``self.history`` (list of dicts).
+
+    Parameters
+    ----------
+    enable_spans : bool
+        Turn the span tracer on in before_training (default True) so the
+        phase attribution is populated even when the caller never called
+        ``telemetry.enable()``.  The flag is left as-is on after_training
+        (process-wide state; flipping it back could disable a concurrent
+        consumer's spans).
+    """
+
+    def __init__(self, enable_spans: bool = True) -> None:
+        self.enable_spans = enable_spans
+        self.history: List[Dict[str, Any]] = []
+        self.compiles_warmup = 0
+        self.compiles_steady = 0
+        self._phase0: Dict[str, Dict[str, float]] = {}
+        self._compiles0 = 0
+        self._t0 = 0.0
+        self._ntrees0 = 0
+        self._warm_round: Optional[int] = None  # first round of current run
+        self._steady_counter = None
+
+    # ------------------------------------------------- TrainingCallback API
+    def before_training(self, model):
+        if self.enable_spans and not spans.enabled():
+            spans.enable()
+        self._ntrees0 = len(getattr(model, "trees", ()))
+        # new training run: its first round is warm-up again, even when the
+        # callback is reused across train() calls (each run compiles its own
+        # level programs; lifetime history must not reclassify them steady)
+        self._warm_round = None
+        return model
+
+    def after_training(self, model):
+        return model
+
+    def before_iteration(self, model, epoch: int, evals_log) -> bool:
+        self._phase0 = spans.phase_totals()
+        self._compiles0 = _compile.compiles_total()
+        self._t0 = time.perf_counter()
+        return False
+
+    def after_iteration(self, model, epoch: int, evals_log) -> bool:
+        seconds = time.perf_counter() - self._t0
+        cur = spans.phase_totals()
+        phases = {}
+        for name, tot in cur.items():
+            prev = self._phase0.get(name)
+            ds = tot["seconds"] - (prev["seconds"] if prev else 0.0)
+            dc = tot["count"] - (prev["count"] if prev else 0)
+            if dc:
+                phases[name] = {"seconds": ds, "count": int(dc)}
+        compiles = _compile.compiles_total() - self._compiles0
+        trees = self._tree_stats(model)
+        rec: Dict[str, Any] = {
+            "round": int(epoch),
+            "seconds": seconds,
+            "phases": phases,
+            "compiles": int(compiles),
+            "trees": trees,
+        }
+        if self._warm_round is None:
+            self._warm_round = epoch
+        if compiles:
+            if epoch == self._warm_round:  # first round of THIS run
+                self.compiles_warmup += compiles
+            else:
+                self.compiles_steady += compiles
+                if self._steady_counter is None:
+                    self._steady_counter = get_registry().counter(
+                        "xtb_compiles_steady",
+                        "backend compiles after warm-up (SLO: 0)",
+                        ("scope",)).labels("train")
+                self._steady_counter.inc(compiles)
+        self.history.append(rec)
+        return False
+
+    # ------------------------------------------------------------ internals
+    def _tree_stats(self, model) -> List[Dict[str, int]]:
+        """Stats of the trees committed since the last look.  cv() hands the
+        callbacks an aggregate stand-in without .trees — record nothing."""
+        trees = getattr(model, "trees", None)
+        if trees is None:
+            return []
+        out = []
+        for t in trees[self._ntrees0:]:
+            out.append({
+                "nodes": int(t.n_nodes),
+                "leaves": int(t.num_leaves),
+                "depth": int(t.max_depth),
+            })
+        self._ntrees0 = len(trees)
+        return out

@@ -1,0 +1,324 @@
+"""Lock-cheap metrics registry: Counter / Gauge / Histogram with labels.
+
+Role model: the Prometheus client data model (counter/gauge/histogram
+families keyed by label sets), stripped to what a training/serving process
+needs.  The reference exposes none of this — its c_api returns raw buffers
+and timing lives in stderr prints at verbosity 3 — so the registry is the
+repo's single source of SLO signals: ``serving/metrics.ServingMetrics`` is
+rebased onto it, the span tracer (spans.py) records phase durations into
+it, and compile accounting (compile.py) counts retraces into it.
+
+Lock discipline: one ``threading.Lock`` per metric family, held for a dict
+lookup plus a few float adds — O(1) and contention-free in practice (the
+serving hot path takes it once per request).  Label children are cached on
+first use so steady-state increments never allocate.
+
+``render_prometheus()`` emits the text exposition format (``# HELP`` /
+``# TYPE`` + one line per sample) ready for a scrape endpoint; see
+docs/observability.md for the serving example.
+"""
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "get_registry",
+    "render_prometheus", "DEFAULT_BUCKETS",
+]
+
+# seconds-scale exponential buckets: 100us .. ~100s (phase timings and
+# request latencies both land comfortably inside)
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
+    1e-4 * (4.0 ** i) for i in range(10)
+)
+
+
+def _validate_name(name: str) -> None:
+    # Prometheus exposition: [a-zA-Z_:][a-zA-Z0-9_:]*
+    if (not name or not name.isascii() or name[0].isdigit()
+            or not all(c.isalnum() or c in "_:" for c in name)):
+        raise ValueError(f"invalid metric name {name!r}")
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+class _Family:
+    """Base metric family: a name + label names + cached label children."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = (),
+                 ) -> None:
+        _validate_name(name)
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def labels(self, *values, **kv):
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            values = tuple(str(kv[n]) for n in self.label_names)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {values}")
+        child = self._children.get(values)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(values, self._child())
+        return child
+
+    def _child(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- read side
+    def collect(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return list(self._children.items())
+
+    def _label_str(self, values: Tuple[str, ...], extra: str = "") -> str:
+        parts = [f'{n}="{_escape_label(v)}"'
+                 for n, v in zip(self.label_names, values)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Value:
+    """A single float cell guarded by its family's lock."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock) -> None:
+        self._lock = lock
+        self.value = 0.0
+
+
+class _CounterChild(_Value):
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self.value += amount
+
+    def get(self) -> float:
+        return self.value
+
+
+class _ScalarFamily(_Family):
+    """Shared single-sample-per-child rendering for Counter and Gauge."""
+
+    def get(self, *values, **kv) -> float:
+        return self.labels(*values, **kv).get()
+
+    def render(self) -> Iterable[str]:
+        for values, child in sorted(self.collect()):
+            yield f"{self.name}{self._label_str(values)} {_fmt(child.value)}"
+
+
+class Counter(_ScalarFamily):
+    kind = "counter"
+
+    def _child(self) -> _CounterChild:
+        return _CounterChild(self._lock)
+
+    def inc(self, amount: float = 1.0) -> None:
+        """Increment the label-less child (families with labels must go
+        through .labels())."""
+        self.labels().inc(amount)
+
+
+class _GaugeChild(_Value):
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def set_max(self, v: float) -> None:
+        """Atomic monotonic raise — the high-water-mark update (a separate
+        get()/set() pair would let a stale writer regress the mark)."""
+        with self._lock:
+            if v > self.value:
+                self.value = float(v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value -= amount
+
+    def get(self) -> float:
+        return self.value
+
+
+class Gauge(_ScalarFamily):
+    kind = "gauge"
+
+    def _child(self) -> _GaugeChild:
+        return _GaugeChild(self._lock)
+
+    def set(self, v: float) -> None:
+        self.labels().set(v)
+
+    def set_max(self, v: float) -> None:
+        self.labels().set_max(v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.labels().dec(amount)
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "_bounds", "counts", "sum", "count")
+
+    def __init__(self, lock: threading.Lock, bounds: Tuple[float, ...]):
+        self._lock = lock
+        self._bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self._bounds, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help, label_names)
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b or not all(math.isfinite(x) for x in b):
+            # an explicit +Inf bound would collide with the implicit
+            # overflow bucket (duplicate le="+Inf" samples = invalid scrape)
+            raise ValueError("histogram needs at least one finite bucket "
+                             "and no non-finite bounds")
+        self.buckets = b
+
+    def _child(self) -> _HistogramChild:
+        return _HistogramChild(self._lock, self.buckets)
+
+    def observe(self, v: float) -> None:
+        self.labels().observe(v)
+
+    def snapshot_sums(self) -> Dict[Tuple[str, ...], Tuple[int, float]]:
+        """{label values: (count, sum)} — the cheap read the per-round
+        TelemetryCallback diffs to attribute phase time."""
+        out = {}
+        with self._lock:
+            for values, child in self._children.items():
+                out[values] = (child.count, child.sum)
+        return out
+
+    def render(self) -> Iterable[str]:
+        for values, child in sorted(self.collect()):
+            cum = 0
+            for bound, c in zip(self.buckets, child.counts):
+                cum += c
+                le = self._label_str(values, f'le="{_fmt(bound)}"')
+                yield f"{self.name}_bucket{le} {cum}"
+            cum += child.counts[-1]
+            le = self._label_str(values, 'le="+Inf"')
+            yield f"{self.name}_bucket{le} {cum}"
+            yield (f"{self.name}_sum{self._label_str(values)} "
+                   f"{_fmt(child.sum)}")
+            yield f"{self.name}_count{self._label_str(values)} {cum}"
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class Registry:
+    """Named metric families; get-or-create is idempotent per (name, kind)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: "OrderedDict[str, _Family]" = OrderedDict()
+
+    def _get_or_create(self, cls, name: str, help: str, label_names,
+                       **kw) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if not isinstance(fam, cls) or fam.label_names != tuple(
+                        label_names):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind} with labels {fam.label_names}")
+                if "buckets" in kw and fam.buckets != tuple(
+                        sorted(float(x) for x in kw["buckets"])):
+                    # silently handing back different boundaries would put
+                    # the caller's observations in the wrong buckets
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {fam.buckets}")
+                return fam
+            fam = cls(name, help, label_names, **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                label_names: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, label_names)
+
+    def gauge(self, name: str, help: str = "",
+              label_names: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, label_names)
+
+    def histogram(self, name: str, help: str = "",
+                  label_names: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, label_names,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    def families(self) -> List[_Family]:
+        with self._lock:
+            return list(self._families.values())
+
+    def render_prometheus(self) -> str:
+        lines: List[str] = []
+        for fam in self.families():
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            lines.extend(fam.render())
+        return "\n".join(lines) + "\n"
+
+_default = Registry()
+
+
+def get_registry() -> Registry:
+    return _default
+
+
+def render_prometheus() -> str:
+    """Text exposition of the process-default registry."""
+    return _default.render_prometheus()

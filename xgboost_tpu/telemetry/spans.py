@@ -1,0 +1,169 @@
+"""Span tracer: named wall-clock brackets over the training/serving hot path.
+
+``span("grow.build_hist")`` is a context manager that, when telemetry is
+enabled, records ``time.perf_counter_ns`` duration into the registry
+histogram ``xtb_phase_seconds{phase=...}``, appends a JSONL trace event
+(trace.py) when ``XGBOOST_TPU_TRACE`` is set, and opens a
+``jax.profiler.TraceAnnotation`` so the same label shows up in TPU/perfetto
+profiler captures — one bracket, three sinks.
+
+Disabled-by-default overhead is the design constraint (the hot path calls
+``span()`` per tree level): everything hangs off ONE module-level flag, and
+the disabled path is a flag test plus returning a shared no-op context
+manager — no allocation, no clock read, no dict lookup
+(tests/test_telemetry.py has the guard test).
+
+``utils/timer.Monitor`` is a thin shim over ``record_phase`` (same sinks,
+stack-based start/stop bracketing); use ``span`` directly in new code.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+from . import trace
+from .registry import get_registry
+
+__all__ = ["span", "enable", "disable", "enabled", "record_phase", "Span",
+           "phase_totals", "PHASE_HISTOGRAM"]
+
+PHASE_HISTOGRAM = "xtb_phase_seconds"
+
+# the ONE flag every span checks; a configured trace destination implies
+# spans are wanted (capturing an empty trace would be the only alternative)
+_ENABLED: bool = bool(os.environ.get(trace.ENV_VAR))
+
+_phase_hist = None  # created lazily so importing telemetry stays cheap
+_children: Dict[str, object] = {}  # phase name -> histogram child (cached)
+_profiler = 0  # 0 = unprobed, module when available, None when not
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable(on: bool = True) -> None:
+    """Turn span bookkeeping on (idempotent; process-wide)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def disable() -> None:
+    enable(False)
+
+
+def _hist():
+    global _phase_hist
+    if _phase_hist is None:
+        _phase_hist = get_registry().histogram(
+            PHASE_HISTOGRAM,
+            "wall-clock seconds per instrumented phase", ("phase",))
+    return _phase_hist
+
+
+def _child(name: str):
+    child = _children.get(name)
+    if child is None:
+        child = _children.setdefault(name, _hist().labels(name))
+    return child
+
+
+def _annotation(name: str):
+    """jax.profiler.TraceAnnotation(name).__enter__() or None — guarded so
+    telemetry works before/without jax initialization."""
+    global _profiler
+    if _profiler == 0:
+        try:
+            import jax.profiler as _p
+            _profiler = _p
+        except Exception:  # pragma: no cover - no jax in the process
+            _profiler = None
+    if _profiler is None:  # pragma: no cover - no jax in the process
+        return None
+    try:
+        ann = _profiler.TraceAnnotation(name)
+        ann.__enter__()
+        return ann
+    except Exception:  # pragma: no cover - profiler backend quirk
+        return None
+
+
+def record_phase(name: str, t0_ns: int, dur_ns: int) -> None:
+    """Feed one finished bracket into both sinks (histogram + JSONL trace).
+    Shared by Span and the Monitor shim so the two agree on format."""
+    _child(name).observe(dur_ns / 1e9)
+    if trace.active():
+        trace.emit(name, t0_ns, dur_ns)
+
+
+class Span:
+    """One enabled bracket.  Usable as a context manager or via explicit
+    begin()/end() (the Monitor shim drives it manually)."""
+
+    __slots__ = ("name", "t0", "_ann")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.t0 = 0
+        self._ann = None
+
+    def begin(self) -> "Span":
+        self._ann = _annotation(self.name)
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def end(self) -> int:
+        dur = time.perf_counter_ns() - self.t0
+        ann = self._ann
+        if ann is not None:
+            self._ann = None
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:  # pragma: no cover - profiler backend quirk
+                pass
+        record_phase(self.name, self.t0, dur)
+        return dur
+
+    def __enter__(self) -> "Span":
+        return self.begin()
+
+    def __exit__(self, *exc) -> None:
+        self.end()
+
+
+class _NullSpan:
+    """Shared no-op: the disabled path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+    def begin(self) -> "_NullSpan":
+        return self
+
+    def end(self) -> int:
+        return 0
+
+
+_NULL = _NullSpan()
+
+
+def span(name: str):
+    """The instrumentation entry point: a live Span when telemetry is
+    enabled, the shared no-op otherwise."""
+    return Span(name) if _ENABLED else _NULL
+
+
+def phase_totals() -> Dict[str, Dict[str, float]]:
+    """{phase: {"count": n, "seconds": s}} accumulated so far — the
+    inspectable read side (render_prometheus() has the full histogram)."""
+    hist = get_registry().get(PHASE_HISTOGRAM)
+    if hist is None:
+        return {}
+    return {values[0]: {"count": c, "seconds": s}
+            for values, (c, s) in hist.snapshot_sums().items()}

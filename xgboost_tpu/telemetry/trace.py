@@ -1,0 +1,143 @@
+"""JSONL event-trace writer (chrome://tracing / Perfetto compatible).
+
+Each record is one JSON object per line with the Trace Event Format's
+complete-event fields — ``{"name", "ph": "X", "ts", "dur", "pid", "tid"}``
+(timestamps/durations in microseconds) — so a capture loads directly in
+chrome://tracing or ui.perfetto.dev after wrapping the lines in a JSON
+array (scripts in docs/observability.md), and line-oriented tools (jq,
+grep) can stream it without parsing the whole file.
+
+Gated by ``XGBOOST_TPU_TRACE=<path>``: when set at import (or via
+``configure(path)``) every span (spans.py), Monitor bracket
+(utils/timer.py), serving batch, and XLA compile appends one line.  The
+writer is append-only behind a small lock, opens the file lazily on the
+first event, and flushes per line so a crashed run still leaves a valid
+parseable prefix.
+"""
+from __future__ import annotations
+
+import atexit
+import io
+import json
+import os
+import threading
+from typing import Optional
+
+__all__ = ["active", "configure", "emit", "path", "flush", "ENV_VAR"]
+
+ENV_VAR = "XGBOOST_TPU_TRACE"
+_OWNER_VAR = ENV_VAR + "_OWNER_PID"
+
+
+def _env_path() -> Optional[str]:
+    """Resolve the env-configured destination.  Multi-process training
+    (launcher.py) spawns workers that inherit XGBOOST_TPU_TRACE; every
+    process truncating and buffering into ONE file would interleave
+    garbage, so the first process to import claims the bare path (owner
+    marker env var, inherited by children) and every other process writes
+    ``<path>.<pid>`` — one valid JSONL per process, pid field in every
+    event for merging."""
+    path = os.environ.get(ENV_VAR) or None
+    if path is None:
+        return None
+    owner = os.environ.get(_OWNER_VAR)
+    me = str(os.getpid())
+    if owner is None:
+        os.environ[_OWNER_VAR] = me
+    elif owner != me:
+        path = f"{path}.{me}"
+    return path
+
+
+_lock = threading.Lock()
+_path: Optional[str] = _env_path()
+_file: Optional[io.TextIOBase] = None
+
+
+def active() -> bool:
+    """True when a trace destination is configured."""
+    return _path is not None
+
+
+def path() -> Optional[str]:
+    return _path
+
+
+def configure(path: Optional[str]) -> None:
+    """Set (or with None, stop) the JSONL destination programmatically —
+    the same switch as the XGBOOST_TPU_TRACE environment variable,
+    including auto-enabling the span tracer (a trace with no spans is
+    never what the caller wanted).  configure(None) stops writing but
+    leaves the span flag alone — it may have been enabled explicitly."""
+    global _path, _file
+    with _lock:
+        if _file is not None:
+            try:
+                _file.flush()
+                _file.close()
+            except OSError:  # pragma: no cover - fs teardown race
+                pass
+            _file = None
+        _path = path or None
+    if _path is not None:
+        from . import spans  # import cycle broken at call time
+
+        spans.enable()
+
+
+def _ensure_file() -> Optional[io.TextIOBase]:
+    global _file
+    if _file is None and _path is not None:
+        # truncate: one capture = one process run (perf_counter timestamps
+        # have a per-process epoch, so appending across runs would render
+        # as one garbage timeline in chrome://tracing); the file stays open
+        # for appends within this run
+        _file = open(_path, "w", encoding="utf-8")
+    return _file
+
+
+def emit(name: str, ts_ns: int, dur_ns: int, ph: str = "X",
+         **args) -> None:
+    """Append one complete event.  ``ts_ns`` is the perf_counter_ns start of
+    the span; chrome expects microseconds, so both fields divide by 1e3."""
+    if _path is None:
+        return
+    rec = {
+        "name": name,
+        "ph": ph,
+        "ts": ts_ns / 1e3,
+        "dur": dur_ns / 1e3,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() & 0x7FFFFFFF,
+    }
+    if args:
+        rec["args"] = args
+    line = json.dumps(rec, separators=(",", ":"))
+    with _lock:
+        f = _ensure_file()
+        if f is None:  # configure(None) raced us
+            return
+        f.write(line + "\n")
+        f.flush()
+
+
+def flush() -> None:
+    with _lock:
+        if _file is not None:
+            _file.flush()
+
+
+@atexit.register
+def _close() -> None:  # pragma: no cover - interpreter teardown
+    global _file, _path
+    with _lock:
+        if _file is not None:
+            try:
+                _file.flush()
+                _file.close()
+            except OSError:
+                pass
+            # later LIFO atexit hooks may still emit(): with _path cleared
+            # they no-op instead of writing to a closed handle
+            _file = None
+            _path = None

@@ -145,7 +145,9 @@ def cv(
     if early_stopping_rounds is not None:
         callbacks.append(EarlyStopping(rounds=early_stopping_rounds, maximize=maximize))
     if verbose_eval:
-        callbacks.append(EvaluationMonitor(period=1 if verbose_eval is True else int(verbose_eval)))
+        callbacks.append(EvaluationMonitor(
+            period=1 if verbose_eval is True else int(verbose_eval),
+            show_stdv=show_stdv))
     cbs = CallbackContainer(callbacks, is_cv=True)
 
     class _Agg:
@@ -153,6 +155,7 @@ def cv(
 
         best_iteration: Optional[int] = None
         best_score: Optional[float] = None
+        _is_cv = True  # EarlyStopping(save_best=) must not slice this
 
         def set_attr(self, **kw):
             for p in packs:
@@ -166,6 +169,9 @@ def cv(
             return ""
 
     agg = _Agg()
+    # full callback lifecycle like train(): TelemetryCallback and friends
+    # hook before/after_training (the loop below otherwise never fires them)
+    agg = cbs.before_training(agg)
     results: Dict[str, List[float]] = {}
     for i in range(num_boost_round):
         if cbs.before_iteration(agg, i, dtrain, []):
@@ -178,13 +184,18 @@ def cv(
                 key, v = part.rsplit(":", 1)
                 fold_metrics.setdefault(key, []).append(float(v))
         for key, vals in fold_metrics.items():
-            results.setdefault(f"{key}-mean", []).append(float(np.mean(vals)))
-            results.setdefault(f"{key}-std", []).append(float(np.std(vals)))
+            mean, std = float(np.mean(vals)), float(np.std(vals))
+            results.setdefault(f"{key}-mean", []).append(mean)
+            results.setdefault(f"{key}-std", []).append(std)
+            # callbacks see (mean, std) tuples (the reference's cv score
+            # shape): EvaluationMonitor renders +std under show_stdv,
+            # EarlyStopping stops on the mean
             cbs.history.setdefault(key.split("-", 1)[0], {}).setdefault(
                 key.split("-", 1)[1], []
-            ).append(float(np.mean(vals)))
+            ).append((mean, std))
         if any(cb.after_iteration(agg, i, cbs.history) for cb in cbs.callbacks):
             break
+    cbs.after_training(agg)
     if as_pandas:
         try:
             import pandas as pd

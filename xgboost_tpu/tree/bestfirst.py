@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from ..models.tree import RegTree
 from ..ops.histogram import build_histogram_at, node_sums
 from ..ops.split import SplitParams, calc_weight, evaluate_splits
+from ..telemetry import span
 
 _EPS = 1e-6
 
@@ -185,12 +186,16 @@ class BestFirstGrower:
         self.mesh = mesh
 
     def _node_hist(self, bins, gpair, pos, i0, n, n_bin):
-        hist = build_histogram_at(bins, gpair, pos, i0, n_nodes=n,
-                                  n_bin=n_bin)
-        if self.distributed:
-            from .. import collective
+        # separately-timed phases (unlike the fused depthwise level_step):
+        # the best-first host loop dispatches hist, split-eval, and apply as
+        # distinct device calls, so the spans attribute them individually
+        with span("grow.build_hist"):
+            hist = build_histogram_at(bins, gpair, pos, i0, n_nodes=n,
+                                      n_bin=n_bin)
+            if self.distributed:
+                from .. import collective
 
-            hist = jnp.asarray(collective.allreduce(np.asarray(hist)))
+                hist = jnp.asarray(collective.allreduce(np.asarray(hist)))
         return hist
 
     def grow(self, bins, gpair, valid, cuts_pad, n_bins, feature_masks=None,
@@ -246,9 +251,10 @@ class BestFirstGrower:
             cand_cat_set=jnp.zeros((N, B), bool),
         )
         hist0 = self._node_hist(bins, gpair, state.pos, jnp.int32(0), 1, B)
-        state = _eval_nodes(state, hist0, cuts_pad, n_bins, fm, setmat,
-                            cm, jnp.int32(0), n=1, params=self.params,
-                            max_depth=self.max_depth, has_cat=has_cat)
+        with span("grow.eval_split"):
+            state = _eval_nodes(state, hist0, cuts_pad, n_bins, fm, setmat,
+                                cm, jnp.int32(0), n=1, params=self.params,
+                                max_depth=self.max_depth, has_cat=has_cat)
 
         monotone = (self.params.monotone is not None
                     and any(c != 0 for c in self.params.monotone))
@@ -259,17 +265,19 @@ class BestFirstGrower:
             if float(gain) <= gamma_eps:  # driver.h: queue exhausted
                 break
             l_id, r_id = n_nodes, n_nodes + 1
-            state = _apply_split(state, bins, setmat, nid,
-                                 jnp.int32(l_id), jnp.int32(r_id),
-                                 self.params, monotone)
+            with span("grow.update_tree"):
+                state = _apply_split(state, bins, setmat, nid,
+                                     jnp.int32(l_id), jnp.int32(r_id),
+                                     self.params, monotone)
             fme = (jnp.ones((1, F), bool) if feature_masks is None
                    else feature_masks(0, 2))
             hist2 = self._node_hist(bins, gpair, state.pos,
                                     jnp.int32(l_id), 2, B)
-            state = _eval_nodes(
-                state, hist2, cuts_pad, n_bins, fme, setmat, cm,
-                jnp.int32(l_id), n=2, params=self.params,
-                max_depth=self.max_depth, has_cat=has_cat)
+            with span("grow.eval_split"):
+                state = _eval_nodes(
+                    state, hist2, cuts_pad, n_bins, fme, setmat, cm,
+                    jnp.int32(l_id), n=2, params=self.params,
+                    max_depth=self.max_depth, has_cat=has_cat)
             n_nodes += 2
         self._n_nodes = n_nodes
         return state

@@ -28,6 +28,7 @@ from jax import lax
 from ..ops.histogram import (build_histogram, combine_sibling_hists,
                              node_sums)
 from ..ops.split import BestSplit, SplitParams, calc_weight, evaluate_splits
+from ..telemetry import span
 
 _EPS = 1e-6
 
@@ -600,39 +601,49 @@ class HistTreeGrower:
         common = dict(params=self.params, axis_name=self.axis_name,
                       lossguide=self.lossguide, has_cat=has_cat,
                       quantised=self.quantised)
+        # one span per level: the compiled program fuses build_hist +
+        # eval_split + the position rewrite, so the bracket necessarily
+        # covers all three — the name keeps the reference phase vocabulary
+        # greppable in traces (bestfirst.py times the phases separately)
+        _LEVEL = "grow.build_hist+eval_split"
         if not self.padded_levels or md < 2:
             hist_prev = None
             for d in range(md + 1):
                 fm = ones if feature_masks is None else feature_masks(d, 1 << d)
-                state, hist_prev = level_step(
-                    state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
-                    hist_prev, rho, depth=d, last_level=(d == md),
-                    hist_impl=self.hist_impl,
-                    subtract=(self.subtract and d > 0 and hist_prev is not None),
-                    **common)
+                with span(_LEVEL):
+                    state, hist_prev = level_step(
+                        state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
+                        hist_prev, rho, depth=d, last_level=(d == md),
+                        hist_impl=self.hist_impl,
+                        subtract=(self.subtract and d > 0 and hist_prev is not None),
+                        **common)
             return state
 
         # 3 compiled programs regardless of depth: root, shared padded
         # interior (traced node0), leaf finalize
         fm = ones if feature_masks is None else feature_masks(0, 1)
-        state, hist = level_step(
-            state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm, None, rho,
-            depth=0, last_level=False, hist_impl=self.hist_impl,
-            subtract=False, **common)
+        with span(_LEVEL):
+            state, hist = level_step(
+                state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm, None,
+                rho, depth=0, last_level=False, hist_impl=self.hist_impl,
+                subtract=False, **common)
         W = 1 << (md - 1)
         hist_pad = jnp.zeros((W,) + hist.shape[1:], hist.dtype).at[:1].set(hist)
         for d in range(1, md):
             fm = (ones if feature_masks is None
                   else self._pad_mask(feature_masks(d, 1 << d), W))
-            state, hist_pad = level_step_padded(
-                state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
-                hist_pad, (1 << d) - 1, rho, width=W, subtract=self.subtract,
-                hist_impl=self.hist_impl, **common)
+            with span(_LEVEL):
+                state, hist_pad = level_step_padded(
+                    state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm,
+                    hist_pad, (1 << d) - 1, rho, width=W,
+                    subtract=self.subtract, hist_impl=self.hist_impl,
+                    **common)
         fm = ones if feature_masks is None else feature_masks(md, 1 << md)
-        state, _ = level_step(
-            state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm, None, rho,
-            depth=md, last_level=True, hist_impl=self.hist_impl,
-            subtract=False, **common)
+        with span(_LEVEL):
+            state, _ = level_step(
+                state, bins, gpair, cuts_pad, n_bins, fm, setmat, cm, None,
+                rho, depth=md, last_level=True, hist_impl=self.hist_impl,
+                subtract=False, **common)
         return state
 
     @staticmethod
@@ -649,17 +660,18 @@ class HistTreeGrower:
     def to_host(state: TreeState) -> GrownTree:
         import numpy as np
 
-        return GrownTree(
-            is_cat=np.asarray(state.is_cat),
-            cat_set=np.asarray(state.cat_set),
-            feat=np.asarray(state.feat),
-            sbin=np.asarray(state.sbin),
-            thr=np.asarray(state.thr),
-            dleft=np.asarray(state.dleft),
-            is_leaf=np.asarray(state.is_leaf),
-            leaf_val=np.asarray(state.leaf_val),
-            gain=np.asarray(state.gain),
-            base_weight=np.asarray(state.base_weight),
-            sum_hess=np.asarray(state.sum_hess),
-            totals=np.asarray(state.totals),
-        )
+        with span("grow.to_host"):
+            return GrownTree(
+                is_cat=np.asarray(state.is_cat),
+                cat_set=np.asarray(state.cat_set),
+                feat=np.asarray(state.feat),
+                sbin=np.asarray(state.sbin),
+                thr=np.asarray(state.thr),
+                dleft=np.asarray(state.dleft),
+                is_leaf=np.asarray(state.is_leaf),
+                leaf_val=np.asarray(state.leaf_val),
+                gain=np.asarray(state.gain),
+                base_weight=np.asarray(state.base_weight),
+                sum_hess=np.asarray(state.sum_hess),
+                totals=np.asarray(state.totals),
+            )

@@ -31,6 +31,14 @@ def log(level: int, msg: str) -> None:
         _emit(msg)
 
 
+def console(msg: str) -> None:
+    """User-facing output shown at default verbosity (the reference's
+    ConsoleLogger CONSOLE channel: eval lines etc. — silenced only by
+    verbosity=0, redirected by register_log_callback like everything
+    else)."""
+    log(WARNING, msg)
+
+
 def warning(msg: str) -> None:
     log(WARNING, f"WARNING: {msg}")
 
