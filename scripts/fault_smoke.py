@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Fault-injection smoke for the nightly suite (docs/reliability.md).
+
+Flow: a 4-process distributed training run is killed by the injected fault
+plan (rank 2 dies entering round 3); a relaunch with ``resume_from=`` picks
+up the newest valid checkpoint; the final model's UBJSON bytes must equal
+an uninterrupted 4-process run's.  Exercises the launcher's ``fault_plan``
+wiring, the CheckpointCallback, and train() resume in one pass.
+
+Usage: JAX_PLATFORMS=cpu python scripts/fault_smoke.py [workers] [rounds]
+"""
+import functools
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WORKERS = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+KILL_RANK, KILL_ROUND = min(2, WORKERS - 1), 3
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+          "max_bin": 32}
+
+
+def worker(rank, world, *, ckpt_dir, out_path, resume, rounds):
+    import numpy as np
+
+    import xgboost_tpu as xtb
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    Xs, ys = X[rank::world], y[rank::world]
+    bst = xtb.train(PARAMS, xtb.DMatrix(Xs, label=ys), rounds,
+                    verbose_eval=False,
+                    callbacks=[xtb.CheckpointCallback(ckpt_dir, interval=1)],
+                    resume_from=ckpt_dir if resume else None)
+    if rank == 0:
+        with open(out_path, "wb") as fh:
+            fh.write(bytes(bst.save_raw()))
+
+
+def main() -> int:
+    from xgboost_tpu.launcher import run_distributed
+    from xgboost_tpu.reliability import latest_checkpoint
+
+    # pickle the worker under its importable module name, not __main__ —
+    # the spawned children re-import it from scripts/ (launcher mod_dir)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fault_smoke as _mod
+
+    global worker
+    worker = _mod.worker
+
+    tmp = tempfile.mkdtemp(prefix="xtb_fault_smoke_")
+    try:
+        full_out = os.path.join(tmp, "full.ubj")
+        res_out = os.path.join(tmp, "resumed.ubj")
+        ckpt_full = os.path.join(tmp, "ckpt_full")
+        ckpt_int = os.path.join(tmp, "ckpt_int")
+
+        print(f"[fault_smoke] uninterrupted {WORKERS}-process run ...")
+        run_distributed(
+            functools.partial(worker, ckpt_dir=ckpt_full, out_path=full_out,
+                              resume=False, rounds=ROUNDS),
+            num_workers=WORKERS, platform="cpu", timeout=900,
+            rendezvous="tracker")
+        full = open(full_out, "rb").read()
+
+        print(f"[fault_smoke] injected kill: rank {KILL_RANK} at round "
+              f"{KILL_ROUND} ...")
+        plan = {"faults": [{"site": "train.round", "kind": "kill",
+                            "rank": KILL_RANK, "round": KILL_ROUND,
+                            "exit_code": 43}]}
+        try:
+            run_distributed(
+                functools.partial(worker, ckpt_dir=ckpt_int, out_path="",
+                                  resume=False, rounds=ROUNDS),
+                num_workers=WORKERS, platform="cpu", timeout=900,
+                fault_plan=json.dumps(plan), rendezvous="tracker")
+        except RuntimeError as e:
+            print(f"[fault_smoke] interrupted as planned: {e}")
+        else:
+            raise SystemExit("fault plan did not interrupt the run")
+        st = latest_checkpoint(ckpt_int)
+        if st is None or not (1 <= st.round <= KILL_ROUND):
+            raise SystemExit(f"no usable checkpoint after the kill: {st}")
+        print(f"[fault_smoke] newest valid checkpoint: round {st.round}")
+
+        print("[fault_smoke] resuming ...")
+        run_distributed(
+            functools.partial(worker, ckpt_dir=ckpt_int, out_path=res_out,
+                              resume=True, rounds=ROUNDS),
+            num_workers=WORKERS, platform="cpu", timeout=900,
+            rendezvous="tracker")
+        resumed = open(res_out, "rb").read()
+        if resumed != full:
+            raise SystemExit(
+                "PARITY FAILURE: resumed model differs from the "
+                f"uninterrupted run ({len(resumed)} vs {len(full)} bytes)")
+        print(f"[fault_smoke] OK: kill/resume parity holds "
+              f"({len(full)} identical UBJSON bytes, {WORKERS} workers, "
+              f"{ROUNDS} rounds)")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

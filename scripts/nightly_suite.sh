@@ -39,4 +39,9 @@ print(f"telemetry smoke OK: {len(events)} events, "
 EOF
 rm -f "$TRACE_OUT"
 
+# fault-injection smoke (docs/reliability.md): 4-process train, kill rank 2
+# at round 3 via the injected plan, resume from the newest valid checkpoint,
+# and require final-model UBJSON parity with an uninterrupted run
+JAX_PLATFORMS=cpu python scripts/fault_smoke.py 4 6
+
 BENCH_FORCE_CPU=1 BENCH_ROWS=100000 BENCH_ROUNDS=5 python bench.py

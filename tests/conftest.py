@@ -46,7 +46,8 @@ _QUICK_FILES = {
     "test_multiquantile.py", "test_ranking.py", "test_survival.py",
     "test_categorical.py", "test_shap.py", "test_golden_models.py",
     "test_serving.py", "test_arrow.py", "test_telemetry.py",
-    "test_timer_observer.py",
+    "test_timer_observer.py", "test_reliability.py",
+    "test_serving_faults.py", "test_reliability_multiprocess.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)

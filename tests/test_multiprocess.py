@@ -24,10 +24,13 @@ jax.config.update("jax_platforms", "cpu")
 rank = int(sys.argv[1]); port = sys.argv[2]
 
 from xgboost_tpu import collective
-collective.init(coordinator_address=f"127.0.0.1:{port}",
-                num_processes=2, process_id=rank)
+# tracker rendezvous: rank assigned by the tracker; on CPU the
+# collectives ride the tracker's socket relay (XLA:CPU cannot run
+# multiprocess collectives — tracker.CollRelay, docs/reliability.md)
+collective.init(dmlc_tracker_uri="127.0.0.1", dmlc_tracker_port=port,
+                dmlc_nworker=2)
+rank = collective.get_rank()
 assert collective.get_world_size() == 2
-assert collective.get_rank() == rank
 
 import numpy as np
 import xgboost_tpu as xtb
@@ -70,9 +73,11 @@ collective.finalize()
 
 
 def _run_two_process(child_src, devices_per_process=None):
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    from xgboost_tpu.tracker import RabitTracker
+
+    tr = RabitTracker(n_workers=2, host_ip="127.0.0.1")
+    tr.start()
+    port = tr.port
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     if devices_per_process:
@@ -86,14 +91,19 @@ def _run_two_process(child_src, devices_per_process=None):
         for rank in range(2)
     ]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=850)
-        assert p.returncode == 0, f"worker failed:\n{err[-4000:]}"
-        line = [ln for ln in out.splitlines() if ln.startswith("RESULT")][-1]
-        outs.append(json.loads(line[len("RESULT"):]))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=850)
+            assert p.returncode == 0, f"worker failed:\n{err[-4000:]}"
+            line = [ln for ln in out.splitlines()
+                    if ln.startswith("RESULT")][-1]
+            outs.append(json.loads(line[len("RESULT"):]))
+    finally:
+        tr.free()
     return sorted(outs, key=lambda o: o["rank"])
 
 
+@pytest.mark.slow
 def test_two_process_training_identical_trees(tmp_path):
     outs = _run_two_process(CHILD)
 
@@ -135,8 +145,12 @@ jax.config.update("jax_platforms", "cpu")
 rank = int(sys.argv[1]); port = sys.argv[2]
 
 from xgboost_tpu import collective
-collective.init(coordinator_address=f"127.0.0.1:{port}",
-                num_processes=2, process_id=rank)
+# tracker rendezvous: rank assigned by the tracker; on CPU the
+# collectives ride the tracker's socket relay (XLA:CPU cannot run
+# multiprocess collectives — tracker.CollRelay, docs/reliability.md)
+collective.init(dmlc_tracker_uri="127.0.0.1", dmlc_tracker_port=port,
+                dmlc_nworker=2)
+rank = collective.get_rank()
 
 import numpy as np
 import xgboost_tpu as xtb
@@ -187,8 +201,12 @@ jax.config.update("jax_platforms", "cpu")
 rank = int(sys.argv[1]); port = sys.argv[2]
 
 from xgboost_tpu import collective
-collective.init(coordinator_address=f"127.0.0.1:{port}",
-                num_processes=2, process_id=rank)
+# tracker rendezvous: rank assigned by the tracker; on CPU the
+# collectives ride the tracker's socket relay (XLA:CPU cannot run
+# multiprocess collectives — tracker.CollRelay, docs/reliability.md)
+collective.init(dmlc_tracker_uri="127.0.0.1", dmlc_tracker_port=port,
+                dmlc_nworker=2)
+rank = collective.get_rank()
 
 import numpy as np
 import xgboost_tpu as xtb
@@ -215,6 +233,7 @@ collective.finalize()
 """
 
 
+@pytest.mark.slow
 def test_two_process_multitarget_identical_trees():
     """Vector-leaf trees x multi-process: the 2K-channel histogram allreduce
     must produce bitwise-identical trees on every rank."""
@@ -222,6 +241,7 @@ def test_two_process_multitarget_identical_trees():
     assert r0["dump_hash"] == r1["dump_hash"]
 
 
+@pytest.mark.slow
 def test_two_process_extmem_training_identical_trees():
     """extmem x multi-process: each worker streams its own page shard; the
     per-level histogram allreduce must make trees bitwise identical across
@@ -346,8 +366,12 @@ jax.config.update("jax_platforms", "cpu")
 rank = int(sys.argv[1]); port = sys.argv[2]
 
 from xgboost_tpu import collective
-collective.init(coordinator_address=f"127.0.0.1:{port}",
-                num_processes=2, process_id=rank)
+# tracker rendezvous: rank assigned by the tracker; on CPU the
+# collectives ride the tracker's socket relay (XLA:CPU cannot run
+# multiprocess collectives — tracker.CollRelay, docs/reliability.md)
+collective.init(dmlc_tracker_uri="127.0.0.1", dmlc_tracker_port=port,
+                dmlc_nworker=2)
+rank = collective.get_rank()
 
 import numpy as np
 import xgboost_tpu as xtb
@@ -407,6 +431,7 @@ collective.finalize()
 """
 
 
+@pytest.mark.slow
 def test_two_process_chip_mesh_composed_identical():
     """Process-DP x chip-DP (VERDICT r4 #2): 2 processes x 4 virtual chips
     each — each process GSPMD-shards its rows over its local mesh, and

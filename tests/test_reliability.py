@@ -1,0 +1,454 @@
+"""Reliability subsystem: retry/backoff, deterministic fault injection,
+crash-safe checkpoints, and train(resume_from=) parity.
+
+The contract under test (docs/reliability.md): a run interrupted at an
+arbitrary round and resumed from its newest valid checkpoint produces the
+SAME final model bytes as a run that was never interrupted; corrupt
+checkpoint files are skipped with a warning, never trusted; retries and
+faults are deterministic and visible in telemetry.
+"""
+import json
+import os
+import socket
+import warnings
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.reliability import (CheckpointCallback, FaultInjected,
+                                     RetriesExhausted, backoff_delays,
+                                     faults, latest_checkpoint, retry_call)
+from xgboost_tpu.reliability.checkpoint import (CheckpointManager,
+                                                CheckpointState)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# =========================================================================
+# retry / backoff
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept = []
+    assert retry_call(flaky, op="t1", retries=5, sleep=slept.append) == "ok"
+    assert len(calls) == 3 and len(slept) == 2
+    assert slept[1] > slept[0]  # exponential growth survives the jitter
+
+
+def test_retry_exhaustion_chains_last_error():
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(RetriesExhausted) as ei:
+        retry_call(always, op="t2", retries=2, sleep=lambda d: None)
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_retry_does_not_swallow_undeclared_exceptions():
+    def bug():
+        raise KeyError("logic bug, not transience")
+
+    with pytest.raises(KeyError):
+        retry_call(bug, op="t3", retries=5, sleep=lambda d: None)
+
+
+def test_backoff_jitter_is_deterministic_and_rank_staggered():
+    a = list(backoff_delays(6, op="connect", seed=3))
+    b = list(backoff_delays(6, op="connect", seed=3))
+    c = list(backoff_delays(6, op="connect", seed=4))
+    assert a == b          # same (op, seed) -> same schedule, every run
+    assert a != c          # different ranks de-synchronize
+    assert all(d <= 10.0 * 1.25 + 1e-9 for d in a)
+
+
+def test_retries_counted_in_telemetry():
+    from xgboost_tpu.telemetry.registry import get_registry
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError("x")
+        return 1
+
+    retry_call(flaky, op="telemetry_probe", retries=3, sleep=lambda d: None)
+    fam = get_registry().get("xtb_retries_total")
+    assert fam is not None and fam.get("telemetry_probe") >= 1
+
+
+# =========================================================================
+# fault plan
+
+
+def test_fault_plan_matchers_and_times():
+    faults.install({"faults": [
+        {"site": "s", "kind": "exception", "at": 2},
+    ]})
+    assert faults.maybe_inject("s") is None
+    assert faults.maybe_inject("s") is None
+    with pytest.raises(FaultInjected):
+        faults.maybe_inject("s")
+    # times=1 (default): exhausted even though `at` keeps matching nothing
+    assert faults.maybe_inject("s") is None
+    assert faults.active().fired("s") == 1
+
+
+def test_fault_plan_round_and_rank_matchers():
+    faults.install({"faults": [
+        {"site": "r", "kind": "exception", "round": 5, "rank": 1},
+    ]})
+    assert faults.maybe_inject("r", rank=0, round=5) is None
+    assert faults.maybe_inject("r", rank=1, round=4) is None
+    with pytest.raises(FaultInjected):
+        faults.maybe_inject("r", rank=1, round=5)
+
+
+def test_fault_rank_callable_resolved_lazily():
+    probed = []
+
+    def rank():
+        probed.append(1)
+        return 0
+
+    faults.install({"faults": [{"site": "a", "kind": "delay"}]})
+    faults.maybe_inject("a", rank=rank)     # no rank-constrained spec
+    assert not probed
+    faults.install({"faults": [{"site": "a", "kind": "delay", "rank": 0}]})
+    faults.maybe_inject("a", rank=rank)
+    assert probed
+
+
+def test_fault_plan_env_inline_and_file(tmp_path, monkeypatch):
+    plan = {"faults": [{"site": "e", "kind": "exception"}]}
+    monkeypatch.setenv(faults.ENV_VAR, json.dumps(plan))
+    faults.clear()
+    with pytest.raises(FaultInjected):
+        faults.maybe_inject("e")
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(plan))
+    monkeypatch.setenv(faults.ENV_VAR, str(p))
+    faults.clear()
+    with pytest.raises(FaultInjected):
+        faults.maybe_inject("e")
+
+
+def test_fault_plan_rejects_unknown_keys_and_kinds():
+    with pytest.raises(ValueError):
+        faults.install({"faults": [{"site": "x", "kind": "nuke"}]})
+    with pytest.raises(ValueError):
+        faults.install({"faults": [{"site": "x", "kind": "kill",
+                                    "banana": 1}]})
+
+
+def test_faults_counted_in_telemetry():
+    from xgboost_tpu.telemetry.registry import get_registry
+
+    faults.install({"faults": [{"site": "counted", "kind": "delay",
+                                "seconds": 0.0}]})
+    faults.maybe_inject("counted")
+    fam = get_registry().get("xtb_faults_injected_total")
+    assert fam is not None and fam.get("counted", "delay") >= 1
+
+
+# =========================================================================
+# checkpoint manager (atomicity, keep-last-K, corruption fallback)
+
+
+def _mk_state(round_, payload=b"model-bytes", hist=None):
+    return CheckpointState(round=round_, booster_bytes=payload,
+                           history=hist or {"t": {"rmse": [0.5]}},
+                           callback_state={})
+
+
+def test_checkpoint_roundtrip_and_keep_last(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last=2)
+    for r in (1, 2, 3, 4):
+        mgr.save(_mk_state(r, payload=bytes([r]) * 64))
+    assert len(mgr.files()) == 2  # pruned to keep-last-K
+    st = mgr.load_latest()
+    assert st.round == 4 and st.booster_bytes == bytes([4]) * 64
+    assert st.history == {"t": {"rmse": [0.5]}}
+
+
+def test_checkpoint_write_leaves_no_tmp_droppings(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last=3)
+    mgr.save(_mk_state(1))
+    names = os.listdir(tmp_path)
+    assert all(n.endswith(".xtbckpt") for n in names), names
+
+
+@pytest.mark.parametrize("mutate", ["zero", "truncate_tail", "truncate_head",
+                                    "bitflip", "garbage"])
+def test_checkpoint_corruption_fallback_fuzz(tmp_path, mutate):
+    """Style of test_model_io_fuzz: every damaged newest-file variant is
+    skipped WITH a warning and load falls back to the older valid one."""
+    mgr = CheckpointManager(str(tmp_path), keep_last=3)
+    mgr.save(_mk_state(1, payload=b"a" * 200))
+    mgr.save(_mk_state(2, payload=b"b" * 200))
+    newest = mgr.files()[-1]
+    blob = bytearray(open(newest, "rb").read())
+    if mutate == "zero":
+        blob = bytearray()
+    elif mutate == "truncate_tail":
+        blob = blob[: len(blob) // 2]
+    elif mutate == "truncate_head":
+        blob = blob[10:]
+    elif mutate == "bitflip":
+        blob[len(blob) // 2] ^= 0x40
+    elif mutate == "garbage":
+        blob = bytearray(os.urandom(len(blob)))
+    with open(newest, "wb") as fh:
+        fh.write(blob)
+    with pytest.warns(RuntimeWarning, match="invalid checkpoint"):
+        st = mgr.load_latest()
+    assert st is not None and st.round == 1
+    assert st.booster_bytes == b"a" * 200
+
+
+def test_checkpoint_bitflip_sweep_never_half_loads(tmp_path):
+    """Random single-byte corruptions anywhere in the file must either be
+    rejected (fall back) — a flipped byte can never produce a 'valid' state
+    with different bytes, the checksum guarantees it."""
+    mgr = CheckpointManager(str(tmp_path), keep_last=2)
+    mgr.save(_mk_state(7, payload=b"x" * 333))
+    good = open(mgr.files()[-1], "rb").read()
+    rng = np.random.default_rng(0)
+    for _ in range(25):
+        blob = bytearray(good)
+        blob[int(rng.integers(0, len(blob)))] ^= int(rng.integers(1, 256))
+        with open(mgr.files()[-1], "wb") as fh:
+            fh.write(blob)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            st = mgr.load_latest()
+        assert st is None  # the only file is damaged -> nothing to trust
+    with open(mgr.files()[-1] if mgr.files() else
+              os.path.join(str(tmp_path), "ckpt_00000007.xtbckpt"),
+              "wb") as fh:
+        fh.write(good)
+    assert mgr.load_latest().round == 7  # pristine bytes still load
+
+
+def test_checkpoint_all_corrupt_returns_none(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last=3)
+    mgr.save(_mk_state(1))
+    for p in mgr.files():
+        with open(p, "wb") as fh:
+            fh.write(b"")
+    with pytest.warns(RuntimeWarning):
+        assert mgr.load_latest() is None
+    assert latest_checkpoint(str(tmp_path / "missing")) is None
+
+
+def test_truncate_fault_seam_corrupts_then_falls_back(tmp_path):
+    """The checkpoint.write truncate fault produces exactly the torn-write
+    artifact load_latest must survive."""
+    mgr = CheckpointManager(str(tmp_path), keep_last=3)
+    mgr.save(_mk_state(1, payload=b"ok" * 100))
+    faults.install({"faults": [{"site": "checkpoint.write",
+                                "kind": "truncate", "round": 2}]})
+    mgr.save(_mk_state(2, payload=b"no" * 100))
+    faults.clear()
+    assert len(mgr.files()) == 2  # the torn file DID commit under its name
+    with pytest.warns(RuntimeWarning, match="invalid checkpoint"):
+        st = mgr.load_latest()
+    assert st.round == 1 and st.booster_bytes == b"ok" * 100
+
+
+# =========================================================================
+# CheckpointCallback + train(resume_from=) parity
+
+
+def _data(seed=0, n=800, f=6):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    X[rng.random((n, f)) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) ** 2 > 0.5
+         ).astype(np.float32)
+    return X, y
+
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+          "max_bin": 32}
+
+
+def test_kill_resume_parity_bitwise(tmp_path):
+    """Interrupt at round 3 of 6 via an injected fault, resume from the
+    checkpoint directory: the final model's UBJSON bytes equal the
+    uninterrupted run's (the acceptance bit-parity contract, single
+    process; test_reliability_multiprocess.py holds it multi-process)."""
+    X, y = _data()
+    full = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 6, verbose_eval=False)
+
+    ckpt = str(tmp_path / "ckpt")
+    faults.install({"faults": [{"site": "train.round", "kind": "exception",
+                                "round": 3}]})
+    with pytest.raises(FaultInjected):
+        xtb.train(PARAMS, xtb.DMatrix(X, label=y), 6, verbose_eval=False,
+                  callbacks=[CheckpointCallback(ckpt, interval=1)])
+    faults.clear()
+    st = latest_checkpoint(ckpt)
+    assert st is not None and st.round == 3
+
+    res = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 6, verbose_eval=False,
+                    resume_from=ckpt,
+                    callbacks=[CheckpointCallback(ckpt, interval=1)])
+    assert res.num_boosted_rounds() == 6
+    assert bytes(res.save_raw()) == bytes(full.save_raw())
+
+
+def test_resume_total_round_semantics(tmp_path):
+    """num_boost_round is the TOTAL target under resume: a relaunch whose
+    checkpoint already reached it trains zero extra rounds."""
+    X, y = _data(seed=2)
+    ckpt = str(tmp_path / "c")
+    bst = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 4, verbose_eval=False,
+                    callbacks=[CheckpointCallback(ckpt)])
+    res = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 4, verbose_eval=False,
+                    resume_from=ckpt)
+    assert res.num_boosted_rounds() == 4
+    assert bytes(res.save_raw()) == bytes(bst.save_raw())
+
+
+def test_resume_from_empty_dir_is_fresh_start(tmp_path):
+    X, y = _data(seed=3)
+    res = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 3, verbose_eval=False,
+                    resume_from=str(tmp_path / "nothing_here"))
+    full = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 3, verbose_eval=False)
+    assert bytes(res.save_raw()) == bytes(full.save_raw())
+
+
+def test_resume_restores_eval_history_and_early_stopping(tmp_path):
+    """History and EarlyStopping patience survive the crash: the resumed
+    run's evals_result and stopping round match the uninterrupted run's."""
+    X, y = _data(seed=4)
+    dtrain = xtb.DMatrix(X, label=y)
+    dval = xtb.DMatrix(X[:200], label=y[:200])
+    kw = dict(evals=[(dval, "v")], early_stopping_rounds=3,
+              verbose_eval=False)
+
+    full_res = {}
+    full = xtb.train({**PARAMS, "eval_metric": "logloss"}, dtrain, 8,
+                     evals_result=full_res, **kw)
+
+    ckpt = str(tmp_path / "es")
+    faults.install({"faults": [{"site": "train.round", "kind": "exception",
+                                "round": 4}]})
+    with pytest.raises(FaultInjected):
+        xtb.train({**PARAMS, "eval_metric": "logloss"},
+                  xtb.DMatrix(X, label=y), 8,
+                  callbacks=[CheckpointCallback(ckpt)], **kw)
+    faults.clear()
+
+    # ordering guard: the checkpoint must capture THIS round's EarlyStopping
+    # decision (train() dispatches run-last callbacks after the rest) — a
+    # one-round-stale state would resume with the wrong patience/best
+    st = latest_checkpoint(ckpt)
+    es_state = st.callback_state["EarlyStopping@0"]
+    assert (len(es_state["best_scores"]) + es_state["current_rounds"]
+            == st.round), (es_state, st.round)
+
+    res_res = {}
+    res = xtb.train({**PARAMS, "eval_metric": "logloss"},
+                    xtb.DMatrix(X, label=y), 8, resume_from=ckpt,
+                    evals_result=res_res,
+                    callbacks=[CheckpointCallback(ckpt)], **kw)
+    assert res.best_iteration == full.best_iteration
+    assert res_res["v"]["logloss"] == full_res["v"]["logloss"]
+    assert bytes(res.save_raw()) == bytes(full.save_raw())
+
+
+def test_checkpoint_callback_interval_and_cv_safety(tmp_path):
+    X, y = _data(seed=5)
+    ckpt = str(tmp_path / "iv")
+    xtb.train(PARAMS, xtb.DMatrix(X, label=y), 6, verbose_eval=False,
+              callbacks=[CheckpointCallback(ckpt, interval=2, keep_last=2)])
+    rounds = [int(os.path.basename(p)[5:13])
+              for p in CheckpointManager(ckpt).files()]
+    assert rounds == [4, 6]  # every 2nd round, pruned to keep-last 2
+    # cv's aggregate stand-in has no serialize(); the callback must no-op,
+    # not crash the fold loop
+    xtb.cv(PARAMS, xtb.DMatrix(X, label=y), num_boost_round=2, nfold=2,
+           callbacks=[CheckpointCallback(str(tmp_path / "cv"))])
+
+
+def test_checkpoint_telemetry_series_present(tmp_path):
+    X, y = _data(seed=6)
+    xtb.train(PARAMS, xtb.DMatrix(X, label=y), 2, verbose_eval=False,
+              callbacks=[CheckpointCallback(str(tmp_path / "t"))])
+    from xgboost_tpu.telemetry import render_prometheus
+
+    prom = render_prometheus()
+    assert "xtb_checkpoint_seconds_bucket" in prom
+    assert "xtb_checkpoints_total" in prom
+
+
+# =========================================================================
+# tracker robustness satellites
+
+
+def test_get_host_ip_falls_back_with_warning(monkeypatch):
+    from xgboost_tpu import tracker as tr
+
+    class Boom:
+        def __init__(self, *a, **k):
+            raise OSError("no interfaces")
+
+    monkeypatch.setattr(tr.socket, "socket", Boom)
+    with pytest.warns(RuntimeWarning, match="127.0.0.1"):
+        assert tr.get_host_ip("auto") == "127.0.0.1"
+    # explicit addresses pass through untouched (and un-warned)
+    assert tr.get_host_ip("10.0.0.5") == "10.0.0.5"
+
+
+def test_recv_msg_timeout_is_a_detected_fault():
+    """A peer that connects and then goes silent trips the per-operation
+    timeout (an OSError) instead of wedging the reader forever."""
+    from xgboost_tpu.tracker import recv_msg, send_msg
+
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(OSError):
+            recv_msg(a, timeout=0.2)
+        # the timeout is per-operation: the socket still works afterwards
+        send_msg(b, {"cmd": "ping"}, timeout=5.0)
+        assert recv_msg(a, timeout=5.0) == {"cmd": "ping"}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tracker_connect_retries_through_injected_failures():
+    """The connect seam: two injected failures, then the real connection
+    succeeds — counted as retries, invisible to the caller."""
+    from xgboost_tpu.tracker import RabitTracker, TrackerClient
+
+    tr = RabitTracker(n_workers=1, host_ip="127.0.0.1")
+    tr.start()
+    faults.install({"faults": [{"site": "tracker.connect",
+                                "kind": "exception", "times": 2}]})
+    try:
+        c = TrackerClient("127.0.0.1", tr.port, timeout=30)
+        assert c.rank == 0 and c.world == 1
+        if c.coordinator:  # rank 0 reports, completing the bootstrap
+            pass
+        c.shutdown()
+        tr.wait_for(timeout=30)
+    finally:
+        faults.clear()
+        tr.free()

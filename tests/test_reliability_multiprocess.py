@@ -1,0 +1,196 @@
+"""End-to-end fault injection across real worker processes.
+
+The acceptance contract (ISSUE 3 / docs/reliability.md): killing a worker
+mid-training makes the SURVIVORS abort within the watcher timeout (tracker
+EOF fan-out — a silent death must not wedge peers in a collective), and a
+relaunch with ``resume_from=`` continues from the last good checkpoint to a
+final model bitwise-equal (UBJSON bytes) to an uninterrupted run.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from xgboost_tpu.tracker import RabitTracker
+
+TRAIN_CHILD = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+uri, port = sys.argv[1], int(sys.argv[2])
+ckpt_dir, out_path, resume = sys.argv[3], sys.argv[4], sys.argv[5] == "1"
+
+from xgboost_tpu import collective
+collective.init(dmlc_tracker_uri=uri, dmlc_tracker_port=port, dmlc_nworker=2)
+rank = collective.get_rank()
+
+import numpy as np
+import xgboost_tpu as xtb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1600, 6)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+Xs, ys = X[rank::2], y[rank::2]          # disjoint shards
+
+bst = xtb.train({"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+                 "max_bin": 32}, xtb.DMatrix(Xs, label=ys), 6,
+                verbose_eval=False,
+                callbacks=[xtb.CheckpointCallback(ckpt_dir, interval=1)],
+                resume_from=ckpt_dir if resume else None)
+if rank == 0 and out_path:
+    with open(out_path, "wb") as fh:
+        fh.write(bytes(bst.save_raw()))
+collective.finalize()
+print("DONE", rank, flush=True)
+"""
+
+
+def _run_pair(tmp_path, tag, *, ckpt_dir, out_name, resume, fault_plan=None,
+              timeout=600):
+    """Two tracker-rendezvoused workers; returns (tracker_error, rcs)."""
+    tr = RabitTracker(n_workers=2, host_ip="127.0.0.1")
+    tr.start()
+    args = tr.worker_args()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    if fault_plan is not None:
+        env["XGBOOST_TPU_FAULT_PLAN"] = json.dumps(fault_plan)
+    else:
+        env.pop("XGBOOST_TPU_FAULT_PLAN", None)
+    out_path = str(tmp_path / out_name) if out_name else ""
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", TRAIN_CHILD, str(args["dmlc_tracker_uri"]),
+         str(args["dmlc_tracker_port"]), ckpt_dir, out_path, resume],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for _ in range(2)]
+    outs = [p.communicate(timeout=timeout) for p in procs]
+    tracker_error = None
+    try:
+        tr.wait_for(timeout=60)
+    except (RuntimeError, TimeoutError) as e:
+        tracker_error = e
+    tr.free()
+    rcs = [p.returncode for p in procs]
+    for (_, err), rc in zip(outs, rcs):
+        if fault_plan is None:
+            assert rc == 0, f"[{tag}] worker failed (rc={rc}):\n{err[-3000:]}"
+    return tracker_error, rcs
+
+
+def test_kill_resume_parity_multiprocess(tmp_path):
+    """Quick-tier acceptance: kill one worker at round 3 via the fault plan
+    -> its peer is ABORTED by the tracker's EOF fan-out (no wedge); a
+    relaunch resumes from the newest valid checkpoint and the final model
+    bytes equal the uninterrupted run's."""
+    ckpt_a = str(tmp_path / "ckpt_full")
+    err, _ = _run_pair(tmp_path, "full", ckpt_dir=ckpt_a,
+                       out_name="full.ubj", resume="0")
+    assert err is None
+    full = open(tmp_path / "full.ubj", "rb").read()
+
+    # interrupted: whichever process drew rank 1 dies entering round 3
+    ckpt_b = str(tmp_path / "ckpt_int")
+    t0 = time.time()
+    err, rcs = _run_pair(
+        tmp_path, "interrupted", ckpt_dir=ckpt_b, out_name="", resume="0",
+        fault_plan={"faults": [{"site": "train.round", "kind": "kill",
+                                "rank": 1, "round": 3, "exit_code": 43}]})
+    elapsed = time.time() - t0
+    # the killed worker exits 43; the SURVIVOR must be aborted (255) by the
+    # tracker fan-out — promptly, not after a collective timeout
+    assert sorted(rcs) == [43, 255], rcs
+    assert err is not None and "worker" in str(err)
+    assert elapsed < 420, f"survivor abort took {elapsed:.0f}s"
+    from xgboost_tpu.reliability import latest_checkpoint
+
+    st = latest_checkpoint(ckpt_b)
+    assert st is not None and 1 <= st.round <= 3
+
+    # relaunch with the same command + resume_from: bitwise parity
+    err, _ = _run_pair(tmp_path, "resume", ckpt_dir=ckpt_b,
+                       out_name="resumed.ubj", resume="1")
+    assert err is None
+    resumed = open(tmp_path / "resumed.ubj", "rb").read()
+    assert resumed == full, "kill/resume model differs from uninterrupted run"
+
+
+FANOUT_CHILD = r"""
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+uri, port = sys.argv[1], int(sys.argv[2])
+from xgboost_tpu import collective
+collective.init(dmlc_tracker_uri=uri, dmlc_tracker_port=port, dmlc_nworker=3)
+rank = collective.get_rank()
+print("READY", rank, flush=True)
+if rank == 1:
+    time.sleep(1.0)
+    collective.signal_error("deliberate failure rank1")  # exits 1
+time.sleep(600)  # survivors: only the abort fan-out can end this
+"""
+
+
+@pytest.mark.slow
+def test_signal_error_fanout_aborts_all_workers(tmp_path):
+    """Satellite: one of THREE workers calls collective.signal_error; every
+    other worker's watcher must exit the process within the timeout (the
+    reference's comm.cc detached error watcher contract)."""
+    tr = RabitTracker(n_workers=3, host_ip="127.0.0.1")
+    tr.start()
+    args = tr.worker_args()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("XGBOOST_TPU_FAULT_PLAN", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", FANOUT_CHILD, str(args["dmlc_tracker_uri"]),
+         str(args["dmlc_tracker_port"])],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+        for _ in range(3)]
+    with pytest.raises(RuntimeError, match="deliberate failure rank1"):
+        tr.wait_for(timeout=420)
+    rcs = sorted(p.wait(timeout=180) for p in procs)
+    # the failer sys.exit(1)s; BOTH survivors os._exit(255) on the abort
+    assert rcs == [1, 255, 255], rcs
+    tr.free()
+
+
+@pytest.mark.slow
+def test_dropped_tracker_connection_is_a_detected_fault(tmp_path):
+    """A worker whose tracker connection drops right after rendezvous is
+    treated as dead: the tracker fans the abort out to its peers."""
+    tr = RabitTracker(n_workers=2, host_ip="127.0.0.1")
+    tr.start()
+    args = tr.worker_args()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["XGBOOST_TPU_FAULT_PLAN"] = json.dumps(
+        {"faults": [{"site": "tracker.connected", "kind": "drop_connection",
+                     "rank": 1}]})
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", FANOUT_CHILD.replace("dmlc_nworker=3",
+                                                    "dmlc_nworker=2"),
+         str(args["dmlc_tracker_uri"]), str(args["dmlc_tracker_port"])],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+        for _ in range(2)]
+    with pytest.raises(RuntimeError, match="connection lost"):
+        tr.wait_for(timeout=420)
+    # rank 0 is aborted; rank 1 (channel-less) would sleep 600s — kill it
+    rcs = []
+    deadline = time.time() + 180
+    for p in procs:
+        rc = None
+        while time.time() < deadline:
+            rc = p.poll()
+            if rc is not None:
+                break
+            time.sleep(0.5)
+        if rc is None:
+            p.kill()
+            p.wait(timeout=30)
+        else:
+            rcs.append(rc)
+    assert 255 in rcs, rcs  # the worker with a live channel was aborted
+    tr.free()

@@ -384,8 +384,10 @@ def test_serving_snapshot_shape_stable():
     assert sorted(snap) == ["compiles_steady", "compiles_warmup", "models",
                             "queue_depth", "queue_peak"]
     assert sorted(snap["models"]["m"]) == [
-        "batch_size_hist", "batches", "errors", "latency_ms", "requests",
-        "rows", "rows_per_s"]
+        "batch_size_hist", "batches", "deadline", "errors", "latency_ms",
+        "requests", "rows", "rows_per_s", "shed"]  # +degradation counters
+    assert snap["models"]["m"]["shed"] == 0
+    assert snap["models"]["m"]["deadline"] == 0
     assert sorted(snap["models"]["m"]["latency_ms"]) == ["p50", "p95", "p99"]
 
 

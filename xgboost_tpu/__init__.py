@@ -20,7 +20,8 @@ from .data.extmem import (DataIter, ExtMemQuantileDMatrix,
 from .data.ellpack import EllpackPage
 from .data.quantile import HistogramCuts
 from .training import cv, train
-from . import collective, telemetry, tracker
+from . import collective, reliability, telemetry, tracker
+from .reliability import CheckpointCallback
 from .telemetry import TelemetryCallback
 from .callback import (
     EarlyStopping,
@@ -51,7 +52,9 @@ __all__ = [
     "LearningRateScheduler",
     "TrainingCheckPoint",
     "TelemetryCallback",
+    "CheckpointCallback",
     "collective",
+    "reliability",
     "telemetry",
     "tracker",
     "serving",

@@ -31,6 +31,16 @@ class TrainingCallback:
         """Return True to stop training."""
         return False
 
+    # checkpoint/resume protocol (reliability/checkpoint.py): stateful
+    # callbacks override both so an interrupted run resumes with the same
+    # decisions (EarlyStopping patience, scheduler position, ...) as an
+    # uninterrupted one.  State must be JSON-serializable.
+    def state_dict(self) -> Optional[dict]:
+        return None
+
+    def load_state(self, state: dict) -> None:
+        pass
+
 
 class CallbackContainer:
     """Driver for a list of callbacks (reference: callback.py:149)."""
@@ -147,6 +157,14 @@ class EarlyStopping(TrainingCallback):
         if self.save_best and model.best_iteration is not None and not getattr(model, "_is_cv", False):
             model = model[: model.best_iteration + 1]
         return model
+
+    def state_dict(self) -> dict:
+        return {"best_scores": list(self.best_scores),
+                "current_rounds": int(self.current_rounds)}
+
+    def load_state(self, state: dict) -> None:
+        self.best_scores = [float(s) for s in state.get("best_scores", [])]
+        self.current_rounds = int(state.get("current_rounds", 0))
 
 
 class EvaluationMonitor(TrainingCallback):

@@ -19,6 +19,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from .reliability.faults import maybe_inject as _maybe_inject
+
 __all__ = [
     "init", "finalize", "get_rank", "get_world_size", "is_distributed",
     "communicator_print", "get_processor_name", "broadcast", "allreduce",
@@ -52,6 +54,64 @@ def _reduce_stacked(gathered: np.ndarray, op: Op, dtype) -> np.ndarray:
     if red is None:
         raise NotImplementedError(f"allreduce op {op!r} not supported")
     return red(gathered, axis=0).astype(dtype)
+
+
+def _platform_hint() -> str:
+    """The REQUESTED jax platform ("cpu", "tpu", ... or "" when unset),
+    from jax.config / JAX_PLATFORMS — without initializing any backend
+    (jax.default_backend() would, and jax.distributed.initialize must run
+    first on accelerator clusters)."""
+    import os
+
+    import jax
+
+    hint = ""
+    try:
+        hint = jax.config.jax_platforms or ""
+    except AttributeError:
+        pass
+    hint = hint or os.environ.get("JAX_PLATFORMS", "")
+    return hint.split(",")[0].strip().lower()
+
+
+_TRANSIENT_RENDEZVOUS = ("deadline", "unavailable", "connection", "refused",
+                         "timed out", "timeout", "reset")
+
+
+def _init_jax_distributed(*, coordinator_address, num_processes,
+                          process_id) -> None:
+    """jax.distributed rendezvous with elastic retry/backoff: a coordinator
+    that is still binding its port (worker raced the launcher) or briefly
+    unreachable (restart) is retried with jittered exponential backoff
+    instead of failing the whole job on the first refused connection.
+    ``XGBOOST_TPU_RENDEZVOUS_RETRIES`` (default 3) bounds the re-attempts;
+    retries count into ``xtb_retries_total{op="jax.rendezvous"}``."""
+    import os
+
+    import jax
+
+    from .reliability.retry import retry_call
+
+    def _initialize():
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+    retry_call(
+        _initialize, op="jax.rendezvous",
+        retries=int(os.environ.get("XGBOOST_TPU_RENDEZVOUS_RETRIES", "3")),
+        base=0.5, max_delay=15.0,
+        seed=int(process_id) if process_id is not None else 0,
+        # jax surfaces rendezvous failures as RuntimeError, but so are
+        # permanent conditions ("already initialized", misconfiguration) —
+        # only grpc-transient-looking messages are worth re-attempting,
+        # the rest must fail immediately with the real error
+        retry_on=(RuntimeError, OSError),
+        retry_if=lambda e: (isinstance(e, OSError)
+                            or any(s in str(e).lower()
+                                   for s in _TRANSIENT_RENDEZVOUS)))
 
 
 # ---------------------------------------------------------------------------
@@ -118,6 +178,7 @@ class JaxDistributedBackend(CollBackend):
 
     def __init__(self, **args: Any) -> None:
         self._tracker = None
+        self._relay_mode = False
         if args.get("dmlc_tracker_uri") and args.get("dmlc_tracker_port"):
             # tracker mode (reference flow): dmlc_* args address a
             # RabitTracker rendezvous server, which assigns the rank,
@@ -130,9 +191,26 @@ class JaxDistributedBackend(CollBackend):
                 str(args["dmlc_tracker_uri"]),
                 int(args["dmlc_tracker_port"]),
                 task_id=str(args.get("dmlc_task_id", "")))
-            import jax
+            import os
 
-            jax.distributed.initialize(
+            # XLA's CPU backend cannot execute multiprocess collectives
+            # (jaxlib raises INVALID_ARGUMENT at the first gather), so on
+            # CPU the tracker's socket relay carries them instead and
+            # jax.distributed is skipped entirely; accelerator backends
+            # keep the native path.  XGBOOST_TPU_COLL=relay|jax overrides.
+            # The platform is read from config/env, NOT jax.default_backend():
+            # probing the backend would initialize XLA, and
+            # jax.distributed.initialize must run before any computation —
+            # the probe would break the accelerator path it selects.
+            mode = os.environ.get("XGBOOST_TPU_COLL", "auto")
+            self._relay_mode = (
+                self._tracker.coll_port is not None
+                and self._tracker.world > 1
+                and (mode == "relay"
+                     or (mode == "auto" and _platform_hint() == "cpu")))
+            if self._relay_mode:
+                return
+            _init_jax_distributed(
                 coordinator_address=self._tracker.coordinator,
                 num_processes=self._tracker.world,
                 process_id=self._tracker.rank,
@@ -152,25 +230,29 @@ class JaxDistributedBackend(CollBackend):
         n_proc = args.get("num_processes")
         rank = args.get("process_id")
         if coordinator is not None:
-            import jax
-
-            jax.distributed.initialize(
+            _init_jax_distributed(
                 coordinator_address=str(coordinator),
                 num_processes=int(n_proc) if n_proc is not None else None,
                 process_id=int(rank) if rank is not None else None,
             )
 
     def rank(self) -> int:
+        if self._relay_mode:
+            return self._tracker.rank
         import jax
 
         return jax.process_index()
 
     def world_size(self) -> int:
+        if self._relay_mode:
+            return self._tracker.world
         import jax
 
         return jax.process_count()
 
     def allgather(self, data: np.ndarray) -> np.ndarray:
+        if self._relay_mode:
+            return self._tracker.coll_allgather(np.asarray(data))
         if self.world_size() == 1:
             return np.asarray(data)[None]
         from jax.experimental import multihost_utils
@@ -181,6 +263,9 @@ class JaxDistributedBackend(CollBackend):
         return np.asarray(multihost_utils.process_allgather(data))
 
     def broadcast_bytes(self, payload: Optional[bytes], root: int) -> bytes:
+        if self._relay_mode:
+            # derived gather-based broadcast over the relay (CollBackend)
+            return super().broadcast_bytes(payload, root)
         if self.world_size() == 1:
             return payload
         from jax.experimental import multihost_utils
@@ -197,9 +282,13 @@ class JaxDistributedBackend(CollBackend):
         return bytes(np.asarray(out))
 
     def shutdown(self) -> None:
+        relay = self._relay_mode
+        self._relay_mode = False
         if self._tracker is not None:
             self._tracker.shutdown()
             self._tracker = None
+        if relay:
+            return  # jax.distributed was never initialized
         try:
             import jax
 
@@ -373,6 +462,10 @@ def communicator_print(msg: str) -> None:
 def allreduce(data: np.ndarray, op: Op = Op.SUM) -> np.ndarray:
     """Allreduce across workers (reference: collective.py allreduce) —
     exact and identically ordered on every worker."""
+    # seam: delay (slow peer), exception (failed exchange -> caller's
+    # signal_error path), kill (worker death mid-collective); no-op
+    # without an installed plan (one global read)
+    _maybe_inject("collective.allreduce", rank=get_rank)
     return _backend().allreduce(np.asarray(data), op)
 
 
@@ -381,6 +474,7 @@ def allgather(data: np.ndarray) -> np.ndarray:
 
     The building block of the distributed quantile-sketch merge
     (reference: src/common/quantile.cc:397 AllreduceV of summaries)."""
+    _maybe_inject("collective.allgather", rank=get_rank)
     return _backend().allgather(np.asarray(data))
 
 

@@ -24,6 +24,7 @@ Example worker::
 """
 from __future__ import annotations
 
+import functools
 import os
 import pickle
 import socket
@@ -53,8 +54,15 @@ from xgboost_tpu import collective
 rank = int(sys.argv[1])
 world = int(sys.argv[2])
 port = sys.argv[3]
-collective.init(coordinator_address=f"127.0.0.1:{port}",
-                num_processes=world, process_id=rank)
+if sys.argv[7] == "tracker":
+    # tracker rendezvous: rank assigned by the tracker, persistent abort
+    # channel, socket-relay collectives on CPU backends (tracker.CollRelay)
+    collective.init(dmlc_tracker_uri="127.0.0.1", dmlc_tracker_port=port,
+                    dmlc_nworker=world)
+    rank = collective.get_rank()
+else:
+    collective.init(coordinator_address=f"127.0.0.1:{port}",
+                    num_processes=world, process_id=rank)
 with open(sys.argv[5], "rb") as fh:
     fn = pickle.load(fh)
 try:
@@ -67,33 +75,65 @@ finally:
 def run_distributed(fn: Callable[[int, int], None], num_workers: int,
                     *, coordinator_port: Optional[int] = None,
                     platform: Optional[str] = None,
-                    timeout: float = 3600.0) -> None:
+                    timeout: float = 3600.0,
+                    fault_plan: Optional[str] = None,
+                    rendezvous: str = "auto") -> None:
     """Spawn ``num_workers`` processes, each running ``fn(rank, world)``
     under an initialized collective.  ``fn`` must be picklable (a module-
     level function).  ``platform`` overrides jax_platforms in the workers
     (e.g. "cpu" for tests; the sitecustomize freeze means the env var alone
-    is not enough).  Raises on the first failing worker."""
-    port = coordinator_port or _free_port()
+    is not enough).  Raises on the first failing worker.
+
+    ``fault_plan``: inline JSON or a file path, exported to the workers as
+    ``XGBOOST_TPU_FAULT_PLAN`` (reliability/faults.py) — the hook the
+    fault-injection tests and the nightly kill/resume smoke use.
+
+    ``rendezvous``: "direct" (jax.distributed coordinator, pre-assigned
+    ranks) or "tracker" (a RabitTracker assigns ranks, keeps the abort
+    fan-out channel, and supplies socket-relay collectives on CPU backends
+    — required for CPU multi-process training, docs/reliability.md).
+    "auto" picks "tracker" for CPU workers (XLA:CPU cannot run
+    multiprocess collectives, and the abort fan-out is strictly more
+    robust locally) and "direct" for accelerator platforms."""
+    tracker = None
+    if rendezvous == "auto":
+        rendezvous = "tracker" if (platform or "") == "cpu" else "direct"
+    if rendezvous == "tracker":
+        from .tracker import RabitTracker
+
+        tracker = RabitTracker(n_workers=num_workers, host_ip="127.0.0.1")
+        tracker.start()
+        port = tracker.port
+    elif rendezvous == "direct":
+        port = coordinator_port or _free_port()
+    else:
+        raise ValueError(f"unknown rendezvous {rendezvous!r}")
     with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as fh:
         pickle.dump(fn, fh)
         fn_path = fh.name
-    mod = sys.modules.get(getattr(fn, "__module__", ""), None)
+    target = fn
+    while isinstance(target, functools.partial):
+        target = target.func  # resolve the real function's home module
+    mod = sys.modules.get(getattr(target, "__module__", ""), None)
     mod_dir = (os.path.dirname(os.path.abspath(mod.__file__))
                if mod is not None and getattr(mod, "__file__", None) else "")
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    if fault_plan is not None:
+        env["XGBOOST_TPU_FAULT_PLAN"] = fault_plan
     import time
 
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _CHILD, str(rank), str(num_workers),
-             str(port), platform or "", fn_path, mod_dir],
+             str(port), platform or "", fn_path, mod_dir, rendezvous],
             env=env)
         for rank in range(num_workers)
     ]
     try:
         deadline = time.monotonic() + timeout
         errs = []
+        rcs = {}
         pending = dict(enumerate(procs))
         while pending:
             for rank, p in list(pending.items()):
@@ -103,13 +143,18 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
                 del pending[rank]
                 if rc != 0:
                     errs.append(rank)
+                    rcs[rank] = rc
             if errs:
                 # fail fast: peers would otherwise block in rendezvous or a
                 # collective forever, waiting for the dead worker
                 for p in pending.values():
                     p.kill()
-                raise RuntimeError(f"worker(s) {errs} exited non-zero; "
-                                   "remaining workers killed")
+                detail = ", ".join(
+                    f"rank {r}: " + ("aborted by tracker fan-out"
+                                     if rcs[r] == 255 else f"exit {rcs[r]}")
+                    for r in errs)
+                raise RuntimeError(f"worker(s) {errs} exited non-zero "
+                                   f"({detail}); remaining workers killed")
             if pending and time.monotonic() > deadline:
                 for p in pending.values():
                     p.kill()
@@ -119,6 +164,8 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
             if pending:
                 time.sleep(0.2)
     finally:
+        if tracker is not None:
+            tracker.free()
         try:
             os.unlink(fn_path)
         except OSError:

@@ -28,6 +28,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4 -> 0.5+
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 # sweep overrides (scripts/pallas_hw_sweep.py); None = VMEM-budget autotune
 _ROW_TILE = None
 _FEAT_GROUP = None
@@ -150,7 +154,7 @@ def build_histogram_pallas(bins, gpair, pos, *, node0: int, n_nodes: int,
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct((F_pad, n_bin, 2 * n_nodes), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -253,7 +257,7 @@ def build_histogram_pallas_q(bins, gq, pos, *, node0: int, n_nodes: int,
         ),
         out_shape=jax.ShapeDtypeStruct((F_pad, n_bin, n_ch * n_nodes),
                                        jnp.int32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from .. import collective
 from ..ops.histogram import combine_sibling_hists
+from ..reliability.faults import maybe_inject
 from ..ops.split import SplitParams
 from ..tree.grow import (TreeState, init_tree_state, make_set_matrix,
                          max_nodes_for_depth)
@@ -117,6 +118,11 @@ class ProcessHistTreeGrower:
             )
             state = state._replace(pos=pos)
             if build:
+                # seam: the per-level histogram exchange — delay a rank
+                # (straggler), raise (failed allreduce -> signal_error),
+                # or kill (death inside the collective, the case the
+                # tracker's EOF abort fan-out exists for)
+                maybe_inject("process.allreduce", rank=collective.get_rank)
                 # the one cross-process exchange per level (AllReduceHist);
                 # quantised: limbs reduce in int64 on host — exact, so the
                 # exchange is order-invariant (integer-rabit role)

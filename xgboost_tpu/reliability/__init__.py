@@ -1,0 +1,46 @@
+"""xgboost_tpu.reliability — fault tolerance for training and serving.
+
+Long boosting runs and always-on serving both assume workers die and come
+back (the Rabit elastic model; "Out-of-Core GPU Gradient Boosting",
+arXiv:2005.09148, is hours of wall-clock per model).  This package holds
+the three pieces that make that survivable:
+
+- **Checkpoints** (checkpoint.py): :class:`CheckpointCallback` atomically
+  persists Booster + training state every N rounds (tmp + fsync + rename,
+  keep-last-K, checksum-validated fallback past corrupt files);
+  ``train(..., resume_from=dir)`` continues bit-identically.
+- **Retry/backoff** (retry.py): :func:`retry_call`, exponential backoff
+  with deterministic per-rank jitter — tracker connect and the
+  jax.distributed rendezvous go through it; retries count into
+  ``xtb_retries_total``.
+- **Fault injection** (faults.py): a deterministic, env/config-driven
+  plan (kill rank k at round r, drop the tracker connection, delay or fail
+  an allreduce, truncate a checkpoint) fired at named seams in training,
+  the collective, the tracker, and the serving batcher — the harness the
+  kill/resume and abort fan-out tests drive.  Fired faults count into
+  ``xtb_faults_injected_total``.
+
+docs/reliability.md is the guide (checkpoint format, resume semantics,
+fault-plan schema, serving degradation behavior).
+"""
+from __future__ import annotations
+
+from . import faults
+from .checkpoint import (CheckpointCallback, CheckpointManager,
+                         CheckpointState, latest_checkpoint)
+from .faults import FaultInjected, FaultPlan, FaultSpec
+from .retry import RetriesExhausted, backoff_delays, retry_call
+
+__all__ = [
+    "CheckpointCallback",
+    "CheckpointManager",
+    "CheckpointState",
+    "latest_checkpoint",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultSpec",
+    "faults",
+    "RetriesExhausted",
+    "backoff_delays",
+    "retry_call",
+]

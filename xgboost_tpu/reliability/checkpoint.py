@@ -1,0 +1,318 @@
+"""Crash-safe training checkpoints: atomic write, keep-last-K, corruption
+fallback, and the :class:`CheckpointCallback` / resume glue.
+
+The failure model is the Rabit lineage's (XGBoost paper §5: workers die and
+come back; recovery = last committed model + round counter): a worker can be
+killed at ANY instruction — including halfway through writing a checkpoint —
+and a relaunch must find a usable snapshot.  Three mechanisms:
+
+1. **Atomic commit.**  Each checkpoint is written to a same-directory temp
+   file, flushed, ``fsync``-ed, then ``os.replace``-d into place (and the
+   directory fsync-ed), so a crash leaves either the old set or the new
+   file, never a half-written one under the final name.
+2. **Self-validating format.**  ``XTBCKPT1`` magic + length-prefixed JSON
+   meta + the ``Booster.serialize()`` payload + a trailing SHA-256 over
+   everything before it.  Truncation, bit rot, or a torn write all fail the
+   checksum and the file is *skipped with a warning*, not trusted.
+3. **Keep-last-K fallback.**  ``load_latest`` walks newest → oldest and
+   returns the first valid snapshot, so one corrupt file costs K-1 rounds
+   of progress, not the run.
+
+What a checkpoint carries is the full *training* state, not just the model:
+the serialized Booster (model + config), the completed-round counter, the
+eval history, and per-callback state (e.g. EarlyStopping's best/patience),
+so ``train(..., resume_from=dir)`` continues bit-identically to a run that
+was never interrupted (tests/test_reliability.py holds the parity).
+
+Telemetry: ``xtb_checkpoint_seconds`` (write latency histogram),
+``xtb_checkpoints_total``, ``xtb_checkpoint_corrupt_total``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import struct
+import tempfile
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..callback import TrainingCallback
+from . import faults
+
+__all__ = ["CheckpointManager", "CheckpointCallback", "CheckpointState",
+           "latest_checkpoint", "collect_callback_state",
+           "restore_callback_state"]
+
+_MAGIC = b"XTBCKPT1"
+_SUFFIX = ".xtbckpt"
+_DIGEST = hashlib.sha256
+_DIGEST_LEN = 32
+
+_instruments = None  # (seconds hist, saved counter, corrupt counter)
+
+
+def _ins():
+    global _instruments
+    if _instruments is None:
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        _instruments = (
+            reg.histogram("xtb_checkpoint_seconds",
+                          "checkpoint write latency"),
+            reg.counter("xtb_checkpoints_total", "checkpoints committed"),
+            reg.counter("xtb_checkpoint_corrupt_total",
+                        "invalid checkpoint files skipped at load"),
+        )
+    return _instruments
+
+
+@dataclasses.dataclass
+class CheckpointState:
+    """One decoded checkpoint."""
+
+    round: int                      # completed boosting rounds
+    booster_bytes: bytes            # Booster.serialize() payload
+    history: Dict[str, Any]         # CallbackContainer.history at save time
+    callback_state: Dict[str, Any]  # {"ClassName@i": state_dict()}
+    path: str = ""
+
+
+def _encode(state: CheckpointState) -> bytes:
+    meta = json.dumps({
+        "version": 1,
+        "round": int(state.round),
+        "booster_len": len(state.booster_bytes),
+        "history": state.history,
+        "callback_state": state.callback_state,
+    }).encode()
+    body = (_MAGIC + struct.pack(">I", len(meta)) + meta
+            + bytes(state.booster_bytes))
+    return body + _DIGEST(body).digest()
+
+
+def _decode(blob: bytes, path: str = "") -> CheckpointState:
+    """Raises ValueError on ANY structural or integrity problem."""
+    if len(blob) < len(_MAGIC) + 4 + _DIGEST_LEN:
+        raise ValueError("checkpoint too short")
+    if blob[: len(_MAGIC)] != _MAGIC:
+        raise ValueError("bad checkpoint magic")
+    body, digest = blob[:-_DIGEST_LEN], blob[-_DIGEST_LEN:]
+    if _DIGEST(body).digest() != digest:
+        raise ValueError("checkpoint checksum mismatch")
+    (meta_len,) = struct.unpack(">I", blob[len(_MAGIC): len(_MAGIC) + 4])
+    meta_start = len(_MAGIC) + 4
+    if meta_start + meta_len > len(body):
+        raise ValueError("checkpoint meta length out of range")
+    meta = json.loads(body[meta_start: meta_start + meta_len].decode())
+    booster = body[meta_start + meta_len:]
+    if len(booster) != int(meta["booster_len"]):
+        raise ValueError("checkpoint booster payload length mismatch")
+    return CheckpointState(
+        round=int(meta["round"]), booster_bytes=booster,
+        history=meta.get("history", {}),
+        callback_state=meta.get("callback_state", {}), path=path)
+
+
+class CheckpointManager:
+    """Atomic keep-last-K checkpoint files under one directory."""
+
+    def __init__(self, directory: str, keep_last: int = 3) -> None:
+        self.directory = os.fspath(directory)
+        self.keep_last = max(int(keep_last), 1)
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -------------------------------------------------------------- write
+    def _path(self, round: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{round:08d}{_SUFFIX}")
+
+    def save(self, state: CheckpointState) -> str:
+        """Atomically commit ``state`` as the round-``state.round``
+        checkpoint and prune beyond ``keep_last``.  Returns the path."""
+        t0 = time.perf_counter()
+        blob = _encode(state)
+        final = self._path(state.round)
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                os.fsync(fh.fileno())
+            # fault seam: a torn write — the file commits under its final
+            # name but the tail never hit the disk (what a crash between
+            # write() and fsync() can leave on weaker filesystems); the
+            # checksum makes load_latest skip it
+            spec = faults.maybe_inject("checkpoint.write", round=state.round)
+            if spec is not None and spec.kind == "truncate":
+                keep = (spec.keep_bytes if spec.keep_bytes is not None
+                        else len(blob) // 2)
+                with open(tmp, "r+b") as fh:
+                    fh.truncate(max(int(keep), 0))
+            os.replace(tmp, final)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self._fsync_dir()
+        self.prune()
+        hist, saved, _corrupt = _ins()
+        hist.observe(time.perf_counter() - t0)
+        saved.inc()
+        return final
+
+    def _fsync_dir(self) -> None:
+        try:
+            dfd = os.open(self.directory, os.O_RDONLY)
+        except OSError:  # platform without directory fds
+            return
+        try:
+            os.fsync(dfd)
+        except OSError:
+            pass
+        finally:
+            os.close(dfd)
+
+    def prune(self) -> None:
+        for path in self.files()[: -self.keep_last]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # --------------------------------------------------------------- read
+    def files(self) -> List[str]:
+        """Checkpoint paths sorted oldest → newest by round number."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            if name.startswith("ckpt_") and name.endswith(_SUFFIX):
+                out.append(os.path.join(self.directory, name))
+        return sorted(out)
+
+    def load_latest(self) -> Optional[CheckpointState]:
+        """Newest VALID checkpoint, or None.  Corrupt/truncated/zero-byte
+        files are skipped with a warning (and counted), falling back to the
+        next-newest — the keep-last-K contract."""
+        for path in reversed(self.files()):
+            try:
+                with open(path, "rb") as fh:
+                    state = _decode(fh.read(), path=path)
+                state.path = path
+                return state
+            except (OSError, ValueError, KeyError, TypeError,
+                    json.JSONDecodeError, struct.error,
+                    UnicodeDecodeError) as e:
+                _ins()[2].inc()
+                warnings.warn(
+                    f"skipping invalid checkpoint {path!r}: {e}",
+                    RuntimeWarning, stacklevel=2)
+        return None
+
+
+def latest_checkpoint(directory: str) -> Optional[CheckpointState]:
+    """Newest valid checkpoint under ``directory`` (None when the directory
+    is missing or holds no usable checkpoint)."""
+    if not os.path.isdir(directory):
+        return None
+    return CheckpointManager(directory).load_latest()
+
+
+# ---------------------------------------------------------------------------
+# callback-state capture/restore (EarlyStopping best/patience etc.)
+# ---------------------------------------------------------------------------
+
+
+def _state_keys(callbacks: Sequence[TrainingCallback]
+                ) -> List[Tuple[str, TrainingCallback]]:
+    """Stable per-run keys: class name + index among same-class callbacks
+    (train() rebuilds the same callback list on relaunch, so keys line up)."""
+    seen: Dict[str, int] = {}
+    out = []
+    for cb in callbacks:
+        name = type(cb).__name__
+        idx = seen.get(name, 0)
+        seen[name] = idx + 1
+        out.append((f"{name}@{idx}", cb))
+    return out
+
+
+def collect_callback_state(callbacks: Sequence[TrainingCallback]
+                           ) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, cb in _state_keys(callbacks):
+        fn = getattr(cb, "state_dict", None)
+        if fn is None:
+            continue
+        state = fn()
+        if state is not None:
+            out[key] = state
+    return out
+
+
+def restore_callback_state(callbacks: Sequence[TrainingCallback],
+                           saved: Dict[str, Any]) -> None:
+    for key, cb in _state_keys(callbacks):
+        state = saved.get(key)
+        fn = getattr(cb, "load_state", None)
+        if state is not None and fn is not None:
+            fn(state)
+
+
+class CheckpointCallback(TrainingCallback):
+    """Persist the Booster + training state every ``interval`` rounds.
+
+    Unlike :class:`~xgboost_tpu.callback.TrainingCheckPoint` (model-only,
+    non-atomic, unbounded file count), this writes the crash-safe format
+    above and is the counterpart of ``train(..., resume_from=dir)``.  Under
+    multi-process training only rank 0 writes by default — trees are
+    bitwise-identical across ranks, so one snapshot serves every worker on
+    a shared filesystem (the Rabit CheckPoint contract)."""
+
+    # train() dispatches run-last callbacks after the rest: the snapshot
+    # must capture THIS round's EarlyStopping decision (best/patience) and
+    # booster attrs, not last round's — train() appends EarlyStopping
+    # after user callbacks, so without the reorder a resume would replay
+    # a one-round-stale stopping state
+    _run_last = True
+
+    def __init__(self, directory: str, interval: int = 1,
+                 keep_last: int = 3, only_rank0: bool = True) -> None:
+        self.manager = CheckpointManager(directory, keep_last=keep_last)
+        self.interval = max(int(interval), 1)
+        self.only_rank0 = only_rank0
+        self.last_saved_round: Optional[int] = None
+        self._container = None  # bound by train() for history + peer state
+
+    def _bind_container(self, container) -> None:
+        self._container = container
+
+    def after_iteration(self, model, epoch: int, evals_log) -> bool:
+        if (epoch + 1) % self.interval:
+            return False
+        if self.only_rank0:
+            from .. import collective
+
+            if collective.get_rank() != 0:
+                return False
+        if not hasattr(model, "serialize"):  # cv aggregate stand-in
+            return False
+        peers = (self._container.callbacks if self._container is not None
+                 else [self])
+        state = CheckpointState(
+            round=model.num_boosted_rounds(),
+            booster_bytes=bytes(model.serialize()),
+            history=evals_log if evals_log is not None else {},
+            callback_state=collect_callback_state(
+                [cb for cb in peers if cb is not self]),
+        )
+        self.manager.save(state)
+        self.last_saved_round = state.round
+        return False

@@ -1,0 +1,227 @@
+"""Deterministic fault-injection harness.
+
+Training, the collective, the tracker, and the serving batcher all expose
+*seams* — named call sites (``train.round``, ``collective.allreduce``,
+``tracker.connect``, ``tracker.connected``, ``process.allreduce``,
+``checkpoint.write``, ``serve.worker``) that consult an installed
+:class:`FaultPlan` before doing their real work.  A plan is a list of fault
+specs, each matching a seam by name plus optional ``rank`` / ``round`` /
+``at`` (the Nth invocation of that seam in this process) and firing at most
+``times`` times.  Because every matcher is an explicit value and invocation
+counters advance with program order, a plan replays identically run after
+run — the property the kill/resume parity tests rely on.
+
+Kinds:
+
+- ``kill``       — ``os._exit(exit_code)``: a hard worker death (SIGKILL
+  moral equivalent; no finalizers, no tracker shutdown message).
+- ``exception``  — raise :class:`FaultInjected` at the seam.
+- ``delay``      — sleep ``seconds`` then continue (slow-peer simulation).
+- ``drop_connection`` / ``truncate`` — returned to the caller, which owns
+  the resource being damaged (the tracker client closes its socket, the
+  checkpoint writer truncates the file).
+
+Plans install programmatically (``install(...)``) or through the
+``XGBOOST_TPU_FAULT_PLAN`` environment variable — either inline JSON or a
+path to a JSON file — so spawned worker subprocesses inherit the plan with
+no extra wiring.  With no plan installed every seam is a single module-
+attribute check.
+
+Every fired fault counts into ``xtb_faults_injected_total{site,kind}``
+(telemetry registry), so a test can assert not just the failure's effect
+but that the harness — not an unrelated bug — caused it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Union
+
+__all__ = ["FaultInjected", "FaultSpec", "FaultPlan", "install", "clear",
+           "active", "maybe_inject", "ENV_VAR"]
+
+ENV_VAR = "XGBOOST_TPU_FAULT_PLAN"
+
+_KINDS = ("kill", "exception", "delay", "drop_connection", "truncate")
+
+
+class FaultInjected(RuntimeError):
+    """Raised at a seam by an ``exception`` fault spec."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One planned fault.  ``site`` and ``kind`` are required; the rest are
+    matchers/parameters (``None`` = match any)."""
+
+    site: str
+    kind: str
+    rank: Optional[int] = None       # fire only on this worker rank
+    round: Optional[int] = None      # fire only at this training round
+    at: Optional[int] = None         # fire only on the Nth seam hit (0-based)
+    times: int = 1                   # fire at most this many times
+    seconds: float = 0.0             # delay duration
+    exit_code: int = 43              # kill exit status
+    keep_bytes: Optional[int] = None  # truncate: bytes to keep (None = half)
+    message: str = "injected fault"
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"expected one of {_KINDS}")
+
+    def matches(self, invocation: int, rank: Optional[int],
+                round: Optional[int]) -> bool:
+        if self.at is not None and invocation != self.at:
+            return False
+        if self.round is not None and round != self.round:
+            return False
+        if self.rank is not None and rank != self.rank:
+            return False
+        return True
+
+
+class FaultPlan:
+    """An installed set of :class:`FaultSpec` plus per-site invocation and
+    per-spec trigger counters (all process-local, lock-guarded)."""
+
+    def __init__(self, specs: List[FaultSpec]) -> None:
+        self.specs = list(specs)
+        self._fired: Dict[int, int] = {}    # spec index -> times fired
+        self._calls: Dict[str, int] = {}    # site -> invocation counter
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_dict(cls, obj: Union[dict, list]) -> "FaultPlan":
+        raw = obj.get("faults", []) if isinstance(obj, dict) else obj
+        specs = []
+        for f in raw:
+            known = {fld.name for fld in dataclasses.fields(FaultSpec)}
+            unknown = set(f) - known
+            if unknown:
+                raise ValueError(f"unknown fault-spec keys {sorted(unknown)}")
+            specs.append(FaultSpec(**f))
+        return cls(specs)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(text))
+
+    def fired(self, site: Optional[str] = None) -> int:
+        """Total faults fired (optionally only at ``site``)."""
+        with self._lock:
+            return sum(n for i, n in self._fired.items()
+                       if site is None or self.specs[i].site == site)
+
+    def _claim(self, site: str, rank, round) -> Optional[FaultSpec]:
+        """Match-and-count under the lock; returns the spec to fire."""
+        with self._lock:
+            inv = self._calls.get(site, 0)
+            self._calls[site] = inv + 1
+            for i, spec in enumerate(self.specs):
+                if spec.site != site:
+                    continue
+                if self._fired.get(i, 0) >= spec.times:
+                    continue
+                if spec.matches(inv, rank, round):
+                    self._fired[i] = self._fired.get(i, 0) + 1
+                    return spec
+        return None
+
+
+# ---------------------------------------------------------------------------
+# module-level installation (env-driven or programmatic)
+# ---------------------------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+_ENV_CHECKED = False
+_counter = None  # xtb_faults_injected_total family, created lazily
+
+
+def install(plan: Union[FaultPlan, dict, list, str, None]) -> Optional[FaultPlan]:
+    """Install a fault plan process-wide (dict/list/JSON accepted); ``None``
+    clears.  Returns the installed :class:`FaultPlan`."""
+    global _PLAN, _ENV_CHECKED
+    if plan is None:
+        _PLAN = None
+    elif isinstance(plan, FaultPlan):
+        _PLAN = plan
+    elif isinstance(plan, str):
+        _PLAN = FaultPlan.from_json(plan)
+    else:
+        _PLAN = FaultPlan.from_dict(plan)
+    _ENV_CHECKED = True  # programmatic install wins over the env var
+    return _PLAN
+
+
+def clear() -> None:
+    """Remove the installed plan AND forget the env var was consumed, so a
+    test that mutates ``XGBOOST_TPU_FAULT_PLAN`` gets a fresh load."""
+    global _PLAN, _ENV_CHECKED
+    _PLAN = None
+    _ENV_CHECKED = False
+
+
+def active() -> Optional[FaultPlan]:
+    """The installed plan, loading ``XGBOOST_TPU_FAULT_PLAN`` on first use
+    (inline JSON, or a path to a JSON file).  None when fault injection is
+    off — the common case, and the only cost every seam pays."""
+    global _PLAN, _ENV_CHECKED
+    if _PLAN is None and not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        raw = os.environ.get(ENV_VAR, "").strip()
+        if raw:
+            if not raw.lstrip().startswith(("{", "[")):
+                with open(raw) as fh:
+                    raw = fh.read()
+            _PLAN = FaultPlan.from_json(raw)
+    return _PLAN
+
+
+def _count(site: str, kind: str) -> None:
+    global _counter
+    if _counter is None:
+        from ..telemetry.registry import get_registry
+
+        _counter = get_registry().counter(
+            "xtb_faults_injected_total", "faults fired by the injection "
+            "harness", ("site", "kind"))
+    _counter.labels(site, kind).inc()
+
+
+def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
+                 ) -> Optional[FaultSpec]:
+    """Seam entry point.  ``rank`` may be an int or a zero-arg callable
+    (resolved only when some spec for this site constrains rank, so seams
+    can pass ``collective.get_rank`` without paying for it when unused).
+    Applies ``kill``/``exception``/``delay`` here; returns the spec for
+    caller-applied kinds (``drop_connection``, ``truncate``) and for
+    ``delay`` (so callers can log), else None."""
+    plan = _PLAN  # fast path: installed-plan check is one global read
+    if plan is None:
+        plan = active()
+        if plan is None:
+            return None
+    if callable(rank) and any(s.site == site and s.rank is not None
+                              for s in plan.specs):
+        rank = rank()
+    elif callable(rank):
+        rank = None
+    spec = plan._claim(site, rank, round)
+    if spec is None:
+        return None
+    _count(site, spec.kind)
+    if spec.kind == "kill":
+        import sys
+
+        print(f"[faults] kill at {site} (rank={rank} round={round}): "
+              f"{spec.message}", file=sys.stderr, flush=True)
+        os._exit(spec.exit_code)
+    if spec.kind == "exception":
+        raise FaultInjected(f"{site}: {spec.message}")
+    if spec.kind == "delay":
+        time.sleep(spec.seconds)
+    return spec

@@ -1,0 +1,94 @@
+"""Exponential backoff with deterministic jitter.
+
+The repo's one retry policy: tracker worker connect, the jax.distributed
+rendezvous, and anything else that races a peer's startup go through
+:func:`retry_call` instead of hand-rolled sleep loops.  Jitter matters —
+N workers retrying in lockstep re-collide on every attempt (the thundering
+herd the reference's ``kRetry`` backoff also staggers) — but *random*
+jitter would make distributed runs unreproducible, so the jitter here is
+drawn from a generator seeded by ``(op, seed)``: different ranks passing
+their rank as ``seed`` de-synchronize, while the same rank replays the
+same schedule every run.
+
+Every retry (not the first attempt) counts into
+``xtb_retries_total{op=...}`` so a healthy-looking job that is quietly
+reconnecting in a loop shows up in telemetry.
+"""
+from __future__ import annotations
+
+import random
+import time
+import zlib
+from typing import Callable, Iterator, Optional, Tuple, Type, TypeVar
+
+__all__ = ["backoff_delays", "retry_call", "RetriesExhausted"]
+
+T = TypeVar("T")
+
+_counter = None  # xtb_retries_total family, created lazily
+
+
+class RetriesExhausted(RuntimeError):
+    """All attempts failed; ``__cause__`` is the last underlying error."""
+
+
+def _count_retry(op: str) -> None:
+    global _counter
+    if _counter is None:
+        from ..telemetry.registry import get_registry
+
+        _counter = get_registry().counter(
+            "xtb_retries_total", "retried operations (attempts after the "
+            "first)", ("op",))
+    _counter.labels(op).inc()
+
+
+def backoff_delays(retries: int, *, base: float = 0.05, factor: float = 2.0,
+                   max_delay: float = 10.0, jitter: float = 0.25,
+                   op: str = "op", seed: int = 0) -> Iterator[float]:
+    """Yield ``retries`` sleep durations: ``base * factor**i`` capped at
+    ``max_delay``, each scaled by a deterministic factor in
+    ``[1-jitter, 1+jitter]`` drawn from a ``(op, seed)``-seeded RNG."""
+    rng = random.Random(zlib.crc32(op.encode()) ^ (seed * 0x9E3779B1))
+    for i in range(retries):
+        d = min(base * (factor ** i), max_delay)
+        if jitter:
+            d *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
+        yield d
+
+
+def retry_call(fn: Callable[[], T], *, op: str, retries: int = 5,
+               base: float = 0.05, factor: float = 2.0,
+               max_delay: float = 10.0, jitter: float = 0.25, seed: int = 0,
+               retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+               retry_if: Optional[Callable[[BaseException], bool]] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               on_retry: Optional[Callable[[int, BaseException], None]] = None,
+               ) -> T:
+    """Call ``fn`` with up to ``retries`` backed-off re-attempts on
+    ``retry_on`` exceptions.  Raises :class:`RetriesExhausted` (chained to
+    the last error) when every attempt fails; any exception outside
+    ``retry_on`` propagates immediately — only the failure modes the caller
+    declared transient are retried.  ``retry_if`` further narrows within
+    ``retry_on`` (e.g. broad RuntimeErrors filtered by message): an
+    exception failing the predicate propagates unwrapped, immediately —
+    retrying a permanent failure only buries the real error under backoff."""
+    delays = backoff_delays(retries, base=base, factor=factor,
+                            max_delay=max_delay, jitter=jitter, op=op,
+                            seed=seed)
+    last: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except retry_on as e:
+            if retry_if is not None and not retry_if(e):
+                raise
+            last = e
+            if attempt >= retries:
+                break
+            _count_retry(op)
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(next(delays))
+    raise RetriesExhausted(
+        f"{op}: all {retries + 1} attempts failed: {last}") from last

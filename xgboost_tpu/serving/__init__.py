@@ -22,7 +22,7 @@ Quick start::
     probs = eng.predict("ctr", rows)        # N threads may call this
     print(eng.metrics_snapshot()["models"]["ctr"]["latency_ms"])
 """
-from .batcher import MicroBatcher
+from .batcher import MicroBatcher, QueueFullError, WorkerDiedError
 from .engine import ServeConfig, ServingEngine
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
@@ -35,4 +35,6 @@ __all__ = [
     "InferenceSnapshot",
     "MicroBatcher",
     "ServingMetrics",
+    "WorkerDiedError",
+    "QueueFullError",
 ]

@@ -14,6 +14,15 @@ A single worker thread executes all batches.  That is deliberate: the JAX/C
 ABI dispatch path serializes on the interpreter anyway (docs/serving.md), so
 extra executor threads would only add context switches; ordering through one
 worker also keeps results deterministic.
+
+Failure contract (docs/reliability.md): the worker thread dying must never
+wedge callers.  ``submit()`` probes worker liveness and raises
+:class:`WorkerDiedError` (chained to the original worker exception) instead
+of returning a future nobody will resolve; a worker that dies with requests
+queued fails every pending future on its way down.  ``max_queue_rows``
+bounds the queue — beyond it ``submit()`` sheds with :class:`QueueFullError`
+(counted in ``xtb_serve_shed_total``) so an overloaded engine degrades by
+rejecting fast, not by growing an unbounded backlog.
 """
 from __future__ import annotations
 
@@ -21,11 +30,25 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import Any, Callable, Deque, Dict, List
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 import numpy as np
 
+from ..reliability import faults as _faults
 from ..telemetry import spans as _spans
+
+
+class WorkerDiedError(RuntimeError):
+    """The batcher worker thread is not running; ``__cause__`` carries the
+    exception that killed it (when one escaped)."""
+
+
+class QueueFullError(RuntimeError):
+    """Request shed: admitting it would exceed ``max_queue_rows``."""
+
+
+def _key_label(key: Any) -> str:
+    return key[0] if isinstance(key, tuple) else str(key)
 
 
 class _Request:
@@ -48,27 +71,51 @@ class MicroBatcher:
 
     def __init__(self, execute: Callable[[Any, np.ndarray, Any], np.ndarray],
                  *, max_batch: int = 4096, max_delay_us: int = 2000,
-                 metrics=None) -> None:
+                 max_queue_rows: Optional[int] = None, metrics=None) -> None:
         self._execute = execute
         self.max_batch = int(max_batch)
         self.max_delay_ns = int(max_delay_us) * 1000
+        self.max_queue_rows = (int(max_queue_rows)
+                               if max_queue_rows is not None else None)
         self._metrics = metrics
         self._queues: Dict[Any, Deque[_Request]] = {}
         self._rows: Dict[Any, int] = {}  # running per-key queued-row counts
+        self._total_rows = 0             # across all keys (shed bound)
         self._cv = threading.Condition()
         self._closed = False
+        self._worker_exc: Optional[BaseException] = None
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="xtb-serve-batcher")
         self._worker.start()
 
     # ------------------------------------------------------------------ API
+    def worker_alive(self) -> bool:
+        return self._worker.is_alive() and self._worker_exc is None
+
     def submit(self, key: Any, X: np.ndarray, ctx: Any = None) -> Future:
         req = _Request(X, ctx)
         with self._cv:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
+            if not self.worker_alive():
+                # fail fast with the REAL cause — returning a future no
+                # worker will ever resolve blocks the caller forever
+                raise WorkerDiedError(
+                    "micro-batcher worker thread is not running"
+                ) from self._worker_exc
+            if (self.max_queue_rows is not None
+                    and self._total_rows + len(X) > self.max_queue_rows
+                    and self._total_rows > 0):
+                # shed under overload (a single oversized request with an
+                # empty queue is still admitted — it must be servable)
+                if self._metrics is not None:
+                    self._metrics.observe_shed(_key_label(key))
+                raise QueueFullError(
+                    f"queue full: {self._total_rows} rows waiting "
+                    f"(max_queue_rows={self.max_queue_rows})")
             self._queues.setdefault(key, deque()).append(req)
             self._rows[key] = self._rows.get(key, 0) + len(X)
+            self._total_rows += len(X)
             if self._metrics is not None:
                 self._metrics.queue_delta(len(X))
             self._cv.notify()
@@ -82,21 +129,33 @@ class MicroBatcher:
 
     # ---------------------------------------------------------------- worker
     def _drain(self, key: Any) -> List[_Request]:
-        """Pop FIFO requests up to max_batch rows (always at least one, so an
-        oversized single request still runs as its own batch)."""
+        """Pop FIFO requests up to max_batch rows (always at least one live
+        request, so an oversized single request still runs as its own
+        batch).  Requests whose future was cancelled — a caller that gave
+        up at its deadline — are discarded without consuming batch budget:
+        executing them would burn device time producing results nobody
+        reads, falling further behind and timing out MORE callers (may
+        return an empty batch when everything queued was abandoned)."""
         q = self._queues[key]
-        batch, rows = [], 0
-        while q and (not batch or rows + len(q[0].X) <= self.max_batch):
+        batch, popped, batch_rows = [], 0, 0
+        while q:
+            if q[0].future.cancelled():
+                popped += len(q.popleft().X)
+                continue
+            if batch and batch_rows + len(q[0].X) > self.max_batch:
+                break
             r = q.popleft()
             batch.append(r)
-            rows += len(r.X)
+            popped += len(r.X)
+            batch_rows += len(r.X)
         if q:
-            self._rows[key] -= rows
+            self._rows[key] -= popped
         else:
             del self._queues[key]
             del self._rows[key]
+        self._total_rows -= popped
         if self._metrics is not None:
-            self._metrics.queue_delta(-rows)
+            self._metrics.queue_delta(-popped)
         return batch
 
     def _run_batch(self, key: Any, batch: List[_Request]) -> None:
@@ -117,9 +176,8 @@ class MicroBatcher:
             out = self._execute(key, X, batch[0].ctx)
             exec_ns = time.perf_counter_ns() - t0
             if self._metrics is not None:
-                label = key[0] if isinstance(key, tuple) else str(key)
-                self._metrics.observe_batch(label, len(X), len(batch),
-                                            exec_ns)
+                self._metrics.observe_batch(_key_label(key), len(X),
+                                            len(batch), exec_ns)
         except BaseException as e:  # fan the failure out to every caller
             for r in batch:
                 if not r.future.set_running_or_notify_cancel():
@@ -134,7 +192,36 @@ class MicroBatcher:
             off += n
 
     def _loop(self) -> None:
+        try:
+            self._loop_impl()
+        except BaseException as e:
+            self._on_worker_death(e)
+
+    def _on_worker_death(self, exc: BaseException) -> None:
+        """The sole worker is gone: record why, fail every queued request
+        (their futures would otherwise never resolve), wake everyone."""
+        with self._cv:
+            self._worker_exc = exc
+            pending = [r for q in self._queues.values() for r in q]
+            drained = self._total_rows
+            self._queues.clear()
+            self._rows.clear()
+            self._total_rows = 0
+            if self._metrics is not None and drained:
+                self._metrics.queue_delta(-drained)
+            self._cv.notify_all()
+        err = WorkerDiedError("micro-batcher worker died with requests "
+                              "queued")
+        err.__cause__ = exc
+        for r in pending:
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(err)
+
+    def _loop_impl(self) -> None:
         while True:
+            # seam: 'exception' here IS a worker-thread death — the
+            # deterministic stand-in for a bug escaping _loop_impl
+            _faults.maybe_inject("serve.worker")
             with self._cv:
                 while True:
                     # scan EVERY key: a queue that reached max_batch launches
@@ -158,6 +245,8 @@ class MicroBatcher:
                             earliest = deadline
                     if key is not None:
                         batch = self._drain(key)
+                        if not batch:  # all abandoned: rescan, don't execute
+                            continue
                         break
                     if earliest is None:  # nothing queued at all
                         if self._closed:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Optional, Tuple
 
 import numpy as np
@@ -56,10 +57,21 @@ class ServeConfig:
     warmup_buckets: Optional[Tuple[int, ...]] = None
     use_batcher: bool = True     # False = every predict() runs inline
     donate_buffers: bool = True  # donate scratch on non-CPU backends
+    # degradation knobs (docs/reliability.md): a per-request deadline in
+    # seconds (None = wait forever) — predict() raises TimeoutError instead
+    # of outliving its SLO on a slow/stuck batch; and a bound on queued rows
+    # — beyond it submit() sheds (QueueFullError, xtb_serve_shed_total)
+    # instead of growing an unbounded backlog
+    request_timeout_s: Optional[float] = None
+    max_queue_rows: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.max_batch < 1 or self.max_delay_us < 0:
             raise ValueError("max_batch >= 1 and max_delay_us >= 0 required")
+        if self.request_timeout_s is not None and self.request_timeout_s <= 0:
+            raise ValueError("request_timeout_s must be positive or None")
+        if self.max_queue_rows is not None and self.max_queue_rows < 1:
+            raise ValueError("max_queue_rows must be >= 1 or None")
 
     def resolved_warmup_buckets(self) -> Tuple[int, ...]:
         if self.warmup_buckets is not None:
@@ -135,6 +147,7 @@ class ServingEngine:
         self._batcher: Optional[MicroBatcher] = (
             MicroBatcher(self._execute, max_batch=config.max_batch,
                          max_delay_us=config.max_delay_us,
+                         max_queue_rows=config.max_queue_rows,
                          metrics=self.metrics)
             if config.use_batcher else None)
         self._warming = 0  # >0 while warmup() runs (attributes its compiles)
@@ -216,8 +229,20 @@ class ServingEngine:
             if direct or self._batcher is None:
                 out = self._execute(key, Xn, (snap, output_margin))
             else:
-                out = self._batcher.submit(key, Xn,
-                                           (snap, output_margin)).result()
+                fut = self._batcher.submit(key, Xn, (snap, output_margin))
+                try:
+                    out = fut.result(timeout=self.config.request_timeout_s)
+                except FuturesTimeout:
+                    # deadline expired: abandon the request (cancel if it
+                    # has not launched) and raise within the SLO window
+                    # rather than hang on a stuck batch
+                    fut.cancel()
+                    self.metrics.observe_deadline(name)
+                    raise TimeoutError(
+                        f"predict({name!r}) missed its "
+                        f"{self.config.request_timeout_s}s deadline "
+                        f"(worker alive: {self._batcher.worker_alive()})"
+                    ) from None
         except BaseException:
             self.metrics.observe_error(name)
             raise

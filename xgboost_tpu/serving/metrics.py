@@ -59,6 +59,12 @@ class _Instruments:
         self.batches = reg.counter(
             "xtb_serve_batches_total", "coalesced batches executed",
             ("model",))
+        self.shed = reg.counter(
+            "xtb_serve_shed_total",
+            "requests shed at admission (queue full)", ("model",))
+        self.deadline = reg.counter(
+            "xtb_serve_deadline_total",
+            "requests abandoned at their deadline", ("model",))
         self.exec_seconds = reg.counter(
             "xtb_serve_exec_seconds_total",
             "device-execute seconds (batch granularity)", ("model",))
@@ -91,14 +97,18 @@ class _Instruments:
 class _ModelStats:
     __slots__ = ("requests", "rows", "errors", "batches", "batch_hist",
                  "lat_ns", "lat_idx", "lat_n", "exec_ns", "batched_rows",
+                 "shed", "deadline",
                  "reg_requests", "reg_rows", "reg_errors", "reg_batches",
-                 "reg_exec_seconds", "reg_batch_rows", "reg_latency")
+                 "reg_exec_seconds", "reg_batch_rows", "reg_latency",
+                 "reg_shed", "reg_deadline")
 
     def __init__(self, name: str, instruments: _Instruments) -> None:
         self.requests = 0
         self.rows = 0
         self.errors = 0
         self.batches = 0
+        self.shed = 0
+        self.deadline = 0
         self.batch_hist: Dict[int, int] = {}  # pow2 batch-rows bucket -> count
         self.lat_ns = np.zeros(_RING, np.int64)  # request latency ring
         self.lat_idx = 0
@@ -113,6 +123,8 @@ class _ModelStats:
         self.reg_exec_seconds = ins.exec_seconds.labels(name)
         self.reg_batch_rows = ins.batch_rows.labels(name)
         self.reg_latency = ins.latency.labels(name)
+        self.reg_shed = ins.shed.labels(name)
+        self.reg_deadline = ins.deadline.labels(name)
 
     def add_latency(self, ns: int) -> None:
         self.lat_ns[self.lat_idx] = ns
@@ -201,6 +213,20 @@ class ServingMetrics:
             s.errors += 1
         s.reg_errors.inc()
 
+    def observe_shed(self, model: str) -> None:
+        """A request rejected at admission (bounded-queue load shedding)."""
+        with self._lock:
+            s = self._stats(model)
+            s.shed += 1
+        s.reg_shed.inc()
+
+    def observe_deadline(self, model: str) -> None:
+        """A caller gave up at its deadline (slow or dead worker)."""
+        with self._lock:
+            s = self._stats(model)
+            s.deadline += 1
+        s.reg_deadline.inc()
+
     def queue_delta(self, d_rows: int) -> None:
         with self._lock:
             prev = self._queue_rows
@@ -244,6 +270,8 @@ class ServingMetrics:
                     "requests": s.requests,
                     "rows": s.rows,
                     "errors": s.errors,
+                    "shed": s.shed,
+                    "deadline": s.deadline,
                     "batches": s.batches,
                     "batch_size_hist": {str(k): v for k, v in
                                         sorted(s.batch_hist.items())},

@@ -16,48 +16,262 @@ Wire format: 4-byte big-endian length + JSON object.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import socket
 import struct
 import threading
+import time
+import warnings
 from typing import Dict, List, Optional, Union
 
+# default bound on any single handshake/control send or recv: a hung peer
+# mid-protocol becomes a detected fault (OSError/timeout at the caller)
+# instead of a silent wedge.  Blocking reads that are SUPPOSED to wait
+# forever — the abort-channel watchers — pass timeout=None explicitly.
+OP_TIMEOUT = 300.0
 
-def send_msg(sock: socket.socket, obj: dict) -> None:
+
+@contextlib.contextmanager
+def _op_timeout(sock: socket.socket, timeout: Optional[float]):
+    """Temporarily bound one socket operation (restores the prior mode)."""
+    if timeout is None:
+        yield
+        return
+    prev = sock.gettimeout()
+    sock.settimeout(timeout)
+    try:
+        yield
+    finally:
+        try:
+            sock.settimeout(prev)
+        except OSError:
+            pass  # peer closed the socket mid-operation
+
+
+def send_msg(sock: socket.socket, obj: dict,
+             timeout: Optional[float] = None) -> None:
     payload = json.dumps(obj).encode()
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
+    with _op_timeout(sock, timeout):
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
-def recv_msg(sock: socket.socket) -> Optional[dict]:
-    """One length-prefixed JSON message; None on clean EOF."""
-    hdr = b""
-    while len(hdr) < 4:
-        chunk = sock.recv(4 - len(hdr))
-        if not chunk:
-            return None
-        hdr += chunk
-    (n,) = struct.unpack(">I", hdr)
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
+def recv_msg(sock: socket.socket,
+             timeout: Optional[float] = None) -> Optional[dict]:
+    """One length-prefixed JSON message; None on clean EOF.  ``timeout``
+    bounds the WHOLE message (socket.timeout is an OSError subclass, so
+    existing error paths treat expiry as a connection fault)."""
+    with _op_timeout(sock, timeout):
+        hdr = b""
+        while len(hdr) < 4:
+            chunk = sock.recv(4 - len(hdr))
+            if not chunk:
+                return None
+            hdr += chunk
+        (n,) = struct.unpack(">I", hdr)
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
     return json.loads(buf.decode())
 
 
 def get_host_ip(host_ip: str = "auto") -> str:
     if host_ip and host_ip != "auto":
         return host_ip
-    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    except OSError as e:
+        warnings.warn(f"get_host_ip: cannot create a probe socket ({e}); "
+                      "falling back to 127.0.0.1", RuntimeWarning,
+                      stacklevel=2)
+        return "127.0.0.1"
     try:
         s.connect(("10.255.255.255", 1))
         ip = s.getsockname()[0]
-    except Exception:
+    except Exception as e:
+        warnings.warn(f"get_host_ip: interface resolution failed ({e}); "
+                      "falling back to 127.0.0.1", RuntimeWarning,
+                      stacklevel=2)
         ip = "127.0.0.1"
     finally:
         s.close()
     return ip
+
+
+def _recv_exact(sock: socket.socket, n: int,
+                timeout: Optional[float] = None) -> bytes:
+    """Exactly ``n`` raw bytes or OSError/ConnectionError (EOF counts)."""
+    with _op_timeout(sock, timeout):
+        chunks, got = [], 0
+        while got < n:
+            chunk = sock.recv(min(n - got, 1 << 20))
+            if not chunk:
+                raise ConnectionError("peer closed mid-payload")
+            chunks.append(chunk)
+            got += len(chunk)
+    return b"".join(chunks)
+
+
+class CollRelay:
+    """Host-socket collective fallback: rank-ordered allgather through the
+    tracker process.
+
+    Why it exists: XLA's CPU backend (jaxlib < gloo support) cannot run
+    multi-process collectives at all — ``jax.jit`` raises "Multiprocess
+    computations aren't implemented on the CPU backend" — which would make
+    tracker-mode CPU training (and every fault-injection test that needs
+    real worker processes) impossible.  The relay carries the per-level
+    histogram exchange over plain sockets instead: each worker sends
+    (seq, payload); when all ``world`` contributions for a seq arrived, the
+    rank-ordered concatenation goes back to every worker.  SPMD lockstep
+    makes the seq numbering deterministic, and the host-side ordered
+    reduction over the gathered stack keeps training bitwise reproducible
+    (the same property the jax path has).
+
+    Failure semantics are the tracker's: a worker EOF with an incomplete
+    gather outstanding fails the collective for everyone (``coll_error``
+    fan-out + the main-channel abort via ``on_worker_lost``); a completed
+    worker closing its socket with nothing pending is a clean departure.
+    Every send/recv is bounded by ``op_timeout`` so a hung peer is a
+    detected fault, not a wedge."""
+
+    def __init__(self, host_ip: str, world: int,
+                 op_timeout: float = 600.0) -> None:
+        self.world = world
+        self.op_timeout = op_timeout
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host_ip, 0))
+        self.port = self._listener.getsockname()[1]
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: Dict[int, Dict[int, bytes]] = {}  # seq -> rank -> buf
+        self._results: Dict[int, tuple] = {}  # seq -> (payload, refcount)
+        self._departed: set = set()
+        self._failed: Optional[str] = None
+        self._closing = False
+        self.on_worker_lost = None  # callback(rank, msg) -> abort fan-out
+
+    def start(self) -> None:
+        self._listener.listen(self.world)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # closed
+            try:
+                conn.settimeout(30.0)
+                msg = recv_msg(conn)
+                conn.settimeout(None)
+            except (OSError, ValueError):
+                conn.close()
+                continue
+            if not msg or msg.get("cmd") != "coll_join":
+                conn.close()
+                continue
+            rank = int(msg["rank"])
+            threading.Thread(target=self._serve_worker, args=(conn, rank),
+                             daemon=True).start()
+
+    def _fail(self, msg: str, lost_rank: Optional[int] = None) -> None:
+        with self._cond:
+            if self._failed is None and not self._closing:
+                self._failed = msg
+                self._cond.notify_all()
+            else:
+                return
+        if lost_rank is not None and self.on_worker_lost is not None:
+            self.on_worker_lost(lost_rank, msg)
+
+    def _serve_worker(self, conn: socket.socket, rank: int) -> None:
+        try:
+            while True:
+                try:
+                    hdr = recv_msg(conn)
+                except OSError:
+                    hdr = None
+                if hdr is None or hdr.get("cmd") != "coll":
+                    break
+                seq = int(hdr["seq"])
+                buf = _recv_exact(conn, int(hdr["nbytes"]),
+                                  timeout=self.op_timeout)
+                result = self._contribute(seq, rank, buf)
+                if result is None:
+                    send_msg(conn, {"cmd": "coll_error",
+                                    "msg": self._failed or "relay failed"},
+                             timeout=30.0)
+                    break
+                send_msg(conn, {"cmd": "coll_result", "seq": seq,
+                                "nbytes": len(result)},
+                         timeout=self.op_timeout)
+                with _op_timeout(conn, self.op_timeout):
+                    conn.sendall(result)
+        except OSError:
+            pass
+        finally:
+            incomplete = False
+            with self._cond:
+                self._departed.add(rank)
+                # only gathers still MISSING this rank's payload are doomed;
+                # one it already fed can complete for the survivors
+                incomplete = (not self._closing
+                              and any(rank not in contribs
+                                      for contribs in self._pending.values()))
+                self._cond.notify_all()  # wake waiters to run the check
+            if incomplete and self._failed is None:
+                # this worker can no longer contribute to an outstanding
+                # gather: everyone blocked on it must fail fast
+                self._fail(f"collective peer {rank} lost mid-gather",
+                           lost_rank=rank)
+            conn.close()
+
+    def _contribute(self, seq: int, rank: int, buf: bytes) -> Optional[bytes]:
+        """Add ``rank``'s payload; block until the gather completes; returns
+        the rank-ordered concatenation or None on failure/timeout."""
+        deadline = time.monotonic() + self.op_timeout
+        with self._cond:
+            self._pending.setdefault(seq, {})[rank] = buf
+            while True:
+                if self._failed is not None or self._closing:
+                    return None
+                got = self._pending.get(seq)
+                if got is not None and len(got) == self.world:
+                    payload = b"".join(got[r] for r in range(self.world))
+                    del self._pending[seq]
+                    self._results[seq] = (payload, self.world)
+                    self._cond.notify_all()
+                if seq in self._results:
+                    payload, refs = self._results[seq]
+                    if refs <= 1:
+                        del self._results[seq]
+                    else:
+                        self._results[seq] = (payload, refs - 1)
+                    return payload
+                if got is not None and any(d not in got
+                                           for d in self._departed):
+                    break  # a missing contributor is gone: can never finish
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(timeout=min(left, 5.0))
+        self._fail(f"collective seq {seq} incomplete "
+                   f"(departed={sorted(self._departed)})")
+        return None
+
+    def close(self) -> None:
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
 
 
 class RabitTracker:
@@ -65,11 +279,17 @@ class RabitTracker:
     start(), worker_args(), wait_for(), free())."""
 
     def __init__(self, n_workers: int, host_ip: str = "auto", port: int = 0,
-                 sortby: str = "host", timeout: int = 0) -> None:
+                 sortby: str = "host", timeout: int = 0,
+                 handshake_timeout: float = OP_TIMEOUT) -> None:
         self.n_workers = n_workers
         self.host_ip = get_host_ip(host_ip)
         self.sortby = sortby
         self.timeout = timeout
+        self.handshake_timeout = handshake_timeout
+        self._closing = False
+        self._relay = CollRelay(self.host_ip, n_workers)
+        self._relay.on_worker_lost = (
+            lambda rank, msg: self._fan_abort(rank, msg, None))
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host_ip, port))
@@ -84,6 +304,7 @@ class RabitTracker:
     # ------------------------------------------------------------- serving
     def start(self) -> None:
         self._listener.listen(self.n_workers)
+        self._relay.start()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
 
@@ -118,46 +339,80 @@ class RabitTracker:
         # machine — multi-host topologies put them on different hosts):
         # two-phase bootstrap, rank 0 reports its coordinator address first
         r0_conn = self._conns[0]
-        send_msg(r0_conn, {"rank": 0, "world": self.n_workers,
-                           "coordinator": None})
-        reply = recv_msg(r0_conn)
+        try:
+            # bounded two-phase bootstrap: a rank 0 that connects and then
+            # hangs must surface as a handshake failure, not wedge the
+            # tracker (and every other worker) forever
+            send_msg(r0_conn, {"rank": 0, "world": self.n_workers,
+                               "coordinator": None,
+                               "coll_port": self._relay.port},
+                     timeout=self.handshake_timeout)
+            reply = recv_msg(r0_conn, timeout=self.handshake_timeout)
+        except OSError:
+            reply = None
         if not reply or reply.get("cmd") != "coordinator":
+            with self._lock:
+                if self._error is None:
+                    self._error = ("worker 0: coordinator handshake failed "
+                                   "or timed out")
             for c in self._conns:
                 c.close()
+            self._done.set()
             return
         coordinator = str(reply["addr"])
         for rank, conn in enumerate(self._conns[1:], start=1):
-            send_msg(conn, {"rank": rank, "world": self.n_workers,
-                            "coordinator": coordinator})
+            try:
+                send_msg(conn, {"rank": rank, "world": self.n_workers,
+                                "coordinator": coordinator,
+                                "coll_port": self._relay.port},
+                         timeout=self.handshake_timeout)
+            except OSError:
+                pass  # the worker's watcher EOF-detection handles its death
         for rank, conn in enumerate(self._conns):
             t = threading.Thread(target=self._watch_worker,
                                  args=(conn, rank), daemon=True)
             t.start()
 
+    def _fan_abort(self, rank: int, msg: str,
+                   source: Optional[socket.socket]) -> None:
+        """First failure wins: record it and abort every OTHER worker
+        (tracker.cc:345; workers' watchers exit on receipt)."""
+        with self._lock:
+            if self._error is None:
+                self._error = f"worker {rank}: {msg}"
+                for other in self._conns:
+                    if other is not source:
+                        try:
+                            send_msg(other, {"cmd": "abort",
+                                             "msg": self._error},
+                                     timeout=30.0)
+                        except OSError:
+                            pass
+        self._done.set()
+
     def _watch_worker(self, conn: socket.socket, rank: int) -> None:
+        clean = False
         while True:
             try:
                 msg = recv_msg(conn)
             except OSError:
                 msg = None
-            if msg is None or msg.get("cmd") == "shutdown":
+            if msg is None:
+                break
+            if msg.get("cmd") == "shutdown":
+                clean = True
                 break
             if msg.get("cmd") == "error":
-                # fan the failure out: every other worker aborts
-                # (tracker.cc:345; workers' watchers exit on receipt)
-                with self._lock:
-                    if self._error is None:
-                        self._error = (f"worker {rank}: "
-                                       f"{msg.get('msg', 'unknown error')}")
-                        for other in self._conns:
-                            if other is not conn:
-                                try:
-                                    send_msg(other, {"cmd": "abort",
-                                                     "msg": self._error})
-                                except OSError:
-                                    pass
-                self._done.set()
+                self._fan_abort(rank, msg.get("msg", "unknown error"), conn)
                 break
+        if not clean and not self._closing and self._error is None:
+            # EOF without a shutdown message: the worker DIED (crash,
+            # SIGKILL, machine loss) without getting to signal_error.  Its
+            # peers are blocked in a collective waiting for it — fan the
+            # abort out so they fail fast instead of wedging (the Rabit
+            # lineage treats a lost tracker connection exactly this way).
+            self._fan_abort(rank, "tracker connection lost "
+                            "(worker process died)", conn)
         with self._lock:
             self._n_finished += 1
             if self._n_finished >= self.n_workers:
@@ -181,6 +436,8 @@ class RabitTracker:
             raise RuntimeError(f"tracker: training failed — {self._error}")
 
     def free(self) -> None:
+        self._closing = True  # watcher EOFs from here on are OURS, not deaths
+        self._relay.close()
         try:
             self._listener.close()
         except OSError:
@@ -198,28 +455,52 @@ class TrackerClient:
     (the comm.cc:340-376 detached watcher thread role)."""
 
     def __init__(self, host: str, port: int, timeout: float = 120.0,
-                 retries: int = 5, task_id: str = "") -> None:
-        import time
+                 retries: int = 5, task_id: str = "",
+                 handshake_timeout: float = OP_TIMEOUT) -> None:
+        import os
 
-        last = None
-        for attempt in range(max(retries, 1)):
-            try:
-                self._sock = socket.create_connection((host, int(port)),
-                                                      timeout=timeout)
-                break
-            except OSError as e:  # connect retry (comm.h:23 kRetry role);
-                last = e          # backoff so workers racing the tracker's
-                time.sleep(min(2.0 ** attempt, 10.0))  # start() can win
-        else:
-            raise ConnectionError(f"cannot reach tracker {host}:{port}: {last}")
-        self._sock.settimeout(None)
+        from .reliability import faults as _faults
+        from .reliability.retry import RetriesExhausted, retry_call
+
+        def _connect() -> socket.socket:
+            # seam: kinds 'exception' (with times=N, a connect that fails N
+            # times then succeeds — retried like a real refusal) and 'delay'
+            _faults.maybe_inject("tracker.connect")
+            return socket.create_connection((host, int(port)),
+                                           timeout=timeout)
+
+        try:
+            # jittered exponential backoff (comm.h:23 kRetry role): workers
+            # racing the tracker's start() — or a tracker restarting — win
+            # eventually, de-synchronized by the pid-seeded jitter
+            self._sock = retry_call(
+                _connect, op="tracker.connect",
+                retries=max(retries, 1) - 1, base=0.25, max_delay=10.0,
+                seed=os.getpid(),
+                retry_on=(OSError, _faults.FaultInjected))
+        except RetriesExhausted as e:
+            raise ConnectionError(
+                f"cannot reach tracker {host}:{port}: {e.__cause__}") from e
+        # the whole rendezvous handshake is bounded: a tracker that accepts
+        # and then stalls becomes a ConnectionError here, not a hang
+        self._sock.settimeout(handshake_timeout)
         send_msg(self._sock, {"cmd": "start", "host": socket.gethostname(),
                               "task_id": task_id})
-        reply = recv_msg(self._sock)
+        try:
+            reply = recv_msg(self._sock)
+        except OSError as e:
+            raise ConnectionError(
+                f"tracker handshake failed or timed out: {e}") from e
         if not reply or "rank" not in reply:
             raise ConnectionError("tracker rejected the start handshake")
         self.rank = int(reply["rank"])
         self.world = int(reply["world"])
+        self.coll_port = reply.get("coll_port")  # socket-relay collectives
+        self._coll: Optional[socket.socket] = None
+        self._coll_host = host
+        self._coll_seq = 0
+        self._coll_lock = threading.Lock()
+        self.op_timeout = handshake_timeout
         if reply.get("coordinator") is None:
             # rank 0: host the jax coordinator — allocate a port on THIS
             # machine and report it back (bind-then-close is a small TOCTOU
@@ -233,6 +514,17 @@ class TrackerClient:
                                   "addr": self.coordinator})
         else:
             self.coordinator = str(reply["coordinator"])
+        # handshake done: the persistent connection is now the abort channel
+        # and legitimately blocks forever in the watcher
+        self._sock.settimeout(None)
+        # seam: 'drop_connection' severs the error channel right after
+        # rendezvous — the tracker sees EOF and treats this worker as dead
+        spec = _faults.maybe_inject("tracker.connected", rank=self.rank)
+        if spec is not None and spec.kind == "drop_connection":
+            try:
+                self._sock.close()
+            except OSError:
+                pass
         self._watcher = threading.Thread(target=self._watch, daemon=True)
         self._watcher.start()
 
@@ -252,15 +544,70 @@ class TrackerClient:
                       f"{msg.get('msg', '')}", file=sys.stderr, flush=True)
                 os._exit(255)  # reference: std::exit(-1) in the watcher
 
+    # --------------------------------------------------- relay collectives
+    def _coll_sock(self) -> socket.socket:
+        if self._coll is None:
+            if self.coll_port is None:
+                raise RuntimeError("tracker offers no collective relay")
+            from .reliability.retry import retry_call
+
+            self._coll = retry_call(
+                lambda: socket.create_connection(
+                    (self._coll_host, int(self.coll_port)), timeout=60.0),
+                op="tracker.coll_connect", retries=4, base=0.25,
+                seed=self.rank, retry_on=(OSError,))
+            send_msg(self._coll, {"cmd": "coll_join", "rank": self.rank},
+                     timeout=30.0)
+        return self._coll
+
+    def coll_allgather(self, arr) -> "object":
+        """Rank-ordered allgather over the tracker's socket relay:
+        (world, *arr.shape).  The CPU-backend fallback for XLA multiprocess
+        collectives (CollRelay docstring has the why + failure model)."""
+        import numpy as np
+
+        arr = np.ascontiguousarray(arr)
+        payload = arr.tobytes()
+        with self._coll_lock:
+            s = self._coll_sock()
+            seq = self._coll_seq
+            self._coll_seq += 1
+            try:
+                send_msg(s, {"cmd": "coll", "seq": seq,
+                             "nbytes": len(payload)},
+                         timeout=self.op_timeout)
+                with _op_timeout(s, self.op_timeout):
+                    s.sendall(payload)
+                hdr = recv_msg(s, timeout=self.op_timeout)
+                if not hdr or hdr.get("cmd") != "coll_result":
+                    raise RuntimeError(
+                        "collective relay failed: "
+                        f"{(hdr or {}).get('msg', 'connection lost')}")
+                buf = _recv_exact(s, int(hdr["nbytes"]),
+                                  timeout=self.op_timeout)
+            except OSError as e:
+                raise RuntimeError(
+                    f"collective relay I/O failed (peer/tracker lost?): {e}"
+                ) from e
+        return np.frombuffer(buf, arr.dtype).reshape(
+            (self.world,) + arr.shape).copy()
+
     def signal_error(self, msg: str) -> None:
+        # bounded: a dying worker must not block on a wedged tracker
         try:
-            send_msg(self._sock, {"cmd": "error", "msg": msg})
+            send_msg(self._sock, {"cmd": "error", "msg": msg}, timeout=30.0)
         except OSError:
             pass
 
     def shutdown(self) -> None:
+        if self._coll is not None:
+            try:
+                self._coll.close()
+            except OSError:
+                pass
+            self._coll = None
         try:
-            send_msg(self._sock, {"cmd": "shutdown"})
+            send_msg(self._sock, {"cmd": "shutdown"}, timeout=30.0)
             self._sock.close()
         except OSError:
             pass

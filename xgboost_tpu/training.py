@@ -31,7 +31,16 @@ def train(
     xgb_model: Optional[Union[str, Booster]] = None,
     callbacks: Optional[Sequence[TrainingCallback]] = None,
     custom_metric: Optional[Callable] = None,
+    resume_from: Optional[str] = None,
 ) -> Booster:
+    """``resume_from``: a checkpoint directory written by
+    :class:`~xgboost_tpu.reliability.CheckpointCallback`.  When it holds a
+    valid checkpoint, training continues from it (overriding ``xgb_model``)
+    and ``num_boost_round`` is the TOTAL round target, so an interrupted-
+    and-resumed run finishes at the same round — and, under deterministic
+    config, the same bits — as an uninterrupted one.  An empty or missing
+    directory falls through to a normal start, so the same command line
+    works for launch and relaunch (docs/reliability.md)."""
     callbacks = list(callbacks) if callbacks else []
     evals = list(evals) if evals else []
     if early_stopping_rounds is not None:
@@ -43,9 +52,35 @@ def train(
     if verbose_eval:
         period = 1 if verbose_eval is True else int(verbose_eval)
         callbacks.append(EvaluationMonitor(period=period))
+    # run-last callbacks (CheckpointCallback) dispatch after the rest so a
+    # checkpoint captures the CURRENT round's EarlyStopping state, not the
+    # previous round's (stable sort keeps every other relative order)
+    callbacks.sort(key=lambda cb: bool(getattr(cb, "_run_last", False)))
     cbs = CallbackContainer(callbacks, metric=custom_metric)
+    for cb in callbacks:
+        bind = getattr(cb, "_bind_container", None)
+        if bind is not None:  # CheckpointCallback snapshots history + peers
+            bind(cbs)
 
-    if isinstance(xgb_model, (str, bytes, bytearray)):
+    resumed = None
+    if resume_from is not None:
+        from .reliability.checkpoint import (latest_checkpoint,
+                                             restore_callback_state)
+
+        resumed = latest_checkpoint(resume_from)
+    if resumed is not None:
+        bst = Booster(params)
+        bst.unserialize(resumed.booster_bytes)
+        bst.set_param(params)
+        bi = bst.attr("best_iteration")
+        if bi is not None:  # re-expose early-stopping bests on the object
+            bst.best_iteration = int(bi)
+            bs = bst.attr("best_score")
+            bst.best_score = float(bs) if bs is not None else None
+        for name, metrics in resumed.history.items():
+            cbs.history.setdefault(name, {}).update(metrics)
+        restore_callback_state(callbacks, resumed.callback_state)
+    elif isinstance(xgb_model, (str, bytes, bytearray)):
         bst = Booster(params)
         bst.load_model(xgb_model)
         bst.set_param(params)
@@ -57,7 +92,18 @@ def train(
 
     bst = cbs.before_training(bst)
     start = bst.num_boosted_rounds()
-    for i in range(start, start + num_boost_round):
+    # resumed runs count num_boost_round as the TOTAL target (so relaunching
+    # the same command converges on the same final round); a fresh or
+    # xgb_model continuation keeps the additive reference semantics
+    end = num_boost_round if resumed is not None else start + num_boost_round
+    from . import collective
+    from .reliability.faults import maybe_inject
+
+    for i in range(start, end):
+        # fault seam (kill/exception/delay; no-op without a plan): the
+        # round boundary is where a worker death is injected for the
+        # kill->resume parity tests
+        maybe_inject("train.round", round=i, rank=collective.get_rank)
         if cbs.before_iteration(bst, i, dtrain, evals):
             break
         bst.update(dtrain, i, fobj=obj)
