@@ -3,6 +3,12 @@
 # (`pytest -m quick`, <3 min) is the per-commit gate; this is the deep one.
 set -e
 cd "$(dirname "$0")/.."
+
+# static-analysis gate first (docs/static_analysis.md): fail fast on
+# retrace/lock/seam/metric violations before paying for the test suite;
+# writes bench_out/lint_report.json for trend tracking
+bash scripts/lint_gate.sh
+
 python -m pytest tests/ -q --durations=25
 
 # telemetry smoke: a short traced training run must leave a parseable JSONL

@@ -122,6 +122,13 @@ def test_extmem_single_batch_equals_incore_exactly():
 def test_page_compression(tmp_path, batches):
     """Zstd-compressed pages (the nvCOMP/compressed_iterator role): same
     trees as uncompressed, real RAM savings on binned codes."""
+    # environment-limited: without the zstandard package the extmem layer
+    # (deliberately) falls back to uncompressed pages with a UserWarning,
+    # so there is nothing to measure — the compression contract itself
+    # cannot be exercised here
+    pytest.importorskip("zstandard",
+                        reason="zstandard not installed: pages stay "
+                               "uncompressed (graceful-fallback path)")
     from xgboost_tpu.data.extmem import CompressedPage
 
     X, y, Xs, ys = batches

@@ -1,0 +1,29 @@
+"""xtblint — project-native static analysis for the xgboost_tpu tree.
+
+The reference C++ stack leans on compiler warnings, clang-tidy, and
+sanitizer CI; a JAX port gets none of that for its real invariants.
+This package is the replacement: an AST-level linter with five rule
+families grounded in this codebase's contracts —
+
+- **XTB1xx** retrace/host-sync hazards inside ``jax.jit``/``pallas_call``
+  bodies (the thing ``xtb_compiles_total`` only catches at runtime);
+- **XTB2xx** lock discipline in thread-shared classes (telemetry
+  registry, serving batcher/registry, tracker);
+- **XTB3xx** fault-seam string consistency against ``faults.SEAMS`` and
+  ``docs/reliability.md``;
+- **XTB4xx** ``xtb_*`` metric-name consistency against the registry and
+  the ``docs/observability.md`` catalog;
+- **XTB5xx** nondeterminism (wall-clock reads, ambient-state RNG) in
+  reproducible paths.
+
+CLI: ``python -m xgboost_tpu.analysis xgboost_tpu/`` (exit 0 = clean —
+the pre-merge gate run by ``scripts/lint_gate.sh`` and the quick test
+tier).  Suppress a line with ``# xtblint: disable=XTB201``; see
+``docs/static_analysis.md`` for the rule catalog and how to add a rule.
+"""
+from .core import (Finding, LintResult, lint_paths, lint_source,
+                   rule_catalog, run_lint)
+from .reporters import render_json, render_text
+
+__all__ = ["Finding", "LintResult", "lint_paths", "lint_source",
+           "run_lint", "rule_catalog", "render_json", "render_text"]

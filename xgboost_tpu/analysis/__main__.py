@@ -1,0 +1,93 @@
+"""``python -m xgboost_tpu.analysis`` — the xtblint CLI.
+
+Exit-code contract (what the CI gate keys on):
+
+- **0** — no findings (suppressed findings do not fail the gate; they are
+  reported so trends catch suppression creep);
+- **1** — at least one finding (or an unparseable file);
+- **2** — usage error / unknown path.
+
+Typical invocations::
+
+    python -m xgboost_tpu.analysis xgboost_tpu/
+    python -m xgboost_tpu.analysis xgboost_tpu/ --format json \
+        --json-out bench_out/lint_report.json
+    python -m xgboost_tpu.analysis --list-rules
+    python -m xgboost_tpu.analysis xgboost_tpu/serving --select XTB2
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .core import rule_catalog, run_lint
+from .reporters import render_json, render_text
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m xgboost_tpu.analysis",
+        description="xtblint: project-native static analysis for retrace "
+                    "hazards (XTB1xx), lock discipline (XTB2xx), fault-seam "
+                    "consistency (XTB3xx), metric-name consistency "
+                    "(XTB4xx), and nondeterminism (XTB5xx).")
+    p.add_argument("paths", nargs="*", help="files/directories to lint "
+                   "(default: ./xgboost_tpu)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--json-out", metavar="FILE",
+                   help="also write the JSON report here (any --format)")
+    p.add_argument("--select", action="append", default=[],
+                   metavar="CODES", help="only these codes/families "
+                   "(comma-separated; e.g. XTB2,XTB301)")
+    p.add_argument("--ignore", action="append", default=[],
+                   metavar="CODES", help="drop these codes/families")
+    p.add_argument("--docs", metavar="DIR",
+                   help="docs directory for the XTB3xx/XTB4xx contracts "
+                   "(default: auto-detected docs/ next to the package)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="also print suppressed findings")
+    return p
+
+
+def _split(entries: List[str]) -> List[str]:
+    out: List[str] = []
+    for e in entries:
+        out.extend(c.strip() for c in e.split(",") if c.strip())
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+    if args.list_rules:
+        for code, rule, desc in rule_catalog():
+            print(f"{code}  [{rule}] {desc}")
+        return 0
+    paths = args.paths or ["xgboost_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"xtblint: no such path: {p}", file=sys.stderr)
+            return 2
+    try:
+        result = run_lint(paths, docs_root=args.docs,
+                          select=_split(args.select),
+                          ignore=_split(args.ignore))
+    except FileNotFoundError as e:  # racing deletion mid-walk
+        print(f"xtblint: {e}", file=sys.stderr)
+        return 2
+    if args.json_out:
+        os.makedirs(os.path.dirname(args.json_out) or ".", exist_ok=True)
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            fh.write(render_json(result))
+    if args.format == "json":
+        sys.stdout.write(render_json(result))
+    else:
+        print(render_text(result, verbose=args.verbose))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,250 @@
+"""XTB2xx — lock discipline in classes that own a threading lock.
+
+A class whose ``__init__`` creates a ``threading.Lock`` / ``RLock`` /
+``Condition`` (``telemetry/registry.py``, ``serving/batcher.py``,
+``serving/registry.py``, ``tracker.py``, ...) has declared that its
+instance attributes are shared across threads.  Every *store* to an
+instance attribute outside ``__init__`` must then happen under
+``with self.<lock>`` — an unguarded ``self.x = ...`` is a data race that
+no test reliably reproduces (the ServingMetrics ``compiles_warmup``
+setter and the tracker's ``self._conns`` publication were exactly this
+before the rule landed).
+
+Attribute-store analysis, per class:
+
+- **lock attributes**: ``self.<name> = threading.Lock()/RLock()/
+  Condition(...)`` assignments in ``__init__`` (a Condition wrapping a
+  lock counts as a second name for the same guard).
+- **stores**: ``self.a = v``, ``self.a += v``, ``del self.a``,
+  ``self.a[k] = v``, ``del self.a[k]`` in any other method.  Reads are
+  not checked (lock-cheap read paths are a deliberate design here);
+  method calls on attributes (``self._q.append``) are not checked —
+  flagging them would indict every internally-synchronized member
+  (Events, Queues, registry children).
+- **guarded**: the store is lexically inside ``with self.<lock>``, or
+  the enclosing method is only ever *called from this class* at guarded
+  call sites (fixpoint over the intra-class call graph — the
+  caller-holds-lock helper pattern: ``MicroBatcher._drain``,
+  ``ModelRegistry._evict_for_capacity``).  A method whose reference
+  escapes un-called (``threading.Thread(target=self._serve)``) never
+  inherits its callers' locks.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from .core import Finding, Project, Rule, SourceFile
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr in _LOCK_FACTORIES
+    if isinstance(f, ast.Name):
+        return f.id in _LOCK_FACTORIES
+    return False
+
+
+def _self_attr(node: ast.expr) -> str:
+    """'a' when node is ``self.a``, else ''."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+def _store_target(node: ast.expr) -> str:
+    """Attribute name for a store through self (direct or subscripted)."""
+    name = _self_attr(node)
+    if name:
+        return name
+    if isinstance(node, ast.Subscript):
+        return _self_attr(node.value)
+    return ""
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method's stores, intra-class call sites, and escaping method
+    references, each tagged with whether it sits under ``with self.<lock>``."""
+
+    def __init__(self, lock_attrs: Set[str]) -> None:
+        self.lock_attrs = lock_attrs
+        self.depth = 0  # with-lock nesting
+        self.closure = 0  # nested def/lambda nesting
+        self.stores: List[Tuple[ast.AST, str, bool]] = []
+        self.calls: List[Tuple[str, bool]] = []   # (method, under_lock)
+        self.method_refs: Set[str] = set()        # self.m not in call position
+
+    def _enter_closure(self, node: ast.AST) -> None:
+        """A nested def/lambda runs WHENEVER it is later called, not where
+        it is written: its body gets no credit for the ambient lock, and a
+        ``self.m()`` call inside it counts as an escaping reference (the
+        ``Thread(target=lambda: self._serve())`` wrapper pattern)."""
+        prev, self.depth = self.depth, 0
+        self.closure += 1
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
+            self.visit(stmt)
+        self.closure -= 1
+        self.depth = prev
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_closure(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_closure(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_closure(node)
+
+    def _locked_item(self, item: ast.withitem) -> bool:
+        return _self_attr(item.context_expr) in self.lock_attrs
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(self._locked_item(i) for i in node.items)
+        self.depth += locked
+        self.generic_visit(node)
+        self.depth -= locked
+
+    def _record_store(self, target: ast.expr, node: ast.AST) -> None:
+        name = _store_target(target)
+        if name and name not in self.lock_attrs:
+            self.stores.append((node, name, self.depth > 0))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            for el in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                       else [t]):
+                self._record_store(el, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_store(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_store(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._record_store(t, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        m = _self_attr(node.func)
+        if m and self.closure:
+            # deferred execution context: treat as an escaping reference,
+            # never as a guarded call site
+            self.method_refs.add(m)
+        elif m:
+            self.calls.append((m, self.depth > 0))
+        # visit children, but the func attribute itself is a call position
+        for child in ast.iter_child_nodes(node):
+            if child is node.func and m:
+                continue
+            self.visit(child)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        m = _self_attr(node)
+        if m:
+            self.method_refs.add(m)
+        self.generic_visit(node)
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    codes = {
+        "XTB201": "store to a shared instance attribute outside `with "
+                  "self.<lock>` in a lock-owning class",
+    }
+
+    def check_file(self, sf: SourceFile, project: Project,
+                   ) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for cls in ast.walk(sf.tree):
+            if isinstance(cls, ast.ClassDef):
+                findings.extend(self._check_class(sf, cls))
+        return findings
+
+    def _check_class(self, sf: SourceFile, cls: ast.ClassDef,
+                     ) -> Iterable[Finding]:
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        if init is None:
+            return ()
+        lock_attrs: Set[str] = set()
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    name = _self_attr(t)
+                    if name:
+                        lock_attrs.add(name)
+        if not lock_attrs:
+            return ()
+
+        methods = [n for n in cls.body if isinstance(n, ast.FunctionDef)
+                   and n.name != "__init__"]
+        scans: Dict[str, _MethodScan] = {}
+        for m in methods:
+            scan = _MethodScan(lock_attrs)
+            for stmt in m.body:
+                scan.visit(stmt)
+            scans[m.name] = scan
+        # __init__ contributes call sites and escaping references (e.g.
+        # Thread(target=self._watch) at construction) but its own stores
+        # are exempt — construction happens-before publication
+        init_scan = _MethodScan(lock_attrs)
+        for stmt in init.body:
+            init_scan.visit(stmt)
+
+        # intra-class call graph: method -> [(caller, call under lock)]
+        call_sites: Dict[str, List[Tuple[str, bool]]] = {}
+        escaped: Set[str] = set()
+        for caller, scan in list(scans.items()) + [("__init__", init_scan)]:
+            for callee, locked in scan.calls:
+                if callee in scans:
+                    call_sites.setdefault(callee, []).append((caller, locked))
+            for ref in scan.method_refs:
+                # method_refs only holds NON-call-position references, so
+                # any hit means the method escapes its callers' locks
+                if ref in scans:
+                    escaped.add(ref)  # e.g. Thread(target=self._serve)
+
+        # fixpoint: a method runs with the lock held when every intra-class
+        # call site is under the lock (directly or via a guarded caller) and
+        # its reference never escapes without a call
+        guarded: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name in scans:
+                if name in guarded or name in escaped:
+                    continue
+                sites = call_sites.get(name, [])
+                if sites and all(locked or caller in guarded
+                                 for caller, locked in sites):
+                    guarded.add(name)
+                    changed = True
+
+        lock_list = "/".join(sorted(lock_attrs))
+        findings: List[Finding] = []
+        for m in methods:
+            if m.name in guarded:
+                continue
+            for node, attr, locked in scans[m.name].stores:
+                if not locked:
+                    findings.append(sf.finding(
+                        node, "XTB201",
+                        f"{cls.name}.{m.name} stores self.{attr} outside "
+                        f"`with self.{lock_list}` ({cls.name} owns a lock; "
+                        f"unguarded stores race other threads)"))
+        return findings

@@ -1,0 +1,183 @@
+"""XTB4xx — ``xtb_*`` metric-name consistency.
+
+The telemetry registry keys families by *string name*; serving,
+reliability, and telemetry modules each register their own series, and
+the docs promise operators a stable catalog.  Three drift modes, each a
+code:
+
+- **XTB401** — a registered metric missing from the metrics catalog in
+  ``docs/observability.md`` (operators scrape names they can't look up);
+- **XTB402** — the same name registered with a conflicting kind or label
+  set (the registry raises at runtime — but only when the *second*
+  registration happens to run, typically in production);
+- **XTB403** — a metric-shaped ``xtb_*`` name mentioned in code
+  docstrings/strings or in the docs that no code registers (a renamed or
+  deleted series leaving dangling references — dashboards built from
+  those mentions silently flatline).
+
+"Metric-shaped" filters the package's other ``xtb_`` namespaces (native
+kernel symbols like ``xtb_csr_rows``): a token counts only when it ends
+with a Prometheus-convention suffix (``_total``, ``_seconds``, ...) and
+does not carry a native symbol prefix (``xtb_csr_`` etc., the
+``utils/native.py`` / ``native/`` C symbol families).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import Finding, Project, Rule, SourceFile
+
+_FACT_REG = "metrics.registrations"  # name -> [(kind, labels, path, line)]
+_FACT_MENTION = "metrics.mentions"   # list[(token, path, line)]
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_TOKEN_RE = re.compile(r"\bxtb_[a-z0-9_]+")
+# Prometheus-convention endings that make an xtb_ token a metric name
+_METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_rows", "_peak",
+                    "_steady", "_warmup", "_count", "_sum", "_bucket",
+                    "_info", "_ratio")
+# the package's non-metric xtb_ namespaces (native C symbols + sources)
+_NATIVE_PREFIXES = ("xtb_csr_", "xtb_dense_", "xtb_summary_", "xtb_parse_",
+                    "xtb_native", "xtb_ffi", "xtb_kernels", "xtb_capi",
+                    "xtb_hist", "xtb_split", "xtb_predict", "xtb_lambdarank")
+_DOCS = ("observability.md", "reliability.md", "serving.md")
+
+
+def _literal_labels(node: ast.Call) -> Optional[Tuple[str, ...]]:
+    """Label-name tuple when given literally; None when absent/dynamic."""
+    arg = None
+    if len(node.args) >= 3:
+        arg = node.args[2]
+    else:
+        for kw in node.keywords:
+            if kw.arg == "label_names":
+                arg = kw.value
+    if arg is None:
+        return ()
+    if isinstance(arg, (ast.Tuple, ast.List)):
+        out = []
+        for el in arg.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _metric_shaped(token: str) -> bool:
+    if token.startswith(_NATIVE_PREFIXES):
+        return False
+    return token.endswith(_METRIC_SUFFIXES)
+
+
+def _derived_names(name: str, kind: str) -> List[str]:
+    if kind == "histogram":
+        return [name, name + "_bucket", name + "_sum", name + "_count"]
+    return [name]
+
+
+class MetricNameRule(Rule):
+    name = "metric-names"
+    codes = {
+        "XTB401": "registered xtb_* metric missing from the "
+                  "docs/observability.md metrics catalog",
+        "XTB402": "metric name registered with conflicting kind or labels",
+        "XTB403": "metric-shaped xtb_* name mentioned but never registered",
+    }
+
+    def check_file(self, sf: SourceFile, project: Project,
+                   ) -> Iterable[Finding]:
+        regs: Dict[str, list] = project.facts.setdefault(_FACT_REG, {})
+        mentions: list = project.facts.setdefault(_FACT_MENTION, [])
+        # module-level string constants (PHASE_HISTOGRAM = "xtb_...") so a
+        # registration through a named constant still resolves
+        consts: Dict[str, str] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Name)
+                            and isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)):
+                        consts[t.id] = node.value.value
+        for node in ast.walk(sf.tree):
+            name = None
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REG_METHODS
+                    and node.args):
+                arg0 = node.args[0]
+                if (isinstance(arg0, ast.Constant)
+                        and isinstance(arg0.value, str)):
+                    name = arg0.value
+                elif isinstance(arg0, ast.Name):
+                    name = consts.get(arg0.id)
+            if name is not None and name.startswith("xtb_"):
+                regs.setdefault(name, []).append(
+                    (node.func.attr, _literal_labels(node), sf.path,
+                     node.lineno))
+            elif (isinstance(node, ast.Constant)
+                  and isinstance(node.value, str)):
+                for token in _TOKEN_RE.findall(node.value):
+                    mentions.append((token, sf.path, node.lineno))
+        return ()
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        regs: Dict[str, list] = project.facts.get(_FACT_REG) or {}
+        mentions: List[Tuple[str, str, int]] = (
+            project.facts.get(_FACT_MENTION) or [])
+        findings: List[Finding] = []
+
+        # XTB402: one signature per name across every registration site
+        for name, sites in sorted(regs.items()):
+            kinds = {k for k, _l, _p, _ln in sites}
+            labels = {l for _k, l, _p, _ln in sites if l is not None}
+            if len(kinds) > 1 or len(labels) > 1:
+                first = sites[0]
+                for kind, lab, path, line in sites[1:]:
+                    findings.append(Finding(
+                        path, line, 0, "XTB402",
+                        f"metric {name!r} registered as {kind}{lab} here "
+                        f"but as {first[0]}{first[1]} at "
+                        f"{first[2]}:{first[3]} (the registry raises on "
+                        f"the second registration at runtime)"))
+
+        # known = every registered family plus histogram exposition series
+        known = set()
+        for name, sites in regs.items():
+            for kind, _labels, _p, _ln in sites:
+                known.update(_derived_names(name, kind))
+
+        # XTB401: every registered family must be in the docs catalog
+        obs = project.doc_text("observability.md")
+        if obs is not None:
+            for name, sites in sorted(regs.items()):
+                if name not in obs:
+                    kind, _labels, path, line = sites[0]
+                    findings.append(Finding(
+                        path, line, 0, "XTB401",
+                        f"metric {name!r} ({kind}) is not documented in "
+                        f"{project.doc_path('observability.md')} — add it "
+                        f"to the metrics catalog"))
+
+        # XTB403: metric-shaped mentions (code strings + docs) must resolve
+        if regs:
+            doc_mentions: List[Tuple[str, str, int]] = []
+            for doc in _DOCS:
+                text = project.doc_text(doc)
+                if text is None:
+                    continue
+                for i, line_text in enumerate(text.splitlines(), start=1):
+                    for token in _TOKEN_RE.findall(line_text):
+                        doc_mentions.append(
+                            (token, project.doc_path(doc), i))
+            for token, path, line in mentions + doc_mentions:
+                if _metric_shaped(token) and token not in known:
+                    findings.append(Finding(
+                        path, line, 0, "XTB403",
+                        f"{token!r} looks like a metric name but nothing "
+                        f"registers it (renamed series? native symbol "
+                        f"missing from the prefix allowlist?)"))
+        return findings

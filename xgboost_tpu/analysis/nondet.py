@@ -1,0 +1,86 @@
+"""XTB5xx — nondeterminism in reproducible code paths.
+
+Training is contractually bit-reproducible (quantised histograms, relay
+collectives, kill/resume parity tests), which makes wall-clock reads and
+unseeded RNG in those paths latent reproducibility bugs even when today's
+call sites look harmless:
+
+- **XTB501** — ``time.time()``: wall clock, steps on NTP adjustments and
+  is not monotonic.  Timing code here uses ``time.monotonic()`` /
+  ``time.perf_counter_ns()``; scheduling uses deadlines derived from
+  monotonic clocks.  (``time.sleep`` is fine — duration, not a reading.)
+- **XTB502** — module-level ``random.*`` / ``np.random.*`` convenience
+  functions draw from ambient global state no test controls.  The
+  sanctioned forms are explicit seeded generators:
+  ``random.Random(seed)`` (retry jitter, seeded per rank/op) and
+  ``np.random.default_rng(seed)`` / ``Generator`` / ``SeedSequence``
+  (column sampling, test data).
+
+Scope: the whole package except ``testing/`` (fixture helpers may be
+time-seeded) and ``analysis/`` (the linter itself).  The sanctioned
+constructors are allowed *everywhere* — the rule flags ambient-state
+draws, not randomness.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import Finding, Project, Rule, SourceFile
+
+_EXEMPT_PREFIXES = ("testing/", "analysis/")
+
+_RANDOM_MODULE_FNS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "lognormvariate",
+    "expovariate", "vonmisesvariate", "paretovariate", "weibullvariate",
+    "betavariate", "triangular", "getrandbits", "randbytes", "seed",
+}
+_NP_RANDOM_ALLOWED = {
+    "default_rng", "Generator", "SeedSequence", "PCG64", "Philox",
+    "MT19937", "BitGenerator",
+}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+
+
+class NondeterminismRule(Rule):
+    name = "nondeterminism"
+    codes = {
+        "XTB501": "time.time() in a reproducible code path (use "
+                  "time.monotonic()/perf_counter_ns())",
+        "XTB502": "ambient-state RNG (random.* / np.random.*) in a "
+                  "reproducible code path (use a seeded generator)",
+    }
+
+    def check_file(self, sf: SourceFile, project: Project,
+                   ) -> Iterable[Finding]:
+        if sf.rel.startswith(_EXEMPT_PREFIXES):
+            return ()
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            base = node.value
+            if (isinstance(base, ast.Name) and base.id == "time"
+                    and node.attr == "time"):
+                findings.append(sf.finding(
+                    node, "XTB501",
+                    "time.time() is wall-clock (non-monotonic, NTP-"
+                    "steppable); use time.monotonic() or "
+                    "time.perf_counter_ns()"))
+            elif (isinstance(base, ast.Name) and base.id == "random"
+                  and node.attr in _RANDOM_MODULE_FNS):
+                findings.append(sf.finding(
+                    node, "XTB502",
+                    f"random.{node.attr} draws from the ambient global "
+                    f"RNG; use an explicit random.Random(seed) instance"))
+            elif (isinstance(base, ast.Attribute)
+                  and base.attr == "random"
+                  and isinstance(base.value, ast.Name)
+                  and base.value.id in _NUMPY_ALIASES
+                  and node.attr not in _NP_RANDOM_ALLOWED):
+                findings.append(sf.finding(
+                    node, "XTB502",
+                    f"np.random.{node.attr} uses the legacy global RNG; "
+                    f"use np.random.default_rng(seed)"))
+        return findings

@@ -1,0 +1,47 @@
+"""xtblint output: human text and machine JSON (trend-tracking shape).
+
+The JSON report is what ``scripts/lint_gate.sh`` persists into
+``bench_out/lint_report.json`` — findings AND suppressed findings, so a
+suppression added to silence a rule shows up in the trend instead of
+vanishing.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List
+
+from .core import LintResult
+
+TOOL = "xtblint"
+VERSION = "1.0"
+
+
+def render_text(result: LintResult, *, verbose: bool = False) -> str:
+    lines: List[str] = [f.render() for f in result.findings]
+    lines.extend(f"ERROR {e}" for e in result.errors)
+    if verbose and result.suppressed:
+        lines.extend(f"suppressed: {f.render()}" for f in result.suppressed)
+    n = len(result.findings)
+    summary = (f"{TOOL}: {n} finding{'s' if n != 1 else ''}, "
+               f"{len(result.suppressed)} suppressed, "
+               f"{result.files_scanned} files scanned")
+    if result.errors:
+        summary += f", {len(result.errors)} errors"
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    counts = Counter(f.code for f in result.findings)
+    payload = {
+        "tool": TOOL,
+        "version": VERSION,
+        "clean": result.clean,
+        "files_scanned": result.files_scanned,
+        "counts": dict(sorted(counts.items())),
+        "findings": [f.as_dict() for f in result.findings],
+        "suppressed": [f.as_dict() for f in result.suppressed],
+        "errors": list(result.errors),
+    }
+    return json.dumps(payload, indent=2, sort_keys=False) + "\n"

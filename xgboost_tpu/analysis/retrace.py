@@ -1,0 +1,267 @@
+"""XTB1xx — retrace / host-sync hazards inside traced function bodies.
+
+A ``@jax.jit`` (or ``pallas_call``) body runs under tracing: any call that
+needs a *concrete* value — ``float()``/``int()``/``bool()`` on a traced
+array, ``.item()``/``.tolist()``, ``jax.device_get``, or a ``np.*``
+function over traced operands — either blocks on a device sync (silently
+serializing the hot path) or raises ``TracerArrayConversionError`` only
+on the first input that takes that branch.  Both failure modes are
+exactly what ``xtb_compiles_total`` / ``xtb_compiles_steady`` catch at
+runtime; this rule catches them pre-merge.
+
+Traced bodies are found lexically, per file:
+
+- functions decorated with ``jit`` / ``jax.jit`` /
+  ``functools.partial(jax.jit, ...)``;
+- local/module functions and lambdas referenced by name anywhere inside a
+  ``jax.jit(...)`` or ``pallas_call(...)`` call expression (covers the
+  ``self._fn = jax.jit(_shard_map(fn, ...))`` pattern in
+  ``parallel/grower.py``);
+- functions nested inside a traced body (they execute during the trace).
+
+Host-side work on *statically known* values is allowed — that is how the
+FFI entry points legitimately pass ``np.int32(k)`` attributes.  Static
+means: constants, ``static_argnames``/``static_argnums`` parameters,
+``len(...)``, ``.shape``/``.ndim``/``.size``/``.dtype`` expressions,
+``x is (not) None`` checks (concrete at trace time), and locals assigned
+only from static expressions (a small per-function dataflow fixpoint).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .core import Finding, Project, Rule, SourceFile
+
+_JIT_NAMES = {"jit"}
+_PALLAS_NAMES = {"pallas_call"}
+_HOST_SYNC_BUILTINS = {"float", "int", "bool"}
+_HOST_PULL_METHODS = {"item", "tolist"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+_STATIC_BUILTINS = {"len", "int", "float", "bool", "max", "min", "round",
+                    "abs", "range", "tuple", "str", "isinstance", "getattr",
+                    "hasattr"}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+
+
+def _attr_tail(node: ast.expr) -> str:
+    """Last component of a Name/Attribute chain ('' otherwise)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_jit_expr(func: ast.expr) -> bool:
+    """``jit`` / ``jax.jit`` / ``partial(jax.jit, ...)`` as a callee."""
+    if _attr_tail(func) in _JIT_NAMES:
+        return True
+    if isinstance(func, ast.Call) and _attr_tail(func.func) == "partial":
+        return any(_attr_tail(a) in _JIT_NAMES for a in func.args[:1])
+    return False
+
+
+def _is_tracing_call(call: ast.Call) -> bool:
+    return (_is_jit_expr(call.func)
+            or _attr_tail(call.func) in _PALLAS_NAMES)
+
+
+def _static_params_from_jit(call: ast.Call, fn: Optional[ast.AST],
+                            ) -> Set[str]:
+    """Parameter names pinned static by ``static_argnames``/``static_argnums``
+    keywords of a jit/partial call."""
+    out: Set[str] = set()
+    argnames: List[str] = []
+    if fn is not None and isinstance(fn, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+        argnames = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            vals = (kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    out.add(v.value)
+        elif kw.arg == "static_argnums":
+            vals = (kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value])
+            for v in vals:
+                if (isinstance(v, ast.Constant)
+                        and isinstance(v.value, int)
+                        and 0 <= v.value < len(argnames)):
+                    out.add(argnames[v.value])
+    return out
+
+
+def _is_numpy_call(func: ast.expr) -> bool:
+    """Any ``np.<...>(...)`` / ``numpy.<...>(...)`` callee, including
+    nested chains like ``np.linalg.norm``."""
+    node = func
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id in _NUMPY_ALIASES
+
+
+class _StaticEnv:
+    """Static-expression oracle for one traced function: the pinned static
+    parameters plus locals assigned only from static expressions."""
+
+    def __init__(self, fn: ast.AST, static_params: Set[str]) -> None:
+        self.names: Set[str] = set(static_params)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        assigns: Dict[str, List[ast.expr]] = {}
+        targets_seen: Set[str] = set()
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            assigns.setdefault(t.id, []).append(node.value)
+                            targets_seen.add(t.id)
+                        else:  # tuple unpack etc: give up on those names
+                            for el in ast.walk(t):
+                                if isinstance(el, ast.Name):
+                                    targets_seen.add(el.id)
+                                    assigns.setdefault(el.id, []).append(
+                                        None)  # type: ignore[arg-type]
+                elif isinstance(node, (ast.AugAssign, ast.For)):
+                    t = node.target
+                    for el in ast.walk(t):
+                        if isinstance(el, ast.Name):
+                            assigns.setdefault(el.id, []).append(
+                                None)  # type: ignore[arg-type]
+        changed = True
+        while changed:
+            changed = False
+            for name, values in assigns.items():
+                if name in self.names:
+                    continue
+                if all(v is not None and self.is_static(v) for v in values):
+                    self.names.add(name)
+                    changed = True
+
+    def is_static(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            return node.attr in _STATIC_ATTRS or self.is_static(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_static(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.is_static(node.left) and self.is_static(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_static(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return all(self.is_static(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return True  # identity checks are concrete at trace time
+            return (self.is_static(node.left)
+                    and all(self.is_static(c) for c in node.comparators))
+        if isinstance(node, ast.IfExp):
+            return (self.is_static(node.body)
+                    and self.is_static(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return all(self.is_static(e) for e in node.elts)
+        if isinstance(node, ast.Call):
+            tail = _attr_tail(node.func)
+            if tail in _STATIC_BUILTINS and isinstance(node.func, ast.Name):
+                return all(self.is_static(a) for a in node.args)
+            return False
+        return False
+
+
+class RetraceRule(Rule):
+    name = "retrace-hazards"
+    codes = {
+        "XTB101": "host-sync builtin (float/int/bool) on a traced value "
+                  "inside a jit/pallas body",
+        "XTB102": "explicit host transfer (.item()/.tolist()/device_get) "
+                  "inside a jit/pallas body",
+        "XTB103": "numpy call on traced operands inside a jit/pallas body "
+                  "(numpy executes on host and forces a sync)",
+    }
+
+    # ------------------------------------------------------------ discovery
+    def _traced_functions(self, tree: ast.AST) -> List[tuple]:
+        """[(function node, static param names)]"""
+        traced: List[tuple] = []
+        traced_names: Set[str] = set()
+        funcs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs_by_name.setdefault(node.name, []).append(node)
+                for dec in node.decorator_list:
+                    if _is_jit_expr(dec):
+                        static = (_static_params_from_jit(dec, node)
+                                  if isinstance(dec, ast.Call) else set())
+                        traced.append((node, static))
+                        break
+                    if isinstance(dec, ast.Call) and _is_jit_expr(dec.func):
+                        traced.append(
+                            (node, _static_params_from_jit(dec, node)))
+                        break
+            elif isinstance(node, ast.Call) and _is_tracing_call(node):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Lambda):
+                        traced.append((sub, set()))
+                    elif isinstance(sub, ast.Name) and sub is not node.func:
+                        traced_names.add(sub.id)
+        for name in traced_names:
+            for fn in funcs_by_name.get(name, ()):
+                traced.append((fn, set()))
+        seen: Set[int] = set()
+        out = []
+        for fn, static in traced:
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                out.append((fn, static))
+        return out
+
+    # ------------------------------------------------------------- checking
+    def check_file(self, sf: SourceFile, project: Project,
+                   ) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn, static_params in self._traced_functions(sf.tree):
+            env = _StaticEnv(fn, static_params)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    tail = _attr_tail(node.func)
+                    if (isinstance(node.func, ast.Name)
+                            and tail in _HOST_SYNC_BUILTINS
+                            and node.args
+                            and not env.is_static(node.args[0])):
+                        findings.append(sf.finding(
+                            node, "XTB101",
+                            f"{tail}() on a possibly-traced value inside "
+                            f"a traced body (forces a host sync; hoist it "
+                            f"out of the jit or use jnp)"))
+                    elif (isinstance(node.func, ast.Attribute)
+                          and tail in _HOST_PULL_METHODS):
+                        findings.append(sf.finding(
+                            node, "XTB102",
+                            f".{tail}() inside a traced body transfers to "
+                            f"host per trace — move it outside the jit "
+                            f"boundary"))
+                    elif tail == "device_get":
+                        findings.append(sf.finding(
+                            node, "XTB102",
+                            "jax.device_get inside a traced body — move "
+                            "the transfer outside the jit boundary"))
+                    elif (_is_numpy_call(node.func)
+                          and not all(env.is_static(a) for a in node.args)):
+                        findings.append(sf.finding(
+                            node, "XTB103",
+                            f"numpy call ({ast.unparse(node.func)}) on "
+                            f"traced operands inside a traced body — "
+                            f"numpy runs on host; use jnp or hoist to "
+                            f"the caller"))
+        return findings

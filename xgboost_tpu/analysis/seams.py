@@ -1,0 +1,125 @@
+"""XTB3xx — fault-seam consistency.
+
+The fault-injection harness (``reliability/faults.py``) and its users
+agree only through *strings*: a seam fires because some call site passes
+``maybe_inject("train.round")`` and a fault plan names the same string.
+Nothing at runtime ever cross-checks the set — a typo'd seam silently
+never fires, and a seam removed from code leaves plans and docs pointing
+at nothing.  This rule makes ``faults.SEAMS`` the single source of truth:
+
+- **XTB301** — a ``maybe_inject("...")`` call site uses a seam name that
+  is not in ``SEAMS`` (typo or undeclared seam);
+- **XTB302** — a ``SEAMS`` member no call site ever injects (dead seam:
+  plans targeting it silently no-op);
+- **XTB303** — a ``SEAMS`` member missing from the seam table in
+  ``docs/reliability.md`` (the documented operator contract);
+- **XTB304** — ``maybe_inject`` called with a non-literal seam name
+  (dynamic names defeat every static check, including this one).
+
+When the scanned set does not include a ``SEAMS`` definition (linting a
+subtree), the cross-checks are skipped — per-file XTB304 still applies.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, Project, Rule, SourceFile
+
+_FACT_USES = "seams.uses"       # list[(seam, path, line, col)]
+_FACT_DECL = "seams.declared"   # (set[str], path, line)
+
+
+def _call_tail(func: ast.expr) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _frozenset_literal(node: ast.expr) -> Optional[Set[str]]:
+    """String members of ``frozenset({...})`` / ``frozenset((...))``."""
+    if not (isinstance(node, ast.Call)
+            and _call_tail(node.func) == "frozenset" and node.args):
+        return None
+    arg = node.args[0]
+    if isinstance(arg, (ast.Set, ast.Tuple, ast.List)):
+        out = set()
+        for el in arg.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            out.add(el.value)
+        return out
+    return None
+
+
+class SeamConsistencyRule(Rule):
+    name = "seam-consistency"
+    codes = {
+        "XTB301": "maybe_inject() seam name not declared in faults.SEAMS",
+        "XTB302": "declared seam never injected anywhere (dead seam)",
+        "XTB303": "declared seam missing from the docs/reliability.md "
+                  "seam table",
+        "XTB304": "maybe_inject() with a non-literal seam name",
+    }
+
+    def check_file(self, sf: SourceFile, project: Project,
+                   ) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        uses = project.facts.setdefault(_FACT_USES, [])
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Call)
+                    and _call_tail(node.func).endswith("maybe_inject")):
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    uses.append((arg.value, sf.path, node.lineno,
+                                 node.col_offset))
+                else:
+                    findings.append(sf.finding(
+                        node, "XTB304",
+                        "maybe_inject() seam name must be a string literal "
+                        "(dynamic names cannot be checked against SEAMS)"))
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "SEAMS":
+                        members = _frozenset_literal(node.value)
+                        if members is not None:
+                            project.facts[_FACT_DECL] = (
+                                members, sf.path, node.lineno)
+        return findings
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        decl = project.facts.get(_FACT_DECL)
+        uses: List[Tuple[str, str, int, int]] = (
+            project.facts.get(_FACT_USES) or [])
+        if decl is None:
+            return ()
+        seams, decl_path, decl_line = decl
+        findings: List[Finding] = []
+        used_names: Dict[str, None] = {}
+        for seam, path, line, col in uses:
+            used_names.setdefault(seam)
+            if seam not in seams:
+                findings.append(Finding(
+                    path, line, col, "XTB301",
+                    f"seam {seam!r} is not declared in faults.SEAMS "
+                    f"(typo, or add it to the canonical set + docs)"))
+        for seam in sorted(seams - set(used_names)):
+            findings.append(Finding(
+                decl_path, decl_line, 0, "XTB302",
+                f"seam {seam!r} is declared in SEAMS but no "
+                f"maybe_inject() call site fires it (dead seam)"))
+        doc = project.doc_text("reliability.md")
+        if doc is not None:
+            for seam in sorted(seams):
+                if seam not in doc:
+                    findings.append(Finding(
+                        decl_path, decl_line, 0, "XTB303",
+                        f"seam {seam!r} is not documented in "
+                        f"{project.doc_path('reliability.md')}"))
+        return findings

@@ -30,6 +30,11 @@ attribute check.
 Every fired fault counts into ``xtb_faults_injected_total{site,kind}``
 (telemetry registry), so a test can assert not just the failure's effect
 but that the harness — not an unrelated bug — caused it.
+
+``SEAMS`` is the canonical seam set (checked statically by xtblint's
+XTB3xx rules against every call site and docs/reliability.md); setting
+``XGBOOST_TPU_STRICT_SEAMS=1`` additionally rejects unknown seam names at
+runtime, both at the seam and at plan-install time.
 """
 from __future__ import annotations
 
@@ -41,11 +46,53 @@ import time
 from typing import Any, Dict, List, Optional, Union
 
 __all__ = ["FaultInjected", "FaultSpec", "FaultPlan", "install", "clear",
-           "active", "maybe_inject", "ENV_VAR"]
+           "active", "maybe_inject", "ENV_VAR", "SEAMS", "STRICT_ENV"]
 
 ENV_VAR = "XGBOOST_TPU_FAULT_PLAN"
 
+# The canonical seam set — the single source of truth the static analyzer
+# (xtblint XTB3xx) checks every maybe_inject() call site and the
+# docs/reliability.md seam table against.  Adding a seam means adding it
+# here, at the call site, and in the docs — the linter fails the gate on
+# any one of the three drifting.
+SEAMS = frozenset({
+    "train.round",
+    "collective.allreduce",
+    "collective.allgather",
+    "process.allreduce",
+    "tracker.connect",
+    "tracker.connected",
+    "checkpoint.write",
+    "serve.worker",
+})
+
+# Debug guard: with XGBOOST_TPU_STRICT_SEAMS=1, maybe_inject() rejects
+# seam names outside SEAMS and plans naming unknown sites fail at install
+# time — the runtime complement of the static XTB3xx check (catches seams
+# constructed dynamically, which the linter cannot see).
+STRICT_ENV = "XGBOOST_TPU_STRICT_SEAMS"
+_STRICT: Optional[bool] = None
+
 _KINDS = ("kill", "exception", "delay", "drop_connection", "truncate")
+
+
+def _strict() -> bool:
+    global _STRICT
+    if _STRICT is None:
+        _STRICT = os.environ.get(STRICT_ENV, "").strip() not in ("", "0")
+    return _STRICT
+
+
+def _check_sites(specs) -> None:
+    """Strict-mode seam validation for every plan path (construction AND
+    install — a plan built while strict was off must not slip through)."""
+    if not _strict():
+        return
+    for spec in specs:
+        if spec.site not in SEAMS:
+            raise ValueError(
+                f"unknown fault seam {spec.site!r} (strict mode); "
+                f"known seams: {sorted(SEAMS)}")
 
 
 class FaultInjected(RuntimeError):
@@ -90,6 +137,7 @@ class FaultPlan:
 
     def __init__(self, specs: List[FaultSpec]) -> None:
         self.specs = list(specs)
+        _check_sites(self.specs)
         self._fired: Dict[int, int] = {}    # spec index -> times fired
         self._calls: Dict[str, int] = {}    # site -> invocation counter
         self._lock = threading.Lock()
@@ -148,6 +196,7 @@ def install(plan: Union[FaultPlan, dict, list, str, None]) -> Optional[FaultPlan
     if plan is None:
         _PLAN = None
     elif isinstance(plan, FaultPlan):
+        _check_sites(plan.specs)
         _PLAN = plan
     elif isinstance(plan, str):
         _PLAN = FaultPlan.from_json(plan)
@@ -159,10 +208,12 @@ def install(plan: Union[FaultPlan, dict, list, str, None]) -> Optional[FaultPlan
 
 def clear() -> None:
     """Remove the installed plan AND forget the env var was consumed, so a
-    test that mutates ``XGBOOST_TPU_FAULT_PLAN`` gets a fresh load."""
-    global _PLAN, _ENV_CHECKED
+    test that mutates ``XGBOOST_TPU_FAULT_PLAN`` (or the strict-seams
+    flag) gets a fresh load."""
+    global _PLAN, _ENV_CHECKED, _STRICT
     _PLAN = None
     _ENV_CHECKED = False
+    _STRICT = None
 
 
 def active() -> Optional[FaultPlan]:
@@ -200,6 +251,9 @@ def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
     Applies ``kill``/``exception``/``delay`` here; returns the spec for
     caller-applied kinds (``drop_connection``, ``truncate``) and for
     ``delay`` (so callers can log), else None."""
+    if _strict() and site not in SEAMS:
+        raise ValueError(f"unknown fault seam {site!r} (strict mode); "
+                         f"known seams: {sorted(SEAMS)}")
     plan = _PLAN  # fast path: installed-plan check is one global read
     if plan is None:
         plan = active()

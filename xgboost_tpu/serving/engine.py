@@ -100,8 +100,10 @@ class _Program:
         # donated-path callers hold this from margin_padded through their
         # host copy-out: the buffer pushed to _scratch is the CALLER'S result,
         # so a second thread (warmup racing the batcher worker on the same
-        # program) must not pop and donate it until the caller has drained it
-        self.donate_lock = threading.Lock()
+        # program) must not pop and donate it until the caller has drained it.
+        # Re-entrant: margin_padded/base_dev guard their own stores while a
+        # caller already holds the drain-scope lock
+        self.donate_lock = threading.RLock()
         self.seen_shapes = set()  # (bucket, F, margin) served at least once
         self._base_dev = None
         if self.donate:  # pragma: no cover - accelerator-only path
@@ -115,7 +117,9 @@ class _Program:
         if self._base_dev is None:
             import jax.numpy as jnp
 
-            self._base_dev = jnp.asarray(self.snap.base_score)
+            with self.donate_lock:
+                if self._base_dev is None:
+                    self._base_dev = jnp.asarray(self.snap.base_score)
         return self._base_dev
 
     def margin_padded(self, Xp, donate: bool = True):
@@ -124,13 +128,15 @@ class _Program:
         import jax.numpy as jnp  # pragma: no cover - accelerator-only path
 
         B = Xp.shape[0]
-        scratch = self._scratch.pop(B, None)
-        if scratch is None:
-            scratch = jnp.zeros((B, self.snap.n_groups), jnp.float32)
-        out = self._fn(scratch, Xp)
-        # recycle: the caller holds donate_lock until its result is copied to
-        # host, so the next donated call cannot reuse this buffer early
-        self._scratch[B] = out
+        with self.donate_lock:  # re-entrant under the caller's drain scope
+            scratch = self._scratch.pop(B, None)
+            if scratch is None:
+                scratch = jnp.zeros((B, self.snap.n_groups), jnp.float32)
+            out = self._fn(scratch, Xp)
+            # recycle: the caller holds donate_lock until its result is
+            # copied to host, so the next donated call cannot reuse this
+            # buffer early
+            self._scratch[B] = out
         return out
 
 
@@ -367,9 +373,10 @@ class ServingEngine:
         return snap
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
+        with self._warm_lock:
+            if self._closed:
+                return
+            self._closed = True
         if self._batcher is not None:
             self._batcher.close()
 

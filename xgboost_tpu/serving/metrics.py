@@ -161,8 +161,9 @@ class ServingMetrics:
 
     @compiles_warmup.setter
     def compiles_warmup(self, v: int) -> None:
-        d = int(v) - self._compiles_warmup
-        self._compiles_warmup = int(v)
+        with self._lock:
+            d = int(v) - self._compiles_warmup
+            self._compiles_warmup = int(v)
         if d > 0:
             self._ins.compiles_warmup.inc(d)
 
@@ -172,8 +173,9 @@ class ServingMetrics:
 
     @compiles_steady.setter
     def compiles_steady(self, v: int) -> None:
-        d = int(v) - self._compiles_steady
-        self._compiles_steady = int(v)
+        with self._lock:
+            d = int(v) - self._compiles_steady
+            self._compiles_steady = int(v)
         if d > 0:
             self._ins.compiles_steady.inc(d)
 

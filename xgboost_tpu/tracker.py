@@ -305,8 +305,10 @@ class RabitTracker:
     def start(self) -> None:
         self._listener.listen(self.n_workers)
         self._relay.start()
-        self._thread = threading.Thread(target=self._serve, daemon=True)
-        self._thread.start()
+        t = threading.Thread(target=self._serve, daemon=True)
+        with self._lock:
+            self._thread = t
+        t.start()
 
     def _serve(self) -> None:
         pending = []  # (sort_key, arrival, conn)
@@ -333,7 +335,11 @@ class RabitTracker:
         except OSError:
             return  # freed while accepting
         pending.sort(key=lambda t: (t[0], t[1]))
-        self._conns = [c for (_k, _a, c) in pending]
+        conns = [c for (_k, _a, c) in pending]
+        with self._lock:
+            # publish under the lock: _fan_abort iterates _conns from other
+            # threads the moment the watchers below start
+            self._conns = conns
         # rank 0 hosts the jax.distributed coordinator (it must BIND the
         # address, so the port cannot be allocated here on the tracker's
         # machine — multi-host topologies put them on different hosts):
@@ -436,7 +442,9 @@ class RabitTracker:
             raise RuntimeError(f"tracker: training failed — {self._error}")
 
     def free(self) -> None:
-        self._closing = True  # watcher EOFs from here on are OURS, not deaths
+        with self._lock:
+            # watcher EOFs from here on are OURS, not deaths
+            self._closing = True
         self._relay.close()
         try:
             self._listener.close()
@@ -600,12 +608,13 @@ class TrackerClient:
             pass
 
     def shutdown(self) -> None:
-        if self._coll is not None:
-            try:
-                self._coll.close()
-            except OSError:
-                pass
-            self._coll = None
+        with self._coll_lock:
+            if self._coll is not None:
+                try:
+                    self._coll.close()
+                except OSError:
+                    pass
+                self._coll = None
         try:
             send_msg(self._sock, {"cmd": "shutdown"}, timeout=30.0)
             self._sock.close()
