@@ -401,6 +401,8 @@ def main() -> None:
     throughput = N_ROWS * N_ROUNDS / train_s
     size = (f"{N_ROWS // 10**6}M" if N_ROWS >= 10**6 else f"{N_ROWS // 1000}k")
     tag = " [CPU FALLBACK: TPU tunnel unavailable]" if cpu_fallback else ""
+    from xgboost_tpu.utils import native as _native
+
     result = {
         "metric": f"synthetic-HIGGS {size}x{N_FEATURES} "
                   f"binary:logistic depth{MAX_DEPTH} train throughput{tag}",
@@ -411,6 +413,10 @@ def main() -> None:
         "tier": BENCH_TIER,
         "warmup_s": round(warmup_s, 2),
         "auc": round(float(auc_v), 4),
+        # host-parallelism provenance (docs/native_threading.md): the native
+        # kernel pool width this run used, and the cores it had to use
+        "nthread": _native.get_nthread(),
+        "cores": os.cpu_count(),
     }
     print(json.dumps(result))
 

@@ -1,0 +1,217 @@
+// ThreadSanitizer smoke for the ParallelFor pool and the threaded kernel
+// bodies (native/xtb_kernels.h).  Run by `make -C native tsan` from
+// scripts/nightly_suite.sh.  Covers, under TSAN:
+//
+//   1. threaded f32 + quantised histogram builds, bitwise vs nthread=1;
+//   2. threaded split scan + raw predict, bitwise vs nthread=1;
+//   3. CONCURRENT predict callers (4 host threads sharing the pool — the
+//      busy-pool inline-fallback path the narrowed C-API dispatch relies
+//      on), each caller bitwise vs the sequential reference;
+//   4. injected worker death (xtb_pool_kill_worker, the
+//      `native.parallel_for` fault seam): region completes, results stay
+//      correct, the pool respawns to full strength.
+//
+// Exits 0 + prints TSAN-SMOKE-OK when every check passes (TSAN itself
+// fails the process on a detected race).
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+#define XTB_DEFINE_POOL_ABI
+#include "xtb_kernels.h"
+
+namespace {
+
+constexpr int64_t R = 20000;
+constexpr int32_t F = 13, B = 32, N = 8, C = 2;
+
+struct Data {
+  std::vector<uint8_t> bins;
+  std::vector<float> gpair;
+  std::vector<int32_t> pos;
+};
+
+Data make_data() {
+  Data d;
+  std::mt19937 rng(7);
+  d.bins.resize(R * F);
+  d.gpair.resize(R * C);
+  d.pos.resize(R);
+  for (auto& b : d.bins) b = static_cast<uint8_t>(rng() % (B + 1));
+  std::normal_distribution<float> g;
+  for (auto& v : d.gpair) v = g(rng);
+  for (auto& p : d.pos) p = static_cast<int32_t>(rng() % (2 * N)) + N - 1;
+  return d;
+}
+
+bool bitwise_eq(const float* a, const float* b, size_t n, const char* what) {
+  if (memcmp(a, b, n * sizeof(float)) != 0) {
+    fprintf(stderr, "FAIL: %s not bitwise identical\n", what);
+    return false;
+  }
+  return true;
+}
+
+std::vector<float> run_hist(const Data& d) {
+  std::vector<float> out(static_cast<size_t>(N) * F * B * C);
+  xtb_hist_build_impl(d.bins.data(), d.gpair.data(), d.pos.data(), R, F, B,
+                      N - 1, N, 1, C, out.data());
+  return out;
+}
+
+std::vector<float> run_predict(const Data& d, const std::vector<int32_t>& feat,
+                               const std::vector<float>& thr,
+                               const std::vector<uint8_t>& dleft,
+                               const std::vector<int32_t>& lr,
+                               const std::vector<float>& value,
+                               const std::vector<int32_t>& groups, int32_t T,
+                               int32_t M) {
+  std::vector<float> X(R * F), init(R, 0.5f), out(R);
+  for (int64_t i = 0; i < R * F; ++i)
+    X[i] = static_cast<float>(d.bins[i]) * 0.1f;
+  std::vector<uint8_t> ic(static_cast<size_t>(T) * M, 0),
+      cm(static_cast<size_t>(T) * M, 0);
+  xtb_predict_raw_impl(X.data(), R, F, feat.data(), thr.data(), dleft.data(),
+                       lr.data(), lr.data(), value.data(), groups.data(), T,
+                       M, 4, 1, 1, 0, ic.data(), cm.data(), 1, init.data(),
+                       out.data());
+  return out;
+}
+
+}  // namespace
+
+int main() {
+  Data d = make_data();
+
+  // --- 1. histogram: nthread=1 reference vs threaded, bitwise ---
+  xtb_set_nthread(1);
+  auto ref = run_hist(d);
+  xtb_set_nthread(4);
+  auto thr4 = run_hist(d);
+  if (!bitwise_eq(ref.data(), thr4.data(), ref.size(), "hist nthread=4"))
+    return 1;
+
+  // quantised limbs
+  std::vector<int8_t> limbs(R * 6);
+  std::mt19937 rng(11);
+  for (auto& l : limbs) l = static_cast<int8_t>(rng() % 256 - 128);
+  std::vector<int32_t> q1(static_cast<size_t>(N) * F * B * 6),
+      q4(static_cast<size_t>(N) * F * B * 6);
+  xtb_set_nthread(1);
+  xtb_hist_q_impl(d.bins.data(), limbs.data(), d.pos.data(), R, F, B, N - 1,
+                  N, 1, 6, q1.data());
+  xtb_set_nthread(4);
+  xtb_hist_q_impl(d.bins.data(), limbs.data(), d.pos.data(), R, F, B, N - 1,
+                  N, 1, 6, q4.data());
+  if (memcmp(q1.data(), q4.data(), q1.size() * sizeof(int32_t)) != 0) {
+    fprintf(stderr, "FAIL: hist_q not bitwise identical\n");
+    return 1;
+  }
+
+  // --- 2. split scan, bitwise ---
+  std::vector<float> totals(N * 2);
+  for (int32_t n = 0; n < N; ++n) {
+    totals[n * 2] = 0.5f * n;
+    totals[n * 2 + 1] = 1.0f + n;
+  }
+  std::vector<int32_t> nb(F, B);
+  std::vector<uint8_t> fmask(static_cast<size_t>(N) * F, 1);
+  auto run_split = [&](float* gain, int32_t* feat, int32_t* bin,
+                       uint8_t* dl, float* GL, float* HL) {
+    xtb_split_scan_impl(ref.data(), totals.data(), nb.data(), fmask.data(),
+                        N, F, B, 1.0f, 0.0f, 1.0f, 0.0f, gain, feat, bin, dl,
+                        GL, HL);
+  };
+  std::vector<float> g1(N), g4(N), GL1(N), GL4(N), HL1(N), HL4(N);
+  std::vector<int32_t> f1(N), f4(N), b1(N), b4(N);
+  std::vector<uint8_t> d1(N), d4(N);
+  xtb_set_nthread(1);
+  run_split(g1.data(), f1.data(), b1.data(), d1.data(), GL1.data(),
+            HL1.data());
+  xtb_set_nthread(4);
+  run_split(g4.data(), f4.data(), b4.data(), d4.data(), GL4.data(),
+            HL4.data());
+  if (!bitwise_eq(g1.data(), g4.data(), N, "split gains") ||
+      memcmp(f1.data(), f4.data(), N * sizeof(int32_t)) != 0) {
+    return 1;
+  }
+
+  // --- 3. concurrent predict callers over the shared pool ---
+  const int32_t T = 16, M = 31;
+  std::vector<int32_t> feat(static_cast<size_t>(T) * M), lr(T * M);
+  std::vector<float> thr(T * M), value(T * M);
+  std::vector<uint8_t> dleft(T * M, 1);
+  std::vector<int32_t> groups(T, 0);
+  for (int32_t t = 0; t < T; ++t) {
+    for (int32_t m = 0; m < M; ++m) {
+      const size_t i = static_cast<size_t>(t) * M + m;
+      feat[i] = (2 * m + 2 < M) ? (m % F) : -1;
+      thr[i] = 1.5f + 0.01f * m;
+      lr[i] = (2 * m + 1 < M) ? 2 * m + 1 : m;
+      value[i] = 0.01f * (t + m);
+    }
+  }
+  xtb_set_nthread(1);
+  auto pref = run_predict(d, feat, thr, dleft, lr, value, groups, T, M);
+  xtb_set_nthread(4);
+  bool ok = true;
+  std::vector<std::thread> callers;
+  for (int c = 0; c < 4; ++c) {
+    callers.emplace_back([&] {
+      for (int it = 0; it < 3; ++it) {
+        auto out = run_predict(d, feat, thr, dleft, lr, value, groups, T, M);
+        if (memcmp(out.data(), pref.data(), out.size() * sizeof(float)) != 0)
+          ok = false;
+      }
+    });
+  }
+  for (auto& t : callers) t.join();
+  if (!ok) {
+    fprintf(stderr, "FAIL: concurrent predict diverged\n");
+    return 1;
+  }
+
+  // --- 4. injected worker death: completes, correct, respawns ---
+  const int64_t faults0 = xtb_pool_faults_total();
+  xtb_pool_kill_worker();
+  auto after_kill = run_hist(d);
+  if (!bitwise_eq(ref.data(), after_kill.data(), ref.size(),
+                  "hist after worker kill"))
+    return 1;
+  if (xtb_pool_faults_total() <= faults0) {
+    fprintf(stderr, "FAIL: injected worker death not recorded\n");
+    return 1;
+  }
+  auto respawned = run_hist(d);  // next region must be back at strength
+  if (!bitwise_eq(ref.data(), respawned.data(), ref.size(),
+                  "hist after respawn") ||
+      xtb_pool_alive_workers() != 3) {
+    fprintf(stderr, "FAIL: pool did not respawn (alive=%d)\n",
+            xtb_pool_alive_workers());
+    return 1;
+  }
+
+  // --- 5. rapid-fire tiny regions: back-to-back dispatch is the ABA
+  // window where a worker lingering past one region's completion must NOT
+  // claim the next region's shards with a stale job pointer ---
+  xtb_set_nthread(4);
+  for (int it = 0; it < 2000; ++it) {
+    std::vector<int64_t> sums(4, 0);
+    xtb_parallel_for(4, 1, XTB_K_OTHER, [&](int64_t b, int64_t e) {
+      for (int64_t i = b; i < e; ++i) sums[i] = i + it;
+    });
+    for (int64_t i = 0; i < 4; ++i) {
+      if (sums[i] != i + it) {
+        fprintf(stderr, "FAIL: rapid-fire region dropped shard %lld\n",
+                static_cast<long long>(i));
+        return 1;
+      }
+    }
+  }
+
+  printf("TSAN-SMOKE-OK regions=%lld\n",
+         static_cast<long long>(xtb_pool_regions_total()));
+  return 0;
+}

@@ -8,16 +8,38 @@
 // are strong PyObject references; every call holds the GIL and converts
 // Python exceptions into the XGBGetLastError contract (c_api_error.h).
 //
-// CONCURRENCY CONTRACT: every entry point acquires the embedded
-// interpreter's GIL for its full duration (API_BEGIN's Gil guard), so the
-// ABI is thread-SAFE but thread-SERIALIZED — N host threads predicting
-// through this library get correct results at single-thread throughput
-// (tests/test_c_api.py test_concurrent_predict_serialized_but_correct).
-// The reference's C API serves truly concurrent predict from one learner
-// (src/c_api/c_api.cc thread-safe Learner); here the supported concurrent
-// path is xgboost_tpu.serving.ServingEngine, which batches concurrent
-// callers into single dispatches instead of multiplying threads
-// (docs/serving.md).
+// CONCURRENCY CONTRACT (dispatch-lock contract, checked by xtblint XTB2xx —
+// docs/static_analysis.md): every entry point still takes the embedded
+// interpreter's GIL while it executes Python, but the GIL is NOT the
+// serializer any more — jax releases it for the duration of each compiled
+// XLA execution, and the native kernels those executions dispatch are
+// internally multi-threaded (native/xtb_kernels.h ParallelFor).  What keeps
+// the ABI safe across those release windows is a process-wide
+// reader/writer dispatch lock:
+//
+//   - API_BEGIN_READ()  — read-only Booster entry points (the predict
+//     family, save/dump/attr getters).  SHARED lock: N host threads
+//     predicting through this library overlap their XLA compute and run at
+//     multi-thread throughput (tests/test_c_api.py
+//     test_concurrent_predict_parallel_throughput).
+//   - API_BEGIN_MUT()   — Booster mutators (train/boost/set-param/load/
+//     reset/attr setters + EvalOneIter, which rewrites the pinned eval
+//     buffer).  EXCLUSIVE lock: mutation stays fully serialized against
+//     both other mutators and in-flight reads.
+//   - API_BEGIN()       — handle-local creation/ingestion (DMatrix, proxy,
+//     tracker, collective).  GIL only: these never share learner state, and
+//     the DataIter callback path re-enters the ABI (a dispatch lock here
+//     would self-deadlock XGDMatrixCreateFromCallback).
+//
+// Lock order is dispatch-lock BEFORE GIL, always: a reader/writer never
+// blocks on the dispatch lock while holding the GIL, so the GIL-release
+// windows inside Python cannot deadlock against a waiting mutator.
+// Prediction result buffers pin per (handle, caller thread) on the glue
+// side (capi_glue.py), the reference's XGBAPIThreadLocalEntry convention,
+// so concurrent readers of one handle never free each other's returns.
+// The reference's C API serves concurrent predict from one learner via a
+// thread-safe Learner (src/c_api/c_api.cc); batching-style concurrency
+// remains the job of xgboost_tpu.serving.ServingEngine (docs/serving.md).
 //
 // Build: native/Makefile (links libpython via python3-config --embed).
 
@@ -29,6 +51,7 @@
 #include <cstdio>
 #include <cstring>
 #include <mutex>
+#include <shared_mutex>
 #include <string>
 
 #define XTB_DLL extern "C" __attribute__((visibility("default")))
@@ -145,12 +168,28 @@ PyObject* CallGlue(const char* method, const char* fmt, ...) {
   return ret;
 }
 
+// Process-wide reader/writer dispatch lock (see the CONCURRENCY CONTRACT
+// above).  One lock for all boosters: per-handle locks would buy nothing —
+// the embedded interpreter is shared anyway — and a single rwlock keeps the
+// acquire order trivially deadlock-free.
+std::shared_mutex g_dispatch_rw;
+
 }  // namespace
 
 #define API_BEGIN()  \
   InitPython();      \
   Gil gil;           \
   try {
+
+// read-only Booster entry: shared dispatch lock, acquired BEFORE the GIL
+#define API_BEGIN_READ()                                   \
+  std::shared_lock<std::shared_mutex> rw_(g_dispatch_rw);  \
+  API_BEGIN()
+
+// mutating Booster entry: exclusive dispatch lock, acquired BEFORE the GIL
+#define API_BEGIN_MUT()                                    \
+  std::unique_lock<std::shared_mutex> rw_(g_dispatch_rw);  \
+  API_BEGIN()
 #define API_END()                               \
   }                                             \
   catch (...) {                                 \
@@ -287,7 +326,7 @@ XTB_DLL int XGBoosterFree(BoosterHandle handle) {
 
 XTB_DLL int XGBoosterSetParam(BoosterHandle handle, const char* name,
                               const char* value) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* r = CallGlue("booster_set_param", "(Oss)", (PyObject*)handle,
                          name, value);
   FAIL_IF_NULL(r);
@@ -298,7 +337,7 @@ XTB_DLL int XGBoosterSetParam(BoosterHandle handle, const char* name,
 
 XTB_DLL int XGBoosterUpdateOneIter(BoosterHandle handle, int iter,
                                    DMatrixHandle dtrain) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* r = CallGlue("booster_update_one_iter", "(OiO)",
                          (PyObject*)handle, iter, (PyObject*)dtrain);
   FAIL_IF_NULL(r);
@@ -309,7 +348,7 @@ XTB_DLL int XGBoosterUpdateOneIter(BoosterHandle handle, int iter,
 
 XTB_DLL int XGBoosterBoostOneIter(BoosterHandle handle, DMatrixHandle dtrain,
                                   float* grad, float* hess, bst_ulong len) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* r = CallGlue("booster_boost_one_iter", "(OOKKK)",
                          (PyObject*)handle, (PyObject*)dtrain,
                          (unsigned long long)(uintptr_t)grad,
@@ -325,7 +364,7 @@ XTB_DLL int XGBoosterEvalOneIter(BoosterHandle handle, int iter,
                                  DMatrixHandle dmats[],
                                  const char* evnames[], bst_ulong len,
                                  const char** out_result) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* dl = PyList_New((Py_ssize_t)len);
   FAIL_IF_NULL(dl);
   PyObject* nl = PyList_New((Py_ssize_t)len);
@@ -371,7 +410,7 @@ XTB_DLL int XGBoosterPredict(BoosterHandle handle, DMatrixHandle dmat,
                              int option_mask, unsigned ntree_limit,
                              int training, bst_ulong* out_len,
                              const float** out_result) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_predict", "(OOiIi)", (PyObject*)handle,
                          (PyObject*)dmat, option_mask, ntree_limit, training);
   FAIL_IF_NULL(r);
@@ -389,7 +428,7 @@ XTB_DLL int XGBoosterPredict(BoosterHandle handle, DMatrixHandle dmat,
 }
 
 XTB_DLL int XGBoosterSaveModel(BoosterHandle handle, const char* fname) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_save_model", "(Os)", (PyObject*)handle,
                          fname);
   FAIL_IF_NULL(r);
@@ -399,7 +438,7 @@ XTB_DLL int XGBoosterSaveModel(BoosterHandle handle, const char* fname) {
 }
 
 XTB_DLL int XGBoosterLoadModel(BoosterHandle handle, const char* fname) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* r = CallGlue("booster_load_model", "(Os)", (PyObject*)handle,
                          fname);
   FAIL_IF_NULL(r);
@@ -411,7 +450,7 @@ XTB_DLL int XGBoosterLoadModel(BoosterHandle handle, const char* fname) {
 XTB_DLL int XGBoosterSaveModelToBuffer(BoosterHandle handle,
                                        const char* config, bst_ulong* out_len,
                                        const char** out_dptr) {
-  API_BEGIN();
+  API_BEGIN_READ();
   // config is '{"format": "json"|"ubj"}' (c_api.cc); default ubj
   const char* fmt = (config && std::strstr(config, "json")) ? "json" : "ubj";
   PyObject* r = CallGlue("booster_save_raw", "(Os)", (PyObject*)handle, fmt);
@@ -439,7 +478,7 @@ XTB_DLL int XGBoosterSaveModelToBuffer(BoosterHandle handle,
 
 XTB_DLL int XGBoosterLoadModelFromBuffer(BoosterHandle handle, const void* buf,
                                          bst_ulong len) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* r = CallGlue("booster_load_raw", "(OKK)", (PyObject*)handle,
                          (unsigned long long)(uintptr_t)buf,
                          (unsigned long long)len);
@@ -451,7 +490,7 @@ XTB_DLL int XGBoosterLoadModelFromBuffer(BoosterHandle handle, const void* buf,
 
 XTB_DLL int XGBoosterGetAttr(BoosterHandle handle, const char* key,
                              const char** out, int* success) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_get_attr", "(Os)", (PyObject*)handle, key);
   FAIL_IF_NULL(r);
   if (r == Py_None) {
@@ -475,7 +514,7 @@ XTB_DLL int XGBoosterGetAttr(BoosterHandle handle, const char* key,
 
 XTB_DLL int XGBoosterSetAttr(BoosterHandle handle, const char* key,
                              const char* value) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* r = (value == nullptr)
                     ? CallGlue("booster_set_attr", "(OsO)", (PyObject*)handle,
                                key, Py_None)
@@ -488,7 +527,7 @@ XTB_DLL int XGBoosterSetAttr(BoosterHandle handle, const char* key,
 }
 
 XTB_DLL int XGBoosterBoostedRounds(BoosterHandle handle, int* out) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_num_boosted_rounds", "(O)",
                          (PyObject*)handle);
   FAIL_IF_NULL(r);
@@ -499,7 +538,7 @@ XTB_DLL int XGBoosterBoostedRounds(BoosterHandle handle, int* out) {
 }
 
 XTB_DLL int XGBoosterGetNumFeature(BoosterHandle handle, bst_ulong* out) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_num_features", "(O)", (PyObject*)handle);
   FAIL_IF_NULL(r);
   *out = (bst_ulong)PyLong_AsUnsignedLongLong(r);
@@ -537,7 +576,7 @@ static int GetCategoriesImpl(const char* glue_method, void* handle,
 
 XTB_DLL int XGBoosterGetCategories(BoosterHandle handle,
                                    const char** out_json) {
-  API_BEGIN();
+  API_BEGIN_READ();
   return GetCategoriesImpl("booster_get_categories", handle, out_json);
   API_END();
 }
@@ -696,8 +735,19 @@ XTB_DLL int XGDMatrixCreateFromCSR(char const* indptr, char const* indices,
 
 XTB_DLL int XGDMatrixCreateFromMat_omp(const float* data, bst_ulong nrow,
                                        bst_ulong ncol, float missing,
-                                       DMatrixHandle* out, int) {
-  return XGDMatrixCreateFromMat(data, nrow, ncol, missing, out);
+                                       DMatrixHandle* out, int nthread) {
+  // nthread is honored (it was name-only ABI compatibility before):
+  // it configures the native ParallelFor pool, the analogue of the
+  // reference's omp_set_num_threads scope (0/negative = default).
+  API_BEGIN();
+  PyObject* d = CallGlue("dmatrix_from_mat_nthread", "(KKKdi)",
+                         (unsigned long long)(uintptr_t)data,
+                         (unsigned long long)nrow, (unsigned long long)ncol,
+                         (double)missing, nthread);
+  FAIL_IF_NULL(d);
+  *out = d;
+  return 0;
+  API_END();
 }
 
 XTB_DLL int XGDMatrixCreateFromURI(char const* config, DMatrixHandle* out) {
@@ -943,7 +993,7 @@ XTB_DLL int XGExtMemQuantileDMatrixCreateFromCallback(
 
 // ---------------------------------------------------------------- Booster
 XTB_DLL int XGBoosterReset(BoosterHandle handle) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* r = CallGlue("booster_reset", "(O)", (PyObject*)handle);
   FAIL_IF_NULL(r);
   Py_DECREF(r);
@@ -953,7 +1003,7 @@ XTB_DLL int XGBoosterReset(BoosterHandle handle) {
 
 XTB_DLL int XGBoosterSlice(BoosterHandle handle, int begin_layer,
                            int end_layer, int step, BoosterHandle* out) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* b = CallGlue("booster_slice", "(Oiii)", (PyObject*)handle,
                          begin_layer, end_layer, step);
   FAIL_IF_NULL(b);
@@ -965,7 +1015,7 @@ XTB_DLL int XGBoosterSlice(BoosterHandle handle, int begin_layer,
 XTB_DLL int XGBoosterTrainOneIter(BoosterHandle handle, DMatrixHandle dtrain,
                                   int iter, char const* grad,
                                   char const* hess) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* r = CallGlue("booster_train_one_iter", "(OOiss)",
                          (PyObject*)handle, (PyObject*)dtrain, iter, grad,
                          hess);
@@ -981,7 +1031,7 @@ XTB_DLL int XGBoosterPredictFromDMatrix(BoosterHandle handle,
                                         bst_ulong const** out_shape,
                                         bst_ulong* out_dim,
                                         float const** out_result) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_predict_from_dmatrix", "(OOs)",
                          (PyObject*)handle, (PyObject*)dmat, config);
   FAIL_IF_NULL(r);
@@ -995,7 +1045,7 @@ XTB_DLL int XGBoosterPredictFromDense(BoosterHandle handle,
                                       bst_ulong const** out_shape,
                                       bst_ulong* out_dim,
                                       const float** out_result) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* meta = m ? (PyObject*)m : Py_None;
   PyObject* r = CallGlue("booster_inplace_predict_dense", "(OssO)",
                          (PyObject*)handle, values, config, meta);
@@ -1011,7 +1061,7 @@ XTB_DLL int XGBoosterPredictFromCSR(BoosterHandle handle, char const* indptr,
                                     bst_ulong const** out_shape,
                                     bst_ulong* out_dim,
                                     const float** out_result) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* meta = m ? (PyObject*)m : Py_None;
   PyObject* r = CallGlue("booster_inplace_predict_csr", "(OsssKsO)",
                          (PyObject*)handle, indptr, indices, values,
@@ -1024,7 +1074,7 @@ XTB_DLL int XGBoosterPredictFromCSR(BoosterHandle handle, char const* indptr,
 XTB_DLL int XGBoosterSerializeToBuffer(BoosterHandle handle,
                                        bst_ulong* out_len,
                                        const char** out_dptr) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_serialize", "(O)", (PyObject*)handle);
   FAIL_IF_NULL(r);
   unsigned long long n = 0;
@@ -1050,7 +1100,7 @@ XTB_DLL int XGBoosterSerializeToBuffer(BoosterHandle handle,
 
 XTB_DLL int XGBoosterUnserializeFromBuffer(BoosterHandle handle,
                                            const void* buf, bst_ulong len) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* r = CallGlue("booster_unserialize", "(OKK)", (PyObject*)handle,
                          (unsigned long long)(uintptr_t)buf,
                          (unsigned long long)len);
@@ -1062,7 +1112,7 @@ XTB_DLL int XGBoosterUnserializeFromBuffer(BoosterHandle handle,
 
 XTB_DLL int XGBoosterSaveJsonConfig(BoosterHandle handle, bst_ulong* out_len,
                                     char const** out_str) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_save_json_config", "(O)", (PyObject*)handle);
   FAIL_IF_NULL(r);
   unsigned long long n = 0;
@@ -1088,7 +1138,7 @@ XTB_DLL int XGBoosterSaveJsonConfig(BoosterHandle handle, bst_ulong* out_len,
 
 XTB_DLL int XGBoosterLoadJsonConfig(BoosterHandle handle,
                                     char const* config) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* r = CallGlue("booster_load_json_config", "(Os)", (PyObject*)handle,
                          config);
   FAIL_IF_NULL(r);
@@ -1101,7 +1151,7 @@ XTB_DLL int XGBoosterDumpModelEx(BoosterHandle handle, const char* fmap,
                                  int with_stats, const char* format,
                                  bst_ulong* out_len,
                                  const char*** out_dump_array) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_dump_model", "(Osis)", (PyObject*)handle,
                          fmap ? fmap : "", with_stats,
                          format ? format : "text");
@@ -1121,7 +1171,7 @@ XTB_DLL int XGBoosterDumpModelExWithFeatures(
     BoosterHandle handle, int fnum, const char** fname, const char** ftype,
     int with_stats, const char* format, bst_ulong* out_len,
     const char*** out_models) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* names = StrList(fname, (bst_ulong)fnum);
   FAIL_IF_NULL(names);
   PyObject* types = StrList(ftype, (bst_ulong)fnum);
@@ -1152,7 +1202,7 @@ XTB_DLL int XGBoosterDumpModelWithFeatures(BoosterHandle handle, int fnum,
 
 XTB_DLL int XGBoosterGetAttrNames(BoosterHandle handle, bst_ulong* out_len,
                                   const char*** out) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_get_attr_names", "(O)", (PyObject*)handle);
   FAIL_IF_NULL(r);
   return StrArrayResult(r, out_len, out);
@@ -1163,7 +1213,7 @@ XTB_DLL int XGBoosterSetStrFeatureInfo(BoosterHandle handle,
                                        const char* field,
                                        const char** features,
                                        const bst_ulong size) {
-  API_BEGIN();
+  API_BEGIN_MUT();
   PyObject* l = StrList(features, size);
   FAIL_IF_NULL(l);
   PyObject* r = CallGlue("booster_set_str_feature_info", "(OsO)",
@@ -1178,7 +1228,7 @@ XTB_DLL int XGBoosterSetStrFeatureInfo(BoosterHandle handle,
 XTB_DLL int XGBoosterGetStrFeatureInfo(BoosterHandle handle,
                                        const char* field, bst_ulong* len,
                                        const char*** out_features) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_get_str_feature_info", "(Os)",
                          (PyObject*)handle, field);
   FAIL_IF_NULL(r);
@@ -1192,7 +1242,7 @@ XTB_DLL int XGBoosterFeatureScore(BoosterHandle handle, const char* config,
                                   bst_ulong* out_dim,
                                   bst_ulong const** out_shape,
                                   float const** out_scores) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* r = CallGlue("booster_feature_score", "(Os)", (PyObject*)handle,
                          config);
   FAIL_IF_NULL(r);
@@ -1420,7 +1470,7 @@ XTB_DLL int XGBoosterPredictFromColumnar(BoosterHandle handle,
                                          bst_ulong const** out_shape,
                                          bst_ulong* out_dim,
                                          const float** out_result) {
-  API_BEGIN();
+  API_BEGIN_READ();
   PyObject* meta = m ? (PyObject*)m : Py_None;
   PyObject* r = CallGlue("booster_inplace_predict_columnar", "(OssO)",
                          (PyObject*)handle, values, config, meta);

@@ -9,6 +9,11 @@
 #include <cstdint>
 
 #include "xla/ffi/api/ffi.h"
+
+// this .so carries its own ParallelFor pool instance; exporting the pool C
+// ABI here lets utils/native.py configure nthread + read pool stats on the
+// library the jitted programs actually dispatch into
+#define XTB_DEFINE_POOL_ABI
 #include "xtb_kernels.h"
 
 namespace ffi = xla::ffi;

@@ -7,21 +7,386 @@
 // src/tree/hist/evaluate_splits.h EnumerateSplit), re-designed around the
 // elementwise `pos` row routing used by the JAX growers instead of the
 // reference's physical row partitions.
+//
+// Every hot kernel is multi-threaded through the ParallelFor pool below
+// (the role of the reference's common/threading_utils.h ParallelFor over
+// OpenMP) under a strict determinism contract: sharding axes are chosen so
+// every output element receives its f32 adds in exactly the order the
+// sequential kernel produces, which keeps results BITWISE IDENTICAL for
+// every nthread — see docs/native_threading.md for the per-kernel scheme.
 #ifndef XTB_KERNELS_H_
 #define XTB_KERNELS_H_
 
+#include <algorithm>
+#include <atomic>
+#include <chrono>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <functional>
+#include <mutex>
+#include <thread>
 #include <vector>
+
+// ===========================================================================
+// ParallelFor pool.
+//
+// Persistent workers, lazy start, one in-flight region at a time.  A region
+// splits [0, n) into at most nthread contiguous shards of >= grain elements;
+// shards are CLAIMED dynamically (load balancing) but shard BOUNDARIES and
+// each shard's internal iteration order are fixed, so any kernel whose
+// shards write disjoint output (all of ours) is bitwise-reproducible for
+// every thread count.  When a second caller dispatches while a region is in
+// flight (concurrent C-API predict), it runs its range inline on its own
+// thread instead of queueing — concurrent callers never serialize on the
+// pool, they just don't multiply threads.
+//
+// Fault containment: a shard body that throws marks the region failed; the
+// dispatcher then re-runs the WHOLE region inline (shard bodies are
+// restart-idempotent: each (re)initialises the output it owns), which is
+// the nthread=1 execution and therefore bitwise-correct.  An injected
+// worker death (xtb_pool_kill_worker, the `native.parallel_for` fault
+// seam) makes one worker exit before claiming shards; the dispatcher
+// drains the remaining shards itself — no hang — and respawns the worker
+// at the end of the region.
+// ===========================================================================
+
+enum XtbKernelId {
+  XTB_K_HIST = 0,
+  XTB_K_HIST_Q,
+  XTB_K_SPLIT,
+  XTB_K_PREDICT,
+  XTB_K_LAMBDARANK,
+  XTB_K_SKETCH,
+  XTB_K_SHAP,
+  XTB_K_OTHER,
+  XTB_K_COUNT,
+};
+
+inline const char* xtb_kernel_name_impl(int k) {
+  static const char* kNames[XTB_K_COUNT] = {
+      "hist", "hist_q", "split", "predict", "lambdarank",
+      "sketch", "shap", "other"};
+  return (k >= 0 && k < XTB_K_COUNT) ? kNames[k] : "";
+}
+
+// Region busy-seconds bucket bounds — MUST match
+// telemetry/registry.py DEFAULT_BUCKETS (1e-4 * 4**i, i in 0..9) so the
+// Python bridge can fold these counts straight into the registry histogram.
+constexpr int kXtbPoolBuckets = 10;  // + 1 overflow slot in the arrays
+
+struct XtbKernelStats {
+  std::atomic<int64_t> regions{0};
+  std::atomic<int64_t> busy_ns{0};
+  std::atomic<int64_t> bucket[kXtbPoolBuckets + 1]{};
+};
+
+class XtbThreadPool {
+ public:
+  static XtbThreadPool& Get() {
+    static XtbThreadPool* pool = new XtbThreadPool();  // never destroyed:
+    // worker threads may outlive static destruction order in the embedding
+    return *pool;
+  }
+
+  // n <= 0 resolves the default (XGBOOST_TPU_NTHREAD env, else hardware
+  // concurrency).  Returns the effective thread count (callers + workers).
+  int set_nthread(int n) {
+    int eff = resolve(n);
+    std::lock_guard<std::mutex> dispatch(dispatch_mu_);  // no region in flight
+    if (eff != target()) {
+      stop_workers();
+      std::lock_guard<std::mutex> g(mu_);
+      target_ = eff;
+    }
+    return eff;
+  }
+
+  int nthread() { return target(); }
+
+  int alive_workers() { return alive_.load(std::memory_order_acquire); }
+
+  // Fault seam (reliability/faults.py `native.parallel_for`): the next
+  // parallel region loses one worker thread before it claims any shard.
+  void kill_worker() { kill_requests_.fetch_add(1); }
+
+  int64_t faults_total() { return faults_.load(); }
+  int64_t regions_total() {
+    int64_t t = 0;
+    for (auto& s : stats_) t += s.regions.load();
+    return t;
+  }
+  const XtbKernelStats& stats(int kernel) {
+    return stats_[(kernel >= 0 && kernel < XTB_K_COUNT) ? kernel
+                                                        : XTB_K_OTHER];
+  }
+
+  void parallel_for(int64_t n, int64_t grain, int kernel,
+                    const std::function<void(int64_t, int64_t)>& fn) {
+    if (n <= 0) return;
+    if (grain < 1) grain = 1;
+    int64_t max_shards = (n + grain - 1) / grain;
+    int S = static_cast<int>(std::min<int64_t>(target(), max_shards));
+    if (S <= 1) {
+      fn(0, n);
+      return;
+    }
+    // one region at a time; a busy pool means another caller owns the
+    // workers right now — run inline rather than queue (concurrent
+    // read-only predict callers each keep their own thread busy)
+    if (!dispatch_mu_.try_lock()) {
+      fn(0, n);
+      return;
+    }
+    std::unique_lock<std::mutex> dispatch(dispatch_mu_, std::adopt_lock);
+    ensure_workers();
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      // retire injected worker deaths at dispatch (not at worker wake):
+      // small regions can drain before a sleeping worker ever wakes, and
+      // the fault seam promises the NEXT region loses a worker
+      retire_requests_ += kill_requests_.exchange(0);
+      job_fn_ = &fn;
+      job_n_ = n;
+      job_shards_ = S;
+      done_shards_.store(0, std::memory_order_relaxed);
+      failed_.store(false, std::memory_order_relaxed);
+      busy_ns_region_.store(0, std::memory_order_relaxed);
+      ++generation_;
+      // generation-tagged shard ticket: claims CAS against the tag, so a
+      // worker that lingers past its region's completion can never claim
+      // (or steal) a shard of the NEXT region with a dangling job pointer
+      ticket_.store(generation_ << kShardBits, std::memory_order_release);
+    }
+    cv_.notify_all();
+    run_shards(&fn, n, S, generation_);  // dispatcher is pool member 0
+    {
+      std::unique_lock<std::mutex> g(mu_);
+      cv_done_.wait(g, [&] {
+        return done_shards_.load(std::memory_order_acquire) >= job_shards_;
+      });
+      job_fn_ = nullptr;
+    }
+    bool failed = failed_.load(std::memory_order_acquire);
+    if (failed) {
+      faults_.fetch_add(1);
+      fn(0, n);  // deterministic recovery: the nthread=1 execution
+    }
+    if (alive_.load() < target() - 1) ensure_workers();  // respawn the dead
+    record(kernel, failed ? 0 : busy_ns_region_.load());
+  }
+
+ private:
+  XtbThreadPool() : target_(resolve(0)) {}
+
+  int target() {
+    std::lock_guard<std::mutex> g(mu_);
+    return target_;
+  }
+
+  static int resolve(int n) {
+    if (n > 0) return std::min(n, 1024);
+    const char* env = std::getenv("XGBOOST_TPU_NTHREAD");
+    if (env && *env) {
+      int v = std::atoi(env);
+      if (v > 0) return std::min(v, 1024);
+    }
+    unsigned hw = std::thread::hardware_concurrency();
+    return hw > 0 ? static_cast<int>(hw) : 1;
+  }
+
+  void record(int kernel, int64_t busy_ns) {
+    auto& s = stats_[(kernel >= 0 && kernel < XTB_K_COUNT) ? kernel
+                                                           : XTB_K_OTHER];
+    s.regions.fetch_add(1, std::memory_order_relaxed);
+    s.busy_ns.fetch_add(busy_ns, std::memory_order_relaxed);
+    double sec = static_cast<double>(busy_ns) * 1e-9;
+    int b = 0;
+    double bound = 1e-4;  // DEFAULT_BUCKETS[0]; bounds quadruple per slot
+    while (b < kXtbPoolBuckets && sec > bound) {
+      bound *= 4.0;
+      ++b;
+    }
+    s.bucket[b].fetch_add(1, std::memory_order_relaxed);
+  }
+
+  void run_shards(const std::function<void(int64_t, int64_t)>* fn, int64_t n,
+                  int S, uint64_t gen) {
+    const uint64_t tag = gen << kShardBits;
+    for (;;) {
+      uint64_t v = ticket_.load(std::memory_order_acquire);
+      uint64_t s = v & ((uint64_t{1} << kShardBits) - 1);
+      if ((v & ~((uint64_t{1} << kShardBits) - 1)) != tag ||
+          s >= static_cast<uint64_t>(S)) {
+        break;  // all shards claimed, or a newer region owns the ticket
+      }
+      if (!ticket_.compare_exchange_weak(v, v + 1,
+                                         std::memory_order_acq_rel)) {
+        continue;  // lost the claim race; re-read
+      }
+      int64_t b = n * static_cast<int64_t>(s) / S;
+      int64_t e = n * (static_cast<int64_t>(s) + 1) / S;
+      auto t0 = std::chrono::steady_clock::now();
+      try {
+        (*fn)(b, e);
+      } catch (...) {
+        failed_.store(true, std::memory_order_release);
+      }
+      busy_ns_region_.fetch_add(
+          std::chrono::duration_cast<std::chrono::nanoseconds>(
+              std::chrono::steady_clock::now() - t0).count(),
+          std::memory_order_relaxed);
+      if (done_shards_.fetch_add(1, std::memory_order_acq_rel) + 1 >= S) {
+        std::lock_guard<std::mutex> g(mu_);
+        cv_done_.notify_all();
+      }
+    }
+  }
+
+  void worker_loop() {
+    uint64_t seen = 0;
+    for (;;) {
+      const std::function<void(int64_t, int64_t)>* fn;
+      int64_t n;
+      int S;
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_.wait(g, [&] {
+          return shutdown_ || retire_requests_ > 0 || generation_ != seen;
+        });
+        if (shutdown_) break;
+        if (retire_requests_ > 0) {
+          --retire_requests_;
+          faults_.fetch_add(1);
+          break;  // injected worker death: exit before claiming any shard
+        }
+        seen = generation_;
+        fn = job_fn_;  // copied under mu_: a late wake after the region
+        n = job_n_;    // completed sees nullptr and just re-arms
+        S = job_shards_;
+      }
+      if (fn == nullptr) continue;
+      run_shards(fn, n, S, seen);
+    }
+    alive_.fetch_sub(1, std::memory_order_acq_rel);
+  }
+
+  // callers hold dispatch_mu_
+  void ensure_workers() {
+    // reap exited threads first (injected deaths leave joinable husks)
+    if (alive_.load(std::memory_order_acquire) <
+        static_cast<int>(workers_.size())) {
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        shutdown_ = true;
+      }
+      cv_.notify_all();
+      for (auto& t : workers_) t.join();
+      workers_.clear();
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        shutdown_ = false;
+      }
+      alive_.store(0, std::memory_order_release);
+    }
+    while (static_cast<int>(workers_.size()) < target_ - 1) {
+      workers_.emplace_back([this] { worker_loop(); });
+      alive_.fetch_add(1, std::memory_order_acq_rel);
+    }
+  }
+
+  // callers hold dispatch_mu_
+  void stop_workers() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      shutdown_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+    workers_.clear();
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      shutdown_ = false;
+    }
+    alive_.store(0, std::memory_order_release);
+  }
+
+  std::mutex dispatch_mu_;  // serializes regions + worker lifecycle
+  std::mutex mu_;           // guards job fields + cv state
+  std::condition_variable cv_, cv_done_;
+  std::vector<std::thread> workers_;
+  int target_;
+  bool shutdown_ = false;
+  uint64_t generation_ = 0;
+  const std::function<void(int64_t, int64_t)>* job_fn_ = nullptr;
+  int64_t job_n_ = 0;
+  int job_shards_ = 0;
+  static constexpr int kShardBits = 20;  // shards per region < 2^20
+  std::atomic<uint64_t> ticket_{0};      // (generation << 20) | next_shard
+  std::atomic<int> done_shards_{0}, alive_{0};
+  std::atomic<int> kill_requests_{0};
+  int retire_requests_ = 0;  // guarded by mu_
+  std::atomic<bool> failed_{false};
+  std::atomic<int64_t> busy_ns_region_{0}, faults_{0};
+  XtbKernelStats stats_[XTB_K_COUNT];
+};
+
+// The one entry point kernels use: run fn(begin, end) over [0, n) shards of
+// >= grain elements on the shared pool (inline when single-shard/busy).
+inline void xtb_parallel_for(int64_t n, int64_t grain, int kernel,
+                             const std::function<void(int64_t, int64_t)>& fn) {
+  XtbThreadPool::Get().parallel_for(n, grain, kernel, fn);
+}
+
+// Per-translation-unit C ABI over the pool (each .so carries its own pool
+// instance; utils/native.py configures every loaded library).  Define
+// XTB_DEFINE_POOL_ABI before including this header in exactly one TU per
+// shared object.
+#ifdef XTB_DEFINE_POOL_ABI
+extern "C" {
+int xtb_set_nthread(int n) { return XtbThreadPool::Get().set_nthread(n); }
+int xtb_get_nthread() { return XtbThreadPool::Get().nthread(); }
+int xtb_pool_alive_workers() { return XtbThreadPool::Get().alive_workers(); }
+void xtb_pool_kill_worker() { XtbThreadPool::Get().kill_worker(); }
+int64_t xtb_pool_faults_total() { return XtbThreadPool::Get().faults_total(); }
+int64_t xtb_pool_regions_total() {
+  return XtbThreadPool::Get().regions_total();
+}
+int xtb_pool_n_kernels() { return XTB_K_COUNT; }
+// gcc emits the pool's inline static with STB_GNU_UNIQUE linkage, so
+// multiple kernel .so's in one process usually SHARE one pool instance;
+// utils/native.py dedupes stats by this id before aggregating
+uint64_t xtb_pool_instance_id() {
+  return reinterpret_cast<uint64_t>(&XtbThreadPool::Get());
+}
+const char* xtb_pool_kernel_name(int k) { return xtb_kernel_name_impl(k); }
+// out: [regions, busy_ns, bucket_0 .. bucket_10] (13 int64 slots)
+void xtb_pool_kernel_stats(int kernel, int64_t* out) {
+  const XtbKernelStats& s = XtbThreadPool::Get().stats(kernel);
+  out[0] = s.regions.load();
+  out[1] = s.busy_ns.load();
+  for (int i = 0; i <= kXtbPoolBuckets; ++i) out[2 + i] = s.bucket[i].load();
+}
+}  // extern "C"
+#endif  // XTB_DEFINE_POOL_ABI
 
 // ---------------------------------------------------------------------------
 // Gradient histogram build — one pass over all rows; each row's F adds land
 // in its node's block (F*n_bin*C floats, cache-resident at bench shapes).
 // stride=2 selects left children only (heap offsets 2j) for the subtraction
 // trick; pos ids outside [node0, node0+stride*n_nodes) add nothing; a bin
-// value >= n_bin is the missing sentinel.  Sequential row order ->
-// deterministic within a topology (same contract as the XLA scatter path).
+// value >= n_bin is the missing sentinel.
+//
+// Threading: FEATURE-sharded.  Each shard sweeps all R rows but touches only
+// its feature columns, so per output element (n, f, b, c) the f32 adds
+// happen in global row order — bitwise identical to the sequential kernel
+// (and to the XLA scatter formulation the parity tests pin) for EVERY
+// nthread.  Row-sharded partial accumulators would be deterministic per
+// thread count but not nthread-invariant: f32 partial-sum merges reassociate
+// the adds.  The per-shard repeat of the pos decode is ~6 ops/row —
+// negligible against the F/S adds it amortises.
 // ---------------------------------------------------------------------------
 template <typename BinT>
 inline void xtb_hist_build_impl(const BinT* bins, const float* gpair,
@@ -29,53 +394,60 @@ inline void xtb_hist_build_impl(const BinT* bins, const float* gpair,
                                 int32_t n_bin, int32_t node0, int32_t n_nodes,
                                 int32_t stride, int32_t C, float* out) {
   const size_t node_sz = static_cast<size_t>(F) * n_bin * C;
-  memset(out, 0, n_nodes * node_sz * sizeof(float));
-  for (int64_t r = 0; r < R; ++r) {
-    int32_t local = pos[r] - node0;
-    if (local < 0) continue;
-    int32_t node;
-    if (stride == 2) {
-      if (local & 1) continue;
-      node = local >> 1;
-    } else if (stride == 1) {
-      node = local;
-    } else {
-      if (local % stride != 0) continue;
-      node = local / stride;
+  const size_t col_sz = static_cast<size_t>(n_bin) * C;
+  auto shard = [=](int64_t f0, int64_t f1) {
+    for (int32_t nd = 0; nd < n_nodes; ++nd) {
+      memset(out + nd * node_sz + f0 * col_sz, 0,
+             (f1 - f0) * col_sz * sizeof(float));
     }
-    if (node >= n_nodes) continue;
-    const BinT* br = bins + r * F;
-    float* ob = out + node * node_sz;
-    if (C == 2) {
-      const float g = gpair[r * 2], h = gpair[r * 2 + 1];
-      for (int32_t f = 0; f < F; ++f) {
-        int32_t b = static_cast<int32_t>(br[f]);
-        if (b < n_bin) {
-          float* p = ob + (static_cast<size_t>(f) * n_bin + b) * 2;
-          p[0] += g;
-          p[1] += h;
+    for (int64_t r = 0; r < R; ++r) {
+      int32_t local = pos[r] - node0;
+      if (local < 0) continue;
+      int32_t node;
+      if (stride == 2) {
+        if (local & 1) continue;
+        node = local >> 1;
+      } else if (stride == 1) {
+        node = local;
+      } else {
+        if (local % stride != 0) continue;
+        node = local / stride;
+      }
+      if (node >= n_nodes) continue;
+      const BinT* br = bins + r * F;
+      float* ob = out + node * node_sz;
+      if (C == 2) {
+        const float g = gpair[r * 2], h = gpair[r * 2 + 1];
+        for (int64_t f = f0; f < f1; ++f) {
+          int32_t b = static_cast<int32_t>(br[f]);
+          if (b < n_bin) {
+            float* p = ob + (static_cast<size_t>(f) * n_bin + b) * 2;
+            p[0] += g;
+            p[1] += h;
+          }
+        }
+      } else {
+        const float* gr = gpair + r * C;
+        for (int64_t f = f0; f < f1; ++f) {
+          int32_t b = static_cast<int32_t>(br[f]);
+          if (b < n_bin) {
+            float* p = ob + (static_cast<size_t>(f) * n_bin + b) * C;
+            for (int32_t c = 0; c < C; ++c) p[c] += gr[c];
+          }
         }
       }
-    } else {
-      const float* gr = gpair + r * C;
-      for (int32_t f = 0; f < F; ++f) {
-        int32_t b = static_cast<int32_t>(br[f]);
-        if (b < n_bin) {
-          float* p = ob + (static_cast<size_t>(f) * n_bin + b) * C;
-          for (int32_t c = 0; c < C; ++c) p[c] += gr[c];
-        }
-      }
     }
-  }
+  };
+  xtb_parallel_for(F, 1, XTB_K_HIST, shard);
 }
 
 // ---------------------------------------------------------------------------
 // Quantised limb-histogram build: int8 signed base-256 limbs accumulated in
 // int32 (ops/quantise.py layout: values (R, C*3) with C=2 channels x 3
 // limbs).  Integer sums are exact and associative, so ANY accumulation
-// order yields identical bits — this kernel exists purely to give the
-// deterministic_histogram contract the same row-pass speed as the f32
-// path on CPU (the XLA int scatter it replaces is ~10x slower).
+// order yields identical bits; the kernel still feature-shards (same scheme
+// as the f32 path, zero extra allocations) rather than row-sharding into
+// partial buffers.
 // ---------------------------------------------------------------------------
 template <typename BinT>
 inline void xtb_hist_q_impl(const BinT* bins, const int8_t* limbs,
@@ -83,32 +455,39 @@ inline void xtb_hist_q_impl(const BinT* bins, const int8_t* limbs,
                             int32_t n_bin, int32_t node0, int32_t n_nodes,
                             int32_t stride, int32_t CL, int32_t* out) {
   const size_t node_sz = static_cast<size_t>(F) * n_bin * CL;
-  memset(out, 0, n_nodes * node_sz * sizeof(int32_t));
-  for (int64_t r = 0; r < R; ++r) {
-    int32_t local = pos[r] - node0;
-    if (local < 0) continue;
-    int32_t node;
-    if (stride == 2) {
-      if (local & 1) continue;
-      node = local >> 1;
-    } else if (stride == 1) {
-      node = local;
-    } else {
-      if (local % stride != 0) continue;
-      node = local / stride;
+  const size_t col_sz = static_cast<size_t>(n_bin) * CL;
+  auto shard = [=](int64_t f0, int64_t f1) {
+    for (int32_t nd = 0; nd < n_nodes; ++nd) {
+      memset(out + nd * node_sz + f0 * col_sz, 0,
+             (f1 - f0) * col_sz * sizeof(int32_t));
     }
-    if (node >= n_nodes) continue;
-    const BinT* br = bins + r * F;
-    const int8_t* lr = limbs + r * CL;
-    int32_t* ob = out + node * node_sz;
-    for (int32_t f = 0; f < F; ++f) {
-      int32_t b = static_cast<int32_t>(br[f]);
-      if (b < n_bin) {
-        int32_t* p = ob + (static_cast<size_t>(f) * n_bin + b) * CL;
-        for (int32_t c = 0; c < CL; ++c) p[c] += lr[c];
+    for (int64_t r = 0; r < R; ++r) {
+      int32_t local = pos[r] - node0;
+      if (local < 0) continue;
+      int32_t node;
+      if (stride == 2) {
+        if (local & 1) continue;
+        node = local >> 1;
+      } else if (stride == 1) {
+        node = local;
+      } else {
+        if (local % stride != 0) continue;
+        node = local / stride;
+      }
+      if (node >= n_nodes) continue;
+      const BinT* br = bins + r * F;
+      const int8_t* lr = limbs + r * CL;
+      int32_t* ob = out + node * node_sz;
+      for (int64_t f = f0; f < f1; ++f) {
+        int32_t b = static_cast<int32_t>(br[f]);
+        if (b < n_bin) {
+          int32_t* p = ob + (static_cast<size_t>(f) * n_bin + b) * CL;
+          for (int32_t c = 0; c < CL; ++c) p[c] += lr[c];
+        }
       }
     }
-  }
+  };
+  xtb_parallel_for(F, 1, XTB_K_HIST_Q, shard);
 }
 
 // ---------------------------------------------------------------------------
@@ -117,6 +496,10 @@ inline void xtb_hist_q_impl(const BinT* bins, const int8_t* limbs,
 // (N,F,B) temporaries.  Mirrors ops/split.py evaluate_splits exactly: both
 // missing directions scored, first-occurrence argmax in (feature, bin)
 // order, same f32 arithmetic.
+//
+// Threading: NODE-sharded — each node's scan is self-contained and writes
+// only its own output slots, so results are bitwise-identical to the
+// sequential scan for every nthread.
 // ---------------------------------------------------------------------------
 inline float xtb_thr_l1(float g, float alpha) {
   float a = fabsf(g) - alpha;
@@ -148,7 +531,8 @@ inline void xtb_split_scan_impl(const float* hist, const float* totals,
                                 float* out_HL) {
   const float kEps = 1e-6f;
   const XtbGainParams p{lambda_, alpha, min_child_weight, max_delta_step};
-  for (int32_t n = 0; n < N; ++n) {
+  auto shard = [=](int64_t lo, int64_t hi) {
+  for (int32_t n = static_cast<int32_t>(lo); n < hi; ++n) {
     const float totG = totals[n * 2], totH = totals[n * 2 + 1];
     if (totG == 0.0f && totH == 0.0f) {
       // dead heap slot (padded shared level program): its histogram is
@@ -241,6 +625,8 @@ inline void xtb_split_scan_impl(const float* hist, const float* totals,
     out_GL[n] = best_GL;
     out_HL[n] = best_HL;
   }
+  };
+  xtb_parallel_for(N, 1, XTB_K_SPLIT, shard);
 }
 
 // ---------------------------------------------------------------------------
@@ -252,6 +638,9 @@ inline void xtb_split_scan_impl(const float* hist, const float* totals,
 // `depth` steps with stick-at-leaf, NaN -> default-left, categorical
 // in-set -> right.  K_leaf == 1 adds the scalar leaf to column groups[t];
 // K_leaf > 1 adds the leaf vector to all K columns (multi-target trees).
+//
+// Threading: ROW-block sharded — rows are independent and each shard owns
+// its init memcpy + output rows, so every nthread is bitwise-identical.
 // ---------------------------------------------------------------------------
 inline void xtb_predict_raw_impl(
     const float* X, int64_t R, int32_t F, const int32_t* feat,
@@ -260,37 +649,41 @@ inline void xtb_predict_raw_impl(
     int32_t T, int32_t M, int32_t depth, int32_t K, int32_t K_leaf,
     int32_t has_cat, const uint8_t* is_cat, const uint8_t* catm, int32_t Bc,
     const float* init, float* out) {
-  memcpy(out, init, static_cast<size_t>(R) * K * sizeof(float));
-  for (int64_t r = 0; r < R; ++r) {
-    const float* xr = X + r * F;
-    float* orow = out + r * K;
-    for (int32_t t = 0; t < T; ++t) {
-      const size_t base = static_cast<size_t>(t) * M;
-      int32_t nid = 0;
-      for (int32_t d = 0; d < depth; ++d) {
-        const int32_t fi = feat[base + nid];
-        if (fi < 0) break;
-        const float x = xr[fi];
-        const bool miss = std::isnan(x);
-        bool gol;
-        if (has_cat && is_cat[base + nid]) {
-          const int32_t c = miss ? -1 : static_cast<int32_t>(x);
-          const bool member =
-              c >= 0 && c < Bc && catm[(base + nid) * Bc + c];
-          gol = miss ? (dleft[base + nid] != 0) : !member;
-        } else {
-          gol = miss ? (dleft[base + nid] != 0) : (x < thr[base + nid]);
+  auto shard = [=](int64_t r0, int64_t r1) {
+    memcpy(out + r0 * K, init + r0 * K,
+           static_cast<size_t>(r1 - r0) * K * sizeof(float));
+    for (int64_t r = r0; r < r1; ++r) {
+      const float* xr = X + r * F;
+      float* orow = out + r * K;
+      for (int32_t t = 0; t < T; ++t) {
+        const size_t base = static_cast<size_t>(t) * M;
+        int32_t nid = 0;
+        for (int32_t d = 0; d < depth; ++d) {
+          const int32_t fi = feat[base + nid];
+          if (fi < 0) break;
+          const float x = xr[fi];
+          const bool miss = std::isnan(x);
+          bool gol;
+          if (has_cat && is_cat[base + nid]) {
+            const int32_t c = miss ? -1 : static_cast<int32_t>(x);
+            const bool member =
+                c >= 0 && c < Bc && catm[(base + nid) * Bc + c];
+            gol = miss ? (dleft[base + nid] != 0) : !member;
+          } else {
+            gol = miss ? (dleft[base + nid] != 0) : (x < thr[base + nid]);
+          }
+          nid = gol ? left[base + nid] : right[base + nid];
         }
-        nid = gol ? left[base + nid] : right[base + nid];
-      }
-      if (K_leaf == 1) {
-        orow[groups[t]] += value[base + nid];
-      } else {
-        const float* v = value + (base + nid) * K_leaf;
-        for (int32_t k = 0; k < K_leaf; ++k) orow[k] += v[k];
+        if (K_leaf == 1) {
+          orow[groups[t]] += value[base + nid];
+        } else {
+          const float* v = value + (base + nid) * K_leaf;
+          for (int32_t k = 0; k < K_leaf; ++k) orow[k] += v[k];
+        }
       }
     }
-  }
+  };
+  xtb_parallel_for(R, 256, XTB_K_PREDICT, shard);
 }
 
 // Binned variant (split_bins routing over an Ellpack page; sentinel
@@ -303,29 +696,33 @@ inline void xtb_predict_binned_impl(
     const int32_t* groups, int32_t T, int32_t M, int32_t depth, int32_t K,
     int32_t has_cat, const uint8_t* is_cat, const uint8_t* catm, int32_t Bc,
     const float* init, float* out) {
-  memcpy(out, init, static_cast<size_t>(R) * K * sizeof(float));
-  for (int64_t r = 0; r < R; ++r) {
-    const BinT* br = bins + r * F;
-    float* orow = out + r * K;
-    for (int32_t t = 0; t < T; ++t) {
-      const size_t base = static_cast<size_t>(t) * M;
-      int32_t nid = 0;
-      for (int32_t d = 0; d < depth; ++d) {
-        const int32_t fi = feat[base + nid];
-        if (fi < 0) break;
-        const int32_t b = static_cast<int32_t>(br[fi]);
-        bool gol;
-        if (has_cat && is_cat[base + nid]) {
-          gol = !(b < Bc && catm[(base + nid) * Bc + b]);
-        } else {
-          gol = b <= sbin[base + nid];
+  auto shard = [=](int64_t r0, int64_t r1) {
+    memcpy(out + r0 * K, init + r0 * K,
+           static_cast<size_t>(r1 - r0) * K * sizeof(float));
+    for (int64_t r = r0; r < r1; ++r) {
+      const BinT* br = bins + r * F;
+      float* orow = out + r * K;
+      for (int32_t t = 0; t < T; ++t) {
+        const size_t base = static_cast<size_t>(t) * M;
+        int32_t nid = 0;
+        for (int32_t d = 0; d < depth; ++d) {
+          const int32_t fi = feat[base + nid];
+          if (fi < 0) break;
+          const int32_t b = static_cast<int32_t>(br[fi]);
+          bool gol;
+          if (has_cat && is_cat[base + nid]) {
+            gol = !(b < Bc && catm[(base + nid) * Bc + b]);
+          } else {
+            gol = b <= sbin[base + nid];
+          }
+          if (b >= n_bin) gol = dleft[base + nid] != 0;
+          nid = gol ? left[base + nid] : right[base + nid];
         }
-        if (b >= n_bin) gol = dleft[base + nid] != 0;
-        nid = gol ? left[base + nid] : right[base + nid];
+        orow[groups[t]] += value[base + nid];
       }
-      orow[groups[t]] += value[base + nid];
     }
-  }
+  };
+  xtb_parallel_for(R, 256, XTB_K_PREDICT, shard);
 }
 
 // ---------------------------------------------------------------------------
@@ -339,18 +736,21 @@ inline void xtb_predict_binned_impl(
 // ranked below it, |delta ndcg|/idcg pair weight, optional score-diff
 // normalization (skipped while all scores in the group are equal),
 // hessian doubled, per-group log2(1+sum_lambda)/sum_lambda rescale.
+//
+// Threading: GROUP-sharded — each query group's gradient rows are exclusive
+// to it (CSR), so shards write disjoint slices and every nthread is
+// bitwise-identical to the sequential pass.
 // ---------------------------------------------------------------------------
-#include <algorithm>
-
 inline void xtb_lambdarank_topk_impl(
     const float* s, const float* y, const int32_t* gptr, int32_t n_groups,
     int64_t R, int32_t k, int32_t ndcg_weight, int32_t score_norm,
     int32_t group_norm, float* out_grad, float* out_hess) {
   memset(out_grad, 0, R * sizeof(float));
   memset(out_hess, 0, R * sizeof(float));
+  auto shard = [=](int64_t glo, int64_t ghi) {
   std::vector<int32_t> order;
   std::vector<float> gain, disc, lam_acc, hess_acc;
-  for (int32_t g = 0; g < n_groups; ++g) {
+  for (int32_t g = static_cast<int32_t>(glo); g < ghi; ++g) {
     const int32_t lo = gptr[g], hi = gptr[g + 1];
     const int32_t n = hi - lo;
     if (n <= 1) continue;
@@ -414,6 +814,167 @@ inline void xtb_lambdarank_topk_impl(
       out_hess[order[i]] = hess_acc[i] * norm;
     }
   }
+  };
+  xtb_parallel_for(n_groups, 4, XTB_K_LAMBDARANK, shard);
+}
+
+// ---------------------------------------------------------------------------
+// Exact path-dependent TreeSHAP (Lundberg 2018) — the native twin of the
+// host walk in interpret/__init__.py (_extend/_unwind/_unwound_sum), all-f64
+// with identical operation order so the two implementations agree to the
+// last ulp (the Makefile compiles with -ffp-contract=off to keep FMA
+// contraction from reassociating on wider ISAs).  Scalar-leaf numeric trees
+// only; categorical routing stays on the Python walk.
+//
+// Threading: ROW-sharded — each row's recursion is independent and writes
+// its own (F+1) output slice, so every nthread is bitwise-identical.
+// ---------------------------------------------------------------------------
+struct XtbShapTree {
+  const int32_t* left;
+  const int32_t* right;
+  const int32_t* feat;
+  const double* thr;
+  const uint8_t* dleft;
+  const double* value;  // leaf value at leaves, 0 elsewhere
+  const double* cover;  // sum_hessian clamped >= 1e-16
+};
+
+struct XtbShapScratch {
+  // one path buffer per recursion level; level l copies level l-1 on entry
+  std::vector<int32_t> feat;
+  std::vector<double> zero, one, pw;
+  int cap;  // entries per level
+
+  explicit XtbShapScratch(int max_depth) : cap(max_depth + 3) {
+    const int levels = max_depth + 3;
+    feat.assign(static_cast<size_t>(levels) * cap, -1);
+    zero.assign(static_cast<size_t>(levels) * cap, 0.0);
+    one.assign(static_cast<size_t>(levels) * cap, 0.0);
+    pw.assign(static_cast<size_t>(levels) * cap, 0.0);
+  }
+};
+
+inline int xtb_shap_extend(int32_t* feat, double* zero, double* one,
+                           double* pw, int length, double pz, double po,
+                           int32_t pi) {
+  feat[length] = pi;
+  zero[length] = pz;
+  one[length] = po;
+  pw[length] = length == 0 ? 1.0 : 0.0;
+  for (int i = length - 1; i >= 0; --i) {
+    pw[i + 1] += po * pw[i] * (i + 1) / (length + 1);
+    pw[i] = pz * pw[i] * (length - i) / (length + 1);
+  }
+  return length + 1;
+}
+
+inline int xtb_shap_unwind(int32_t* feat, double* zero, double* one,
+                           double* pw, int length, int i) {
+  length -= 1;
+  const double po = one[i], pz = zero[i];
+  double n = pw[length];
+  for (int j = length - 1; j >= 0; --j) {
+    if (po != 0.0) {
+      double t = pw[j];
+      pw[j] = n * (length + 1) / ((j + 1) * po);
+      n = t - pw[j] * pz * (length - j) / (length + 1);
+    } else {
+      pw[j] = pw[j] * (length + 1) / (pz * (length - j));
+    }
+  }
+  for (int j = i; j < length; ++j) {
+    feat[j] = feat[j + 1];
+    zero[j] = zero[j + 1];
+    one[j] = one[j + 1];
+  }
+  return length;
+}
+
+inline double xtb_shap_unwound_sum(const double* zero, const double* one,
+                                   const double* pw, int length, int i) {
+  const double po = one[i], pz = zero[i];
+  double total = 0.0;
+  double n = pw[length - 1];
+  for (int j = length - 2; j >= 0; --j) {
+    if (po != 0.0) {
+      double t = n * length / ((j + 1) * po);
+      total += t;
+      n = pw[j] - t * pz * (length - 1 - j) / length;
+    } else {
+      total += pw[j] * length / (pz * (length - 1 - j));
+    }
+  }
+  return total;
+}
+
+inline void xtb_shap_recurse(const XtbShapTree& t, const double* x,
+                             double* phi, int node, XtbShapScratch& s,
+                             int level, int length, double pz, double po,
+                             int32_t pi) {
+  // copy the parent path into this level's buffer, then extend
+  int32_t* feat = s.feat.data() + static_cast<size_t>(level) * s.cap;
+  double* zero = s.zero.data() + static_cast<size_t>(level) * s.cap;
+  double* one = s.one.data() + static_cast<size_t>(level) * s.cap;
+  double* pw = s.pw.data() + static_cast<size_t>(level) * s.cap;
+  if (level > 0) {
+    const size_t off = static_cast<size_t>(level - 1) * s.cap;
+    memcpy(feat, s.feat.data() + off, length * sizeof(int32_t));
+    memcpy(zero, s.zero.data() + off, length * sizeof(double));
+    memcpy(one, s.one.data() + off, length * sizeof(double));
+    memcpy(pw, s.pw.data() + off, length * sizeof(double));
+  }
+  length = xtb_shap_extend(feat, zero, one, pw, length, pz, po, pi);
+  const int32_t left = t.left[node], right = t.right[node];
+  if (left < 0) {  // leaf
+    const double v = t.value[node];
+    for (int i = 1; i < length; ++i) {
+      const double w = xtb_shap_unwound_sum(zero, one, pw, length, i);
+      phi[feat[i]] += w * (one[i] - zero[i]) * v;
+    }
+    return;
+  }
+  const int32_t f = t.feat[node];
+  const double xv = x[f];
+  const bool miss = std::isnan(xv);
+  const bool go_left = miss ? (t.dleft[node] != 0) : (xv < t.thr[node]);
+  const int32_t hot = go_left ? left : right;
+  const int32_t cold = go_left ? right : left;
+  const double rj = t.cover[node];
+  const double rh = t.cover[hot], rc = t.cover[cold];
+  double iz = 1.0, io = 1.0;
+  // if this feature is already on the path, undo its previous contribution
+  int k = -1;
+  for (int i = 1; i < length; ++i) {
+    if (feat[i] == f) {
+      k = i;
+      break;
+    }
+  }
+  if (k >= 0) {
+    iz = zero[k];
+    io = one[k];
+    length = xtb_shap_unwind(feat, zero, one, pw, length, k);
+  }
+  xtb_shap_recurse(t, x, phi, hot, s, level + 1, length, iz * rh / rj, io, f);
+  xtb_shap_recurse(t, x, phi, cold, s, level + 1, length, iz * rc / rj, 0.0,
+                   f);
+}
+
+// out: (R, F+1) f64, feature columns accumulated in place (callers zero it
+// and fill the bias column F with the tree expectation themselves, exactly
+// like the Python walk).
+inline void xtb_shap_values_impl(const double* X, int64_t R, int32_t F,
+                                 const XtbShapTree& t, int32_t max_depth,
+                                 double* out) {
+  if (t.left[0] < 0) return;  // stump: all mass at the bias column
+  auto shard = [=](int64_t r0, int64_t r1) {
+    XtbShapScratch scratch(max_depth);
+    for (int64_t r = r0; r < r1; ++r) {
+      xtb_shap_recurse(t, X + r * F, out + r * (F + 1), 0, scratch, 0, 0,
+                       1.0, 1.0, -1);
+    }
+  };
+  xtb_parallel_for(R, 16, XTB_K_SHAP, shard);
 }
 
 #endif  // XTB_KERNELS_H_

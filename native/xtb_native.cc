@@ -17,6 +17,12 @@
 #include <string>
 #include <vector>
 
+// brings in the ParallelFor pool (+ its C ABI: xtb_set_nthread and friends)
+// and the shared kernel bodies; the quantile summary below and the SHAP
+// entry point thread through it
+#define XTB_DEFINE_POOL_ABI
+#include "xtb_kernels.h"
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -168,6 +174,32 @@ struct QuantileSummary {
 
   explicit QuantileSummary(size_t b) : budget(b) {}
 
+  // Shard-parallel sort + sequential fold of inplace_merges.  The (value,
+  // weight) pair comparison is a total order up to EXACT duplicates, so the
+  // merged sequence is element-for-element the std::sort result and every
+  // downstream prune/query stays bitwise identical for any thread count.
+  static void sort_batch(std::vector<std::pair<float, double>>* batch) {
+    const int64_t n = static_cast<int64_t>(batch->size());
+    if (n < (1 << 14)) {
+      std::sort(batch->begin(), batch->end());
+      return;
+    }
+    std::vector<std::pair<int64_t, int64_t>> runs;
+    std::mutex runs_mu;
+    xtb_parallel_for(n, 1 << 12, XTB_K_SKETCH,
+                     [&](int64_t b, int64_t e) {
+                       std::sort(batch->begin() + b, batch->begin() + e);
+                       std::lock_guard<std::mutex> g(runs_mu);
+                       runs.emplace_back(b, e);
+                     });
+    std::sort(runs.begin(), runs.end());
+    for (size_t i = 1; i < runs.size(); ++i) {
+      std::inplace_merge(batch->begin() + runs[0].first,
+                         batch->begin() + runs[i].first,
+                         batch->begin() + runs[i].second);
+    }
+  }
+
   void push(const float* vals, const float* wts, int64_t n) {
     std::vector<std::pair<float, double>> batch;
     batch.reserve(n);
@@ -179,7 +211,7 @@ struct QuantileSummary {
       batch.emplace_back(v, w);
       total += w;
     }
-    std::sort(batch.begin(), batch.end());
+    sort_batch(&batch);
     // merge two sorted runs
     std::vector<std::pair<float, double>> merged;
     merged.reserve(entries.size() + batch.size());
@@ -237,6 +269,21 @@ struct QuantileSummary {
     }
   }
 };
+
+// ---------------------------------------------------------------------------
+// Exact TreeSHAP over one tree (xtb_kernels.h xtb_shap_values_impl): the
+// native, row-parallel twin of interpret/__init__.py's host walk.  out is
+// (R, F+1) f64, zeroed by the caller; the bias column stays untouched
+// (Python fills the cover-weighted expectation, as the host walk does).
+// ---------------------------------------------------------------------------
+void xtb_shap_values(const double* X, int64_t R, int32_t F,
+                     const int32_t* left, const int32_t* right,
+                     const int32_t* feat, const double* thr,
+                     const uint8_t* dleft, const double* value,
+                     const double* cover, int32_t max_depth, double* out) {
+  XtbShapTree t{left, right, feat, thr, dleft, value, cover};
+  xtb_shap_values_impl(X, R, F, t, max_depth, out);
+}
 
 void* xtb_summary_new(int64_t budget) { return new QuantileSummary(budget); }
 void xtb_summary_push(void* h, const float* vals, const float* wts, int64_t n) {

@@ -9,6 +9,21 @@ cd "$(dirname "$0")/.."
 # writes bench_out/lint_report.json for trend tracking
 bash scripts/lint_gate.sh
 
+# ThreadSanitizer smoke over the native ParallelFor pool + threaded
+# kernels + concurrent dispatch (docs/native_threading.md).  Only a
+# toolchain WITHOUT libtsan skips (probed with a trivial program, so a
+# real compile error in the smoke/kernels cannot masquerade as "no
+# libtsan"); with libtsan present, build failures and TSAN findings both
+# fail the nightly.
+if echo 'int main(){return 0;}' | g++ -x c++ -fsanitize=thread -o /tmp/_tsan_probe - >/dev/null 2>&1; then
+    rm -f /tmp/_tsan_probe
+    echo "== native TSAN smoke =="
+    make -C native tsan_smoke
+    ./native/tsan_smoke
+else
+    echo "== native TSAN smoke: libtsan unavailable, skipping =="
+fi
+
 python -m pytest tests/ -q --durations=25
 
 # telemetry smoke: a short traced training run must leave a parseable JSONL

@@ -48,7 +48,7 @@ _QUICK_FILES = {
     "test_serving.py", "test_arrow.py", "test_telemetry.py",
     "test_timer_observer.py", "test_reliability.py",
     "test_serving_faults.py", "test_reliability_multiprocess.py",
-    "test_analysis.py",
+    "test_analysis.py", "test_native_threads.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)

@@ -407,9 +407,78 @@ def test_nondet_suppression_honored():
 
 def test_rule_catalog_covers_all_families():
     cat = {code for code, _rule, _desc in rule_catalog()}
-    assert {"XTB101", "XTB102", "XTB103", "XTB201", "XTB301", "XTB302",
-            "XTB303", "XTB304", "XTB401", "XTB402", "XTB403", "XTB501",
-            "XTB502"} <= cat
+    assert {"XTB101", "XTB102", "XTB103", "XTB201", "XTB202", "XTB203",
+            "XTB301", "XTB302", "XTB303", "XTB304", "XTB401", "XTB402",
+            "XTB403", "XTB501", "XTB502"} <= cat
+
+
+# ---------------------------------------------------------------------------
+# XTB202/XTB203 — the native C-API dispatch-lock contract
+# ---------------------------------------------------------------------------
+
+def _capi_codes(cc_text):
+    from xgboost_tpu.analysis.locks import CapiDispatchRule
+
+    return [f.code for f in CapiDispatchRule().check_text(cc_text, "x.cc")]
+
+
+def test_capi_dispatch_unguarded_entry_fires():
+    assert _capi_codes(src("""
+        XTB_DLL int XGBoosterNewThing(BoosterHandle h) {
+          do_stuff();
+          return 0;
+        }
+    """)) == ["XTB202"]
+
+
+def test_capi_dispatch_wrong_mode_fires():
+    # a predict entry downgraded off the shared read path re-serializes
+    # concurrent readers — exactly the regression XTB203 pins
+    assert _capi_codes(src("""
+        XTB_DLL int XGBoosterPredict(BoosterHandle h) {
+          API_BEGIN_MUT();
+          return 0;
+          API_END();
+        }
+        XTB_DLL int XGBoosterUpdateOneIter(BoosterHandle h) {
+          API_BEGIN();
+          return 0;
+          API_END();
+        }
+    """)) == ["XTB203", "XTB203"]
+
+
+def test_capi_dispatch_clean_and_delegation():
+    assert _capi_codes(src("""
+        XTB_DLL int XGBoosterPredict(BoosterHandle h) {
+          API_BEGIN_READ();
+          return 0;
+          API_END();
+        }
+        XTB_DLL int XGBoosterSetParam(BoosterHandle h) {
+          API_BEGIN_MUT();
+          return 0;
+          API_END();
+        }
+        XTB_DLL int XGDMatrixCreateFromMat(const float* d) {
+          API_BEGIN();
+          return 0;
+          API_END();
+        }
+        XTB_DLL int XGDMatrixAlias(const float* d) {
+          return XGDMatrixCreateFromMat(d);
+        }
+    """)) == []
+
+
+def test_capi_dispatch_real_tree_contract_holds():
+    """The committed xtb_capi.cc satisfies its own contract table."""
+    from xgboost_tpu.analysis.locks import CapiDispatchRule
+
+    cc = os.path.join(REPO, "native", "xtb_capi.cc")
+    with open(cc, encoding="utf-8") as fh:
+        findings = CapiDispatchRule().check_text(fh.read(), cc)
+    assert findings == []
 
 
 def test_file_level_suppression_mechanism():

@@ -896,15 +896,15 @@ def test_jni_glue_sequence(tmp_path):
 # ===================================================================
 # Serving-era surface: concurrency contract + categories export.
 
-def test_concurrent_predict_serialized_but_correct(capi):
-    """The C ABI's documented concurrency contract (native/xtb_capi.cc):
-    every entry point holds the embedded interpreter's GIL, so N host
-    threads are SERIALIZED but must stay CORRECT.  Each thread drives its
-    own booster handle (prediction buffers pin per-handle, as in the
-    reference where the returned buffer lives until the next call on the
-    same handle) loaded from one shared model buffer; all predictions must
-    be bitwise-identical to the single-threaded result.  Truly concurrent
-    serving belongs to xgboost_tpu.serving (docs/serving.md)."""
+@pytest.mark.quick
+def test_concurrent_predict_correct(capi):
+    """Correctness half of the C ABI concurrency contract
+    (native/xtb_capi.cc): predict entry points take the SHARED dispatch
+    lock, so N host threads overlap — and must stay bitwise CORRECT.
+    Each thread drives its own booster handle loaded from one shared model
+    buffer; all predictions must be bitwise-identical to the
+    single-threaded result.  The throughput half (no serialization) is
+    test_concurrent_predict_parallel_throughput below."""
     import threading
 
     X, y = _mkdata(13)
@@ -963,6 +963,94 @@ def test_concurrent_predict_serialized_but_correct(capi):
     for outs in results.values():
         for out in outs:
             np.testing.assert_array_equal(out, ref)
+    _check(capi, capi.XGBoosterFree(booster))
+    _check(capi, capi.XGDMatrixFree(dmat))
+
+
+@pytest.mark.quick
+def test_concurrent_predict_parallel_throughput(capi):
+    """Throughput half of the narrowed dispatch contract
+    (native/xtb_capi.cc API_BEGIN_READ + docs/native_threading.md):
+    concurrent read-only predict callers must NOT be reduced to
+    single-thread throughput.  4 threads x k predicts over a shared
+    DMatrix must (a) stay bitwise-identical to the single-threaded
+    reference and (b) beat the serialized wall-clock by a real margin —
+    possible only if the shared lock + jax's GIL release actually overlap
+    the native compute.  The pool is pinned to nthread=1 so per-call
+    kernels leave cores free for the overlap itself."""
+    import threading
+    import time
+
+    if (os.cpu_count() or 1) < 2:
+        pytest.skip("needs >= 2 cores to demonstrate overlap")
+
+    R, F = 200_000, 8
+    rng = np.random.default_rng(21)
+    X = rng.normal(size=(R, F)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] > 0).astype(np.float32)
+    dmat = ctypes.c_void_p()
+    _check(capi, capi.XGDMatrixCreateFromMat(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(R), ctypes.c_uint64(F), ctypes.c_float(np.nan),
+        ctypes.byref(dmat)))
+    _check(capi, capi.XGDMatrixSetFloatInfo(
+        dmat, b"label", y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(len(y))))
+    booster = _train_booster(capi, dmat, rounds=12)
+    # single-threaded kernels: the overlap must come from concurrent
+    # callers, not from the pool parallelizing each call internally
+    _check(capi, capi.XGBoosterSetParam(booster, b"nthread", b"1"))
+
+    def predict():
+        n, p = ctypes.c_uint64(), ctypes.POINTER(ctypes.c_float)()
+        _check(capi, capi.XGBoosterPredict(booster, dmat, 1, 0, 0,
+                                           ctypes.byref(n), ctypes.byref(p)))
+        return np.ctypeslib.as_array(p, shape=(n.value,)).copy()
+
+    ref = predict()  # warm the jit cache + pin the reference bits
+    N_THREADS, CALLS = 4, 2
+
+    def measure():
+        t0 = time.perf_counter()
+        for _ in range(N_THREADS * CALLS):
+            predict()
+        serial_s = time.perf_counter() - t0
+
+        results, errors = {}, []
+        barrier = threading.Barrier(N_THREADS)
+
+        def worker(tid):
+            try:
+                barrier.wait(30)
+                results[tid] = [predict() for _ in range(CALLS)]
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(N_THREADS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        concurrent_s = time.perf_counter() - t0
+        assert not errors, errors[0]
+        for outs in results.values():
+            for out in outs:
+                np.testing.assert_array_equal(out, ref)
+        return serial_s / concurrent_s
+
+    # repeated attempts damp scheduler noise on small/loaded CI boxes
+    # (early-exit on success); demand a real overlap margin, far above
+    # timing jitter yet below the 2x a 2-core host could ideally reach
+    speedups = []
+    for _ in range(5):
+        speedups.append(measure())
+        if speedups[-1] > 1.2:
+            break
+    assert max(speedups) > 1.2, (
+        f"concurrent predict shows no overlap: speedups={speedups} "
+        f"(serialized dispatch?)")
     _check(capi, capi.XGBoosterFree(booster))
     _check(capi, capi.XGDMatrixFree(dmat))
 

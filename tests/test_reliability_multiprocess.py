@@ -177,20 +177,23 @@ def test_dropped_tracker_connection_is_a_detected_fault(tmp_path):
         for _ in range(2)]
     with pytest.raises(RuntimeError, match="connection lost"):
         tr.wait_for(timeout=420)
-    # rank 0 is aborted; rank 1 (channel-less) would sleep 600s — kill it
+    # rank 0 is aborted; rank 1 (channel-less) would sleep 600s — kill it.
+    # Poll ALL workers against one shared deadline: rank assignment follows
+    # connect order, so the sleeper may be procs[0] — a sequential
+    # poll-then-kill loop would burn the whole deadline on it and never
+    # look at the already-aborted peer.
     rcs = []
+    remaining = list(procs)
     deadline = time.time() + 180
-    for p in procs:
-        rc = None
-        while time.time() < deadline:
+    while remaining and 255 not in rcs and time.time() < deadline:
+        for p in list(remaining):
             rc = p.poll()
             if rc is not None:
-                break
-            time.sleep(0.5)
-        if rc is None:
-            p.kill()
-            p.wait(timeout=30)
-        else:
-            rcs.append(rc)
+                rcs.append(rc)
+                remaining.remove(p)
+        time.sleep(0.5)
+    for p in remaining:
+        p.kill()
+        p.wait(timeout=30)
     assert 255 in rcs, rcs  # the worker with a live channel was aborted
     tr.free()

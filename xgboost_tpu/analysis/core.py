@@ -192,8 +192,8 @@ def _rules() -> List[Rule]:
     from . import locks, metric_names, nondet, retrace, seams
 
     return [retrace.RetraceRule(), locks.LockDisciplineRule(),
-            seams.SeamConsistencyRule(), metric_names.MetricNameRule(),
-            nondet.NondeterminismRule()]
+            locks.CapiDispatchRule(), seams.SeamConsistencyRule(),
+            metric_names.MetricNameRule(), nondet.NondeterminismRule()]
 
 
 @dataclasses.dataclass

@@ -1,4 +1,5 @@
-"""XTB2xx — lock discipline in classes that own a threading lock.
+"""XTB2xx — lock discipline: Python lock-owning classes (XTB201) and the
+native C-API dispatch-lock contract (XTB202/XTB203).
 
 A class whose ``__init__`` creates a ``threading.Lock`` / ``RLock`` /
 ``Condition`` (``telemetry/registry.py``, ``serving/batcher.py``,
@@ -28,10 +29,25 @@ Attribute-store analysis, per class:
   ``ModelRegistry._evict_for_capacity``).  A method whose reference
   escapes un-called (``threading.Thread(target=self._serve)``) never
   inherits its callers' locks.
+
+The second pass (:class:`CapiDispatchRule`) covers the narrowed C-API
+dispatch in ``native/xtb_capi.cc``: since the GIL stopped being the
+serializer (jax releases it during XLA execution and the native kernels
+are internally threaded), every ``XTB_DLL`` entry point must declare its
+dispatch mode — ``API_BEGIN_READ()`` (shared lock, read-only Booster
+surface), ``API_BEGIN_MUT()`` (exclusive lock, Booster mutators), or
+``API_BEGIN()`` (GIL only, handle-local creation/ingestion).  The rule
+text-parses the .cc (no clang needed — the macro discipline IS the
+contract) and pins the mode table, so an entry point added without a
+guard (XTB202) or a predict-family entry silently downgraded to the
+exclusive path — re-serializing concurrent readers — (XTB203) fails the
+gate.
 """
 from __future__ import annotations
 
 import ast
+import os
+import re
 from typing import Dict, Iterable, List, Set, Tuple
 
 from .core import Finding, Project, Rule, SourceFile
@@ -247,4 +263,97 @@ class LockDisciplineRule(Rule):
                         f"{cls.name}.{m.name} stores self.{attr} outside "
                         f"`with self.{lock_list}` ({cls.name} owns a lock; "
                         f"unguarded stores race other threads)"))
+        return findings
+
+
+class CapiDispatchRule(Rule):
+    """XTB202/XTB203 — the narrowed xtb_capi.cc dispatch-lock contract."""
+
+    name = "capi-dispatch"
+    codes = {
+        "XTB202": "C-API entry point without a dispatch guard "
+                  "(API_BEGIN_READ/API_BEGIN_MUT/API_BEGIN or a manual "
+                  "Gil hold)",
+        "XTB203": "C-API entry point uses the wrong dispatch mode for its "
+                  "contract class (read-only vs mutating)",
+    }
+
+    # The contract table (native/xtb_capi.cc CONCURRENCY CONTRACT).  Every
+    # name here must carry exactly this macro; unlisted entries may use any
+    # guard (new surface starts unclassified, the guard requirement XTB202
+    # still applies).
+    READ = frozenset({
+        "XGBoosterPredict", "XGBoosterPredictFromDMatrix",
+        "XGBoosterPredictFromDense", "XGBoosterPredictFromCSR",
+        "XGBoosterPredictFromColumnar", "XGBoosterSaveModel",
+        "XGBoosterSaveModelToBuffer", "XGBoosterSerializeToBuffer",
+        "XGBoosterSaveJsonConfig", "XGBoosterDumpModelEx",
+        "XGBoosterDumpModelExWithFeatures", "XGBoosterGetAttr",
+        "XGBoosterGetAttrNames", "XGBoosterBoostedRounds",
+        "XGBoosterGetNumFeature", "XGBoosterGetStrFeatureInfo",
+        "XGBoosterFeatureScore", "XGBoosterGetCategories", "XGBoosterSlice",
+    })
+    MUT = frozenset({
+        "XGBoosterSetParam", "XGBoosterUpdateOneIter",
+        "XGBoosterBoostOneIter", "XGBoosterTrainOneIter",
+        "XGBoosterEvalOneIter", "XGBoosterLoadModel",
+        "XGBoosterLoadModelFromBuffer", "XGBoosterUnserializeFromBuffer",
+        "XGBoosterLoadJsonConfig", "XGBoosterReset", "XGBoosterSetAttr",
+        "XGBoosterSetStrFeatureInfo",
+    })
+    # guard-free by design: trivial accessors that never enter Python
+    EXEMPT = frozenset({
+        "XGBGetLastError", "XGBoostVersion", "XGBRegisterLogCallback",
+    })
+
+    # return types may span several tokens (`const char*`); the entry-point
+    # name is the last identifier before the parameter list
+    _DEF_RE = re.compile(r"XTB_DLL\s+(?:[\w:]+[\s*&]+)+(\w+)\s*\(")
+
+    def capi_path(self, project: Project) -> str:
+        if not project.docs_root:
+            return ""
+        return os.path.join(os.path.dirname(project.docs_root), "native",
+                            "xtb_capi.cc")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        path = self.capi_path(project)
+        if not path or not os.path.isfile(path):
+            return ()  # subtree lint / snippet mode: nothing to check
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        return self.check_text(text, path)
+
+    def check_text(self, text: str, path: str) -> List[Finding]:
+        findings: List[Finding] = []
+        defs = list(self._DEF_RE.finditer(text))
+        for i, m in enumerate(defs):
+            name = m.group(1)
+            end = defs[i + 1].start() if i + 1 < len(defs) else len(text)
+            body = text[m.end():end]
+            line = text.count("\n", 0, m.start()) + 1
+            if "API_BEGIN_READ()" in body:
+                mode = "read"
+            elif "API_BEGIN_MUT()" in body:
+                mode = "mut"
+            elif "API_BEGIN()" in body or "Gil gil" in body:
+                mode = "gil"
+            elif re.search(r"return\s+XG\w+\s*\(", body):
+                mode = "delegate"  # thin alias: the callee carries the guard
+            else:
+                mode = None
+            if mode is None and name not in self.EXEMPT:
+                findings.append(Finding(
+                    path, line, 0, "XTB202",
+                    f"{name} has no dispatch guard (API_BEGIN_READ/"
+                    f"API_BEGIN_MUT/API_BEGIN) and does not delegate"))
+                continue
+            want = ("read" if name in self.READ
+                    else "mut" if name in self.MUT else None)
+            if want is not None and mode not in (want, "delegate"):
+                findings.append(Finding(
+                    path, line, 0, "XTB203",
+                    f"{name} must use API_BEGIN_{want.upper()}() per the "
+                    f"dispatch contract, found "
+                    f"{mode if mode else 'no guard'}"))
         return findings

@@ -35,6 +35,54 @@ def dmatrix_from_mat(addr: int, nrow: int, ncol: int, missing: float) -> DMatrix
     return DMatrix(X, missing=missing)
 
 
+def dmatrix_from_mat_nthread(addr: int, nrow: int, ncol: int, missing: float,
+                             nthread: int) -> DMatrix:
+    """XGDMatrixCreateFromMat_omp: the nthread argument configures the
+    native ParallelFor pool for THIS ingest (utils/native.py; 0/negative =
+    default), the scope omp_set_num_threads has in the reference's _omp
+    path — the prior width is restored afterwards."""
+    from .utils import native
+
+    prev = native.get_nthread()
+    native.set_nthread(int(nthread))
+    try:
+        return dmatrix_from_mat(addr, nrow, ncol, missing)
+    finally:
+        native.set_nthread(prev)
+
+
+_PIN_DICT_LOCK = __import__("threading").Lock()
+
+
+def _pin_per_thread(owner, tag: str, objs) -> None:
+    """Pin result buffers per (handle, calling thread) — the reference's
+    XGBAPIThreadLocalEntry convention (c_api.cc).  Concurrent read-only
+    callers through the narrowed C-API dispatch (native/xtb_capi.cc
+    API_BEGIN_READ) each keep their own last return alive; a buffer dies on
+    the same thread's next same-kind call on the handle or with the handle.
+    Dict creation is locked: two first-callers racing getattr/setattr would
+    otherwise orphan one thread's dict — and free its just-returned
+    buffer — mid-read."""
+    import threading
+
+    d = getattr(owner, tag, None)
+    if d is None:
+        with _PIN_DICT_LOCK:
+            d = getattr(owner, tag, None)
+            if d is None:
+                d = {}
+                setattr(owner, tag, d)
+    d[threading.get_ident()] = objs
+    if len(d) > 64:
+        # thread-per-request embedders would otherwise pin one buffer per
+        # dead thread ident forever; prune entries whose thread is gone
+        # (the reference's thread_local entries die at thread exit)
+        live = {t.ident for t in threading.enumerate()}
+        with _PIN_DICT_LOCK:
+            for ident in [k for k in d if k not in live]:
+                d.pop(ident, None)
+
+
 def _drop_missing_csr(csr, missing: float):
     """Remove entries that mean "missing" (NaN, or == missing when the
     sentinel is finite) so the stored sparsity pattern IS the non-missing
@@ -164,7 +212,9 @@ def booster_predict(b: Booster, d: DMatrix, option_mask: int,
     else:
         out = b.predict(d, output_margin=bool(option_mask & 1), **kw)
     out = np.ascontiguousarray(np.asarray(out, np.float32).reshape(-1))
-    b._capi_pred_buf = out  # keep alive until the next predict on b
+    # alive until this thread's next predict on b (per-thread pinning keeps
+    # concurrent shared-lock readers from freeing each other's returns)
+    _pin_per_thread(b, "_capi_pred_pin", (out,))
     return int(out.size), int(out.ctypes.data)
 
 
@@ -178,7 +228,7 @@ def booster_load_model(b: Booster, path: str) -> None:
 
 def booster_save_raw(b: Booster, raw_format: str) -> tuple:
     buf = bytes(b.save_raw(raw_format))
-    b._capi_raw_buf = buf
+    _pin_per_thread(b, "_capi_raw_buf", (buf,))
     return len(buf), buf
 
 
@@ -191,7 +241,7 @@ def booster_get_attr(b: Booster, name: str):
     if v is None:
         return None
     out = v.encode()
-    b._capi_attr_str = out
+    _pin_per_thread(b, "_capi_attr_str", (out,))
     return out
 
 
@@ -211,13 +261,13 @@ def booster_get_categories(b: Booster) -> bytes:
     """JSON category mapping (reference: XGBoosterGetCategories,
     src/data/cat_container.h) — ``null`` when trained without categories."""
     out = json.dumps(b.get_categories()).encode()
-    b._capi_categories_buf = out  # pinned: the C caller borrows the pointer
+    _pin_per_thread(b, "_capi_categories_buf", (out,))  # C caller borrows
     return out
 
 
 def dmatrix_get_categories(d: DMatrix) -> bytes:
     out = json.dumps(d.get_categories()).encode()
-    d._capi_categories_buf = out
+    _pin_per_thread(d, "_capi_categories_buf", (out,))
     return out
 
 
@@ -245,18 +295,21 @@ def _from_array_interface(spec) -> np.ndarray:
 
 
 def _pin_str_array(owner, tag: str, strings):
-    """Build a NUL-terminated char** pinned on ``owner``; returns
+    """Build a NUL-terminated char** pinned per (owner, thread); returns
     (len, address).  The reference keeps such returns in per-handle
-    thread-local entries (c_api.cc XGBAPIThreadLocalEntry)."""
+    thread-local entries (c_api.cc XGBAPIThreadLocalEntry) — per-thread
+    storage is what keeps the shared-lock READ entry points
+    (native/xtb_capi.cc API_BEGIN_READ) from freeing each other's
+    returns on one handle."""
     bufs = [str(s).encode() for s in strings]
     arr = (ctypes.c_char_p * len(bufs))(*bufs)
-    setattr(owner, tag, (bufs, arr))  # keep both alive
+    _pin_per_thread(owner, tag, (bufs, arr))  # keep both alive
     return len(bufs), ctypes.addressof(arr) if bufs else 0
 
 
 def _pin_array(owner, tag: str, arr: np.ndarray):
     arr = np.ascontiguousarray(arr)
-    setattr(owner, tag, arr)
+    _pin_per_thread(owner, tag, (arr,))
     return int(arr.size), int(arr.ctypes.data)
 
 
@@ -681,8 +734,7 @@ def _predict_with_config(b: Booster, d: DMatrix, c: dict):
         out = out.reshape(-1, 1)
     shape = np.asarray(out.shape, np.uint64)
     flat = np.ascontiguousarray(out.reshape(-1))
-    b._capi_pred_buf = flat
-    b._capi_pred_shape = shape
+    _pin_per_thread(b, "_capi_pred_pin", (flat, shape))
     return (int(shape.ctypes.data), int(shape.size),
             int(flat.ctypes.data))
 
@@ -724,7 +776,7 @@ def booster_inplace_predict_csr(b: Booster, indptr_j: str, indices_j: str,
 
 def booster_serialize(b: Booster):
     buf = bytes(b.serialize())
-    b._capi_serial_buf = buf
+    _pin_per_thread(b, "_capi_serial_buf", (buf,))
     return len(buf), buf
 
 
@@ -734,7 +786,7 @@ def booster_unserialize(b: Booster, addr: int, n: int) -> None:
 
 def booster_save_json_config(b: Booster):
     out = b.save_config().encode()
-    b._capi_config_str = out
+    _pin_per_thread(b, "_capi_config_str", (out,))
     return len(out), out
 
 
@@ -789,8 +841,7 @@ def booster_feature_score(b: Booster, config: str):
     scores = np.asarray([imp[f] for f in feats], np.float32)
     n, feat_addr = _pin_str_array(b, "_capi_score_feats", feats)
     shape = np.asarray([len(feats)], np.uint64)
-    b._capi_score_shape = shape
-    b._capi_score_vals = scores
+    _pin_per_thread(b, "_capi_score_out", (shape, scores))
     return (n, feat_addr, int(shape.ctypes.data), 1,
             int(scores.ctypes.data))
 

@@ -77,7 +77,20 @@ class Context:
 
     @staticmethod
     def create(device: str = "cpu", nthread: int = 0, seed: int = 0) -> "Context":
-        return Context(device=DeviceOrd.parse(device), nthread=nthread, seed=seed)
+        return Context(device=DeviceOrd.parse(device), nthread=int(nthread),
+                       seed=seed)
+
+    def apply_nthread(self) -> int:
+        """Push the resolved thread count into the native ParallelFor pools
+        (both kernel libraries).  Precedence (docs/native_threading.md):
+        explicit ``nthread`` param > ``XGBOOST_TPU_NTHREAD`` env >
+        ``os.cpu_count()`` — the reference's nthread/OMP_NUM_THREADS
+        resolution (src/common/threading_utils.cc OmpGetNumThreads) with
+        the package env var in OMP's seat.  Bitwise-neutral: threaded
+        kernels are pinned identical to nthread=1 for every value."""
+        from .utils import native
+
+        return native.set_nthread(self.nthread)
 
     def jax_device(self):
         return self.device.jax_device()

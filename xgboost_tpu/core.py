@@ -189,7 +189,13 @@ class Booster:
         if unknown and str(p.get("validate_parameters", "")).lower() in ("1", "true"):
             raise ValueError(f"Unknown parameters: {unknown}")
         self.tparam = TrainParam.from_dict(p)
-        self.context = Context.create(str(p.get("device", "cpu")), seed=int(p.get("seed", 0)))
+        self.context = Context.create(str(p.get("device", "cpu")),
+                                      nthread=int(p.get("nthread", 0) or 0),
+                                      seed=int(p.get("seed", 0)))
+        # nthread reaches the native ParallelFor pool here (params dict /
+        # XGBoosterSetParam("nthread") both land in p); results are bitwise
+        # independent of the value (docs/native_threading.md)
+        self.context.apply_nthread()
         obj_name = str(p.get("objective", "reg:squarederror"))
         self.objective: ObjFunction = create_objective(obj_name, p)
         self.num_class = int(p.get("num_class", 0))

@@ -255,6 +255,30 @@ class DMatrix:
         enable_categorical: bool = False,
         silent: bool = False,
     ) -> None:
+        _prev_nthread = None
+        if nthread is not None:
+            # pool width scoped to this construction (the reference's
+            # DMatrix nthread semantics); restored in the finally below —
+            # results are bitwise-neutral either way
+            from ..utils import native
+
+            _prev_nthread = native.get_nthread()
+            native.set_nthread(int(nthread))
+        try:
+            self._init_ingest(data, label, weight, base_margin, missing,
+                              feature_names, feature_types, group, qid,
+                              label_lower_bound, label_upper_bound,
+                              feature_weights, enable_categorical)
+        finally:
+            if _prev_nthread is not None:
+                from ..utils import native
+
+                native.set_nthread(_prev_nthread)
+
+    def _init_ingest(self, data, label, weight, base_margin, missing,
+                     feature_names, feature_types, group, qid,
+                     label_lower_bound, label_upper_bound, feature_weights,
+                     enable_categorical) -> None:
         auto_label = auto_qid = None
         self.cat_categories = None  # {feature idx -> category values} (pandas)
         self._jax_X = None  # device-resident input (zero-copy jax.Array ingest)

@@ -155,12 +155,25 @@ def _expected_value(t) -> float:
 
 
 def shap_values_tree(tree, X: np.ndarray) -> np.ndarray:
-    """(R, F+1) exact TreeSHAP values for one tree (last col = bias)."""
+    """(R, F+1) exact TreeSHAP values for one tree (last col = bias).
+
+    Numeric scalar-leaf trees dispatch to the row-parallel native kernel
+    (native/xtb_kernels.h xtb_shap_values_impl — same f64 recursion in the
+    same operation order, threaded across rows with bitwise-identical
+    output for every nthread); categorical trees and lib-less installs walk
+    the Python recursion below."""
     R, F = X.shape
     t = _tree_arrays(tree)
-    out = np.zeros((R, F + 1), np.float64)
     ev = _expected_value(t)
     maxd = tree.max_depth + 2
+    if not t["is_cat"].any():
+        from ..utils import native
+
+        out = native.shap_values_native(t, X, maxd)
+        if out is not None:
+            out[:, F] = ev
+            return out
+    out = np.zeros((R, F + 1), np.float64)
     for r in range(R):
         phi = np.zeros(F + 1, np.float64)
         _tree_shap_recurse(t, X[r], phi, 0, _Path(maxd + 1), 0, 1.0, 1.0, -1)

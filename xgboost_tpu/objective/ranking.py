@@ -145,10 +145,13 @@ def _lambda_gradients_topk_native(pred, y, gptr, *, k: int,
     tolerance (tests/test_native_parity.py pins it)."""
     import numpy as np
 
+    from ..utils import native
+
+    native.ensure_pool()
     R = pred.shape[0]
     shapes = (jax.ShapeDtypeStruct((R,), jnp.float32),
               jax.ShapeDtypeStruct((R,), jnp.float32))
-    call = jax.ffi.ffi_call("xtb_lambdarank", shapes)
+    call = native.jax_ffi().ffi_call("xtb_lambdarank", shapes)
     return call(pred.astype(jnp.float32), y.astype(jnp.float32),
                 gptr.astype(jnp.int32), k=np.int32(k),
                 ndcg_weight=np.int32(ndcg_weight),

@@ -121,14 +121,22 @@ def _native_hist(bins, gpair, pos, node0, n_nodes, n_bin, stride):
 
     node0 may be traced (the padded shared level program) — it rides as an
     operand.  Works under shard_map: the custom call fires per shard on that
-    shard's rows, exactly the partial-histogram semantics the psum expects."""
+    shard's rows, exactly the partial-histogram semantics the psum expects.
+
+    The kernel is internally multi-threaded (feature-sharded ParallelFor,
+    native/xtb_kernels.h) with bitwise-identical output for every nthread;
+    ensure_pool() applies the process's thread-count default before the
+    first dispatch."""
     import numpy as np
 
+    from ..utils import native
+
+    native.ensure_pool()
     R, F = bins.shape
     C = gpair.shape[1]
     if bins.dtype not in (jnp.uint8, jnp.uint16, jnp.int16, jnp.int32):
         bins = bins.astype(jnp.int32)
-    call = jax.ffi.ffi_call(
+    call = native.jax_ffi().ffi_call(
         "xtb_hist",
         jax.ShapeDtypeStruct((n_nodes, F, n_bin, C), jnp.float32))
     return call(bins, gpair.astype(jnp.float32), pos.astype(jnp.int32),

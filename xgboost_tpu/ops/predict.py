@@ -152,9 +152,14 @@ def _native_predict_ok() -> bool:
 def _predict_native(X, feat, thr, dleft, left, right, value, groups,
                     is_cat, catm, init, n_groups: int, depth: int):
     """FFI custom call into xtb_predict_raw_impl — rows outer, trees inner,
-    per-row adds in tree order (bitwise-identical to the XLA scan)."""
+    per-row adds in tree order (bitwise-identical to the XLA scan).  The
+    kernel row-block-shards across the ParallelFor pool; output is bitwise
+    identical for every nthread."""
     import numpy as np
 
+    from ..utils import native
+
+    native.ensure_pool()
     R = X.shape[0]
     T, M = feat.shape
     has_cat = is_cat is not None
@@ -164,7 +169,7 @@ def _predict_native(X, feat, thr, dleft, left, right, value, groups,
           else jnp.zeros((T, M, 1), jnp.uint8))
     init_arr = (jnp.zeros((R, n_groups), jnp.float32) if init is None
                 else init.astype(jnp.float32))
-    call = jax.ffi.ffi_call(
+    call = native.jax_ffi().ffi_call(
         "xtb_predict", jax.ShapeDtypeStruct((R, n_groups), jnp.float32))
     return call(X.astype(jnp.float32), feat.astype(jnp.int32),
                 thr.astype(jnp.float32), dleft.astype(jnp.uint8),
@@ -269,6 +274,9 @@ def predict_margin_delta_binned(bins, feat, sbin, dleft, left, right, value,
     if _native_predict_ok():
         import numpy as np
 
+        from ..utils import native
+
+        native.ensure_pool()
         R = bins.shape[0]
         T, M = feat.shape
         has_cat = is_cat is not None
@@ -281,7 +289,7 @@ def predict_margin_delta_binned(bins, feat, sbin, dleft, left, right, value,
         b = bins
         if b.dtype not in (jnp.uint8, jnp.uint16, jnp.int16, jnp.int32):
             b = b.astype(jnp.int32)
-        call = jax.ffi.ffi_call(
+        call = native.jax_ffi().ffi_call(
             "xtb_predict_binned",
             jax.ShapeDtypeStruct((R, n_groups), jnp.float32))
         return call(b, feat.astype(jnp.int32), sbin.astype(jnp.int32),

@@ -223,7 +223,10 @@ def _evaluate_splits_native(hist, totals, n_bins, params: SplitParams,
               jax.ShapeDtypeStruct((N,), jnp.uint8),
               jax.ShapeDtypeStruct((N,), jnp.float32),
               jax.ShapeDtypeStruct((N,), jnp.float32))
-    call = jax.ffi.ffi_call("xtb_split", shapes)
+    from ..utils import native as _native
+
+    _native.ensure_pool()
+    call = _native.jax_ffi().ffi_call("xtb_split", shapes)
     gain, feat, bin_, dleft, GL, HL = call(
         hist.astype(jnp.float32), totals.astype(jnp.float32),
         n_bins.astype(jnp.int32), fm.astype(jnp.uint8),

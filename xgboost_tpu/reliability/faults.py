@@ -64,6 +64,7 @@ SEAMS = frozenset({
     "tracker.connected",
     "checkpoint.write",
     "serve.worker",
+    "native.parallel_for",
 })
 
 # Debug guard: with XGBOOST_TPU_STRICT_SEAMS=1, maybe_inject() rejects

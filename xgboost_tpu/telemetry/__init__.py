@@ -40,7 +40,7 @@ from .registry import (Counter, Gauge, Histogram, Registry, get_registry,
 from .spans import (PHASE_HISTOGRAM, Span, disable, enable, enabled,
                     phase_totals, record_phase, span)
 from .compile import COMPILE_EVENT, compile_delta, compiles_total
-from . import trace
+from . import native_pool, trace
 from .callback import TelemetryCallback
 
 __all__ = [
@@ -49,6 +49,6 @@ __all__ = [
     "span", "Span", "enable", "disable", "enabled", "record_phase",
     "phase_totals", "PHASE_HISTOGRAM",
     "compiles_total", "compile_delta", "COMPILE_EVENT",
-    "trace",
+    "trace", "native_pool",
     "TelemetryCallback",
 ]

@@ -1,0 +1,72 @@
+"""Bridge: native ParallelFor pool counters -> the telemetry registry.
+
+The pool (native/xtb_kernels.h XtbThreadPool) lives in C++ — one instance
+per loaded kernel library — and tracks per-kernel region counts, busy
+nanoseconds, and pre-bucketed per-region busy-second histograms whose
+bounds equal ``registry.DEFAULT_BUCKETS`` exactly.  ``sync()`` reads those
+counters through the pool C ABI (utils/native.py ``pool_stats``) and folds
+the DELTAS since the previous sync into three registry families:
+
+- ``xtb_native_threads`` (gauge) — configured pool width;
+- ``xtb_native_parallel_regions_total{kernel}`` (counter) — multi-shard
+  parallel regions dispatched (inline/single-shard runs are not regions);
+- ``xtb_native_busy_seconds{kernel}`` (histogram) — per-region busy seconds
+  summed over the participating threads.
+
+Metrics appear only after the first ``sync()``: the pool is C++ and cannot
+push into the Python registry itself, so scrape endpoints and snapshot
+readers call ``sync()`` first (the serving example in
+docs/observability.md does).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+from ..utils import native
+from .registry import get_registry
+
+_lock = threading.Lock()
+# per-kernel last-seen (regions, busy_ns, buckets) so repeated syncs fold
+# only the delta into the monotone registry families
+_seen: Dict[str, Tuple[int, int, Tuple[int, ...]]] = {}
+
+
+def sync() -> dict:
+    """Fold fresh pool counters into the registry; returns the raw
+    aggregated ``native.pool_stats()`` snapshot for convenience."""
+    stats = native.pool_stats()
+    reg = get_registry()
+    reg.gauge("xtb_native_threads",
+              "configured native ParallelFor pool width").set(
+                  stats["nthread"])
+    regions = reg.counter(
+        "xtb_native_parallel_regions_total",
+        "multi-shard parallel regions dispatched by the native pool",
+        ("kernel",))
+    busy = reg.histogram(
+        "xtb_native_busy_seconds",
+        "per-region busy seconds (summed over participating threads)",
+        ("kernel",))
+    with _lock:
+        for name, k in stats["kernels"].items():
+            prev = _seen.get(name, (0, 0, tuple([0] * len(k["buckets"]))))
+            d_regions = k["regions"] - prev[0]
+            d_busy_ns = max(k["busy_ns"] - prev[1], 0)
+            d_buckets = [max(b - p, 0)
+                         for b, p in zip(k["buckets"], prev[2])]
+            # the C counters are per-slot atomics, not a snapshot: a read
+            # concurrent with record() can tear across slots.  Deriving the
+            # histogram count FROM the bucket deltas keeps the Prometheus
+            # invariant (+Inf cumulative == _count) by construction, and a
+            # torn region only shifts when an increment is folded, never
+            # whether
+            d_count = sum(d_buckets)
+            if d_regions > 0:
+                regions.labels(name).inc(d_regions)
+            if d_count > 0:
+                busy.labels(name).merge_bucketed(
+                    d_buckets, d_busy_ns * 1e-9, d_count)
+            _seen[name] = (k["regions"], k["busy_ns"],
+                           tuple(k["buckets"]))
+    return stats

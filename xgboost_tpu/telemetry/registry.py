@@ -200,6 +200,22 @@ class _HistogramChild:
             self.sum += v
             self.count += 1
 
+    def merge_bucketed(self, counts: Sequence[int], sum_: float,
+                       count: int) -> None:
+        """Fold observations that were already bucketed elsewhere (the
+        native ParallelFor pool keeps per-kernel duration buckets in C++
+        with these exact bounds; telemetry/native_pool.py bridges the
+        deltas here).  ``counts`` must cover every bucket incl. overflow."""
+        if len(counts) != len(self.counts):
+            raise ValueError(
+                f"expected {len(self.counts)} bucket counts, got "
+                f"{len(counts)}")
+        with self._lock:
+            for i, c in enumerate(counts):
+                self.counts[i] += int(c)
+            self.sum += float(sum_)
+            self.count += int(count)
+
 
 class Histogram(_Family):
     kind = "histogram"

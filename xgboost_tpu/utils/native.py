@@ -20,6 +20,22 @@ _LIB = None
 _TRIED = False
 
 
+def jax_ffi():
+    """The jax FFI module: ``jax.ffi`` (jax >= 0.5) or ``jax.extend.ffi``
+    (0.4.x) — identical surface for everything this package uses
+    (``ffi_call``, ``register_ffi_target``, ``pycapsule``, ``include_dir``).
+    Every FFI call site routes through this shim so the native kernels stay
+    live across the jax version seam."""
+    import jax
+
+    mod = getattr(jax, "ffi", None)
+    if mod is not None and hasattr(mod, "ffi_call"):
+        return mod
+    import jax.extend.ffi as ffi  # jax 0.4.x
+
+    return ffi
+
+
 def _native_dir() -> str:
     return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), "native")
@@ -73,8 +89,160 @@ def load_native() -> Optional[ctypes.CDLL]:
     lib.xtb_summary_total.restype = c.c_double
     lib.xtb_summary_total.argtypes = [c.c_void_p]
     lib.xtb_summary_free.argtypes = [c.c_void_p]
+    lib.xtb_shap_values.argtypes = [c.c_void_p, c.c_int64, c.c_int32,
+                                    c.c_void_p, c.c_void_p, c.c_void_p,
+                                    c.c_void_p, c.c_void_p, c.c_void_p,
+                                    c.c_void_p, c.c_int32, c.c_void_p]
+    _bind_pool_abi(lib)
     _LIB = lib
+    if _NTHREAD is not None:  # pool configured before this lib loaded
+        lib.xtb_set_nthread(_NTHREAD)
     return lib
+
+
+# --------------------------------------------------------------------------
+# ParallelFor pool control (native/xtb_kernels.h XtbThreadPool).
+#
+# Each shared object (libxtb_native.so for the ctypes kernels, libxtb_ffi.so
+# for the XLA custom calls) carries its own pool instance; configuration and
+# stats reads fan out to every loaded library.  nthread precedence:
+# explicit ``nthread`` param > ``XGBOOST_TPU_NTHREAD`` env > os.cpu_count().
+# --------------------------------------------------------------------------
+
+_NTHREAD: Optional[int] = None  # last applied effective thread count
+_FFI_LIB = None                 # CDLL handle kept for the pool ABI
+
+POOL_STAT_SLOTS = 13  # [regions, busy_ns, bucket_0 .. bucket_10]
+
+
+def _bind_pool_abi(lib) -> None:
+    c = ctypes
+    lib.xtb_set_nthread.restype = c.c_int
+    lib.xtb_set_nthread.argtypes = [c.c_int]
+    lib.xtb_get_nthread.restype = c.c_int
+    lib.xtb_pool_alive_workers.restype = c.c_int
+    lib.xtb_pool_faults_total.restype = c.c_int64
+    lib.xtb_pool_regions_total.restype = c.c_int64
+    lib.xtb_pool_n_kernels.restype = c.c_int
+    lib.xtb_pool_kernel_name.restype = c.c_char_p
+    lib.xtb_pool_kernel_name.argtypes = [c.c_int]
+    lib.xtb_pool_kernel_stats.argtypes = [c.c_int, c.c_void_p]
+    lib.xtb_pool_instance_id.restype = c.c_uint64
+
+
+def _pool_libs() -> list:
+    """Loaded kernel libraries, deduped by pool instance: gcc gives the
+    pool's inline static STB_GNU_UNIQUE linkage, so libxtb_native.so and
+    libxtb_ffi.so normally SHARE one pool in-process (configuring/killing/
+    counting through either handle hits the same instance)."""
+    seen, out = set(), []
+    for lib in (load_native(), _FFI_LIB):
+        if lib is None:
+            continue
+        pid = int(lib.xtb_pool_instance_id())
+        if pid not in seen:
+            seen.add(pid)
+            out.append(lib)
+    return out
+
+
+_NTHREAD_CAP = 1024  # must mirror XtbThreadPool::resolve's clamp
+
+
+def resolve_nthread(n: int = 0) -> int:
+    """Effective thread count for ``nthread=n`` (0/negative = default),
+    with the same 1024 cap the C++ pool applies — so the cached value,
+    the gauge, and bench provenance report what the pool actually runs."""
+    if n and int(n) > 0:
+        return min(int(n), _NTHREAD_CAP)
+    env = os.environ.get("XGBOOST_TPU_NTHREAD", "").strip()
+    if env:
+        try:
+            v = int(env)
+            if v > 0:
+                return min(v, _NTHREAD_CAP)
+        except ValueError:
+            pass
+    return min(os.cpu_count() or 1, _NTHREAD_CAP)
+
+
+def set_nthread(n: int = 0) -> int:
+    """Configure the native ParallelFor pools (both libraries) to ``n``
+    threads (0 = default precedence above).  Kernel results are bitwise
+    independent of this value (docs/native_threading.md); it only changes
+    how many cores the native kernels use.  Idempotent and cheap when the
+    effective count is unchanged."""
+    global _NTHREAD
+    _pool_fault_probe()
+    eff = resolve_nthread(n)
+    if eff == _NTHREAD:
+        return eff
+    for lib in _pool_libs():
+        lib.xtb_set_nthread(eff)
+    _NTHREAD = eff
+    return eff
+
+
+def get_nthread() -> int:
+    """The currently applied pool width (resolving the default lazily)."""
+    if _NTHREAD is None:
+        return set_nthread(0)
+    return _NTHREAD
+
+
+def ensure_pool() -> None:
+    """Dispatch-site hook (ops/histogram.py, ops/predict.py): apply the
+    default pool width once before the first native kernel runs."""
+    if _NTHREAD is None:
+        set_nthread(0)
+
+
+def _pool_fault_probe() -> None:
+    """`native.parallel_for` fault seam (reliability/faults.py): fires when
+    the pool is (re)configured.  ``kill``/``exception``/``delay`` apply at
+    the seam; the caller-applied kinds (``drop_connection``/``truncate``)
+    make the pool lose one worker thread before its next region — the pool
+    must complete the region on the remaining threads, stay bitwise-correct,
+    and respawn (pinned by tests/test_native_threads.py)."""
+    try:
+        from ..reliability.faults import maybe_inject
+    except ImportError:  # pragma: no cover - partial install
+        return
+    spec = maybe_inject("native.parallel_for")
+    if spec is not None and spec.kind in ("drop_connection", "truncate"):
+        for lib in _pool_libs():
+            lib.xtb_pool_kill_worker()
+
+
+def pool_stats() -> dict:
+    """Aggregated pool counters across loaded libraries:
+    ``{"nthread", "alive_workers", "faults_total", "regions_total",
+    "kernels": {name: {"regions", "busy_ns", "buckets": [11]}}}``.
+    The Python-side telemetry bridge (telemetry/native_pool.py) folds the
+    deltas into the metrics registry."""
+    out = {
+        "nthread": get_nthread(),
+        "alive_workers": 0,
+        "faults_total": 0,
+        "regions_total": 0,
+        "kernels": {},
+    }
+    for lib in _pool_libs():
+        out["alive_workers"] += int(lib.xtb_pool_alive_workers())
+        out["faults_total"] += int(lib.xtb_pool_faults_total())
+        out["regions_total"] += int(lib.xtb_pool_regions_total())
+        buf = (ctypes.c_int64 * POOL_STAT_SLOTS)()
+        for k in range(int(lib.xtb_pool_n_kernels())):
+            name = lib.xtb_pool_kernel_name(k).decode()
+            lib.xtb_pool_kernel_stats(k, buf)
+            agg = out["kernels"].setdefault(
+                name, {"regions": 0, "busy_ns": 0,
+                       "buckets": [0] * (POOL_STAT_SLOTS - 2)})
+            agg["regions"] += int(buf[0])
+            agg["busy_ns"] += int(buf[1])
+            for i in range(POOL_STAT_SLOTS - 2):
+                agg["buckets"][i] += int(buf[2 + i])
+    return out
 
 
 _FFI_READY: Optional[bool] = None
@@ -94,7 +262,7 @@ def load_ffi() -> bool:
     custom calls (jax.ffi).  The pure_callback route is NOT used as a
     fallback — jax 0.9's CPU host-callback deadlocks on large operands —
     callers fall back to the XLA scatter/cumsum formulations instead."""
-    global _FFI_READY
+    global _FFI_READY, _FFI_LIB
     if _FFI_READY is not None:
         return _FFI_READY
     _FFI_READY = False
@@ -122,8 +290,7 @@ def load_ffi() -> bool:
                     fcntl.flock(lk, fcntl.LOCK_UN)
         import ctypes as c
 
-        import jax
-
+        ffi = jax_ffi()
         lib = c.CDLL(so)
         for name, sym in (("xtb_hist", lib.XtbHist),
                           ("xtb_hist_q", lib.XtbHistQ),
@@ -131,8 +298,11 @@ def load_ffi() -> bool:
                           ("xtb_predict", lib.XtbPredict),
                           ("xtb_predict_binned", lib.XtbPredictBinned),
                           ("xtb_lambdarank", lib.XtbLambdaRank)):
-            jax.ffi.register_ffi_target(name, jax.ffi.pycapsule(sym),
-                                        platform="cpu")
+            ffi.register_ffi_target(name, ffi.pycapsule(sym), platform="cpu")
+        _bind_pool_abi(lib)
+        _FFI_LIB = lib
+        if _NTHREAD is not None:  # pool configured before this lib loaded
+            lib.xtb_set_nthread(_NTHREAD)
         _FFI_READY = True
     except Exception:
         _FFI_READY = False
@@ -230,6 +400,37 @@ def parse_csv(path: str, skip_header: Optional[bool] = None) -> np.ndarray:
             lib.xtb_dense_free(h)
     return np.genfromtxt(path, delimiter=",", dtype=np.float32,
                          skip_header=int(skip_header))
+
+
+def shap_values_native(t: dict, X: np.ndarray,
+                       max_depth: int) -> Optional[np.ndarray]:
+    """Row-parallel exact TreeSHAP for one scalar-leaf numeric tree
+    (native/xtb_kernels.h xtb_shap_values_impl — the f64 twin of the host
+    walk in interpret/__init__.py, identical operation order).
+
+    ``t`` is interpret's ``_tree_arrays`` dict; returns (R, F+1) with the
+    bias column left at zero (the caller fills the tree expectation), or
+    None when the native library is unavailable."""
+    lib = load_native()
+    if lib is None:
+        return None
+    R, F = X.shape
+    Xc = np.ascontiguousarray(X, np.float64)
+    left = np.ascontiguousarray(t["left"], np.int32)
+    right = np.ascontiguousarray(t["right"], np.int32)
+    feat = np.ascontiguousarray(t["feat"], np.int32)
+    thr = np.ascontiguousarray(t["thr"], np.float64)
+    dleft = np.ascontiguousarray(t["dleft"], np.uint8)
+    value = np.ascontiguousarray(t["value"], np.float64)
+    cover = np.ascontiguousarray(t["cover"], np.float64)
+    out = np.zeros((R, F + 1), np.float64)
+    ensure_pool()
+    lib.xtb_shap_values(
+        Xc.ctypes.data, R, F, left.ctypes.data, right.ctypes.data,
+        feat.ctypes.data, thr.ctypes.data, dleft.ctypes.data,
+        value.ctypes.data, cover.ctypes.data, int(max_depth),
+        out.ctypes.data)
+    return out
 
 
 class StreamingQuantileSummary:
