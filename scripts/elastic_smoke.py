@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Elastic-training smoke for the nightly suite (docs/reliability.md
+§ Elastic training).
+
+Three legs over a tracker-rendezvous CPU run:
+
+1. **Shrink**: 4 workers, the fault plan kills rank 2 entering round 3;
+   the survivors regroup and FINISH at world 3 — no restart — producing a
+   valid model, with the shard map in the final checkpoint recording the
+   3-way ownership.
+2. **Determinism**: the same fault plan run twice must produce
+   bitwise-identical model bytes (the elastic determinism contract: a
+   rescaled run is reproducible given the same death schedule).
+3. **Absorb**: same kill, but the launcher respawns one replacement
+   worker; it connects to the tracker, is absorbed at a round boundary
+   with the shard map restored from the checkpoint, and the run finishes
+   with the final checkpoint back at world 4.
+
+Usage: JAX_PLATFORMS=cpu python scripts/elastic_smoke.py [workers] [rounds]
+"""
+import functools
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# NOTE: no argv parsing at module level — the spawned workers re-import
+# this module (launcher mod_dir) with THEIR OWN argv; every per-run knob
+# travels through functools.partial kwargs instead.
+PARAMS = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+          "max_bin": 32}
+N_ROWS = 2400
+
+
+def worker(rank, world, *, ckpt_dir, out_path, rounds, num_shards):
+    import numpy as np
+
+    import xgboost_tpu as xtb
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N_ROWS, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+
+    def data_fn(shard_map, rank, world):
+        # shard s = rows s::num_shards — any worker can materialize any
+        # shard (the elastic contract: shards are globally loadable)
+        shards = shard_map.shards_of(rank)
+        rows = np.sort(np.concatenate(
+            [np.arange(s, N_ROWS, shard_map.num_shards) for s in shards]))
+        return xtb.DMatrix(X[rows], label=y[rows])
+
+    cfg = xtb.ElasticConfig(data_fn, ckpt_dir, num_shards=num_shards)
+    bst = xtb.train(PARAMS, None, rounds, elastic=cfg, verbose_eval=False)
+    from xgboost_tpu import collective
+
+    # every survivor could write: the killed worker may have been rank 0's
+    # original holder; whoever ends up rank 0 owns the artifact
+    if collective.get_rank() == 0 and out_path:
+        with open(out_path, "wb") as fh:
+            fh.write(bytes(bst.save_raw()))
+
+
+def _run(tag, *, workers, rounds, num_shards, ckpt_dir, out_path,
+         fault_plan=None, max_respawns=0):
+    import json
+
+    from xgboost_tpu.launcher import run_distributed
+
+    print(f"[elastic_smoke] {tag}: {workers} workers, {rounds} rounds"
+          + (f", respawns={max_respawns}" if max_respawns else ""),
+          flush=True)
+    run_distributed(
+        functools.partial(worker, ckpt_dir=ckpt_dir, out_path=out_path,
+                          rounds=rounds, num_shards=num_shards),
+        num_workers=workers, platform="cpu", timeout=900,
+        rendezvous="tracker", elastic=True,
+        fault_plan=json.dumps(fault_plan) if fault_plan else None,
+        max_respawns=max_respawns)
+
+
+def main() -> int:
+    from xgboost_tpu.reliability import latest_checkpoint
+
+    WORKERS = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    KILL_RANK, KILL_ROUND = min(2, WORKERS - 1), 3
+    NUM_SHARDS = 2 * WORKERS
+
+    # pickle the worker under its importable module name, not __main__ —
+    # the spawned children re-import it from scripts/ (launcher mod_dir)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import elastic_smoke as _mod
+
+    global worker
+    worker = _mod.worker
+
+    # `at` pins the death to the FIRST pass over round KILL_ROUND: after
+    # the regroup a (different) worker holds rank KILL_RANK and re-runs
+    # the same round — without the invocation matcher the plan would kill
+    # it too, every regroup, until the world collapsed
+    plan = {"faults": [{"site": "train.round", "kind": "kill",
+                        "rank": KILL_RANK, "round": KILL_ROUND,
+                        "at": KILL_ROUND, "exit_code": 43}]}
+    tmp = tempfile.mkdtemp(prefix="xtb_elastic_smoke_")
+    try:
+        kw = dict(workers=WORKERS, rounds=ROUNDS, num_shards=NUM_SHARDS)
+        # -- leg 1: shrink to WORKERS-1 and finish ------------------------
+        ckpt_a = os.path.join(tmp, "ckpt_a")
+        out_a = os.path.join(tmp, "a.ubj")
+        _run("shrink", ckpt_dir=ckpt_a, out_path=out_a, fault_plan=plan,
+             **kw)
+        model_a = open(out_a, "rb").read()
+        st = latest_checkpoint(ckpt_a)
+        if st is None or st.round != ROUNDS:
+            raise SystemExit(f"shrink run did not complete: {st}")
+        if st.world != WORKERS - 1 or st.shard_map["world"] != WORKERS - 1:
+            raise SystemExit(
+                f"final checkpoint world {st.world} != {WORKERS - 1}: the "
+                "survivors did not regroup")
+        print(f"[elastic_smoke] shrink OK: finished at world {st.world}, "
+              f"{len(model_a)} model bytes")
+
+        # -- leg 2: bitwise reproducibility under the same plan -----------
+        ckpt_b = os.path.join(tmp, "ckpt_b")
+        out_b = os.path.join(tmp, "b.ubj")
+        _run("replay", ckpt_dir=ckpt_b, out_path=out_b, fault_plan=plan,
+             **kw)
+        model_b = open(out_b, "rb").read()
+        if model_a != model_b:
+            raise SystemExit(
+                "DETERMINISM FAILURE: two elastic runs under the same "
+                f"fault plan differ ({len(model_a)} vs {len(model_b)} "
+                "bytes)")
+        print(f"[elastic_smoke] determinism OK: identical bytes across "
+              f"replayed fault plan")
+
+        # -- leg 3: absorb a replacement at a round boundary --------------
+        # pace the rounds (pure-delay faults change no bits) so the
+        # replacement's cold start reliably lands before the final round
+        absorb_plan = {"faults": plan["faults"] + [
+            {"site": "train.round", "kind": "delay", "seconds": 1.5,
+             "times": 1000}]}
+        ckpt_c = os.path.join(tmp, "ckpt_c")
+        out_c = os.path.join(tmp, "c.ubj")
+        _run("absorb", ckpt_dir=ckpt_c, out_path=out_c,
+             fault_plan=absorb_plan, max_respawns=1, **kw)
+        model_c = open(out_c, "rb").read()
+        st = latest_checkpoint(ckpt_c)
+        if st is None or st.round != ROUNDS:
+            raise SystemExit(f"absorb run did not complete: {st}")
+        if not model_c:
+            raise SystemExit("absorb run produced no model")
+        # the replacement joined mid-run: the final shard map must be back
+        # at full world, restored/rebalanced through the checkpoint
+        if st.shard_map["world"] != WORKERS:
+            raise SystemExit(
+                f"absorb run finished at world {st.shard_map['world']}, "
+                f"expected {WORKERS} (replacement not absorbed)")
+        print(f"[elastic_smoke] absorb OK: finished back at world "
+              f"{st.shard_map['world']}, {len(model_c)} model bytes")
+        print(f"[elastic_smoke] OK: shrink + determinism + absorb "
+              f"({WORKERS} workers, {ROUNDS} rounds)")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,4 +65,11 @@ rm -f "$TRACE_OUT"
 # and require final-model UBJSON parity with an uninterrupted run
 JAX_PLATFORMS=cpu python scripts/fault_smoke.py 4 6
 
+# elastic smoke (docs/reliability.md § Elastic training): 4 workers, kill
+# rank 2 mid-run via the fault plan, the survivors FINISH at world 3 (no
+# restart); the same plan replayed must give bitwise-identical model
+# bytes; a respawned replacement is absorbed at a round boundary with the
+# shard map restored from the checkpoint
+JAX_PLATFORMS=cpu python scripts/elastic_smoke.py 4 8
+
 BENCH_FORCE_CPU=1 BENCH_ROWS=100000 BENCH_ROUNDS=5 python bench.py

@@ -48,7 +48,7 @@ _QUICK_FILES = {
     "test_serving.py", "test_arrow.py", "test_telemetry.py",
     "test_timer_observer.py", "test_reliability.py",
     "test_serving_faults.py", "test_reliability_multiprocess.py",
-    "test_analysis.py", "test_native_threads.py",
+    "test_analysis.py", "test_native_threads.py", "test_elastic.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)
@@ -78,6 +78,8 @@ _QUICK_DENY = {
     "test_ranker_sklearn_with_eval", "test_dart_weighted_sampling",
     "test_categorical_nan_uses_default_direction",
     "test_cox_partial_likelihood",
+    "test_inmemory_elastic_shrink_finishes_at_reduced_world",
+    "test_two_process_elastic_shrink_to_single_worker",
 }
 
 

@@ -1,0 +1,587 @@
+"""Elastic training: survive worker loss at reduced world size, absorb
+replacements at round boundaries (docs/reliability.md § Elastic training).
+
+Quick tier: the regroup state machine runs on the in-memory thread
+backend — no subprocess spawn — plus unit coverage of the shard map, the
+versioned checkpoint format, the relay's stale-buffer flush, and the
+launcher's failure attribution.  The real multi-process protocol (tracker
+regroup, relay epochs, replacement absorption) is exercised by the
+slow-tier tests here and at 4 workers by ``scripts/elastic_smoke.py`` in
+the nightly suite.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu import collective
+from xgboost_tpu.elastic import RegroupRequired, ShardMap
+from xgboost_tpu.reliability import faults, latest_checkpoint
+from xgboost_tpu.reliability.checkpoint import (CheckpointManager,
+                                                CheckpointState, _decode)
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 2, "eta": 0.3,
+          "max_bin": 16}
+
+
+def _toy(n=900, f=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# ShardMap
+# ---------------------------------------------------------------------------
+
+
+def test_shard_map_create_rebalance_roundtrip():
+    m = ShardMap.create(8, 4)
+    # round-robin, every shard owned exactly once, deterministic
+    assert m.assign == tuple(s % 4 for s in range(8))
+    assert sorted(sum((m.shards_of(r) for r in range(4)), ())) == list(range(8))
+    assert m == ShardMap.create(8, 4)
+
+    shrunk = m.rebalance(3)
+    assert shrunk.world == 3 and shrunk.num_shards == 8
+    # the departed rank's shards are re-owned, none lost
+    assert sorted(sum((shrunk.shards_of(r) for r in range(3)), ())) == list(range(8))
+    # rebalance is a pure function: shrink-then-grow returns to the start
+    assert shrunk.rebalance(4) == m
+
+    assert ShardMap.from_dict(m.to_dict()) == m
+    with pytest.raises(ValueError):
+        ShardMap.create(2, 4)  # a rank would own no data
+    with pytest.raises(ValueError):
+        ShardMap.from_dict({"num_shards": 3, "world": 2, "assign": [0, 1]})
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint format v2 + v1 fallback
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_v2_carries_world_and_shard_map(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    smap = ShardMap.create(6, 3)
+    mgr.save(CheckpointState(round=4, booster_bytes=b"model-bytes",
+                             history={"train": {"logloss": [0.5, 0.4]}},
+                             callback_state={}, world=3,
+                             shard_map=smap.to_dict()))
+    st = mgr.load_latest()
+    assert st.round == 4 and st.booster_bytes == b"model-bytes"
+    assert st.world == 3
+    assert ShardMap.from_dict(st.shard_map) == smap
+
+
+def _encode_v1(round_, booster, history):
+    """The pre-elastic (PR 3) on-disk layout, byte for byte."""
+    import hashlib
+    import struct
+
+    meta = json.dumps({"version": 1, "round": round_,
+                       "booster_len": len(booster), "history": history,
+                       "callback_state": {}}).encode()
+    body = b"XTBCKPT1" + struct.pack(">I", len(meta)) + meta + booster
+    return body + hashlib.sha256(body).digest()
+
+
+def test_checkpoint_v1_backward_compat(tmp_path):
+    """Pre-elastic checkpoints still load: world/shard_map read as None."""
+    blob = _encode_v1(7, b"old-model", {"train": {"rmse": [1.0]}})
+    st = _decode(blob)
+    assert st.round == 7 and st.booster_bytes == b"old-model"
+    assert st.world is None and st.shard_map is None
+
+    # and through the manager's file path
+    path = tmp_path / "ckpt_00000007.xtbckpt"
+    path.write_bytes(blob)
+    st = latest_checkpoint(str(tmp_path))
+    assert st is not None and st.round == 7 and st.shard_map is None
+
+
+def test_checkpoint_unknown_version_falls_back(tmp_path):
+    """A future-format file is skipped (with a warning) in favor of the
+    newest file this reader understands — the corruption-fallback path."""
+    import hashlib
+    import struct
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(CheckpointState(round=3, booster_bytes=b"good", history={},
+                             callback_state={}))
+    meta = json.dumps({"version": 99, "round": 5, "booster_len": 1,
+                       "history": {}, "callback_state": {}}).encode()
+    body = b"XTBCKPT1" + struct.pack(">I", len(meta)) + meta + b"x"
+    (tmp_path / "ckpt_00000005.xtbckpt").write_bytes(
+        body + hashlib.sha256(body).digest())
+    with pytest.warns(RuntimeWarning, match="version"):
+        st = mgr.load_latest()
+    assert st is not None and st.round == 3
+
+
+# ---------------------------------------------------------------------------
+# In-memory elastic shrink/absorb (the quick-tier regroup coverage)
+# ---------------------------------------------------------------------------
+
+
+def _elastic_worker(rank, world, group, ckpt_dir, rounds, num_shards,
+                    results, errors, join=False, X=None, y=None):
+    backend = None
+    try:
+        args = dict(dmlc_communicator="in-memory", in_memory_group=group)
+        if join:
+            args.update(in_memory_join=True, in_memory_join_timeout=120.0)
+        else:
+            args.update(in_memory_world_size=world, in_memory_rank=rank)
+        with collective.CommunicatorContext(**args):
+            backend = collective._TLS.backend
+
+            def data_fn(smap, rank, world):
+                rows = np.sort(np.concatenate(
+                    [np.arange(s, len(X), smap.num_shards)
+                     for s in smap.shards_of(rank)]))
+                return xtb.DMatrix(X[rows], label=y[rows])
+
+            cfg = xtb.ElasticConfig(data_fn, ckpt_dir,
+                                    num_shards=num_shards)
+            bst = xtb.train(PARAMS, None, rounds, elastic=cfg,
+                            verbose_eval=False)
+            results[rank if not join else f"join{rank}"] = bytes(
+                bst.save_raw())
+    except faults.FaultInjected:
+        # the planned preemption: this worker departs the group
+        if backend is not None:
+            backend.leave()
+    except Exception as e:  # noqa: BLE001
+        errors[rank] = e
+        try:
+            backend._group.barrier.abort()
+        except Exception:
+            pass
+
+
+def _run_inmemory_shrink(group, ckpt_dir, plan):
+    X, y = _toy()
+    results, errors = {}, {}
+    faults.install(plan)
+    try:
+        threads = [threading.Thread(
+            target=_elastic_worker,
+            args=(r, 3, group, ckpt_dir, 5, 6, results, errors),
+            kwargs=dict(X=X, y=y), daemon=True) for r in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), "worker deadlocked"
+    finally:
+        faults.clear()
+    assert not errors, errors
+    return results
+
+
+# NOTE: no `at` matcher here — thread workers share one process-global
+# invocation counter, so rank+round are the right thread-safe matchers
+# (the shrunken world has no rank 2, so the spec cannot re-fire).  The
+# subprocess tests below DO pin `at`: there each worker counts alone, and
+# a post-regroup worker re-running the same round at the victim's old
+# rank must not be killed again.
+_SHRINK_PLAN = {"faults": [{"site": "train.round", "kind": "exception",
+                            "rank": 2, "round": 2}]}
+
+
+def test_inmemory_elastic_shrink_finishes_at_reduced_world(tmp_path):
+    """3 thread workers; rank 2 is preempted entering round 2; the two
+    survivors regroup in-process, inherit its shards, and finish all 5
+    rounds with identical model bytes — no restart."""
+    results = _run_inmemory_shrink("el_shrink", str(tmp_path / "ck"),
+                                   _SHRINK_PLAN)
+    assert sorted(results) == [0, 1]  # rank 2 departed
+    assert results[0] == results[1]
+    bst = xtb.Booster()
+    bst.load_model(results[0])
+    assert bst.num_boosted_rounds() == 5
+
+    st = latest_checkpoint(str(tmp_path / "ck"))
+    assert st is not None and st.round == 5
+    assert st.world == 2  # written after the shrink
+    smap = ShardMap.from_dict(st.shard_map)
+    assert smap.world == 2 and smap.num_shards == 6
+    # the dead rank's shards are owned by survivors
+    assert sorted(smap.shards_of(0) + smap.shards_of(1)) == list(range(6))
+
+
+def test_inmemory_elastic_shrink_bitwise_reproducible(tmp_path):
+    """The determinism contract: the same fault plan replayed gives
+    bitwise-identical final model bytes."""
+    a = _run_inmemory_shrink("el_rep_a", str(tmp_path / "a"), _SHRINK_PLAN)
+    b = _run_inmemory_shrink("el_rep_b", str(tmp_path / "b"), _SHRINK_PLAN)
+    assert a[0] == b[0], "elastic shrink is not reproducible"
+
+
+def test_inmemory_elastic_absorb_replacement(tmp_path):
+    """2 workers train; once checkpoints exist a replacement parks on the
+    group and is absorbed at the next round boundary (world back to 3);
+    everyone — including the replacement, which restores the shard map
+    from the checkpoint — finishes with identical model bytes."""
+    X, y = _toy()
+    ckpt_dir = str(tmp_path / "ck")
+    group = "el_absorb"
+    results, errors = {}, {}
+    threads = [threading.Thread(
+        target=_elastic_worker,
+        args=(r, 2, group, ckpt_dir, 6, 6, results, errors),
+        kwargs=dict(X=X, y=y), daemon=True) for r in range(2)]
+    for t in threads:
+        t.start()
+    # wait for the first committed checkpoint, then join mid-run
+    deadline = time.monotonic() + 120
+    while latest_checkpoint(ckpt_dir) is None:
+        assert time.monotonic() < deadline, "no checkpoint appeared"
+        time.sleep(0.02)
+    joiner = threading.Thread(
+        target=_elastic_worker,
+        args=(9, None, group, ckpt_dir, 6, 6, results, errors),
+        kwargs=dict(join=True, X=X, y=y), daemon=True)
+    joiner.start()
+    for t in threads + [joiner]:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads + [joiner]), "deadlocked"
+    assert not errors, errors
+    assert sorted(map(str, results)) == ["0", "1", "join9"]
+    vals = list(results.values())
+    assert all(v == vals[0] for v in vals[1:])
+    st = latest_checkpoint(ckpt_dir)
+    assert st is not None and st.round == 6
+    assert st.world == 3, "replacement was not absorbed before the end"
+    assert ShardMap.from_dict(st.shard_map).world == 3
+
+
+def test_inmemory_departure_while_peers_already_parked():
+    """Regression: a member leaving AFTER its peers already entered the
+    regroup barrier must re-trigger epoch formation — the parked
+    survivors would otherwise wait out the full timeout."""
+    from xgboost_tpu.collective import InMemoryBackend
+
+    backends = [InMemoryBackend(3, r, group="el_parked") for r in range(3)]
+    # rank 2 "is slow": 0 and 1 park in the regroup barrier first; only
+    # rank 2's later departure can complete the formation
+    out, errs = {}, {}
+
+    def park(r):
+        try:
+            out[r] = backends[r].regroup(4)
+        except Exception as e:  # noqa: BLE001
+            errs[r] = e
+
+    threads = [threading.Thread(target=park, args=(r,), daemon=True)
+               for r in (0, 1)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)  # both parked, waiting for rank 2
+    backends[2].leave()  # departure must complete the formation
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), \
+        "parked survivors never unblocked after the departure"
+    assert not errs, errs
+    assert out[0] == (0, 2) and out[1] == (1, 2)
+
+    # the NEW epoch must be usable: leave() aborts the OLD barrier, not
+    # the one formation just installed (regression: Barrier.abort() is
+    # permanent, so aborting the wrong one poisoned every later gather)
+    gathered = {}
+
+    def gather(r):
+        try:
+            gathered[r] = backends[r].allgather(np.asarray([r + 1.0]))
+        except Exception as e:  # noqa: BLE001
+            errs[r] = e
+
+    threads = [threading.Thread(target=gather, args=(r,), daemon=True)
+               for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), "post-regroup gather hung"
+    assert not errs, errs
+    np.testing.assert_array_equal(gathered[0], [[1.0], [2.0]])
+    np.testing.assert_array_equal(gathered[1], [[1.0], [2.0]])
+
+
+# ---------------------------------------------------------------------------
+# CollRelay: stale-buffer flush on a lost rank (partial-epoch regression)
+# ---------------------------------------------------------------------------
+
+
+def test_relay_flushes_lost_rank_partial_epoch():
+    """A lost rank's pending per-seq contributions are flushed at regroup:
+    the next epoch's gather contains ONLY fresh buffers — a dead worker's
+    stale payload can never fold into a later allreduce."""
+    from xgboost_tpu.tracker import (CollRelay, _recv_exact, recv_msg,
+                                     send_msg)
+    import socket as sk
+
+    relay = CollRelay("127.0.0.1", 3, op_timeout=60.0, elastic=True)
+    lost = []
+    relay.on_worker_lost = lambda rank, msg: lost.append(rank)
+    relay.start()
+
+    def connect(rank, epoch):
+        s = sk.create_connection(("127.0.0.1", relay.port), timeout=10)
+        send_msg(s, {"cmd": "coll_join", "rank": rank, "epoch": epoch})
+        return s
+
+    def contribute(s, rank, data, out):
+        send_msg(s, {"cmd": "coll", "seq": 0, "nbytes": len(data)})
+        s.sendall(data)
+        hdr = recv_msg(s, timeout=60.0)
+        out[rank] = hdr
+        if hdr and hdr.get("cmd") == "coll_result":
+            out[rank, "buf"] = _recv_exact(s, int(hdr["nbytes"]),
+                                           timeout=60.0)
+
+    try:
+        socks = {r: connect(r, 0) for r in range(3)}
+        stale = {r: np.full(4, 10 + r, np.float32).tobytes()
+                 for r in range(2)}
+        out = {}
+        workers = [threading.Thread(target=contribute,
+                                    args=(socks[r], r, stale[r], out),
+                                    daemon=True) for r in range(2)]
+        for t in workers:
+            t.start()
+        time.sleep(0.3)       # both contributions parked in seq 0
+        socks[2].close()      # rank 2 dies without ever contributing
+        for t in workers:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in workers), "relay wedged"
+        # blocked contributors were steered into the regroup, not failed
+        assert out[0]["cmd"] == "coll_regroup", out[0]
+        assert out[1]["cmd"] == "coll_regroup", out[1]
+        assert lost == [2]
+
+        # epoch 1 at world 2: same seq number, fresh buffers only
+        relay.regroup(2, 1)
+        fresh = {r: np.full(4, 70 + r, np.float32).tobytes()
+                 for r in range(2)}
+        socks2 = {r: connect(r, 1) for r in range(2)}
+        out2 = {}
+        workers = [threading.Thread(target=contribute,
+                                    args=(socks2[r], r, fresh[r], out2),
+                                    daemon=True) for r in range(2)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join(timeout=60)
+        assert out2[0]["cmd"] == "coll_result"
+        assert out2[1]["cmd"] == "coll_result"
+        expect = fresh[0] + fresh[1]
+        assert out2[0, "buf"] == expect, "stale epoch-0 buffer leaked in"
+        assert out2[1, "buf"] == expect
+    finally:
+        relay.close()
+
+
+def test_relay_rejects_stale_epoch_contribution():
+    """A worker that raced the regroup (still tagged with the old epoch)
+    is answered coll_regroup, not folded into the new epoch's gather."""
+    from xgboost_tpu.tracker import CollRelay, recv_msg, send_msg
+    import socket as sk
+
+    relay = CollRelay("127.0.0.1", 2, op_timeout=30.0, elastic=True)
+    relay.start()
+    try:
+        relay.regroup(2, 3)  # relay has moved on to epoch 3
+        s = sk.create_connection(("127.0.0.1", relay.port), timeout=10)
+        send_msg(s, {"cmd": "coll_join", "rank": 0, "epoch": 1})
+        payload = b"\x00" * 8
+        send_msg(s, {"cmd": "coll", "seq": 0, "nbytes": len(payload)})
+        s.sendall(payload)
+        hdr = recv_msg(s, timeout=30.0)
+        assert hdr and hdr.get("cmd") == "coll_regroup", hdr
+        s.close()
+    finally:
+        relay.close()
+
+
+# ---------------------------------------------------------------------------
+# Seam catalog
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_seams_are_catalogued():
+    assert "tracker.regroup" in faults.SEAMS
+    assert "collective.regroup" in faults.SEAMS
+
+
+def test_non_elastic_backend_refuses_regroup():
+    with pytest.raises(RuntimeError, match="not elastic"):
+        collective.CollBackend().regroup(0)
+    assert collective.regroup_pending() is False
+
+
+def test_regroup_required_is_runtime_error():
+    # train() without elastic= must propagate, not swallow, the signal
+    assert issubclass(RegroupRequired, RuntimeError)
+    with pytest.raises(TypeError, match="dtrain"):
+        xtb.train(PARAMS, None, 2)
+
+
+def test_elastic_rejects_mismatched_checkpoint_directory(tmp_path):
+    """A user CheckpointCallback on a different directory than the elastic
+    config would checkpoint one place and recover from an empty other —
+    refuse loudly instead of silently discarding progress on a death."""
+    cb = xtb.CheckpointCallback(str(tmp_path / "a"))
+    cfg = xtb.ElasticConfig(lambda smap, r, w: None, str(tmp_path / "b"))
+    with pytest.raises(ValueError, match="must match"):
+        xtb.train(PARAMS, None, 2, elastic=cfg, callbacks=[cb])
+
+
+# ---------------------------------------------------------------------------
+# Launcher failure attribution (satellite: stderr tails, not bare codes)
+# ---------------------------------------------------------------------------
+
+
+def _boom_worker(rank, world):
+    if rank == 1:
+        raise RuntimeError("deliberate boom from rank 1")
+    time.sleep(300)  # survivor: only the abort fan-out ends this
+
+
+def test_launcher_attaches_rank_and_stderr_tail():
+    """A failing worker's raised error carries the spawn label, exit code,
+    and the captured stderr tail with the real traceback — not a bare
+    exit-code failure where the first cause is lost."""
+    from xgboost_tpu.launcher import WorkerFailedError, run_distributed
+
+    with pytest.raises(WorkerFailedError) as ei:
+        run_distributed(_boom_worker, num_workers=2, platform="cpu",
+                        timeout=300, rendezvous="tracker")
+    err = ei.value
+    assert err.failures, "no per-worker failure details"
+    assert "stderr tail" in str(err)
+    assert "deliberate boom from rank 1" in str(err)
+    labels = [f[0] for f in err.failures]
+    rcs = [f[1] for f in err.failures]
+    assert all(rc != 0 for rc in rcs)
+    assert len(labels) >= 1
+
+
+def test_launcher_elastic_requires_tracker():
+    from xgboost_tpu.launcher import run_distributed
+
+    with pytest.raises(ValueError, match="elastic"):
+        run_distributed(_boom_worker, num_workers=2, platform=None,
+                        rendezvous="direct", elastic=True)
+
+
+# ---------------------------------------------------------------------------
+# Multi-process elastic (tracker protocol end to end)
+# ---------------------------------------------------------------------------
+
+
+def _mp_elastic_worker(rank, world, *, ckpt_dir, out_path, rounds,
+                       num_shards):
+    import numpy as np
+
+    import xgboost_tpu as xtb
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1200, 5)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+
+    def data_fn(smap, rank, world):
+        rows = np.sort(np.concatenate(
+            [np.arange(s, len(X), smap.num_shards)
+             for s in smap.shards_of(rank)]))
+        return xtb.DMatrix(X[rows], label=y[rows])
+
+    cfg = xtb.ElasticConfig(data_fn, ckpt_dir, num_shards=num_shards)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "eta": 0.3, "max_bin": 32}, None, rounds, elastic=cfg,
+                    verbose_eval=False)
+    from xgboost_tpu import collective as coll
+
+    if coll.get_rank() == 0 and out_path:
+        with open(out_path, "wb") as fh:
+            fh.write(bytes(bst.save_raw()))
+
+
+def _mp_run(tmp_path, tag, *, workers, kill_rank=None, max_respawns=0,
+            rounds=6):
+    import functools
+
+    from xgboost_tpu.launcher import run_distributed
+
+    ckpt = str(tmp_path / f"ck_{tag}")
+    out = str(tmp_path / f"{tag}.ubj")
+    plan = None
+    if kill_rank is not None:
+        plan = json.dumps({"faults": [
+            {"site": "train.round", "kind": "kill", "rank": kill_rank,
+             "round": 2, "at": 2, "exit_code": 43}]})
+    run_distributed(
+        functools.partial(_mp_elastic_worker, ckpt_dir=ckpt, out_path=out,
+                          rounds=rounds, num_shards=2 * workers),
+        num_workers=workers, platform="cpu", timeout=600,
+        rendezvous="tracker", elastic=True, fault_plan=plan,
+        max_respawns=max_respawns)
+    return open(out, "rb").read(), latest_checkpoint(ckpt)
+
+
+def test_two_process_elastic_shrink_to_single_worker(tmp_path):
+    """Tracker-path acceptance at the smallest scale that exercises the
+    whole protocol: 2 workers, rank 1 killed entering round 2, the single
+    survivor regroups to world 1 and finishes all 6 rounds."""
+    model, st = _mp_run(tmp_path, "shrink", workers=2, kill_rank=1)
+    assert model and st is not None
+    assert st.round == 6
+    assert st.world == 1 and st.shard_map["world"] == 1
+    bst = xtb.Booster()
+    bst.load_model(model)
+    assert bst.num_boosted_rounds() == 6
+
+
+@pytest.mark.slow
+def test_three_process_elastic_shrink_bitwise_reproducible(tmp_path):
+    """3 workers, same deterministic kill plan run twice: both runs finish
+    at world 2 with bitwise-identical model bytes."""
+    m1, st1 = _mp_run(tmp_path, "rep1", workers=3, kill_rank=1)
+    m2, st2 = _mp_run(tmp_path, "rep2", workers=3, kill_rank=1)
+    assert st1.world == 2 and st2.world == 2
+    assert m1 == m2, "elastic shrink is not bitwise reproducible"
+
+
+@pytest.mark.slow
+def test_three_process_elastic_absorbs_replacement(tmp_path):
+    """3 workers, one killed, launcher respawns a replacement: it connects
+    to the tracker, is absorbed at a round boundary with the shard map
+    restored from the checkpoint, and the run finishes back at world 3."""
+    import functools
+
+    from xgboost_tpu.launcher import run_distributed
+
+    ckpt = str(tmp_path / "ck_absorb")
+    out = str(tmp_path / "absorb.ubj")
+    plan = {"faults": [
+        {"site": "train.round", "kind": "kill", "rank": 1, "round": 2,
+         "at": 2, "exit_code": 43},
+        # pace the rounds so the replacement's cold start lands mid-run
+        {"site": "train.round", "kind": "delay", "seconds": 1.0,
+         "times": 1000}]}
+    run_distributed(
+        functools.partial(_mp_elastic_worker, ckpt_dir=ckpt, out_path=out,
+                          rounds=10, num_shards=6),
+        num_workers=3, platform="cpu", timeout=600, rendezvous="tracker",
+        elastic=True, fault_plan=json.dumps(plan), max_respawns=1)
+    st = latest_checkpoint(ckpt)
+    assert st is not None and st.round == 10
+    assert st.shard_map["world"] == 3, "replacement was not absorbed"
+    assert open(out, "rb").read()

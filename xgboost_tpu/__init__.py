@@ -20,7 +20,8 @@ from .data.extmem import (DataIter, ExtMemQuantileDMatrix,
 from .data.ellpack import EllpackPage
 from .data.quantile import HistogramCuts
 from .training import cv, train
-from . import collective, reliability, telemetry, tracker
+from . import collective, elastic, reliability, telemetry, tracker
+from .elastic import ElasticConfig, ShardMap
 from .reliability import CheckpointCallback
 from .telemetry import TelemetryCallback
 from .callback import (
@@ -53,6 +54,9 @@ __all__ = [
     "TrainingCheckPoint",
     "TelemetryCallback",
     "CheckpointCallback",
+    "ElasticConfig",
+    "ShardMap",
+    "elastic",
     "collective",
     "reliability",
     "telemetry",

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from .elastic import RegroupRequired
 from .reliability.faults import maybe_inject as _maybe_inject
 
 __all__ = [
@@ -26,6 +27,7 @@ __all__ = [
     "communicator_print", "get_processor_name", "broadcast", "allreduce",
     "allgather", "allgather_ragged", "signal_error", "Op",
     "global_sum", "global_max", "global_ratio",
+    "regroup", "regroup_pending", "RegroupRequired",
     "CommunicatorContext", "CollBackend",
 ]
 
@@ -148,6 +150,18 @@ class CollBackend:
         if me == root:
             buf[:] = np.frombuffer(payload, np.uint8)
         return bytes(self.allgather(buf)[root])
+
+    def regroup_pending(self) -> bool:
+        """True when elastic group membership changed and this worker has
+        not yet regrouped (checked by ``train()`` at round boundaries)."""
+        return False
+
+    def regroup(self, completed_round: int):
+        """Join the elastic regroup barrier; returns the new
+        ``(rank, world)``.  Only elastic-capable backends implement it."""
+        raise RuntimeError(
+            f"{type(self).__name__} is not elastic: regroup is only "
+            "supported on tracker-relay and in-memory backends")
 
     def shutdown(self) -> None:
         pass
@@ -281,6 +295,20 @@ class JaxDistributedBackend(CollBackend):
         out = multihost_utils.broadcast_one_to_all(buf, is_source=is_root)
         return bytes(np.asarray(out))
 
+    def regroup_pending(self) -> bool:
+        t = self._tracker
+        return bool(self._relay_mode and t is not None
+                    and t.regroup_pending)
+
+    def regroup(self, completed_round: int):
+        if self._tracker is None or not self._relay_mode:
+            raise RuntimeError(
+                "elastic regroup requires tracker rendezvous with relay "
+                "collectives (CPU tracker mode): a jax.distributed world "
+                "is fixed at initialize() and cannot rescale")
+        self._tracker.regroup(int(completed_round))
+        return self._tracker.rank, self._tracker.world
+
     def shutdown(self) -> None:
         relay = self._relay_mode
         self._relay_mode = False
@@ -297,13 +325,38 @@ class JaxDistributedBackend(CollBackend):
             pass
 
 
+class _InMemoryJoiner:
+    """A thread waiting to be absorbed by the group's next regroup."""
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.rank: Optional[int] = None
+        self.epoch: Optional[int] = None
+
+
 class _InMemoryGroup:
-    """Shared rendezvous state for thread workers in one process."""
+    """Shared rendezvous state for thread workers in one process.
+
+    Elastic state mirrors the tracker protocol in miniature so the
+    regroup logic is exercisable in-process (tier-1, no subprocess
+    spawn): ``departed`` ranks leave via :meth:`InMemoryBackend.leave`
+    (aborting the barrier so blocked peers surface
+    :class:`RegroupRequired`), joiners park on the group, and the last
+    live member to call ``regroup`` forms the next epoch — compacted
+    ranks, fresh barrier, joiners appended."""
 
     def __init__(self, world: int) -> None:
         self.world = world
         self.barrier = threading.Barrier(world)
         self.slots: List[Optional[np.ndarray]] = [None] * world
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.epoch = 0
+        self.regroup_pending = False
+        self.departed: set = set()
+        self.joiners: List[_InMemoryJoiner] = []
+        self.waiters: Dict[int, int] = {}  # rank -> completed round
+        self.assignment: Optional[tuple] = None  # (epoch, {old: new})
 
 
 _INMEM_GROUPS: Dict[str, _InMemoryGroup] = {}
@@ -315,12 +368,52 @@ class InMemoryBackend(CollBackend):
     (reference: src/collective/in_memory_communicator.h:18 +
     in_memory_handler.h:68 — used by the thread-worker test harness,
     tests/cpp/collective/test_worker.h:155).  Select with
-    ``dmlc_communicator='in-memory'`` plus world size/rank/group args."""
+    ``dmlc_communicator='in-memory'`` plus world size/rank/group args.
 
-    def __init__(self, world: int, rank: int, group: str = "default") -> None:
+    Elastic shrink/absorb works here too (``leave()`` /
+    ``join=True``), giving the regroup state machine quick-tier
+    coverage with no subprocess spawn (tests/test_elastic.py)."""
+
+    def __init__(self, world: Optional[int] = None,
+                 rank: Optional[int] = None, group: str = "default",
+                 join: bool = False, join_timeout: float = 600.0) -> None:
+        self._group_name = group
+        self._epoch = 0
+        if join:
+            # replacement worker: park on the existing group; the next
+            # regroup assigns our rank (absorption at a round boundary)
+            with _INMEM_LOCK:
+                g = _INMEM_GROUPS.get(group)
+            if g is None:
+                raise RuntimeError(
+                    f"in-memory group {group!r} does not exist; a joiner "
+                    "needs a live cohort to be absorbed into")
+            tok = _InMemoryJoiner()
+            with g.cond:
+                g.joiners.append(tok)
+                g.regroup_pending = True
+                # snapshot BEFORE formation: _try_form_epoch may install
+                # the next epoch's fresh barrier, which must not be the
+                # one we abort (Barrier.abort() is permanent)
+                stale_barrier = g.barrier
+                # members may ALL be parked in regroup() already
+                self._try_form_epoch(g)
+                g.cond.notify_all()
+            # wake members blocked mid-gather: they re-enter via regroup
+            stale_barrier.abort()
+            if not tok.event.wait(timeout=join_timeout):
+                raise RuntimeError("in-memory join timed out (no regroup)")
+            with g.cond:
+                self._group = g
+                self._rank = int(tok.rank)
+                self._world = g.world
+                self._epoch = int(tok.epoch)
+            return
+        if world is None or rank is None:
+            raise TypeError("InMemoryBackend needs world and rank "
+                            "(or join=True)")
         self._world = world
         self._rank = rank
-        self._group_name = group
         with _INMEM_LOCK:
             g = _INMEM_GROUPS.get(group)
             # a failed cohort leaves its barrier aborted; a fresh cohort
@@ -337,11 +430,95 @@ class InMemoryBackend(CollBackend):
 
     def allgather(self, data: np.ndarray) -> np.ndarray:
         g = self._group
+        with g.cond:
+            if g.regroup_pending:
+                raise RegroupRequired(
+                    "in-memory group membership changed")
         g.slots[self._rank] = np.asarray(data)
-        g.barrier.wait()  # all slots filled
-        out = np.stack([np.asarray(s) for s in g.slots])
-        g.barrier.wait()  # everyone copied before slots are reused
+        try:
+            g.barrier.wait()  # all slots filled
+            out = np.stack([np.asarray(s) for s in g.slots])
+            g.barrier.wait()  # everyone copied before slots are reused
+        except threading.BrokenBarrierError:
+            with g.cond:
+                if g.regroup_pending:
+                    raise RegroupRequired(
+                        "in-memory group membership changed") from None
+            raise
         return out
+
+    # ------------------------------------------------------------ elastic
+    @staticmethod
+    def _try_form_epoch(g: _InMemoryGroup) -> None:
+        """Form the next epoch once every LIVE member is parked in
+        regroup() (``g.cond`` must be held).  Called from regroup() on
+        each arrival AND from leave()/join registration — a departure or
+        joiner while the others are already parked must re-evaluate
+        formation, or the parked survivors would wait out the timeout."""
+        live = [r for r in range(g.world) if r not in g.departed]
+        if not g.regroup_pending or not (set(g.waiters) >= set(live)):
+            return
+        joiners = list(g.joiners)
+        new_world = len(live) + len(joiners)
+        if new_world == 0:
+            return  # nobody left to form an epoch for
+        g.joiners = []
+        mapping = {old: new for new, old in enumerate(sorted(live))}
+        g.world = new_world
+        g.barrier = threading.Barrier(new_world)
+        g.slots = [None] * new_world
+        g.departed = set()
+        g.waiters = {}
+        g.regroup_pending = False
+        g.epoch += 1
+        g.assignment = (g.epoch, mapping)
+        for k, tok in enumerate(joiners):
+            tok.rank = len(live) + k
+            tok.epoch = g.epoch
+        g.cond.notify_all()
+        for tok in joiners:
+            tok.event.set()
+
+    def leave(self) -> None:
+        """Deterministic preemption: depart the group (the in-memory
+        equivalent of a worker process dying).  Peers blocked in a gather
+        get :class:`RegroupRequired` through the aborted barrier; peers
+        already parked in regroup() are re-checked for epoch formation."""
+        g = self._group
+        with g.cond:
+            g.departed.add(self._rank)
+            g.regroup_pending = True
+            # snapshot first: _try_form_epoch may have just installed the
+            # new epoch's barrier, and aborting THAT would poison the
+            # epoch the survivors are about to train on
+            stale_barrier = g.barrier
+            self._try_form_epoch(g)
+            g.cond.notify_all()
+        stale_barrier.abort()
+
+    def regroup_pending(self) -> bool:
+        g = self._group
+        with g.cond:
+            return g.regroup_pending
+
+    def regroup(self, completed_round: int):
+        g = self._group
+        with g.cond:
+            g.waiters[self._rank] = int(completed_round)
+            target = self._epoch + 1
+            self._try_form_epoch(g)
+            while not (g.assignment is not None
+                       and g.assignment[0] >= target):
+                if not g.cond.wait(timeout=600.0):
+                    raise RuntimeError("in-memory regroup timed out")
+            epoch, mapping = g.assignment
+            if self._rank not in mapping:
+                raise RuntimeError(
+                    f"departed rank {self._rank} cannot regroup")
+            self._rank = mapping[self._rank]
+            self._world = g.world
+            self._epoch = epoch
+        return self._rank, self._world
 
 
 # ---------------------------------------------------------------------------
@@ -402,9 +579,17 @@ def init(**args: Any) -> None:
     kind = (args.get("dmlc_communicator")
             or args.get("xgboost_communicator") or "").replace("_", "-")
     if kind == "in-memory":
+        group = str(args.get("in_memory_group", "default"))
+        if args.get("in_memory_join"):
+            # elastic replacement: absorbed by the group's next regroup
+            _TLS.backend = InMemoryBackend(
+                group=group, join=True,
+                join_timeout=float(args.get("in_memory_join_timeout",
+                                            600.0)))
+            _reconcile_native_kernels()
+            return
         world = int(args.get("in_memory_world_size", 1))
         rank = int(args.get("in_memory_rank", 0))
-        group = str(args.get("in_memory_group", "default"))
         _TLS.backend = InMemoryBackend(world, rank, group)
         _reconcile_native_kernels()
         return
@@ -509,6 +694,37 @@ def global_ratio(dividend: float, divisor: float) -> float:
     distributed metric uses)."""
     out = allreduce(np.asarray([dividend, divisor], np.float64), Op.SUM)
     return float(out[0] / out[1]) if out[1] > 0 else float("nan")
+
+
+def regroup_pending() -> bool:
+    """True when elastic group membership changed (a worker died or a
+    replacement is waiting) and this worker has not yet regrouped.
+    ``train(..., elastic=...)`` polls this at every round boundary."""
+    return _backend().regroup_pending()
+
+
+def regroup(completed_round: int = 0):
+    """Join the elastic regroup barrier and adopt the next epoch's
+    ``(rank, world)`` — returned as a tuple.  Blocks until every live
+    member has reached its round boundary (dead members are detected and
+    excluded by the tracker); parked replacement workers are absorbed
+    into the new epoch.  Raises on non-elastic backends.
+
+    The caller (``train()``) is responsible for reloading model state
+    from the last checkpoint and rebuilding its data shard from the
+    rebalanced :class:`~xgboost_tpu.elastic.ShardMap` afterwards —
+    docs/reliability.md § Elastic training."""
+    # seam: delay (slow member holding up the barrier), exception
+    # (regroup machinery fault -> job failure path), kill (death during
+    # the regroup itself — the tracker completes with the remainder)
+    _maybe_inject("collective.regroup", rank=get_rank)
+    out = _backend().regroup(int(completed_round))
+    # re-run the kernel reconcile as the new epoch's FIRST collective: an
+    # absorbed replacement runs it during init(), so survivors must replay
+    # it too or the epoch's relay seq numbering diverges between them —
+    # and a joiner lacking the native kernels must still veto everyone
+    _reconcile_native_kernels()
+    return out
 
 
 def broadcast(data: Any, root: int) -> Any:

@@ -1,0 +1,175 @@
+"""Elastic training: survive worker loss at reduced world size and absorb
+replacements at round boundaries.
+
+The reliability package (PR 3) survives worker death by aborting the
+survivors and *relaunching at the same world size* from the last
+checkpoint.  This module goes to the spot-instance/preemption reality the
+roadmap calls for: when a worker dies, the survivors **regroup** — the
+tracker re-forms the relay group at world N-1, the dead rank's data shards
+are re-assigned through the :class:`ShardMap`, and training resumes from
+the last completed round without any process restart.  Symmetrically, a
+late-joining worker is absorbed at the next round boundary with the shard
+map rebalanced back up.
+
+Three pieces live here (the protocol itself spans layers):
+
+- :class:`ShardMap` — the deterministic shard→rank assignment that travels
+  inside ``CheckpointCallback`` checkpoints (XTBCKPT meta v2), so any
+  worker — survivor or replacement — can derive exactly which data it owns
+  at the current world size.
+- :class:`ElasticConfig` — what ``train(..., elastic=...)`` needs: a
+  ``data_fn(shard_map, rank, world)`` that (re)builds the local DMatrix
+  from owned shards, and the checkpoint directory regroup recovery reloads
+  from.
+- :class:`RegroupRequired` — raised by a collective when group membership
+  changed mid-operation; ``train()`` catches it at the round boundary,
+  discards the partial round, and re-enters after the regroup.
+
+Determinism contract (pinned by ``tests/test_elastic.py`` and
+``scripts/elastic_smoke.py``): a rescaled run need not match an
+uninterrupted one, but it must be **bitwise-reproducible given the same
+fault plan** — the deterministic death schedules in
+``reliability/faults.py`` fire at the same seam invocation every run, the
+survivors reload the same checkpoint, the :class:`ShardMap` rebalance is a
+pure function of ``(num_shards, world)``, and the relay's rank-ordered
+host reduction keeps the shrunken world's histograms exactly ordered.
+
+Telemetry: ``xtb_elastic_regroups_total``,
+``xtb_elastic_lost_workers_total``, ``xtb_elastic_regroup_seconds``
+(docs/observability.md).  docs/reliability.md § "Elastic training" is the
+operator guide.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = ["RegroupRequired", "ShardMap", "ElasticConfig"]
+
+
+class RegroupRequired(RuntimeError):
+    """Group membership changed under an in-flight collective.
+
+    Raised instead of a generic failure when the backend knows the job is
+    regrouping (elastic mode) rather than dying: the training loop catches
+    it at the round boundary, abandons the partial round, and re-enters
+    through :func:`xgboost_tpu.collective.regroup`.
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardMap:
+    """Deterministic assignment of ``num_shards`` data shards to ``world``
+    ranks.
+
+    The shard is the unit of data ownership and re-assignment: a worker
+    owns the union of its shards, and a regroup moves *shards*, never row
+    ranges, so ownership after any shrink/absorb sequence is a pure
+    function of ``(num_shards, world)`` — the property the bitwise
+    reproducibility contract needs.  ``assign[s]`` is the rank owning
+    shard ``s`` (round-robin: ``s % world``).
+    """
+
+    num_shards: int
+    world: int
+    assign: Tuple[int, ...]
+
+    @classmethod
+    def create(cls, num_shards: int, world: int) -> "ShardMap":
+        num_shards = int(num_shards)
+        world = int(world)
+        if num_shards < 1 or world < 1:
+            raise ValueError(
+                f"ShardMap needs num_shards >= 1 and world >= 1; got "
+                f"{num_shards}, {world}")
+        if num_shards < world:
+            raise ValueError(
+                f"num_shards ({num_shards}) must be >= world ({world}): "
+                "a rank with no data cannot contribute to the quantile "
+                "sketch or the histogram exchange")
+        return cls(num_shards=num_shards, world=world,
+                   assign=tuple(s % world for s in range(num_shards)))
+
+    def shards_of(self, rank: int) -> Tuple[int, ...]:
+        """The shards ``rank`` owns, in ascending shard order."""
+        return tuple(s for s, r in enumerate(self.assign) if r == int(rank))
+
+    def rebalance(self, world: int) -> "ShardMap":
+        """The canonical map at a new world size (same shard universe)."""
+        return ShardMap.create(self.num_shards, world)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"num_shards": self.num_shards, "world": self.world,
+                "assign": list(self.assign)}
+
+    @classmethod
+    def from_dict(cls, obj: Dict[str, Any]) -> "ShardMap":
+        num_shards = int(obj["num_shards"])
+        world = int(obj["world"])
+        assign = obj.get("assign")
+        if assign is None:
+            return cls.create(num_shards, world)
+        assign = tuple(int(r) for r in assign)
+        if len(assign) != num_shards:
+            raise ValueError(
+                f"shard map assign length {len(assign)} != num_shards "
+                f"{num_shards}")
+        return cls(num_shards=num_shards, world=world, assign=assign)
+
+
+class ElasticConfig:
+    """Configuration for ``train(..., elastic=...)``.
+
+    ``data_fn(shard_map, rank, world)`` builds this rank's training data
+    from the shards it owns under ``shard_map`` — called at start and
+    again after every regroup (the shards a rank owns change with the
+    world size).  It returns a DMatrix, or ``(DMatrix, evals)`` to
+    re-shard evaluation sets too.  Every shard must be loadable by *any*
+    worker (shared storage or a recomputable source): a survivor inherits
+    the dead rank's shards.
+
+    ``checkpoint_dir`` is where regroup recovery reloads from; ``train``
+    appends a :class:`~xgboost_tpu.reliability.CheckpointCallback` on this
+    directory automatically unless the caller already passed one (the
+    shard map travels inside those checkpoints).
+
+    ``num_shards`` defaults to the world size at first start and is the
+    run's fixed shard universe: the world can never grow PAST it (a rank
+    with no shards has no data to train on), so set it to the largest
+    world you intend to absorb to — 2×workers is a good default, and
+    also gives the rebalance finer granularity.
+    """
+
+    def __init__(self, data_fn: Callable[..., Any], checkpoint_dir: str,
+                 num_shards: Optional[int] = None,
+                 checkpoint_interval: int = 1, keep_last: int = 3) -> None:
+        if not callable(data_fn):
+            raise TypeError("ElasticConfig.data_fn must be callable")
+        self.data_fn = data_fn
+        self.checkpoint_dir = str(checkpoint_dir)
+        self.num_shards = int(num_shards) if num_shards is not None else None
+        self.checkpoint_interval = max(int(checkpoint_interval), 1)
+        self.keep_last = max(int(keep_last), 1)
+
+
+_instruments = None  # (regroups counter, lost counter, seconds histogram)
+
+
+def instruments():
+    """Elastic telemetry family (lazy; docs/observability.md catalog)."""
+    global _instruments
+    if _instruments is None:
+        from .telemetry.registry import get_registry
+
+        reg = get_registry()
+        _instruments = (
+            reg.counter("xtb_elastic_regroups_total",
+                        "elastic regroups, per process: epochs this worker "
+                        "joined / epochs this tracker formed"),
+            reg.counter("xtb_elastic_lost_workers_total",
+                        "workers lost while training continued elastically"),
+            reg.histogram("xtb_elastic_regroup_seconds",
+                          "regroup latency: epoch formation (tracker) or "
+                          "local recovery (worker)"),
+        )
+    return _instruments

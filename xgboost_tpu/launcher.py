@@ -40,6 +40,32 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+class WorkerFailedError(RuntimeError):
+    """One or more spawned workers exited non-zero.
+
+    ``failures`` holds ``(label, returncode, stderr_tail)`` per failed
+    worker — ``label`` is the spawn index (the tracker may have assigned a
+    different collective rank; the worker's own stderr says which), and
+    ``stderr_tail`` is the captured tail of that process's stderr, so the
+    first-failure cause survives instead of every peer's death reading as
+    a generic rendezvous hang."""
+
+    def __init__(self, message: str, failures) -> None:
+        super().__init__(message)
+        self.failures = list(failures)
+
+
+def _stderr_tail(path: str, limit: int = 4000) -> str:
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(size - limit, 0))
+            return fh.read().decode("utf-8", "replace").strip()
+    except OSError:
+        return "<stderr unavailable>"
+
+
 _CHILD = r"""
 import pickle, sys
 import jax
@@ -51,7 +77,8 @@ if sys.argv[6]:
     sys.path.insert(0, sys.argv[6])  # make fn's defining module importable
 from xgboost_tpu import collective
 
-rank = int(sys.argv[1])
+rank = sys.argv[1]  # spawn label; an int only in direct mode ("respawn<N>"
+                    # labels exist in elastic tracker mode)
 world = int(sys.argv[2])
 port = sys.argv[3]
 if sys.argv[7] == "tracker":
@@ -60,7 +87,11 @@ if sys.argv[7] == "tracker":
     collective.init(dmlc_tracker_uri="127.0.0.1", dmlc_tracker_port=port,
                     dmlc_nworker=world)
     rank = collective.get_rank()
+    # elastic replacements join at the CURRENT world size, not the
+    # originally requested one
+    world = collective.get_world_size()
 else:
+    rank = int(rank)
     collective.init(coordinator_address=f"127.0.0.1:{port}",
                     num_processes=world, process_id=rank)
 with open(sys.argv[5], "rb") as fh:
@@ -77,7 +108,9 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
                     platform: Optional[str] = None,
                     timeout: float = 3600.0,
                     fault_plan: Optional[str] = None,
-                    rendezvous: str = "auto") -> None:
+                    rendezvous: str = "auto",
+                    elastic: bool = False,
+                    max_respawns: int = 0) -> None:
     """Spawn ``num_workers`` processes, each running ``fn(rank, world)``
     under an initialized collective.  ``fn`` must be picklable (a module-
     level function).  ``platform`` overrides jax_platforms in the workers
@@ -94,14 +127,31 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
     — required for CPU multi-process training, docs/reliability.md).
     "auto" picks "tracker" for CPU workers (XLA:CPU cannot run
     multiprocess collectives, and the abort fan-out is strictly more
-    robust locally) and "direct" for accelerator platforms."""
+    robust locally) and "direct" for accelerator platforms.
+
+    ``elastic``: the tracker runs in elastic mode — a worker dying no
+    longer fails the job; the survivors regroup at world N-1 and keep
+    training (workers must pass ``train(..., elastic=...)`` for the data
+    re-sharding side).  Requires tracker rendezvous.  ``max_respawns``
+    bounds how many replacement workers the launcher spawns after deaths;
+    each connects to the tracker and is absorbed at the next round
+    boundary.  Exit code 255 (tracker abort fan-out: an explicitly
+    signalled error) still fails the job even in elastic mode.
+
+    Failures raise :class:`WorkerFailedError` carrying each failed
+    worker's spawn index, exit code, and captured stderr tail."""
     tracker = None
     if rendezvous == "auto":
         rendezvous = "tracker" if (platform or "") == "cpu" else "direct"
+    if elastic and rendezvous != "tracker":
+        raise ValueError("elastic mode requires rendezvous='tracker' "
+                         "(relay collectives re-form at regroup; a "
+                         "jax.distributed world cannot rescale)")
     if rendezvous == "tracker":
         from .tracker import RabitTracker
 
-        tracker = RabitTracker(n_workers=num_workers, host_ip="127.0.0.1")
+        tracker = RabitTracker(n_workers=num_workers, host_ip="127.0.0.1",
+                               elastic=elastic)
         tracker.start()
         port = tracker.port
     elif rendezvous == "direct":
@@ -123,44 +173,85 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
         env["XGBOOST_TPU_FAULT_PLAN"] = fault_plan
     import time
 
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _CHILD, str(rank), str(num_workers),
-             str(port), platform or "", fn_path, mod_dir, rendezvous],
-            env=env)
-        for rank in range(num_workers)
-    ]
+    err_files = {}
+
+    def _spawn(label):
+        # stderr to a per-worker file (not a pipe: nobody drains pipes
+        # while workers run, and the tail must survive the process) so a
+        # failure surfaces its actual cause, not a bare exit code
+        fd, err_path = tempfile.mkstemp(prefix=f"xtb_worker_{label}_",
+                                        suffix=".stderr")
+        err_files[label] = err_path
+        with os.fdopen(fd, "wb") as ef:
+            return subprocess.Popen(
+                [sys.executable, "-c", _CHILD, str(label),
+                 str(num_workers), str(port), platform or "", fn_path,
+                 mod_dir, rendezvous],
+                env=env, stderr=ef)
+
+    pending = {rank: _spawn(rank) for rank in range(num_workers)}
+    respawned = 0
+    succeeded = 0
+    tolerated = []  # (label, rc) deaths survived in elastic mode
     try:
         deadline = time.monotonic() + timeout
-        errs = []
-        rcs = {}
-        pending = dict(enumerate(procs))
+        failures = []  # (label, rc, stderr_tail)
         while pending:
-            for rank, p in list(pending.items()):
+            for label, p in list(pending.items()):
                 rc = p.poll()
                 if rc is None:
                     continue
-                del pending[rank]
-                if rc != 0:
-                    errs.append(rank)
-                    rcs[rank] = rc
-            if errs:
+                del pending[label]
+                if rc == 0:
+                    succeeded += 1
+                    continue
+                tail = _stderr_tail(err_files[label])
+                late_respawn = (isinstance(label, str)
+                                and label.startswith("respawn")
+                                and succeeded > 0)
+                # a death during the initial rendezvous cannot be
+                # regrouped (the tracker is still collecting the cohort);
+                # tolerating it would leave the survivors blocked in
+                # their handshakes until the full job timeout
+                regroupable = (tracker is not None
+                               and tracker.rendezvous_complete)
+                if (elastic and rc != 255 and regroupable
+                        and (pending or late_respawn)):
+                    # a death the survivors absorb (rc 255 means the
+                    # tracker itself declared the job failed)
+                    tolerated.append((label, rc))
+                    print(f"[launcher] elastic: worker {label} exited "
+                          f"{rc}; {len(pending)} continuing"
+                          + (f"\n--- worker {label} stderr tail ---\n{tail}"
+                             if tail else ""), flush=True)
+                    if respawned < max_respawns:
+                        respawned += 1
+                        new_label = f"respawn{respawned}"
+                        pending[new_label] = _spawn(new_label)
+                    continue
+                failures.append((label, rc, tail))
+            if failures:
                 # fail fast: peers would otherwise block in rendezvous or a
                 # collective forever, waiting for the dead worker
                 for p in pending.values():
                     p.kill()
+                labels = [f[0] for f in failures]
                 detail = ", ".join(
                     f"rank {r}: " + ("aborted by tracker fan-out"
-                                     if rcs[r] == 255 else f"exit {rcs[r]}")
-                    for r in errs)
-                raise RuntimeError(f"worker(s) {errs} exited non-zero "
-                                   f"({detail}); remaining workers killed")
+                                     if rc == 255 else f"exit {rc}")
+                    for r, rc, _t in failures)
+                msg = (f"worker(s) {labels} exited non-zero ({detail}); "
+                       f"remaining workers killed")
+                for r, _rc, tail in failures:
+                    if tail:
+                        msg += (f"\n--- worker {r} stderr tail ---\n{tail}")
+                raise WorkerFailedError(msg, failures)
             if pending and time.monotonic() > deadline:
                 for p in pending.values():
                     p.kill()
                 raise TimeoutError(
-                    f"worker(s) {sorted(pending)} still running after "
-                    f"{timeout}s; killed")
+                    f"worker(s) {sorted(pending, key=str)} still running "
+                    f"after {timeout}s; killed")
             if pending:
                 time.sleep(0.2)
     finally:
@@ -170,3 +261,8 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
             os.unlink(fn_path)
         except OSError:
             pass
+        for path in err_files.values():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass

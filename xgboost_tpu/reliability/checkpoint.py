@@ -72,22 +72,39 @@ def _ins():
 
 @dataclasses.dataclass
 class CheckpointState:
-    """One decoded checkpoint."""
+    """One decoded checkpoint.
+
+    ``world`` and ``shard_map`` (meta version 2) carry the elastic
+    membership at save time: the world size the checkpoint was written
+    under and the shard→rank data assignment
+    (:class:`~xgboost_tpu.elastic.ShardMap` dict form), so a regrouped
+    survivor or a replacement worker can rebuild exactly the data it now
+    owns.  Version-1 files (pre-elastic) decode with both as ``None``."""
 
     round: int                      # completed boosting rounds
     booster_bytes: bytes            # Booster.serialize() payload
     history: Dict[str, Any]         # CallbackContainer.history at save time
     callback_state: Dict[str, Any]  # {"ClassName@i": state_dict()}
     path: str = ""
+    world: Optional[int] = None          # world size at save (v2)
+    shard_map: Optional[Dict[str, Any]] = None  # ShardMap.to_dict() (v2)
+
+
+# newest meta version written; every version in _READ_VERSIONS still loads
+# (the pre-elastic v1 fallback is pinned by tests/test_elastic.py)
+_META_VERSION = 2
+_READ_VERSIONS = (1, 2)
 
 
 def _encode(state: CheckpointState) -> bytes:
     meta = json.dumps({
-        "version": 1,
+        "version": _META_VERSION,
         "round": int(state.round),
         "booster_len": len(state.booster_bytes),
         "history": state.history,
         "callback_state": state.callback_state,
+        "world": state.world,
+        "shard_map": state.shard_map,
     }).encode()
     body = (_MAGIC + struct.pack(">I", len(meta)) + meta
             + bytes(state.booster_bytes))
@@ -108,13 +125,21 @@ def _decode(blob: bytes, path: str = "") -> CheckpointState:
     if meta_start + meta_len > len(body):
         raise ValueError("checkpoint meta length out of range")
     meta = json.loads(body[meta_start: meta_start + meta_len].decode())
+    version = int(meta.get("version", 1))
+    if version not in _READ_VERSIONS:
+        # a future format this reader cannot interpret: skip to the
+        # next-newest file (load_latest's corruption-fallback path)
+        raise ValueError(f"unsupported checkpoint meta version {version}")
     booster = body[meta_start + meta_len:]
     if len(booster) != int(meta["booster_len"]):
         raise ValueError("checkpoint booster payload length mismatch")
+    world = meta.get("world")
     return CheckpointState(
         round=int(meta["round"]), booster_bytes=booster,
         history=meta.get("history", {}),
-        callback_state=meta.get("callback_state", {}), path=path)
+        callback_state=meta.get("callback_state", {}), path=path,
+        world=int(world) if world is not None else None,
+        shard_map=meta.get("shard_map"))
 
 
 class CheckpointManager:
@@ -284,24 +309,28 @@ class CheckpointCallback(TrainingCallback):
     _run_last = True
 
     def __init__(self, directory: str, interval: int = 1,
-                 keep_last: int = 3, only_rank0: bool = True) -> None:
+                 keep_last: int = 3, only_rank0: bool = True,
+                 shard_map: Optional[Dict[str, Any]] = None) -> None:
         self.manager = CheckpointManager(directory, keep_last=keep_last)
         self.interval = max(int(interval), 1)
         self.only_rank0 = only_rank0
         self.last_saved_round: Optional[int] = None
+        # elastic shard ownership (ShardMap.to_dict()): set/refreshed by
+        # train(..., elastic=...) so every checkpoint records who owned
+        # which data shards — the recovery and absorption source of truth
+        self.shard_map: Optional[Dict[str, Any]] = shard_map
         self._container = None  # bound by train() for history + peer state
 
     def _bind_container(self, container) -> None:
         self._container = container
 
     def after_iteration(self, model, epoch: int, evals_log) -> bool:
+        from .. import collective
+
         if (epoch + 1) % self.interval:
             return False
-        if self.only_rank0:
-            from .. import collective
-
-            if collective.get_rank() != 0:
-                return False
+        if self.only_rank0 and collective.get_rank() != 0:
+            return False
         if not hasattr(model, "serialize"):  # cv aggregate stand-in
             return False
         peers = (self._container.callbacks if self._container is not None
@@ -312,6 +341,8 @@ class CheckpointCallback(TrainingCallback):
             history=evals_log if evals_log is not None else {},
             callback_state=collect_callback_state(
                 [cb for cb in peers if cb is not self]),
+            world=collective.get_world_size(),
+            shard_map=self.shard_map,
         )
         self.manager.save(state)
         self.last_saved_round = state.round

@@ -59,9 +59,11 @@ SEAMS = frozenset({
     "train.round",
     "collective.allreduce",
     "collective.allgather",
+    "collective.regroup",
     "process.allreduce",
     "tracker.connect",
     "tracker.connected",
+    "tracker.regroup",
     "checkpoint.write",
     "serve.worker",
     "native.parallel_for",

@@ -25,6 +25,12 @@ import time
 import warnings
 from typing import Dict, List, Optional, Union
 
+from .elastic import RegroupRequired
+
+# sentinel returned by CollRelay._contribute while elastic membership is
+# changing: the serve thread answers ``coll_regroup`` instead of a payload
+_REGROUP = object()
+
 # default bound on any single handshake/control send or recv: a hung peer
 # mid-protocol becomes a detected fault (OSError/timeout at the caller)
 # instead of a silent wedge.  Blocking reads that are SUPPOSED to wait
@@ -136,12 +142,24 @@ class CollRelay:
     fan-out + the main-channel abort via ``on_worker_lost``); a completed
     worker closing its socket with nothing pending is a clean departure.
     Every send/recv is bounded by ``op_timeout`` so a hung peer is a
-    detected fault, not a wedge."""
+    detected fault, not a wedge.
+
+    **Elastic mode** (``elastic=True``): membership is *epoch-tagged*.
+    A lost rank no longer fails the job — the relay **flushes** every
+    pending per-seq contribution (a dead worker's stale buffer must never
+    fold into a later allreduce), answers blocked and future contributions
+    with ``coll_regroup`` (workers raise
+    :class:`~xgboost_tpu.elastic.RegroupRequired`), and waits for
+    :meth:`regroup` to form the next epoch with the reduced (or grown)
+    membership.  Contributions tagged with a stale epoch are rejected the
+    same way, so a worker that raced the regroup can never mix epochs."""
 
     def __init__(self, host_ip: str, world: int,
-                 op_timeout: float = 600.0) -> None:
+                 op_timeout: float = 600.0, elastic: bool = False) -> None:
         self.world = world
         self.op_timeout = op_timeout
+        self.elastic = bool(elastic)
+        self.epoch = 0
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host_ip, 0))
@@ -152,6 +170,7 @@ class CollRelay:
         self._results: Dict[int, tuple] = {}  # seq -> (payload, refcount)
         self._departed: set = set()
         self._failed: Optional[str] = None
+        self._regroup_pending = False
         self._closing = False
         self.on_worker_lost = None  # callback(rank, msg) -> abort fan-out
 
@@ -176,8 +195,9 @@ class CollRelay:
                 conn.close()
                 continue
             rank = int(msg["rank"])
-            threading.Thread(target=self._serve_worker, args=(conn, rank),
-                             daemon=True).start()
+            epoch = int(msg.get("epoch", 0))
+            threading.Thread(target=self._serve_worker,
+                             args=(conn, rank, epoch), daemon=True).start()
 
     def _fail(self, msg: str, lost_rank: Optional[int] = None) -> None:
         with self._cond:
@@ -189,7 +209,8 @@ class CollRelay:
         if lost_rank is not None and self.on_worker_lost is not None:
             self.on_worker_lost(lost_rank, msg)
 
-    def _serve_worker(self, conn: socket.socket, rank: int) -> None:
+    def _serve_worker(self, conn: socket.socket, rank: int,
+                      epoch: int = 0) -> None:
         try:
             while True:
                 try:
@@ -201,7 +222,13 @@ class CollRelay:
                 seq = int(hdr["seq"])
                 buf = _recv_exact(conn, int(hdr["nbytes"]),
                                   timeout=self.op_timeout)
-                result = self._contribute(seq, rank, buf)
+                result = self._contribute(seq, rank, buf, epoch)
+                if result is _REGROUP:
+                    # membership is changing: the worker raises
+                    # RegroupRequired and reconnects on the next epoch
+                    send_msg(conn, {"cmd": "coll_regroup",
+                                    "epoch": self.epoch}, timeout=30.0)
+                    break
                 if result is None:
                     send_msg(conn, {"cmd": "coll_error",
                                     "msg": self._failed or "relay failed"},
@@ -215,29 +242,100 @@ class CollRelay:
         except OSError:
             pass
         finally:
-            incomplete = False
-            with self._cond:
-                self._departed.add(rank)
-                # only gathers still MISSING this rank's payload are doomed;
-                # one it already fed can complete for the survivors
-                incomplete = (not self._closing
-                              and any(rank not in contribs
-                                      for contribs in self._pending.values()))
-                self._cond.notify_all()  # wake waiters to run the check
-            if incomplete and self._failed is None:
-                # this worker can no longer contribute to an outstanding
-                # gather: everyone blocked on it must fail fast
-                self._fail(f"collective peer {rank} lost mid-gather",
-                           lost_rank=rank)
+            if self.elastic:
+                self._elastic_departure(rank, epoch)
+            else:
+                incomplete = False
+                with self._cond:
+                    self._departed.add(rank)
+                    # only gathers still MISSING this rank's payload are
+                    # doomed; one it already fed can complete for survivors
+                    incomplete = (not self._closing
+                                  and any(rank not in contribs
+                                          for contribs
+                                          in self._pending.values()))
+                    self._cond.notify_all()  # wake waiters to run the check
+                if incomplete and self._failed is None:
+                    # this worker can no longer contribute to an outstanding
+                    # gather: everyone blocked on it must fail fast
+                    self._fail(f"collective peer {rank} lost mid-gather",
+                               lost_rank=rank)
             conn.close()
 
-    def _contribute(self, seq: int, rank: int, buf: bytes) -> Optional[bytes]:
+    def _elastic_departure(self, rank: int, epoch: int) -> None:
+        """Elastic worker-socket EOF: flush gathers the departed rank can no
+        longer feed (its stale partial contributions must never reach the
+        next epoch's allreduce) and hand the loss to the tracker, which
+        initiates the regroup.  A stale-epoch or mid-regroup departure is a
+        worker reconnecting — membership bookkeeping already moved on."""
+        lost_mid_gather = False
+        with self._cond:
+            if (not self._closing and epoch == self.epoch
+                    and not self._regroup_pending):
+                self._departed.add(rank)
+                lost_mid_gather = any(rank not in contribs
+                                      for contribs in self._pending.values())
+                if lost_mid_gather:
+                    self._regroup_pending = True
+                    self._pending.clear()
+                    self._results.clear()
+                self._cond.notify_all()
+        if lost_mid_gather and self.on_worker_lost is not None:
+            self.on_worker_lost(rank, "collective peer lost; regrouping")
+
+    def invalidate(self, epoch: Optional[int] = None) -> None:
+        """Flush every in-flight gather and answer all contributions with
+        ``coll_regroup`` until :meth:`regroup` forms the next epoch.  The
+        tracker calls this the moment it starts a regroup — also for pure
+        absorption (no death): a worker that checked its round boundary a
+        microsecond before the announcement would otherwise enter a gather
+        its already-regrouping peers never join.
+
+        ``epoch`` guards against the stale-invalidation race: the members'
+        ``regroup_join``\\s can complete the regroup (on watcher threads)
+        while the detecting thread is still on its way here, and an
+        unconditional flush would then poison the epoch that was just
+        formed.  Pass the epoch the invalidation was captured under; a
+        mismatch means that membership change already completed."""
+        with self._cond:
+            if self._closing:
+                return
+            if epoch is not None and epoch != self.epoch:
+                return  # that regroup already formed the next epoch
+            self._regroup_pending = True
+            self._pending.clear()
+            self._results.clear()
+            self._cond.notify_all()
+
+    def regroup(self, world: int, epoch: int) -> None:
+        """Form the next epoch: new membership size, fresh buffers, stale
+        departure state cleared.  Workers reconnect with the new epoch tag
+        and restart their seq numbering at 0."""
+        with self._cond:
+            self.world = int(world)
+            self.epoch = int(epoch)
+            self._pending.clear()
+            self._results.clear()
+            self._departed.clear()
+            self._failed = None
+            self._regroup_pending = False
+            self._cond.notify_all()
+
+    def _contribute(self, seq: int, rank: int, buf: bytes,
+                    epoch: int = 0):
         """Add ``rank``'s payload; block until the gather completes; returns
-        the rank-ordered concatenation or None on failure/timeout."""
+        the rank-ordered concatenation, ``_REGROUP`` when membership is
+        changing (elastic), or None on failure/timeout."""
         deadline = time.monotonic() + self.op_timeout
         with self._cond:
+            if self.elastic and (self._regroup_pending
+                                 or epoch != self.epoch):
+                return _REGROUP
             self._pending.setdefault(seq, {})[rank] = buf
             while True:
+                if self.elastic and (self._regroup_pending
+                                     or epoch != self.epoch):
+                    return _REGROUP
                 if self._failed is not None or self._closing:
                     return None
                 got = self._pending.get(seq)
@@ -255,7 +353,16 @@ class CollRelay:
                     return payload
                 if got is not None and any(d not in got
                                            for d in self._departed):
-                    break  # a missing contributor is gone: can never finish
+                    # a missing contributor is gone: can never finish
+                    if self.elastic:
+                        # the epoch is doomed, not the job: flush and
+                        # steer every blocked worker into the regroup
+                        self._regroup_pending = True
+                        self._pending.clear()
+                        self._results.clear()
+                        self._cond.notify_all()
+                        return _REGROUP
+                    break
                 left = deadline - time.monotonic()
                 if left <= 0:
                     break
@@ -276,20 +383,37 @@ class CollRelay:
 
 class RabitTracker:
     """Socket rendezvous + error fan-out (reference surface: tracker.py:17 —
-    start(), worker_args(), wait_for(), free())."""
+    start(), worker_args(), wait_for(), free()).
+
+    **Elastic mode** (``elastic=True``, docs/reliability.md § Elastic
+    training): a worker whose connection drops no longer aborts the job.
+    Instead the tracker initiates a **regroup**: the relay is invalidated
+    (in-flight collectives surface ``RegroupRequired`` on every survivor),
+    ``regroup_pending`` is announced on the persistent channel, and once
+    every live worker has sent ``regroup_join`` from its round boundary
+    the tracker assigns compacted ``(rank, world)`` pairs — survivors
+    ordered by their previous rank, then any late joiners — bumps the
+    epoch, re-forms the relay, and replies with the new membership.  A
+    replacement worker simply connects with the normal ``start``
+    handshake after rendezvous; it is parked and absorbed by the next
+    regroup, its handshake answered with the elastic assignment
+    (including the round to resume from).  Explicit ``signal_error`` still
+    aborts everyone — elasticity forgives *deaths*, not reported bugs."""
 
     def __init__(self, n_workers: int, host_ip: str = "auto", port: int = 0,
                  sortby: str = "host", timeout: int = 0,
-                 handshake_timeout: float = OP_TIMEOUT) -> None:
+                 handshake_timeout: float = OP_TIMEOUT,
+                 elastic: bool = False) -> None:
         self.n_workers = n_workers
         self.host_ip = get_host_ip(host_ip)
         self.sortby = sortby
         self.timeout = timeout
         self.handshake_timeout = handshake_timeout
+        self.elastic = bool(elastic)
         self._closing = False
-        self._relay = CollRelay(self.host_ip, n_workers)
-        self._relay.on_worker_lost = (
-            lambda rank, msg: self._fan_abort(rank, msg, None))
+        self._relay = CollRelay(self.host_ip, n_workers,
+                                elastic=self.elastic)
+        self._relay.on_worker_lost = self._relay_worker_lost
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host_ip, port))
@@ -297,9 +421,19 @@ class RabitTracker:
         self._conns: List[socket.socket] = []
         self._done = threading.Event()
         self._error: Optional[str] = None
-        self._n_finished = 0
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+        # --- membership state (all guarded by _lock) ---
+        self._members: Dict[socket.socket, int] = {}  # live conn -> rank
+        self._watched: set = set()      # conns with a running watcher
+        self._serve_done = False        # initial rendezvous complete
+        self._clean_exits = 0
+        self._epoch = 0
+        self._regrouping = False
+        self._regroup_t0 = 0.0
+        self._regroup_joins: Dict[socket.socket, int] = {}  # conn -> round
+        self._joiners: List[socket.socket] = []  # parked replacement conns
+        self.lost_workers = 0
 
     # ------------------------------------------------------------- serving
     def start(self) -> None:
@@ -374,10 +508,19 @@ class RabitTracker:
                          timeout=self.handshake_timeout)
             except OSError:
                 pass  # the worker's watcher EOF-detection handles its death
+        with self._lock:
+            self._members = {conn: rank
+                             for rank, conn in enumerate(self._conns)}
+            self._watched = set(self._conns)
+            self._serve_done = True
         for rank, conn in enumerate(self._conns):
             t = threading.Thread(target=self._watch_worker,
                                  args=(conn, rank), daemon=True)
             t.start()
+        if self.elastic:
+            # keep the listener open: replacement workers connect with the
+            # same start handshake and are absorbed at the next regroup
+            threading.Thread(target=self._accept_late, daemon=True).start()
 
     def _fan_abort(self, rank: int, msg: str,
                    source: Optional[socket.socket]) -> None:
@@ -409,22 +552,244 @@ class RabitTracker:
                 clean = True
                 break
             if msg.get("cmd") == "error":
-                self._fan_abort(rank, msg.get("msg", "unknown error"), conn)
+                with self._lock:
+                    cur = self._members.get(conn, rank)
+                self._fan_abort(cur, msg.get("msg", "unknown error"), conn)
                 break
-        if not clean and not self._closing and self._error is None:
-            # EOF without a shutdown message: the worker DIED (crash,
-            # SIGKILL, machine loss) without getting to signal_error.  Its
-            # peers are blocked in a collective waiting for it — fan the
-            # abort out so they fail fast instead of wedging (the Rabit
-            # lineage treats a lost tracker connection exactly this way).
-            self._fan_abort(rank, "tracker connection lost "
-                            "(worker process died)", conn)
+            if msg.get("cmd") == "regroup_join" and self.elastic:
+                self._handle_regroup_join(conn, int(msg.get("round", 0)))
+                continue
+        if clean:
+            with self._lock:
+                self._members.pop(conn, None)
+                self._clean_exits += 1
+                if not self._members and self._joiners:
+                    # training finished with replacements still parked:
+                    # there is nothing left to absorb them into
+                    for j in self._joiners:
+                        try:
+                            send_msg(j, {"cmd": "abort",
+                                         "msg": "training already complete"},
+                                     timeout=5.0)
+                        except OSError:
+                            pass
+                        try:
+                            j.close()
+                        except OSError:
+                            pass
+                    self._joiners = []
+                    # the regroup those joiners triggered can never form —
+                    # a stale flag here would turn the clean finish into a
+                    # spurious "regroup with no members" error
+                    self._regrouping = False
+                    self._regroup_joins = {}
+            if self.elastic:
+                # a clean exit during a pending regroup: the remaining
+                # members must not wait for this worker's join
+                self._maybe_complete_regroup()
+        elif not self._closing and self._error is None:
+            if self.elastic:
+                # elastic: a silent death shrinks the world instead of
+                # ending the job — regroup the survivors
+                with self._lock:
+                    cur = self._members.get(conn, rank)
+                self._on_worker_death(conn, cur,
+                                      "tracker connection lost "
+                                      "(worker process died)")
+            else:
+                # EOF without a shutdown message: the worker DIED (crash,
+                # SIGKILL, machine loss) without getting to signal_error.
+                # Its peers are blocked in a collective waiting for it —
+                # fan the abort out so they fail fast instead of wedging
+                # (the Rabit lineage treats a lost tracker connection
+                # exactly this way).
+                self._fan_abort(rank, "tracker connection lost "
+                                "(worker process died)", conn)
         with self._lock:
-            self._n_finished += 1
-            if self._n_finished >= self.n_workers:
-                self._done.set()
+            self._watched.discard(conn)
+            finished = (self._serve_done and not self._watched
+                        and not self._joiners)
+            if finished and self._clean_exits == 0 and self._error is None:
+                self._error = "all workers lost (no clean shutdowns)"
+        if finished:
+            self._done.set()
+
+    # ------------------------------------------------- elastic membership
+    def _accept_late(self) -> None:
+        """Post-rendezvous accept loop (elastic only): a connecting worker
+        is a replacement — park it and trigger a regroup; its handshake is
+        answered with the elastic assignment when the epoch forms."""
+        while True:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # freed
+            try:
+                conn.settimeout(30.0)
+                msg = recv_msg(conn)
+                conn.settimeout(None)
+            except (OSError, ValueError):
+                conn.close()
+                continue
+            if not msg or msg.get("cmd") != "start":
+                conn.close()
+                continue
+            with self._lock:
+                if self._closing or self._error is not None:
+                    conn.close()
+                    continue
+                self._joiners.append(conn)
+                self._conns.append(conn)  # abort fan-out coverage
+            self._request_regroup()
+
+    def _relay_worker_lost(self, rank: int, msg: str) -> None:
+        if not self.elastic:
+            self._fan_abort(rank, msg, None)
+            return
+        with self._lock:
+            conn = next((c for c, r in self._members.items() if r == rank),
+                        None)
+        if conn is not None:
+            self._on_worker_death(conn, rank, msg)
+
+    def _on_worker_death(self, conn: socket.socket, rank: int,
+                         msg: str) -> None:
+        """Elastic death handling (idempotent per connection): drop the
+        member, flush the relay, and start a regroup among the survivors.
+        With nobody left the job has failed — there is no one to carry the
+        model forward."""
+        with self._lock:
+            if (conn not in self._members or self._closing
+                    or self._error is not None):
+                return
+            del self._members[conn]
+            self._regroup_joins.pop(conn, None)
+            self.lost_workers += 1
+            survivors = len(self._members)
+            joiners = len(self._joiners)
+            epoch_now = self._epoch
+        from .elastic import instruments as _elastic_ins
+
+        _elastic_ins()[1].inc()
+        warnings.warn(f"elastic: worker {rank} lost ({msg}); "
+                      f"{survivors} survivor(s) regrouping", RuntimeWarning,
+                      stacklevel=2)
+        self._relay.invalidate(epoch_now)
+        if survivors == 0 and joiners == 0:
+            with self._lock:
+                if self._error is None:
+                    self._error = f"worker {rank}: {msg} (no survivors)"
+            self._done.set()
+            return
+        self._request_regroup()
+
+    def _request_regroup(self) -> None:
+        """Announce a pending regroup to every live member (idempotent) and
+        invalidate the relay so no gather can straddle the membership
+        change; completion happens when the last member joins."""
+        with self._lock:
+            if self._closing or self._error is not None:
+                return
+            first = not self._regrouping
+            if first:
+                self._regrouping = True
+                self._regroup_t0 = time.perf_counter()
+            epoch_now = self._epoch
+        if first:
+            # invalidate BEFORE announcing: a member that hears the
+            # announcement first would close its relay socket entering
+            # regroup(), and with the flush flag not yet set the relay
+            # would misread that live survivor as a mid-gather death
+            self._relay.invalidate(epoch_now)
+            with self._lock:
+                if self._closing or self._error is not None:
+                    return
+                for conn in self._members:
+                    try:
+                        send_msg(conn, {"cmd": "regroup_pending",
+                                        "epoch": self._epoch + 1},
+                                 timeout=30.0)
+                    except OSError:
+                        pass  # its watcher will report the death
+        self._maybe_complete_regroup()
+
+    def _handle_regroup_join(self, conn: socket.socket, round_: int) -> None:
+        with self._lock:
+            if conn not in self._members:
+                return
+            # a join can arrive before the tracker noticed the death (the
+            # relay told the worker first): it opens the regroup
+            if not self._regrouping:
+                self._regrouping = True
+                self._regroup_t0 = time.perf_counter()
+            self._regroup_joins[conn] = int(round_)
+            epoch_now = self._epoch
+        self._relay.invalidate(epoch_now)
+        self._maybe_complete_regroup()
+
+    def _maybe_complete_regroup(self) -> None:
+        """Form the next epoch once every live member has joined: compact
+        rank assignment (survivors by previous rank, then parked joiners),
+        relay re-formed, assignments sent — survivors on the persistent
+        channel, joiners as their held start-handshake reply."""
+        with self._lock:
+            if (not self._regrouping or self._closing
+                    or self._error is not None):
+                return
+            if set(self._regroup_joins) != set(self._members):
+                return  # someone is still draining toward its boundary
+            survivors = sorted(self._members, key=self._members.get)
+            joiners = list(self._joiners)
+            self._joiners = []
+            ordered = survivors + joiners
+            new_world = len(ordered)
+            if new_world == 0:
+                # everyone left while the regroup was pending (clean
+                # finishes or deaths — both have their own error/success
+                # accounting); there is simply no epoch to form
+                self._regrouping = False
+                self._regroup_joins = {}
+                return
+            self._epoch += 1
+            epoch = self._epoch
+            resume_round = max(self._regroup_joins.values(), default=0)
+            self._regroup_joins = {}
+            self._members = {conn: nr for nr, conn in enumerate(ordered)}
+            self._regrouping = False
+            self._watched.update(joiners)
+            duration = time.perf_counter() - self._regroup_t0
+            self._relay.regroup(new_world, epoch)
+            for nr, conn in enumerate(ordered):
+                try:
+                    send_msg(conn, {"cmd": "regroup", "epoch": epoch,
+                                    "rank": nr, "world": new_world,
+                                    "round": resume_round,
+                                    "coll_port": self._relay.port,
+                                    "coordinator": ""},
+                             timeout=30.0)
+                except OSError:
+                    pass  # the death will be seen and regrouped again
+            # capture under the lock: a joiner's conn could die (and leave
+            # _members) before the watcher threads below start
+            joiner_ranks = [(conn, self._members[conn]) for conn in joiners]
+        from .elastic import instruments as _elastic_ins
+
+        ins = _elastic_ins()
+        ins[0].inc()
+        ins[2].observe(duration)
+        for conn, jrank in joiner_ranks:
+            threading.Thread(target=self._watch_worker,
+                             args=(conn, jrank), daemon=True).start()
 
     # ------------------------------------------------------------- client API
+    @property
+    def rendezvous_complete(self) -> bool:
+        """True once the initial cohort fully rendezvoused.  Elasticity
+        starts HERE: a death before this point cannot be regrouped (the
+        cohort does not exist yet) and must stay a fail-fast error."""
+        with self._lock:
+            return self._serve_done
+
     def worker_args(self) -> Dict[str, Union[str, int]]:
         """Env for workers (consumed by collective.init tracker mode: no
         pre-assigned rank — the tracker hands one out)."""
@@ -504,10 +869,18 @@ class TrackerClient:
         self.rank = int(reply["rank"])
         self.world = int(reply["world"])
         self.coll_port = reply.get("coll_port")  # socket-relay collectives
+        # elastic: a replacement worker's handshake is answered by the
+        # regroup itself, which carries the epoch (recovery reloads the
+        # newest checkpoint rather than trusting a reported round)
+        self.epoch = int(reply.get("epoch", 0))
         self._coll: Optional[socket.socket] = None
         self._coll_host = host
         self._coll_seq = 0
         self._coll_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._regroup_flag = threading.Event()   # regroup_pending received
+        self._regroup_ready = threading.Event()  # assignment received
+        self._regroup_info: Optional[dict] = None
         self.op_timeout = handshake_timeout
         if reply.get("coordinator") is None:
             # rank 0: host the jax coordinator — allocate a port on THIS
@@ -551,6 +924,68 @@ class TrackerClient:
                 print(f"[rank {self.rank}] aborting: peer failure — "
                       f"{msg.get('msg', '')}", file=sys.stderr, flush=True)
                 os._exit(255)  # reference: std::exit(-1) in the watcher
+            if msg.get("cmd") == "regroup_pending":
+                # picked up by the training loop at its round boundary
+                # (and by any collective about to enter the relay)
+                self._regroup_flag.set()
+                continue
+            if msg.get("cmd") == "regroup":
+                with self._state_lock:
+                    self._regroup_info = msg
+                self._regroup_flag.set()
+                self._regroup_ready.set()
+                continue
+
+    @property
+    def regroup_pending(self) -> bool:
+        """True once the tracker announced a membership change this worker
+        has not yet regrouped into."""
+        return self._regroup_flag.is_set()
+
+    def regroup(self, completed_round: int,
+                timeout: Optional[float] = None) -> dict:
+        """Round-boundary regroup: drop the stale relay connection, join
+        the barrier on the tracker, and adopt the new ``(rank, world)``
+        assignment for the next epoch.  Returns the assignment message
+        (``round`` is the highest completed round any survivor reported —
+        recovery reloads the newest checkpoint at or below it)."""
+        from .reliability import faults as _faults
+
+        # seam: 'delay' (slow joiner), 'exception' (regroup machinery
+        # fault), 'kill' (a worker dying DURING the regroup — the tracker
+        # must detect it and complete with the remaining members)
+        _faults.maybe_inject("tracker.regroup", rank=self.rank)
+        with self._coll_lock:
+            if self._coll is not None:
+                try:
+                    self._coll.close()
+                except OSError:
+                    pass
+            self._coll = None
+            self._coll_seq = 0
+        self._regroup_ready.clear()
+        try:
+            send_msg(self._sock, {"cmd": "regroup_join",
+                                  "round": int(completed_round)},
+                     timeout=30.0)
+        except OSError as e:
+            raise RuntimeError(
+                f"tracker unreachable during elastic regroup: {e}") from e
+        if not self._regroup_ready.wait(timeout or self.op_timeout):
+            raise RuntimeError(
+                "elastic regroup timed out waiting for the tracker "
+                "assignment")
+        with self._state_lock:
+            info = self._regroup_info or {}
+            self._regroup_info = None
+            self.rank = int(info["rank"])
+            self.world = int(info["world"])
+            self.epoch = int(info["epoch"])
+            if info.get("coll_port") is not None:
+                self.coll_port = info["coll_port"]
+        self._regroup_ready.clear()
+        self._regroup_flag.clear()
+        return dict(info)
 
     # --------------------------------------------------- relay collectives
     def _coll_sock(self) -> socket.socket:
@@ -564,7 +999,8 @@ class TrackerClient:
                     (self._coll_host, int(self.coll_port)), timeout=60.0),
                 op="tracker.coll_connect", retries=4, base=0.25,
                 seed=self.rank, retry_on=(OSError,))
-            send_msg(self._coll, {"cmd": "coll_join", "rank": self.rank},
+            send_msg(self._coll, {"cmd": "coll_join", "rank": self.rank,
+                                  "epoch": self.epoch},
                      timeout=30.0)
         return self._coll
 
@@ -577,6 +1013,10 @@ class TrackerClient:
         arr = np.ascontiguousarray(arr)
         payload = arr.tobytes()
         with self._coll_lock:
+            if self._regroup_flag.is_set():
+                # membership already changed: entering the relay would only
+                # contribute a buffer the regroup is about to flush
+                raise RegroupRequired("elastic regroup pending")
             s = self._coll_sock()
             seq = self._coll_seq
             self._coll_seq += 1
@@ -587,6 +1027,9 @@ class TrackerClient:
                 with _op_timeout(s, self.op_timeout):
                     s.sendall(payload)
                 hdr = recv_msg(s, timeout=self.op_timeout)
+                if hdr and hdr.get("cmd") == "coll_regroup":
+                    raise RegroupRequired(
+                        "collective membership changed mid-operation")
                 if not hdr or hdr.get("cmd") != "coll_result":
                     raise RuntimeError(
                         "collective relay failed: "
@@ -594,6 +1037,9 @@ class TrackerClient:
                 buf = _recv_exact(s, int(hdr["nbytes"]),
                                   timeout=self.op_timeout)
             except OSError as e:
+                if self._regroup_flag.is_set():
+                    raise RegroupRequired(
+                        "collective interrupted by elastic regroup") from e
                 raise RuntimeError(
                     f"collective relay I/O failed (peer/tracker lost?): {e}"
                 ) from e

@@ -6,6 +6,7 @@ training.py:212) and aggregates fold metrics.
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -13,13 +14,126 @@ import numpy as np
 from .callback import CallbackContainer, EarlyStopping, EvaluationMonitor, TrainingCallback
 from .core import Booster
 from .data.dmatrix import DMatrix
+from .elastic import ElasticConfig, RegroupRequired, ShardMap
 
 __all__ = ["train", "cv"]
 
 
+def _elastic_data(cfg: ElasticConfig, shard_map: ShardMap, rank: int,
+                  world: int, default_evals: list):
+    """(dtrain, evals) from the user's data_fn — which may return just the
+    DMatrix or a (DMatrix, evals) pair when evals re-shard too."""
+    built = cfg.data_fn(shard_map, rank, world)
+    if isinstance(built, tuple):
+        dtrain, ev = built
+        return dtrain, list(ev) if ev else []
+    return built, default_evals
+
+
+def _elastic_shard_map(cfg: ElasticConfig, resumed, world: int) -> ShardMap:
+    """The canonical shard map at ``world``: restored from the checkpoint
+    when one exists (the dead rank's shards re-assign from what was
+    actually saved), else created fresh; rebalanced if the world moved.
+    ``cfg.num_shards`` is resolved to the initial world size at train()
+    entry, so a fresh restart after a pre-checkpoint death keeps the
+    ORIGINAL shard universe — absorption back to full strength stays
+    possible."""
+    smap = None
+    if resumed is not None and resumed.shard_map:
+        smap = ShardMap.from_dict(resumed.shard_map)
+    if smap is None:
+        smap = ShardMap.create(cfg.num_shards or world, world)
+    if smap.world != world:
+        if world > smap.num_shards:
+            raise RuntimeError(
+                f"cannot regroup to world {world}: this run's shard "
+                f"universe has only {smap.num_shards} shards and a rank "
+                "with no data cannot train; set ElasticConfig(num_shards=) "
+                "to at least the largest world you intend to absorb to "
+                "(e.g. 2x the worker count)")
+        smap = smap.rebalance(world)
+    return smap
+
+
+def _restore_booster(params, resumed) -> Booster:
+    """Booster from a checkpoint's serialized bytes — shared by the
+    resume_from start path and in-process elastic regroup recovery so the
+    restore semantics (config re-apply, early-stopping best re-exposure)
+    cannot drift apart."""
+    bst = Booster(params)
+    bst.unserialize(resumed.booster_bytes)
+    bst.set_param(params)
+    bi = bst.attr("best_iteration")
+    if bi is not None:  # re-expose early-stopping bests on the object
+        bst.best_iteration = int(bi)
+        bs = bst.attr("best_score")
+        bst.best_score = float(bs) if bs is not None else None
+    return bst
+
+
+def _elastic_regroup(params, cfg: ElasticConfig, cbs, callbacks, ckpt_cb,
+                     evals, completed_hint: int):
+    """Round-boundary regroup with re-entry: join the new epoch, reload
+    training state from the newest checkpoint, rebuild this rank's data
+    from the rebalanced shard map.  Membership can change AGAIN while
+    recovery is in flight (another death, a replacement arriving) — the
+    new epoch's first collective then raises RegroupRequired from inside
+    recovery itself, so the whole sequence simply re-enters.  Returns
+    (bst, dtrain, evals, next_round)."""
+    while True:
+        try:
+            return _elastic_regroup_once(params, cfg, cbs, callbacks,
+                                         ckpt_cb, evals, completed_hint)
+        except RegroupRequired:
+            continue
+
+
+def _elastic_regroup_once(params, cfg: ElasticConfig, cbs, callbacks,
+                          ckpt_cb, evals, completed_hint: int):
+    import time
+
+    from . import collective
+    from .elastic import instruments as _elastic_ins
+    from .reliability.checkpoint import (latest_checkpoint,
+                                         restore_callback_state)
+
+    t0 = time.perf_counter()
+    rank, world = collective.regroup(completed_hint)
+    resumed = latest_checkpoint(cfg.checkpoint_dir)
+    smap = _elastic_shard_map(cfg, resumed, world)
+    dtrain, evals = _elastic_data(cfg, smap, rank, world, evals)
+    if resumed is not None:
+        bst = _restore_booster(params, resumed)
+        # REPLACE the in-memory history with the checkpoint's: the partial
+        # round being abandoned must not leave duplicate eval entries when
+        # the round is re-run at the new world size
+        cbs.history.clear()
+        for name, metrics in resumed.history.items():
+            cbs.history[name] = {k: list(v) for k, v in metrics.items()}
+        restore_callback_state(callbacks, resumed.callback_state)
+        next_round = resumed.round
+    else:
+        # death before the first checkpoint: the survivors restart from
+        # round 0 at the reduced world size — with callback state reset
+        # too (EarlyStopping best/patience from the abandoned rounds must
+        # not leak into the restarted run)
+        bst = Booster(params, cache=[dtrain])
+        cbs.history.clear()
+        for cb in callbacks:
+            fn = getattr(cb, "load_state", None)
+            if fn is not None and getattr(cb, "state_dict", None) is not None:
+                fn({})
+        next_round = 0
+    ckpt_cb.shard_map = smap.to_dict()
+    ins = _elastic_ins()
+    ins[0].inc()
+    ins[2].observe(time.perf_counter() - t0)
+    return bst, dtrain, evals, next_round
+
+
 def train(
     params: Dict[str, Any],
-    dtrain: DMatrix,
+    dtrain: Optional[DMatrix] = None,
     num_boost_round: int = 10,
     *,
     evals: Optional[Sequence[Tuple[DMatrix, str]]] = None,
@@ -32,6 +146,7 @@ def train(
     callbacks: Optional[Sequence[TrainingCallback]] = None,
     custom_metric: Optional[Callable] = None,
     resume_from: Optional[str] = None,
+    elastic: Optional[ElasticConfig] = None,
 ) -> Booster:
     """``resume_from``: a checkpoint directory written by
     :class:`~xgboost_tpu.reliability.CheckpointCallback`.  When it holds a
@@ -40,11 +155,26 @@ def train(
     and-resumed run finishes at the same round — and, under deterministic
     config, the same bits — as an uninterrupted one.  An empty or missing
     directory falls through to a normal start, so the same command line
-    works for launch and relaunch (docs/reliability.md)."""
+    works for launch and relaunch (docs/reliability.md).
+
+    ``elastic``: an :class:`~xgboost_tpu.elastic.ElasticConfig` makes the
+    run survive worker loss at reduced world size and absorb replacement
+    workers at round boundaries.  ``dtrain`` may then be omitted — the
+    config's ``data_fn`` builds it from this rank's shards (and rebuilds
+    it after every regroup); a CheckpointCallback on the config's
+    directory is appended automatically and ``resume_from`` defaults to
+    it.  ``num_boost_round`` is always the TOTAL round target under
+    elastic mode.  Requires an elastic-capable collective backend
+    (tracker relay or in-memory) — docs/reliability.md § Elastic
+    training."""
     callbacks = list(callbacks) if callbacks else []
     evals = list(evals) if evals else []
+    if dtrain is None and elastic is None:
+        raise TypeError("train() needs dtrain (or an elastic config whose "
+                        "data_fn builds it)")
     if early_stopping_rounds is not None:
-        if not evals:
+        if not evals and (elastic is None or dtrain is not None):
+            # elastic data_fn may supply evals; re-validated after it runs
             raise ValueError(
                 "Must have at least 1 validation dataset for early stopping."
             )
@@ -52,6 +182,33 @@ def train(
     if verbose_eval:
         period = 1 if verbose_eval is True else int(verbose_eval)
         callbacks.append(EvaluationMonitor(period=period))
+    ckpt_cb = None
+    if elastic is not None:
+        from .reliability.checkpoint import CheckpointCallback
+
+        # regroup recovery reloads from elastic.checkpoint_dir: make sure
+        # something is writing there, and resume from it by default so the
+        # same invocation serves launch, relaunch, and replacement workers
+        ckpt_cb = next((cb for cb in callbacks
+                        if isinstance(cb, CheckpointCallback)), None)
+        if ckpt_cb is None:
+            ckpt_cb = CheckpointCallback(
+                elastic.checkpoint_dir, interval=elastic.checkpoint_interval,
+                keep_last=elastic.keep_last)
+            callbacks.append(ckpt_cb)
+        elif (os.path.abspath(ckpt_cb.manager.directory)
+              != os.path.abspath(elastic.checkpoint_dir)):
+            # a mismatch would silently break regroup recovery: the run
+            # would checkpoint to one directory and reload from an
+            # empty other, discarding every completed round on a death
+            raise ValueError(
+                f"CheckpointCallback directory "
+                f"{ckpt_cb.manager.directory!r} != "
+                f"ElasticConfig.checkpoint_dir "
+                f"{elastic.checkpoint_dir!r}: regroup recovery reloads "
+                "from the elastic directory, so they must match")
+        if resume_from is None:
+            resume_from = elastic.checkpoint_dir
     # run-last callbacks (CheckpointCallback) dispatch after the rest so a
     # checkpoint captures the CURRENT round's EarlyStopping state, not the
     # previous round's (stable sort keeps every other relative order)
@@ -68,15 +225,31 @@ def train(
                                              restore_callback_state)
 
         resumed = latest_checkpoint(resume_from)
+    from . import collective
+
+    if elastic is not None:
+        rank, world = collective.get_rank(), collective.get_world_size()
+        if elastic.num_shards is None:
+            # pin the shard universe to the INITIAL world: a fresh restart
+            # after a pre-checkpoint death must not shrink it, or
+            # absorption back to full strength becomes impossible.  Pin on
+            # a copy — the caller's config object must stay reusable for
+            # a later run at a different world size.
+            import copy
+
+            elastic = copy.copy(elastic)
+            elastic.num_shards = world
+        smap = _elastic_shard_map(elastic, resumed, world)
+        if dtrain is None:
+            dtrain, evals = _elastic_data(elastic, smap, rank, world, evals)
+            if early_stopping_rounds is not None and not evals:
+                raise ValueError(
+                    "Must have at least 1 validation dataset for early "
+                    "stopping (the elastic data_fn returned none)."
+                )
+        ckpt_cb.shard_map = smap.to_dict()
     if resumed is not None:
-        bst = Booster(params)
-        bst.unserialize(resumed.booster_bytes)
-        bst.set_param(params)
-        bi = bst.attr("best_iteration")
-        if bi is not None:  # re-expose early-stopping bests on the object
-            bst.best_iteration = int(bi)
-            bs = bst.attr("best_score")
-            bst.best_score = float(bs) if bs is not None else None
+        bst = _restore_booster(params, resumed)
         for name, metrics in resumed.history.items():
             cbs.history.setdefault(name, {}).update(metrics)
         restore_callback_state(callbacks, resumed.callback_state)
@@ -94,21 +267,43 @@ def train(
     start = bst.num_boosted_rounds()
     # resumed runs count num_boost_round as the TOTAL target (so relaunching
     # the same command converges on the same final round); a fresh or
-    # xgb_model continuation keeps the additive reference semantics
-    end = num_boost_round if resumed is not None else start + num_boost_round
-    from . import collective
+    # xgb_model continuation keeps the additive reference semantics.
+    # Elastic runs are always total: survivors and replacements must agree
+    # on the final round whatever state they entered with.
+    total = resumed is not None or elastic is not None
+    end = num_boost_round if total else start + num_boost_round
     from .reliability.faults import maybe_inject
 
-    for i in range(start, end):
-        # fault seam (kill/exception/delay; no-op without a plan): the
-        # round boundary is where a worker death is injected for the
-        # kill->resume parity tests
-        maybe_inject("train.round", round=i, rank=collective.get_rank)
-        if cbs.before_iteration(bst, i, dtrain, evals):
+    i = start
+    while i < end:
+        if elastic is not None and collective.regroup_pending():
+            # round-boundary absorption/shrink: membership changed while
+            # this worker was between rounds
+            bst, dtrain, evals, i = _elastic_regroup(
+                params, elastic, cbs, callbacks, ckpt_cb, evals,
+                bst.num_boosted_rounds())
+            continue
+        try:
+            # fault seam (kill/exception/delay; no-op without a plan): the
+            # round boundary is where a worker death is injected for the
+            # kill->resume parity tests
+            maybe_inject("train.round", round=i, rank=collective.get_rank)
+            if cbs.before_iteration(bst, i, dtrain, evals):
+                break
+            bst.update(dtrain, i, fobj=obj)
+            stop = cbs.after_iteration(bst, i, dtrain, evals)
+        except RegroupRequired:
+            if elastic is None:
+                raise
+            # a peer died (or a replacement arrived) mid-round: abandon the
+            # partial round, regroup, and re-enter from the last checkpoint
+            bst, dtrain, evals, i = _elastic_regroup(
+                params, elastic, cbs, callbacks, ckpt_cb, evals,
+                bst.num_boosted_rounds())
+            continue
+        if stop:
             break
-        bst.update(dtrain, i, fobj=obj)
-        if cbs.after_iteration(bst, i, dtrain, evals):
-            break
+        i += 1
     bst = cbs.after_training(bst)
 
     if evals_result is not None:
