@@ -85,13 +85,20 @@ std::vector<float> run_predict(const Data& d, const std::vector<int32_t>& feat,
 int main() {
   Data d = make_data();
 
-  // --- 1. histogram: nthread=1 reference vs threaded, bitwise ---
+  // --- 1. histogram: scalar nthread=1 reference vs threaded runs at BOTH
+  // simd levels (scalar + best detected), all bitwise ---
+  xtb_simd_set(XTB_SIMD_SCALAR);
   xtb_set_nthread(1);
   auto ref = run_hist(d);
-  xtb_set_nthread(4);
-  auto thr4 = run_hist(d);
-  if (!bitwise_eq(ref.data(), thr4.data(), ref.size(), "hist nthread=4"))
-    return 1;
+  for (int lvl : {0, -1}) {
+    xtb_simd_set(lvl);
+    xtb_set_nthread(4);
+    auto thr4 = run_hist(d);
+    if (!bitwise_eq(ref.data(), thr4.data(), ref.size(),
+                    lvl == 0 ? "hist nthread=4 scalar"
+                             : "hist nthread=4 vector"))
+      return 1;
+  }
 
   // quantised limbs
   std::vector<int8_t> limbs(R * 6);
@@ -99,9 +106,11 @@ int main() {
   for (auto& l : limbs) l = static_cast<int8_t>(rng() % 256 - 128);
   std::vector<int32_t> q1(static_cast<size_t>(N) * F * B * 6),
       q4(static_cast<size_t>(N) * F * B * 6);
+  xtb_simd_set(XTB_SIMD_SCALAR);
   xtb_set_nthread(1);
   xtb_hist_q_impl(d.bins.data(), limbs.data(), d.pos.data(), R, F, B, N - 1,
                   N, 1, 6, q1.data());
+  xtb_simd_set(-1);
   xtb_set_nthread(4);
   xtb_hist_q_impl(d.bins.data(), limbs.data(), d.pos.data(), R, F, B, N - 1,
                   N, 1, 6, q4.data());
@@ -127,9 +136,11 @@ int main() {
   std::vector<float> g1(N), g4(N), GL1(N), GL4(N), HL1(N), HL4(N);
   std::vector<int32_t> f1(N), f4(N), b1(N), b4(N);
   std::vector<uint8_t> d1(N), d4(N);
+  xtb_simd_set(XTB_SIMD_SCALAR);
   xtb_set_nthread(1);
   run_split(g1.data(), f1.data(), b1.data(), d1.data(), GL1.data(),
             HL1.data());
+  xtb_simd_set(-1);
   xtb_set_nthread(4);
   run_split(g4.data(), f4.data(), b4.data(), d4.data(), GL4.data(),
             HL4.data());
@@ -153,8 +164,13 @@ int main() {
       value[i] = 0.01f * (t + m);
     }
   }
+  xtb_simd_set(XTB_SIMD_SCALAR);
   xtb_set_nthread(1);
   auto pref = run_predict(d, feat, thr, dleft, lr, value, groups, T, M);
+  // concurrent callers run at the detected simd level: the lane-parallel
+  // traversal shares the pool with the busy-pool inline fallback — the
+  // exact interleaving the narrowed C-API dispatch relies on
+  xtb_simd_set(-1);
   xtb_set_nthread(4);
   bool ok = true;
   std::vector<std::thread> callers;
@@ -211,7 +227,8 @@ int main() {
     }
   }
 
-  printf("TSAN-SMOKE-OK regions=%lld\n",
-         static_cast<long long>(xtb_pool_regions_total()));
+  printf("TSAN-SMOKE-OK regions=%lld simd=%s\n",
+         static_cast<long long>(xtb_pool_regions_total()),
+         xtb_simd_name(xtb_simd_detected()));
   return 0;
 }

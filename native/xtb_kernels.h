@@ -26,9 +26,17 @@
 #include <cstdlib>
 #include <cstring>
 #include <functional>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <vector>
+
+// Runtime CPU-feature dispatch + every raw intrinsic in the project lives in
+// the SIMD seam header (xtblint XTB601 rejects intrinsics anywhere else).
+// Kernels below call the xtb_* dispatch wrappers; each has a scalar twin
+// with identical per-element semantics, so scalar and vector builds stay
+// bitwise-equal — see docs/native_threading.md for the per-kernel technique.
+#include "xtb_simd.h"
 
 // ===========================================================================
 // ParallelFor pool.
@@ -61,6 +69,7 @@ enum XtbKernelId {
   XTB_K_LAMBDARANK,
   XTB_K_SKETCH,
   XTB_K_SHAP,
+  XTB_K_ELLPACK,
   XTB_K_OTHER,
   XTB_K_COUNT,
 };
@@ -68,7 +77,7 @@ enum XtbKernelId {
 inline const char* xtb_kernel_name_impl(int k) {
   static const char* kNames[XTB_K_COUNT] = {
       "hist", "hist_q", "split", "predict", "lambdarank",
-      "sketch", "shap", "other"};
+      "sketch", "shap", "ellpack", "other"};
   return (k >= 0 && k < XTB_K_COUNT) ? kNames[k] : "";
 }
 
@@ -362,6 +371,15 @@ uint64_t xtb_pool_instance_id() {
   return reinterpret_cast<uint64_t>(&XtbThreadPool::Get());
 }
 const char* xtb_pool_kernel_name(int k) { return xtb_kernel_name_impl(k); }
+// SIMD level control (native/xtb_simd.h): kernel output is bitwise
+// level-independent, so these only pick which (identical) body runs.
+// lvl: -1 auto (best detected), 0 scalar, 1 avx2, 2 neon; unsupported
+// requests resolve to the detected level.  Returns the effective level.
+int xtb_simd_set(int lvl) { return xtb_simd_set_impl(lvl); }
+int xtb_simd_get() { return xtb_simd_active(); }
+int xtb_simd_detected() { return xtb_simd_detect_impl(); }
+int xtb_simd_lanes() { return xtb_simd_lanes_impl(xtb_simd_active()); }
+const char* xtb_simd_name(int lvl) { return xtb_simd_level_name_impl(lvl); }
 // out: [regions, busy_ns, bucket_0 .. bucket_10] (13 int64 slots)
 void xtb_pool_kernel_stats(int kernel, int64_t* out) {
   const XtbKernelStats& s = XtbThreadPool::Get().stats(kernel);
@@ -387,7 +405,24 @@ void xtb_pool_kernel_stats(int kernel, int64_t* out) {
 // thread count but not nthread-invariant: f32 partial-sum merges reassociate
 // the adds.  The per-shard repeat of the pos decode is ~6 ops/row —
 // negligible against the F/S adds it amortises.
+//
+// Vectorization (xtb_simd.h xtb_hist_row8): the C==2 inner feature loop
+// loads 8 contiguous bins at once and computes destination indices + the
+// in-range mask in vector registers; the (g, h) adds stay scalar in lane
+// (= feature) order, preserving the sequential add order per output
+// element.  Engaged only while the whole level's histogram
+// (n_nodes * F * B * C floats) fits ~L2 — beyond that the adds are
+// memory-bound and index prep just adds overhead (measured).  The
+// cache-blocking restructures suggested by Chen & Guestrin KDD'16 §4 were
+// measured on this layout and REJECTED: both a row-tiled feature-outer
+// variant (0.24-0.54x) and a feature-major page mirror (0.24-0.61x) lose
+// to this row sweep, because the elementwise pos routing already streams
+// every operand sequentially and consecutive rows of one feature column
+// serialize on the same histogram bucket while the row sweep gets free ILP
+// across F independent columns — see docs/perf_r7.md for the numbers.
 // ---------------------------------------------------------------------------
+constexpr size_t kXtbHistVecL2 = size_t{4} << 20;  // vec index-prep cutoff
+
 template <typename BinT>
 inline void xtb_hist_build_impl(const BinT* bins, const float* gpair,
                                 const int32_t* pos, int64_t R, int32_t F,
@@ -395,25 +430,25 @@ inline void xtb_hist_build_impl(const BinT* bins, const float* gpair,
                                 int32_t stride, int32_t C, float* out) {
   const size_t node_sz = static_cast<size_t>(F) * n_bin * C;
   const size_t col_sz = static_cast<size_t>(n_bin) * C;
+  const bool vec_row = C == 2 && xtb_simd_active() != XTB_SIMD_SCALAR &&
+                       n_nodes * node_sz * sizeof(float) <= kXtbHistVecL2;
   auto shard = [=](int64_t f0, int64_t f1) {
     for (int32_t nd = 0; nd < n_nodes; ++nd) {
       memset(out + nd * node_sz + f0 * col_sz, 0,
              (f1 - f0) * col_sz * sizeof(float));
     }
+#if XTB_SIMD_X86
+    if (vec_row) {
+      xtb_hist_sweep_avx2(bins, gpair, pos, R, F, f0, f1, n_bin, node0,
+                          n_nodes, stride, node_sz, out);
+      return;
+    }
+#else
+    (void)vec_row;
+#endif
     for (int64_t r = 0; r < R; ++r) {
-      int32_t local = pos[r] - node0;
-      if (local < 0) continue;
       int32_t node;
-      if (stride == 2) {
-        if (local & 1) continue;
-        node = local >> 1;
-      } else if (stride == 1) {
-        node = local;
-      } else {
-        if (local % stride != 0) continue;
-        node = local / stride;
-      }
-      if (node >= n_nodes) continue;
+      if (!xtb_pos_node(pos[r], node0, stride, n_nodes, &node)) continue;
       const BinT* br = bins + r * F;
       float* ob = out + node * node_sz;
       if (C == 2) {
@@ -462,19 +497,8 @@ inline void xtb_hist_q_impl(const BinT* bins, const int8_t* limbs,
              (f1 - f0) * col_sz * sizeof(int32_t));
     }
     for (int64_t r = 0; r < R; ++r) {
-      int32_t local = pos[r] - node0;
-      if (local < 0) continue;
       int32_t node;
-      if (stride == 2) {
-        if (local & 1) continue;
-        node = local >> 1;
-      } else if (stride == 1) {
-        node = local;
-      } else {
-        if (local % stride != 0) continue;
-        node = local / stride;
-      }
-      if (node >= n_nodes) continue;
+      if (!xtb_pos_node(pos[r], node0, stride, n_nodes, &node)) continue;
       const BinT* br = bins + r * F;
       const int8_t* lr = limbs + r * CL;
       int32_t* ob = out + node * node_sz;
@@ -531,7 +555,27 @@ inline void xtb_split_scan_impl(const float* hist, const float* totals,
                                 float* out_HL) {
   const float kEps = 1e-6f;
   const XtbGainParams p{lambda_, alpha, min_child_weight, max_delta_step};
+  // max_delta_step == 0 (the default) takes the vectorized candidate
+  // evaluation: the glr/hlr prefix chains stay serial (the f32 adds keep
+  // their sequential order), only the per-bin ELEMENTWISE gain math runs 8
+  // bins at a time (xtb_simd.h xtb_split_eval) — per-lane IEEE-identical
+  // to the scalar transcription, so scalar and vector builds match bitwise.
+  // A scalar-level run keeps the original fused loop below: the buffered
+  // two-pass shape only pays when a vector body consumes the buffers.
+  const bool vec_eval =
+      max_delta_step == 0.0f && xtb_simd_active() != XTB_SIMD_SCALAR;
   auto shard = [=](int64_t lo, int64_t hi) {
+  static thread_local std::vector<float> glr_buf, hlr_buf, g2_buf, GLb, HLb;
+  static thread_local std::vector<uint8_t> ok_buf, dl_buf;
+  if (vec_eval) {
+    glr_buf.resize(B);
+    hlr_buf.resize(B);
+    g2_buf.resize(B);
+    GLb.resize(B);
+    HLb.resize(B);
+    ok_buf.resize(B);
+    dl_buf.resize(B);
+  }
   for (int32_t n = static_cast<int32_t>(lo); n < hi; ++n) {
     const float totG = totals[n * 2], totH = totals[n * 2 + 1];
     if (totG == 0.0f && totH == 0.0f) {
@@ -564,8 +608,35 @@ inline void xtb_split_scan_impl(const float* hist, const float* totals,
       }
       const float missG = totG - gsum, missH = totH - hsum;
       const bool has_miss = fabsf(missH) > kEps;
-      float glr = 0.0f, hlr = 0.0f;
       const int32_t bmax = nb < B ? nb : B;
+      if (vec_eval) {
+        float glr_acc = 0.0f, hlr_acc = 0.0f;
+        for (int32_t b = 0; b < bmax; ++b) {  // serial prefix, exact order
+          glr_acc += hf[2 * b];
+          hlr_acc += hf[2 * b + 1];
+          glr_buf[b] = glr_acc;
+          hlr_buf[b] = hlr_acc;
+          ok_buf[b] = (b < nb - 1) || (b == nb - 1 && has_miss) ? 1 : 0;
+        }
+        const XtbSplitEvalArgs a{totG, totH, missG, missH, parent,
+                                 lambda_, alpha, min_child_weight};
+        xtb_split_eval(glr_buf.data(), hlr_buf.data(), ok_buf.data(), bmax,
+                       a, g2_buf.data(), dl_buf.data(), GLb.data(),
+                       HLb.data());
+        for (int32_t b = 0; b < bmax; ++b) {
+          if (g2_buf[b] > best_gain) {
+            best_gain = g2_buf[b];
+            best_f = f;
+            best_b = b;
+            best_dl = dl_buf[b] != 0;
+            best_GL = GLb[b];
+            best_HL = HLb[b];
+            any = true;
+          }
+        }
+        continue;
+      }
+      float glr = 0.0f, hlr = 0.0f;
       for (int32_t b = 0; b < bmax; ++b) {
         glr += hf[2 * b];
         hlr += hf[2 * b + 1];
@@ -641,6 +712,12 @@ inline void xtb_split_scan_impl(const float* hist, const float* totals,
 //
 // Threading: ROW-block sharded — rows are independent and each shard owns
 // its init memcpy + output rows, so every nthread is bitwise-identical.
+//
+// Vector path (numeric scalar-leaf ensembles): eight rows ride the AVX2
+// lanes through one tree at a time (xtb_simd.h xtb_predict_raw_rows_avx2);
+// per row the leaf adds still land in tree order, so lane-parallel ==
+// scalar bitwise.  Categorical / vector-leaf ensembles and shard tails
+// keep the scalar walk.
 // ---------------------------------------------------------------------------
 inline void xtb_predict_raw_impl(
     const float* X, int64_t R, int32_t F, const int32_t* feat,
@@ -649,10 +726,30 @@ inline void xtb_predict_raw_impl(
     int32_t T, int32_t M, int32_t depth, int32_t K, int32_t K_leaf,
     int32_t has_cat, const uint8_t* is_cat, const uint8_t* catm, int32_t Bc,
     const float* init, float* out) {
+  // the byte-wide dleft array is gathered with 32-bit reads on the vector
+  // path; copy it into a 4-byte-padded scratch once per call
+  std::shared_ptr<std::vector<uint8_t>> dl_pad;
+  const bool vec_ok =
+      xtb_simd_active() == XTB_SIMD_AVX2 && K_leaf == 1 && !has_cat &&
+      R >= 16 &&
+      static_cast<int64_t>(R) * F + F < (int64_t{1} << 31);
+  if (vec_ok) {
+    dl_pad = std::make_shared<std::vector<uint8_t>>(
+        static_cast<size_t>(T) * M + 4);
+    memcpy(dl_pad->data(), dleft, static_cast<size_t>(T) * M);
+  }
   auto shard = [=](int64_t r0, int64_t r1) {
     memcpy(out + r0 * K, init + r0 * K,
            static_cast<size_t>(r1 - r0) * K * sizeof(float));
-    for (int64_t r = r0; r < r1; ++r) {
+    int64_t done = 0;
+#if XTB_SIMD_X86
+    if (vec_ok && xtb_simd_active() == XTB_SIMD_AVX2) {
+      done = xtb_predict_raw_rows_avx2(X, r0, r1, F, feat, thr,
+                                       dl_pad->data(), left, right, value,
+                                       groups, T, M, depth, K, out);
+    }
+#endif
+    for (int64_t r = r0 + done; r < r1; ++r) {
       const float* xr = X + r * F;
       float* orow = out + r * K;
       for (int32_t t = 0; t < T; ++t) {
@@ -688,6 +785,10 @@ inline void xtb_predict_raw_impl(
 
 // Binned variant (split_bins routing over an Ellpack page; sentinel
 // b >= n_bin = missing) — ops/predict.py predict_margin_delta_binned.
+// Same lane-per-row vector path as the raw kernel; sub-word bin gathers
+// read up to 3 bytes past the addressed element, so the final 16 rows of
+// the page always take the scalar walk (interior rows have the next row's
+// bytes as slack).
 template <typename BinT>
 inline void xtb_predict_binned_impl(
     const BinT* bins, int64_t R, int32_t F, int32_t n_bin,
@@ -696,10 +797,40 @@ inline void xtb_predict_binned_impl(
     const int32_t* groups, int32_t T, int32_t M, int32_t depth, int32_t K,
     int32_t has_cat, const uint8_t* is_cat, const uint8_t* catm, int32_t Bc,
     const float* init, float* out) {
+  std::shared_ptr<std::vector<uint8_t>> dl_pad;
+  const bool vec_ok =
+      xtb_simd_active() == XTB_SIMD_AVX2 && !has_cat && R >= 16 &&
+      static_cast<int64_t>(R) * F * static_cast<int64_t>(sizeof(BinT)) +
+              4 * sizeof(BinT) < (int64_t{1} << 31);
+  if (vec_ok) {
+    dl_pad = std::make_shared<std::vector<uint8_t>>(
+        static_cast<size_t>(T) * M + 4);
+    memcpy(dl_pad->data(), dleft, static_cast<size_t>(T) * M);
+  }
+  const int64_t r_vec_end = sizeof(BinT) == 4 ? R : std::max<int64_t>(R - 16, 0);
   auto shard = [=](int64_t r0, int64_t r1) {
     memcpy(out + r0 * K, init + r0 * K,
            static_cast<size_t>(r1 - r0) * K * sizeof(float));
-    for (int64_t r = r0; r < r1; ++r) {
+    int64_t done = 0;
+#if XTB_SIMD_X86
+    if (vec_ok && xtb_simd_active() == XTB_SIMD_AVX2) {
+      const int64_t vend = std::min(r1, r_vec_end);
+      if (sizeof(BinT) == 1) {
+        done = xtb_predict_binned_rows_avx2<1, 0xFF>(
+            bins, r0, vend, F, n_bin, feat, sbin, dl_pad->data(), left,
+            right, value, groups, T, M, depth, K, out);
+      } else if (sizeof(BinT) == 2) {
+        done = xtb_predict_binned_rows_avx2<2, 0xFFFF>(
+            bins, r0, vend, F, n_bin, feat, sbin, dl_pad->data(), left,
+            right, value, groups, T, M, depth, K, out);
+      } else {
+        done = xtb_predict_binned_rows_avx2<4, -1>(
+            bins, r0, vend, F, n_bin, feat, sbin, dl_pad->data(), left,
+            right, value, groups, T, M, depth, K, out);
+      }
+    }
+#endif
+    for (int64_t r = r0 + done; r < r1; ++r) {
       const BinT* br = bins + r * F;
       float* orow = out + r * K;
       for (int32_t t = 0; t < T; ++t) {
@@ -975,6 +1106,100 @@ inline void xtb_shap_values_impl(const double* X, int64_t R, int32_t F,
     }
   };
   xtb_parallel_for(R, 16, XTB_K_SHAP, shard);
+}
+
+// ---------------------------------------------------------------------------
+// Ellpack page ingestion: bin a dense (R, F) f32 matrix against per-feature
+// quantile cuts into local bin indices (data/ellpack.py build_ellpack's
+// native fast path).  Semantics are EXACTLY the XLA formulation it replaces:
+// bin = upper_bound(cuts_f, v) (== searchsorted side='right'), clamped into
+// the top bin, NaN -> sentinel B.  The sweep is row-major — X is streamed
+// once, sequentially, and the page is written sequentially, the
+// prefetch-friendly layout the blocked hist kernels then consume.
+//
+// Threading: ROW-sharded — outputs are disjoint row slices and bin indices
+// are integers, so every nthread (and ISA) is bitwise-identical.
+// ---------------------------------------------------------------------------
+template <typename BinT>
+inline void xtb_ellpack_bin_impl(const float* X, int64_t R, int32_t F,
+                                 const float* cut_values,
+                                 const int32_t* cut_ptrs, int32_t B,
+                                 BinT* out) {
+  auto shard = [=](int64_t r0, int64_t r1) {
+    for (int64_t r = r0; r < r1; ++r) {
+      const float* xr = X + r * F;
+      BinT* orow = out + r * F;
+      for (int32_t f = 0; f < F; ++f) {
+        const float v = xr[f];
+        if (std::isnan(v)) {
+          orow[f] = static_cast<BinT>(B);
+          continue;
+        }
+        const float* seg = cut_values + cut_ptrs[f];
+        const int32_t nb = cut_ptrs[f + 1] - cut_ptrs[f];
+        int32_t b = static_cast<int32_t>(
+            std::upper_bound(seg, seg + nb, v) - seg);
+        if (b > nb - 1) b = nb - 1;
+        orow[f] = static_cast<BinT>(b);
+      }
+    }
+  };
+  xtb_parallel_for(R, 512, XTB_K_ELLPACK, shard);
+}
+
+// ---------------------------------------------------------------------------
+// Sub-byte (4-bit) packed histogram — BENCH-ONLY kernel backing the
+// docs/bitpack.md re-measurement (scripts/bitpack_bench.py --native): bins
+// packed two per byte in (R, ceil(F/2)) u8, unpacked on the fly with the
+// same vector gather the resident-u8 blocked path uses plus a shift/mask
+// (the `vpgatherdd`-era roofline question the scalar 2026-07 measurement
+// could not answer).  C == 2 only; NOT wired into training — adoption is
+// decided by the bench numbers, see docs/bitpack.md.
+// ---------------------------------------------------------------------------
+inline void xtb_hist_packed4_impl(const uint8_t* packed, const float* gpair,
+                                  const int32_t* pos, int64_t R, int32_t F,
+                                  int32_t n_bin, int32_t node0,
+                                  int32_t n_nodes, int32_t stride,
+                                  float* out) {
+  const int32_t Fp = (F + 1) / 2;  // bytes per packed row
+  const size_t node_sz = static_cast<size_t>(F) * n_bin * 2;
+  const size_t col_sz = static_cast<size_t>(n_bin) * 2;
+  const bool vec_row = xtb_simd_active() != XTB_SIMD_SCALAR &&
+                       n_nodes * node_sz * sizeof(float) <= kXtbHistVecL2;
+  auto shard = [=](int64_t fp0, int64_t fp1) {
+    // shard over packed BYTES so every shard starts nibble-aligned
+    const int64_t f0 = fp0 * 2;
+    const int64_t f1 = std::min<int64_t>(fp1 * 2, F);
+    for (int32_t nd = 0; nd < n_nodes; ++nd) {
+      memset(out + nd * node_sz + f0 * col_sz, 0,
+             (f1 - f0) * col_sz * sizeof(float));
+    }
+#if XTB_SIMD_X86
+    if (vec_row) {
+      xtb_hist_sweep_p4_avx2(packed, gpair, pos, R, F, f0, f1, n_bin, node0,
+                             n_nodes, stride, node_sz, out);
+      return;
+    }
+#else
+    (void)vec_row;
+#endif
+    for (int64_t r = 0; r < R; ++r) {
+      int32_t node;
+      if (!xtb_pos_node(pos[r], node0, stride, n_nodes, &node)) continue;
+      const uint8_t* br = packed + r * Fp;
+      float* ob = out + node * node_sz;
+      const float g = gpair[r * 2], h = gpair[r * 2 + 1];
+      for (int64_t f = f0; f < f1; ++f) {
+        const int32_t b = (br[f >> 1] >> ((f & 1) * 4)) & 0xF;
+        if (b < n_bin) {
+          float* p = ob + (static_cast<size_t>(f) * n_bin + b) * 2;
+          p[0] += g;
+          p[1] += h;
+        }
+      }
+    }
+  };
+  xtb_parallel_for(Fp, 1, XTB_K_HIST, shard);
 }
 
 #endif  // XTB_KERNELS_H_

@@ -285,6 +285,52 @@ void xtb_shap_values(const double* X, int64_t R, int32_t F,
   xtb_shap_values_impl(X, R, F, t, max_depth, out);
 }
 
+// ---------------------------------------------------------------------------
+// Ellpack native ingestion (xtb_kernels.h xtb_ellpack_bin_impl): bin a dense
+// f32 matrix against quantile cuts, bitwise-equal to the XLA searchsorted
+// formulation in data/ellpack.py.  dtype_code: 0 = u8, 1 = i16, 2 = i32
+// (ellpack.py _bin_dtype's ladder).
+// ---------------------------------------------------------------------------
+void xtb_ellpack_bin(const float* X, int64_t R, int32_t F,
+                     const float* cut_values, const int32_t* cut_ptrs,
+                     int32_t B, int32_t dtype_code, void* out) {
+  switch (dtype_code) {
+    case 0:
+      xtb_ellpack_bin_impl(X, R, F, cut_values, cut_ptrs, B,
+                           static_cast<uint8_t*>(out));
+      break;
+    case 1:
+      xtb_ellpack_bin_impl(X, R, F, cut_values, cut_ptrs, B,
+                           static_cast<int16_t*>(out));
+      break;
+    default:
+      xtb_ellpack_bin_impl(X, R, F, cut_values, cut_ptrs, B,
+                           static_cast<int32_t*>(out));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Bench/ctypes twins of the hist kernels (scripts/bitpack_bench.py --native):
+// the resident-u8 layout vs the 4-bit packed layout, both through the same
+// blocked + vector-gather machinery, so the bitpack decision compares
+// layouts rather than dispatch overheads.
+// ---------------------------------------------------------------------------
+void xtb_hist_f32_u8(const uint8_t* bins, const float* gpair,
+                     const int32_t* pos, int64_t R, int32_t F, int32_t n_bin,
+                     int32_t node0, int32_t n_nodes, int32_t stride,
+                     int32_t C, float* out) {
+  xtb_hist_build_impl(bins, gpair, pos, R, F, n_bin, node0, n_nodes, stride,
+                      C, out);
+}
+
+void xtb_hist_packed4(const uint8_t* packed, const float* gpair,
+                      const int32_t* pos, int64_t R, int32_t F,
+                      int32_t n_bin, int32_t node0, int32_t n_nodes,
+                      int32_t stride, float* out) {
+  xtb_hist_packed4_impl(packed, gpair, pos, R, F, n_bin, node0, n_nodes,
+                        stride, out);
+}
+
 void* xtb_summary_new(int64_t budget) { return new QuantileSummary(budget); }
 void xtb_summary_push(void* h, const float* vals, const float* wts, int64_t n) {
   static_cast<QuantileSummary*>(h)->push(vals, wts, n);

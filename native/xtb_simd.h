@@ -1,0 +1,667 @@
+// SIMD dispatch seam for the native host kernels.
+//
+// This header is the ONLY translation-unit-visible home for raw vector
+// intrinsics in the project (xtblint XTB601 rejects `_mm*`/`__m256`/NEON
+// tokens anywhere else under native/).  Every entry point here carries a
+// scalar fallback with IDENTICAL per-element semantics, and the vector
+// bodies are written under the repo's bitwise determinism contract
+// (docs/native_threading.md):
+//
+//   * elementwise-only float vector math (add/sub/mul/div/min/max/abs/
+//     compare/blend) — per-lane IEEE-754 identical to the scalar ops, so
+//     lanes equal the scalar loop bit for bit;
+//   * NO FMA intrinsics and no reassociating horizontal reductions:
+//     every f32 accumulation chain keeps the exact sequential element
+//     order (the Makefile's -ffp-contract=off keeps the compiler from
+//     contracting the scalar twins);
+//   * integer lanes are exact, so integer kernels vectorize freely.
+//
+// Runtime CPU dispatch: the AVX2 bodies are compiled with a per-function
+// `target("avx2")` attribute into every build, and selected at runtime via
+// cpuid (`__builtin_cpu_supports`), so one .so runs on any x86-64 host.
+// On aarch64, NEON is baseline and selected at compile time.  The active
+// level is process-global, overridable by XGBOOST_TPU_SIMD
+// (scalar|avx2|neon|auto) and the xtb_simd_set C ABI — the lane-width
+// fuzz tests flip it to pin scalar == vector bitwise.
+#ifndef XTB_SIMD_H_
+#define XTB_SIMD_H_
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#if defined(__x86_64__) && defined(__GNUC__)
+#define XTB_SIMD_X86 1
+#include <immintrin.h>
+#elif defined(__aarch64__)
+#define XTB_SIMD_ARM 1
+#include <arm_neon.h>
+#endif
+
+enum XtbSimdLevel {
+  XTB_SIMD_SCALAR = 0,
+  XTB_SIMD_AVX2 = 1,
+  XTB_SIMD_NEON = 2,
+};
+
+inline const char* xtb_simd_level_name_impl(int lvl) {
+  switch (lvl) {
+    case XTB_SIMD_AVX2: return "avx2";
+    case XTB_SIMD_NEON: return "neon";
+    default: return "scalar";
+  }
+}
+
+// Best level this host can run (cpuid on x86; NEON is baseline on aarch64).
+inline int xtb_simd_detect_impl() {
+#if XTB_SIMD_X86
+  return __builtin_cpu_supports("avx2") ? XTB_SIMD_AVX2 : XTB_SIMD_SCALAR;
+#elif XTB_SIMD_ARM
+  return XTB_SIMD_NEON;
+#else
+  return XTB_SIMD_SCALAR;
+#endif
+}
+
+inline int xtb_simd_resolve_impl(int requested) {
+  const int det = xtb_simd_detect_impl();
+  if (requested == XTB_SIMD_SCALAR) return XTB_SIMD_SCALAR;
+  if (requested == det) return requested;
+  return det;  // auto / unavailable request -> best available
+}
+
+inline int xtb_simd_env_level() {
+  const char* env = std::getenv("XGBOOST_TPU_SIMD");
+  if (env && *env) {
+    if (!std::strcmp(env, "scalar") || !std::strcmp(env, "0")) {
+      return XTB_SIMD_SCALAR;
+    }
+    if (!std::strcmp(env, "avx2")) return xtb_simd_resolve_impl(XTB_SIMD_AVX2);
+    if (!std::strcmp(env, "neon")) return xtb_simd_resolve_impl(XTB_SIMD_NEON);
+    if (std::strcmp(env, "auto") != 0) {
+      // typos must be LOUD (set_simd raises on the Python side; a shared
+      // library cannot, so warn) — silently running avx2 while the env
+      // claims scalar would invalidate any benchmark or repro attempt
+      std::fprintf(stderr,
+                   "xtb_simd: unknown XGBOOST_TPU_SIMD=%s (expected "
+                   "scalar|avx2|neon|auto); using detected best\n", env);
+    }
+  }
+  return xtb_simd_detect_impl();  // auto
+}
+
+inline std::atomic<int>& xtb_simd_level_ref() {
+  static std::atomic<int> level{xtb_simd_env_level()};
+  return level;
+}
+
+// Results are bitwise level-independent, so flipping this mid-process is
+// always safe; it only changes which (identical-output) body runs.
+inline int xtb_simd_set_impl(int requested) {
+  const int eff = requested < 0 ? xtb_simd_detect_impl()
+                                : xtb_simd_resolve_impl(requested);
+  xtb_simd_level_ref().store(eff, std::memory_order_relaxed);
+  return eff;
+}
+
+inline int xtb_simd_active() {
+  return xtb_simd_level_ref().load(std::memory_order_relaxed);
+}
+
+inline int xtb_simd_lanes_impl(int lvl) {
+  return lvl == XTB_SIMD_AVX2 ? 8 : lvl == XTB_SIMD_NEON ? 4 : 1;
+}
+
+// ===========================================================================
+// pos -> level-local node decode, shared by every hist kernel body (scalar
+// kernels in xtb_kernels.h AND the AVX2 sweep bodies below): the routing
+// semantics exist exactly once, so scalar/vector/u8/packed4 parity cannot
+// drift.  Returns false when the row is outside this level's node range.
+// ===========================================================================
+
+inline bool xtb_pos_node(int32_t pos, int32_t node0, int32_t stride,
+                         int32_t n_nodes, int32_t* node) {
+  const int32_t local = pos - node0;
+  if (local < 0) return false;
+  int32_t n;
+  if (stride == 2) {
+    if (local & 1) return false;
+    n = local >> 1;
+  } else if (stride == 1) {
+    n = local;
+  } else {
+    if (local % stride != 0) return false;
+    n = local / stride;
+  }
+  if (n >= n_nodes) return false;
+  *node = n;
+  return true;
+}
+
+// ===========================================================================
+// Histogram row vectorization (hist kernels, C == 2): load 8 contiguous
+// bins of one row, compute the 8 destination indices and the in-range mask
+// in vector registers, then do the 8 (g, h) adds SCALAR in lane order —
+// lane order == feature order, so per output element the f32 adds keep the
+// exact sequential order (the adds are to 8 *different* feature columns,
+// so they could not collide anyway).  Only index prep vectorizes; this is
+// deliberate: full scatter-adds would need conflict detection (AVX-512CD)
+// and reassociation.  Row-blocked and column-major-mirror restructures
+// were both measured SLOWER than this row sweep on the elementwise-pos
+// Ellpack layout (see docs/perf_r7.md), so the row sweep stays.
+//
+// Contract: callers invoke the *_avx2 bodies only when xtb_simd_active()
+// says AVX2 (hoisted per shard, not re-checked per row).  Returns features
+// consumed (a multiple of 8); the caller's scalar loop finishes the rest.
+// ===========================================================================
+
+#if XTB_SIMD_X86
+// Whole-shard sweep: the row loop (node decode, C == 2) lives inside the
+// AVX2 function so the vector constants hoist once per shard, not per row.
+// LOAD8 pulls 8 bins for features [f, f+8) of row pointer `br`.
+#define XTB_HIST_SWEEP_BODY(LOAD8)                                          \
+  const __m256i fstep = _mm256_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7);          \
+  const __m256i vnbin = _mm256_set1_epi32(n_bin);                           \
+  alignas(32) int32_t idx[8];                                               \
+  const int64_t nf8 = f0 + ((f1 - f0) & ~int64_t{7});                       \
+  for (int64_t r = 0; r < R; ++r) {                                         \
+    int32_t node;                                                           \
+    if (!xtb_pos_node(pos[r], node0, stride, n_nodes, &node)) continue;     \
+    const auto* br = bins + r * F;                                          \
+    float* ob = out + node * node_sz;                                       \
+    const float g = gpair[r * 2], h = gpair[r * 2 + 1];                     \
+    float* obf = ob + f0 * 2 * n_bin;                                       \
+    for (int64_t f = f0; f < nf8; f += 8) {                                 \
+      const __m256i b = (LOAD8);                                            \
+      const __m256i fidx = _mm256_add_epi32(                                \
+          _mm256_set1_epi32(static_cast<int32_t>(f - f0)), fstep);          \
+      const __m256i a = _mm256_slli_epi32(                                  \
+          _mm256_add_epi32(_mm256_mullo_epi32(fidx, vnbin), b), 1);         \
+      const int okm = _mm256_movemask_ps(                                   \
+          _mm256_castsi256_ps(_mm256_cmpgt_epi32(vnbin, b)));               \
+      _mm256_store_si256(reinterpret_cast<__m256i*>(idx), a);               \
+      for (int k = 0; k < 8; ++k) {                                         \
+        if (okm >> k & 1) {                                                 \
+          float* p = obf + idx[k];                                          \
+          p[0] += g;                                                        \
+          p[1] += h;                                                        \
+        }                                                                   \
+      }                                                                     \
+    }                                                                       \
+    for (int64_t f = nf8; f < f1; ++f) {                                    \
+      const int32_t b = static_cast<int32_t>(br[f]);                        \
+      if (b < n_bin) {                                                      \
+        float* p = ob + (static_cast<size_t>(f) * n_bin + b) * 2;           \
+        p[0] += g;                                                          \
+        p[1] += h;                                                          \
+      }                                                                     \
+    }                                                                       \
+  }
+
+#define XTB_HIST_SWEEP_DECL(BIN_T, LOAD8)                                   \
+  __attribute__((target("avx2"))) inline void xtb_hist_sweep_avx2(          \
+      const BIN_T* bins, const float* gpair, const int32_t* pos, int64_t R, \
+      int32_t F, int64_t f0, int64_t f1, int32_t n_bin, int32_t node0,      \
+      int32_t n_nodes, int32_t stride, size_t node_sz, float* out) {        \
+    XTB_HIST_SWEEP_BODY(LOAD8)                                              \
+  }
+
+XTB_HIST_SWEEP_DECL(uint8_t, _mm256_cvtepu8_epi32(_mm_loadl_epi64(
+    reinterpret_cast<const __m128i*>(br + f))))
+XTB_HIST_SWEEP_DECL(uint16_t, _mm256_cvtepu16_epi32(_mm_loadu_si128(
+    reinterpret_cast<const __m128i*>(br + f))))
+XTB_HIST_SWEEP_DECL(int16_t, _mm256_cvtepi16_epi32(_mm_loadu_si128(
+    reinterpret_cast<const __m128i*>(br + f))))
+XTB_HIST_SWEEP_DECL(int32_t, _mm256_loadu_si256(
+    reinterpret_cast<const __m256i*>(br + f)))
+#undef XTB_HIST_SWEEP_DECL
+#undef XTB_HIST_SWEEP_BODY
+
+// 4-bit packed variant (bench-only, scripts/bitpack_bench.py): 4 packed
+// bytes -> 8 nibble lanes via byte-duplicating shuffle + per-lane shift —
+// the `vpgatherdd`-era shift/mask unpack, fused into the same index prep.
+// Feature shards are nibble-aligned by the caller (f0 even).
+__attribute__((target("avx2"))) inline void xtb_hist_sweep_p4_avx2(
+    const uint8_t* packed, const float* gpair, const int32_t* pos, int64_t R,
+    int32_t F, int64_t f0, int64_t f1, int32_t n_bin, int32_t node0,
+    int32_t n_nodes, int32_t stride, size_t node_sz, float* out) {
+  const int32_t Fp = (F + 1) / 2;
+  const __m128i dup = _mm_setr_epi8(0, 0, 1, 1, 2, 2, 3, 3,
+                                    -1, -1, -1, -1, -1, -1, -1, -1);
+  const __m256i nib_shift = _mm256_setr_epi32(0, 4, 0, 4, 0, 4, 0, 4);
+  const __m256i nib_mask = _mm256_set1_epi32(0xF);
+  const __m256i fstep = _mm256_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7);
+  const __m256i vnbin = _mm256_set1_epi32(n_bin);
+  alignas(32) int32_t idx[8];
+  const int64_t nf8 = f0 + ((f1 - f0) & ~int64_t{7});
+  for (int64_t r = 0; r < R; ++r) {
+    int32_t node;
+    if (!xtb_pos_node(pos[r], node0, stride, n_nodes, &node)) continue;
+    const uint8_t* br = packed + r * Fp;
+    float* ob = out + node * node_sz;
+    const float g = gpair[r * 2], h = gpair[r * 2 + 1];
+    float* obf = ob + f0 * 2 * n_bin;
+    for (int64_t f = f0; f < nf8; f += 8) {
+      int32_t w;
+      memcpy(&w, br + (f >> 1), 4);
+      const __m128i bytes = _mm_shuffle_epi8(_mm_cvtsi32_si128(w), dup);
+      const __m256i b = _mm256_and_si256(
+          _mm256_srlv_epi32(_mm256_cvtepu8_epi32(bytes), nib_shift),
+          nib_mask);
+      const __m256i fidx = _mm256_add_epi32(
+          _mm256_set1_epi32(static_cast<int32_t>(f - f0)), fstep);
+      const __m256i a = _mm256_slli_epi32(
+          _mm256_add_epi32(_mm256_mullo_epi32(fidx, vnbin), b), 1);
+      const int okm = _mm256_movemask_ps(
+          _mm256_castsi256_ps(_mm256_cmpgt_epi32(vnbin, b)));
+      _mm256_store_si256(reinterpret_cast<__m256i*>(idx), a);
+      for (int k = 0; k < 8; ++k) {
+        if (okm >> k & 1) {
+          float* p = obf + idx[k];
+          p[0] += g;
+          p[1] += h;
+        }
+      }
+    }
+    for (int64_t f = nf8; f < f1; ++f) {
+      const int32_t b = (br[f >> 1] >> ((f & 1) * 4)) & 0xF;
+      if (b < n_bin) {
+        float* p = ob + (static_cast<size_t>(f) * n_bin + b) * 2;
+        p[0] += g;
+        p[1] += h;
+      }
+    }
+  }
+}
+#endif  // XTB_SIMD_X86
+
+// ===========================================================================
+// Split-scan candidate evaluation (xtb_split_scan_impl): given the serial
+// prefix sums glr/hlr per bin (computed by the caller in exact sequential
+// order), evaluate both missing-direction candidates per bin.  All math is
+// elementwise, so the AVX2 body equals the scalar body lane for lane; the
+// scalar body is a faithful transcription of the original in-loop code.
+// Only the max_delta_step == 0 fast path is vectorized — callers keep the
+// original scalar loop otherwise.
+// ===========================================================================
+
+struct XtbSplitEvalArgs {
+  float totG, totH, missG, missH, parent;
+  float lambda_, alpha, min_child_weight;
+};
+
+inline float xtb_gain_mds0(float G, float H, float lambda_, float alpha) {
+  if (H <= 0.0f) return 0.0f;
+  float a = fabsf(G) - alpha;
+  if (a < 0.0f) a = 0.0f;
+  return a * a / (H + lambda_);  // == t*t/(H+l): (-a)*(-a) is bitwise a*a
+}
+
+inline void xtb_split_eval_scalar(const float* glr, const float* hlr,
+                                  const uint8_t* okb, int32_t b0, int32_t b1,
+                                  const XtbSplitEvalArgs& a, float* g2_out,
+                                  uint8_t* dl_out, float* GL_out,
+                                  float* HL_out) {
+  for (int32_t b = b0; b < b1; ++b) {
+    if (!okb[b]) {
+      g2_out[b] = -INFINITY;
+      dl_out[b] = 1;
+      GL_out[b] = glr[b];
+      HL_out[b] = hlr[b];
+      continue;
+    }
+    float g2 = -INFINITY;
+    bool dl2 = true;
+    {  // missing -> right
+      const float GR = a.totG - glr[b], HR = a.totH - hlr[b];
+      if (hlr[b] >= a.min_child_weight && HR >= a.min_child_weight &&
+          hlr[b] > 0.0f && HR > 0.0f) {
+        g2 = xtb_gain_mds0(glr[b], hlr[b], a.lambda_, a.alpha) +
+             xtb_gain_mds0(GR, HR, a.lambda_, a.alpha) - a.parent;
+        dl2 = false;
+      }
+    }
+    const float gll = glr[b] + a.missG, hll = hlr[b] + a.missH;
+    {  // missing -> left
+      const float GR = a.totG - gll, HR = a.totH - hll;
+      if (hll >= a.min_child_weight && HR >= a.min_child_weight &&
+          hll > 0.0f && HR > 0.0f) {
+        const float gl_gain = xtb_gain_mds0(gll, hll, a.lambda_, a.alpha) +
+                              xtb_gain_mds0(GR, HR, a.lambda_, a.alpha) -
+                              a.parent;
+        if (gl_gain >= g2) {
+          g2 = gl_gain;
+          dl2 = true;
+        }
+      }
+    }
+    g2_out[b] = g2;
+    dl_out[b] = dl2 ? 1 : 0;
+    GL_out[b] = dl2 ? gll : glr[b];
+    HL_out[b] = dl2 ? hll : hlr[b];
+  }
+}
+
+#if XTB_SIMD_X86
+__attribute__((target("avx2"))) inline __m256 xtb_gain_mds0_avx2(
+    __m256 G, __m256 H, __m256 vlam, __m256 valpha) {
+  const __m256 sign = _mm256_set1_ps(-0.0f);
+  const __m256 zero = _mm256_setzero_ps();
+  __m256 a = _mm256_sub_ps(_mm256_andnot_ps(sign, G), valpha);
+  // scalar twin: `if (a < 0) a = 0` — a NaN `a` (inf gradients upstream)
+  // must STAY NaN so the candidate loses `gain > best` exactly like the
+  // scalar build.  maxps would quietly map NaN -> 0; blend on `a < 0`
+  // (false for NaN) keeps the lane NaN.
+  a = _mm256_blendv_ps(a, zero, _mm256_cmp_ps(a, zero, _CMP_LT_OQ));
+  const __m256 q = _mm256_div_ps(_mm256_mul_ps(a, a), _mm256_add_ps(H, vlam));
+  // H <= 0 -> 0 (invalid lanes may hold inf/nan; the blend discards them)
+  const __m256 hpos = _mm256_cmp_ps(H, zero, _CMP_GT_OQ);
+  return _mm256_and_ps(q, hpos);
+}
+
+__attribute__((target("avx2"))) inline void xtb_split_eval_avx2(
+    const float* glr, const float* hlr, const uint8_t* okb, int32_t bmax,
+    const XtbSplitEvalArgs& a, float* g2_out, uint8_t* dl_out, float* GL_out,
+    float* HL_out) {
+  const __m256 vtotG = _mm256_set1_ps(a.totG);
+  const __m256 vtotH = _mm256_set1_ps(a.totH);
+  const __m256 vmissG = _mm256_set1_ps(a.missG);
+  const __m256 vmissH = _mm256_set1_ps(a.missH);
+  const __m256 vparent = _mm256_set1_ps(a.parent);
+  const __m256 vlam = _mm256_set1_ps(a.lambda_);
+  const __m256 valpha = _mm256_set1_ps(a.alpha);
+  const __m256 vmcw = _mm256_set1_ps(a.min_child_weight);
+  const __m256 vninf = _mm256_set1_ps(-INFINITY);
+  const __m256 vzero = _mm256_setzero_ps();
+  int32_t b = 0;
+  for (; b + 8 <= bmax; b += 8) {
+    const __m256 vglr = _mm256_loadu_ps(glr + b);
+    const __m256 vhlr = _mm256_loadu_ps(hlr + b);
+    // missing -> right candidate
+    const __m256 GR = _mm256_sub_ps(vtotG, vglr);
+    const __m256 HR = _mm256_sub_ps(vtotH, vhlr);
+    __m256 valid_r = _mm256_and_ps(
+        _mm256_and_ps(_mm256_cmp_ps(vhlr, vmcw, _CMP_GE_OQ),
+                      _mm256_cmp_ps(HR, vmcw, _CMP_GE_OQ)),
+        _mm256_and_ps(_mm256_cmp_ps(vhlr, vzero, _CMP_GT_OQ),
+                      _mm256_cmp_ps(HR, vzero, _CMP_GT_OQ)));
+    const __m256 gain_r = _mm256_sub_ps(
+        _mm256_add_ps(xtb_gain_mds0_avx2(vglr, vhlr, vlam, valpha),
+                      xtb_gain_mds0_avx2(GR, HR, vlam, valpha)),
+        vparent);
+    __m256 g2 = _mm256_blendv_ps(vninf, gain_r, valid_r);
+    // missing -> left candidate
+    const __m256 gll = _mm256_add_ps(vglr, vmissG);
+    const __m256 hll = _mm256_add_ps(vhlr, vmissH);
+    const __m256 GR2 = _mm256_sub_ps(vtotG, gll);
+    const __m256 HR2 = _mm256_sub_ps(vtotH, hll);
+    const __m256 valid_l = _mm256_and_ps(
+        _mm256_and_ps(_mm256_cmp_ps(hll, vmcw, _CMP_GE_OQ),
+                      _mm256_cmp_ps(HR2, vmcw, _CMP_GE_OQ)),
+        _mm256_and_ps(_mm256_cmp_ps(hll, vzero, _CMP_GT_OQ),
+                      _mm256_cmp_ps(HR2, vzero, _CMP_GT_OQ)));
+    const __m256 gain_l = _mm256_sub_ps(
+        _mm256_add_ps(xtb_gain_mds0_avx2(gll, hll, vlam, valpha),
+                      xtb_gain_mds0_avx2(GR2, HR2, vlam, valpha)),
+        vparent);
+    // dl2 = take_left || !valid_r  (scalar: dl2 starts true, right sets
+    // false, a winning/tying left restores true)
+    const __m256 take_left =
+        _mm256_and_ps(valid_l, _mm256_cmp_ps(gain_l, g2, _CMP_GE_OQ));
+    g2 = _mm256_blendv_ps(g2, gain_l, take_left);
+    const __m256 dl = _mm256_or_ps(
+        take_left, _mm256_xor_ps(valid_r, _mm256_castsi256_ps(
+                                              _mm256_set1_epi32(-1))));
+    // !ok bins are never candidates
+    const __m256 ok = _mm256_castsi256_ps(_mm256_cmpgt_epi32(
+        _mm256_cvtepu8_epi32(
+            _mm_loadl_epi64(reinterpret_cast<const __m128i*>(okb + b))),
+        _mm256_setzero_si256()));
+    g2 = _mm256_blendv_ps(vninf, g2, ok);
+    _mm256_storeu_ps(g2_out + b, g2);
+    _mm256_storeu_ps(GL_out + b, _mm256_blendv_ps(vglr, gll, dl));
+    _mm256_storeu_ps(HL_out + b, _mm256_blendv_ps(vhlr, hll, dl));
+    const int m = _mm256_movemask_ps(dl);
+    for (int k = 0; k < 8; ++k) dl_out[b + k] = (m >> k) & 1;
+  }
+  if (b < bmax) {
+    xtb_split_eval_scalar(glr, hlr, okb, b, bmax, a, g2_out, dl_out, GL_out,
+                          HL_out);
+  }
+}
+#endif  // XTB_SIMD_X86
+
+#if XTB_SIMD_ARM
+inline float32x4_t xtb_gain_mds0_neon(float32x4_t G, float32x4_t H,
+                                      float32x4_t vlam, float32x4_t valpha) {
+  float32x4_t a = vsubq_f32(vabsq_f32(G), valpha);
+  // blend on `a < 0` (false for NaN) so a NaN `a` stays NaN like the
+  // scalar twin — see the AVX2 body's note
+  a = vbslq_f32(vcltq_f32(a, vdupq_n_f32(0.0f)), vdupq_n_f32(0.0f), a);
+  const float32x4_t q = vdivq_f32(vmulq_f32(a, a), vaddq_f32(H, vlam));
+  const uint32x4_t hpos = vcgtq_f32(H, vdupq_n_f32(0.0f));
+  return vreinterpretq_f32_u32(
+      vandq_u32(vreinterpretq_u32_f32(q), hpos));
+}
+
+inline void xtb_split_eval_neon(const float* glr, const float* hlr,
+                                const uint8_t* okb, int32_t bmax,
+                                const XtbSplitEvalArgs& a, float* g2_out,
+                                uint8_t* dl_out, float* GL_out,
+                                float* HL_out) {
+  const float32x4_t vtotG = vdupq_n_f32(a.totG);
+  const float32x4_t vtotH = vdupq_n_f32(a.totH);
+  const float32x4_t vmissG = vdupq_n_f32(a.missG);
+  const float32x4_t vmissH = vdupq_n_f32(a.missH);
+  const float32x4_t vparent = vdupq_n_f32(a.parent);
+  const float32x4_t vlam = vdupq_n_f32(a.lambda_);
+  const float32x4_t valpha = vdupq_n_f32(a.alpha);
+  const float32x4_t vmcw = vdupq_n_f32(a.min_child_weight);
+  const float32x4_t vninf = vdupq_n_f32(-INFINITY);
+  const float32x4_t vzero = vdupq_n_f32(0.0f);
+  int32_t b = 0;
+  for (; b + 4 <= bmax; b += 4) {
+    const float32x4_t vglr = vld1q_f32(glr + b);
+    const float32x4_t vhlr = vld1q_f32(hlr + b);
+    const float32x4_t GR = vsubq_f32(vtotG, vglr);
+    const float32x4_t HR = vsubq_f32(vtotH, vhlr);
+    const uint32x4_t valid_r = vandq_u32(
+        vandq_u32(vcgeq_f32(vhlr, vmcw), vcgeq_f32(HR, vmcw)),
+        vandq_u32(vcgtq_f32(vhlr, vzero), vcgtq_f32(HR, vzero)));
+    const float32x4_t gain_r = vsubq_f32(
+        vaddq_f32(xtb_gain_mds0_neon(vglr, vhlr, vlam, valpha),
+                  xtb_gain_mds0_neon(GR, HR, vlam, valpha)),
+        vparent);
+    float32x4_t g2 = vbslq_f32(valid_r, gain_r, vninf);
+    const float32x4_t gll = vaddq_f32(vglr, vmissG);
+    const float32x4_t hll = vaddq_f32(vhlr, vmissH);
+    const float32x4_t GR2 = vsubq_f32(vtotG, gll);
+    const float32x4_t HR2 = vsubq_f32(vtotH, hll);
+    const uint32x4_t valid_l = vandq_u32(
+        vandq_u32(vcgeq_f32(hll, vmcw), vcgeq_f32(HR2, vmcw)),
+        vandq_u32(vcgtq_f32(hll, vzero), vcgtq_f32(HR2, vzero)));
+    const float32x4_t gain_l = vsubq_f32(
+        vaddq_f32(xtb_gain_mds0_neon(gll, hll, vlam, valpha),
+                  xtb_gain_mds0_neon(GR2, HR2, vlam, valpha)),
+        vparent);
+    const uint32x4_t take_left = vandq_u32(valid_l, vcgeq_f32(gain_l, g2));
+    g2 = vbslq_f32(take_left, gain_l, g2);
+    const uint32x4_t dl = vorrq_u32(take_left, vmvnq_u32(valid_r));
+    uint32_t okw[4], dlw[4];
+    for (int k = 0; k < 4; ++k) okw[k] = okb[b + k] ? ~0u : 0u;
+    const uint32x4_t ok = vld1q_u32(okw);
+    g2 = vbslq_f32(ok, g2, vninf);
+    vst1q_f32(g2_out + b, g2);
+    vst1q_f32(GL_out + b, vbslq_f32(dl, gll, vglr));
+    vst1q_f32(HL_out + b, vbslq_f32(dl, hll, vhlr));
+    vst1q_u32(dlw, dl);
+    for (int k = 0; k < 4; ++k) dl_out[b + k] = dlw[k] ? 1 : 0;
+  }
+  if (b < bmax) {
+    xtb_split_eval_scalar(glr, hlr, okb, b, bmax, a, g2_out, dl_out, GL_out,
+                          HL_out);
+  }
+}
+#endif  // XTB_SIMD_ARM
+
+inline void xtb_split_eval(const float* glr, const float* hlr,
+                           const uint8_t* okb, int32_t bmax,
+                           const XtbSplitEvalArgs& a, float* g2_out,
+                           uint8_t* dl_out, float* GL_out, float* HL_out) {
+#if XTB_SIMD_X86
+  if (xtb_simd_active() == XTB_SIMD_AVX2) {
+    xtb_split_eval_avx2(glr, hlr, okb, bmax, a, g2_out, dl_out, GL_out,
+                        HL_out);
+    return;
+  }
+#elif XTB_SIMD_ARM
+  if (xtb_simd_active() == XTB_SIMD_NEON) {
+    xtb_split_eval_neon(glr, hlr, okb, bmax, a, g2_out, dl_out, GL_out,
+                        HL_out);
+    return;
+  }
+#endif
+  xtb_split_eval_scalar(glr, hlr, okb, 0, bmax, a, g2_out, dl_out, GL_out,
+                        HL_out);
+}
+
+// ===========================================================================
+// Lane-per-row ensemble traversal (predict kernels).  Eight rows ride the
+// vector lanes through one tree at a time: gathers fetch each lane's node
+// fields, blends pick the child, frozen (leaf-reached) lanes keep their
+// node id.  Per ROW, leaf values still accumulate in tree order — the same
+// f32 add chain as the scalar loop — so outputs are bitwise identical.
+//
+// The raw variant gathers X as exact-width f32.  The binned variant (and
+// the dleft byte array in both) use 32-bit gathers over sub-word elements,
+// which read up to 3 bytes past the addressed element: callers pass
+// `r_vec_end` <= the last row whose gathers stay in-bounds (buffer interior
+// is always safe — the next row's data provides the slack; only the final
+// rows of the whole buffer go scalar), and dleft is copied into a 4-byte
+// padded scratch by the caller.
+// Returns the number of rows consumed from r0 (a multiple of 8); the caller
+// finishes the rest with the scalar loop.
+// ===========================================================================
+
+#if XTB_SIMD_X86
+__attribute__((target("avx2"))) inline int64_t xtb_predict_raw_rows_avx2(
+    const float* X, int64_t r0, int64_t r1, int32_t F, const int32_t* feat,
+    const float* thr, const uint8_t* dleft_pad, const int32_t* left,
+    const int32_t* right, const float* value, const int32_t* groups,
+    int32_t T, int32_t M, int32_t depth, int32_t K, float* out) {
+  const __m256i vzero = _mm256_setzero_si256();
+  const __m256i lane_rows = _mm256_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7);
+  int64_t r = r0;
+  for (; r + 8 <= r1; r += 8) {
+    // per-lane base index into X: (r + lane) * F
+    const __m256i xbase = _mm256_mullo_epi32(
+        _mm256_add_epi32(_mm256_set1_epi32(static_cast<int32_t>(r)),
+                         lane_rows),
+        _mm256_set1_epi32(F));
+    for (int32_t t = 0; t < T; ++t) {
+      const size_t base = static_cast<size_t>(t) * M;
+      const int32_t* featb = feat + base;
+      const float* thrb = thr + base;
+      const uint8_t* dlb = dleft_pad + base;
+      const int32_t* lb = left + base;
+      const int32_t* rb = right + base;
+      __m256i nid = vzero;
+      __m256i done = vzero;
+      for (int32_t d = 0; d < depth; ++d) {
+        const __m256i fi = _mm256_i32gather_epi32(featb, nid, 4);
+        done = _mm256_or_si256(done, _mm256_cmpgt_epi32(vzero, fi));
+        if (_mm256_movemask_epi8(done) == -1) break;
+        const __m256i fi_safe = _mm256_andnot_si256(done, fi);
+        const __m256 x = _mm256_i32gather_ps(
+            X, _mm256_add_epi32(xbase, fi_safe), 4);
+        const __m256 thrv = _mm256_i32gather_ps(thrb, nid, 4);
+        const __m256i dlv = _mm256_and_si256(
+            _mm256_i32gather_epi32(reinterpret_cast<const int*>(dlb), nid, 1),
+            _mm256_set1_epi32(0xFF));
+        const __m256 miss = _mm256_cmp_ps(x, x, _CMP_UNORD_Q);
+        const __m256 lt = _mm256_cmp_ps(x, thrv, _CMP_LT_OQ);
+        const __m256 dlm =
+            _mm256_castsi256_ps(_mm256_cmpgt_epi32(dlv, vzero));
+        const __m256i gol =
+            _mm256_castps_si256(_mm256_blendv_ps(lt, dlm, miss));
+        const __m256i lv = _mm256_i32gather_epi32(lb, nid, 4);
+        const __m256i rv = _mm256_i32gather_epi32(rb, nid, 4);
+        const __m256i nid_next = _mm256_blendv_epi8(rv, lv, gol);
+        nid = _mm256_blendv_epi8(nid_next, nid, done);
+      }
+      const __m256 leaf = _mm256_i32gather_ps(value + base, nid, 4);
+      float lv8[8];
+      _mm256_storeu_ps(lv8, leaf);
+      const int32_t g = groups[t];
+      for (int k = 0; k < 8; ++k) out[(r + k) * K + g] += lv8[k];
+    }
+  }
+  return r - r0;
+}
+
+template <int kSize, int kMask>
+__attribute__((target("avx2"))) inline int64_t xtb_predict_binned_rows_avx2(
+    const void* bins, int64_t r0, int64_t r_vec_end, int32_t F, int32_t n_bin,
+    const int32_t* feat, const int32_t* sbin, const uint8_t* dleft_pad,
+    const int32_t* left, const int32_t* right, const float* value,
+    const int32_t* groups, int32_t T, int32_t M, int32_t depth, int32_t K,
+    float* out) {
+  const __m256i vzero = _mm256_setzero_si256();
+  const __m256i lane_rows = _mm256_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7);
+  const __m256i vnbin = _mm256_set1_epi32(n_bin);
+  int64_t r = r0;
+  for (; r + 8 <= r_vec_end; r += 8) {
+    const __m256i bbase = _mm256_mullo_epi32(
+        _mm256_add_epi32(_mm256_set1_epi32(static_cast<int32_t>(r)),
+                         lane_rows),
+        _mm256_set1_epi32(F * kSize));
+    for (int32_t t = 0; t < T; ++t) {
+      const size_t base = static_cast<size_t>(t) * M;
+      const int32_t* featb = feat + base;
+      const int32_t* sbinb = sbin + base;
+      const uint8_t* dlb = dleft_pad + base;
+      const int32_t* lb = left + base;
+      const int32_t* rb = right + base;
+      __m256i nid = vzero;
+      __m256i done = vzero;
+      for (int32_t d = 0; d < depth; ++d) {
+        const __m256i fi = _mm256_i32gather_epi32(featb, nid, 4);
+        done = _mm256_or_si256(done, _mm256_cmpgt_epi32(vzero, fi));
+        if (_mm256_movemask_epi8(done) == -1) break;
+        const __m256i fi_safe = _mm256_andnot_si256(done, fi);
+        __m256i b = _mm256_i32gather_epi32(
+            reinterpret_cast<const int*>(bins),
+            _mm256_add_epi32(
+                bbase, _mm256_mullo_epi32(fi_safe,
+                                          _mm256_set1_epi32(kSize))),
+            1);
+        if (kMask != -1) b = _mm256_and_si256(b, _mm256_set1_epi32(kMask));
+        const __m256i sbv = _mm256_i32gather_epi32(sbinb, nid, 4);
+        const __m256i dlv = _mm256_and_si256(
+            _mm256_i32gather_epi32(reinterpret_cast<const int*>(dlb), nid, 1),
+            _mm256_set1_epi32(0xFF));
+        // gol = b <= sbin  ==  !(b > sbin)
+        __m256i gol = _mm256_xor_si256(_mm256_cmpgt_epi32(b, sbv),
+                                       _mm256_set1_epi32(-1));
+        const __m256i miss = _mm256_xor_si256(
+            _mm256_cmpgt_epi32(vnbin, b), _mm256_set1_epi32(-1));
+        gol = _mm256_blendv_epi8(gol, _mm256_cmpgt_epi32(dlv, vzero), miss);
+        const __m256i lv = _mm256_i32gather_epi32(lb, nid, 4);
+        const __m256i rv = _mm256_i32gather_epi32(rb, nid, 4);
+        const __m256i nid_next = _mm256_blendv_epi8(rv, lv, gol);
+        nid = _mm256_blendv_epi8(nid_next, nid, done);
+      }
+      const __m256 leaf = _mm256_i32gather_ps(value + base, nid, 4);
+      float lv8[8];
+      _mm256_storeu_ps(lv8, leaf);
+      const int32_t g = groups[t];
+      for (int k = 0; k < 8; ++k) out[(r + k) * K + g] += lv8[k];
+    }
+  }
+  return r - r0;
+}
+#endif  // XTB_SIMD_X86
+
+#endif  // XTB_SIMD_H_

@@ -118,6 +118,39 @@ def _sweep_nthreads():
     return out
 
 
+# simd levels for the lane-width scaling sweep (round 7): each level
+# re-runs the warmed program at nthread=1 — the per-core roofline the SIMD
+# work targets — plus one all-cores vector run to show the SIMD and
+# threading wins COMPOSE.  Results are bitwise level-invariant
+# (docs/native_threading.md), so the sweep times identical outputs.
+# Override with LADDER_SIMD="scalar,auto"; LADDER_SIMD="" disables.
+def _sweep_simd():
+    raw = os.environ.get("LADDER_SIMD", "scalar,auto")
+    levels = [tok.strip() for tok in raw.split(",") if tok.strip()]
+    from xgboost_tpu.utils import native
+
+    for lvl in levels:  # typos fail HERE, not mid-ladder after a config ran
+        native.set_simd(lvl)
+    native.set_simd("auto")
+    return levels
+
+
+# LADDER_REPS=N takes the MINIMUM of N runs per sweep point (default 1).
+# On time-shared bench hosts single-shot walls swing 2-3x with scheduler
+# noise; min-of-N is the standard estimator for the code's actual cost.
+def _reps() -> int:
+    return max(1, int(os.environ.get("LADDER_REPS", "1")))
+
+
+def _timed_min(fn) -> float:
+    best = float("inf")
+    for _ in range(_reps()):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def run_ours(cfg, X, y, group_sizes):
     import xgboost_tpu as xtb
 
@@ -168,17 +201,36 @@ def run_ours(cfg, X, y, group_sizes):
     # same plumbing XGBoosterSetParam("nthread") uses.
     from xgboost_tpu.utils import native
 
+    def train_predict(params):
+        b2 = xtb.train(params, d, cfg["rounds"], verbose_eval=False)
+        np.asarray(b2.predict(d))
+
     scaling = {}
     for n in _sweep_nthreads():
-        t0 = time.perf_counter()
-        b2 = xtb.train({**p, "nthread": n}, d, cfg["rounds"],
-                       verbose_eval=False)
-        np.asarray(b2.predict(d))
+        wall = _timed_min(lambda: train_predict({**p, "nthread": n}))
         scaling[f"nthread={n if n > 0 else 'all'}"] = dict(
-            wall_s=round(time.perf_counter() - t0, 2),
-            effective=native.get_nthread())
-    native.set_nthread(0)  # back to the default for the next config
-    return dt, preds, scaling
+            wall_s=round(wall, 2), effective=native.get_nthread())
+
+    # lane-width sweep over the same warmed cache: simd level is applied
+    # inside the native kernels at execution time, so flipping it re-times
+    # the identical program with different (identical-output) bodies.  The
+    # pool width must ride the params dict like the nthread sweep above —
+    # train() re-applies the params' width, so a bare set_nthread(1) here
+    # would be silently reset to all cores at the first configure.
+    simd_scaling = {}
+    for level in _sweep_simd():
+        eff = native.set_simd(level)
+        wall = _timed_min(lambda: train_predict({**p, "nthread": 1}))
+        simd_scaling[f"{level}@nthread=1"] = dict(
+            wall_s=round(wall, 2), effective=eff)
+    if simd_scaling:
+        native.set_simd("auto")
+        wall = _timed_min(lambda: train_predict({**p, "nthread": 0}))
+        simd_scaling["auto@nthread=all"] = dict(
+            wall_s=round(wall, 2), effective=native.get_simd())
+    native.set_simd("auto")
+    native.set_nthread(0)  # back to the defaults for the next config
+    return dt, preds, scaling, simd_scaling
 
 
 def run_oracle(cfg, X, y, group_sizes):
@@ -231,10 +283,10 @@ def main() -> None:
         R, X, y, groups = make_data(cfg, scale)
         print(f"[{cfg['name']}] rows={R} cols={cfg['cols']} "
               f"rounds={cfg['rounds']} scale={scale}", flush=True)
-        ours_s, ours_pred, scaling = run_ours(cfg, X, y, groups)
+        ours_s, ours_pred, scaling, simd_scaling = run_ours(cfg, X, y, groups)
         ours_q = eval_quality(cfg["metric"], ours_pred, y, groups)
         print(f"  ours:   {ours_s:8.1f}s  {cfg['metric']}={ours_q:.5f}  "
-              f"scaling={scaling}", flush=True)
+              f"scaling={scaling}  simd={simd_scaling}", flush=True)
         try:
             orc_s, orc_pred = run_oracle(cfg, X, y, groups)
             orc_q = eval_quality(cfg["metric"], orc_pred, y, groups)
@@ -268,8 +320,10 @@ def main() -> None:
             objective=cfg["objective"], metric=cfg["metric"],
             platform=platform,
             nthread=_native.get_nthread(), cores=os.cpu_count(),
+            simd=_native.simd_info(), sweep_reps=_reps(),
             ours_wall_s=round(ours_s, 2), ours_quality=round(ours_q, 6),
             nthread_scaling=scaling,
+            simd_scaling=simd_scaling,
             oracle_wall_s=None if orc_s is None else round(orc_s, 2),
             oracle_quality=None if orc_q is None else round(orc_q, 6),
             oracle_source=oracle_source,

@@ -48,6 +48,60 @@ def _packed_hist(packed, gp, pos, *, n_bin):
                             2048, 1)
 
 
+def native_section(rng):
+    """Round-7 re-measurement (docs/bitpack.md): the scalar 2026-07 numbers
+    could not answer what a VECTOR unpack does to the packed-4-bit
+    roofline.  This times the native row-sweep hist kernel (the production
+    CPU path since the FFI revival) on the resident u8 layout vs the
+    packed two-bins-per-byte layout whose nibble unpack is fused into the
+    AVX2 index-prep (native/xtb_simd.h xtb_hist_sweep_p4_avx2), at both
+    simd levels, nthread=1 (the per-core roofline the decision is about).
+    """
+    from xgboost_tpu.utils import native
+
+    lib = native.load_native()
+    if lib is None:
+        return {"native": "unavailable"}
+    out = {"simd": native.simd_info()}
+    native.set_nthread(1)
+    gp = np.ascontiguousarray(rng.normal(size=(R, 2)), np.float32)
+    pos = np.ascontiguousarray(rng.integers(0, N_NODES, size=R), np.int32)
+
+    for B in (256, 16):
+        bins = np.ascontiguousarray(
+            rng.integers(0, B, size=(R, F)), np.uint8)
+        hist = np.empty((N_NODES, F, B, 2), np.float32)
+
+        def u8():
+            lib.xtb_hist_f32_u8(bins.ctypes.data, gp.ctypes.data,
+                                pos.ctypes.data, R, F, B, 0, N_NODES, 1, 2,
+                                hist.ctypes.data)
+
+        for level in ("scalar", "auto"):
+            native.set_simd(level)
+            out[f"native_u8_B{B}_{level}_s"] = round(timed(u8), 5)
+        if B <= 16:
+            packed = np.ascontiguousarray(
+                bins[:, 0::2] | (bins[:, 1::2] << 4))
+            hist_p = np.empty_like(hist)
+
+            def p4():
+                lib.xtb_hist_packed4(packed.ctypes.data, gp.ctypes.data,
+                                     pos.ctypes.data, R, F, B, 0, N_NODES,
+                                     1, hist_p.ctypes.data)
+
+            for level in ("scalar", "auto"):
+                native.set_simd(level)
+                out[f"native_packed4_B{B}_{level}_s"] = round(timed(p4), 5)
+            np.testing.assert_array_equal(hist_p, hist)  # layouts agree
+            vec = out[f"native_u8_B{B}_auto_s"]
+            out[f"native_packed4_B{B}_vector_speedup"] = round(
+                vec / out[f"native_packed4_B{B}_auto_s"], 3)
+    native.set_simd("auto")
+    native.set_nthread(0)
+    return out
+
+
 def main():
     rng = np.random.default_rng(0)
     gp = jnp.asarray(rng.normal(size=(R, 2)).astype(np.float32))
@@ -68,6 +122,8 @@ def main():
             results[f"packed4_B{B}_speedup"] = round(t_u8 / t_p4, 3)
         # HBM-traffic roofline: bins bytes per level vs matmul FLOPs
         results[f"flops_per_bins_byte_B{B}"] = 2 * B * N_NODES * 2
+    if jax.devices()[0].platform == "cpu":
+        results.update(native_section(rng))
     print(json.dumps(results, indent=1))
 
 

@@ -10,11 +10,13 @@ cd "$(dirname "$0")/.."
 bash scripts/lint_gate.sh
 
 # ThreadSanitizer smoke over the native ParallelFor pool + threaded
-# kernels + concurrent dispatch (docs/native_threading.md).  Only a
-# toolchain WITHOUT libtsan skips (probed with a trivial program, so a
-# real compile error in the smoke/kernels cannot masquerade as "no
-# libtsan"); with libtsan present, build failures and TSAN findings both
-# fail the nightly.
+# kernels + concurrent dispatch (docs/native_threading.md).  The smoke
+# binary itself sweeps BOTH simd levels (scalar + best detected ISA,
+# native/xtb_simd.h) through every kernel section, so one run covers the
+# scalar and vector paths under TSAN.  Only a toolchain WITHOUT libtsan
+# skips (probed with a trivial program, so a real compile error in the
+# smoke/kernels cannot masquerade as "no libtsan"); with libtsan present,
+# build failures and TSAN findings both fail the nightly.
 if echo 'int main(){return 0;}' | g++ -x c++ -fsanitize=thread -o /tmp/_tsan_probe - >/dev/null 2>&1; then
     rm -f /tmp/_tsan_probe
     echo "== native TSAN smoke =="

@@ -481,6 +481,46 @@ def test_capi_dispatch_real_tree_contract_holds():
     assert findings == []
 
 
+def _simd_codes(cc_text):
+    from xgboost_tpu.analysis.simd_seam import SimdSeamRule
+
+    return [f.code for f in SimdSeamRule().check_text(cc_text, "x.h")]
+
+
+def test_simd_seam_intrinsics_fire():
+    assert _simd_codes(src("""
+        #include <immintrin.h>
+        void f() { __m256 x = _mm256_setzero_ps(); }
+    """)) == ["XTB601", "XTB601"]
+    assert _simd_codes(src("""
+        float32x4_t a = vaddq_f32(b, c);
+    """)) == ["XTB601"]
+
+
+def test_simd_seam_dispatch_calls_clean():
+    # calls INTO the seam are the sanctioned surface
+    assert _simd_codes(src("""
+        if (vec_row) xtb_hist_sweep_avx2(bins, gpair, pos, R, F, f0, f1);
+        xtb_simd_set(0);
+        int lanes = xtb_simd_lanes_impl(xtb_simd_active());
+    """)) == []
+
+
+def test_simd_seam_real_tree_confined():
+    """Every intrinsic in native/ lives in xtb_simd.h; the seam header
+    itself is exempt (it IS the seam) and must actually contain them."""
+    from xgboost_tpu.analysis.simd_seam import ALLOWED_BASENAME, SimdSeamRule
+
+    rule = SimdSeamRule()
+    nd = os.path.join(REPO, "native")
+    for name in os.listdir(nd):
+        if name.endswith((".cc", ".h", ".c")) and name != ALLOWED_BASENAME:
+            with open(os.path.join(nd, name), encoding="utf-8") as fh:
+                assert rule.check_text(fh.read(), name) == [], name
+    with open(os.path.join(nd, ALLOWED_BASENAME), encoding="utf-8") as fh:
+        assert rule.check_text(fh.read(), ALLOWED_BASENAME)
+
+
 def test_file_level_suppression_mechanism():
     # the mechanism works (and is what the gate forbids in-tree)
     r = lint_source(src("""

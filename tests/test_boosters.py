@@ -46,6 +46,66 @@ def test_gblinear_save_load_roundtrip(tmp_path):
     np.testing.assert_allclose(b2.predict(d), bst.predict(d), rtol=1e-5)
 
 
+def test_gblinear_shotgun_cyclic_matches_coord_descent():
+    """shotgun runs the same CoordinateDelta chain as coord_descent when the
+    selector visits features cyclically (the deterministic equivalence the
+    reference's nthread=1 shotgun also has; updater_shotgun.cc:96)."""
+    X, y = make_regression(600, 6, seed=21)
+    d = xtb.DMatrix(X, label=y)
+
+    def weights(params):
+        bst = xtb.train({"booster": "gblinear",
+                         "objective": "reg:squarederror", "eta": 0.5,
+                         "lambda": 0.1, **params}, d, 8, verbose_eval=False)
+        return bst.linear_weights
+
+    np.testing.assert_array_equal(
+        weights({"updater": "coord_descent"}),
+        weights({"updater": "shotgun", "feature_selector": "cyclic"}))
+
+
+def test_gblinear_shotgun_shuffle_deterministic_and_converges():
+    rng = np.random.default_rng(22)
+    X = rng.normal(size=(800, 6)).astype(np.float32)
+    true_w = np.array([1.0, -2.0, 0.5, 0.0, 3.0, -1.0], np.float32)
+    y = X @ true_w + 0.05 * rng.normal(size=800).astype(np.float32)
+    d = xtb.DMatrix(X, label=y)
+    params = {"booster": "gblinear", "objective": "reg:squarederror",
+              "eta": 0.7, "lambda": 0.01, "updater": "shotgun", "seed": 7}
+
+    def run():  # shotgun defaults to the shuffle selector (reference)
+        bst = xtb.train(params, d, 40, verbose_eval=False)
+        return bst.linear_weights, np.asarray(bst.predict(d))
+
+    (w1, p1), (w2, p2) = run(), run()
+    np.testing.assert_array_equal(w1, w2)  # seeded shuffle: reproducible
+    np.testing.assert_array_equal(p1, p2)
+    np.testing.assert_allclose(w1[:, 0], true_w, atol=0.05)
+    # a different seed visits in a different order -> different f32 chain
+    w3 = xtb.train({**params, "seed": 8}, d, 40,
+                   verbose_eval=False).linear_weights
+    assert not np.array_equal(w1, w3)
+    np.testing.assert_allclose(w3[:, 0], true_w, atol=0.05)
+
+
+def test_gblinear_random_selector_and_validation():
+    X, y = make_regression(300, 5, seed=23)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"booster": "gblinear", "objective": "reg:squarederror",
+                     "updater": "shotgun", "feature_selector": "random",
+                     "seed": 3}, d, 20, verbose_eval=False)
+    assert np.isfinite(bst.linear_weights).all()
+    with pytest.raises(ValueError, match="feature_selector"):
+        xtb.train({"booster": "gblinear", "objective": "reg:squarederror",
+                   "feature_selector": "sideways"}, d, 1, verbose_eval=False)
+    with pytest.raises(NotImplementedError, match="greedy"):
+        xtb.train({"booster": "gblinear", "objective": "reg:squarederror",
+                   "feature_selector": "greedy"}, d, 1, verbose_eval=False)
+    with pytest.raises(ValueError, match="updater"):
+        xtb.train({"booster": "gblinear", "objective": "reg:squarederror",
+                   "updater": "warp_drive"}, d, 1, verbose_eval=False)
+
+
 def test_dart_trains_and_roundtrips(tmp_path):
     X, y = make_binary(500, 6, seed=3)
     d = xtb.DMatrix(X, label=y)

@@ -1,14 +1,16 @@
 """ParallelFor pool determinism + plumbing (native/xtb_kernels.h,
-docs/native_threading.md).
+native/xtb_simd.h, docs/native_threading.md).
 
 The contract under test: every threaded native kernel produces output
-BITWISE IDENTICAL to its sequential (nthread=1) execution, for every
-thread count — fuzzed here for nthread in {1, 2, 8} across histogram
-(f32 + quantised limbs), split scan, predict (raw + binned), the
-quantile sketch, LambdaMART pair gradients, and TreeSHAP.  Plus: the
-nthread param plumbing (params dict -> Context -> pool), the
-`native.parallel_for` fault seam (worker death -> correct results +
-respawn), and the pool telemetry bridge.
+BITWISE IDENTICAL to its sequential (nthread=1) SCALAR execution, for
+every thread count AND every SIMD lane width — fuzzed here over
+{scalar, vector} x nthread {1, 2, 8} across histogram (f32 + quantised
+limbs), split scan, predict (raw + binned), the quantile sketch,
+LambdaMART pair gradients, and TreeSHAP.  Plus: the nthread param
+plumbing (params dict -> Context -> pool), the SIMD level plumbing
+(env/set_simd -> both libraries), the `native.parallel_for` fault seam
+(worker death -> correct results + respawn), and the pool telemetry
+bridge.
 """
 import os
 
@@ -23,27 +25,39 @@ pytestmark = pytest.mark.skipif(not native.load_ffi(),
                                 reason="FFI kernels unavailable")
 
 NTHREADS = (1, 2, 8)
+# the lane-width axis: scalar is the reference; "auto" resolves to the best
+# detected ISA (avx2/neon) and MUST match scalar bitwise.  On hosts without
+# any vector ISA both entries run scalar and the sweep degenerates safely.
+SIMD_LEVELS = ("scalar", "auto")
 
 
 @pytest.fixture(autouse=True)
 def _default_pool_after():
     yield
-    native.set_nthread(0)  # leave the default width for other tests
+    native.set_nthread(0)   # leave the default width for other tests
+    native.set_simd("auto")  # and the default lane width
 
 
 def _per_nthread(fn):
-    """fn() once per pool width; assert later runs bitwise-match the first."""
+    """fn() once per (simd level, pool width); assert every run is
+    bitwise-identical to the scalar nthread=1 reference."""
+    native.set_simd(SIMD_LEVELS[0])
     native.set_nthread(NTHREADS[0])
     ref = fn()
     ref = ref if isinstance(ref, tuple) else (ref,)
-    for n in NTHREADS[1:]:
-        native.set_nthread(n)
-        got = fn()
-        got = got if isinstance(got, tuple) else (got,)
-        for r, g in zip(ref, got):
-            np.testing.assert_array_equal(
-                np.asarray(g), np.asarray(r),
-                err_msg=f"nthread={n} diverged from nthread=1")
+    for simd in SIMD_LEVELS:
+        native.set_simd(simd)
+        for n in NTHREADS:
+            if simd == SIMD_LEVELS[0] and n == NTHREADS[0]:
+                continue  # the reference run
+            native.set_nthread(n)
+            got = fn()
+            got = got if isinstance(got, tuple) else (got,)
+            for r, g in zip(ref, got):
+                np.testing.assert_array_equal(
+                    np.asarray(g), np.asarray(r),
+                    err_msg=(f"simd={simd} nthread={n} diverged from the "
+                             f"scalar nthread=1 reference"))
     return ref
 
 
@@ -82,7 +96,7 @@ def test_split_threaded_bitwise_fuzz():
     rng = np.random.default_rng(2)
     params = SplitParams(eta=0.3, gamma=0.0, min_child_weight=1.0,
                          lambda_=1.0, alpha=0.0, max_delta_step=0.0)
-    for N, F, B in ((64, 5, 33), (3, 12, 17)):
+    for i, (N, F, B) in enumerate(((64, 5, 33), (3, 12, 17))):
         hist = rng.normal(size=(N, F, B, 2)).astype(np.float32)
         hist[..., 1] = np.abs(hist[..., 1])
         n_bins = rng.integers(1, B, size=F).astype(np.int32)
@@ -90,6 +104,13 @@ def test_split_threaded_bitwise_fuzz():
             hist[:, f, n_bins[f]:] = 0.0
         totals = hist.sum(axis=(1, 2)) / max(F, 1)
         totals[..., 1] += 0.5
+        if i == 1:
+            # non-finite gradients upstream: inf prefix sums make
+            # GR = inf - inf = NaN inside the gain eval — scalar and
+            # vector must reject the SAME candidates (the vector body
+            # must not quietly map NaN -> 0; pinned after review)
+            hist[0, 0, 2, 0] = np.inf
+            totals[0, 0] = np.inf
         out = _per_nthread(lambda: (lambda s: (s.gain, s.feature, s.bin,
                                                s.default_left, s.left_sum))(
             evaluate_splits(jnp.asarray(hist), jnp.asarray(totals),
@@ -112,8 +133,9 @@ def test_predict_threaded_bitwise():
 
 
 def test_training_bitwise_nthread_invariant():
-    """End to end: MODELS trained at different pool widths are identical
-    byte for byte (the acceptance bar of the threading PR)."""
+    """End to end: MODELS trained at different pool widths AND lane widths
+    are identical byte for byte (the acceptance bar of the threading PR,
+    extended to the SIMD axis in round 7)."""
     import xgboost_tpu as xtb
 
     rng = np.random.default_rng(4)
@@ -127,10 +149,16 @@ def test_training_bitwise_nthread_invariant():
         return np.frombuffer(bytes(bst.save_raw("ubj")), np.uint8)
 
     raws = {}
-    for n in (1, 2):
-        native.set_nthread(n)
-        raws[n] = train_raw()
-    np.testing.assert_array_equal(raws[1], raws[2])
+    for simd in SIMD_LEVELS:
+        native.set_simd(simd)
+        for n in (1, 2):
+            native.set_nthread(n)
+            raws[(simd, n)] = train_raw()
+    ref_key = (SIMD_LEVELS[0], 1)
+    for key, raw in raws.items():
+        np.testing.assert_array_equal(
+            raw, raws[ref_key],
+            err_msg=f"model bytes at {key} diverged from {ref_key}")
 
 
 def test_sketch_threaded_bitwise():
@@ -229,6 +257,52 @@ def test_dmatrix_nthread_scoped_to_construction():
     assert before == 3
     xtb.DMatrix(X, nthread=1)
     assert native.get_nthread() == 3  # restored, not leaked
+
+
+def test_simd_level_plumbing():
+    """set_simd fans out to every loaded library; "auto" resolves to the
+    detected ISA; forcing scalar always works; simd_info records
+    provenance for the benches."""
+    info = native.simd_info()
+    assert info["detected"] in ("scalar", "avx2", "neon")
+    assert native.set_simd("scalar") == "scalar"
+    assert native.get_simd() == "scalar"
+    eff = native.set_simd("auto")
+    assert eff == info["detected"]
+    assert native.simd_info()["lanes"] >= 1
+    # an unavailable request resolves to the detected best, never errors
+    other = "neon" if info["detected"] != "neon" else "avx2"
+    assert native.set_simd(other) in (other, info["detected"])
+    native.set_simd("auto")
+
+
+def test_ellpack_native_bin_parity(monkeypatch):
+    """The native ingestion kernel (xtb_ellpack_bin) is bitwise-equal to
+    the XLA searchsorted formulation at every dtype, incl. NaN sentinel
+    and top-bin clamp, across simd levels and thread counts."""
+    from xgboost_tpu.data import ellpack
+    from xgboost_tpu.data.quantile import sketch_dense
+
+    rng = np.random.default_rng(12)
+    for R, F, max_bin in ((3000, 7, 256), (1500, 4, 300)):
+        X = rng.normal(size=(R, F)).astype(np.float32)
+        X[rng.random(X.shape) < 0.1] = np.nan
+        X[0, 0] = np.inf  # past-the-last-cut clamp
+        cuts = sketch_dense(X, max_bin=max_bin)
+        # the XLA reference: force the searchsorted formulation
+        with monkeypatch.context() as m:
+            m.setattr(native, "ellpack_bin_native",
+                      lambda *a, **k: None)
+            ref = np.asarray(ellpack.build_ellpack(X, cuts,
+                                                   row_align=256).bins)
+        for simd in SIMD_LEVELS:
+            native.set_simd(simd)
+            for n in (1, 8):
+                native.set_nthread(n)
+                page = ellpack.build_ellpack(X, cuts, row_align=256)
+                np.testing.assert_array_equal(
+                    np.asarray(page.bins), ref,
+                    err_msg=f"simd={simd} nthread={n} vs XLA searchsorted")
 
 
 def test_pool_fault_worker_death_recovers():

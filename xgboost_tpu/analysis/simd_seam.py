@@ -1,0 +1,93 @@
+"""XTB6xx — SIMD intrinsics confinement (the kernel-dispatch seam).
+
+The native kernels are vectorized under a bitwise determinism contract
+(docs/native_threading.md): every intrinsic body has a scalar twin with
+identical per-element semantics, runtime CPU dispatch picks between them,
+and the lane-width fuzz tests pin scalar == vector.  That contract is only
+auditable while ALL raw intrinsics live in the one designated seam header,
+``native/xtb_simd.h`` — an ``_mm256_*`` call sprinkled into a kernel body
+bypasses the scalar fallback, the runtime dispatch, *and* the fuzz axis.
+
+- **XTB601** — a raw SIMD intrinsic, vector type, or intrinsics header
+  include appears in a native C++ file other than ``xtb_simd.h``.
+
+The scan is textual (the C++ sources have no AST here): intrinsic name
+patterns (``_mm*_``/``__m128/256/512``/NEON ``v*q_*`` load-store-arith
+families) and the intrinsics headers (``immintrin.h``, ``arm_neon.h``,
+...).  Calls *into* the seam (``xtb_simd_*``, ``xtb_hist_sweep_avx2``)
+are the sanctioned surface and do not match.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterable, List
+
+from .core import Finding, Project, Rule
+
+# the one file allowed to contain intrinsics
+ALLOWED_BASENAME = "xtb_simd.h"
+
+_PATTERNS = (
+    # x86: _mm_*, _mm256_*, _mm512_* intrinsic calls and vector types
+    re.compile(r"\b_mm\d*_\w+\s*\("),
+    re.compile(r"\b__m(?:128|256|512)[id]?\b"),
+    # NEON: vector types and the common intrinsic families
+    re.compile(r"\b(?:float|int|uint)(?:8|16|32|64)x\d+(?:x\d+)?_t\b"),
+    re.compile(r"\bv(?:ld|st)\d\w*_\w+\s*\("),
+    re.compile(r"\bv(?:add|sub|mul|div|max|min|abs|bsl|and|orr|mvn|cge|cgt|"
+               r"dup|reinterpret)q?\w*_\w+\s*\("),
+    # the headers themselves
+    re.compile(r"#\s*include\s*[<\"](?:immintrin|x86intrin|emmintrin|"
+               r"smmintrin|tmmintrin|avxintrin|avx2intrin|arm_neon|arm_sve)"
+               r"\.h[>\"]"),
+)
+
+_NATIVE_EXTS = (".cc", ".cpp", ".cxx", ".h", ".hpp", ".c")
+
+
+class SimdSeamRule(Rule):
+    """XTB601 — raw intrinsics outside native/xtb_simd.h."""
+
+    name = "simd-seam"
+    codes = {
+        "XTB601": "raw SIMD intrinsics outside the dispatch seam "
+                  "(native/xtb_simd.h)",
+    }
+
+    def native_dir(self, project: Project) -> str:
+        if not project.docs_root:
+            return ""
+        return os.path.join(os.path.dirname(project.docs_root), "native")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        nd = self.native_dir(project)
+        if not nd or not os.path.isdir(nd):
+            return ()  # subtree lint / snippet mode: nothing to check
+        findings: List[Finding] = []
+        for name in sorted(os.listdir(nd)):
+            if not name.endswith(_NATIVE_EXTS) or name == ALLOWED_BASENAME:
+                continue
+            path = os.path.join(nd, name)
+            try:
+                with open(path, encoding="utf-8", errors="replace") as fh:
+                    text = fh.read()
+            except OSError:
+                continue
+            findings.extend(self.check_text(text, path))
+        return findings
+
+    def check_text(self, text: str, path: str) -> List[Finding]:
+        findings: List[Finding] = []
+        for i, line in enumerate(text.splitlines(), start=1):
+            for pat in _PATTERNS:
+                m = pat.search(line)
+                if m:
+                    findings.append(Finding(
+                        path, i, m.start(), "XTB601",
+                        f"raw SIMD token {m.group(0).strip()!r} outside "
+                        f"native/{ALLOWED_BASENAME}; vector bodies belong "
+                        f"in the dispatch seam with a scalar twin "
+                        f"(docs/native_threading.md)"))
+                    break  # one finding per line is enough
+        return findings

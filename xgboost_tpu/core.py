@@ -614,14 +614,28 @@ class Booster:
             cache.raw_X = jnp.asarray(self._host_dense_recoded(cache.dmat), jnp.float32)
         Xz = jnp.nan_to_num(cache.raw_X, nan=0.0)
         updater = str(self.params.get("updater", "coord_descent"))
+        if updater not in ("coord_descent", "shotgun"):
+            raise ValueError(
+                f"unknown gblinear updater {updater!r}; expected "
+                "'coord_descent' or 'shotgun'")
+        # reference defaults (coordinate_common.h): shotgun shuffles its
+        # visit order every round, coord_descent walks features cyclically
+        from .models.gblinear import selector_order
+
+        selector = str(self.params.get(
+            "feature_selector",
+            "shuffle" if updater == "shotgun" else "cyclic"))
+        order = jnp.asarray(selector_order(
+            selector, F, getattr(self, "_linear_rounds", 0),
+            int(self.params.get("seed", 0))))
         W = jnp.asarray(self.linear_weights)
         b = jnp.asarray(self.linear_bias)
         R = cache.dmat.num_row()
         for k in range(K):
             wk, bk = linear_update(
-                Xz, gpair[:R, k, :], W[:, k], b[k],
+                Xz, gpair[:R, k, :], W[:, k], b[k], order,
                 eta=float(self.tparam.eta), lambda_=float(self.tparam.lambda_),
-                alpha=float(self.tparam.alpha), updater=updater,
+                alpha=float(self.tparam.alpha),
             )
             W = W.at[:, k].set(wk)
             b = b.at[k].set(bk)

@@ -91,6 +91,25 @@ def build_ellpack(
     n_bins = jnp.asarray(cuts.n_bins_array())  # (F,)
     dtype = _bin_dtype(B + 1)
 
+    # native ingestion fast path (CPU backend): the threaded row-sharded
+    # binning kernel streams X once, row-major, and writes the page
+    # sequentially — bitwise-equal to the XLA searchsorted formulation
+    # below (upper_bound + top-bin clamp + NaN sentinel), pinned by
+    # tests/test_native_threads.py::test_ellpack_native_bin_parity
+    if jax.default_backend() == "cpu":
+        from ..utils import native as _native
+
+        binned = _native.ellpack_bin_native(
+            np.asarray(X, np.float32), cuts.cut_values, cuts.cut_ptrs, B,
+            np.dtype(dtype))
+        if binned is not None:
+            bins = jnp.asarray(binned)
+            if R_pad != R:
+                pad = jnp.full((R_pad - R, F), B, dtype=dtype)
+                bins = jnp.concatenate([bins, pad], axis=0)
+            return EllpackPage(bins=bins, cuts_pad=cuts_pad, n_bins=n_bins,
+                               n_rows=R, cuts=cuts)
+
     Xd = jnp.asarray(X, dtype=jnp.float32)
 
     @jax.jit

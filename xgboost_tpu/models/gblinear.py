@@ -2,19 +2,36 @@
 
 Reference: src/gbm/gblinear.cc + src/linear/ (coordinate descent
 updater_coordinate.cc:100, parallel 'shotgun' updater_shotgun.cc:96, GPU
-updater_gpu_coordinate.cu:247).  The TPU-native updater is the shotgun shape —
-all coordinates updated from one pair of MXU matmuls per round:
+updater_gpu_coordinate.cu:247) with the feature-selector family from
+src/linear/coordinate_common.h (cyclic / shuffle / random selectors).
 
-    num_j   = sum_r g_r x_rj           (X^T g)
-    denom_j = sum_r h_r x_rj^2         (X^T diag(h) X, diagonal only)
-    dw_j    = -soft_threshold(num_j + lambda w_j, alpha) / (denom_j + lambda)
+Two updaters:
 
-which is the reference's CoordinateDelta applied to every feature at the
-current round's gradients (parallel coordinate descent).  Fully-parallel
-updates can overshoot on correlated features, so ``coord_descent`` (cyclic,
-gradients refreshed after every coordinate via lax.scan — bitwise the
-reference semantics) is the default; ``shotgun`` applies a 1/sqrt(F) damping
-to stay stable.
+``coord_descent``
+    Coordinate descent: every feature updated with the gradient refreshed
+    after each coordinate via ``lax.scan`` — bitwise the reference
+    semantics.  (Default, as in the reference.)  Its default selector is
+    ``cyclic`` (index order), but like the reference it honors any
+    implemented ``feature_selector``.
+
+``shotgun``
+    The reference's shotgun updater runs the same CoordinateDelta updates
+    feature-parallel over OpenMP *without locks* — its output is racy and
+    run-dependent by design (Bradley et al., the "shotgun" paper).  Under
+    this repo's bitwise determinism contract we implement its
+    deterministic equivalent: the identical update sequence in the
+    selector-chosen feature order with per-coordinate gradient refresh —
+    exactly the reference's shotgun at ``nthread=1``, reproducible at any
+    thread count.  The ``feature_selector`` param picks the order:
+
+    - ``cyclic``  : 0, 1, ..., F-1 (shotgun output == coord_descent);
+    - ``shuffle`` : a fresh deterministic permutation every round (the
+      reference's shotgun default), seeded by ``seed`` + round index;
+    - ``random``  : sample F coordinates WITH replacement per round
+      (coordinate_common.h RandomFeatureSelector).
+
+    ``greedy``/``thrifty`` (coordinate_common.h) remain unimplemented and
+    raise — they need the per-coordinate gain ranking, a different shape.
 
 Missing values are zeros for the linear model, matching the reference (only
 stored sparse entries contribute).
@@ -26,19 +43,51 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
+
+SELECTORS = ("cyclic", "shuffle", "random", "greedy", "thrifty")
 
 
 def _soft_threshold(x, alpha):
     return jnp.sign(x) * jnp.maximum(jnp.abs(x) - alpha, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("updater",))
-def linear_update(X, gpair, weights, bias, *, eta: float, lambda_: float,
-                  alpha: float, updater: str = "shotgun"):
+def selector_order(selector: str, n_features: int, round_idx: int,
+                   seed: int) -> np.ndarray:
+    """The coordinate visit order for one boosting round (host-side,
+    deterministic): the linear-updater analogue of coordinate_common.h's
+    FeatureSelector::NextFeature loop.  Same (selector, seed, round) ->
+    same order on every host, so trained models stay bitwise-reproducible.
+    """
+    if selector not in SELECTORS:
+        raise ValueError(
+            f"unknown feature_selector {selector!r}; expected one of "
+            f"{SELECTORS}")
+    if selector in ("greedy", "thrifty"):
+        raise NotImplementedError(
+            f"feature_selector={selector!r} is not implemented; use "
+            "cyclic, shuffle, or random")
+    if selector == "cyclic":
+        return np.arange(n_features, dtype=np.int32)
+    rng = np.random.default_rng(
+        np.random.SeedSequence([seed & 0x7FFFFFFF, round_idx]))
+    if selector == "shuffle":
+        return rng.permutation(n_features).astype(np.int32)
+    return rng.integers(0, n_features, size=n_features).astype(np.int32)
+
+
+@jax.jit
+def linear_update(X, gpair, weights, bias, order, *, eta: float,
+                  lambda_: float, alpha: float):
     """One boosting round of the linear model for one output group.
 
-    X : (R, F) f32 with NaN already zeroed; gpair (R, 2); weights (F,), bias ().
+    X : (R, F) f32 with NaN already zeroed; gpair (R, 2); weights (F,);
+    bias (); order (F,) int32 — the coordinate visit order.  Both updaters
+    run this one CoordinateDelta chain (the reference's selectors apply to
+    coord_descent too, coordinate_common.h) — the updater param only picks
+    the default selector; with the defaults (cyclic) order is 0..F-1 and
+    the chain is bitwise the pre-selector behaviour.
     Returns (new_weights, new_bias).
     """
     g, h = gpair[:, 0], gpair[:, 1]
@@ -46,23 +95,16 @@ def linear_update(X, gpair, weights, bias, *, eta: float, lambda_: float,
     db = -jnp.sum(g) / jnp.maximum(jnp.sum(h), 1e-6) * eta
     g = g + h * db  # refresh gradients for the bias shift
 
-    if updater == "coord_descent":
-        def body(carry, j):
-            w, g = carry
-            xj = X[:, j]
-            num = jnp.dot(xj, g) + lambda_ * w[j]
-            den = jnp.dot(xj * xj, h) + lambda_
-            dw = -_soft_threshold(num, alpha) / den * eta
-            g = g + h * xj * dw
-            return (w.at[j].add(dw), g), None
+    def body(carry, j):
+        w, g = carry
+        xj = X[:, j]
+        num = jnp.dot(xj, g) + lambda_ * w[j]
+        den = jnp.dot(xj * xj, h) + lambda_
+        dw = -_soft_threshold(num, alpha) / den * eta
+        g = g + h * xj * dw
+        return (w.at[j].add(dw), g), None
 
-        (w_new, _), _ = lax.scan(body, (weights, g), jnp.arange(X.shape[1]))
-    else:  # shotgun: all coordinates in parallel (two MXU reductions)
-        num = X.T @ g + lambda_ * weights
-        den = (X * X).T @ h + lambda_
-        damp = 1.0 / jnp.sqrt(jnp.float32(X.shape[1]))
-        dw = -_soft_threshold(num, alpha) / den * eta * damp
-        w_new = weights + dw
+    (w_new, _), _ = lax.scan(body, (weights, g), order)
     return w_new, bias + db
 
 

@@ -47,10 +47,12 @@ def load_native() -> Optional[ctypes.CDLL]:
         return _LIB
     _TRIED = True
     so = os.path.join(_native_dir(), "libxtb_native.so")
-    src = os.path.join(_native_dir(), "xtb_native.cc")
+    srcs = [os.path.join(_native_dir(), n)
+            for n in ("xtb_native.cc", "xtb_kernels.h", "xtb_simd.h")]
     stale = (not os.path.exists(so)
-             or (os.path.exists(src)
-                 and os.path.getmtime(src) > os.path.getmtime(so)))
+             or any(os.path.exists(s)
+                    and os.path.getmtime(s) > os.path.getmtime(so)
+                    for s in srcs))
     if stale:
         try:
             subprocess.run(["make", "-C", _native_dir()], capture_output=True,
@@ -93,10 +95,23 @@ def load_native() -> Optional[ctypes.CDLL]:
                                     c.c_void_p, c.c_void_p, c.c_void_p,
                                     c.c_void_p, c.c_void_p, c.c_void_p,
                                     c.c_void_p, c.c_int32, c.c_void_p]
+    lib.xtb_ellpack_bin.argtypes = [c.c_void_p, c.c_int64, c.c_int32,
+                                    c.c_void_p, c.c_void_p, c.c_int32,
+                                    c.c_int32, c.c_void_p]
+    lib.xtb_hist_f32_u8.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                    c.c_int64, c.c_int32, c.c_int32,
+                                    c.c_int32, c.c_int32, c.c_int32,
+                                    c.c_int32, c.c_void_p]
+    lib.xtb_hist_packed4.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                     c.c_int64, c.c_int32, c.c_int32,
+                                     c.c_int32, c.c_int32, c.c_int32,
+                                     c.c_void_p]
     _bind_pool_abi(lib)
     _LIB = lib
     if _NTHREAD is not None:  # pool configured before this lib loaded
         lib.xtb_set_nthread(_NTHREAD)
+    if _SIMD is not None:  # simd level pinned before this lib loaded
+        lib.xtb_simd_set(_SIMD)
     return lib
 
 
@@ -128,6 +143,13 @@ def _bind_pool_abi(lib) -> None:
     lib.xtb_pool_kernel_name.argtypes = [c.c_int]
     lib.xtb_pool_kernel_stats.argtypes = [c.c_int, c.c_void_p]
     lib.xtb_pool_instance_id.restype = c.c_uint64
+    lib.xtb_simd_set.restype = c.c_int
+    lib.xtb_simd_set.argtypes = [c.c_int]
+    lib.xtb_simd_get.restype = c.c_int
+    lib.xtb_simd_detected.restype = c.c_int
+    lib.xtb_simd_lanes.restype = c.c_int
+    lib.xtb_simd_name.restype = c.c_char_p
+    lib.xtb_simd_name.argtypes = [c.c_int]
 
 
 def _pool_libs() -> list:
@@ -195,6 +217,78 @@ def ensure_pool() -> None:
     default pool width once before the first native kernel runs."""
     if _NTHREAD is None:
         set_nthread(0)
+
+
+# --------------------------------------------------------------------------
+# SIMD level control (native/xtb_simd.h).  Kernel output is bitwise
+# level-INDEPENDENT (the lane-width axis of the determinism contract,
+# fuzzed by tests/test_native_threads.py), so flipping this only selects
+# which identical-output body runs.  Initial level: XGBOOST_TPU_SIMD env
+# (scalar|avx2|neon|auto), else the best ISA cpuid reports.
+# --------------------------------------------------------------------------
+
+_SIMD: Optional[int] = None  # last applied level (C-side enum), None = auto
+_SIMD_LEVELS = {"auto": -1, "scalar": 0, "avx2": 1, "neon": 2}
+
+
+def set_simd(level="auto") -> str:
+    """Set the active SIMD level on every loaded kernel library.
+
+    ``level``: "auto" (best detected), "scalar", "avx2", "neon", or the
+    C-side integer.  A level this HOST cannot run (e.g. "neon" on x86)
+    resolves to the detected best; an unknown NAME raises — typos should
+    be loud, not silently benchmark the wrong thing.  Returns the
+    effective level name.
+    """
+    global _SIMD
+    if not isinstance(level, int):
+        key = str(level).lower()
+        if key not in _SIMD_LEVELS:
+            raise ValueError(
+                f"unknown SIMD level {level!r}; expected one of "
+                f"{sorted(_SIMD_LEVELS)}")
+        lvl = _SIMD_LEVELS[key]
+    else:
+        lvl = int(level)
+    eff = lvl
+    for lib in _pool_libs():
+        eff = int(lib.xtb_simd_set(lvl))
+    _SIMD = eff if eff >= 0 else None
+    return get_simd()
+
+
+def get_simd() -> str:
+    """The active SIMD level name on the loaded libraries ("scalar" when no
+    native library is available — the pure-Python fallbacks are scalar)."""
+    for lib in _pool_libs():
+        return lib.xtb_simd_name(lib.xtb_simd_get()).decode()
+    return "scalar"
+
+
+def simd_info() -> dict:
+    """Provenance record for benches (BENCH_LADDER.json metadata): active
+    and detected ISA, lane width, and the raw CPU flags the detection saw."""
+    info = {"active": "scalar", "detected": "scalar", "lanes": 1,
+            "env": os.environ.get("XGBOOST_TPU_SIMD") or None}
+    for lib in _pool_libs():
+        info["active"] = lib.xtb_simd_name(lib.xtb_simd_get()).decode()
+        info["detected"] = lib.xtb_simd_name(lib.xtb_simd_detected()).decode()
+        info["lanes"] = int(lib.xtb_simd_lanes())
+        break
+    flags = []
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    present = set(line.split(":", 1)[1].split())
+                    flags = sorted(present & {"avx", "avx2", "avx512f",
+                                              "fma", "sse4_2", "asimd",
+                                              "neon", "sve"})
+                    break
+    except OSError:  # pragma: no cover - non-procfs hosts
+        pass
+    info["cpu_flags"] = flags
+    return info
 
 
 def _pool_fault_probe() -> None:
@@ -268,7 +362,8 @@ def load_ffi() -> bool:
     _FFI_READY = False
     nd = _native_dir()
     so = os.path.join(nd, "libxtb_ffi.so")
-    srcs = [os.path.join(nd, n) for n in ("xtb_ffi.cc", "xtb_kernels.h")]
+    srcs = [os.path.join(nd, n)
+            for n in ("xtb_ffi.cc", "xtb_kernels.h", "xtb_simd.h")]
     try:
         stale = (not os.path.exists(so)
                  or any(os.path.exists(s)
@@ -303,6 +398,8 @@ def load_ffi() -> bool:
         _FFI_LIB = lib
         if _NTHREAD is not None:  # pool configured before this lib loaded
             lib.xtb_set_nthread(_NTHREAD)
+        if _SIMD is not None:
+            lib.xtb_simd_set(_SIMD)
         _FFI_READY = True
     except Exception:
         _FFI_READY = False
@@ -400,6 +497,36 @@ def parse_csv(path: str, skip_header: Optional[bool] = None) -> np.ndarray:
             lib.xtb_dense_free(h)
     return np.genfromtxt(path, delimiter=",", dtype=np.float32,
                          skip_header=int(skip_header))
+
+
+_ELLPACK_DTYPE_CODES = {np.dtype(np.uint8): 0, np.dtype(np.int16): 1,
+                        np.dtype(np.int32): 2}
+
+
+def ellpack_bin_native(X: np.ndarray, cut_values: np.ndarray,
+                       cut_ptrs: np.ndarray, n_bin_pad: int,
+                       dtype) -> Optional[np.ndarray]:
+    """Native Ellpack binning (xtb_kernels.h xtb_ellpack_bin_impl): bin a
+    dense (R, F) f32 matrix against per-feature cuts, bitwise-equal to the
+    XLA searchsorted path in data/ellpack.py (upper_bound, clamp into the
+    top bin, NaN -> sentinel ``n_bin_pad``).  Streams X row-major once and
+    writes the page sequentially through the threaded row-sharded kernel.
+    Returns None when the native library is unavailable."""
+    lib = load_native()
+    if lib is None:
+        return None
+    code = _ELLPACK_DTYPE_CODES.get(np.dtype(dtype))
+    if code is None:
+        return None
+    R, F = X.shape
+    Xc = np.ascontiguousarray(X, np.float32)
+    cv = np.ascontiguousarray(cut_values, np.float32)
+    cp = np.ascontiguousarray(cut_ptrs, np.int32)
+    out = np.empty((R, F), np.dtype(dtype))
+    ensure_pool()
+    lib.xtb_ellpack_bin(Xc.ctypes.data, R, F, cv.ctypes.data, cp.ctypes.data,
+                        int(n_bin_pad), code, out.ctypes.data)
+    return out
 
 
 def shap_values_native(t: dict, X: np.ndarray,
