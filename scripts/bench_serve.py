@@ -1,4 +1,4 @@
-"""Serving-engine latency/throughput benchmark -> BENCH_SERVE.json.
+"""Serving latency/throughput benchmark -> BENCH_SERVE.json.
 
 Measures the ISSUE-1 acceptance numbers on the CPU backend: p50/p99
 request latency and rows/s at batch sizes {1, 64, 4096} through the
@@ -10,15 +10,35 @@ batch-1 traffic through the micro-batcher — whose engine metrics snapshot
 timed loop is a serving regression, and the suite's smoke test
 (tests/test_serving.py) fails on the same gauge.
 
+Two fleet sections (ISSUE-8, docs/serving.md "Fleet"):
+
+- ``fleet_coldstart`` — replica warm-work seconds against a cold vs a
+  warm persistent compile cache (cold gets a FRESH cache dir every rep;
+  warm reuses the dir the cold rep just populated — a within-run pair).
+- ``fleet_saturation`` — sustained throughput + p99 under mixed
+  two-model closed-loop traffic at fleet sizes {1, 2, 4}, all sizes
+  measured in this run (the fleet-of-1 row IS the baseline pair).
+
+Host-noise convention (the ladder's): this host is time-shared, so walls
+swing run to run; every timed section repeats ``BENCH_SERVE_REPS`` times
+and reports the MINIMUM wall (min-of-N estimates the code's actual cost;
+the mean estimates the host's load average), latency percentiles taken
+from the min-wall rep.  The ``reps`` field records N.
+
 Usage:  python scripts/bench_serve.py [out.json]   (default BENCH_SERVE.json)
 Knobs:  BENCH_SERVE_ROUNDS / _DEPTH / _FEATURES for model size,
-        BENCH_SERVE_ITERS to scale the timed loops.
+        BENCH_SERVE_ITERS to scale the timed loops,
+        BENCH_SERVE_REPS for min-of-N (default 3),
+        BENCH_SERVE_FLEET=0 to skip the (multi-process, slower) fleet
+        sections.
 """
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import sys
+import tempfile
 import threading
 import time
 
@@ -29,51 +49,73 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 BATCH_SIZES = (1, 64, 4096)
 ITERS = {1: 400, 64: 200, 4096: 30}
+FLEET_SIZES = (1, 2, 4)
+FLEET_BATCH = 512       # rows per fleet request
+FLEET_CLIENTS = 8       # closed-loop client threads
+FLEET_REQS_PER_CLIENT = 40
 
 
-def train_model(rounds: int, depth: int, features: int):
+def _reps() -> int:
+    return max(1, int(os.environ.get("BENCH_SERVE_REPS", "3")))
+
+
+def train_model(rounds: int, depth: int, features: int,
+                objective: str = "binary:logistic", num_class: int = 0):
     import xgboost_tpu as xtb
 
     rng = np.random.default_rng(0)
     X = rng.normal(size=(20_000, features)).astype(np.float32)
-    y = (X[:, 0] + 0.5 * X[:, 1] - 0.25 * X[:, 2] > 0).astype(np.float32)
-    bst = xtb.train({"objective": "binary:logistic", "max_depth": depth,
-                     "max_bin": 256}, xtb.DMatrix(X, label=y), rounds,
-                    verbose_eval=False)
+    margin = X[:, 0] + 0.5 * X[:, 1] - 0.25 * X[:, 2]
+    params = {"objective": objective}
+    if num_class:
+        y = np.digitize(margin, np.linspace(-1.5, 1.5, num_class - 1)
+                        ).astype(np.float32)
+        params["num_class"] = num_class
+    elif objective.startswith("reg:"):
+        y = margin.astype(np.float32)
+    else:
+        y = (margin > 0).astype(np.float32)
+    bst = xtb.train({**params, "max_depth": depth, "max_bin": 256},
+                    xtb.DMatrix(X, label=y), rounds, verbose_eval=False)
     return bst, X
 
 
 def bench_direct(eng, X, batch: int, iters: int) -> dict:
-    """Per-request latency through the pre-compiled direct path."""
+    """Per-request latency through the pre-compiled direct path
+    (min-of-N walls; percentiles from the min-wall rep)."""
     rng = np.random.default_rng(batch)
     rows = [X[rng.integers(0, len(X) - batch + 1)
               or 0:][:batch] for _ in range(8)]
     for r in rows[:2]:  # shape warm-up (bucket already compiled by warmup())
         eng.predict("bench", r, direct=True)
-    lat = np.empty(iters)
-    t_all0 = time.perf_counter()
-    for i in range(iters):
-        t0 = time.perf_counter()
-        eng.predict("bench", rows[i % len(rows)], direct=True)
-        lat[i] = time.perf_counter() - t0
-    wall = time.perf_counter() - t_all0
-    p50, p99 = np.percentile(lat, [50, 99])
+    best_wall, best_lat = None, None
+    for _ in range(_reps()):
+        lat = np.empty(iters)
+        t_all0 = time.perf_counter()
+        for i in range(iters):
+            t0 = time.perf_counter()
+            eng.predict("bench", rows[i % len(rows)], direct=True)
+            lat[i] = time.perf_counter() - t0
+        wall = time.perf_counter() - t_all0
+        if best_wall is None or wall < best_wall:
+            best_wall, best_lat = wall, lat
+    p50, p99 = np.percentile(best_lat, [50, 99])
     return {
         "batch": batch,
         "iters": iters,
+        "reps": _reps(),
         "p50_ms": round(float(p50) * 1e3, 4),
         "p99_ms": round(float(p99) * 1e3, 4),
-        "rows_per_s": round(batch * iters / wall, 1),
+        "rows_per_s": round(batch * iters / best_wall, 1),
     }
 
 
 def bench_concurrent(eng, X, n_threads: int = 4, per_thread: int = 100):
     """Batch-1 traffic from N threads through the micro-batcher: the
-    coalescing path the engine exists for."""
-    barrier = threading.Barrier(n_threads)
+    coalescing path the engine exists for (min-of-N walls)."""
     errors = []
 
-    def worker(tid):
+    def worker(tid, barrier):
         rng = np.random.default_rng(tid)
         try:
             barrier.wait(30)
@@ -82,23 +124,155 @@ def bench_concurrent(eng, X, n_threads: int = 4, per_thread: int = 100):
         except BaseException as e:  # pragma: no cover
             errors.append(repr(e))
 
-    threads = [threading.Thread(target=worker, args=(t,))
-               for t in range(n_threads)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(600)
-    wall = time.perf_counter() - t0
+    best_wall = None
+    for _ in range(_reps()):
+        barrier = threading.Barrier(n_threads)
+        threads = [threading.Thread(target=worker, args=(t, barrier))
+                   for t in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        wall = time.perf_counter() - t0
+        best_wall = wall if best_wall is None else min(best_wall, wall)
     snap = eng.metrics_snapshot()
     return {
         "threads": n_threads,
         "requests": n_threads * per_thread,
-        "wall_s": round(wall, 3),
-        "requests_per_s": round(n_threads * per_thread / wall, 1),
+        "reps": _reps(),
+        "wall_s": round(best_wall, 3),
+        "requests_per_s": round(n_threads * per_thread / best_wall, 1),
         "errors": errors,
         "engine_metrics": snap,
     }
+
+
+# ---------------------------------------------------------------- fleet
+def bench_fleet_coldstart(model_paths: dict, workdir: str) -> dict:
+    """Replica warm-work seconds, cold vs warm persistent compile cache.
+
+    The replica warms its DEFAULT bucket ladder (8..4096) for every
+    model — the production configuration, where the AOT file covers
+    every admission-policy bucket.  Within-run pairing: each rep starts
+    a 1-replica fleet against a FRESH cache dir (cold: every program
+    compiles) and then again against the dir that start just populated
+    (warm: every program deserializes).  min-of-N on each side; the
+    acceptance ratio compares the two minima.
+    """
+    from xgboost_tpu.serving import ServingFleet
+
+    cold_s, warm_s = [], []
+    info_cold = info_warm = None
+    for rep in range(_reps()):
+        cache = os.path.join(workdir, f"coldstart_cache_{rep}")
+        for side, sink in (("cold", cold_s), ("warm", warm_s)):
+            with ServingFleet(model_paths, n_replicas=1,
+                              cache_dir=cache) as fleet:
+                info = fleet.replica_info()[0]
+            assert info["cache_state"] == side, (
+                f"rep {rep}: expected a {side} cache, got "
+                f"{info['cache_state']} (hits={info['aot_hits']} "
+                f"compiled={info['aot_compiled']})")
+            sink.append(float(info["warmup_s"]))
+            if side == "cold":
+                info_cold = info
+            else:
+                info_warm = info
+    cold, warm = min(cold_s), min(warm_s)
+    return {
+        "reps": _reps(),
+        "warmup_buckets": "default ladder (8..4096)",
+        "models": len(model_paths),
+        "programs": int(info_cold["aot_compiled"]),
+        "cold_warmup_s": round(cold, 4),
+        "warm_warmup_s": round(warm, 4),
+        "speedup": round(cold / warm, 1),
+        "pair_speedups": [round(c / w, 1) for c, w in zip(cold_s, warm_s)],
+        "cold_info": {k: info_cold[k] for k in
+                      ("aot_hits", "aot_compiled", "bringup_s")},
+        "warm_info": {k: info_warm[k] for k in
+                      ("aot_hits", "aot_compiled", "bringup_s")},
+    }
+
+
+def _fleet_load(fleet, Xa, Xb) -> dict:
+    """One closed-loop mixed two-model load: FLEET_CLIENTS threads, each
+    alternating models request by request.  Returns wall + latencies."""
+    lats = [None] * FLEET_CLIENTS
+    errors = []
+    barrier = threading.Barrier(FLEET_CLIENTS)
+
+    def client(tid):
+        lat = np.empty(FLEET_REQS_PER_CLIENT)
+        try:
+            barrier.wait(60)
+            for i in range(FLEET_REQS_PER_CLIENT):
+                model, X = (("a", Xa) if (tid + i) % 2 == 0
+                            else ("b", Xb))
+                t0 = time.perf_counter()
+                fleet.predict(model, X, timeout=600)
+                lat[i] = time.perf_counter() - t0
+            lats[tid] = lat
+        except BaseException as e:  # pragma: no cover
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(FLEET_CLIENTS)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(900)
+    wall = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError(f"fleet load errors: {errors[:3]}")
+    return {"wall": wall, "lat": np.concatenate(lats)}
+
+
+def bench_fleet_saturation(model_paths: dict, workdir: str,
+                           features: int) -> list:
+    """Sustained mixed-traffic throughput + p99 at fleet sizes 1/2/4.
+
+    All sizes run in THIS invocation (within-run pairs: the size-1 row is
+    the baseline the fleet-of-4 acceptance ratio divides by); per size,
+    min-of-N walls with percentiles from the min-wall rep.  The shared
+    warm cache keeps what's measured at steady state, not compile time.
+    """
+    from xgboost_tpu.serving import ServingFleet
+
+    cache = os.path.join(workdir, "saturation_cache")
+    rng = np.random.default_rng(7)
+    Xa = rng.normal(size=(FLEET_BATCH, features)).astype(np.float32)
+    Xb = rng.normal(size=(FLEET_BATCH, features)).astype(np.float32)
+    rows = []
+    n_requests = FLEET_CLIENTS * FLEET_REQS_PER_CLIENT
+    for n in FLEET_SIZES:
+        with ServingFleet(model_paths, n_replicas=n, cache_dir=cache,
+                          warmup_buckets=(FLEET_BATCH,)) as fleet:
+            _fleet_load(fleet, Xa, Xb)  # steady-state warm pass, untimed
+            best = None
+            for _ in range(_reps()):
+                r = _fleet_load(fleet, Xa, Xb)
+                if best is None or r["wall"] < best["wall"]:
+                    best = r
+        p50, p99 = np.percentile(best["lat"], [50, 99])
+        row = {
+            "n_replicas": n,
+            "clients": FLEET_CLIENTS,
+            "requests": n_requests,
+            "batch": FLEET_BATCH,
+            "reps": _reps(),
+            "wall_s": round(best["wall"], 3),
+            "requests_per_s": round(n_requests / best["wall"], 1),
+            "rows_per_s": round(n_requests * FLEET_BATCH / best["wall"], 1),
+            "p50_ms": round(float(p50) * 1e3, 3),
+            "p99_ms": round(float(p99) * 1e3, 3),
+        }
+        rows.append(row)
+        print(f"fleet n={n}  rows/s={row['rows_per_s']:.0f}  "
+              f"p50={row['p50_ms']:.1f}ms  p99={row['p99_ms']:.1f}ms")
+    return rows
 
 
 def main(out_path: str) -> int:
@@ -116,6 +290,8 @@ def main(out_path: str) -> int:
         "bench": "serving_engine",
         "platform": jax.default_backend(),
         "generated_unix": int(time.time()),
+        "reps": _reps(),
+        "host_cores": os.cpu_count(),
         "model": {"rounds": rounds, "max_depth": depth, "features": features,
                   "objective": "binary:logistic"},
         "config": {"warmup_buckets": [1, 64, 4096], "max_batch": 4096,
@@ -137,14 +313,66 @@ def main(out_path: str) -> int:
               f"req/s over {report['concurrent']['threads']} threads, "
               f"steady-state compiles={steady}")
 
+    rc = 0
+    if os.environ.get("BENCH_SERVE_FLEET", "1") != "0":
+        workdir = tempfile.mkdtemp(prefix="xtb_bench_fleet_")
+        try:
+            # mixed-architecture set: the binary model above + a
+            # multiclass + a regression one (distinct serve programs per
+            # bucket each — a multi-tenant replica's real warm load)
+            bst_b, _ = train_model(max(2, rounds // 2), max(3, depth - 2),
+                                   features, "multi:softprob", num_class=5)
+            bst_c, _ = train_model(max(2, rounds // 2), max(3, depth - 1),
+                                   features, "reg:squarederror")
+            pa = os.path.join(workdir, "a.json")
+            pb = os.path.join(workdir, "b.json")
+            pc = os.path.join(workdir, "c.json")
+            bst.save_model(pa)
+            bst_b.save_model(pb)
+            bst_c.save_model(pc)
+            cs = bench_fleet_coldstart({"a": pa, "b": pb, "c": pc}, workdir)
+            report["fleet_coldstart"] = cs
+            print(f"fleet coldstart ({cs['programs']} programs): "
+                  f"cold={cs['cold_warmup_s']:.2f}s "
+                  f"warm={cs['warm_warmup_s']:.3f}s "
+                  f"speedup={cs['speedup']:.0f}x")
+            sat = bench_fleet_saturation({"a": pa, "b": pb}, workdir,
+                                         features)
+            report["fleet_saturation"] = sat
+            base = sat[0]["rows_per_s"]
+            top = sat[-1]["rows_per_s"]
+            report["fleet_scaling_vs_single"] = round(top / base, 2)
+            report["fleet_best_scaling"] = round(
+                max(r["rows_per_s"] for r in sat) / base, 2)
+            cores = os.cpu_count() or 1
+            if cores < 2 * max(FLEET_SIZES):
+                # N replicas + dispatcher need ~N+1 cores to demonstrate
+                # replica-limited scale-out; below that the rows measure
+                # core-oversubscription, not the dispatcher design (total
+                # CPU bounds fleet/single at cores/1 when a single replica
+                # already saturates its core)
+                report["fleet_scaling_note"] = (
+                    f"host-bound: {cores} cores for "
+                    f"{max(FLEET_SIZES)} replicas + dispatcher; "
+                    f"theoretical scaling ceiling ~{cores}.0x")
+            print(f"fleet-of-{sat[-1]['n_replicas']} vs single: "
+                  f"{top / base:.2f}x "
+                  f"({report.get('fleet_scaling_note', 'replica-limited')})")
+            if cs["speedup"] < 10:
+                print("FAIL: warm-cache cold-start speedup < 10x",
+                      file=sys.stderr)
+                rc = 1
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
     with open(out_path, "w") as fh:
         json.dump(report, fh, indent=2)
         fh.write("\n")
     print(f"wrote {out_path}")
     if steady:
         print("FAIL: engine recompiled after warm-up", file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":

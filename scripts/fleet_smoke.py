@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Serving-fleet smoke for the nightly suite (docs/serving.md "Fleet").
+
+One scenario, end to end against real replica processes:
+
+1. Start a 3-replica fleet over two models with a warm-capable persistent
+   compile cache.
+2. Drive mixed two-model traffic from several client threads.
+3. SIGKILL one replica mid-stream.
+4. Assert EVERY request completes with the right bits (the dead replica's
+   in-flight batch reroutes; nothing is dropped), the respawn brings the
+   fleet back to strength, and the p99 over the whole disrupted stream is
+   recorded (printed + exit-code-gated on completeness, not speed — this
+   host is time-shared).
+
+Usage: JAX_PLATFORMS=cpu python scripts/fleet_smoke.py [n_replicas] [reqs]
+"""
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+N_CLIENTS = 6
+BATCH = 256
+
+
+def train_pair(workdir):
+    import xgboost_tpu as xtb
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(4000, 12)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    d = xtb.DMatrix(X, label=y)
+    paths = {}
+    for name, rounds, depth in (("a", 8, 4), ("b", 5, 3)):
+        bst = xtb.train({"objective": "binary:logistic", "max_depth": depth,
+                         "seed": 1}, d, rounds, verbose_eval=False)
+        paths[name] = os.path.join(workdir, f"{name}.json")
+        bst.save_model(paths[name])
+    return paths, X
+
+
+def main() -> int:
+    n_replicas = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    per_client = (int(sys.argv[2]) if len(sys.argv) > 2 else 120) // N_CLIENTS
+
+    from xgboost_tpu.serving import ServeConfig, ServingEngine, ServingFleet
+
+    workdir = tempfile.mkdtemp(prefix="xtb_fleet_smoke_")
+    paths, X = train_pair(workdir)
+    Xq = X[:BATCH]
+
+    # in-process reference bits: every fleet answer must match these
+    eng = ServingEngine(ServeConfig(use_batcher=False))
+    eng.add_model("a", paths["a"])
+    eng.add_model("b", paths["b"])
+    ref = {"a": eng.predict("a", Xq, direct=True),
+           "b": eng.predict("b", Xq, direct=True)}
+    eng.close()
+
+    lats = []
+    lats_lock = threading.Lock()
+    errors = []
+    kill_at = threading.Event()
+
+    with ServingFleet(paths, n_replicas=n_replicas,
+                      cache_dir=os.path.join(workdir, "cache"),
+                      warmup_buckets=(BATCH,), max_respawns=1) as fleet:
+        print(f"fleet up: {fleet.alive_replicas()}/{n_replicas} replicas, "
+              f"coldstart info: {fleet.replica_info()[0]['cache_state']}")
+
+        def client(tid):
+            try:
+                for i in range(per_client):
+                    model = "a" if (tid + i) % 2 == 0 else "b"
+                    t0 = time.perf_counter()
+                    out = fleet.predict(model, Xq, timeout=600)
+                    dt = time.perf_counter() - t0
+                    with lats_lock:
+                        lats.append(dt)
+                    if not np.array_equal(out, ref[model]):
+                        errors.append(f"client{tid} req{i}: WRONG BITS "
+                                      f"for model {model}")
+                    if tid == 0 and i == per_client // 4:
+                        kill_at.set()  # a quarter in: release the killer
+            except BaseException as e:
+                errors.append(f"client{tid}: {e!r}")
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(N_CLIENTS)]
+        for t in threads:
+            t.start()
+        assert kill_at.wait(timeout=600), "traffic never reached kill point"
+        victim = next(r for r in fleet._replicas.values() if r.alive)
+        print(f"killing {victim.label} (pid {victim.proc.pid}) mid-stream")
+        victim.proc.send_signal(signal.SIGKILL)
+        for t in threads:
+            t.join(900)
+        alive = [t for t in threads if t.is_alive()]
+        if alive:
+            errors.append(f"{len(alive)} clients never finished")
+
+        deadline = time.monotonic() + 120
+        while (fleet.alive_replicas() < n_replicas
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        respawned = fleet.alive_replicas()
+
+    total = N_CLIENTS * per_client
+    done = len(lats)
+    p50, p99 = (np.percentile(lats, [50, 99]) if lats else (0.0, 0.0))
+    print(f"fleet smoke: {done}/{total} requests completed through a "
+          f"replica kill; p50={p50 * 1e3:.1f}ms p99={p99 * 1e3:.1f}ms; "
+          f"fleet back at {respawned}/{n_replicas} replicas")
+    if errors:
+        print(f"FAIL: {errors[:5]}", file=sys.stderr)
+        return 1
+    if done != total:
+        print(f"FAIL: lost {total - done} requests", file=sys.stderr)
+        return 1
+    if respawned < n_replicas:
+        print("FAIL: respawn never restored fleet strength",
+              file=sys.stderr)
+        return 1
+    import shutil
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    print("fleet smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

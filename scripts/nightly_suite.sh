@@ -74,4 +74,11 @@ JAX_PLATFORMS=cpu python scripts/fault_smoke.py 4 6
 # shard map restored from the checkpoint
 JAX_PLATFORMS=cpu python scripts/elastic_smoke.py 4 8
 
+# serving-fleet smoke (docs/serving.md "Fleet"): 3 replicas over two
+# models with a warm compile cache, mixed traffic from 6 client threads,
+# one replica SIGKILLed mid-stream — every request must complete with the
+# in-process engine's exact bits (the dead replica's in-flight batch
+# reroutes), p99 recorded, and the respawn must restore fleet strength
+JAX_PLATFORMS=cpu python scripts/fleet_smoke.py 3 120
+
 BENCH_FORCE_CPU=1 BENCH_ROWS=100000 BENCH_ROUNDS=5 python bench.py

@@ -98,12 +98,76 @@ def test_gblinear_random_selector_and_validation():
     with pytest.raises(ValueError, match="feature_selector"):
         xtb.train({"booster": "gblinear", "objective": "reg:squarederror",
                    "feature_selector": "sideways"}, d, 1, verbose_eval=False)
-    with pytest.raises(NotImplementedError, match="greedy"):
-        xtb.train({"booster": "gblinear", "objective": "reg:squarederror",
-                   "feature_selector": "greedy"}, d, 1, verbose_eval=False)
     with pytest.raises(ValueError, match="updater"):
         xtb.train({"booster": "gblinear", "objective": "reg:squarederror",
                    "updater": "warp_drive"}, d, 1, verbose_eval=False)
+
+
+def test_gblinear_gain_selector_orders():
+    """The coordinate_common.h selector semantics, directly: thrifty ranks
+    by |univariate weight change| from the round-start gradients; greedy's
+    first pick is the same top coordinate (interleaved re-ranking)."""
+    import jax.numpy as jnp
+
+    from xgboost_tpu.models.gblinear import (effective_top_k,
+                                             linear_update_greedy,
+                                             selector_order, thrifty_order)
+
+    rng = np.random.default_rng(31)
+    X = rng.normal(size=(2000, 6)).astype(np.float32)
+    y = 3.0 * X[:, 2] + 1.0 * X[:, 0] + 0.5 * X[:, 4]
+    gpair = np.stack([-y, np.ones_like(y)], axis=1)  # squarederror at pred=0
+    w0 = np.zeros(6, np.float32)
+    order = thrifty_order(X, gpair, w0, top_k=0, alpha=0.0, lambda_=1.0)
+    assert list(order[:3]) == [2, 0, 4]  # signal-strength ranking
+    assert len(thrifty_order(X, gpair, w0, top_k=2, alpha=0.0,
+                             lambda_=1.0)) == 2
+    # exact-magnitude ties (duplicated column) resolve to the lower index
+    Xt = np.concatenate([X[:, :1], X], axis=1)
+    gt = thrifty_order(Xt, gpair, np.zeros(7, np.float32), top_k=0,
+                       alpha=0.0, lambda_=1.0)
+    assert list(gt).index(0) < list(gt).index(1)
+    # greedy interleaves select-and-update; first pick == thrifty's top
+    _, _, picked = linear_update_greedy(
+        jnp.asarray(X), jnp.asarray(gpair, jnp.float32), jnp.asarray(w0),
+        jnp.float32(0.0), steps=3, eta=0.5, lambda_=1.0, alpha=0.0)
+    assert int(picked[0]) == 2
+    assert len(set(int(p) for p in picked)) == 3  # no coordinate twice
+    assert effective_top_k(0, 5) == 5
+    assert effective_top_k(3, 5) == 3
+    assert effective_top_k(10, 5) == 5
+    # gain-ranked selectors have no gradient-free order
+    with pytest.raises(ValueError, match="gain-ranked"):
+        selector_order("greedy", 6, 0, 0)
+
+
+@pytest.mark.parametrize("selector", ["greedy", "thrifty"])
+def test_gblinear_gain_selectors_train_deterministic(selector):
+    rng = np.random.default_rng(24)
+    X = rng.normal(size=(800, 6)).astype(np.float32)
+    true_w = np.array([1.0, -2.0, 0.5, 0.0, 3.0, -1.0], np.float32)
+    y = X @ true_w + 0.05 * rng.normal(size=800).astype(np.float32)
+    d = xtb.DMatrix(X, label=y)
+    params = {"booster": "gblinear", "objective": "reg:squarederror",
+              "eta": 0.7, "lambda": 0.01, "updater": "coord_descent",
+              "feature_selector": selector}
+
+    def run(extra):
+        return xtb.train({**params, **extra}, d, 40,
+                         verbose_eval=False).linear_weights
+
+    w1, w2 = run({}), run({})
+    np.testing.assert_array_equal(w1, w2)  # bitwise-deterministic
+    np.testing.assert_allclose(w1[:, 0], true_w, atol=0.05)  # converges
+    # top_k restricts each round to the k best coordinates; one round
+    # from zero moves exactly k weights (plus the bias)
+    wk = xtb.train({**params, "top_k": 2}, d, 1,
+                   verbose_eval=False).linear_weights[:, 0]
+    assert np.count_nonzero(wk) == 2
+    # the shotgun updater accepts gain-ranked selectors too
+    ws = xtb.train({**params, "updater": "shotgun"}, d, 40,
+                   verbose_eval=False).linear_weights
+    np.testing.assert_array_equal(ws, w1)  # same chain, updater-agnostic
 
 
 def test_dart_trains_and_roundtrips(tmp_path):

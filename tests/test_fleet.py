@@ -1,0 +1,531 @@
+"""Serving fleet: dispatcher policy, shared model store, wire protocol,
+warm compile cache, and the multi-process no-loss contracts.
+
+Single-process tiers exercise the unit seams (DispatchQueue shed/expiry
+policy, ModelStore publish/snapshot parity, wire framing, program keys);
+the multi-process tests pin the fleet-level contracts from
+docs/serving.md "Fleet": bitwise parity with the in-process engine on
+both request encodings, warm-cache cold-start at a fraction of
+cold-cache, and replica death dropping nothing but (at most) nothing —
+the in-flight batch reroutes to a live replica.
+"""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.reliability import faults
+from xgboost_tpu.serving import (ModelStore, ServeConfig, ServingEngine,
+                                 ServingFleet, SLOClass)
+from xgboost_tpu.serving import wire
+from xgboost_tpu.serving.fleet import DispatchQueue, FleetConfig, _Request
+from xgboost_tpu.serving.warmcache import WarmProgramCache, program_key
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _train(seed=0, n=400, f=8, rounds=5, depth=4):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": depth,
+                     "seed": seed}, xtb.DMatrix(X, label=y), rounds,
+                    verbose_eval=False)
+    return bst, X
+
+
+def _req(rid, slo, model="m"):
+    return _Request(rid, model, {"op": "predict", "id": rid}, b"", slo)
+
+
+# =========================================================================
+# DispatchQueue: SLO-ordered admission, shedding, expiry
+
+
+def test_queue_priority_order_and_fifo_within_class():
+    gold = SLOClass("gold", priority=2)
+    free = SLOClass("free", priority=0)
+    q = DispatchQueue(max_queue=16)
+    order = []
+    for rid, slo in [(1, free), (2, gold), (3, free), (4, gold)]:
+        assert q.push(_req(rid, slo)) is None
+    while True:
+        r, _ = q.pop(time.monotonic())
+        if r is None:
+            break
+        order.append(r.id)
+    # gold first (FIFO within gold), then free (FIFO within free)
+    assert order == [2, 4, 1, 3]
+
+
+def test_queue_full_sheds_newest_lowest_priority():
+    gold = SLOClass("gold", priority=2)
+    free = SLOClass("free", priority=0)
+    q = DispatchQueue(max_queue=2)
+    assert q.push(_req(1, free)) is None
+    assert q.push(_req(2, free)) is None
+    # a gold request outranks: the NEWEST free request (id 2) is shed
+    victim = q.push(_req(3, gold))
+    assert victim is not None and victim.id == 2
+    assert victim.state == "shed"
+    # an equal-priority newcomer does not outrank anyone: it sheds itself
+    victim = q.push(_req(4, free))
+    assert victim is not None and victim.id == 4
+    # queue still serves gold before the surviving free request
+    r1, _ = q.pop(time.monotonic())
+    r2, _ = q.pop(time.monotonic())
+    assert [r1.id, r2.id] == [3, 1]
+
+
+def test_queue_deadline_expires_in_queue():
+    fast = SLOClass("fast", priority=1, deadline_s=0.005)
+    slow = SLOClass("slow", priority=0, deadline_s=None)
+    q = DispatchQueue(max_queue=8)
+    q.push(_req(1, fast))
+    q.push(_req(2, slow))
+    time.sleep(0.02)
+    r, expired = q.pop(time.monotonic())
+    assert [e.id for e in expired] == [1]
+    assert expired[0].state == "expired"
+    assert r.id == 2  # the deadline-free request still serves
+
+
+def test_queue_pop_skips_cancelled_futures():
+    """A caller that timed out cancels its future; the queue must not
+    hand the abandoned request to a replica."""
+    slo = SLOClass()
+    q = DispatchQueue(max_queue=8)
+    r1, r2 = _req(1, slo), _req(2, slo)
+    q.push(r1)
+    q.push(r2)
+    assert r1.future.cancel()
+    r, _ = q.pop(time.monotonic())
+    assert r.id == 2 and r1.state == "done"
+    assert len(q) == 0
+
+
+def test_queue_requeue_front_precedes_fifo():
+    slo = SLOClass()
+    q = DispatchQueue(max_queue=8)
+    q.push(_req(1, slo))
+    q.push(_req(2, slo))
+    r, _ = q.pop(time.monotonic())
+    assert r.id == 1
+    q.requeue_front(r)  # rerouted in-flight work goes back to the FRONT
+    r, _ = q.pop(time.monotonic())
+    assert r.id == 1
+    assert len(q) == 1
+
+
+# =========================================================================
+# wire protocol
+
+
+def _socketpair():
+    import socket
+
+    a, b = socket.socketpair()
+    return wire.configure(a), wire.configure(b)
+
+
+def test_wire_raw_roundtrip_bitwise():
+    X = np.random.default_rng(0).normal(size=(33, 7)).astype(np.float32)
+    fields, payload = wire.encode_raw(X)
+    a, b = _socketpair()
+    try:
+        wire.send_frame(a, dict(fields, op="predict", id=9), payload)
+        hdr, body = wire.recv_frame(wire.reader(b))
+        assert hdr["id"] == 9
+        Y = wire.decode_matrix(hdr, body)
+        np.testing.assert_array_equal(X, Y)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_large_payload_and_eof():
+    import threading
+
+    X = np.zeros((4096, 32), np.float32)  # > _INLINE_PAYLOAD: two sendalls
+    fields, payload = wire.encode_raw(X)
+    a, b = _socketpair()
+    try:
+        # 512KB overflows the socketpair buffer: send concurrently with
+        # the receive (sendall blocks until the peer drains)
+        tx = threading.Thread(target=wire.send_frame,
+                              args=(a, fields, payload), daemon=True)
+        tx.start()
+        hdr, body = wire.recv_frame(b)
+        tx.join(timeout=30)
+        assert not tx.is_alive()
+        assert wire.decode_matrix(hdr, body).shape == (4096, 32)
+        a.close()
+        with pytest.raises(wire.WireError):
+            wire.recv_frame(b)  # EOF at frame boundary is still WireError
+    finally:
+        b.close()
+
+
+def test_wire_arrow_roundtrip_parity():
+    pa = pytest.importorskip("pyarrow")
+    X = np.random.default_rng(1).normal(size=(50, 5)).astype(np.float32)
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array(X[:, i]) for i in range(5)],
+        names=[f"f{i}" for i in range(5)])
+    fields, payload = wire.encode_arrow(batch)
+    assert fields["enc"] == wire.ARROW
+    Y = wire.decode_matrix(fields, bytes(payload))
+    np.testing.assert_array_equal(X, Y)  # bitwise through the IPC stream
+
+
+def test_wire_arrow_nulls_and_dictionary():
+    pa = pytest.importorskip("pyarrow")
+    from xgboost_tpu.data.arrow import ipc_batch_to_dense
+
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array([1.0, None, 3.0], type=pa.float32()),
+         pa.array([1, 2, 3], type=pa.int64())], names=["a", "b"])
+    _, payload = wire.encode_arrow(batch)
+    Y = ipc_batch_to_dense(bytes(payload))
+    assert np.isnan(Y[1, 0]) and Y[2, 1] == 3.0  # nulls -> NaN, ints cast
+    dict_batch = pa.RecordBatch.from_arrays(
+        [pa.array(["x", "y", "x"]).dictionary_encode()], names=["c"])
+    _, payload = wire.encode_arrow(dict_batch)
+    with pytest.raises(ValueError, match="dictionary"):
+        ipc_batch_to_dense(bytes(payload))
+
+
+# =========================================================================
+# ModelStore: one mmap copy, snapshot parity
+
+
+def test_modelstore_publish_snapshot_parity(tmp_path):
+    bst, X = _train(seed=3)
+    store = ModelStore(str(tmp_path))
+    v = store.publish("m", bst)
+    assert v == 1 and store.entries() == [("m", 1)]
+    snap = store.snapshot("m", device=False)
+    from xgboost_tpu.serving.snapshot import InferenceSnapshot
+
+    ref = InferenceSnapshot.from_booster(bst)
+    for key, a in ref.stacked.items():
+        b = snap.stacked[key]
+        if a is None:
+            assert b is None
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert snap.num_features == ref.num_features
+    assert snap.depth == ref.depth and snap.n_groups == ref.n_groups
+    # the arena views are READ-ONLY mmaps: one host copy fleet-wide
+    with pytest.raises(ValueError):
+        np.asarray(snap.stacked["feat"])[0] = 0
+
+
+def test_modelstore_engine_predict_bitwise(tmp_path):
+    bst, X = _train(seed=4)
+    store = ModelStore(str(tmp_path))
+    store.publish("m", bst)
+    eng = ServingEngine(ServeConfig(use_batcher=False))
+    try:
+        eng.add_model("ref", bst)
+        ref = eng.predict("ref", X, direct=True)
+        eng.registry.register_snapshot("m", store.snapshot("m"), 1)
+        out = eng.predict("m", X, direct=True)
+        np.testing.assert_array_equal(ref, out)
+    finally:
+        eng.close()
+
+
+def test_modelstore_versioning_and_missing(tmp_path):
+    bst, _ = _train(seed=5, rounds=2)
+    bst2, _ = _train(seed=6, rounds=3)
+    store = ModelStore(str(tmp_path))
+    assert store.publish("m", bst) == 1
+    assert store.publish("m", bst2) == 2
+    assert store.latest_version("m") == 2
+    assert store.snapshot("m", 1).n_trees != store.snapshot("m", 2).n_trees
+    with pytest.raises(KeyError):
+        store.snapshot("absent")
+
+
+# =========================================================================
+# warm program cache
+
+
+def test_program_key_is_architecture_not_weights(tmp_path):
+    # same architecture, different weights -> SAME program key (a
+    # hot-swapped retrain warms instantly); different bucket/depth -> new
+    bst_a, _ = _train(seed=7, rounds=3, depth=3)
+    bst_b, _ = _train(seed=8, rounds=3, depth=3)
+    store = ModelStore(str(tmp_path))
+    store.publish("a", bst_a)
+    store.publish("b", bst_b)
+    sa = store.snapshot("a", device=False)
+    sb = store.snapshot("b", device=False)
+    assert program_key(sa, 64) == program_key(sb, 64)
+    assert program_key(sa, 64) != program_key(sa, 128)
+    bst_c, _ = _train(seed=7, rounds=3, depth=5)
+    store.publish("c", bst_c)
+    sc = store.snapshot("c", device=False)
+    assert program_key(sa, 64) != program_key(sc, 64)
+
+
+def test_warmcache_attach_and_reload(tmp_path):
+    bst, X = _train(seed=9)
+    store = ModelStore(str(tmp_path / "store"))
+    store.publish("m", bst)
+    snap = store.snapshot("m")
+    warm = WarmProgramCache(str(tmp_path / "cache"))
+    st = warm.attach(snap, (32, 64))
+    assert st["compiled"] == 2 and st["hits"] == 0
+    assert warm.save()
+    # a second "replica" (fresh cache object + fresh snapshot) hits
+    snap2 = store.snapshot("m")
+    warm2 = WarmProgramCache(str(tmp_path / "cache"))
+    st2 = warm2.attach(snap2, (32, 64))
+    assert st2["hits"] == 2 and st2["compiled"] == 0
+    # and the AOT program computes the same bits as the eager engine path
+    eng = ServingEngine(ServeConfig(use_batcher=False))
+    try:
+        eng.add_model("ref", bst)
+        ref = eng.predict("ref", X[:32], direct=True)
+        out = np.asarray(snap2.aot_execute(X[:32], False))
+        np.testing.assert_array_equal(ref, out[:, 0])
+    finally:
+        eng.close()
+
+
+# =========================================================================
+# fleet config
+
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError):
+        FleetConfig(n_replicas=0)
+    with pytest.raises(ValueError):
+        FleetConfig(max_queue=0)
+    cfg = FleetConfig(slo_classes={"t": SLOClass("gold", 2, 1.0)})
+    assert cfg.resolve_slo("t").priority == 2
+    assert cfg.resolve_slo("unknown").priority == 0
+    assert cfg.resolve_slo(None).name == "default"
+    with pytest.raises(ValueError):
+        ServingFleet({}, n_replicas=1).start()  # no models
+
+
+# =========================================================================
+# multi-process fleet contracts (slow: real replica processes)
+
+
+@pytest.fixture(scope="module")
+def fleet_models(tmp_path_factory):
+    d = tmp_path_factory.mktemp("fleet_models")
+    bst_a, X = _train(seed=11, f=8, rounds=6, depth=4)
+    bst_b, _ = _train(seed=12, f=8, rounds=4, depth=3)
+    pa = str(d / "a.json")
+    pb = str(d / "b.json")
+    bst_a.save_model(pa)
+    bst_b.save_model(pb)
+    eng = ServingEngine(ServeConfig(use_batcher=False))
+    eng.add_model("a", pa)
+    eng.add_model("b", pb)
+    ref_a = eng.predict("a", X, direct=True)
+    ref_b = eng.predict("b", X, direct=True)
+    eng.close()
+    return {"a": pa, "b": pb, "X": X, "ref_a": ref_a, "ref_b": ref_b}
+
+
+@pytest.mark.slow
+def test_fleet_end_to_end_parity_and_reroute(fleet_models, tmp_path):
+    X = fleet_models["X"]
+    cache = str(tmp_path / "cache")
+    with ServingFleet({"a": fleet_models["a"], "b": fleet_models["b"]},
+                      n_replicas=2, cache_dir=cache, max_respawns=1,
+                      warmup_buckets=(64, 512)) as fleet:
+        assert fleet.alive_replicas() == 2
+        # numpy path: bitwise the in-process engine
+        np.testing.assert_array_equal(
+            fleet.predict("a", X, timeout=60), fleet_models["ref_a"])
+        np.testing.assert_array_equal(
+            fleet.predict("b", X, timeout=60), fleet_models["ref_b"])
+        # arrow path: bitwise too (zero-copy parity contract)
+        try:
+            import pyarrow as pa
+        except ImportError:
+            pa = None
+        if pa is not None:
+            batch = pa.RecordBatch.from_arrays(
+                [pa.array(X[:, i]) for i in range(X.shape[1])],
+                names=[f"f{i}" for i in range(X.shape[1])])
+            np.testing.assert_array_equal(
+                fleet.predict_arrow("a", batch, timeout=60),
+                fleet_models["ref_a"])
+        # unknown model surfaces the replica's error, typed
+        with pytest.raises(KeyError):
+            fleet.predict("nope", X[:4], timeout=60)
+        # kill one replica mid-stream: nothing is lost — the dead
+        # replica's in-flight batch reroutes, queued work drains on the
+        # survivor (and later the respawn)
+        victim = next(iter(fleet._replicas.values()))
+        futs = [fleet.submit("a", X) for _ in range(24)]
+        victim.proc.send_signal(signal.SIGKILL)
+        for f in futs:
+            np.testing.assert_array_equal(f.result(timeout=60),
+                                          fleet_models["ref_a"])
+        # respawn absorbs back to full strength
+        deadline = time.monotonic() + 60
+        while fleet.alive_replicas() < 2 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert fleet.alive_replicas() == 2
+        np.testing.assert_array_equal(
+            fleet.predict("b", X, timeout=60), fleet_models["ref_b"])
+
+
+@pytest.mark.slow
+def test_fleet_coldstart_warm_cache_faster(fleet_models, tmp_path):
+    """The persistent-cache contract: a replica starting against a warm
+    cache does a fraction of the cold warm-work (the >=10x claim lives in
+    BENCH_SERVE.json; the test asserts the mechanism with slack for a
+    noisy host: all programs hit, none compiled, and wall at most half)."""
+    cache = str(tmp_path / "cache")
+    buckets = (64, 512)
+    kw = dict(n_replicas=1, cache_dir=cache, warmup_buckets=buckets)
+    with ServingFleet({"a": fleet_models["a"]}, **kw) as fleet:
+        cold = fleet.replica_info()[0]
+    with ServingFleet({"a": fleet_models["a"]}, **kw) as fleet:
+        warm = fleet.replica_info()[0]
+    assert cold["aot_compiled"] == len(buckets) and cold["aot_hits"] == 0
+    assert cold["cache_state"] == "cold"
+    assert warm["aot_hits"] == len(buckets) and warm["aot_compiled"] == 0
+    assert warm["cache_state"] == "warm"
+    assert warm["warmup_s"] < cold["warmup_s"] / 2
+
+
+def _stalled_first_request(fleet, model, X, seconds):
+    """Submit one request whose dispatch-seam delay holds the lone replica
+    'busy' (in_flight claimed, nothing on the wire) for ``seconds`` — the
+    deterministic window the SLO tests stack the queue in.  Returns the
+    (background-submitted) future; join via .result()."""
+    import threading
+
+    faults.install({"faults": [{"site": "fleet.dispatch", "kind": "delay",
+                                "seconds": seconds, "at": 0, "times": 1}]})
+    box = {}
+    ev = threading.Event()
+
+    def _bg():
+        box["f"] = fleet.submit(model, X)  # blocks in the seam delay
+        ev.set()
+
+    t = threading.Thread(target=_bg, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:  # wait until the stall claimed it
+        with fleet._cv:
+            busy = any(r.in_flight is not None
+                       for r in fleet._replicas.values())
+        if busy:
+            break
+        time.sleep(0.01)
+    assert busy, "stalled request never claimed the replica"
+    return box, ev
+
+
+@pytest.mark.slow
+def test_fleet_slo_deadline_and_dispatch_fault(fleet_models):
+    X = fleet_models["X"][:32]
+    classes = {"paid": SLOClass("paid", priority=2, deadline_s=30.0),
+               "free": SLOClass("free", priority=0, deadline_s=0.05)}
+    with ServingFleet({"a": fleet_models["a"]}, n_replicas=1,
+                      warmup_buckets=(64,), slo_classes=classes) as fleet:
+        # hold the replica for 1.5s; a free-tier request queued behind the
+        # stall outlives its 50ms deadline and must expire with
+        # TimeoutError, while the paid-tier request (queued later, higher
+        # priority) still serves
+        box, ev = _stalled_first_request(fleet, "a", X, 1.5)
+        f_free = fleet.submit("a", X, tenant="free")
+        f_paid = fleet.submit("a", X, tenant="paid")
+        assert f_paid.result(timeout=60) is not None
+        with pytest.raises(TimeoutError):
+            f_free.result(timeout=60)
+        ev.wait(timeout=60)
+        assert box["f"].result(timeout=60) is not None
+        faults.clear()
+        # an exception at the dispatch seam fails that request only
+        faults.install({"faults": [{"site": "fleet.dispatch",
+                                    "kind": "exception",
+                                    "message": "dispatch boom"}]})
+        with pytest.raises(faults.FaultInjected):
+            fleet.predict("a", X, timeout=60)
+        faults.clear()
+        np.testing.assert_array_equal(
+            fleet.predict("a", fleet_models["X"], timeout=60),
+            fleet_models["ref_a"])
+
+
+@pytest.mark.slow
+def test_fleet_extinct_fails_fast(fleet_models):
+    """With the respawn budget spent and every replica dead, queued work
+    fails with WorkerFailedError AND later submits fail fast instead of
+    queueing into a permanent hang."""
+    from xgboost_tpu.launcher import WorkerFailedError
+
+    X = fleet_models["X"][:16]
+    fleet = ServingFleet({"a": fleet_models["a"]}, n_replicas=1,
+                         warmup_buckets=(64,), max_respawns=0).start()
+    try:
+        victim = next(iter(fleet._replicas.values()))
+        victim.proc.send_signal(signal.SIGKILL)
+        deadline = time.monotonic() + 60
+        while not fleet._extinct and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fleet._extinct
+        with pytest.raises(WorkerFailedError, match="respawn budget"):
+            fleet.predict("a", X, timeout=60)
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_fleet_start_crash_fails_fast(fleet_models):
+    """Replicas that crash during launch with no respawn budget must fail
+    start() as soon as the fleet is extinct, not at ready_timeout_s."""
+    from xgboost_tpu.launcher import WorkerFailedError
+
+    t0 = time.monotonic()
+    with pytest.raises(WorkerFailedError, match="replicas became ready"):
+        ServingFleet({"a": fleet_models["a"]}, n_replicas=1,
+                     max_respawns=0, platform="not_a_jax_backend",
+                     ready_timeout_s=120).start()
+    assert time.monotonic() - t0 < 60  # well under the ready timeout
+
+
+@pytest.mark.slow
+def test_fleet_queue_shed_under_pressure(fleet_models):
+    """max_queue=2 with the replica stalled: a low-priority resident is
+    shed to admit a higher class; an equal-priority newcomer sheds
+    itself (FIFO fairness)."""
+    from xgboost_tpu.serving.batcher import QueueFullError
+
+    X = fleet_models["X"][:16]
+    classes = {"gold": SLOClass("gold", priority=2),
+               "free": SLOClass("free", priority=0)}
+    with ServingFleet({"a": fleet_models["a"]}, n_replicas=1,
+                      warmup_buckets=(64,), max_queue=2,
+                      slo_classes=classes) as fleet:
+        box, ev = _stalled_first_request(fleet, "a", X, 1.5)
+        fillers = [fleet.submit("a", X, tenant="free") for _ in range(2)]
+        gold = fleet.submit("a", X, tenant="gold")  # sheds a free filler
+        shed = [f for f in fillers
+                if isinstance(f.exception(timeout=60), QueueFullError)]
+        assert len(shed) == 1 and shed[0] is fillers[1]  # newest free
+        assert gold.result(timeout=60) is not None
+        ev.wait(timeout=60)
+        assert box["f"].result(timeout=60) is not None

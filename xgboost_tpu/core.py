@@ -620,23 +620,45 @@ class Booster:
                 "'coord_descent' or 'shotgun'")
         # reference defaults (coordinate_common.h): shotgun shuffles its
         # visit order every round, coord_descent walks features cyclically
-        from .models.gblinear import selector_order
+        from .models.gblinear import (SELECTORS, effective_top_k,
+                                      linear_update_greedy, selector_order,
+                                      thrifty_order)
 
         selector = str(self.params.get(
             "feature_selector",
             "shuffle" if updater == "shotgun" else "cyclic"))
-        order = jnp.asarray(selector_order(
-            selector, F, getattr(self, "_linear_rounds", 0),
-            int(self.params.get("seed", 0))))
+        if selector not in SELECTORS:
+            raise ValueError(
+                f"unknown feature_selector {selector!r}; expected one of "
+                f"{SELECTORS}")
+        top_k = int(self.params.get("top_k", 0) or 0)
+        order = None
+        if selector not in ("greedy", "thrifty"):
+            order = jnp.asarray(selector_order(
+                selector, F, getattr(self, "_linear_rounds", 0),
+                int(self.params.get("seed", 0))))
         W = jnp.asarray(self.linear_weights)
         b = jnp.asarray(self.linear_bias)
         R = cache.dmat.num_row()
+        eta, lam, alpha = (float(self.tparam.eta),
+                           float(self.tparam.lambda_),
+                           float(self.tparam.alpha))
         for k in range(K):
-            wk, bk = linear_update(
-                Xz, gpair[:R, k, :], W[:, k], b[k], order,
-                eta=float(self.tparam.eta), lambda_=float(self.tparam.lambda_),
-                alpha=float(self.tparam.alpha),
-            )
+            if selector == "greedy":
+                wk, bk, _ = linear_update_greedy(
+                    Xz, gpair[:R, k, :], W[:, k], b[k],
+                    steps=effective_top_k(top_k, F), eta=eta, lambda_=lam,
+                    alpha=alpha)
+            else:
+                if selector == "thrifty":
+                    # gain-ranked per group from the round-start gradients
+                    order = jnp.asarray(thrifty_order(
+                        Xz, gpair[:R, k, :], W[:, k], top_k=top_k,
+                        alpha=alpha, lambda_=lam))
+                wk, bk = linear_update(
+                    Xz, gpair[:R, k, :], W[:, k], b[k], order,
+                    eta=eta, lambda_=lam, alpha=alpha,
+                )
             W = W.at[:, k].set(wk)
             b = b.at[k].set(bk)
         self.linear_weights = np.asarray(W)

@@ -69,3 +69,47 @@ def arrow_to_columnar(data: Any, missing: float, normalize_dense):
              normalize_dense(arr, missing, np, feature_types),
              cat_categories),
             feature_names, feature_types)
+
+
+def ipc_batch_to_dense(payload) -> np.ndarray:
+    """Arrow IPC stream bytes -> (R, F) float32 matrix, reading straight
+    off the IPC buffer (the fleet replica's request-path decoder).
+
+    Zero-copy fast path: every column float32 with no nulls — each column
+    becomes a ``to_numpy(zero_copy_only=True)`` view over the received
+    buffer and the single copy on the whole request path is the final
+    columnar->row-major ``np.stack`` at the kernel boundary (the same
+    layout transform the in-process engine pays in ``_as_batch``).
+    Columns of other numeric types or with nulls take the copying
+    ``astype``/NaN-fill route with the exact semantics of
+    :func:`arrow_to_columnar` numeric ingestion (nulls -> NaN).
+    """
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(pa.py_buffer(payload)) as reader:
+        table = reader.read_all()
+    batch = table.combine_chunks()
+    cols = []
+    for ci in range(batch.num_columns):
+        col = batch.column(ci)
+        if isinstance(col, pa.ChunkedArray):
+            col = (col.combine_chunks() if col.num_chunks != 1
+                   else col.chunk(0))
+        if pa.types.is_dictionary(col.type):
+            # serving-time category recode needs the train-time dictionary
+            # (snapshot.host_dense_recoded); on the wire, send the CODES
+            raise ValueError(
+                "dictionary-encoded columns are not accepted on the fleet "
+                "request path: recode to training category codes client-"
+                "side and send the numeric codes (Booster.get_categories "
+                "exports the train-time dictionaries)")
+        if pa.types.is_float32(col.type) and col.null_count == 0:
+            cols.append(col.to_numpy(zero_copy_only=True))
+        else:
+            vals = col.to_numpy(zero_copy_only=False).astype(np.float32)
+            if col.null_count:
+                vals[np.asarray(col.is_null())] = np.nan
+            cols.append(vals)
+    if not cols:
+        return np.zeros((batch.num_rows, 0), np.float32)
+    return np.stack(cols, axis=1)

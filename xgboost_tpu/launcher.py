@@ -55,7 +55,9 @@ class WorkerFailedError(RuntimeError):
         self.failures = list(failures)
 
 
-def _stderr_tail(path: str, limit: int = 4000) -> str:
+def stderr_tail(path: str, limit: int = 4000) -> str:
+    """Last ``limit`` bytes of a spawned worker's captured stderr file
+    (what :class:`WorkerFailedError.failures` carries per corpse)."""
     try:
         with open(path, "rb") as fh:
             fh.seek(0, os.SEEK_END)
@@ -64,6 +66,23 @@ def _stderr_tail(path: str, limit: int = 4000) -> str:
             return fh.read().decode("utf-8", "replace").strip()
     except OSError:
         return "<stderr unavailable>"
+
+
+def spawn_worker(argv, label, err_files: dict, *, env=None):
+    """Spawn one worker subprocess with per-process stderr capture.
+
+    The launcher's stderr-to-file discipline as a reusable primitive (the
+    serving fleet spawns replicas through it): stderr goes to a temp file
+    recorded in ``err_files[label]`` — not a pipe, since nobody drains
+    pipes while workers run and the tail must survive the process — so a
+    death surfaces its actual cause via :func:`stderr_tail`, not a bare
+    exit code.  Returns the ``subprocess.Popen``; the caller owns reaping
+    and unlinking ``err_files`` values."""
+    fd, err_path = tempfile.mkstemp(prefix=f"xtb_worker_{label}_",
+                                    suffix=".stderr")
+    err_files[label] = err_path
+    with os.fdopen(fd, "wb") as ef:
+        return subprocess.Popen(argv, env=env, stderr=ef)
 
 
 _CHILD = r"""
@@ -176,18 +195,11 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
     err_files = {}
 
     def _spawn(label):
-        # stderr to a per-worker file (not a pipe: nobody drains pipes
-        # while workers run, and the tail must survive the process) so a
-        # failure surfaces its actual cause, not a bare exit code
-        fd, err_path = tempfile.mkstemp(prefix=f"xtb_worker_{label}_",
-                                        suffix=".stderr")
-        err_files[label] = err_path
-        with os.fdopen(fd, "wb") as ef:
-            return subprocess.Popen(
-                [sys.executable, "-c", _CHILD, str(label),
-                 str(num_workers), str(port), platform or "", fn_path,
-                 mod_dir, rendezvous],
-                env=env, stderr=ef)
+        return spawn_worker(
+            [sys.executable, "-c", _CHILD, str(label),
+             str(num_workers), str(port), platform or "", fn_path,
+             mod_dir, rendezvous],
+            label, err_files, env=env)
 
     pending = {rank: _spawn(rank) for rank in range(num_workers)}
     respawned = 0
@@ -205,7 +217,7 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
                 if rc == 0:
                     succeeded += 1
                     continue
-                tail = _stderr_tail(err_files[label])
+                tail = stderr_tail(err_files[label])
                 late_respawn = (isinstance(label, str)
                                 and label.startswith("respawn")
                                 and succeeded > 0)

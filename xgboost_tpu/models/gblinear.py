@@ -28,10 +28,25 @@ Two updaters:
     - ``shuffle`` : a fresh deterministic permutation every round (the
       reference's shotgun default), seeded by ``seed`` + round index;
     - ``random``  : sample F coordinates WITH replacement per round
-      (coordinate_common.h RandomFeatureSelector).
+      (coordinate_common.h RandomFeatureSelector);
+    - ``thrifty`` : rank features once per round by the magnitude of their
+      univariate weight change computed from the ROUND-START gradients
+      (ThriftyFeatureSelector::Setup runs before the bias update), visit
+      the ``top_k`` largest in decreasing order (0 = all);
+    - ``greedy``  : interleaved select-and-update — at each of ``top_k``
+      steps recompute every coordinate's weight delta against the CURRENT
+      refreshed gradient, apply the largest-magnitude one
+      (GreedyFeatureSelector::NextFeature; ties resolve to the lowest
+      feature index, and selection stops contributing once every remaining
+      delta is exactly zero, as in the reference's ``dw > best`` scan).
 
-    ``greedy``/``thrifty`` (coordinate_common.h) remain unimplemented and
-    raise — they need the per-coordinate gain ranking, a different shape.
+    ``greedy`` and ``thrifty`` are gain-ranked (coordinate_common.h), so
+    their visit order depends on the gradients: ``thrifty`` goes through
+    :func:`thrifty_order` + :func:`linear_update`, ``greedy`` through
+    :func:`linear_update_greedy` (selection and update are one chain —
+    replaying a pre-computed order against re-derived deltas could drift
+    in the last ulp on near-ties).  Both are bitwise-deterministic for a
+    given (data, params, round).
 
 Missing values are zeros for the linear model, matching the reference (only
 stored sparse entries contribute).
@@ -65,9 +80,10 @@ def selector_order(selector: str, n_features: int, round_idx: int,
             f"unknown feature_selector {selector!r}; expected one of "
             f"{SELECTORS}")
     if selector in ("greedy", "thrifty"):
-        raise NotImplementedError(
-            f"feature_selector={selector!r} is not implemented; use "
-            "cyclic, shuffle, or random")
+        raise ValueError(
+            f"feature_selector={selector!r} is gain-ranked — its order "
+            "depends on the gradients, not just (round, seed); use "
+            "thrifty_order() / linear_update_greedy()")
     if selector == "cyclic":
         return np.arange(n_features, dtype=np.int32)
     rng = np.random.default_rng(
@@ -75,6 +91,71 @@ def selector_order(selector: str, n_features: int, round_idx: int,
     if selector == "shuffle":
         return rng.permutation(n_features).astype(np.int32)
     return rng.integers(0, n_features, size=n_features).astype(np.int32)
+
+
+def effective_top_k(top_k: int, n_features: int) -> int:
+    """coordinate_common.h: ``top_k == 0`` means every feature."""
+    k = int(top_k)
+    return n_features if k <= 0 else min(k, n_features)
+
+
+def thrifty_order(Xz, gpair, weights, *, top_k: int, alpha: float,
+                  lambda_: float) -> np.ndarray:
+    """ThriftyFeatureSelector: rank features by |univariate weight change|
+    against the ROUND-START gradients (reference Setup runs before the bias
+    update), keep the ``top_k`` largest in decreasing order.
+
+    Host-side float64 (the reference accumulates sums in double); stable
+    sort so exact-magnitude ties resolve by feature index, deterministically
+    on every host.  Returns an int32 order for :func:`linear_update`.
+    """
+    Xh = np.asarray(Xz, np.float64)
+    g = np.asarray(gpair[:, 0], np.float64)
+    h = np.asarray(gpair[:, 1], np.float64)
+    w = np.asarray(weights, np.float64)
+    num = Xh.T @ g + lambda_ * w
+    den = (Xh * Xh).T @ h + lambda_
+    dw = np.sign(num) * np.maximum(np.abs(num) - alpha, 0.0) / den
+    k = effective_top_k(top_k, Xh.shape[1])
+    # stable sort on -|dw|: equal magnitudes keep ascending feature order
+    return np.argsort(-np.abs(dw), kind="stable")[:k].astype(np.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def linear_update_greedy(X, gpair, weights, bias, *, steps: int, eta: float,
+                         lambda_: float, alpha: float):
+    """One boosting round with the greedy selector: bias first, then
+    ``steps`` rounds of pick-the-largest-|delta| coordinate against the
+    CURRENT gradient, update it, refresh.  Selection and update are one
+    chain (GreedyFeatureSelector interleaves NextFeature with
+    UpdateFeature), so this returns the final ``(weights, bias, order)``
+    directly; ``order`` holds -1 at steps where every remaining delta was
+    exactly zero (the reference's ``dw > best`` scan selects nothing and
+    the round ends early).
+    """
+    g, h = gpair[:, 0], gpair[:, 1]
+    db = -jnp.sum(g) / jnp.maximum(jnp.sum(h), 1e-6) * eta
+    g = g + h * db
+    den = jnp.sum(X * X * h[:, None], axis=0) + lambda_  # h fixed all round
+
+    def body(carry, _):
+        w, g, used = carry
+        num = X.T @ g + lambda_ * w
+        dwv = -_soft_threshold(num, alpha) / den * eta
+        mag = jnp.where(used, 0.0, jnp.abs(dwv))
+        j = jnp.argmax(mag)  # first occurrence wins ties -> lowest index
+        live = mag[j] > 0
+        dw = jnp.where(live, dwv[j], 0.0)
+        g = g + h * X[:, j] * dw
+        w = w.at[j].add(dw)
+        used = used.at[j].set(True)
+        return (w, g, used), jnp.where(live, j.astype(jnp.int32),
+                                       jnp.int32(-1))
+
+    used0 = jnp.zeros(X.shape[1], bool)
+    (w_new, _, _), order = lax.scan(body, (weights, g, used0), None,
+                                    length=steps)
+    return w_new, bias + db, order
 
 
 @jax.jit

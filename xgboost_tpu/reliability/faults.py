@@ -66,6 +66,7 @@ SEAMS = frozenset({
     "tracker.regroup",
     "checkpoint.write",
     "serve.worker",
+    "fleet.dispatch",
     "native.parallel_for",
 })
 

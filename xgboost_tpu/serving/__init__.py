@@ -24,13 +24,22 @@ Quick start::
 """
 from .batcher import MicroBatcher, QueueFullError, WorkerDiedError
 from .engine import ServeConfig, ServingEngine
+from .fleet import FleetConfig, ServingFleet, SLOClass
 from .metrics import ServingMetrics
+from .modelstore import ModelStore
 from .registry import ModelRegistry
 from .snapshot import InferenceSnapshot
+from .warmcache import WarmProgramCache, configure_persistent_cache
 
 __all__ = [
     "ServingEngine",
     "ServeConfig",
+    "ServingFleet",
+    "FleetConfig",
+    "SLOClass",
+    "ModelStore",
+    "WarmProgramCache",
+    "configure_persistent_cache",
     "ModelRegistry",
     "InferenceSnapshot",
     "MicroBatcher",

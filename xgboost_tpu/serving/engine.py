@@ -337,6 +337,10 @@ class ServingEngine:
                         + prog.base_dev()[None, :]
                     out = margin if output_margin else snap.transform(margin)
                     host = np.asarray(out)
+            elif bucket in snap.aot_programs:
+                # fleet warm path: the AOT fused serve program (warmcache)
+                # — no trace, no jit-cache touch, bitwise the eager path
+                host = np.asarray(snap.aot_execute(Xd, bool(output_margin)))
             else:
                 margin = prog.margin_padded(Xd, donate=False) \
                     + prog.base_dev()[None, :]

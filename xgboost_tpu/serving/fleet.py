@@ -1,0 +1,828 @@
+"""Serving fleet: N replica processes behind one dispatcher.
+
+The multi-process scale-out layer over :class:`ServingEngine`
+(docs/serving.md "Fleet" has the full topology/tuning guide):
+
+- **Replicas** are launcher-spawned subprocesses (``serving/replica.py``)
+  sharing the mmap :class:`ModelStore` (one host copy of every booster)
+  and the warm compile cache (``warmcache.py`` — AOT program file + XLA
+  persistent cache), so adding a replica costs milliseconds of warm work,
+  not seconds of compiles.
+- **The dispatcher** (this module) owns admission and routing: requests
+  queue centrally in priority order (per-tenant :class:`SLOClass`), and
+  each replica holds AT MOST ONE batch in flight.  Central queueing +
+  window-1 is a deliberate failure-semantics choice: when a replica dies,
+  everything except its single in-flight batch is still in the
+  dispatcher's queue — and the in-flight batch itself is requeued onto a
+  live replica (predict is idempotent), so replica death drops nothing
+  (``xtb_fleet_rerouted_total`` counts the reroutes; the fleet smoke and
+  ``tests/test_fleet.py`` pin the no-loss contract).
+- **The request path is zero-copy** end to end (``wire.py``): the
+  dispatcher routes on the tiny JSON header and forwards Arrow IPC /
+  raw-f32 payload buffers verbatim — row bytes are never deserialized,
+  copied, or even looked at outside the replica.
+- **Failure handling** rides the launcher's machinery: replica stderr is
+  captured per process, deaths are tolerated and respawned up to
+  ``max_respawns``, and a fleet that loses every replica (or can't start
+  one) raises :class:`~xgboost_tpu.launcher.WorkerFailedError` carrying
+  each corpse's exit code + stderr tail.
+
+Degradation is explicit, per tenant class: beyond ``max_queue`` queued
+requests the LOWEST-priority newest request is shed
+(:class:`~xgboost_tpu.serving.batcher.QueueFullError`,
+``xtb_fleet_shed_total{slo=}``); a request older than its class deadline
+is expired in-queue (``TimeoutError``, ``xtb_fleet_deadline_total{slo=}``)
+instead of wasting replica time on an answer nobody is waiting for.
+
+The ``fleet.dispatch`` fault seam fires right before a request is handed
+to a replica: ``exception`` fails that request, ``delay`` stalls the
+dispatcher, ``drop_connection`` severs the chosen replica's socket — the
+deterministic stand-in for a replica vanishing mid-conversation
+(docs/reliability.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import os
+import sys
+import tempfile
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
+from socket import socket as Socket
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..launcher import WorkerFailedError, spawn_worker, stderr_tail
+from ..reliability import faults as _faults
+from ..telemetry.registry import get_registry
+from . import wire
+from .batcher import QueueFullError
+
+_LATENCY_BUCKETS = tuple(1e-5 * (4.0 ** i) for i in range(12))
+_COLDSTART_BUCKETS = tuple(0.01 * (2.0 ** i) for i in range(14))
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOClass:
+    """One tenant class: who gets served first and how long they wait.
+
+    ``priority``: higher dispatches first and sheds last.  ``deadline_s``:
+    submit-to-result budget — expired queued requests fail fast with
+    ``TimeoutError`` instead of occupying a replica (None = wait forever).
+    """
+
+    name: str = "default"
+    priority: int = 0
+    deadline_s: Optional[float] = None
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    n_replicas: int = 2
+    store_dir: Optional[str] = None   # None = private temp dir
+    cache_dir: Optional[str] = None   # None = no warm cache (always cold)
+    warmup_buckets: Tuple[int, ...] = ()  # () = replica default ladder
+    max_queue: int = 4096             # queued requests before shedding
+    slo_classes: Dict[str, SLOClass] = dataclasses.field(
+        default_factory=dict)       # tenant -> class
+    default_slo: SLOClass = dataclasses.field(default_factory=SLOClass)
+    nthread_per_replica: int = 1      # native pool width per replica
+    max_respawns: int = 2
+    ready_timeout_s: float = 300.0
+    platform: Optional[str] = None    # replica jax platform (None = inherit)
+
+    def __post_init__(self) -> None:
+        if self.n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        if self.max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+
+    def resolve_slo(self, tenant: Optional[str]) -> SLOClass:
+        if tenant is None:
+            return self.default_slo
+        return self.slo_classes.get(tenant, self.default_slo)
+
+
+class _Instruments:
+    """xtb_fleet_* registry families (process-wide singleton)."""
+
+    _singleton = None
+
+    def __init__(self) -> None:
+        reg = get_registry()
+        self.replicas = reg.gauge(
+            "xtb_fleet_replicas", "live (ready) fleet replicas")
+        self.requests = reg.counter(
+            "xtb_fleet_requests_total", "requests dispatched to replicas",
+            ("model",))
+        self.rerouted = reg.counter(
+            "xtb_fleet_rerouted_total",
+            "in-flight requests requeued after a replica death")
+        self.respawns = reg.counter(
+            "xtb_fleet_respawns_total", "replacement replicas spawned")
+        self.shed = reg.counter(
+            "xtb_fleet_shed_total",
+            "requests shed at admission (queue full)", ("slo",))
+        self.deadline = reg.counter(
+            "xtb_fleet_deadline_total",
+            "requests expired before/at their class deadline", ("slo",))
+        self.latency = reg.histogram(
+            "xtb_fleet_latency_seconds", "submit-to-result request latency",
+            ("model",), buckets=_LATENCY_BUCKETS)
+        self.coldstart = reg.histogram(
+            "xtb_fleet_coldstart_seconds",
+            "replica warm-work seconds at ready, by compile-cache state",
+            ("cache",), buckets=_COLDSTART_BUCKETS)
+
+    @classmethod
+    def get(cls) -> "_Instruments":
+        if cls._singleton is None:
+            cls._singleton = cls()
+        return cls._singleton
+
+
+class _Request:
+    __slots__ = ("id", "model", "header", "payload", "future",
+                 "slo", "deadline", "t_submit", "tries", "state")
+
+    def __init__(self, rid: int, model: str, header: dict, payload,
+                 slo: SLOClass) -> None:
+        self.id = rid
+        self.model = model
+        self.header = header
+        self.payload = payload
+        self.future: Future = Future()
+        self.slo = slo
+        self.t_submit = time.monotonic()
+        self.deadline = (self.t_submit + slo.deadline_s
+                         if slo.deadline_s is not None else None)
+        self.tries = 0
+        self.state = "queued"  # queued | inflight | done | shed | expired
+
+
+class DispatchQueue:
+    """Priority queue with SLO-ordered shedding (NOT thread-safe: the
+    fleet holds its lock around every call; standalone so the shed/expiry
+    policy is unit-testable without processes).
+
+    Order: higher ``SLOClass.priority`` first, FIFO within a class.  When
+    full, the victim is the NEWEST request of the LOWEST priority class —
+    and only if the incoming request outranks it; an incoming request that
+    doesn't outrank anyone is shed itself (equal priority sheds the
+    newcomer: FIFO fairness).
+    """
+
+    def __init__(self, max_queue: int) -> None:
+        self.max_queue = int(max_queue)
+        self._heap: List[Tuple[int, int, _Request]] = []
+        self._seq = itertools.count()
+        self._live = 0
+
+    def __len__(self) -> int:
+        return self._live
+
+    def push(self, req: _Request) -> Optional[_Request]:
+        """Admit ``req``; returns the request shed to make room (which may
+        be ``req`` itself), or None when nothing was shed."""
+        victim = None
+        if self._live >= self.max_queue:
+            # victim = newest request of the lowest-priority class (heap
+            # entries carry (-priority, seq): max picks exactly that).
+            # Removed PHYSICALLY, not just by state: under a sustained
+            # overload with no pops (every replica stalled) lazy removal
+            # would grow the heap — and the shed payload buffers it
+            # retains — by one entry per shed, without bound.
+            cands = [e for e in self._heap if e[2].state == "queued"]
+            entry = max(cands, key=lambda e: (e[0], e[1]), default=None)
+            if entry is not None and -entry[0] < req.priority_():
+                victim = entry[2]
+                victim.state = "shed"
+                self._heap.remove(entry)
+                heapq.heapify(self._heap)
+                self._live -= 1
+            else:  # nobody outranked: the newcomer is the victim
+                req.state = "shed"
+                return req
+        heapq.heappush(self._heap, (-req.priority_(), next(self._seq), req))
+        self._live += 1
+        return victim
+
+    def pop(self, now: float) -> Tuple[Optional[_Request], List[_Request]]:
+        """Highest-priority oldest live request, plus any expired on the
+        way (deadline passed while queued)."""
+        expired: List[_Request] = []
+        while self._heap:
+            _, _, req = self._heap[0]
+            if req.state != "queued":  # lazily drop shed/expired/cancelled
+                heapq.heappop(self._heap)
+                continue
+            if req.future.cancelled():
+                # the caller timed out and cancelled: don't burn a replica
+                # on an answer nobody will read
+                heapq.heappop(self._heap)
+                req.state = "done"
+                self._live -= 1
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                heapq.heappop(self._heap)
+                req.state = "expired"
+                self._live -= 1
+                expired.append(req)
+                continue
+            heapq.heappop(self._heap)
+            req.state = "inflight"
+            self._live -= 1
+            return req, expired
+        return None, expired
+
+    def requeue_front(self, req: _Request) -> None:
+        """Put a rerouted in-flight request back at the FRONT of its
+        class (seq below everything queued so far)."""
+        req.state = "queued"
+        # negative seq sorts below every normally-pushed entry of the class
+        heapq.heappush(self._heap, (-req.priority_(), -next(self._seq), req))
+        self._live += 1
+
+    def drain(self) -> List[_Request]:
+        out = [e[2] for e in self._heap if e[2].state == "queued"]
+        for r in out:
+            r.state = "shed"
+        self._heap.clear()
+        self._live = 0
+        return out
+
+
+# priority accessor lives on the request so DispatchQueue never imports
+# SLOClass details
+_Request.priority_ = lambda self: self.slo.priority  # type: ignore
+
+
+class _Replica:
+    """Dispatcher-side view of one replica process (plain struct; all
+    mutation happens under the fleet condition variable)."""
+
+    __slots__ = ("label", "proc", "sock", "rx", "in_flight", "ready_info",
+                 "alive")
+
+    def __init__(self, label: str, proc) -> None:
+        self.label = label
+        self.proc = proc
+        self.sock: Optional[Socket] = None
+        self.rx: Optional[threading.Thread] = None
+        self.in_flight: Optional[_Request] = None
+        self.ready_info: Optional[dict] = None
+        self.alive = False
+
+
+_ERR_TYPES = {"ValueError": ValueError, "KeyError": KeyError,
+              "TimeoutError": TimeoutError, "TypeError": TypeError}
+
+
+class ServingFleet:
+    """Spawn, route, survive.  ``models`` maps name -> Booster or model
+    path (published into the store at start); alternatively pass a
+    pre-populated ``store_dir`` and ``models=None``.
+
+    Usage::
+
+        from xgboost_tpu.serving import ServingFleet, SLOClass
+
+        with ServingFleet({"ctr": booster}, n_replicas=4,
+                          cache_dir="/var/cache/xtb-fleet") as fleet:
+            y = fleet.predict("ctr", rows)                  # numpy path
+            y = fleet.predict_arrow("ctr", record_batch)    # arrow path
+    """
+
+    def __init__(self, models: Optional[Dict[str, Any]] = None,
+                 config: Optional[FleetConfig] = None, **overrides) -> None:
+        if config is None:
+            config = FleetConfig(**overrides)
+        elif overrides:
+            config = dataclasses.replace(config, **overrides)
+        self.config = config
+        self._models = dict(models or {})
+        self._ins = _Instruments.get()
+        self._cv = threading.Condition()
+        self._queue = DispatchQueue(config.max_queue)
+        self._replicas: Dict[str, _Replica] = {}
+        self._failures: List[Tuple[str, int, str]] = []
+        self._err_files: Dict[str, str] = {}
+        self._next_id = itertools.count(1)
+        self._respawned = 0
+        self._started = False
+        self._closed = False
+        self._extinct = False  # every replica dead, respawn budget spent
+        self._listener: Optional[Socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._sched_thread: Optional[threading.Thread] = None
+        self._store_dir: Optional[str] = None
+        self._tmp_store = False
+
+    # ---------------------------------------------------------------- start
+    def start(self) -> "ServingFleet":
+        import socket as socketlib
+
+        from .modelstore import ModelStore
+
+        with self._cv:
+            if self._started:
+                return self
+            self._started = True
+            self._store_dir = self.config.store_dir
+            if self._store_dir is None:
+                self._store_dir = tempfile.mkdtemp(prefix="xtb_fleet_store_")
+                self._tmp_store = True
+        store = ModelStore(self._store_dir)
+        for name, source in self._models.items():
+            store.publish(name, source)
+        if not store.entries():
+            raise ValueError("fleet has no models: pass models= or a "
+                             "pre-populated store_dir=")
+        listener = socketlib.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(max(8, self.config.n_replicas * 2))
+        with self._cv:
+            self._listener = listener
+        accept = threading.Thread(target=self._accept_loop, daemon=True,
+                                  name="xtb-fleet-accept")
+        sched = threading.Thread(target=self._dispatch_loop, daemon=True,
+                                 name="xtb-fleet-dispatch")
+        with self._cv:
+            self._accept_thread = accept
+            self._sched_thread = sched
+        for i in range(self.config.n_replicas):
+            self._spawn(f"replica{i}")
+        accept.start()
+        sched.start()
+        deadline = time.monotonic() + self.config.ready_timeout_s
+        with self._cv:
+            while True:
+                ready = sum(1 for r in self._replicas.values() if r.alive)
+                remaining = deadline - time.monotonic()
+                if (ready >= self.config.n_replicas or self._closed
+                        or self._extinct or remaining <= 0):
+                    # extinct = every replica already crashed and the
+                    # respawn budget is spent: fail NOW, not at timeout
+                    failures = list(self._failures)
+                    break
+                self._cv.wait(timeout=min(remaining, 0.5))
+        if ready < self.config.n_replicas:
+            self._shutdown()
+            raise WorkerFailedError(
+                f"fleet start: only {ready}/{self.config.n_replicas} "
+                f"replicas became ready within "
+                f"{self.config.ready_timeout_s}s", failures)
+        return self
+
+    def _spawn(self, label: str) -> None:
+        port = self._listener.getsockname()[1]
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        plat = self.config.platform
+        if plat is None:
+            try:
+                import jax
+
+                plat = jax.default_backend()
+            except Exception:
+                plat = None
+        if plat == "cpu" and self.config.nthread_per_replica > 0:
+            # N replicas each spawning an ncores-wide spinning XLA intra-op
+            # pool convoy each other off the host (4 replicas on 2 cores
+            # measured ~10x per-request inflation); one knob caps BOTH
+            # pools — the native XtbThreadPool (--nthread) and XLA's —
+            # at the configured per-replica width.  This REPLACES any
+            # inherited XLA_FLAGS for CPU replicas (set
+            # nthread_per_replica=0 to pass the parent's flags through);
+            # on other backends replicas inherit the environment as-is.
+            env["XLA_FLAGS"] = (
+                "--xla_cpu_multi_thread_eigen=false "
+                f"intra_op_parallelism_threads="
+                f"{self.config.nthread_per_replica}")
+        argv = [sys.executable, "-m", "xgboost_tpu.serving.replica",
+                "--host", "127.0.0.1", "--port", str(port),
+                "--store", self._store_dir, "--label", label,
+                "--nthread", str(self.config.nthread_per_replica)]
+        if self.config.cache_dir:
+            argv += ["--cache", self.config.cache_dir]
+        if self.config.platform:
+            argv += ["--platform", self.config.platform]
+        if self.config.warmup_buckets:
+            argv += ["--buckets",
+                     ",".join(str(b) for b in self.config.warmup_buckets)]
+        proc = spawn_worker(argv, label, self._err_files, env=env)
+        with self._cv:
+            self._replicas[label] = _Replica(label, proc)
+
+    # ------------------------------------------------------------- accepting
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed: fleet shutting down
+            wire.configure(sock)
+            try:
+                sock.settimeout(self.config.ready_timeout_s)
+                hello, _ = wire.recv_frame(sock)
+                ready, _ = wire.recv_frame(sock)
+                sock.settimeout(None)
+                label = hello.get("label", "?")
+            except (wire.WireError, OSError):
+                sock.close()
+                continue
+            rx = threading.Thread(target=self._rx_loop, args=(label, sock),
+                                  daemon=True, name=f"xtb-fleet-rx-{label}")
+            with self._cv:
+                rep = self._replicas.get(label)
+                if rep is None or self._closed:
+                    sock.close()
+                    continue
+                rep.sock = sock
+                rep.rx = rx
+                rep.ready_info = ready
+                rep.alive = True
+                self._ins.replicas.set(
+                    sum(1 for r in self._replicas.values() if r.alive))
+                self._cv.notify_all()
+            self._ins.coldstart.labels(
+                ready.get("cache_state", "cold")).observe(
+                float(ready.get("warmup_s", 0.0)))
+            rx.start()
+
+    # ------------------------------------------------------------ rx per rep
+    def _rx_loop(self, label: str, sock) -> None:
+        # buffered frame source: one GIL release/reacquire per frame
+        # instead of three — the reacquire under a many-threaded
+        # dispatcher was profiled at ~ms of convoy per request
+        stream = wire.reader(sock)
+        while True:
+            try:
+                header, payload = wire.recv_frame(stream)
+            except (wire.WireError, OSError) as e:
+                self._on_replica_death(label, e)
+                return
+            op = header.get("op")
+            # one critical section per completion: free the replica AND
+            # claim its next request.  The hot path never notifies the cv —
+            # per-request notify_all wakes the housekeeping thread (which
+            # polls every replica process) and convoys every rx thread on
+            # the lock; profiled as the fleet=4 throughput collapse.
+            nxt = None
+            expired: List[_Request] = []
+            with self._cv:
+                rep = self._replicas.get(label)
+                req = rep.in_flight if rep is not None else None
+                if rep is not None:
+                    rep.in_flight = None
+                    if rep.alive and not self._closed:
+                        nxt, expired = self._queue.pop(time.monotonic())
+                        if nxt is not None:
+                            rep.in_flight = nxt
+            self._expire(expired)
+            if nxt is not None:
+                # next request on the wire BEFORE this result's caller is
+                # woken: the replica computes while the client-side wake
+                # and copy-out happen, instead of idling through them
+                self._send(rep, nxt)
+            if req is None or header.get("id") != req.id:
+                continue  # late/unmatched frame (e.g. post-reroute twin)
+            if op == "result":
+                shape = tuple(int(x) for x in header["shape"])
+                arr = np.frombuffer(payload, np.float32).reshape(shape)
+                self._finish(req, arr)
+            else:
+                etype = _ERR_TYPES.get(header.get("etype", ""), RuntimeError)
+                self._fail(req, etype(header.get("error", "replica error")))
+
+    def _finish(self, req: _Request, arr: np.ndarray) -> None:
+        req.state = "done"
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_result(arr)
+            # only delivered results count: an abandoned (caller-timed-out,
+            # cancelled) request's latency would skew the histogram
+            self._ins.latency.labels(req.model).observe(
+                time.monotonic() - req.t_submit)
+
+    def _fail(self, req: _Request, exc: BaseException) -> None:
+        req.state = "done"
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_exception(exc)
+
+    def _expire(self, expired: List[_Request]) -> None:
+        """Fail requests whose class deadline passed while queued."""
+        for r in expired:
+            self._ins.deadline.labels(r.slo.name).inc()
+            self._fail(r, TimeoutError(
+                f"request {r.id} ({r.model}) expired in queue after "
+                f"{r.slo.deadline_s}s (slo={r.slo.name})"))
+
+    # ----------------------------------------------------------- death path
+    def _on_replica_death(self, label: str, cause: BaseException) -> None:
+        with self._cv:
+            rep = self._replicas.pop(label, None)
+            if rep is None:
+                return
+            closed = self._closed
+            req = rep.in_flight
+            rep.in_flight = None
+            rep.alive = False
+            self._ins.replicas.set(
+                sum(1 for r in self._replicas.values() if r.alive))
+            if req is not None and not closed:
+                # the dead replica's batch: requeue at the front (predict
+                # is idempotent; the twin result from the corpse, if any,
+                # is dropped by the id check in _rx_loop)
+                req.tries += 1
+                if req.tries <= 3:
+                    self._queue.requeue_front(req)
+                    self._ins.rerouted.inc()
+                    req = None
+            respawn = (not closed
+                       and self._respawned < self.config.max_respawns)
+            if respawn:
+                self._respawned += 1
+                n = self._respawned
+            self._cv.notify_all()
+        try:
+            rep.sock and rep.sock.close()
+        except OSError:
+            pass
+        rc = rep.proc.poll()
+        tail = stderr_tail(self._err_files.get(label, ""))
+        with self._cv:
+            self._failures.append((label, rc if rc is not None else -1,
+                                   tail))
+        if req is not None:
+            self._fail(req, WorkerFailedError(
+                f"request {req.id} lost to replica {label} "
+                f"{req.tries} times (exit={rc}): {cause}",
+                [(label, rc if rc is not None else -1, tail)]))
+        if req is None and not closed:
+            self._pump()  # the requeued request goes to a live replica now
+        if respawn:
+            self._ins.respawns.inc()
+            self._spawn(f"respawn{n}")
+        elif not self._alive_or_pending():
+            # fleet extinct: nothing will ever drain the queue — fail what
+            # is queued AND mark the fleet so later submits fail fast
+            # instead of queueing into a hang
+            failures = list(self._failures)
+            with self._cv:
+                self._extinct = True
+                dead = self._queue.drain()
+                self._cv.notify_all()
+            err = WorkerFailedError(
+                "every fleet replica died and the respawn budget is spent",
+                failures)
+            for r in dead:
+                self._fail(r, err)
+
+    def _alive_or_pending(self) -> bool:
+        with self._cv:
+            return any(r.proc.poll() is None or r.alive
+                       for r in self._replicas.values())
+
+    # ------------------------------------------------------------ dispatching
+    def _dispatch_loop(self) -> None:
+        """Housekeeping only: reap pre-ready crashes and run a periodic
+        fallback pump.  The hot path never waits on this thread — requests
+        go to replicas directly from the thread that created the work or
+        the capacity (:meth:`_pump`), because a per-request hand-off
+        through one scheduler thread costs two GIL/condvar wake hops per
+        request and caps fleet throughput at single-replica rates."""
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+                self._reap_locked()
+                self._cv.wait(timeout=0.2)
+                if self._closed:
+                    return
+            self._pump()
+
+    def _pump(self) -> None:
+        """Dispatch queued requests onto free replicas until one side runs
+        dry.  Called wherever work or capacity appears: submit(), the rx
+        loop on completion, the death path after a requeue, and the
+        housekeeping loop.  Safe from any number of threads at once — the
+        pop and the replica in_flight claim are one critical section, so
+        two pumpers can never double-assign; the socket send runs outside
+        the lock."""
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                req, expired = (None, [])
+                free = [r for r in self._replicas.values()
+                        if r.alive and r.in_flight is None]
+                if free:
+                    req, expired = self._queue.pop(now)
+                target = None
+                if req is not None:
+                    target = free[0]
+                    target.in_flight = req
+            self._expire(expired)
+            if req is None:
+                return
+            self._send(target, req)
+
+    def _send(self, rep: _Replica, req: _Request) -> None:
+        try:
+            spec = _faults.maybe_inject("fleet.dispatch")
+        except _faults.FaultInjected as e:
+            with self._cv:
+                rep.in_flight = None
+                self._cv.notify_all()
+            self._fail(req, e)
+            return
+        if spec is not None and spec.kind == "drop_connection":
+            # sever the chosen replica's socket (in_flight already carries
+            # this request): the rx loop sees EOF and runs the death path,
+            # which requeues the request onto a surviving replica
+            try:
+                rep.sock.shutdown(2)
+            except OSError:
+                pass
+            return
+        try:
+            wire.send_frame(rep.sock, req.header, req.payload)
+            self._ins.requests.labels(req.model).inc()
+        except OSError as e:
+            self._on_replica_death(rep.label, e)
+
+    # ------------------------------------------------------------------ API
+    def submit(self, model: str, X=None, *, arrow=None,
+               tenant: Optional[str] = None, output_margin: bool = False,
+               version: Optional[int] = None) -> Future:
+        """Queue one predict; returns a Future of the result rows.  Pass
+        ``X`` (numpy, raw path) or ``arrow`` (pyarrow RecordBatch/Table —
+        or pre-encoded IPC bytes, forwarded untouched)."""
+        if (X is None) == (arrow is None):
+            raise ValueError("pass exactly one of X= or arrow=")
+        if X is not None:
+            fields, payload = wire.encode_raw(np.asarray(X))
+        elif isinstance(arrow, (bytes, bytearray, memoryview)):
+            fields, payload = {"enc": wire.ARROW}, memoryview(arrow)
+        else:
+            fields, payload = wire.encode_arrow(arrow)
+        slo = self.config.resolve_slo(tenant)
+        # everything but the queue push happens outside the cv (the lock is
+        # the fleet's one contended resource; hot-path critical sections
+        # stay tiny and notify-free)
+        rid = next(self._next_id)  # itertools.count is atomic
+        header = dict(fields)
+        header.update({"op": "predict", "id": rid, "model": model,
+                       "margin": bool(output_margin)})
+        if version is not None:
+            header["version"] = int(version)
+        req = _Request(rid, model, header, payload, slo)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("ServingFleet is closed")
+            if not self._started:
+                raise RuntimeError("ServingFleet.start() has not run")
+            if self._extinct:
+                raise WorkerFailedError(
+                    "every fleet replica died and the respawn budget is "
+                    "spent", list(self._failures))
+            victim = self._queue.push(req)
+        if victim is not None:
+            self._ins.shed.labels(victim.slo.name).inc()
+            self._fail(victim, QueueFullError(
+                f"fleet queue full ({self.config.max_queue} requests); "
+                f"shed slo={victim.slo.name} request {victim.id}"))
+        self._pump()  # a free replica takes this request on OUR thread
+        return req.future
+
+    def predict(self, model: str, X, *, tenant: Optional[str] = None,
+                output_margin: bool = False, version: Optional[int] = None,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking predict through the fleet (numpy request path)."""
+        slo = self.config.resolve_slo(tenant)
+        fut = self.submit(model, X, tenant=tenant,
+                          output_margin=output_margin, version=version)
+        return self._wait(fut, timeout, slo, model)
+
+    def predict_arrow(self, model: str, batch, *,
+                      tenant: Optional[str] = None,
+                      output_margin: bool = False,
+                      version: Optional[int] = None,
+                      timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking predict with an Arrow RecordBatch/Table (or IPC
+        bytes): the zero-copy request path."""
+        slo = self.config.resolve_slo(tenant)
+        fut = self.submit(model, arrow=batch, tenant=tenant,
+                          output_margin=output_margin, version=version)
+        return self._wait(fut, timeout, slo, model)
+
+    def _wait(self, fut: Future, timeout: Optional[float], slo: SLOClass,
+              model: str) -> np.ndarray:
+        if timeout is None:
+            timeout = slo.deadline_s
+        try:
+            return fut.result(timeout=timeout)
+        except FuturesTimeout:
+            fut.cancel()
+            self._ins.deadline.labels(slo.name).inc()
+            raise TimeoutError(
+                f"predict({model!r}) missed its {timeout}s deadline "
+                f"(slo={slo.name})") from None
+
+    # ---------------------------------------------------------------- admin
+    def replica_info(self) -> List[dict]:
+        """Ready-frame info per live replica (warmup_s, aot hit/compile
+        counts, cache_state) — the cold-start telemetry."""
+        with self._cv:
+            return [dict(r.ready_info) for r in self._replicas.values()
+                    if r.alive and r.ready_info]
+
+    def alive_replicas(self) -> int:
+        with self._cv:
+            return sum(1 for r in self._replicas.values() if r.alive)
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def _reap_locked(self) -> None:
+        """Catch replicas that died without a socket event (pre-connect
+        crash, kill -9 before EOF surfaces).  Caller holds the cv."""
+        dead = [r.label for r in self._replicas.values()
+                if r.proc.poll() is not None and not r.alive
+                and r.sock is None]
+        for label in dead:
+            # run the death path without the lock held
+            threading.Thread(target=self._on_replica_death,
+                             args=(label, RuntimeError(
+                                 "replica exited before ready")),
+                             daemon=True).start()
+
+    def close(self) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        self._shutdown()
+
+    def _shutdown(self) -> None:
+        with self._cv:
+            self._closed = True
+            dead = self._queue.drain()
+            reps = list(self._replicas.values())
+            self._cv.notify_all()
+        err = RuntimeError("ServingFleet closed")
+        for r in dead:
+            self._fail(r, err)
+        if self._sched_thread is not None:
+            self._sched_thread.join(timeout=5)
+        for rep in reps:
+            if rep.sock is not None:
+                try:
+                    wire.send_frame(rep.sock, {"op": "close"})
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 10
+        for rep in reps:
+            while rep.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if rep.proc.poll() is None:
+                rep.proc.kill()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+        for rep in reps:
+            if rep.sock is not None:
+                try:
+                    rep.sock.close()
+                except OSError:
+                    pass
+        for path in self._err_files.values():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        if self._tmp_store and self._store_dir:
+            import shutil
+
+            shutil.rmtree(self._store_dir, ignore_errors=True)
+
+    def __enter__(self) -> "ServingFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,253 @@
+"""mmap-backed read-only model store: one host copy of every booster.
+
+The fleet-scale residency problem (docs/serving.md "Fleet"): N serving
+replicas each building an :class:`InferenceSnapshot` from a model file hold
+N private copies of the stacked tree tensors.  The store publishes each
+model ONCE as an aligned binary arena + JSON meta; replicas open the arena
+with ``np.memmap`` (read-only) and the OS page cache shares the physical
+pages across every process on the host — the ``data/extmem.py`` memmap
+spill idea applied to model weights instead of training pages.
+
+On the CPU backend the zero-copy goes all the way into XLA:
+``jax.device_put`` of a 64-byte-aligned read-only array aliases the mapped
+pages instead of copying (PJRT CPU immutable-zero-copy semantics), so M
+replicas genuinely hold ONE copy of each booster's arrays in host RAM.  On
+accelerator backends the arena is still the single *host* copy; each
+device holds its own resident copy as usual.
+
+Layout (``store_dir/``)::
+
+    manifest.json          {"version": 1, "models": {name: latest_version}}
+    <name>.v<V>.meta.json  snapshot metadata + arena field table
+    <name>.v<V>.arena      64-byte-aligned concatenation of the raw arrays
+
+Publishes are atomic (tmp + rename, manifest rewritten last) so a replica
+opening mid-publish sees either the old or the new version, never a torn
+one.  The arena stores the *snapshot* tensors (stacked node fields, group
+routing, base score) — not the model file — so opening is an mmap + a few
+small JSON reads, with no tree parsing on the replica cold path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_ALIGN = 64  # PJRT CPU zero-copy needs 64-byte-aligned buffers
+_FORMAT_VERSION = 1
+
+
+def _json_params(params: dict) -> dict:
+    """The JSON-safe scalar subset of a booster's params — enough to
+    rebuild the objective (`create_objective(name, params)` reads scalars
+    like num_class / quantile_alpha from it)."""
+    out = {}
+    for k, v in params.items():
+        if isinstance(v, (bool, int, float, str)) or v is None:
+            out[k] = v
+        elif isinstance(v, (list, tuple)) and all(
+                isinstance(x, (bool, int, float, str)) for x in v):
+            out[k] = list(v)
+    return out
+
+
+def _cat_to_json(cat_categories) -> Optional[dict]:
+    if not cat_categories:
+        return None
+    out = {}
+    for fi, vals in cat_categories.items():
+        out[str(int(fi))] = [v.item() if hasattr(v, "item") else v
+                             for v in list(vals)]
+    return out
+
+
+def _cat_from_json(obj) -> Optional[dict]:
+    if not obj:
+        return None
+    return {int(k): list(v) for k, v in obj.items()}
+
+
+class ModelStore:
+    """Open (or create) a model store directory.
+
+    Writer side: :meth:`publish` snapshots a Booster (or model path) into
+    the arena format.  Reader side: :meth:`snapshot` mmaps a published
+    model into an :class:`InferenceSnapshot` whose stacked tensors alias
+    the store file.
+    """
+
+    def __init__(self, store_dir: str) -> None:
+        self.dir = os.fspath(store_dir)
+        os.makedirs(self.dir, exist_ok=True)
+
+    # ------------------------------------------------------------- manifest
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, "manifest.json")
+
+    def manifest(self) -> dict:
+        try:
+            with open(self._manifest_path()) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return {"version": _FORMAT_VERSION, "models": {}}
+
+    def names(self) -> List[str]:
+        return sorted(self.manifest()["models"])
+
+    def latest_version(self, name: str) -> Optional[int]:
+        v = self.manifest()["models"].get(name)
+        return int(v) if v is not None else None
+
+    def _write_manifest(self, manifest: dict) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".manifest.tmp")
+        with os.fdopen(fd, "w") as fh:
+            json.dump(manifest, fh, indent=1)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._manifest_path())
+
+    # -------------------------------------------------------------- publish
+    def publish(self, name: str, source, version: Optional[int] = None,
+                ) -> int:
+        """Snapshot ``source`` (Booster or .json/.ubj path) into the store.
+        Returns the version (auto-incremented when not given)."""
+        from .registry import _load_booster
+        from .snapshot import InferenceSnapshot
+
+        booster = _load_booster(source)
+        snap = InferenceSnapshot.from_booster(booster)
+        if version is None:
+            version = (self.latest_version(name) or 0) + 1
+        version = int(version)
+
+        fields: Dict[str, np.ndarray] = {}
+        if snap.stacked is not None:
+            for k, v in snap.stacked.items():
+                if v is not None:
+                    fields["stacked." + k] = np.asarray(v)
+        if snap.groups is not None:
+            fields["groups"] = np.asarray(snap.groups)
+        fields["base_score"] = np.asarray(snap.base_score, np.float32)
+
+        table = {}
+        fd, tmp_arena = tempfile.mkstemp(dir=self.dir, suffix=".arena.tmp")
+        with os.fdopen(fd, "wb") as fh:
+            off = 0
+            for key in sorted(fields):
+                arr = np.ascontiguousarray(fields[key])
+                pad = (-off) % _ALIGN
+                fh.write(b"\0" * pad)
+                off += pad
+                table[key] = {"offset": off, "shape": list(arr.shape),
+                              "dtype": arr.dtype.str}
+                fh.write(arr.tobytes())
+                off += arr.nbytes
+            fh.flush()
+            os.fsync(fh.fileno())
+
+        meta = {
+            "format": _FORMAT_VERSION,
+            "name": name,
+            "model_version": version,
+            "n_groups": snap.n_groups,
+            "depth": snap.depth,
+            "n_trees": snap.n_trees,
+            "num_features": snap.num_features,
+            "feature_names": snap.feature_names,
+            "cat_categories": _cat_to_json(snap.cat_categories),
+            "objective": str(booster.params.get(
+                "objective", "reg:squarederror")),
+            "params": _json_params(booster.params),
+            "fields": table,
+        }
+        stem = f"{name}.v{version}"
+        fd, tmp_meta = tempfile.mkstemp(dir=self.dir, suffix=".meta.tmp")
+        with os.fdopen(fd, "w") as fh:
+            json.dump(meta, fh, indent=1)
+            fh.flush()
+            os.fsync(fh.fileno())
+        # arena first, then meta, then manifest: a reader resolves through
+        # the manifest, so every hop it can see is complete
+        os.replace(tmp_arena, os.path.join(self.dir, stem + ".arena"))
+        os.replace(tmp_meta, os.path.join(self.dir, stem + ".meta.json"))
+        manifest = self.manifest()
+        manifest["models"][name] = max(
+            int(manifest["models"].get(name, 0)), version)
+        self._write_manifest(manifest)
+        return version
+
+    # ----------------------------------------------------------------- open
+    def snapshot(self, name: str, version: Optional[int] = None,
+                 device: bool = True):
+        """mmap one published model into an :class:`InferenceSnapshot`.
+
+        ``device=True`` runs the arrays through ``jax.device_put`` once
+        (zero-copy aliasing on CPU, a single resident copy elsewhere);
+        ``device=False`` returns raw memmap views (inspection/tests).
+        """
+        from .snapshot import InferenceSnapshot
+
+        if version is None:
+            version = self.latest_version(name)
+            if version is None:
+                raise KeyError(f"model {name!r} is not in the store "
+                               f"({self.dir})")
+        stem = f"{name}.v{int(version)}"
+        with open(os.path.join(self.dir, stem + ".meta.json")) as fh:
+            meta = json.load(fh)
+        if int(meta.get("format", 0)) != _FORMAT_VERSION:
+            raise ValueError(
+                f"store entry {stem} has format {meta.get('format')!r}; "
+                f"this reader understands {_FORMAT_VERSION}")
+        arena = np.memmap(os.path.join(self.dir, stem + ".arena"),
+                          dtype=np.uint8, mode="r")
+
+        def view(key):
+            ent = meta["fields"].get(key)
+            if ent is None:
+                return None
+            dt = np.dtype(ent["dtype"])
+            count = int(np.prod(ent["shape"], dtype=np.int64))
+            return np.frombuffer(arena, dtype=dt, count=count,
+                                 offset=int(ent["offset"])
+                                 ).reshape(ent["shape"])
+
+        def put(arr):
+            if arr is None or not device:
+                return arr
+            import jax
+
+            return jax.device_put(arr)
+
+        stacked = None
+        stacked_keys = [k.split(".", 1)[1] for k in meta["fields"]
+                        if k.startswith("stacked.")]
+        if stacked_keys:
+            stacked = {k: put(view("stacked." + k)) for k in stacked_keys}
+            if "catm" not in stacked:
+                stacked["catm"] = None
+        from ..objective import create_objective
+
+        objective = create_objective(meta["objective"], meta["params"])
+        snap = InferenceSnapshot(
+            stacked=stacked,
+            groups=put(view("groups")),
+            depth=int(meta["depth"]),
+            n_groups=int(meta["n_groups"]),
+            base_score=np.asarray(view("base_score"), np.float32),
+            objective=objective,
+            num_features=int(meta["num_features"]),
+            feature_names=meta.get("feature_names"),
+            cat_categories=_cat_from_json(meta.get("cat_categories")),
+            n_trees=int(meta["n_trees"]),
+        )
+        snap.store_meta = meta  # program-key inputs ride along (warmcache)
+        return snap
+
+    def entries(self) -> List[Tuple[str, int]]:
+        """Every (name, latest_version) pair in the manifest."""
+        return [(n, int(v)) for n, v in
+                sorted(self.manifest()["models"].items())]

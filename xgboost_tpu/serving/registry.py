@@ -82,8 +82,16 @@ class ModelRegistry:
                  ) -> int:
         """Snapshot ``source`` (Booster or model path) under ``name``.
         Returns the version number (auto-incremented when not given)."""
-        booster = _load_booster(source)
-        snap = InferenceSnapshot.from_booster(booster)
+        # replacing a pinned version keeps the pin (the replacement is
+        # what get() now resolves to; it must not become LRU-evictable)
+        return self.register_snapshot(
+            name, InferenceSnapshot.from_booster(_load_booster(source)),
+            version)
+
+    def register_snapshot(self, name: str, snap: InferenceSnapshot,
+                          version: Optional[int] = None) -> int:
+        """Register an already-built snapshot (the fleet replica path:
+        snapshots come out of the mmap ModelStore, not a Booster)."""
         with self._lock:
             if version is None:
                 version = self._latest.get(name, 0) + 1
@@ -91,8 +99,6 @@ class ModelRegistry:
             if (name, version) not in self._entries:
                 self._evict_for_capacity()
             e = _Entry(snap)
-            # replacing a pinned version keeps the pin (the replacement is
-            # what get() now resolves to; it must not become LRU-evictable)
             e.pinned = self._pinned_version.get(name) == version
             self._entries[(name, version)] = e
             self._latest[name] = max(self._latest.get(name, 0), version)

@@ -38,6 +38,22 @@ class InferenceSnapshot:
         self.feature_names = list(feature_names) if feature_names else None
         self.cat_categories = cat_categories  # train-time {feat -> categories}
         self.n_trees = n_trees
+        # bucket -> AOT-compiled fused serve program (warmcache.attach);
+        # engine._execute prefers these — no trace, no compile, same bits
+        self.aot_programs: dict = {}
+        self._aot_base = None  # device copy of base_score for the AOT call
+
+    def aot_execute(self, Xp_dev, output_margin: bool):
+        """Run one bucket-padded batch through the AOT serve program for
+        its row count (caller checked ``aot_programs``).  Returns the
+        margin or transformed output, base score folded in."""
+        if self._aot_base is None:
+            import jax
+
+            self._aot_base = jax.device_put(self.base_score)
+        m, p = self.aot_programs[int(Xp_dev.shape[0])](
+            Xp_dev, self.stacked, self.groups, self._aot_base)
+        return m if output_margin else p
 
     # ------------------------------------------------------------ construct
     @classmethod
