@@ -10,7 +10,8 @@ batch-1 traffic through the micro-batcher — whose engine metrics snapshot
 timed loop is a serving regression, and the suite's smoke test
 (tests/test_serving.py) fails on the same gauge.
 
-Two fleet sections (ISSUE-8, docs/serving.md "Fleet"):
+Three fleet sections (ISSUE-8/ISSUE-9, docs/serving.md "Fleet" +
+"Online model lifecycle"):
 
 - ``fleet_coldstart`` — replica warm-work seconds against a cold vs a
   warm persistent compile cache (cold gets a FRESH cache dir every rep;
@@ -18,6 +19,8 @@ Two fleet sections (ISSUE-8, docs/serving.md "Fleet"):
 - ``fleet_saturation`` — sustained throughput + p99 under mixed
   two-model closed-loop traffic at fleet sizes {1, 2, 4}, all sizes
   measured in this run (the fleet-of-1 row IS the baseline pair).
+- ``lifecycle_swap`` — p99 during a hot version swap vs the same run's
+  steady state, with the requests in flight during each swap recorded.
 
 Host-noise convention (the ladder's): this host is time-shared, so walls
 swing run to run; every timed section repeats ``BENCH_SERVE_REPS`` times
@@ -275,6 +278,96 @@ def bench_fleet_saturation(model_paths: dict, workdir: str,
     return rows
 
 
+def bench_lifecycle_swap(workdir: str, features: int, bst) -> dict:
+    """p99 during a hot swap vs steady state, with requests in flight.
+
+    A 2-replica fleet serves v1 from a model store that already holds a
+    continuation-trained v2 (training and gating excluded — this times
+    the SWAP itself: double-buffered load + serialized activate).  Each
+    rep alternates the active version under continuous client traffic;
+    min-of-N swap walls with the during-swap p99 from the min-wall rep,
+    steady-state p99 from the same run's between-swap windows (a
+    within-run pair, per the host-noise convention).
+    """
+    import xgboost_tpu as xtb
+    from xgboost_tpu.lifecycle import LifecycleConfig, LifecycleManager
+    from xgboost_tpu.serving import ModelStore, ServingFleet
+
+    store = ModelStore(os.path.join(workdir, "lifecycle_store"))
+    store.publish("m", bst)
+    store.set_active("m", 1)
+    rng = np.random.default_rng(3)
+    Xw = rng.normal(size=(4000, features)).astype(np.float32)
+    yw = (Xw[:, 0] + 0.5 * Xw[:, 1] > 0).astype(np.float32)
+    cont = xtb.train(dict(bst.params), xtb.DMatrix(Xw, label=yw), 2,
+                     verbose_eval=False, xgb_model=bst)
+    store.publish("m", cont)
+
+    Xq = Xw[:FLEET_BATCH]
+    n_clients = 4
+    lats, lock, errors = [], threading.Lock(), []
+    stop = threading.Event()
+    swaps = []
+    with ServingFleet(store_dir=store.dir, n_replicas=2,
+                      cache_dir=os.path.join(workdir, "lifecycle_cache"),
+                      warmup_buckets=(FLEET_BATCH,)) as fleet:
+
+        def client(tid):
+            try:
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    fleet.predict("m", Xq, timeout=600)
+                    with lock:
+                        lats.append((t0, time.perf_counter() - t0))
+            except BaseException as e:  # pragma: no cover
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_clients)]
+        for t in threads:
+            t.start()
+        mgr = LifecycleManager(fleet, "m",
+                               config=LifecycleConfig(rounds_per_cycle=1))
+        time.sleep(1.0)  # steady-state lead-in
+        target = 2
+        for _ in range(_reps()):
+            t0 = time.perf_counter()
+            mgr.swap(target)
+            swaps.append((t0, time.perf_counter()))
+            target = 1 if target == 2 else 2
+            time.sleep(0.5)  # steady window between swaps
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(900)
+    if errors:
+        raise RuntimeError(f"lifecycle swap bench errors: {errors[:3]}")
+
+    walls = [t1 - t0 for t0, t1 in swaps]
+    best = int(np.argmin(walls))
+    during_best = [dt for (t, dt) in lats
+                   if swaps[best][0] <= t <= swaps[best][1]]
+    steady = [dt for (t, dt) in lats
+              if not any(a <= t <= b for a, b in swaps)]
+    in_flight = [len([1 for (t, _) in lats if a <= t <= b])
+                 for a, b in swaps]
+    return {
+        "reps": _reps(),
+        "n_replicas": 2,
+        "clients": n_clients,
+        "batch": FLEET_BATCH,
+        "requests_total": len(lats),
+        "swap_wall_s": round(min(walls), 4),
+        "swap_walls_s": [round(w, 4) for w in walls],
+        "requests_during_swap": in_flight[best],
+        "requests_during_swap_all": in_flight,
+        "p99_during_ms": round(float(np.percentile(during_best, 99)) * 1e3,
+                               3) if during_best else None,
+        "p99_steady_ms": round(float(np.percentile(steady, 99)) * 1e3, 3),
+        "p50_steady_ms": round(float(np.percentile(steady, 50)) * 1e3, 3),
+    }
+
+
 def main(out_path: str) -> int:
     import jax
 
@@ -358,6 +451,12 @@ def main(out_path: str) -> int:
             print(f"fleet-of-{sat[-1]['n_replicas']} vs single: "
                   f"{top / base:.2f}x "
                   f"({report.get('fleet_scaling_note', 'replica-limited')})")
+            ls = bench_lifecycle_swap(workdir, features, bst)
+            report["lifecycle_swap"] = ls
+            print(f"lifecycle swap: wall={ls['swap_wall_s'] * 1e3:.0f}ms  "
+                  f"{ls['requests_during_swap']} requests in flight  "
+                  f"p99 during={ls['p99_during_ms']}ms "
+                  f"steady={ls['p99_steady_ms']}ms")
             if cs["speedup"] < 10:
                 print("FAIL: warm-cache cold-start speedup < 10x",
                       file=sys.stderr)

@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Online-model-lifecycle smoke for the nightly suite
+(docs/serving.md "Online model lifecycle").
+
+Two scenarios, end to end against real replica processes:
+
+1. **Swap under traffic.**  Serve a base model from a 2-replica fleet,
+   drive sustained client traffic, continuation-train a candidate on
+   fresh rows, gate it, and hot-swap it in (with a shadow phase).
+   Assert ZERO dropped/failed requests across the swap, post-swap
+   predictions bitwise-stable, and a gate-rejected follow-up cycle
+   leaving those bits untouched.  The p99 of requests issued during the
+   swap window is printed next to steady-state p99 (recorded, not
+   gated — this host is time-shared).
+
+2. **Kill mid-swap.**  Replay the cycle in a subprocess with a
+   ``lifecycle.swap`` kill fault installed: the manager dies (hard
+   ``os._exit``) after the candidate is loaded onto replicas but BEFORE
+   the durable ``set_active`` commit.  Assert the store manifest still
+   names the incumbent and a RESTARTED fleet over the same store serves
+   the incumbent's exact bits.
+
+Usage: JAX_PLATFORMS=cpu python scripts/lifecycle_smoke.py [n_replicas] [reqs]
+"""
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+N_CLIENTS = 4
+BATCH = 64
+KILL_EXIT = 43  # faults.py FaultSpec.exit_code default
+
+
+def _data(seed, n=3000, f=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 3,
+          "eval_metric": "logloss", "seed": 7}
+
+
+def _publish_base(store_dir):
+    """Deterministic base model -> store v1 (shared by both scenarios and
+    the kill-replay child, which re-derives nothing from the parent)."""
+    import xgboost_tpu as xtb
+    from xgboost_tpu.serving import ModelStore
+
+    X, y = _data(seed=20)
+    base = xtb.train(PARAMS, xtb.DMatrix(X[:2000], label=y[:2000]), 4,
+                     verbose_eval=False)
+    st = ModelStore(store_dir)
+    st.publish("m", base)
+    st.set_active("m", 1)
+    return X, y, base
+
+
+def swap_under_traffic(workdir, n_replicas, total_requests) -> int:
+    from xgboost_tpu.lifecycle import LifecycleConfig, LifecycleManager
+    from xgboost_tpu.serving import ServingFleet
+
+    store_dir = os.path.join(workdir, "store")
+    X, y, base = _publish_base(store_dir)
+    Xq = X[:BATCH]
+
+    lats = []  # (t_issued, latency)
+    lats_lock = threading.Lock()
+    errors, stop = [], threading.Event()
+
+    with ServingFleet(store_dir=store_dir, n_replicas=n_replicas,
+                      cache_dir=os.path.join(workdir, "cache"),
+                      warmup_buckets=(BATCH,)) as fleet:
+        ref1 = fleet.predict("m", Xq, timeout=120)
+
+        def client(tid):
+            # continuous until stopped: every issued request must complete
+            # (a dropped one surfaces as an exception -> errors)
+            try:
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    fleet.predict("m", Xq, timeout=600)
+                    with lats_lock:
+                        lats.append((t0, time.perf_counter() - t0))
+            except BaseException as e:
+                errors.append(f"client{tid}: {e!r}")
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(N_CLIENTS)]
+        for t in threads:
+            t.start()
+
+        mgr = LifecycleManager(fleet, "m", config=LifecycleConfig(
+            rounds_per_cycle=3, checkpoint_dir=os.path.join(workdir, "ckpt"),
+            shadow_fraction=0.25, shadow_min_pairs=2))
+        t_swap0 = time.perf_counter()
+        rep = mgr.run_cycle((X[2000:], y[2000:]),
+                            eval_window=(X[:2000], y[:2000]))
+        t_swap1 = time.perf_counter()
+        if not rep.swapped:
+            errors.append(f"cycle did not swap: {rep.decision}")
+        elif (rep.shadow or {}).get("pairs", 0) < 2:
+            errors.append(f"shadow phase never scored: {rep.shadow}")
+        if rep.swapped:
+            out = fleet.predict("m", Xq, timeout=120)
+            if np.array_equal(out, ref1):
+                errors.append("post-swap predictions identical to incumbent "
+                              "(swap did not take)")
+            for _ in range(3):
+                if not np.array_equal(fleet.predict("m", Xq, timeout=120),
+                                      out):
+                    errors.append("post-swap predictions NOT bitwise-stable")
+                    break
+            # gate-rejected follow-up cycle must leave the new bits alone
+            from xgboost_tpu.lifecycle import GateConfig
+            rej = LifecycleManager(fleet, "m", config=LifecycleConfig(
+                rounds_per_cycle=1, gate=GateConfig(min_improvement=1e9)))
+            rep2 = rej.run_cycle((X[2000:], y[2000:]))
+            if rep2.swapped or rep2.decision.reason != "metric":
+                errors.append(f"reject cycle misbehaved: {rep2.decision}")
+            elif not np.array_equal(fleet.predict("m", Xq, timeout=120), out):
+                errors.append("gate-rejected cycle disturbed serving bits")
+
+        stop.set()
+        for t in threads:
+            t.join(900)
+        if any(t.is_alive() for t in threads):
+            errors.append("clients never finished")
+
+    done = len(lats)
+    during = [dt for (t0, dt) in lats if t_swap0 <= t0 <= t_swap1]
+    steady = [dt for (t0, dt) in lats if t0 < t_swap0 or t0 > t_swap1]
+    p99_d = float(np.percentile(during, 99)) if during else 0.0
+    p99_s = float(np.percentile(steady, 99)) if steady else 0.0
+    print(f"lifecycle swap-under-traffic: {done} requests completed, zero "
+          f"failed, through a hot swap ({len(during)} issued during the "
+          f"{t_swap1 - t_swap0:.2f}s cycle); p99 during={p99_d * 1e3:.1f}ms "
+          f"steady={p99_s * 1e3:.1f}ms; shadow pairs="
+          f"{(rep.shadow or {}).get('pairs', 0)}")
+    if errors:
+        print(f"FAIL: {errors[:5]}", file=sys.stderr)
+        return 1
+    if done < total_requests:
+        print(f"FAIL: only {done}/{total_requests} requests flowed — not "
+              f"enough traffic to exercise the swap", file=sys.stderr)
+        return 1
+    return 0
+
+
+def kill_replay_child(store_dir) -> None:
+    """Child body: drive a cycle with a lifecycle.swap KILL installed.
+    os._exit fires after load/shadow, before the durable commit."""
+    from xgboost_tpu.lifecycle import LifecycleConfig, LifecycleManager
+    from xgboost_tpu.reliability import faults
+    from xgboost_tpu.serving import ServingFleet
+
+    X, y = _data(seed=20)
+    with ServingFleet(store_dir=store_dir, n_replicas=1,
+                      warmup_buckets=(BATCH,)) as fleet:
+        fleet.predict("m", X[:BATCH], timeout=120)  # serving for real
+        faults.install([{"site": "lifecycle.swap", "kind": "kill"}])
+        mgr = LifecycleManager(fleet, "m",
+                               config=LifecycleConfig(rounds_per_cycle=2))
+        mgr.run_cycle((X[2000:], y[2000:]),
+                      eval_window=(X[:2000], y[:2000]))
+    print("UNREACHABLE: kill fault never fired", file=sys.stderr)
+    sys.exit(2)
+
+
+def kill_mid_swap(workdir, n_replicas) -> int:
+    from xgboost_tpu.serving import ModelStore, ServingFleet
+
+    store_dir = os.path.join(workdir, "killstore")
+    X, y, base = _publish_base(store_dir)
+    import xgboost_tpu as xtb
+
+    Xq = X[:BATCH]
+    ref = base.predict(xtb.DMatrix(Xq))
+
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--kill-child",
+         store_dir],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        start_new_session=True, timeout=600)
+    if child.returncode != KILL_EXIT:
+        print(f"FAIL: kill child exited {child.returncode}, expected "
+              f"{KILL_EXIT} (the lifecycle.swap kill)", file=sys.stderr)
+        return 1
+
+    st = ModelStore(store_dir)
+    if st.active_version("m") != 1:
+        print(f"FAIL: manifest moved to v{st.active_version('m')} despite "
+              f"dying before the commit", file=sys.stderr)
+        return 1
+    # the crash contract: a RESTARTED fleet over the same store serves the
+    # incumbent's exact bits
+    with ServingFleet(store_dir=store_dir, n_replicas=n_replicas,
+                      warmup_buckets=(BATCH,)) as fleet:
+        out = fleet.predict("m", Xq, timeout=120)
+    if not np.array_equal(out, ref):
+        print("FAIL: restarted fleet does not serve the incumbent's bits",
+              file=sys.stderr)
+        return 1
+    print(f"lifecycle kill-mid-swap: child died at the seam (exit "
+          f"{KILL_EXIT}), manifest still v1, restarted fleet serves the "
+          f"incumbent bitwise")
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--kill-child":
+        kill_replay_child(sys.argv[2])
+        return 2  # unreachable
+
+    n_replicas = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    reqs = int(sys.argv[2]) if len(sys.argv) > 2 else 80
+
+    workdir = tempfile.mkdtemp(prefix="xtb_lifecycle_smoke_")
+    rc = swap_under_traffic(workdir, n_replicas, reqs)
+    rc = rc or kill_mid_swap(workdir, n_replicas)
+    if rc == 0:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+        print("lifecycle smoke OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

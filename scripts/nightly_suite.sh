@@ -81,4 +81,12 @@ JAX_PLATFORMS=cpu python scripts/elastic_smoke.py 4 8
 # reroutes), p99 recorded, and the respawn must restore fleet strength
 JAX_PLATFORMS=cpu python scripts/fleet_smoke.py 3 120
 
+# online-lifecycle smoke (docs/serving.md "Online model lifecycle"):
+# serve -> continuation-train on fresh rows -> gate -> hot-swap under
+# sustained traffic (zero dropped requests, post-swap bitwise-stable,
+# shadow comparator scored), then the cycle replayed with a
+# lifecycle.swap KILL — the manifest must still name the incumbent and a
+# restarted fleet must serve its exact bits
+JAX_PLATFORMS=cpu python scripts/lifecycle_smoke.py 2 60
+
 BENCH_FORCE_CPU=1 BENCH_ROWS=100000 BENCH_ROUNDS=5 python bench.py

@@ -49,6 +49,7 @@ _QUICK_FILES = {
     "test_timer_observer.py", "test_reliability.py",
     "test_serving_faults.py", "test_reliability_multiprocess.py",
     "test_analysis.py", "test_native_threads.py", "test_elastic.py",
+    "test_lifecycle.py", "test_updaters_process.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)
@@ -80,6 +81,8 @@ _QUICK_DENY = {
     "test_cox_partial_likelihood",
     "test_inmemory_elastic_shrink_finishes_at_reduced_world",
     "test_two_process_elastic_shrink_to_single_worker",
+    "test_manager_continuation_resumes_from_checkpoint",
+    "test_lifecycle_end_to_end_fleet",
 }
 
 

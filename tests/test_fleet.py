@@ -529,3 +529,118 @@ def test_fleet_queue_shed_under_pressure(fleet_models):
         assert gold.result(timeout=60) is not None
         ev.wait(timeout=60)
         assert box["f"].result(timeout=60) is not None
+
+
+# =========================================================================
+# DART + refresh/prune boosters through the fleet fast path (the dormant
+# workload axes the lifecycle PR turns live)
+
+
+def _fastpath_for(store, name, buckets=(64,)):
+    """A replica-identical serving stack for one store entry: mmap
+    snapshot -> AOT programs -> _FastPath (the exact path replica.py
+    runs), without spawning processes."""
+    from xgboost_tpu.serving.replica import _FastPath
+
+    snap = store.snapshot(name)
+    WarmProgramCache(None).attach(snap, buckets)
+    return _FastPath(snap), snap
+
+
+def test_fastpath_dart_dropout_free_parity(tmp_path):
+    """DART inference is dropout-free: the _FastPath result (per-tree
+    weights folded into the stacked values) must equal Booster.predict
+    bitwise, and the continuation round-trips through model bytes."""
+    rng = np.random.default_rng(31)
+    X = rng.normal(size=(600, 8)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    params = {"booster": "dart", "objective": "binary:logistic",
+              "rate_drop": 0.4, "one_drop": 1, "max_depth": 3, "seed": 5}
+    bst = xtb.train(params, xtb.DMatrix(X, label=y), 8, verbose_eval=False)
+    assert any(w != 1.0 for w in bst.tree_weights)  # dropout really fired
+
+    store = ModelStore(str(tmp_path))
+    store.publish("dart", bst)
+    fp, snap = _fastpath_for(store, "dart")
+    out = fp.run(X[:64], False)
+    assert out is not None  # the AOT fast path took it, no engine fallback
+    np.testing.assert_array_equal(out, bst.predict(xtb.DMatrix(X[:64])))
+
+    # continuation round-trip: serialized bytes survive store archive and
+    # continue training with the weights intact
+    cont = xtb.train(params, xtb.DMatrix(X, label=y), 2,
+                     verbose_eval=False, xgb_model=store.booster("dart"))
+    assert cont.num_boosted_rounds() == bst.num_boosted_rounds() + 2
+    v2 = store.publish("dart", cont)
+    fp2, _ = _fastpath_for(store, "dart")
+    np.testing.assert_array_equal(
+        fp2.run(X[:64], False), cont.predict(xtb.DMatrix(X[:64])))
+    assert store.model_bytes("dart", v2) == bytes(cont.serialize())
+
+
+def test_fastpath_refresh_prune_same_arch_warms_instantly(tmp_path):
+    """refresh/prune continuation (process_type=update) keeps the tree
+    COUNT and stacked shapes: the arch-keyed program key is unchanged, so
+    the hot-swapped version deserializes the incumbent's AOT programs
+    instead of compiling (the instant-warm half of the swap design) —
+    and the fast path serves it bitwise vs Booster.predict."""
+    rng = np.random.default_rng(32)
+    X = rng.normal(size=(800, 8)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.3 * rng.normal(size=800)).astype(np.float32)
+    X2 = rng.normal(size=(800, 8)).astype(np.float32)
+    y2 = (X2[:, 0] * X2[:, 1]).astype(np.float32)
+    params = {"objective": "reg:squarederror", "max_depth": 4, "eta": 0.5}
+    base = xtb.train(params, xtb.DMatrix(X, label=y), 4, verbose_eval=False)
+
+    store = ModelStore(str(tmp_path))
+    store.publish("m", base)
+    # refresh the leaves against fresh rows via the continuation path
+    refreshed = xtb.train(
+        {**params, "process_type": "update", "updater": "refresh,prune"},
+        xtb.DMatrix(X2, label=y2), base.num_boosted_rounds(),
+        verbose_eval=False, xgb_model=store.booster("m"))
+    assert len(refreshed.trees) == len(base.trees)  # structure preserved
+    assert not np.array_equal(refreshed.predict(xtb.DMatrix(X2[:64])),
+                              base.predict(xtb.DMatrix(X2[:64])))
+    store.publish("m", refreshed)
+
+    s1 = store.snapshot("m", 1)
+    s2 = store.snapshot("m", 2)
+    assert program_key(s1, 64) == program_key(s2, 64)  # same architecture
+
+    # a warm cache populated by the incumbent serves the refresh with
+    # hits only — zero compiles (the double-buffer instant-warm contract)
+    cache = WarmProgramCache(str(tmp_path / "warm"))
+    st1 = cache.attach(s1, (64,))
+    cache.save()
+    cache2 = WarmProgramCache(str(tmp_path / "warm"))
+    st2 = cache2.attach(s2, (64,))
+    assert st1["compiled"] >= 1
+    assert st2 == {**st2, "hits": 1, "compiled": 0}
+
+    from xgboost_tpu.serving.replica import _FastPath
+
+    fp = _FastPath(s2)
+    np.testing.assert_array_equal(fp.run(X2[:64], False),
+                                  refreshed.predict(xtb.DMatrix(X2[:64])))
+
+
+def test_fastpath_refresh_model_bytes_roundtrip(tmp_path):
+    """The lifecycle continuation contract for the updaters: archived
+    model bytes -> booster -> refresh -> serialize -> unserialize is a
+    bitwise fixed point (what hot-swap publishes is exactly what a
+    restarted fleet reloads)."""
+    rng = np.random.default_rng(33)
+    X = rng.normal(size=(500, 6)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1]).astype(np.float32)
+    params = {"objective": "reg:squarederror", "max_depth": 3}
+    base = xtb.train(params, xtb.DMatrix(X, label=y), 3, verbose_eval=False)
+    refreshed = xtb.train(
+        {**params, "process_type": "update", "updater": "refresh"},
+        xtb.DMatrix(X, label=y), 3, verbose_eval=False, xgb_model=base)
+    blob = bytes(refreshed.serialize())
+    b2 = xtb.Booster()
+    b2.unserialize(blob)
+    assert bytes(b2.serialize()) == blob
+    np.testing.assert_array_equal(b2.predict(xtb.DMatrix(X[:32])),
+                                  refreshed.predict(xtb.DMatrix(X[:32])))

@@ -1,0 +1,488 @@
+"""Online model lifecycle: continuation-train -> gate -> hot-swap.
+
+Quick tiers cover the unit seams in isolation: the fresh-traffic window,
+the model store's lifecycle surface (active version, archived model
+bytes, arena checksum), the validation gate's accept/reject/direction
+semantics, the registry retirement hook, and a full manager cycle
+against an in-process stub fleet (ordering + durable-commit contracts
+without processes).  The slow tier drives the real thing end to end:
+a 2-replica fleet under sustained traffic, a continuation-trained
+candidate passing the gate and hot-swapping with zero dropped requests,
+a gate-rejected candidate and a mid-swap fault both leaving the
+incumbent serving bit-identically, and rollback restoring the previous
+version (docs/serving.md "Online model lifecycle").
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.lifecycle import (FreshWindow, GateConfig, LifecycleConfig,
+                                   LifecycleManager, validate_candidate)
+from xgboost_tpu.reliability import faults
+from xgboost_tpu.reliability.checkpoint import CheckpointCallback
+from xgboost_tpu.serving import ModelStore, ServingFleet
+from xgboost_tpu.serving.modelstore import arena_checksum
+from xgboost_tpu.serving.registry import ModelRegistry
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _data(seed=0, n=2000, f=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 3,
+          "eval_metric": "logloss", "seed": 7}
+
+
+def _train(X, y, rounds=4, xgb_model=None, params=PARAMS):
+    return xtb.train(params, xtb.DMatrix(X, label=y), rounds,
+                     verbose_eval=False, xgb_model=xgb_model)
+
+
+# =========================================================================
+# FreshWindow
+
+
+def test_fresh_window_sliding_bound():
+    X, y = _data(n=200)
+    w = FreshWindow(max_rows=90)
+    for i in range(5):
+        w.append(X[i * 40:(i + 1) * 40], y[i * 40:(i + 1) * 40])
+    assert len(w) == 90
+    Xw, yw, wt = w.arrays()
+    # the NEWEST 90 rows survive (oldest fall off the front)
+    np.testing.assert_array_equal(Xw, X[110:200])
+    np.testing.assert_array_equal(yw, y[110:200])
+    assert wt is None
+    assert w.to_dmatrix().num_row() == 90
+    w.clear()
+    with pytest.raises(ValueError):
+        w.arrays()
+
+
+def test_fresh_window_weights_and_validation():
+    X, y = _data(n=100)
+    w = FreshWindow()
+    w.append(X[:50], y[:50], weight=np.ones(50, np.float32))
+    with pytest.raises(ValueError):  # weighted window stays weighted
+        w.append(X[50:], y[50:])
+    with pytest.raises(ValueError):  # length mismatch
+        w.append(X[:10], y[:5])
+
+
+def test_fresh_window_extmem_route():
+    X, y = _data(n=256)
+    w = FreshWindow()
+    w.append(X, y)
+    d = w.to_dmatrix(extmem_chunk_rows=64)
+    assert d.num_row() == 256
+
+
+# =========================================================================
+# ModelStore lifecycle surface
+
+
+def test_store_active_version_distinct_from_latest(tmp_path):
+    X, y = _data()
+    bst = _train(X, y)
+    st = ModelStore(str(tmp_path))
+    v1 = st.publish("m", bst)
+    assert st.active_version("m") == v1  # no commit yet: falls to latest
+    v2 = st.publish("m", bst)
+    assert st.latest_version("m") == v2
+    st.set_active("m", v1)
+    # a later publish moves latest but NOT the committed serving version
+    v3 = st.publish("m", bst)
+    assert (st.latest_version("m"), st.active_version("m")) == (v3, v1)
+    assert st.serving_entries() == [("m", v1)]
+    with pytest.raises(KeyError):
+        st.set_active("m", 99)  # unpublished
+
+
+def test_store_model_bytes_roundtrip_and_checksum(tmp_path):
+    X, y = _data(seed=3)
+    bst = _train(X, y)
+    st = ModelStore(str(tmp_path))
+    v = st.publish("m", bst)
+    # archived bytes ARE the serving model: serialize round-trip equality
+    assert st.model_bytes("m", v) == bytes(bst.serialize())
+    b2 = st.booster("m", v)
+    d = xtb.DMatrix(X)
+    np.testing.assert_array_equal(b2.predict(d), bst.predict(d))
+    # publish-time checksum verifies off the mmapped arena
+    assert st.checksum("m", v)
+    assert st.verify_checksum("m", v)
+
+
+def test_store_checksum_detects_corruption(tmp_path):
+    X, y = _data(seed=4)
+    st = ModelStore(str(tmp_path))
+    v = st.publish("m", _train(X, y))
+    arena = os.path.join(str(tmp_path), f"m.v{v}.arena")
+    blob = bytearray(open(arena, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # one flipped bit in a field byte
+    with open(arena, "wb") as fh:
+        fh.write(blob)
+    assert not st.verify_checksum("m", v)
+
+
+def test_arena_checksum_deterministic_and_field_sensitive():
+    fields = {"a": np.arange(8, dtype=np.float32),
+              "b": np.arange(6, dtype=np.int32).reshape(2, 3)}
+    assert arena_checksum(fields) == arena_checksum(dict(fields))
+    mutated = {**fields, "a": fields["a"].copy()}
+    mutated["a"][0] += 1
+    assert arena_checksum(fields) != arena_checksum(mutated)
+
+
+# =========================================================================
+# Validation gate
+
+
+def test_gate_accepts_improvement_rejects_regression():
+    X, y = _data(seed=5)
+    d = xtb.DMatrix(X, label=y)
+    base = _train(X, y, rounds=4)
+    cont = _train(X, y, rounds=3, xgb_model=base)  # more rounds: better fit
+    dec = validate_candidate(cont, base, d, GateConfig())
+    assert dec.accepted and dec.reason == "accepted"
+    assert dec.metric == "logloss" and dec.improvement > 0
+    # swapped roles: the "candidate" regresses and is rejected, with the
+    # scores in the decision (the deterministic reject path)
+    dec2 = validate_candidate(base, cont, d, GateConfig())
+    assert not dec2.accepted and dec2.reason == "metric"
+    assert dec2.improvement < 0 and "gate-logloss" in dec2.detail
+    # identical candidate passes at min_improvement=0, fails above it
+    assert validate_candidate(base, base, d, GateConfig()).accepted
+    assert not validate_candidate(base, base, d,
+                                  GateConfig(min_improvement=1e-9)).accepted
+
+
+def test_gate_metric_direction_and_selection():
+    X, y = _data(seed=6)
+    params = dict(PARAMS, eval_metric=["auc", "logloss"])
+    d = xtb.DMatrix(X, label=y)
+    base = xtb.train(params, d, 3, verbose_eval=False)
+    cont = xtb.train(params, d, 3, verbose_eval=False, xgb_model=base)
+    # auc is higher-is-better by name inference
+    dec = validate_candidate(cont, base, d, GateConfig(metric="auc"))
+    assert dec.metric == "auc" and dec.accepted
+    # default picks the LAST configured metric (EarlyStopping convention)
+    assert validate_candidate(cont, base, d, GateConfig()).metric == "logloss"
+    with pytest.raises(ValueError):
+        validate_candidate(cont, base, d, GateConfig(metric="rmse"))
+
+
+def test_gate_validate_seam_fires():
+    X, y = _data(seed=7)
+    d = xtb.DMatrix(X, label=y)
+    base = _train(X, y)
+    faults.install([{"site": "lifecycle.validate", "kind": "exception"}])
+    with pytest.raises(faults.FaultInjected):
+        validate_candidate(base, base, d)
+    faults.clear()
+
+
+# =========================================================================
+# Registry retirement hook (satellite: LRU eviction + lifecycle retire
+# share one code path)
+
+
+def test_registry_retire_hook_shared_path():
+    from xgboost_tpu.telemetry.registry import get_registry
+
+    X, y = _data(seed=8)
+    bst = _train(X, y, rounds=2)
+    events = []
+    reg = ModelRegistry(max_models=2)
+    reg.add_retire_hook(lambda n, v, r, s: events.append((n, v, r)))
+    fam = get_registry().get("xtb_serve_evicted_total")
+    before = {k: c.get() for k, c in fam.collect()} if fam else {}
+
+    reg.register("a", bst)
+    reg.register("b", bst)
+    reg.register("c", bst)          # capacity: LRU evicts "a"
+    assert events == [("a", 1, "lru")]
+    reg.remove("b")                 # explicit retirement, same hook
+    assert events == [("a", 1, "lru"), ("b", 1, "retired")]
+    assert reg.names() == ["c"]
+
+    fam = get_registry().get("xtb_serve_evicted_total")
+    after = {k: c.get() for k, c in fam.collect()}
+    assert after.get(("a", "lru"), 0) - before.get(("a", "lru"), 0) == 1
+    assert after.get(("b", "retired"), 0) - before.get(("b", "retired"), 0) == 1
+
+
+def test_registry_pinned_never_lru_evicted_hook_still_fires_on_remove():
+    X, y = _data(seed=9)
+    bst = _train(X, y, rounds=2)
+    events = []
+    reg = ModelRegistry(max_models=2)
+    reg.add_retire_hook(lambda n, v, r, s: events.append((n, v, r)))
+    reg.register("live", bst)
+    reg.pin("live", 1)
+    reg.register("c1", bst)
+    reg.register("c2", bst)   # evicts c1 (live is pinned)
+    assert ("c1", 1, "lru") in events and all(e[0] != "live" for e in events)
+
+
+# =========================================================================
+# Manager against a stub fleet (ordering + durable-commit contracts,
+# no processes)
+
+
+class _StubFleet:
+    """In-process stand-in recording the control-surface calls in order,
+    mirroring ServingFleet's durable-commit semantics."""
+
+    def __init__(self, store):
+        self.store = store
+        self.calls = []
+        self._versions = dict(store.serving_entries())
+        for name, v in store.serving_entries():
+            store.set_active(name, v)
+
+    @property
+    def store_dir(self):
+        return self.store.dir
+
+    def active_version(self, model):
+        return self._versions.get(model)
+
+    def load_version(self, model, version, timeout=None):
+        self.calls.append(("load", model, int(version)))
+        return [{"aot_hits": 0, "aot_compiled": 0}]
+
+    def activate_version(self, model, version, timeout=None):
+        self.store.set_active(model, int(version))  # the durable commit
+        self._versions[model] = int(version)
+        self.calls.append(("activate", model, int(version)))
+        return [{}]
+
+    def retire_version(self, model, version, timeout=None):
+        self.calls.append(("retire", model, int(version)))
+        return [{}]
+
+    def set_shadow(self, model, version, fraction):
+        self.calls.append(("set_shadow", model, int(version), fraction))
+
+    def shadow_stats(self, model):
+        return {"pairs": 5, "failures": 0, "mean_div": 0.0, "max_div": 0.0}
+
+    def clear_shadow(self, model):
+        self.calls.append(("clear_shadow", model))
+        return self.shadow_stats(model)
+
+
+def _stub_pair(tmp_path, seed=10):
+    X, y = _data(seed=seed, n=3000)
+    base = _train(X[:2000], y[:2000])
+    st = ModelStore(str(tmp_path / "store"))
+    st.publish("m", base)
+    return X, y, st, _StubFleet(st)
+
+
+def test_manager_cycle_orders_load_shadow_activate_retire(tmp_path):
+    X, y, st, fleet = _stub_pair(tmp_path)
+    mgr = LifecycleManager(fleet, "m", config=LifecycleConfig(
+        rounds_per_cycle=2, shadow_fraction=0.5, shadow_min_pairs=1))
+    rep = mgr.run_cycle((X[2000:], y[2000:]),
+                        eval_window=(X[:2000], y[:2000]))
+    assert rep.swapped and rep.candidate_version == 2
+    assert rep.decision.accepted and rep.shadow["pairs"] == 5
+    ops = [c[0] for c in fleet.calls]
+    assert ops == ["load", "set_shadow", "clear_shadow", "activate"]
+    assert st.active_version("m") == 2
+    # second cycle retires the version beyond the rollback window
+    rep2 = mgr.run_cycle((X[2000:], y[2000:]),
+                         eval_window=(X[:2000], y[:2000]))
+    assert rep2.swapped and rep2.candidate_version == 3
+    assert ("retire", "m", 1) in fleet.calls
+    assert ("retire", "m", 2) not in fleet.calls  # rollback target stays
+    assert {"train", "validate", "publish", "load", "activate"} <= set(
+        rep2.timings)
+
+
+def test_manager_reject_leaves_active_untouched(tmp_path):
+    X, y, st, fleet = _stub_pair(tmp_path, seed=11)
+    mgr = LifecycleManager(fleet, "m", config=LifecycleConfig(
+        rounds_per_cycle=1, gate=GateConfig(min_improvement=1e9)))
+    rep = mgr.run_cycle((X[2000:], y[2000:]))
+    assert not rep.swapped and rep.decision.reason == "metric"
+    assert rep.candidate_version is None  # rejected BEFORE publish
+    assert st.active_version("m") == 1 and fleet.calls == []
+
+
+def test_manager_validate_fault_is_deterministic_reject(tmp_path):
+    X, y, st, fleet = _stub_pair(tmp_path, seed=12)
+    mgr = LifecycleManager(fleet, "m",
+                           config=LifecycleConfig(rounds_per_cycle=1))
+    faults.install([{"site": "lifecycle.validate", "kind": "exception"}])
+    rep = mgr.run_cycle((X[2000:], y[2000:]))
+    assert not rep.swapped and rep.decision.reason == "fault"
+    assert st.active_version("m") == 1 and fleet.calls == []
+
+
+def test_manager_swap_fault_aborts_before_commit(tmp_path):
+    X, y, st, fleet = _stub_pair(tmp_path, seed=13)
+    mgr = LifecycleManager(fleet, "m",
+                           config=LifecycleConfig(rounds_per_cycle=1))
+    faults.install([{"site": "lifecycle.swap", "kind": "exception"}])
+    rep = mgr.run_cycle((X[2000:], y[2000:]))
+    assert not rep.swapped and rep.decision.reason == "fault"
+    assert rep.candidate_version == 2      # published but never activated
+    assert st.active_version("m") == 1     # commit never happened
+    ops = [c[0] for c in fleet.calls]
+    assert "activate" not in ops
+    assert ("retire", "m", 2) in fleet.calls  # candidate cleaned off replicas
+
+
+def test_manager_rollback_requires_a_swap(tmp_path):
+    X, y, st, fleet = _stub_pair(tmp_path, seed=14)
+    mgr = LifecycleManager(fleet, "m",
+                           config=LifecycleConfig(rounds_per_cycle=1))
+    with pytest.raises(RuntimeError):
+        mgr.rollback()
+    rep = mgr.run_cycle((X[2000:], y[2000:]),
+                        eval_window=(X[:2000], y[:2000]))
+    assert rep.swapped
+    assert mgr.rollback() == 1
+    assert st.active_version("m") == 1
+
+
+def test_manager_continuation_resumes_from_checkpoint(tmp_path):
+    """A continuation killed mid-cycle resumes from its newest checkpoint
+    and lands on the SAME bytes as an uninterrupted continuation (the
+    crash-safety contract; resume_from > xgb_model precedence)."""
+    X, y, st, fleet = _stub_pair(tmp_path, seed=15)
+    base = st.booster("m", 1)
+    dwin = xtb.DMatrix(X[2000:], label=y[2000:])
+    full = xtb.train(PARAMS, xtb.DMatrix(X[2000:], label=y[2000:]), 4,
+                     verbose_eval=False, xgb_model=base)
+
+    mgr = LifecycleManager(fleet, "m", config=LifecycleConfig(
+        rounds_per_cycle=4, checkpoint_dir=str(tmp_path / "ckpt")))
+    # simulate the interrupted first attempt: 2 of 4 rounds, checkpointing
+    # into the cycle's directory, then "crash"
+    ckpt_dir = mgr._ckpt_dir(1)
+    xtb.train(PARAMS, dwin, 2, verbose_eval=False, xgb_model=base,
+              callbacks=[CheckpointCallback(ckpt_dir)])
+    # the retry resumes from round 6 (base 4 + 2) and finishes at 8
+    resumed = mgr.continue_training((X[2000:], y[2000:]))
+    assert resumed.num_boosted_rounds() == 8
+    assert bytes(resumed.serialize()) == bytes(full.serialize())
+
+
+def test_manager_rejected_cycle_consumes_checkpoints(tmp_path):
+    """A finished continuation's checkpoints are consumed even when the
+    gate REJECTS the candidate: the next cycle must train on its own
+    window (resuming a completed stale continuation would re-propose the
+    same rejected candidate forever, and the loop would stop learning)."""
+    from xgboost_tpu.reliability.checkpoint import latest_checkpoint
+
+    X, y, st, fleet = _stub_pair(tmp_path, seed=16)
+    mgr = LifecycleManager(fleet, "m", config=LifecycleConfig(
+        rounds_per_cycle=2, checkpoint_dir=str(tmp_path / "ckpt"),
+        gate=GateConfig(min_improvement=1e9)))
+    rep = mgr.run_cycle((X[2000:], y[2000:]))
+    assert not rep.swapped
+    assert latest_checkpoint(mgr._ckpt_dir(1)) is None  # consumed
+    # the follow-up continuation genuinely trains on a DIFFERENT window:
+    # its bytes equal a fresh continuation on that window, not the
+    # rejected candidate's
+    X2, y2 = _data(seed=61, n=500)
+    cand = mgr.continue_training((X2, y2))
+    fresh = xtb.train(PARAMS, xtb.DMatrix(X2, label=y2), 2,
+                      verbose_eval=False, xgb_model=st.booster("m", 1))
+    assert bytes(cand.serialize()) == bytes(fresh.serialize())
+
+
+# =========================================================================
+# Real fleet, end to end (slow: multi-process)
+
+
+@pytest.mark.slow
+def test_lifecycle_end_to_end_fleet(tmp_path):
+    """The acceptance scenario: under continuous fleet traffic, a
+    continuation-trained candidate passes the gate and hot-swaps with
+    zero dropped requests; a gate-rejected candidate and a mid-swap
+    injected fault both leave the incumbent serving bit-identical
+    predictions; rollback restores the previous version."""
+    X, y = _data(seed=20, n=3000)
+    base = _train(X[:2000], y[:2000])
+    store = ModelStore(str(tmp_path / "store"))
+    store.publish("m", base)
+    Xq = X[:64]
+
+    with ServingFleet(store_dir=store.dir, n_replicas=2,
+                      cache_dir=str(tmp_path / "cache"),
+                      warmup_buckets=(64,)) as fleet:
+        ref1 = fleet.predict("m", Xq, timeout=120)
+        stop = threading.Event()
+        done, errs = [0], []
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    fleet.predict("m", Xq, timeout=120)
+                    done[0] += 1
+                except BaseException as e:  # pragma: no cover
+                    errs.append(repr(e))
+                    return
+
+        th = threading.Thread(target=traffic)
+        th.start()
+        try:
+            mgr = LifecycleManager(fleet, "m", config=LifecycleConfig(
+                rounds_per_cycle=3,
+                checkpoint_dir=str(tmp_path / "ckpt"),
+                shadow_fraction=0.25, shadow_min_pairs=2))
+            rep = mgr.run_cycle((X[2000:], y[2000:]),
+                                eval_window=(X[:2000], y[:2000]))
+            assert rep.swapped and rep.candidate_version == 2
+            assert rep.shadow["pairs"] >= 2 and rep.shadow["failures"] == 0
+            out = fleet.predict("m", Xq, timeout=120)
+            assert not np.array_equal(out, ref1)
+            for _ in range(3):  # post-swap predictions are bitwise-stable
+                np.testing.assert_array_equal(
+                    fleet.predict("m", Xq, timeout=120), out)
+
+            # gate-rejected candidate: incumbent (v2 now) keeps its bits
+            rej = LifecycleManager(fleet, "m", config=LifecycleConfig(
+                rounds_per_cycle=1, gate=GateConfig(min_improvement=1e9)))
+            rep2 = rej.run_cycle((X[2000:], y[2000:]))
+            assert not rep2.swapped and rep2.decision.reason == "metric"
+            np.testing.assert_array_equal(
+                fleet.predict("m", Xq, timeout=120), out)
+
+            # mid-swap fault: candidate published + loaded, never activated
+            faults.install([{"site": "lifecycle.swap", "kind": "exception"}])
+            rep3 = mgr.run_cycle((X[2000:], y[2000:]))
+            faults.clear()
+            assert not rep3.swapped and rep3.decision.reason == "fault"
+            np.testing.assert_array_equal(
+                fleet.predict("m", Xq, timeout=120), out)
+            assert store.active_version("m") == 2
+
+            # rollback restores the previous version's exact bits
+            assert mgr.rollback() == 1
+            np.testing.assert_array_equal(
+                fleet.predict("m", Xq, timeout=120), ref1)
+            assert store.active_version("m") == 1
+        finally:
+            stop.set()
+            th.join(120)
+        assert not errs, errs
+        assert done[0] > 0  # traffic genuinely flowed through the swaps

@@ -333,6 +333,43 @@ def test_resume_from_empty_dir_is_fresh_start(tmp_path):
     assert bytes(res.save_raw()) == bytes(full.save_raw())
 
 
+def test_resume_from_takes_precedence_over_xgb_model(tmp_path):
+    """The documented precedence: when resume_from holds a valid
+    checkpoint, it wins over xgb_model (and num_boost_round becomes the
+    TOTAL target); an EMPTY resume_from falls through to the xgb_model
+    continuation with additive round semantics.  The lifecycle manager's
+    crash-safe continuation leans on exactly this contract."""
+    X, y = _data(seed=5)
+    ckpt_model = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 4,
+                           verbose_eval=False)
+    # a decoy continuation base, deliberately DIFFERENT from the
+    # checkpointed model (other seed -> other trees)
+    decoy = xtb.train({**PARAMS, "seed": 99},
+                      xtb.DMatrix(X[::2], label=y[::2]), 2,
+                      verbose_eval=False)
+
+    ckpt = str(tmp_path / "ckpt")
+    xtb.train(PARAMS, xtb.DMatrix(X, label=y), 4, verbose_eval=False,
+              callbacks=[CheckpointCallback(ckpt)])
+    # both passed: the checkpoint wins, the decoy is ignored, and 6 is the
+    # TOTAL target (4 checkpointed + 2 more)
+    res = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 6, verbose_eval=False,
+                    xgb_model=decoy, resume_from=ckpt)
+    assert res.num_boosted_rounds() == 6
+    expect = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 2,
+                       verbose_eval=False, xgb_model=ckpt_model)
+    assert bytes(res.save_raw()) == bytes(expect.save_raw())
+
+    # empty checkpoint dir: xgb_model is honored, rounds are ADDITIVE
+    res2 = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 2, verbose_eval=False,
+                     xgb_model=decoy,
+                     resume_from=str(tmp_path / "never_written"))
+    assert res2.num_boosted_rounds() == decoy.num_boosted_rounds() + 2
+    cont = xtb.train(PARAMS, xtb.DMatrix(X, label=y), 2, verbose_eval=False,
+                     xgb_model=decoy)
+    assert bytes(res2.save_raw()) == bytes(cont.save_raw())
+
+
 def test_resume_restores_eval_history_and_early_stopping(tmp_path):
     """History and EarlyStopping patience survive the crash: the resumed
     run's evals_result and stopping round match the uninterrupted run's."""

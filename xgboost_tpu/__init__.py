@@ -62,6 +62,7 @@ __all__ = [
     "telemetry",
     "tracker",
     "serving",
+    "lifecycle",
     "train_distributed",
     "plot_importance",
     "plot_tree",
@@ -85,12 +86,12 @@ def __getattr__(name):  # lazy heavy imports
         from . import plotting as _pl
 
         return getattr(_pl, name)
-    if name == "serving":
-        # importlib, not `from . import serving`: the fromlist resolution
-        # getattr's the package for "serving" and would re-enter this hook
+    if name in ("serving", "lifecycle"):
+        # importlib, not `from . import <pkg>`: the fromlist resolution
+        # getattr's the package for the name and would re-enter this hook
         import importlib
 
-        return importlib.import_module(".serving", __name__)
+        return importlib.import_module("." + name, __name__)
     if name == "train_distributed":
         from .distributed import train_distributed
 

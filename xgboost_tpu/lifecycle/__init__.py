@@ -1,0 +1,41 @@
+"""xgboost_tpu.lifecycle — online model lifecycle over the serving fleet.
+
+The train → validate → hot-swap loop (docs/serving.md "Online model
+lifecycle"):
+
+- :class:`LifecycleManager` — drives one model's continuation cycles
+  against a :class:`~xgboost_tpu.serving.fleet.ServingFleet`:
+  crash-safe continuation training on a fresh-traffic window, the
+  validation gate, shadow scoring, the zero-drop hot-swap, rollback.
+- :class:`LifecycleConfig` / :class:`CycleReport` — knobs and outcome.
+- :class:`GateConfig` / :class:`GateDecision` /
+  :func:`validate_candidate` — the metric + bitwise-checksum gate,
+  usable standalone.
+- :class:`FreshWindow` — bounded sliding buffer of labeled traffic.
+
+Quick start::
+
+    from xgboost_tpu.lifecycle import LifecycleManager, FreshWindow
+
+    window = FreshWindow(max_rows=100_000)
+    window.append(X_fresh, y_fresh)          # as labels arrive
+    mgr = LifecycleManager(fleet, "ctr", rounds_per_cycle=5,
+                           shadow_fraction=0.1)
+    report = mgr.run_cycle(window)           # train -> gate -> swap
+    if report.swapped and regret:
+        mgr.rollback()
+"""
+from .gate import GateConfig, GateDecision, score_on, validate_candidate
+from .manager import CycleReport, LifecycleConfig, LifecycleManager
+from .window import FreshWindow
+
+__all__ = [
+    "LifecycleManager",
+    "LifecycleConfig",
+    "CycleReport",
+    "GateConfig",
+    "GateDecision",
+    "validate_candidate",
+    "score_on",
+    "FreshWindow",
+]

@@ -1,0 +1,115 @@
+"""Candidate validation gate: metric threshold vs the incumbent plus a
+bitwise snapshot checksum.
+
+A continuation-trained candidate may only reach the serving fleet through
+this gate (docs/serving.md "Online model lifecycle").  Two halves:
+
+1. **Metric gate.**  Candidate and incumbent are both scored on the SAME
+   held-out eval window via ``Booster.eval_set`` (the exact metrics
+   training uses, so gate numbers and training logs agree to the digit).
+   The direction-normalized improvement (higher-is-better metrics flip
+   sign) must be at least ``GateConfig.min_improvement``; 0.0 means "no
+   worse than the incumbent", a negative value tolerates that much
+   regression (the fresh-window-drift case), a positive one demands a
+   real win.
+
+2. **Bitwise checksum.**  On publish, the model store records a SHA-256
+   over the candidate's snapshot arena fields
+   (:func:`~xgboost_tpu.serving.modelstore.arena_checksum`); the manager
+   re-derives it from the mmapped arena before activation.  A mismatch —
+   torn publish, bit rot, nondeterministic export — is a deterministic
+   reject: the candidate is never activated and the incumbent keeps
+   serving.
+
+Every reject path is **deterministic**: the same candidate, incumbent,
+and eval window produce the same :class:`GateDecision` every time, and a
+rejected cycle leaves zero serving-side state behind.  The
+``lifecycle.validate`` fault seam fires at gate entry (docs/reliability.md):
+``exception`` turns into a rejected cycle (reason ``fault``), ``kill``
+proves a validator death cannot disturb the incumbent.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+from ..callback import EarlyStopping
+from ..reliability import faults as _faults
+
+__all__ = ["GateConfig", "GateDecision", "score_on", "validate_candidate"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GateConfig:
+    """Gate knobs.
+
+    ``metric``: which eval metric decides (None = the last metric the
+    params configure, matching EarlyStopping's convention).
+    ``min_improvement``: required direction-normalized improvement over
+    the incumbent (see module docstring).  ``higher_is_better``: override
+    the auc/map/ndcg/pre name inference.
+    """
+
+    metric: Optional[str] = None
+    min_improvement: float = 0.0
+    higher_is_better: Optional[bool] = None
+
+    def maximize(self, metric: str) -> bool:
+        if self.higher_is_better is not None:
+            return self.higher_is_better
+        return metric.startswith(EarlyStopping._MAXIMIZE_METRICS)
+
+
+@dataclasses.dataclass
+class GateDecision:
+    """One gate verdict (deterministic for fixed inputs)."""
+
+    accepted: bool
+    reason: str                      # "accepted" | "metric" | "checksum" | "fault"
+    metric: str = ""
+    candidate_score: float = float("nan")
+    incumbent_score: float = float("nan")
+    improvement: float = float("nan")
+    detail: str = ""
+
+
+def score_on(booster, dval, metric: Optional[str] = None,
+             ) -> Tuple[float, str, Dict[str, float]]:
+    """Score ``booster`` on ``dval`` with its configured eval metrics.
+    Returns (score, metric_name, all_scores); ``metric=None`` picks the
+    last configured metric (EarlyStopping's convention)."""
+    msg = booster.eval_set([(dval, "gate")], iteration=0)
+    scores: Dict[str, float] = {}
+    for part in msg.strip().split("\t")[1:]:
+        key, val = part.rsplit(":", 1)
+        scores[key.split("-", 1)[1]] = float(val)
+    if not scores:
+        raise ValueError(f"eval_set produced no metrics: {msg!r}")
+    if metric is None:
+        metric = list(scores)[-1]
+    if metric not in scores:
+        raise ValueError(f"gate metric {metric!r} not among configured "
+                         f"eval metrics {sorted(scores)}")
+    return scores[metric], metric, scores
+
+
+def validate_candidate(candidate, incumbent, dval,
+                       config: Optional[GateConfig] = None) -> GateDecision:
+    """The metric half of the gate: score both boosters on the eval
+    window, compare direction-normalized.  Raises
+    :class:`~xgboost_tpu.reliability.faults.FaultInjected` when the
+    ``lifecycle.validate`` seam fires with an ``exception`` spec — the
+    manager maps that onto the deterministic reject path."""
+    config = config or GateConfig()
+    _faults.maybe_inject("lifecycle.validate")
+    cand, metric, _ = score_on(candidate, dval, config.metric)
+    incu, _, _ = score_on(incumbent, dval, metric)
+    improvement = (cand - incu) if config.maximize(metric) else (incu - cand)
+    if improvement >= config.min_improvement:
+        return GateDecision(True, "accepted", metric, cand, incu,
+                            improvement)
+    return GateDecision(
+        False, "metric", metric, cand, incu, improvement,
+        detail=(f"gate-{metric}: candidate {cand:.6g} vs incumbent "
+                f"{incu:.6g} (improvement {improvement:.6g} < required "
+                f"{config.min_improvement:.6g})"))

@@ -1,0 +1,395 @@
+"""LifecycleManager: the train → validate → hot-swap loop over a fleet.
+
+The continual-learning loop production boosting systems run
+(docs/serving.md "Online model lifecycle"), closed over this repo's
+pieces: additive-ensemble continuation (``train(xgb_model=)``, the Chen &
+Guestrin additive semantics applied online), the crash-safe checkpoint
+machinery, the mmap model store, and the fleet's serialized control
+channel.  One :meth:`~LifecycleManager.run_cycle` is one state-machine
+pass::
+
+    IDLE -> TRAIN -> VALIDATE -> PUBLISH(+checksum) -> [SHADOW] -> SWAP -> IDLE
+                \\______________________________________________________/
+                          any reject/fault: incumbent untouched
+
+Guarantees (pinned by ``tests/test_lifecycle.py`` +
+``scripts/lifecycle_smoke.py``):
+
+- **Crash-safe continuation**: each cycle trains under a
+  CheckpointCallback in a per-incumbent directory; a cycle killed
+  mid-training resumes from its newest checkpoint on the next call
+  (``resume_from`` > ``xgb_model`` precedence in ``train()``) and lands on
+  the same final round.
+- **Deterministic reject**: a gate failure (metric, checksum, or a
+  ``lifecycle.validate`` fault) leaves the incumbent serving
+  bit-identically, every time, with nothing activated.
+- **Kill-mid-swap safety**: the ``lifecycle.swap`` seam fires BEFORE the
+  store's ``set_active`` commit, so a process killed there leaves a store
+  whose restarted fleet serves the incumbent.
+- **Zero dropped requests**: the swap itself is fleet control frames on
+  each replica's serialized connection — predicts in flight complete on
+  whichever version was active when they were dispatched, and the old
+  version is retired only after its replica's traffic drained past the
+  retire frame.
+- **Rollback**: the previous version stays published, resident, and
+  loadable; :meth:`rollback` repoints the fleet (and the durable
+  manifest) back at it.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from ..reliability import faults as _faults
+from .gate import GateConfig, GateDecision, validate_candidate
+from .window import FreshWindow
+
+__all__ = ["LifecycleConfig", "LifecycleManager", "CycleReport"]
+
+_instruments = None
+
+
+def instruments():
+    """(phase hist, swaps, rollbacks, rejected) xtb_lifecycle_* families."""
+    global _instruments
+    if _instruments is None:
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        _instruments = (
+            reg.histogram("xtb_lifecycle_phase_seconds",
+                          "wall-clock per lifecycle phase", ("phase",)),
+            reg.counter("xtb_lifecycle_swaps_total",
+                        "candidates hot-swapped into serving"),
+            reg.counter("xtb_lifecycle_rollbacks_total",
+                        "serving versions rolled back"),
+            reg.counter("xtb_lifecycle_rejected_total",
+                        "candidates rejected by the gate, by reason",
+                        ("reason",)),
+        )
+    return _instruments
+
+
+@dataclasses.dataclass
+class LifecycleConfig:
+    """Cycle knobs.
+
+    ``rounds_per_cycle``: continuation rounds K per cycle.
+    ``checkpoint_dir``: root for crash-safe mid-continuation checkpoints
+    (one subdirectory per incumbent version; None disables — a killed
+    cycle then restarts its training leg from the incumbent).
+    ``shadow_fraction`` / ``shadow_min_pairs`` / ``shadow_timeout_s``:
+    pre-swap shadow phase — mirror that fraction of live traffic onto the
+    candidate until that many comparator pairs (or the timeout) before
+    activating; 0.0 skips the phase.
+    ``retire_keep``: versions kept resident behind the active one
+    (>= 1 so rollback is instant).
+    """
+
+    rounds_per_cycle: int = 5
+    checkpoint_dir: Optional[str] = None
+    checkpoint_interval: int = 1
+    gate: GateConfig = dataclasses.field(default_factory=GateConfig)
+    shadow_fraction: float = 0.0
+    shadow_min_pairs: int = 1
+    shadow_timeout_s: float = 30.0
+    retire_keep: int = 1
+
+    def __post_init__(self) -> None:
+        if self.rounds_per_cycle < 1:
+            raise ValueError("rounds_per_cycle must be >= 1")
+        if self.retire_keep < 1:
+            raise ValueError("retire_keep must be >= 1 (rollback needs "
+                             "the previous version resident)")
+
+
+@dataclasses.dataclass
+class CycleReport:
+    """What one run_cycle did."""
+
+    model: str
+    incumbent_version: int
+    candidate_version: Optional[int]    # None when never published
+    swapped: bool
+    decision: Optional[GateDecision]
+    shadow: Optional[dict] = None       # comparator stats, when shadowed
+    timings: Dict[str, float] = dataclasses.field(default_factory=dict)
+    load_acks: Optional[List[dict]] = None
+
+    @property
+    def accepted(self) -> bool:
+        return self.swapped
+
+
+class LifecycleManager:
+    """Drive continuation cycles for one model name against a fleet.
+
+    ``fleet`` needs the control surface of
+    :class:`~xgboost_tpu.serving.fleet.ServingFleet` (``store_dir``,
+    ``load_version``/``activate_version``/``retire_version``,
+    ``set_shadow``/``clear_shadow``/``shadow_stats``); ``params`` defaults
+    to the serving model's own archived training params.
+    """
+
+    def __init__(self, fleet, model: str,
+                 params: Optional[Dict[str, Any]] = None,
+                 config: Optional[LifecycleConfig] = None,
+                 **overrides) -> None:
+        from ..serving.modelstore import ModelStore
+
+        if config is None:
+            config = LifecycleConfig(**overrides)
+        elif overrides:
+            config = dataclasses.replace(config, **overrides)
+        self.fleet = fleet
+        self.model = model
+        self.config = config
+        if fleet.store_dir is None:
+            raise ValueError("fleet has no model store (start() not run?)")
+        self.store = ModelStore(fleet.store_dir)
+        if self.store.active_version(model) is None:
+            raise KeyError(f"model {model!r} is not in the fleet store")
+        self._params = dict(params) if params is not None else None
+        self._previous: Optional[int] = None  # rollback target
+        # versions this manager loaded onto replicas (retire bookkeeping)
+        self._resident = {self.serving_version()}
+
+    # ------------------------------------------------------------ accessors
+    def serving_version(self) -> int:
+        v = self.store.active_version(self.model)
+        assert v is not None  # checked at construction
+        return int(v)
+
+    def params(self) -> Dict[str, Any]:
+        if self._params is not None:
+            return dict(self._params)
+        bst = self.store.booster(self.model, self.serving_version())
+        return dict(bst.params)
+
+    @contextlib.contextmanager
+    def _phase(self, name: str, timings: Dict[str, float]):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            timings[name] = dt
+            instruments()[0].labels(name).observe(dt)
+
+    def _ckpt_dir(self, incumbent_version: int) -> Optional[str]:
+        if self.config.checkpoint_dir is None:
+            return None
+        # per-incumbent directory: a killed cycle resumes ITS checkpoints,
+        # while the next cycle (new incumbent) starts clean
+        return os.path.join(self.config.checkpoint_dir,
+                            f"{self.model}_from_v{incumbent_version}")
+
+    # ---------------------------------------------------------------- train
+    def continue_training(self, window, *, num_rounds: Optional[int] = None,
+                          evals=None, _base=None) -> "Any":
+        """K more boosting rounds on the fresh window, continuing from the
+        EXACT bytes being served (store-archived model).  Crash-safe: under
+        a checkpoint_dir, a killed continuation resumes from its newest
+        checkpoint (``resume_from`` wins over ``xgb_model`` — the round
+        target is then TOTAL, so the resumed run lands on the same final
+        round as an uninterrupted one).  Checkpoints are consumed on
+        successful return: they exist to survive a crash DURING this
+        continuation, and a later cycle resuming a finished one would
+        re-propose the same candidate without ever seeing its window."""
+        from ..reliability.checkpoint import (CheckpointCallback,
+                                              latest_checkpoint)
+        from ..training import train
+
+        incumbent_v = self.serving_version()
+        base = (_base if _base is not None
+                else self.store.booster(self.model, incumbent_v))
+        K = int(num_rounds or self.config.rounds_per_cycle)
+        params = (dict(self._params) if self._params is not None
+                  else dict(base.params))
+        dwin = _as_dmatrix(window)
+        ckpt_dir = self._ckpt_dir(incumbent_v)
+        callbacks = []
+        kw: Dict[str, Any] = {}
+        total = base.num_boosted_rounds() + K
+        if ckpt_dir is not None:
+            callbacks.append(CheckpointCallback(
+                ckpt_dir, interval=self.config.checkpoint_interval))
+            if latest_checkpoint(ckpt_dir) is not None:
+                # mid-continuation crash: resume_from takes precedence over
+                # xgb_model and counts num_boost_round as the TOTAL target
+                kw["resume_from"] = ckpt_dir
+        if "resume_from" in kw:
+            out = train(params, dwin, total, xgb_model=base, evals=evals,
+                        callbacks=callbacks, verbose_eval=False, **kw)
+        else:
+            # fresh continuation: additive semantics — K more rounds on top
+            out = train(params, dwin, K, xgb_model=base, evals=evals,
+                        callbacks=callbacks, verbose_eval=False)
+        if ckpt_dir is not None:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+        return out
+
+    # ----------------------------------------------------------------- swap
+    def swap(self, version: int, *, timings: Optional[dict] = None,
+             ) -> Optional[dict]:
+        """Hot-swap a PUBLISHED version into the fleet: double-buffered
+        load, optional shadow phase, durable activate, drain-ordered
+        retire of versions beyond ``retire_keep``.  Returns the shadow
+        comparator stats (None when the phase was skipped).  The
+        ``lifecycle.swap`` seam fires before the durable commit — a kill
+        there leaves the store (and any restarted fleet) on the
+        incumbent."""
+        cfg = self.config
+        timings = timings if timings is not None else {}
+        # the incumbent is what the FLEET is serving (its dispatcher view,
+        # seeded from the committed manifest) — never the store's
+        # latest-version fallback, which a publish just moved
+        incumbent = self.fleet.active_version(self.model)
+        if incumbent is None:
+            incumbent = self.serving_version()
+        version = int(version)
+        with self._phase("load", timings):
+            acks = self.fleet.load_version(self.model, version)
+        self._resident.add(version)
+        shadow_stats = None
+        if cfg.shadow_fraction > 0.0:
+            with self._phase("shadow", timings):
+                shadow_stats = self._shadow_phase(version)
+        try:
+            # kill here = dead BEFORE the durable commit: the manifest
+            # still says incumbent, a fleet restart serves incumbent
+            _faults.maybe_inject("lifecycle.swap")
+            with self._phase("activate", timings):
+                self.fleet.activate_version(self.model, version)
+        except _faults.FaultInjected:
+            # deterministic abort: drop the loaded-but-never-activated
+            # candidate from the replicas; the incumbent never moved
+            with contextlib.suppress(Exception):
+                self.fleet.retire_version(self.model, version)
+            self._resident.discard(version)
+            raise
+        self._previous = incumbent
+        instruments()[1].inc()
+        # retire everything beyond the rollback window (the retire_keep
+        # newest non-active versions stay resident); the retire frame
+        # drains behind each replica's in-flight traffic by design
+        behind = sorted(self._resident - {version}, reverse=True)
+        for old in behind[cfg.retire_keep:]:
+            with contextlib.suppress(Exception):
+                self.fleet.retire_version(self.model, old)
+            self._resident.discard(old)
+        return shadow_stats
+
+    def _shadow_phase(self, version: int) -> dict:
+        cfg = self.config
+        self.fleet.set_shadow(self.model, version, cfg.shadow_fraction)
+        try:
+            deadline = time.monotonic() + cfg.shadow_timeout_s
+            while time.monotonic() < deadline:
+                st = self.fleet.shadow_stats(self.model)
+                if st is not None and st["pairs"] >= cfg.shadow_min_pairs:
+                    break
+                time.sleep(0.02)
+        finally:
+            stats = self.fleet.clear_shadow(self.model)
+        return stats or {"pairs": 0, "failures": 0, "mean_div": 0.0,
+                         "max_div": 0.0}
+
+    def rollback(self) -> int:
+        """Repoint serving (fleet + durable manifest) at the previous
+        version.  Returns the version now serving."""
+        prev = self._previous
+        if prev is None:
+            raise RuntimeError("nothing to roll back to: no swap has "
+                               "completed in this manager")
+        current = self.serving_version()
+        self.fleet.load_version(self.model, prev)  # no-op if resident
+        self.fleet.activate_version(self.model, prev)
+        self._resident.add(prev)
+        self._previous = current
+        instruments()[2].inc()
+        return prev
+
+    # ---------------------------------------------------------------- cycle
+    def run_cycle(self, window, *, eval_window=None,
+                  num_rounds: Optional[int] = None) -> CycleReport:
+        """One full lifecycle pass; see the module docstring's state
+        machine.  Never raises on a gate reject — the report says why."""
+        cfg = self.config
+        timings: Dict[str, float] = {}
+        incumbent_v = self.serving_version()
+        # one deserialize per cycle: the same archived incumbent seeds the
+        # continuation AND scores the gate's incumbent side
+        incumbent = self.store.booster(self.model, incumbent_v)
+        with self._phase("train", timings):
+            candidate = self.continue_training(window, num_rounds=num_rounds,
+                                               _base=incumbent)
+        dval = _as_dmatrix(eval_window if eval_window is not None
+                           else window)
+        try:
+            with self._phase("validate", timings):
+                decision = validate_candidate(candidate, incumbent, dval,
+                                              cfg.gate)
+        except _faults.FaultInjected as e:
+            instruments()[3].labels("fault").inc()
+            return CycleReport(
+                self.model, incumbent_v, None, False,
+                GateDecision(False, "fault", detail=str(e)),
+                timings=timings)
+        if not decision.accepted:
+            instruments()[3].labels("metric").inc()
+            return CycleReport(self.model, incumbent_v, None, False,
+                               decision, timings=timings)
+        with self._phase("publish", timings):
+            version = self.store.publish(self.model, candidate)
+            checksum_ok = self.store.verify_checksum(self.model, version)
+        if not checksum_ok:
+            # bitwise half of the gate: a torn/drifted arena must never
+            # activate.  active still points at the incumbent, so the
+            # published-but-rejected files are inert.
+            instruments()[3].labels("checksum").inc()
+            return CycleReport(
+                self.model, incumbent_v, version, False,
+                GateDecision(False, "checksum", decision.metric,
+                             decision.candidate_score,
+                             decision.incumbent_score,
+                             decision.improvement,
+                             detail="arena checksum mismatch after publish"),
+                timings=timings)
+        try:
+            shadow_stats = self.swap(version, timings=timings)
+        except _faults.FaultInjected as e:
+            instruments()[3].labels("fault").inc()
+            return CycleReport(
+                self.model, incumbent_v, version, False,
+                GateDecision(False, "fault", decision.metric,
+                             decision.candidate_score,
+                             decision.incumbent_score,
+                             decision.improvement, detail=str(e)),
+                timings=timings)
+        return CycleReport(self.model, incumbent_v, version, True, decision,
+                           shadow=shadow_stats, timings=timings)
+
+
+def _as_dmatrix(window):
+    """DMatrix | FreshWindow | (X, y[, weight]) -> DMatrix."""
+    from ..data.dmatrix import DMatrix
+
+    if isinstance(window, DMatrix):
+        return window
+    if isinstance(window, FreshWindow):
+        return window.to_dmatrix()
+    if isinstance(window, (tuple, list)):
+        if len(window) == 2:
+            X, y = window
+            return DMatrix(X, label=y)
+        if len(window) == 3:
+            X, y, w = window
+            return DMatrix(X, label=y, weight=w)
+    raise TypeError(
+        f"window must be a DMatrix, FreshWindow, or (X, y[, weight]) "
+        f"tuple, got {type(window).__name__}")

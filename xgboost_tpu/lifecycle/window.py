@@ -1,0 +1,115 @@
+"""Fresh-traffic window: the rows a continuation cycle trains on.
+
+Production continual learning trains each cycle on a sliding window of
+recent traffic (labels arrive after serving).  :class:`FreshWindow` is
+that buffer: append scored batches as their labels land, and the
+lifecycle manager turns the window into a DMatrix per cycle.  The window
+is bounded — beyond ``max_rows`` the OLDEST rows fall off, so a
+long-running loop holds a fixed-size recency window, not an ever-growing
+dataset.
+
+For windows too large to keep resident, ``to_dmatrix`` can route through
+the external-memory path (``extmem_chunk_rows``): the window streams into
+an :class:`~xgboost_tpu.data.extmem.ExtMemQuantileDMatrix` in chunks, the
+"Out-of-Core GPU Gradient Boosting" (arXiv:2005.09148) page machinery
+applied to the continuation window.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["FreshWindow"]
+
+
+class FreshWindow:
+    """Bounded sliding buffer of (rows, labels[, weights]) batches."""
+
+    def __init__(self, max_rows: Optional[int] = None) -> None:
+        self.max_rows = int(max_rows) if max_rows else None
+        self._X: List[np.ndarray] = []
+        self._y: List[np.ndarray] = []
+        self._w: List[Optional[np.ndarray]] = []
+
+    def append(self, X, y, weight=None) -> None:
+        X = np.atleast_2d(np.asarray(X, np.float32))
+        y = np.asarray(y, np.float32).reshape(-1)
+        if len(X) != len(y):
+            raise ValueError(f"rows ({len(X)}) != labels ({len(y)})")
+        if weight is not None:
+            weight = np.asarray(weight, np.float32).reshape(-1)
+            if len(weight) != len(y):
+                raise ValueError("weight length != label length")
+        if self._w and (weight is None) != (self._w[-1] is None):
+            raise ValueError("either every batch carries weights or none")
+        self._X.append(X)
+        self._y.append(y)
+        self._w.append(weight)
+        self._trim()
+
+    def _trim(self) -> None:
+        if self.max_rows is None:
+            return
+        while len(self) > self.max_rows and self._X:
+            over = len(self) - self.max_rows
+            if len(self._X[0]) <= over:  # whole oldest batch falls off
+                self._X.pop(0), self._y.pop(0), self._w.pop(0)
+            else:
+                self._X[0] = self._X[0][over:]
+                self._y[0] = self._y[0][over:]
+                if self._w[0] is not None:
+                    self._w[0] = self._w[0][over:]
+
+    def __len__(self) -> int:
+        return int(sum(len(y) for y in self._y))
+
+    def clear(self) -> None:
+        self._X, self._y, self._w = [], [], []
+
+    def arrays(self):
+        """(X, y, weight-or-None) as single concatenated arrays."""
+        if not self._X:
+            raise ValueError("FreshWindow is empty")
+        X = np.concatenate(self._X, axis=0)
+        y = np.concatenate(self._y)
+        w = (np.concatenate([w for w in self._w])
+             if self._w and self._w[0] is not None else None)
+        return X, y, w
+
+    def to_dmatrix(self, extmem_chunk_rows: Optional[int] = None,
+                   max_bin: int = 256, **kw):
+        """Materialize the window.  Default: an in-memory DMatrix.  With
+        ``extmem_chunk_rows``, stream through ExtMemQuantileDMatrix pages
+        instead (quantised, spillable — the large-window path)."""
+        X, y, w = self.arrays()
+        if extmem_chunk_rows:
+            from ..data.extmem import DataIter, ExtMemQuantileDMatrix
+
+            chunk = int(extmem_chunk_rows)
+
+            class _WindowIter(DataIter):
+                def __init__(self) -> None:
+                    super().__init__()
+                    self._i = 0
+
+                def next(self, input_data) -> bool:
+                    lo = self._i * chunk
+                    if lo >= len(X):
+                        return False
+                    hi = min(lo + chunk, len(X))
+                    batch = {"data": X[lo:hi], "label": y[lo:hi]}
+                    if w is not None:
+                        batch["weight"] = w[lo:hi]
+                    input_data(**batch)
+                    self._i += 1
+                    return True
+
+                def reset(self) -> None:
+                    self._i = 0
+
+            return ExtMemQuantileDMatrix(_WindowIter(), max_bin=max_bin,
+                                         **kw)
+        from ..data.dmatrix import DMatrix
+
+        return DMatrix(X, label=y, weight=w, **kw)

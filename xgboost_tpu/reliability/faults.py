@@ -68,6 +68,8 @@ SEAMS = frozenset({
     "serve.worker",
     "fleet.dispatch",
     "native.parallel_for",
+    "lifecycle.validate",
+    "lifecycle.swap",
 })
 
 # Debug guard: with XGBOOST_TPU_STRICT_SEAMS=1, maybe_inject() rejects

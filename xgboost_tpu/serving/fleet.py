@@ -39,6 +39,21 @@ to a replica: ``exception`` fails that request, ``delay`` stalls the
 dispatcher, ``drop_connection`` severs the chosen replica's socket — the
 deterministic stand-in for a replica vanishing mid-conversation
 (docs/reliability.md).
+
+**Lifecycle integration** (docs/serving.md "Online model lifecycle"):
+:meth:`ServingFleet.load_version` / :meth:`~ServingFleet.activate_version`
+/ :meth:`~ServingFleet.retire_version` broadcast control frames that ride
+each replica's serialized connection — a replica processes them strictly
+after every predict dispatched before them, which is exactly the
+"retire only after in-flight batches drain" contract.  ``activate_version``
+durably commits the store manifest FIRST, so a replica that dies and
+respawns mid-broadcast reads the committed version at startup and
+converges with the survivors.  **Shadow scoring**
+(:meth:`~ServingFleet.set_shadow`) duplicates a deterministic 1-in-N
+subset of a model's unversioned traffic onto a candidate version; the
+comparator feeds ``xtb_lifecycle_shadow_*`` divergence series and the
+per-version ``xtb_fleet_version_latency_seconds`` histogram without the
+duplicated result ever reaching a caller.
 """
 from __future__ import annotations
 
@@ -50,6 +65,8 @@ import sys
 import tempfile
 import threading
 import time
+import warnings
+from collections import deque
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeout
 from socket import socket as Socket
@@ -65,6 +82,9 @@ from .batcher import QueueFullError
 
 _LATENCY_BUCKETS = tuple(1e-5 * (4.0 ** i) for i in range(12))
 _COLDSTART_BUCKETS = tuple(0.01 * (2.0 ** i) for i in range(14))
+# prediction divergence spans "bitwise identical continuation" (0) through
+# "differently-shaped model" (O(1)); decades, not latency quartics
+_SHADOW_BUCKETS = tuple(1e-9 * (10.0 ** i) for i in range(10))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +99,12 @@ class SLOClass:
     name: str = "default"
     priority: int = 0
     deadline_s: Optional[float] = None
+
+
+# shadow twins are discardable measurements: they outrank NOTHING, so
+# under queue pressure a twin sheds itself (a comparator "failure")
+# rather than evicting any real caller's request
+_SHADOW_SLO = SLOClass("shadow", priority=-(2 ** 31))
 
 
 @dataclasses.dataclass
@@ -138,6 +164,21 @@ class _Instruments:
             "xtb_fleet_coldstart_seconds",
             "replica warm-work seconds at ready, by compile-cache state",
             ("cache",), buckets=_COLDSTART_BUCKETS)
+        self.version_latency = reg.histogram(
+            "xtb_fleet_version_latency_seconds",
+            "submit-to-result latency by served model version",
+            ("model", "version"), buckets=_LATENCY_BUCKETS)
+        self.shadow_requests = reg.counter(
+            "xtb_lifecycle_shadow_requests_total",
+            "shadow-scored request pairs compared", ("model",))
+        self.shadow_failures = reg.counter(
+            "xtb_lifecycle_shadow_failures_total",
+            "shadow pairs that could not be compared (either side failed "
+            "or was shed)", ("model",))
+        self.shadow_divergence = reg.histogram(
+            "xtb_lifecycle_shadow_divergence",
+            "mean |candidate - incumbent| prediction divergence per "
+            "shadow-scored request", ("model",), buckets=_SHADOW_BUCKETS)
 
     @classmethod
     def get(cls) -> "_Instruments":
@@ -267,7 +308,7 @@ class _Replica:
     mutation happens under the fleet condition variable)."""
 
     __slots__ = ("label", "proc", "sock", "rx", "in_flight", "ready_info",
-                 "alive")
+                 "alive", "ctrl")
 
     def __init__(self, label: str, proc) -> None:
         self.label = label
@@ -277,6 +318,9 @@ class _Replica:
         self.in_flight: Optional[_Request] = None
         self.ready_info: Optional[dict] = None
         self.alive = False
+        # replica-bound lifecycle control frames (load/activate/retire):
+        # dispatched ahead of queued traffic, never rerouted to a peer
+        self.ctrl: deque = deque()
 
 
 _ERR_TYPES = {"ValueError": ValueError, "KeyError": KeyError,
@@ -313,8 +357,14 @@ class ServingFleet:
         self._failures: List[Tuple[str, int, str]] = []
         self._err_files: Dict[str, str] = {}
         self._next_id = itertools.count(1)
+        # lifecycle state (all under _cv): the fleet's view of each model's
+        # active version (labels unversioned latency) and per-model shadow
+        # routing config {name: {"version", "every", "n", stats...}}
+        self._versions: Dict[str, int] = {}
+        self._shadow: Dict[str, dict] = {}
         self._respawned = 0
         self._started = False
+        self._bringup_done = False
         self._closed = False
         self._extinct = False  # every replica dead, respawn budget spent
         self._listener: Optional[Socket] = None
@@ -343,6 +393,23 @@ class ServingFleet:
         if not store.entries():
             raise ValueError("fleet has no models: pass models= or a "
                              "pre-populated store_dir=")
+        with self._cv:
+            try:
+                # commit the serving versions explicitly (one rewrite,
+                # no-op when already committed): once a fleet runs,
+                # "active" never silently tracks "latest", so a lifecycle
+                # publish (which bumps latest) cannot move what serves
+                # before its activate commit
+                store.commit_active()
+            except OSError as e:
+                # read-only store: a pure-read consumer fleet still works
+                # (lifecycle publishes need a writable store anyway, so
+                # "latest" cannot drift underneath this fleet)
+                warnings.warn(f"model store {self._store_dir} is not "
+                              f"writable ({e}); serving versions stay "
+                              f"implicitly latest-tracking")
+            for name, version in store.serving_entries():
+                self._versions[name] = version
         listener = socketlib.socket()
         listener.bind(("127.0.0.1", 0))
         listener.listen(max(8, self.config.n_replicas * 2))
@@ -377,6 +444,8 @@ class ServingFleet:
                 f"fleet start: only {ready}/{self.config.n_replicas} "
                 f"replicas became ready within "
                 f"{self.config.ready_timeout_s}s", failures)
+        with self._cv:
+            self._bringup_done = True
         return self
 
     def _spawn(self, label: str) -> None:
@@ -450,6 +519,23 @@ class ServingFleet:
                 rep.rx = rx
                 rep.ready_info = ready
                 rep.alive = True
+                # version resync for RESPAWNS: the replica read the
+                # manifest's active versions at process startup, which may
+                # predate an activate committed while it was warming up
+                # (spawn -> set_active -> broadcast that skipped the
+                # not-yet-ready respawn).  Idempotent activate frames,
+                # dispatched ahead of any traffic, bring it to the fleet's
+                # view; when the replica already serves that version this
+                # is a no-op pin.  Initial bring-up needs none of this:
+                # start() returns only after every replica is ready, so no
+                # activate can precede an initial replica's manifest read.
+                for name, version in (self._versions.items()
+                                      if self._bringup_done else ()):
+                    rid = next(self._next_id)
+                    rep.ctrl.append(_Request(
+                        rid, name, {"op": "activate", "model": name,
+                                    "version": int(version), "id": rid},
+                        b"", self.config.default_slo))
                 self._ins.replicas.set(
                     sum(1 for r in self._replicas.values() if r.alive))
                 self._cv.notify_all()
@@ -484,7 +570,14 @@ class ServingFleet:
                 if rep is not None:
                     rep.in_flight = None
                     if rep.alive and not self._closed:
-                        nxt, expired = self._queue.pop(time.monotonic())
+                        # replica-bound control frames dispatch ahead of
+                        # queued traffic (a swap must not starve behind a
+                        # deep queue; predicts already on the wire keep
+                        # their ordering — that IS the drain contract)
+                        if rep.ctrl:
+                            nxt = rep.ctrl.popleft()
+                        else:
+                            nxt, expired = self._queue.pop(time.monotonic())
                         if nxt is not None:
                             rep.in_flight = nxt
             self._expire(expired)
@@ -499,6 +592,8 @@ class ServingFleet:
                 shape = tuple(int(x) for x in header["shape"])
                 arr = np.frombuffer(payload, np.float32).reshape(shape)
                 self._finish(req, arr)
+            elif op == "ctrl_ok":
+                self._finish_ctrl(req, header)
             else:
                 etype = _ERR_TYPES.get(header.get("etype", ""), RuntimeError)
                 self._fail(req, etype(header.get("error", "replica error")))
@@ -509,8 +604,24 @@ class ServingFleet:
             req.future.set_result(arr)
             # only delivered results count: an abandoned (caller-timed-out,
             # cancelled) request's latency would skew the histogram
-            self._ins.latency.labels(req.model).observe(
-                time.monotonic() - req.t_submit)
+            lat = time.monotonic() - req.t_submit
+            self._ins.latency.labels(req.model).observe(lat)
+            # per-version latency: explicit version from the header, else
+            # the fleet's view of the model's active version — the
+            # lifecycle comparator reads candidate vs incumbent from here
+            v = req.header.get("version")
+            if v is None:
+                v = self._versions.get(req.model)
+            if v is not None:
+                self._ins.version_latency.labels(
+                    req.model, str(v)).observe(lat)
+
+    def _finish_ctrl(self, req: _Request, header: dict) -> None:
+        """A replica acked a lifecycle control frame: the future carries
+        the ack payload (aot hit/compile counts, seconds)."""
+        req.state = "done"
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_result(dict(header))
 
     def _fail(self, req: _Request, exc: BaseException) -> None:
         req.state = "done"
@@ -535,8 +646,17 @@ class ServingFleet:
             req = rep.in_flight
             rep.in_flight = None
             rep.alive = False
+            ctrl_orphans = list(rep.ctrl)
+            rep.ctrl.clear()
             self._ins.replicas.set(
                 sum(1 for r in self._replicas.values() if r.alive))
+            if (req is not None and not closed
+                    and req.header.get("op") != "predict"):
+                # a replica-bound control frame cannot reroute to a peer:
+                # fail it — the broadcast layer tolerates this, because a
+                # respawn reads the committed store state at startup
+                ctrl_orphans.append(req)
+                req = None
             if req is not None and not closed:
                 # the dead replica's batch: requeue at the front (predict
                 # is idempotent; the twin result from the corpse, if any,
@@ -561,6 +681,11 @@ class ServingFleet:
         with self._cv:
             self._failures.append((label, rc if rc is not None else -1,
                                    tail))
+        for c in ctrl_orphans:
+            self._fail(c, WorkerFailedError(
+                f"replica {label} died before completing control op "
+                f"{c.header.get('op')!r} (exit={rc}): {cause}",
+                [(label, rc if rc is not None else -1, tail)]))
         if req is not None:
             self._fail(req, WorkerFailedError(
                 f"request {req.id} lost to replica {label} "
@@ -623,13 +748,20 @@ class ServingFleet:
                     return
                 now = time.monotonic()
                 req, expired = (None, [])
+                target = None
                 free = [r for r in self._replicas.values()
                         if r.alive and r.in_flight is None]
-                if free:
+                # replica-bound control frames first (they cannot be
+                # served by any other replica and must not starve)
+                for r in free:
+                    if r.ctrl:
+                        req = r.ctrl.popleft()
+                        target = r
+                        break
+                if req is None and free:
                     req, expired = self._queue.pop(now)
-                target = None
+                    target = free[0] if req is not None else None
                 if req is not None:
-                    target = free[0]
                     target.in_flight = req
             self._expire(expired)
             if req is None:
@@ -656,7 +788,8 @@ class ServingFleet:
             return
         try:
             wire.send_frame(rep.sock, req.header, req.payload)
-            self._ins.requests.labels(req.model).inc()
+            if req.header.get("op") == "predict":
+                self._ins.requests.labels(req.model).inc()
         except OSError as e:
             self._on_replica_death(rep.label, e)
 
@@ -686,6 +819,7 @@ class ServingFleet:
         if version is not None:
             header["version"] = int(version)
         req = _Request(rid, model, header, payload, slo)
+        shadow_req = None
         with self._cv:
             if self._closed:
                 raise RuntimeError("ServingFleet is closed")
@@ -695,8 +829,27 @@ class ServingFleet:
                 raise WorkerFailedError(
                     "every fleet replica died and the respawn budget is "
                     "spent", list(self._failures))
-            victim = self._queue.push(req)
-        if victim is not None:
+            sh = self._shadow.get(model) if version is None else None
+            if sh is not None:
+                # deterministic 1-in-N selection (a counter, not a PRNG:
+                # replayable, and exactly the configured fraction)
+                sh["n"] += 1
+                if sh["n"] % sh["every"] == 0:
+                    shadow_header = dict(header)
+                    shadow_header["id"] = next(self._next_id)
+                    shadow_header["version"] = sh["version"]
+                    # same payload buffer: the twin rides zero-copy too
+                    shadow_req = _Request(shadow_header["id"], model,
+                                          shadow_header, payload,
+                                          _SHADOW_SLO)
+            victims = [self._queue.push(req)]
+            if shadow_req is not None:
+                victims.append(self._queue.push(shadow_req))
+        if shadow_req is not None:
+            self._attach_shadow(model, req, shadow_req)
+        for victim in victims:
+            if victim is None:
+                continue
             self._ins.shed.labels(victim.slo.name).inc()
             self._fail(victim, QueueFullError(
                 f"fleet queue full ({self.config.max_queue} requests); "
@@ -737,6 +890,173 @@ class ServingFleet:
             raise TimeoutError(
                 f"predict({model!r}) missed its {timeout}s deadline "
                 f"(slo={slo.name})") from None
+
+    # ----------------------------------------------------- lifecycle control
+    @property
+    def store_dir(self) -> Optional[str]:
+        """The fleet's model-store directory (the lifecycle manager's
+        publish target)."""
+        return self._store_dir
+
+    def _control_all(self, fields: Dict[str, Any],
+                     timeout: float = 300.0) -> List[dict]:
+        """Broadcast one control frame to every live replica and collect
+        the acks.  A replica that DIES mid-broadcast is tolerated — its
+        respawn reads the committed store state at startup and converges —
+        but an error *reply* (bad version, refused retire) raises."""
+        pending: List[Tuple[str, _Request]] = []
+        with self._cv:
+            if not self._started or self._closed:
+                raise RuntimeError("ServingFleet is not running")
+            for rep in self._replicas.values():
+                if not rep.alive:
+                    continue
+                rid = next(self._next_id)
+                header = dict(fields)
+                header["id"] = rid
+                req = _Request(rid, str(fields.get("model", "?")), header,
+                               b"", self.config.default_slo)
+                rep.ctrl.append(req)
+                pending.append((rep.label, req))
+        if not pending:
+            raise WorkerFailedError(
+                "no live replica to broadcast to", list(self._failures))
+        self._pump()
+        acks: List[dict] = []
+        for label, req in pending:
+            try:
+                acks.append(req.future.result(timeout=timeout))
+            except WorkerFailedError:
+                with self._cv:
+                    gone = label not in self._replicas
+                if not gone:  # pragma: no cover - defensive
+                    raise
+        return acks
+
+    def load_version(self, model: str, version: int,
+                     timeout: float = 300.0) -> List[dict]:
+        """Double-buffer a published store version onto every replica:
+        registry entry, arch-keyed AOT warm attach, fast path, NaN warm
+        pass — all while the incumbent keeps serving.  Returns per-replica
+        acks carrying aot_hits/aot_compiled (a same-architecture
+        continuation shows hits, not compiles)."""
+        return self._control_all({"op": "load", "model": model,
+                                  "version": int(version)}, timeout)
+
+    def activate_version(self, model: str, version: int,
+                         timeout: float = 300.0) -> List[dict]:
+        """Repoint ``model``'s unversioned traffic at ``version``.
+
+        Durably commits the store manifest FIRST (``set_active``), then
+        broadcasts: a replica that dies between the two reads the
+        committed version when it respawns, so the fleet converges on the
+        new version through any single failure.  Per replica the activate
+        frame is serialized after every previously dispatched predict —
+        nothing is dropped, and no request observes a half-swap."""
+        from .modelstore import ModelStore
+
+        ModelStore(self._store_dir).set_active(model, int(version))
+        with self._cv:
+            # fleet view moves WITH the durable commit, before the
+            # broadcast: a replica respawning while the acks are collected
+            # builds its ready-time resync frames from _versions, and a
+            # stale entry here would regress it to the old version
+            self._versions[model] = int(version)
+        return self._control_all({"op": "activate", "model": model,
+                                  "version": int(version)}, timeout)
+
+    def retire_version(self, model: str, version: int,
+                       timeout: float = 300.0) -> List[dict]:
+        """Drop a non-active version from every replica.  The retire frame
+        rides each replica's serialized connection, so it executes only
+        after every predict dispatched before it has drained; replicas
+        refuse to retire the active version."""
+        return self._control_all({"op": "retire", "model": model,
+                                  "version": int(version)}, timeout)
+
+    def active_version(self, model: str) -> Optional[int]:
+        with self._cv:
+            return self._versions.get(model)
+
+    # ------------------------------------------------------- shadow scoring
+    def set_shadow(self, model: str, version: int,
+                   fraction: float) -> None:
+        """Mirror a deterministic ``fraction`` of ``model``'s unversioned
+        traffic onto candidate ``version`` (which must be loaded).  The
+        twin's result never reaches a caller; the comparator feeds
+        ``xtb_lifecycle_shadow_*`` and per-version latency series."""
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"shadow fraction must be in (0, 1], "
+                             f"got {fraction}")
+        every = max(1, round(1.0 / fraction))
+        with self._cv:
+            self._shadow[model] = {
+                "version": int(version), "every": every, "n": 0,
+                "pairs": 0, "failures": 0, "sum_div": 0.0, "max_div": 0.0,
+            }
+
+    def clear_shadow(self, model: str) -> Optional[dict]:
+        """Stop mirroring; returns the accumulated comparator stats
+        (pairs, failures, mean_div, max_div) or None if never set."""
+        with self._cv:
+            sh = self._shadow.pop(model, None)
+        if sh is None:
+            return None
+        pairs = sh["pairs"]
+        return {"pairs": pairs, "failures": sh["failures"],
+                "mean_div": (sh["sum_div"] / pairs) if pairs else 0.0,
+                "max_div": sh["max_div"]}
+
+    def shadow_stats(self, model: str) -> Optional[dict]:
+        with self._cv:
+            sh = self._shadow.get(model)
+            if sh is None:
+                return None
+            pairs = sh["pairs"]
+            return {"pairs": pairs, "failures": sh["failures"],
+                    "mean_div": (sh["sum_div"] / pairs) if pairs else 0.0,
+                    "max_div": sh["max_div"]}
+
+    def _attach_shadow(self, model: str, primary: _Request,
+                       shadow: _Request) -> None:
+        """Compare the pair once BOTH futures settle (runs on whichever rx
+        thread finishes second; cheap: one mean-abs-diff)."""
+        remaining = [2]
+        lock = threading.Lock()
+
+        def done(_fut):
+            with lock:
+                remaining[0] -= 1
+                if remaining[0]:
+                    return
+            self._compare_shadow(model, primary, shadow)
+
+        primary.future.add_done_callback(done)
+        shadow.future.add_done_callback(done)
+
+    def _compare_shadow(self, model: str, primary: _Request,
+                        shadow: _Request) -> None:
+        sh_live = None
+        try:
+            a = np.asarray(primary.future.result(timeout=0), np.float64)
+            b = np.asarray(shadow.future.result(timeout=0), np.float64)
+            div = (float(np.mean(np.abs(a - b))) if a.shape == b.shape
+                   else float("inf"))
+        except BaseException:
+            self._ins.shadow_failures.labels(model).inc()
+            with self._cv:
+                sh_live = self._shadow.get(model)
+                if sh_live is not None:
+                    sh_live["failures"] += 1
+            return
+        self._ins.shadow_requests.labels(model).inc()
+        self._ins.shadow_divergence.labels(model).observe(div)
+        with self._cv:
+            sh_live = self._shadow.get(model)
+            if sh_live is not None:
+                sh_live["pairs"] += 1
+                sh_live["sum_div"] += div
+                sh_live["max_div"] = max(sh_live["max_div"], div)
 
     # ---------------------------------------------------------------- admin
     def replica_info(self) -> List[dict]:
@@ -780,6 +1100,9 @@ class ServingFleet:
             self._closed = True
             dead = self._queue.drain()
             reps = list(self._replicas.values())
+            for rep in reps:  # pending control frames cannot complete now
+                dead.extend(rep.ctrl)
+                rep.ctrl.clear()
             self._cv.notify_all()
         err = RuntimeError("ServingFleet closed")
         for r in dead:

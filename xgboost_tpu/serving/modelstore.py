@@ -17,18 +17,34 @@ device holds its own resident copy as usual.
 
 Layout (``store_dir/``)::
 
-    manifest.json          {"version": 1, "models": {name: latest_version}}
-    <name>.v<V>.meta.json  snapshot metadata + arena field table
+    manifest.json          {"version": 1, "models": {name: latest_version},
+                            "active": {name: serving_version}}
+    <name>.v<V>.meta.json  snapshot metadata + arena field table + checksum
     <name>.v<V>.arena      64-byte-aligned concatenation of the raw arrays
+    <name>.v<V>.model      Booster.serialize() bytes (lifecycle continuation)
 
 Publishes are atomic (tmp + rename, manifest rewritten last) so a replica
 opening mid-publish sees either the old or the new version, never a torn
 one.  The arena stores the *snapshot* tensors (stacked node fields, group
 routing, base score) — not the model file — so opening is an mmap + a few
 small JSON reads, with no tree parsing on the replica cold path.
+
+Two lifecycle additions (docs/serving.md "Online model lifecycle"):
+
+- **Active version.**  ``manifest["active"]`` records which version is
+  *serving* per name, distinct from the latest *published* one.  A hot-swap
+  publishes the candidate first (latest moves, active does not) and commits
+  ``set_active`` only after the validation gate passes — so a process
+  killed mid-swap leaves a store whose restart serves the incumbent.
+- **Model bytes + checksum.**  Each version archives the exact
+  ``Booster.serialize()`` payload (continuation training resumes from
+  precisely what is being served) and the meta records a SHA-256 over the
+  arena fields; ``verify_checksum`` re-derives it from the mmapped arena,
+  the bitwise half of the lifecycle validation gate.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import tempfile
@@ -38,6 +54,19 @@ import numpy as np
 
 _ALIGN = 64  # PJRT CPU zero-copy needs 64-byte-aligned buffers
 _FORMAT_VERSION = 1
+
+
+def arena_checksum(fields: Dict[str, np.ndarray]) -> str:
+    """Deterministic SHA-256 over a snapshot's field tensors (sorted key
+    order; dtype + shape + raw bytes).  The same digest must come out of
+    the pre-publish arrays and the post-publish mmap views — any torn or
+    bit-flipped arena fails the lifecycle gate's bitwise check."""
+    h = hashlib.sha256()
+    for key in sorted(fields):
+        arr = np.ascontiguousarray(fields[key])
+        h.update(f"{key}|{arr.dtype.str}|{arr.shape}|".encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
 
 
 def _json_params(params: dict) -> dict:
@@ -101,6 +130,52 @@ class ModelStore:
         v = self.manifest()["models"].get(name)
         return int(v) if v is not None else None
 
+    def active_version(self, name: str) -> Optional[int]:
+        """The version unversioned requests should serve: the committed
+        ``active`` entry, falling back to latest (stores that never ran a
+        lifecycle swap behave exactly as before)."""
+        m = self.manifest()
+        v = m.get("active", {}).get(name, m["models"].get(name))
+        return int(v) if v is not None else None
+
+    def set_active(self, name: str, version: int) -> None:
+        """Durably commit ``version`` as the serving version for ``name``
+        (atomic manifest rewrite).  This is the hot-swap commit point: a
+        kill before this call leaves a store whose restart serves the
+        incumbent, whatever has been published since."""
+        version = int(version)
+        manifest = self.manifest()
+        if int(manifest["models"].get(name, 0)) < version:
+            raise KeyError(
+                f"cannot activate unpublished version {name!r} v{version}")
+        manifest.setdefault("active", {})[name] = version
+        self._write_manifest(manifest)
+
+    def serving_entries(self) -> List[Tuple[str, int]]:
+        """Every (name, active_version) pair — what a replica loads and
+        pins at startup."""
+        m = self.manifest()
+        active = m.get("active", {})
+        return [(n, int(active.get(n, v)))
+                for n, v in sorted(m["models"].items())]
+
+    def commit_active(self) -> bool:
+        """Explicitly commit every model's RESOLVED serving version (one
+        atomic manifest rewrite; a no-op returning False when everything
+        is already committed).  A running fleet calls this at start so
+        "active" never silently tracks "latest": a later publish moves
+        latest, but what serves moves only at its activate commit."""
+        manifest = self.manifest()
+        active = manifest.setdefault("active", {})
+        changed = False
+        for name, version in manifest["models"].items():
+            if active.get(name) is None:
+                active[name] = int(version)
+                changed = True
+        if changed:
+            self._write_manifest(manifest)
+        return changed
+
     def _write_manifest(self, manifest: dict) -> None:
         fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".manifest.tmp")
         with os.fdopen(fd, "w") as fh:
@@ -148,6 +223,16 @@ class ModelStore:
             fh.flush()
             os.fsync(fh.fileno())
 
+        # archive the exact serialized model alongside the inference arena:
+        # the lifecycle trainer continues from precisely the bytes being
+        # served, not a re-trained approximation of them
+        model_blob = bytes(booster.serialize())
+        fd, tmp_model = tempfile.mkstemp(dir=self.dir, suffix=".model.tmp")
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(model_blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+
         meta = {
             "format": _FORMAT_VERSION,
             "name": name,
@@ -161,6 +246,7 @@ class ModelStore:
             "objective": str(booster.params.get(
                 "objective", "reg:squarederror")),
             "params": _json_params(booster.params),
+            "checksum": arena_checksum(fields),
             "fields": table,
         }
         stem = f"{name}.v{version}"
@@ -172,6 +258,7 @@ class ModelStore:
         # arena first, then meta, then manifest: a reader resolves through
         # the manifest, so every hop it can see is complete
         os.replace(tmp_arena, os.path.join(self.dir, stem + ".arena"))
+        os.replace(tmp_model, os.path.join(self.dir, stem + ".model"))
         os.replace(tmp_meta, os.path.join(self.dir, stem + ".meta.json"))
         manifest = self.manifest()
         manifest["models"][name] = max(
@@ -179,29 +266,66 @@ class ModelStore:
         self._write_manifest(manifest)
         return version
 
-    # ----------------------------------------------------------------- open
-    def snapshot(self, name: str, version: Optional[int] = None,
-                 device: bool = True):
-        """mmap one published model into an :class:`InferenceSnapshot`.
-
-        ``device=True`` runs the arrays through ``jax.device_put`` once
-        (zero-copy aliasing on CPU, a single resident copy elsewhere);
-        ``device=False`` returns raw memmap views (inspection/tests).
-        """
-        from .snapshot import InferenceSnapshot
-
+    # -------------------------------------------------------- lifecycle read
+    def _stem(self, name: str, version: Optional[int]) -> str:
         if version is None:
             version = self.latest_version(name)
             if version is None:
                 raise KeyError(f"model {name!r} is not in the store "
                                f"({self.dir})")
-        stem = f"{name}.v{int(version)}"
+        return f"{name}.v{int(version)}"
+
+    def model_bytes(self, name: str, version: Optional[int] = None) -> bytes:
+        """The archived ``Booster.serialize()`` payload for one version —
+        the continuation trainer's starting point."""
+        path = os.path.join(self.dir, self._stem(name, version) + ".model")
+        try:
+            with open(path, "rb") as fh:
+                return fh.read()
+        except FileNotFoundError:
+            raise KeyError(
+                f"{name!r} v{version} has no archived model bytes (published "
+                "before the lifecycle format? re-publish the booster)"
+            ) from None
+
+    def booster(self, name: str, version: Optional[int] = None):
+        """Rebuild the exact Booster serving as ``(name, version)`` from the
+        archived bytes (serialize() round-trip: model + full config)."""
+        from ..core import Booster
+
+        bst = Booster()
+        bst.unserialize(self.model_bytes(name, version))
+        return bst
+
+    def checksum(self, name: str, version: Optional[int] = None,
+                 ) -> Optional[str]:
+        """The publish-time arena checksum recorded in the meta."""
+        stem = self._stem(name, version)
+        with open(os.path.join(self.dir, stem + ".meta.json")) as fh:
+            return json.load(fh).get("checksum")
+
+    def verify_checksum(self, name: str, version: Optional[int] = None,
+                        ) -> bool:
+        """Re-derive the arena checksum from the mmapped field views and
+        compare it with the publish-time digest — the bitwise half of the
+        lifecycle gate.  False = torn/corrupt/drifted arena (or a
+        pre-checksum store entry): do not activate."""
+        stem = self._stem(name, version)
+        meta, view = self._open_arena(stem)
+        recorded = meta.get("checksum")
+        if recorded is None:
+            return False
+        return arena_checksum({k: view(k) for k in meta["fields"]}
+                              ) == recorded
+
+    # ----------------------------------------------------------------- open
+    def _open_arena(self, stem: str):
+        """meta dict + a field-view accessor over the mmapped arena — the
+        ONE decoder of the arena layout, shared by :meth:`snapshot` and
+        :meth:`verify_checksum` so a layout change can never make the
+        checksum disagree with what actually serves."""
         with open(os.path.join(self.dir, stem + ".meta.json")) as fh:
             meta = json.load(fh)
-        if int(meta.get("format", 0)) != _FORMAT_VERSION:
-            raise ValueError(
-                f"store entry {stem} has format {meta.get('format')!r}; "
-                f"this reader understands {_FORMAT_VERSION}")
         arena = np.memmap(os.path.join(self.dir, stem + ".arena"),
                           dtype=np.uint8, mode="r")
 
@@ -214,6 +338,24 @@ class ModelStore:
             return np.frombuffer(arena, dtype=dt, count=count,
                                  offset=int(ent["offset"])
                                  ).reshape(ent["shape"])
+
+        return meta, view
+    def snapshot(self, name: str, version: Optional[int] = None,
+                 device: bool = True):
+        """mmap one published model into an :class:`InferenceSnapshot`.
+
+        ``device=True`` runs the arrays through ``jax.device_put`` once
+        (zero-copy aliasing on CPU, a single resident copy elsewhere);
+        ``device=False`` returns raw memmap views (inspection/tests).
+        """
+        from .snapshot import InferenceSnapshot
+
+        stem = self._stem(name, version)
+        meta, view = self._open_arena(stem)
+        if int(meta.get("format", 0)) != _FORMAT_VERSION:
+            raise ValueError(
+                f"store entry {stem} has format {meta.get('format')!r}; "
+                f"this reader understands {_FORMAT_VERSION}")
 
         def put(arr):
             if arr is None or not device:

@@ -6,14 +6,37 @@ stacked tree tensors device-resident; the least-recently-served unpinned
 entry is evicted when a new model loads.  Versions are monotonically
 numbered per name; ``pin`` freezes the version ``get`` resolves to (the
 rollout/rollback knob) and pinned entries are never evicted.
+
+Every exit from residency — LRU eviction for capacity AND explicit
+``remove()`` (lifecycle version retirement) — funnels through ONE path:
+``xtb_serve_evicted_total{model,reason}`` counts it and registered
+retirement hooks (:meth:`ModelRegistry.add_retire_hook`) fire, so a fleet
+replica drops its per-version fast-path state identically whether the
+registry aged a model out or the lifecycle manager retired it.
 """
 from __future__ import annotations
 
 import os
 import threading
-from typing import Dict, List, Optional, Tuple
+import warnings
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .snapshot import InferenceSnapshot
+
+_evicted = None  # xtb_serve_evicted_total family, created lazily
+
+
+def _evicted_counter():
+    global _evicted
+    if _evicted is None:
+        from ..telemetry.registry import get_registry
+
+        _evicted = get_registry().counter(
+            "xtb_serve_evicted_total",
+            "snapshots leaving registry residency, by cause "
+            "(lru = capacity eviction, retired = explicit remove)",
+            ("model", "reason"))
+    return _evicted
 
 
 class _Entry:
@@ -47,6 +70,7 @@ class ModelRegistry:
         self._entries: Dict[Tuple[str, int], _Entry] = {}
         self._latest: Dict[str, int] = {}
         self._pinned_version: Dict[str, int] = {}
+        self._retire_hooks: List[Callable] = []
         self._clock = 0
         self.evictions = 0
 
@@ -54,6 +78,38 @@ class ModelRegistry:
     def _touch(self, e: _Entry) -> None:
         self._clock += 1
         e.tick = self._clock
+
+    def add_retire_hook(self, fn: Callable[[str, int, str, InferenceSnapshot],
+                                           None]) -> None:
+        """Register ``fn(name, version, reason, snapshot)`` to fire whenever
+        a snapshot leaves residency (``reason`` = ``"lru"`` / ``"retired"``).
+        Hooks run under the registry lock (RLock: re-entrant registry calls
+        are fine) and must be cheap and non-blocking."""
+        with self._lock:
+            self._retire_hooks.append(fn)
+
+    def _retire_entry(self, key: Tuple[str, int], entry: _Entry,
+                      reason: str) -> None:
+        """The ONE exit path from residency (caller holds the lock): count
+        it, fire the retirement hooks, keep get(name) resolving to the
+        highest surviving version."""
+        del self._entries[key]
+        name, version = key
+        _evicted_counter().labels(name, reason).inc()
+        for fn in self._retire_hooks:
+            try:
+                fn(name, version, reason, entry.snapshot)
+            except Exception as e:  # a broken hook must not corrupt residency
+                warnings.warn(f"registry retire hook failed for "
+                              f"{key}: {e!r}", RuntimeWarning, stacklevel=3)
+        # retiring the latest version must not orphan still-resident older
+        # ones: keep get(name) resolving to the highest surviving version
+        if self._latest.get(name) == version:
+            remaining = [v for n, v in self._entries if n == name]
+            if remaining:
+                self._latest[name] = max(remaining)
+            else:
+                self._latest.pop(name, None)
 
     def _evict_for_capacity(self) -> None:
         while len(self._entries) >= self.max_models:
@@ -64,18 +120,8 @@ class ModelRegistry:
                     f"registry full ({self.max_models} models, all pinned); "
                     "unpin or raise ServeConfig.max_models")
             _, key = min(victims)
-            del self._entries[key]
             self.evictions += 1
-            # evicting the latest version must not orphan still-resident
-            # older ones (same invariant remove() maintains): keep get(name)
-            # resolving to the highest surviving version
-            name, version = key
-            if self._latest.get(name) == version:
-                remaining = [v for n, v in self._entries if n == name]
-                if remaining:
-                    self._latest[name] = max(remaining)
-                else:
-                    self._latest.pop(name, None)
+            self._retire_entry(key, self._entries[key], "lru")
 
     # ------------------------------------------------------------------ API
     def register(self, name: str, source, version: Optional[int] = None,
@@ -145,14 +191,9 @@ class ModelRegistry:
             keys = [k for k in self._entries
                     if k[0] == name and (version is None or k[1] == version)]
             for k in keys:
-                del self._entries[k]
-            # keep get(name) resolving to the highest surviving version —
-            # removing the latest must not orphan still-resident older ones
-            remaining = [v for n, v in self._entries if n == name]
-            if remaining:
-                self._latest[name] = max(remaining)
-            else:
-                self._latest.pop(name, None)
+                # same single exit path as LRU eviction: the retirement
+                # hooks + counter fire identically for a lifecycle retire
+                self._retire_entry(k, self._entries[k], "retired")
             if version is None or self._pinned_version.get(name) == version:
                 self._pinned_version.pop(name, None)
 

@@ -19,6 +19,30 @@ cold-start telemetry BENCH_SERVE.json persists), then serve ``predict``
 frames until ``close``/EOF.  Any uncaught error is fatal by design: the
 dispatcher owns the retry/respawn policy (launcher WorkerFailedError
 machinery), a wounded replica must die loudly, not limp.
+
+Lifecycle control ops (docs/serving.md "Online model lifecycle") ride the
+same serialized connection as predicts, which is what gives hot-swap its
+drain semantics for free: by the time the replica processes an
+``activate`` or ``retire`` frame, every predict the dispatcher sent before
+it has already completed.
+
+- ``load`` — mmap a published version out of the store and double-buffer
+  it NEXT TO the incumbent: registry entry, AOT programs (arch-keyed warm
+  cache: a same-architecture continuation deserializes instead of
+  compiling), fast path, and a NaN warm pass, all while the incumbent
+  keeps serving.
+- ``activate`` — repoint unversioned requests at a loaded version (pin +
+  fast-path alias flip: one dict store, no request ever sees a half-swap).
+  Self-sufficient: a respawned replica that missed the ``load`` broadcast
+  loads here.
+- ``retire`` — drop a non-active version.  Runs through
+  ``registry.remove``, whose retirement hook also fires on LRU eviction —
+  one cleanup path for both causes.
+
+At startup the replica serves the store's ACTIVE version per model (the
+manifest's committed serving version, falling back to latest) and pins it,
+so capacity pressure from candidate loads can only evict old candidates,
+never what is live.
 """
 from __future__ import annotations
 
@@ -78,7 +102,61 @@ class _FastPath:
         return out[:, 0] if out.shape[1] == 1 else out
 
 
-def _serve_loop(sock, engine, fast: dict) -> None:
+def _warm_fastpath(engine, fp, name, version, buckets) -> None:
+    """One NaN-row execute per bucket through the steady-state path (see
+    the startup warm loop) so the first real request after a load/activate
+    runs at steady-state latency."""
+    snap = fp.snap
+    for b in buckets:
+        X = np.full((int(b), max(snap.num_features, 1)), np.nan, np.float32)
+        if fp.run(X, False) is None:
+            engine.predict(name, X, direct=True, version=version)
+
+
+def _apply_control(engine, store, warm, fast, buckets, header) -> dict:
+    """One lifecycle control op (load / activate / retire); returns the
+    ack payload.  Raises on a bad request — the serve loop reports it as a
+    typed per-request error and keeps serving."""
+    import time
+
+    op = header["op"]
+    name = header["model"]
+    version = int(header["version"])
+    t0 = time.perf_counter()
+    if op == "retire":
+        fp = fast.get((name, version))
+        if fp is not None and fast.get((name, None)) is fp:
+            raise ValueError(
+                f"cannot retire the active version {name!r} v{version}; "
+                "activate another version first")
+        # registry.remove fires the retirement hook, which drops the
+        # (name, version) fast-path entry — the same path LRU eviction runs
+        engine.registry.remove(name, version)
+        return {"seconds": time.perf_counter() - t0}
+    st = {"hits": 0, "compiled": 0}
+    fp = fast.get((name, version))
+    if fp is None:
+        # double-buffer: the incumbent's registry entry, AOT programs, and
+        # fast path all stay live while the candidate builds next to them
+        snap = store.snapshot(name, version)
+        engine.registry.register_snapshot(name, snap, version)
+        st = warm.attach(snap, buckets)
+        fp = _FastPath(snap)
+        fast[(name, version)] = fp
+        _warm_fastpath(engine, fp, name, version, buckets)
+        warm.save()
+    if op == "activate":
+        # pin: get(name) resolves here and capacity pressure cannot evict
+        # it; the alias flip is one dict store, so every request sees
+        # either the old fast path or the new one, never neither
+        engine.registry.pin(name, version)
+        fast[(name, None)] = fp
+    return {"aot_hits": st["hits"], "aot_compiled": st["compiled"],
+            "seconds": time.perf_counter() - t0}
+
+
+def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
+                buckets=()) -> None:
     from . import wire
 
     stream = wire.reader(sock)  # one GIL event per frame, not three
@@ -88,14 +166,25 @@ def _serve_loop(sock, engine, fast: dict) -> None:
         except wire.WireError:
             return  # dispatcher gone: clean exit
         op = header.get("op")
+        rid = header.get("id")
         if op == "close":
             return
+        if op in ("load", "activate", "retire"):
+            try:
+                ack = _apply_control(engine, store, warm, fast, buckets,
+                                     header)
+                ack.update({"op": "ctrl_ok", "id": rid})
+                wire.send_frame(sock, ack)
+            except Exception as e:  # report, keep serving
+                wire.send_frame(sock, {"op": "error", "id": rid,
+                                       "etype": type(e).__name__,
+                                       "error": str(e)})
+            continue
         if op != "predict":
-            wire.send_frame(sock, {"op": "error", "id": header.get("id"),
+            wire.send_frame(sock, {"op": "error", "id": rid,
                                    "etype": "ValueError",
                                    "error": f"unknown op {op!r}"})
             continue
-        rid = header.get("id")
         try:
             X = wire.decode_matrix(header, payload)
             margin = bool(header.get("margin", False))
@@ -166,10 +255,11 @@ def main(argv=None) -> int:
 
     t0 = time.perf_counter()
     store = ModelStore(args.store)
-    entries = store.entries()
+    entries = store.serving_entries()  # the committed ACTIVE version each
     cfg = ServeConfig(use_batcher=False,
                       max_models=max(8, len(entries) + 2))
     engine = ServingEngine(cfg)
+
     if args.buckets:
         buckets = tuple(int(b) for b in args.buckets.split(",") if b)
     else:
@@ -177,13 +267,25 @@ def main(argv=None) -> int:
     warm = WarmProgramCache(args.cache or None)
     n_hits = n_compiled = 0
     fast: dict = {}
+
+    def _drop_fast(name, version, reason, snap):
+        # registry retirement hook: LRU eviction and lifecycle retire()
+        # both land here, so per-version fast-path state can never outlive
+        # residency whatever caused the exit (the active alias is safe: the
+        # active version is pinned, and retire refuses it explicitly)
+        fast.pop((name, version), None)
+
+    engine.registry.add_retire_hook(_drop_fast)
+
     for name, version in entries:
         snap = store.snapshot(name, version)
         engine.registry.register_snapshot(name, snap, version)
         st = warm.attach(snap, buckets)
         fp = _FastPath(snap)
-        # the manifest's latest version also answers unversioned requests
+        # the store's active version answers unversioned requests; pinned
+        # so candidate loads can never evict what is live
         fast[(name, version)] = fast[(name, None)] = fp
+        engine.registry.pin(name, version)
         n_hits += st["hits"]
         n_compiled += st["compiled"]
         # one NaN-row execute per bucket through the STEADY-STATE path
@@ -193,11 +295,7 @@ def main(argv=None) -> int:
         # layer doesn't cover (stump models) warm via the engine instead;
         # an engine-fallback request for an odd shape pays its own lazy
         # compile, same as any unwarmed bucket.
-        for b in buckets:
-            X = np.full((int(b), max(snap.num_features, 1)), np.nan,
-                        np.float32)
-            if fp.run(X, False) is None:
-                engine.predict(name, X, direct=True, version=version)
+        _warm_fastpath(engine, fp, name, version, buckets)
     warm.save()
     warmup_s = time.perf_counter() - t0
     wire.send_frame(sock, {
@@ -210,7 +308,8 @@ def main(argv=None) -> int:
     })
 
     try:
-        _serve_loop(sock, engine, fast)
+        _serve_loop(sock, engine, fast, store=store, warm=warm,
+                    buckets=buckets)
     finally:
         engine.close()
         try:

@@ -270,7 +270,14 @@ def train(
     # xgb_model continuation keeps the additive reference semantics.
     # Elastic runs are always total: survivors and replacements must agree
     # on the final round whatever state they entered with.
-    total = resumed is not None or elastic is not None
+    # process_type=update appends nothing — iterations are tree-SEGMENT
+    # indices into the existing model (the reference train() always starts
+    # at 0), so a refresh/prune pass over an xgb_model continuation walks
+    # rounds 0..num_boost_round-1 instead of past the end of the ensemble.
+    if getattr(bst, "process_type", "default") == "update":
+        start = 0
+    total = (resumed is not None or elastic is not None
+             or getattr(bst, "process_type", "default") == "update")
     end = num_boost_round if total else start + num_boost_round
     from .reliability.faults import maybe_inject
 
