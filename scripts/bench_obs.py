@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Observability overhead guard -> BENCH_OBS.json.
+
+Measures the wall-clock cost of the distributed telemetry plane's
+SHIPPING work — registry snapshot + JSON serialization + merged-registry
+ingest, the exact per-round / per-interval work a training rank or fleet
+replica pays when metric shipping is on (docs/observability.md
+"Distributed observability plane") — on the higgs ladder config shape
+(binary:logistic, 28 features, max_depth=8, eta=0.3, max_bin=256,
+5 rounds; rows = 11M * BENCH_OBS_SCALE).
+
+Two legs, each timed shipping-OFF then shipping-ON:
+
+- **train**: `xtb.train` bare vs with `TelemetryCallback(enable_spans=
+  False)` + a per-round snapshot ship (the tracker-channel cadence).
+  Spans stay off in both legs — they are a separate opt-in; this guard
+  isolates the shipping plane.
+- **serve**: a closed loop of direct engine predicts vs the same loop
+  shipping on the replica cadence (`XGBOOST_TPU_TELEMETRY_INTERVAL`),
+  with the `/metrics` scrape endpoint running and scraped once mid-leg.
+
+Convention matches bench_serve.py: every timed section repeats
+``BENCH_OBS_REPS`` times (default 3) and reports the MINIMUM wall
+(min-of-N estimates the code's actual cost on a time-shared host).
+The guard fails (exit 1) when the shipping-on overhead exceeds
+``BENCH_OBS_MAX_PCT`` (default 5%) on either leg.
+
+Usage:  python scripts/bench_obs.py [out.json]   (default BENCH_OBS.json)
+        BENCH_OBS_SCALE (default 0.02 -> 220k rows), BENCH_OBS_REPS,
+        BENCH_OBS_MAX_PCT, BENCH_OBS_ROUNDS (default 5)
+"""
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+HIGGS = dict(cols=28, objective="binary:logistic", max_depth=8, eta=0.3,
+             max_bin=256)
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+def make_higgs(scale: float, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    R = int(11_000_000 * scale)
+    X = rng.normal(size=(R, HIGGS["cols"])).astype(np.float32)
+    y = (X[:, 0] + 0.3 * X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+def _ship_once(merged, source):
+    """The per-ship work a worker/replica pays: snapshot + JSON encode
+    (what goes on the wire) + driver-side ingest."""
+    from xgboost_tpu.telemetry import distributed
+
+    payload = distributed.snapshot_payload()
+    json.dumps(payload)  # the wire bytes a real ship serializes
+    merged.ingest(source, payload["snapshot"])
+
+
+def bench_train(X, y, rounds, reps):
+    import xgboost_tpu as xtb
+    from xgboost_tpu import telemetry
+    from xgboost_tpu.telemetry import distributed
+
+    params = {"objective": HIGGS["objective"],
+              "max_depth": HIGGS["max_depth"], "eta": HIGGS["eta"],
+              "max_bin": HIGGS["max_bin"]}
+    d = xtb.DMatrix(X, label=y)
+    merged = distributed.MergedRegistry()
+
+    class _ShippingCallback(telemetry.TelemetryCallback):
+        def after_iteration(self, model, epoch, evals_log):
+            out = super().after_iteration(model, epoch, evals_log)
+            _ship_once(merged, "rank0")
+            return out
+
+    def run(shipping: bool) -> float:
+        cb = ([_ShippingCallback(enable_spans=False)] if shipping else None)
+        t0 = time.perf_counter()
+        xtb.train(params, d, rounds, callbacks=cb, verbose_eval=False)
+        return time.perf_counter() - t0
+
+    run(False)  # warm the compile caches once; both legs measure steady
+    # interleaved off/on reps: host-noise bursts hit both legs equally
+    # instead of biasing whichever leg ran during the burst
+    offs, ons = [], []
+    for _ in range(reps):
+        offs.append(run(False))
+        ons.append(run(True))
+    return min(offs), min(ons)
+
+
+def bench_serve(X, y, reps, batch=256):
+    """Closed predict loop for a FIXED duration per rep (long enough to
+    amortize several ship intervals); reports walls normalized to the
+    off-leg's request count so the two legs compare like-for-like."""
+    import xgboost_tpu as xtb
+    from xgboost_tpu.serving import ServeConfig, ServingEngine
+    from xgboost_tpu.telemetry import distributed
+
+    params = {"objective": HIGGS["objective"], "max_depth": 6,
+              "eta": HIGGS["eta"], "max_bin": HIGGS["max_bin"]}
+    bst = xtb.train(params, xtb.DMatrix(X[:50_000], label=y[:50_000]), 5,
+                    verbose_eval=False)
+    eng = ServingEngine(ServeConfig(use_batcher=False))
+    eng.add_model("m", bst)
+    Xq = X[:batch]
+    eng.predict("m", Xq, direct=True)  # warm the serve program
+    merged = distributed.MergedRegistry()
+    interval = distributed.ship_interval()
+    leg_s = max(_env_float("BENCH_OBS_LEG_S", 4.0), 2.0 * interval)
+    srv = distributed.MetricsServer(0, merged=merged).start()
+    try:
+        def run(shipping: bool) -> float:
+            """requests/second over one fixed-duration leg."""
+            last = time.monotonic()
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < leg_s:
+                eng.predict("m", Xq, direct=True)
+                n += 1
+                if shipping:
+                    now = time.monotonic()
+                    if now - last >= interval:
+                        last = now
+                        _ship_once(merged, "replica0")
+            return n / (time.perf_counter() - t0)
+
+        # one scrape mid-bench, like a live Prometheus target
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10).read()
+        offs, ons = [], []
+        for _ in range(reps):
+            offs.append(run(False))
+            ons.append(run(True))
+        # best rate per leg -> equivalent wall for the same request count
+        off_rate, on_rate = max(offs), max(ons)
+        return 1.0 / off_rate, 1.0 / on_rate
+    finally:
+        srv.close()
+        eng.close()
+
+
+def main(out_path: str) -> int:
+    scale = _env_float("BENCH_OBS_SCALE", 0.02)
+    reps = max(1, int(_env_float("BENCH_OBS_REPS", 3)))
+    rounds = max(1, int(_env_float("BENCH_OBS_ROUNDS", 5)))
+    max_pct = _env_float("BENCH_OBS_MAX_PCT", 5.0)
+
+    X, y = make_higgs(scale)
+    print(f"bench_obs: higgs config at scale {scale} "
+          f"({len(X):,} rows x {X.shape[1]}), {rounds} rounds, "
+          f"min-of-{reps}")
+
+    t_off, t_on = bench_train(X, y, rounds, reps)
+    s_off, s_on = bench_serve(X, y, reps)
+
+    def pct(off, on):
+        return 100.0 * (on - off) / off if off > 0 else 0.0
+
+    report = {
+        "config": {"name": "higgs_binary", "scale": scale,
+                   "rows": int(len(X)), "rounds": rounds,
+                   **{k: v for k, v in HIGGS.items()}},
+        "reps": reps,
+        "threshold_pct": max_pct,
+        "train": {"off_s": t_off, "on_s": t_on,
+                  "overhead_pct": pct(t_off, t_on)},
+        "serve": {"off_s_per_request": s_off, "on_s_per_request": s_on,
+                  "overhead_pct": pct(s_off, s_on)},
+    }
+    worst = max(report["train"]["overhead_pct"],
+                report["serve"]["overhead_pct"])
+    report["pass"] = bool(worst <= max_pct)
+    with open(out_path, "w") as fh:
+        json.dump(report, fh, indent=2)
+        fh.write("\n")
+    print(f"train: off {t_off:.3f}s on {t_on:.3f}s "
+          f"({report['train']['overhead_pct']:+.2f}%)")
+    print(f"serve: off {s_off * 1e3:.3f}ms/req on {s_on * 1e3:.3f}ms/req "
+          f"({report['serve']['overhead_pct']:+.2f}%)")
+    print(f"wrote {out_path}; worst overhead {worst:+.2f}% "
+          f"(threshold {max_pct}%)")
+    if not report["pass"]:
+        print("bench_obs: FAIL — telemetry shipping overhead exceeds "
+              "threshold", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "BENCH_OBS.json"))

@@ -4,14 +4,22 @@
 One scenario, end to end against real replica processes:
 
 1. Start a 3-replica fleet over two models with a warm-capable persistent
-   compile cache.
+   compile cache — with tracing configured and the merged `/metrics`
+   scrape endpoint up (docs/observability.md "Distributed observability
+   plane").
 2. Drive mixed two-model traffic from several client threads.
-3. SIGKILL one replica mid-stream.
+3. SIGKILL one replica mid-stream; scrape `/metrics` MID-RUN and assert
+   the merged view carries both per-replica-labeled `xtb_serve_*` series
+   and merged `xtb_fleet_*` series.
 4. Assert EVERY request completes with the right bits (the dead replica's
    in-flight batch reroutes; nothing is dropped), the respawn brings the
    fleet back to strength, and the p99 over the whole disrupted stream is
    recorded (printed + exit-code-gated on completeness, not speed — this
    host is time-shared).
+5. Observability postmortems: the SIGKILL'd replica's driver-side flight
+   dump exists, and the merged chrome trace (driver file + per-replica
+   sidecars) contains a dispatcher `fleet.request` and a replica
+   `replica.execute` event sharing one request trace id across two pids.
 
 Usage: JAX_PLATFORMS=cpu python scripts/fleet_smoke.py [n_replicas] [reqs]
 """
@@ -52,8 +60,16 @@ def main() -> int:
     per_client = (int(sys.argv[2]) if len(sys.argv) > 2 else 120) // N_CLIENTS
 
     from xgboost_tpu.serving import ServeConfig, ServingEngine, ServingFleet
+    from xgboost_tpu.telemetry import distributed, trace
 
     workdir = tempfile.mkdtemp(prefix="xtb_fleet_smoke_")
+    # observability smoke preamble: trace everything (configure exports
+    # the env var, so replicas capture <path>.<pid> sidecars), ship fast,
+    # and stand up the merged scrape endpoint
+    trace_path = os.path.join(workdir, "fleet_trace.jsonl")
+    os.environ[distributed.ENV_INTERVAL] = "0.2"
+    trace.configure(trace_path)
+    metrics_srv = distributed.start_metrics_server(port=0)
     paths, X = train_pair(workdir)
     Xq = X[:BATCH]
 
@@ -99,8 +115,34 @@ def main() -> int:
             t.start()
         assert kill_at.wait(timeout=600), "traffic never reached kill point"
         victim = next(r for r in fleet._replicas.values() if r.alive)
+        victim_label = victim.label
         print(f"killing {victim.label} (pid {victim.proc.pid}) mid-stream")
         victim.proc.send_signal(signal.SIGKILL)
+        # mid-run merged scrape: per-replica AND merged series in one GET.
+        # Keep traffic flowing through the scrape window — shipping
+        # piggybacks on frames, so a ship needs requests spanning the
+        # interval (the client threads may already have drained)
+        t_end = time.monotonic() + 1.5
+        while time.monotonic() < t_end:
+            fleet.predict("a", Xq, timeout=600)
+            time.sleep(0.04)
+        import urllib.request
+
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics_srv.port}/metrics",
+            timeout=30).read().decode()
+        if 'xtb_fleet_requests_total{proc="driver",model="a"}' not in body:
+            errors.append("scrape: driver-side xtb_fleet_* series missing")
+        if not [ln for ln in body.splitlines()
+                if ln.startswith('xtb_serve_requests_total{proc="replica')]:
+            errors.append("scrape: per-replica xtb_serve_* series missing")
+        merged_fleet = [ln for ln in body.splitlines()
+                        if ln.startswith('xtb_fleet_requests_total{model=')]
+        if not merged_fleet:
+            errors.append("scrape: merged xtb_fleet_* series missing")
+        else:
+            print(f"mid-run scrape OK: {len(body.splitlines())} lines, "
+                  f"merged {merged_fleet[0]}")
         for t in threads:
             t.join(900)
         alive = [t for t in threads if t.is_alive()]
@@ -112,6 +154,53 @@ def main() -> int:
                and time.monotonic() < deadline):
             time.sleep(0.2)
         respawned = fleet.alive_replicas()
+        # the SIGKILL'd replica's postmortem, written driver-side from its
+        # last shipped flight ring + final snapshot
+        deadline = time.monotonic() + 30
+        while (victim_label not in fleet.flight_dumps
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        flight_path = fleet.flight_dumps.get(victim_label)
+        if not flight_path or not os.path.exists(flight_path):
+            errors.append(f"no driver-side flight dump for SIGKILL'd "
+                          f"{victim_label}")
+        else:
+            import json
+
+            dump = json.load(open(flight_path))
+            if dump.get("snapshot") is None:
+                errors.append("flight dump missing the final snapshot")
+            print(f"flight dump OK: {flight_path} "
+                  f"({len(dump.get('events', []))} ring events)")
+
+    # merged chrome trace: driver file + per-replica sidecars must pair a
+    # dispatcher fleet.request with a replica.execute on ONE trace id
+    import glob
+    import json
+
+    trace.flush()
+    events = []
+    for path in [trace_path] + sorted(glob.glob(trace_path + ".*")):
+        with open(path) as fh:
+            for line in fh:
+                events.append(json.loads(line))  # every line must parse
+    disp = {e["args"]["trace"]: e["pid"] for e in events
+            if e["name"] == "fleet.request" and e.get("args", {}).get(
+                "trace")}
+    paired = [e for e in events if e["name"] == "replica.execute"
+              and e.get("args", {}).get("trace") in disp
+              and e["pid"] != disp[e["args"]["trace"]]]
+    if not paired:
+        errors.append("merged trace: no dispatcher+replica pair sharing a "
+                      "request trace id")
+    else:
+        ex = paired[0]
+        print(f"merged trace OK: {len(events)} events across "
+              f"{len({e['pid'] for e in events})} pids; e.g. trace "
+              f"{ex['args']['trace']} paired across pids "
+              f"{disp[ex['args']['trace']]} and {ex['pid']}")
+    trace.configure(None)
+    distributed.stop_metrics_server()
 
     total = N_CLIENTS * per_client
     done = len(lats)

@@ -74,12 +74,23 @@ JAX_PLATFORMS=cpu python scripts/fault_smoke.py 4 6
 # shard map restored from the checkpoint
 JAX_PLATFORMS=cpu python scripts/elastic_smoke.py 4 8
 
-# serving-fleet smoke (docs/serving.md "Fleet"): 3 replicas over two
-# models with a warm compile cache, mixed traffic from 6 client threads,
-# one replica SIGKILLed mid-stream — every request must complete with the
-# in-process engine's exact bits (the dead replica's in-flight batch
-# reroutes), p99 recorded, and the respawn must restore fleet strength
+# serving-fleet + observability smoke (docs/serving.md "Fleet",
+# docs/observability.md "Distributed observability plane"): 3 replicas
+# over two models with a warm compile cache, mixed traffic from 6 client
+# threads, one replica SIGKILLed mid-stream — every request must complete
+# with the in-process engine's exact bits (the dead replica's in-flight
+# batch reroutes), p99 recorded, and the respawn must restore fleet
+# strength.  Mid-run, one /metrics scrape must return per-replica-labeled
+# xtb_serve_* AND merged xtb_fleet_* series; afterwards the SIGKILL'd
+# replica's driver-side flight dump must exist and the merged chrome
+# trace (driver + sidecars) must pair a dispatcher fleet.request with a
+# replica.execute on one request trace id across two pids
 JAX_PLATFORMS=cpu python scripts/fleet_smoke.py 3 120
+
+# observability overhead guard (docs/observability.md): train+serve walls
+# with telemetry shipping on vs off on the higgs config shape, min-of-N
+# with interleaved legs; fails beyond BENCH_OBS_MAX_PCT (default 5%)
+JAX_PLATFORMS=cpu python scripts/bench_obs.py bench_out/BENCH_OBS.json
 
 # online-lifecycle smoke (docs/serving.md "Online model lifecycle"):
 # serve -> continuation-train on fresh rows -> gate -> hot-swap under

@@ -1,0 +1,333 @@
+"""Distributed observability plane (telemetry/distributed.py + flight.py).
+
+Quick tier covers the unit seams with no subprocess spawn: snapshot
+round-trip, merged relabel + sum semantics, the HTTP scrape endpoint,
+exposition-format escaping, catalog-sourced HELP text, the flight ring,
+collective wait instrumentation, and the 2-rank in-memory straggler
+report.  The slow tier runs a real 2-replica fleet and asserts the
+acceptance contract: one scrape returns per-process-labeled AND merged
+series, with the merged counter equal to the per-replica sum.
+"""
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from xgboost_tpu.telemetry import distributed, flight
+from xgboost_tpu.telemetry.registry import Registry, get_registry
+
+
+def _mk_registry(requests=3.0, lat=(0.01, 0.02)):
+    r = Registry()
+    r.counter("xtb_t_requests_total", "requests", ("model",)).labels(
+        "m").inc(requests)
+    r.gauge("xtb_t_live", "live things").set(1)
+    h = r.histogram("xtb_t_seconds", "latency", ("model",),
+                    buckets=(0.015, 1.0))
+    for v in lat:
+        h.labels("m").observe(v)
+    return r
+
+
+# =========================================================================
+# snapshot + merge
+
+
+def test_snapshot_roundtrip_is_json_serializable():
+    snap = _mk_registry().snapshot()
+    again = json.loads(json.dumps(snap))
+    fams = {f["name"]: f for f in again["families"]}
+    assert fams["xtb_t_requests_total"]["children"] == [[["m"], 3.0]]
+    hist = fams["xtb_t_seconds"]
+    assert hist["buckets"] == [0.015, 1.0]
+    ((labels, counts, s, n),) = hist["children"]
+    assert labels == ["m"] and counts == [1, 1, 0] and n == 2
+    assert s == pytest.approx(0.03)
+
+
+def test_merged_relabels_per_process_and_sums():
+    m = distributed.MergedRegistry()
+    m.ingest("replica0", _mk_registry(requests=2).snapshot())
+    m.ingest("replica1", _mk_registry(requests=5).snapshot())
+    text = m.render_prometheus(include_local=False)
+    # per-process series carry proc=, the merged series does not
+    assert 'xtb_t_requests_total{proc="replica0",model="m"} 2' in text
+    assert 'xtb_t_requests_total{proc="replica1",model="m"} 5' in text
+    assert '\nxtb_t_requests_total{model="m"} 7' in text
+    # gauges merge by sum too (documented in the catalog scope column)
+    assert '\nxtb_t_live 2' in text
+    assert m.merged_totals("xtb_t_requests_total",
+                           include_local=False) == {("m",): 7.0}
+
+
+def test_merged_histogram_buckets_sum_bucketwise():
+    m = distributed.MergedRegistry()
+    m.ingest("a", _mk_registry(lat=(0.01,)).snapshot())
+    m.ingest("b", _mk_registry(lat=(0.02, 0.02)).snapshot())
+    text = m.render_prometheus(include_local=False)
+    assert '\nxtb_t_seconds_bucket{model="m",le="0.015"} 1' in text
+    assert '\nxtb_t_seconds_bucket{model="m",le="+Inf"} 3' in text
+    assert '\nxtb_t_seconds_count{model="m"} 3' in text
+
+
+def test_merged_retains_dead_sources_and_replaces_live_ones():
+    m = distributed.MergedRegistry()
+    m.ingest("replica0", _mk_registry(requests=1).snapshot())
+    m.ingest("replica0", _mk_registry(requests=9).snapshot())  # newer wins
+    assert m.merged_totals("xtb_t_requests_total",
+                           include_local=False) == {("m",): 9.0}
+    # nothing forgets a source on death — the last snapshot stays
+    assert m.sources() == ["replica0"]
+
+
+def test_merged_skips_conflicting_family_signature():
+    m = distributed.MergedRegistry()
+    m.ingest("a", _mk_registry().snapshot())
+    bad = Registry()
+    bad.counter("xtb_t_requests_total", "conflicting labels",
+                ("other",)).labels("x").inc()
+    m.ingest("b", bad.snapshot())
+    text = m.render_prometheus(include_local=False)
+    assert 'proc="a"' in text and 'other="x"' not in text
+
+
+# =========================================================================
+# scrape endpoint
+
+
+def test_scrape_endpoint_serves_merged_view():
+    m = distributed.MergedRegistry()
+    m.ingest("rank0", _mk_registry(requests=4).snapshot())
+    srv = distributed.MetricsServer(0, merged=m,
+                                    include_local=False).start()
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10)
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        body = resp.read().decode()
+        assert 'xtb_t_requests_total{proc="rank0",model="m"} 4' in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=10)
+    finally:
+        srv.close()
+
+
+def test_start_metrics_server_disabled_without_env(monkeypatch):
+    monkeypatch.delenv(distributed.ENV_PORT, raising=False)
+    assert distributed.start_metrics_server() is None
+
+
+# =========================================================================
+# exposition format (satellite: HELP escaping + catalog-sourced help)
+
+
+def test_help_line_escapes_newlines_and_backslashes():
+    r = Registry()
+    r.counter("xtb_t_requests_total", 'first line\nsecond "quoted" \\x')
+    text = r.render_prometheus()
+    (help_line,) = [l for l in text.splitlines() if l.startswith("# HELP")]
+    # one physical line, newline and backslash escaped per the format
+    assert help_line == ('# HELP xtb_t_requests_total first line\\n'
+                         'second "quoted" \\\\x')
+
+
+def test_label_values_escape_quotes_and_newlines():
+    r = Registry()
+    r.counter("xtb_t_requests_total", "r", ("m",)).labels('a"b\nc').inc()
+    text = r.render_prometheus()
+    assert 'xtb_t_requests_total{m="a\\"b\\nc"} 1' in text
+
+
+def test_empty_help_falls_back_to_docs_catalog():
+    r = Registry()
+    # registered with NO help; the docs catalog documents this family
+    r.counter("xtb_serve_requests_total", "", ("model",)).labels("m").inc()
+    text = r.render_prometheus()
+    help_lines = [l for l in text.splitlines()
+                  if l.startswith("# HELP xtb_serve_requests_total")]
+    assert help_lines and "request" in help_lines[0]
+
+
+# =========================================================================
+# flight recorder
+
+
+def test_flight_ring_records_bounds_and_dumps(tmp_path):
+    flight.clear()
+    for i in range(5):
+        flight.record("event", "unit.test", i=i)
+    evs = [e for e in flight.events() if e["name"] == "unit.test"]
+    assert len(evs) == 5 and evs[0]["detail"] == {"i": 0}
+    assert all(e["kind"] == "event" and "t_mono" in e for e in evs)
+    path = flight.dump(str(tmp_path / "dump.json"))
+    data = json.load(open(path))
+    assert data["pid"] and data["wall_at_dump"]
+    assert [e for e in data["events"] if e["name"] == "unit.test"]
+    flight.clear()
+    assert flight.events() == []
+
+
+def test_flight_ring_is_bounded():
+    flight.clear()
+    cap = flight._ring.maxlen
+    for i in range(cap + 500):
+        flight.record("event", "flood", i=i)
+    evs = flight.events()
+    # the ring holds exactly its configured capacity: oldest events fell
+    # off, the newest survived
+    assert len(evs) == cap
+    assert evs[-1]["detail"]["i"] == cap + 499
+    assert evs[0]["detail"]["i"] == 500
+    flight.clear()
+
+
+def test_spans_feed_flight_ring():
+    from xgboost_tpu.telemetry import spans
+
+    flight.clear()
+    was = spans.enabled()
+    spans.enable()
+    try:
+        with spans.span("unit.flightspan"):
+            pass
+    finally:
+        spans.enable(was)
+    names = [e["name"] for e in flight.events() if e["kind"] == "span"]
+    assert "unit.flightspan" in names
+    flight.clear()
+
+
+def test_snapshot_payload_carries_registry_and_flight():
+    flight.clear()
+    flight.record("event", "payload.test")
+    payload = distributed.snapshot_payload()
+    assert payload["pid"] > 0
+    assert any(f["name"].startswith("xtb_")
+               for f in payload["snapshot"]["families"])
+    assert any(e["name"] == "payload.test" for e in payload["flight"])
+    json.dumps(payload)  # shippable as-is
+    flight.clear()
+
+
+# =========================================================================
+# collective wait instrumentation + straggler report
+
+
+def test_allreduce_records_coll_wait_histogram():
+    from xgboost_tpu import collective
+
+    out = collective.allreduce(np.asarray([1.0, 2.0]))
+    np.testing.assert_array_equal(out, [1.0, 2.0])
+    hist = get_registry().get("xtb_coll_wait_seconds")
+    assert hist is not None
+    sums = hist.snapshot_sums()
+    assert any(k[0] == "allreduce" for k in sums)
+
+
+def test_inmemory_straggler_report_names_slow_rank():
+    from xgboost_tpu import collective
+    from xgboost_tpu.telemetry import TelemetryCallback
+
+    results = {}
+    errors = []
+
+    def worker(rank):
+        try:
+            collective.init(dmlc_communicator="in-memory",
+                            in_memory_world_size=2, in_memory_rank=rank,
+                            in_memory_group="straggler-test")
+            cb = TelemetryCallback(enable_spans=False, straggler=True)
+            cb.before_iteration(None, 0, None)
+            # the round's collective (what a real level allreduce is)
+            collective.allgather(np.asarray([float(rank)]))
+            if rank == 1:
+                time.sleep(0.25)  # rank 1 is the deterministic straggler
+            cb.after_iteration(object(), 0, None)
+            results[rank] = cb.history[0]
+        except BaseException as e:  # pragma: no cover - surfaced below
+            errors.append((rank, repr(e)))
+        finally:
+            collective.finalize()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    for rank in (0, 1):
+        st = results[rank]["straggler"]
+        assert st["max_rank"] == 1 and st["min_rank"] == 0
+        assert len(st["walls"]) == 2
+        assert st["spread_s"] > 0.1
+    # the round's collective landed in the per-rank wait accounting
+    for rank in (0, 1):
+        assert results[rank]["coll_wait"]["count"] >= 1
+
+
+def test_callback_without_straggler_adds_no_collective(monkeypatch):
+    from xgboost_tpu.telemetry import TelemetryCallback
+
+    cb = TelemetryCallback(enable_spans=False)
+    cb.before_iteration(None, 0, None)
+    cb.after_iteration(object(), 0, None)
+    assert "straggler" not in cb.history[0]
+
+
+# =========================================================================
+# slow: real 2-replica fleet, one scrape = per-process + merged series
+
+
+@pytest.mark.slow
+def test_fleet_scrape_merged_equals_per_replica_sum(tmp_path, monkeypatch):
+    import xgboost_tpu as xtb
+    from xgboost_tpu.serving import ServingFleet
+
+    monkeypatch.setenv(distributed.ENV_INTERVAL, "0.2")
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(400, 8)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "seed": 3}, xtb.DMatrix(X, label=y), 3,
+                    verbose_eval=False)
+    total = 60
+    with ServingFleet({"obsm": bst}, n_replicas=2,
+                      warmup_buckets=(64,)) as fleet:
+        # concurrent waves so BOTH replicas serve (window-1 dispatch gives
+        # sequential blocking predicts to one free replica over and over)
+        for _wave in range(3):
+            futs = [fleet.submit("obsm", X[:64]) for _ in range(total // 3)]
+            for f in futs:
+                f.result(timeout=60)
+            time.sleep(0.25)  # let a periodic ship fire mid-run
+    # the close handshake makes each replica ship its final snapshot; the
+    # rx threads ingest it — poll until the merged count catches up
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        tot = distributed.get_merged().merged_totals(
+            "xtb_serve_requests_total").get(("obsm",), 0.0)
+        if tot >= total:
+            break
+        time.sleep(0.05)
+    assert tot == total
+    srv = distributed.MetricsServer(0).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10).read(
+        ).decode()
+    finally:
+        srv.close()
+    per_proc = {
+        proc: float(v) for proc, v in re.findall(
+            r'xtb_serve_requests_total\{proc="([^"]+)",model="obsm"\} '
+            r'([0-9.e+-]+)', body)}
+    (merged_v,) = re.findall(
+        r'\nxtb_serve_requests_total\{model="obsm"\} ([0-9.e+-]+)', body)
+    assert set(per_proc) == {"replica0", "replica1"}
+    assert all(v > 0 for v in per_proc.values())  # both replicas served
+    assert float(merged_v) == sum(per_proc.values()) == total

@@ -386,6 +386,27 @@ def test_fleet_end_to_end_parity_and_reroute(fleet_models, tmp_path):
         assert fleet.alive_replicas() == 2
         np.testing.assert_array_equal(
             fleet.predict("b", X, timeout=60), fleet_models["ref_b"])
+        # flight recorder on kill: the dispatcher dumped the SIGKILL'd
+        # replica's last shipped ring + final snapshot driver-side (the
+        # corpse itself never got the chance), and the failure record
+        # points at it
+        deadline = time.monotonic() + 30
+        while (victim.label not in fleet.flight_dumps
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        dump_path = fleet.flight_dumps[victim.label]
+        assert os.path.exists(dump_path)
+        import json as _json
+
+        dump = _json.load(open(dump_path))
+        assert dump["label"] == victim.label
+        assert any(e["name"] == "replica.start" for e in dump["events"])
+        assert any(f["name"].startswith("xtb_")
+                   for f in (dump["snapshot"] or {}).get("families", []))
+        with fleet._cv:
+            failure_tails = [t for (lb, _rc, t) in fleet._failures
+                             if lb == victim.label]
+        assert any("flight recorder" in t for t in failure_tails)
 
 
 @pytest.mark.slow

@@ -261,17 +261,17 @@ class _StubFleet:
     def active_version(self, model):
         return self._versions.get(model)
 
-    def load_version(self, model, version, timeout=None):
+    def load_version(self, model, version, timeout=None, trace=None):
         self.calls.append(("load", model, int(version)))
         return [{"aot_hits": 0, "aot_compiled": 0}]
 
-    def activate_version(self, model, version, timeout=None):
+    def activate_version(self, model, version, timeout=None, trace=None):
         self.store.set_active(model, int(version))  # the durable commit
         self._versions[model] = int(version)
         self.calls.append(("activate", model, int(version)))
         return [{}]
 
-    def retire_version(self, model, version, timeout=None):
+    def retire_version(self, model, version, timeout=None, trace=None):
         self.calls.append(("retire", model, int(version)))
         return [{}]
 
@@ -279,7 +279,8 @@ class _StubFleet:
         self.calls.append(("set_shadow", model, int(version), fraction))
 
     def shadow_stats(self, model):
-        return {"pairs": 5, "failures": 0, "mean_div": 0.0, "max_div": 0.0}
+        return {"pairs": 5, "failures": 0, "mean_div": 0.0,
+                "max_div": 0.0, "mean_ks": 0.0, "max_ks": 0.0}
 
     def clear_shadow(self, model):
         self.calls.append(("clear_shadow", model))
@@ -486,3 +487,68 @@ def test_lifecycle_end_to_end_fleet(tmp_path):
             th.join(120)
         assert not errs, errs
         assert done[0] > 0  # traffic genuinely flowed through the swaps
+
+
+# =========================================================================
+# Shadow KS distribution gate (PR 11 satellite)
+
+
+def test_ks_stat_zero_for_identical_and_one_for_disjoint():
+    from xgboost_tpu.serving.fleet import _ks_stat
+
+    a = np.linspace(0.0, 1.0, 100)
+    assert _ks_stat(a, a.copy()) == 0.0
+    assert _ks_stat(np.zeros(50), np.ones(50)) == pytest.approx(1.0)
+    # a mild shift moves the statistic strictly between the extremes
+    shifted = _ks_stat(a, a + 0.1)
+    assert 0.0 < shifted < 1.0
+
+
+def test_shadow_ks_gate_rejects_drifted_candidate(tmp_path):
+    """A candidate whose shadow phase shows KS drift beyond shadow_max_ks
+    is rejected (reason "shadow"): retired from the replicas, never
+    activated, incumbent untouched — like every other gate half."""
+
+    X, y, st, fleet = _stub_pair(tmp_path)
+
+    drifted = {"pairs": 5, "failures": 0, "mean_div": 0.01,
+               "max_div": 0.02, "mean_ks": 0.4, "max_ks": 0.6}
+    fleet.shadow_stats = lambda model: dict(drifted)
+    real_clear = fleet.clear_shadow
+
+    def clear(model):
+        real_clear(model)
+        return dict(drifted)
+
+    fleet.clear_shadow = clear
+    mgr = LifecycleManager(fleet, "m", config=LifecycleConfig(
+        rounds_per_cycle=2, shadow_fraction=0.5, shadow_min_pairs=1,
+        shadow_max_ks=0.1))
+    rep = mgr.run_cycle((X[2000:], y[2000:]),
+                        eval_window=(X[:2000], y[:2000]))
+    assert not rep.swapped
+    assert rep.decision.reason == "shadow"
+    assert rep.shadow["max_ks"] == pytest.approx(0.6)
+    assert rep.trace_id  # the cycle is joinable against flight/trace data
+    ops = [c[0] for c in fleet.calls]
+    # loaded, shadowed, then RETIRED — never activated
+    assert ops == ["load", "set_shadow", "clear_shadow", "retire"]
+    assert st.active_version("m") == 1  # incumbent still serving
+    # the published-but-rejected candidate is inert; a permissive manager
+    # afterwards can still swap (nothing is wedged)
+    mgr2 = LifecycleManager(fleet, "m", config=LifecycleConfig(
+        rounds_per_cycle=2))
+    rep2 = mgr2.run_cycle((X[2000:], y[2000:]),
+                          eval_window=(X[:2000], y[:2000]))
+    assert rep2.swapped
+
+
+def test_shadow_ks_gate_passes_within_threshold(tmp_path):
+    X, y, st, fleet = _stub_pair(tmp_path)
+    mgr = LifecycleManager(fleet, "m", config=LifecycleConfig(
+        rounds_per_cycle=2, shadow_fraction=0.5, shadow_min_pairs=1,
+        shadow_max_ks=0.25))  # stub reports max_ks 0.0
+    rep = mgr.run_cycle((X[2000:], y[2000:]),
+                        eval_window=(X[:2000], y[:2000]))
+    assert rep.swapped and rep.decision.accepted
+    assert st.active_version("m") == 2

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from enum import IntEnum
 from typing import Any, Dict, List, Optional
 
@@ -544,6 +545,32 @@ def _backend() -> CollBackend:
     return _DEFAULT
 
 
+_coll_hist = None  # xtb_coll_wait_seconds family (lazy; import stays cheap)
+
+
+def _observe_wait(op: str, t0: float) -> None:
+    """Record one collective's blocked wall into
+    ``xtb_coll_wait_seconds{op,rank}`` — the per-rank straggler signal: a
+    FAST rank spends its round waiting in collectives for the slow one,
+    so the rank with the largest wait is pointing at the straggler, per
+    op.  Shipped snapshots merge these driver-side, where the per-rank
+    labels make cross-rank comparison one scrape
+    (docs/observability.md § Distributed observability)."""
+    global _coll_hist
+    if _coll_hist is None:
+        from .telemetry.registry import get_registry
+
+        _coll_hist = get_registry().histogram(
+            "xtb_coll_wait_seconds",
+            "seconds blocked in collective operations, by op and rank",
+            ("op", "rank"))
+    try:
+        rank = get_rank()
+    except Exception:  # pragma: no cover - backend mid-teardown
+        rank = -1
+    _coll_hist.labels(op, str(rank)).observe(time.perf_counter() - t0)
+
+
 def _reconcile_native_kernels() -> None:
     """All ranks must run the SAME split/hist implementation: the native FFI
     scan differs from the XLA formulation in the last f32 ulp, and every
@@ -612,6 +639,15 @@ def init(**args: Any) -> None:
 
 def finalize() -> None:
     global _PROCESS_BACKEND
+    # final telemetry ship BEFORE the channel closes: the driver-side
+    # merged registry keeps this worker's last numbers after the process
+    # is gone (best-effort; no tracker backend = no-op)
+    try:
+        from .telemetry import distributed as _distributed
+
+        _distributed.ship_to_tracker(force=True)
+    except Exception:  # pragma: no cover - observability must not fail exit
+        pass
     b = getattr(_TLS, "backend", None)
     if b is not None:
         b.shutdown()
@@ -651,7 +687,10 @@ def allreduce(data: np.ndarray, op: Op = Op.SUM) -> np.ndarray:
     # signal_error path), kill (worker death mid-collective); no-op
     # without an installed plan (one global read)
     _maybe_inject("collective.allreduce", rank=get_rank)
-    return _backend().allreduce(np.asarray(data), op)
+    t0 = time.perf_counter()
+    out = _backend().allreduce(np.asarray(data), op)
+    _observe_wait("allreduce", t0)
+    return out
 
 
 def allgather(data: np.ndarray) -> np.ndarray:
@@ -660,7 +699,10 @@ def allgather(data: np.ndarray) -> np.ndarray:
     The building block of the distributed quantile-sketch merge
     (reference: src/common/quantile.cc:397 AllreduceV of summaries)."""
     _maybe_inject("collective.allgather", rank=get_rank)
-    return _backend().allgather(np.asarray(data))
+    t0 = time.perf_counter()
+    out = _backend().allgather(np.asarray(data))
+    _observe_wait("allgather", t0)
+    return out
 
 
 def allgather_ragged(data: np.ndarray) -> np.ndarray:
@@ -718,7 +760,9 @@ def regroup(completed_round: int = 0):
     # (regroup machinery fault -> job failure path), kill (death during
     # the regroup itself — the tracker completes with the remainder)
     _maybe_inject("collective.regroup", rank=get_rank)
+    t0 = time.perf_counter()
     out = _backend().regroup(int(completed_round))
+    _observe_wait("regroup", t0)
     # re-run the kernel reconcile as the new epoch's FIRST collective: an
     # absorbed replacement runs it during init(), so survivors must replay
     # it too or the epoch's relay seq numbering diverges between them —

@@ -76,13 +76,31 @@ def spawn_worker(argv, label, err_files: dict, *, env=None):
     recorded in ``err_files[label]`` — not a pipe, since nobody drains
     pipes while workers run and the tail must survive the process — so a
     death surfaces its actual cause via :func:`stderr_tail`, not a bare
-    exit code.  Returns the ``subprocess.Popen``; the caller owns reaping
-    and unlinking ``err_files`` values."""
+    exit code.  The child also inherits a flight-recorder identity
+    (``XGBOOST_TPU_FLIGHT_DIR``/``_LABEL``), so its crash/spill dump
+    lands at :func:`flight_dump_path` for this label.  Returns the
+    ``subprocess.Popen``; the caller owns reaping and unlinking
+    ``err_files`` values."""
+    from .telemetry import flight
+
     fd, err_path = tempfile.mkstemp(prefix=f"xtb_worker_{label}_",
                                     suffix=".stderr")
     err_files[label] = err_path
+    env = dict(env if env is not None else os.environ)
+    env.setdefault(flight.ENV_DIR, flight.dump_dir())
+    env[flight.ENV_LABEL] = str(label)
     with os.fdopen(fd, "wb") as ef:
         return subprocess.Popen(argv, env=env, stderr=ef)
+
+
+def flight_dump_path(label) -> Optional[str]:
+    """The flight-recorder dump a worker spawned with ``label`` would
+    have left (crash dump, periodic spill, or atexit) — None when the
+    process never wrote one (e.g. SIGKILL before the first spill)."""
+    from .telemetry import flight
+
+    path = flight.default_path(str(label))
+    return path if os.path.exists(path) else None
 
 
 _CHILD = r"""
@@ -95,6 +113,9 @@ if platform:
 if sys.argv[6]:
     sys.path.insert(0, sys.argv[6])  # make fn's defining module importable
 from xgboost_tpu import collective
+from xgboost_tpu.telemetry import flight, trace
+
+flight.install()  # ring spill + crash dump under the launcher's label env
 
 rank = sys.argv[1]  # spawn label; an int only in direct mode ("respawn<N>"
                     # labels exist in elastic tracker mode)
@@ -113,10 +134,18 @@ else:
     rank = int(rank)
     collective.init(coordinator_address=f"127.0.0.1:{port}",
                     num_processes=world, process_id=rank)
+if trace.active():
+    trace.set_process_name(f"rank{rank}")
 with open(sys.argv[5], "rb") as fh:
     fn = pickle.load(fh)
 try:
     fn(rank, world)
+except BaseException as e:
+    # postmortem without tracing: the ring of recent spans/events/faults
+    # survives as a dump the launcher attaches to WorkerFailedError
+    flight.record("fault", "worker.crash", error=repr(e))
+    flight.dump()
+    raise
 finally:
     collective.finalize()
 """
@@ -160,6 +189,12 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
     Failures raise :class:`WorkerFailedError` carrying each failed
     worker's spawn index, exit code, and captured stderr tail."""
     tracker = None
+    # opt-in driver-side scrape endpoint (XGBOOST_TPU_METRICS_PORT): the
+    # tracker ingests worker snapshot ships into the merged registry, and
+    # /metrics serves per-rank plus merged series while the job runs
+    from .telemetry.distributed import start_metrics_server
+
+    start_metrics_server()
     if rendezvous == "auto":
         rendezvous = "tracker" if (platform or "") == "cpu" else "direct"
     if elastic and rendezvous != "tracker":
@@ -247,6 +282,14 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
                 # collective forever, waiting for the dead worker
                 for p in pending.values():
                     p.kill()
+                # attach each corpse's flight-recorder dump (crash dump or
+                # last periodic spill — the ring of recent spans/events/
+                # faults that makes the postmortem possible without tracing)
+                failures = [
+                    (r, rc,
+                     tail + (f"\n[flight recorder: {fp}]"
+                             if (fp := flight_dump_path(r)) else ""))
+                    for r, rc, tail in failures]
                 labels = [f[0] for f in failures]
                 detail = ", ".join(
                     f"rank {r}: " + ("aborted by tracker fan-out"

@@ -26,13 +26,15 @@ Quick start::
         mgr.rollback()
 """
 from .gate import GateConfig, GateDecision, score_on, validate_candidate
-from .manager import CycleReport, LifecycleConfig, LifecycleManager
+from .manager import (CycleReport, LifecycleConfig, LifecycleManager,
+                      ShadowRejected)
 from .window import FreshWindow
 
 __all__ = [
     "LifecycleManager",
     "LifecycleConfig",
     "CycleReport",
+    "ShadowRejected",
     "GateConfig",
     "GateDecision",
     "validate_candidate",

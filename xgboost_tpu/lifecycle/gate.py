@@ -65,7 +65,8 @@ class GateDecision:
     """One gate verdict (deterministic for fixed inputs)."""
 
     accepted: bool
-    reason: str                      # "accepted" | "metric" | "checksum" | "fault"
+    # "accepted" | "metric" | "checksum" | "shadow" | "fault"
+    reason: str
     metric: str = ""
     candidate_score: float = float("nan")
     incumbent_score: float = float("nan")

@@ -45,10 +45,21 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..reliability import faults as _faults
+from ..telemetry import flight as _flight
 from .gate import GateConfig, GateDecision, validate_candidate
 from .window import FreshWindow
 
-__all__ = ["LifecycleConfig", "LifecycleManager", "CycleReport"]
+__all__ = ["LifecycleConfig", "LifecycleManager", "CycleReport",
+           "ShadowRejected"]
+
+
+class ShadowRejected(RuntimeError):
+    """The shadow phase's KS distribution gate refused the candidate
+    (``LifecycleConfig.shadow_max_ks``); carries the comparator stats."""
+
+    def __init__(self, message: str, stats: Optional[dict] = None) -> None:
+        super().__init__(message)
+        self.stats = dict(stats or {})
 
 _instruments = None
 
@@ -86,6 +97,11 @@ class LifecycleConfig:
     pre-swap shadow phase — mirror that fraction of live traffic onto the
     candidate until that many comparator pairs (or the timeout) before
     activating; 0.0 skips the phase.
+    ``shadow_max_ks``: distribution gate on the shadow phase — reject the
+    candidate (reason ``shadow``) when the worst observed two-sample KS
+    statistic between candidate and incumbent predictions exceeds this
+    (the mean-abs divergence misses rank-reshuffling drift; KS catches
+    it).  None disables the check.
     ``retire_keep``: versions kept resident behind the active one
     (>= 1 so rollback is instant).
     """
@@ -97,6 +113,7 @@ class LifecycleConfig:
     shadow_fraction: float = 0.0
     shadow_min_pairs: int = 1
     shadow_timeout_s: float = 30.0
+    shadow_max_ks: Optional[float] = None
     retire_keep: int = 1
 
     def __post_init__(self) -> None:
@@ -119,6 +136,11 @@ class CycleReport:
     shadow: Optional[dict] = None       # comparator stats, when shadowed
     timings: Dict[str, float] = dataclasses.field(default_factory=dict)
     load_acks: Optional[List[dict]] = None
+    # the cycle's trace id: stamped on every fleet control frame this
+    # cycle broadcast and on its flight-ring events, so a CycleReport can
+    # be joined against the merged trace/flight record of what actually
+    # happened on the replicas
+    trace_id: Optional[str] = None
 
     @property
     def accepted(self) -> bool:
@@ -157,6 +179,8 @@ class LifecycleManager:
         self._previous: Optional[int] = None  # rollback target
         # versions this manager loaded onto replicas (retire bookkeeping)
         self._resident = {self.serving_version()}
+        self._cycles = 0
+        self._cycle_trace: Optional[str] = None
 
     # ------------------------------------------------------------ accessors
     def serving_version(self) -> int:
@@ -179,6 +203,8 @@ class LifecycleManager:
             dt = time.perf_counter() - t0
             timings[name] = dt
             instruments()[0].labels(name).observe(dt)
+            _flight.record("event", f"lifecycle.{name}", seconds=dt,
+                           trace=self._cycle_trace)
 
     def _ckpt_dir(self, incumbent_version: int) -> Optional[str]:
         if self.config.checkpoint_dir is None:
@@ -235,9 +261,10 @@ class LifecycleManager:
 
     # ----------------------------------------------------------------- swap
     def swap(self, version: int, *, timings: Optional[dict] = None,
-             ) -> Optional[dict]:
+             trace: Optional[str] = None) -> Optional[dict]:
         """Hot-swap a PUBLISHED version into the fleet: double-buffered
-        load, optional shadow phase, durable activate, drain-ordered
+        load, optional shadow phase (with the KS distribution gate when
+        ``shadow_max_ks`` is set), durable activate, drain-ordered
         retire of versions beyond ``retire_keep``.  Returns the shadow
         comparator stats (None when the phase was skipped).  The
         ``lifecycle.swap`` seam fires before the durable commit — a kill
@@ -245,6 +272,14 @@ class LifecycleManager:
         incumbent."""
         cfg = self.config
         timings = timings if timings is not None else {}
+        if trace is None:
+            # direct swap() call (not via run_cycle): mint a FRESH id —
+            # falling back to the previous cycle's would join this swap's
+            # control frames to a cycle that already completed
+            self._cycles += 1
+            trace = (f"swap-{self.model}-v{int(version)}-"
+                     f"{os.getpid():x}-{self._cycles}")
+        self._cycle_trace = trace
         # the incumbent is what the FLEET is serving (its dispatcher view,
         # seeded from the committed manifest) — never the store's
         # latest-version fallback, which a publish just moved
@@ -253,23 +288,39 @@ class LifecycleManager:
             incumbent = self.serving_version()
         version = int(version)
         with self._phase("load", timings):
-            acks = self.fleet.load_version(self.model, version)
+            acks = self.fleet.load_version(self.model, version,
+                                           trace=trace)
         self._resident.add(version)
         shadow_stats = None
         if cfg.shadow_fraction > 0.0:
             with self._phase("shadow", timings):
                 shadow_stats = self._shadow_phase(version)
+            max_ks = (shadow_stats or {}).get("max_ks")
+            if (cfg.shadow_max_ks is not None and max_ks is not None
+                    and max_ks > cfg.shadow_max_ks):
+                # distribution gate: the candidate redistributes scores
+                # beyond tolerance — drop it and leave the incumbent
+                # serving (deterministic for a fixed traffic replay)
+                with contextlib.suppress(Exception):
+                    self.fleet.retire_version(self.model, version,
+                                              trace=trace)
+                self._resident.discard(version)
+                raise ShadowRejected(
+                    f"shadow KS gate: max_ks {max_ks:.6g} > allowed "
+                    f"{cfg.shadow_max_ks:.6g} over "
+                    f"{shadow_stats.get('pairs', 0)} pairs", shadow_stats)
         try:
             # kill here = dead BEFORE the durable commit: the manifest
             # still says incumbent, a fleet restart serves incumbent
             _faults.maybe_inject("lifecycle.swap")
             with self._phase("activate", timings):
-                self.fleet.activate_version(self.model, version)
+                self.fleet.activate_version(self.model, version,
+                                            trace=trace)
         except _faults.FaultInjected:
             # deterministic abort: drop the loaded-but-never-activated
             # candidate from the replicas; the incumbent never moved
             with contextlib.suppress(Exception):
-                self.fleet.retire_version(self.model, version)
+                self.fleet.retire_version(self.model, version, trace=trace)
             self._resident.discard(version)
             raise
         self._previous = incumbent
@@ -322,6 +373,15 @@ class LifecycleManager:
         cfg = self.config
         timings: Dict[str, float] = {}
         incumbent_v = self.serving_version()
+        # the cycle trace id: on every control frame this cycle broadcasts,
+        # on its flight events, and on the returned CycleReport — the join
+        # key between "what the manager decided" and "what the fleet did"
+        self._cycles += 1
+        trace_id = (f"cycle-{self.model}-v{incumbent_v}-"
+                    f"{os.getpid():x}-{self._cycles}")
+        self._cycle_trace = trace_id
+        _flight.record("event", "lifecycle.cycle_start", model=self.model,
+                       incumbent=incumbent_v, trace=trace_id)
         # one deserialize per cycle: the same archived incumbent seeds the
         # continuation AND scores the gate's incumbent side
         incumbent = self.store.booster(self.model, incumbent_v)
@@ -339,11 +399,12 @@ class LifecycleManager:
             return CycleReport(
                 self.model, incumbent_v, None, False,
                 GateDecision(False, "fault", detail=str(e)),
-                timings=timings)
+                timings=timings, trace_id=trace_id)
         if not decision.accepted:
             instruments()[3].labels("metric").inc()
             return CycleReport(self.model, incumbent_v, None, False,
-                               decision, timings=timings)
+                               decision, timings=timings,
+                               trace_id=trace_id)
         with self._phase("publish", timings):
             version = self.store.publish(self.model, candidate)
             checksum_ok = self.store.verify_checksum(self.model, version)
@@ -359,9 +420,22 @@ class LifecycleManager:
                              decision.incumbent_score,
                              decision.improvement,
                              detail="arena checksum mismatch after publish"),
-                timings=timings)
+                timings=timings, trace_id=trace_id)
         try:
-            shadow_stats = self.swap(version, timings=timings)
+            shadow_stats = self.swap(version, timings=timings,
+                                     trace=trace_id)
+        except ShadowRejected as e:
+            # distribution half of the shadow phase: the candidate's
+            # score distribution drifted past shadow_max_ks — rejected
+            # with the incumbent untouched, like every other gate half
+            instruments()[3].labels("shadow").inc()
+            return CycleReport(
+                self.model, incumbent_v, version, False,
+                GateDecision(False, "shadow", decision.metric,
+                             decision.candidate_score,
+                             decision.incumbent_score,
+                             decision.improvement, detail=str(e)),
+                shadow=e.stats, timings=timings, trace_id=trace_id)
         except _faults.FaultInjected as e:
             instruments()[3].labels("fault").inc()
             return CycleReport(
@@ -370,9 +444,10 @@ class LifecycleManager:
                              decision.candidate_score,
                              decision.incumbent_score,
                              decision.improvement, detail=str(e)),
-                timings=timings)
+                timings=timings, trace_id=trace_id)
         return CycleReport(self.model, incumbent_v, version, True, decision,
-                           shadow=shadow_stats, timings=timings)
+                           shadow=shadow_stats, timings=timings,
+                           trace_id=trace_id)
 
 
 def _as_dmatrix(window):

@@ -247,6 +247,11 @@ def _count(site: str, kind: str) -> None:
             "xtb_faults_injected_total", "faults fired by the injection "
             "harness", ("site", "kind"))
     _counter.labels(site, kind).inc()
+    from ..telemetry import flight
+
+    # every fired fault lands in the postmortem ring (a killed process's
+    # dump then names the seam that killed it)
+    flight.record("fault", site, fault_kind=kind)
 
 
 def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
@@ -279,6 +284,14 @@ def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
 
         print(f"[faults] kill at {site} (rank={rank} round={round}): "
               f"{spec.message}", file=sys.stderr, flush=True)
+        try:
+            # os._exit skips atexit: flush the flight ring NOW so the
+            # launcher/fleet postmortem has this process's last moments
+            from ..telemetry import flight
+
+            flight.dump()
+        except Exception:
+            pass
         os._exit(spec.exit_code)
     if spec.kind == "exception":
         raise FaultInjected(f"{site}: {spec.message}")

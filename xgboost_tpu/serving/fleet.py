@@ -60,6 +60,7 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import itertools
+import json
 import os
 import sys
 import tempfile
@@ -76,6 +77,9 @@ import numpy as np
 
 from ..launcher import WorkerFailedError, spawn_worker, stderr_tail
 from ..reliability import faults as _faults
+from ..telemetry import distributed as _distributed
+from ..telemetry import flight as _flight
+from ..telemetry import trace as _trace
 from ..telemetry.registry import get_registry
 from . import wire
 from .batcher import QueueFullError
@@ -85,6 +89,25 @@ _COLDSTART_BUCKETS = tuple(0.01 * (2.0 ** i) for i in range(14))
 # prediction divergence spans "bitwise identical continuation" (0) through
 # "differently-shaped model" (O(1)); decades, not latency quartics
 _SHADOW_BUCKETS = tuple(1e-9 * (10.0 ** i) for i in range(10))
+# a two-sample KS statistic lives in [0, 1]: a handful of decision points
+# from "indistinguishable distributions" to "disjoint supports"
+_KS_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.5)
+
+
+def _ks_stat(a: np.ndarray, b: np.ndarray) -> float:
+    """Two-sample Kolmogorov–Smirnov statistic between flattened
+    prediction sets: max |ECDF_a - ECDF_b|.  Complements the mean-abs
+    divergence — a candidate can match the incumbent on average while
+    redistributing scores across the ranking (the failure mode that
+    matters for AUC-shaped objectives), and KS catches exactly that."""
+    a = np.sort(np.asarray(a, np.float64).ravel())
+    b = np.sort(np.asarray(b, np.float64).ravel())
+    if a.size == 0 or b.size == 0:
+        return 0.0
+    grid = np.concatenate([a, b])
+    cdf_a = np.searchsorted(a, grid, side="right") / a.size
+    cdf_b = np.searchsorted(b, grid, side="right") / b.size
+    return float(np.max(np.abs(cdf_a - cdf_b)))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -179,6 +202,11 @@ class _Instruments:
             "xtb_lifecycle_shadow_divergence",
             "mean |candidate - incumbent| prediction divergence per "
             "shadow-scored request", ("model",), buckets=_SHADOW_BUCKETS)
+        self.shadow_ks = reg.histogram(
+            "xtb_lifecycle_shadow_ks",
+            "two-sample KS statistic between candidate and incumbent "
+            "prediction distributions per shadow-scored request",
+            ("model",), buckets=_KS_BUCKETS)
 
     @classmethod
     def get(cls) -> "_Instruments":
@@ -189,7 +217,8 @@ class _Instruments:
 
 class _Request:
     __slots__ = ("id", "model", "header", "payload", "future",
-                 "slo", "deadline", "t_submit", "tries", "state")
+                 "slo", "deadline", "t_submit", "tries", "state",
+                 "t_submit_ns", "t_send_ns")
 
     def __init__(self, rid: int, model: str, header: dict, payload,
                  slo: SLOClass) -> None:
@@ -204,6 +233,11 @@ class _Request:
                          if slo.deadline_s is not None else None)
         self.tries = 0
         self.state = "queued"  # queued | inflight | done | shed | expired
+        # trace bracket anchors (perf_counter_ns: on Linux a system-wide
+        # monotonic epoch, so dispatcher and replica events align in one
+        # merged chrome://tracing timeline)
+        self.t_submit_ns = time.perf_counter_ns()
+        self.t_send_ns = 0
 
 
 class DispatchQueue:
@@ -356,6 +390,12 @@ class ServingFleet:
         self._replicas: Dict[str, _Replica] = {}
         self._failures: List[Tuple[str, int, str]] = []
         self._err_files: Dict[str, str] = {}
+        # observability plane (all under _cv): last shipped registry
+        # snapshot + flight ring per replica label — retained after death
+        # (the merged /metrics view and the postmortem dump read these)
+        self._telemetry: Dict[str, dict] = {}
+        self._flight_rings: Dict[str, list] = {}
+        self.flight_dumps: Dict[str, str] = {}
         self._next_id = itertools.count(1)
         # lifecycle state (all under _cv): the fleet's view of each model's
         # active version (labels unversioned latency) and per-model shadow
@@ -387,6 +427,12 @@ class ServingFleet:
             if self._store_dir is None:
                 self._store_dir = tempfile.mkdtemp(prefix="xtb_fleet_store_")
                 self._tmp_store = True
+        # opt-in scrape endpoint (XGBOOST_TPU_METRICS_PORT): one GET
+        # /metrics returns driver-side xtb_fleet_* plus every replica's
+        # shipped series, per-process-labeled and merged
+        _distributed.start_metrics_server()
+        if _trace.active():
+            _trace.set_process_name("fleet-driver")
         store = ModelStore(self._store_dir)
         for name, source in self._models.items():
             store.publish(name, source)
@@ -557,6 +603,12 @@ class ServingFleet:
                 self._on_replica_death(label, e)
                 return
             op = header.get("op")
+            if op == wire.TELEMETRY:
+                # unsolicited shipment from the replica's serve loop: it
+                # does NOT complete the in-flight request — ingest and go
+                # straight back to the socket
+                self._ingest_telemetry(label, payload)
+                continue
             # one critical section per completion: free the replica AND
             # claim its next request.  The hot path never notifies the cv —
             # per-request notify_all wakes the housekeeping thread (which
@@ -598,10 +650,35 @@ class ServingFleet:
                 etype = _ERR_TYPES.get(header.get("etype", ""), RuntimeError)
                 self._fail(req, etype(header.get("error", "replica error")))
 
+    def _ingest_telemetry(self, label: str, payload) -> None:
+        """One replica telemetry frame: retain the latest snapshot +
+        flight ring under the replica's label and feed the merged view."""
+        try:
+            data = json.loads(bytes(payload))
+        except (ValueError, TypeError):
+            return  # a malformed shipment is dropped, never fatal
+        snap = data.get("snapshot")
+        ring = data.get("flight") or []
+        with self._cv:
+            if snap:
+                self._telemetry[label] = snap
+            self._flight_rings[label] = ring
+        if snap:
+            _distributed.get_merged().ingest(label, snap)
+
     def _finish(self, req: _Request, arr: np.ndarray) -> None:
         req.state = "done"
         if req.future.set_running_or_notify_cancel():
             req.future.set_result(arr)
+            if _trace.active() and req.header.get("trace"):
+                # dispatcher-side bracket of the whole request: with the
+                # replica's own replica.execute event (same trace id) the
+                # merged timeline shows dispatch -> queue -> execute ->
+                # reply per request
+                now = time.perf_counter_ns()
+                _trace.emit("fleet.request", req.t_submit_ns,
+                            now - req.t_submit_ns,
+                            trace=req.header["trace"], model=req.model)
             # only delivered results count: an abandoned (caller-timed-out,
             # cancelled) request's latency would skew the histogram
             lat = time.monotonic() - req.t_submit
@@ -678,6 +755,14 @@ class ServingFleet:
             pass
         rc = rep.proc.poll()
         tail = stderr_tail(self._err_files.get(label, ""))
+        if not closed:
+            # a real death gets a postmortem; a clean shutdown's EOFs are
+            # us closing the sockets, not replicas dying
+            dump_path = self._dump_replica_flight(label, rc)
+            if dump_path:
+                tail += f"\n[flight recorder: {dump_path}]"
+            _flight.record("event", "fleet.replica_death", replica=label,
+                           exit=rc if rc is not None else -1)
         with self._cv:
             self._failures.append((label, rc if rc is not None else -1,
                                    tail))
@@ -710,6 +795,30 @@ class ServingFleet:
                 failures)
             for r in dead:
                 self._fail(r, err)
+
+    def _dump_replica_flight(self, label: str, rc) -> Optional[str]:
+        """Postmortem for a dead replica, written DRIVER-side from the
+        last telemetry shipment: the replica's recent flight ring plus
+        its final registry snapshot — present even for SIGKILL, which
+        leaves the corpse no chance to dump anything itself.  The path
+        lands in :attr:`flight_dumps` and on the failure record."""
+        with self._cv:
+            ring = list(self._flight_rings.get(label, ()))
+            snap = self._telemetry.get(label)
+        path = os.path.join(_flight.dump_dir(),
+                            f"flight_fleet_{label}_{os.getpid()}.json")
+        try:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"label": label, "exit": rc, "events": ring,
+                           "snapshot": snap, "dumped_by": "dispatcher"},
+                          fh)
+            os.replace(tmp, path)
+        except OSError:  # pragma: no cover - fs trouble must not block
+            return None  # the death path
+        with self._cv:
+            self.flight_dumps[label] = path
+        return path
 
     def _alive_or_pending(self) -> bool:
         with self._cv:
@@ -788,8 +897,16 @@ class ServingFleet:
             return
         try:
             wire.send_frame(rep.sock, req.header, req.payload)
+            req.t_send_ns = time.perf_counter_ns()
             if req.header.get("op") == "predict":
                 self._ins.requests.labels(req.model).inc()
+                if _trace.active() and req.header.get("trace"):
+                    # queue-time bracket: submit -> on-the-wire (re-emitted
+                    # per try when a reroute requeues the request)
+                    _trace.emit("fleet.queue", req.t_submit_ns,
+                                req.t_send_ns - req.t_submit_ns,
+                                trace=req.header["trace"], model=req.model,
+                                replica=rep.label)
         except OSError as e:
             self._on_replica_death(rep.label, e)
 
@@ -814,8 +931,12 @@ class ServingFleet:
         # stay tiny and notify-free)
         rid = next(self._next_id)  # itertools.count is atomic
         header = dict(fields)
+        # the request's trace id, born here and carried on the wire: the
+        # replica tags its replica.execute event with it, so one merged
+        # trace shows the whole dispatch->queue->execute->reply path
         header.update({"op": "predict", "id": rid, "model": model,
-                       "margin": bool(output_margin)})
+                       "margin": bool(output_margin),
+                       "trace": f"{os.getpid():x}-{rid:x}"})
         if version is not None:
             header["version"] = int(version)
         req = _Request(rid, model, header, payload, slo)
@@ -838,6 +959,7 @@ class ServingFleet:
                     shadow_header = dict(header)
                     shadow_header["id"] = next(self._next_id)
                     shadow_header["version"] = sh["version"]
+                    shadow_header["trace"] = header["trace"] + "-shadow"
                     # same payload buffer: the twin rides zero-copy too
                     shadow_req = _Request(shadow_header["id"], model,
                                           shadow_header, payload,
@@ -905,6 +1027,15 @@ class ServingFleet:
         respawn reads the committed store state at startup and converges —
         but an error *reply* (bad version, refused retire) raises."""
         pending: List[Tuple[str, _Request]] = []
+        fields = dict(fields)
+        # one trace id per broadcast (lifecycle CycleReports reference it;
+        # replicas log it with the applied control op)
+        fields.setdefault(
+            "trace", f"ctrl-{os.getpid():x}-{next(self._next_id):x}")
+        _flight.record("event", f"fleet.{fields.get('op')}",
+                       model=str(fields.get("model")),
+                       version=fields.get("version"),
+                       trace=fields["trace"])
         with self._cv:
             if not self._started or self._closed:
                 raise RuntimeError("ServingFleet is not running")
@@ -934,17 +1065,21 @@ class ServingFleet:
         return acks
 
     def load_version(self, model: str, version: int,
-                     timeout: float = 300.0) -> List[dict]:
+                     timeout: float = 300.0,
+                     trace: Optional[str] = None) -> List[dict]:
         """Double-buffer a published store version onto every replica:
         registry entry, arch-keyed AOT warm attach, fast path, NaN warm
         pass — all while the incumbent keeps serving.  Returns per-replica
         acks carrying aot_hits/aot_compiled (a same-architecture
         continuation shows hits, not compiles)."""
-        return self._control_all({"op": "load", "model": model,
-                                  "version": int(version)}, timeout)
+        fields = {"op": "load", "model": model, "version": int(version)}
+        if trace:
+            fields["trace"] = trace
+        return self._control_all(fields, timeout)
 
     def activate_version(self, model: str, version: int,
-                         timeout: float = 300.0) -> List[dict]:
+                         timeout: float = 300.0,
+                         trace: Optional[str] = None) -> List[dict]:
         """Repoint ``model``'s unversioned traffic at ``version``.
 
         Durably commits the store manifest FIRST (``set_active``), then
@@ -962,17 +1097,22 @@ class ServingFleet:
             # builds its ready-time resync frames from _versions, and a
             # stale entry here would regress it to the old version
             self._versions[model] = int(version)
-        return self._control_all({"op": "activate", "model": model,
-                                  "version": int(version)}, timeout)
+        fields = {"op": "activate", "model": model, "version": int(version)}
+        if trace:
+            fields["trace"] = trace
+        return self._control_all(fields, timeout)
 
     def retire_version(self, model: str, version: int,
-                       timeout: float = 300.0) -> List[dict]:
+                       timeout: float = 300.0,
+                       trace: Optional[str] = None) -> List[dict]:
         """Drop a non-active version from every replica.  The retire frame
         rides each replica's serialized connection, so it executes only
         after every predict dispatched before it has drained; replicas
         refuse to retire the active version."""
-        return self._control_all({"op": "retire", "model": model,
-                                  "version": int(version)}, timeout)
+        fields = {"op": "retire", "model": model, "version": int(version)}
+        if trace:
+            fields["trace"] = trace
+        return self._control_all(fields, timeout)
 
     def active_version(self, model: str) -> Optional[int]:
         with self._cv:
@@ -993,29 +1133,34 @@ class ServingFleet:
             self._shadow[model] = {
                 "version": int(version), "every": every, "n": 0,
                 "pairs": 0, "failures": 0, "sum_div": 0.0, "max_div": 0.0,
+                "sum_ks": 0.0, "max_ks": 0.0,
             }
+
+    @staticmethod
+    def _shadow_summary(sh: dict) -> dict:
+        pairs = sh["pairs"]
+        return {"pairs": pairs, "failures": sh["failures"],
+                "mean_div": (sh["sum_div"] / pairs) if pairs else 0.0,
+                "max_div": sh["max_div"],
+                "mean_ks": (sh["sum_ks"] / pairs) if pairs else 0.0,
+                "max_ks": sh["max_ks"]}
 
     def clear_shadow(self, model: str) -> Optional[dict]:
         """Stop mirroring; returns the accumulated comparator stats
-        (pairs, failures, mean_div, max_div) or None if never set."""
+        (pairs, failures, mean/max divergence and KS) or None if never
+        set."""
         with self._cv:
             sh = self._shadow.pop(model, None)
         if sh is None:
             return None
-        pairs = sh["pairs"]
-        return {"pairs": pairs, "failures": sh["failures"],
-                "mean_div": (sh["sum_div"] / pairs) if pairs else 0.0,
-                "max_div": sh["max_div"]}
+        return self._shadow_summary(sh)
 
     def shadow_stats(self, model: str) -> Optional[dict]:
         with self._cv:
             sh = self._shadow.get(model)
             if sh is None:
                 return None
-            pairs = sh["pairs"]
-            return {"pairs": pairs, "failures": sh["failures"],
-                    "mean_div": (sh["sum_div"] / pairs) if pairs else 0.0,
-                    "max_div": sh["max_div"]}
+            return self._shadow_summary(sh)
 
     def _attach_shadow(self, model: str, primary: _Request,
                        shadow: _Request) -> None:
@@ -1040,8 +1185,11 @@ class ServingFleet:
         try:
             a = np.asarray(primary.future.result(timeout=0), np.float64)
             b = np.asarray(shadow.future.result(timeout=0), np.float64)
-            div = (float(np.mean(np.abs(a - b))) if a.shape == b.shape
-                   else float("inf"))
+            if a.shape == b.shape:
+                div = float(np.mean(np.abs(a - b)))
+                ks = _ks_stat(a, b)
+            else:
+                div = ks = float("inf")
         except BaseException:
             self._ins.shadow_failures.labels(model).inc()
             with self._cv:
@@ -1051,12 +1199,15 @@ class ServingFleet:
             return
         self._ins.shadow_requests.labels(model).inc()
         self._ins.shadow_divergence.labels(model).observe(div)
+        self._ins.shadow_ks.labels(model).observe(min(ks, 1.0))
         with self._cv:
             sh_live = self._shadow.get(model)
             if sh_live is not None:
                 sh_live["pairs"] += 1
                 sh_live["sum_div"] += div
                 sh_live["max_div"] = max(sh_live["max_div"], div)
+                sh_live["sum_ks"] += ks
+                sh_live["max_ks"] = max(sh_live["max_ks"], ks)
 
     # ---------------------------------------------------------------- admin
     def replica_info(self) -> List[dict]:

@@ -47,6 +47,7 @@ never what is live.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import socket
 import sys
@@ -155,10 +156,44 @@ def _apply_control(engine, store, warm, fast, buckets, header) -> dict:
             "seconds": time.perf_counter() - t0}
 
 
-def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
-                buckets=()) -> None:
-    from . import wire
+def ship_telemetry(sock, label: str) -> bool:
+    """One ``op="telemetry"`` frame on the dispatcher connection: the full
+    registry snapshot + flight-recorder ring (JSON payload, header-only
+    routing like every fleet frame).  Best-effort — shipping must never
+    take the serve loop down."""
+    from ..telemetry import distributed
 
+    try:
+        payload = json.dumps(distributed.snapshot_payload()).encode()
+        from . import wire
+
+        wire.send_frame(sock, {"op": wire.TELEMETRY, "label": label},
+                        payload)
+        return True
+    except (OSError, TypeError, ValueError):
+        return False
+
+
+def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
+                buckets=(), label: str = "replica") -> None:
+    from . import wire
+    from ..telemetry import distributed, flight, trace
+    from ..telemetry.registry import get_registry
+
+    # fast-path requests bypass the engine (and its ServingMetrics), so
+    # the serve loop feeds the per-model counters itself — same families
+    # the engine registered, so get-or-create just hands them back
+    reg = get_registry()
+    req_counter = reg.counter("xtb_serve_requests_total",
+                              "predict requests", ("model",))
+    rows_counter = reg.counter("xtb_serve_rows_total", "rows predicted",
+                               ("model",))
+    # telemetry shipping piggybacks on traffic (no background sender: the
+    # socket is single-writer by design).  An idle replica ships nothing —
+    # and needs to: with no requests handled, its counters haven't moved,
+    # so the dispatcher's retained snapshot is still exact.
+    interval = distributed.ship_interval()
+    last_ship = time.monotonic()
     stream = wire.reader(sock)  # one GIL event per frame, not three
     while True:
         try:
@@ -174,34 +209,58 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
                 ack = _apply_control(engine, store, warm, fast, buckets,
                                      header)
                 ack.update({"op": "ctrl_ok", "id": rid})
+                flight.record("event", f"replica.{op}",
+                              model=header.get("model"),
+                              version=header.get("version"),
+                              trace=header.get("trace"))
                 wire.send_frame(sock, ack)
             except Exception as e:  # report, keep serving
+                flight.record("fault", f"replica.{op}", error=str(e))
                 wire.send_frame(sock, {"op": "error", "id": rid,
                                        "etype": type(e).__name__,
                                        "error": str(e)})
-            continue
-        if op != "predict":
+        elif op != "predict":
             wire.send_frame(sock, {"op": "error", "id": rid,
                                    "etype": "ValueError",
                                    "error": f"unknown op {op!r}"})
-            continue
-        try:
-            X = wire.decode_matrix(header, payload)
-            margin = bool(header.get("margin", False))
-            fp = fast.get((header["model"], header.get("version")))
-            out = fp.run(X, margin) if fp is not None else None
-            if out is None:
-                out = engine.predict(header["model"], X, direct=True,
-                                     version=header.get("version"),
-                                     output_margin=margin)
-            out = np.ascontiguousarray(out, np.float32)
-            wire.send_frame(sock, {"op": "result", "id": rid,
-                                   "shape": list(out.shape)},
-                            memoryview(out).cast("B"))
-        except Exception as e:  # per-request failure: report, keep serving
-            wire.send_frame(sock, {"op": "error", "id": rid,
-                                   "etype": type(e).__name__,
-                                   "error": str(e)})
+        else:
+            t0 = time.perf_counter_ns()
+            try:
+                X = wire.decode_matrix(header, payload)
+                margin = bool(header.get("margin", False))
+                fp = fast.get((header["model"], header.get("version")))
+                out = fp.run(X, margin) if fp is not None else None
+                if out is not None:
+                    req_counter.labels(header["model"]).inc()
+                    rows_counter.labels(header["model"]).inc(
+                        float(X.shape[0]))
+                else:
+                    out = engine.predict(header["model"], X, direct=True,
+                                         version=header.get("version"),
+                                         output_margin=margin)
+                out = np.ascontiguousarray(out, np.float32)
+                wire.send_frame(sock, {"op": "result", "id": rid,
+                                       "shape": list(out.shape)},
+                                memoryview(out).cast("B"))
+                if trace.active() and header.get("trace"):
+                    # same trace id the dispatcher stamped at submit: the
+                    # merged capture pairs this bracket with fleet.queue/
+                    # fleet.request from the driver process
+                    trace.emit("replica.execute", t0,
+                               time.perf_counter_ns() - t0,
+                               trace=header["trace"],
+                               model=header.get("model"),
+                               rows=int(out.shape[0]))
+            except Exception as e:  # per-request failure: report, serve on
+                flight.record("fault", "replica.predict",
+                              model=header.get("model"), error=str(e))
+                wire.send_frame(sock, {"op": "error", "id": rid,
+                                       "etype": type(e).__name__,
+                                       "error": str(e)})
+        now = time.monotonic()
+        if now - last_ship >= interval:
+            last_ship = now
+            ship_telemetry(sock, label)
 
 
 def main(argv=None) -> int:
@@ -217,6 +276,14 @@ def main(argv=None) -> int:
                     help="comma-separated warm row buckets ('' = engine "
                          "default ladder)")
     args = ap.parse_args(argv)
+
+    from ..telemetry import flight, trace
+
+    flight.install(args.label)
+    flight.record("event", "replica.start", label=args.label,
+                  pid=os.getpid())
+    if trace.active():
+        trace.set_process_name(f"replica:{args.label}")
 
     import jax
 
@@ -307,10 +374,22 @@ def main(argv=None) -> int:
         "backend": jax.default_backend(),
     })
 
+    ship_telemetry(sock, args.label)  # baseline snapshot before traffic
     try:
         _serve_loop(sock, engine, fast, store=store, warm=warm,
-                    buckets=buckets)
+                    buckets=buckets, label=args.label)
+    except BaseException as e:
+        # wounded replicas die loudly — but first leave a postmortem: a
+        # local flight dump; the finally-ship below carries the ring
+        # (with this crash fault) to the driver too
+        flight.record("fault", "replica.crash", error=repr(e))
+        try:
+            flight.dump()
+        except OSError:
+            pass
+        raise
     finally:
+        ship_telemetry(sock, args.label)  # final counters survive us
         engine.close()
         try:
             sock.close()

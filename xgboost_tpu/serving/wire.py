@@ -36,6 +36,16 @@ _PREFIX = struct.Struct("<IQ")
 RAW = "raw"      # C-order float32 bytes; header carries "shape"
 ARROW = "arrow"  # Arrow IPC stream holding one RecordBatch
 
+# replica -> dispatcher telemetry shipment (serving/replica.py
+# ship_telemetry): header {"op": TELEMETRY, "label": ...}, payload = JSON
+# bytes of telemetry.distributed.snapshot_payload().  Rides the same
+# serialized connection as predicts; the dispatcher ingests it without
+# touching the in-flight request.  Predict headers additionally carry a
+# "trace" id the replica echoes into its span events, which is what lets
+# one merged chrome://tracing file pair dispatcher and replica brackets
+# per request (docs/observability.md).
+TELEMETRY = "telemetry"
+
 
 class WireError(RuntimeError):
     """Framing violation on a fleet socket (peer is gone or confused)."""

@@ -18,7 +18,15 @@ serving/metrics counters with no export format):
   chrome://tracing JSONL writer gated by ``XGBOOST_TPU_TRACE=path``
   (trace.py).
 - **TelemetryCallback** (callback.py): per-round phase timings, tree
-  stats, and compile deltas as an inspectable history.
+  stats, compile deltas, collective-wait attribution, and the optional
+  cross-rank straggler report as an inspectable history.
+- **Distributed plane** (distributed.py): workers/replicas ship registry
+  snapshots over their existing channels into a driver-side
+  ``MergedRegistry`` (per-``proc``-labeled + merged series) behind an
+  HTTP ``/metrics`` scrape endpoint (``XGBOOST_TPU_METRICS_PORT``).
+- **Flight recorder** (flight.py): always-armed fixed-size ring of recent
+  spans/events/faults, dumped on crash/kill (and driver-side for
+  SIGKILL'd replicas) — postmortems without tracing enabled.
 
 Quick start::
 
@@ -40,7 +48,9 @@ from .registry import (Counter, Gauge, Histogram, Registry, get_registry,
 from .spans import (PHASE_HISTOGRAM, Span, disable, enable, enabled,
                     phase_totals, record_phase, span)
 from .compile import COMPILE_EVENT, compile_delta, compiles_total
-from . import native_pool, trace
+from . import distributed, flight, native_pool, trace
+from .distributed import (MergedRegistry, get_merged, snapshot_payload,
+                          start_metrics_server, stop_metrics_server)
 from .callback import TelemetryCallback
 
 __all__ = [
@@ -49,6 +59,8 @@ __all__ = [
     "span", "Span", "enable", "disable", "enabled", "record_phase",
     "phase_totals", "PHASE_HISTOGRAM",
     "compiles_total", "compile_delta", "COMPILE_EVENT",
-    "trace", "native_pool",
+    "trace", "native_pool", "distributed", "flight",
+    "MergedRegistry", "get_merged", "snapshot_payload",
+    "start_metrics_server", "stop_metrics_server",
     "TelemetryCallback",
 ]

@@ -42,14 +42,25 @@ class TelemetryCallback(TrainingCallback):
         ``telemetry.enable()``.  The flag is left as-is on after_training
         (process-wide state; flipping it back could disable a concurrent
         consumer's spans).
+    straggler : bool
+        Distributed only: allgather every rank's round wall + collective
+        wait at each round boundary and record a straggler report
+        (``history[i]["straggler"]``: per-rank walls, max/min rank,
+        spread).  This ADDS one collective per round, so it must be
+        enabled on EVERY rank or the job wedges — and it is not for
+        elastic runs (the extra gather shifts the relay seq numbering a
+        regroup replays).  Default off.
     """
 
-    def __init__(self, enable_spans: bool = True) -> None:
+    def __init__(self, enable_spans: bool = True,
+                 straggler: bool = False) -> None:
         self.enable_spans = enable_spans
+        self.straggler = straggler
         self.history: List[Dict[str, Any]] = []
         self.compiles_warmup = 0
         self.compiles_steady = 0
         self._phase0: Dict[str, Dict[str, float]] = {}
+        self._coll0: Dict[Any, Any] = {}
         self._compiles0 = 0
         self._t0 = 0.0
         self._ntrees0 = 0
@@ -72,6 +83,7 @@ class TelemetryCallback(TrainingCallback):
 
     def before_iteration(self, model, epoch: int, evals_log) -> bool:
         self._phase0 = spans.phase_totals()
+        self._coll0 = self._coll_sums()
         self._compiles0 = _compile.compiles_total()
         self._t0 = time.perf_counter()
         return False
@@ -95,6 +107,10 @@ class TelemetryCallback(TrainingCallback):
             "compiles": int(compiles),
             "trees": trees,
         }
+        coll = self._coll_delta(self._coll0)
+        if coll["count"]:
+            rec["coll_wait"] = coll
+        self._round_boundary(rec, seconds, coll)
         if self._warm_round is None:
             self._warm_round = epoch
         if compiles:
@@ -112,6 +128,56 @@ class TelemetryCallback(TrainingCallback):
         return False
 
     # ------------------------------------------------------------ internals
+    @staticmethod
+    def _coll_sums() -> Dict[Any, Any]:
+        """Current (op, rank) -> (count, seconds) of the collective-wait
+        histogram (empty for single-process runs that never registered
+        it)."""
+        from .registry import get_registry
+
+        hist = get_registry().get("xtb_coll_wait_seconds")
+        return hist.snapshot_sums() if hist is not None else {}
+
+    def _coll_delta(self, base: Dict[Any, Any]) -> Dict[str, float]:
+        total_s, total_n = 0.0, 0
+        for key, (n, s) in self._coll_sums().items():
+            n0, s0 = base.get(key, (0, 0.0))
+            total_s += s - s0
+            total_n += n - n0
+        return {"seconds": total_s, "count": int(total_n)}
+
+    def _round_boundary(self, rec: Dict[str, Any], seconds: float,
+                        coll: Dict[str, float]) -> None:
+        """Distributed observability at the round boundary: flight-ring
+        breadcrumb, rate-limited snapshot ship to the tracker, and the
+        optional cross-rank straggler report (one extra allgather)."""
+        from . import distributed, flight
+
+        flight.record("event", "train.round", round=rec["round"],
+                      seconds=seconds)
+        try:
+            distributed.ship_to_tracker()
+        except Exception:  # pragma: no cover - shipping is best-effort
+            pass
+        if not self.straggler:
+            return
+        from .. import collective
+
+        if not collective.is_distributed():
+            return
+        import numpy as np
+
+        walls = collective.allgather(
+            np.asarray([seconds, coll["seconds"]], np.float64))
+        round_walls = [float(w) for w in walls[:, 0]]
+        rec["straggler"] = {
+            "walls": round_walls,
+            "coll_wait": [float(w) for w in walls[:, 1]],
+            "max_rank": int(np.argmax(walls[:, 0])),
+            "min_rank": int(np.argmin(walls[:, 0])),
+            "spread_s": float(max(round_walls) - min(round_walls)),
+        }
+
     def _tree_stats(self, model) -> List[Dict[str, int]]:
         """Stats of the trees committed since the last look.  cv() hands the
         callbacks an aggregate stand-in without .trees — record nothing."""

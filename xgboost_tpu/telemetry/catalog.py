@@ -1,0 +1,68 @@
+"""Metric-catalog help text, sourced from docs/observability.md.
+
+The docs catalog table is the operator-facing contract for every
+``xtb_*`` family (xtblint XTB4xx keeps it in sync with the code).  Some
+families are registered with an empty ``help`` string — e.g. lazily
+created counters where the call site keeps the line short — and their
+``# HELP`` exposition line would silently vanish.  This module parses the
+catalog table once and hands ``render_prometheus()`` the documented
+meaning as the fallback help text, so the scrape output and the docs
+describe every series with the same words.
+
+Best-effort by design: when the docs tree is not present next to the
+package (a bare install), ``help_for`` returns ``""`` and exposition
+simply omits the HELP line, exactly as before.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional
+
+__all__ = ["help_for", "catalog", "catalog_path"]
+
+_NAME_RE = re.compile(r"^xtb_[a-z0-9_]+$")
+_cache: Optional[Dict[str, str]] = None
+
+
+def catalog_path() -> str:
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "docs", "observability.md")
+
+
+def _parse(text: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("| `xtb_"):
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        if len(cells) < 2:
+            continue
+        name = cells[0].strip("`").strip()
+        if not _NAME_RE.match(name):
+            continue
+        # the MEANING column is last by convention; strip markdown
+        # emphasis but keep backticked cross-references readable
+        meaning = cells[-1].replace("**", "").strip()
+        if meaning:
+            out.setdefault(name, meaning)
+    return out
+
+
+def catalog() -> Dict[str, str]:
+    """{metric family name: documented meaning} from the docs catalog
+    table (empty when the docs are not shipped alongside the package)."""
+    global _cache
+    if _cache is None:
+        try:
+            with open(catalog_path(), "r", encoding="utf-8") as fh:
+                _cache = _parse(fh.read())
+        except OSError:
+            _cache = {}
+    return _cache
+
+
+def help_for(name: str) -> str:
+    return catalog().get(name, "")

@@ -1,0 +1,424 @@
+"""Distributed observability plane: one merged view of a multi-process job.
+
+PR 2's registry, spans, and Prometheus exposition are strictly
+per-process, but the system is not: elastic training workers
+(launcher.py + tracker relay), fleet replica processes (serving/fleet.py)
+and lifecycle swaps all run their own interpreters, so a replica's
+``xtb_serve_*`` series and a training rank's ``xtb_elastic_*`` counters
+were invisible from the driver and vanished with the process.  This
+module is the driver-side half of the fix:
+
+- **Shipping** (the senders live on each process's EXISTING channel —
+  no new sockets): fleet replicas append ``op="telemetry"`` wire frames
+  on their dispatcher connection (serving/replica.py, periodically and
+  at exit); tracker-mode training workers send ``cmd="telemetry"``
+  messages on the persistent tracker channel
+  (:meth:`~xgboost_tpu.tracker.TrackerClient.ship_telemetry`, driven by
+  ``TelemetryCallback`` per round and by ``collective.finalize`` at
+  exit).  Each payload is :func:`snapshot_payload`: the full registry
+  snapshot plus the flight-recorder ring (flight.py).
+- **MergedRegistry**: the driver ingests each process's latest snapshot
+  under a source label (``replica0``, ``rank2``, ...).  Rendering emits
+  BOTH views of every family: per-process samples relabeled with
+  ``proc="<source>"``, and merged samples (no ``proc`` label) where
+  counters and histogram buckets sum across processes and gauges sum too
+  (documented in docs/observability.md's catalog scope column).  Dead
+  processes keep their last snapshot — a SIGKILL'd replica's final
+  numbers stay scrapeable.
+- **Scrape endpoint**: a stdlib ``http.server`` ``/metrics`` endpoint
+  (:func:`start_metrics_server`), opt-in via ``XGBOOST_TPU_METRICS_PORT``
+  — started automatically by ``ServingFleet.start`` and
+  ``launcher.run_distributed`` when the variable is set, or explicitly
+  (``port=0`` picks an ephemeral port; read it back from ``server.port``).
+
+The driver process's own registry is included as source ``driver`` so a
+single scrape covers dispatcher-side series (``xtb_fleet_*``) alongside
+the shipped ones.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import flight
+from .registry import _escape_help, _escape_label, _fmt, get_registry
+
+__all__ = [
+    "snapshot_payload", "MergedRegistry", "get_merged",
+    "MetricsServer", "start_metrics_server", "stop_metrics_server",
+    "ship_to_tracker", "ship_interval", "ENV_PORT", "ENV_INTERVAL",
+]
+
+ENV_PORT = "XGBOOST_TPU_METRICS_PORT"
+ENV_HOST = "XGBOOST_TPU_METRICS_HOST"
+ENV_INTERVAL = "XGBOOST_TPU_TELEMETRY_INTERVAL"
+
+PROC_LABEL = "proc"  # the relabel key per-process samples carry
+
+
+def ship_interval() -> float:
+    """Seconds between periodic snapshot ships (replicas + workers)."""
+    try:
+        return max(0.05, float(os.environ.get(ENV_INTERVAL, "2.0")))
+    except ValueError:
+        return 2.0
+
+
+def _local_snapshot() -> dict:
+    try:
+        from . import native_pool
+
+        native_pool.sync()  # fold fresh C-side pool counters first
+    except Exception:
+        pass
+    return get_registry().snapshot()
+
+
+def snapshot_payload() -> dict:
+    """What one process ships: its full registry snapshot plus the
+    flight-recorder ring (the driver dumps the ring when the process
+    dies — the SIGKILL postmortem path)."""
+    return {"snapshot": _local_snapshot(), "flight": flight.events(),
+            "pid": os.getpid()}
+
+
+# ---------------------------------------------------------------------------
+# Merged view
+# ---------------------------------------------------------------------------
+
+
+def _label_str(pairs: Sequence[Tuple[str, str]]) -> str:
+    if not pairs:
+        return ""
+    return ("{" + ",".join(f'{n}="{_escape_label(v)}"' for n, v in pairs)
+            + "}")
+
+
+class MergedRegistry:
+    """Driver-side union of per-process registry snapshots.
+
+    ``ingest(source, snapshot)`` replaces that source's view (sources are
+    retained until :meth:`clear` — death keeps the last snapshot).
+    ``render_prometheus()`` emits one text exposition with per-process
+    (``proc=``-labeled) and merged (unlabeled) samples per family;
+    kind/label conflicts across sources keep the first-seen signature and
+    skip the conflicting source's contribution for that family."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._sources: "OrderedDict[str, dict]" = OrderedDict()
+
+    # ------------------------------------------------------------- ingest
+    def ingest(self, source: str, snapshot: dict) -> None:
+        if not isinstance(snapshot, dict):
+            return
+        with self._lock:
+            self._sources[str(source)] = snapshot
+
+    def forget(self, source: str) -> None:
+        with self._lock:
+            self._sources.pop(str(source), None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._sources.clear()
+
+    def sources(self) -> List[str]:
+        with self._lock:
+            return list(self._sources)
+
+    def _snapshot_items(self, include_local: bool,
+                        local_source: str) -> List[Tuple[str, dict]]:
+        items: List[Tuple[str, dict]] = []
+        if include_local:
+            items.append((local_source, _local_snapshot()))
+        with self._lock:
+            items.extend((s, snap) for s, snap in self._sources.items()
+                         if s != local_source or not include_local)
+        return items
+
+    # ------------------------------------------------------------- totals
+    def merged_totals(self, name: str, include_local: bool = True,
+                      local_source: str = "driver",
+                      ) -> Dict[Tuple[str, ...], float]:
+        """{label values: summed value} for a scalar family across every
+        source (histograms: summed ``sum``) — the programmatic read side
+        tests and smokes assert against."""
+        out: Dict[Tuple[str, ...], float] = {}
+        for _source, snap in self._snapshot_items(include_local,
+                                                  local_source):
+            for fam in snap.get("families", ()):
+                if fam.get("name") != name:
+                    continue
+                for child in fam.get("children", ()):
+                    values = tuple(str(v) for v in child[0])
+                    v = (float(child[2]) if fam.get("kind") == "histogram"
+                         else float(child[1]))
+                    out[values] = out.get(values, 0.0) + v
+        return out
+
+    # ------------------------------------------------------------- render
+    def render_prometheus(self, include_local: bool = True,
+                          local_source: str = "driver") -> str:
+        from .catalog import help_for
+
+        fams: "OrderedDict[str, dict]" = OrderedDict()
+        for source, snap in self._snapshot_items(include_local,
+                                                 local_source):
+            for f in snap.get("families", ()):
+                name = f.get("name")
+                if not name:
+                    continue
+                labels = tuple(f.get("labels", ()))
+                entry = fams.get(name)
+                if entry is None:
+                    entry = fams[name] = {
+                        "kind": f.get("kind", "untyped"),
+                        "labels": labels,
+                        "buckets": tuple(f.get("buckets", ())),
+                        "help": f.get("help", ""),
+                        "rows": [],
+                    }
+                elif (entry["kind"] != f.get("kind")
+                      or entry["labels"] != labels):
+                    continue  # conflicting signature: first source wins
+                if not entry["help"] and f.get("help"):
+                    entry["help"] = f["help"]
+                entry["rows"].append((source, f))
+
+        lines: List[str] = []
+        for name, e in fams.items():
+            help_text = e["help"] or help_for(name)
+            if help_text:
+                lines.append(f"# HELP {name} {_escape_help(help_text)}")
+            lines.append(f"# TYPE {name} {e['kind']}")
+            if e["kind"] == "histogram":
+                self._render_hist(lines, name, e)
+            else:
+                self._render_scalar(lines, name, e)
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _render_scalar(lines: List[str], name: str, e: dict) -> None:
+        merged: "OrderedDict[Tuple[str, ...], float]" = OrderedDict()
+        for source, f in e["rows"]:
+            for child in sorted(f.get("children", ())):
+                values = tuple(str(v) for v in child[0])
+                val = float(child[1])
+                pairs = [(PROC_LABEL, source)] + list(zip(e["labels"],
+                                                          values))
+                lines.append(f"{name}{_label_str(pairs)} {_fmt(val)}")
+                merged[values] = merged.get(values, 0.0) + val
+        for values, val in merged.items():
+            pairs = list(zip(e["labels"], values))
+            lines.append(f"{name}{_label_str(pairs)} {_fmt(val)}")
+
+    @staticmethod
+    def _render_hist(lines: List[str], name: str, e: dict) -> None:
+        bounds = e["buckets"]
+        # merged accumulation only over sources whose bounds match the
+        # first-seen family (mismatched bounds still render per-process)
+        merged: "OrderedDict[Tuple[str, ...], list]" = OrderedDict()
+        for source, f in e["rows"]:
+            f_bounds = tuple(f.get("buckets", ()))
+            mergeable = f_bounds == bounds
+            for child in sorted(f.get("children", ())):
+                values = tuple(str(v) for v in child[0])
+                counts = [int(c) for c in child[1]]
+                # counts is authoritative: every _count line (per-source
+                # and merged) renders from the cumulative bucket total,
+                # so the shipped count field (child[3]) is not re-used
+                s = float(child[2])
+                if len(counts) != len(f_bounds) + 1:
+                    continue  # malformed shipment
+                base = [(PROC_LABEL, source)] + list(zip(e["labels"],
+                                                         values))
+                cum = 0
+                for b, c in zip(f_bounds, counts):
+                    cum += c
+                    pairs = base + [("le", _fmt(b))]
+                    lines.append(f"{name}_bucket{_label_str(pairs)} {cum}")
+                cum += counts[-1]
+                lines.append(
+                    f"{name}_bucket{_label_str(base + [('le', '+Inf')])} "
+                    f"{cum}")
+                lines.append(f"{name}_sum{_label_str(base)} {_fmt(s)}")
+                lines.append(f"{name}_count{_label_str(base)} {cum}")
+                if mergeable:
+                    acc = merged.get(values)
+                    if acc is None:
+                        acc = merged[values] = [[0] * len(counts), 0.0]
+                    for i, c in enumerate(counts):
+                        acc[0][i] += c
+                    acc[1] += s
+        for values, (counts, s) in merged.items():
+            base = list(zip(e["labels"], values))
+            cum = 0
+            for b, c in zip(bounds, counts):
+                cum += c
+                pairs = base + [("le", _fmt(b))]
+                lines.append(f"{name}_bucket{_label_str(pairs)} {cum}")
+            cum += counts[-1]
+            lines.append(
+                f"{name}_bucket{_label_str(base + [('le', '+Inf')])} {cum}")
+            lines.append(f"{name}_sum{_label_str(base)} {_fmt(s)}")
+            lines.append(f"{name}_count{_label_str(base)} {cum}")
+
+
+_merged = MergedRegistry()
+
+
+def get_merged() -> MergedRegistry:
+    """The process-default merged view (what the tracker and the fleet
+    dispatcher ingest into, and what the scrape endpoint serves)."""
+    return _merged
+
+
+# ---------------------------------------------------------------------------
+# Scrape endpoint
+# ---------------------------------------------------------------------------
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    server_version = "xtb-metrics/1"
+
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        if self.path.split("?", 1)[0].rstrip("/") not in ("", "/metrics"):
+            self.send_error(404)
+            return
+        try:
+            body = self.server.render().encode("utf-8")  # type: ignore
+        except Exception as e:  # pragma: no cover - render must not 500
+            self.send_error(500, str(e))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args) -> None:  # scrapes must not spam stderr
+        pass
+
+
+class MetricsServer(ThreadingHTTPServer):
+    """``/metrics`` over the merged view (plus the local registry as
+    source ``driver``).  ``port=0`` binds an ephemeral port; read the
+    bound one from :attr:`port`.  Binds loopback by default — the
+    endpoint is unauthenticated and leaks model/tenant names, so
+    exposing it beyond the host is an explicit decision
+    (``XGBOOST_TPU_METRICS_HOST=0.0.0.0`` or ``host=``)."""
+
+    daemon_threads = True
+
+    def __init__(self, port: int,
+                 merged: Optional[MergedRegistry] = None,
+                 include_local: bool = True,
+                 host: Optional[str] = None) -> None:
+        if host is None:
+            host = os.environ.get(ENV_HOST, "").strip() or "127.0.0.1"
+        super().__init__((host, int(port)), _MetricsHandler)
+        self._merged = merged
+        self._include_local = include_local
+        self._thread: Optional[threading.Thread] = None
+
+    def render(self) -> str:
+        m = self._merged if self._merged is not None else get_merged()
+        return m.render_prometheus(include_local=self._include_local)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start(self) -> "MetricsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self.serve_forever,
+                                            daemon=True,
+                                            name="xtb-metrics-http")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self.shutdown()
+        self.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+_server: Optional[MetricsServer] = None
+_server_lock = threading.Lock()
+
+
+def start_metrics_server(port: Optional[int] = None,
+                         ) -> Optional[MetricsServer]:
+    """Start (or return) the process-wide scrape endpoint.  With
+    ``port=None`` the port comes from ``XGBOOST_TPU_METRICS_PORT``; the
+    variable absent or <= 0 means disabled (returns None).  An explicit
+    ``port`` argument always starts one (0 = ephemeral)."""
+    global _server
+    with _server_lock:
+        if _server is not None:
+            return _server
+        if port is None:
+            raw = os.environ.get(ENV_PORT, "").strip()
+            if not raw:
+                return None
+            try:
+                port = int(raw)
+            except ValueError:
+                return None
+            if port <= 0:
+                return None
+        try:
+            _server = MetricsServer(port).start()
+        except OSError as e:
+            # an opt-in observability endpoint failing to bind (port in
+            # use, restart race) must never take training/serving down
+            import warnings
+
+            warnings.warn(f"metrics endpoint on port {port} not started "
+                          f"({e}); continuing without a scrape endpoint",
+                          RuntimeWarning, stacklevel=2)
+            return None
+        return _server
+
+
+def stop_metrics_server() -> None:
+    global _server
+    with _server_lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Worker-side shipping (tracker channel)
+# ---------------------------------------------------------------------------
+
+_last_ship = 0.0
+
+
+def ship_to_tracker(force: bool = False) -> bool:
+    """Ship this process's snapshot to the rendezvous tracker over the
+    persistent channel (tracker-mode training workers only; other
+    backends return False).  Rate-limited to :func:`ship_interval`
+    unless ``force`` — ``TelemetryCallback`` calls this every round and
+    ``collective.finalize`` forces a final ship at exit."""
+    global _last_ship
+    from .. import collective
+
+    backend = collective._backend()
+    tracker = getattr(backend, "_tracker", None)
+    if tracker is None or not hasattr(tracker, "ship_telemetry"):
+        return False
+    now = time.monotonic()
+    if not force and now - _last_ship < ship_interval():
+        return False
+    _last_ship = now
+    return bool(tracker.ship_telemetry(snapshot_payload()))

@@ -29,6 +29,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "get_registry",
     "render_prometheus", "DEFAULT_BUCKETS",
 ]
+# _escape_label/_escape_help/_fmt are shared with telemetry/distributed.py
+# so merged and local exposition agree byte-for-byte on formatting.
 
 # seconds-scale exponential buckets: 100us .. ~100s (phase timings and
 # request latencies both land comfortably inside)
@@ -47,6 +49,12 @@ def _validate_name(name: str) -> None:
 def _escape_label(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
         "\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    # exposition format: HELP text escapes backslash and newline (an
+    # unescaped newline splits the comment into a garbage sample line)
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 class _Family:
@@ -319,11 +327,40 @@ class Registry:
         with self._lock:
             return list(self._families.values())
 
+    def snapshot(self) -> dict:
+        """JSON-serializable dump of every family — the cross-process
+        shipping format (telemetry/distributed.py merges these driver-side
+        into a :class:`~xgboost_tpu.telemetry.distributed.MergedRegistry`).
+
+        Scalars ship ``[label_values, value]``; histograms ship
+        ``[label_values, bucket_counts, sum, count]`` with the family's
+        bucket bounds alongside, so the receiver can fold them via the
+        same bucketed-merge path the native pool bridge uses."""
+        fams = []
+        for fam in self.families():
+            rec: dict = {"name": fam.name, "kind": fam.kind,
+                         "help": fam.help,
+                         "labels": list(fam.label_names)}
+            if fam.kind == "histogram":
+                rec["buckets"] = [float(b) for b in fam.buckets]
+                rec["children"] = [
+                    [list(values), [int(c) for c in child.counts],
+                     float(child.sum), int(child.count)]
+                    for values, child in fam.collect()]
+            else:
+                rec["children"] = [[list(values), float(child.value)]
+                                   for values, child in fam.collect()]
+            fams.append(rec)
+        return {"families": fams}
+
     def render_prometheus(self) -> str:
+        from .catalog import help_for  # lazy: parses the docs catalog once
+
         lines: List[str] = []
         for fam in self.families():
-            if fam.help:
-                lines.append(f"# HELP {fam.name} {fam.help}")
+            help_text = fam.help or help_for(fam.name)
+            if help_text:
+                lines.append(f"# HELP {fam.name} {_escape_help(help_text)}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
             lines.extend(fam.render())
         return "\n".join(lines) + "\n"

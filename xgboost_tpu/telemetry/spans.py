@@ -22,7 +22,7 @@ import os
 import time
 from typing import Dict, Optional
 
-from . import trace
+from . import flight, trace
 from .registry import get_registry
 
 __all__ = ["span", "enable", "disable", "enabled", "record_phase", "Span",
@@ -90,9 +90,12 @@ def _annotation(name: str):
 
 
 def record_phase(name: str, t0_ns: int, dur_ns: int) -> None:
-    """Feed one finished bracket into both sinks (histogram + JSONL trace).
-    Shared by Span and the Monitor shim so the two agree on format."""
+    """Feed one finished bracket into the sinks (histogram + flight ring
+    + JSONL trace).  Shared by Span and the Monitor shim so they agree on
+    format.  The flight append keeps the crash ring carrying the last few
+    hundred spans even when no trace file is configured."""
     _child(name).observe(dur_ns / 1e9)
+    flight.record("span", name, s=dur_ns / 1e9)
     if trace.active():
         trace.emit(name, t0_ns, dur_ns)
 

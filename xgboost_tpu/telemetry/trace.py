@@ -23,7 +23,8 @@ import os
 import threading
 from typing import Optional
 
-__all__ = ["active", "configure", "emit", "path", "flush", "ENV_VAR"]
+__all__ = ["active", "configure", "emit", "emit_meta", "path", "flush",
+           "set_process_name", "set_thread_name", "ENV_VAR"]
 
 ENV_VAR = "XGBOOST_TPU_TRACE"
 _OWNER_VAR = ENV_VAR + "_OWNER_PID"
@@ -52,6 +53,8 @@ def _env_path() -> Optional[str]:
 _lock = threading.Lock()
 _path: Optional[str] = _env_path()
 _file: Optional[io.TextIOBase] = None
+_configured_export = False  # True only when configure(path) set ENV_VAR
+_env_before_export: Optional[str] = None  # user's value, restored on None
 
 
 def active() -> bool:
@@ -68,8 +71,17 @@ def configure(path: Optional[str]) -> None:
     the same switch as the XGBOOST_TPU_TRACE environment variable,
     including auto-enabling the span tracer (a trace with no spans is
     never what the caller wanted).  configure(None) stops writing but
-    leaves the span flag alone — it may have been enabled explicitly."""
-    global _path, _file
+    leaves the span flag alone — it may have been enabled explicitly.
+
+    Like the env-var path, configure(path) claims ownership: the variable
+    (and the owner-pid marker) are exported so subprocesses spawned after
+    this call — fleet replicas, launcher workers — capture their own
+    ``<path>.<pid>`` sidecar files instead of truncating ours; the merged
+    multi-process timeline is their union (docs/observability.md).
+    configure(None) undoes only an export configure(path) ITSELF made —
+    a variable the user set in the launching environment is restored,
+    never deleted."""
+    global _path, _file, _configured_export, _env_before_export
     with _lock:
         if _file is not None:
             try:
@@ -80,9 +92,23 @@ def configure(path: Optional[str]) -> None:
             _file = None
         _path = path or None
     if _path is not None:
+        if not _configured_export:
+            _env_before_export = os.environ.get(ENV_VAR)
+            _configured_export = True
+        os.environ[ENV_VAR] = _path
+        os.environ.setdefault(_OWNER_VAR, str(os.getpid()))
         from . import spans  # import cycle broken at call time
 
         spans.enable()
+    elif _configured_export:
+        _configured_export = False
+        if _env_before_export is not None:
+            os.environ[ENV_VAR] = _env_before_export
+        else:
+            os.environ.pop(ENV_VAR, None)
+            if os.environ.get(_OWNER_VAR) == str(os.getpid()):
+                os.environ.pop(_OWNER_VAR, None)
+        _env_before_export = None
 
 
 def _ensure_file() -> Optional[io.TextIOBase]:
@@ -119,6 +145,42 @@ def emit(name: str, ts_ns: int, dur_ns: int, ph: str = "X",
             return
         f.write(line + "\n")
         f.flush()
+
+
+def emit_meta(meta: str, value: str) -> None:
+    """Append one Trace Event Format metadata record (``ph="M"``) —
+    ``process_name`` / ``thread_name`` entries that make a merged
+    multi-process capture readable (the viewer shows ``replica0`` or
+    ``rank2`` instead of bare pids).  ``dur``/``ts`` ride along as zeros
+    so line-oriented consumers see the same field set as span events."""
+    if _path is None:
+        return
+    rec = {
+        "name": meta,
+        "ph": "M",
+        "ts": 0.0,
+        "dur": 0.0,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() & 0x7FFFFFFF,
+        "args": {"name": value},
+    }
+    line = json.dumps(rec, separators=(",", ":"))
+    with _lock:
+        f = _ensure_file()
+        if f is None:
+            return
+        f.write(line + "\n")
+        f.flush()
+
+
+def set_process_name(label: str) -> None:
+    """Name this process in the merged timeline (``replica0``,
+    ``rank3``, ``fleet-driver``...).  No-op when tracing is off."""
+    emit_meta("process_name", label)
+
+
+def set_thread_name(name: str) -> None:
+    emit_meta("thread_name", name)
 
 
 def flush() -> None:

@@ -434,6 +434,9 @@ class RabitTracker:
         self._regroup_joins: Dict[socket.socket, int] = {}  # conn -> round
         self._joiners: List[socket.socket] = []  # parked replacement conns
         self.lost_workers = 0
+        # last shipped telemetry payload per source label ("rank<N>"):
+        # retained after the worker dies (postmortem + merged scrape)
+        self.telemetry: Dict[str, dict] = {}
 
     # ------------------------------------------------------------- serving
     def start(self) -> None:
@@ -559,6 +562,14 @@ class RabitTracker:
             if msg.get("cmd") == "regroup_join" and self.elastic:
                 self._handle_regroup_join(conn, int(msg.get("round", 0)))
                 continue
+            if msg.get("cmd") == "telemetry":
+                # metric shipping over the persistent channel: ingest the
+                # worker's registry snapshot + flight ring driver-side
+                # under its CURRENT rank (dead workers keep their last)
+                with self._lock:
+                    cur = self._members.get(conn, rank)
+                self._ingest_telemetry(cur, msg)
+                continue
         if clean:
             with self._lock:
                 self._members.pop(conn, None)
@@ -613,6 +624,26 @@ class RabitTracker:
                 self._error = "all workers lost (no clean shutdowns)"
         if finished:
             self._done.set()
+
+    def _ingest_telemetry(self, rank: int, msg: dict) -> None:
+        """One worker telemetry shipment: keep the last payload per rank
+        and feed the snapshot into the process-default merged registry so
+        a driver-side ``/metrics`` scrape shows every rank's series
+        (telemetry/distributed.py; docs/observability.md)."""
+        source = f"rank{rank}"
+        payload = {"snapshot": msg.get("snapshot"),
+                   "flight": msg.get("flight") or [],
+                   "pid": msg.get("pid")}
+        with self._lock:
+            self.telemetry[source] = payload
+        snap = payload["snapshot"]
+        if snap:
+            try:
+                from .telemetry.distributed import get_merged
+
+                get_merged().ingest(source, snap)
+            except Exception:  # pragma: no cover - telemetry must not kill
+                pass           # the rendezvous channel
 
     # ------------------------------------------------- elastic membership
     def _accept_late(self) -> None:
@@ -669,8 +700,10 @@ class RabitTracker:
             joiners = len(self._joiners)
             epoch_now = self._epoch
         from .elastic import instruments as _elastic_ins
+        from .telemetry import flight as _flight
 
         _elastic_ins()[1].inc()
+        _flight.record("event", "tracker.worker_lost", rank=rank, msg=msg)
         warnings.warn(f"elastic: worker {rank} lost ({msg}); "
                       f"{survivors} survivor(s) regrouping", RuntimeWarning,
                       stacklevel=2)
@@ -773,10 +806,13 @@ class RabitTracker:
             # _members) before the watcher threads below start
             joiner_ranks = [(conn, self._members[conn]) for conn in joiners]
         from .elastic import instruments as _elastic_ins
+        from .telemetry import flight as _flight
 
         ins = _elastic_ins()
         ins[0].inc()
         ins[2].observe(duration)
+        _flight.record("event", "tracker.regroup", epoch=epoch,
+                       world=new_world, seconds=duration)
         for conn, jrank in joiner_ranks:
             threading.Thread(target=self._watch_worker,
                              args=(conn, jrank), daemon=True).start()
@@ -913,6 +949,15 @@ class TrackerClient:
         while True:
             try:
                 msg = recv_msg(self._sock)
+            except socket.timeout:
+                # a concurrent TIMED send (ship_telemetry / signal_error
+                # both bound their sends) toggles the shared socket's
+                # timeout; a watcher recv entered in that window inherits
+                # it and expires on an idle channel.  That is not a death
+                # — retry.  (Mid-frame expiry would desync framing, but
+                # the watcher sits at a frame boundary and abort/regroup
+                # frames arrive as single segments.)
+                continue
             except OSError:
                 return
             if msg is None:
@@ -923,6 +968,16 @@ class TrackerClient:
 
                 print(f"[rank {self.rank}] aborting: peer failure — "
                       f"{msg.get('msg', '')}", file=sys.stderr, flush=True)
+                try:
+                    # os._exit skips atexit: flush the flight ring so the
+                    # aborted peer's postmortem shows ITS last moments too
+                    from .telemetry import flight
+
+                    flight.record("fault", "tracker.abort",
+                                  msg=msg.get("msg", ""))
+                    flight.dump()
+                except Exception:
+                    pass
                 os._exit(255)  # reference: std::exit(-1) in the watcher
             if msg.get("cmd") == "regroup_pending":
                 # picked up by the training loop at its round boundary
@@ -1045,6 +1100,21 @@ class TrackerClient:
                 ) from e
         return np.frombuffer(buf, arr.dtype).reshape(
             (self.world,) + arr.shape).copy()
+
+    def ship_telemetry(self, payload: dict) -> bool:
+        """Send a registry-snapshot + flight-ring payload
+        (``telemetry.distributed.snapshot_payload()``) to the tracker on
+        the persistent channel.  Best-effort and bounded: a wedged or
+        gone tracker costs one timeout, never the training run."""
+        msg = {"cmd": "telemetry",
+               "snapshot": payload.get("snapshot"),
+               "flight": payload.get("flight"),
+               "pid": payload.get("pid", 0)}
+        try:
+            send_msg(self._sock, msg, timeout=30.0)
+            return True
+        except (OSError, TypeError, ValueError):
+            return False
 
     def signal_error(self, msg: str) -> None:
         # bounded: a dying worker must not block on a wedged tracker
