@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Out-of-core training smoke for the nightly suite (docs/extmem.md).
+
+Flow, all over the tracker relay:
+
+1. A 2-worker paged run through ``train(params, ExtMemConfig(...))`` —
+   each rank owns a page shard, cuts merge through the streaming
+   page-wise sketch, per-level histograms allreduce over the relay —
+   must produce identical model bytes on every rank, and the driver's
+   **peak RSS must stay under a ceiling** far below what the resident
+   full matrix would need (``resource.getrusage``; pages are generated
+   on the fly, never materialized together).
+2. The same run with a ``fault`` at the new ``extmem.page_load`` seam
+   (a mid-stream decode failure on a prefetch worker): the affected
+   worker must die LOUDLY and the launcher must surface a
+   ``WorkerFailedError`` naming it — instead of wedging the relay.
+
+Usage: JAX_PLATFORMS=cpu python scripts/extmem_smoke.py [pages] [rounds]
+"""
+import functools
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_PAGES = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+PAGE_ROWS = 65536
+N_COLS = 12
+WORKERS = 2
+# generated pages are u8-binned; the would-be resident f32 matrix is
+# pages*rows*cols*4 bytes.  The ceiling leaves room for the interpreter +
+# jax runtime (~600 MB here) + per-row training state, but NOT for a
+# resident matrix copy per worker.
+RSS_CEILING_MB = 1600
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+          "max_bin": 64}
+
+
+def _page(shard: int):
+    """Synthesize one page deterministically from its shard id — any rank
+    can own any shard without shared storage."""
+    import numpy as np
+
+    rng = np.random.default_rng(1000 + shard)
+    X = rng.normal(size=(PAGE_ROWS, N_COLS)).astype(np.float32)
+    X[rng.random(X.shape) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) - 0.5 * np.nan_to_num(X[:, 1]) > 0
+         ).astype(np.float32)
+    return X, y
+
+
+def worker(rank, world, *, out_dir, rounds, n_pages):
+    import resource
+
+    import numpy as np
+
+    import xgboost_tpu as xtb
+
+    class ShardIter(xtb.DataIter):
+        def __init__(self, shards):
+            super().__init__()
+            self._shards, self._i = list(shards), 0
+
+        def reset(self):
+            self._i = 0
+
+        def next(self, input_data):
+            if self._i >= len(self._shards):
+                return 0
+            X, y = _page(self._shards[self._i])
+            input_data(data=X, label=y)
+            self._i += 1
+            return 1
+
+    def data_fn(smap, rank, world):
+        return ShardIter(smap.shards_of(rank))
+
+    cfg = xtb.ExtMemConfig(data_fn, num_shards=n_pages,
+                           max_bin=PARAMS["max_bin"])
+    bst = xtb.train(PARAMS, cfg, rounds, verbose_eval=False)
+    peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    with open(os.path.join(out_dir, f"rank{rank}.ubj"), "wb") as fh:
+        fh.write(bytes(bst.save_raw()))
+    with open(os.path.join(out_dir, f"rank{rank}.rss"), "w") as fh:
+        fh.write(str(peak_mb))
+    print(f"[extmem_smoke] rank {rank}: trained {rounds} rounds over "
+          f"{len(xtb.ShardMap.create(n_pages, world).shards_of(rank))} "
+          f"pages, peak RSS {peak_mb:.0f} MB", flush=True)
+
+
+def main() -> int:
+    from xgboost_tpu.launcher import WorkerFailedError, run_distributed
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import extmem_smoke as _mod
+
+    global worker
+    worker = _mod.worker
+
+    resident_mb = N_PAGES * PAGE_ROWS * N_COLS * 4 / 2**20
+    with tempfile.TemporaryDirectory(prefix="xtb_extmem_smoke_") as tmp:
+        print(f"[extmem_smoke] {WORKERS}-worker paged run: {N_PAGES} pages "
+              f"x {PAGE_ROWS} rows x {N_COLS} cols "
+              f"(resident would be {resident_mb:.0f} MB f32) ...",
+              flush=True)
+        run_distributed(
+            functools.partial(worker, out_dir=tmp, rounds=ROUNDS,
+                              n_pages=N_PAGES),
+            num_workers=WORKERS, platform="cpu", timeout=900,
+            rendezvous="tracker")
+        models = [open(os.path.join(tmp, f"rank{r}.ubj"), "rb").read()
+                  for r in range(WORKERS)]
+        if models[0] != models[1]:
+            raise SystemExit("ranks disagree on the trained model bytes")
+        peaks = [float(open(os.path.join(tmp, f"rank{r}.rss")).read())
+                 for r in range(WORKERS)]
+        if max(peaks) > RSS_CEILING_MB:
+            raise SystemExit(
+                f"RSS ceiling exceeded: peak {max(peaks):.0f} MB > "
+                f"{RSS_CEILING_MB} MB (resident matrix would be "
+                f"{resident_mb:.0f} MB)")
+        print(f"[extmem_smoke] OK: identical model bytes "
+              f"({len(models[0])}), peak RSS {max(peaks):.0f} MB <= "
+              f"{RSS_CEILING_MB} MB ceiling", flush=True)
+
+        # mid-stream decode failure: page_load raises on rank 1 during the
+        # second streamed page — the job must FAIL with the cause named,
+        # not hang the relay
+        plan = {"faults": [{"site": "extmem.page_load", "kind": "exception",
+                            "rank": 1, "round": 1}]}
+        print("[extmem_smoke] injected decode failure at extmem.page_load "
+              "(rank 1, page 1) ...", flush=True)
+        try:
+            run_distributed(
+                functools.partial(worker, out_dir=tmp, rounds=ROUNDS,
+                                  n_pages=N_PAGES),
+                num_workers=WORKERS, platform="cpu", timeout=300,
+                fault_plan=json.dumps(plan), rendezvous="tracker")
+        except WorkerFailedError as e:
+            tail = "".join(t or "" for _, _, t in e.failures)
+            if "FaultInjected" not in tail and "page_load" not in tail:
+                raise SystemExit(
+                    f"decode failure surfaced without its cause: {e}")
+            print(f"[extmem_smoke] OK: decode failure surfaced cleanly "
+                  f"({len(e.failures)} failed worker(s), cause in stderr "
+                  "tail)", flush=True)
+        else:
+            raise SystemExit("extmem.page_load fault did not fail the run")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

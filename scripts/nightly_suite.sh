@@ -74,6 +74,14 @@ JAX_PLATFORMS=cpu python scripts/fault_smoke.py 4 6
 # shard map restored from the checkpoint
 JAX_PLATFORMS=cpu python scripts/elastic_smoke.py 4 8
 
+# out-of-core smoke (docs/extmem.md): 2-worker paged run through
+# train(ExtMemConfig) over the tracker relay — identical model bytes on
+# every rank with peak RSS under the ceiling (pages stream, the full
+# matrix never materializes) — then a mid-stream decode failure injected
+# at the extmem.page_load seam must fail the job loudly with the cause
+# in the worker's stderr tail instead of wedging the relay
+JAX_PLATFORMS=cpu python scripts/extmem_smoke.py 8 4
+
 # serving-fleet + observability smoke (docs/serving.md "Fleet",
 # docs/observability.md "Distributed observability plane"): 3 replicas
 # over two models with a warm compile cache, mixed traffic from 6 client

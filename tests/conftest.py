@@ -49,7 +49,7 @@ _QUICK_FILES = {
     "test_timer_observer.py", "test_reliability.py",
     "test_serving_faults.py", "test_reliability_multiprocess.py",
     "test_analysis.py", "test_native_threads.py", "test_elastic.py",
-    "test_lifecycle.py", "test_updaters_process.py",
+    "test_lifecycle.py", "test_updaters_process.py", "test_extmem.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)
@@ -83,6 +83,9 @@ _QUICK_DENY = {
     "test_two_process_elastic_shrink_to_single_worker",
     "test_manager_continuation_resumes_from_checkpoint",
     "test_lifecycle_end_to_end_fleet",
+    "test_extmem_matches_incore", "test_extmem_multidevice_matches_single",
+    "test_sparse_page_dmatrix_raw_predict_and_training",
+    "test_sparse_page_dmatrix_scipy_batches_and_sentinel",
 }
 
 

@@ -287,21 +287,38 @@ def test_extmem_twenty_pages_mesh_parity(eight_devices):
     assert err_ext <= err_mem + 0.02, (err_ext, err_mem)
 
 
-def test_prefetch_overlap_under_simulated_transfer(monkeypatch):
-    """Prefetch must actually overlap page transfer with page compute
-    (VERDICT r4 #6).  The CPU backend has no real H2D DMA, so a synthetic
-    per-byte latency (XTB_EXTMEM_SIM_TRANSFER_MS_PER_MB) stands in: the
-    sleep in _put_page yields the core while XLA's async-dispatched page
-    compute proceeds — the same concurrency shape as device compute under
-    a real transfer.  The matmul hist impl keeps compute comparable to the
-    simulated transfer (the TPU-like compute profile); gain is measured as
-    serialized wall / prefetch wall over identical trees."""
-    import time
+def _split_events_by_level(events):
+    levels = []
+    cur = None
+    for e in events:
+        if e[0] == "level":
+            cur = []
+            levels.append(cur)
+        elif cur is not None:
+            cur.append(e)
+    return levels
 
+
+def test_prefetch_overlap_pipeline_deterministic(monkeypatch):
+    """Prefetch must actually pipeline page staging under page compute
+    (VERDICT r4 #6).  The old form of this test thresholded a wall-clock
+    ratio, which flaked on time-shared hosts; the pipeline property is now
+    pinned deterministically (ISSUE 12 satellite):
+
+    - event ordering (main-thread program order, scheduling-independent):
+      with prefetch on, page j+1's decode is SUBMITTED before the consumer
+      blocks on page j — the decode is in flight under page j's compute —
+      while the serialized baseline stages strictly synchronously;
+    - the xtb_extmem_* counters account for the work: every page staged is
+      counted, and decode seconds include the simulated transfer's exact
+      floor (the sleep is inside the staged decode);
+    - prefetch stays numerically transparent: identical trees either way.
+    """
+    from xgboost_tpu.data import extmem
     from xgboost_tpu.data.extmem import DataIter, ExtMemQuantileDMatrix
 
     rng = np.random.default_rng(5)
-    n_pages, rows_page, F = 4, 16384, 64
+    n_pages, rows_page, F = 4, 2048, 16
     X_all = rng.normal(size=(n_pages * rows_page, F)).astype(np.float32)
     y_all = (X_all[:, 0] + 0.3 * rng.normal(size=len(X_all)) > 0).astype(
         np.float32)
@@ -323,29 +340,355 @@ def test_prefetch_overlap_under_simulated_transfer(monkeypatch):
         def reset(self):
             self._i = 0
 
-    # pages are uint8-binned (16384 x 64 = 1 MB); 400 ms/MB puts the
-    # simulated transfer in the same band as the per-page matmul compute
-    # (~0.4 s each) — the regime where overlap shows, like a TPU fed over
-    # PCIe.  The sleep must dominate the (non-overlappable, host-side)
-    # zstd decompress for the measurement to isolate transfer overlap.
-    monkeypatch.setenv("XTB_HIST_IMPL", "matmul")
-    monkeypatch.setenv("XTB_EXTMEM_SIM_TRANSFER_MS_PER_MB", "400")
-    d = ExtMemQuantileDMatrix(Pages(), max_bin=64)
+    # a synthetic per-byte transfer latency rides inside the staged decode
+    # (and disables the CPU committed-page cache, preserving the TPU-like
+    # stream-every-level shape); 20 ms/MB on 2048x16 u8 pages = 0.64 ms
+    # per page load — a deterministic floor for the decode counter
+    sim_ms_per_mb = 20.0
+    monkeypatch.setenv("XTB_EXTMEM_SIM_TRANSFER_MS_PER_MB",
+                       str(sim_ms_per_mb))
+    monkeypatch.setenv("XTB_EXTMEM_EVENT_LOG", "1")
+    d = ExtMemQuantileDMatrix(Pages(), max_bin=32)
     params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
-              "max_bin": 64}
+              "max_bin": 32}
+    ins = extmem.instruments()
 
     def run(prefetch: str):
-        p = {**params, "_extmem_prefetch": prefetch}
-        xtb.train(p, d, 1, verbose_eval=False)  # compile warmup
-        t0 = time.perf_counter()
-        bst = xtb.train(p, d, 2, verbose_eval=False)
+        extmem.PAGE_EVENT_LOG.clear()
+        before = (ins[0].get(), ins[1].get(), ins[2].get(), ins[3].get())
+        bst = xtb.train({**params, "_extmem_prefetch": prefetch}, d, 2,
+                        verbose_eval=False)
         import jax
 
         jax.block_until_ready(bst._caches[id(d)].margin)
-        return time.perf_counter() - t0, bst
+        delta = (ins[0].get() - before[0], ins[1].get() - before[1],
+                 ins[2].get() - before[2], ins[3].get() - before[3])
+        return bst, list(extmem.PAGE_EVENT_LOG), delta
 
-    wall_pre, bst_pre = run("1")
-    wall_ser, bst_ser = run("0")
+    bst_pre, ev_pre, d_pre = run("1")
+    bst_ser, ev_ser, d_ser = run("0")
     assert bst_pre.get_dump() == bst_ser.get_dump()  # transparency
-    gain = wall_ser / wall_pre
-    assert gain > 1.2, (wall_ser, wall_pre, gain)
+
+    # prefetch on: within every level, the scheduler submits page j+1
+    # before blocking on page j (main-thread program order — deterministic
+    # however the host schedules the worker threads)
+    levels_pre = _split_events_by_level(ev_pre)
+    assert levels_pre, ev_pre[:8]
+    waits = 0
+    for lv in levels_pre:
+        assert not any(n == "load_sync" for n, _ in lv)
+        submit_at = {j: k for k, (n, j) in enumerate(lv) if n == "submit"}
+        for k, (n, j) in enumerate(lv):
+            if n == "wait" and j + 1 in submit_at:
+                waits += 1
+                assert submit_at[j + 1] < k, (j, lv)
+    assert waits > 0  # the ordering property was actually exercised
+
+    # serialized baseline: strictly synchronous staging, no window
+    assert all(n == "load_sync" for lv in _split_events_by_level(ev_ser)
+               for n, _ in lv)
+
+    # counters: every page staged is accounted (4 levels x 4 pages x
+    # 2 rounds per run), and decode seconds carry at least the simulated
+    # transfer floor; overlap can only be claimed by the prefetch run
+    page_mb = (rows_page * F) / 1e6
+    n_loads = 4 * n_pages * 2
+    for dd in (d_pre, d_ser):
+        assert dd[3] == n_loads, (dd, n_loads)
+        assert dd[0] >= n_loads * page_mb * sim_ms_per_mb / 1e3 * 0.99
+    assert d_ser[2] == 0.0  # serialized: nothing overlaps by construction
+    assert d_pre[2] >= 0.0 and d_pre[1] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: streaming out-of-core distributed training
+# ---------------------------------------------------------------------------
+
+
+def _cuts_bytes(cuts):
+    return (cuts.cut_ptrs.tobytes(), cuts.cut_values.tobytes(),
+            cuts.min_vals.tobytes())
+
+
+def _run_thread_world(world, fn, group):
+    """Run fn(rank) under an in-memory collective at `world` ranks; returns
+    {rank: result} and re-raises the first worker error."""
+    import threading
+
+    from xgboost_tpu import collective
+
+    out, errors = {}, {}
+
+    def worker(rank):
+        try:
+            with collective.CommunicatorContext(
+                    dmlc_communicator="in-memory", in_memory_group=group,
+                    in_memory_world_size=world, in_memory_rank=rank):
+                out[rank] = fn(rank)
+        except Exception as e:  # noqa: BLE001 - reported to the main thread
+            errors[rank] = e
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "worker deadlocked"
+    if errors:
+        raise errors[min(errors)]
+    return out
+
+
+def test_streaming_sketch_page_parity_fuzz():
+    """The pinned streaming-sketch contract (docs/extmem.md): the page is
+    the atomic sketch unit and the merge is order-insensitive — cuts are
+    bitwise-identical however the pages are grouped onto ranks (world
+    1/2/4) and in whatever order each rank pushes its pages, and equal to
+    the one-shot sketch_distributed where each page is one rank's shard."""
+    from xgboost_tpu.data.quantile import StreamingSketch, sketch_distributed
+
+    rng = np.random.default_rng(11)
+    n_pages, F = 8, 5
+    pages = [rng.normal(size=(rng.integers(50, 300), F)).astype(np.float32)
+             for _ in range(n_pages)]
+    for p in pages:
+        p[rng.random(p.shape) < 0.1] = np.nan
+    weights = [rng.random(len(p)).astype(np.float32) + 0.1 for p in pages]
+
+    def streaming(world, order_seed, group, weighted):
+        def fn(rank):
+            mine = [i for i in range(n_pages) if i % world == rank]
+            np.random.default_rng(order_seed + rank).shuffle(mine)
+            sk = StreamingSketch(F, 16)
+            for i in mine:
+                sk.push(pages[i], weights=weights[i] if weighted else None)
+            return _cuts_bytes(sk.finalize(distributed=True))
+
+        got = _run_thread_world(world, fn, group)
+        assert all(v == got[0] for v in got.values())
+        return got[0]
+
+    for weighted in (False, True):
+        tag = "w" if weighted else "u"
+        ref = streaming(1, 3, f"sk1{tag}", weighted)
+        assert streaming(2, 17, f"sk2{tag}", weighted) == ref
+        assert streaming(4, 29, f"sk4{tag}", weighted) == ref
+        # one-shot sketch_distributed, one page per rank == streaming
+        oneshot = _run_thread_world(
+            n_pages,
+            lambda rank: _cuts_bytes(sketch_distributed(
+                pages[rank], 16,
+                weights=weights[rank] if weighted else None)),
+            f"sk8{tag}")
+        assert oneshot[0] == ref
+
+
+def test_streaming_sketch_csr_parity():
+    """push_csr: page-wise CSR streaming == one-shot
+    sketch_csr(distributed=True) with one page per rank, across groupings
+    — including categorical columns (identity cuts from the global max)."""
+    import scipy.sparse as sp
+
+    from xgboost_tpu.data.quantile import StreamingSketch, sketch_csr
+
+    rng = np.random.default_rng(23)
+    n_pages, F = 6, 4
+    cat_mask = np.array([False, True, False, False])
+    pages = []
+    for _ in range(n_pages):
+        R = int(rng.integers(40, 120))
+        X = rng.normal(size=(R, F)).astype(np.float32)
+        X[:, 1] = rng.integers(0, 5, size=R)  # categorical codes
+        X[rng.random((R, F)) < 0.2] = 0.0     # implicit-zero candidates
+        pages.append(sp.csr_matrix(X))
+
+    def streaming(world, group):
+        def fn(rank):
+            mine = [i for i in range(n_pages) if i % world == rank]
+            sk = StreamingSketch(F, 16, cat_mask=cat_mask)
+            for i in reversed(mine):  # adversarial push order
+                c = pages[i]
+                sk.push_csr(c.indptr, c.indices, c.data)
+            return _cuts_bytes(sk.finalize(distributed=True))
+
+        got = _run_thread_world(world, fn, group)
+        assert all(v == got[0] for v in got.values())
+        return got[0]
+
+    ref = streaming(1, "csr1")
+    assert streaming(2, "csr2") == ref
+    assert streaming(3, "csr3") == ref
+
+    def oneshot(rank):
+        c = pages[rank]
+        return _cuts_bytes(sketch_csr(c.indptr, c.indices, c.data, F, 16,
+                                      cat_mask=cat_mask, distributed=True))
+
+    assert _run_thread_world(n_pages, oneshot, "csr6")[0] == ref
+
+
+def _paged_iter(X, y, page_rows):
+    class Pages(xtb.DataIter):
+        def __init__(self, idx):
+            super().__init__()
+            self._idx, self._i = idx, 0
+
+        def reset(self):
+            self._i = 0
+
+        def next(self, input_data):
+            if self._i >= len(self._idx):
+                return 0
+            lo = self._idx[self._i] * page_rows
+            input_data(data=X[lo:lo + page_rows], label=y[lo:lo + page_rows])
+            self._i += 1
+            return 1
+
+    return Pages
+
+
+def test_extmem_world2_bitwise_matches_incore_single():
+    """The full-pipeline bitwise contract (ISSUE 12): a world-2 extmem run
+    under deterministic_histogram (exact integer limb histograms: page
+    accumulation and the cross-rank reduce are associative) produces the
+    exact model bytes of the in-memory single-process run on the same
+    binned data — same cuts injected via ref=."""
+    n_pages, page_rows, F = 4, 1024, 6
+    rng = np.random.default_rng(31)
+    X = rng.normal(size=(n_pages * page_rows, F)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+              "max_bin": 16, "deterministic_histogram": 1}
+    Pages = _paged_iter(X, y, page_rows)
+
+    d_in = xtb.QuantileDMatrix(X, label=y, max_bin=16)
+    ref_bytes = bytes(xtb.train(params, d_in, 3,
+                                verbose_eval=False).save_raw())
+
+    def fn(rank):
+        d = xtb.ExtMemQuantileDMatrix(
+            Pages([2 * rank, 2 * rank + 1]), max_bin=16, ref=d_in)
+        return bytes(xtb.train(params, d, 3, verbose_eval=False).save_raw())
+
+    got = _run_thread_world(2, fn, "extmem_bw2")
+    assert got[0] == got[1], "ranks disagree"
+    assert got[0] == ref_bytes, \
+        "world-2 extmem != in-memory single-process model bytes"
+
+
+def test_extmem_config_world_invariant():
+    """train(params, ExtMemConfig(...)): the launcher-shaped composition.
+    Under deterministic_histogram the model is world-invariant BITWISE:
+    the page is the sketch unit (cuts identical at any world) and limb
+    histograms reduce exactly, so world 1 == world 2 model bytes."""
+    n_pages, page_rows, F = 4, 1024, 5
+    rng = np.random.default_rng(37)
+    X = rng.normal(size=(n_pages * page_rows, F)).astype(np.float32)
+    y = (X[:, 0] - 0.3 * X[:, 2] > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+              "max_bin": 16, "deterministic_histogram": 1}
+    Pages = _paged_iter(X, y, page_rows)
+
+    def make_cfg():
+        def data_fn(smap, rank, world):
+            return Pages(list(smap.shards_of(rank)))
+
+        return xtb.ExtMemConfig(data_fn, num_shards=n_pages, max_bin=16)
+
+    def fn(rank):
+        return bytes(xtb.train(params, make_cfg(), 3,
+                               verbose_eval=False).save_raw())
+
+    single = _run_thread_world(1, fn, "excfg1")[0]
+    got = _run_thread_world(2, fn, "excfg2")
+    assert got[0] == got[1] == single
+
+
+def test_extmem_page_load_fault_surfaces_cleanly():
+    """A mid-stream decode failure at the extmem.page_load seam surfaces
+    as a clean FaultInjected on the consumer — training fails loudly
+    instead of wedging (the multi-process twin runs in
+    scripts/extmem_smoke.py)."""
+    from xgboost_tpu.reliability import faults
+
+    assert "extmem.page_load" in faults.SEAMS
+    n_pages, page_rows = 3, 1024
+    rng = np.random.default_rng(41)
+    X = rng.normal(size=(n_pages * page_rows, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    d = xtb.ExtMemQuantileDMatrix(
+        _paged_iter(X, y, page_rows)(list(range(n_pages))), max_bin=16)
+    faults.install({"faults": [{"site": "extmem.page_load",
+                                "kind": "exception", "round": 1}]})
+    try:
+        with pytest.raises(faults.FaultInjected):
+            xtb.train({"objective": "binary:logistic", "max_depth": 3,
+                       "max_bin": 16}, d, 2, verbose_eval=False)
+    finally:
+        faults.clear()
+    # the matrix is not poisoned: a clean retry trains through
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "max_bin": 16}, d, 2, verbose_eval=False)
+    assert np.isfinite(bst.predict(d)).all()
+
+
+def test_gradient_sampling_page_residency(monkeypatch):
+    """Gradient-based sampling decides page residency: pages whose rows
+    all sampled out (zero gpair) are loaded once per tree instead of once
+    per level, and the routed-once positions leave the model identical to
+    the skip-disabled run."""
+    from xgboost_tpu.data import extmem
+
+    n_pages, page_rows, F = 4, 1024, 5
+    rng = np.random.default_rng(43)
+    X = rng.normal(size=(n_pages * page_rows, F)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    d = xtb.ExtMemQuantileDMatrix(
+        _paged_iter(X, y, page_rows)(list(range(n_pages))), max_bin=16)
+
+    # a custom objective that zeroes the gradient on the last two pages:
+    # gradient_based sampling then assigns them keep-probability 0, so
+    # their gpair is exactly zero and the pages lose residency
+    cut = 2 * page_rows
+
+    def obj(preds, dmat):
+        g = np.asarray(preds, np.float64) - y
+        h = np.ones_like(g)
+        g[cut:] = 0.0
+        h[cut:] = 0.0
+        return g, h
+
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+              "max_bin": 16, "subsample": 0.9,
+              "sampling_method": "gradient_based", "seed": 7}
+    ins = extmem.instruments()
+    monkeypatch.setenv("XTB_EXTMEM_SIM_TRANSFER_MS_PER_MB", "0.001")
+
+    def run(skip: str):
+        before = ins[3].get()
+        bst = xtb.train({**params, "_extmem_page_skip": skip}, d, 2,
+                        verbose_eval=False, obj=obj)
+        return bst, ins[3].get() - before
+
+    bst_skip, loads_skip = run("1")
+    bst_full, loads_full = run("0")
+    # 2 rounds x 4 levels x 4 pages when every page streams every level;
+    # with residency the two zero-gradient pages stream once per tree
+    assert loads_full == 2 * 4 * n_pages, loads_full
+    assert loads_skip == 2 * (4 * 2 + 2), loads_skip
+    assert bst_skip.get_dump() == bst_full.get_dump()
+    p_skip, p_full = bst_skip.predict(d), bst_full.predict(d)
+    np.testing.assert_array_equal(p_skip, p_full)
+
+
+def test_extmem_empty_iterator_raises():
+    class Empty(xtb.DataIter):
+        def reset(self):
+            pass
+
+        def next(self, input_data):
+            return 0
+
+    with pytest.raises(ValueError, match="no batches"):
+        xtb.ExtMemQuantileDMatrix(Empty(), max_bin=16)

@@ -15,7 +15,7 @@ __version__ = "0.1.0"
 from .config import config_context, get_config, set_config
 from .core import Booster
 from .data.dmatrix import DMatrix, MetaInfo, QuantileDMatrix
-from .data.extmem import (DataIter, ExtMemQuantileDMatrix,
+from .data.extmem import (DataIter, ExtMemConfig, ExtMemQuantileDMatrix,
                           SparsePageDMatrix)
 from .data.ellpack import EllpackPage
 from .data.quantile import HistogramCuts
@@ -37,6 +37,7 @@ __all__ = [
     "DMatrix",
     "QuantileDMatrix",
     "DataIter",
+    "ExtMemConfig",
     "ExtMemQuantileDMatrix",
     "SparsePageDMatrix",
     "MetaInfo",

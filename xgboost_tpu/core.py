@@ -703,6 +703,15 @@ class Booster:
             prefetch=str(self.params.get("_extmem_prefetch", "1")).lower()
             in ("1", "true"),
             quantised=self.deterministic_histogram,
+            # gradient-based sampling decides page residency: a page whose
+            # rows all sampled out is loaded once per tree, not per level
+            # ("_extmem_page_skip": 0 keeps every page level-resident — the
+            # measurement/parity baseline, tests/test_extmem.py)
+            page_skip=(self.tparam.subsample < 1.0
+                       and self.tparam.sampling_method == "gradient_based"
+                       and str(self.params.get("_extmem_page_skip",
+                                               "1")).lower()
+                       in ("1", "true")),
         )
         K = gpair.shape[1]
         new_margin = cache.margin

@@ -22,9 +22,52 @@ import numpy as np
 
 from .dmatrix import DMatrix, MetaInfo
 from .ellpack import build_ellpack
-from .quantile import HistogramCuts, cuts_from_quantile_grid
+from .quantile import HistogramCuts, StreamingSketch
 
 PAGE_ALIGN = 1024  # rows; keeps every page a whole number of hist row tiles
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: the xtb_extmem_* family (docs/observability.md catalog).
+# Decode/wait/overlap make the prefetch pipeline's behaviour observable —
+# decode seconds spent off the critical path (overlap) vs blocking the
+# consumer (wait) — and the cache counters say how often a page touch was
+# served without paying the decode again.
+# ---------------------------------------------------------------------------
+_instruments = None
+
+
+def instruments():
+    """(decode_s, wait_s, overlap_s, pages, bytes, hits, misses) counters."""
+    global _instruments
+    if _instruments is None:
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        _instruments = (
+            reg.counter("xtb_extmem_decode_seconds_total",
+                        "seconds decoding/staging external-memory pages "
+                        "(zstd decompress + host->device put), wherever "
+                        "they ran"),
+            reg.counter("xtb_extmem_wait_seconds_total",
+                        "seconds the page consumer blocked waiting for a "
+                        "page to be ready (decode not hidden under "
+                        "compute)"),
+            reg.counter("xtb_extmem_overlap_seconds_total",
+                        "decode seconds hidden under compute: per page, "
+                        "max(0, decode - consumer wait)"),
+            reg.counter("xtb_extmem_pages_loaded_total",
+                        "external-memory pages staged for compute"),
+            reg.counter("xtb_extmem_page_bytes_total",
+                        "bytes of staged (decoded) page data"),
+            reg.counter("xtb_extmem_cache_hits_total",
+                        "page touches served from the host/device page "
+                        "cache"),
+            reg.counter("xtb_extmem_cache_misses_total",
+                        "page touches that paid a decode (or device "
+                        "re-stage)"),
+        )
+    return _instruments
 
 
 class CompressedPage:
@@ -61,9 +104,12 @@ class CompressedPage:
     def __array__(self, dtype=None, copy=None):
         import zstandard as zstd
 
+        hits, misses = instruments()[5:7]
         cached = _host_page_cache_get(self)
         if cached is not None:
+            hits.inc()
             return cached if dtype is None else cached.astype(dtype)
+        misses.inc()
         blob = self._blob
         if blob is None:
             with open(self._path, "rb") as fh:
@@ -90,11 +136,15 @@ class CompressedPage:
 # the pages.  Entries hold no strong reference to the owning page; a
 # weakref finalizer evicts them when the page (and so its DMatrix) dies.
 # ---------------------------------------------------------------------------
+import threading
 import weakref
 from collections import OrderedDict
 
 _PAGE_CACHE: "OrderedDict" = OrderedDict()  # (id(page), kind) -> array
 _PAGE_CACHE_BYTES = 0
+# prefetch worker threads and the consumer touch the cache concurrently;
+# every read/write of the two globals above goes through this lock
+_CACHE_LOCK = threading.Lock()
 
 
 def _host_cache_budget() -> int:
@@ -109,33 +159,37 @@ def _host_cache_budget() -> int:
 
 def _page_cache_evict_page(pid: int) -> None:
     global _PAGE_CACHE_BYTES
-    for kind in ("host", "dev"):
-        arr = _PAGE_CACHE.pop((pid, kind), None)
-        if arr is not None:
-            _PAGE_CACHE_BYTES -= arr.nbytes
+    with _CACHE_LOCK:
+        for kind in ("host", "dev"):
+            arr = _PAGE_CACHE.pop((pid, kind), None)
+            if arr is not None:
+                _PAGE_CACHE_BYTES -= arr.nbytes
 
 
 def _page_cache_get(page, kind: str):
-    hit = _PAGE_CACHE.get((id(page), kind))
-    if hit is not None:
-        _PAGE_CACHE.move_to_end((id(page), kind))
-    return hit
+    with _CACHE_LOCK:
+        hit = _PAGE_CACHE.get((id(page), kind))
+        if hit is not None:
+            _PAGE_CACHE.move_to_end((id(page), kind))
+        return hit
 
 
 def _page_cache_put(page, kind: str, arr) -> None:
     global _PAGE_CACHE_BYTES
     budget = _host_cache_budget()
-    if arr.nbytes > budget or (id(page), kind) in _PAGE_CACHE:
-        return
     try:
-        weakref.finalize(page, _page_cache_evict_page, id(page))
+        finalizer = weakref.finalize(page, _page_cache_evict_page, id(page))
     except TypeError:
         return  # not weakref-able: never cache (no safe eviction)
-    _PAGE_CACHE[(id(page), kind)] = arr
-    _PAGE_CACHE_BYTES += arr.nbytes
-    while _PAGE_CACHE_BYTES > budget and _PAGE_CACHE:
-        _, old = _PAGE_CACHE.popitem(last=False)
-        _PAGE_CACHE_BYTES -= old.nbytes
+    with _CACHE_LOCK:
+        if arr.nbytes > budget or (id(page), kind) in _PAGE_CACHE:
+            finalizer.detach()
+            return
+        _PAGE_CACHE[(id(page), kind)] = arr
+        _PAGE_CACHE_BYTES += arr.nbytes
+        while _PAGE_CACHE_BYTES > budget and _PAGE_CACHE:
+            _, old = _PAGE_CACHE.popitem(last=False)
+            _PAGE_CACHE_BYTES -= old.nbytes
 
 
 def _host_page_cache_get(page):
@@ -150,16 +204,25 @@ def device_page_cache_get_or_put(page, make):
     """CPU-backend committed-page cache (tree/stream.py _put_page): holds
     the jax.Array so the per-level device_put memcpy disappears, under the
     same shared budget as the decompress cache.  Never used on TPU."""
+    hits, misses = instruments()[5:7]
     hit = _page_cache_get(page, "dev")
     if hit is not None:
+        hits.inc()
         return hit
-    arr = make()
-    # the committed array supersedes the decompressed numpy copy — same
-    # bytes on the CPU backend, no reason to hold both
+    # one count per page touch: a compressed page's make() re-enters
+    # __array__, which scores the decode itself (host-cache hit = decode
+    # avoided — the ratio that matters); only uncompressed pages, which
+    # never pass __array__, are scored here
+    if not isinstance(page, CompressedPage):
+        misses.inc()
+    arr = make()  # expensive: decode + device commit, outside the lock
     global _PAGE_CACHE_BYTES
-    host = _PAGE_CACHE.pop((id(page), "host"), None)
-    if host is not None:
-        _PAGE_CACHE_BYTES -= host.nbytes
+    with _CACHE_LOCK:
+        # the committed array supersedes the decompressed numpy copy — same
+        # bytes on the CPU backend, no reason to hold both
+        host = _PAGE_CACHE.pop((id(page), "host"), None)
+        if host is not None:
+            _PAGE_CACHE_BYTES -= host.nbytes
     _page_cache_put(page, "dev", arr)
     return arr
 
@@ -171,6 +234,151 @@ def _zstd_available() -> bool:
         return True
     except ImportError:
         return False
+
+
+# ---------------------------------------------------------------------------
+# Overlapped page scheduler.
+#
+# The reference streams compressed pages under compute with an N-ahead
+# prefetch window (sparse_page_source.h:293 n_prefetch_batches; the
+# out-of-core GPU paper's overlap pipeline, arXiv:2005.09148 §4).  Here a
+# small persistent thread pool decodes (zstd -> numpy) and stages
+# (device_put) pages while the consumer's histogram kernels run, so the
+# decode hides entirely under compute; the consumer blocks only when it
+# outruns the window.  One pool is shared by every scheduler instance —
+# page streaming is level-sequential, so two concurrent windows never
+# compete for more than the window width.
+# ---------------------------------------------------------------------------
+import time
+
+_POOL = None
+_POOL_LOCK = threading.Lock()
+
+
+def _prefetch_pool():
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            import concurrent.futures
+
+            _POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="xtb-extmem-prefetch")
+        return _POOL
+
+
+def prefetch_lookahead(default: int = 2) -> int:
+    """Prefetch window width (pages in flight beyond the one being
+    consumed).  XTB_EXTMEM_PREFETCH_PAGES overrides; 0 disables the pool
+    (synchronous staging)."""
+    import os
+
+    try:
+        n = int(os.environ.get("XTB_EXTMEM_PREFETCH_PAGES", str(default)))
+    except ValueError:
+        n = default
+    return max(n, 0)
+
+
+# Deterministic pipeline-shape probe for tests (XTB_EXTMEM_EVENT_LOG=1):
+# consumers append ("submit"/"wait"/"ready"/"load_sync", page_idx) and
+# ("level", depth) markers in MAIN-THREAD program order, so assertions on
+# it are scheduling-independent (tests/test_extmem.py).
+PAGE_EVENT_LOG: List[tuple] = []
+
+
+def event_log_enabled() -> bool:
+    import os
+
+    return bool(os.environ.get("XTB_EXTMEM_EVENT_LOG"))
+
+
+class PageScheduler:
+    """Stream a page list through the prefetch pool, N ahead.
+
+    ``stage(page) -> staged`` runs on a pool worker (decode + device put);
+    ``get(j)`` (called with strictly increasing ``j``) first submits
+    through ``j + lookahead``, then blocks only until page ``j``'s decode
+    lands.  ``lookahead=0`` stages synchronously in ``get`` — the
+    measurement baseline where decode serializes against compute.
+
+    Telemetry (docs/observability.md): per page, decode seconds are
+    attributed as consumer ``wait`` (not hidden) vs ``overlap`` (hidden
+    under compute); plus pages/bytes staged.  The ``extmem.page_load``
+    fault seam fires before every stage — ``round`` matches the position
+    in the streamed page list — so a mid-stream decode failure surfaces
+    on the consumer as a clean exception (docs/reliability.md).
+    """
+
+    def __init__(self, pages: List[Any], stage: Callable[[Any], Any], *,
+                 lookahead: Optional[int] = None,
+                 events: Optional[List[tuple]] = None) -> None:
+        from .. import collective
+
+        self._pages = pages
+        self._stage = stage
+        self._lookahead = (prefetch_lookahead() if lookahead is None
+                           else max(int(lookahead), 0))
+        self._futures: dict = {}
+        self._events = events
+        self._ins = instruments()
+        self._next = 0
+        # resolve the rank HERE, on the consumer thread: thread-local
+        # collective backends (the in-memory thread harness) are invisible
+        # from the prefetch pool workers, so a lazy get_rank inside _load
+        # would mis-attribute rank-constrained fault plans under prefetch
+        try:
+            self._rank = collective.get_rank()
+        except Exception:  # pragma: no cover - backend mid-teardown
+            self._rank = None
+
+    @property
+    def lookahead(self) -> int:
+        return self._lookahead
+
+    def _record(self, name: str, j: int) -> None:
+        if self._events is not None:
+            self._events.append((name, j))
+
+    def _load(self, j: int):
+        from ..reliability.faults import maybe_inject
+
+        maybe_inject("extmem.page_load", rank=self._rank, round=j)
+        t0 = time.perf_counter()
+        arr = self._stage(self._pages[j])
+        dt = time.perf_counter() - t0
+        self._ins[0].inc(dt)
+        self._ins[3].inc()
+        self._ins[4].inc(float(getattr(arr, "nbytes", 0)))
+        return arr, dt
+
+    def _submit_through(self, j: int) -> None:
+        stop = min(j, len(self._pages) - 1)
+        while self._next <= stop:
+            k = self._next
+            self._record("submit", k)
+            self._futures[k] = _prefetch_pool().submit(self._load, k)
+            self._next += 1
+
+    def get(self, j: int):
+        if self._lookahead <= 0:
+            self._record("load_sync", j)
+            arr, dt = self._load(j)
+            self._ins[1].inc(dt)  # synchronous: the consumer waited it all
+            return arr
+        self._submit_through(j + self._lookahead)
+        self._record("wait", j)
+        t0 = time.perf_counter()
+        arr, decode_s = self._futures.pop(j).result()
+        wait_s = time.perf_counter() - t0
+        self._record("ready", j)
+        self._ins[1].inc(wait_s)
+        self._ins[2].inc(max(0.0, decode_s - wait_s))
+        return arr
+
+    def close(self) -> None:
+        for fut in self._futures.values():
+            fut.cancel()
+        self._futures.clear()
 
 
 class DataIter:
@@ -246,16 +454,15 @@ class ExtMemQuantileDMatrix(DMatrix):
         self._page_rows: List[int] = []  # real rows per page
         self._spill_dir = None if on_host else tempfile.mkdtemp(prefix="xtb_pages_")
 
-        # ---- pass 1: sketch (native streaming GK-style summaries per feature,
-        # the role of WQuantileSketch, src/common/quantile.h:565) ----
-        from ..utils.native import StreamingQuantileSummary
-
-        summaries = None
+        # ---- pass 1: streaming page-wise sketch (data/quantile.py
+        # StreamingSketch — per-page fixed-size grids folded one page at a
+        # time, one ragged summary gather when distributed, so cuts never
+        # require the full matrix resident; the out-of-core role of
+        # WQuantileSketch, src/common/quantile.h:565) ----
+        sketch = None
         labels, weights, margins, n_col = [], [], [], None
         cat_mask = None
-        cat_max = None
         num_row = 0
-        vmin = vmax = None
         for batch in _iterate(data):
             X = np.asarray(batch["data"], dtype=np.float32)
             num_row += X.shape[0]
@@ -264,12 +471,9 @@ class ExtMemQuantileDMatrix(DMatrix):
                 ft = batch.get("feature_types")
                 if ft is not None:
                     cat_mask = np.asarray([t == "c" for t in ft], bool)
-                cat_max = np.zeros(n_col, np.int64)
-                vmin = np.full(n_col, np.inf, np.float32)
-                vmax = np.full(n_col, -np.inf, np.float32)
                 if ref is None:
-                    summaries = [StreamingQuantileSummary(max(8 * max_bin, 512))
-                                 for _ in range(n_col)]
+                    sketch = StreamingSketch(n_col, max_bin,
+                                             cat_mask=cat_mask)
             if "label" in batch and batch["label"] is not None:
                 labels.append(np.asarray(batch["label"], np.float32))
             if batch.get("weight") is not None:
@@ -279,21 +483,7 @@ class ExtMemQuantileDMatrix(DMatrix):
             if ref is None:
                 w_b = (np.asarray(batch["weight"], np.float32)
                        if batch.get("weight") is not None else None)
-                import warnings
-
-                with warnings.catch_warnings():
-                    warnings.simplefilter("ignore", RuntimeWarning)
-                    # fmin/fmax ignore NaN from all-NaN batch columns
-                    vmin = np.fmin(vmin, np.nanmin(X, axis=0))
-                    vmax = np.fmax(vmax, np.nanmax(X, axis=0))
-                for f in range(n_col):
-                    if cat_mask is not None and cat_mask[f]:
-                        col = X[:, f]
-                        col = col[~np.isnan(col)]
-                        if len(col):
-                            cat_max[f] = max(cat_max[f], int(col.max()))
-                    else:
-                        summaries[f].push(X[:, f], w_b)
+                sketch.push(X, weights=w_b)
 
         if ref is not None:
             # GetCutsFromRef: reuse training cuts (quantile_dmatrix.cc:19);
@@ -302,43 +492,19 @@ class ExtMemQuantileDMatrix(DMatrix):
             if cuts is None:
                 cuts = ref.ensure_ellpack(max_bin=max_bin).cuts
         else:
+            if sketch is None:
+                # every rank's DataIter must produce >= 1 batch: n_col is
+                # only learned from the first one, so a zero-batch rank
+                # cannot even join the sketch's summary gather (ExtMemConfig
+                # guarantees this — ShardMap gives every rank a shard;
+                # direct StreamingSketch users can hold zero pages)
+                raise ValueError("DataIter produced no batches")
             from .. import collective
-            from .quantile import _assemble_cuts, merge_quantile_grids
 
-            Q = max(max_bin - 1, 1)
-            qs = np.arange(1, Q + 1, dtype=np.float64) / (Q + 1)
-            grid = np.full((n_col, Q), np.inf, np.float32)
-            nvalid = np.zeros(n_col, np.int64)
-            mass = np.zeros(n_col, np.float64)
-            for f in range(n_col):
-                if cat_mask is not None and cat_mask[f]:
-                    continue  # identity cuts assembled below, from global max
-                if summaries[f].total_weight() > 0:
-                    grid[f] = summaries[f].query(qs)
-                    nvalid[f] = num_row
-                    mass[f] = summaries[f].total_weight()
-            vmin = np.where(np.isfinite(vmin), vmin, 0.0)
-            vmax = np.where(np.isfinite(vmax), vmax, 0.0)
-            if collective.is_distributed():
-                # each process sketched only its DataIter shard: merge the
-                # fixed-size per-shard grids into shared cuts, exactly like
-                # the in-memory distributed path (quantile.cc:397 analogue)
-                base = merge_quantile_grids(
-                    collective.allgather(grid), collective.allgather(nvalid),
-                    collective.allgather(vmax), collective.allgather(vmin),
-                    max_bin, masses=collective.allgather(mass))
-                if cat_max is not None:
-                    cat_max = collective.allreduce(cat_max, collective.Op.MAX)
-            else:
-                base = cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
-            if cat_mask is not None and cat_mask.any():
-                cat_n_cats = {int(f): int(cat_max[f]) + 1
-                              for f in np.nonzero(cat_mask)[0]}
-                cuts = _assemble_cuts(
-                    n_col, max_bin, cat_n_cats,
-                    lambda f: (base.feature_cuts(f), base.min_vals[f]))
-            else:
-                cuts = base
+            # each process sketched only its DataIter shard: the finalize
+            # merges every rank's page grids into shared cuts, exactly like
+            # the in-memory distributed path (quantile.cc:397 analogue)
+            cuts = sketch.finalize(distributed=collective.is_distributed())
         self._cuts = cuts
 
         # metadata container
@@ -572,3 +738,62 @@ class SparsePageDMatrix(ExtMemQuantileDMatrix):
         """Yield each raw page densified (rows_i, F) — bounded memory."""
         for i in range(len(self._raw_pages)):
             yield self._raw_page_dense(i)
+
+
+class ExtMemConfig:
+    """Multi-process out-of-core training config for
+    ``train(params, ExtMemConfig(...))`` (docs/extmem.md).
+
+    Composes the pieces that each work alone into one full-dataset
+    multi-process run: every tracker/relay rank owns a page shard
+    (:class:`~xgboost_tpu.elastic.ShardMap` round-robin over
+    ``num_shards``), builds its :class:`ExtMemQuantileDMatrix` from the
+    :class:`DataIter` returned by ``data_fn``, the streaming page-wise
+    sketch merges cuts in one ragged gather, and the per-level histogram
+    allreduce rides the existing collective (tracker relay on CPU).
+
+    ``data_fn(shard_map, rank, world)`` returns the rank's
+    :class:`DataIter` — one ``input_data(...)`` batch per owned page — or
+    ``(DataIter, evals)`` to supply evaluation sets too.  Launch the ranks
+    with :func:`xgboost_tpu.launcher.run_distributed`; a single process
+    (world 1) works unchanged.
+
+    ``num_shards`` defaults to the world size (one page shard per rank);
+    ``max_bin`` / ``on_host`` / ``compress`` forward to
+    :class:`ExtMemQuantileDMatrix`.
+    """
+
+    def __init__(self, data_fn: Callable[..., Any], *,
+                 num_shards: Optional[int] = None, max_bin: int = 256,
+                 on_host: bool = True, compress: bool = True,
+                 enable_categorical: bool = False) -> None:
+        if not callable(data_fn):
+            raise TypeError("ExtMemConfig.data_fn must be callable")
+        self.data_fn = data_fn
+        self.num_shards = int(num_shards) if num_shards is not None else None
+        self.max_bin = int(max_bin)
+        self.on_host = bool(on_host)
+        self.compress = bool(compress)
+        self.enable_categorical = bool(enable_categorical)
+
+    def build(self):
+        """(dtrain, evals) for this rank — called by ``train()``."""
+        from .. import collective
+        from ..elastic import ShardMap
+
+        rank, world = collective.get_rank(), collective.get_world_size()
+        smap = ShardMap.create(self.num_shards or world, world)
+        built = self.data_fn(smap, rank, world)
+        evals: List[Any] = []
+        if isinstance(built, tuple):
+            built, ev = built
+            evals = list(ev) if ev else []
+        if not isinstance(built, DataIter):
+            raise TypeError(
+                "ExtMemConfig.data_fn must return a DataIter (or a "
+                f"(DataIter, evals) pair); got {type(built).__name__}")
+        dtrain = ExtMemQuantileDMatrix(
+            built, max_bin=self.max_bin, on_host=self.on_host,
+            compress=self.compress,
+            enable_categorical=self.enable_categorical)
+        return dtrain, evals

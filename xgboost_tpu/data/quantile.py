@@ -364,55 +364,171 @@ def merge_quantile_grids(grids: np.ndarray, nvalids: np.ndarray,
     return cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
 
 
+def _pack_contrib(grid: np.ndarray, nvalid: np.ndarray, vmax: np.ndarray,
+                  vmin: np.ndarray, mass: np.ndarray) -> np.ndarray:
+    """One page's sketch contribution as a single (F, Q+4) f64 block, so the
+    whole per-rank summary crosses the collective in ONE ragged gather.
+    f32 grid values and int64 counts round-trip exactly through f64."""
+    F, Q = grid.shape
+    out = np.empty((F, Q + 4), np.float64)
+    out[:, :Q] = grid
+    out[:, Q] = nvalid
+    out[:, Q + 1] = vmax
+    out[:, Q + 2] = vmin
+    out[:, Q + 3] = mass
+    return out
+
+
+class StreamingSketch:
+    """Page-at-a-time (distributed) quantile sketch.
+
+    The out-of-core analogue of :func:`sketch_distributed`: instead of one
+    grid from a materialized shard, every pushed page contributes one
+    fixed-size summary — exactly what :func:`_host_grid` produces for that
+    page — and ``finalize()`` merges ALL page contributions (across every
+    rank when ``distributed=True``) through :func:`merge_quantile_grids`,
+    so cuts never require the full matrix resident.
+
+    Pinned contract (tests/test_extmem.py sketch-parity fuzz): **the page
+    is the atomic sketch unit, and the merge is a pure function of the
+    multiset of page contributions.**  Candidates are value-sorted inside
+    :func:`merge_quantile_grids` and a tied value is selected by value, not
+    position, so the merged cuts are bitwise-identical however the pages
+    are grouped onto ranks (world 1/2/4/...) and in whatever order they
+    are pushed — and equal to the one-shot :func:`sketch_distributed`
+    where each page is one rank's whole shard.  Per-rank memory is
+    O(pages x F x max_bin); the summary allreduce is one ragged gather of
+    the packed page blocks plus (with categoricals) one MAX-allreduce.
+    """
+
+    def __init__(self, n_features: int, max_bin: int,
+                 cat_mask: Optional[np.ndarray] = None) -> None:
+        self.n_features = int(n_features)
+        self.max_bin = int(max_bin)
+        cm = None
+        if cat_mask is not None and np.any(cat_mask):
+            cm = np.asarray(cat_mask, bool)
+            if len(cm) != self.n_features:
+                raise ValueError("cat_mask length != n_features")
+        self.cat_mask = cm
+        self._contribs: List[np.ndarray] = []
+        self._cat_max = np.full(self.n_features, -1.0, np.float32)
+
+    @property
+    def n_cand(self) -> int:
+        return max(self.max_bin - 1, 1)
+
+    @property
+    def n_pages(self) -> int:
+        return len(self._contribs)
+
+    def push(self, X, weights: Optional[np.ndarray] = None) -> None:
+        """Fold one dense (R, F) page (NaN = missing) into the sketch."""
+        Xh = np.asarray(X, dtype=np.float32)
+        if Xh.shape[1] != self.n_features:
+            raise ValueError(
+                f"page has {Xh.shape[1]} features, sketch expects "
+                f"{self.n_features}")
+        w = None if weights is None else np.asarray(weights)
+        if self.cat_mask is None:
+            self._contribs.append(_pack_contrib(*_host_grid(
+                Xh, self.max_bin, w)))
+            return
+        # categorical columns never enter the numeric sort: their cuts come
+        # from the global category max (a nanmax, not a quantile grid), and
+        # their rows in the packed contribution stay the empty-feature
+        # sentinel (inf grid / zero stats) the merge ignores
+        cat = self.cat_mask
+        for f in np.nonzero(cat)[0]:
+            col = Xh[:, f]
+            col = col[~np.isnan(col)]
+            if len(col):
+                self._cat_max[f] = max(self._cat_max[f], col.max())
+        F, Q = self.n_features, self.n_cand
+        grid = np.full((F, Q), np.inf, np.float32)
+        nvalid = np.zeros(F, np.int64)
+        vmax = np.zeros(F, np.float32)
+        vmin = np.zeros(F, np.float32)
+        mass = np.zeros(F, np.float64)
+        num_idx = np.nonzero(~cat)[0]
+        if len(num_idx):
+            g, nv, vx, vn, ms = _host_grid(Xh[:, num_idx], self.max_bin, w)
+            grid[num_idx] = g
+            nvalid[num_idx] = nv
+            vmax[num_idx] = vx
+            vmin[num_idx] = vn
+            mass[num_idx] = ms
+        self._contribs.append(_pack_contrib(grid, nvalid, vmax, vmin, mass))
+
+    def push_csr(self, indptr, indices, values,
+                 weights: Optional[np.ndarray] = None) -> None:
+        """Fold one CSR page (implicit zeros = missing, matching
+        :func:`sketch_csr`) without densifying it."""
+        grid, nvalid, vmax, vmin, mass, cat_local = _csr_grid(
+            np.asarray(indptr), np.asarray(indices), np.asarray(values),
+            self.n_features, self.max_bin,
+            None if weights is None else np.asarray(weights), self.cat_mask)
+        np.maximum(self._cat_max, cat_local, out=self._cat_max)
+        self._contribs.append(_pack_contrib(grid, nvalid, vmax, vmin, mass))
+
+    def finalize(self, distributed: bool = False) -> HistogramCuts:
+        """Merge every page contribution into shared cuts.
+
+        ``distributed=True`` gathers all ranks' packed page blocks in one
+        ragged allgather (every rank computes bitwise-identical cuts); a
+        rank may hold any number of pages, including zero, as long as the
+        job holds at least one page overall — but every rank must CALL
+        finalize (``ExtMemQuantileDMatrix`` additionally requires one
+        batch per rank, since it learns the feature count from it)."""
+        from .. import collective
+
+        F, Q = self.n_features, self.n_cand
+        local = (np.stack(self._contribs) if self._contribs
+                 else np.zeros((0, F, Q + 4), np.float64))
+        cat_max = self._cat_max
+        if distributed:
+            flat = local.reshape(local.shape[0], F * (Q + 4))
+            local = collective.allgather_ragged(flat).reshape(-1, F, Q + 4)
+            if self.cat_mask is not None:
+                cat_max = collective.allreduce(cat_max, collective.Op.MAX)
+        if local.shape[0] == 0:
+            raise ValueError("StreamingSketch.finalize: no pages pushed")
+        base = merge_quantile_grids(
+            local[:, :, :Q].astype(np.float32),
+            local[:, :, Q].astype(np.int64),
+            local[:, :, Q + 1].astype(np.float32),
+            local[:, :, Q + 2].astype(np.float32),
+            self.max_bin, masses=local[:, :, Q + 3])
+        if self.cat_mask is None:
+            return base
+        cat_n_cats = {int(f): (int(cat_max[f]) + 1 if cat_max[f] >= 0 else 1)
+                      for f in np.nonzero(self.cat_mask)[0]}
+        return _assemble_cuts(
+            F, self.max_bin, cat_n_cats,
+            lambda f: (base.feature_cuts(f), base.min_vals[f]))
+
+
 def sketch_distributed(X, max_bin: int, weights: Optional[np.ndarray] = None,
                        cat_mask: Optional[np.ndarray] = None) -> HistogramCuts:
     """Shared cuts across processes, each holding a row shard of X.
 
-    Local fixed-size grid -> collective.allgather -> deterministic merge;
-    categorical features take identity cuts sized by the global category max.
-    """
-    from .. import collective
-
+    One :class:`StreamingSketch` page per rank: local fixed-size grid ->
+    one ragged gather -> deterministic merge; categorical features take
+    identity cuts sized by the global category max."""
     Xh = np.asarray(X, dtype=np.float32)
-    F = Xh.shape[1]
-    if cat_mask is not None and np.any(cat_mask):
-        num_idx = np.nonzero(~np.asarray(cat_mask))[0]
-        base = (sketch_distributed(Xh[:, num_idx], max_bin, weights=weights)
-                if len(num_idx) else None)
-        # global category count via MAX-allreduce of local maxima
-        local_max = np.full(F, -1.0, np.float32)
-        for f in np.nonzero(cat_mask)[0]:
-            col = Xh[:, f]
-            col = col[~np.isnan(col)]
-            if len(col):
-                local_max[f] = col.max()
-        global_max = collective.allreduce(local_max, collective.Op.MAX)
-        cat_n_cats = {int(f): (int(global_max[f]) + 1 if global_max[f] >= 0 else 1)
-                      for f in np.nonzero(cat_mask)[0]}
-        num_pos = {int(f): i for i, f in enumerate(num_idx)}
-        return _assemble_cuts(
-            F, max_bin, cat_n_cats,
-            lambda f: (base.feature_cuts(num_pos[f]), base.min_vals[num_pos[f]]))
-
-    grid, nvalid, vmax, vmin, mass = _host_grid(Xh, max_bin, weights)
-    return merge_quantile_grids(
-        collective.allgather(grid), collective.allgather(nvalid),
-        collective.allgather(vmax), collective.allgather(vmin), max_bin,
-        masses=collective.allgather(mass))
+    sk = StreamingSketch(Xh.shape[1], max_bin, cat_mask=cat_mask)
+    sk.push(Xh, weights=weights)
+    return sk.finalize(distributed=True)
 
 
-def sketch_csr(indptr, indices, values, n_features: int, max_bin: int,
-               weights: Optional[np.ndarray] = None,
-               cat_mask: Optional[np.ndarray] = None,
-               distributed: bool = False) -> HistogramCuts:
-    """Sketch a CSR matrix column-by-column on host (sparse ingest path).
-
-    Implicit zeros in sparse input are treated as missing, matching the
-    reference's sparse DMatrix semantics (only stored entries are sketched,
-    src/common/hist_util.cc SketchOnDMatrix walks nonzeros).
-    ``distributed=True``: this process holds a row shard — the per-feature
-    grids are merged across processes without ever densifying the shard.
-    """
+def _csr_grid(indptr, indices, values, n_features: int, max_bin: int,
+              weights: Optional[np.ndarray],
+              cat_mask: Optional[np.ndarray]):
+    """Per-feature quantile grid + stats of one CSR page — the CSR twin of
+    :func:`_host_grid`, shared by :func:`sketch_csr` and
+    :meth:`StreamingSketch.push_csr`.  Returns (grid, nvalid, vmax, vmin,
+    mass, cat_local_max); categorical columns are excluded from the
+    numeric grid (nvalid stays 0) and report their max code instead."""
     R = len(indptr) - 1
     n_cand = max(max_bin - 1, 1)
     grid = np.full((n_features, n_cand), np.inf, dtype=np.float32)
@@ -457,20 +573,33 @@ def sketch_csr(indptr, indices, values, n_features: int, max_bin: int,
             mass[f] = cdf[-1]
             idx = np.searchsorted(cdf, qs * cdf[-1], side="left")
             grid[f] = sv[np.clip(idx, 0, len(sv) - 1)].astype(np.float32)
-    if distributed:
-        from .. import collective
+    return grid, nvalid, vmax, vmin, mass, cat_local_max
 
-        base = merge_quantile_grids(
-            collective.allgather(grid), collective.allgather(nvalid),
-            collective.allgather(vmax), collective.allgather(vmin), max_bin,
-            masses=collective.allgather(mass))
-        cat_global_max = collective.allreduce(cat_local_max, collective.Op.MAX)
-    else:
-        base = cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
-        cat_global_max = cat_local_max
+
+def sketch_csr(indptr, indices, values, n_features: int, max_bin: int,
+               weights: Optional[np.ndarray] = None,
+               cat_mask: Optional[np.ndarray] = None,
+               distributed: bool = False) -> HistogramCuts:
+    """Sketch a CSR matrix column-by-column on host (sparse ingest path).
+
+    Implicit zeros in sparse input are treated as missing, matching the
+    reference's sparse DMatrix semantics (only stored entries are sketched,
+    src/common/hist_util.cc SketchOnDMatrix walks nonzeros).
+    ``distributed=True``: this process holds a row shard — one
+    :class:`StreamingSketch` page per rank, merged across processes
+    without ever densifying the shard.
+    """
+    if distributed:
+        sk = StreamingSketch(n_features, max_bin, cat_mask=cat_mask)
+        sk.push_csr(indptr, indices, values, weights=weights)
+        return sk.finalize(distributed=True)
+    grid, nvalid, vmax, vmin, _mass, cat_local_max = _csr_grid(
+        indptr, indices, values, n_features, max_bin, weights, cat_mask)
+    base = cuts_from_quantile_grid(grid, nvalid, vmax, vmin)
+    is_cat = np.zeros(n_features, bool) if cat_mask is None else np.asarray(cat_mask)
     if not is_cat.any():
         return base
-    cat_n_cats = {int(f): (int(cat_global_max[f]) + 1 if cat_global_max[f] >= 0 else 1)
+    cat_n_cats = {int(f): (int(cat_local_max[f]) + 1 if cat_local_max[f] >= 0 else 1)
                   for f in np.nonzero(is_cat)[0]}
     return _assemble_cuts(
         n_features, max_bin, cat_n_cats,

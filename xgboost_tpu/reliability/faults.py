@@ -70,6 +70,7 @@ SEAMS = frozenset({
     "native.parallel_for",
     "lifecycle.validate",
     "lifecycle.swap",
+    "extmem.page_load",
 })
 
 # Debug guard: with XGBOOST_TPU_STRICT_SEAMS=1, maybe_inject() rejects

@@ -14,6 +14,7 @@ import numpy as np
 from .callback import CallbackContainer, EarlyStopping, EvaluationMonitor, TrainingCallback
 from .core import Booster
 from .data.dmatrix import DMatrix
+from .data.extmem import ExtMemConfig
 from .elastic import ElasticConfig, RegroupRequired, ShardMap
 
 __all__ = ["train", "cv"]
@@ -157,6 +158,14 @@ def train(
     directory falls through to a normal start, so the same command line
     works for launch and relaunch (docs/reliability.md).
 
+    ``dtrain`` may also be an
+    :class:`~xgboost_tpu.data.extmem.ExtMemConfig`: this rank then builds
+    an out-of-core :class:`~xgboost_tpu.data.extmem.ExtMemQuantileDMatrix`
+    over its page shard (``ShardMap`` round-robin), with cuts merged by
+    the streaming page-wise sketch and per-level histograms allreduced
+    across ranks — the launcher-composed full-scale path
+    (docs/extmem.md).
+
     ``elastic``: an :class:`~xgboost_tpu.elastic.ElasticConfig` makes the
     run survive worker loss at reduced world size and absorb replacement
     workers at round boundaries.  ``dtrain`` may then be omitted — the
@@ -169,6 +178,20 @@ def train(
     training."""
     callbacks = list(callbacks) if callbacks else []
     evals = list(evals) if evals else []
+    if isinstance(dtrain, ExtMemConfig):
+        # out-of-core multi-process composition (docs/extmem.md): this
+        # rank builds its page shard's ExtMemQuantileDMatrix — streaming
+        # sketch merge and per-level histogram allreduce happen inside the
+        # normal distributed paths once the DMatrix is paged
+        if elastic is not None:
+            raise ValueError(
+                "train(ExtMemConfig, elastic=...) is not supported: "
+                "elastic re-sharding rebuilds data through "
+                "ElasticConfig.data_fn — return the paged DMatrix there "
+                "instead")
+        dtrain, extmem_evals = dtrain.build()
+        if not evals:
+            evals = extmem_evals
     if dtrain is None and elastic is None:
         raise TypeError("train() needs dtrain (or an elastic config whose "
                         "data_fn builds it)")

@@ -129,7 +129,7 @@ class StreamingHistTreeGrower:
                  interaction_sets=None, max_leaves: int = 0,
                  lossguide: bool = False, mesh=None,
                  distributed: bool = False, prefetch: bool = True,
-                 quantised: bool = False) -> None:
+                 quantised: bool = False, page_skip: bool = False) -> None:
         self.max_depth = max_depth
         self.params = params
         self.interaction_sets = interaction_sets
@@ -153,6 +153,13 @@ class StreamingHistTreeGrower:
         # chip psum and the cross-process reduce are exact integer sums, so
         # external-memory training is bit-identical on any topology too
         self.quantised = quantised
+        # gradient-based sampling decides page residency (arXiv:2005.09148
+        # §5): a page whose every row was sampled out (zero gpair) is
+        # skipped by all D per-level passes and routed ONCE at the end —
+        # page traffic per tree drops from D loads to 1 for sampled-out
+        # pages.  Enabled by core.py only under
+        # sampling_method=gradient_based (docs/extmem.md).
+        self.page_skip = page_skip
         self.max_nodes = max_nodes_for_depth(max_depth)
 
     def _put_page(self, page_np):
@@ -205,6 +212,27 @@ class StreamingHistTreeGrower:
             max_splits=(self.max_leaves - 1) if self.max_leaves > 0 else 0,
             n_bin=B,
         )
+        n_pages = len(pages)
+        # ---- page residency (gradient-based sampling, arXiv:2005.09148):
+        # pages whose every row carries zero gpair (sampled out) leave the
+        # per-level streaming entirely; their positions are routed once at
+        # the end so margin updates stay exact.  Decided on the RAW gpair
+        # (before limb quantisation).  At least one page stays resident so
+        # a fully-sampled-out rank still joins every per-level allreduce.
+        stream_idx = list(range(n_pages))
+        skipped_idx: List[int] = []
+        if self.page_skip and n_pages > 1:
+            row_mass = jnp.sum(jnp.abs(gpair),
+                               axis=tuple(range(1, gpair.ndim)))
+            page_ids = jnp.asarray(np.repeat(
+                np.arange(n_pages), np.diff(np.asarray(page_offsets))))
+            pmass = np.asarray(jax.ops.segment_sum(
+                row_mass, page_ids, num_segments=n_pages))
+            active = pmass > 0.0
+            if not active.any():
+                active[0] = True
+            stream_idx = [i for i in range(n_pages) if active[i]]
+            skipped_idx = [i for i in range(n_pages) if not active[i]]
         rho = None
         if self.quantised:
             from ..ops.quantise import prepare_quantised
@@ -215,9 +243,13 @@ class StreamingHistTreeGrower:
             from .grow import sync_root_totals
 
             state = sync_root_totals(state)
+        from ..data import extmem as _extmem
+
+        events = (_extmem.PAGE_EVENT_LOG if _extmem.event_log_enabled()
+                  else None)
         prev_best, prev_can, prev_d = None, None, -1
         hist_prev = None
-        n_pages = len(pages)
+        decisions = []  # (best, can, depth) per split level, for the replay
         for d in range(self.max_depth + 1):
             build = d < self.max_depth  # last level only finalizes leaves
             subtract = build and d > 0 and hist_prev is not None
@@ -225,36 +257,46 @@ class StreamingHistTreeGrower:
             N = 1 << d
             n_build = (N // 2) if subtract else N
             hist_acc = None
-            # prefetch pipeline: page i's compute is DISPATCHED (async jit)
-            # before page i+1 is decompressed/shipped, so the host-side
-            # decompress of compressed pages overlaps device compute
-            next_dev = self._put_page(pages[0]) if n_pages else None
+            # prefetch pipeline (data/extmem.py PageScheduler): pages
+            # decode/stage on the shared worker pool N ahead of the
+            # consumer, so the host-side decompress of page j+1..j+N
+            # overlaps page j's (async-dispatched) device compute
+            if events is not None:
+                events.append(("level", d))
+            sched = _extmem.PageScheduler(
+                [pages[i] for i in stream_idx], self._put_page,
+                lookahead=None if self.prefetch else 0, events=events)
             pos = state.pos
-            for i in range(n_pages):
-                dev = next_dev
-                lo, hi = page_offsets[i], page_offsets[i + 1]
-                seg_len = hi - lo
-                pos_seg = lax.dynamic_slice_in_dim(pos, lo, seg_len)
-                gp_seg = lax.dynamic_slice_in_dim(gpair, lo, seg_len)
-                pos_seg, h = _page_step(
-                    dev, gp_seg, pos_seg, prev_best, prev_can,
-                    node0_prev=(1 << prev_d) - 1 if prev_d >= 0 else 0,
-                    n_prev=1 << max(prev_d, 0), node0=node0, n_nodes=n_build,
-                    n_bin=B, has_prev=prev_best is not None, has_cat=has_cat,
-                    build=build, stride=2 if subtract else 1,
-                    quantised=self.quantised,
-                )
-                if i + 1 < n_pages:
-                    if not self.prefetch:
-                        # serialize: page i's compute must finish before
-                        # page i+1's host decompress starts (pos_seg too —
+            try:
+                for j, i in enumerate(stream_idx):
+                    dev = sched.get(j)
+                    lo, hi = page_offsets[i], page_offsets[i + 1]
+                    seg_len = hi - lo
+                    pos_seg = lax.dynamic_slice_in_dim(pos, lo, seg_len)
+                    gp_seg = lax.dynamic_slice_in_dim(gpair, lo, seg_len)
+                    pos_seg, h = _page_step(
+                        dev, gp_seg, pos_seg, prev_best, prev_can,
+                        node0_prev=(1 << prev_d) - 1 if prev_d >= 0 else 0,
+                        n_prev=1 << max(prev_d, 0), node0=node0,
+                        n_nodes=n_build, n_bin=B,
+                        has_prev=prev_best is not None, has_cat=has_cat,
+                        build=build, stride=2 if subtract else 1,
+                        quantised=self.quantised,
+                    )
+                    if not self.prefetch and j + 1 < len(stream_idx):
+                        # serialize: page j's compute must finish before
+                        # page j+1's host decompress starts (pos_seg too —
                         # on the last level h is a constant dummy while the
                         # position routing still runs)
                         jax.block_until_ready((pos_seg, h))
-                    next_dev = self._put_page(pages[i + 1])
-                pos = lax.dynamic_update_slice_in_dim(pos, pos_seg, lo, axis=0)
-                if build:
-                    hist_acc = h if hist_acc is None else hist_acc + h
+                    pos = lax.dynamic_update_slice_in_dim(pos, pos_seg, lo,
+                                                          axis=0)
+                    if build:
+                        hist_acc = h if hist_acc is None else hist_acc + h
+            finally:
+                # on an abort (fault-injected decode, compute error) the
+                # not-yet-started prefetch futures must not keep loading
+                sched.close()
             state = state._replace(pos=pos)
             fm = ones if feature_masks is None else feature_masks(d, N)
             if hist_acc is not None and self.distributed:
@@ -289,7 +331,48 @@ class StreamingHistTreeGrower:
                 depth=d, params=self.params, lossguide=self.lossguide,
                 last_level=(d == self.max_depth),
             )
+            if best is not None:
+                decisions.append((best, can, d))
             prev_best, prev_can, prev_d = best, can, d
+        if skipped_idx:
+            state = self._route_skipped(state, pages, page_offsets, gpair,
+                                        skipped_idx, decisions, B, has_cat,
+                                        events)
+        return state
+
+    def _route_skipped(self, state, pages, page_offsets, gpair, skipped_idx,
+                       decisions, B, has_cat, events):
+        """One final pass over the sampled-out pages: replay every level's
+        split decisions so their rows' positions (and so their leaf margin
+        updates) are identical to a run that streamed them every level —
+        D page loads collapse to 1 for pages sampling removed."""
+        if events is not None:
+            events.append(("route_skipped", len(skipped_idx)))
+        from ..data import extmem as _extmem
+
+        sched = _extmem.PageScheduler(
+            [pages[i] for i in skipped_idx], self._put_page,
+            lookahead=None if self.prefetch else 0, events=events)
+        pos = state.pos
+        try:
+            for j, i in enumerate(skipped_idx):
+                dev = sched.get(j)
+                lo, hi = page_offsets[i], page_offsets[i + 1]
+                seg_len = hi - lo
+                pos_seg = lax.dynamic_slice_in_dim(pos, lo, seg_len)
+                gp_seg = lax.dynamic_slice_in_dim(gpair, lo, seg_len)
+                for best, can, d in decisions:
+                    pos_seg, _ = _page_step(
+                        dev, gp_seg, pos_seg, best, can,
+                        node0_prev=(1 << d) - 1, n_prev=1 << d, node0=0,
+                        n_nodes=1, n_bin=B, has_prev=True, has_cat=has_cat,
+                        build=False, quantised=self.quantised,
+                    )
+                pos = lax.dynamic_update_slice_in_dim(pos, pos_seg, lo,
+                                                      axis=0)
+        finally:
+            sched.close()
+        state = state._replace(pos=pos)
         return state
 
     @staticmethod

@@ -564,7 +564,11 @@ class StreamingQuantileSummary:
     """Per-feature streaming weighted quantile summary (GK-style merge-prune).
 
     Native-backed when available; numpy fallback keeps semantics identical.
-    Used by the external-memory sketcher to merge batches without holding data.
+    The external-memory sketcher now uses the page-wise
+    ``data/quantile.py StreamingSketch`` (its merge is the bitwise-pinned
+    distributed contract, docs/extmem.md); this remains the public
+    bounded-memory single-column summary API (native kernel +
+    tests/test_native_threads.py) for callers that cannot batch a page.
     """
 
     def __init__(self, budget: int = 2048):
