@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Composed-fault chaos soak (docs/reliability.md "Integrity & chaos").
+
+Nightly entry point for ``xgboost_tpu.reliability.chaos``: run seeded
+multi-fault episodes round-robin across the scenario templates under a
+wall-clock budget, check every invariant (no hang, no silent wrong bits,
+fault accounting, no dropped requests, flight dump per death), finish
+with a replay of the first episode's seed (schedule AND outcome must be
+bit-for-bit identical), and write the full report to
+``bench_out/CHAOS_SOAK.json``.  Exit 0 only when every episode is green
+and the replay matched.
+
+Usage::
+
+    python scripts/chaos_soak.py --budget-s 120 --seed $NIGHTLY_SEED
+    python scripts/chaos_soak.py --replay extmem 123456   # one red episode
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="composed-fault chaos soak")
+    ap.add_argument("--budget-s", type=float, default=120.0,
+                    help="soak wall-clock budget in seconds")
+    ap.add_argument("--seed", type=int, default=20260804,
+                    help="master seed (episode seeds derive from it)")
+    ap.add_argument("--min-episodes", type=int, default=20,
+                    help="minimum episodes even if the budget runs dry "
+                         "(cheap scenarios fill the tail)")
+    ap.add_argument("--scenarios", default="",
+                    help="comma-separated scenario subset (default: all)")
+    ap.add_argument("--out", default="bench_out/CHAOS_SOAK.json")
+    ap.add_argument("--replay", nargs=2, metavar=("SCENARIO", "SEED"),
+                    help="replay ONE episode by (scenario, seed) and "
+                         "print its report — the red-episode repro path")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from xgboost_tpu.reliability import chaos
+
+    if args.replay:
+        scenario, seed = args.replay[0], int(args.replay[1])
+        rep = chaos.run_episode(scenario, seed)
+        print(json.dumps(rep.to_json(), indent=1))
+        print(f"[chaos] replay {scenario}/{seed}: "
+              f"{'GREEN' if rep.ok else 'RED'} in {rep.seconds:.1f}s")
+        return 0 if rep.ok else 1
+
+    scenarios = ([s for s in args.scenarios.split(",") if s]
+                 if args.scenarios else None)
+    report = chaos.soak(args.seed, budget_s=args.budget_s,
+                        min_episodes=args.min_episodes,
+                        scenarios=scenarios)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=1)
+    for ep in report["episodes"]:
+        status = "green" if ep["ok"] else "RED"
+        bad = {k: v for k, v in ep["invariants"].items() if v != "ok"}
+        print(f"[chaos] {ep['scenario']:<10} seed={ep['seed']:<12} "
+              f"{status:<5} {ep['seconds']:6.1f}s "
+              f"faults={len(ep['plan']['faults'])}"
+              + (f"  {bad}" if bad else ""))
+    rp = report["replay"]
+    if rp is not None:
+        print(f"[chaos] replay {rp['scenario']}/{rp['seed']}: schedule "
+              f"{'==' if rp['schedule_identical'] else '!='} outcome "
+              f"{'==' if rp['outcome_identical'] else '!='}")
+    print(f"[chaos] {report['green']} green / {report['red']} red in "
+          f"{report['wall_s']:.1f}s (budget {args.budget_s}s, "
+          f"{report['downgraded']} budget downgrades) -> {args.out}")
+    if not report["ok"]:
+        for ep in report["episodes"]:
+            if not ep["ok"]:
+                print(f"[chaos] repro: python scripts/chaos_soak.py "
+                      f"--replay {ep['scenario']} {ep['seed']}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,6 +100,16 @@ JAX_PLATFORMS=cpu python scripts/fleet_smoke.py 3 120
 # with interleaved legs; fails beyond BENCH_OBS_MAX_PCT (default 5%)
 JAX_PLATFORMS=cpu python scripts/bench_obs.py bench_out/BENCH_OBS.json
 
+# composed-fault chaos soak (docs/reliability.md "Integrity & chaos"):
+# >= 20 seeded multi-fault episodes round-robin across the four scenario
+# templates (extmem / fleet / lifecycle / elastic), each checked for
+# no-hang, bitwise-vs-twin, fault accounting, zero dropped requests, and
+# a flight dump per death; the run ends by replaying episode 0's seed and
+# requiring the identical schedule and outcome.  Any red episode prints
+# its one-command repro (--replay <scenario> <seed>).
+JAX_PLATFORMS=cpu python scripts/chaos_soak.py --budget-s 120 \
+    --seed "${NIGHTLY_SEED:-20260804}"
+
 # online-lifecycle smoke (docs/serving.md "Online model lifecycle"):
 # serve -> continuation-train on fresh rows -> gate -> hot-swap under
 # sustained traffic (zero dropped requests, post-swap bitwise-stable,

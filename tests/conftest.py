@@ -50,6 +50,7 @@ _QUICK_FILES = {
     "test_serving_faults.py", "test_reliability_multiprocess.py",
     "test_analysis.py", "test_native_threads.py", "test_elastic.py",
     "test_lifecycle.py", "test_updaters_process.py", "test_extmem.py",
+    "test_integrity.py", "test_chaos.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)

@@ -27,6 +27,65 @@ from .quantile import HistogramCuts, StreamingSketch
 PAGE_ALIGN = 1024  # rows; keeps every page a whole number of hist row tiles
 
 
+class PageCorruptError(RuntimeError):
+    """An external-memory page failed its integrity check at decode and a
+    one-shot re-read from the backing store failed too.  Raised instead of
+    ever handing corrupted bins to the histogram kernels; in a
+    multi-process run the worker dies loudly on it and the tracker abort
+    fan-out stops the peers (docs/reliability.md "Integrity & chaos")."""
+
+
+def _page_crc(arr: np.ndarray) -> int:
+    import zlib
+
+    return zlib.crc32(np.ascontiguousarray(arr))
+
+
+def _retry_pause() -> None:
+    """Deterministic pause before a page's one-shot re-read: its own
+    (op, seed) RNG stream, so interleaving with any other backoff user
+    cannot perturb either schedule (pinned by tests/test_integrity.py)."""
+    from ..reliability import integrity as _integrity
+    from ..reliability.retry import backoff_delays
+
+    _integrity.retried("page")
+    time.sleep(next(backoff_delays(1, base=0.005, max_delay=0.05,
+                                   op="integrity.page", seed=0)))
+
+
+def _verify_decoded(out, crc: int, *, what: str, attempt: int):
+    """One decode attempt's integrity gate, shared by the compressed and
+    disk page paths.  ``out`` is the decoded payload — bytes, or a
+    C-contiguous ndarray verified in place (no copy on the no-fault
+    path).  Applies the ``extmem.page_decode`` fault seam (``corrupt``
+    flips a byte in a copy — the deterministic stand-in for a bit flip
+    during decompress/read; ``exception`` raises), then verifies the page
+    CRC recorded at construction.  Returns the verified payload, or None
+    when this attempt failed verification (the caller retries once from
+    the backing store, then fails loud)."""
+    import zlib
+
+    from ..reliability import integrity as _integrity
+    from ..reliability.faults import corrupt_bytes, maybe_inject
+
+    spec = maybe_inject("extmem.page_decode", round=attempt)
+    if spec is not None and spec.kind == "corrupt":
+        if isinstance(out, np.ndarray):
+            out = memoryview(np.ascontiguousarray(out)).cast("B")
+        out = corrupt_bytes(out, spec)
+    buf = out if isinstance(out, (bytes, bytearray)) \
+        else np.ascontiguousarray(out)
+    if zlib.crc32(buf) == crc:
+        return out
+    _integrity.corrupt_detected("page")
+    if attempt == 0:
+        _retry_pause()
+        return None
+    raise PageCorruptError(
+        f"{what}: page CRC mismatch after decode AND after one re-read "
+        "from the backing store — refusing to train on corrupted bins")
+
+
 # ---------------------------------------------------------------------------
 # Telemetry: the xtb_extmem_* family (docs/observability.md catalog).
 # Decode/wait/overlap make the prefetch pipeline's behaviour observable —
@@ -84,7 +143,7 @@ class CompressedPage:
 
     # __weakref__ so the page cache can hang its eviction finalizer here
     __slots__ = ("shape", "dtype", "_blob", "_path", "nbytes_compressed",
-                 "__weakref__")
+                 "crc", "__weakref__")
 
     def __init__(self, arr: np.ndarray, path: Optional[str] = None):
         import zstandard as zstd
@@ -94,6 +153,10 @@ class CompressedPage:
         self.shape = raw.shape
         self.dtype = raw.dtype
         self.nbytes_compressed = len(blob)
+        # CRC over the UNCOMPRESSED bytes, verified after every decompress:
+        # catches blob damage zstd happens to decode anyway AND decode-side
+        # corruption, one check for both (docs/reliability.md)
+        self.crc = _page_crc(raw)
         if path is not None:
             with open(path, "wb") as fh:
                 fh.write(blob)
@@ -101,22 +164,111 @@ class CompressedPage:
         else:
             self._blob, self._path = blob, None
 
-    def __array__(self, dtype=None, copy=None):
+    def _decompress(self) -> bytes:
+        """One decode attempt: (re-)read the blob, decompress.  A blob zstd
+        itself rejects (truncated / framing damage) is a detected
+        corruption, reported like a CRC miss."""
         import zstandard as zstd
 
+        from ..reliability import integrity as _integrity
+
+        blob = self._blob
+        if blob is None:
+            with open(self._path, "rb") as fh:
+                blob = fh.read()
+        try:
+            return zstd.ZstdDecompressor().decompress(blob)
+        except zstd.ZstdError as e:
+            _integrity.corrupt_detected("page")
+            raise PageCorruptError(
+                f"page blob undecodable ({e}); truncated or bit-flipped "
+                "compressed stream") from e
+
+    def __array__(self, dtype=None, copy=None):
         hits, misses = instruments()[5:7]
         cached = _host_page_cache_get(self)
         if cached is not None:
             hits.inc()
             return cached if dtype is None else cached.astype(dtype)
         misses.inc()
-        blob = self._blob
-        if blob is None:
-            with open(self._path, "rb") as fh:
-                blob = fh.read()
-        out = np.frombuffer(
-            zstd.ZstdDecompressor().decompress(blob), dtype=self.dtype
-        ).reshape(self.shape)
+        raw = None
+        for attempt in (0, 1):
+            try:
+                decoded = self._decompress()
+            except PageCorruptError:
+                # a zstd-rejected blob gets the same retry-once-from-the-
+                # backing-store contract a CRC miss gets (a transient
+                # in-memory flip in the framing heals on re-read)
+                if attempt == 0:
+                    _retry_pause()
+                    continue
+                raise
+            raw = _verify_decoded(decoded, self.crc,
+                                  what=f"compressed page {self._path or ''}",
+                                  attempt=attempt)
+            if raw is not None:
+                break
+        out = np.frombuffer(raw, dtype=self.dtype).reshape(self.shape)
+        _host_page_cache_put(self, out)
+        return out if dtype is None else out.astype(dtype)
+
+
+class DiskPage:
+    """Uncompressed page spilled to a ``.npy`` file (the no-zstandard
+    ``on_host=False`` fallback), wrapped so every disk read passes the
+    same CRC-verify / retry-once / fail-loud gate the compressed decode
+    does — disk is a failure surface whether or not the bytes were
+    entropy-coded.  Same consumer contract as :class:`CompressedPage`:
+    ``shape`` / ``dtype`` / ``__array__`` only."""
+
+    __slots__ = ("shape", "dtype", "_path", "crc", "__weakref__")
+
+    def __init__(self, arr: np.ndarray, path: str):
+        raw = np.ascontiguousarray(arr)
+        self.shape = raw.shape
+        self.dtype = raw.dtype
+        self.crc = _page_crc(raw)
+        mm = np.lib.format.open_memmap(path, mode="w+", dtype=raw.dtype,
+                                       shape=raw.shape)
+        mm[:] = raw
+        mm.flush()
+        del mm
+        self._path = path
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
+
+    def __array__(self, dtype=None, copy=None):
+        hits, misses = instruments()[5:7]
+        cached = _host_page_cache_get(self)
+        if cached is not None:
+            hits.inc()
+            return cached if dtype is None else cached.astype(dtype)
+        misses.inc()
+        raw = None
+        for attempt in (0, 1):
+            try:
+                arr = np.load(self._path)
+            except (ValueError, OSError) as e:
+                from ..reliability import integrity as _integrity
+
+                _integrity.corrupt_detected("page")
+                if attempt == 0:  # same retry-once contract as a CRC miss
+                    _retry_pause()
+                    continue
+                raise PageCorruptError(
+                    f"disk page {self._path} unreadable ({e}); damaged "
+                    "npy header or truncated file") from e
+            # verified IN PLACE over the loaded array's buffer — no
+            # per-decode tobytes copy on the no-fault hot path
+            raw = _verify_decoded(arr, self.crc,
+                                  what=f"disk page {self._path}",
+                                  attempt=attempt)
+            if raw is not None:
+                break
+        out = (raw if isinstance(raw, np.ndarray)
+               else np.frombuffer(raw, dtype=self.dtype).reshape(self.shape))
         _host_page_cache_put(self, out)
         return out if dtype is None else out.astype(dtype)
 
@@ -209,11 +361,11 @@ def device_page_cache_get_or_put(page, make):
     if hit is not None:
         hits.inc()
         return hit
-    # one count per page touch: a compressed page's make() re-enters
+    # one count per page touch: a compressed/disk page's make() re-enters
     # __array__, which scores the decode itself (host-cache hit = decode
-    # avoided — the ratio that matters); only uncompressed pages, which
-    # never pass __array__, are scored here
-    if not isinstance(page, CompressedPage):
+    # avoided — the ratio that matters); only in-RAM uncompressed pages,
+    # which never pass __array__, are scored here
+    if not isinstance(page, (CompressedPage, DiskPage)):
         misses.inc()
     arr = make()  # expensive: decode + device commit, outside the lock
     global _PAGE_CACHE_BYTES
@@ -448,8 +600,8 @@ class ExtMemQuantileDMatrix(DMatrix):
                           "will be stored uncompressed")
             compress = False
         self.compress = compress
-        # plain ndarrays (or memmaps) when compress=False, CompressedPage
-        # otherwise — consumers only use shape/dtype/__array__
+        # plain ndarrays (compress=False, on_host) / DiskPage (spilled) /
+        # CompressedPage — consumers only use shape/dtype/__array__
         self._pages: List[Any] = []
         self._page_rows: List[int] = []  # real rows per page
         self._spill_dir = None if on_host else tempfile.mkdtemp(prefix="xtb_pages_")
@@ -535,13 +687,10 @@ class ExtMemQuantileDMatrix(DMatrix):
                         if not on_host else None)
                 host_page = CompressedPage(host_page, path=path)
             elif not on_host:
-                path = f"{self._spill_dir}/page{bi}.npy"
-                mm = np.lib.format.open_memmap(
-                    path, mode="w+", dtype=host_page.dtype, shape=host_page.shape
-                )
-                mm[:] = host_page
-                mm.flush()
-                host_page = np.lib.format.open_memmap(path, mode="r")
+                # DiskPage instead of a bare read-mode memmap: every
+                # re-read from the spill file passes the CRC gate
+                host_page = DiskPage(host_page,
+                                     f"{self._spill_dir}/page{bi}.npy")
             self._pages.append(host_page)
             self._page_rows.append(X.shape[0])
         import jax.numpy as jnp
@@ -695,14 +844,9 @@ class SparsePageDMatrix(ExtMemQuantileDMatrix):
                             f"{spill}/p{i}_{tag}.zst")
                     return CompressedPage(arr, path)
                 if spill is not None:
-                    # on_host=False without zstd: memmap spill, same
-                    # fallback the binned pages use
-                    path = f"{spill}/p{i}_{tag}.npy"
-                    mm = np.lib.format.open_memmap(
-                        path, mode="w+", dtype=arr.dtype, shape=arr.shape)
-                    mm[:] = arr
-                    mm.flush()
-                    return np.lib.format.open_memmap(path, mode="r")
+                    # on_host=False without zstd: CRC-gated disk spill,
+                    # same fallback the binned pages use
+                    return DiskPage(arr, f"{spill}/p{i}_{tag}.npy")
                 return arr
 
             raw_pages.append((_store(csr.indptr.astype(np.int64), "ip"),

@@ -15,20 +15,27 @@ the three pieces that make that survivable:
   ``xtb_retries_total``.
 - **Fault injection** (faults.py): a deterministic, env/config-driven
   plan (kill rank k at round r, drop the tracker connection, delay or fail
-  an allreduce, truncate a checkpoint) fired at named seams in training,
-  the collective, the tracker, and the serving batcher — the harness the
-  kill/resume and abort fan-out tests drive.  Fired faults count into
-  ``xtb_faults_injected_total``.
+  an allreduce, truncate a checkpoint, flip a byte in a payload) fired at
+  named seams in training, the collective, the tracker, and the serving
+  batcher — the harness the kill/resume and abort fan-out tests drive.
+  Fired faults count into ``xtb_faults_injected_total``.
+- **Integrity accounting** (integrity.py): the ``xtb_integrity_*``
+  counters behind every checksum boundary — wire frames, tracker
+  messages, extmem pages, model arenas, checkpoints (docs/reliability.md
+  "Integrity & chaos").
+- **Chaos soak** (chaos.py): seeded multi-fault schedules composed over
+  the seam catalog, run through scenario templates with checked
+  invariants and bit-for-bit replay (``scripts/chaos_soak.py``).
 
 docs/reliability.md is the guide (checkpoint format, resume semantics,
 fault-plan schema, serving degradation behavior).
 """
 from __future__ import annotations
 
-from . import faults
+from . import faults, integrity
 from .checkpoint import (CheckpointCallback, CheckpointManager,
-                         CheckpointState, latest_checkpoint)
-from .faults import FaultInjected, FaultPlan, FaultSpec
+                         CheckpointState, latest_checkpoint, scrub_dir)
+from .faults import FaultInjected, FaultPlan, FaultSpec, corrupt_bytes
 from .retry import RetriesExhausted, backoff_delays, retry_call
 
 __all__ = [
@@ -36,10 +43,13 @@ __all__ = [
     "CheckpointManager",
     "CheckpointState",
     "latest_checkpoint",
+    "scrub_dir",
     "FaultInjected",
     "FaultPlan",
     "FaultSpec",
+    "corrupt_bytes",
     "faults",
+    "integrity",
     "RetriesExhausted",
     "backoff_delays",
     "retry_call",

@@ -1,0 +1,700 @@
+"""Composed-fault chaos soak: seeded schedules, scenario templates,
+checked invariants, one-command replay.
+
+Every fault test before this module fires exactly ONE fault at a known
+seam.  Production failure is *coincidence*: a slow decode while a replica
+connection drops while a checkpoint write tears.  This harness closes the
+gap (docs/reliability.md "Integrity & chaos"):
+
+- :func:`generate_plan` — a **pure function of (scenario, seed)** that
+  composes N faults from the scenario's seam/kind catalog into one
+  :class:`~xgboost_tpu.reliability.faults.FaultPlan` dict.  Same seed →
+  same schedule, byte for byte; there is no other source of randomness.
+- **Scenario templates** (:data:`SCENARIOS`) — an external-memory
+  training run, a serving fleet under traffic, a lifecycle hot-swap
+  cycle, and a multi-process elastic training run; each knows which
+  (seam, kind) pairs its stack must *survive* (a green episode means the
+  faults fired AND the contract held — nothing in a catalog is allowed
+  to be fatal).
+- :func:`run_episode` — install the plan, run the scenario under a
+  wall-clock deadline, then check the invariants:
+
+  1. **no hang**: the episode finished before its deadline;
+  2. **no silent wrong bits**: where the determinism contract applies
+     (``twin=True``) the episode's result digest is bitwise-equal to a
+     fault-free twin run of the same scenario;
+  3. **accounting**: the ``xtb_faults_injected_total`` delta equals the
+     plan's own fired ledger (the harness, not an unrelated bug, caused
+     every observed fault) — both measured in the driver process;
+  4. scenario invariants: no dropped fleet requests, a flight-recorder
+     dump for every replica death, checkpoint scrub counts matching the
+     fired damage, a lifecycle reject for every reject-class fault.
+
+- :func:`soak` — round-robin episodes across scenarios under a budget,
+  guaranteeing a minimum episode count (cheap scenarios fill the tail
+  when the budget runs dry), then **replays the first episode's seed**
+  and requires the identical schedule and outcome — so ANY red episode
+  in a soak report is a one-command repro:
+  ``python scripts/chaos_soak.py --replay <scenario> <seed>``.
+
+Kill-kind faults appear only in catalogs whose seams fire inside
+launcher-spawned worker/replica subprocesses — a kill at a driver-side
+seam would take the harness down with it (``os._exit``), which is why the
+lifecycle catalog injects ``exception`` at ``lifecycle.swap`` here and
+leaves the kill-mid-swap replay to ``scripts/lifecycle_smoke.py``'s
+subprocess rig.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import random
+import tempfile
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import faults
+
+__all__ = ["CatalogEntry", "Scenario", "SCENARIOS", "EpisodeReport",
+           "generate_plan", "run_episode", "soak"]
+
+
+_instruments = None
+
+
+def _ins():
+    global _instruments
+    if _instruments is None:
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        _instruments = (
+            reg.counter("xtb_chaos_episodes_total",
+                        "chaos episodes run, by scenario and outcome",
+                        ("scenario", "outcome")),
+            reg.histogram("xtb_chaos_episode_seconds",
+                          "wall-clock per chaos episode", ("scenario",)),
+        )
+    return _instruments
+
+
+def _counter_total(name: str) -> float:
+    """Sum of a counter family across all label sets (0 when the family
+    was never registered)."""
+    from ..telemetry.registry import get_registry
+
+    fam = get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return sum(child.value for _values, child in fam.collect())
+
+
+def _digest(*parts) -> str:
+    h = hashlib.sha256()
+    for p in parts:
+        if isinstance(p, str):
+            p = p.encode()
+        h.update(bytes(p))
+        h.update(b"|")
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# schedule generation
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class CatalogEntry:
+    """One injectable (seam, kind) with sampled parameters.  ``params``
+    values are sampled per entry draw: a list is a uniform choice, an
+    ``(int, int)`` tuple a ``randrange``, a ``(float, float)`` tuple a
+    rounded ``uniform``.  ``post`` (optional, pure) patches the sampled
+    spec for coupled fields (e.g. elastic kills pin ``at`` to ``round``)."""
+
+    site: str
+    kind: str
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    post: Optional[Callable[[dict], dict]] = None
+
+
+def _sample_entry(entry: CatalogEntry, rng: random.Random) -> dict:
+    spec: dict = {"site": entry.site, "kind": entry.kind}
+    for key, rng_spec in entry.params.items():
+        if isinstance(rng_spec, list):
+            spec[key] = rng.choice(rng_spec)
+        elif isinstance(rng_spec[0], float):
+            spec[key] = round(rng.uniform(rng_spec[0], rng_spec[1]), 4)
+        else:
+            spec[key] = rng.randrange(rng_spec[0], rng_spec[1])
+    if entry.post is not None:
+        spec = entry.post(spec)
+    return spec
+
+
+def generate_plan(scenario: str, seed: int,
+                  n_faults: Optional[int] = None) -> dict:
+    """The seeded schedule: a fault-plan dict composing ``n_faults``
+    (default 2–4, seed-chosen) entries from the scenario's catalog.  Pure
+    in (scenario, seed, n_faults) — the replay guarantee rests here."""
+    sc = SCENARIOS[scenario]
+    rng = random.Random((zlib.crc32(scenario.encode()) << 32)
+                        ^ (int(seed) * 0x9E3779B1))
+    n = int(n_faults) if n_faults is not None else rng.randint(2, 4)
+    n = max(1, min(n, sc.max_faults))
+    specs = [_sample_entry(sc.catalog[rng.randrange(len(sc.catalog))], rng)
+             for _ in range(n)]
+    if sc.per_plan_caps:
+        seen: Dict[Tuple[str, str], int] = {}
+        kept = []
+        for spec in specs:
+            key = (spec["site"], spec["kind"])
+            seen[key] = seen.get(key, 0) + 1
+            if seen[key] <= sc.per_plan_caps.get(key, n):
+                kept.append(spec)
+        specs = kept
+    return {"faults": specs}
+
+
+# ---------------------------------------------------------------------------
+# scenario templates
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    catalog: Tuple[CatalogEntry, ...]
+    run: Callable[[str], dict]        # workdir -> artifacts (must contain
+    #                                   "digest" when twin=True)
+    check: Callable[[List[tuple], dict, Optional[dict]], Dict[str, str]]
+    twin: bool = True                 # compare digest vs a fault-free run
+    cost_hint_s: float = 5.0
+    deadline_s: float = 120.0
+    # cap on composed faults per episode: the fleet's reroute budget
+    # survives 3 severed connections per request, not unbounded chains
+    max_faults: int = 4
+    # per-(site, kind) caps applied AFTER sampling (deterministic drop of
+    # the extras): some faults compose into a strictly stronger fault —
+    # two transient page corruptions can land on a decode AND its retry
+    # (the prefetch pool interleaves invocation numbering), which IS a
+    # persistent corruption and correctly fails loud
+    per_plan_caps: Dict[Tuple[str, str], int] = dataclasses.field(
+        default_factory=dict)
+
+
+def _no_checks(fired, artifacts, baseline) -> Dict[str, str]:
+    return {}
+
+
+# ------------------------------------------------------------------ extmem
+def _extmem_data():
+    import numpy as np
+
+    rng = np.random.default_rng(20260804)
+    Xs = [rng.standard_normal((600, 8)).astype(np.float32)
+          for _ in range(3)]
+    ys = [(X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32) for X in Xs]
+    return Xs, ys
+
+
+def _run_extmem(workdir: str) -> dict:
+    import numpy as np
+
+    import xgboost_tpu as xtb
+    from ..data.extmem import _zstd_available
+    from .checkpoint import CheckpointCallback, latest_checkpoint, scrub_dir
+
+    Xs, ys = _extmem_data()
+
+    class _Iter(xtb.DataIter):
+        def __init__(self):
+            super().__init__()
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= len(Xs):
+                return 0
+            input_data(data=Xs[self.i], label=ys[self.i])
+            self.i += 1
+            return 1
+
+        def reset(self):
+            self.i = 0
+
+    # on_host=False puts every page behind a decode boundary (zstd blob or
+    # CRC-gated DiskPage spill), which is where extmem.page_decode fires
+    d = xtb.ExtMemQuantileDMatrix(_Iter(), max_bin=32, on_host=False,
+                                  compress=_zstd_available())
+    ckpt = os.path.join(workdir, "ckpt")
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "max_bin": 32, "eta": 0.3}, d, 6,
+                    callbacks=[CheckpointCallback(ckpt, interval=2)],
+                    verbose_eval=False)
+    scrub = scrub_dir(ckpt)
+    state = latest_checkpoint(ckpt)
+    preds = np.asarray(bst.predict(d), np.float64)
+    return {"digest": _digest(bytes(bst.serialize()), preds.tobytes()),
+            "ckpt_valid": len(scrub["valid"]),
+            "ckpt_corrupt": len(scrub["corrupt"]),
+            "resumable": state is not None}
+
+
+def _check_extmem(fired, artifacts, baseline) -> Dict[str, str]:
+    inv = {}
+    ckpt_hits = sum(n for spec, n in fired
+                    if spec.site == "checkpoint.write")
+    total = artifacts["ckpt_valid"] + artifacts["ckpt_corrupt"]
+    inv["ckpt_scrub_matches_plan"] = (
+        "ok" if artifacts["ckpt_corrupt"] == ckpt_hits
+        else f"FAIL: scrub found {artifacts['ckpt_corrupt']} corrupt "
+             f"checkpoints, plan damaged {ckpt_hits}")
+    inv["ckpt_population"] = ("ok" if total == 3
+                              else f"FAIL: {total} checkpoint files != 3")
+    inv["resume_fallback"] = (
+        "ok" if artifacts["resumable"] == (artifacts["ckpt_valid"] > 0)
+        else "FAIL: latest_checkpoint disagrees with the scrub walk")
+    return inv
+
+
+# ------------------------------------------------------------------- fleet
+_FLEET_FIXTURE: dict = {}
+
+
+def _fleet_fixture():
+    """One tiny booster + request rows + expected predictions, built once
+    per process (the in-process twin every fleet episode compares
+    against)."""
+    if not _FLEET_FIXTURE:
+        import numpy as np
+
+        import xgboost_tpu as xtb
+
+        rng = np.random.default_rng(7)
+        X = rng.standard_normal((400, 6)).astype(np.float32)
+        y = (X[:, 0] - X[:, 2] > 0).astype(np.float32)
+        bst = xtb.train({"objective": "binary:logistic", "max_depth": 3},
+                        xtb.DMatrix(X, label=y), 5, verbose_eval=False)
+        Q = rng.standard_normal((64, 6)).astype(np.float32)
+        _FLEET_FIXTURE.update(bst=bst, Q=Q)
+    return _FLEET_FIXTURE["bst"], _FLEET_FIXTURE["Q"]
+
+
+_N_FLEET_REQ = 24
+
+
+def _run_fleet(workdir: str) -> dict:
+    import numpy as np
+
+    from ..serving.fleet import FleetConfig, ServingFleet
+
+    bst, Q = _fleet_fixture()
+    cfg = FleetConfig(n_replicas=2, max_respawns=8, nthread_per_replica=1,
+                      cache_dir=os.path.join(
+                          tempfile.gettempdir(), "xtb_chaos_warm"))
+    outs: List[bytes] = []
+    with ServingFleet({"m": bst}, cfg) as fleet:
+        for i in range(_N_FLEET_REQ):
+            rows = Q[(i * 5) % 48: (i * 5) % 48 + 16]
+            # predict() raising = a dropped request = a red episode
+            outs.append(np.ascontiguousarray(
+                fleet.predict("m", rows, timeout=180), np.float32
+            ).tobytes())
+        deaths = len(fleet.flight_dumps)
+        dumps = len([p for p in fleet.flight_dumps.values()
+                     if os.path.exists(p)])
+    return {"digest": _digest(*outs), "completed": len(outs),
+            "deaths": deaths, "dumps": dumps}
+
+
+def _check_fleet(fired, artifacts, baseline) -> Dict[str, str]:
+    inv = {}
+    severed = sum(n for spec, n in fired
+                  if (spec.site == "fleet.dispatch"
+                      and spec.kind == "drop_connection")
+                  or (spec.site == "wire.frame" and spec.kind == "corrupt"))
+    inv["no_dropped_requests"] = (
+        "ok" if artifacts["completed"] == _N_FLEET_REQ
+        else f"FAIL: {artifacts['completed']}/{_N_FLEET_REQ} completed")
+    inv["deaths_match_severed"] = (
+        "ok" if artifacts["deaths"] == severed
+        else f"FAIL: {artifacts['deaths']} replica deaths, plan severed "
+             f"{severed} connections")
+    inv["flight_dump_per_death"] = (
+        "ok" if artifacts["dumps"] == artifacts["deaths"]
+        else f"FAIL: {artifacts['dumps']} flight dumps for "
+             f"{artifacts['deaths']} deaths")
+    return inv
+
+
+# --------------------------------------------------------------- lifecycle
+def _run_lifecycle(workdir: str) -> dict:
+    import numpy as np
+
+    import xgboost_tpu as xtb
+    from ..lifecycle import GateConfig, LifecycleConfig, LifecycleManager
+    from ..serving.fleet import FleetConfig, ServingFleet
+    from ..serving.modelstore import ModelStore
+
+    bst, Q = _fleet_fixture()
+    rng = np.random.default_rng(11)
+    X2 = rng.standard_normal((300, 6)).astype(np.float32)
+    y2 = (X2[:, 0] - X2[:, 2] > 0).astype(np.float32)
+    cfg = FleetConfig(n_replicas=1, max_respawns=2, nthread_per_replica=1,
+                      cache_dir=os.path.join(
+                          tempfile.gettempdir(), "xtb_chaos_warm"))
+    with ServingFleet({"m": bst}, cfg) as fleet:
+        mgr = LifecycleManager(
+            fleet, "m", config=LifecycleConfig(
+                rounds_per_cycle=2,
+                gate=GateConfig(min_improvement=-1e9)))
+        report = mgr.run_cycle((X2, y2))
+        served = np.ascontiguousarray(
+            fleet.predict("m", Q, timeout=180), np.float32)
+        active = fleet.active_version("m")
+        expected = ModelStore(fleet.store_dir).booster("m", active).predict(
+            xtb.DMatrix(Q))
+    reason = "accepted" if report.swapped else report.decision.reason
+    return {"digest": _digest(served.tobytes(), reason),
+            "swapped": bool(report.swapped), "reason": reason,
+            "serving_matches_active": bool(
+                np.array_equal(served, np.asarray(expected, np.float32)))}
+
+
+def _check_lifecycle(fired, artifacts, baseline) -> Dict[str, str]:
+    inv = {}
+    rejecting = sum(
+        n for spec, n in fired
+        if (spec.site in ("lifecycle.validate", "lifecycle.swap")
+            and spec.kind == "exception")
+        or (spec.site == "modelstore.publish" and spec.kind == "corrupt"))
+    inv["serving_is_active_version"] = (
+        "ok" if artifacts["serving_matches_active"]
+        else "FAIL: fleet serves bytes that are not the active version's")
+    if rejecting:
+        inv["reject_fault_rejects"] = (
+            "ok" if not artifacts["swapped"]
+            else "FAIL: a reject-class fault fired but the swap went "
+                 "through")
+    else:
+        inv["clean_cycle_swaps"] = (
+            "ok" if artifacts["swapped"]
+            else f"FAIL: no reject-class fault fired yet the cycle was "
+                 f"rejected ({artifacts['reason']})")
+    return inv
+
+
+# ----------------------------------------------------------------- elastic
+def _elastic_chaos_worker(rank, world, *, ckpt_dir, out_path, rounds,
+                          num_shards):
+    import numpy as np
+
+    import xgboost_tpu as xtb
+    from .. import collective as coll
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1200, 5)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+
+    def data_fn(smap, rank, world):
+        rows = np.sort(np.concatenate(
+            [np.arange(s, len(X), smap.num_shards)
+             for s in smap.shards_of(rank)]))
+        return xtb.DMatrix(X[rows], label=y[rows])
+
+    cfg = xtb.ElasticConfig(data_fn, ckpt_dir, num_shards=num_shards)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "eta": 0.3, "max_bin": 32}, None, rounds, elastic=cfg,
+                    verbose_eval=False)
+    if coll.get_rank() == 0 and out_path:
+        with open(out_path, "wb") as fh:
+            fh.write(bytes(bst.save_raw()))
+
+
+def _run_elastic(workdir: str) -> dict:
+    import functools
+
+    from ..launcher import run_distributed
+    from .checkpoint import latest_checkpoint
+
+    ckpt = os.path.join(workdir, "ck")
+    out = os.path.join(workdir, "model.ubj")
+    # the plan reaches the WORKERS via the launcher's env passthrough;
+    # driver-side it fires nothing (the accounting invariant holds at 0)
+    plan = faults.active()
+    plan_json = (json.dumps({"faults": [dataclasses.asdict(s)
+                                        for s in plan.specs]})
+                 if plan is not None else None)
+    run_distributed(
+        functools.partial(_elastic_chaos_worker, ckpt_dir=ckpt,
+                          out_path=out, rounds=6, num_shards=4),
+        num_workers=2, platform="cpu", timeout=300, rendezvous="tracker",
+        elastic=True, fault_plan=plan_json, max_respawns=0)
+    st = latest_checkpoint(ckpt)
+    with open(out, "rb") as fh:
+        model = fh.read()
+    return {"digest": _digest(model), "round": st.round if st else -1,
+            "world": st.world if st else -1, "model_bytes": len(model)}
+
+
+def _check_elastic(fired, artifacts, baseline) -> Dict[str, str]:
+    inv = {}
+    inv["finished_all_rounds"] = (
+        "ok" if artifacts["round"] == 6
+        else f"FAIL: finished at round {artifacts['round']}, wanted 6")
+    inv["model_written"] = ("ok" if artifacts["model_bytes"] > 0
+                            else "FAIL: rank 0 wrote no model")
+    return inv
+
+
+def _pin_kill_at(spec: dict) -> dict:
+    # a {rank, round} kill re-fires when a survivor inherits the rank and
+    # redoes the round (docs/reliability.md, the elastic sharp edge):
+    # pin `at` to the round so it fires exactly once per process
+    spec["at"] = spec["round"]
+    return spec
+
+
+SCENARIOS: Dict[str, Scenario] = {
+    "extmem": Scenario(
+        name="extmem",
+        catalog=(
+            CatalogEntry("extmem.page_decode", "corrupt", {"at": (0, 3)}),
+            CatalogEntry("extmem.page_load", "delay",
+                         {"seconds": (0.001, 0.02), "at": (0, 6)}),
+            CatalogEntry("checkpoint.write", "truncate",
+                         {"round": [2, 4, 6]}),
+            CatalogEntry("checkpoint.write", "corrupt",
+                         {"round": [2, 4, 6]}),
+            CatalogEntry("train.round", "delay",
+                         {"seconds": (0.001, 0.01), "round": (0, 6)}),
+        ),
+        run=_run_extmem, check=_check_extmem, twin=True,
+        cost_hint_s=4.0, deadline_s=120.0,
+        per_plan_caps={("extmem.page_decode", "corrupt"): 1}),
+    "fleet": Scenario(
+        name="fleet",
+        catalog=(
+            CatalogEntry("fleet.dispatch", "drop_connection",
+                         {"at": (0, 20)}),
+            CatalogEntry("fleet.dispatch", "delay",
+                         {"seconds": (0.001, 0.05), "at": (0, 20)}),
+            CatalogEntry("wire.frame", "corrupt", {"at": (0, 20)}),
+        ),
+        run=_run_fleet, check=_check_fleet, twin=True,
+        cost_hint_s=25.0, deadline_s=300.0, max_faults=3),
+    "lifecycle": Scenario(
+        name="lifecycle",
+        catalog=(
+            CatalogEntry("lifecycle.validate", "exception", {}),
+            CatalogEntry("lifecycle.swap", "exception", {}),
+            # at=1: the SECOND publish in the episode is the cycle's
+            # candidate (the first is fleet bringup publishing the
+            # incumbent, whose corruption is the attach-gate's test, not
+            # this scenario's — a refused incumbent fails bringup loudly)
+            CatalogEntry("modelstore.publish", "corrupt", {"at": [1]}),
+            CatalogEntry("lifecycle.validate", "delay",
+                         {"seconds": (0.001, 0.05)}),
+            CatalogEntry("fleet.dispatch", "delay",
+                         {"seconds": (0.001, 0.03), "at": (0, 3)}),
+        ),
+        run=_run_lifecycle, check=_check_lifecycle, twin=False,
+        cost_hint_s=25.0, deadline_s=300.0),
+    "elastic": Scenario(
+        name="elastic",
+        catalog=(
+            CatalogEntry("train.round", "kill",
+                         {"rank": [1], "round": [2, 3]}, post=_pin_kill_at),
+            CatalogEntry("train.round", "delay",
+                         {"seconds": (0.001, 0.02), "rank": [0],
+                          "round": (0, 5)}),
+            CatalogEntry("collective.allreduce", "delay",
+                         {"seconds": (0.001, 0.01), "at": (0, 30)}),
+        ),
+        run=_run_elastic, check=_check_elastic, twin=False,
+        cost_hint_s=45.0, deadline_s=300.0),
+}
+
+
+# ---------------------------------------------------------------------------
+# episode runner + soak driver
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class EpisodeReport:
+    scenario: str
+    seed: int
+    plan: dict
+    ok: bool
+    hung: bool
+    seconds: float
+    invariants: Dict[str, str]
+    artifacts: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    error: str = ""
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @property
+    def repro(self) -> str:
+        return (f"python scripts/chaos_soak.py --replay {self.scenario} "
+                f"{self.seed}")
+
+
+_BASELINES: Dict[str, dict] = {}
+
+
+def _baseline(sc: Scenario) -> Optional[dict]:
+    """The fault-free twin: the SAME runner with no plan installed, once
+    per scenario per process."""
+    if not sc.twin:
+        return None
+    if sc.name not in _BASELINES:
+        assert faults.active() is None, \
+            "baseline must run with no fault plan installed"
+        with tempfile.TemporaryDirectory(prefix="xtb_chaos_base_") as wd:
+            _BASELINES[sc.name] = sc.run(wd)
+    return _BASELINES[sc.name]
+
+
+def run_episode(scenario: str, seed: int, *,
+                n_faults: Optional[int] = None,
+                deadline_s: Optional[float] = None,
+                plan: Optional[dict] = None) -> EpisodeReport:
+    """One composed-fault episode: generate the seeded plan, run the
+    scenario under the deadline, check every invariant.  Replayable by
+    construction — see the module docstring.  ``plan`` overrides the
+    seeded schedule (hand-written repros; the seed then only labels the
+    report)."""
+    sc = SCENARIOS[scenario]
+    deadline = float(deadline_s if deadline_s is not None
+                     else sc.deadline_s)
+    plan_dict = plan if plan is not None \
+        else generate_plan(scenario, seed, n_faults)
+    baseline = _baseline(sc)  # before the plan installs: twin is fault-free
+
+    counted_before = _counter_total("xtb_faults_injected_total")
+    plan = faults.install(json.loads(json.dumps(plan_dict)))
+    outcome: Dict[str, Any] = {}
+    t0 = time.monotonic()
+    body = threading.Thread(
+        target=lambda: outcome.update(_safe_run(sc)), daemon=True,
+        name=f"xtb-chaos-{scenario}-{seed}")
+    body.start()
+    body.join(deadline)
+    hung = body.is_alive()
+    seconds = time.monotonic() - t0
+    fired = plan.fired()
+    fired_specs = plan.fired_by_spec()
+    faults.clear()
+    counted_delta = _counter_total("xtb_faults_injected_total") \
+        - counted_before
+
+    invariants: Dict[str, str] = {}
+    invariants["no_hang"] = (
+        "ok" if not hung
+        else f"FAIL: episode still running after {deadline}s deadline")
+    error = str(outcome.get("error", ""))
+    invariants["completed"] = (
+        "ok" if not error and not hung
+        else f"FAIL: {error or 'deadline'}")
+    invariants["fault_accounting"] = (
+        "ok" if counted_delta == fired
+        else f"FAIL: xtb_faults_injected_total moved {counted_delta}, "
+             f"plan fired {fired}")
+    artifacts = outcome.get("artifacts") or {}
+    if sc.twin and baseline is not None and not error and not hung:
+        invariants["bitwise_vs_twin"] = (
+            "ok" if artifacts.get("digest") == baseline.get("digest")
+            else "FAIL: result digest differs from the fault-free twin")
+    if artifacts and not hung:
+        invariants.update(sc.check(fired_specs, artifacts, baseline))
+    ok = all(v == "ok" for v in invariants.values())
+    episodes, ep_seconds = _ins()
+    episodes.labels(scenario, "green" if ok else "red").inc()
+    ep_seconds.labels(scenario).observe(seconds)
+    return EpisodeReport(scenario=scenario, seed=int(seed), plan=plan_dict,
+                         ok=ok, hung=hung, seconds=seconds,
+                         invariants=invariants, artifacts=artifacts,
+                         error=error)
+
+
+def _safe_run(sc: Scenario) -> dict:
+    with tempfile.TemporaryDirectory(prefix="xtb_chaos_") as wd:
+        try:
+            return {"artifacts": sc.run(wd)}
+        except BaseException as e:  # red episode, not a dead soak
+            return {"error": f"{type(e).__name__}: {e}"}
+
+
+def soak(master_seed: int, *, budget_s: float = 120.0,
+         min_episodes: int = 20,
+         scenarios: Optional[List[str]] = None,
+         replay_check: bool = True) -> Dict[str, Any]:
+    """Round-robin episodes across ``scenarios`` until the budget is spent
+    AND at least ``min_episodes`` ran; when the remaining budget cannot
+    afford the next scenario in the rotation, the cheapest one fills the
+    tail (never silently: the report carries a ``downgraded`` count).
+    Ends with a replay of the first episode's seed, requiring an
+    identical schedule and outcome — the determinism half of the chaos
+    contract, checked on every soak, not just in tests."""
+    names = list(scenarios or SCENARIOS)
+    for n in names:
+        if n not in SCENARIOS:
+            raise ValueError(f"unknown chaos scenario {n!r}; "
+                             f"known: {sorted(SCENARIOS)}")
+    cheapest = min(names, key=lambda n: SCENARIOS[n].cost_hint_s)
+    reports: List[EpisodeReport] = []
+    downgraded = 0
+    t0 = time.monotonic()
+    i = 0
+    while True:
+        elapsed = time.monotonic() - t0
+        if len(reports) >= min_episodes and elapsed >= budget_s:
+            break
+        pick = names[i % len(names)]
+        if (SCENARIOS[pick].cost_hint_s > budget_s - elapsed
+                and pick != cheapest):
+            if len(reports) >= min_episodes:
+                # the rotation's next scenario no longer fits and the
+                # floor is met: stop, rather than spinning the remaining
+                # budget away on the cheapest scenario
+                break
+            pick = cheapest
+            downgraded += 1
+        seed = (int(master_seed) * 1000003 + i) & 0x7FFFFFFF
+        rep = run_episode(pick, seed)
+        reports.append(rep)
+        if rep.hung:
+            break  # the stuck thread cannot be reclaimed: stop, report red
+        i += 1
+    replay = None
+    if replay_check and reports and not reports[0].hung:
+        first = reports[0]
+        again = run_episode(first.scenario, first.seed)
+        replay = {
+            "scenario": first.scenario, "seed": first.seed,
+            "schedule_identical": again.plan == first.plan,
+            "outcome_identical": (
+                again.ok == first.ok
+                and again.artifacts.get("digest")
+                == first.artifacts.get("digest")),
+        }
+        reports.append(again)
+    green = sum(1 for r in reports if r.ok)
+    return {
+        "master_seed": int(master_seed),
+        "budget_s": budget_s,
+        "episodes": [r.to_json() for r in reports],
+        "green": green,
+        "red": len(reports) - green,
+        "downgraded": downgraded,
+        "replay": replay,
+        "ok": (green == len(reports)
+               and (replay is None
+                    or (replay["schedule_identical"]
+                        and replay["outcome_identical"]))),
+        "wall_s": time.monotonic() - t0,
+    }

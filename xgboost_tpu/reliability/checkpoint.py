@@ -43,7 +43,7 @@ from ..callback import TrainingCallback
 from . import faults
 
 __all__ = ["CheckpointManager", "CheckpointCallback", "CheckpointState",
-           "latest_checkpoint", "collect_callback_state",
+           "latest_checkpoint", "scrub_dir", "collect_callback_state",
            "restore_callback_state"]
 
 _MAGIC = b"XTBCKPT1"
@@ -168,14 +168,18 @@ class CheckpointManager:
                 os.fsync(fh.fileno())
             # fault seam: a torn write — the file commits under its final
             # name but the tail never hit the disk (what a crash between
-            # write() and fsync() can leave on weaker filesystems); the
-            # checksum makes load_latest skip it
+            # write() and fsync() can leave on weaker filesystems); or a
+            # bit flip between encode and disk (``corrupt``).  The
+            # trailing SHA-256 makes load_latest skip either.
             spec = faults.maybe_inject("checkpoint.write", round=state.round)
             if spec is not None and spec.kind == "truncate":
                 keep = (spec.keep_bytes if spec.keep_bytes is not None
                         else len(blob) // 2)
                 with open(tmp, "r+b") as fh:
                     fh.truncate(max(int(keep), 0))
+            elif spec is not None and spec.kind == "corrupt":
+                with open(tmp, "wb") as fh:
+                    fh.write(faults.corrupt_bytes(blob, spec))
             os.replace(tmp, final)
         except BaseException:
             try:
@@ -248,6 +252,34 @@ def latest_checkpoint(directory: str) -> Optional[CheckpointState]:
     if not os.path.isdir(directory):
         return None
     return CheckpointManager(directory).load_latest()
+
+
+def scrub_dir(directory: str) -> Dict[str, List[str]]:
+    """Proactive checkpoint-directory scrub: run every ``.xtbckpt`` file
+    through the same XTBCKPT magic/structure/SHA-256 walk ``load_latest``
+    uses (one decoder — a format change cannot make the scrubber and the
+    loader disagree).  Returns ``{"valid": [paths], "corrupt": [paths]}``;
+    corrupt files count into ``xtb_checkpoint_corrupt_total`` AND
+    ``xtb_integrity_corrupt_total{boundary="checkpoint"}``, the pass into
+    ``xtb_integrity_scrub_total{target="checkpoint"}``.  Read-only: a
+    corrupt file is *reported*, not deleted — keep-last-K pruning and the
+    load-time fallback already bound its blast radius."""
+    from . import integrity as _integrity
+
+    valid: List[str] = []
+    corrupt: List[str] = []
+    for path in CheckpointManager(directory).files():
+        try:
+            with open(path, "rb") as fh:
+                _decode(fh.read(), path=path)
+            valid.append(path)
+        except (OSError, ValueError, KeyError, TypeError,
+                json.JSONDecodeError, struct.error, UnicodeDecodeError):
+            corrupt.append(path)
+            _ins()[2].inc()
+            _integrity.corrupt_detected("checkpoint")
+    _integrity.scrubbed("checkpoint")
+    return {"valid": valid, "corrupt": corrupt}
 
 
 # ---------------------------------------------------------------------------

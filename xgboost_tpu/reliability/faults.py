@@ -20,6 +20,14 @@ Kinds:
 - ``drop_connection`` / ``truncate`` — returned to the caller, which owns
   the resource being damaged (the tracker client closes its socket, the
   checkpoint writer truncates the file).
+- ``corrupt``    — returned to the caller, which flips bytes in the
+  payload it owns (:func:`corrupt_bytes`): a wire frame, an extmem page
+  decode, a model arena, a checkpoint file.  The flip is a deterministic
+  function of (spec, payload length) — ``offset`` (default: the middle
+  byte) XORed with ``xor_mask`` — so a corruption episode replays
+  bit-for-bit.  The integrity layer (docs/reliability.md "Integrity &
+  chaos") must *detect* every one: checksum-verify, quarantine or retry,
+  never decode garbage.
 
 Plans install programmatically (``install(...)``) or through the
 ``XGBOOST_TPU_FAULT_PLAN`` environment variable — either inline JSON or a
@@ -46,7 +54,8 @@ import time
 from typing import Any, Dict, List, Optional, Union
 
 __all__ = ["FaultInjected", "FaultSpec", "FaultPlan", "install", "clear",
-           "active", "maybe_inject", "ENV_VAR", "SEAMS", "STRICT_ENV"]
+           "active", "maybe_inject", "corrupt_bytes", "ENV_VAR", "SEAMS",
+           "STRICT_ENV"]
 
 ENV_VAR = "XGBOOST_TPU_FAULT_PLAN"
 
@@ -64,6 +73,7 @@ SEAMS = frozenset({
     "tracker.connect",
     "tracker.connected",
     "tracker.regroup",
+    "tracker.message",
     "checkpoint.write",
     "serve.worker",
     "fleet.dispatch",
@@ -71,6 +81,9 @@ SEAMS = frozenset({
     "lifecycle.validate",
     "lifecycle.swap",
     "extmem.page_load",
+    "extmem.page_decode",
+    "wire.frame",
+    "modelstore.publish",
 })
 
 # Debug guard: with XGBOOST_TPU_STRICT_SEAMS=1, maybe_inject() rejects
@@ -80,7 +93,8 @@ SEAMS = frozenset({
 STRICT_ENV = "XGBOOST_TPU_STRICT_SEAMS"
 _STRICT: Optional[bool] = None
 
-_KINDS = ("kill", "exception", "delay", "drop_connection", "truncate")
+_KINDS = ("kill", "exception", "delay", "drop_connection", "truncate",
+          "corrupt")
 
 
 def _strict() -> bool:
@@ -120,6 +134,8 @@ class FaultSpec:
     seconds: float = 0.0             # delay duration
     exit_code: int = 43              # kill exit status
     keep_bytes: Optional[int] = None  # truncate: bytes to keep (None = half)
+    offset: Optional[int] = None     # corrupt: byte offset (None = middle)
+    xor_mask: int = 0xFF             # corrupt: XOR applied to the byte
     message: str = "injected fault"
 
     def __post_init__(self) -> None:
@@ -170,6 +186,15 @@ class FaultPlan:
         with self._lock:
             return sum(n for i, n in self._fired.items()
                        if site is None or self.specs[i].site == site)
+
+    def fired_by_spec(self) -> List[tuple]:
+        """``[(spec, times_fired), ...]`` in plan order — the chaos
+        harness's post-episode ledger (which planned faults actually hit,
+        so invariants like "deaths == severed connections" can be checked
+        against what fired, not what was merely scheduled)."""
+        with self._lock:
+            return [(spec, self._fired.get(i, 0))
+                    for i, spec in enumerate(self.specs)]
 
     def _claim(self, site: str, rank, round) -> Optional[FaultSpec]:
         """Match-and-count under the lock; returns the spec to fire."""
@@ -299,3 +324,18 @@ def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
     if spec.kind == "delay":
         time.sleep(spec.seconds)
     return spec
+
+
+def corrupt_bytes(data, spec: FaultSpec) -> bytes:
+    """Apply a ``corrupt``-kind spec to a payload: XOR one byte at
+    ``spec.offset`` (``None`` = the middle byte; offsets wrap) with
+    ``spec.xor_mask``.  A pure function of (payload, spec), so the same
+    plan damages the same bit every replay.  A zero-effective mask falls
+    back to ``0xFF`` — an installed corrupt spec must never be a no-op."""
+    buf = bytearray(data)
+    if not buf:
+        return bytes(buf)
+    off = (len(buf) // 2) if spec.offset is None else int(spec.offset)
+    mask = (int(spec.xor_mask) & 0xFF) or 0xFF
+    buf[off % len(buf)] ^= mask
+    return bytes(buf)

@@ -1,0 +1,78 @@
+"""End-to-end data-integrity accounting: the ``xtb_integrity_*`` families.
+
+Every byte that crosses a process or storage boundary in this repo is
+checksummed and verified at the receiving side (docs/reliability.md
+"Integrity & chaos" has the coverage table): fleet wire frames and
+tracker/relay messages carry a CRC-32, external-memory pages verify a
+per-page CRC at decode, model-store arenas re-verify their SHA-256 at
+replica attach and on scrub, and checkpoints have carried a trailing
+SHA-256 since PR 3.  This module is the shared *accounting* for all of
+them — one place that answers "how often does verification run, how often
+does it fail, and what happened next":
+
+- :func:`corrupt_detected` — a verification FAILED: the payload was
+  damaged and the damage was caught (the contract: caught, never decoded).
+- :func:`retried` — a recoverable boundary re-read the source once
+  (extmem pages re-decode from their backing store before failing loud).
+- :func:`quarantined` — a component was fenced off after a failed
+  verification (a fleet connection dropped, a replica that reported a
+  diverged arena).
+- :func:`scrubbed` — a proactive verification walk completed (model-store
+  arena scrub, checkpoint-directory scrub).
+
+Registration is lazy (first event creates the families) so importing the
+integrity-checked modules costs nothing when telemetry is never touched.
+"""
+from __future__ import annotations
+
+__all__ = ["corrupt_detected", "retried", "quarantined", "scrubbed"]
+
+_instruments = None
+
+
+def _ins():
+    global _instruments
+    if _instruments is None:
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        _instruments = (
+            reg.counter("xtb_integrity_corrupt_total",
+                        "corrupted payloads detected at an integrity "
+                        "boundary (checksum/structure verification "
+                        "failed)", ("boundary",)),
+            reg.counter("xtb_integrity_retry_total",
+                        "integrity re-reads: a failed verification "
+                        "retried once from the backing store",
+                        ("boundary",)),
+            reg.counter("xtb_integrity_quarantine_total",
+                        "components fenced off after a failed "
+                        "verification (connection dropped, replica "
+                        "quarantined)", ("boundary",)),
+            reg.counter("xtb_integrity_scrub_total",
+                        "proactive integrity scrub passes completed",
+                        ("target",)),
+        )
+    return _instruments
+
+
+def corrupt_detected(boundary: str) -> None:
+    """Count one detected corruption at ``boundary`` (``wire`` /
+    ``tracker`` / ``page`` / ``arena`` / ``checkpoint``) — and land it in
+    the flight ring, so a postmortem shows WHICH boundary went bad."""
+    _ins()[0].labels(boundary).inc()
+    from ..telemetry import flight
+
+    flight.record("fault", "integrity.corrupt", boundary=boundary)
+
+
+def retried(boundary: str) -> None:
+    _ins()[1].labels(boundary).inc()
+
+
+def quarantined(boundary: str) -> None:
+    _ins()[2].labels(boundary).inc()
+
+
+def scrubbed(target: str) -> None:
+    _ins()[3].labels(target).inc()

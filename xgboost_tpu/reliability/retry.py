@@ -13,6 +13,15 @@ same schedule every run.
 Every retry (not the first attempt) counts into
 ``xtb_retries_total{op=...}`` so a healthy-looking job that is quietly
 reconnecting in a loop shows up in telemetry.
+
+Stream independence is part of the contract: each :func:`backoff_delays`
+call builds its own ``random.Random`` seeded only by ``(op, seed)``, so
+one consumer's draws can never perturb another's schedule.  The
+integrity-retry path (``data/extmem.py`` page re-reads, op
+``"integrity.page"``) leans on exactly this — its delay is deterministic
+per (seam, attempt) no matter what the fault-injection plan or any other
+backoff user drew in between (pinned by
+``tests/test_integrity.py::test_integrity_backoff_deterministic_per_op_and_attempt``).
 """
 from __future__ import annotations
 

@@ -342,7 +342,7 @@ class _Replica:
     mutation happens under the fleet condition variable)."""
 
     __slots__ = ("label", "proc", "sock", "rx", "in_flight", "ready_info",
-                 "alive", "ctrl")
+                 "alive", "ctrl", "quarantined")
 
     def __init__(self, label: str, proc) -> None:
         self.label = label
@@ -355,6 +355,9 @@ class _Replica:
         # replica-bound lifecycle control frames (load/activate/retire):
         # dispatched ahead of queued traffic, never rerouted to a peer
         self.ctrl: deque = deque()
+        # set by an op="quarantine" frame (arena checksum divergence):
+        # the death that follows is a quarantine, not a crash
+        self.quarantined: Optional[str] = None
 
 
 _ERR_TYPES = {"ValueError": ValueError, "KeyError": KeyError,
@@ -396,6 +399,10 @@ class ServingFleet:
         self._telemetry: Dict[str, dict] = {}
         self._flight_rings: Dict[str, list] = {}
         self.flight_dumps: Dict[str, str] = {}
+        # label -> reason for every replica that quarantined itself after
+        # a failed arena verification (retained after death, like the
+        # telemetry above — the postmortem surface)
+        self.quarantined: Dict[str, str] = {}
         self._next_id = itertools.count(1)
         # lifecycle state (all under _cv): the fleet's view of each model's
         # active version (labels unversioned latency) and per-model shadow
@@ -600,6 +607,15 @@ class ServingFleet:
             try:
                 header, payload = wire.recv_frame(stream)
             except (wire.WireError, OSError) as e:
+                if isinstance(e, wire.WireCorruptError):
+                    # corrupt replica->dispatcher frame: the death path
+                    # below IS the quarantine — record it as one (the
+                    # replica-receive direction counts its own side)
+                    from ..reliability import integrity as _integrity
+
+                    _integrity.quarantined("wire")
+                    _flight.record("fault", "fleet.wire_corrupt",
+                                   replica=label)
                 self._on_replica_death(label, e)
                 return
             op = header.get("op")
@@ -608,6 +624,24 @@ class ServingFleet:
                 # does NOT complete the in-flight request — ingest and go
                 # straight back to the socket
                 self._ingest_telemetry(label, payload)
+                continue
+            if op == "quarantine":
+                # the replica's loaded arena checksum diverged: it fences
+                # itself and dies right after this frame.  Record WHY so
+                # the imminent death path (EOF on this socket) reads as a
+                # quarantine, not an unexplained crash; in-flight work
+                # reroutes through the normal death machinery.
+                reason = str(header.get("error", "arena checksum diverged"))
+                with self._cv:
+                    rep = self._replicas.get(label)
+                    if rep is not None:
+                        rep.quarantined = reason
+                    self.quarantined[label] = reason
+                from ..reliability import integrity as _integrity
+
+                _integrity.quarantined("arena")
+                _flight.record("event", "fleet.replica_quarantined",
+                               replica=label, error=reason)
                 continue
             # one critical section per completion: free the replica AND
             # claim its next request.  The hot path never notifies the cv —
@@ -755,6 +789,8 @@ class ServingFleet:
             pass
         rc = rep.proc.poll()
         tail = stderr_tail(self._err_files.get(label, ""))
+        if rep.quarantined:
+            tail = f"[quarantined: {rep.quarantined}]\n{tail}"
         if not closed:
             # a real death gets a postmortem; a clean shutdown's EOFs are
             # us closing the sockets, not replicas dying
@@ -1117,6 +1153,23 @@ class ServingFleet:
     def active_version(self, model: str) -> Optional[int]:
         with self._cv:
             return self._versions.get(model)
+
+    def scrub_replicas(self, timeout: float = 300.0) -> List[dict]:
+        """Broadcast an on-demand arena scrub: every live replica
+        re-verifies each RESIDENT version's checksum against the store
+        meta (the same check its periodic ``XGBOOST_TPU_ARENA_SCRUB_S``
+        tick runs).  Healthy replicas ack ``{"verified": n}``; a replica
+        whose loaded checksum diverges sends an ``op="quarantine"`` frame
+        and dies — its in-flight batch reroutes and :attr:`quarantined`
+        records the reason.  Riding the serialized connection means the
+        scrub drains behind every predict dispatched before it."""
+        return self._control_all({"op": "scrub", "model": "*"}, timeout)
+
+    def quarantined_replicas(self) -> Dict[str, str]:
+        """label -> reason for every self-quarantined replica (retained
+        after death)."""
+        with self._cv:
+            return dict(self.quarantined)
 
     # ------------------------------------------------------- shadow scoring
     def set_shadow(self, model: str, version: int,

@@ -44,16 +44,53 @@ Two lifecycle additions (docs/serving.md "Online model lifecycle"):
 """
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
 import tempfile
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 _ALIGN = 64  # PJRT CPU zero-copy needs 64-byte-aligned buffers
 _FORMAT_VERSION = 1
+
+try:
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX platform
+    fcntl = None
+
+
+class ArenaCorruptError(RuntimeError):
+    """A published arena's bytes no longer match the checksum recorded at
+    publish time.  Raised at replica attach (a corrupt model must never
+    start serving) and by the periodic arena scrub (a replica whose loaded
+    checksum diverges quarantines itself — docs/reliability.md
+    "Integrity & chaos")."""
+
+
+_lock_instruments = None
+
+
+def _lock_ins():
+    """(held gauge, wait-seconds counter) for the manifest flock — the
+    observability the two-manager contention story needs: a stuck gauge
+    means a wedged holder, a climbing wait counter means contention."""
+    global _lock_instruments
+    if _lock_instruments is None:
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        _lock_instruments = (
+            reg.gauge("xtb_store_lock_held",
+                      "manifest flocks currently held by this process"),
+            reg.counter("xtb_store_lock_wait_seconds_total",
+                        "seconds spent waiting to acquire the model-store "
+                        "manifest flock"),
+        )
+    return _lock_instruments
 
 
 def arena_checksum(fields: Dict[str, np.ndarray]) -> str:
@@ -116,6 +153,36 @@ class ModelStore:
     def _manifest_path(self) -> str:
         return os.path.join(self.dir, "manifest.json")
 
+    @contextlib.contextmanager
+    def _manifest_lock(self):
+        """Exclusive ``flock`` held across every manifest READ-MODIFY-WRITE
+        (publish's version allocation, ``set_active``, ``commit_active``).
+        Concurrent :class:`~xgboost_tpu.lifecycle.LifecycleManager`\\ s —
+        threads in one process or separate processes on a shared store —
+        serialize here, so two publishes can never allocate the same
+        version and an activate can never overwrite a concurrent one with
+        a stale manifest read.  Plain readers stay lock-free: the manifest
+        is still replaced atomically, so a read sees a complete old or new
+        file.  No-op where ``fcntl`` is unavailable."""
+        if fcntl is None:  # pragma: no cover - non-POSIX platform
+            yield
+            return
+        held, waited = _lock_ins()
+        t0 = time.perf_counter()
+        fd = os.open(os.path.join(self.dir, ".manifest.lock"),
+                     os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            waited.inc(time.perf_counter() - t0)
+            held.inc()
+            try:
+                yield
+            finally:
+                held.dec()
+                fcntl.flock(fd, fcntl.LOCK_UN)
+        finally:
+            os.close(fd)
+
     def manifest(self) -> dict:
         try:
             with open(self._manifest_path()) as fh:
@@ -144,12 +211,14 @@ class ModelStore:
         kill before this call leaves a store whose restart serves the
         incumbent, whatever has been published since."""
         version = int(version)
-        manifest = self.manifest()
-        if int(manifest["models"].get(name, 0)) < version:
-            raise KeyError(
-                f"cannot activate unpublished version {name!r} v{version}")
-        manifest.setdefault("active", {})[name] = version
-        self._write_manifest(manifest)
+        with self._manifest_lock():
+            manifest = self.manifest()
+            if int(manifest["models"].get(name, 0)) < version:
+                raise KeyError(
+                    f"cannot activate unpublished version {name!r} "
+                    f"v{version}")
+            manifest.setdefault("active", {})[name] = version
+            self._write_manifest(manifest)
 
     def serving_entries(self) -> List[Tuple[str, int]]:
         """Every (name, active_version) pair — what a replica loads and
@@ -165,15 +234,16 @@ class ModelStore:
         is already committed).  A running fleet calls this at start so
         "active" never silently tracks "latest": a later publish moves
         latest, but what serves moves only at its activate commit."""
-        manifest = self.manifest()
-        active = manifest.setdefault("active", {})
-        changed = False
-        for name, version in manifest["models"].items():
-            if active.get(name) is None:
-                active[name] = int(version)
-                changed = True
-        if changed:
-            self._write_manifest(manifest)
+        with self._manifest_lock():
+            manifest = self.manifest()
+            active = manifest.setdefault("active", {})
+            changed = False
+            for name, version in manifest["models"].items():
+                if active.get(name) is None:
+                    active[name] = int(version)
+                    changed = True
+            if changed:
+                self._write_manifest(manifest)
         return changed
 
     def _write_manifest(self, manifest: dict) -> None:
@@ -188,12 +258,21 @@ class ModelStore:
     def publish(self, name: str, source, version: Optional[int] = None,
                 ) -> int:
         """Snapshot ``source`` (Booster or .json/.ubj path) into the store.
-        Returns the version (auto-incremented when not given)."""
+        Returns the version (auto-incremented when not given).  The whole
+        allocate-version → write-files → commit-manifest sequence runs
+        under the manifest flock, so concurrent publishers (two lifecycle
+        managers over one store) get distinct versions instead of silently
+        overwriting each other's files."""
         from .registry import _load_booster
         from .snapshot import InferenceSnapshot
 
         booster = _load_booster(source)
         snap = InferenceSnapshot.from_booster(booster)
+        with self._manifest_lock():
+            return self._publish_locked(name, booster, snap, version)
+
+    def _publish_locked(self, name: str, booster, snap,
+                        version: Optional[int]) -> int:
         if version is None:
             version = (self.latest_version(name) or 0) + 1
         version = int(version)
@@ -222,6 +301,26 @@ class ModelStore:
                 off += arr.nbytes
             fh.flush()
             os.fsync(fh.fileno())
+        # fault seam: a bit flip between checksum computation and the
+        # arena hitting disk — verify_checksum must catch it (the
+        # lifecycle gate's "checksum" reject; replica attach refuses it)
+        from ..reliability import faults as _faults
+
+        spec = _faults.maybe_inject("modelstore.publish")
+        if spec is not None and spec.kind == "corrupt":
+            import dataclasses as _dc
+
+            # default the flip to byte 0: the arena interleaves fields
+            # with alignment padding the checksum does not cover, and a
+            # corrupt injection that lands in padding would be a no-op
+            if spec.offset is None:
+                spec = _dc.replace(spec, offset=0)
+            with open(tmp_arena, "rb") as fh:
+                damaged = _faults.corrupt_bytes(fh.read(), spec)
+            with open(tmp_arena, "wb") as fh:
+                fh.write(damaged)
+                fh.flush()
+                os.fsync(fh.fileno())
 
         # archive the exact serialized model alongside the inference arena:
         # the lifecycle trainer continues from precisely the bytes being
@@ -315,8 +414,44 @@ class ModelStore:
         recorded = meta.get("checksum")
         if recorded is None:
             return False
-        return arena_checksum({k: view(k) for k in meta["fields"]}
-                              ) == recorded
+        ok = arena_checksum({k: view(k) for k in meta["fields"]}
+                            ) == recorded
+        if not ok:
+            from ..reliability import integrity as _integrity
+
+            _integrity.corrupt_detected("arena")
+        return ok
+
+    def scrub(self) -> Dict[str, List[Tuple[str, int]]]:
+        """Walk EVERY version on disk (not just manifest heads) and
+        re-verify each arena against its publish-time checksum — the
+        model-store counterpart of the checkpoint-directory scrubber.
+        Returns ``{"verified": [(name, version), ...], "corrupt": [...]}``;
+        corrupt entries are also counted into
+        ``xtb_integrity_corrupt_total{boundary="arena"}`` (by
+        :meth:`verify_checksum`) and the scrub pass into
+        ``xtb_integrity_scrub_total{target="arena"}``."""
+        from ..reliability import integrity as _integrity
+
+        verified: List[Tuple[str, int]] = []
+        corrupt: List[Tuple[str, int]] = []
+        for fname in sorted(os.listdir(self.dir)):
+            if not fname.endswith(".meta.json"):
+                continue
+            stem = fname[: -len(".meta.json")]
+            name, _, vtag = stem.rpartition(".v")
+            try:
+                version = int(vtag)
+            except ValueError:
+                continue
+            try:
+                ok = self.verify_checksum(name, version)
+            except (OSError, ValueError, KeyError):
+                ok = False  # unreadable meta/arena counts as corrupt
+                _integrity.corrupt_detected("arena")
+            (verified if ok else corrupt).append((name, version))
+        _integrity.scrubbed("arena")
+        return {"verified": verified, "corrupt": corrupt}
 
     # ----------------------------------------------------------------- open
     def _open_arena(self, stem: str):

@@ -137,6 +137,10 @@ def _apply_control(engine, store, warm, fast, buckets, header) -> dict:
     st = {"hits": 0, "compiled": 0}
     fp = fast.get((name, version))
     if fp is None:
+        # attach gate: re-verify the arena checksum BEFORE anything maps
+        # it into serving — a corrupt candidate is refused as a typed
+        # per-request error (incumbent untouched), never loaded
+        _verify_arena(store, name, version)
         # double-buffer: the incumbent's registry entry, AOT programs, and
         # fast path all stay live while the candidate builds next to them
         snap = store.snapshot(name, version)
@@ -154,6 +158,40 @@ def _apply_control(engine, store, warm, fast, buckets, header) -> dict:
         fast[(name, None)] = fp
     return {"aot_hits": st["hits"], "aot_compiled": st["compiled"],
             "seconds": time.perf_counter() - t0}
+
+
+def _verify_arena(store, name, version) -> None:
+    """Raise :class:`~xgboost_tpu.serving.modelstore.ArenaCorruptError`
+    when the (mmapped) arena no longer matches its publish-time checksum.
+    On the CPU backend the mmap pages ARE the served bytes (zero-copy
+    aliasing), so this re-derivation verifies exactly what predictions
+    read."""
+    from .modelstore import ArenaCorruptError
+
+    if not store.verify_checksum(name, version):
+        raise ArenaCorruptError(
+            f"arena checksum diverged for {name!r} v{version}: refusing "
+            "to serve corrupted model bytes")
+
+
+def _scrub_resident(store, fast: dict) -> int:
+    """Re-verify every RESIDENT version against the store meta; returns
+    the number verified, raises ``ArenaCorruptError`` on the first
+    divergence (the serve loop turns that into quarantine-and-die)."""
+    resident = sorted({(n, v) for (n, v) in fast if v is not None})
+    for name, version in resident:
+        _verify_arena(store, name, version)
+    return len(resident)
+
+
+def _scrub_interval() -> float:
+    """Periodic arena-scrub tick, seconds (0 disables).  Piggybacks on the
+    serve loop like telemetry shipping, so an idle replica scrubs at its
+    next frame — traffic is what makes corruption matter."""
+    try:
+        return float(os.environ.get("XGBOOST_TPU_ARENA_SCRUB_S", "300"))
+    except ValueError:
+        return 300.0
 
 
 def ship_telemetry(sock, label: str) -> bool:
@@ -192,18 +230,54 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
     # socket is single-writer by design).  An idle replica ships nothing —
     # and needs to: with no requests handled, its counters haven't moved,
     # so the dispatcher's retained snapshot is still exact.
+    from .modelstore import ArenaCorruptError
+
+    def _quarantine(e: BaseException, rid=None) -> None:
+        # the replica's own loaded checksum diverged: tell the dispatcher
+        # WHY before dying loudly (it fences the label, reroutes the
+        # in-flight batch, and decides respawn) — then die; a wounded
+        # replica must never keep serving.  The quarantine COUNTER is the
+        # dispatcher's (on frame receipt): counting here too would
+        # double the merged view once this replica's final telemetry
+        # ship lands driver-side.
+        flight.record("fault", "replica.quarantine", error=str(e))
+        try:
+            wire.send_frame(sock, {"op": "quarantine", "id": rid,
+                                   "label": label, "error": str(e)})
+        except OSError:
+            pass
+
     interval = distributed.ship_interval()
-    last_ship = time.monotonic()
+    scrub_s = _scrub_interval()
+    last_ship = last_scrub = time.monotonic()
     stream = wire.reader(sock)  # one GIL event per frame, not three
     while True:
         try:
             header, payload = wire.recv_frame(stream)
+        except wire.WireCorruptError:
+            # corrupted frame: this connection cannot be trusted at any
+            # subsequent byte — quarantine it (exit; the dispatcher's
+            # death path reroutes and respawns), never decode garbage
+            from ..reliability import integrity as _integrity
+
+            _integrity.quarantined("wire")
+            flight.record("fault", "replica.wire_corrupt")
+            return
         except wire.WireError:
             return  # dispatcher gone: clean exit
         op = header.get("op")
         rid = header.get("id")
         if op == "close":
             return
+        if op == "scrub":
+            try:
+                n = _scrub_resident(store, fast)
+                wire.send_frame(sock, {"op": "ctrl_ok", "id": rid,
+                                       "verified": n})
+            except ArenaCorruptError as e:
+                _quarantine(e, rid)
+                raise
+            continue
         if op in ("load", "activate", "retire"):
             try:
                 ack = _apply_control(engine, store, warm, fast, buckets,
@@ -261,6 +335,15 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
         if now - last_ship >= interval:
             last_ship = now
             ship_telemetry(sock, label)
+        if scrub_s > 0 and now - last_scrub >= scrub_s:
+            # periodic scrub tick (piggybacked like telemetry shipping):
+            # a replica whose loaded checksum diverged quarantines itself
+            last_scrub = now
+            try:
+                _scrub_resident(store, fast)
+            except ArenaCorruptError as e:
+                _quarantine(e)
+                raise
 
 
 def main(argv=None) -> int:
@@ -345,6 +428,10 @@ def main(argv=None) -> int:
     engine.registry.add_retire_hook(_drop_fast)
 
     for name, version in entries:
+        # attach gate: a corrupt store entry must fail replica startup
+        # LOUDLY (launcher failure with the cause in the stderr tail) —
+        # never serve bytes the publish-time checksum disowns
+        _verify_arena(store, name, version)
         snap = store.snapshot(name, version)
         engine.registry.register_snapshot(name, snap, version)
         st = warm.attach(snap, buckets)

@@ -1,6 +1,7 @@
 """Fleet wire protocol: length-prefixed frames with zero-copy payloads.
 
-One frame = ``<u32 header_len><u64 payload_len><header JSON><payload>``.
+One frame = ``<u32 header_len><u64 payload_len><u32 crc32><header
+JSON><payload>`` (the CRC covers header + payload).
 The header is tiny routing metadata (op, request id, model, encoding,
 shape); the payload is the row data — and the whole design goal is that
 the payload bytes are never copied or decoded at the dispatcher:
@@ -20,17 +21,39 @@ the payload bytes are never copied or decoded at the dispatcher:
 Arrow is optional (pyarrow is an optional dependency repo-wide): the
 ``arrow`` encoding is negotiated by the client helper and raises cleanly
 when pyarrow is absent; ``raw`` always works.
+
+**Integrity** (docs/reliability.md "Integrity & chaos"): every frame's
+prefix carries a CRC-32 (``zlib.crc32``, C-speed) over header + payload,
+verified by :func:`recv_frame` before the header is even JSON-decoded.  A
+mismatch raises :class:`WireCorruptError` — a :class:`WireError` subclass,
+so every existing caller already treats it as peer-gone and quarantines
+the connection exactly like a ``drop_connection`` fault: the dispatcher
+runs its replica-death path (in-flight batch reroutes), the replica exits
+its serve loop.  Length prefixes are sanity-bounded (``MAX_HEADER`` /
+``MAX_PAYLOAD``) so a garbage prefix can never make the reader allocate
+an absurd buffer, and a header that fails to JSON-decode is a
+:class:`WireError` too — garbage fails ONE connection, never the fleet.
+The ``wire.frame`` fault seam in :func:`send_frame` injects deterministic
+byte flips (``corrupt`` kind) after the CRC is computed, which is how the
+chaos harness proves the detection end to end.
 """
 from __future__ import annotations
 
 import json
 import socket
 import struct
+import zlib
 from typing import Any, Optional, Tuple
 
 import numpy as np
 
-_PREFIX = struct.Struct("<IQ")
+# <u32 header_len> <u64 payload_len> <u32 crc32(header + payload)>
+_PREFIX = struct.Struct("<IQI")
+
+# sanity bounds on the two length prefixes: a corrupted/garbage prefix
+# must fail the connection, not OOM the reader with one allocation
+MAX_HEADER = 1 << 20          # 1 MiB of routing JSON is already absurd
+MAX_PAYLOAD = 1 << 31         # 2 GiB of row data per frame
 
 # payload encodings
 RAW = "raw"      # C-order float32 bytes; header carries "shape"
@@ -49,6 +72,13 @@ TELEMETRY = "telemetry"
 
 class WireError(RuntimeError):
     """Framing violation on a fleet socket (peer is gone or confused)."""
+
+
+class WireCorruptError(WireError):
+    """Frame CRC mismatch: the bytes on the wire are not the bytes that
+    were sent.  Subclasses :class:`WireError` on purpose — corruption is
+    handled as peer-gone (quarantine the connection), never by decoding
+    the damaged frame."""
 
 
 # payloads up to this ride in the header's sendall (one segment, one
@@ -76,10 +106,28 @@ def send_frame(sock: socket.socket, header: dict,
     """Write one frame.  ``payload`` may be bytes/bytearray/memoryview —
     a large one is handed to the kernel as-is (no intermediate concat
     copy of the row data); small ones merge into the prefix+header write
-    (one syscall beats one copy at that size)."""
+    (one syscall beats one copy at that size).  The prefix CRC covers
+    header + payload (~GB/s, a fraction of what the kernel copy costs)."""
+    from ..reliability import faults as _faults
+
     hdr = json.dumps(header, separators=(",", ":")).encode()
     body = memoryview(payload) if payload is not None else memoryview(b"")
-    head = _PREFIX.pack(len(hdr), len(body)) + hdr
+    if body.ndim != 1 or body.itemsize != 1:
+        body = body.cast("B")
+    crc = zlib.crc32(body, zlib.crc32(hdr))
+    prefix = _PREFIX.pack(len(hdr), len(body), crc)
+    head = prefix + hdr
+    spec = _faults.maybe_inject("wire.frame")
+    if spec is not None and spec.kind == "corrupt":
+        # deterministic damage AFTER the CRC was computed, scoped to the
+        # header+payload region the CRC covers: the receiver must detect
+        # it (WireCorruptError) and quarantine the connection.  (A flip
+        # in the length prefix itself is indistinguishable from a stalled
+        # or insane peer — the MAX_* bounds and callers' timeouts own
+        # that case.)
+        sock.sendall(prefix
+                     + _faults.corrupt_bytes(hdr + bytes(body), spec))
+        return
     if len(body) and len(body) <= _INLINE_PAYLOAD:
         sock.sendall(head + bytes(body))
         return
@@ -117,13 +165,32 @@ def _recv_exact(stream, n: int) -> memoryview:
 def recv_frame(stream) -> Tuple[dict, memoryview]:
     """Read one frame -> (header, payload view) from a socket or a
     :func:`reader` stream.  Raises WireError on EOF at a frame boundary
-    too (callers treat any WireError as peer-gone)."""
+    too (callers treat any WireError as peer-gone); length-prefix
+    violations and CRC mismatches (:class:`WireCorruptError`) are
+    WireErrors as well, so a poisoned connection fails itself, not the
+    fleet, and damaged bytes are never JSON-decoded."""
     prefix = _recv_exact(stream, _PREFIX.size)
-    hlen, plen = _PREFIX.unpack(prefix)
-    if hlen > 1 << 20:
+    hlen, plen, crc = _PREFIX.unpack(prefix)
+    if hlen > MAX_HEADER:
         raise WireError(f"unreasonable header length {hlen}")
-    header = json.loads(bytes(_recv_exact(stream, hlen)))
+    if plen > MAX_PAYLOAD:
+        raise WireError(f"unreasonable payload length {plen}")
+    hdr_bytes = _recv_exact(stream, hlen)
     payload = _recv_exact(stream, plen) if plen else memoryview(b"")
+    if zlib.crc32(payload, zlib.crc32(hdr_bytes)) != crc:
+        from ..reliability import integrity as _integrity
+
+        _integrity.corrupt_detected("wire")
+        raise WireCorruptError(
+            f"frame CRC mismatch ({hlen}B header, {plen}B payload): "
+            "corrupted in transit — quarantining the connection")
+    try:
+        header = json.loads(bytes(hdr_bytes))
+    except ValueError as e:
+        raise WireError(f"undecodable frame header: {e}") from e
+    if not isinstance(header, dict):
+        raise WireError(f"frame header is {type(header).__name__}, "
+                        "expected a JSON object")
     return header, payload
 
 

@@ -12,7 +12,16 @@ other worker, whose watcher thread exits the process — the reference's
 fail-fast elastic path (tracker.cc:345 CMD::kError handling +
 comm.cc:340-376 detached error watcher calling std::exit).
 
-Wire format: 4-byte big-endian length + JSON object.
+Wire format: 4-byte big-endian length + 4-byte CRC-32 + JSON object; the
+relay's raw binary payloads carry their CRC in the preceding ``coll`` /
+``coll_result`` header.  Verification failures (and insane length
+prefixes, which a flipped bit can produce) surface as
+``ConnectionError`` — every caller already treats that as the peer being
+gone, so a corrupted channel is quarantined exactly like a dropped one
+instead of a damaged histogram folding into an allreduce
+(docs/reliability.md "Integrity & chaos").  The ``tracker.message`` fault
+seam in :func:`send_msg` injects deterministic byte flips to prove the
+detection.
 """
 from __future__ import annotations
 
@@ -55,32 +64,71 @@ def _op_timeout(sock: socket.socket, timeout: Optional[float]):
             pass  # peer closed the socket mid-operation
 
 
+# bound on one control-channel JSON message (telemetry snapshots are the
+# largest legitimate ones, ~100s of KB): a garbage length prefix must be
+# a detected connection fault, not a 4 GiB allocation
+MAX_MSG = 1 << 26
+
+
 def send_msg(sock: socket.socket, obj: dict,
              timeout: Optional[float] = None) -> None:
+    import zlib
+
+    from .reliability import faults as _faults
+
     payload = json.dumps(obj).encode()
+    spec = _faults.maybe_inject("tracker.message")
+    if spec is not None and spec.kind == "corrupt":
+        # deterministic damage AFTER the CRC below is computed over the
+        # ORIGINAL payload; scoped to the payload region (a flipped
+        # length prefix is a stalled/insane peer, owned by the MAX_MSG
+        # bound and the callers' operation timeouts)
+        frame = (struct.pack(">II", len(payload), zlib.crc32(payload))
+                 + _faults.corrupt_bytes(payload, spec))
+    else:
+        frame = (struct.pack(">II", len(payload), zlib.crc32(payload))
+                 + payload)
     with _op_timeout(sock, timeout):
-        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        sock.sendall(frame)
 
 
 def recv_msg(sock: socket.socket,
              timeout: Optional[float] = None) -> Optional[dict]:
     """One length-prefixed JSON message; None on clean EOF.  ``timeout``
     bounds the WHOLE message (socket.timeout is an OSError subclass, so
-    existing error paths treat expiry as a connection fault)."""
+    existing error paths treat expiry as a connection fault).  A CRC
+    mismatch or an insane length prefix raises ``ConnectionError`` — the
+    corrupted channel is quarantined like a dropped one."""
+    import zlib
+
     with _op_timeout(sock, timeout):
         hdr = b""
-        while len(hdr) < 4:
-            chunk = sock.recv(4 - len(hdr))
+        while len(hdr) < 8:
+            chunk = sock.recv(8 - len(hdr))
             if not chunk:
                 return None
             hdr += chunk
-        (n,) = struct.unpack(">I", hdr)
+        n, crc = struct.unpack(">II", hdr)
+        if n > MAX_MSG:
+            from .reliability import integrity as _integrity
+
+            _integrity.corrupt_detected("tracker")
+            raise ConnectionError(
+                f"tracker message length {n} exceeds the {MAX_MSG} bound "
+                "(corrupted length prefix?) — dropping the connection")
         buf = b""
         while len(buf) < n:
             chunk = sock.recv(n - len(buf))
             if not chunk:
                 return None
             buf += chunk
+    if zlib.crc32(buf) != crc:
+        from .reliability import integrity as _integrity
+
+        _integrity.corrupt_detected("tracker")
+        raise ConnectionError(
+            f"tracker message CRC mismatch ({n} bytes): corrupted in "
+            "transit — dropping the connection")
     return json.loads(buf.decode())
 
 
@@ -105,6 +153,12 @@ def get_host_ip(host_ip: str = "auto") -> str:
     finally:
         s.close()
     return ip
+
+
+def _crc32(data) -> int:
+    import zlib
+
+    return zlib.crc32(data)
 
 
 def _recv_exact(sock: socket.socket, n: int,
@@ -222,6 +276,14 @@ class CollRelay:
                 seq = int(hdr["seq"])
                 buf = _recv_exact(conn, int(hdr["nbytes"]),
                                   timeout=self.op_timeout)
+                if hdr.get("crc") is not None and _crc32(buf) != hdr["crc"]:
+                    # a damaged contribution must NEVER fold into the
+                    # gather: quarantine this worker's relay connection
+                    # (the departure path below treats it as a lost peer)
+                    from .reliability import integrity as _integrity
+
+                    _integrity.corrupt_detected("tracker")
+                    break
                 result = self._contribute(seq, rank, buf, epoch)
                 if result is _REGROUP:
                     # membership is changing: the worker raises
@@ -235,7 +297,8 @@ class CollRelay:
                              timeout=30.0)
                     break
                 send_msg(conn, {"cmd": "coll_result", "seq": seq,
-                                "nbytes": len(result)},
+                                "nbytes": len(result),
+                                "crc": _crc32(result)},
                          timeout=self.op_timeout)
                 with _op_timeout(conn, self.op_timeout):
                     conn.sendall(result)
@@ -1077,7 +1140,8 @@ class TrackerClient:
             self._coll_seq += 1
             try:
                 send_msg(s, {"cmd": "coll", "seq": seq,
-                             "nbytes": len(payload)},
+                             "nbytes": len(payload),
+                             "crc": _crc32(payload)},
                          timeout=self.op_timeout)
                 with _op_timeout(s, self.op_timeout):
                     s.sendall(payload)
@@ -1091,6 +1155,16 @@ class TrackerClient:
                         f"{(hdr or {}).get('msg', 'connection lost')}")
                 buf = _recv_exact(s, int(hdr["nbytes"]),
                                   timeout=self.op_timeout)
+                if (hdr.get("crc") is not None
+                        and _crc32(buf) != hdr["crc"]):
+                    # a damaged gather result must never reach the
+                    # histogram fold: fail the connection, not the math
+                    from .reliability import integrity as _integrity
+
+                    _integrity.corrupt_detected("tracker")
+                    raise ConnectionError(
+                        f"relay gather seq {seq} CRC mismatch: corrupted "
+                        "payload — dropping the relay connection")
             except OSError as e:
                 if self._regroup_flag.is_set():
                     raise RegroupRequired(
