@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Coordinator-failover smoke for the nightly suite (docs/reliability.md
+"Coordinator failover & watchdog").
+
+Three legs over a 3-worker tracker-mode CPU run with the tracker as a
+SUPERVISED, JOURNALING subprocess (``tracker_failover=True``):
+
+1. **Kill**: the fault plan SIGKILLs the tracker at its 3rd journal
+   write, mid-round (rounds paced by a pure-delay fault).  The launcher
+   respawns it against the journal, the workers re-adopt with backoff,
+   the run finishes with all workers intact — and the **tracker-respawn
+   pause wall** (death detection → the respawned tracker accepting) is
+   recorded in the smoke output.
+2. **Parity**: an undisturbed run of the same job must produce
+   bitwise-identical model bytes — a coordinator death costs a pause,
+   never a bit.
+3. **Stall**: a watchdog leg at tight budgets — one rank sleeps far past
+   the collective-wait budget; the guard dumps all-thread stacks and
+   severs, the tracker's join ladder declares the sleeper dead, and the
+   survivors finish at world N−1.  Asserts the faulthandler dump exists
+   and the run needed no outer deadline.
+
+Usage: JAX_PLATFORMS=cpu python scripts/failover_smoke.py [workers] [rounds]
+"""
+import functools
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+          "max_bin": 32}
+N_ROWS = 2400
+
+
+def worker(rank, world, *, ckpt_dir, out_path, rounds, num_shards):
+    import numpy as np
+
+    import xgboost_tpu as xtb
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N_ROWS, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+
+    def data_fn(shard_map, rank, world):
+        shards = shard_map.shards_of(rank)
+        rows = np.sort(np.concatenate(
+            [np.arange(s, N_ROWS, shard_map.num_shards) for s in shards]))
+        return xtb.DMatrix(X[rows], label=y[rows])
+
+    cfg = xtb.ElasticConfig(data_fn, ckpt_dir, num_shards=num_shards)
+    bst = xtb.train(PARAMS, None, rounds, elastic=cfg, verbose_eval=False)
+    from xgboost_tpu import collective
+
+    if collective.get_rank() == 0 and out_path:
+        with open(out_path, "wb") as fh:
+            fh.write(bytes(bst.save_raw()))
+
+
+def _run(tag, *, tmp, workers, rounds, fault_plan=None, failover=True,
+         env=None):
+    from xgboost_tpu.launcher import run_distributed
+
+    ckpt = os.path.join(tmp, f"ckpt_{tag}")
+    out = os.path.join(tmp, f"{tag}.ubj")
+    print(f"[failover_smoke] {tag}: {workers} workers, {rounds} rounds",
+          flush=True)
+    saved = {}
+    if env:
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+    try:
+        stats = run_distributed(
+            functools.partial(worker, ckpt_dir=ckpt, out_path=out,
+                              rounds=rounds, num_shards=2 * workers),
+            num_workers=workers, platform="cpu", timeout=900,
+            rendezvous="tracker", elastic=True,
+            fault_plan=json.dumps(fault_plan) if fault_plan else None,
+            tracker_failover=failover)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return open(out, "rb").read(), stats, ckpt
+
+
+def main() -> int:
+    from xgboost_tpu.reliability import latest_checkpoint
+
+    WORKERS = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import failover_smoke as _mod
+
+    global worker
+    worker = _mod.worker
+
+    tmp = tempfile.mkdtemp(prefix="xtb_failover_smoke_")
+    try:
+        # -- leg 1: SIGKILL the tracker mid-round --------------------------
+        plan = {"faults": [
+            {"site": "tracker.journal", "kind": "kill", "at": 2},
+            {"site": "train.round", "kind": "delay", "seconds": 0.6,
+             "times": 1000},
+        ]}
+        model_k, stats_k, _ = _run("tracker_kill", tmp=tmp, workers=WORKERS,
+                                   rounds=ROUNDS, fault_plan=plan)
+        if stats_k["tracker_respawns"] < 1:
+            raise SystemExit("tracker kill never fired (no respawn)")
+        if stats_k["succeeded"] != WORKERS:
+            raise SystemExit(
+                f"failover cost a worker: {stats_k['succeeded']}/{WORKERS}")
+        pauses = ", ".join(f"{p:.2f}s" for p in stats_k["tracker_pauses_s"])
+        print(f"[failover_smoke] kill OK: {stats_k['tracker_respawns']} "
+              f"respawn(s), tracker-respawn pause wall: {pauses}")
+
+        # -- leg 2: bitwise parity vs an undisturbed run -------------------
+        model_c, stats_c, ckpt_c = _run("clean", tmp=tmp, workers=WORKERS,
+                                        rounds=ROUNDS)
+        if stats_c["tracker_respawns"] != 0:
+            raise SystemExit("clean leg respawned a tracker?!")
+        if model_k != model_c:
+            raise SystemExit(
+                f"PARITY FAILURE: tracker-kill model ({len(model_k)} B) != "
+                f"undisturbed model ({len(model_c)} B)")
+        st = latest_checkpoint(ckpt_c)
+        if st is None or st.round != ROUNDS:
+            raise SystemExit(f"clean run did not complete: {st}")
+        print(f"[failover_smoke] parity OK: identical bytes "
+              f"({len(model_k)} B) across a coordinator SIGKILL")
+
+        # -- leg 3: stall watchdog ----------------------------------------
+        flight_dir = os.path.join(tmp, "flight")
+        stall_plan = {"faults": [
+            {"site": "train.round", "kind": "delay", "seconds": 12.0,
+             "rank": 1, "round": 2, "at": 2},
+        ]}
+        model_s, stats_s, ckpt_s = _run(
+            "stall", tmp=tmp, workers=2, rounds=ROUNDS,
+            fault_plan=stall_plan, failover=False,
+            env={"XGBOOST_TPU_FLIGHT_DIR": flight_dir,
+                 "XGBOOST_TPU_WATCHDOG_COLLECTIVE_WAIT_S": "1.5",
+                 "XGBOOST_TPU_WATCHDOG_TRACKER_JOIN_S": "1.5"})
+        st = latest_checkpoint(ckpt_s)
+        if st is None or st.round != ROUNDS:
+            raise SystemExit(f"stall run did not complete: {st}")
+        if st.world != 1:
+            raise SystemExit(
+                f"stalled rank was not declared dead (world {st.world})")
+        stacks = glob.glob(os.path.join(flight_dir, "stacks_*.txt"))
+        if not stacks:
+            raise SystemExit("watchdog left no faulthandler stack dump")
+        print(f"[failover_smoke] stall OK: survivors finished at world "
+              f"{st.world}, {len(stacks)} stack dump(s)")
+        print(f"[failover_smoke] OK: kill + parity + stall "
+              f"({WORKERS} workers, {ROUNDS} rounds)")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

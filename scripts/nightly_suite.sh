@@ -74,6 +74,15 @@ JAX_PLATFORMS=cpu python scripts/fault_smoke.py 4 6
 # shard map restored from the checkpoint
 JAX_PLATFORMS=cpu python scripts/elastic_smoke.py 4 8
 
+# coordinator failover + watchdog smoke (docs/reliability.md
+# § Coordinator failover & watchdog): SIGKILL the supervised journaling
+# tracker mid-round -> respawn + worker re-adoption -> model bytes
+# bitwise-identical to an undisturbed run (the respawn pause wall is in
+# the output); then a stall leg: a rank sleeping past the watchdog
+# budget gets an all-thread stack dump and is declared dead, the
+# survivors finish at world N-1 — dump + recovery, no hang
+JAX_PLATFORMS=cpu python scripts/failover_smoke.py 3 8
+
 # out-of-core smoke (docs/extmem.md): 2-worker paged run through
 # train(ExtMemConfig) over the tracker relay — identical model bytes on
 # every rank with peak RSS under the ceiling (pages stream, the full

@@ -50,7 +50,8 @@ _QUICK_FILES = {
     "test_serving_faults.py", "test_reliability_multiprocess.py",
     "test_analysis.py", "test_native_threads.py", "test_elastic.py",
     "test_lifecycle.py", "test_updaters_process.py", "test_extmem.py",
-    "test_integrity.py", "test_chaos.py",
+    "test_integrity.py", "test_chaos.py", "test_watchdog.py",
+    "test_failover.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)
@@ -87,6 +88,7 @@ _QUICK_DENY = {
     "test_extmem_matches_incore", "test_extmem_multidevice_matches_single",
     "test_sparse_page_dmatrix_raw_predict_and_training",
     "test_sparse_page_dmatrix_scipy_batches_and_sentinel",
+    "test_tracker_sigkill_mid_round_bitwise_parity",
 }
 
 

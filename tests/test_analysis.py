@@ -521,6 +521,64 @@ def test_simd_seam_real_tree_confined():
         assert rule.check_text(fh.read(), ALLOWED_BASENAME)
 
 
+# ---------------------------------------------------------------------------
+# XTB7xx unbounded blocking calls
+# ---------------------------------------------------------------------------
+
+def test_blocking_fires_on_untimed_wait_get_result_connect():
+    r = lint_source(src("""
+        import socket
+
+        def f(ev, q, fut):
+            ev.wait()
+            fut.result()
+            q.get()
+            socket.create_connection(("h", 1))
+    """), select=["XTB7"])
+    assert codes(r) == ["XTB701", "XTB702", "XTB702", "XTB703"]
+
+
+def test_blocking_clean_with_explicit_timeouts():
+    """Explicit bounds — including a deliberate ``timeout=None`` — pass:
+    the rule rejects IMPLICIT forever, not designed-forever."""
+    r = lint_source(src("""
+        import socket
+
+        def f(ev, q, fut, d, gauge):
+            ev.wait(timeout=None)
+            ev.wait(5.0)
+            fut.result(timeout=1)
+            q.get(timeout=1)
+            socket.create_connection(("h", 1), 5)
+            socket.create_connection(("h", 1), timeout=None)
+            d.get("key")         # dict.get: not a queue consume
+            gauge.get()          # non-queue receiver: gauge read
+    """), select=["XTB7"])
+    assert codes(r) == []
+
+
+def test_blocking_watchdog_module_exempt():
+    """The watchdog module is the one place allowed to own unbounded
+    blocking primitives — the real file must carry no XTB7xx findings
+    BECAUSE of the exemption, not because it happens to be clean."""
+    from xgboost_tpu.analysis.blocking import _EXEMPT_FILES
+
+    assert "reliability/watchdog.py" in _EXEMPT_FILES
+    path = os.path.join(REPO, "xgboost_tpu", "reliability", "watchdog.py")
+    r = lint_paths([path], select=["XTB7"])
+    assert codes(r) == []
+
+
+def test_blocking_queue_receiver_naming():
+    r = lint_source(src("""
+        def f(self):
+            self._queue.get()
+            self.request_queue.get()
+            self.q.get()
+    """), select=["XTB7"])
+    assert codes(r) == ["XTB702", "XTB702", "XTB702"]
+
+
 def test_file_level_suppression_mechanism():
     # the mechanism works (and is what the gate forbids in-tree)
     r = lint_source(src("""

@@ -1,0 +1,238 @@
+"""Stall watchdog semantics (docs/reliability.md "Coordinator failover &
+watchdog"): the escalation ladder fires deterministically past a budget,
+NEVER on legitimate slowness under it, and the tracker-side liveness
+monitor distinguishes heartbeat loss from progress loss — a slow but
+progressing peer must not be declared dead.
+"""
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from xgboost_tpu.reliability import watchdog as wd
+from xgboost_tpu.tracker import RabitTracker, recv_msg, send_msg
+
+
+@pytest.fixture(autouse=True)
+def _clean_watchdog():
+    wd.reset()
+    yield
+    wd.reset()
+
+
+# ---------------------------------------------------------------------------
+# guard ladder
+# ---------------------------------------------------------------------------
+
+def test_ladder_escalates_in_order_and_runs_on_stall():
+    stalled = []
+    with wd.guard("collective.wait", budget_s=0.01,
+                  on_stall=stalled.append) as g:
+        time.sleep(0.05)
+        fired = wd.check_now()
+    assert [s for _seam, s in fired] == ["warn", "dump", "stall"]
+    assert g.stalled
+    assert len(stalled) == 1
+    # the dump stage left an all-thread faulthandler dump
+    assert g.stack_path and os.path.exists(g.stack_path)
+    with open(g.stack_path, encoding="utf-8") as fh:
+        text = fh.read()
+    assert "=== stacks pid=" in text and "Thread" in text
+
+
+def test_ladder_stages_are_monotonic_and_fire_once():
+    with wd.guard("collective.wait", budget_s=0.02) as g:
+        time.sleep(0.025)
+        assert [s for _n, s in wd.check_now()] == ["warn"]
+        assert wd.check_now() == []  # no re-fire at the same stage
+        time.sleep(0.03)  # past 2x budget: dump then stall, in order
+        assert [s for _n, s in wd.check_now()] == ["dump", "stall"]
+        assert wd.check_now() == []
+        assert g.stage == 3
+
+
+def test_legitimately_slow_op_under_budget_never_escalates():
+    """The false-positive contract: a slow round under budget is NOT a
+    stall — nothing fires, nothing is dumped."""
+    with wd.guard("collective.wait", budget_s=5.0) as g:
+        time.sleep(0.05)
+        assert wd.check_now() == []
+    assert g.stage == 0 and not g.stalled
+
+
+def test_slow_but_progressing_stream_never_escalates():
+    """Per-op guards model per-page/per-request budgets: N sequential
+    waits each under budget must never trip, however long they total —
+    only ONE op wedged past the budget does."""
+    for page in range(10):
+        with wd.guard("extmem.decode", budget_s=0.05, page=page) as g:
+            time.sleep(0.01)  # 10 x 0.01 = 2x budget in total, all fine
+            assert wd.check_now() == []
+            assert not g.stalled
+        wd.progress("extmem.page", page=page)
+
+
+def test_exit_unregisters_op():
+    with wd.guard("collective.wait", budget_s=0.01):
+        pass
+    time.sleep(0.02)
+    assert wd.check_now() == []  # completed op cannot escalate late
+
+
+def test_disabled_guard_is_noop():
+    wd.configure(enabled=False)
+    with wd.guard("collective.wait", budget_s=0.001) as g:
+        time.sleep(0.01)
+        assert wd.check_now() == []
+    assert not g.stalled and g.stage == 0
+
+
+def test_budget_env_override(monkeypatch):
+    monkeypatch.setenv("XGBOOST_TPU_WATCHDOG_COLLECTIVE_WAIT_S", "42.5")
+    assert wd.budget_for("collective.wait") == 42.5
+    monkeypatch.delenv("XGBOOST_TPU_WATCHDOG_COLLECTIVE_WAIT_S")
+    assert wd.budget_for("collective.wait") \
+        == wd.DEFAULT_BUDGETS["collective.wait"]
+    assert wd.budget_for("no.such.seam") > 0  # fallback, never unbudgeted
+
+
+def test_on_stall_exception_does_not_kill_the_monitor():
+    def boom(_op):
+        raise RuntimeError("poke failed")
+
+    with wd.guard("collective.wait", budget_s=0.001, on_stall=boom):
+        time.sleep(0.01)
+        fired = wd.check_now()
+    assert [s for _n, s in fired] == ["warn", "dump", "stall"]
+    # a subsequent guard still works
+    with wd.guard("collective.wait", budget_s=0.001):
+        time.sleep(0.005)
+        assert wd.check_now()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat-loss vs progress-loss semantics
+# ---------------------------------------------------------------------------
+
+def test_progress_markers_advance_only_on_payload_change():
+    wd.progress("train.round", round=1)
+    m1 = wd.markers()
+    time.sleep(0.01)
+    wd.progress("train.round", round=1)  # re-shipped identical marker
+    m2 = wd.markers()
+    # a heartbeat (same payload, newer timestamp) is NOT progress
+    assert not wd.advanced(m1, m2)
+    wd.progress("train.round", round=2)
+    assert wd.advanced(m2, wd.markers())
+    # a NEW marker key is progress too
+    wd.progress("extmem.page", page=0)
+    assert wd.advanced(m2, wd.markers())
+    # empty/missing current markers are never progress
+    assert not wd.advanced(m1, {})
+    assert wd.advanced(None, m1)
+
+
+def test_marker_age_uses_newest_marker():
+    wd.progress("a", v=1)
+    time.sleep(0.02)
+    wd.progress("b", v=1)
+    age = wd.marker_age(wd.markers())
+    assert age is not None and age < 0.02
+    assert wd.marker_age({}) is None and wd.marker_age(None) is None
+
+
+def test_tracker_liveness_clock_resets_only_on_progress():
+    """The tracker-side half of the semantics: ingesting an IDENTICAL
+    marker set (heartbeat) must not reset the staleness clock; an
+    advanced one must."""
+    tr = RabitTracker(n_workers=2, host_ip="127.0.0.1", elastic=True)
+    try:
+        tr._ingest_progress(0, {"train.round": {"t_mono": 1.0, "round": 1}})
+        t_first = tr._liveness[0]["t_advance"]
+        time.sleep(0.02)
+        tr._ingest_progress(0, {"train.round": {"t_mono": 2.0, "round": 1}})
+        assert tr._liveness[0]["t_advance"] == t_first  # heartbeat only
+        tr._ingest_progress(0, {"train.round": {"t_mono": 3.0, "round": 2}})
+        assert tr._liveness[0]["t_advance"] > t_first   # real progress
+        # the journal's per-rank resume round tracks the marker
+        assert tr._progress_round[0] == 2
+        # the shard map marker lands in journalable state
+        tr._ingest_progress(0, {"shard_map": {
+            "t_mono": 4.0, "map": {"num_shards": 4, "world": 2,
+                                   "assign": [0, 1, 0, 1]}}})
+        assert tr._shard_map == {"num_shards": 4, "world": 2,
+                                 "assign": [0, 1, 0, 1]}
+    finally:
+        tr.free()
+
+
+# ---------------------------------------------------------------------------
+# tracker join ladder (the "declare the peer dead" recovery path)
+# ---------------------------------------------------------------------------
+
+def test_join_watchdog_dumps_then_declares_laggard_dead(monkeypatch):
+    """A member that never reaches its round boundary during a pending
+    regroup: warned, asked for a remote stack dump, then declared dead so
+    the epoch forms with the remainder — the survivors get their
+    assignment instead of waiting forever."""
+    monkeypatch.setenv("XGBOOST_TPU_WATCHDOG_TRACKER_JOIN_S", "0.6")
+    tr = RabitTracker(n_workers=2, host_ip="127.0.0.1", elastic=True)
+    tr.start()
+    socks = {}
+
+    def fake_worker(tag, idx):
+        s = socket.create_connection(("127.0.0.1", tr.port), timeout=30)
+        send_msg(s, {"cmd": "start", "host": tag})
+        reply = recv_msg(s)
+        if reply.get("coordinator") is None:
+            send_msg(s, {"cmd": "coordinator", "addr": "127.0.0.1:45678"})
+        socks[idx] = s
+
+    t0 = threading.Thread(target=fake_worker, args=("a", 0))
+    t1 = threading.Thread(target=fake_worker, args=("b", 1))
+    t0.start()
+    t1.start()
+    t0.join(30)
+    t1.join(30)
+    assert len(socks) == 2, "rendezvous did not complete"
+    try:
+        # rank 0 reaches its boundary and joins; rank 1 "stalls" (silent)
+        send_msg(socks[0], {"cmd": "regroup_join", "round": 3})
+        got = {}
+
+        def drain(idx):
+            while True:
+                try:
+                    m = recv_msg(socks[idx], timeout=15.0)
+                except OSError:
+                    m = None
+                if m is None:
+                    got.setdefault(idx, []).append("EOF")
+                    return
+                got.setdefault(idx, []).append(m)
+                if m.get("cmd") == "regroup":
+                    return
+
+        d0 = threading.Thread(target=drain, args=(0,), daemon=True)
+        d1 = threading.Thread(target=drain, args=(1,), daemon=True)
+        d0.start()
+        d1.start()
+        d0.join(15)
+        d1.join(15)
+        # the laggard was asked for its stacks, then severed
+        cmds1 = [m if m == "EOF" else m.get("cmd") for m in got.get(1, [])]
+        assert "stackdump" in cmds1 and "EOF" in cmds1, cmds1
+        # the survivor got the shrunken epoch with its reported round
+        regroup = [m for m in got.get(0, [])
+                   if m != "EOF" and m.get("cmd") == "regroup"]
+        assert regroup and regroup[0]["world"] == 1
+        assert regroup[0]["round"] == 3
+    finally:
+        for s in socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        tr.free()

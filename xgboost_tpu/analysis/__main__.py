@@ -32,7 +32,8 @@ def _parser() -> argparse.ArgumentParser:
         description="xtblint: project-native static analysis for retrace "
                     "hazards (XTB1xx), lock discipline (XTB2xx), fault-seam "
                     "consistency (XTB3xx), metric-name consistency "
-                    "(XTB4xx), and nondeterminism (XTB5xx).")
+                    "(XTB4xx), nondeterminism (XTB5xx), SIMD confinement "
+                    "(XTB6xx), and unbounded blocking calls (XTB7xx).")
     p.add_argument("paths", nargs="*", help="files/directories to lint "
                    "(default: ./xgboost_tpu)")
     p.add_argument("--format", choices=("text", "json"), default="text")

@@ -1,0 +1,118 @@
+"""XTB7xx — unbounded blocking calls (the watchdog's static complement).
+
+The stall watchdog (``reliability/watchdog.py``) can only escalate a
+wedge it can *see*: an operation bracketed by a guard or bounded by a
+timeout eventually surfaces somewhere, but a bare ``Event.wait()``,
+``Queue.get()``, ``Future.result()``, or un-timed socket connect blocks
+a thread forever with nothing watching — the exact hang class the
+watchdog plane exists to eliminate.  This rule family rejects them
+textually (the XTB202 approach: the call shape IS the contract):
+
+- **XTB701** — ``<expr>.wait()`` with no arguments and no ``timeout=``
+  (Event/Condition/Barrier/``Popen.wait`` all block unbounded in this
+  form).  An explicit ``timeout=None`` is allowed: deliberately-forever
+  waits must SAY so (the tracker abort watchers do).
+- **XTB702** — an unbounded blocking consume: zero-argument
+  ``.result()`` (concurrent.futures), or zero-argument ``.get()`` on a
+  queue-named receiver (``q``, ``queue``, ``*_queue`` — plain
+  ``dict.get``/gauge reads don't match).
+- **XTB703** — ``socket.create_connection(addr)`` without a timeout
+  (second positional argument or ``timeout=``): the OS-level connect
+  can block for minutes on a black-holed route.
+
+The watchdog module itself is exempt — it is the one place allowed to
+own blocking primitives, because it is the thing doing the watching.
+Everything else either bounds the call or routes it through a guard.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import Finding, Project, Rule, SourceFile
+
+# the one module allowed to block unbounded (package-relative path)
+_EXEMPT_FILES = ("reliability/watchdog.py",)
+
+_QUEUEISH = ("q", "queue")
+
+
+def _call_tail(func: ast.expr) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _receiver_tail(func: ast.expr) -> str:
+    """Name of the object a method is called on ('x' for x.get, 'b' for
+    a.b.get), lower-cased; '' when unnameable."""
+    if not isinstance(func, ast.Attribute):
+        return ""
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id.lower()
+    if isinstance(v, ast.Attribute):
+        return v.attr.lower()
+    return ""
+
+
+def _has_kwarg(node: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in node.keywords)
+
+
+def _queueish(name: str) -> bool:
+    base = name.lstrip("_")
+    return base in _QUEUEISH or base.endswith("_queue") or base == "queue"
+
+
+class BlockingCallRule(Rule):
+    name = "blocking-calls"
+    codes = {
+        "XTB701": "unbounded .wait() — no argument and no timeout= "
+                  "(Event/Condition/Barrier/Popen block forever here)",
+        "XTB702": "unbounded blocking consume — zero-arg .result(), or "
+                  "zero-arg .get() on a queue-named receiver",
+        "XTB703": "socket.create_connection without a timeout (the "
+                  "connect can black-hole for minutes)",
+    }
+
+    def check_file(self, sf: SourceFile, project: Project,
+                   ) -> Iterable[Finding]:
+        if sf.rel in _EXEMPT_FILES:
+            return ()
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _call_tail(node.func)
+            if (tail == "wait" and not node.args
+                    and not _has_kwarg(node, "timeout")):
+                findings.append(sf.finding(
+                    node, "XTB701",
+                    "unbounded .wait(): pass a timeout (or an explicit "
+                    "timeout=None if blocking forever is the design) — "
+                    "an unwatched wait is the hang class the watchdog "
+                    "plane exists to kill"))
+            elif (tail == "result" and not node.args
+                    and not _has_kwarg(node, "timeout")):
+                findings.append(sf.finding(
+                    node, "XTB702",
+                    "unbounded Future.result(): poll with "
+                    "result(timeout=...) under a watchdog guard so a "
+                    "wedged producer is a detected stall, not a hang"))
+            elif (tail == "get" and not node.args and not node.keywords
+                    and _queueish(_receiver_tail(node.func))):
+                findings.append(sf.finding(
+                    node, "XTB702",
+                    "unbounded queue .get(): pass a timeout (block "
+                    "forever only via an explicit, watched wait)"))
+            elif (tail == "create_connection" and len(node.args) < 2
+                    and not _has_kwarg(node, "timeout")):
+                findings.append(sf.finding(
+                    node, "XTB703",
+                    "socket.create_connection without a timeout: bound "
+                    "the connect so a black-holed route is a detected "
+                    "fault"))
+        return findings

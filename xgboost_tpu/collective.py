@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .elastic import RegroupRequired
+from .reliability import watchdog as _watchdog
 from .reliability.faults import maybe_inject as _maybe_inject
 
 __all__ = [
@@ -437,9 +438,11 @@ class InMemoryBackend(CollBackend):
                     "in-memory group membership changed")
         g.slots[self._rank] = np.asarray(data)
         try:
-            g.barrier.wait()  # all slots filled
+            # bounded (XTB701): a thread worker wedged forever breaks the
+            # barrier for everyone, surfacing an error instead of a hang
+            g.barrier.wait(timeout=600.0)  # all slots filled
             out = np.stack([np.asarray(s) for s in g.slots])
-            g.barrier.wait()  # everyone copied before slots are reused
+            g.barrier.wait(timeout=600.0)  # everyone copied before reuse
         except threading.BrokenBarrierError:
             with g.cond:
                 if g.regroup_pending:
@@ -545,6 +548,29 @@ def _backend() -> CollBackend:
     return _DEFAULT
 
 
+_coll_seq = 0  # liveness counter: collectives completed in this process
+
+
+def _coll_stall(op) -> None:
+    """Collective-wait watchdog stall stage: sever the relay socket so
+    the blocked thread surfaces ``RegroupRequired`` and drains into the
+    elastic regroup — a wedged collective becomes a membership change,
+    not a hang.  A no-op on backends without an interruptible relay
+    (jax.distributed owns its own liveness there)."""
+    t = getattr(_backend(), "_tracker", None)
+    if t is not None and hasattr(t, "interrupt_collective"):
+        t.interrupt_collective()
+
+
+def _coll_progress() -> None:
+    """Advance the liveness marker the tracker's stall monitor compares
+    between telemetry ships: a worker completing collectives is alive
+    however slow its rounds look."""
+    global _coll_seq
+    _coll_seq += 1
+    _watchdog.progress("collective", seq=_coll_seq)
+
+
 _coll_hist = None  # xtb_coll_wait_seconds family (lazy; import stays cheap)
 
 
@@ -594,6 +620,21 @@ def _reconcile_native_kernels() -> None:
         jax.clear_caches()
 
 
+def _reconcile_with_regroup() -> None:
+    """Init-time kernel reconcile that survives a membership change: the
+    epoch-0 first collective can race a peer death or a tracker failover
+    (re-adoption sets the regroup flag before anything trains), and
+    nothing above ``init()`` catches ``RegroupRequired`` — so join the
+    regroup here and retry; the new epoch replays the reconcile as its
+    first collective anyway."""
+    while True:
+        try:
+            _reconcile_native_kernels()
+            return
+        except RegroupRequired:
+            _backend().regroup(0)
+
+
 def init(**args: Any) -> None:
     """Initialize the collective (reference: collective.py:94 init).
 
@@ -634,7 +675,7 @@ def init(**args: Any) -> None:
         _reconcile_native_kernels()
         return
     _PROCESS_BACKEND = JaxDistributedBackend(**args)
-    _reconcile_native_kernels()
+    _reconcile_with_regroup()
 
 
 def finalize() -> None:
@@ -688,7 +729,10 @@ def allreduce(data: np.ndarray, op: Op = Op.SUM) -> np.ndarray:
     # without an installed plan (one global read)
     _maybe_inject("collective.allreduce", rank=get_rank)
     t0 = time.perf_counter()
-    out = _backend().allreduce(np.asarray(data), op)
+    with _watchdog.guard("collective.wait", op="allreduce",
+                         on_stall=_coll_stall):
+        out = _backend().allreduce(np.asarray(data), op)
+    _coll_progress()
     _observe_wait("allreduce", t0)
     return out
 
@@ -700,7 +744,10 @@ def allgather(data: np.ndarray) -> np.ndarray:
     (reference: src/common/quantile.cc:397 AllreduceV of summaries)."""
     _maybe_inject("collective.allgather", rank=get_rank)
     t0 = time.perf_counter()
-    out = _backend().allgather(np.asarray(data))
+    with _watchdog.guard("collective.wait", op="allgather",
+                         on_stall=_coll_stall):
+        out = _backend().allgather(np.asarray(data))
+    _coll_progress()
     _observe_wait("allgather", t0)
     return out
 

@@ -512,19 +512,43 @@ class PageScheduler:
             self._next += 1
 
     def get(self, j: int):
+        from ..reliability import watchdog as _watchdog
+
         if self._lookahead <= 0:
             self._record("load_sync", j)
             arr, dt = self._load(j)
             self._ins[1].inc(dt)  # synchronous: the consumer waited it all
+            _watchdog.progress("extmem.page", page=j)
             return arr
         self._submit_through(j + self._lookahead)
         self._record("wait", j)
         t0 = time.perf_counter()
-        arr, decode_s = self._futures.pop(j).result()
+        fut = self._futures.pop(j)
+        # bounded wait under the extmem watchdog budget (XTB702): each
+        # PAGE gets its own guard, so a slow-but-progressing stream never
+        # escalates — only one decode wedged past the budget does (warn
+        # -> all-thread stack dump -> typed failure; multi-process, the
+        # loud death runs the tracker abort/regroup path)
+        with _watchdog.guard("extmem.decode", page=j) as g:
+            from concurrent.futures import TimeoutError as _FutTimeout
+
+            while True:
+                try:
+                    arr, decode_s = fut.result(timeout=0.5)
+                    break
+                except _FutTimeout:
+                    if g.stalled:
+                        fut.cancel()
+                        raise PageCorruptError(
+                            f"external-memory page {j} decode stalled past "
+                            f"the watchdog budget (stack dump: "
+                            f"{g.stack_path}); failing loud instead of "
+                            "wedging the stream")
         wait_s = time.perf_counter() - t0
         self._record("ready", j)
         self._ins[1].inc(wait_s)
         self._ins[2].inc(max(0.0, decode_s - wait_s))
+        _watchdog.progress("extmem.page", page=j)
         return arr
 
     def close(self) -> None:

@@ -198,7 +198,13 @@ def train_distributed(params: Dict[str, Any], parts: Sequence[Any],
                 p.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
                 p.kill()
-                p.wait()
+                # bounded reap (XTB701): SIGKILL is not waitable-proof on
+                # a wedged kernel-side process, and this loop must report
+                # every worker, not hang on one corpse
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
                 errs.append(f"worker {i}: timed out after {timeout}s")
                 continue
             if p.returncode != 0:

@@ -103,6 +103,74 @@ def flight_dump_path(label) -> Optional[str]:
     return path if os.path.exists(path) else None
 
 
+def stack_dump_path(label) -> Optional[str]:
+    """The all-thread ``faulthandler`` dump a worker spawned with
+    ``label`` would have left (crash path, injected kill, watchdog dump
+    stage) — None when none was written."""
+    from .telemetry import flight
+
+    path = flight.stacks_path(str(label))
+    return path if os.path.exists(path) else None
+
+
+def _postmortem_tail(label, tail: str) -> str:
+    """Append the flight-recorder and stack-dump pointers a corpse left
+    to its stderr tail (what WorkerFailedError.failures carries)."""
+    fp = flight_dump_path(label)
+    if fp:
+        tail += f"\n[flight recorder: {fp}]"
+    sp = stack_dump_path(label)
+    if sp:
+        tail += f"\n[stack dump: {sp}]"
+    return tail
+
+
+_TRACKER_CHILD = r"""
+import sys
+
+host, port, world = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+elastic, journal = sys.argv[4] == "1", sys.argv[5]
+if sys.argv[6]:
+    sys.path.insert(0, sys.argv[6])  # the xgboost_tpu package root
+
+from xgboost_tpu.telemetry import flight
+from xgboost_tpu.tracker import RabitTracker
+
+flight.install()  # label "tracker"/"tracker_r<N>" from the launcher env
+tr = RabitTracker(n_workers=world, host_ip=host, port=port,
+                  elastic=elastic, journal=journal)
+tr.start()
+try:
+    # block until the job finishes; the LAUNCHER owns the overall
+    # deadline and kills this process when the run is over or failed
+    tr.wait_for(timeout=0)
+except RuntimeError:
+    # the job failed — the abort already fanned out to every worker.
+    # Exit 1 tells the launcher "job error", distinct from a crash
+    # (any other status), which is what triggers a respawn.
+    sys.exit(1)
+finally:
+    tr.free()
+"""
+
+
+def _tracker_connectable(port: int, deadline_s: float = 30.0) -> bool:
+    """Poll until the tracker child accepts connections (its import +
+    bind window).  The probe connection EOFs without a handshake, which
+    the tracker's accept loops already treat as a stray scan."""
+    import time
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            socket.create_connection(("127.0.0.1", int(port)),
+                                     timeout=1.0).close()
+            return True
+        except OSError:
+            time.sleep(0.1)
+    return False
+
+
 _CHILD = r"""
 import pickle, sys
 import jax
@@ -142,8 +210,11 @@ try:
     fn(rank, world)
 except BaseException as e:
     # postmortem without tracing: the ring of recent spans/events/faults
-    # survives as a dump the launcher attaches to WorkerFailedError
+    # survives as a dump the launcher attaches to WorkerFailedError —
+    # plus an all-thread faulthandler dump (what were the OTHER threads
+    # doing: prefetch pools, relay watchers, telemetry shippers)
     flight.record("fault", "worker.crash", error=repr(e))
+    flight.dump_stacks()
     flight.dump()
     raise
 finally:
@@ -158,7 +229,9 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
                     fault_plan: Optional[str] = None,
                     rendezvous: str = "auto",
                     elastic: bool = False,
-                    max_respawns: int = 0) -> None:
+                    max_respawns: int = 0,
+                    tracker_failover: bool = False,
+                    max_tracker_respawns: int = 3) -> dict:
     """Spawn ``num_workers`` processes, each running ``fn(rank, world)``
     under an initialized collective.  ``fn`` must be picklable (a module-
     level function).  ``platform`` overrides jax_platforms in the workers
@@ -186,9 +259,28 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
     boundary.  Exit code 255 (tracker abort fan-out: an explicitly
     signalled error) still fails the job even in elastic mode.
 
+    ``tracker_failover``: the tracker runs as a SUPERVISED SUBPROCESS
+    journaling its replayable state (roster, epoch, per-rank resume
+    rounds — reliability/journal.py); a crashed/SIGKILL'd tracker is
+    respawned (up to ``max_tracker_respawns`` times) and recovers from
+    the journal, the surviving workers re-adopt with backoff, and the
+    run continues through an elastic regroup at the same world size —
+    bitwise-identical model bytes under deterministic config (the
+    coordinator stops being a single point of failure;
+    docs/reliability.md "Coordinator failover & watchdog").  Requires
+    ``elastic=True``.  A respawned tracker starts with a CLEAN fault-plan
+    environment, so a plan that killed the first tracker cannot re-kill
+    every successor.  Note the merged-telemetry ingest then happens in
+    the tracker subprocess, not this driver.
+
     Failures raise :class:`WorkerFailedError` carrying each failed
-    worker's spawn index, exit code, and captured stderr tail."""
+    worker's spawn index, exit code, and captured stderr tail.  Returns a
+    stats dict: tolerated worker deaths, worker respawns, tracker
+    respawns, and each tracker-respawn pause wall (death detection to
+    the respawned tracker accepting again) in seconds."""
     tracker = None
+    tracker_proc = None
+    journal_dir = None
     # opt-in driver-side scrape endpoint (XGBOOST_TPU_METRICS_PORT): the
     # tracker ingests worker snapshot ships into the merged registry, and
     # /metrics serves per-rank plus merged series while the job runs
@@ -201,13 +293,22 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
         raise ValueError("elastic mode requires rendezvous='tracker' "
                          "(relay collectives re-form at regroup; a "
                          "jax.distributed world cannot rescale)")
-    if rendezvous == "tracker":
+    if tracker_failover and (rendezvous != "tracker" or not elastic):
+        raise ValueError("tracker_failover requires rendezvous='tracker' "
+                         "AND elastic=True: a re-adopted cohort recovers "
+                         "through the elastic regroup + checkpoint path")
+    if rendezvous == "tracker" and not tracker_failover:
         from .tracker import RabitTracker
 
         tracker = RabitTracker(n_workers=num_workers, host_ip="127.0.0.1",
                                elastic=elastic)
         tracker.start()
         port = tracker.port
+    elif rendezvous == "tracker":
+        port = _free_port()  # the tracker child binds it (and rebinds it
+        #                      on every respawn — workers only know this
+        #                      address)
+        journal_dir = tempfile.mkdtemp(prefix="xtb_tracker_journal_")
     elif rendezvous == "direct":
         port = coordinator_port or _free_port()
     else:
@@ -236,6 +337,33 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
              mod_dir, rendezvous],
             label, err_files, env=env)
 
+    tracker_respawns = 0
+    tracker_pauses = []  # seconds, death detection -> accepting again
+
+    def _spawn_tracker(label):
+        t_env = dict(env)
+        if tracker_respawns:
+            # a respawned coordinator must start with a clean plan: the
+            # per-process seam counters restart at 0, so the spec that
+            # killed the first tracker would re-fire in every successor
+            t_env.pop("XGBOOST_TPU_FAULT_PLAN", None)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        argv = [sys.executable, "-c", _TRACKER_CHILD, "127.0.0.1",
+                str(port), str(num_workers), "1" if elastic else "0",
+                os.path.join(journal_dir, "tracker.xtbjrnl"), pkg_root]
+        return spawn_worker(argv, label, err_files, env=t_env)
+
+    if tracker_failover:
+        tracker_proc = _spawn_tracker("tracker")
+        if not _tracker_connectable(port):
+            tracker_proc.kill()
+            raise WorkerFailedError(
+                "tracker subprocess never became connectable; stderr "
+                "tail:\n" + stderr_tail(err_files["tracker"]),
+                [("tracker", tracker_proc.poll(),
+                  stderr_tail(err_files["tracker"]))])
+
     pending = {rank: _spawn(rank) for rank in range(num_workers)}
     respawned = 0
     succeeded = 0
@@ -244,6 +372,51 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
         deadline = time.monotonic() + timeout
         failures = []  # (label, rc, stderr_tail)
         while pending:
+            if tracker_proc is not None:
+                rc_t = tracker_proc.poll()
+                if rc_t is not None:
+                    if rc_t == 1:
+                        # the tracker declared the JOB failed (it already
+                        # fanned the abort out): stop supervising; the
+                        # workers' 255 exits carry the failure below
+                        tracker_proc = None
+                    elif rc_t == 0:
+                        # clean completion: the workers are finishing too
+                        tracker_proc = None
+                    elif tracker_respawns >= max_tracker_respawns:
+                        for p in pending.values():
+                            p.kill()
+                        raise WorkerFailedError(
+                            f"tracker crashed (exit {rc_t}) with the "
+                            f"respawn budget ({max_tracker_respawns}) "
+                            "spent", [("tracker", rc_t,
+                                       stderr_tail(err_files.get(
+                                           f"tracker_r{tracker_respawns}"
+                                           if tracker_respawns
+                                           else "tracker", "")))])
+                    else:
+                        # coordinator crash (SIGKILL, injected kill, bug):
+                        # respawn it against the journal — the workers
+                        # are re-adopting with backoff meanwhile, and the
+                        # pause ends when the new tracker accepts
+                        t0 = time.monotonic()
+                        tracker_respawns += 1
+                        print(f"[launcher] tracker exited {rc_t}; "
+                              f"respawning against the journal "
+                              f"({tracker_respawns}/{max_tracker_respawns})",
+                              flush=True)
+                        tracker_proc = _spawn_tracker(
+                            f"tracker_r{tracker_respawns}")
+                        if not _tracker_connectable(port):
+                            for p in pending.values():
+                                p.kill()
+                            raise WorkerFailedError(
+                                "respawned tracker never became "
+                                "connectable",
+                                [("tracker", rc_t, stderr_tail(
+                                    err_files[
+                                        f"tracker_r{tracker_respawns}"]))])
+                        tracker_pauses.append(time.monotonic() - t0)
             for label, p in list(pending.items()):
                 rc = p.poll()
                 if rc is None:
@@ -253,17 +426,24 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
                     succeeded += 1
                     continue
                 tail = stderr_tail(err_files[label])
-                late_respawn = (isinstance(label, str)
-                                and label.startswith("respawn")
-                                and succeeded > 0)
+                # a death after peers already finished is still a
+                # survivable death (a watchdog-declared stall wakes and
+                # dies LAST, after the survivors completed the run) —
+                # only "nobody succeeded and nobody is left" is fatal
+                survivors_exist = succeeded > 0
                 # a death during the initial rendezvous cannot be
                 # regrouped (the tracker is still collecting the cohort);
                 # tolerating it would leave the survivors blocked in
-                # their handshakes until the full job timeout
-                regroupable = (tracker is not None
-                               and tracker.rendezvous_complete)
+                # their handshakes until the full job timeout.  With a
+                # subprocess tracker the journal's existence IS the
+                # rendezvous-complete signal: its first record is the
+                # initial roster.
+                regroupable = (
+                    (tracker is not None and tracker.rendezvous_complete)
+                    or (journal_dir is not None and os.path.exists(
+                        os.path.join(journal_dir, "tracker.xtbjrnl"))))
                 if (elastic and rc != 255 and regroupable
-                        and (pending or late_respawn)):
+                        and (pending or survivors_exist)):
                     # a death the survivors absorb (rc 255 means the
                     # tracker itself declared the job failed)
                     tolerated.append((label, rc))
@@ -283,13 +463,11 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
                 for p in pending.values():
                     p.kill()
                 # attach each corpse's flight-recorder dump (crash dump or
-                # last periodic spill — the ring of recent spans/events/
-                # faults that makes the postmortem possible without tracing)
-                failures = [
-                    (r, rc,
-                     tail + (f"\n[flight recorder: {fp}]"
-                             if (fp := flight_dump_path(r)) else ""))
-                    for r, rc, tail in failures]
+                # last periodic spill) and its all-thread faulthandler
+                # stack dump — the pair that makes the postmortem possible
+                # without tracing or a debugger
+                failures = [(r, rc, _postmortem_tail(r, tail))
+                            for r, rc, tail in failures]
                 labels = [f[0] for f in failures]
                 detail = ", ".join(
                     f"rank {r}: " + ("aborted by tracker fan-out"
@@ -312,6 +490,12 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
     finally:
         if tracker is not None:
             tracker.free()
+        if tracker_proc is not None:
+            tracker_proc.kill()
+        if journal_dir is not None:
+            import shutil
+
+            shutil.rmtree(journal_dir, ignore_errors=True)
         try:
             os.unlink(fn_path)
         except OSError:
@@ -321,3 +505,6 @@ def run_distributed(fn: Callable[[int, int], None], num_workers: int,
                 os.unlink(path)
             except OSError:
                 pass
+    return {"tolerated": list(tolerated), "respawned": respawned,
+            "succeeded": succeeded, "tracker_respawns": tracker_respawns,
+            "tracker_pauses_s": tracker_pauses}

@@ -196,15 +196,23 @@ class LifecycleManager:
 
     @contextlib.contextmanager
     def _phase(self, name: str, timings: Dict[str, float]):
+        from ..reliability import watchdog
+
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            timings[name] = dt
-            instruments()[0].labels(name).observe(dt)
-            _flight.record("event", f"lifecycle.{name}", seconds=dt,
-                           trace=self._cycle_trace)
+        # watchdog bracket (warn -> all-thread stack dump; no stall
+        # action: the phase runs on THIS thread, so there is no peer to
+        # declare dead — the dump is the diagnosis, and the cycle's own
+        # exception/gate machinery owns the recovery)
+        with watchdog.guard("lifecycle.phase", phase=name):
+            try:
+                yield
+            finally:
+                dt = time.perf_counter() - t0
+                timings[name] = dt
+                instruments()[0].labels(name).observe(dt)
+                _flight.record("event", f"lifecycle.{name}", seconds=dt,
+                               trace=self._cycle_trace)
+        watchdog.progress("lifecycle.phase", phase=name)
 
     def _ckpt_dir(self, incumbent_version: int) -> Optional[str]:
         if self.config.checkpoint_dir is None:

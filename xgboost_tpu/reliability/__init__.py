@@ -32,13 +32,16 @@ fault-plan schema, serving degradation behavior).
 """
 from __future__ import annotations
 
-from . import faults, integrity
+from . import faults, integrity, watchdog
 from .checkpoint import (CheckpointCallback, CheckpointManager,
                          CheckpointState, latest_checkpoint, scrub_dir)
 from .faults import FaultInjected, FaultPlan, FaultSpec, corrupt_bytes
+from .journal import TrackerJournal
 from .retry import RetriesExhausted, backoff_delays, retry_call
 
 __all__ = [
+    "TrackerJournal",
+    "watchdog",
     "CheckpointCallback",
     "CheckpointManager",
     "CheckpointState",

@@ -12,7 +12,9 @@ gap (docs/reliability.md "Integrity & chaos"):
   same schedule, byte for byte; there is no other source of randomness.
 - **Scenario templates** (:data:`SCENARIOS`) — an external-memory
   training run, a serving fleet under traffic, a lifecycle hot-swap
-  cycle, and a multi-process elastic training run; each knows which
+  cycle, a multi-process elastic training run, a coordinator-failover
+  run (the supervised tracker SIGKILL'd at a journal write), and a
+  stall-watchdog run (a delay past tight budgets); each knows which
   (seam, kind) pairs its stack must *survive* (a green episode means the
   faults fired AND the contract held — nothing in a catalog is allowed
   to be fatal).
@@ -38,11 +40,11 @@ gap (docs/reliability.md "Integrity & chaos"):
   ``python scripts/chaos_soak.py --replay <scenario> <seed>``.
 
 Kill-kind faults appear only in catalogs whose seams fire inside
-launcher-spawned worker/replica subprocesses — a kill at a driver-side
-seam would take the harness down with it (``os._exit``), which is why the
-lifecycle catalog injects ``exception`` at ``lifecycle.swap`` here and
-leaves the kill-mid-swap replay to ``scripts/lifecycle_smoke.py``'s
-subprocess rig.
+launcher-spawned subprocesses (workers, or the supervised tracker child
+for ``tracker.journal``) — a kill at a driver-side seam would take the
+harness down with it (``os._exit``), which is why the lifecycle catalog
+injects ``exception`` at ``lifecycle.swap`` here and leaves the
+kill-mid-swap replay to ``scripts/lifecycle_smoke.py``'s subprocess rig.
 """
 from __future__ import annotations
 
@@ -449,6 +451,145 @@ def _check_elastic(fired, artifacts, baseline) -> Dict[str, str]:
     return inv
 
 
+# ------------------------------------------------------------ tracker_kill
+def _active_plan_json() -> Optional[str]:
+    """The installed plan re-serialized for the launcher's env
+    passthrough (driver-side it fires nothing — the subprocess scenarios'
+    accounting invariant holds at 0)."""
+    plan = faults.active()
+    if plan is None:
+        return None
+    return json.dumps({"faults": [dataclasses.asdict(s)
+                                  for s in plan.specs]})
+
+
+def _run_tracker_kill(workdir: str) -> dict:
+    import functools
+
+    from ..launcher import run_distributed
+    from .checkpoint import latest_checkpoint
+
+    plan = faults.active()
+    kills = sum(1 for s in (plan.specs if plan else [])
+                if s.site == "tracker.journal" and s.kind == "kill")
+    ckpt = os.path.join(workdir, "ck")
+    out = os.path.join(workdir, "model.ubj")
+    stats = run_distributed(
+        functools.partial(_elastic_chaos_worker, ckpt_dir=ckpt,
+                          out_path=out, rounds=6, num_shards=4),
+        num_workers=2, platform="cpu", timeout=300, rendezvous="tracker",
+        elastic=True, fault_plan=_active_plan_json(), max_respawns=0,
+        tracker_failover=True)
+    st = latest_checkpoint(ckpt)
+    with open(out, "rb") as fh:
+        model = fh.read()
+    return {"digest": _digest(model), "round": st.round if st else -1,
+            "world": st.world if st else -1,
+            "respawns": int(stats["tracker_respawns"]),
+            "pauses_s": [round(p, 3) for p in stats["tracker_pauses_s"]],
+            "kills_scheduled": kills}
+
+
+def _check_tracker_kill(fired, artifacts, baseline) -> Dict[str, str]:
+    """The bitwise-vs-twin check (run_episode does it: twin=True) is the
+    heart — a SIGKILL'd coordinator must not change one model bit."""
+    inv = {}
+    inv["finished_all_rounds"] = (
+        "ok" if artifacts["round"] == 6
+        else f"FAIL: finished at round {artifacts['round']}, wanted 6")
+    inv["world_preserved"] = (
+        "ok" if artifacts["world"] == 2
+        else f"FAIL: world {artifacts['world']} != 2 — a tracker death "
+             "must not cost a worker")
+    inv["respawns_bounded"] = (
+        "ok" if artifacts["respawns"] <= artifacts["kills_scheduled"]
+        else f"FAIL: {artifacts['respawns']} tracker respawns for "
+             f"{artifacts['kills_scheduled']} scheduled kills")
+    if artifacts["kills_scheduled"]:
+        inv["tracker_respawned"] = (
+            "ok" if artifacts["respawns"] >= 1
+            else "FAIL: a tracker kill was scheduled but no respawn "
+                 "happened (the kill never fired?)")
+    return inv
+
+
+# ------------------------------------------------------------------- stall
+_STALL_BUDGET_S = 1.5
+
+
+def _run_stall(workdir: str) -> dict:
+    import functools
+    import glob
+
+    from ..launcher import run_distributed
+    from .checkpoint import latest_checkpoint
+
+    plan = faults.active()
+    stalls = sum(1 for s in (plan.specs if plan else [])
+                 if s.kind == "delay" and s.site == "train.round"
+                 and s.seconds >= 3.0 * _STALL_BUDGET_S)
+    ckpt = os.path.join(workdir, "ck")
+    out = os.path.join(workdir, "model.ubj")
+    flight_dir = os.path.join(workdir, "flight")
+    # tight budgets + a scenario-local flight dir, env-inherited by the
+    # spawned workers; restored so later episodes (fleet, lifecycle) keep
+    # the production defaults
+    overrides = {
+        "XGBOOST_TPU_FLIGHT_DIR": flight_dir,
+        "XGBOOST_TPU_WATCHDOG_COLLECTIVE_WAIT_S": str(_STALL_BUDGET_S),
+        "XGBOOST_TPU_WATCHDOG_TRACKER_JOIN_S": str(_STALL_BUDGET_S),
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        stats = run_distributed(
+            functools.partial(_elastic_chaos_worker, ckpt_dir=ckpt,
+                              out_path=out, rounds=6, num_shards=4),
+            num_workers=2, platform="cpu", timeout=200,
+            rendezvous="tracker", elastic=True,
+            fault_plan=_active_plan_json(), max_respawns=0)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    st = latest_checkpoint(ckpt)
+    with open(out, "rb") as fh:
+        model = fh.read()
+    stacks = glob.glob(os.path.join(flight_dir, "stacks_*.txt"))
+    return {"digest": _digest(model), "round": st.round if st else -1,
+            "world": st.world if st else -1, "stacks": len(stacks),
+            "tolerated": len(stats["tolerated"]),
+            "stalls_scheduled": stalls}
+
+
+def _check_stall(fired, artifacts, baseline) -> Dict[str, str]:
+    """A delay past the watchdog budget must produce a stack dump and
+    recovery through the elastic regroup — never a hang (the episode
+    deadline, `no_hang`, is the other half of the contract)."""
+    inv = {}
+    inv["finished_all_rounds"] = (
+        "ok" if artifacts["round"] == 6
+        else f"FAIL: finished at round {artifacts['round']}, wanted 6")
+    if artifacts["stalls_scheduled"]:
+        inv["stack_dump_written"] = (
+            "ok" if artifacts["stacks"] >= 1
+            else "FAIL: a stall-class delay fired but the watchdog left "
+                 "no faulthandler dump")
+        inv["stalled_peer_declared_dead"] = (
+            "ok" if artifacts["world"] == 1
+            else f"FAIL: world {artifacts['world']} — the survivors did "
+                 "not regroup past the stalled rank")
+    else:
+        inv["no_false_positive"] = (
+            "ok" if artifacts["world"] == 2 and artifacts["stacks"] == 0
+            else f"FAIL: no stall-class fault, yet world="
+                 f"{artifacts['world']} stacks={artifacts['stacks']} — "
+                 "the watchdog escalated a legitimately slow run")
+    return inv
+
+
 def _pin_kill_at(spec: dict) -> dict:
     # a {rank, round} kill re-fires when a survivor inherits the rank and
     # redoes the round (docs/reliability.md, the elastic sharp edge):
@@ -515,6 +656,41 @@ SCENARIOS: Dict[str, Scenario] = {
         ),
         run=_run_elastic, check=_check_elastic, twin=False,
         cost_hint_s=45.0, deadline_s=300.0),
+    "tracker_kill": Scenario(
+        name="tracker_kill",
+        catalog=(
+            # at=0 dies at the roster write (right after rendezvous),
+            # at=1 at the first progress write — both mid-job; the kill
+            # fires in the TRACKER subprocess (the launcher clears the
+            # plan env for respawns, so successors survive)
+            CatalogEntry("tracker.journal", "kill", {"at": [0, 1]}),
+            CatalogEntry("train.round", "delay",
+                         {"seconds": (0.2, 0.5), "times": [4, 8]}),
+            CatalogEntry("collective.allreduce", "delay",
+                         {"seconds": (0.001, 0.01), "at": (0, 30)}),
+        ),
+        run=_run_tracker_kill, check=_check_tracker_kill, twin=True,
+        cost_hint_s=50.0, deadline_s=300.0, max_faults=3,
+        per_plan_caps={("tracker.journal", "kill"): 2}),
+    "stall": Scenario(
+        name="stall",
+        catalog=(
+            # a delay far past the scenario's 1.5s watchdog budgets: the
+            # collective-wait guard dumps + severs, the tracker's join
+            # ladder declares the sleeper dead, the survivors regroup —
+            # dump + recovery, never a deadline red
+            CatalogEntry("train.round", "delay",
+                         {"seconds": (6.0, 9.0), "rank": [1],
+                          "round": [2, 3]}, post=_pin_kill_at),
+            # benign: well under budget — must NOT trip the ladder
+            CatalogEntry("train.round", "delay",
+                         {"seconds": (0.05, 0.3), "rank": [0],
+                          "round": (0, 5), "times": [1, 3]}),
+            CatalogEntry("watchdog.escalate", "delay",
+                         {"seconds": (0.01, 0.05)}),
+        ),
+        run=_run_stall, check=_check_stall, twin=False,
+        cost_hint_s=40.0, deadline_s=240.0, max_faults=3),
 }
 
 

@@ -84,6 +84,8 @@ SEAMS = frozenset({
     "extmem.page_decode",
     "wire.frame",
     "modelstore.publish",
+    "tracker.journal",
+    "watchdog.escalate",
 })
 
 # Debug guard: with XGBOOST_TPU_STRICT_SEAMS=1, maybe_inject() rejects
@@ -312,9 +314,12 @@ def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
               f"{spec.message}", file=sys.stderr, flush=True)
         try:
             # os._exit skips atexit: flush the flight ring NOW so the
-            # launcher/fleet postmortem has this process's last moments
+            # launcher/fleet postmortem has this process's last moments —
+            # and an all-thread stack dump, so the postmortem shows what
+            # every OTHER thread was doing when this one died
             from ..telemetry import flight
 
+            flight.dump_stacks()
             flight.dump()
         except Exception:
             pass

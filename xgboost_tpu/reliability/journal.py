@@ -1,0 +1,182 @@
+"""Tracker state journal: the coordinator's replayable on-disk memory.
+
+The `RabitTracker` is the one process whose death used to end (or wedge)
+the whole job: the rendezvous roster, relay epoch, and regroup state
+lived only in its heap.  This module gives it the same crash discipline
+checkpoints gave the model (reliability/checkpoint.py): every membership
+transition is appended to an fsync'd journal with XTBCKPT-style checksum
+framing, and a respawned tracker replays the last valid record to pick
+up exactly where its predecessor died — the re-adoption protocol in
+docs/reliability.md "Coordinator failover & watchdog".
+
+File format (append-only)::
+
+    "XTBJRNL1"                                  file header, written once
+    "JR" | u32 len | u32 crc32(payload) | payload(JSON)   per record
+
+``load()`` walks the records front to back and returns the LAST fully
+valid one; a torn tail (the tracker was SIGKILL'd mid-append — the
+``tracker.journal`` fault seam injects exactly this) or a flipped byte
+fails that record's CRC and the walk stops at the previous good state,
+which is always a committed membership transition.  The file is
+compacted (atomic rewrite with a single record) once it accumulates
+``COMPACT_EVERY`` records, so a long-running job's journal stays tiny.
+
+What a record carries is deliberately small — everything needed to
+re-form the job, nothing that can be rederived: the listening port,
+original worker count, elastic flag, relay epoch, the live roster with
+each rank's last reported resume round (from the piggybacked watchdog
+progress markers), the latest shard map any rank reported, and whether a
+regroup was pending.  Model state never enters the journal: recovery
+reloads it from the elastic checkpoints, same as any worker death.
+
+Telemetry: ``xtb_tracker_journal_writes_total``,
+``xtb_tracker_journal_recoveries_total`` (docs/observability.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Optional
+
+__all__ = ["TrackerJournal", "MAGIC", "COMPACT_EVERY"]
+
+MAGIC = b"XTBJRNL1"
+_REC = b"JR"
+_HDR = struct.Struct(">II")  # payload length, crc32(payload)
+COMPACT_EVERY = 512
+# one journal record is a tiny roster dict; anything bigger is a
+# corrupted length prefix and must not drive an allocation
+_MAX_RECORD = 1 << 22
+
+_instruments = None
+
+
+def _ins():
+    global _instruments
+    if _instruments is None:
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        _instruments = (
+            reg.counter("xtb_tracker_journal_writes_total",
+                        "tracker journal records committed (fsync'd "
+                        "membership transitions)"),
+            reg.counter("xtb_tracker_journal_recoveries_total",
+                        "tracker restarts that recovered state from the "
+                        "journal"),
+        )
+    return _instruments
+
+
+class TrackerJournal:
+    """Append-only checksummed journal for one tracker's state."""
+
+    def __init__(self, path: str) -> None:
+        self.path = os.fspath(path)
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        self._records_since_open = 0
+
+    # -------------------------------------------------------------- write
+    def append(self, state: Dict[str, Any]) -> None:
+        """Commit one state record: frame, append, flush, fsync.  The
+        ``tracker.journal`` fault seam fires first, so a kill-kind spec
+        deterministically dies the tracker process at a journal write and
+        a corrupt-kind spec damages the record to prove the torn-tail
+        walk ignores it."""
+        from . import faults
+
+        payload = json.dumps(state, sort_keys=True).encode()
+        spec = faults.maybe_inject("tracker.journal")
+        if spec is not None and spec.kind == "corrupt":
+            # damage AFTER the CRC is computed over the original payload:
+            # the record must fail verification at load, not decode wrong
+            frame = (_REC + _HDR.pack(len(payload), zlib.crc32(payload))
+                     + faults.corrupt_bytes(payload, spec))
+        else:
+            frame = (_REC + _HDR.pack(len(payload), zlib.crc32(payload))
+                     + payload)
+        fresh = not os.path.exists(self.path)
+        with open(self.path, "ab") as fh:
+            if fresh or fh.tell() == 0:
+                fh.write(MAGIC)
+            fh.write(frame)
+            fh.flush()
+            os.fsync(fh.fileno())
+        _ins()[0].inc()
+        self._records_since_open += 1
+        if self._records_since_open >= COMPACT_EVERY:
+            self._compact(state)
+
+    def _compact(self, state: Dict[str, Any]) -> None:
+        """Atomic rewrite with a single record (tmp + fsync + rename)."""
+        payload = json.dumps(state, sort_keys=True).encode()
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as fh:
+                fh.write(MAGIC + _REC
+                         + _HDR.pack(len(payload), zlib.crc32(payload))
+                         + payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        self._records_since_open = 0
+
+    # --------------------------------------------------------------- read
+    def load(self, count_recovery: bool = False,
+             repair: bool = False) -> Optional[Dict[str, Any]]:
+        """The last fully valid record, or None (missing/empty/unreadable
+        file, bad header, or no record surviving the CRC walk).  A torn
+        or corrupted tail stops the walk at the previous good record.
+
+        ``repair=True`` (the recovering tracker passes it) additionally
+        TRUNCATES a detected torn/damaged tail: appends land after the
+        last committed record, not after garbage the next recovery's
+        walk would stop at — without repair, a post-tear append would be
+        permanently unreachable."""
+        try:
+            with open(self.path, "rb") as fh:
+                blob = fh.read()
+        except OSError:
+            return None
+        if not blob.startswith(MAGIC):
+            return None
+        off = len(MAGIC)
+        valid_end = off
+        last: Optional[Dict[str, Any]] = None
+        while off + len(_REC) + _HDR.size <= len(blob):
+            if blob[off: off + len(_REC)] != _REC:
+                break  # framing lost: nothing after this can be trusted
+            off += len(_REC)
+            n, crc = _HDR.unpack_from(blob, off)
+            off += _HDR.size
+            if n > _MAX_RECORD or off + n > len(blob):
+                break  # torn tail / insane length
+            payload = blob[off: off + n]
+            off += n
+            if zlib.crc32(payload) != crc:
+                break  # damaged record: stop at the previous good state
+            try:
+                last = json.loads(payload.decode())
+            except (ValueError, UnicodeDecodeError):
+                break
+            valid_end = off
+        if repair and valid_end < len(blob):
+            try:
+                with open(self.path, "r+b") as fh:
+                    fh.truncate(valid_end)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+            except OSError:
+                pass  # read-only media: appends were impossible anyway
+        if last is not None and count_recovery:
+            _ins()[1].inc()
+        return last

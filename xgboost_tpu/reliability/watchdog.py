@@ -1,0 +1,385 @@
+"""Stall watchdog: per-seam budgets, a deterministic escalation ladder,
+and liveness progress markers — a stalled-but-alive process becomes a
+*detected* fault instead of an invisible wedge.
+
+Every other reliability layer reacts to a process *dying* (tracker EOF
+fan-out, relay departure, replica death rerouting).  A process that is
+alive but stuck — a peer sleeping in a driver bug, a decode thread lost
+in a syscall, a replica wedged mid-execute — moves no sockets and trips
+nothing until an outer chaos deadline declares the whole episode red.
+This module closes that gap with three pieces (docs/reliability.md
+"Coordinator failover & watchdog"):
+
+- **Guards** (:func:`guard`): a context manager bracketing one blocking
+  operation at a named seam with a wall-clock budget.  A monitor thread
+  walks the in-flight set and escalates deterministically:
+
+  1. ``warn``  (1.0x budget) — stderr warning + flight-ring event +
+     ``xtb_watchdog_escalations_total{seam,stage="warn"}``;
+  2. ``dump``  (1.5x budget) — ``faulthandler.dump_traceback`` of ALL
+     threads into the flight-recorder directory
+     (:func:`~xgboost_tpu.telemetry.flight.dump_stacks`) plus a flight
+     ring dump, so the postmortem exists *before* anything is killed;
+  3. ``stall`` (2.0x budget) — the op's ``stalled`` flag is set and its
+     ``on_stall`` callback runs (close the relay socket, exit the
+     replica), steering the failure into an EXISTING recovery path
+     (elastic regroup, replica reroute) instead of a hang.  The
+     ``watchdog.escalate`` fault seam fires here so chaos plans can
+     perturb the ladder deterministically.
+
+- **Progress markers** (:func:`progress`): cheap process-local liveness
+  breadcrumbs (current round, collective seq, page index, request id)
+  that ship to the driver inside every telemetry snapshot
+  (``telemetry.distributed.snapshot_payload``).  The tracker compares a
+  rank's successive markers with :func:`advanced` — a *slow but
+  progressing* worker keeps resetting its staleness clock; only frozen
+  markers age (pinned by ``tests/test_watchdog.py``).
+
+- **Budgets**: per-seam seconds, overridable per seam via
+  ``XGBOOST_TPU_WATCHDOG_<SEAM>_S`` (seam upper-cased, dots to
+  underscores, e.g. ``XGBOOST_TPU_WATCHDOG_COLLECTIVE_WAIT_S``);
+  ``XGBOOST_TPU_WATCHDOG=0`` disables every guard (each then costs one
+  cached flag test).
+
+This module is the one place allowed to own unbounded blocking
+primitives — everywhere else the xtblint XTB7xx family rejects
+``.wait()`` / queue ``.get()`` / ``.result()`` / socket connects without
+an explicit timeout (docs/static_analysis.md).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["guard", "note", "progress", "markers", "advanced",
+           "marker_age", "check_now", "enabled", "budget_for", "configure",
+           "reset", "DEFAULT_BUDGETS", "STAGES", "WARN_AT", "DUMP_AT",
+           "STALL_AT", "ENV_ENABLE"]
+
+ENV_ENABLE = "XGBOOST_TPU_WATCHDOG"
+_ENV_PREFIX = "XGBOOST_TPU_WATCHDOG_"
+_ENV_TICK = "XGBOOST_TPU_WATCHDOG_TICK_S"
+
+# escalation thresholds as multiples of the seam budget
+WARN_AT, DUMP_AT, STALL_AT = 1.0, 1.5, 2.0
+STAGES = ("warn", "dump", "stall")
+
+# Per-seam budget defaults (seconds).  Generous on purpose: the watchdog
+# exists to catch *wedges*, not to police slow rounds — the false-positive
+# contract (tests/test_watchdog.py) is that legitimate slowness under
+# budget never escalates.  Every value is env-overridable (module doc).
+DEFAULT_BUDGETS: Dict[str, float] = {
+    "collective.wait": 300.0,   # one blocked collective (relay op_timeout
+    #                             is 600s; the watchdog dumps first)
+    "extmem.decode": 180.0,     # one page decode/stage wait
+    "replica.execute": 120.0,   # one replica request, admission to reply
+    "lifecycle.phase": 900.0,   # one lifecycle phase (train can be long)
+    "tracker.peer": 300.0,      # tracker-side: a rank's progress markers
+    #                             frozen while its channel stays up
+    "tracker.join": 120.0,      # tracker-side: a member not reaching its
+    #                             round boundary during a pending regroup
+}
+_FALLBACK_BUDGET = 300.0
+
+_lock = threading.Lock()
+_ops: Dict[int, "_Operation"] = {}
+_next_id = 0
+_monitor: Optional[threading.Thread] = None
+_markers: Dict[str, Dict[str, Any]] = {}
+_enabled_override: Optional[bool] = None
+_tick_override: Optional[float] = None
+_instruments = None
+
+
+def _ins():
+    global _instruments
+    if _instruments is None:
+        from ..telemetry.registry import get_registry
+
+        _instruments = get_registry().counter(
+            "xtb_watchdog_escalations_total",
+            "watchdog escalations by seam and ladder stage "
+            "(warn -> dump -> stall)", ("seam", "stage"))
+    return _instruments
+
+
+def enabled() -> bool:
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get(ENV_ENABLE, "").strip() != "0"
+
+
+def budget_for(seam: str) -> float:
+    """The seam's budget in seconds (env override, else the default)."""
+    env = _ENV_PREFIX + seam.upper().replace(".", "_") + "_S"
+    raw = os.environ.get(env, "").strip()
+    if raw:
+        try:
+            return max(0.05, float(raw))
+        except ValueError:
+            pass
+    return DEFAULT_BUDGETS.get(seam, _FALLBACK_BUDGET)
+
+
+def _tick_s() -> float:
+    if _tick_override is not None:
+        return _tick_override
+    try:
+        return max(0.02, float(os.environ.get(_ENV_TICK, "1.0")))
+    except ValueError:
+        return 1.0
+
+
+class _Operation:
+    """One in-flight guarded operation."""
+
+    __slots__ = ("seam", "budget", "t0", "detail", "on_stall", "stage",
+                 "stalled", "stack_path", "done")
+
+    def __init__(self, seam: str, budget: float,
+                 on_stall: Optional[Callable[["_Operation"], None]],
+                 detail: Dict[str, Any]) -> None:
+        self.seam = seam
+        self.budget = budget
+        self.t0 = time.monotonic()
+        self.detail = detail
+        self.on_stall = on_stall
+        self.stage = 0           # 0 = nominal, then warn/dump/stall
+        self.stalled = False     # set at the stall stage; pollable
+        self.stack_path: Optional[str] = None
+        self.done = False        # guard exited: must never escalate
+
+    def elapsed(self, now: Optional[float] = None) -> float:
+        return (time.monotonic() if now is None else now) - self.t0
+
+
+class _NoopGuard:
+    """Shared disabled-path guard: one attribute read per poll."""
+
+    stalled = False
+    stage = 0
+    stack_path = None
+
+    def __enter__(self) -> "_NoopGuard":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        return None
+
+
+_NOOP = _NoopGuard()
+
+
+class guard:
+    """Bracket one blocking operation at ``seam`` under the watchdog.
+
+    Returns an object with ``stalled`` (set once the ladder reached the
+    stall stage — pollable from wait loops), ``stage``, and
+    ``stack_path`` (the faulthandler dump, once written).  ``on_stall``
+    runs ONCE at the stall stage, from the monitor thread — it must only
+    poke another thread awake (close a socket, set a flag), never block.
+    """
+
+    __slots__ = ("_op", "_id")
+
+    def __init__(self, seam: str, *, budget_s: Optional[float] = None,
+                 on_stall: Optional[Callable[["_Operation"], None]] = None,
+                 **detail: Any) -> None:
+        if not enabled():
+            self._op = None
+            self._id = -1
+            return
+        self._op = _Operation(
+            seam, budget_for(seam) if budget_s is None else float(budget_s),
+            on_stall, detail)
+        self._id = _register(self._op)
+
+    def __enter__(self):
+        if self._op is None:
+            return _NOOP
+        return self._op
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._op is not None:
+            # flag FIRST: the monitor snapshots the op set lock-free, so
+            # an op completing right at a stage threshold must not have a
+            # destructive stall action run against healthy work
+            self._op.done = True
+            with _lock:
+                _ops.pop(self._id, None)
+        return None
+
+
+def _register(op: _Operation) -> int:
+    global _next_id, _monitor
+    with _lock:
+        _next_id += 1
+        oid = _next_id
+        _ops[oid] = op
+        if _monitor is None or not _monitor.is_alive():
+            _monitor = threading.Thread(target=_monitor_loop, daemon=True,
+                                        name="xtb-watchdog")
+            _monitor.start()
+    return oid
+
+
+def _monitor_loop() -> None:
+    while True:
+        time.sleep(_tick_s())
+        try:
+            check_now()
+        except Exception:  # pragma: no cover - the watchdog must not die
+            pass
+
+
+def check_now(now: Optional[float] = None) -> List[tuple]:
+    """Walk the in-flight set once and apply due escalations; returns
+    ``[(seam, stage), ...]`` for every transition taken this call.  The
+    monitor thread calls this every tick; tests call it directly for
+    deterministic stage control."""
+    now = time.monotonic() if now is None else now
+    with _lock:
+        live = list(_ops.values())
+    fired: List[tuple] = []
+    for op in live:
+        e = op.elapsed(now)
+        while op.stage < len(STAGES) and not op.done:
+            threshold = (WARN_AT, DUMP_AT, STALL_AT)[op.stage]
+            if e < op.budget * threshold:
+                break
+            op.stage += 1
+            stage = STAGES[op.stage - 1]
+            _escalate(op, stage)
+            fired.append((op.seam, stage))
+    return fired
+
+
+def _escalate(op: _Operation, stage: str) -> None:
+    import sys
+
+    from ..telemetry import flight
+
+    _ins().labels(op.seam, stage).inc()
+    flight.record("fault", "watchdog." + stage, seam=op.seam,
+                  elapsed_s=round(op.elapsed(), 3), budget_s=op.budget,
+                  **op.detail)
+    print(f"[watchdog] {stage}: {op.seam} blocked "
+          f"{op.elapsed():.1f}s (budget {op.budget:.1f}s) "
+          f"{op.detail or ''}", file=sys.stderr, flush=True)
+    if stage == "dump":
+        # the all-thread stack dump lands BEFORE anything is killed: the
+        # postmortem must exist even if the stall stage takes the process
+        op.stack_path = flight.dump_stacks()
+        try:
+            flight.dump()
+        except OSError:
+            pass
+    elif stage == "stall":
+        from . import faults
+
+        try:
+            # deterministic perturbation point for chaos plans (delay /
+            # exception); an injected exception must not kill the monitor
+            faults.maybe_inject("watchdog.escalate")
+        except faults.FaultInjected:
+            pass
+        op.stalled = True
+        # last-instant completion check: the destructive poke must not
+        # hit work that just finished (the window is now one statement,
+        # not a whole monitor tick)
+        if op.on_stall is not None and not op.done:
+            try:
+                op.on_stall(op)
+            except Exception:  # the recovery poke must not kill the monitor
+                pass
+
+
+def note(seam: str, stage: str, **detail: Any) -> None:
+    """Escalation bookkeeping for ladders the module does not drive
+    itself (the tracker's join/peer monitors): counter + flight event +
+    stderr line, same shape as a guard escalation."""
+    import sys
+
+    from ..telemetry import flight
+
+    _ins().labels(seam, stage).inc()
+    flight.record("fault", "watchdog." + stage, seam=seam, **detail)
+    print(f"[watchdog] {stage}: {seam} {detail}", file=sys.stderr,
+          flush=True)
+
+
+# ---------------------------------------------------------------------------
+# liveness progress markers
+# ---------------------------------------------------------------------------
+
+
+def progress(key: str, **detail: Any) -> None:
+    """Record a liveness breadcrumb under ``key`` (e.g. ``train.round``
+    round=i, ``collective`` seq=n, ``extmem.page`` page=j).  Cheap — one
+    dict store — and JSON-able: markers ride every shipped telemetry
+    snapshot so the tracker can tell a slow-but-progressing peer from a
+    frozen one."""
+    with _lock:
+        _markers[key] = {"t_mono": time.monotonic(), **detail}
+
+
+def markers() -> Dict[str, Dict[str, Any]]:
+    """A copy of this process's current progress markers."""
+    with _lock:
+        return {k: dict(v) for k, v in _markers.items()}
+
+
+def advanced(prev: Optional[Dict[str, dict]],
+             cur: Optional[Dict[str, dict]]) -> bool:
+    """True when ``cur`` shows PROGRESS over ``prev``: a new marker key or
+    any marker whose payload (timestamps excluded) changed.  A re-shipped
+    identical marker set is a heartbeat, not progress — heartbeat-loss and
+    progress-loss are different faults and only the latter ages a peer
+    toward the stall ladder."""
+    if not cur:
+        return False
+    if not prev:
+        return True
+
+    def strip(m: Dict[str, dict]) -> Dict[str, dict]:
+        return {k: {kk: vv for kk, vv in v.items() if kk != "t_mono"}
+                for k, v in m.items()}
+
+    return strip(cur) != strip(prev)
+
+
+def marker_age(marks: Optional[Dict[str, dict]],
+               now: Optional[float] = None) -> Optional[float]:
+    """Seconds since the newest marker in ``marks`` was recorded (sender's
+    monotonic clock — only meaningful same-host), or None."""
+    if not marks:
+        return None
+    newest = max((float(v.get("t_mono", 0.0)) for v in marks.values()),
+                 default=0.0)
+    return (time.monotonic() if now is None else now) - newest
+
+
+# ---------------------------------------------------------------------------
+# test hooks
+# ---------------------------------------------------------------------------
+
+
+def configure(*, enabled: Optional[bool] = None,
+              tick_s: Optional[float] = None) -> None:
+    """Override the env-driven enable flag / monitor tick (tests)."""
+    global _enabled_override, _tick_override
+    _enabled_override = enabled
+    _tick_override = tick_s
+
+
+def reset() -> None:
+    """Drop every in-flight op, marker, and override (test isolation).
+    The monitor thread is left running — it is harmless when idle."""
+    global _enabled_override, _tick_override
+    with _lock:
+        _ops.clear()
+        _markers.clear()
+    _enabled_override = None
+    _tick_override = None

@@ -251,7 +251,11 @@ class MicroBatcher:
                     if earliest is None:  # nothing queued at all
                         if self._closed:
                             return
-                        self._cv.wait()
+                        # bounded idle wait (XTB701): submit()/close()
+                        # notify immediately; the periodic wake only
+                        # re-checks _closed so a lost notification can
+                        # never wedge the worker forever
+                        self._cv.wait(timeout=1.0)
                     else:
                         self._cv.wait(timeout=(earliest - now) / 1e9)
             self._run_batch(key, batch)

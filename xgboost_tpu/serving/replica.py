@@ -212,9 +212,25 @@ def ship_telemetry(sock, label: str) -> bool:
         return False
 
 
+def _replica_stall(op) -> None:
+    """Watchdog stall stage for a wedged request: die loudly.  The stack
+    dump already landed at the dump stage; the dispatcher's death path
+    reroutes the in-flight batch and respawns — a stalled replica
+    becomes a dead one, which the fleet already survives."""
+    from ..telemetry import flight
+
+    flight.record("fault", "replica.stall", **op.detail)
+    try:
+        flight.dump()
+    except OSError:
+        pass
+    os._exit(121)
+
+
 def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
                 buckets=(), label: str = "replica") -> None:
     from . import wire
+    from ..reliability import watchdog
     from ..telemetry import distributed, flight, trace
     from ..telemetry.registry import get_registry
 
@@ -269,68 +285,77 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
         rid = header.get("id")
         if op == "close":
             return
-        if op == "scrub":
-            try:
-                n = _scrub_resident(store, fast)
-                wire.send_frame(sock, {"op": "ctrl_ok", "id": rid,
-                                       "verified": n})
-            except ArenaCorruptError as e:
-                _quarantine(e, rid)
-                raise
-            continue
-        if op in ("load", "activate", "retire"):
-            try:
-                ack = _apply_control(engine, store, warm, fast, buckets,
-                                     header)
-                ack.update({"op": "ctrl_ok", "id": rid})
-                flight.record("event", f"replica.{op}",
-                              model=header.get("model"),
-                              version=header.get("version"),
-                              trace=header.get("trace"))
-                wire.send_frame(sock, ack)
-            except Exception as e:  # report, keep serving
-                flight.record("fault", f"replica.{op}", error=str(e))
+        # liveness marker (ships with every telemetry frame) + a per-
+        # request watchdog: a frame whose handling wedges past the budget
+        # gets an all-thread stack dump and then a LOUD death, steering
+        # recovery into the dispatcher's existing reroute/respawn path.
+        # Idle recv is not guarded — no traffic is not a stall.
+        watchdog.progress("replica.request", id=rid, op=op)
+        with watchdog.guard("replica.execute", op=op, id=rid,
+                            on_stall=_replica_stall):
+            if op == "scrub":
+                try:
+                    n = _scrub_resident(store, fast)
+                    wire.send_frame(sock, {"op": "ctrl_ok", "id": rid,
+                                           "verified": n})
+                except ArenaCorruptError as e:
+                    _quarantine(e, rid)
+                    raise
+                continue
+            if op in ("load", "activate", "retire"):
+                try:
+                    ack = _apply_control(engine, store, warm, fast, buckets,
+                                         header)
+                    ack.update({"op": "ctrl_ok", "id": rid})
+                    flight.record("event", f"replica.{op}",
+                                  model=header.get("model"),
+                                  version=header.get("version"),
+                                  trace=header.get("trace"))
+                    wire.send_frame(sock, ack)
+                except Exception as e:  # report, keep serving
+                    flight.record("fault", f"replica.{op}", error=str(e))
+                    wire.send_frame(sock, {"op": "error", "id": rid,
+                                           "etype": type(e).__name__,
+                                           "error": str(e)})
+            elif op != "predict":
                 wire.send_frame(sock, {"op": "error", "id": rid,
-                                       "etype": type(e).__name__,
-                                       "error": str(e)})
-        elif op != "predict":
-            wire.send_frame(sock, {"op": "error", "id": rid,
-                                   "etype": "ValueError",
-                                   "error": f"unknown op {op!r}"})
-        else:
-            t0 = time.perf_counter_ns()
-            try:
-                X = wire.decode_matrix(header, payload)
-                margin = bool(header.get("margin", False))
-                fp = fast.get((header["model"], header.get("version")))
-                out = fp.run(X, margin) if fp is not None else None
-                if out is not None:
-                    req_counter.labels(header["model"]).inc()
-                    rows_counter.labels(header["model"]).inc(
-                        float(X.shape[0]))
-                else:
-                    out = engine.predict(header["model"], X, direct=True,
-                                         version=header.get("version"),
-                                         output_margin=margin)
-                out = np.ascontiguousarray(out, np.float32)
-                wire.send_frame(sock, {"op": "result", "id": rid,
-                                       "shape": list(out.shape)},
-                                memoryview(out).cast("B"))
-                if trace.active() and header.get("trace"):
-                    # same trace id the dispatcher stamped at submit: the
-                    # merged capture pairs this bracket with fleet.queue/
-                    # fleet.request from the driver process
-                    trace.emit("replica.execute", t0,
-                               time.perf_counter_ns() - t0,
-                               trace=header["trace"],
-                               model=header.get("model"),
-                               rows=int(out.shape[0]))
-            except Exception as e:  # per-request failure: report, serve on
-                flight.record("fault", "replica.predict",
-                              model=header.get("model"), error=str(e))
-                wire.send_frame(sock, {"op": "error", "id": rid,
-                                       "etype": type(e).__name__,
-                                       "error": str(e)})
+                                       "etype": "ValueError",
+                                       "error": f"unknown op {op!r}"})
+            else:
+                t0 = time.perf_counter_ns()
+                try:
+                    X = wire.decode_matrix(header, payload)
+                    margin = bool(header.get("margin", False))
+                    fp = fast.get((header["model"], header.get("version")))
+                    out = fp.run(X, margin) if fp is not None else None
+                    if out is not None:
+                        req_counter.labels(header["model"]).inc()
+                        rows_counter.labels(header["model"]).inc(
+                            float(X.shape[0]))
+                    else:
+                        out = engine.predict(header["model"], X,
+                                             direct=True,
+                                             version=header.get("version"),
+                                             output_margin=margin)
+                    out = np.ascontiguousarray(out, np.float32)
+                    wire.send_frame(sock, {"op": "result", "id": rid,
+                                           "shape": list(out.shape)},
+                                    memoryview(out).cast("B"))
+                    if trace.active() and header.get("trace"):
+                        # same trace id the dispatcher stamped at submit:
+                        # the merged capture pairs this bracket with
+                        # fleet.queue/fleet.request from the driver
+                        trace.emit("replica.execute", t0,
+                                   time.perf_counter_ns() - t0,
+                                   trace=header["trace"],
+                                   model=header.get("model"),
+                                   rows=int(out.shape[0]))
+                except Exception as e:  # per-request failure: serve on
+                    flight.record("fault", "replica.predict",
+                                  model=header.get("model"), error=str(e))
+                    wire.send_frame(sock, {"op": "error", "id": rid,
+                                           "etype": type(e).__name__,
+                                           "error": str(e)})
         now = time.monotonic()
         if now - last_ship >= interval:
             last_ship = now
@@ -466,10 +491,12 @@ def main(argv=None) -> int:
         _serve_loop(sock, engine, fast, store=store, warm=warm,
                     buckets=buckets, label=args.label)
     except BaseException as e:
-        # wounded replicas die loudly — but first leave a postmortem: a
-        # local flight dump; the finally-ship below carries the ring
-        # (with this crash fault) to the driver too
+        # wounded replicas die loudly — but first leave a postmortem: an
+        # all-thread stack dump plus the local flight dump; the
+        # finally-ship below carries the ring (with this crash fault) to
+        # the driver too
         flight.record("fault", "replica.crash", error=repr(e))
+        flight.dump_stacks()
         try:
             flight.dump()
         except OSError:

@@ -81,9 +81,14 @@ def _local_snapshot() -> dict:
 def snapshot_payload() -> dict:
     """What one process ships: its full registry snapshot plus the
     flight-recorder ring (the driver dumps the ring when the process
-    dies — the SIGKILL postmortem path)."""
+    dies — the SIGKILL postmortem path) plus the watchdog's liveness
+    progress markers (round, collective seq, page index — what the
+    tracker's stall monitor compares between ships,
+    docs/reliability.md "Coordinator failover & watchdog")."""
+    from ..reliability import watchdog
+
     return {"snapshot": _local_snapshot(), "flight": flight.events(),
-            "pid": os.getpid()}
+            "progress": watchdog.markers(), "pid": os.getpid()}
 
 
 # ---------------------------------------------------------------------------

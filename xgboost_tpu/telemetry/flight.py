@@ -45,8 +45,8 @@ from collections import deque
 from datetime import datetime, timezone
 from typing import Any, Dict, List, Optional
 
-__all__ = ["record", "events", "dump", "install", "dump_dir",
-           "default_path", "set_label", "clear",
+__all__ = ["record", "events", "dump", "dump_stacks", "install",
+           "dump_dir", "default_path", "stacks_path", "set_label", "clear",
            "ENV_DIR", "ENV_LABEL", "ENV_SIZE", "ENV_SPILL"]
 
 ENV_DIR = "XGBOOST_TPU_FLIGHT_DIR"
@@ -134,6 +134,38 @@ def dump(path: Optional[str] = None) -> str:
         json.dump(_payload(), fh)
     os.replace(tmp, path)
     return path
+
+
+def stacks_path(label: Optional[str] = None) -> str:
+    """Where :func:`dump_stacks` writes for ``label`` (same directory and
+    labeling scheme as the ring dump, so a postmortem finds both)."""
+    return os.path.join(dump_dir(),
+                        f"stacks_{label or _resolved_label()}.txt")
+
+
+def dump_stacks(path: Optional[str] = None) -> Optional[str]:
+    """``faulthandler.dump_traceback`` of ALL threads into the flight
+    directory (append — successive dumps of one process stay in order,
+    separated by a monotonic-stamped header line).  The crash/abort path
+    of every spawned process and the watchdog's dump stage both land
+    here, so "what was every thread doing" survives without a debugger
+    attached.  Returns the path, or None when the write failed — stack
+    dumping must never take the dying process down faster."""
+    import faulthandler
+
+    path = path or stacks_path()
+    try:
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(f"=== stacks pid={os.getpid()} "
+                     f"label={_resolved_label()} "
+                     f"mono={time.monotonic():.3f} ===\n")
+            fh.flush()
+            faulthandler.dump_traceback(file=fh, all_threads=True)
+            fh.write("\n")
+        record("event", "flight.stacks", path=path)
+        return path
+    except Exception:  # pragma: no cover - fs trouble on the death path
+        return None
 
 
 def _maybe_spill() -> None:

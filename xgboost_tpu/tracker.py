@@ -466,7 +466,8 @@ class RabitTracker:
     def __init__(self, n_workers: int, host_ip: str = "auto", port: int = 0,
                  sortby: str = "host", timeout: int = 0,
                  handshake_timeout: float = OP_TIMEOUT,
-                 elastic: bool = False) -> None:
+                 elastic: bool = False,
+                 journal: Optional[str] = None) -> None:
         self.n_workers = n_workers
         self.host_ip = get_host_ip(host_ip)
         self.sortby = sortby
@@ -474,6 +475,26 @@ class RabitTracker:
         self.handshake_timeout = handshake_timeout
         self.elastic = bool(elastic)
         self._closing = False
+        # --- coordinator failover (docs/reliability.md "Coordinator
+        # failover & watchdog"): with a journal armed, every membership
+        # transition is fsync'd, and a respawned tracker recovers the
+        # roster/epoch and re-adopts the surviving workers instead of the
+        # job dying with the coordinator.
+        self._journal = None
+        self._recovered: Optional[dict] = None
+        if journal:
+            from .reliability.journal import TrackerJournal
+
+            self._journal = TrackerJournal(journal)
+            # repair: a SIGKILL mid-append leaves a torn tail; truncating
+            # it keeps OUR appends reachable by the NEXT recovery's walk
+            state = self._journal.load(count_recovery=True, repair=True)
+            if state and state.get("members"):
+                self._recovered = state
+                if port == 0:
+                    # rebind the predecessor's port: the workers only know
+                    # that address
+                    port = int(state.get("port", 0))
         self._relay = CollRelay(self.host_ip, n_workers,
                                 elastic=self.elastic)
         self._relay.on_worker_lost = self._relay_worker_lost
@@ -500,15 +521,80 @@ class RabitTracker:
         # last shipped telemetry payload per source label ("rank<N>"):
         # retained after the worker dies (postmortem + merged scrape)
         self.telemetry: Dict[str, dict] = {}
+        # --- failover/watchdog state (guarded by _lock) ---
+        self._readopt_pending: set = set()   # ranks a recovery still awaits
+        self._readopt_deadline = 0.0
+        self._progress_round: Dict[int, int] = {}  # rank -> last round seen
+        self._shard_map: Optional[dict] = None     # latest reported map
+        self._liveness: Dict[int, dict] = {}  # rank -> markers/t_advance/stage
+        self._join_stage: Dict[socket.socket, int] = {}
+        self._journal_last = 0.0
+        if self._recovered is not None:
+            self._epoch = int(self._recovered.get("epoch", 0))
+            self._progress_round = {
+                int(r): int((m or {}).get("round", 0))
+                for r, m in self._recovered.get("members", {}).items()}
+            self._shard_map = self._recovered.get("shard_map")
 
     # ------------------------------------------------------------- serving
     def start(self) -> None:
         self._listener.listen(self.n_workers)
         self._relay.start()
-        t = threading.Thread(target=self._serve, daemon=True)
+        t = threading.Thread(
+            target=(self._serve_recovery if self._recovered is not None
+                    else self._serve), daemon=True)
         with self._lock:
             self._thread = t
         t.start()
+        if self.elastic:
+            # always started: the loop also enforces the readopt deadline,
+            # which is failover CORRECTNESS (a never-returning rank must be
+            # pruned or the recovery regroup cannot form) — only the stall
+            # ladders inside honor the XGBOOST_TPU_WATCHDOG kill switch
+            threading.Thread(target=self._watchdog_loop,
+                             daemon=True).start()
+
+    # ------------------------------------------------------ journal writes
+    def _journal_state(self) -> dict:
+        """The replayable coordinator state (``_lock`` must be held):
+        roster with per-rank resume rounds, epoch, shard map, pending
+        regroup — everything a respawned tracker needs to re-adopt the
+        survivors; model state stays in the elastic checkpoints."""
+        ranks = sorted(self._members.values())
+        return {
+            "version": 1,
+            "port": self.port,
+            "n_workers": self.n_workers,
+            "elastic": self.elastic,
+            "sortby": self.sortby,
+            "epoch": self._epoch,
+            "regrouping": bool(self._regrouping),
+            "members": {str(r): {"round": self._progress_round.get(r, 0)}
+                        for r in ranks},
+            "shard_map": self._shard_map,
+        }
+
+    def _journal_write(self, force: bool = False) -> None:
+        """Commit the current state to the journal.  Membership
+        transitions pass ``force``; progress-marker refreshes are
+        throttled so the fsync cadence stays bounded however chatty the
+        telemetry channel is."""
+        if self._journal is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if not self._serve_done and self._recovered is None:
+                return  # no roster yet: nothing replayable to record
+            if not force and now - self._journal_last < 1.0:
+                return
+            self._journal_last = now
+            state = self._journal_state()
+        try:
+            self._journal.append(state)
+        except OSError as e:  # journal loss degrades failover, not the job
+            warnings.warn(f"tracker journal write failed ({e}); a tracker "
+                          "respawn may not recover this transition",
+                          RuntimeWarning, stacklevel=2)
 
     def _serve(self) -> None:
         pending = []  # (sort_key, arrival, conn)
@@ -524,6 +610,27 @@ class RabitTracker:
                     msg = recv_msg(conn)
                 except (OSError, ValueError):
                     msg = None
+                if (msg and msg.get("cmd") == "readopt"
+                        and self._journal is not None):
+                    # the predecessor died in the window between handing
+                    # out assignments and journaling the roster: the
+                    # re-adopting workers ARE the roster (ranks 0..n-1
+                    # were just assigned) — run the recovery protocol
+                    # with the full expected set instead of refusing
+                    conn.settimeout(None)
+                    with self._lock:
+                        # 'start' handshakes already collected are NOT
+                        # part of the dead cohort — park them as joiners
+                        # so the recovery regroup absorbs (and answers)
+                        # them instead of leaving them blocked unreplied
+                        for _k, _a, c in pending:
+                            self._joiners.append(c)
+                            self._conns.append(c)
+                    pending = []
+                    self._begin_unjournaled_recovery()
+                    self._handle_readopt(conn, msg)
+                    self._accept_post()
+                    return
                 if not msg or msg.get("cmd") != "start":
                     conn.close()
                     continue
@@ -545,13 +652,15 @@ class RabitTracker:
         # machine — multi-host topologies put them on different hosts):
         # two-phase bootstrap, rank 0 reports its coordinator address first
         r0_conn = self._conns[0]
+        failover = self._journal is not None
         try:
             # bounded two-phase bootstrap: a rank 0 that connects and then
             # hangs must surface as a handshake failure, not wedge the
             # tracker (and every other worker) forever
             send_msg(r0_conn, {"rank": 0, "world": self.n_workers,
                                "coordinator": None,
-                               "coll_port": self._relay.port},
+                               "coll_port": self._relay.port,
+                               "failover": failover},
                      timeout=self.handshake_timeout)
             reply = recv_msg(r0_conn, timeout=self.handshake_timeout)
         except OSError:
@@ -570,7 +679,8 @@ class RabitTracker:
             try:
                 send_msg(conn, {"rank": rank, "world": self.n_workers,
                                 "coordinator": coordinator,
-                                "coll_port": self._relay.port},
+                                "coll_port": self._relay.port,
+                                "failover": failover},
                          timeout=self.handshake_timeout)
             except OSError:
                 pass  # the worker's watcher EOF-detection handles its death
@@ -579,6 +689,7 @@ class RabitTracker:
                              for rank, conn in enumerate(self._conns)}
             self._watched = set(self._conns)
             self._serve_done = True
+        self._journal_write(force=True)  # the roster is now replayable
         for rank, conn in enumerate(self._conns):
             t = threading.Thread(target=self._watch_worker,
                                  args=(conn, rank), daemon=True)
@@ -587,6 +698,85 @@ class RabitTracker:
             # keep the listener open: replacement workers connect with the
             # same start handshake and are absorbed at the next regroup
             threading.Thread(target=self._accept_late, daemon=True).start()
+
+    def _serve_recovery(self) -> None:
+        """Respawned-tracker serving: no rendezvous — the journaled roster
+        IS the cohort.  The tracker opens in a pending regroup, accepts
+        ``readopt`` handshakes from the journaled ranks (and ordinary
+        ``start`` handshakes from replacements, parked as usual), and the
+        re-adoption completes through the NORMAL regroup machinery: every
+        re-adopted worker sends ``regroup_join`` from its round boundary,
+        the epoch bumps, the relay re-forms, training resumes from the
+        newest checkpoint.  A rank that never re-adopts (it died with —
+        or because of — the old tracker) is declared dead at the readopt
+        deadline and the epoch forms with the remainder."""
+        import os as _os
+
+        state = self._recovered or {}
+        expected = {int(r) for r in state.get("members", {})}
+        try:
+            deadline_s = float(_os.environ.get(
+                "XGBOOST_TPU_READOPT_TIMEOUT_S", "60"))
+        except ValueError:
+            deadline_s = 60.0
+        with self._lock:
+            self._serve_done = True
+            self._regrouping = True
+            self._regroup_t0 = time.perf_counter()
+            self._readopt_pending = set(expected)
+            self._readopt_deadline = time.monotonic() + deadline_s
+        from .telemetry import flight as _flight
+
+        _flight.record("event", "tracker.recovery", epoch=self._epoch,
+                       expected=sorted(expected))
+        warnings.warn(
+            f"tracker recovered from journal: epoch {self._epoch}, "
+            f"awaiting re-adoption of rank(s) {sorted(expected)}",
+            RuntimeWarning, stacklevel=2)
+        self._accept_post()
+
+    def _begin_unjournaled_recovery(self) -> None:
+        """Open a recovery for a cohort the journal never recorded (the
+        predecessor was killed pre-first-write): expect every originally
+        assigned rank; the readopt deadline prunes the ones that died."""
+        import os as _os
+
+        try:
+            deadline_s = float(_os.environ.get(
+                "XGBOOST_TPU_READOPT_TIMEOUT_S", "60"))
+        except ValueError:
+            deadline_s = 60.0
+        with self._lock:
+            self._serve_done = True
+            self._regrouping = True
+            self._regroup_t0 = time.perf_counter()
+            self._readopt_pending = set(range(self.n_workers))
+            self._readopt_deadline = time.monotonic() + deadline_s
+        from .telemetry import flight as _flight
+
+        _flight.record("event", "tracker.recovery_unjournaled",
+                       expected=self.n_workers)
+        warnings.warn(
+            "tracker respawned with no journaled roster (predecessor died "
+            "pre-first-write); re-adopting the assigned cohort",
+            RuntimeWarning, stacklevel=2)
+
+    def _declare_readopt_deadline(self) -> None:
+        """Readopt deadline passed: the ranks that never came back died
+        with the old tracker — stop waiting for them so the survivors'
+        regroup can form."""
+        with self._lock:
+            missing = set(self._readopt_pending)
+            if not missing:
+                return
+            self._readopt_pending = set()
+            self.lost_workers += len(missing)
+        from .reliability import watchdog as _watchdog
+
+        for rank in sorted(missing):
+            _watchdog.note("tracker.peer", "stall", rank=rank,
+                           reason="never re-adopted after tracker recovery")
+        self._maybe_complete_regroup()
 
     def _fan_abort(self, rank: int, msg: str,
                    source: Optional[socket.socket]) -> None:
@@ -658,6 +848,7 @@ class RabitTracker:
                     self._regrouping = False
                     self._regroup_joins = {}
             if self.elastic:
+                self._journal_write(force=True)
                 # a clean exit during a pending regroup: the remaining
                 # members must not wait for this worker's join
                 self._maybe_complete_regroup()
@@ -692,13 +883,18 @@ class RabitTracker:
         """One worker telemetry shipment: keep the last payload per rank
         and feed the snapshot into the process-default merged registry so
         a driver-side ``/metrics`` scrape shows every rank's series
-        (telemetry/distributed.py; docs/observability.md)."""
+        (telemetry/distributed.py; docs/observability.md).  Piggybacked
+        watchdog progress markers feed the stall monitor and the journal's
+        per-rank resume rounds."""
         source = f"rank{rank}"
         payload = {"snapshot": msg.get("snapshot"),
                    "flight": msg.get("flight") or [],
                    "pid": msg.get("pid")}
         with self._lock:
             self.telemetry[source] = payload
+        marks = msg.get("progress")
+        if isinstance(marks, dict) and marks:
+            self._ingest_progress(rank, marks)
         snap = payload["snapshot"]
         if snap:
             try:
@@ -708,11 +904,160 @@ class RabitTracker:
             except Exception:  # pragma: no cover - telemetry must not kill
                 pass           # the rendezvous channel
 
+    def _ingest_progress(self, rank: int, marks: dict) -> None:
+        """One rank's liveness markers.  The staleness clock only resets
+        when the markers ADVANCED — a shipment carrying the same frozen
+        markers is a heartbeat (the channel is up) but not progress, and
+        only progress keeps a peer off the stall ladder
+        (tests/test_watchdog.py pins the distinction)."""
+        from .reliability import watchdog as _watchdog
+
+        with self._lock:
+            ent = self._liveness.get(rank)
+            if ent is None or _watchdog.advanced(ent.get("markers"), marks):
+                self._liveness[rank] = {"markers": marks,
+                                        "t_advance": time.monotonic(),
+                                        "stage": 0}
+            tr = marks.get("train.round")
+            if isinstance(tr, dict) and tr.get("round") is not None:
+                try:
+                    self._progress_round[rank] = max(
+                        self._progress_round.get(rank, 0),
+                        int(tr["round"]))
+                except (TypeError, ValueError):
+                    pass
+            sm = marks.get("shard_map")
+            if isinstance(sm, dict) and isinstance(sm.get("map"), dict):
+                self._shard_map = sm["map"]
+        self._journal_write()  # throttled: resume rounds stay fresh
+
+    # --------------------------------------------------- stall watchdog
+    def _watchdog_loop(self) -> None:
+        """Tracker-side stall monitor (elastic mode): two deterministic
+        ladders over the watchdog budgets, both ending in an EXISTING
+        recovery path —
+
+        - ``tracker.join``: a member that has not reached its round
+          boundary while a regroup is pending (warn → request a remote
+          stack dump → declare it dead, so the epoch forms with the
+          remainder instead of everyone waiting forever);
+        - ``tracker.peer``: a rank whose progress markers froze while at
+          least one peer kept advancing (same ladder — a stalled-but-
+          alive worker becomes a detected death, and the regroup fires).
+
+        Plus the readopt deadline after a tracker recovery."""
+        from .reliability import watchdog as _watchdog
+
+        while True:
+            time.sleep(0.25)
+            with self._lock:
+                if self._closing or self._error is not None:
+                    return
+                deadline = self._readopt_deadline
+                pending = bool(self._readopt_pending)
+            if pending and time.monotonic() > deadline:
+                self._declare_readopt_deadline()
+            if _watchdog.enabled():
+                self._check_join_stalls(_watchdog)
+                self._check_peer_stalls(_watchdog)
+
+    def _escalate_member(self, watchdog, seam: str, stage: int,
+                         conn: socket.socket, rank: int,
+                         elapsed: float) -> None:
+        """One ladder step against a live member: warn, ask it for an
+        all-thread stack dump (its watcher thread answers even when the
+        main thread is wedged), or close its channel — the EOF runs the
+        ordinary elastic death path, so 'declared dead' and 'actually
+        dead' recover identically."""
+        stage_name = watchdog.STAGES[stage - 1]
+        watchdog.note(seam, stage_name, rank=rank,
+                      elapsed_s=round(elapsed, 3))
+        if stage_name == "dump":
+            try:
+                send_msg(conn, {"cmd": "stackdump",
+                                "reason": f"{seam} watchdog"}, timeout=5.0)
+            except OSError:
+                pass
+        elif stage_name == "stall":
+            # shutdown() (not close()) is what reliably wakes the watcher
+            # thread blocked in recv on this socket: its EOF then runs
+            # the ordinary elastic death path
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _check_join_stalls(self, watchdog) -> None:
+        budget = watchdog.budget_for("tracker.join")
+        thresholds = (watchdog.WARN_AT, watchdog.DUMP_AT, watchdog.STALL_AT)
+        laggards = []
+        with self._lock:
+            if not self._regrouping or self._readopt_pending:
+                if self._join_stage:
+                    self._join_stage = {}
+                return
+            elapsed = time.perf_counter() - self._regroup_t0
+            for conn, rank in self._members.items():
+                if conn in self._regroup_joins:
+                    continue
+                stage = self._join_stage.get(conn, 0)
+                while (stage < len(thresholds)
+                       and elapsed >= budget * thresholds[stage]):
+                    stage += 1
+                    laggards.append((conn, rank, stage, elapsed))
+                self._join_stage[conn] = stage
+        for conn, rank, stage, elapsed in laggards:
+            self._escalate_member(watchdog, "tracker.join", stage, conn,
+                                  rank, elapsed)
+
+    def _check_peer_stalls(self, watchdog) -> None:
+        budget = watchdog.budget_for("tracker.peer")
+        thresholds = (watchdog.WARN_AT, watchdog.DUMP_AT, watchdog.STALL_AT)
+        now = time.monotonic()
+        due = []
+        with self._lock:
+            if self._regrouping:
+                return  # the join ladder owns a draining membership change
+            if len(self._liveness) < 2:
+                return  # nothing to compare a suspect against
+            newest = max(e["t_advance"] for e in self._liveness.values())
+            if now - newest > budget:
+                # only a DIVERGING stall escalates: if nobody advanced
+                # within the budget the whole job is in one long legit
+                # phase (a big collective, a huge page) — not a stall
+                return
+            for rank, ent in self._liveness.items():
+                age = now - ent["t_advance"]
+                stage = ent.get("stage", 0)
+                while (stage < len(thresholds)
+                       and age >= budget * thresholds[stage]):
+                    stage += 1
+                    conn = next((c for c, r in self._members.items()
+                                 if r == rank), None)
+                    if conn is not None:
+                        due.append((conn, rank, stage, age))
+                ent["stage"] = stage
+        for conn, rank, stage, age in due:
+            self._escalate_member(watchdog, "tracker.peer", stage, conn,
+                                  rank, age)
+
     # ------------------------------------------------- elastic membership
     def _accept_late(self) -> None:
-        """Post-rendezvous accept loop (elastic only): a connecting worker
-        is a replacement — park it and trigger a regroup; its handshake is
-        answered with the elastic assignment when the epoch forms."""
+        """Post-rendezvous accept loop (elastic only) — see
+        :meth:`_accept_post` (shared with the recovery path)."""
+        self._accept_post()
+
+    def _accept_post(self) -> None:
+        """Post-rendezvous/recovery accept loop: a ``start`` handshake is
+        a replacement worker (parked, absorbed at the next regroup); a
+        ``readopt`` handshake is a survivor of a tracker respawn
+        reclaiming its journaled rank (recovery only — outside a pending
+        re-adoption it is refused, because a rank declared dead at the
+        readopt deadline must not resurrect into a formed epoch)."""
         while True:
             try:
                 conn, _addr = self._listener.accept()
@@ -725,7 +1070,11 @@ class RabitTracker:
             except (OSError, ValueError):
                 conn.close()
                 continue
-            if not msg or msg.get("cmd") != "start":
+            cmd = msg.get("cmd") if msg else None
+            if cmd == "readopt":
+                self._handle_readopt(conn, msg)
+                continue
+            if not msg or cmd != "start":
                 conn.close()
                 continue
             with self._lock:
@@ -735,6 +1084,61 @@ class RabitTracker:
                 self._joiners.append(conn)
                 self._conns.append(conn)  # abort fan-out coverage
             self._request_regroup()
+
+    def _handle_readopt(self, conn: socket.socket, msg: dict) -> None:
+        try:
+            rank = int(msg.get("rank", -1))
+        except (TypeError, ValueError):
+            rank = -1
+        with self._lock:
+            accept = (rank in self._readopt_pending and not self._closing
+                      and self._error is None)
+            if accept:
+                self._readopt_pending.discard(rank)
+                self._members[conn] = rank
+                self._conns.append(conn)
+                self._watched.add(conn)
+                if msg.get("round") is not None:
+                    self._progress_round[rank] = max(
+                        self._progress_round.get(rank, 0),
+                        int(msg["round"]))
+            epoch = self._epoch
+        if not accept:
+            try:
+                send_msg(conn, {"cmd": "abort",
+                                "msg": "re-adoption refused (unknown rank, "
+                                       "readopt deadline passed, or job "
+                                       "over)"}, timeout=5.0)
+            except OSError:
+                pass
+            conn.close()
+            return
+        from .telemetry import flight as _flight
+
+        _flight.record("event", "tracker.readopt", rank=rank, epoch=epoch)
+        try:
+            send_msg(conn, {"cmd": "readopted", "epoch": epoch,
+                            "failover": True}, timeout=30.0)
+        except OSError:
+            # the reply never arrived: ROLL BACK the membership — no
+            # watcher exists yet, so a zombie member here would block
+            # _maybe_complete_regroup forever — and re-open the rank so
+            # the worker's backoff retry can re-adopt
+            with self._lock:
+                self._members.pop(conn, None)
+                self._watched.discard(conn)
+                if conn in self._conns:
+                    self._conns.remove(conn)
+                self._readopt_pending.add(rank)
+            conn.close()
+            return
+        threading.Thread(target=self._watch_worker, args=(conn, rank),
+                         daemon=True).start()
+        self._journal_write(force=True)
+        # the readopt does not JOIN the regroup — the worker's regroup()
+        # does — but completion must be re-checked in case everyone else
+        # already joined while this straggler was reconnecting
+        self._maybe_complete_regroup()
 
     def _relay_worker_lost(self, rank: int, msg: str) -> None:
         if not self.elastic:
@@ -767,6 +1171,7 @@ class RabitTracker:
 
         _elastic_ins()[1].inc()
         _flight.record("event", "tracker.worker_lost", rank=rank, msg=msg)
+        self._journal_write(force=True)
         warnings.warn(f"elastic: worker {rank} lost ({msg}); "
                       f"{survivors} survivor(s) regrouping", RuntimeWarning,
                       stacklevel=2)
@@ -832,9 +1237,12 @@ class RabitTracker:
             if (not self._regrouping or self._closing
                     or self._error is not None):
                 return
+            if self._readopt_pending:
+                return  # a tracker-recovery re-adoption is still draining
             if set(self._regroup_joins) != set(self._members):
                 return  # someone is still draining toward its boundary
             survivors = sorted(self._members, key=self._members.get)
+            old_ranks = dict(self._members)  # conn -> pre-regroup rank
             joiners = list(self._joiners)
             self._joiners = []
             ordered = survivors + joiners
@@ -851,9 +1259,21 @@ class RabitTracker:
             resume_round = max(self._regroup_joins.values(), default=0)
             self._regroup_joins = {}
             self._members = {conn: nr for nr, conn in enumerate(ordered)}
+            # re-key the journal's per-rank resume rounds to the NEW
+            # numbering: a dead or renumbered rank's stale entry must not
+            # survive into the next recovery's journal (joiners start at
+            # the epoch's resume round)
+            self._progress_round = {
+                nr: (self._progress_round.get(old_ranks[conn], 0)
+                     if conn in old_ranks else resume_round)
+                for nr, conn in enumerate(ordered)}
             self._regrouping = False
             self._watched.update(joiners)
             duration = time.perf_counter() - self._regroup_t0
+            self._join_stage = {}
+            # ranks were just re-numbered: stale liveness entries keyed by
+            # the old ranks must not age anyone in the new epoch
+            self._liveness = {}
             self._relay.regroup(new_world, epoch)
             for nr, conn in enumerate(ordered):
                 try:
@@ -861,7 +1281,11 @@ class RabitTracker:
                                     "rank": nr, "world": new_world,
                                     "round": resume_round,
                                     "coll_port": self._relay.port,
-                                    "coordinator": ""},
+                                    "coordinator": "",
+                                    # a parked JOINER's start handshake is
+                                    # answered by this message: it must
+                                    # learn failover is armed here
+                                    "failover": self._journal is not None},
                              timeout=30.0)
                 except OSError:
                     pass  # the death will be seen and regrouped again
@@ -876,6 +1300,7 @@ class RabitTracker:
         ins[2].observe(duration)
         _flight.record("event", "tracker.regroup", epoch=epoch,
                        world=new_world, seconds=duration)
+        self._journal_write(force=True)  # the epoch is a committed fact
         for conn, jrank in joiner_ranks:
             threading.Thread(target=self._watch_worker,
                              args=(conn, jrank), daemon=True).start()
@@ -972,11 +1397,21 @@ class TrackerClient:
         # regroup itself, which carries the epoch (recovery reloads the
         # newest checkpoint rather than trusting a reported round)
         self.epoch = int(reply.get("epoch", 0))
+        # failover: the tracker journals its state — a dropped channel is
+        # a coordinator respawn to reconnect to, not (necessarily) the end
+        self.failover = bool(reply.get("failover", False))
+        self._host = host
+        self._port = int(port)
+        self._closed = False
+        self._channel_dead = False
         self._coll: Optional[socket.socket] = None
         self._coll_host = host
         self._coll_seq = 0
+        self._coll_interrupted = False  # set by the collective watchdog
         self._coll_lock = threading.Lock()
         self._state_lock = threading.Lock()
+        self._connected = threading.Event()      # channel is usable
+        self._connected.set()
         self._regroup_flag = threading.Event()   # regroup_pending received
         self._regroup_ready = threading.Event()  # assignment received
         self._regroup_info: Optional[dict] = None
@@ -1022,9 +1457,35 @@ class TrackerClient:
                 # frames arrive as single segments.)
                 continue
             except OSError:
-                return
+                msg = None
             if msg is None:
-                return
+                # channel down.  Clean shutdown or a non-failover tracker:
+                # this watcher's job is over (the old semantics).  With
+                # failover armed the coordinator is respawning — reconnect
+                # with backoff and re-adopt into the journaled epoch.
+                if self._closed or not self.failover:
+                    if not self._closed:
+                        # a regroup entered (or about to be entered) on a
+                        # DEAD channel would wait out its full timeout for
+                        # an assignment that can never arrive: fail it now
+                        self._channel_lost()
+                    return
+                if not self._reconnect():
+                    self._channel_lost()
+                    return
+                continue
+            if msg.get("cmd") == "stackdump":
+                # the tracker's stall watchdog wants to see this process's
+                # threads: the watcher can answer even when the main
+                # thread is wedged — exactly the case being diagnosed
+                try:
+                    from .telemetry import flight
+
+                    flight.dump_stacks()
+                    flight.dump()
+                except Exception:
+                    pass
+                continue
             if msg.get("cmd") == "abort":
                 import os
                 import sys
@@ -1034,10 +1495,13 @@ class TrackerClient:
                 try:
                     # os._exit skips atexit: flush the flight ring so the
                     # aborted peer's postmortem shows ITS last moments too
+                    # — plus an all-thread stack dump, so "what was this
+                    # process doing when it was told to die" is on disk
                     from .telemetry import flight
 
                     flight.record("fault", "tracker.abort",
                                   msg=msg.get("msg", ""))
+                    flight.dump_stacks()
                     flight.dump()
                 except Exception:
                     pass
@@ -1053,6 +1517,104 @@ class TrackerClient:
                 self._regroup_flag.set()
                 self._regroup_ready.set()
                 continue
+
+    def _reconnect(self) -> bool:
+        """Re-adopt into a respawned tracker: jittered-backoff reconnect,
+        ``readopt`` handshake carrying this worker's rank/epoch/last
+        round, and a pending-regroup flag so the training loop drains to
+        its round boundary and joins the re-adoption epoch.  Returns
+        False when the coordinator never came back (the job is over; the
+        callers fail loudly through the normal paths)."""
+        import os
+
+        from .reliability import watchdog as _watchdog
+        from .reliability.retry import RetriesExhausted, retry_call
+        from .telemetry import flight
+
+        self._connected.clear()
+        # membership is about to change (the re-adoption forms a new
+        # epoch): collectives must drain into regroup, not retry a relay
+        # that died with the old tracker
+        self._regroup_flag.set()
+        self.interrupt_collective()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        marks = _watchdog.markers().get("train.round") or {}
+        flight.record("event", "tracker.reconnect", rank=self.rank,
+                      epoch=self.epoch)
+
+        def _dial() -> socket.socket:
+            s = socket.create_connection((self._host, self._port),
+                                         timeout=30.0)
+            try:
+                s.settimeout(30.0)
+                send_msg(s, {"cmd": "readopt", "rank": self.rank,
+                             "epoch": self.epoch,
+                             "round": marks.get("round")})
+                reply = recv_msg(s)
+                if not reply or reply.get("cmd") != "readopted":
+                    raise ConnectionError(
+                        f"tracker refused re-adoption: {reply!r}")
+            except BaseException:
+                s.close()
+                raise
+            return s
+
+        try:
+            retries = int(os.environ.get("XGBOOST_TPU_READOPT_RETRIES",
+                                         "15"))
+        except ValueError:
+            retries = 15
+        try:
+            s = retry_call(_dial, op="tracker.readopt", retries=retries,
+                           base=0.25, max_delay=2.0, seed=self.rank,
+                           retry_on=(OSError, ValueError))
+        except RetriesExhausted as e:
+            flight.record("fault", "tracker.readopt_failed",
+                          rank=self.rank, error=str(e))
+            return False
+        s.settimeout(None)
+        with self._state_lock:
+            self._sock = s
+        self._connected.set()
+        flight.record("event", "tracker.readopted", rank=self.rank,
+                      epoch=self.epoch)
+        return True
+
+    def _channel_lost(self) -> None:
+        """Tracker channel permanently gone (non-failover EOF, or every
+        re-adoption attempt failed): wake anything waiting on a regroup
+        assignment — with ``_regroup_info`` left None, :meth:`regroup`
+        raises instead of sleeping out its timeout on a dead socket."""
+        with self._state_lock:
+            self._regroup_info = None
+            self._channel_dead = True
+        self._connected.set()  # a send on the dead socket fails FAST
+        self._regroup_ready.set()
+        self.interrupt_collective()
+
+    def interrupt_collective(self) -> None:
+        """Poke a thread blocked in :meth:`coll_allgather` awake by
+        closing the relay socket (the blocked recv surfaces OSError →
+        ``RegroupRequired``).  Called by the collective-wait watchdog at
+        its stall stage and by :meth:`_reconnect` — both from OTHER
+        threads, so no lock: the blocked collective holds it."""
+        with self._state_lock:
+            self._coll_interrupted = True
+        c = self._coll
+        if c is not None:
+            # shutdown() wakes the blocked recv reliably; a bare close()
+            # can leave the other thread blocked on the dead fd forever
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     @property
     def regroup_pending(self) -> bool:
@@ -1081,26 +1643,55 @@ class TrackerClient:
                     pass
             self._coll = None
             self._coll_seq = 0
+        with self._state_lock:
+            if self._channel_dead:
+                raise RuntimeError(
+                    "tracker channel lost: cannot regroup (a join would "
+                    "wait on a dead socket)")
+            self._coll_interrupted = False  # the new epoch starts clean
         self._regroup_ready.clear()
-        try:
-            send_msg(self._sock, {"cmd": "regroup_join",
-                                  "round": int(completed_round)},
-                     timeout=30.0)
-        except OSError as e:
-            raise RuntimeError(
-                f"tracker unreachable during elastic regroup: {e}") from e
-        if not self._regroup_ready.wait(timeout or self.op_timeout):
+        wait_s = timeout or self.op_timeout
+        for attempt in range(3):
+            # failover: a regroup can be entered WHILE the watcher is
+            # still re-adopting into a respawned tracker — wait for the
+            # channel, and retry the join if the send raced a reconnection
+            # (the watcher may not have noticed the dead socket yet)
+            if not self._connected.wait(timeout=wait_s):
+                raise RuntimeError(
+                    "tracker unreachable during elastic regroup "
+                    "(re-adoption never completed)")
+            try:
+                send_msg(self._sock, {"cmd": "regroup_join",
+                                      "round": int(completed_round)},
+                         timeout=30.0)
+                break
+            except OSError as e:
+                if attempt >= 2 or not self.failover:
+                    raise RuntimeError(
+                        f"tracker unreachable during elastic regroup: {e}"
+                    ) from e
+                time.sleep(0.5)  # let the watcher notice and reconnect
+        if not self._regroup_ready.wait(wait_s):
             raise RuntimeError(
                 "elastic regroup timed out waiting for the tracker "
                 "assignment")
         with self._state_lock:
-            info = self._regroup_info or {}
+            if self._channel_dead or self._regroup_info is None:
+                raise RuntimeError(
+                    "tracker channel lost during elastic regroup: no "
+                    "assignment can arrive — failing loud instead of "
+                    "waiting out the timeout")
+            info = self._regroup_info
             self._regroup_info = None
             self.rank = int(info["rank"])
             self.world = int(info["world"])
             self.epoch = int(info["epoch"])
             if info.get("coll_port") is not None:
                 self.coll_port = info["coll_port"]
+            if info.get("failover") is not None:
+                # a replacement worker's handshake was answered by this
+                # very message — adopt the tracker's failover capability
+                self.failover = bool(info["failover"])
         self._regroup_ready.clear()
         self._regroup_flag.clear()
         return dict(info)
@@ -1150,6 +1741,14 @@ class TrackerClient:
                     raise RegroupRequired(
                         "collective membership changed mid-operation")
                 if not hdr or hdr.get("cmd") != "coll_result":
+                    if hdr is None and (self._coll_interrupted
+                                        or self._regroup_flag.is_set()
+                                        or self.failover):
+                        # a shutdown() poke (watchdog stall stage /
+                        # failover reconnect) surfaces as clean EOF here,
+                        # not OSError: same recovery — drain into regroup
+                        raise RegroupRequired(
+                            "collective interrupted; regrouping")
                     raise RuntimeError(
                         "collective relay failed: "
                         f"{(hdr or {}).get('msg', 'connection lost')}")
@@ -1166,9 +1765,19 @@ class TrackerClient:
                         f"relay gather seq {seq} CRC mismatch: corrupted "
                         "payload — dropping the relay connection")
             except OSError as e:
-                if self._regroup_flag.is_set():
+                if self._regroup_flag.is_set() or self._coll_interrupted:
+                    # elastic regroup pending, or the collective-wait
+                    # watchdog severed the relay at its stall stage: both
+                    # recover through the regroup path
                     raise RegroupRequired(
                         "collective interrupted by elastic regroup") from e
+                if self.failover:
+                    # the relay died with the tracker: the respawned
+                    # coordinator re-adopts us and the job regroups —
+                    # a dead relay is a membership change, not a job loss
+                    raise RegroupRequired(
+                        "collective relay lost; tracker failover in "
+                        "progress") from e
                 raise RuntimeError(
                     f"collective relay I/O failed (peer/tracker lost?): {e}"
                 ) from e
@@ -1183,6 +1792,7 @@ class TrackerClient:
         msg = {"cmd": "telemetry",
                "snapshot": payload.get("snapshot"),
                "flight": payload.get("flight"),
+               "progress": payload.get("progress"),
                "pid": payload.get("pid", 0)}
         try:
             send_msg(self._sock, msg, timeout=30.0)
@@ -1198,6 +1808,10 @@ class TrackerClient:
             pass
 
     def shutdown(self) -> None:
+        with self._state_lock:
+            # the watcher must read the coming EOF as OUR close, not a
+            # tracker death to re-adopt from
+            self._closed = True
         with self._coll_lock:
             if self._coll is not None:
                 try:

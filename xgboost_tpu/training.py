@@ -271,6 +271,11 @@ def train(
                     "stopping (the elastic data_fn returned none)."
                 )
         ckpt_cb.shard_map = smap.to_dict()
+        from .reliability import watchdog as _wd
+
+        # the shard map rides the liveness markers to the tracker, whose
+        # journal then carries it across a coordinator respawn
+        _wd.progress("shard_map", map=ckpt_cb.shard_map)
     if resumed is not None:
         bst = _restore_booster(params, resumed)
         for name, metrics in resumed.history.items():
@@ -302,7 +307,9 @@ def train(
     total = (resumed is not None or elastic is not None
              or getattr(bst, "process_type", "default") == "update")
     end = num_boost_round if total else start + num_boost_round
+    from .reliability import watchdog as _wd
     from .reliability.faults import maybe_inject
+    from .telemetry.distributed import ship_to_tracker
 
     i = start
     while i < end:
@@ -312,8 +319,15 @@ def train(
             bst, dtrain, evals, i = _elastic_regroup(
                 params, elastic, cbs, callbacks, ckpt_cb, evals,
                 bst.num_boosted_rounds())
+            _wd.progress("shard_map", map=ckpt_cb.shard_map)
             continue
         try:
+            # liveness marker + (tracker mode) a rate-limited snapshot
+            # ship: the tracker's stall watchdog distinguishes a slow
+            # round from a frozen one by whether this marker advances,
+            # and its journal tracks the per-rank resume round from it
+            _wd.progress("train.round", round=i)
+            ship_to_tracker()
             # fault seam (kill/exception/delay; no-op without a plan): the
             # round boundary is where a worker death is injected for the
             # kill->resume parity tests
@@ -330,6 +344,7 @@ def train(
             bst, dtrain, evals, i = _elastic_regroup(
                 params, elastic, cbs, callbacks, ckpt_cb, evals,
                 bst.num_boosted_rounds())
+            _wd.progress("shard_map", map=ckpt_cb.shard_map)
             continue
         if stop:
             break
