@@ -110,14 +110,26 @@ JAX_PLATFORMS=cpu python scripts/fleet_smoke.py 3 120
 JAX_PLATFORMS=cpu python scripts/bench_obs.py bench_out/BENCH_OBS.json
 
 # composed-fault chaos soak (docs/reliability.md "Integrity & chaos"):
-# >= 20 seeded multi-fault episodes round-robin across the four scenario
-# templates (extmem / fleet / lifecycle / elastic), each checked for
-# no-hang, bitwise-vs-twin, fault accounting, zero dropped requests, and
-# a flight dump per death; the run ends by replaying episode 0's seed and
-# requiring the identical schedule and outcome.  Any red episode prints
-# its one-command repro (--replay <scenario> <seed>).
+# >= 20 seeded multi-fault episodes round-robin across the scenario
+# templates (extmem / fleet / lifecycle / elastic / tracker_kill /
+# stall / resource), each checked for no-hang, bitwise-vs-twin, fault
+# accounting, zero dropped requests, and a flight dump per death; the
+# run ends by replaying episode 0's seed and requiring the identical
+# schedule and outcome.  Any red episode prints its one-command repro
+# (--replay <scenario> <seed>).
 JAX_PLATFORMS=cpu python scripts/chaos_soak.py --budget-s 120 \
     --seed "${NIGHTLY_SEED:-20260804}"
+
+# resource-degradation smoke (docs/reliability.md "Resource pressure &
+# graceful degradation"): train with the checkpoint directory on a
+# tmpfs too small for the keep-last-K set — the kernel returns REAL
+# ENOSPC mid-commit; the ladder must prune-retry then skip, the run
+# must finish bitwise-identical to its roomy-disk twin, every committed
+# checkpoint must scrub clean (no torn files under a final name), and
+# the degradation must be counted + loud.  Falls back to the injected
+# disk_full kind (same seam, same ladder) where tmpfs mounts are not
+# permitted.
+JAX_PLATFORMS=cpu python scripts/resource_smoke.py 10
 
 # online-lifecycle smoke (docs/serving.md "Online model lifecycle"):
 # serve -> continuation-train on fresh rows -> gate -> hot-swap under

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Real-ENOSPC resource-degradation smoke (docs/reliability.md "Resource
+pressure & graceful degradation").
+
+Leg 1 (twin): train N rounds with checkpoints on a normal directory —
+the fault-free reference bytes.
+
+Leg 2 (tiny disk): mount a tmpfs sized to ~1.8x the final model (so the
+keep-last-K snapshot set CANNOT fit) and train the same run with its
+checkpoint directory on it.  The kernel returns genuine ENOSPC from
+write()/fsync() mid-commit; the checkpoint ladder must prune-and-retry,
+then skip, and the run must finish with BITWISE-identical model bytes,
+``xtb_resource_degraded_total{subsystem="checkpoint"}`` >= 1, classified
+ENOSPC errors in ``xtb_resource_errors_total``, and — the atomicity
+half — every checkpoint that DID commit on the full disk scrubs clean
+(a torn write may never surface under a final name).
+
+Without root or a working ``mount`` (not a container privilege
+everywhere), the leg downgrades to the injected ``disk_full`` fault kind
+at the same seam and says so loudly — the fault-injection twin of the
+same ladder.
+
+Usage:  python scripts/resource_smoke.py [rounds]
+"""
+import os
+import subprocess
+import sys
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _try_mount_tmpfs(path: str, size_bytes: int) -> bool:
+    if os.geteuid() != 0:
+        return False
+    os.makedirs(path, exist_ok=True)
+    try:
+        subprocess.run(["mount", "-t", "tmpfs", "-o",
+                        f"size={size_bytes}", "tmpfs", path],
+                       check=True, capture_output=True, timeout=30)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _umount(path: str) -> None:
+    subprocess.run(["umount", "-l", path], check=False,
+                   capture_output=True, timeout=30)
+
+
+def main() -> int:
+    import tempfile
+
+    import numpy as np
+
+    import xgboost_tpu as xtb
+    from xgboost_tpu.reliability import faults, resources
+    from xgboost_tpu.reliability.checkpoint import (CheckpointCallback,
+                                                    scrub_dir)
+    from xgboost_tpu.telemetry.registry import get_registry
+
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(4000, 12)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 5, "eta": 0.3,
+              "max_bin": 64}
+
+    def train(ckpt_dir):
+        cb = CheckpointCallback(ckpt_dir, interval=1, keep_last=3)
+        bst = xtb.train(dict(params), xtb.DMatrix(X, label=y), rounds,
+                        callbacks=[cb], verbose_eval=False)
+        return bytes(bst.save_raw()), cb
+
+    # ---- leg 1: fault-free twin on a roomy disk
+    with tempfile.TemporaryDirectory(prefix="xtb_res_twin_") as td:
+        twin_bytes, _ = train(os.path.join(td, "ck"))
+        one_ckpt = max(os.path.getsize(os.path.join(td, "ck", f))
+                       for f in os.listdir(os.path.join(td, "ck")))
+    print(f"[resource_smoke] twin: {rounds} rounds, model "
+          f"{len(twin_bytes)} B, newest checkpoint {one_ckpt} B")
+
+    # ---- leg 2: the same run against a disk that cannot hold keep-last-3
+    tiny_size = int(one_ckpt * 1.8)
+    mnt = tempfile.mkdtemp(prefix="xtb_res_tiny_")
+    real_disk = _try_mount_tmpfs(mnt, tiny_size)
+    if not real_disk:
+        print("[resource_smoke] NOTE: cannot mount tmpfs (not root, or "
+              "mount unavailable) — downgrading to the injected "
+              "disk_full kind at checkpoint.write")
+        faults.install({"faults": [
+            {"site": "checkpoint.write", "kind": "disk_full",
+             "round": max(2, rounds // 2), "times": 2}]})
+    deg0 = 0.0
+    fam = get_registry().get("xtb_resource_degraded_total")
+    if fam is not None:
+        deg0 = sum(c.value for v, c in fam.collect()
+                   if v == ("checkpoint",))
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            tiny_bytes, cb = train(os.path.join(mnt, "ck"))
+        scrub = scrub_dir(os.path.join(mnt, "ck"))
+    finally:
+        faults.clear()
+        if real_disk:
+            _umount(mnt)
+
+    fam = get_registry().get("xtb_resource_degraded_total")
+    degraded = sum(c.value for v, c in fam.collect()
+                   if v == ("checkpoint",)) - deg0
+    efam = get_registry().get("xtb_resource_errors_total")
+    enospc = sum(c.value for v, c in (efam.collect() if efam else ())
+                 if v and v[0] == "ENOSPC")
+    loud = sum(1 for w in caught if "degraded" in str(w.message))
+
+    print(f"[resource_smoke] tiny disk ({tiny_size} B, "
+          f"{'real tmpfs' if real_disk else 'injected ENOSPC'}): "
+          f"run finished; ladder steps={degraded:.0f} "
+          f"skipped_rounds={cb.skipped_rounds} "
+          f"ENOSPC classified={enospc:.0f} loud warnings={loud}")
+    print(f"[resource_smoke] scrub on the full disk: "
+          f"{len(scrub['valid'])} valid / {len(scrub['corrupt'])} corrupt")
+
+    ok = True
+    if tiny_bytes != twin_bytes:
+        print("FAIL: model bytes diverged under disk pressure — "
+              "degradation changed the math", file=sys.stderr)
+        ok = False
+    if degraded < 1:
+        print("FAIL: no checkpoint ladder step was counted "
+              "(xtb_resource_degraded_total)", file=sys.stderr)
+        ok = False
+    if scrub["corrupt"]:
+        print(f"FAIL: ENOSPC left torn committed checkpoints: "
+              f"{scrub['corrupt']}", file=sys.stderr)
+        ok = False
+    if real_disk and enospc < 1:
+        print("FAIL: real ENOSPC was never classified into "
+              "xtb_resource_errors_total", file=sys.stderr)
+        ok = False
+    if loud < 1:
+        print("FAIL: degradation happened silently (no RuntimeWarning)",
+              file=sys.stderr)
+        ok = False
+    print(f"[resource_smoke] {'OK' if ok else 'FAILED'}: bitwise parity "
+          f"{'held' if tiny_bytes == twin_bytes else 'BROKEN'} across "
+          f"the {'real' if real_disk else 'injected'}-ENOSPC run")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

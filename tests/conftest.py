@@ -51,7 +51,7 @@ _QUICK_FILES = {
     "test_analysis.py", "test_native_threads.py", "test_elastic.py",
     "test_lifecycle.py", "test_updaters_process.py", "test_extmem.py",
     "test_integrity.py", "test_chaos.py", "test_watchdog.py",
-    "test_failover.py",
+    "test_failover.py", "test_resources.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)

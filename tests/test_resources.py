@@ -1,0 +1,745 @@
+"""Resource-exhaustion robustness (docs/reliability.md "Resource pressure
+& graceful degradation"): the governor's levels and ladders, the
+resource-class fault kinds, checkpoint prune-retry-skip under ENOSPC with
+bitwise model parity, journal forced compaction, clean publish aborts,
+the extmem cache/prefetch ladder, and the fleet's AIMD admission +
+SLO brownout — degradation changes how hard the machine works, never the
+math.
+"""
+import errno
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.reliability import faults, resources
+from xgboost_tpu.reliability.checkpoint import (CheckpointCallback,
+                                                CheckpointManager,
+                                                latest_checkpoint)
+from xgboost_tpu.reliability.journal import TrackerJournal
+from xgboost_tpu.telemetry.registry import get_registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    resources.reset()
+    yield
+    faults.clear()
+    resources.reset()
+
+
+def _counter(name, *labels):
+    fam = get_registry().get(name)
+    if fam is None:
+        return 0.0
+    for values, child in fam.collect():
+        if values == tuple(str(x) for x in labels):
+            return float(child.value)
+    return 0.0
+
+
+def _train_data(n=1500, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+_PARAMS = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+           "max_bin": 32}
+
+
+# ---------------------------------------------------------------------------
+# governor
+# ---------------------------------------------------------------------------
+
+def test_governor_levels_ladders_and_reset():
+    g = resources.get_governor()
+    assert g.max_level() == 0 and not g.degraded()
+    assert g.memory_scale() == 1.0 and g.prefetch_allowed()
+    assert g.brownout_cutoff() is None
+    assert g.degrade("memory", "test") == 1
+    assert g.memory_scale() == 0.25 and not g.prefetch_allowed()
+    assert g.brownout_cutoff() == 0
+    assert g.degrade("memory", "test") == 2
+    assert g.memory_scale() == 0.0
+    assert g.brownout_cutoff() == 1
+    # capped at MAX_LEVEL
+    g.degrade("memory", "t")
+    assert g.degrade("memory", "t") == resources.MAX_LEVEL
+    assert g.restore("memory") == resources.MAX_LEVEL - 1
+    resources.reset()
+    assert g.max_level() == 0 and g.memory_scale() == 1.0
+
+
+def test_note_os_error_classifies_and_degrades():
+    g = resources.get_governor()
+    before = _counter("xtb_resource_errors_total", "ENOSPC", "t.site")
+    kind = resources.note_os_error(OSError(errno.ENOSPC, "full"), "t.site")
+    assert kind == "ENOSPC"
+    assert kind in resources.DISK_ERRNOS
+    assert _counter("xtb_resource_errors_total", "ENOSPC",
+                    "t.site") == before + 1
+    assert g.level("disk") == 1
+    assert resources.note_os_error(OSError(errno.EMFILE, "fds"),
+                                   "t.site") == "EMFILE"
+    assert g.level("fd") == 1
+    # non-resource errno: classified, degrades nothing
+    assert resources.note_os_error(OSError(errno.EACCES, "perm"),
+                                   "t.site") == "EACCES"
+    assert g.level("disk") == 1 and g.level("fd") == 1
+    assert resources.note_os_error(ValueError("no errno"),
+                                   "t.site") == "EUNKNOWN"
+
+
+def test_real_headroom_poll_with_hysteresis(tmp_path, monkeypatch):
+    monkeypatch.setenv("XGBOOST_TPU_RESOURCE_POLL_S", "0")
+    g = resources.get_governor()
+    # absurd floor: any real filesystem is "below" it -> degrade once
+    monkeypatch.setenv("XGBOOST_TPU_DISK_MIN_MB", str(1 << 30))
+    g.poll(str(tmp_path))
+    assert g.level("disk") == 1
+    g.poll(str(tmp_path))
+    assert g.level("disk") == 1  # steady state: no re-degrade
+    # floor back to sane: free >= 2x floor -> restore on the transition
+    monkeypatch.setenv("XGBOOST_TPU_DISK_MIN_MB", "0.001")
+    out = g.poll(str(tmp_path))
+    assert g.level("disk") == 0
+    assert out.get("disk_free_bytes", 0) > 0
+
+
+def test_hysteresis_gradual_recovery_still_restores(monkeypatch):
+    """The latch must survive the [floor, 2*floor) gray zone: a dip
+    followed by a GRADUAL recovery restores once headroom reaches 2x the
+    floor — not only on a single-poll jump (review regression)."""
+    g = resources.get_governor()
+    g._hysteresis("disk", free=50.0, floor=64.0)
+    assert g.level("disk") == 1
+    g._hysteresis("disk", free=100.0, floor=64.0)   # gray zone
+    assert g.level("disk") == 1
+    g._hysteresis("disk", free=100.0, floor=64.0)   # still gray: no churn
+    assert g.level("disk") == 1
+    g._hysteresis("disk", free=200.0, floor=64.0)   # healthy: restore
+    assert g.level("disk") == 0
+
+
+def test_errno_raised_level_restored_by_healthy_headroom():
+    """A level raised by note_os_error (no latch involved) must walk
+    back down once measured headroom is healthy — without this, one
+    transient ENOSPC brownouts low-SLO tenants for the process lifetime
+    (review regression)."""
+    g = resources.get_governor()
+    resources.note_os_error(OSError(errno.ENOSPC, "blip"), "t.site")
+    assert g.level("disk") == 1
+    g._hysteresis("disk", free=1e12, floor=64.0)
+    assert g.level("disk") == 0
+
+
+def test_is_resource_errno_classification():
+    assert resources.is_resource_errno(OSError(errno.ENOSPC, "x"))
+    assert resources.is_resource_errno(OSError(errno.EMFILE, "x"))
+    assert not resources.is_resource_errno(OSError(errno.EACCES, "x"))
+    assert not resources.is_resource_errno(ValueError("no errno"))
+
+
+def test_pressure_seam_drives_governor_deterministically():
+    faults.install({"faults": [
+        {"site": "resource.pressure", "kind": "mem_pressure", "at": 0},
+        {"site": "resource.pressure", "kind": "fd_exhaust", "at": 1},
+    ]})
+    g = resources.get_governor()
+    g.poll()
+    assert g.level("memory") == 1 and g.level("fd") == 0
+    g.poll()  # fd_exhaust raises EMFILE into the classifier
+    assert g.level("fd") == 1
+    g.poll()  # plan exhausted: no further transitions
+    assert g.level("memory") == 1 and g.level("fd") == 1
+
+
+# ---------------------------------------------------------------------------
+# resource fault kinds
+# ---------------------------------------------------------------------------
+
+def test_disk_full_and_fd_exhaust_kinds_raise_matching_errno():
+    faults.install({"faults": [
+        {"site": "checkpoint.write", "kind": "disk_full"},
+        {"site": "serve.worker", "kind": "fd_exhaust"},
+    ]})
+    with pytest.raises(OSError) as ei:
+        faults.maybe_inject("checkpoint.write")
+    assert ei.value.errno == errno.ENOSPC
+    with pytest.raises(OSError) as ei:
+        faults.maybe_inject("serve.worker")
+    assert ei.value.errno == errno.EMFILE
+
+
+def test_slow_disk_kind_sleeps_and_returns_spec():
+    import time
+
+    faults.install({"faults": [
+        {"site": "extmem.page_load", "kind": "slow_disk", "seconds": 0.05},
+    ]})
+    t0 = time.perf_counter()
+    spec = faults.maybe_inject("extmem.page_load")
+    assert spec is not None and spec.kind == "slow_disk"
+    assert time.perf_counter() - t0 >= 0.045
+
+
+# ---------------------------------------------------------------------------
+# checkpoint ladder (satellite: keep-last-K pruning under disk_full)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_prune_keep_overrides_keep_last(tmp_path):
+    from xgboost_tpu.reliability.checkpoint import CheckpointState
+
+    mgr = CheckpointManager(str(tmp_path), keep_last=5)
+    for r in range(1, 5):
+        mgr.save(CheckpointState(round=r, booster_bytes=b"x" * 64,
+                                 history={}, callback_state={}))
+    assert len(mgr.files()) == 4
+    mgr.prune(keep=1)
+    files = mgr.files()
+    assert len(files) == 1 and files[0].endswith("ckpt_00000004.xtbckpt")
+
+
+def test_disk_full_once_heals_on_pruned_retry(tmp_path):
+    """times=1: the first commit attempt hits ENOSPC, the ladder prunes
+    to the newest snapshot and the retry lands — the round IS
+    checkpointed, one degraded step counted."""
+    X, y = _train_data()
+    faults.install({"faults": [{"site": "checkpoint.write",
+                                "kind": "disk_full", "round": 4}]})
+    before = _counter("xtb_resource_degraded_total", "checkpoint")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        cb = CheckpointCallback(str(tmp_path), interval=2)
+        xtb.train(dict(_PARAMS), xtb.DMatrix(X, label=y), 6,
+                  callbacks=[cb], verbose_eval=False)
+    assert cb.skipped_rounds == []
+    assert cb.last_saved_round == 6
+    st = latest_checkpoint(str(tmp_path))
+    assert st is not None and st.round == 6
+    assert _counter("xtb_resource_degraded_total",
+                    "checkpoint") == before + 1
+
+
+def test_disk_full_persistent_skips_snapshot_and_training_continues(
+        tmp_path):
+    X, y = _train_data()
+    faults.install({"faults": [{"site": "checkpoint.write",
+                                "kind": "disk_full", "round": 4,
+                                "times": 2}]})
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        cb = CheckpointCallback(str(tmp_path), interval=2)
+        bst = xtb.train(dict(_PARAMS), xtb.DMatrix(X, label=y), 6,
+                        callbacks=[cb], verbose_eval=False)
+    assert cb.skipped_rounds == [4]
+    assert bst.num_boosted_rounds() == 6  # the run finished
+    # the loud-warning contract
+    assert any("degraded" in str(w.message) for w in caught)
+    # rounds 2 and 6 still committed; prune-to-1 dropped round 2 so the
+    # newest valid snapshot is round 6
+    st = latest_checkpoint(str(tmp_path))
+    assert st is not None and st.round == 6
+
+
+def test_non_disk_oserror_still_raises(tmp_path):
+    from xgboost_tpu.reliability.checkpoint import CheckpointState
+
+    cb = CheckpointCallback(str(tmp_path), interval=1)
+
+    class _Mgr(CheckpointManager):
+        def save(self, state):
+            raise OSError(errno.EACCES, "permission denied")
+
+    cb.manager = _Mgr(str(tmp_path))
+    with pytest.raises(OSError):
+        cb._save_degradable(CheckpointState(
+            round=1, booster_bytes=b"x", history={}, callback_state={}))
+
+
+def test_mid_run_disk_full_bitwise_parity_and_flight_event(tmp_path):
+    """THE acceptance case: a training run with a mid-run disk_full on
+    the checkpoint directory completes with bitwise-identical model
+    bytes to a fault-free twin, emits
+    xtb_resource_degraded_total{subsystem="checkpoint"} >= 1 and a
+    flight-recorder degradation event."""
+    from xgboost_tpu.telemetry import flight
+
+    X, y = _train_data()
+    twin = xtb.train(dict(_PARAMS), xtb.DMatrix(X, label=y), 8,
+                     verbose_eval=False)
+    before = _counter("xtb_resource_degraded_total", "checkpoint")
+    faults.install({"faults": [{"site": "checkpoint.write",
+                                "kind": "disk_full", "round": 4,
+                                "times": 2}]})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        bst = xtb.train(dict(_PARAMS), xtb.DMatrix(X, label=y), 8,
+                        callbacks=[CheckpointCallback(str(tmp_path),
+                                                      interval=2)],
+                        verbose_eval=False)
+    assert bytes(bst.save_raw()) == bytes(twin.save_raw())
+    assert _counter("xtb_resource_degraded_total",
+                    "checkpoint") >= before + 1
+    names = [e.get("name") for e in flight.events()]
+    assert "resource.degraded" in names
+
+
+def test_resume_after_degraded_run_bitwise_parity(tmp_path):
+    """Resume-after-degradation: a run whose round-4 snapshot was lost to
+    ENOSPC resumes from what DID commit and lands on the same bytes as
+    an uninterrupted run."""
+    X, y = _train_data()
+    twin = xtb.train(dict(_PARAMS), xtb.DMatrix(X, label=y), 8,
+                     verbose_eval=False)
+    # leg 1: train 5 rounds; the round-4 snapshot is skipped (ENOSPC on
+    # commit and on the pruned retry), so the newest snapshot is round 2
+    faults.install({"faults": [{"site": "checkpoint.write",
+                                "kind": "disk_full", "round": 4,
+                                "times": 2}]})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        xtb.train(dict(_PARAMS), xtb.DMatrix(X, label=y), 5,
+                  callbacks=[CheckpointCallback(str(tmp_path), interval=2,
+                                                keep_last=1)],
+                  verbose_eval=False)
+    faults.clear()
+    st = latest_checkpoint(str(tmp_path))
+    assert st is not None and st.round == 2  # the degradation gap is real
+    # leg 2: resume to the full 8 rounds from the surviving snapshot
+    bst = xtb.train(dict(_PARAMS), xtb.DMatrix(X, label=y), 8,
+                    resume_from=str(tmp_path), verbose_eval=False)
+    assert bytes(bst.save_raw()) == bytes(twin.save_raw())
+
+
+# ---------------------------------------------------------------------------
+# journal ladder (satellite: forced compaction under disk_full)
+# ---------------------------------------------------------------------------
+
+def test_journal_disk_full_forces_compaction_then_retries(tmp_path):
+    path = str(tmp_path / "j.jrnl")
+    j = TrackerJournal(path)
+    for i in range(6):
+        j.append({"epoch": i, "world": 2})
+    grown = os.path.getsize(path)
+    before = _counter("xtb_resource_degraded_total", "journal")
+    faults.install({"faults": [{"site": "tracker.journal",
+                                "kind": "disk_full"}]})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        j.append({"epoch": 6, "world": 2})
+    faults.clear()
+    # the ladder compacted (single-record file is smaller than the grown
+    # one even with the retried record appended after it) and the retry
+    # committed the record
+    assert os.path.getsize(path) < grown
+    assert j.load() == {"epoch": 6, "world": 2}
+    assert _counter("xtb_resource_degraded_total",
+                    "journal") == before + 1
+
+
+def test_journal_disk_full_persistent_skips_record_keeps_running(tmp_path):
+    path = str(tmp_path / "j.jrnl")
+    j = TrackerJournal(path)
+    j.append({"epoch": 0, "world": 2})
+    faults.install({"faults": [{"site": "tracker.journal",
+                                "kind": "disk_full", "times": 2}]})
+    before = _counter("xtb_resource_degraded_total", "journal")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        j.append({"epoch": 1, "world": 2})  # must NOT raise
+    faults.clear()
+    # both append attempts failed, but the forced compaction committed
+    # the NEW state atomically on its own path — the transition survives
+    # ENOSPC on the append framing entirely
+    assert j.load() == {"epoch": 1, "world": 2}
+    assert _counter("xtb_resource_degraded_total",
+                    "journal") == before + 2  # compaction + append skip
+    # and the journal still works once pressure clears
+    j.append({"epoch": 2, "world": 2})
+    assert j.load() == {"epoch": 2, "world": 2}
+
+
+# ---------------------------------------------------------------------------
+# model store / lifecycle
+# ---------------------------------------------------------------------------
+
+def test_publish_disk_full_aborts_cleanly_no_torn_files(tmp_path):
+    from xgboost_tpu.serving.modelstore import ModelStore
+
+    X, y = _train_data(400)
+    bst = xtb.train(dict(_PARAMS), xtb.DMatrix(X, label=y), 3,
+                    verbose_eval=False)
+    store = ModelStore(str(tmp_path / "store"))
+    v1 = store.publish("m", bst)
+    listing = sorted(os.listdir(store.dir))
+    faults.install({"faults": [{"site": "modelstore.publish",
+                                "kind": "disk_full"}]})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with pytest.raises(OSError):
+            store.publish("m", bst)
+    faults.clear()
+    # no tmp litter, no torn version files, manifest untouched
+    assert sorted(os.listdir(store.dir)) == listing
+    assert store.latest_version("m") == v1
+    assert store.scrub() == {"verified": [("m", v1)], "corrupt": []}
+
+
+def test_lifecycle_cycle_rejects_with_reason_resource(tmp_path):
+    """A publish-time ENOSPC fails the cycle CLEANLY: reason="resource",
+    incumbent untouched (stub fleet, no processes)."""
+    from xgboost_tpu.lifecycle import (GateConfig, LifecycleConfig,
+                                       LifecycleManager)
+    from xgboost_tpu.serving.modelstore import ModelStore
+
+    X, y = _train_data(400)
+    bst = xtb.train(dict(_PARAMS), xtb.DMatrix(X, label=y), 3,
+                    verbose_eval=False)
+    store = ModelStore(str(tmp_path / "store"))
+    store.publish("m", bst)
+    store.set_active("m", 1)
+
+    class _StubFleet:
+        store_dir = store.dir
+
+        def active_version(self, name):
+            return store.active_version(name)
+
+        def load_version(self, *a, **k):
+            return [{}]
+
+        def activate_version(self, model, version, **k):
+            store.set_active(model, version)
+            return [{}]
+
+        def retire_version(self, *a, **k):
+            return [{}]
+
+    mgr = LifecycleManager(_StubFleet(), "m", config=LifecycleConfig(
+        rounds_per_cycle=1, gate=GateConfig(min_improvement=-1e9)))
+    faults.install({"faults": [{"site": "modelstore.publish",
+                                "kind": "disk_full"}]})
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        report = mgr.run_cycle((X, y))
+    faults.clear()
+    assert not report.swapped
+    assert report.decision.reason == "resource"
+    assert store.active_version("m") == 1  # incumbent untouched
+    assert store.latest_version("m") == 1  # nothing half-published
+    # a NON-exhaustion OSError is a bug and must raise, not masquerade
+    # as transient pressure (review regression)
+    faults.clear()
+    resources.reset()
+    real_publish = type(store).publish
+
+    def _eacces_publish(self, *a, **k):
+        raise OSError(errno.EACCES, "misconfigured store dir")
+
+    type(store).publish = _eacces_publish
+    try:
+        with pytest.raises(OSError):
+            mgr.run_cycle((X, y))
+    finally:
+        type(store).publish = real_publish
+
+
+# ---------------------------------------------------------------------------
+# extmem ladder
+# ---------------------------------------------------------------------------
+
+def test_extmem_ladder_prefetch_and_cache_budget(monkeypatch):
+    from xgboost_tpu.data import extmem
+
+    monkeypatch.setenv("XTB_EXTMEM_PREFETCH_PAGES", "3")
+    monkeypatch.setenv("XTB_EXTMEM_HOST_CACHE_MB", "100")
+    assert extmem.prefetch_lookahead() == 3
+    assert extmem._host_cache_budget() == int(100 * 2**20)
+    g = resources.get_governor()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        g.degrade("memory", "test")
+        assert extmem.prefetch_lookahead() == 0
+        assert extmem._host_cache_budget() == int(100 * 2**20 * 0.25)
+        g.degrade("memory", "test")
+        assert extmem._host_cache_budget() == 0  # recompute every touch
+        resources.reset()
+        assert extmem.prefetch_lookahead() == 3
+        # fd pressure alone also parks the prefetch window
+        g.degrade("fd", "test")
+        assert extmem.prefetch_lookahead() == 0
+    assert _counter("xtb_resource_degraded_total", "extmem") >= 2
+
+
+def test_extmem_training_bitwise_under_memory_pressure(tmp_path):
+    """Cache disabled + prefetch off must not change one model bit —
+    the ladder changes how hard the machine works, never the math."""
+    Xs = [c.astype(np.float32) for c in
+          np.array_split(np.random.default_rng(3).normal(
+              size=(1200, 6)), 3)]
+    ys = [(x[:, 0] > 0).astype(np.float32) for x in Xs]
+
+    class _It(xtb.DataIter):
+        def __init__(self):
+            super().__init__()
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= len(Xs):
+                return 0
+            input_data(data=Xs[self.i], label=ys[self.i])
+            self.i += 1
+            return 1
+
+        def reset(self):
+            self.i = 0
+
+    def run():
+        d = xtb.ExtMemQuantileDMatrix(_It(), max_bin=32)
+        return bytes(xtb.train(dict(_PARAMS), d, 4,
+                               verbose_eval=False).save_raw())
+
+    clean = run()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        resources.get_governor().degrade("memory", "test")
+        resources.get_governor().degrade("memory", "test")
+        degraded = run()
+    assert degraded == clean
+
+
+# ---------------------------------------------------------------------------
+# fleet: AIMD admission + brownout (pure units; E2E rides test_fleet)
+# ---------------------------------------------------------------------------
+
+def test_adaptive_admission_aimd_window():
+    from xgboost_tpu.serving.fleet import AdaptiveAdmission
+
+    a = AdaptiveAdmission(1024)
+    assert a.limit() == 1024 and a.floor == 8
+    assert a.on_pressure() is False  # 512: nowhere near the floor
+    assert a.limit() == 512
+    edges = [a.on_pressure() for _ in range(10)]
+    assert a.limit() == 8
+    assert edges.count(True) == 1  # exactly one onto-the-floor edge
+    # additive recovery: one completion = +1
+    assert a.on_ok() is False and a.limit() == 9
+    for _ in range(1024):
+        recovered = a.on_ok()
+    assert a.limit() == 1024
+    assert recovered is False  # the recovered edge fired once, earlier
+    # edge fires once per excursion
+    for _ in range(20):
+        a.on_pressure()
+    assert sum(a.on_pressure() for _ in range(3)) == 0
+
+
+def test_adaptive_admission_small_queues_never_couple():
+    from xgboost_tpu.serving.fleet import AdaptiveAdmission
+
+    a = AdaptiveAdmission(4)  # floor clamps to the ceiling
+    assert not a.coupled
+    assert all(not a.on_pressure() for _ in range(10))
+    assert a.limit() == 4  # toy queues keep their full bound
+    # 9..31: the window works but governor coupling stays off — the
+    # floor edge and the ceiling/2 recovery edge would be one
+    # completion apart, flapping the overload level per request
+    b = AdaptiveAdmission(16)
+    assert not b.coupled
+    assert all(not b.on_pressure() for _ in range(10))
+    assert not b.on_ok()  # no recovered edge either: never floored-out
+    c = AdaptiveAdmission(32)
+    assert c.coupled  # first size where the edges are a doubling apart
+
+
+def test_adaptive_admission_edges_are_a_doubling_apart():
+    """On a coupled queue, recovering from the floor takes >= floor
+    completions (8 -> 16 on max_queue=32), so overload cannot flap
+    per-request under sustained saturation (review regression)."""
+    from xgboost_tpu.serving.fleet import AdaptiveAdmission
+
+    a = AdaptiveAdmission(32)
+    edges = sum(a.on_pressure() for _ in range(10))
+    assert edges == 1 and a.limit() == 8
+    oks = [a.on_ok() for _ in range(8)]
+    assert oks[:-1] == [False] * 7 and oks[-1] is True  # 8 -> 16: edge
+    assert a.limit() == 16
+
+
+def test_dispatch_queue_honors_admission_limit():
+    from xgboost_tpu.serving.fleet import (DispatchQueue, SLOClass,
+                                           _Request)
+
+    q = DispatchQueue(max_queue=100)
+    slo = SLOClass("t", priority=0)
+    reqs = [_Request(i, "m", {}, b"", slo) for i in range(5)]
+    assert q.push(reqs[0], limit=2) is None
+    assert q.push(reqs[1], limit=2) is None
+    victim = q.push(reqs[2], limit=2)  # window full: equal prio sheds self
+    assert victim is reqs[2]
+    assert q.push(reqs[3], limit=4) is None  # window re-opened
+
+
+def test_brownout_cutoff_sheds_low_slo_first():
+    g = resources.get_governor()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert g.brownout_cutoff() is None
+        g.degrade("overload", "test")
+        # level 1: below-default tenants shed, default (0) and up served
+        assert g.brownout_cutoff() == 0
+        assert not (-1 >= g.brownout_cutoff())
+        g.degrade("overload", "test")
+        # level 2: the default class sheds too; priority >= 1 serves
+        assert g.brownout_cutoff() == 1
+        g.degrade("disk", "test")  # the WORST resource drives the cutoff
+        assert g.brownout_cutoff() == 1
+
+
+def test_fleet_submit_brownout_path_without_processes():
+    """submit()'s brownout admission check, driven directly on an
+    unstarted fleet object (no replicas needed: the shed happens before
+    any queue/socket work)."""
+    from xgboost_tpu.serving.batcher import QueueFullError
+    from xgboost_tpu.serving.fleet import FleetConfig, ServingFleet, SLOClass
+
+    cfg = FleetConfig(n_replicas=1, slo_classes={
+        "free": SLOClass("free", priority=-1),
+        "gold": SLOClass("gold", priority=2)})
+    fleet = ServingFleet({}, cfg)
+    fleet._started = True  # bypass start() (no processes in this test)
+    before = _counter("xtb_fleet_brownout_total", "free")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        resources.get_governor().degrade("overload", "test")
+    fut = fleet.submit("m", np.zeros((1, 2), np.float32), tenant="free")
+    with pytest.raises(QueueFullError, match="browned out"):
+        fut.result(timeout=1)
+    assert _counter("xtb_fleet_brownout_total", "free") == before + 1
+    # a gold request passes admission (it queues; nothing serves it here)
+    fut2 = fleet.submit("m", np.zeros((1, 2), np.float32), tenant="gold")
+    assert not fut2.done()
+    resources.reset()
+
+
+# ---------------------------------------------------------------------------
+# chaos: the resource scenario in the quick tier (>= 2 episodes + replay)
+# ---------------------------------------------------------------------------
+
+def test_resource_chaos_two_episodes_with_seeded_replay():
+    from xgboost_tpu.reliability import chaos
+
+    first = chaos.run_episode("resource", 11)
+    assert first.ok, (first.invariants, first.error)
+    second = chaos.run_episode("resource", 12)
+    assert second.ok, (second.invariants, second.error)
+    replay = chaos.run_episode("resource", 11)
+    assert replay.plan == first.plan
+    assert replay.artifacts.get("digest") == first.artifacts.get("digest")
+    assert replay.ok
+
+
+def test_resource_scenario_is_in_the_soak_rotation():
+    from xgboost_tpu.reliability import chaos
+
+    assert "resource" in chaos.SCENARIOS
+    sc = chaos.SCENARIOS["resource"]
+    kinds = {(e.site, e.kind) for e in sc.catalog}
+    assert ("checkpoint.write", "disk_full") in kinds
+    assert ("resource.pressure", "mem_pressure") in kinds
+    assert sc.twin  # bitwise-vs-twin is the heart of the contract
+
+
+# ---------------------------------------------------------------------------
+# xtblint XTB801
+# ---------------------------------------------------------------------------
+
+def _lint(src, filename):
+    from xgboost_tpu.analysis.core import lint_source
+
+    return [f.code for f in lint_source(src, filename).findings
+            if f.code.startswith("XTB8")]
+
+
+def test_xtb801_flags_silent_swallow_in_scope():
+    src = ("import os\n"
+           "def f(p):\n"
+           "    try:\n"
+           "        os.unlink(p)\n"
+           "    except OSError:\n"
+           "        pass\n")
+    assert _lint(src, "xgboost_tpu/reliability/x.py") == ["XTB801"]
+    assert _lint(src, "xgboost_tpu/serving/x.py") == ["XTB801"]
+    assert _lint(src, "xgboost_tpu/data/x.py") == ["XTB801"]
+    # out of scope: telemetry etc. are not resource-critical modules
+    assert _lint(src, "xgboost_tpu/telemetry/x.py") == []
+
+
+def test_xtb801_accepts_the_four_compliant_shapes():
+    route = ("import os\n"
+             "from xgboost_tpu.reliability import resources\n"
+             "def f(p):\n"
+             "    try:\n"
+             "        os.unlink(p)\n"
+             "    except OSError as e:\n"
+             "        resources.note_os_error(e, 's')\n")
+    reraise = ("import os\n"
+               "def f(p):\n"
+               "    try:\n"
+               "        os.unlink(p)\n"
+               "    except OSError:\n"
+               "        raise RuntimeError('x')\n")
+    counts = ("import os\n"
+              "def f(p, c):\n"
+              "    try:\n"
+              "        os.unlink(p)\n"
+              "    except OSError:\n"
+              "        c.labels('x').inc()\n")
+    surfaces = ("import os, warnings\n"
+                "def f(p):\n"
+                "    try:\n"
+                "        os.unlink(p)\n"
+                "    except OSError as e:\n"
+                "        warnings.warn(f'gone: {e}')\n")
+    narrow = ("import os\n"
+              "def f(p):\n"
+              "    try:\n"
+              "        os.unlink(p)\n"
+              "    except FileNotFoundError:\n"
+              "        pass\n")
+    for src in (route, reraise, counts, surfaces, narrow):
+        assert _lint(src, "xgboost_tpu/reliability/x.py") == [], src
+
+
+def test_xtb801_tuple_catch_and_unused_binding_still_flagged():
+    tup = ("import os\n"
+           "def f(p):\n"
+           "    try:\n"
+           "        os.unlink(p)\n"
+           "    except (ValueError, OSError):\n"
+           "        return None\n")
+    bound_unused = ("import os\n"
+                    "def f(p):\n"
+                    "    try:\n"
+                    "        os.unlink(p)\n"
+                    "    except OSError as e:\n"
+                    "        print('oops')\n")
+    assert _lint(tup, "xgboost_tpu/data/x.py") == ["XTB801"]
+    assert _lint(bound_unused, "xgboost_tpu/data/x.py") == ["XTB801"]
+
+
+def test_repo_is_xtb801_clean():
+    from xgboost_tpu.analysis.core import run_lint
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = run_lint([os.path.join(root, "xgboost_tpu")],
+                   select=["XTB801"])
+    assert res.findings == [], [f.render() for f in res.findings]
+    assert res.suppressed == []  # zero suppressions, per the satellite

@@ -189,13 +189,14 @@ def _detect_docs_root(paths: Sequence[str]) -> Optional[str]:
 
 def _rules() -> List[Rule]:
     # imported here so `import xgboost_tpu.analysis.core` stays cycle-free
-    from . import (blocking, locks, metric_names, nondet, retrace, seams,
-                   simd_seam)
+    from . import (blocking, locks, metric_names, nondet, resource_errors,
+                   retrace, seams, simd_seam)
 
     return [retrace.RetraceRule(), locks.LockDisciplineRule(),
             locks.CapiDispatchRule(), seams.SeamConsistencyRule(),
             metric_names.MetricNameRule(), nondet.NondeterminismRule(),
-            simd_seam.SimdSeamRule(), blocking.BlockingCallRule()]
+            simd_seam.SimdSeamRule(), blocking.BlockingCallRule(),
+            resource_errors.ResourceErrorRule()]
 
 
 @dataclasses.dataclass

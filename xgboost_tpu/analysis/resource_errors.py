@@ -1,0 +1,116 @@
+"""XTB8xx — silent OS-error swallows in resource-critical modules.
+
+The resource-pressure audit (docs/reliability.md "Resource pressure &
+graceful degradation") found the failure pattern behind most "mystery"
+degradations: an ``except OSError: pass`` at a write/close/cleanup site.
+ENOSPC on a checkpoint, EMFILE on an accept loop, and EBADF on a routine
+shutdown close all vanish into the same two lines — so the one errno that
+*mattered* was indistinguishable from the noise, and the first visible
+symptom of a full disk was a crash three subsystems away.
+
+**XTB801**: in the ``reliability/``, ``serving/``, and ``data/`` modules,
+an ``except`` handler that catches bare ``OSError`` (or ``IOError`` /
+``EnvironmentError``, or a tuple containing one) must do at least one of:
+
+- **re-raise** (``raise`` anywhere in the handler body);
+- **route through the governor** — call ``note_os_error(...)`` /
+  ``degrade(...)`` (``reliability/resources.py`` classifies the errno
+  into ``xtb_resource_errors_total{errno,site}`` and degrades the
+  matching resource level);
+- **increment a telemetry counter** (an ``.inc(...)`` call);
+- **surface the caught exception** — bind it (``as e``) and pass it into
+  some call (a warning, a death path, a wrapped re-raise), so the error
+  object leaves the handler instead of dying in it.
+
+Handlers doing none of these are *silent swallows* and fail the gate.
+Narrow catches (``except FileNotFoundError``) are exempt: naming the
+precise expected errno IS the classification — the rule targets the
+catch-all shape that conflates "expected" with "out of disk".
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import Finding, Project, Rule, SourceFile
+
+# package-relative path prefixes in scope: the modules that own storage,
+# sockets, and spill files — where an errno is load-bearing
+_SCOPE_PREFIXES = ("reliability/", "serving/", "data/")
+
+# bare catch-all names the rule triggers on (IOError/EnvironmentError are
+# OSError aliases since 3.3)
+_BROAD_NAMES = {"OSError", "IOError", "EnvironmentError"}
+
+# calls that count as routing/counting: the governor funnel, the
+# telemetry counter increment shape, and the integrity accounting funnel
+# (reliability/integrity.py — those ARE labeled counters)
+_ROUTING_CALLS = {"note_os_error", "degrade", "inc",
+                  "corrupt_detected", "quarantined", "retried", "scrubbed"}
+
+
+def _name_tail(node: ast.expr) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _catches_broad_oserror(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return False  # bare `except:` is XTB-agnostic (and already rare)
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(_name_tail(x) in _BROAD_NAMES for x in types)
+
+
+def _handler_compliant(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name  # `except OSError as e` -> "e"; None when unbound
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                if _name_tail(node.func) in _ROUTING_CALLS:
+                    return True
+                if bound is not None:
+                    # does the caught exception flow INTO this call?
+                    for part in list(node.args) + [
+                            kw.value for kw in node.keywords]:
+                        for sub in ast.walk(part):
+                            if (isinstance(sub, ast.Name)
+                                    and sub.id == bound):
+                                return True
+    return False
+
+
+class ResourceErrorRule(Rule):
+    name = "resource-errors"
+    codes = {
+        "XTB801": "bare `except OSError` in reliability/serving/data must "
+                  "re-raise, route through the resource governor "
+                  "(note_os_error/degrade), increment a counter, or pass "
+                  "the caught error to a call — no silent swallows",
+    }
+
+    def check_file(self, sf: SourceFile, project: Project,
+                   ) -> Iterable[Finding]:
+        if not sf.rel.startswith(_SCOPE_PREFIXES):
+            return ()
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _catches_broad_oserror(node):
+                continue
+            if _handler_compliant(node):
+                continue
+            findings.append(sf.finding(
+                node, "XTB801",
+                "silent OSError swallow: classify it "
+                "(reliability.resources.note_os_error(e, site)), count "
+                "it, re-raise it, or narrow the except to the precise "
+                "expected subclass — an ENOSPC dropped here surfaces "
+                "three subsystems away as a mystery crash"))
+        return findings

@@ -299,14 +299,35 @@ _PAGE_CACHE_BYTES = 0
 _CACHE_LOCK = threading.Lock()
 
 
+# one-shot announcements of governed extmem ladder steps (benign racy:
+# a duplicate flight event at worst); keyed so a restore re-arms them
+_GOV_ANNOUNCED = {"prefetch": False, "cache_level": 0}
+
+
 def _host_cache_budget() -> int:
+    """Page-cache byte budget: the XTB_EXTMEM_HOST_CACHE_MB env knob
+    scaled by the resource governor's memory ladder — level 1 cuts it to
+    a quarter, level 2+ disables caching entirely (every page touch
+    recomputes from its backing store; bitwise-identical, just slower —
+    docs/reliability.md "Resource pressure & graceful degradation")."""
     import os
+
+    from ..reliability import resources as _resources
 
     try:
         mb = float(os.environ.get("XTB_EXTMEM_HOST_CACHE_MB", "1024"))
     except ValueError:
         mb = 1024.0
-    return int(mb * 2**20)
+    gov = _resources.get_governor()
+    scale = gov.memory_scale()
+    level = gov.level("memory")
+    if level != _GOV_ANNOUNCED["cache_level"]:
+        _GOV_ANNOUNCED["cache_level"] = level
+        if level > 0:
+            _resources.degraded_event(
+                "extmem", "cache_budget_scaled", memory_level=level,
+                scale=scale)
+    return int(mb * 2**20 * scale)
 
 
 def _page_cache_evict_page(pid: int) -> None:
@@ -421,14 +442,31 @@ def _prefetch_pool():
 def prefetch_lookahead(default: int = 2) -> int:
     """Prefetch window width (pages in flight beyond the one being
     consumed).  XTB_EXTMEM_PREFETCH_PAGES overrides; 0 disables the pool
-    (synchronous staging)."""
+    (synchronous staging).  Under memory or fd pressure the resource
+    governor forces 0 — no decoded pages in flight beyond the consumer,
+    no extra spill files open — the first step of the extmem degradation
+    ladder (bitwise-identical output, pinned by tests)."""
     import os
+
+    from ..reliability import resources as _resources
 
     try:
         n = int(os.environ.get("XTB_EXTMEM_PREFETCH_PAGES", str(default)))
     except ValueError:
         n = default
-    return max(n, 0)
+    n = max(n, 0)
+    gov = _resources.get_governor()
+    if n > 0 and not gov.prefetch_allowed():
+        if not _GOV_ANNOUNCED["prefetch"]:
+            _GOV_ANNOUNCED["prefetch"] = True
+            _resources.degraded_event(
+                "extmem", "prefetch_disabled",
+                memory_level=gov.level("memory"),
+                fd_level=gov.level("fd"))
+        return 0
+    if gov.prefetch_allowed():
+        _GOV_ANNOUNCED["prefetch"] = False  # re-arm after a restore
+    return n
 
 
 # Deterministic pipeline-shape probe for tests (XTB_EXTMEM_EVENT_LOG=1):
@@ -697,6 +735,13 @@ class ExtMemQuantileDMatrix(DMatrix):
         )
 
         # ---- pass 2: bin pages on device, park them on host/disk ----
+        # governor tick at the page-build boundary: the resource.pressure
+        # seam fires here (deterministic program point — chaos plans key
+        # invocation numbers off it) and real headroom on the spill
+        # directory is measured when one exists
+        from ..reliability import resources as _resources
+
+        _resources.get_governor().poll(self._spill_dir)
         self._kind = "extmem"
         self._dense = None
         self._csr = None

@@ -65,7 +65,7 @@ class GateDecision:
     """One gate verdict (deterministic for fixed inputs)."""
 
     accepted: bool
-    # "accepted" | "metric" | "checksum" | "shadow" | "fault"
+    # "accepted" | "metric" | "checksum" | "shadow" | "fault" | "resource"
     reason: str
     metric: str = ""
     candidate_score: float = float("nan")

@@ -413,9 +413,33 @@ class LifecycleManager:
             return CycleReport(self.model, incumbent_v, None, False,
                                decision, timings=timings,
                                trace_id=trace_id)
-        with self._phase("publish", timings):
-            version = self.store.publish(self.model, candidate)
-            checksum_ok = self.store.verify_checksum(self.model, version)
+        try:
+            with self._phase("publish", timings):
+                version = self.store.publish(self.model, candidate)
+                checksum_ok = self.store.verify_checksum(self.model,
+                                                         version)
+        except OSError as e:
+            from ..reliability import resources as _resources
+
+            if not _resources.is_resource_errno(e):
+                # EACCES/EROFS/etc. is a misconfiguration BUG, not
+                # pressure — masking it as a transient "resource" reject
+                # would hide it forever (the checkpoint/journal ladders
+                # make the same distinction)
+                raise
+            # resource exhaustion mid-publish (ENOSPC writing the arena,
+            # EMFILE): the store cleaned its tmp files and the manifest
+            # never moved — reject the cycle with reason "resource", the
+            # incumbent untouched (docs/reliability.md "Resource
+            # pressure & graceful degradation")
+            instruments()[3].labels("resource").inc()
+            return CycleReport(
+                self.model, incumbent_v, None, False,
+                GateDecision(False, "resource", decision.metric,
+                             decision.candidate_score,
+                             decision.incumbent_score,
+                             decision.improvement, detail=str(e)),
+                timings=timings, trace_id=trace_id)
         if not checksum_ok:
             # bitwise half of the gate: a torn/drifted arena must never
             # activate.  active still points at the incumbent, so the

@@ -23,6 +23,13 @@ the three pieces that make that survivable:
   counters behind every checksum boundary — wire frames, tracker
   messages, extmem pages, model arenas, checkpoints (docs/reliability.md
   "Integrity & chaos").
+- **Resource governor** (resources.py): per-resource degradation levels
+  (memory/disk/fd/overload), OS-error classification
+  (``note_os_error`` → ``xtb_resource_errors_total``), and the graceful
+  degradation ladders — checkpoint prune-retry-skip under ENOSPC,
+  journal forced compaction, clean publish aborts, extmem cache/prefetch
+  shrink, fleet AIMD + SLO brownout (docs/reliability.md "Resource
+  pressure & graceful degradation").
 - **Chaos soak** (chaos.py): seeded multi-fault schedules composed over
   the seam catalog, run through scenario templates with checked
   invariants and bit-for-bit replay (``scripts/chaos_soak.py``).
@@ -32,7 +39,7 @@ fault-plan schema, serving degradation behavior).
 """
 from __future__ import annotations
 
-from . import faults, integrity, watchdog
+from . import faults, integrity, resources, watchdog
 from .checkpoint import (CheckpointCallback, CheckpointManager,
                          CheckpointState, latest_checkpoint, scrub_dir)
 from .faults import FaultInjected, FaultPlan, FaultSpec, corrupt_bytes
@@ -53,6 +60,7 @@ __all__ = [
     "corrupt_bytes",
     "faults",
     "integrity",
+    "resources",
     "RetriesExhausted",
     "backoff_delays",
     "retry_call",

@@ -13,8 +13,11 @@ gap (docs/reliability.md "Integrity & chaos"):
 - **Scenario templates** (:data:`SCENARIOS`) — an external-memory
   training run, a serving fleet under traffic, a lifecycle hot-swap
   cycle, a multi-process elastic training run, a coordinator-failover
-  run (the supervised tracker SIGKILL'd at a journal write), and a
-  stall-watchdog run (a delay past tight budgets); each knows which
+  run (the supervised tracker SIGKILL'd at a journal write), a
+  stall-watchdog run (a delay past tight budgets), and a
+  resource-exhaustion run (ENOSPC at checkpoint commits, injected
+  memory/fd pressure through the governor — the degradation ladders
+  must absorb it bitwise); each knows which
   (seam, kind) pairs its stack must *survive* (a green episode means the
   faults fired AND the contract held — nothing in a catalog is allowed
   to be fatal).
@@ -93,6 +96,19 @@ def _counter_total(name: str) -> float:
     if fam is None:
         return 0.0
     return sum(child.value for _values, child in fam.collect())
+
+
+def _counter_labeled(name: str, *label_values: str) -> float:
+    """One label set's counter value (0 when family/child absent)."""
+    from ..telemetry.registry import get_registry
+
+    fam = get_registry().get(name)
+    if fam is None:
+        return 0.0
+    for values, child in fam.collect():
+        if values == tuple(label_values):
+            return float(child.value)
+    return 0.0
 
 
 def _digest(*parts) -> str:
@@ -590,6 +606,118 @@ def _check_stall(fired, artifacts, baseline) -> Dict[str, str]:
     return inv
 
 
+# ---------------------------------------------------------------- resource
+def _run_resource(workdir: str) -> dict:
+    """Paged training with checkpoints under resource exhaustion: the
+    extmem episode's shape, but the catalog throws disk_full at the
+    checkpoint commits, mem_pressure/fd_exhaust at the governor polls,
+    and slow_disk at the page loads — the degradation-ladder contract is
+    that the run COMPLETES with bitwise-identical model bytes and every
+    ladder step is counted (docs/reliability.md "Resource pressure &
+    graceful degradation")."""
+    import numpy as np
+
+    import xgboost_tpu as xtb
+    from ..data.extmem import _zstd_available
+    from . import resources as _resources
+    from .checkpoint import CheckpointCallback, latest_checkpoint, scrub_dir
+
+    _resources.reset()  # levels from a previous episode must not leak in
+    degraded0 = {
+        sub: _counter_labeled("xtb_resource_degraded_total", sub)
+        for sub in ("checkpoint", "extmem", "journal")}
+    errors0 = _counter_total("xtb_resource_errors_total")
+    Xs, ys = _extmem_data()
+
+    class _Iter(xtb.DataIter):
+        def __init__(self):
+            super().__init__()
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= len(Xs):
+                return 0
+            input_data(data=Xs[self.i], label=ys[self.i])
+            self.i += 1
+            return 1
+
+        def reset(self):
+            self.i = 0
+
+    d = xtb.ExtMemQuantileDMatrix(_Iter(), max_bin=32, on_host=False,
+                                  compress=_zstd_available())
+    ckpt = os.path.join(workdir, "ckpt")
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        # degradation is LOUD by design; the soak only needs the counters
+        _warnings.simplefilter("ignore", RuntimeWarning)
+        cb = CheckpointCallback(ckpt, interval=2)
+        bst = xtb.train({"objective": "binary:logistic", "max_depth": 3,
+                         "max_bin": 32, "eta": 0.3}, d, 6,
+                        callbacks=[cb], verbose_eval=False)
+    scrub = scrub_dir(ckpt)
+    state = latest_checkpoint(ckpt)
+    preds = np.asarray(bst.predict(d), np.float64)
+    degraded = {
+        sub: _counter_labeled("xtb_resource_degraded_total", sub) - v0
+        for sub, v0 in degraded0.items()}
+    gov = _resources.get_governor()
+    out = {"digest": _digest(bytes(bst.serialize()), preds.tobytes()),
+           "ckpt_valid": len(scrub["valid"]),
+           "ckpt_corrupt": len(scrub["corrupt"]),
+           "ckpt_skipped": len(cb.skipped_rounds),
+           "resumable": state is not None,
+           "degraded": degraded,
+           "errors_classified": _counter_total(
+               "xtb_resource_errors_total") - errors0,
+           "mem_level": gov.level("memory"),
+           "fd_level": gov.level("fd")}
+    _resources.reset()
+    return out
+
+
+def _check_resource(fired, artifacts, baseline) -> Dict[str, str]:
+    inv = {}
+    disk_hits = sum(n for spec, n in fired
+                    if spec.site == "checkpoint.write"
+                    and spec.kind == "disk_full")
+    mem_hits = sum(n for spec, n in fired
+                   if spec.site == "resource.pressure"
+                   and spec.kind == "mem_pressure")
+    fd_hits = sum(n for spec, n in fired
+                  if spec.site == "resource.pressure"
+                  and spec.kind == "fd_exhaust")
+    deg = artifacts["degraded"]
+    # every disk_full at a checkpoint commit is >= 1 ladder step
+    # (pruned_to_1; +1 more when the retry also failed and the snapshot
+    # was skipped), so steps ∈ [hits, 2*hits]
+    inv["checkpoint_ladder_counted"] = (
+        "ok" if disk_hits <= deg["checkpoint"] <= 2 * disk_hits
+        else f"FAIL: {deg['checkpoint']} checkpoint ladder steps for "
+             f"{disk_hits} injected disk_full hits")
+    inv["no_corrupt_snapshots"] = (
+        "ok" if artifacts["ckpt_corrupt"] == 0
+        else f"FAIL: {artifacts['ckpt_corrupt']} corrupt checkpoints — "
+             "a degraded save must commit whole or not at all")
+    want_resumable = artifacts["ckpt_valid"] > 0
+    inv["resume_fallback"] = (
+        "ok" if artifacts["resumable"] == want_resumable
+        else "FAIL: latest_checkpoint disagrees with the scrub walk")
+    if mem_hits or fd_hits:
+        inv["governor_engaged"] = (
+            "ok" if (artifacts["mem_level"] > 0) == bool(mem_hits)
+            and (artifacts["fd_level"] > 0) == bool(fd_hits)
+            else f"FAIL: injected pressure (mem={mem_hits} fd={fd_hits}) "
+                 f"but governor levels are mem={artifacts['mem_level']} "
+                 f"fd={artifacts['fd_level']}")
+        inv["errors_classified"] = (
+            "ok" if fd_hits == 0 or artifacts["errors_classified"] >= fd_hits
+            else "FAIL: injected fd_exhaust was not classified into "
+                 "xtb_resource_errors_total")
+    return inv
+
+
 def _pin_kill_at(spec: dict) -> dict:
     # a {rank, round} kill re-fires when a survivor inherits the rank and
     # redoes the round (docs/reliability.md, the elastic sharp edge):
@@ -672,6 +800,24 @@ SCENARIOS: Dict[str, Scenario] = {
         run=_run_tracker_kill, check=_check_tracker_kill, twin=True,
         cost_hint_s=50.0, deadline_s=300.0, max_faults=3,
         per_plan_caps={("tracker.journal", "kill"): 2}),
+    "resource": Scenario(
+        name="resource",
+        catalog=(
+            # ENOSPC at a checkpoint commit: times=1 heals on the pruned
+            # retry, times=2 skips the snapshot — both must stay bitwise
+            CatalogEntry("checkpoint.write", "disk_full",
+                         {"round": [2, 4, 6], "times": [1, 2]}),
+            CatalogEntry("checkpoint.write", "slow_disk",
+                         {"seconds": (0.001, 0.05), "round": (1, 7)}),
+            CatalogEntry("resource.pressure", "mem_pressure",
+                         {"at": (0, 6)}),
+            CatalogEntry("resource.pressure", "fd_exhaust",
+                         {"at": (0, 6)}),
+            CatalogEntry("extmem.page_load", "slow_disk",
+                         {"seconds": (0.001, 0.02), "at": (0, 6)}),
+        ),
+        run=_run_resource, check=_check_resource, twin=True,
+        cost_hint_s=4.0, deadline_s=120.0),
     "stall": Scenario(
         name="stall",
         catalog=(
@@ -765,6 +911,12 @@ def run_episode(scenario: str, seed: int, *,
     fired = plan.fired()
     fired_specs = plan.fired_by_spec()
     faults.clear()
+    from . import resources as _resources
+
+    # governor levels must not leak across episodes: a mem_pressure from
+    # a resource episode would brown out the NEXT fleet episode's
+    # requests (an un-replayable red)
+    _resources.reset()
     counted_delta = _counter_total("xtb_faults_injected_total") \
         - counted_before
 

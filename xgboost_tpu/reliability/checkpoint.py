@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..callback import TrainingCallback
 from . import faults
+from . import resources as _resources
 
 __all__ = ["CheckpointManager", "CheckpointCallback", "CheckpointState",
            "latest_checkpoint", "scrub_dir", "collect_callback_state",
@@ -184,8 +185,8 @@ class CheckpointManager:
         except BaseException:
             try:
                 os.unlink(tmp)
-            except OSError:
-                pass
+            except OSError as ue:
+                _resources.note_os_error(ue, "checkpoint.cleanup")
             raise
         self._fsync_dir()
         self.prune()
@@ -197,21 +198,27 @@ class CheckpointManager:
     def _fsync_dir(self) -> None:
         try:
             dfd = os.open(self.directory, os.O_RDONLY)
-        except OSError:  # platform without directory fds
+        except OSError as e:  # platform without directory fds
+            _resources.note_os_error(e, "checkpoint.fsync_dir")
             return
         try:
             os.fsync(dfd)
-        except OSError:
-            pass
+        except OSError as e:
+            _resources.note_os_error(e, "checkpoint.fsync_dir")
         finally:
             os.close(dfd)
 
-    def prune(self) -> None:
-        for path in self.files()[: -self.keep_last]:
+    def prune(self, keep: Optional[int] = None) -> None:
+        """Delete checkpoints beyond the newest ``keep`` (default
+        ``keep_last``).  ``keep=1`` is the disk-pressure ladder's
+        aggressive step: free everything but the newest snapshot so the
+        retry after an ENOSPC has room to commit."""
+        keep = self.keep_last if keep is None else max(int(keep), 1)
+        for path in self.files()[: -keep]:
             try:
                 os.unlink(path)
-            except OSError:
-                pass
+            except OSError as e:
+                _resources.note_os_error(e, "checkpoint.prune")
 
     # --------------------------------------------------------------- read
     def files(self) -> List[str]:
@@ -219,7 +226,10 @@ class CheckpointManager:
         out = []
         try:
             names = os.listdir(self.directory)
-        except OSError:
+        except FileNotFoundError:
+            return []
+        except OSError as e:
+            _resources.note_os_error(e, "checkpoint.list")
             return []
         for name in names:
             if name.startswith("ckpt_") and name.endswith(_SUFFIX):
@@ -352,6 +362,10 @@ class CheckpointCallback(TrainingCallback):
         # which data shards — the recovery and absorption source of truth
         self.shard_map: Optional[Dict[str, Any]] = shard_map
         self._container = None  # bound by train() for history + peer state
+        # rounds whose snapshot was skipped on the disk-pressure ladder
+        # (pruned-retry also failed): training continued, this records
+        # the durability gap (tests + resource_smoke assert on it)
+        self.skipped_rounds: list = []
 
     def _bind_container(self, container) -> None:
         self._container = container
@@ -359,6 +373,10 @@ class CheckpointCallback(TrainingCallback):
     def after_iteration(self, model, epoch: int, evals_log) -> bool:
         from .. import collective
 
+        # governor tick: one deterministic poll per round — the
+        # resource.pressure seam fires here, and real headroom on the
+        # checkpoint directory is measured (rate-limited)
+        _resources.get_governor().poll(self.manager.directory)
         if (epoch + 1) % self.interval:
             return False
         if self.only_rank0 and collective.get_rank() != 0:
@@ -376,6 +394,41 @@ class CheckpointCallback(TrainingCallback):
             world=collective.get_world_size(),
             shard_map=self.shard_map,
         )
-        self.manager.save(state)
-        self.last_saved_round = state.round
+        self._save_degradable(state)
         return False
+
+    def _save_degradable(self, state: CheckpointState) -> None:
+        """The disk-pressure ladder around one checkpoint commit
+        (docs/reliability.md "Resource pressure & graceful degradation"):
+
+        1. nominal: atomic save, as ever;
+        2. ENOSPC/EDQUOT: prune to the single newest snapshot (freeing
+           keep-last-K minus one files) and retry ONCE — on a genuinely
+           full disk the prune is what makes room;
+        3. still failing: SKIP this round's snapshot with a loud warning
+           and ``xtb_resource_degraded_total{subsystem="checkpoint"}``,
+           and keep training — a missing checkpoint costs recovery
+           granularity, never the run.  Non-disk OS errors re-raise
+           unchanged (a permission bug is a bug, not pressure).
+        """
+        try:
+            self.manager.save(state)
+        except OSError as e:
+            kind = _resources.note_os_error(e, "checkpoint.write")
+            if kind not in _resources.DISK_ERRNOS:
+                raise
+            self.manager.prune(keep=1)
+            _resources.degraded_event(
+                "checkpoint", "pruned_to_1", round=state.round, errno=kind)
+            try:
+                self.manager.save(state)
+            except OSError as e2:
+                kind2 = _resources.note_os_error(e2, "checkpoint.write")
+                if kind2 not in _resources.DISK_ERRNOS:
+                    raise
+                self.skipped_rounds.append(state.round)
+                _resources.degraded_event(
+                    "checkpoint", "snapshot_skipped", round=state.round,
+                    errno=kind2)
+                return
+        self.last_saved_round = state.round

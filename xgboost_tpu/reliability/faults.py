@@ -29,6 +29,25 @@ Kinds:
   chaos") must *detect* every one: checksum-verify, quarantine or retry,
   never decode garbage.
 
+Resource-class kinds (docs/reliability.md "Resource pressure & graceful
+degradation" — the deterministic stand-ins for a machine running out of
+something; each must land on a *degradation ladder*, never a crash):
+
+- ``disk_full``   — raise ``OSError(ENOSPC)`` at the seam, exactly what
+  a full disk makes the next ``write()``/``fsync()`` do.  Checkpoint
+  saves prune-and-retry then skip with a loud warning; journal appends
+  force a compaction; a model-store publish fails the lifecycle cycle
+  cleanly (incumbent untouched).
+- ``fd_exhaust``  — raise ``OSError(EMFILE)``: the process is out of
+  file descriptors.
+- ``slow_disk``   — sleep ``seconds`` then continue: a degraded device
+  (like ``delay``, but classified as a resource fault so plans read
+  honestly).
+- ``mem_pressure`` — returned to the caller: the resource governor
+  (``reliability/resources.py``) shrinks its enforced memory budget one
+  level (extmem prefetch off, page LRU cache cut).  Fired at the
+  ``resource.pressure`` seam the governor polls.
+
 Plans install programmatically (``install(...)``) or through the
 ``XGBOOST_TPU_FAULT_PLAN`` environment variable — either inline JSON or a
 path to a JSON file — so spawned worker subprocesses inherit the plan with
@@ -86,6 +105,7 @@ SEAMS = frozenset({
     "modelstore.publish",
     "tracker.journal",
     "watchdog.escalate",
+    "resource.pressure",
 })
 
 # Debug guard: with XGBOOST_TPU_STRICT_SEAMS=1, maybe_inject() rejects
@@ -96,7 +116,7 @@ STRICT_ENV = "XGBOOST_TPU_STRICT_SEAMS"
 _STRICT: Optional[bool] = None
 
 _KINDS = ("kill", "exception", "delay", "drop_connection", "truncate",
-          "corrupt")
+          "corrupt", "disk_full", "mem_pressure", "fd_exhaust", "slow_disk")
 
 
 def _strict() -> bool:
@@ -287,9 +307,11 @@ def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
     """Seam entry point.  ``rank`` may be an int or a zero-arg callable
     (resolved only when some spec for this site constrains rank, so seams
     can pass ``collective.get_rank`` without paying for it when unused).
-    Applies ``kill``/``exception``/``delay`` here; returns the spec for
-    caller-applied kinds (``drop_connection``, ``truncate``) and for
-    ``delay`` (so callers can log), else None."""
+    Applies ``kill``/``exception``/``delay``/``slow_disk`` here and
+    raises the matching ``OSError`` for ``disk_full`` (ENOSPC) /
+    ``fd_exhaust`` (EMFILE); returns the spec for caller-applied kinds
+    (``drop_connection``, ``truncate``, ``corrupt``, ``mem_pressure``)
+    and for ``delay``/``slow_disk`` (so callers can log), else None."""
     if _strict() and site not in SEAMS:
         raise ValueError(f"unknown fault seam {site!r} (strict mode); "
                          f"known seams: {sorted(SEAMS)}")
@@ -326,8 +348,18 @@ def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
         os._exit(spec.exit_code)
     if spec.kind == "exception":
         raise FaultInjected(f"{site}: {spec.message}")
-    if spec.kind == "delay":
+    if spec.kind in ("delay", "slow_disk"):
         time.sleep(spec.seconds)
+    elif spec.kind == "disk_full":
+        import errno
+
+        raise OSError(errno.ENOSPC,
+                      f"injected disk full at {site}: {spec.message}")
+    elif spec.kind == "fd_exhaust":
+        import errno
+
+        raise OSError(errno.EMFILE,
+                      f"injected fd exhaustion at {site}: {spec.message}")
     return spec
 
 

@@ -84,9 +84,37 @@ class TrackerJournal:
     def append(self, state: Dict[str, Any]) -> None:
         """Commit one state record: frame, append, flush, fsync.  The
         ``tracker.journal`` fault seam fires first, so a kill-kind spec
-        deterministically dies the tracker process at a journal write and
-        a corrupt-kind spec damages the record to prove the torn-tail
-        walk ignores it."""
+        deterministically dies the tracker process at a journal write, a
+        corrupt-kind spec damages the record to prove the torn-tail walk
+        ignores it, and a ``disk_full`` spec drives the ENOSPC ladder:
+        force a compaction (a single-record rewrite — on a genuinely full
+        disk the shrink IS what frees space) and retry ONCE, then degrade
+        loudly (``xtb_resource_degraded_total{subsystem="journal"}``) and
+        keep running — a missed journal record costs failover coverage
+        for one transition, never the job.  Non-disk OS errors re-raise
+        (the tracker's caller warns on them, as before)."""
+        from . import resources as _resources
+
+        try:
+            self._append_once(state)
+        except OSError as e:
+            kind = _resources.note_os_error(e, "tracker.journal")
+            if kind not in _resources.DISK_ERRNOS:
+                raise
+            # ladder step 1: compact to a single record, then retry
+            self._compact(state)
+            _resources.degraded_event("journal", "forced_compaction",
+                                      errno=kind)
+            try:
+                self._append_once(state)
+            except OSError as e2:
+                kind2 = _resources.note_os_error(e2, "tracker.journal")
+                if kind2 not in _resources.DISK_ERRNOS:
+                    raise
+                _resources.degraded_event("journal", "record_skipped",
+                                          errno=kind2)
+
+    def _append_once(self, state: Dict[str, Any]) -> None:
         from . import faults
 
         payload = json.dumps(state, sort_keys=True).encode()
@@ -112,7 +140,12 @@ class TrackerJournal:
             self._compact(state)
 
     def _compact(self, state: Dict[str, Any]) -> None:
-        """Atomic rewrite with a single record (tmp + fsync + rename)."""
+        """Atomic rewrite with a single record (tmp + fsync + rename).
+        A failed compaction is classified and counted
+        (``xtb_resource_errors_total``), never silently dropped — the
+        journal keeps appending to the uncompacted file."""
+        from . import resources as _resources
+
         payload = json.dumps(state, sort_keys=True).encode()
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
@@ -123,11 +156,12 @@ class TrackerJournal:
                 fh.flush()
                 os.fsync(fh.fileno())
             os.replace(tmp, self.path)
-        except OSError:
+        except OSError as e:
+            _resources.note_os_error(e, "journal.compact")
             try:
                 os.unlink(tmp)
-            except OSError:
-                pass
+            except OSError as ue:
+                _resources.note_os_error(ue, "journal.compact")
         self._records_since_open = 0
 
     # --------------------------------------------------------------- read
@@ -145,7 +179,12 @@ class TrackerJournal:
         try:
             with open(self.path, "rb") as fh:
                 blob = fh.read()
-        except OSError:
+        except FileNotFoundError:
+            return None  # no journal yet: a fresh tracker, not an error
+        except OSError as e:
+            from . import resources as _resources
+
+            _resources.note_os_error(e, "journal.load")
             return None
         if not blob.startswith(MAGIC):
             return None
@@ -175,8 +214,10 @@ class TrackerJournal:
                     fh.truncate(valid_end)
                     fh.flush()
                     os.fsync(fh.fileno())
-            except OSError:
-                pass  # read-only media: appends were impossible anyway
+            except OSError as e:  # read-only media: appends were
+                from . import resources as _resources  # impossible anyway
+
+                _resources.note_os_error(e, "journal.repair")
         if last is not None and count_recovery:
             _ins()[1].inc()
         return last

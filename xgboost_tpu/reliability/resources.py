@@ -1,0 +1,389 @@
+"""Resource governor: bounded disk/memory/fd headroom becomes *deliberate
+degradation*, never an unplanned death.
+
+Every reliability layer before this one assumed resources are infinite: a
+full disk crashed a checkpoint mid-train, memory pressure OOM-killed an
+external-memory run instead of shrinking its page cache, and the fleet's
+only overload defense was a fixed queue bound.  The out-of-core designs
+this repo reproduces (Chen & Guestrin KDD'16 §4; Out-of-Core GPU Gradient
+Boosting, arXiv:2005.09148) exist precisely because resources are bounded
+— so when the system hits a bound it must step down a *ladder*, one
+deterministic, observable transition at a time (docs/reliability.md
+"Resource pressure & graceful degradation"):
+
+- **Levels.**  The governor tracks one small integer level (0 = nominal,
+  up to :data:`MAX_LEVEL`) per resource — ``memory`` / ``disk`` / ``fd``
+  / ``overload`` — published as the ``xtb_resource_level`` gauge.
+  :func:`ResourceGovernor.degrade` raises a level (flight-ring event +
+  stderr line), :func:`ResourceGovernor.restore` lowers it.
+- **Ladders.**  Subsystems *consult* levels instead of reacting to OOM:
+  external memory drops prefetch to 0 and shrinks its page LRU budget by
+  :meth:`ResourceGovernor.memory_scale` (level 2+ = recompute from the
+  backing store every touch); the fleet dispatcher's brownout cutoff
+  (:meth:`ResourceGovernor.brownout_cutoff`) sheds low-SLO tenants first.
+  Checkpoint/journal/modelstore react at their own write failures and
+  report each ladder step through :func:`degraded_event`
+  (``xtb_resource_degraded_total{subsystem}``).
+- **Classification.**  :func:`note_os_error` is the one funnel for OS
+  errors previously swallowed silently: every classified errno counts
+  into ``xtb_resource_errors_total{errno,site}``, and resource-class
+  errnos (ENOSPC/EDQUOT → disk, EMFILE/ENFILE → fd, ENOMEM → memory)
+  additionally degrade the matching level.  The xtblint XTB801 rule
+  statically forbids ``except OSError`` handlers in reliability/serving/
+  data modules that neither re-raise, route through here, nor count.
+- **Headroom polling.**  :meth:`ResourceGovernor.poll` measures real
+  headroom (``os.statvfs`` free bytes on watched directories, free fd
+  slots vs ``RLIMIT_NOFILE``) with hysteresis, publishing
+  ``xtb_resource_headroom``; and it fires the ``resource.pressure``
+  fault seam first, so chaos plans drive every ladder transition
+  deterministically (``mem_pressure`` degrades memory; ``disk_full`` /
+  ``fd_exhaust`` raise the matching OSError into the classifier) —
+  no real exhaustion needed to reach any step.
+
+Determinism contract: given the same fault plan (pressure schedule),
+every ladder transition happens at the same program point, and degraded
+training produces bitwise-identical model bytes to an undegraded twin —
+degradation changes *how hard the machine works*, never the math
+(pinned by tests/test_resources.py and the ``resource`` chaos scenario).
+"""
+from __future__ import annotations
+
+import errno as _errno
+import os
+import threading
+import time
+import warnings
+from typing import Any, Dict, Optional
+
+__all__ = ["ResourceGovernor", "get_governor", "note_os_error",
+           "degraded_event", "is_resource_errno", "reset", "RESOURCES",
+           "MAX_LEVEL"]
+
+RESOURCES = ("memory", "disk", "fd", "overload")
+MAX_LEVEL = 3
+
+# errno -> governed resource.  Everything else is classified (counted by
+# name) but degrades nothing.
+_ERRNO_RESOURCE = {
+    _errno.ENOSPC: "disk",
+    _errno.EDQUOT: "disk",
+    _errno.EMFILE: "fd",
+    _errno.ENFILE: "fd",
+    _errno.ENOMEM: "memory",
+}
+# the disk-class set subsystem ladders key off ("is this worth a prune/
+# compact retry, or a real bug to re-raise")
+DISK_ERRNOS = ("ENOSPC", "EDQUOT")
+
+# real-headroom thresholds (env-overridable); hysteresis restores at 2x
+_ENV_DISK_MIN_MB = "XGBOOST_TPU_DISK_MIN_MB"        # default 64 MB free
+_ENV_FD_MIN = "XGBOOST_TPU_FD_MIN"                  # default 64 free slots
+_ENV_POLL_S = "XGBOOST_TPU_RESOURCE_POLL_S"         # default 1.0 s
+
+_instruments = None
+
+
+def _ins():
+    """(degraded_total, errors_total, level gauge, headroom gauge)."""
+    global _instruments
+    if _instruments is None:
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        _instruments = (
+            reg.counter("xtb_resource_degraded_total",
+                        "graceful-degradation ladder steps taken, by "
+                        "subsystem (checkpoint/journal/modelstore/extmem/"
+                        "fleet)", ("subsystem",)),
+            reg.counter("xtb_resource_errors_total",
+                        "OS errors classified at a resource boundary, by "
+                        "errno name and site (silent swallows surfaced — "
+                        "xtblint XTB801)", ("errno", "site")),
+            reg.gauge("xtb_resource_level",
+                      "governor degradation level per resource (0 = "
+                      "nominal)", ("resource",)),
+            reg.gauge("xtb_resource_headroom",
+                      "measured headroom per resource (disk: free bytes "
+                      "on the tightest watched path; fd: free descriptor "
+                      "slots)", ("resource",)),
+        )
+    return _instruments
+
+
+def degraded_event(subsystem: str, action: str, **detail: Any) -> None:
+    """One ladder step taken by ``subsystem``: counter + flight-recorder
+    event + a LOUD warning.  Every graceful-degradation transition in the
+    repo routes through here, so "did the system degrade, where, and why"
+    is one counter family and one flight-ring query."""
+    _ins()[0].labels(subsystem).inc()
+    from ..telemetry import flight
+
+    flight.record("event", "resource.degraded", subsystem=subsystem,
+                  action=action, **detail)
+    warnings.warn(
+        f"[resource] {subsystem} degraded: {action} {detail or ''} — "
+        f"continuing (see docs/reliability.md 'Resource pressure & "
+        f"graceful degradation')", RuntimeWarning, stacklevel=2)
+
+
+def is_resource_errno(exc: BaseException) -> bool:
+    """True when the exception's errno is exhaustion-class (disk/fd/
+    memory) — the branch point between "pressure: degrade and continue"
+    and "bug: re-raise" that every ladder uses (a permission error is a
+    bug, not pressure)."""
+    return getattr(exc, "errno", None) in _ERRNO_RESOURCE
+
+
+def note_os_error(exc: BaseException, site: str) -> str:
+    """Classify one caught OSError: count it into
+    ``xtb_resource_errors_total{errno,site}`` and degrade the matching
+    governor level for resource-class errnos.  Returns the errno name
+    (``"ENOSPC"``, ``"EMFILE"``, ...; ``"EUNKNOWN"`` when the exception
+    carries none) so callers can branch on the class — the one funnel
+    replacing silent ``except OSError: pass`` swallows (xtblint XTB801).
+    """
+    num = getattr(exc, "errno", None)
+    name = (_errno.errorcode.get(num, f"E{num}") if num is not None
+            else "EUNKNOWN")
+    _ins()[1].labels(name, site).inc()
+    resource = _ERRNO_RESOURCE.get(num)
+    if resource is not None:
+        get_governor().degrade(resource, f"{name} at {site}")
+    return name
+
+
+class ResourceGovernor:
+    """Process-wide resource levels + headroom polling (one singleton via
+    :func:`get_governor`; construct directly only in tests)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._levels: Dict[str, int] = {r: 0 for r in RESOURCES}
+        self._polls = 0
+        self._last_headroom = 0.0   # monotonic; gates real statvfs work
+        self._below: Dict[str, bool] = {"disk": False, "fd": False}
+        self.watch_paths: set = set()
+
+    # ------------------------------------------------------------- levels
+    def level(self, resource: str) -> int:
+        with self._lock:
+            return self._levels[resource]
+
+    def max_level(self) -> int:
+        with self._lock:
+            return max(self._levels.values())
+
+    def degraded(self) -> bool:
+        return self.max_level() > 0
+
+    def degrade(self, resource: str, reason: str) -> int:
+        """Raise ``resource``'s level by one (capped at :data:`MAX_LEVEL`).
+        Returns the new level.  Idempotent at the cap; every actual
+        transition is an observable event."""
+        with self._lock:
+            old = self._levels[resource]
+            new = min(old + 1, MAX_LEVEL)
+            self._levels[resource] = new
+        if new != old:
+            _ins()[2].labels(resource).set(new)
+            from ..telemetry import flight
+
+            flight.record("event", "resource.level", resource=resource,
+                          level=new, reason=reason)
+            import sys
+
+            print(f"[resource] {resource} pressure level {old} -> {new} "
+                  f"({reason})", file=sys.stderr, flush=True)
+        return new
+
+    def restore(self, resource: str) -> int:
+        """Lower ``resource``'s level by one (floor 0); the recovery half
+        of the ladder, driven by headroom hysteresis or the caller."""
+        with self._lock:
+            old = self._levels[resource]
+            new = max(old - 1, 0)
+            self._levels[resource] = new
+        if new != old:
+            _ins()[2].labels(resource).set(new)
+            from ..telemetry import flight
+
+            flight.record("event", "resource.level", resource=resource,
+                          level=new, reason="restored")
+        return new
+
+    # --------------------------------------------------- subsystem ladders
+    def memory_scale(self) -> float:
+        """Multiplier for memory budgets (the extmem page LRU cache):
+        level 0 → 1.0, level 1 → 0.25, level 2+ → 0.0 (cache disabled —
+        every page touch recomputes from its backing store)."""
+        lvl = self.level("memory")
+        if lvl <= 0:
+            return 1.0
+        return 0.25 if lvl == 1 else 0.0
+
+    def prefetch_allowed(self) -> bool:
+        """False under memory or fd pressure: the extmem prefetch window
+        drops to 0 (no decoded pages in flight beyond the consumer, no
+        extra spill files open)."""
+        return self.level("memory") < 1 and self.level("fd") < 1
+
+    def brownout_cutoff(self) -> Optional[int]:
+        """SLO-priority admission cutoff for the fleet dispatcher, from
+        the WORST resource level: None at level 0 (no brownout); at level
+        L, requests with ``priority < L - 1`` are shed at admission —
+        level 1 sheds below-default tenants (priority < 0, including
+        shadow twins), level 2 sheds the default class too, level 3 only
+        admits priority >= 2."""
+        lvl = self.max_level()
+        if lvl <= 0:
+            return None
+        return lvl - 1
+
+    # ------------------------------------------------------------- polling
+    def poll(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """One governor tick: fire the ``resource.pressure`` fault seam
+        (the deterministic chaos hook — ``mem_pressure`` degrades memory;
+        ``disk_full``/``fd_exhaust`` raise the matching OSError into the
+        classifier), then measure real headroom (rate-limited).  Cheap to
+        call from hot-ish paths: with no plan installed and within the
+        poll interval it is one module read + one clock read."""
+        from . import faults
+
+        with self._lock:
+            self._polls += 1
+            n = self._polls
+        spec = None
+        try:
+            spec = faults.maybe_inject("resource.pressure", round=n - 1)
+        except OSError as e:
+            # injected disk_full / fd_exhaust: classified exactly like a
+            # real one caught at a write seam
+            note_os_error(e, "resource.poll")
+        if spec is not None and spec.kind == "mem_pressure":
+            self.degrade("memory", "injected mem_pressure")
+        if path is not None:
+            self.watch_paths.add(os.fspath(path))
+        now = time.monotonic()
+        with self._lock:
+            due = now - self._last_headroom >= self._poll_interval()
+            if due:
+                self._last_headroom = now
+        out: Dict[str, Any] = {"polls": n}
+        if due:
+            out.update(self._measure_headroom())
+        return out
+
+    @staticmethod
+    def _poll_interval() -> float:
+        try:
+            return max(0.0, float(os.environ.get(_ENV_POLL_S, "1.0")))
+        except ValueError:
+            return 1.0
+
+    def _measure_headroom(self) -> Dict[str, Any]:
+        """Real disk/fd headroom with hysteresis: degrade on the
+        transition below the floor, restore on the transition back above
+        2x the floor — repeated polls at a steady level are no-ops."""
+        out: Dict[str, Any] = {}
+        try:
+            disk_min = float(os.environ.get(_ENV_DISK_MIN_MB, "64")) * 2**20
+        except ValueError:
+            disk_min = 64 * 2**20
+        free = None
+        for p in list(self.watch_paths) or ["."]:
+            try:
+                st = os.statvfs(p)
+            except OSError as e:
+                note_os_error(e, "resource.statvfs")
+                continue
+            avail = st.f_bavail * st.f_frsize
+            free = avail if free is None else min(free, avail)
+        if free is not None:
+            out["disk_free_bytes"] = int(free)
+            _ins()[3].labels("disk").set(float(free))
+            self._hysteresis("disk", free, disk_min)
+        try:
+            fd_min = int(os.environ.get(_ENV_FD_MIN, "64"))
+        except ValueError:
+            fd_min = 64
+        fd_free = self._fd_free()
+        if fd_free is not None:
+            out["fd_free"] = fd_free
+            _ins()[3].labels("fd").set(float(fd_free))
+            self._hysteresis("fd", fd_free, fd_min)
+        return out
+
+    def _hysteresis(self, resource: str, free: float, floor: float) -> None:
+        """Degrade on the transition below ``floor``; restore while
+        headroom sits at/above 2x the floor.  The latch (``_below``)
+        clears ONLY at the restore point — a gradual recovery through
+        the [floor, 2*floor) gray zone must not forget the dip, and a
+        level raised by a *classified errno* (``note_os_error``) with no
+        latch set is still walked back one step per measurement once
+        real headroom says the resource is healthy again (the errno
+        path has no other restore edge — without this, one transient
+        ENOSPC/EMFILE would brown out low-SLO tenants for the process
+        lifetime)."""
+        below = free < floor
+        healthy = free >= 2 * floor
+        with self._lock:
+            was = self._below[resource]
+            if below:
+                self._below[resource] = True
+            elif healthy:
+                self._below[resource] = False
+            # in the gray zone the latch keeps its previous state
+        if below and not was:
+            self.degrade(resource, f"headroom {free:.0f} < floor "
+                                   f"{floor:.0f}")
+        elif healthy and self.level(resource) > 0:
+            self.restore(resource)
+
+    @staticmethod
+    def _fd_free() -> Optional[int]:
+        try:
+            import resource as _rlimit
+
+            soft, _hard = _rlimit.getrlimit(_rlimit.RLIMIT_NOFILE)
+            used = len(os.listdir("/proc/self/fd"))
+            return max(int(soft) - used, 0)
+        except FileNotFoundError:
+            return None  # no /proc: unmetered platform, not an error
+        except OSError as e:
+            note_os_error(e, "resource.fd_probe")
+            return None
+        except (ImportError, ValueError):
+            return None  # platform without rlimits: unmetered
+
+    # --------------------------------------------------------------- tests
+    def reset(self) -> None:
+        with self._lock:
+            changed = [r for r, v in self._levels.items() if v]
+            for r in RESOURCES:
+                self._levels[r] = 0
+            self._polls = 0
+            self._last_headroom = 0.0
+            self._below = {"disk": False, "fd": False}
+            self.watch_paths.clear()
+        for r in changed:
+            _ins()[2].labels(r).set(0.0)
+
+
+_GOVERNOR: Optional[ResourceGovernor] = None
+_GOVERNOR_LOCK = threading.Lock()
+
+
+def get_governor() -> ResourceGovernor:
+    global _GOVERNOR
+    if _GOVERNOR is None:
+        with _GOVERNOR_LOCK:
+            if _GOVERNOR is None:
+                _GOVERNOR = ResourceGovernor()
+    return _GOVERNOR
+
+
+def reset() -> None:
+    """Reset the singleton's levels/polls (test + chaos-episode isolation;
+    the instance itself is kept so cached references stay valid)."""
+    if _GOVERNOR is not None:
+        _GOVERNOR.reset()

@@ -274,8 +274,10 @@ def _escalate(op: _Operation, stage: str) -> None:
         op.stack_path = flight.dump_stacks()
         try:
             flight.dump()
-        except OSError:
-            pass
+        except OSError as e:
+            from . import resources
+
+            resources.note_os_error(e, "watchdog.dump")
     elif stage == "stall":
         from . import faults
 

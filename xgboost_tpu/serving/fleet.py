@@ -58,6 +58,7 @@ duplicated result ever reaching a caller.
 from __future__ import annotations
 
 import dataclasses
+import errno
 import heapq
 import itertools
 import json
@@ -77,6 +78,7 @@ import numpy as np
 
 from ..launcher import WorkerFailedError, spawn_worker, stderr_tail
 from ..reliability import faults as _faults
+from ..reliability import resources as _resources
 from ..telemetry import distributed as _distributed
 from ..telemetry import flight as _flight
 from ..telemetry import trace as _trace
@@ -207,12 +209,86 @@ class _Instruments:
             "two-sample KS statistic between candidate and incumbent "
             "prediction distributions per shadow-scored request",
             ("model",), buckets=_KS_BUCKETS)
+        self.brownout = reg.counter(
+            "xtb_fleet_brownout_total",
+            "requests shed at admission by the resource-pressure "
+            "brownout (low-SLO tenants first)", ("slo",))
+        self.admission_window = reg.gauge(
+            "xtb_fleet_admission_window",
+            "current AIMD admission window (queued requests admitted "
+            "before shedding; collapses under overload, recovers on "
+            "completions)")
 
     @classmethod
     def get(cls) -> "_Instruments":
         if cls._singleton is None:
             cls._singleton = cls()
         return cls._singleton
+
+
+class AdaptiveAdmission:
+    """AIMD admission control over the dispatch queue (pure state machine;
+    the fleet wires its transitions to the resource governor, tests drive
+    it directly).
+
+    The fixed ``max_queue`` bound is the right *ceiling*, but under
+    overload it is the wrong *operating point*: a queue allowed to sit at
+    the ceiling serves every request at worst-case latency before finally
+    shedding.  TCP's answer applies directly — multiplicative decrease on
+    every pressure event (a shed, an in-queue deadline expiry, a replica
+    death), additive increase (+1) per completed request, clamped to
+    ``[floor, max_queue]``.  A saturated fleet converges to a small
+    admission window (shedding early, keeping queue wait bounded); a
+    recovered fleet climbs back to the ceiling in ~max_queue completions.
+
+    ``on_pressure()`` returns True on the transition onto the floor —
+    the fleet's cue to declare overload to the resource governor (which
+    starts the SLO brownout); ``on_ok()`` returns True on the recovery
+    transition (window back above half the ceiling) — the cue to restore
+    it.  Both edges fire once per excursion, so governor levels move on
+    state *transitions*, never per request.
+    """
+
+    def __init__(self, max_queue: int, floor: Optional[int] = None) -> None:
+        self.max_queue = max(int(max_queue), 1)
+        self.floor = max(1, min(int(floor) if floor is not None else 8,
+                                self.max_queue))
+        # governor coupling needs room between the edges: the floor edge
+        # (declare overload) and the recovery edge (ceiling/2) must be at
+        # least a doubling apart, or a single completion right after a
+        # shed would flap the overload level per request.  Queues under
+        # 4x the floor (tests, toy configs) keep the AIMD window but
+        # never couple to the governor.
+        self.coupled = self.max_queue >= 4 * self.floor
+        self._window = float(self.max_queue)
+        self._lock = threading.Lock()
+        self._floored = False
+
+    def limit(self) -> int:
+        return int(self._window)
+
+    def on_pressure(self) -> bool:
+        """Multiplicative decrease; True on the onto-the-floor edge
+        (coupled queues only — see ``__init__``)."""
+        with self._lock:
+            self._window = max(float(self.floor), self._window / 2.0)
+            hit = self._window <= self.floor and self.coupled
+            edge = hit and not self._floored
+            if hit:
+                self._floored = True
+        return edge
+
+    def on_ok(self) -> bool:
+        """Additive increase; True on the recovered edge (window back
+        above half the ceiling — >= 2x the floor on any coupled queue —
+        after having been floored)."""
+        with self._lock:
+            self._window = min(float(self.max_queue), self._window + 1.0)
+            recovered = (self._floored
+                         and self._window >= self.max_queue / 2.0)
+            if recovered:
+                self._floored = False
+        return recovered
 
 
 class _Request:
@@ -261,11 +337,16 @@ class DispatchQueue:
     def __len__(self) -> int:
         return self._live
 
-    def push(self, req: _Request) -> Optional[_Request]:
+    def push(self, req: _Request,
+             limit: Optional[int] = None) -> Optional[_Request]:
         """Admit ``req``; returns the request shed to make room (which may
-        be ``req`` itself), or None when nothing was shed."""
+        be ``req`` itself), or None when nothing was shed.  ``limit``
+        (the AIMD admission window) tightens the bound below
+        ``max_queue`` for this push — the ceiling still always applies."""
         victim = None
-        if self._live >= self.max_queue:
+        cap = self.max_queue if limit is None else max(
+            1, min(int(limit), self.max_queue))
+        if self._live >= cap:
             # victim = newest request of the lowest-priority class (heap
             # entries carry (-priority, seq): max picks exactly that).
             # Removed PHYSICALLY, not just by state: under a sustained
@@ -364,6 +445,19 @@ _ERR_TYPES = {"ValueError": ValueError, "KeyError": KeyError,
               "TimeoutError": TimeoutError, "TypeError": TypeError}
 
 
+_EBADF_ONLY = (errno.EBADF,)
+_SHUTDOWN_BENIGN = (errno.EBADF, errno.EPIPE, errno.ECONNRESET)
+
+
+def _note_os(e: OSError, site: str, benign=()) -> None:
+    """Classify an OS error unless its errno is expected on this path
+    (EBADF from closing an already-closed socket at shutdown, EPIPE to a
+    dead replica): xtb_resource_errors_total exists to surface the errno
+    that MATTERS, and steady shutdown noise would bury it."""
+    if getattr(e, "errno", None) not in benign:
+        _resources.note_os_error(e, site)
+
+
 class ServingFleet:
     """Spawn, route, survive.  ``models`` maps name -> Booster or model
     path (published into the store at start); alternatively pass a
@@ -390,6 +484,8 @@ class ServingFleet:
         self._ins = _Instruments.get()
         self._cv = threading.Condition()
         self._queue = DispatchQueue(config.max_queue)
+        self._admit = AdaptiveAdmission(config.max_queue)
+        self._ins.admission_window.set(self._admit.limit())
         self._replicas: Dict[str, _Replica] = {}
         self._failures: List[Tuple[str, int, str]] = []
         self._err_files: Dict[str, str] = {}
@@ -549,8 +645,12 @@ class ServingFleet:
         while True:
             try:
                 sock, _ = self._listener.accept()
-            except OSError:
-                return  # listener closed: fleet shutting down
+            except OSError as e:
+                # listener closed = shutdown (EBADF, not worth counting);
+                # anything else (EMFILE under fd exhaustion) is
+                # classified before we stop accepting
+                _note_os(e, "fleet.accept", benign=_EBADF_ONLY)
+                return
             wire.configure(sock)
             try:
                 sock.settimeout(self.config.ready_timeout_s)
@@ -558,7 +658,13 @@ class ServingFleet:
                 ready, _ = wire.recv_frame(sock)
                 sock.settimeout(None)
                 label = hello.get("label", "?")
-            except (wire.WireError, OSError):
+            except (wire.WireError, TimeoutError):
+                # malformed or slow hello (socket.timeout is
+                # TimeoutError): not a resource event
+                sock.close()
+                continue
+            except OSError as e:
+                _note_os(e, "fleet.handshake")
                 sock.close()
                 continue
             rx = threading.Thread(target=self._rx_loop, args=(label, sock),
@@ -717,6 +823,7 @@ class ServingFleet:
             # cancelled) request's latency would skew the histogram
             lat = time.monotonic() - req.t_submit
             self._ins.latency.labels(req.model).observe(lat)
+            self._admit_ok()
             # per-version latency: explicit version from the header, else
             # the fleet's view of the model's active version — the
             # lifecycle comparator reads candidate vs incumbent from here
@@ -739,10 +846,32 @@ class ServingFleet:
         if req.future.set_running_or_notify_cancel():
             req.future.set_exception(exc)
 
+    # --------------------------------------------------- adaptive admission
+    def _admit_pressure(self) -> None:
+        """One overload signal (shed / expiry / replica death): AIMD
+        multiplicative decrease; on the onto-the-floor edge, declare
+        overload to the resource governor — the SLO brownout starts."""
+        edge = self._admit.on_pressure()
+        self._ins.admission_window.set(self._admit.limit())
+        if edge:
+            _resources.get_governor().degrade(
+                "overload", "fleet admission window at floor")
+            _resources.degraded_event(
+                "fleet", "admission_floor", window=self._admit.limit())
+
+    def _admit_ok(self) -> None:
+        """One completed request: additive increase; on the recovered
+        edge, lift the governor's overload level again."""
+        recovered = self._admit.on_ok()
+        self._ins.admission_window.set(self._admit.limit())
+        if recovered:
+            _resources.get_governor().restore("overload")
+
     def _expire(self, expired: List[_Request]) -> None:
         """Fail requests whose class deadline passed while queued."""
         for r in expired:
             self._ins.deadline.labels(r.slo.name).inc()
+            self._admit_pressure()
             self._fail(r, TimeoutError(
                 f"request {r.id} ({r.model}) expired in queue after "
                 f"{r.slo.deadline_s}s (slo={r.slo.name})"))
@@ -785,9 +914,14 @@ class ServingFleet:
             self._cv.notify_all()
         try:
             rep.sock and rep.sock.close()
-        except OSError:
-            pass
+        except OSError as e:
+            _note_os(e, "fleet.sock_close", benign=_EBADF_ONLY)
         rc = rep.proc.poll()
+        if not closed:
+            # a real death is an overload signal too: the survivors
+            # briefly have less capacity (a clean shutdown's EOFs are us
+            # closing sockets, not pressure)
+            self._admit_pressure()
         tail = stderr_tail(self._err_files.get(label, ""))
         if rep.quarantined:
             tail = f"[quarantined: {rep.quarantined}]\n{tail}"
@@ -850,8 +984,9 @@ class ServingFleet:
                            "snapshot": snap, "dumped_by": "dispatcher"},
                           fh)
             os.replace(tmp, path)
-        except OSError:  # pragma: no cover - fs trouble must not block
-            return None  # the death path
+        except OSError as e:  # pragma: no cover - fs trouble must not
+            _resources.note_os_error(e, "fleet.flight_dump")
+            return None       # block the death path
         with self._cv:
             self.flight_dumps[label] = path
         return path
@@ -877,6 +1012,11 @@ class ServingFleet:
                 self._cv.wait(timeout=0.2)
                 if self._closed:
                     return
+            # governor tick: the fleet process's ONLY poll site — it is
+            # what walks an errno-raised disk/fd level back down once
+            # real headroom recovers (internally rate-limited), ending a
+            # brownout instead of latching it for the process lifetime
+            _resources.get_governor().poll(self._store_dir)
             self._pump()
 
     def _pump(self) -> None:
@@ -928,8 +1068,9 @@ class ServingFleet:
             # which requeues the request onto a surviving replica
             try:
                 rep.sock.shutdown(2)
-            except OSError:
-                pass
+            except OSError as e:
+                # severing an already-dead socket is the point here
+                _note_os(e, "fleet.sock_close", benign=_SHUTDOWN_BENIGN)
             return
         try:
             wire.send_frame(rep.sock, req.header, req.payload)
@@ -955,13 +1096,30 @@ class ServingFleet:
         or pre-encoded IPC bytes, forwarded untouched)."""
         if (X is None) == (arrow is None):
             raise ValueError("pass exactly one of X= or arrow=")
+        slo = self.config.resolve_slo(tenant)
+        # resource-pressure brownout BEFORE any other work — including
+        # the payload encode, which is exactly the CPU/memory cost a
+        # degraded host cannot spare: under pressure (overload / memory /
+        # disk / fd), low-SLO tenants are refused on the tenant name
+        # alone — deterministic cutoff per governor level
+        # (docs/reliability.md "Resource pressure & graceful
+        # degradation"); higher classes keep their full service
+        cutoff = _resources.get_governor().brownout_cutoff()
+        if cutoff is not None and slo.priority < cutoff:
+            self._ins.brownout.labels(slo.name).inc()
+            fut: Future = Future()
+            fut.set_exception(QueueFullError(
+                f"browned out: resource pressure level "
+                f"{_resources.get_governor().max_level()} sheds "
+                f"slo={slo.name} (priority {slo.priority} < cutoff "
+                f"{cutoff})"))
+            return fut
         if X is not None:
             fields, payload = wire.encode_raw(np.asarray(X))
         elif isinstance(arrow, (bytes, bytearray, memoryview)):
             fields, payload = {"enc": wire.ARROW}, memoryview(arrow)
         else:
             fields, payload = wire.encode_arrow(arrow)
-        slo = self.config.resolve_slo(tenant)
         # everything but the queue push happens outside the cv (the lock is
         # the fleet's one contended resource; hot-path critical sections
         # stay tiny and notify-free)
@@ -991,7 +1149,12 @@ class ServingFleet:
                 # deterministic 1-in-N selection (a counter, not a PRNG:
                 # replayable, and exactly the configured fraction)
                 sh["n"] += 1
-                if sh["n"] % sh["every"] == 0:
+                if sh["n"] % sh["every"] == 0 and cutoff is not None:
+                    # any brownout level sheds the twin (priority -2^31
+                    # < every cutoff): the discretionary duplicate load
+                    # is the FIRST thing a degraded host stops paying
+                    self._ins.brownout.labels(_SHADOW_SLO.name).inc()
+                elif sh["n"] % sh["every"] == 0:
                     shadow_header = dict(header)
                     shadow_header["id"] = next(self._next_id)
                     shadow_header["version"] = sh["version"]
@@ -1000,18 +1163,21 @@ class ServingFleet:
                     shadow_req = _Request(shadow_header["id"], model,
                                           shadow_header, payload,
                                           _SHADOW_SLO)
-            victims = [self._queue.push(req)]
+            limit = self._admit.limit()
+            victims = [self._queue.push(req, limit=limit)]
             if shadow_req is not None:
-                victims.append(self._queue.push(shadow_req))
+                victims.append(self._queue.push(shadow_req, limit=limit))
         if shadow_req is not None:
             self._attach_shadow(model, req, shadow_req)
         for victim in victims:
             if victim is None:
                 continue
             self._ins.shed.labels(victim.slo.name).inc()
+            self._admit_pressure()
             self._fail(victim, QueueFullError(
-                f"fleet queue full ({self.config.max_queue} requests); "
-                f"shed slo={victim.slo.name} request {victim.id}"))
+                f"fleet queue full (admission window {limit} of "
+                f"{self.config.max_queue}); shed slo={victim.slo.name} "
+                f"request {victim.id}"))
         self._pump()  # a free replica takes this request on OUR thread
         return req.future
 
@@ -1317,8 +1483,9 @@ class ServingFleet:
             if rep.sock is not None:
                 try:
                     wire.send_frame(rep.sock, {"op": "close"})
-                except OSError:
-                    pass
+                except OSError as e:
+                    _note_os(e, "fleet.shutdown",
+                             benign=_SHUTDOWN_BENIGN)
         deadline = time.monotonic() + 10
         for rep in reps:
             while rep.proc.poll() is None and time.monotonic() < deadline:
@@ -1328,21 +1495,23 @@ class ServingFleet:
         if self._listener is not None:
             try:
                 self._listener.close()
-            except OSError:
-                pass
+            except OSError as e:
+                _note_os(e, "fleet.shutdown", benign=_EBADF_ONLY)
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
         for rep in reps:
             if rep.sock is not None:
                 try:
                     rep.sock.close()
-                except OSError:
-                    pass
+                except OSError as e:
+                    _note_os(e, "fleet.sock_close", benign=_EBADF_ONLY)
         for path in self._err_files.values():
             try:
                 os.unlink(path)
-            except OSError:
+            except FileNotFoundError:
                 pass
+            except OSError as e:
+                _resources.note_os_error(e, "fleet.shutdown")
         if self._tmp_store and self._store_dir:
             import shutil
 

@@ -187,8 +187,18 @@ class ModelStore:
         try:
             with open(self._manifest_path()) as fh:
                 return json.load(fh)
-        except (OSError, ValueError):
+        except (FileNotFoundError, ValueError):
+            # no manifest yet (fresh store) / torn JSON can only mean a
+            # pre-atomic-rewrite store: start empty, as ever
             return {"version": _FORMAT_VERSION, "models": {}}
+        except OSError as e:
+            # a manifest that EXISTS but cannot be read (EMFILE, EIO) must
+            # not masquerade as an empty store — a publish against the
+            # default would re-allocate version 1 over live files
+            from ..reliability import resources as _resources
+
+            _resources.note_os_error(e, "modelstore.manifest")
+            raise
 
     def names(self) -> List[str]:
         return sorted(self.manifest()["models"])
@@ -248,11 +258,20 @@ class ModelStore:
 
     def _write_manifest(self, manifest: dict) -> None:
         fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".manifest.tmp")
-        with os.fdopen(fd, "w") as fh:
-            json.dump(manifest, fh, indent=1)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, self._manifest_path())
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump(manifest, fh, indent=1)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self._manifest_path())
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError as e:
+                from ..reliability import resources as _resources
+
+                _resources.note_os_error(e, "modelstore.cleanup")
+            raise
 
     # -------------------------------------------------------------- publish
     def publish(self, name: str, source, version: Optional[int] = None,
@@ -269,10 +288,44 @@ class ModelStore:
         booster = _load_booster(source)
         snap = InferenceSnapshot.from_booster(booster)
         with self._manifest_lock():
-            return self._publish_locked(name, booster, snap, version)
+            try:
+                return self._publish_locked(name, booster, snap, version)
+            except OSError as e:
+                # resource failure mid-publish (ENOSPC while writing the
+                # arena, EMFILE opening the meta): the tmp files are gone
+                # (finally below), the manifest never moved, and the
+                # incumbent keeps serving — the lifecycle cycle fails
+                # CLEANLY with reason "resource", never a torn arena
+                from ..reliability import resources as _resources
+
+                _resources.note_os_error(e, "modelstore.publish")
+                _resources.degraded_event(
+                    "modelstore", "publish_aborted", model=name,
+                    errno=getattr(e, "errno", None))
+                raise
 
     def _publish_locked(self, name: str, booster, snap,
                         version: Optional[int]) -> int:
+        """Tmp-file hygiene wrapper: whatever _publish_files leaves behind
+        on failure (an arena written but never renamed, a meta mkstemp
+        that hit EMFILE) is unlinked, so an aborted publish leaves the
+        store directory exactly as it found it."""
+        tmps: List[str] = []
+        try:
+            return self._publish_files(name, booster, snap, version, tmps)
+        finally:
+            for t in tmps:
+                try:
+                    os.unlink(t)
+                except FileNotFoundError:
+                    pass  # committed (renamed away) — the success path
+                except OSError as e:
+                    from ..reliability import resources as _resources
+
+                    _resources.note_os_error(e, "modelstore.cleanup")
+
+    def _publish_files(self, name: str, booster, snap,
+                       version: Optional[int], tmps: List[str]) -> int:
         if version is None:
             version = (self.latest_version(name) or 0) + 1
         version = int(version)
@@ -288,6 +341,7 @@ class ModelStore:
 
         table = {}
         fd, tmp_arena = tempfile.mkstemp(dir=self.dir, suffix=".arena.tmp")
+        tmps.append(tmp_arena)
         with os.fdopen(fd, "wb") as fh:
             off = 0
             for key in sorted(fields):
@@ -327,6 +381,7 @@ class ModelStore:
         # served, not a re-trained approximation of them
         model_blob = bytes(booster.serialize())
         fd, tmp_model = tempfile.mkstemp(dir=self.dir, suffix=".model.tmp")
+        tmps.append(tmp_model)
         with os.fdopen(fd, "wb") as fh:
             fh.write(model_blob)
             fh.flush()
@@ -350,6 +405,7 @@ class ModelStore:
         }
         stem = f"{name}.v{version}"
         fd, tmp_meta = tempfile.mkstemp(dir=self.dir, suffix=".meta.tmp")
+        tmps.append(tmp_meta)
         with os.fdopen(fd, "w") as fh:
             json.dump(meta, fh, indent=1)
             fh.flush()

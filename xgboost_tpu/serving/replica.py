@@ -208,7 +208,12 @@ def ship_telemetry(sock, label: str) -> bool:
         wire.send_frame(sock, {"op": wire.TELEMETRY, "label": label},
                         payload)
         return True
-    except (OSError, TypeError, ValueError):
+    except OSError as e:
+        from ..reliability import resources as _resources
+
+        _resources.note_os_error(e, "replica.ship")
+        return False
+    except (TypeError, ValueError):
         return False
 
 
@@ -222,8 +227,10 @@ def _replica_stall(op) -> None:
     flight.record("fault", "replica.stall", **op.detail)
     try:
         flight.dump()
-    except OSError:
-        pass
+    except OSError as e:
+        from ..reliability import resources as _resources
+
+        _resources.note_os_error(e, "replica.flight_dump")
     os._exit(121)
 
 
@@ -260,8 +267,10 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
         try:
             wire.send_frame(sock, {"op": "quarantine", "id": rid,
                                    "label": label, "error": str(e)})
-        except OSError:
-            pass
+        except OSError as se:
+            from ..reliability import resources as _resources
+
+            _resources.note_os_error(se, "replica.quarantine_send")
 
     interval = distributed.ship_interval()
     scrub_s = _scrub_interval()
@@ -499,16 +508,20 @@ def main(argv=None) -> int:
         flight.dump_stacks()
         try:
             flight.dump()
-        except OSError:
-            pass
+        except OSError as de:
+            from ..reliability import resources as _resources
+
+            _resources.note_os_error(de, "replica.flight_dump")
         raise
     finally:
         ship_telemetry(sock, args.label)  # final counters survive us
         engine.close()
         try:
             sock.close()
-        except OSError:
-            pass
+        except OSError as ce:
+            from ..reliability import resources as _resources
+
+            _resources.note_os_error(ce, "replica.sock_close")
     return 0
 
 

@@ -153,9 +153,15 @@ class WarmProgramCache:
                 obj = pickle.load(fh)
             if obj.get("format") == _FORMAT:
                 return dict(obj["programs"])
-        except (OSError, pickle.UnpicklingError, EOFError, KeyError,
+        except FileNotFoundError:
+            pass  # no warm file yet: every program compiles (cold)
+        except OSError as e:
+            from ..reliability import resources as _resources
+
+            _resources.note_os_error(e, "warmcache.load")
+        except (pickle.UnpicklingError, EOFError, KeyError,
                 AttributeError):
-            pass
+            pass  # stale/corrupt cache payload: fall back to compiling
         return {}
 
     # ------------------------------------------------------------------ API

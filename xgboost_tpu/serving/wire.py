@@ -96,8 +96,18 @@ def configure(sock: socket.socket) -> socket.socket:
     latency).  Both ends call this on every fleet connection."""
     try:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    except OSError:  # non-TCP transport (tests pair unix sockets)
-        pass
+    except OSError as e:
+        # a non-TCP transport (tests pair unix sockets) rejects the
+        # option — expected, not a resource event; anything else is
+        # classified
+        import errno as _errno
+
+        if getattr(e, "errno", None) not in (
+                _errno.ENOPROTOOPT, _errno.EOPNOTSUPP, _errno.EINVAL,
+                getattr(_errno, "ENOTSUP", _errno.EOPNOTSUPP)):
+            from ..reliability import resources as _resources
+
+            _resources.note_os_error(e, "wire.configure")
     return sock
 
 

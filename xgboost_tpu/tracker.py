@@ -1275,6 +1275,21 @@ class RabitTracker:
             # the old ranks must not age anyone in the new epoch
             self._liveness = {}
             self._relay.regroup(new_world, epoch)
+            if self._journal is not None:
+                # durable-commit-first: the new epoch must hit the journal
+                # BEFORE any worker is told about it — a tracker killed
+                # between announce and journal would otherwise respawn
+                # believing the OLD epoch while the workers run the new
+                # one (and a reader racing the replies would see a stale
+                # epoch, the flake this ordering fix removes)
+                try:
+                    self._journal_last = time.monotonic()
+                    self._journal.append(self._journal_state())
+                except OSError as e:
+                    warnings.warn(
+                        f"tracker journal write failed ({e}); a tracker "
+                        "respawn may not recover this epoch",
+                        RuntimeWarning, stacklevel=2)
             for nr, conn in enumerate(ordered):
                 try:
                     send_msg(conn, {"cmd": "regroup", "epoch": epoch,
@@ -1300,7 +1315,6 @@ class RabitTracker:
         ins[2].observe(duration)
         _flight.record("event", "tracker.regroup", epoch=epoch,
                        world=new_world, seconds=duration)
-        self._journal_write(force=True)  # the epoch is a committed fact
         for conn, jrank in joiner_ranks:
             threading.Thread(target=self._watch_worker,
                              args=(conn, jrank), daemon=True).start()
