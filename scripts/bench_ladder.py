@@ -19,8 +19,10 @@ Usage:  python scripts/bench_ladder.py [out.json]
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import platform as _platform
 import sys
 import time
 
@@ -29,6 +31,54 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 ORACLE_PKG = "/root/oracle_build/pkg"
+
+_HOST_FP = None
+
+
+def _host_fingerprint() -> dict:
+    """What makes a wall-clock number comparable: core count, arch, and
+    the SIMD capability set.  Stamped on every ladder row; any path that
+    compares rows across files (archived-oracle reuse, --diff) must
+    refuse when the ids differ — a cross-host wall ratio is not a
+    regression signal, it is two different machines."""
+    global _HOST_FP
+    if _HOST_FP is None:
+        from xgboost_tpu.utils import native as _native
+
+        simd = _native.simd_info()
+        info = dict(cores=os.cpu_count(), machine=_platform.machine(),
+                    cpu_flags=sorted(simd.get("cpu_flags", [])),
+                    lanes=simd.get("lanes"))
+        blob = json.dumps(info, sort_keys=True).encode()
+        info["id"] = hashlib.sha256(blob).hexdigest()[:12]
+        _HOST_FP = info
+    return _HOST_FP
+
+
+def diff_main(old_path: str, new_path: str) -> int:
+    """Compare two ladder files config-by-config; refuses (exit 2) when
+    any compared pair was produced on different hosts."""
+    with open(old_path) as fh:
+        old = {r["config"]: r for r in json.load(fh)}
+    with open(new_path) as fh:
+        new = {r["config"]: r for r in json.load(fh)}
+    rc = 0
+    for name in sorted(set(old) & set(new)):
+        a, b = old[name], new[name]
+        ha, hb = a.get("host"), b.get("host")
+        if not ha or not hb or ha.get("id") != hb.get("id"):
+            print(f"[{name}] REFUSED: rows are from different hosts "
+                  f"({(ha or {}).get('id', 'unstamped')} vs "
+                  f"{(hb or {}).get('id', 'unstamped')}) — wall-clock "
+                  f"deltas across hosts are not comparable")
+            rc = 2
+            continue
+        wa, wb = a.get("ours_wall_s"), b.get("ours_wall_s")
+        if wa and wb:
+            print(f"[{name}] ours_wall_s {wa} -> {wb} "
+                  f"({(wb - wa) / wa * 100.0:+.1f}%)  quality "
+                  f"{a.get('ours_quality')} -> {b.get('ours_quality')}")
+    return rc
 
 FULL_CONFIGS = [
     # BASELINE.md ladder #2: HIGGS 11M x 28, binary:logistic, AUC
@@ -299,26 +349,33 @@ def main() -> None:
         note = None
         if orc_s is None:
             prev = prior_oracle.get(cfg["name"])
+            prev_host = (prev or {}).get("host") or {}
+            if (prev and prev.get("rows") == R
+                    and prev.get("platform") == platform
+                    and prev_host.get("id") != _host_fingerprint()["id"]):
+                # a cross-host oracle wall is not a baseline — refuse it
+                # loudly rather than mix hosts into speed_vs_oracle
+                print(f"  oracle: archived numbers REFUSED — host "
+                      f"{prev_host.get('id', 'unstamped')} != this host "
+                      f"{_host_fingerprint()['id']}", flush=True)
+                prev = None
             if (prev and prev.get("rows") == R
                     and prev.get("platform") == platform):
                 orc_s = prev["oracle_wall_s"]
                 orc_q = prev.get("oracle_quality")
                 oracle_source = "archived (oracle build unavailable)"
-                note = ("oracle walls are from the archived run's HOST, "
-                        "which may differ from this one — "
-                        "speed_vs_oracle is cross-host and indicative "
-                        "only; the like-for-like signal on this host is "
-                        "nthread_scaling")
+                note = ("oracle walls are archived from an earlier run "
+                        "on THIS host (fingerprint-matched) — "
+                        "like-for-like, but from an older session")
                 print(f"  oracle: {orc_s:8.1f}s  [archived numbers — "
-                      f"same rows/platform, possibly different host]",
-                      flush=True)
+                      f"same rows/platform/host]", flush=True)
         from xgboost_tpu.utils import native as _native
 
         rows_out.append(dict(
             config=cfg["name"], rows=R, cols=cfg["cols"],
             full_rows=cfg["rows"], scale=scale, rounds=cfg["rounds"],
             objective=cfg["objective"], metric=cfg["metric"],
-            platform=platform,
+            platform=platform, host=_host_fingerprint(),
             nthread=_native.get_nthread(), cores=os.cpu_count(),
             simd=_native.simd_info(), sweep_reps=_reps(),
             ours_wall_s=round(ours_s, 2), ours_quality=round(ours_q, 6),
@@ -679,6 +736,7 @@ def _row_main(name: str, out_path: str) -> None:
           "higgs_full": bench_row_higgs_full,
           "criteo_extmem_40m": bench_row_criteo_extmem}[name]
     row = fn()
+    row["host"] = _host_fingerprint()
     with open(out_path, "w") as fh:
         json.dump(row, fh, indent=1)
     print(json.dumps(row, indent=1), flush=True)
@@ -688,6 +746,9 @@ if __name__ == "__main__":
     if "--row" in sys.argv:
         i = sys.argv.index("--row")
         _row_main(sys.argv[i + 1], sys.argv[i + 2])
+    elif "--diff" in sys.argv:
+        i = sys.argv.index("--diff")
+        sys.exit(diff_main(sys.argv[i + 1], sys.argv[i + 2]))
     elif "--extmem" in sys.argv:
         args = [a for a in sys.argv[1:] if not a.startswith("--")]
         extmem_main(args[0] if args else "BENCH_LADDER.json")

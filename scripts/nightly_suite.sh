@@ -111,8 +111,8 @@ JAX_PLATFORMS=cpu python scripts/bench_obs.py bench_out/BENCH_OBS.json
 
 # composed-fault chaos soak (docs/reliability.md "Integrity & chaos"):
 # >= 20 seeded multi-fault episodes round-robin across the scenario
-# templates (extmem / fleet / lifecycle / elastic / tracker_kill /
-# stall / resource), each checked for no-hang, bitwise-vs-twin, fault
+# templates (extmem / fleet / lifecycle / online / elastic /
+# tracker_kill / stall / resource), each checked for no-hang, bitwise-vs-twin, fault
 # accounting, zero dropped requests, and a flight dump per death; the
 # run ends by replaying episode 0's seed and requiring the identical
 # schedule and outcome.  Any red episode prints its one-command repro
@@ -138,5 +138,13 @@ JAX_PLATFORMS=cpu python scripts/resource_smoke.py 10
 # lifecycle.swap KILL — the manifest must still name the incumbent and a
 # restarted fleet must serve its exact bits
 JAX_PLATFORMS=cpu python scripts/lifecycle_smoke.py 2 60
+
+# online-learning-loop smoke (docs/online.md): live traffic with feedback
+# sampling on -> trace-keyed label join -> drift detector trips on a
+# shifted distribution -> OnlineScheduler retrains + hot-swaps under
+# sustained traffic (zero dropped requests); a governor-degraded forced
+# retrain must DEFER while serving keeps answering; the whole loop
+# replayed from the same seed must retrain the bitwise-identical model
+JAX_PLATFORMS=cpu python scripts/online_smoke.py 2
 
 BENCH_FORCE_CPU=1 BENCH_ROWS=100000 BENCH_ROUNDS=5 python bench.py

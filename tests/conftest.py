@@ -51,7 +51,8 @@ _QUICK_FILES = {
     "test_analysis.py", "test_native_threads.py", "test_elastic.py",
     "test_lifecycle.py", "test_updaters_process.py", "test_extmem.py",
     "test_integrity.py", "test_chaos.py", "test_watchdog.py",
-    "test_failover.py", "test_resources.py",
+    "test_failover.py", "test_resources.py", "test_window_store.py",
+    "test_online.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)
@@ -85,6 +86,8 @@ _QUICK_DENY = {
     "test_two_process_elastic_shrink_to_single_worker",
     "test_manager_continuation_resumes_from_checkpoint",
     "test_lifecycle_end_to_end_fleet",
+    "test_online_closed_loop_end_to_end",
+    "test_chaos_online_episode_green_and_deterministic",
     "test_extmem_matches_incore", "test_extmem_multidevice_matches_single",
     "test_sparse_page_dmatrix_raw_predict_and_training",
     "test_sparse_page_dmatrix_scipy_batches_and_sentinel",

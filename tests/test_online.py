@@ -1,0 +1,420 @@
+"""Online learning loop (docs/online.md): feedback join, drift
+detection, scheduler policy, the shadow comparator's PSI/calibration
+gates, and the closed loop end to end over a real replica fleet.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.lifecycle import LifecycleConfig, LifecycleManager
+from xgboost_tpu.online import (DriftConfig, DriftDetector, FeedbackHub,
+                                OnlineConfig, OnlineScheduler, WindowStore)
+from xgboost_tpu.reliability import faults, resources
+from xgboost_tpu.serving import ModelStore
+
+PARAMS = {"objective": "binary:logistic", "max_depth": 3,
+          "eval_metric": "logloss", "seed": 7}
+
+
+def _data(seed=10, n=3000, f=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+def _train(X, y, rounds=4):
+    return xtb.train(PARAMS, xtb.DMatrix(X, label=y), rounds,
+                     verbose_eval=False)
+
+
+def _rec(trace, tag=0, rows=4, model="m"):
+    rng = np.random.default_rng(200 + tag)
+    X = rng.standard_normal((rows, 3)).astype(np.float32)
+    return {"model": model, "trace": trace, "X": X,
+            "scores": rng.random(rows).astype(np.float32)}
+
+
+# ============================================================ FeedbackHub
+
+def test_hub_joins_in_either_order():
+    hub = FeedbackHub(horizon_s=100.0, clock=lambda: 0.0)
+    hub.offer(_rec("a-1"))
+    assert hub.label("a-1", [1.0] * 4) is True       # label second
+    assert hub.label("a-2", [0.0] * 4) is False      # label first
+    hub.offer(_rec("a-2"))
+    out = hub.drain()
+    assert [r["trace"] for r in out] == ["a-1", "a-2"]
+    np.testing.assert_array_equal(out[0]["y"], np.ones(4, np.float32))
+    s = hub.stats()
+    assert s["matched"] == 2 and s["dropped"] == {}
+    assert s["pending_features"] == 0 and s["pending_labels"] == 0
+
+
+def test_hub_expires_both_sides_past_horizon():
+    now = [0.0]
+    hub = FeedbackHub(horizon_s=10.0, clock=lambda: now[0])
+    hub.offer(_rec("a-1"))
+    hub.label("a-2", [1.0])
+    now[0] = 20.0
+    hub.offer(_rec("a-3"))       # any call sweeps the expired front
+    assert hub.label("a-1", [1.0]) is False  # its features already expired
+    s = hub.stats()
+    assert s["dropped"]["expired"] == 2
+    assert s["matched"] == 0
+
+
+def test_hub_capacity_drops_oldest():
+    hub = FeedbackHub(horizon_s=1e9, max_pending=2, clock=lambda: 0.0)
+    for i in range(4):
+        hub.offer(_rec(f"a-{i:x}"))
+    s = hub.stats()
+    assert s["pending_features"] == 2
+    assert s["dropped"]["capacity"] == 2
+    # the two newest survived
+    assert hub.label("a-3", [1.0]) is True
+    assert hub.label("a-0", [1.0]) is False
+
+
+def test_hub_duplicates_and_untraced_counted():
+    hub = FeedbackHub(horizon_s=100.0, clock=lambda: 0.0)
+    hub.offer(_rec("a-1"))
+    hub.offer(_rec("a-1"))            # replica reroute re-executed sample
+    hub.offer({"model": "m", "X": np.ones((1, 2))})  # no trace
+    hub.label("a-9", [1.0])
+    hub.label("a-9", [1.0])           # duplicate label
+    s = hub.stats()
+    assert s["dropped"]["duplicate"] == 2
+    assert s["dropped"]["untraced"] == 1
+
+
+def test_hub_label_join_fault_seam_drops_label():
+    faults.install([{"site": "online.label_join", "kind": "exception"}])
+    try:
+        hub = FeedbackHub(horizon_s=100.0, clock=lambda: 0.0)
+        hub.offer(_rec("a-1"))
+        assert hub.label("a-1", [1.0]) is False
+        s = hub.stats()
+        assert s["dropped"]["fault"] == 1
+        assert s["pending_features"] == 1  # features still wait, unharmed
+    finally:
+        faults.clear()
+
+
+# ========================================================== DriftDetector
+
+def test_drift_self_primes_and_stays_quiet_on_same_distribution():
+    det = DriftDetector(min_rows=32, current_rows=256)
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((40, 4))
+    s = rng.random(40)
+    det.observe(X, s)            # first min_rows become the reference
+    assert det.has_reference()
+    det.observe(X, s)            # identical traffic: every stat exactly 0
+    rep = det.check()
+    assert not rep.drifted and rep.triggers == []
+    assert rep.stats["feature_ks"] == 0.0
+    assert rep.stats["score_psi"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_drift_trips_on_shift_and_rebase_resets():
+    det = DriftDetector(min_rows=32, current_rows=256,
+                        max_feature_ks=0.3)
+    rng = np.random.default_rng(1)
+    s = rng.random(64)
+    Xs = rng.standard_normal((64, 4)) + 5.0
+    det.set_reference(rng.standard_normal((64, 4)), s)
+    det.observe(Xs, s)
+    rep = det.check()
+    assert rep.drifted and "feature_ks" in rep.triggers
+    assert rep.stats["feature_ks"] > 0.9
+    det.rebase()   # post-swap: the shifted traffic is the new normal
+    det.observe(Xs, s)
+    assert not det.check().drifted
+
+
+def test_drift_needs_min_rows_both_sides():
+    det = DriftDetector(min_rows=64, current_rows=256)
+    rng = np.random.default_rng(2)
+    det.set_reference(rng.standard_normal((128, 4)), rng.random(128))
+    det.observe(rng.standard_normal((16, 4)) + 9.0, rng.random(16))
+    rep = det.check()   # 16 current rows: tiny-sample KS is noise
+    assert not rep.drifted and rep.stats == {}
+
+
+# ============================================== PSI / calibration helpers
+
+def test_psi_zero_for_identical_large_for_shifted():
+    from xgboost_tpu.serving.fleet import _psi
+
+    rng = np.random.default_rng(3)
+    a = rng.random(2000).astype(np.float32)
+    assert _psi(a, a.copy()) == pytest.approx(0.0, abs=1e-9)
+    assert _psi(a, np.clip(a + 0.4, 0, 1)) > 0.25
+    mild = _psi(a, np.clip(a + 0.02, 0, 1))
+    assert 0.0 < mild < 0.25
+
+
+def test_calibration_gap_detects_decile_bias():
+    from xgboost_tpu.serving.fleet import _calibration_gap
+
+    rng = np.random.default_rng(4)
+    a = rng.random(1000).astype(np.float32)
+    assert _calibration_gap(a, a.copy()) == pytest.approx(0.0)
+    assert _calibration_gap(a, np.clip(a + 0.2, 0, 1)) >= 0.1
+    # shape mismatch = no comparable pairing: defined as zero, the
+    # mean-divergence failure counter owns that case
+    assert _calibration_gap(a, a[:10]) == 0.0
+
+
+# ==================================================== replica-side sampling
+
+def test_sampling_is_deterministic_off_the_trace_rid():
+    from xgboost_tpu.serving.replica import _sampled
+
+    assert _sampled("abcd-10", 2) is True    # 0x10 % 2 == 0
+    assert _sampled("abcd-11", 2) is False
+    assert _sampled("ffff-10", 2) is True    # pid half never matters
+    assert _sampled(None, 2) is False
+    assert _sampled("garbage", 2) is False
+    every = 4
+    picks = [_sampled(f"aa-{rid:x}", every) for rid in range(64)]
+    assert sum(picks) == 16  # exactly 1-in-N, not approximately
+
+
+# ===================================================== scheduler policy
+
+class _SinkFleet:
+    def __init__(self):
+        self.sampling = {}
+        self.sink = None
+
+    def set_sampling(self, model, every, timeout=None):
+        self.sampling[model] = every
+
+    def set_feedback_sink(self, sink):
+        self.sink = sink
+
+
+def test_scheduler_defers_on_rows_then_brownout_then_memory():
+    resources.reset()
+    try:
+        fleet = _SinkFleet()
+        sch = OnlineScheduler(fleet, "m", min_retrain_rows=100)
+        sch.enable()
+        assert fleet.sampling["m"] == sch.config.sample_every
+        out = sch.maybe_retrain()
+        assert (out["outcome"], out["reason"]) == ("deferred", "rows")
+        gov = resources.get_governor()
+        gov.degrade("overload", "test")
+        out = sch.maybe_retrain(force=True)
+        assert (out["outcome"], out["reason"]) == ("deferred", "brownout")
+        gov.restore("overload")
+        gov.degrade("memory", "test")
+        gov.degrade("memory", "test")   # level 2: training must not start
+        out = sch.maybe_retrain(force=True)
+        assert (out["outcome"], out["reason"]) == ("deferred", "memory")
+    finally:
+        resources.reset()
+
+
+def test_scheduler_idle_without_drift_and_fault_seam_spares_incumbent():
+    resources.reset()
+    fleet = _SinkFleet()
+    sch = OnlineScheduler(fleet, "m", min_retrain_rows=32,
+                          drift=DriftConfig(min_rows=16))
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((64, 4)).astype(np.float32)
+    s = rng.random(64)
+    sch.window.append(X, (X[:, 0] > 0).astype(np.float32))
+    sch.detector.set_reference(X, s)
+    sch.detector.observe(X, s)
+    out = sch.maybe_retrain()
+    assert out["outcome"] == "idle"   # same distribution: nothing to do
+    faults.install([{"site": "online.retrain", "kind": "exception"}])
+    try:
+        out = sch.maybe_retrain(force=True)
+        # the cycle never starts: no LifecycleManager was ever built
+        assert out["outcome"] == "fault" and sch._mgr is None
+    finally:
+        faults.clear()
+
+
+def test_scheduler_pump_fills_window_and_detector():
+    fleet = _SinkFleet()
+    sch = OnlineScheduler(fleet, "m", sample_every=1)
+    sch.enable()
+    for i in range(3):
+        fleet.sink(_rec(f"a-{i:x}", tag=i, rows=8))
+        assert sch.label(f"a-{i:x}", np.ones(8, np.float32))
+    fleet.sink(_rec("b-1", tag=9, rows=8, model="other"))  # filtered
+    assert sch.pump() == 24
+    assert len(sch.window) == 24
+    assert sch.hub.stats()["matched"] == 3
+
+
+# ============================== shadow PSI / calibration lifecycle gates
+
+class _ShadowStubFleet:
+    """Stub recording control calls; shadow stats injectable per test."""
+
+    def __init__(self, store, stats):
+        self.store = store
+        self.calls = []
+        self._stats = stats
+        self._versions = dict(store.serving_entries())
+        for name, v in store.serving_entries():
+            store.set_active(name, v)
+
+    @property
+    def store_dir(self):
+        return self.store.dir
+
+    def active_version(self, model):
+        return self._versions.get(model)
+
+    def load_version(self, model, version, timeout=None, trace=None):
+        self.calls.append(("load", model, int(version)))
+        return [{}]
+
+    def activate_version(self, model, version, timeout=None, trace=None):
+        self.store.set_active(model, int(version))
+        self._versions[model] = int(version)
+        self.calls.append(("activate", model, int(version)))
+        return [{}]
+
+    def retire_version(self, model, version, timeout=None, trace=None):
+        self.calls.append(("retire", model, int(version)))
+        return [{}]
+
+    def set_shadow(self, model, version, fraction):
+        self.calls.append(("set_shadow", model, int(version), fraction))
+
+    def shadow_stats(self, model):
+        return dict(self._stats)
+
+    def clear_shadow(self, model):
+        self.calls.append(("clear_shadow", model))
+        return dict(self._stats)
+
+
+_CLEAN_SHADOW = {"pairs": 5, "failures": 0, "mean_div": 0.0,
+                 "max_div": 0.0, "mean_ks": 0.0, "max_ks": 0.0,
+                 "mean_psi": 0.0, "max_psi": 0.0,
+                 "mean_cal": 0.0, "max_cal": 0.0}
+
+
+@pytest.mark.parametrize("stat,knob,bad", [
+    ("max_psi", "shadow_max_psi", 0.8),
+    ("max_cal", "shadow_max_calibration", 0.3),
+])
+def test_shadow_distribution_gates_reject_and_spare_incumbent(
+        tmp_path, stat, knob, bad):
+    X, y = _data()
+    st = ModelStore(str(tmp_path / "store"))
+    st.publish("m", _train(X[:2000], y[:2000]))
+    fleet = _ShadowStubFleet(st, dict(_CLEAN_SHADOW, **{stat: bad}))
+    mgr = LifecycleManager(fleet, "m", config=LifecycleConfig(
+        rounds_per_cycle=2, shadow_fraction=0.5, shadow_min_pairs=1,
+        **{knob: 0.1}))
+    rep = mgr.run_cycle((X[2000:], y[2000:]),
+                        eval_window=(X[:2000], y[:2000]))
+    assert not rep.swapped and rep.decision.reason == "shadow"
+    assert rep.shadow[stat] == pytest.approx(bad)
+    ops = [c[0] for c in fleet.calls]
+    assert ops == ["load", "set_shadow", "clear_shadow", "retire"]
+    assert st.active_version("m") == 1
+
+
+def test_shadow_distribution_gates_pass_within_threshold(tmp_path):
+    X, y = _data()
+    st = ModelStore(str(tmp_path / "store"))
+    st.publish("m", _train(X[:2000], y[:2000]))
+    fleet = _ShadowStubFleet(st, dict(_CLEAN_SHADOW))
+    mgr = LifecycleManager(fleet, "m", config=LifecycleConfig(
+        rounds_per_cycle=2, shadow_fraction=0.5, shadow_min_pairs=1,
+        shadow_max_psi=0.25, shadow_max_calibration=0.1))
+    rep = mgr.run_cycle((X[2000:], y[2000:]),
+                        eval_window=(X[:2000], y[:2000]))
+    assert rep.swapped and st.active_version("m") == 2
+
+
+# =============================================== closed loop, real fleet
+
+def test_online_closed_loop_end_to_end(tmp_path):
+    """The acceptance loop: a real replica serves, feedback samples flow
+    back, labels join by trace, shifted traffic trips drift, the
+    scheduler retrains + hot-swaps, and serving matches the new active
+    version — with zero dropped requests."""
+    from xgboost_tpu.lifecycle import GateConfig
+    from xgboost_tpu.serving import ServingFleet
+
+    rng = np.random.default_rng(31)
+    store_dir = str(tmp_path / "store")
+    Xb, yb = _data(seed=20, n=800, f=6)
+    st = ModelStore(store_dir)
+    st.publish("m", _train(Xb, yb, rounds=3))
+    st.set_active("m", 1)
+
+    blocks = [rng.standard_normal((16, 6)).astype(np.float32)
+              for _ in range(4)]
+    blocks += [(rng.standard_normal((16, 6)) + 4.0).astype(np.float32)
+               for _ in range(8)]
+
+    with ServingFleet(store_dir=store_dir, n_replicas=1,
+                      cache_dir=str(tmp_path / "cache"),
+                      warmup_buckets=(16,)) as fleet:
+        sch = OnlineScheduler(fleet, "m", config=OnlineConfig(
+            sample_every=1, join_horizon_s=600.0, min_retrain_rows=64,
+            window_rows=4096, page_rows=32,
+            spool_dir=str(tmp_path / "window"),
+            drift=DriftConfig(min_rows=32, max_feature_ks=0.3),
+            lifecycle=LifecycleConfig(
+                rounds_per_cycle=2,
+                gate=GateConfig(min_improvement=-1e9))))
+        sch.enable()
+        traces = []
+        for rows in blocks:
+            fut = fleet.submit("m", rows)
+            traces.append(fut.trace_id)
+            fut.result(timeout=180)           # every request completes
+        deadline = time.monotonic() + 60.0
+        while (sch.hub.stats()["offered"] < len(blocks)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert sch.hub.stats()["offered"] == len(blocks)
+        for tr, rows in zip(traces, blocks):
+            assert sch.label(
+                tr, (rows[:, 0] - rows[:, 2] > 0).astype(np.float32))
+        out = sch.step()
+        assert out["pumped_rows"] == 16 * len(blocks)
+        assert out["outcome"] == "swapped", out
+        assert "feature_ks" in (out["drift"] or {})
+        sch.disable()
+        assert fleet.active_version("m") == 2
+        Q = blocks[-1]
+        served = np.asarray(fleet.predict("m", Q, timeout=120), np.float32)
+        expected = ModelStore(store_dir).booster("m", 2).predict(
+            xtb.DMatrix(Q))
+        np.testing.assert_array_equal(
+            served, np.asarray(expected, np.float32))
+        s = sch.hub.stats()
+        assert s["matched"] == len(blocks) and s["dropped"] == {}
+
+
+# ======================================================= chaos scenario
+
+@pytest.mark.slow
+def test_chaos_online_episode_green_and_deterministic():
+    from xgboost_tpu.reliability import chaos
+
+    r1 = chaos.run_episode("online", 11)
+    assert r1.ok, r1.invariants
+    r2 = chaos.run_episode("online", 11)
+    assert r2.ok
+    assert r1.plan == r2.plan
+    assert r1.artifacts["digest"] == r2.artifacts["digest"]
+    assert r1.artifacts["completed"] == 18

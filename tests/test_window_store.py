@@ -1,0 +1,210 @@
+"""Sliding-window coverage: FreshWindow's external-memory chunk route
+(docs/serving.md "Online model lifecycle") and the online loop's
+extmem-paged WindowStore (docs/online.md).
+
+The FreshWindow extmem route existed since the lifecycle PR but was
+nearly untested: these pin eviction order, weight passthrough, and the
+chunked ExtMemQuantileDMatrix path — plus WindowStore's page sealing,
+row/age eviction, and the DiskPage spill fallback (this container has no
+zstandard, so the fallback IS the default path; the zstd leg gates on the
+lib being importable).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.lifecycle import FreshWindow
+from xgboost_tpu.online import WindowStore
+from xgboost_tpu.reliability import resources
+
+
+def _batch(tag, rows=32, cols=4):
+    """Identifiable rows: column 0 carries the batch tag."""
+    rng = np.random.default_rng(100 + tag)
+    X = rng.standard_normal((rows, cols)).astype(np.float32)
+    X[:, 0] = tag
+    y = (X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------- FreshWindow
+
+def test_freshwindow_extmem_chunk_route_matches_arrays():
+    win = FreshWindow()
+    for tag in range(4):
+        win.append(*_batch(tag))
+    X, y, w = win.arrays()
+    d = win.to_dmatrix(extmem_chunk_rows=48, max_bin=32)
+    assert d.num_row() == len(win) == 128
+    np.testing.assert_array_equal(np.asarray(d.info.label), y)
+    assert w is None
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 2},
+                    d, 2, verbose_eval=False)
+    preds = np.asarray(bst.predict(d))
+    assert preds.shape == (128,) and np.all(np.isfinite(preds))
+
+
+def test_freshwindow_eviction_order_through_extmem_route():
+    win = FreshWindow(max_rows=80)
+    for tag in range(4):  # 128 rows in, oldest 48 fall off
+        win.append(*_batch(tag))
+    X, y, _ = win.arrays()
+    assert len(win) == 80
+    # batch 0 fully evicted, batch 1 halved: oldest-first, partial slice
+    np.testing.assert_array_equal(
+        X[:, 0], np.concatenate([np.full(16, 1.0), np.full(32, 2.0),
+                                 np.full(32, 3.0)]).astype(np.float32))
+    d = win.to_dmatrix(extmem_chunk_rows=32, max_bin=32)
+    assert d.num_row() == 80
+    np.testing.assert_array_equal(np.asarray(d.info.label), y)
+
+
+def test_freshwindow_weight_passthrough_extmem_route():
+    win = FreshWindow()
+    rng = np.random.default_rng(3)
+    weights = []
+    for tag in range(3):
+        X, y = _batch(tag)
+        w = rng.random(len(y)).astype(np.float32) + 0.5
+        weights.append(w)
+        win.append(X, y, weight=w)
+    want = np.concatenate(weights)
+    _, _, w_arr = win.arrays()
+    np.testing.assert_array_equal(w_arr, want)
+    d = win.to_dmatrix(extmem_chunk_rows=40, max_bin=32)
+    np.testing.assert_allclose(np.asarray(d.info.weight), want, rtol=1e-6)
+
+
+def test_freshwindow_weight_all_or_none():
+    win = FreshWindow()
+    X, y = _batch(0)
+    win.append(X, y, weight=np.ones(len(y), np.float32))
+    with pytest.raises(ValueError, match="every batch carries weights"):
+        win.append(X, y)
+
+
+# ---------------------------------------------------------- WindowStore
+
+def test_windowstore_seals_exact_pages_with_odd_batches():
+    ws = WindowStore(page_rows=50)
+    for tag in range(5):
+        ws.append(*_batch(tag, rows=32))  # 160 rows in 32-row batches
+    st = ws.stats()
+    assert st["rows"] == 160
+    assert st["pages"] == 3 and st["staging_rows"] == 10
+    X, y, w = ws.arrays()
+    assert X.shape == (160, 4) and w is None
+    # order preserved across seal/spill boundaries
+    np.testing.assert_array_equal(
+        X[:, 0], np.repeat(np.arange(5, dtype=np.float32), 32))
+    ws.clear()
+
+
+def test_windowstore_row_eviction_oldest_page_first():
+    ws = WindowStore(max_rows=100, page_rows=32)
+    for tag in range(6):
+        ws.append(*_batch(tag, rows=32))
+    # 192 rows appended; whole-page eviction holds <= max_rows (bounded
+    # overshoot of at most one page above, never past the bound after)
+    assert len(ws) <= 100
+    X, _, _ = ws.arrays()
+    tags = np.unique(X[:, 0])
+    assert tags.min() >= 2.0, f"oldest pages must fall first, got {tags}"
+    ws.clear()
+
+
+def test_windowstore_age_eviction_with_injected_clock():
+    now = [0.0]
+    ws = WindowStore(max_age_s=10.0, page_rows=32, clock=lambda: now[0])
+    ws.append(*_batch(0, rows=32))   # sealed at t=0
+    now[0] = 20.0                    # ages past the horizon
+    ws.append(*_batch(1, rows=32))   # append runs eviction
+    X, _, _ = ws.arrays()
+    assert np.all(X[:, 0] == 1.0), "aged page must be evicted"
+    assert len(ws) == 32
+    ws.clear()
+
+
+def test_windowstore_weight_rules():
+    ws = WindowStore(page_rows=16)
+    X, y = _batch(0, rows=16)
+    w = np.linspace(0.5, 1.5, 16).astype(np.float32)
+    ws.append(X, y, weight=w)
+    with pytest.raises(ValueError, match="every batch carries weights"):
+        ws.append(X, y)
+    _, _, got = ws.arrays()
+    np.testing.assert_array_equal(got, w)
+    with pytest.raises(ValueError, match="features"):
+        ws.append(np.ones((4, 7), np.float32), np.ones(4, np.float32),
+                  weight=np.ones(4, np.float32))
+    ws.clear()
+
+
+def test_windowstore_disk_fallback_pages_are_crc_gated_files(
+        tmp_path, monkeypatch):
+    from xgboost_tpu.data import extmem
+
+    monkeypatch.setattr(extmem, "_zstd_available", lambda: False)
+    spool = str(tmp_path / "spool")
+    ws = WindowStore(page_rows=32, spool_dir=spool)
+    for tag in range(3):
+        ws.append(*_batch(tag, rows=32))
+    st = ws.stats()
+    assert st["pages_on_disk"] == 3 and st["spilled_bytes"] > 0
+    files = sorted(os.listdir(spool))
+    assert len(files) == 3 and all(f.endswith(".npy") for f in files)
+    X, y, _ = ws.arrays()  # every page read passes the CRC gate
+    assert X.shape == (96, 4) and y.shape == (96,)
+    ws.clear()
+    assert sorted(os.listdir(spool)) == []
+
+
+def test_windowstore_zstd_pages_stay_resident(tmp_path):
+    pytest.importorskip("zstandard")
+    ws = WindowStore(page_rows=32, spool_dir=str(tmp_path / "spool"))
+    ws.append(*_batch(0, rows=64))
+    st = ws.stats()
+    assert st["pages"] == 2 and st["pages_on_disk"] == 0
+    assert st["spilled_bytes"] == 0
+    ws.clear()
+
+
+def test_windowstore_spills_resident_pages_under_memory_pressure(
+        tmp_path):
+    resources.reset()
+    try:
+        spool = str(tmp_path / "spool")
+        ws = WindowStore(page_rows=32, spool_dir=spool)
+        ws.append(*_batch(0, rows=64))
+        before = ws.stats()
+        gov = resources.get_governor()
+        gov.degrade("memory", "test pressure")
+        assert gov.memory_scale() < 1.0
+        ws.append(*_batch(1, rows=64))   # append spills + seals to disk
+        st = ws.stats()
+        assert st["pages"] == 4
+        assert st["pages_on_disk"] == 4, (before, st)
+        X, y, _ = ws.arrays()
+        assert X.shape == (128, 4)
+        np.testing.assert_array_equal(
+            X[:, 0], np.repeat([0.0, 1.0], 64).astype(np.float32))
+        ws.clear()
+    finally:
+        resources.reset()
+
+
+def test_windowstore_extmem_route_trains_with_weights():
+    ws = WindowStore(page_rows=48)
+    rng = np.random.default_rng(5)
+    for tag in range(4):
+        X, y = _batch(tag, rows=36)
+        ws.append(X, y, weight=rng.random(36).astype(np.float32) + 0.5)
+    d = ws.to_dmatrix(extmem_chunk_rows=1, max_bin=32)  # page-per-chunk
+    assert d.num_row() == 144
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 2},
+                    d, 2, verbose_eval=False)
+    preds = np.asarray(bst.predict(d))
+    assert preds.shape == (144,) and np.all(np.isfinite(preds))
+    ws.clear()

@@ -64,6 +64,7 @@ __all__ = [
     "tracker",
     "serving",
     "lifecycle",
+    "online",
     "train_distributed",
     "plot_importance",
     "plot_tree",
@@ -87,7 +88,7 @@ def __getattr__(name):  # lazy heavy imports
         from . import plotting as _pl
 
         return getattr(_pl, name)
-    if name in ("serving", "lifecycle"):
+    if name in ("serving", "lifecycle", "online"):
         # importlib, not `from . import <pkg>`: the fromlist resolution
         # getattr's the package for the name and would re-enter this hook
         import importlib

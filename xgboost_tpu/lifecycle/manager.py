@@ -54,8 +54,10 @@ __all__ = ["LifecycleConfig", "LifecycleManager", "CycleReport",
 
 
 class ShadowRejected(RuntimeError):
-    """The shadow phase's KS distribution gate refused the candidate
-    (``LifecycleConfig.shadow_max_ks``); carries the comparator stats."""
+    """A shadow-phase distribution gate (KS, PSI, or per-decile
+    calibration — ``LifecycleConfig.shadow_max_ks`` /
+    ``shadow_max_psi`` / ``shadow_max_calibration``) refused the
+    candidate; carries the comparator stats."""
 
     def __init__(self, message: str, stats: Optional[dict] = None) -> None:
         super().__init__(message)
@@ -102,6 +104,11 @@ class LifecycleConfig:
     statistic between candidate and incumbent predictions exceeds this
     (the mean-abs divergence misses rank-reshuffling drift; KS catches
     it).  None disables the check.
+    ``shadow_max_psi`` / ``shadow_max_calibration``: the other two
+    comparator lenses — worst observed population-stability index
+    (broad distribution shift KS's single-gap statistic understates) and
+    worst per-incumbent-decile calibration gap (a candidate re-scoring
+    one decile while matching on average).  None disables each.
     ``retire_keep``: versions kept resident behind the active one
     (>= 1 so rollback is instant).
     """
@@ -114,6 +121,8 @@ class LifecycleConfig:
     shadow_min_pairs: int = 1
     shadow_timeout_s: float = 30.0
     shadow_max_ks: Optional[float] = None
+    shadow_max_psi: Optional[float] = None
+    shadow_max_calibration: Optional[float] = None
     retire_keep: int = 1
 
     def __post_init__(self) -> None:
@@ -303,20 +312,28 @@ class LifecycleManager:
         if cfg.shadow_fraction > 0.0:
             with self._phase("shadow", timings):
                 shadow_stats = self._shadow_phase(version)
-            max_ks = (shadow_stats or {}).get("max_ks")
-            if (cfg.shadow_max_ks is not None and max_ks is not None
-                    and max_ks > cfg.shadow_max_ks):
-                # distribution gate: the candidate redistributes scores
-                # beyond tolerance — drop it and leave the incumbent
-                # serving (deterministic for a fixed traffic replay)
+            # distribution gates, one per comparator lens: the candidate
+            # redistributes scores beyond tolerance — drop it and leave
+            # the incumbent serving (deterministic for a fixed traffic
+            # replay).  KS = worst single ECDF gap, PSI = integrated
+            # shift, calibration = worst per-decile re-scoring.
+            for stat, limit, what in (
+                    ("max_ks", cfg.shadow_max_ks, "KS"),
+                    ("max_psi", cfg.shadow_max_psi, "PSI"),
+                    ("max_cal", cfg.shadow_max_calibration,
+                     "calibration")):
+                val = (shadow_stats or {}).get(stat)
+                if limit is None or val is None or val <= limit:
+                    continue
                 with contextlib.suppress(Exception):
                     self.fleet.retire_version(self.model, version,
                                               trace=trace)
                 self._resident.discard(version)
                 raise ShadowRejected(
-                    f"shadow KS gate: max_ks {max_ks:.6g} > allowed "
-                    f"{cfg.shadow_max_ks:.6g} over "
-                    f"{shadow_stats.get('pairs', 0)} pairs", shadow_stats)
+                    f"shadow {what} gate: {stat} {val:.6g} > allowed "
+                    f"{limit:.6g} over "
+                    f"{(shadow_stats or {}).get('pairs', 0)} pairs",
+                    shadow_stats)
         try:
             # kill here = dead BEFORE the durable commit: the manifest
             # still says incumbent, a fleet restart serves incumbent

@@ -1,0 +1,32 @@
+"""Online learning loop: live-traffic feedback, drift, continuous retraining.
+
+The always-on subsystem that closes the loop from serving back to
+training (docs/online.md):
+
+- :class:`FeedbackHub` — bounded symmetric join of replica-sampled
+  (features, scores) records with late-arriving labels, by trace id;
+- :class:`WindowStore` — the extmem-paged, CRC-framed sliding training
+  window those matches land in (FreshWindow generalized: time/row
+  eviction, pages spill to disk under memory pressure);
+- :class:`DriftDetector` — reference-vs-current KS/PSI over features and
+  served scores, deterministic thresholds, the retrain trigger;
+- :class:`OnlineScheduler` — the loop: pump matches, watch drift, and
+  drive LifecycleManager cycles under the ResourceGovernor (training
+  brownout always yields to serving).
+"""
+from __future__ import annotations
+
+from .drift import DriftConfig, DriftDetector, DriftReport
+from .feedback import FeedbackHub
+from .scheduler import OnlineConfig, OnlineScheduler
+from .windowstore import WindowStore
+
+__all__ = [
+    "DriftConfig",
+    "DriftDetector",
+    "DriftReport",
+    "FeedbackHub",
+    "OnlineConfig",
+    "OnlineScheduler",
+    "WindowStore",
+]

@@ -1,0 +1,187 @@
+"""Drift detection over the live feedback stream.
+
+The retrain trigger half of the online loop: the detector holds a
+REFERENCE sample (the traffic the serving model was last trained/rebased
+on) and a bounded CURRENT sample (what feedback capture is seeing now),
+and compares them with the same distribution machinery the shadow
+comparator uses (``serving/fleet.py``): two-sample KS per feature and on
+the score distribution, plus PSI on the scores.  Three signals, three
+deterministic thresholds — crossing any one raises ``drifted`` and the
+scheduler's "retrain now" edge:
+
+- ``feature_ks``: max over features of KS(reference, current) — the
+  covariate-shift lens (an upstream pipeline change moves the inputs
+  before it moves anything else);
+- ``score_ks``: KS between reference and current SERVED scores — the
+  model's own output distribution drifting under it;
+- ``score_psi``: PSI of current scores against reference deciles — broad
+  shift the single worst ECDF gap understates.
+
+Everything is windowed and counter-based — no PRNG, no wall-clock — so a
+seeded replay of the same feedback schedule produces the same
+DriftReport on the same ``check()`` call (docs/online.md "Determinism
+contract").
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..serving.fleet import _ks_stat, _psi
+from ..telemetry import flight as _flight
+from ..telemetry.registry import get_registry
+
+__all__ = ["DriftConfig", "DriftReport", "DriftDetector"]
+
+_instruments = None
+
+
+def instruments():
+    """xtb_online_drift_total{signal}."""
+    global _instruments
+    if _instruments is None:
+        reg = get_registry()
+        _instruments = reg.counter(
+            "xtb_online_drift_total",
+            "drift threshold crossings, by signal (feature_ks | "
+            "score_ks | score_psi)", ("signal",))
+    return _instruments
+
+
+@dataclasses.dataclass
+class DriftConfig:
+    """Deterministic thresholds.  ``min_rows``: both sides need at least
+    this many rows before any signal can fire (tiny-sample KS is noise).
+    ``current_rows``: bound on the current-sample buffer (newest rows
+    win — drift is about what traffic looks like NOW).  A ``None``
+    threshold disables that signal."""
+
+    max_feature_ks: Optional[float] = 0.25
+    max_score_ks: Optional[float] = 0.2
+    max_score_psi: Optional[float] = 0.25
+    min_rows: int = 64
+    current_rows: int = 8192
+
+    def __post_init__(self) -> None:
+        if self.min_rows < 1:
+            raise ValueError("min_rows must be >= 1")
+        if self.current_rows < self.min_rows:
+            raise ValueError("current_rows must be >= min_rows")
+
+
+@dataclasses.dataclass
+class DriftReport:
+    """One check(): per-signal statistics and which thresholds tripped."""
+
+    drifted: bool
+    triggers: List[str]
+    stats: Dict[str, float]
+    reference_rows: int
+    current_rows: int
+
+
+class DriftDetector:
+    """Reference-vs-current drift over (features, served scores).
+
+    Feed it through :meth:`observe` as matched feedback drains; call
+    :meth:`check` on the scheduler's cadence; :meth:`rebase` after a
+    successful swap (the new model's traffic IS the new reference).
+    Thread-safe — observe runs wherever the scheduler pumps, check on
+    its loop.
+    """
+
+    def __init__(self, config: Optional[DriftConfig] = None,
+                 **overrides) -> None:
+        if config is None:
+            config = DriftConfig(**overrides)
+        elif overrides:
+            config = dataclasses.replace(config, **overrides)
+        self.config = config
+        self._lock = threading.Lock()
+        self._ref_X: Optional[np.ndarray] = None
+        self._ref_s: Optional[np.ndarray] = None
+        self._cur_X: List[np.ndarray] = []
+        self._cur_s: List[np.ndarray] = []
+        self._cur_rows = 0
+
+    def set_reference(self, X, scores) -> None:
+        """Pin the reference sample explicitly (e.g. the training window
+        the serving model came from)."""
+        with self._lock:
+            self._ref_X = np.atleast_2d(np.asarray(X, np.float32))
+            self._ref_s = np.asarray(scores, np.float32).ravel()
+
+    def has_reference(self) -> bool:
+        with self._lock:
+            return self._ref_X is not None
+
+    def observe(self, X, scores) -> None:
+        """One matched feedback batch.  With no reference pinned yet, the
+        first ``min_rows`` observed rows become the reference — the loop
+        self-primes on its own traffic."""
+        X = np.atleast_2d(np.asarray(X, np.float32))
+        s = np.asarray(scores, np.float32).ravel()[:len(X)]
+        with self._lock:
+            if self._ref_X is None:
+                self._cur_X.append(X)
+                self._cur_s.append(s)
+                self._cur_rows += len(X)
+                if self._cur_rows >= self.config.min_rows:
+                    self._ref_X = np.concatenate(self._cur_X, axis=0)
+                    self._ref_s = np.concatenate(self._cur_s)
+                    self._cur_X, self._cur_s, self._cur_rows = [], [], 0
+                return
+            self._cur_X.append(X)
+            self._cur_s.append(s)
+            self._cur_rows += len(X)
+            # newest-rows-win bound on the current sample
+            while (self._cur_rows - len(self._cur_X[0])
+                   >= self.config.current_rows):
+                self._cur_rows -= len(self._cur_X[0])
+                self._cur_X.pop(0)
+                self._cur_s.pop(0)
+
+    def rebase(self) -> None:
+        """Current becomes reference (post-swap: the freshly trained
+        model's recent traffic is the new normal); current resets."""
+        with self._lock:
+            if self._cur_rows:
+                self._ref_X = np.concatenate(self._cur_X, axis=0)
+                self._ref_s = np.concatenate(self._cur_s)
+            self._cur_X, self._cur_s, self._cur_rows = [], [], 0
+
+    def check(self) -> DriftReport:
+        cfg = self.config
+        with self._lock:
+            ref_X, ref_s = self._ref_X, self._ref_s
+            cur_rows = self._cur_rows
+            cur_X = (np.concatenate(self._cur_X, axis=0)
+                     if self._cur_X else None)
+            cur_s = (np.concatenate(self._cur_s)
+                     if self._cur_s else None)
+        ref_rows = 0 if ref_X is None else len(ref_X)
+        if (ref_X is None or cur_X is None
+                or ref_rows < cfg.min_rows or cur_rows < cfg.min_rows):
+            return DriftReport(False, [], {}, ref_rows, cur_rows)
+        stats: Dict[str, float] = {}
+        stats["feature_ks"] = max(
+            (_ks_stat(ref_X[:, j], cur_X[:, j])
+             for j in range(min(ref_X.shape[1], cur_X.shape[1]))),
+            default=0.0)
+        stats["score_ks"] = _ks_stat(ref_s, cur_s)
+        stats["score_psi"] = _psi(ref_s, cur_s)
+        triggers = [
+            name for name, limit in (
+                ("feature_ks", cfg.max_feature_ks),
+                ("score_ks", cfg.max_score_ks),
+                ("score_psi", cfg.max_score_psi))
+            if limit is not None and stats[name] > limit]
+        for name in triggers:
+            instruments().labels(name).inc()
+            _flight.record("event", "online.drift", signal=name,
+                           value=stats[name])
+        return DriftReport(bool(triggers), triggers, stats, ref_rows,
+                           cur_rows)

@@ -1,0 +1,208 @@
+"""Label join: pair sampled requests with their late-arriving labels.
+
+The serving half of the online loop ships sampled (features, scores)
+records driver-side (``op="feedback"`` frames, docs/online.md); labels
+for those requests arrive later, from a different producer, keyed by the
+same trace id the dispatcher stamped at submit.  :class:`FeedbackHub` is
+the bounded symmetric join between the two streams:
+
+- features arriving before their label wait in the pending-features map;
+  labels arriving before their features wait in the pending-labels map
+  (the join is symmetric because neither ordering is guaranteed — a
+  feedback frame rides the replica's serialized socket behind in-flight
+  predicts, a label can land the moment the caller's future resolves);
+- a pair that meets inside the ``horizon_s`` join horizon is matched and
+  queued for :meth:`drain`;
+- anything that waits past the horizon, or overflows ``max_pending``, is
+  DROPPED AND COUNTED (``xtb_online_join_dropped_total{reason}``) — the
+  window trains on what actually joined, and the drop counters are the
+  online loop's data-loss budget, never a silent shortfall.
+
+Thread-safe: ``offer`` runs on fleet rx threads, ``label`` on whatever
+thread the label producer owns, ``drain`` on the scheduler's.  The
+``online.label_join`` fault seam fires inside :meth:`label` — an injected
+exception is a dropped label (reason ``fault``), exercising the loop's
+tolerance to a flaky label pipeline.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..reliability import faults as _faults
+from ..telemetry import flight as _flight
+from ..telemetry.registry import get_registry
+
+__all__ = ["FeedbackHub"]
+
+_instruments = None
+
+
+def instruments():
+    """(matched, dropped, pending) xtb_online_join_* families."""
+    global _instruments
+    if _instruments is None:
+        reg = get_registry()
+        _instruments = (
+            reg.counter("xtb_online_join_matched_total",
+                        "feedback records joined with their label",
+                        ("model",)),
+            reg.counter("xtb_online_join_dropped_total",
+                        "join casualties by reason (expired past the "
+                        "horizon, capacity overflow, label-pipeline "
+                        "fault, duplicate trace)", ("reason",)),
+            reg.gauge("xtb_online_join_pending",
+                      "records waiting for their other half "
+                      "(features + labels)"),
+        )
+    return _instruments
+
+
+class FeedbackHub:
+    """Bounded two-sided join of feedback records and labels by trace id.
+
+    ``horizon_s``: how long either half waits for the other.
+    ``max_pending``: cap on EACH side's waiting map — beyond it the
+    oldest entry on that side is dropped (reason ``capacity``).
+    ``clock``: injectable monotonic clock (tests age entries without
+    sleeping).
+    """
+
+    def __init__(self, horizon_s: float = 60.0, max_pending: int = 4096,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if horizon_s <= 0:
+            raise ValueError(f"horizon_s must be > 0, got {horizon_s}")
+        if max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+        self.horizon_s = float(horizon_s)
+        self.max_pending = int(max_pending)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # trace -> (t_arrival, record) / (t_arrival, y); insertion order =
+        # arrival order, so expiry and capacity both pop from the front
+        self._features: "OrderedDict[str, tuple]" = OrderedDict()
+        self._labels: "OrderedDict[str, tuple]" = OrderedDict()
+        self._matched: List[dict] = []
+        self.offered = 0
+        self.labeled = 0
+        self.matched = 0
+        self.dropped: Dict[str, int] = {}
+
+    # ------------------------------------------------------------- internals
+    def _drop_locked(self, reason: str, n: int = 1) -> None:
+        self.dropped[reason] = self.dropped.get(reason, 0) + n
+        instruments()[1].labels(reason).inc(float(n))
+
+    def _expire_locked(self, now: float) -> None:
+        cutoff = now - self.horizon_s
+        for side in (self._features, self._labels):
+            while side:
+                trace, (t, _) = next(iter(side.items()))
+                if t >= cutoff:
+                    break
+                side.pop(trace)
+                self._drop_locked("expired")
+
+    def _cap_locked(self, side: "OrderedDict[str, tuple]") -> None:
+        while len(side) > self.max_pending:
+            side.popitem(last=False)
+            self._drop_locked("capacity")
+
+    def _match_locked(self, record: dict, y) -> None:
+        out = dict(record)
+        out["y"] = np.asarray(y, np.float32).reshape(-1)
+        self._matched.append(out)
+        self.matched += 1
+        instruments()[0].labels(str(record.get("model"))).inc()
+
+    def _gauge_locked(self) -> None:
+        instruments()[2].set(len(self._features) + len(self._labels))
+
+    # ------------------------------------------------------------------- API
+    def offer(self, record: dict) -> None:
+        """One decoded feedback record (the fleet's sink calls this on an
+        rx thread).  Joins immediately when its label already waits."""
+        trace = record.get("trace")
+        if not trace:
+            with self._lock:
+                self._drop_locked("untraced")
+            return
+        now = self._clock()
+        with self._lock:
+            self._expire_locked(now)
+            self.offered += 1
+            pending = self._labels.pop(trace, None)
+            if pending is not None:
+                self._match_locked(record, pending[1])
+            elif trace in self._features:
+                # a duplicate sample for the same request (reroute after a
+                # replica death can re-execute a sampled request): keep the
+                # first, count the twin — matching both would double-weight
+                # the row in the window
+                self._drop_locked("duplicate")
+            else:
+                self._features[trace] = (now, record)
+                self._cap_locked(self._features)
+            self._gauge_locked()
+
+    def label(self, trace: Optional[str], y) -> bool:
+        """One label for ``trace`` (``Future.trace_id`` from submit).
+        Returns True when it matched a waiting feedback record, False when
+        it is itself now waiting (or was dropped).  The
+        ``online.label_join`` seam makes an injected exception a dropped
+        label — the loop's flaky-label-pipeline fault point."""
+        if not trace:
+            with self._lock:
+                self._drop_locked("untraced")
+            return False
+        try:
+            _faults.maybe_inject("online.label_join")
+        except _faults.FaultInjected as e:
+            _flight.record("fault", "online.label_join", trace=trace,
+                           error=str(e))
+            with self._lock:
+                self._drop_locked("fault")
+            return False
+        now = self._clock()
+        with self._lock:
+            self._expire_locked(now)
+            self.labeled += 1
+            pending = self._features.pop(trace, None)
+            if pending is not None:
+                self._match_locked(pending[1], y)
+                self._gauge_locked()
+                return True
+            if trace in self._labels:
+                self._drop_locked("duplicate")
+            else:
+                self._labels[trace] = (now, y)
+                self._cap_locked(self._labels)
+            self._gauge_locked()
+            return False
+
+    def drain(self) -> List[dict]:
+        """Take every matched pair accumulated since the last drain (each
+        a feedback record dict plus its ``y``), in match order."""
+        with self._lock:
+            out, self._matched = self._matched, []
+            return out
+
+    def pending(self) -> Dict[str, int]:
+        with self._lock:
+            return {"features": len(self._features),
+                    "labels": len(self._labels),
+                    "matched": len(self._matched)}
+
+    def stats(self) -> Dict[str, Any]:
+        """Join accounting: offered + labeled = matched*2 + dropped +
+        still-pending, the loop's conservation law (asserted by the chaos
+        scenario's join invariant)."""
+        with self._lock:
+            return {"offered": self.offered, "labeled": self.labeled,
+                    "matched": self.matched, "dropped": dict(self.dropped),
+                    "pending_features": len(self._features),
+                    "pending_labels": len(self._labels)}

@@ -1,0 +1,283 @@
+"""OnlineScheduler: the always-on loop that closes serving back to training.
+
+One object owns the whole feedback cycle (docs/online.md):
+
+    traffic -> replicas sample 1-in-N -> FeedbackHub joins labels
+            -> WindowStore (extmem-paged)  +  DriftDetector
+            -> [drift edge or forced]      -> LifecycleManager.run_cycle
+            -> gate -> shadow -> hot swap  -> detector rebase
+
+The scheduler never trains when serving needs the host: the
+ResourceGovernor is consulted FIRST on every retrain decision, and any
+active brownout (or memory level >= 2) defers the cycle outright
+(``xtb_online_deferred_total{reason}``) — a continuation retrain is the
+single most expendable load on a degraded host, and the gold tenant's p99
+never pays for it (docs/reliability.md "Resource pressure & graceful
+degradation").
+
+The ``online.retrain`` fault seam fires at the decision point, before
+any lifecycle work: an injected exception is a cycle that never started
+(outcome ``fault``), the incumbent untouched — the same incumbent-safety
+contract every lifecycle reject path keeps.
+
+Deterministic by construction: sampling is a counter off the trace id,
+the join is horizon-bounded but clock-injectable, drift thresholds are
+fixed numbers, and the lifecycle cycle under a fixed window is the
+continuation-training determinism the lifecycle tests already pin — so
+a seeded replay of the same request + label schedule retrains the same
+model (the ``online`` chaos scenario's digest check).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..lifecycle.manager import LifecycleConfig, LifecycleManager
+from ..reliability import faults as _faults
+from ..reliability import resources as _resources
+from ..telemetry import flight as _flight
+from ..telemetry.registry import get_registry
+from .drift import DriftConfig, DriftDetector
+from .feedback import FeedbackHub
+from .windowstore import WindowStore
+
+__all__ = ["OnlineConfig", "OnlineScheduler"]
+
+_CYCLE_BUCKETS = tuple(0.01 * (2.0 ** i) for i in range(14))
+
+_instruments = None
+
+
+def instruments():
+    """(cycles, deferred, cycle seconds) xtb_online_* families."""
+    global _instruments
+    if _instruments is None:
+        reg = get_registry()
+        _instruments = (
+            reg.counter("xtb_online_cycles_total",
+                        "retrain cycles by outcome (swapped | the "
+                        "lifecycle reject reason | fault)", ("outcome",)),
+            reg.counter("xtb_online_deferred_total",
+                        "retrain decisions deferred, by reason "
+                        "(brownout | memory | rows | no_drift)",
+                        ("reason",)),
+            reg.histogram("xtb_online_cycle_seconds",
+                          "wall-clock per attempted retrain cycle",
+                          buckets=_CYCLE_BUCKETS),
+        )
+    return _instruments
+
+
+@dataclasses.dataclass
+class OnlineConfig:
+    """Loop knobs.
+
+    ``sample_every``: replica-side 1-in-N feedback capture rate.
+    ``join_horizon_s`` / ``max_pending``: the label join's bounds.
+    ``min_retrain_rows``: window floor before any cycle may run.
+    ``window_rows`` / ``window_age_s`` / ``page_rows`` / ``spool_dir``:
+    the WindowStore's bounds (see :class:`WindowStore`).
+    ``extmem_chunk_rows``: truthy routes each cycle's window through the
+    ExtMemQuantileDMatrix streaming path (the window-exceeds-RAM mode).
+    ``drift`` / ``lifecycle``: the detector's thresholds and the
+    continuation cycle's knobs (gate, shadow phase, checkpointing).
+    """
+
+    sample_every: int = 8
+    join_horizon_s: float = 60.0
+    max_pending: int = 4096
+    min_retrain_rows: int = 256
+    window_rows: Optional[int] = 100_000
+    window_age_s: Optional[float] = None
+    page_rows: int = 1024
+    spool_dir: Optional[str] = None
+    extmem_chunk_rows: int = 0
+    max_bin: int = 256
+    drift: DriftConfig = dataclasses.field(default_factory=DriftConfig)
+    lifecycle: LifecycleConfig = dataclasses.field(
+        default_factory=LifecycleConfig)
+
+    def __post_init__(self) -> None:
+        if self.sample_every < 1:
+            raise ValueError("sample_every must be >= 1")
+        if self.min_retrain_rows < 1:
+            raise ValueError("min_retrain_rows must be >= 1")
+
+
+class OnlineScheduler:
+    """Drive the closed loop for one model over a running fleet.
+
+    Construction wires nothing: call :meth:`enable` to start feedback
+    capture (broadcasts the sample rate, registers the fleet sink), feed
+    labels through :meth:`label`, and either call :meth:`step` on your
+    own cadence (tests, smoke scripts — deterministic) or hand a stop
+    event to :meth:`run` for the always-on thread loop.
+    """
+
+    def __init__(self, fleet, model: str,
+                 config: Optional[OnlineConfig] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 clock=time.monotonic, **overrides) -> None:
+        if config is None:
+            config = OnlineConfig(**overrides)
+        elif overrides:
+            config = dataclasses.replace(config, **overrides)
+        self.fleet = fleet
+        self.model = model
+        self.config = config
+        self._params = params
+        self.hub = FeedbackHub(horizon_s=config.join_horizon_s,
+                               max_pending=config.max_pending, clock=clock)
+        self.window = WindowStore(max_rows=config.window_rows,
+                                  max_age_s=config.window_age_s,
+                                  page_rows=config.page_rows,
+                                  spool_dir=config.spool_dir, clock=clock)
+        self.detector = DriftDetector(config.drift)
+        # the LifecycleManager binds to the fleet's store at first use,
+        # not construction: pumping/joining/drift-checking must work
+        # against a bare fleet (and in unit tests with a stub)
+        self._mgr: Optional[LifecycleManager] = None
+        self._lock = threading.Lock()
+        self._enabled = False
+        self.cycles = 0
+        self.swaps = 0
+
+    # ------------------------------------------------------------- capture
+    def _on_feedback(self, record: dict) -> None:
+        if record.get("model") == self.model:
+            self.hub.offer(record)
+
+    def enable(self) -> None:
+        """Turn the loop's intake on: broadcast the sample rate, register
+        the feedback sink."""
+        self.fleet.set_feedback_sink(self._on_feedback)
+        self.fleet.set_sampling(self.model, self.config.sample_every)
+        with self._lock:
+            self._enabled = True
+        _flight.record("event", "online.enable", model=self.model,
+                       every=self.config.sample_every)
+
+    def disable(self) -> None:
+        with self._lock:
+            was = self._enabled
+            self._enabled = False
+        if was:
+            self.fleet.set_sampling(self.model, 0)
+            self.fleet.set_feedback_sink(None)
+
+    def label(self, trace: Optional[str], y) -> bool:
+        """Label one request by its trace id (``Future.trace_id``)."""
+        return self.hub.label(trace, y)
+
+    def pump(self) -> int:
+        """Drain matched (features, label) pairs into the window and the
+        drift detector; returns rows absorbed."""
+        rows = 0
+        for rec in self.hub.drain():
+            X, y = rec["X"], rec["y"]
+            n = min(len(X), len(y))
+            self.window.append(X[:n], y[:n])
+            self.detector.observe(X[:n], rec.get("scores"))
+            rows += n
+        return rows
+
+    # -------------------------------------------------------------- retrain
+    def _manager(self) -> LifecycleManager:
+        with self._lock:
+            if self._mgr is None:
+                self._mgr = LifecycleManager(self.fleet, self.model,
+                                             params=self._params,
+                                             config=self.config.lifecycle)
+            return self._mgr
+
+    def _defer(self, reason: str, **detail) -> Dict[str, Any]:
+        instruments()[1].labels(reason).inc()
+        _flight.record("event", "online.defer", model=self.model,
+                       reason=reason, **detail)
+        return {"outcome": "deferred", "reason": reason, **detail}
+
+    def maybe_retrain(self, force: bool = False) -> Dict[str, Any]:
+        """One retrain decision.  Order is the contract: governor first
+        (training yields to serving), then the window floor, then the
+        drift edge (unless ``force``), then — and only then — a
+        lifecycle cycle."""
+        gov = _resources.get_governor()
+        if gov.level("memory") >= 2:
+            # memory collapse outranks the generic brownout (any level >=1
+            # raises the cutoff): name the real reason, not the symptom
+            return self._defer("memory", level=gov.level("memory"))
+        if gov.brownout_cutoff() is not None:
+            # serving is shedding load: a discretionary retrain is the
+            # last thing this host should start
+            return self._defer("brownout", level=gov.max_level())
+        rows = len(self.window)
+        if rows < self.config.min_retrain_rows:
+            return self._defer("rows", rows=rows,
+                               need=self.config.min_retrain_rows)
+        drift = None
+        if not force:
+            drift = self.detector.check()
+            if not drift.drifted:
+                instruments()[1].labels("no_drift").inc()
+                return {"outcome": "idle", "drift": drift.stats}
+        t0 = time.perf_counter()
+        with self._lock:
+            self.cycles += 1
+        try:
+            _faults.maybe_inject("online.retrain")
+        except _faults.FaultInjected as e:
+            # the cycle never starts: incumbent untouched, counted as a
+            # faulted cycle — same outcome accounting a lifecycle-phase
+            # fault lands on
+            instruments()[0].labels("fault").inc()
+            _flight.record("fault", "online.retrain", model=self.model,
+                           error=str(e))
+            return {"outcome": "fault", "error": str(e)}
+        _flight.record("event", "online.retrain", model=self.model,
+                       rows=rows,
+                       triggers=list(drift.triggers) if drift else None,
+                       forced=bool(force))
+        dwin = self.window.to_dmatrix(
+            extmem_chunk_rows=self.config.extmem_chunk_rows or None,
+            max_bin=self.config.max_bin)
+        report = self._manager().run_cycle(dwin)
+        seconds = time.perf_counter() - t0
+        outcome = ("swapped" if report.swapped
+                   else (report.decision.reason if report.decision
+                         else "rejected"))
+        instruments()[0].labels(outcome).inc()
+        instruments()[2].observe(seconds)
+        if report.swapped:
+            with self._lock:
+                self.swaps += 1
+            # the freshly swapped model's recent traffic is the new
+            # normal: without the rebase the same drift would retrigger
+            # every cycle forever
+            self.detector.rebase()
+        _flight.record("event", "online.cycle", model=self.model,
+                       outcome=outcome, seconds=seconds,
+                       version=report.candidate_version,
+                       trace=report.trace_id)
+        return {"outcome": outcome, "report": report, "seconds": seconds,
+                "drift": drift.stats if drift else None}
+
+    def step(self, force: bool = False) -> Dict[str, Any]:
+        """One deterministic loop iteration: pump, then decide."""
+        pumped = self.pump()
+        out = self.maybe_retrain(force=force)
+        out["pumped_rows"] = pumped
+        return out
+
+    def run(self, stop: threading.Event, tick_s: float = 1.0) -> None:
+        """The always-on loop: step every ``tick_s`` until ``stop`` is
+        set.  Exceptions are recorded and the loop keeps going — an
+        online loop that dies on one bad cycle silently stops learning."""
+        while not stop.is_set():
+            try:
+                self.step()
+            except Exception as e:  # keep the loop alive
+                _flight.record("fault", "online.loop", model=self.model,
+                               error=str(e))
+            stop.wait(tick_s)

@@ -1,0 +1,371 @@
+"""WindowStore: the extmem-paged, CRC-framed sliding training window.
+
+:class:`~xgboost_tpu.lifecycle.window.FreshWindow` keeps every row as a
+live numpy array — fine for a window that fits in RAM, a cap on how much
+live traffic the online loop can learn from otherwise.  WindowStore
+generalizes it with the out-of-core page machinery (arXiv:2005.09148,
+``data/extmem.py``): appended rows stage in a small buffer, seal into
+fixed-size pages packed ``[X | y | w]``, and each sealed page becomes a
+:class:`~xgboost_tpu.data.extmem.CompressedPage` (zstd in RAM) or — when
+zstandard is absent, or the ResourceGovernor reports memory pressure — a
+CRC-gated :class:`~xgboost_tpu.data.extmem.DiskPage` spill.  Every page
+read passes the pages' CRC-verify / retry-once / fail-loud gate, so a
+bit-flip in a week-old window page is a detected corruption, not a
+silently poisoned retrain.
+
+Eviction is time- and row-bounded at whole-page granularity: the oldest
+page falls off while the window exceeds ``max_rows`` (bounded overshoot
+of at most one page of rows) or once its newest row ages past
+``max_age_s``.  Under memory pressure (``memory_scale() < 1.0``) resident
+pages spill to disk and new pages seal straight there — the window sheds
+RAM before the governor has to shed anything that serves
+(docs/reliability.md "Resource pressure & graceful degradation").
+
+``to_dmatrix`` mirrors FreshWindow's contract: an in-memory DMatrix by
+default, or the ExtMemQuantileDMatrix streaming route with
+``extmem_chunk_rows`` set — one window page per extmem chunk, so a window
+larger than RAM trains without ever being concatenated.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..reliability import resources as _resources
+from ..telemetry.registry import get_registry
+
+__all__ = ["WindowStore"]
+
+_instruments = None
+
+
+def instruments():
+    """(rows gauge, pages gauge, evicted, spilled bytes)
+    xtb_online_window_* families."""
+    global _instruments
+    if _instruments is None:
+        reg = get_registry()
+        _instruments = (
+            reg.gauge("xtb_online_window_rows",
+                      "labeled rows currently in the sliding training "
+                      "window (sealed pages + staging)"),
+            reg.gauge("xtb_online_window_pages",
+                      "sealed window pages currently held"),
+            reg.counter("xtb_online_window_evicted_total",
+                        "window rows evicted, by bound (rows | age)",
+                        ("reason",)),
+            reg.counter("xtb_online_window_spilled_bytes_total",
+                        "window page bytes spilled to disk under memory "
+                        "pressure (or sealed there without zstandard)"),
+        )
+    return _instruments
+
+
+class _PageRec:
+    """One sealed page: the CRC-framed page object plus the bookkeeping
+    eviction and spill need (rows, arrival times, backing path)."""
+
+    __slots__ = ("page", "rows", "t_first", "t_last", "path")
+
+    def __init__(self, page, rows: int, t_first: float, t_last: float,
+                 path: Optional[str]) -> None:
+        self.page = page
+        self.rows = rows
+        self.t_first = t_first
+        self.t_last = t_last
+        self.path = path
+
+
+def _store_iter(blocks: List[np.ndarray], weighted: bool):
+    """DataIter over decoded packed blocks — one window page per extmem
+    chunk (lazy extmem import keeps WindowStore importable without the
+    paged-training stack loaded)."""
+    from ..data.extmem import DataIter
+
+    class _StoreIter(DataIter):
+        def __init__(self) -> None:
+            super().__init__()
+            self._i = 0
+
+        def next(self, input_data) -> bool:
+            if self._i >= len(blocks):
+                return False
+            flat = blocks[self._i]
+            F = flat.shape[1] - 2
+            batch = {"data": flat[:, :F], "label": flat[:, F]}
+            if weighted:
+                batch["weight"] = flat[:, F + 1]
+            input_data(**batch)
+            self._i += 1
+            return True
+
+        def reset(self) -> None:
+            self._i = 0
+
+    return _StoreIter()
+
+
+class WindowStore:
+    """Extmem-paged sliding window of labeled (rows, labels[, weights]).
+
+    ``max_rows``: row bound (whole-page eviction; None = unbounded).
+    ``max_age_s``: age bound on a page's NEWEST row (None = no age bound).
+    ``page_rows``: rows per sealed page (also the extmem chunk size).
+    ``spool_dir``: where spilled pages live (None = private temp dir,
+    removed on :meth:`clear`).
+    ``clock``: injectable monotonic clock (tests age pages without
+    sleeping).
+    """
+
+    def __init__(self, max_rows: Optional[int] = None,
+                 max_age_s: Optional[float] = None,
+                 page_rows: int = 1024,
+                 spool_dir: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if page_rows < 1:
+            raise ValueError(f"page_rows must be >= 1, got {page_rows}")
+        self.max_rows = int(max_rows) if max_rows else None
+        self.max_age_s = float(max_age_s) if max_age_s else None
+        self.page_rows = int(page_rows)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._pages: "deque[_PageRec]" = deque()
+        self._staging: List[np.ndarray] = []   # packed (r, F+2) blocks
+        self._staging_rows = 0
+        self._staging_t: List[float] = []      # arrival time per block
+        self._num_features: Optional[int] = None
+        self._weighted: Optional[bool] = None
+        self._spool = spool_dir
+        self._own_spool = spool_dir is None
+        self._page_seq = 0
+        self._spilled_bytes = 0
+
+    # ------------------------------------------------------------- internals
+    def _spool_path(self) -> str:
+        if self._spool is None:
+            self._spool = tempfile.mkdtemp(prefix="xtb_window_")
+        else:
+            os.makedirs(self._spool, exist_ok=True)
+        self._page_seq += 1
+        return os.path.join(self._spool, f"page{self._page_seq:06d}.npy")
+
+    def _make_page(self, arr: np.ndarray, spill: bool):
+        """Seal one packed block: zstd-compressed in RAM on the happy
+        path, CRC-gated disk spill under pressure or without zstandard.
+        Returns (page, path-or-None)."""
+        from ..data.extmem import CompressedPage, DiskPage, _zstd_available
+
+        if _zstd_available() and not spill:
+            return CompressedPage(arr), None
+        path = self._spool_path()
+        if _zstd_available():
+            page = CompressedPage(arr, path=path)
+            spilled = page.nbytes_compressed
+        else:
+            page = DiskPage(arr, path)
+            spilled = page.nbytes
+        self._spilled_bytes += int(spilled)
+        instruments()[3].inc(float(spilled))
+        return page, path
+
+    def _seal_locked(self, spill: bool) -> None:
+        if not self._staging:
+            return
+        arr = (self._staging[0] if len(self._staging) == 1
+               else np.concatenate(self._staging, axis=0))
+        rec = _PageRec(None, int(len(arr)),
+                       self._staging_t[0], self._staging_t[-1], None)
+        rec.page, rec.path = self._make_page(np.ascontiguousarray(arr),
+                                             spill)
+        self._pages.append(rec)
+        self._staging, self._staging_t, self._staging_rows = [], [], 0
+
+    def _drop_page_locked(self, reason: str) -> None:
+        rec = self._pages.popleft()
+        instruments()[2].labels(reason).inc(float(rec.rows))
+        if rec.path is not None:
+            try:
+                os.unlink(rec.path)
+            except FileNotFoundError:
+                pass
+            except OSError as e:
+                _resources.note_os_error(e, "online.window_unlink")
+
+    def _evict_locked(self, now: float) -> None:
+        if self.max_age_s is not None:
+            cutoff = now - self.max_age_s
+            while self._pages and self._pages[0].t_last < cutoff:
+                self._drop_page_locked("age")
+        if self.max_rows is not None:
+            while self._pages and self._rows_locked() > self.max_rows:
+                self._drop_page_locked("rows")
+
+    def _rows_locked(self) -> int:
+        return sum(r.rows for r in self._pages) + self._staging_rows
+
+    def _gauges_locked(self) -> None:
+        ins = instruments()
+        ins[0].set(self._rows_locked())
+        ins[1].set(len(self._pages))
+
+    def _spill_resident_locked(self) -> int:
+        """Move every RAM-resident page behind a disk path (decode once,
+        re-seal spilled); returns pages moved.  The governor's
+        memory-pressure response: the window gives its RAM back before
+        anything that serves traffic degrades."""
+        moved = 0
+        for rec in self._pages:
+            if rec.path is not None:
+                continue
+            arr = np.asarray(rec.page)
+            rec.page, rec.path = self._make_page(arr, spill=True)
+            moved += 1
+        return moved
+
+    # ------------------------------------------------------------------- API
+    def append(self, X, y, weight=None) -> None:
+        """Append one labeled batch.  Same validation contract as
+        FreshWindow: row/label/weight lengths agree, and either every
+        batch carries weights or none does."""
+        X = np.atleast_2d(np.asarray(X, np.float32))
+        y = np.asarray(y, np.float32).reshape(-1)
+        if len(X) != len(y):
+            raise ValueError(f"rows ({len(X)}) != labels ({len(y)})")
+        if weight is not None:
+            weight = np.asarray(weight, np.float32).reshape(-1)
+            if len(weight) != len(y):
+                raise ValueError("weight length != label length")
+        weighted = weight is not None
+        w = weight if weighted else np.ones(len(y), np.float32)
+        block = np.concatenate(
+            [X, y[:, None], w[:, None]], axis=1).astype(np.float32)
+        now = self._clock()
+        spill = _resources.get_governor().memory_scale() < 1.0
+        with self._lock:
+            if self._num_features is None:
+                self._num_features = int(X.shape[1])
+            elif int(X.shape[1]) != self._num_features:
+                raise ValueError(
+                    f"batch has {X.shape[1]} features, window holds "
+                    f"{self._num_features}")
+            if self._weighted is None:
+                self._weighted = weighted
+            elif weighted != self._weighted:
+                raise ValueError(
+                    "either every batch carries weights or none")
+            self._staging.append(block)
+            self._staging_t.append(now)
+            self._staging_rows += len(block)
+            if spill and any(r.path is None for r in self._pages):
+                moved = self._spill_resident_locked()
+                if moved:
+                    _resources.degraded_event("online", "window_spill",
+                                              pages=moved)
+            while self._staging_rows >= self.page_rows:
+                # seal exactly page_rows per page so the extmem chunk
+                # size (and so the quantile sketch schedule) is stable
+                # whatever batch sizes arrived
+                flat = (self._staging[0] if len(self._staging) == 1
+                        else np.concatenate(self._staging, axis=0))
+                head, tail = flat[:self.page_rows], flat[self.page_rows:]
+                t_head = self._staging_t[0]
+                self._staging = [np.ascontiguousarray(head)]
+                self._staging_t = [t_head]
+                self._staging_rows = len(head)
+                self._seal_locked(spill)
+                if len(tail):
+                    self._staging = [np.ascontiguousarray(tail)]
+                    self._staging_t = [now]
+                    self._staging_rows = len(tail)
+            self._evict_locked(now)
+            self._gauges_locked()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._rows_locked()
+
+    @property
+    def rows(self) -> int:
+        return len(self)
+
+    @property
+    def num_pages(self) -> int:
+        with self._lock:
+            return len(self._pages)
+
+    @property
+    def spilled_bytes(self) -> int:
+        with self._lock:
+            return self._spilled_bytes
+
+    def _blocks(self) -> List[np.ndarray]:
+        """Decoded packed blocks, oldest first (each read CRC-gated by
+        the page machinery)."""
+        with self._lock:
+            recs = list(self._pages)
+            staging = list(self._staging)
+        out = [np.asarray(r.page) for r in recs]
+        out.extend(staging)
+        return out
+
+    def arrays(self):
+        """(X, y, weight-or-None) concatenated — the small-window path."""
+        blocks = self._blocks()
+        if not blocks:
+            raise ValueError("WindowStore is empty")
+        flat = (blocks[0] if len(blocks) == 1
+                else np.concatenate(blocks, axis=0))
+        F = flat.shape[1] - 2
+        w = flat[:, F + 1] if self._weighted else None
+        return np.ascontiguousarray(flat[:, :F]), flat[:, F], w
+
+    def to_dmatrix(self, extmem_chunk_rows: Optional[int] = None,
+                   max_bin: int = 256, **kw):
+        """Materialize the window for a continuation cycle.  Default: an
+        in-memory DMatrix.  With ``extmem_chunk_rows`` (any truthy value —
+        the chunk IS the page) the window streams page-by-page into an
+        ExtMemQuantileDMatrix, never concatenated: the window-exceeds-RAM
+        path."""
+        if extmem_chunk_rows:
+            from ..data.extmem import ExtMemQuantileDMatrix
+
+            it = _store_iter(self._blocks(), bool(self._weighted))
+            return ExtMemQuantileDMatrix(it, max_bin=max_bin, **kw)
+        from ..data.dmatrix import DMatrix
+
+        X, y, w = self.arrays()
+        return DMatrix(X, label=y, weight=w, **kw)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            on_disk = sum(1 for r in self._pages if r.path is not None)
+            return {"rows": self._rows_locked(),
+                    "pages": len(self._pages),
+                    "pages_on_disk": on_disk,
+                    "staging_rows": self._staging_rows,
+                    "spilled_bytes": self._spilled_bytes}
+
+    def clear(self) -> None:
+        """Drop every page and staging row; removes spilled page files
+        (and the private spool dir when this store created it)."""
+        with self._lock:
+            while self._pages:
+                rec = self._pages.popleft()
+                if rec.path is not None:
+                    try:
+                        os.unlink(rec.path)
+                    except OSError:
+                        pass
+            self._staging, self._staging_t, self._staging_rows = [], [], 0
+            self._gauges_locked()
+            if self._own_spool and self._spool is not None:
+                import shutil
+
+                shutil.rmtree(self._spool, ignore_errors=True)
+                self._spool = None

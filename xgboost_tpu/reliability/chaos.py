@@ -404,6 +404,132 @@ def _check_lifecycle(fired, artifacts, baseline) -> Dict[str, str]:
     return inv
 
 
+# ------------------------------------------------------------------ online
+_N_ONLINE_BASE = 6      # 16-row requests of reference-distribution traffic
+_N_ONLINE_SHIFT = 12    # 16-row requests of shifted traffic (forces drift)
+
+
+def _run_online(workdir: str) -> dict:
+    """The closed loop under fault: serve live traffic with feedback
+    sampling on, join deterministic labels by trace id, let the drift
+    detector trip on a distribution shift, and run the retrain cycle —
+    all while the plan's faults fire at the join, the retrain decision,
+    and the lifecycle gate."""
+    import numpy as np
+
+    import xgboost_tpu as xtb
+    from ..lifecycle import GateConfig, LifecycleConfig
+    from ..online import DriftConfig, OnlineConfig, OnlineScheduler
+    from ..serving.fleet import FleetConfig, ServingFleet
+    from ..serving.modelstore import ModelStore
+
+    bst, Q = _fleet_fixture()
+    rng = np.random.default_rng(23)
+    blocks = [rng.standard_normal((16, 6)).astype(np.float32)
+              for _ in range(_N_ONLINE_BASE)]
+    blocks += [(rng.standard_normal((16, 6)) + 4.0).astype(np.float32)
+               for _ in range(_N_ONLINE_SHIFT)]
+    cfg = FleetConfig(n_replicas=1, max_respawns=2, nthread_per_replica=1,
+                      cache_dir=os.path.join(
+                          tempfile.gettempdir(), "xtb_chaos_warm"))
+    with ServingFleet({"m": bst}, cfg) as fleet:
+        sch = OnlineScheduler(fleet, "m", config=OnlineConfig(
+            sample_every=1, join_horizon_s=600.0, min_retrain_rows=128,
+            window_rows=4096, page_rows=64,
+            spool_dir=os.path.join(workdir, "window"),
+            drift=DriftConfig(min_rows=48, max_feature_ks=0.3),
+            lifecycle=LifecycleConfig(
+                rounds_per_cycle=2,
+                gate=GateConfig(min_improvement=-1e9))))
+        sch.enable()
+        traces: List[str] = []
+        completed = 0
+        for rows in blocks:
+            fut = fleet.submit("m", rows)
+            traces.append(fut.trace_id)
+            fut.result(timeout=180)
+            completed += 1
+        # feedback frames ride the replica socket BEHIND each result, so
+        # the last one may still be in flight when the last predict
+        # resolves — wait for the intake to settle before labeling
+        deadline = time.monotonic() + 60.0
+        while (sch.hub.stats()["offered"] < len(traces)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        for tr, rows in zip(traces, blocks):
+            sch.label(tr, (rows[:, 0] - rows[:, 2] > 0).astype(np.float32))
+        out = sch.step()
+        outcome = str(out["outcome"])
+        join = sch.hub.stats()
+        window_rows = len(sch.window)
+        # sampling off BEFORE the verification serve: its requests must
+        # not race fresh feedback frames into the join accounting
+        sch.disable()
+        served = np.ascontiguousarray(
+            fleet.predict("m", Q, timeout=180), np.float32)
+        active = fleet.active_version("m")
+        expected = ModelStore(fleet.store_dir).booster("m", active).predict(
+            xtb.DMatrix(Q))
+    return {"digest": _digest(served.tobytes(), outcome,
+                              json.dumps(join, sort_keys=True),
+                              str(window_rows)),
+            "completed": completed, "outcome": outcome,
+            "swapped": outcome == "swapped",
+            "drift_triggered": outcome not in ("idle", "deferred"),
+            "join": join, "window_rows": window_rows,
+            "serving_matches_active": bool(np.array_equal(
+                served, np.asarray(expected, np.float32)))}
+
+
+def _check_online(fired, artifacts, baseline) -> Dict[str, str]:
+    inv = {}
+    n_req = _N_ONLINE_BASE + _N_ONLINE_SHIFT
+    rejecting = sum(n for spec, n in fired
+                    if spec.kind == "exception"
+                    and spec.site in ("online.retrain",
+                                      "lifecycle.validate"))
+    label_faults = sum(n for spec, n in fired
+                       if spec.site == "online.label_join"
+                       and spec.kind == "exception")
+    inv["no_dropped_requests"] = (
+        "ok" if artifacts["completed"] == n_req
+        else f"FAIL: {artifacts['completed']}/{n_req} completed")
+    inv["serving_is_active_version"] = (
+        "ok" if artifacts["serving_matches_active"]
+        else "FAIL: fleet serves bytes that are not the active version's")
+    inv["drift_detected"] = (
+        "ok" if artifacts["drift_triggered"]
+        else f"FAIL: shifted traffic did not trip the drift edge "
+             f"(outcome {artifacts['outcome']})")
+    if rejecting:
+        inv["reject_fault_rejects"] = (
+            "ok" if not artifacts["swapped"]
+            else "FAIL: a reject-class fault fired but the swap went "
+                 "through")
+    else:
+        inv["clean_cycle_swaps"] = (
+            "ok" if artifacts["swapped"]
+            else f"FAIL: no reject-class fault fired yet the cycle did "
+                 f"not swap ({artifacts['outcome']})")
+    join = artifacts["join"]
+    inv["label_fault_accounting"] = (
+        "ok" if join["dropped"].get("fault", 0) == label_faults
+        else f"FAIL: {join['dropped'].get('fault', 0)} labels dropped to "
+             f"faults, plan fired {label_faults}")
+    # the join's conservation law: every counted intake ends matched,
+    # pending, or dropped (fault/untraced drops happen before counting)
+    lhs = join["offered"] + join["labeled"]
+    rhs = (2 * join["matched"]
+           + join["pending_features"] + join["pending_labels"]
+           + sum(v for k, v in join["dropped"].items()
+                 if k not in ("fault", "untraced")))
+    inv["join_conservation"] = (
+        "ok" if lhs == rhs
+        else f"FAIL: offered+labeled {lhs} != matched*2+pending+dropped "
+             f"{rhs} ({join})")
+    return inv
+
+
 # ----------------------------------------------------------------- elastic
 def _elastic_chaos_worker(rank, world, *, ckpt_dir, out_path, rounds,
                           num_shards):
@@ -771,6 +897,27 @@ SCENARIOS: Dict[str, Scenario] = {
         ),
         run=_run_lifecycle, check=_check_lifecycle, twin=False,
         cost_hint_s=25.0, deadline_s=300.0),
+    "online": Scenario(
+        name="online",
+        catalog=(
+            # driver-side seams only: faults.install() does not export
+            # the plan to replica subprocess env, and the fault-
+            # accounting invariant counts the driver's registry
+            CatalogEntry("online.label_join", "exception",
+                         {"at": (0, _N_ONLINE_BASE + _N_ONLINE_SHIFT)}),
+            CatalogEntry("online.retrain", "exception", {}),
+            CatalogEntry("online.retrain", "delay",
+                         {"seconds": (0.001, 0.05)}),
+            CatalogEntry("lifecycle.validate", "exception", {}),
+            CatalogEntry("fleet.dispatch", "delay",
+                         {"seconds": (0.001, 0.03),
+                          "at": (0, _N_ONLINE_BASE + _N_ONLINE_SHIFT)}),
+        ),
+        run=_run_online, check=_check_online, twin=False,
+        cost_hint_s=30.0, deadline_s=300.0,
+        # bounded label loss: each join fault costs one 16-row block,
+        # and the window floor (128 of 288 rows) must stay reachable
+        per_plan_caps={("online.label_join", "exception"): 2}),
     "elastic": Scenario(
         name="elastic",
         catalog=(

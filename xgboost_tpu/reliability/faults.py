@@ -106,6 +106,9 @@ SEAMS = frozenset({
     "tracker.journal",
     "watchdog.escalate",
     "resource.pressure",
+    "online.sample",
+    "online.label_join",
+    "online.retrain",
 })
 
 # Debug guard: with XGBOOST_TPU_STRICT_SEAMS=1, maybe_inject() rejects

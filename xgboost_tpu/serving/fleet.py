@@ -94,6 +94,9 @@ _SHADOW_BUCKETS = tuple(1e-9 * (10.0 ** i) for i in range(10))
 # a two-sample KS statistic lives in [0, 1]: a handful of decision points
 # from "indistinguishable distributions" to "disjoint supports"
 _KS_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.5)
+# PSI's conventional decision points straddle 0.1 ("noticeable shift") and
+# 0.25 ("act"); decades around them, open-ended above
+_PSI_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
 
 
 def _ks_stat(a: np.ndarray, b: np.ndarray) -> float:
@@ -110,6 +113,50 @@ def _ks_stat(a: np.ndarray, b: np.ndarray) -> float:
     cdf_a = np.searchsorted(a, grid, side="right") / a.size
     cdf_b = np.searchsorted(b, grid, side="right") / b.size
     return float(np.max(np.abs(cdf_a - cdf_b)))
+
+
+def _psi(a: np.ndarray, b: np.ndarray, bins: int = 10) -> float:
+    """Population stability index of ``b`` against reference ``a``, over
+    ``a``'s decile bins: sum over bins of (p_a - p_b) * ln(p_a / p_b).
+    The third comparator lens next to mean-divergence and KS — KS reports
+    the single worst ECDF gap, PSI integrates shift across the whole
+    distribution, so a broad small drift that never opens one large gap
+    still registers.  Bin fractions are clamped to 1e-6 (empty-bin PSI is
+    finite, and a bin emptying out IS the signal)."""
+    a = np.asarray(a, np.float64).ravel()
+    b = np.asarray(b, np.float64).ravel()
+    if a.size == 0 or b.size == 0:
+        return 0.0
+    edges = np.quantile(a, np.linspace(0.0, 1.0, bins + 1)[1:-1])
+    pa = np.bincount(np.searchsorted(edges, a, side="right"),
+                     minlength=bins)[:bins] / a.size
+    pb = np.bincount(np.searchsorted(edges, b, side="right"),
+                     minlength=bins)[:bins] / b.size
+    pa = np.clip(pa, 1e-6, None)
+    pb = np.clip(pb, 1e-6, None)
+    return float(np.sum((pa - pb) * np.log(pa / pb)))
+
+
+def _calibration_gap(a: np.ndarray, b: np.ndarray, bins: int = 10) -> float:
+    """Max per-decile calibration gap: bucket the pair's rows by the
+    INCUMBENT's score deciles, compare each bucket's expected rate (the
+    incumbent's mean score — what the serving distribution promised) with
+    the candidate's observed mean on the same rows.  A candidate can pass
+    mean-divergence and KS while systematically re-scoring one decile
+    (e.g. flattening the top bucket a bid system prices from); the
+    per-decile max catches exactly that."""
+    a = np.asarray(a, np.float64).ravel()
+    b = np.asarray(b, np.float64).ravel()
+    if a.size == 0 or a.size != b.size:
+        return 0.0
+    edges = np.quantile(a, np.linspace(0.0, 1.0, bins + 1)[1:-1])
+    idx = np.searchsorted(edges, a, side="right")
+    gap = 0.0
+    for d in range(bins):
+        m = idx == d
+        if m.any():
+            gap = max(gap, abs(float(a[m].mean()) - float(b[m].mean())))
+    return gap
 
 
 @dataclasses.dataclass(frozen=True)
@@ -209,6 +256,22 @@ class _Instruments:
             "two-sample KS statistic between candidate and incumbent "
             "prediction distributions per shadow-scored request",
             ("model",), buckets=_KS_BUCKETS)
+        self.shadow_psi = reg.histogram(
+            "xtb_lifecycle_shadow_psi",
+            "population stability index of candidate vs incumbent "
+            "prediction distributions per shadow-scored request",
+            ("model",), buckets=_PSI_BUCKETS)
+        self.shadow_calibration = reg.histogram(
+            "xtb_lifecycle_shadow_calibration",
+            "max per-incumbent-decile calibration gap (expected vs "
+            "observed mean score) per shadow-scored request",
+            ("model",), buckets=_SHADOW_BUCKETS)
+        self.feedback_frames = reg.counter(
+            "xtb_online_feedback_frames_total",
+            "feedback-capture frames received from replicas", ("model",))
+        self.feedback_rows = reg.counter(
+            "xtb_online_sampled_rows_total",
+            "feature rows received through feedback capture", ("model",))
         self.brownout = reg.counter(
             "xtb_fleet_brownout_total",
             "requests shed at admission by the resource-pressure "
@@ -505,6 +568,11 @@ class ServingFleet:
         # routing config {name: {"version", "every", "n", stats...}}
         self._versions: Dict[str, int] = {}
         self._shadow: Dict[str, dict] = {}
+        # online-loop state (under _cv): per-model feedback sample rate
+        # (resynced onto respawns like _versions) and the registered
+        # driver-side consumer of decoded feedback records
+        self._sampling: Dict[str, int] = {}
+        self._feedback_sink = None
         self._respawned = 0
         self._started = False
         self._bringup_done = False
@@ -695,6 +763,16 @@ class ServingFleet:
                         rid, name, {"op": "activate", "model": name,
                                     "version": int(version), "id": rid},
                         b"", self.config.default_slo))
+                # feedback-capture resync, same contract as the version
+                # resync above: a respawn that missed the sample broadcast
+                # converges to the fleet's configured rate
+                for name, every in (self._sampling.items()
+                                    if self._bringup_done else ()):
+                    rid = next(self._next_id)
+                    rep.ctrl.append(_Request(
+                        rid, name, {"op": "sample", "model": name,
+                                    "every": int(every), "id": rid},
+                        b"", self.config.default_slo))
                 self._ins.replicas.set(
                     sum(1 for r in self._replicas.values() if r.alive))
                 self._cv.notify_all()
@@ -730,6 +808,12 @@ class ServingFleet:
                 # does NOT complete the in-flight request — ingest and go
                 # straight back to the socket
                 self._ingest_telemetry(label, payload)
+                continue
+            if op == wire.FEEDBACK:
+                # unsolicited like telemetry: a sampled request's features
+                # + served scores for the online loop; never completes the
+                # in-flight request
+                self._ingest_feedback(label, header, payload)
                 continue
             if op == "quarantine":
                 # the replica's loaded arena checksum diverged: it fences
@@ -789,6 +873,39 @@ class ServingFleet:
             else:
                 etype = _ERR_TYPES.get(header.get("etype", ""), RuntimeError)
                 self._fail(req, etype(header.get("error", "replica error")))
+
+    def _ingest_feedback(self, label: str, header: dict, payload) -> None:
+        """One replica feedback frame: decode the (features, scores) pair
+        and hand it to the registered sink.  Malformed frames and sink
+        errors are dropped with a flight fault, never fatal — feedback is
+        a best-effort measurement stream, the serving plane must not
+        depend on its consumer."""
+        try:
+            R, F = (int(x) for x in header["shape"])
+            X = np.frombuffer(payload[:R * F * 4],
+                              np.float32).reshape(R, F)
+            scores = np.frombuffer(payload[R * F * 4:], np.float32)
+            oshape = header.get("oshape")
+            if oshape:
+                scores = scores.reshape([int(x) for x in oshape])
+            model = str(header.get("model"))
+            trace = header.get("trace")
+        except (KeyError, TypeError, ValueError) as e:
+            _flight.record("fault", "fleet.feedback_decode", replica=label,
+                           error=str(e))
+            return
+        self._ins.feedback_frames.labels(model).inc()
+        self._ins.feedback_rows.labels(model).inc(float(R))
+        with self._cv:
+            sink = self._feedback_sink
+        if sink is None:
+            return
+        try:
+            sink({"model": model, "trace": trace, "X": X,
+                  "scores": scores, "replica": label})
+        except Exception as e:  # a broken consumer must not kill rx
+            _flight.record("fault", "fleet.feedback_sink", replica=label,
+                           error=str(e))
 
     def _ingest_telemetry(self, label: str, payload) -> None:
         """One replica telemetry frame: retain the latest snapshot +
@@ -1134,6 +1251,10 @@ class ServingFleet:
         if version is not None:
             header["version"] = int(version)
         req = _Request(rid, model, header, payload, slo)
+        # the trace id rides on the future too: feedback capture keys its
+        # samples off it, so a label producer can join labels to requests
+        # (hub.label(fut.trace_id, y)) without a side channel
+        req.future.trace_id = header["trace"]
         shadow_req = None
         with self._cv:
             if self._closed:
@@ -1337,6 +1458,39 @@ class ServingFleet:
         with self._cv:
             return dict(self.quarantined)
 
+    # ------------------------------------------------------ feedback capture
+    def set_sampling(self, model: str, every: int,
+                     timeout: float = 300.0) -> List[dict]:
+        """Broadcast the feedback-capture rate for ``model``: every live
+        replica samples 1-in-``every`` of its unversioned requests
+        (deterministically, keyed off the request-id half of the trace id)
+        and ships features + served scores back as ``op="feedback"``
+        frames.  ``every=0`` turns capture off.  Respawned replicas are
+        resynced like versions, so the configured rate survives deaths."""
+        every = int(every)
+        if every < 0:
+            raise ValueError(f"every must be >= 0, got {every}")
+        with self._cv:
+            if every > 0:
+                self._sampling[model] = every
+            else:
+                self._sampling.pop(model, None)
+        return self._control_all(
+            {"op": "sample", "model": model, "every": every}, timeout)
+
+    def set_feedback_sink(self, sink) -> None:
+        """Register the driver-side consumer of decoded feedback records
+        (dicts with model/trace/X/scores/replica), called on rx threads.
+        ``None`` unregisters.  Sink exceptions are contained (flight
+        fault), not propagated into the rx loop."""
+        with self._cv:
+            self._feedback_sink = sink
+
+    def sampling_rate(self, model: str) -> int:
+        """The configured feedback-capture rate (0 = off)."""
+        with self._cv:
+            return self._sampling.get(model, 0)
+
     # ------------------------------------------------------- shadow scoring
     def set_shadow(self, model: str, version: int,
                    fraction: float) -> None:
@@ -1353,6 +1507,8 @@ class ServingFleet:
                 "version": int(version), "every": every, "n": 0,
                 "pairs": 0, "failures": 0, "sum_div": 0.0, "max_div": 0.0,
                 "sum_ks": 0.0, "max_ks": 0.0,
+                "sum_psi": 0.0, "max_psi": 0.0,
+                "sum_cal": 0.0, "max_cal": 0.0,
             }
 
     @staticmethod
@@ -1362,7 +1518,11 @@ class ServingFleet:
                 "mean_div": (sh["sum_div"] / pairs) if pairs else 0.0,
                 "max_div": sh["max_div"],
                 "mean_ks": (sh["sum_ks"] / pairs) if pairs else 0.0,
-                "max_ks": sh["max_ks"]}
+                "max_ks": sh["max_ks"],
+                "mean_psi": (sh["sum_psi"] / pairs) if pairs else 0.0,
+                "max_psi": sh["max_psi"],
+                "mean_cal": (sh["sum_cal"] / pairs) if pairs else 0.0,
+                "max_cal": sh["max_cal"]}
 
     def clear_shadow(self, model: str) -> Optional[dict]:
         """Stop mirroring; returns the accumulated comparator stats
@@ -1407,8 +1567,10 @@ class ServingFleet:
             if a.shape == b.shape:
                 div = float(np.mean(np.abs(a - b)))
                 ks = _ks_stat(a, b)
+                psi = _psi(a, b)
+                cal = _calibration_gap(a, b)
             else:
-                div = ks = float("inf")
+                div = ks = psi = cal = float("inf")
         except BaseException:
             self._ins.shadow_failures.labels(model).inc()
             with self._cv:
@@ -1419,6 +1581,10 @@ class ServingFleet:
         self._ins.shadow_requests.labels(model).inc()
         self._ins.shadow_divergence.labels(model).observe(div)
         self._ins.shadow_ks.labels(model).observe(min(ks, 1.0))
+        self._ins.shadow_psi.labels(model).observe(
+            min(psi, _PSI_BUCKETS[-1] * 10))
+        self._ins.shadow_calibration.labels(model).observe(
+            min(cal, _SHADOW_BUCKETS[-1] * 10))
         with self._cv:
             sh_live = self._shadow.get(model)
             if sh_live is not None:
@@ -1427,6 +1593,10 @@ class ServingFleet:
                 sh_live["max_div"] = max(sh_live["max_div"], div)
                 sh_live["sum_ks"] += ks
                 sh_live["max_ks"] = max(sh_live["max_ks"], ks)
+                sh_live["sum_psi"] += psi
+                sh_live["max_psi"] = max(sh_live["max_psi"], psi)
+                sh_live["sum_cal"] += cal
+                sh_live["max_cal"] = max(sh_live["max_cal"], cal)
 
     # ---------------------------------------------------------------- admin
     def replica_info(self) -> List[dict]:

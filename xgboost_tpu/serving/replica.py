@@ -217,6 +217,50 @@ def ship_telemetry(sock, label: str) -> bool:
         return False
 
 
+def _sampled(trace, every: int) -> bool:
+    """Deterministic 1-in-N feedback selection, keyed off the request-id
+    half of the dispatcher's trace id (``{pid:x}-{rid:x}``).  The rid
+    counter — never the pid half, never a PRNG — so a seeded replay of the
+    same request schedule samples the same requests whatever pid the
+    dispatcher process drew (docs/online.md "Determinism contract")."""
+    try:
+        rid = int(str(trace).split("-")[1], 16)
+    except (IndexError, ValueError):
+        return False
+    return rid % every == 0
+
+
+def _capture_feedback(sock, header, X, out) -> None:
+    """Ship one sampled request back to the dispatcher (``op="feedback"``):
+    payload = the feature rows' raw f32 bytes followed by the served
+    scores' raw f32 bytes.  Best-effort like :func:`ship_telemetry` — the
+    result frame already went out, so a failed capture must drop the
+    sample (counted driver-side as a join shortfall), never the request
+    or the serve loop.  The ``online.sample`` seam is the loop's
+    capture-side fault point: an injected exception is exactly a dropped
+    sample."""
+    from ..reliability import faults as _faults
+    from ..telemetry import flight
+    from . import wire
+
+    try:
+        _faults.maybe_inject("online.sample")
+        Xc = np.ascontiguousarray(X, np.float32)
+        oc = np.ascontiguousarray(out, np.float32)
+        wire.send_frame(sock, {"op": wire.FEEDBACK,
+                               "model": header["model"],
+                               "trace": header.get("trace"),
+                               "shape": list(Xc.shape),
+                               "oshape": list(oc.shape)},
+                        Xc.tobytes() + oc.tobytes())
+    except _faults.FaultInjected as e:
+        flight.record("fault", "online.sample", error=str(e))
+    except OSError as e:
+        from ..reliability import resources as _resources
+
+        _resources.note_os_error(e, "online.sample_ship")
+
+
 def _replica_stall(op) -> None:
     """Watchdog stall stage for a wedged request: die loudly.  The stack
     dump already landed at the dump stage; the dispatcher's death path
@@ -274,6 +318,10 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
 
     interval = distributed.ship_interval()
     scrub_s = _scrub_interval()
+    # feedback-capture config per model (the "sample" control broadcast):
+    # model -> every-N; 0/absent = capture off (the default, so serving
+    # pays nothing until the online loop turns it on)
+    sample: dict = {}
     last_ship = last_scrub = time.monotonic()
     stream = wire.reader(sock)  # one GIL event per frame, not three
     while True:
@@ -310,6 +358,22 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
                 except ArenaCorruptError as e:
                     _quarantine(e, rid)
                     raise
+                continue
+            if op == "sample":
+                # feedback-capture control: set/clear the per-model 1-in-N
+                # rate.  Rides the serialized connection like every
+                # lifecycle op — requests dispatched before this frame are
+                # sampled (or not) under the previous rate, deterministically
+                every = int(header.get("every", 0) or 0)
+                if every > 0:
+                    sample[header["model"]] = every
+                else:
+                    sample.pop(header["model"], None)
+                flight.record("event", "replica.sample",
+                              model=header.get("model"), every=every,
+                              trace=header.get("trace"))
+                wire.send_frame(sock, {"op": "ctrl_ok", "id": rid,
+                                       "every": every})
                 continue
             if op in ("load", "activate", "retire"):
                 try:
@@ -359,6 +423,14 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
                                    trace=header["trace"],
                                    model=header.get("model"),
                                    rows=int(out.shape[0]))
+                    # feedback capture AFTER the result frame: only
+                    # unversioned (live-traffic) requests — explicit-
+                    # version probes and shadow twins are measurements,
+                    # not traffic the window should learn from
+                    ev = sample.get(header["model"], 0)
+                    if (ev > 0 and header.get("version") is None
+                            and _sampled(header.get("trace"), ev)):
+                        _capture_feedback(sock, header, X, out)
                 except Exception as e:  # per-request failure: serve on
                     flight.record("fault", "replica.predict",
                                   model=header.get("model"), error=str(e))

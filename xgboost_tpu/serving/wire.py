@@ -69,6 +69,14 @@ ARROW = "arrow"  # Arrow IPC stream holding one RecordBatch
 # per request (docs/observability.md).
 TELEMETRY = "telemetry"
 
+# replica -> dispatcher feedback-capture shipment (serving/replica.py, the
+# online-learning loop's sample stream): header {"op": FEEDBACK, "model",
+# "trace", "shape": [R, F], "oshape": [...]}, payload = the request's raw
+# f32 feature rows followed by the raw f32 scores the replica served.
+# Unsolicited like TELEMETRY — the dispatcher ingests it without touching
+# the in-flight request (docs/online.md "Sampling & the join contract").
+FEEDBACK = "feedback"
+
 
 class WireError(RuntimeError):
     """Framing violation on a fleet socket (peer is gone or confused)."""
