@@ -90,6 +90,15 @@ struct XtbKernelStats {
   std::atomic<int64_t> regions{0};
   std::atomic<int64_t> busy_ns{0};
   std::atomic<int64_t> bucket[kXtbPoolBuckets + 1]{};
+  // Whole-invocation perf accounting (XtbKernelPerf below): unlike the
+  // region fields above, these cover inline executions too (S<=1 or a
+  // busy pool run the body without a dispatched region), so the roofline
+  // reporter sees every byte the kernel actually moved.
+  std::atomic<int64_t> invocations{0};
+  std::atomic<int64_t> wall_ns{0};
+  std::atomic<int64_t> cycles{0};
+  std::atomic<int64_t> bytes{0};
+  std::atomic<int64_t> flops{0};
 };
 
 class XtbThreadPool {
@@ -130,6 +139,19 @@ class XtbThreadPool {
   const XtbKernelStats& stats(int kernel) {
     return stats_[(kernel >= 0 && kernel < XTB_K_COUNT) ? kernel
                                                         : XTB_K_OTHER];
+  }
+
+  // One finished kernel invocation (XtbKernelPerf): wall time, cycle
+  // delta, and the caller's byte/flop traffic model.
+  void record_perf(int kernel, int64_t wall_ns, int64_t cycles,
+                   int64_t bytes, int64_t flops) {
+    auto& s = stats_[(kernel >= 0 && kernel < XTB_K_COUNT) ? kernel
+                                                           : XTB_K_OTHER];
+    s.invocations.fetch_add(1, std::memory_order_relaxed);
+    s.wall_ns.fetch_add(wall_ns, std::memory_order_relaxed);
+    s.cycles.fetch_add(cycles, std::memory_order_relaxed);
+    s.bytes.fetch_add(bytes, std::memory_order_relaxed);
+    s.flops.fetch_add(flops, std::memory_order_relaxed);
   }
 
   void parallel_for(int64_t n, int64_t grain, int kernel,
@@ -349,6 +371,53 @@ inline void xtb_parallel_for(int64_t n, int64_t grain, int kernel,
   XtbThreadPool::Get().parallel_for(n, grain, kernel, fn);
 }
 
+// RAII perf bracket a kernel impl opens as its first statement: wall time
+// (steady_clock — the monotonic-clock contract), cycle delta
+// (xtb_simd.h xtb_cycle_counter_impl: rdtsc / cntvct), and the caller's
+// byte/flop traffic model, recorded into the pool's per-kernel stats on
+// scope exit.  The byte models count algorithmic traffic only (operand
+// reads once, output write + RFO read), not cache effects — the roofline
+// reporter (scripts/bench_roofline.py) documents each model next to its
+// achieved-GB/s row.
+class XtbKernelPerf {
+ public:
+  XtbKernelPerf(int kernel, int64_t bytes, int64_t flops)
+      : kernel_(kernel), bytes_(bytes), flops_(flops),
+        t0_(std::chrono::steady_clock::now()),
+        c0_(xtb_cycle_counter_impl()) {}
+  ~XtbKernelPerf() {
+    const int64_t ns =
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - t0_).count();
+    const uint64_t c1 = xtb_cycle_counter_impl();
+    XtbThreadPool::Get().record_perf(kernel_, ns,
+                                     static_cast<int64_t>(c1 - c0_),
+                                     bytes_, flops_);
+  }
+  XtbKernelPerf(const XtbKernelPerf&) = delete;
+  XtbKernelPerf& operator=(const XtbKernelPerf&) = delete;
+
+ private:
+  int kernel_;
+  int64_t bytes_, flops_;
+  std::chrono::steady_clock::time_point t0_;
+  uint64_t c0_;
+};
+
+// STREAM-like triad a[i] = b[i] + s*c[i] through the pool — the host
+// peak-bandwidth probe the roofline reporter normalizes kernel achieved
+// GB/s against.  Traffic follows the classic STREAM convention:
+// 3 accesses x 4 bytes per element (two reads + one write), no
+// write-allocate accounting.
+inline void xtb_stream_triad_impl(const float* b, const float* c, float s,
+                                  float* a, int64_t n) {
+  XtbKernelPerf perf(XTB_K_OTHER, 12 * n, n);
+  auto shard = [=](int64_t i0, int64_t i1) {
+    for (int64_t i = i0; i < i1; ++i) a[i] = b[i] + s * c[i];
+  };
+  xtb_parallel_for(n, int64_t{1} << 15, XTB_K_OTHER, shard);
+}
+
 // Per-translation-unit C ABI over the pool (each .so carries its own pool
 // instance; utils/native.py configures every loaded library).  Define
 // XTB_DEFINE_POOL_ABI before including this header in exactly one TU per
@@ -386,6 +455,22 @@ void xtb_pool_kernel_stats(int kernel, int64_t* out) {
   out[0] = s.regions.load();
   out[1] = s.busy_ns.load();
   for (int i = 0; i <= kXtbPoolBuckets; ++i) out[2 + i] = s.bucket[i].load();
+}
+// out: [invocations, wall_ns, cycles, bytes, flops] (5 int64 slots) —
+// whole-invocation perf accounting (XtbKernelPerf; includes inline
+// executions the region stats above never see)
+void xtb_pool_kernel_perf(int kernel, int64_t* out) {
+  const XtbKernelStats& s = XtbThreadPool::Get().stats(kernel);
+  out[0] = s.invocations.load();
+  out[1] = s.wall_ns.load();
+  out[2] = s.cycles.load();
+  out[3] = s.bytes.load();
+  out[4] = s.flops.load();
+}
+// STREAM triad peak-bandwidth probe (scripts/bench_roofline.py)
+void xtb_stream_triad(const float* b, const float* c, float s, float* a,
+                      int64_t n) {
+  xtb_stream_triad_impl(b, c, s, a, n);
 }
 }  // extern "C"
 #endif  // XTB_DEFINE_POOL_ABI
@@ -430,6 +515,13 @@ inline void xtb_hist_build_impl(const BinT* bins, const float* gpair,
                                 int32_t stride, int32_t C, float* out) {
   const size_t node_sz = static_cast<size_t>(F) * n_bin * C;
   const size_t col_sz = static_cast<size_t>(n_bin) * C;
+  // bytes: per row one bin row (F*BinT) + gpair (C*4) + pos (4); hist
+  // output written once and RFO-read (2x); flops: C adds per (row, feat)
+  XtbKernelPerf perf(
+      XTB_K_HIST,
+      R * (F * static_cast<int64_t>(sizeof(BinT)) + 4 * C + 4) +
+          2 * static_cast<int64_t>(n_nodes) * node_sz * 4,
+      R * static_cast<int64_t>(F) * C);
   const bool vec_row = C == 2 && xtb_simd_active() != XTB_SIMD_SCALAR &&
                        n_nodes * node_sz * sizeof(float) <= kXtbHistVecL2;
   auto shard = [=](int64_t f0, int64_t f1) {
@@ -491,6 +583,13 @@ inline void xtb_hist_q_impl(const BinT* bins, const int8_t* limbs,
                             int32_t stride, int32_t CL, int32_t* out) {
   const size_t node_sz = static_cast<size_t>(F) * n_bin * CL;
   const size_t col_sz = static_cast<size_t>(n_bin) * CL;
+  // bytes: bins row + int8 limbs (CL) + pos per row; int32 hist written
+  // + RFO-read; "flops" here are exact int32 limb adds
+  XtbKernelPerf perf(
+      XTB_K_HIST_Q,
+      R * (F * static_cast<int64_t>(sizeof(BinT)) + CL + 4) +
+          2 * static_cast<int64_t>(n_nodes) * node_sz * 4,
+      R * static_cast<int64_t>(F) * CL);
   auto shard = [=](int64_t f0, int64_t f1) {
     for (int32_t nd = 0; nd < n_nodes; ++nd) {
       memset(out + nd * node_sz + f0 * col_sz, 0,
@@ -555,6 +654,13 @@ inline void xtb_split_scan_impl(const float* hist, const float* totals,
                                 float* out_HL) {
   const float kEps = 1e-6f;
   const XtbGainParams p{lambda_, alpha, min_child_weight, max_delta_step};
+  // bytes: the (N, F, B, 2) f32 histogram read once + small per-node
+  // outputs; flops: ~24 per (node, feature, bin) — prefix adds + both
+  // missing-direction gain evaluations
+  XtbKernelPerf perf(
+      XTB_K_SPLIT,
+      static_cast<int64_t>(N) * F * B * 8 + static_cast<int64_t>(N) * 21,
+      static_cast<int64_t>(N) * F * B * 24);
   // max_delta_step == 0 (the default) takes the vectorized candidate
   // evaluation: the glr/hlr prefix chains stay serial (the f32 adds keep
   // their sequential order), only the per-bin ELEMENTWISE gain math runs 8
@@ -726,6 +832,14 @@ inline void xtb_predict_raw_impl(
     int32_t T, int32_t M, int32_t depth, int32_t K, int32_t K_leaf,
     int32_t has_cat, const uint8_t* is_cat, const uint8_t* catm, int32_t Bc,
     const float* init, float* out) {
+  // bytes: X streamed once, init read + out written (+RFO), the node
+  // arrays (~21 B/node) read once; flops: one compare per level walked
+  // plus K_leaf leaf adds, per (row, tree)
+  XtbKernelPerf perf(
+      XTB_K_PREDICT,
+      static_cast<int64_t>(R) * F * 4 + static_cast<int64_t>(R) * K * 12 +
+          static_cast<int64_t>(T) * M * 21,
+      static_cast<int64_t>(R) * T * (depth + K_leaf));
   // the byte-wide dleft array is gathered with 32-bit reads on the vector
   // path; copy it into a 4-byte-padded scratch once per call
   std::shared_ptr<std::vector<uint8_t>> dl_pad;
@@ -797,6 +911,14 @@ inline void xtb_predict_binned_impl(
     const int32_t* groups, int32_t T, int32_t M, int32_t depth, int32_t K,
     int32_t has_cat, const uint8_t* is_cat, const uint8_t* catm, int32_t Bc,
     const float* init, float* out) {
+  // same model as the f32 walk with BinT-wide rows (binned ensembles are
+  // scalar-leaf: one add per tree)
+  XtbKernelPerf perf(
+      XTB_K_PREDICT,
+      static_cast<int64_t>(R) * F * static_cast<int64_t>(sizeof(BinT)) +
+          static_cast<int64_t>(R) * K * 12 +
+          static_cast<int64_t>(T) * M * 21,
+      static_cast<int64_t>(R) * T * (depth + 1));
   std::shared_ptr<std::vector<uint8_t>> dl_pad;
   const bool vec_ok =
       xtb_simd_active() == XTB_SIMD_AVX2 && !has_cat && R >= 16 &&
@@ -1125,6 +1247,14 @@ inline void xtb_ellpack_bin_impl(const float* X, int64_t R, int32_t F,
                                  const float* cut_values,
                                  const int32_t* cut_ptrs, int32_t B,
                                  BinT* out) {
+  // bytes: X streamed once, page written (+RFO); flops: ~log2(B)
+  // binary-search compares per element (5 covers max_bin 256 halvings
+  // of the typical per-feature cut count)
+  XtbKernelPerf perf(
+      XTB_K_ELLPACK,
+      static_cast<int64_t>(R) * F *
+          (4 + 2 * static_cast<int64_t>(sizeof(BinT))),
+      static_cast<int64_t>(R) * F * 5);
   auto shard = [=](int64_t r0, int64_t r1) {
     for (int64_t r = r0; r < r1; ++r) {
       const float* xr = X + r * F;

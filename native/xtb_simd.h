@@ -65,6 +65,24 @@ inline int xtb_simd_detect_impl() {
 #endif
 }
 
+// Raw cycle counter for the per-kernel perf accounting
+// (xtb_kernels.h XtbKernelPerf -> xtb_native_kernel_cycles_total): TSC on
+// x86-64 (invariant/constant-rate on every deployment target, so deltas
+// across an invocation are meaningful), the virtual counter register on
+// aarch64, 0 elsewhere (a 0 delta reads as "unavailable" downstream).
+// Lives HERE because xtblint XTB601 confines raw intrinsics to this header.
+inline uint64_t xtb_cycle_counter_impl() {
+#if XTB_SIMD_X86
+  return __builtin_ia32_rdtsc();
+#elif XTB_SIMD_ARM
+  uint64_t v;
+  asm volatile("mrs %0, cntvct_el0" : "=r"(v));
+  return v;
+#else
+  return 0;
+#endif
+}
+
 inline int xtb_simd_resolve_impl(int requested) {
   const int det = xtb_simd_detect_impl();
   if (requested == XTB_SIMD_SCALAR) return XTB_SIMD_SCALAR;

@@ -2,22 +2,24 @@
 """Observability overhead guard -> BENCH_OBS.json.
 
 Measures the wall-clock cost of the distributed telemetry plane's
-SHIPPING work — registry snapshot + JSON serialization + merged-registry
-ingest, the exact per-round / per-interval work a training rank or fleet
-replica pays when metric shipping is on (docs/observability.md
-"Distributed observability plane") — on the higgs ladder config shape
-(binary:logistic, 28 features, max_depth=8, eta=0.3, max_bin=256,
-5 rounds; rows = 11M * BENCH_OBS_SCALE).
+always-on work — registry snapshot + JSON serialization + merged-registry
+ingest (the per-round / per-interval shipping a training rank or fleet
+replica pays) PLUS the sampling wall profiler armed at its default rate
+(telemetry/profiler.py, `XGBOOST_TPU_PROF_HZ`) — on the higgs ladder
+config shape (binary:logistic, 28 features, max_depth=8, eta=0.3,
+max_bin=256, 5 rounds; rows = 11M * BENCH_OBS_SCALE).
 
-Two legs, each timed shipping-OFF then shipping-ON:
+Two legs, each timed observability-OFF then observability-ON:
 
-- **train**: `xtb.train` bare vs with `TelemetryCallback(enable_spans=
-  False)` + a per-round snapshot ship (the tracker-channel cadence).
+- **train**: `xtb.train` bare (profiler stopped) vs with
+  `TelemetryCallback(enable_spans=False)` + a per-round snapshot ship
+  (the tracker-channel cadence) + the profiler sampling at DEFAULT_HZ.
   Spans stay off in both legs — they are a separate opt-in; this guard
-  isolates the shipping plane.
+  isolates the default-on plane.
 - **serve**: a closed loop of direct engine predicts vs the same loop
-  shipping on the replica cadence (`XGBOOST_TPU_TELEMETRY_INTERVAL`),
-  with the `/metrics` scrape endpoint running and scraped once mid-leg.
+  shipping on the replica cadence (`XGBOOST_TPU_TELEMETRY_INTERVAL`)
+  with the profiler armed, the `/metrics` scrape endpoint running and
+  scraped once mid-leg.
 
 Convention matches bench_serve.py: every timed section repeats
 ``BENCH_OBS_REPS`` times (default 3) and reports the MINIMUM wall
@@ -66,7 +68,18 @@ def _ship_once(merged, source):
 
     payload = distributed.snapshot_payload()
     json.dumps(payload)  # the wire bytes a real ship serializes
-    merged.ingest(source, payload["snapshot"])
+    merged.ingest_payload(source, payload)
+
+
+def _set_profiler(on: bool) -> None:
+    """ON legs sample at the default rate (what a fresh process runs);
+    OFF legs have the sampler fully stopped (XGBOOST_TPU_PROF_HZ=0)."""
+    from xgboost_tpu.telemetry import profiler
+
+    if on:
+        profiler.start(hz=profiler.DEFAULT_HZ)
+    else:
+        profiler.stop()
 
 
 def bench_train(X, y, rounds, reps):
@@ -88,9 +101,12 @@ def bench_train(X, y, rounds, reps):
 
     def run(shipping: bool) -> float:
         cb = ([_ShippingCallback(enable_spans=False)] if shipping else None)
+        _set_profiler(shipping)
         t0 = time.perf_counter()
         xtb.train(params, d, rounds, callbacks=cb, verbose_eval=False)
-        return time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        _set_profiler(False)
+        return dt
 
     run(False)  # warm the compile caches once; both legs measure steady
     # interleaved off/on reps: host-noise bursts hit both legs equally
@@ -127,6 +143,7 @@ def bench_serve(X, y, reps, batch=256):
             """requests/second over one fixed-duration leg."""
             last = time.monotonic()
             n = 0
+            _set_profiler(shipping)
             t0 = time.perf_counter()
             while time.perf_counter() - t0 < leg_s:
                 eng.predict("m", Xq, direct=True)
@@ -136,7 +153,9 @@ def bench_serve(X, y, reps, batch=256):
                     if now - last >= interval:
                         last = now
                         _ship_once(merged, "replica0")
-            return n / (time.perf_counter() - t0)
+            rate = n / (time.perf_counter() - t0)
+            _set_profiler(False)
+            return rate
 
         # one scrape mid-bench, like a live Prometheus target
         urllib.request.urlopen(

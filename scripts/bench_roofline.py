@@ -1,0 +1,219 @@
+"""Roofline attribution for the native kernels -> BENCH_ROOFLINE.json.
+
+Answers "is this kernel compute-bound or memory-bound, and how far from
+the host's ceiling is it?" from the per-invocation XtbKernelPerf counters
+(native/xtb_kernels.h): every kernel invocation records wall ns, rdtsc
+cycles, modeled bytes touched, and modeled flops.  This script
+
+1. measures the host's achievable memory bandwidth ONCE with a
+   STREAM-style triad (``a[i] = b[i] + s*c[i]``) run through the same
+   ParallelFor pool the kernels use (utils/native.stream_triad) —
+   best-of-N over arrays far larger than LLC, 12 bytes/element by the
+   STREAM convention (two reads + one write, no RFO accounting);
+2. runs >=2 BASELINE ladder configs (bench_ladder shapes, scaled) through
+   train + predict twice — once on the f32 ``hist`` path and once with
+   ``deterministic_histogram=1`` (the quantised ``hist_q`` path) — so the
+   four headline kernels (hist, hist_q, split, predict) all execute;
+3. emits per-kernel achieved GB/s, GFLOP/s, arithmetic intensity
+   (flops/byte), and % of the measured peak into BENCH_ROOFLINE.json.
+
+Reading the rows: a kernel whose intensity is below the machine balance
+(peak GFLOP/s / peak GB/s) lives on the bandwidth roof — its %-of-peak
+bandwidth is the number to improve; one above it is compute-bound.  The
+byte/flop models are documented next to each kernel's XtbKernelPerf
+scope in native/xtb_kernels.h.
+
+Usage:  python scripts/bench_roofline.py [out.json] [--quick]
+  --quick: small rows / few rounds / smaller triad — the nightly smoke
+  (scripts/nightly_suite.sh); full mode writes the committed file.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_ladder import FULL_CONFIGS, _host_fingerprint, make_data  # noqa: E402
+
+# the four kernels the roofline exists to attribute; missing rows fail
+# the run so the nightly catches an instrumentation regression
+REQUIRED_KERNELS = ("hist", "hist_q", "split", "predict")
+
+PERF_KEYS = ("invocations", "wall_ns", "cycles", "bytes", "flops")
+
+
+def measure_peak(quick: bool) -> dict:
+    """Best-of-N STREAM triad bandwidth through the native pool.
+
+    12 bytes move per element (read b, read c, write a — the STREAM
+    convention; actual traffic with write-allocate is higher, which makes
+    this a conservative peak and kernel %-of-peak slightly flattering)."""
+    from xgboost_tpu.utils import native
+
+    n = 1 << (22 if quick else 24)  # 16M/64M floats: far beyond LLC
+    reps = 3 if quick else 7
+    rng = np.random.default_rng(0)
+    b = rng.random(n, dtype=np.float32)
+    c = rng.random(n, dtype=np.float32)
+    a = np.zeros(n, dtype=np.float32)
+    native.stream_triad(b, c, 3.0, a)  # warm: faults pages, spins pool up
+    best_gbs, used_native = 0.0, True
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        used_native = native.stream_triad(b, c, 3.0, a)
+        dt = time.perf_counter() - t0
+        best_gbs = max(best_gbs, 12.0 * n / dt / 1e9)
+    return {
+        "stream_triad_gbs": round(best_gbs, 2),
+        "n_floats": n, "reps": reps,
+        "native_pool": bool(used_native),
+        "nthread": native.get_nthread(),
+        "bytes_model": "12*n (STREAM triad: 2 reads + 1 write, no RFO)",
+    }
+
+
+def _kernel_totals() -> dict:
+    from xgboost_tpu.utils import native
+
+    out = {}
+    for name, k in native.pool_stats()["kernels"].items():
+        out[name] = {key: int(k.get(key, 0)) for key in PERF_KEYS}
+    return out
+
+
+def _delta(before: dict, after: dict) -> dict:
+    out = {}
+    for name, k in after.items():
+        prev = before.get(name, {})
+        d = {key: k[key] - int(prev.get(key, 0)) for key in PERF_KEYS}
+        if d["invocations"] > 0:
+            out[name] = d
+    return out
+
+
+def _kernel_rows(deltas: dict, peak_gbs: float) -> dict:
+    rows = {}
+    for name, d in sorted(deltas.items()):
+        wall_ns = max(d["wall_ns"], 1)
+        gbs = d["bytes"] / wall_ns          # bytes/ns == GB/s
+        gflops = d["flops"] / wall_ns       # flops/ns == GFLOP/s
+        rows[name] = {
+            "invocations": d["invocations"],
+            "wall_ms": round(d["wall_ns"] / 1e6, 3),
+            "cycles": d["cycles"],
+            "bytes": d["bytes"],
+            "flops": d["flops"],
+            "achieved_gbs": round(gbs, 3),
+            "achieved_gflops": round(gflops, 3),
+            "intensity_flops_per_byte": round(d["flops"] / max(d["bytes"],
+                                                               1), 4),
+            "pct_of_peak_bw": (round(100.0 * gbs / peak_gbs, 1)
+                               if peak_gbs else None),
+        }
+    return rows
+
+
+def run_config(cfg: dict, scale: float, rounds: int, peak_gbs: float) -> dict:
+    import xgboost_tpu as xtb
+
+    R, X, y, groups = make_data(cfg, scale)
+    d = xtb.DMatrix(X, label=y)
+    if groups is not None:
+        d.set_group(groups)
+    p = {"objective": cfg["objective"], **cfg["params"]}
+    if cfg["kind"] == "multi":
+        p["num_class"] = cfg["classes"]
+    pq = {**p, "deterministic_histogram": 1}
+
+    # warm both program variants (XLA compile, ellpack build, pool spin-up)
+    # so the measured region is steady-state kernel execution
+    bst = xtb.train(p, d, 1, verbose_eval=False)
+    np.asarray(bst.predict(d))
+    xtb.train(pq, d, 1, verbose_eval=False)
+
+    before = _kernel_totals()
+    t0 = time.perf_counter()
+    bst = xtb.train(p, d, rounds, verbose_eval=False)       # hist + split
+    xtb.train(pq, d, rounds, verbose_eval=False)            # hist_q + split
+    np.asarray(bst.predict(d))                              # predict
+    wall = time.perf_counter() - t0
+    deltas = _delta(before, _kernel_totals())
+
+    return {
+        "config": cfg["name"], "rows": R, "cols": cfg["cols"],
+        "scale": scale, "rounds": rounds,
+        "objective": cfg["objective"], "wall_s": round(wall, 2),
+        "kernels": _kernel_rows(deltas, peak_gbs),
+    }
+
+
+def main(argv) -> int:
+    quick = "--quick" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    out_path = paths[0] if paths else "BENCH_ROOFLINE.json"
+
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
+    from xgboost_tpu.utils import native
+
+    if native.load_native() is None:  # pragma: no cover - no toolchain
+        print("bench_roofline: native kernels unavailable on this host; "
+              "nothing to attribute", flush=True)
+        return 0
+
+    scale = float(os.environ.get(
+        "ROOFLINE_SCALE", "0.001" if quick else "0.02"))
+    rounds = int(os.environ.get("ROOFLINE_ROUNDS", "3" if quick else "5"))
+
+    peak = measure_peak(quick)
+    print(f"[peak] STREAM triad {peak['stream_triad_gbs']} GB/s "
+          f"(n={peak['n_floats']}, best of {peak['reps']}, "
+          f"nthread={peak['nthread']})", flush=True)
+
+    configs = []
+    for cfg in FULL_CONFIGS[:2]:  # higgs-like binary + covertype multiclass
+        row = run_config(cfg, scale, rounds, peak["stream_triad_gbs"])
+        configs.append(row)
+        print(f"[{row['config']}] rows={row['rows']} "
+              f"rounds={rounds} wall={row['wall_s']}s", flush=True)
+        for name, k in row["kernels"].items():
+            print(f"  {name:10s} {k['achieved_gbs']:8.2f} GB/s "
+                  f"({k['pct_of_peak_bw']:5.1f}% peak)  "
+                  f"{k['achieved_gflops']:8.2f} GFLOP/s  "
+                  f"intensity={k['intensity_flops_per_byte']:.3f} f/B  "
+                  f"wall={k['wall_ms']:.1f}ms x{k['invocations']}",
+                  flush=True)
+
+    rc = 0
+    for row in configs:
+        missing = [k for k in REQUIRED_KERNELS if k not in row["kernels"]]
+        if missing:
+            print(f"bench_roofline: FAIL — config {row['config']} never "
+                  f"ran kernels {missing} (instrumentation or dispatch "
+                  f"regression)", flush=True)
+            rc = 1
+
+    doc = {
+        "host": _host_fingerprint(),
+        "platform": jax.devices()[0].platform,
+        "quick": quick,
+        "peak": peak,
+        "configs": configs,
+    }
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    print(f"bench_roofline: wrote {out_path}", flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -62,6 +62,51 @@ print(f"telemetry smoke OK: {len(events)} events, "
 EOF
 rm -f "$TRACE_OUT"
 
+# profiler smoke (docs/observability.md "Profiling & roofline"): a
+# traced AND profiled 5-round training run next to a 2-replica fleet —
+# the merged flame view must contain non-empty folded stacks from at
+# least two distinct processes (driver + replicas), and the collapsed
+# render must be well-formed stackcollapse lines
+XGBOOST_TPU_PROF_HZ=100 XGBOOST_TPU_TELEMETRY_INTERVAL=0.2 \
+JAX_PLATFORMS=cpu python - <<'EOF'
+import re
+import numpy as np
+import xgboost_tpu as xtb
+from xgboost_tpu.serving import ServingFleet
+from xgboost_tpu.telemetry import distributed, profiler
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(4000, 12)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+bst = xtb.train({"objective": "binary:logistic", "max_depth": 4,
+                 "seed": 0}, xtb.DMatrix(X, label=y), 5,
+                verbose_eval=False)  # train() arms the profiler
+assert profiler.running() and profiler.samples() > 0, "sampler never ran"
+with ServingFleet({"m": bst}, n_replicas=2, warmup_buckets=(64,)) as fl:
+    import time
+    for _ in range(3):
+        for f in [fl.submit("m", X[:64]) for _ in range(12)]:
+            f.result(timeout=60)
+        time.sleep(0.3)
+folded = profiler.merged_folded()
+pids = {k.split(";", 1)[0] for k in folded}
+assert len(pids) >= 2, f"folded stacks from only {pids}"
+assert all(c > 0 for c in folded.values())
+collapsed = [l for l in profiler.render_folded().splitlines()
+             if l and not l.startswith("#") and not l.startswith(" ")]
+assert collapsed and all(re.match(r"^\S.* \d+$", l) for l in collapsed), \
+    "malformed collapsed-stack lines"
+print(f"profiler smoke OK: {len(folded)} stacks from {len(pids)} "
+      f"processes, {sum(folded.values())} weighted samples")
+EOF
+
+# roofline smoke (docs/observability.md "Profiling & roofline"):
+# measured STREAM peak + per-kernel achieved GB/s rows for hist,
+# hist_q, split, predict on two ladder configs; fails when any of the
+# four headline kernels never recorded (instrumentation regression)
+JAX_PLATFORMS=cpu python scripts/bench_roofline.py \
+    bench_out/BENCH_ROOFLINE.json --quick
+
 # fault-injection smoke (docs/reliability.md): 4-process train, kill rank 2
 # at round 3 via the injected plan, resume from the newest valid checkpoint,
 # and require final-model UBJSON parity with an uninterrupted run

@@ -52,7 +52,7 @@ _QUICK_FILES = {
     "test_lifecycle.py", "test_updaters_process.py", "test_extmem.py",
     "test_integrity.py", "test_chaos.py", "test_watchdog.py",
     "test_failover.py", "test_resources.py", "test_window_store.py",
-    "test_online.py",
+    "test_online.py", "test_profiler.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)

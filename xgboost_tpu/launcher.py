@@ -133,10 +133,11 @@ elastic, journal = sys.argv[4] == "1", sys.argv[5]
 if sys.argv[6]:
     sys.path.insert(0, sys.argv[6])  # the xgboost_tpu package root
 
-from xgboost_tpu.telemetry import flight
+from xgboost_tpu.telemetry import flight, profiler
 from xgboost_tpu.tracker import RabitTracker
 
 flight.install()  # label "tracker"/"tracker_r<N>" from the launcher env
+profiler.maybe_start("tracker")  # relay loops join the merged flame view
 tr = RabitTracker(n_workers=world, host_ip=host, port=port,
                   elastic=elastic, journal=journal)
 tr.start()
@@ -181,9 +182,10 @@ if platform:
 if sys.argv[6]:
     sys.path.insert(0, sys.argv[6])  # make fn's defining module importable
 from xgboost_tpu import collective
-from xgboost_tpu.telemetry import flight, trace
+from xgboost_tpu.telemetry import flight, profiler, trace
 
 flight.install()  # ring spill + crash dump under the launcher's label env
+profiler.maybe_start()  # default-on sampler; label set by training.train
 
 rank = sys.argv[1]  # spawn label; an int only in direct mode ("respawn<N>"
                     # labels exist in elastic tracker mode)

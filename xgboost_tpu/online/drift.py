@@ -11,7 +11,11 @@ scheduler's "retrain now" edge:
 
 - ``feature_ks``: max over features of KS(reference, current) — the
   covariate-shift lens (an upstream pipeline change moves the inputs
-  before it moves anything else);
+  before it moves anything else).  The report names the top-K offending
+  features (``DriftReport.top_features``: ``(feature_index, ks)`` pairs,
+  worst first) so the postmortem starts from "feature 12 moved", not
+  "something moved", and the crossing counter carries the worst
+  feature's index as a ``feature`` label;
 - ``score_ks``: KS between reference and current SERVED scores — the
   model's own output distribution drifting under it;
 - ``score_psi``: PSI of current scores against reference deciles — broad
@@ -26,7 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -40,14 +44,17 @@ _instruments = None
 
 
 def instruments():
-    """xtb_online_drift_total{signal}."""
+    """xtb_online_drift_total{signal,feature}: ``feature`` is the worst
+    offending feature index for ``feature_ks`` crossings and empty for
+    the score-level signals (score_ks / score_psi)."""
     global _instruments
     if _instruments is None:
         reg = get_registry()
         _instruments = reg.counter(
             "xtb_online_drift_total",
             "drift threshold crossings, by signal (feature_ks | "
-            "score_ks | score_psi)", ("signal",))
+            "score_ks | score_psi) and worst offending feature",
+            ("signal", "feature"))
     return _instruments
 
 
@@ -64,6 +71,7 @@ class DriftConfig:
     max_score_psi: Optional[float] = 0.25
     min_rows: int = 64
     current_rows: int = 8192
+    top_features: int = 5  # offending features named in the report
 
     def __post_init__(self) -> None:
         if self.min_rows < 1:
@@ -74,13 +82,18 @@ class DriftConfig:
 
 @dataclasses.dataclass
 class DriftReport:
-    """One check(): per-signal statistics and which thresholds tripped."""
+    """One check(): per-signal statistics, which thresholds tripped, and
+    the top-K offending features — ``(feature_index, ks)`` pairs sorted
+    worst-first over ALL features (not only past-threshold ones, so a
+    quiet report still shows where the pressure is building)."""
 
     drifted: bool
     triggers: List[str]
     stats: Dict[str, float]
     reference_rows: int
     current_rows: int
+    top_features: List[Tuple[int, float]] = dataclasses.field(
+        default_factory=list)
 
 
 class DriftDetector:
@@ -167,10 +180,12 @@ class DriftDetector:
                 or ref_rows < cfg.min_rows or cur_rows < cfg.min_rows):
             return DriftReport(False, [], {}, ref_rows, cur_rows)
         stats: Dict[str, float] = {}
-        stats["feature_ks"] = max(
-            (_ks_stat(ref_X[:, j], cur_X[:, j])
-             for j in range(min(ref_X.shape[1], cur_X.shape[1]))),
-            default=0.0)
+        per_feature = [(j, _ks_stat(ref_X[:, j], cur_X[:, j]))
+                       for j in range(min(ref_X.shape[1], cur_X.shape[1]))]
+        # worst-first; index breaks ties so the ranking is deterministic
+        per_feature.sort(key=lambda jv: (-jv[1], jv[0]))
+        top = per_feature[:max(0, cfg.top_features)]
+        stats["feature_ks"] = top[0][1] if top else 0.0
         stats["score_ks"] = _ks_stat(ref_s, cur_s)
         stats["score_psi"] = _psi(ref_s, cur_s)
         triggers = [
@@ -180,8 +195,13 @@ class DriftDetector:
                 ("score_psi", cfg.max_score_psi))
             if limit is not None and stats[name] > limit]
         for name in triggers:
-            instruments().labels(name).inc()
-            _flight.record("event", "online.drift", signal=name,
-                           value=stats[name])
+            # attribution label: the worst offending feature index for the
+            # covariate signal, empty for the score-level ones
+            feat = str(top[0][0]) if (name == "feature_ks" and top) else ""
+            instruments().labels(name, feat).inc()
+            _flight.record(
+                "event", "online.drift", signal=name, value=stats[name],
+                **({"top_features": [[j, round(v, 4)] for j, v in top]}
+                   if name == "feature_ks" else {}))
         return DriftReport(bool(triggers), triggers, stats, ref_rows,
-                           cur_rows)
+                           cur_rows, top)

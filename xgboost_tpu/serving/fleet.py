@@ -81,6 +81,7 @@ from ..reliability import faults as _faults
 from ..reliability import resources as _resources
 from ..telemetry import distributed as _distributed
 from ..telemetry import flight as _flight
+from ..telemetry import profiler as _profiler
 from ..telemetry import trace as _trace
 from ..telemetry.registry import get_registry
 from . import wire
@@ -602,6 +603,9 @@ class ServingFleet:
         # /metrics returns driver-side xtb_fleet_* plus every replica's
         # shipped series, per-process-labeled and merged
         _distributed.start_metrics_server()
+        # default-on wall sampler: the dispatcher rx/dispatch loops join
+        # the merged flame view (telemetry/profiler.py)
+        _profiler.maybe_start("fleet-driver")
         if _trace.active():
             _trace.set_process_name("fleet-driver")
         store = ModelStore(self._store_dir)
@@ -909,7 +913,9 @@ class ServingFleet:
 
     def _ingest_telemetry(self, label: str, payload) -> None:
         """One replica telemetry frame: retain the latest snapshot +
-        flight ring under the replica's label and feed the merged view."""
+        flight ring under the replica's label and feed the merged view
+        (snapshot, flight ring, and profiler stacks — ingest_payload
+        keeps all three per source for /flight and the merged flame)."""
         try:
             data = json.loads(bytes(payload))
         except (ValueError, TypeError):
@@ -920,8 +926,7 @@ class ServingFleet:
             if snap:
                 self._telemetry[label] = snap
             self._flight_rings[label] = ring
-        if snap:
-            _distributed.get_merged().ingest(label, snap)
+        _distributed.get_merged().ingest_payload(label, data)
 
     def _finish(self, req: _Request, arr: np.ndarray) -> None:
         req.state = "done"
@@ -939,7 +944,12 @@ class ServingFleet:
             # only delivered results count: an abandoned (caller-timed-out,
             # cancelled) request's latency would skew the histogram
             lat = time.monotonic() - req.t_submit
-            self._ins.latency.labels(req.model).observe(lat)
+            # the request's trace id rides as a bucket exemplar: the
+            # /metrics scrape names the exact request behind the window's
+            # max latency per bucket ("what was the p99"), resolvable
+            # against the flight recorder / merged chrome trace
+            self._ins.latency.labels(req.model).observe(
+                lat, exemplar=req.header.get("trace"))
             self._admit_ok()
             # per-version latency: explicit version from the header, else
             # the fleet's view of the model's active version — the

@@ -466,9 +466,12 @@ def main(argv=None) -> int:
                          "default ladder)")
     args = ap.parse_args(argv)
 
-    from ..telemetry import flight, trace
+    from ..telemetry import flight, profiler, trace
 
     flight.install(args.label)
+    # default-on wall sampler: replica execute loops ship folded stacks
+    # with every telemetry frame into the driver's merged flame view
+    profiler.maybe_start(args.label)
     flight.record("event", "replica.start", label=args.label,
                   pid=os.getpid())
     if trace.active():

@@ -27,6 +27,10 @@ serving/metrics counters with no export format):
 - **Flight recorder** (flight.py): always-armed fixed-size ring of recent
   spans/events/faults, dumped on crash/kill (and driver-side for
   SIGKILL'd replicas) — postmortems without tracing enabled.
+- **Sampling profiler** (profiler.py): default-on wall sampler
+  (``XGBOOST_TPU_PROF_HZ``, a few Hz) whose folded stacks ship with
+  every telemetry payload into a driver-side merged flame view
+  (``profiler.render_folded()`` — collapsed-stack format).
 
 Quick start::
 
@@ -48,7 +52,7 @@ from .registry import (Counter, Gauge, Histogram, Registry, get_registry,
 from .spans import (PHASE_HISTOGRAM, Span, disable, enable, enabled,
                     phase_totals, record_phase, span)
 from .compile import COMPILE_EVENT, compile_delta, compiles_total
-from . import distributed, flight, native_pool, trace
+from . import distributed, flight, native_pool, profiler, trace
 from .distributed import (MergedRegistry, get_merged, snapshot_payload,
                           start_metrics_server, stop_metrics_server)
 from .callback import TelemetryCallback
@@ -59,7 +63,7 @@ __all__ = [
     "span", "Span", "enable", "disable", "enabled", "record_phase",
     "phase_totals", "PHASE_HISTOGRAM",
     "compiles_total", "compile_delta", "COMPILE_EVENT",
-    "trace", "native_pool", "distributed", "flight",
+    "trace", "native_pool", "distributed", "flight", "profiler",
     "MergedRegistry", "get_merged", "snapshot_payload",
     "start_metrics_server", "stop_metrics_server",
     "TelemetryCallback",

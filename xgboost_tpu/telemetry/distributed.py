@@ -45,7 +45,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import flight
-from .registry import _escape_help, _escape_label, _fmt, get_registry
+from .registry import (_escape_help, _escape_label, _exemplar_str, _fmt,
+                       get_registry)
 
 __all__ = [
     "snapshot_payload", "MergedRegistry", "get_merged",
@@ -84,11 +85,18 @@ def snapshot_payload() -> dict:
     dies — the SIGKILL postmortem path) plus the watchdog's liveness
     progress markers (round, collective seq, page index — what the
     tracker's stall monitor compares between ships,
-    docs/reliability.md "Coordinator failover & watchdog")."""
+    docs/reliability.md "Coordinator failover & watchdog") plus, when
+    the sampling profiler has run, its folded stacks (profiler.py —
+    the driver merges them into one flame view)."""
     from ..reliability import watchdog
+    from . import profiler
 
-    return {"snapshot": _local_snapshot(), "flight": flight.events(),
-            "progress": watchdog.markers(), "pid": os.getpid()}
+    payload = {"snapshot": _local_snapshot(), "flight": flight.events(),
+               "progress": watchdog.markers(), "pid": os.getpid()}
+    prof = profiler.folded_snapshot()
+    if prof is not None:
+        payload["profile"] = prof
+    return payload
 
 
 # ---------------------------------------------------------------------------
@@ -111,10 +119,23 @@ class MergedRegistry:
     ``render_prometheus()`` emits one text exposition with per-process
     (``proc=``-labeled) and merged (unlabeled) samples per family;
     kind/label conflicts across sources keep the first-seen signature and
-    skip the conflicting source's contribution for that family."""
+    skip the conflicting source's contribution for that family.
+
+    Staleness: every ingest stamps a monotonic receive time; a source
+    whose last snapshot is older than 3x :func:`ship_interval` renders
+    its per-process samples with an extra ``stale="1"`` label instead of
+    presenting dead numbers as fresh (the merged samples still include
+    them — last-known-value semantics are deliberate for postmortems,
+    the label just says so).  ``ingest_payload`` additionally retains the
+    shipped flight-recorder ring and profiler stacks per source for the
+    ``/flight`` endpoint and the merged flame view (profiler.py)."""
+
+    STALE_FACTOR = 3.0
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        # source -> {"snapshot": dict, "t": float monotonic,
+        #            "flight": list|None, "profile": dict|None}
         self._sources: "OrderedDict[str, dict]" = OrderedDict()
 
     # ------------------------------------------------------------- ingest
@@ -122,7 +143,33 @@ class MergedRegistry:
         if not isinstance(snapshot, dict):
             return
         with self._lock:
-            self._sources[str(source)] = snapshot
+            entry = self._sources.get(str(source))
+            if entry is None:
+                entry = self._sources[str(source)] = {
+                    "flight": None, "profile": None}
+            entry["snapshot"] = snapshot
+            entry["t"] = time.monotonic()
+
+    def ingest_payload(self, source: str, payload: dict) -> None:
+        """Ingest a full :func:`snapshot_payload` — the registry snapshot
+        plus the side-band flight ring and profiler stacks.  A payload
+        without a snapshot still refreshes the source's staleness clock
+        (the process is alive and shipping)."""
+        if not isinstance(payload, dict):
+            return
+        snap = payload.get("snapshot")
+        with self._lock:
+            entry = self._sources.get(str(source))
+            if entry is None:
+                entry = self._sources[str(source)] = {
+                    "snapshot": {}, "flight": None, "profile": None}
+            if isinstance(snap, dict) and snap:
+                entry["snapshot"] = snap
+            entry["t"] = time.monotonic()
+            if isinstance(payload.get("flight"), list):
+                entry["flight"] = payload["flight"]
+            if isinstance(payload.get("profile"), dict):
+                entry["profile"] = payload["profile"]
 
     def forget(self, source: str) -> None:
         with self._lock:
@@ -136,15 +183,48 @@ class MergedRegistry:
         with self._lock:
             return list(self._sources)
 
+    def profiles(self) -> Dict[str, dict]:
+        """{source: latest shipped profiler snapshot} (profiler.py merges
+        these into the driver-side flame view)."""
+        with self._lock:
+            return {s: e["profile"] for s, e in self._sources.items()
+                    if e.get("profile")}
+
+    def flight_rings(self) -> Dict[str, list]:
+        """{source: latest shipped flight-recorder ring} — served by the
+        ``/flight`` endpoint."""
+        with self._lock:
+            return {s: e["flight"] for s, e in self._sources.items()
+                    if e.get("flight")}
+
+    def staleness(self) -> Dict[str, float]:
+        """{source: seconds since last ingest} (monotonic)."""
+        now = time.monotonic()
+        with self._lock:
+            return {s: max(0.0, now - e.get("t", now))
+                    for s, e in self._sources.items()}
+
+    def _stale_cutoff(self) -> float:
+        return self.STALE_FACTOR * ship_interval()
+
     def _snapshot_items(self, include_local: bool,
                         local_source: str) -> List[Tuple[str, dict]]:
         items: List[Tuple[str, dict]] = []
         if include_local:
             items.append((local_source, _local_snapshot()))
         with self._lock:
-            items.extend((s, snap) for s, snap in self._sources.items()
-                         if s != local_source or not include_local)
+            items.extend((s, e["snapshot"])
+                         for s, e in self._sources.items()
+                         if "snapshot" in e
+                         and (s != local_source or not include_local))
         return items
+
+    def _stale_sources(self) -> set:
+        cutoff = self._stale_cutoff()
+        now = time.monotonic()
+        with self._lock:
+            return {s for s, e in self._sources.items()
+                    if now - e.get("t", now) > cutoff}
 
     # ------------------------------------------------------------- totals
     def merged_totals(self, name: str, include_local: bool = True,
@@ -171,6 +251,7 @@ class MergedRegistry:
                           local_source: str = "driver") -> str:
         from .catalog import help_for
 
+        stale = self._stale_sources()
         fams: "OrderedDict[str, dict]" = OrderedDict()
         for source, snap in self._snapshot_items(include_local,
                                                  local_source):
@@ -202,20 +283,28 @@ class MergedRegistry:
                 lines.append(f"# HELP {name} {_escape_help(help_text)}")
             lines.append(f"# TYPE {name} {e['kind']}")
             if e["kind"] == "histogram":
-                self._render_hist(lines, name, e)
+                self._render_hist(lines, name, e, stale)
             else:
-                self._render_scalar(lines, name, e)
+                self._render_scalar(lines, name, e, stale)
         return "\n".join(lines) + "\n"
 
     @staticmethod
-    def _render_scalar(lines: List[str], name: str, e: dict) -> None:
+    def _proc_pairs(source: str, stale: set) -> List[Tuple[str, str]]:
+        pairs = [(PROC_LABEL, source)]
+        if source in stale:
+            pairs.append(("stale", "1"))
+        return pairs
+
+    @staticmethod
+    def _render_scalar(lines: List[str], name: str, e: dict,
+                       stale: set) -> None:
         merged: "OrderedDict[Tuple[str, ...], float]" = OrderedDict()
         for source, f in e["rows"]:
             for child in sorted(f.get("children", ())):
                 values = tuple(str(v) for v in child[0])
                 val = float(child[1])
-                pairs = [(PROC_LABEL, source)] + list(zip(e["labels"],
-                                                          values))
+                pairs = MergedRegistry._proc_pairs(source, stale) + list(
+                    zip(e["labels"], values))
                 lines.append(f"{name}{_label_str(pairs)} {_fmt(val)}")
                 merged[values] = merged.get(values, 0.0) + val
         for values, val in merged.items():
@@ -223,7 +312,8 @@ class MergedRegistry:
             lines.append(f"{name}{_label_str(pairs)} {_fmt(val)}")
 
     @staticmethod
-    def _render_hist(lines: List[str], name: str, e: dict) -> None:
+    def _render_hist(lines: List[str], name: str, e: dict,
+                     stale: set) -> None:
         bounds = e["buckets"]
         # merged accumulation only over sources whose bounds match the
         # first-seen family (mismatched bounds still render per-process)
@@ -240,36 +330,54 @@ class MergedRegistry:
                 s = float(child[2])
                 if len(counts) != len(f_bounds) + 1:
                     continue  # malformed shipment
-                base = [(PROC_LABEL, source)] + list(zip(e["labels"],
-                                                         values))
+                # optional 5th element: exemplars as [bucket_i, value,
+                # trace] triples (registry.py snapshot)
+                ex: Dict[int, Tuple[float, str]] = {}
+                if len(child) > 4 and isinstance(child[4], list):
+                    for row in child[4]:
+                        try:
+                            ex[int(row[0])] = (float(row[1]), str(row[2]))
+                        except (TypeError, ValueError, IndexError):
+                            continue
+                base = MergedRegistry._proc_pairs(source, stale) + list(
+                    zip(e["labels"], values))
                 cum = 0
-                for b, c in zip(f_bounds, counts):
+                for i, (b, c) in enumerate(zip(f_bounds, counts)):
                     cum += c
                     pairs = base + [("le", _fmt(b))]
-                    lines.append(f"{name}_bucket{_label_str(pairs)} {cum}")
+                    lines.append(f"{name}_bucket{_label_str(pairs)} {cum}"
+                                 f"{_exemplar_str(ex.get(i))}")
                 cum += counts[-1]
                 lines.append(
                     f"{name}_bucket{_label_str(base + [('le', '+Inf')])} "
-                    f"{cum}")
+                    f"{cum}{_exemplar_str(ex.get(len(counts) - 1))}")
                 lines.append(f"{name}_sum{_label_str(base)} {_fmt(s)}")
                 lines.append(f"{name}_count{_label_str(base)} {cum}")
                 if mergeable:
                     acc = merged.get(values)
                     if acc is None:
-                        acc = merged[values] = [[0] * len(counts), 0.0]
+                        acc = merged[values] = [[0] * len(counts), 0.0, {}]
                     for i, c in enumerate(counts):
                         acc[0][i] += c
                     acc[1] += s
-        for values, (counts, s) in merged.items():
+                    for i, pair in ex.items():
+                        # merged exemplar per bucket: the max-latency
+                        # observation across sources — "what was the p99"
+                        cur = acc[2].get(i)
+                        if cur is None or pair[0] >= cur[0]:
+                            acc[2][i] = pair
+        for values, (counts, s, ex) in merged.items():
             base = list(zip(e["labels"], values))
             cum = 0
-            for b, c in zip(bounds, counts):
+            for i, (b, c) in enumerate(zip(bounds, counts)):
                 cum += c
                 pairs = base + [("le", _fmt(b))]
-                lines.append(f"{name}_bucket{_label_str(pairs)} {cum}")
+                lines.append(f"{name}_bucket{_label_str(pairs)} {cum}"
+                             f"{_exemplar_str(ex.get(i))}")
             cum += counts[-1]
             lines.append(
-                f"{name}_bucket{_label_str(base + [('le', '+Inf')])} {cum}")
+                f"{name}_bucket{_label_str(base + [('le', '+Inf')])} {cum}"
+                f"{_exemplar_str(ex.get(len(counts) - 1))}")
             lines.append(f"{name}_sum{_label_str(base)} {_fmt(s)}")
             lines.append(f"{name}_count{_label_str(base)} {cum}")
 
@@ -292,17 +400,26 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     server_version = "xtb-metrics/1"
 
     def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
-        if self.path.split("?", 1)[0].rstrip("/") not in ("", "/metrics"):
+        route = self.path.split("?", 1)[0].rstrip("/")
+        if route in ("", "/metrics"):
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+            renderer = self.server.render  # type: ignore[attr-defined]
+        elif route == "/healthz":
+            ctype = "application/json"
+            renderer = self.server.render_healthz  # type: ignore
+        elif route == "/flight":
+            ctype = "application/json"
+            renderer = self.server.render_flight  # type: ignore
+        else:
             self.send_error(404)
             return
         try:
-            body = self.server.render().encode("utf-8")  # type: ignore
+            body = renderer().encode("utf-8")
         except Exception as e:  # pragma: no cover - render must not 500
             self.send_error(500, str(e))
             return
         self.send_response(200)
-        self.send_header("Content-Type",
-                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -335,6 +452,34 @@ class MetricsServer(ThreadingHTTPServer):
     def render(self) -> str:
         m = self._merged if self._merged is not None else get_merged()
         return m.render_prometheus(include_local=self._include_local)
+
+    def _m(self) -> MergedRegistry:
+        return self._merged if self._merged is not None else get_merged()
+
+    def render_healthz(self) -> str:
+        """Liveness + per-source staleness: {"status", "pid", "stale_after_s",
+        "sources": {name: {"age_s", "stale"}}}.  200 as long as the server
+        answers — the staleness map is the caller's signal, not the code."""
+        import json
+
+        m = self._m()
+        cutoff = m._stale_cutoff()
+        sources = {s: {"age_s": round(age, 3), "stale": age > cutoff}
+                   for s, age in m.staleness().items()}
+        return json.dumps({"status": "ok", "pid": os.getpid(),
+                           "stale_after_s": round(cutoff, 3),
+                           "sources": sources}, sort_keys=True)
+
+    def render_flight(self) -> str:
+        """Most recent flight-recorder rings as JSON: every shipped
+        source's ring plus (when local is included) this process's own
+        under "driver"."""
+        import json
+
+        rings = dict(self._m().flight_rings())
+        if self._include_local:
+            rings.setdefault("driver", flight.events())
+        return json.dumps(rings, sort_keys=True, default=str)
 
     @property
     def port(self) -> int:

@@ -11,7 +11,12 @@ the DELTAS since the previous sync into three registry families:
 - ``xtb_native_parallel_regions_total{kernel}`` (counter) — multi-shard
   parallel regions dispatched (inline/single-shard runs are not regions);
 - ``xtb_native_busy_seconds{kernel}`` (histogram) — per-region busy seconds
-  summed over the participating threads.
+  summed over the participating threads;
+- ``xtb_native_kernel_cycles_total{kernel}`` /
+  ``xtb_native_kernel_bytes_total{kernel}`` (counters) — cycle counts
+  (rdtsc/cntvct) and modeled bytes touched from the per-invocation
+  XtbKernelPerf scopes, the inputs to roofline attribution
+  (scripts/bench_roofline.py).
 
 Metrics appear only after the first ``sync()``: the pool is C++ and cannot
 push into the Python registry itself, so scrape endpoints and snapshot
@@ -30,6 +35,8 @@ _lock = threading.Lock()
 # per-kernel last-seen (regions, busy_ns, buckets) so repeated syncs fold
 # only the delta into the monotone registry families
 _seen: Dict[str, Tuple[int, int, Tuple[int, ...]]] = {}
+# per-kernel last-seen (cycles, bytes) for the perf-counter families
+_seen_perf: Dict[str, Tuple[int, int]] = {}
 
 
 def sync() -> dict:
@@ -47,6 +54,14 @@ def sync() -> dict:
     busy = reg.histogram(
         "xtb_native_busy_seconds",
         "per-region busy seconds (summed over participating threads)",
+        ("kernel",))
+    cycles = reg.counter(
+        "xtb_native_kernel_cycles_total",
+        "cpu cycles spent inside native kernel invocations (rdtsc)",
+        ("kernel",))
+    nbytes = reg.counter(
+        "xtb_native_kernel_bytes_total",
+        "modeled bytes touched by native kernel invocations",
         ("kernel",))
     with _lock:
         for name, k in stats["kernels"].items():
@@ -69,4 +84,13 @@ def sync() -> dict:
                     d_buckets, d_busy_ns * 1e-9, d_count)
             _seen[name] = (k["regions"], k["busy_ns"],
                            tuple(k["buckets"]))
+            pprev = _seen_perf.get(name, (0, 0))
+            d_cycles = max(int(k.get("cycles", 0)) - pprev[0], 0)
+            d_bytes = max(int(k.get("bytes", 0)) - pprev[1], 0)
+            if d_cycles > 0:
+                cycles.labels(name).inc(d_cycles)
+            if d_bytes > 0:
+                nbytes.labels(name).inc(d_bytes)
+            _seen_perf[name] = (int(k.get("cycles", 0)),
+                                int(k.get("bytes", 0)))
     return stats

@@ -22,6 +22,7 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 from collections import OrderedDict
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -191,8 +192,13 @@ class Gauge(_ScalarFamily):
         self.labels().dec(amount)
 
 
+EXEMPLAR_WINDOW_S = 60.0  # a bucket's max-latency exemplar ages out after
+# this many (monotonic) seconds, so one startup outlier cannot pin the
+# bucket's trace id forever
+
+
 class _HistogramChild:
-    __slots__ = ("_lock", "_bounds", "counts", "sum", "count")
+    __slots__ = ("_lock", "_bounds", "counts", "sum", "count", "exemplars")
 
     def __init__(self, lock: threading.Lock, bounds: Tuple[float, ...]):
         self._lock = lock
@@ -200,13 +206,36 @@ class _HistogramChild:
         self.counts = [0] * (len(bounds) + 1)  # last = +Inf overflow
         self.sum = 0.0
         self.count = 0
+        # per-bucket (value, trace_id, t_mono) of the max observation in
+        # the current window, or None; allocated on first exemplar so
+        # exemplar-free histograms pay nothing
+        self.exemplars: Optional[list] = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         i = bisect.bisect_left(self._bounds, v)
         with self._lock:
             self.counts[i] += 1
             self.sum += v
             self.count += 1
+            if exemplar is not None:
+                ex = self.exemplars
+                if ex is None:
+                    ex = self.exemplars = [None] * len(self.counts)
+                cur = ex[i]
+                now = time.monotonic()
+                if (cur is None or v >= cur[0]
+                        or now - cur[2] > EXEMPLAR_WINDOW_S):
+                    ex[i] = (float(v), str(exemplar), now)
+
+    def exemplar_items(self) -> List[Tuple[int, float, str]]:
+        """[(bucket_index, value, trace_id)] for buckets holding a live
+        (non-aged-out) exemplar."""
+        with self._lock:
+            if not self.exemplars:
+                return []
+            now = time.monotonic()
+            return [(i, e[0], e[1]) for i, e in enumerate(self.exemplars)
+                    if e is not None and now - e[2] <= EXEMPLAR_WINDOW_S]
 
     def merge_bucketed(self, counts: Sequence[int], sum_: float,
                        count: int) -> None:
@@ -242,8 +271,8 @@ class Histogram(_Family):
     def _child(self) -> _HistogramChild:
         return _HistogramChild(self._lock, self.buckets)
 
-    def observe(self, v: float) -> None:
-        self.labels().observe(v)
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
+        self.labels().observe(v, exemplar)
 
     def snapshot_sums(self) -> Dict[Tuple[str, ...], Tuple[int, float]]:
         """{label values: (count, sum)} — the cheap read the per-round
@@ -256,14 +285,17 @@ class Histogram(_Family):
 
     def render(self) -> Iterable[str]:
         for values, child in sorted(self.collect()):
+            ex = {i: (v, t) for i, v, t in child.exemplar_items()}
             cum = 0
-            for bound, c in zip(self.buckets, child.counts):
+            for i, (bound, c) in enumerate(zip(self.buckets, child.counts)):
                 cum += c
                 le = self._label_str(values, f'le="{_fmt(bound)}"')
-                yield f"{self.name}_bucket{le} {cum}"
+                yield (f"{self.name}_bucket{le} {cum}"
+                       f"{_exemplar_str(ex.get(i))}")
             cum += child.counts[-1]
             le = self._label_str(values, 'le="+Inf"')
-            yield f"{self.name}_bucket{le} {cum}"
+            yield (f"{self.name}_bucket{le} {cum}"
+                   f"{_exemplar_str(ex.get(len(child.counts) - 1))}")
             yield (f"{self.name}_sum{self._label_str(values)} "
                    f"{_fmt(child.sum)}")
             yield f"{self.name}_count{self._label_str(values)} {cum}"
@@ -274,6 +306,18 @@ def _fmt(v: float) -> str:
         return "+Inf"
     f = float(v)
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _exemplar_str(pair: Optional[Tuple[float, str]]) -> str:
+    """OpenMetrics exemplar suffix for a bucket sample line:
+    `` # {trace="<id>"} <value>`` — the trace id of the window's
+    max-latency observation, resolvable against the flight recorder /
+    chrome trace (shared with distributed.py so local and merged
+    exposition agree byte-for-byte)."""
+    if pair is None:
+        return ""
+    v, trace = pair
+    return f' # {{trace="{_escape_label(trace)}"}} {_fmt(v)}'
 
 
 class Registry:
@@ -335,7 +379,10 @@ class Registry:
         Scalars ship ``[label_values, value]``; histograms ship
         ``[label_values, bucket_counts, sum, count]`` with the family's
         bucket bounds alongside, so the receiver can fold them via the
-        same bucketed-merge path the native pool bridge uses."""
+        same bucketed-merge path the native pool bridge uses.  A
+        histogram child holding live latency exemplars appends a fifth
+        element ``[[bucket_i, value, trace], ...]`` — receivers that
+        predate exemplars ignore extra elements."""
         fams = []
         for fam in self.families():
             rec: dict = {"name": fam.name, "kind": fam.kind,
@@ -343,10 +390,15 @@ class Registry:
                          "labels": list(fam.label_names)}
             if fam.kind == "histogram":
                 rec["buckets"] = [float(b) for b in fam.buckets]
-                rec["children"] = [
-                    [list(values), [int(c) for c in child.counts],
-                     float(child.sum), int(child.count)]
-                    for values, child in fam.collect()]
+                children = []
+                for values, child in fam.collect():
+                    row = [list(values), [int(c) for c in child.counts],
+                           float(child.sum), int(child.count)]
+                    ex = child.exemplar_items()
+                    if ex:
+                        row.append([[i, v, t] for i, v, t in ex])
+                    children.append(row)
+                rec["children"] = children
             else:
                 rec["children"] = [[list(values), float(child.value)]
                                    for values, child in fam.collect()]

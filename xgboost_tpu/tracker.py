@@ -889,20 +889,21 @@ class RabitTracker:
         source = f"rank{rank}"
         payload = {"snapshot": msg.get("snapshot"),
                    "flight": msg.get("flight") or [],
+                   "profile": msg.get("profile"),
                    "pid": msg.get("pid")}
         with self._lock:
             self.telemetry[source] = payload
         marks = msg.get("progress")
         if isinstance(marks, dict) and marks:
             self._ingest_progress(rank, marks)
-        snap = payload["snapshot"]
-        if snap:
-            try:
-                from .telemetry.distributed import get_merged
+        try:
+            from .telemetry.distributed import get_merged
 
-                get_merged().ingest(source, snap)
-            except Exception:  # pragma: no cover - telemetry must not kill
-                pass           # the rendezvous channel
+            # snapshot + flight ring + profiler stacks per rank: the
+            # merged flame view and /flight endpoint read these back
+            get_merged().ingest_payload(source, payload)
+        except Exception:  # pragma: no cover - telemetry must not kill
+            pass           # the rendezvous channel
 
     def _ingest_progress(self, rank: int, marks: dict) -> None:
         """One rank's liveness markers.  The staleness clock only resets

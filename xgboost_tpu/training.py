@@ -176,6 +176,12 @@ def train(
     elastic mode.  Requires an elastic-capable collective backend
     (tracker relay or in-memory) — docs/reliability.md § Elastic
     training."""
+    from .telemetry import profiler
+
+    # default-on wall sampler (XGBOOST_TPU_PROF_HZ=0 disables): training
+    # rounds show up in the merged flame view; sampling only reads
+    # frames, so the trained model is bitwise-identical either way
+    profiler.maybe_start("train")
     callbacks = list(callbacks) if callbacks else []
     evals = list(evals) if evals else []
     if isinstance(dtrain, ExtMemConfig):

@@ -128,6 +128,7 @@ _NTHREAD: Optional[int] = None  # last applied effective thread count
 _FFI_LIB = None                 # CDLL handle kept for the pool ABI
 
 POOL_STAT_SLOTS = 13  # [regions, busy_ns, bucket_0 .. bucket_10]
+POOL_PERF_SLOTS = 5   # [invocations, wall_ns, cycles, bytes, flops]
 
 
 def _bind_pool_abi(lib) -> None:
@@ -142,6 +143,9 @@ def _bind_pool_abi(lib) -> None:
     lib.xtb_pool_kernel_name.restype = c.c_char_p
     lib.xtb_pool_kernel_name.argtypes = [c.c_int]
     lib.xtb_pool_kernel_stats.argtypes = [c.c_int, c.c_void_p]
+    lib.xtb_pool_kernel_perf.argtypes = [c.c_int, c.c_void_p]
+    lib.xtb_stream_triad.argtypes = [c.c_void_p, c.c_void_p, c.c_float,
+                                     c.c_void_p, c.c_int64]
     lib.xtb_pool_instance_id.restype = c.c_uint64
     lib.xtb_simd_set.restype = c.c_int
     lib.xtb_simd_set.argtypes = [c.c_int]
@@ -311,9 +315,12 @@ def _pool_fault_probe() -> None:
 def pool_stats() -> dict:
     """Aggregated pool counters across loaded libraries:
     ``{"nthread", "alive_workers", "faults_total", "regions_total",
-    "kernels": {name: {"regions", "busy_ns", "buckets": [11]}}}``.
-    The Python-side telemetry bridge (telemetry/native_pool.py) folds the
-    deltas into the metrics registry."""
+    "kernels": {name: {"regions", "busy_ns", "buckets": [11],
+    "invocations", "wall_ns", "cycles", "bytes", "flops"}}}``.
+    The last five come from the per-kernel XtbKernelPerf scopes (rdtsc
+    cycles + modeled bytes/flops); the Python-side telemetry bridge
+    (telemetry/native_pool.py) folds the deltas into the registry and
+    scripts/bench_roofline.py turns them into achieved GB/s."""
     out = {
         "nthread": get_nthread(),
         "alive_workers": 0,
@@ -326,17 +333,45 @@ def pool_stats() -> dict:
         out["faults_total"] += int(lib.xtb_pool_faults_total())
         out["regions_total"] += int(lib.xtb_pool_regions_total())
         buf = (ctypes.c_int64 * POOL_STAT_SLOTS)()
+        pbuf = (ctypes.c_int64 * POOL_PERF_SLOTS)()
         for k in range(int(lib.xtb_pool_n_kernels())):
             name = lib.xtb_pool_kernel_name(k).decode()
             lib.xtb_pool_kernel_stats(k, buf)
+            lib.xtb_pool_kernel_perf(k, pbuf)
             agg = out["kernels"].setdefault(
                 name, {"regions": 0, "busy_ns": 0,
-                       "buckets": [0] * (POOL_STAT_SLOTS - 2)})
+                       "buckets": [0] * (POOL_STAT_SLOTS - 2),
+                       "invocations": 0, "wall_ns": 0, "cycles": 0,
+                       "bytes": 0, "flops": 0})
             agg["regions"] += int(buf[0])
             agg["busy_ns"] += int(buf[1])
             for i in range(POOL_STAT_SLOTS - 2):
                 agg["buckets"][i] += int(buf[2 + i])
+            for i, key in enumerate(("invocations", "wall_ns", "cycles",
+                                     "bytes", "flops")):
+                agg[key] += int(pbuf[i])
     return out
+
+
+def stream_triad(b, c, scalar, a) -> bool:
+    """Run the native STREAM-style triad ``a[i] = b[i] + scalar*c[i]``
+    through the ParallelFor pool (scripts/bench_roofline.py's host-peak
+    probe).  Arrays must be contiguous float32 of equal length.  Returns
+    False when no native library is loaded (caller falls back to numpy)."""
+    import numpy as np
+
+    for lib in _pool_libs():
+        n = int(a.shape[0])
+        assert b.shape[0] == n and c.shape[0] == n
+        lib.xtb_stream_triad(
+            b.ctypes.data_as(ctypes.c_void_p),
+            c.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_float(float(scalar)),
+            a.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(n))
+        return True
+    a[:] = b + np.float32(scalar) * c
+    return False
 
 
 _FFI_READY: Optional[bool] = None
