@@ -157,7 +157,8 @@ JAX_PLATFORMS=cpu python scripts/bench_obs.py bench_out/BENCH_OBS.json
 # composed-fault chaos soak (docs/reliability.md "Integrity & chaos"):
 # >= 20 seeded multi-fault episodes round-robin across the scenario
 # templates (extmem / fleet / lifecycle / online / elastic /
-# tracker_kill / stall / resource), each checked for no-hang, bitwise-vs-twin, fault
+# tracker_kill / stall / resource / fleet_degraded / net_partition),
+# each checked for no-hang, bitwise-vs-twin, fault
 # accounting, zero dropped requests, and a flight dump per death; the
 # run ends by replaying episode 0's seed and requiring the identical
 # schedule and outcome.  Any red episode prints its one-command repro

@@ -174,6 +174,51 @@ def test_wire_large_payload_and_eof():
         b.close()
 
 
+def test_wire_recv_frame_slow_loris_bound():
+    """A peer trickling a frame one byte per interval exhausts ONE
+    cumulative frame budget (clocked from the first prefix byte), not an
+    idle timeout reset on every byte."""
+    import socket
+    import threading
+
+    X = np.zeros((4, 4), np.float32)
+    fields, payload = wire.encode_raw(X)
+    a, b = _socketpair()
+    c, d = _socketpair()
+    try:
+        wire.send_frame(a, dict(fields, op="predict", id=1), payload)
+        a.shutdown(socket.SHUT_WR)
+        blob = b"".join(iter(lambda: b.recv(65536), b""))
+
+        def _trickle():
+            try:
+                for i in range(len(blob)):
+                    c.sendall(blob[i:i + 1])
+                    time.sleep(0.02)
+            except OSError:
+                pass  # the reader gave up and closed: expected
+
+        threading.Thread(target=_trickle, daemon=True).start()
+        t0 = time.monotonic()
+        with pytest.raises(wire.WireError, match="slow-loris"):
+            wire.recv_frame(d, budget_s=0.3)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        for s in (a, b, c, d):
+            s.close()
+    # the budget is a trickle bound, not a size bound: an intact frame
+    # inside it still parses
+    e, f = _socketpair()
+    try:
+        wire.send_frame(e, dict(fields, op="predict", id=2), payload)
+        hdr, body = wire.recv_frame(f, budget_s=30.0)
+        assert hdr["id"] == 2
+        np.testing.assert_array_equal(wire.decode_matrix(hdr, body), X)
+    finally:
+        e.close()
+        f.close()
+
+
 def test_wire_arrow_roundtrip_parity():
     pa = pytest.importorskip("pyarrow")
     X = np.random.default_rng(1).normal(size=(50, 5)).astype(np.float32)
@@ -550,6 +595,128 @@ def test_fleet_queue_shed_under_pressure(fleet_models):
         assert gold.result(timeout=60) is not None
         ev.wait(timeout=60)
         assert box["f"].result(timeout=60) is not None
+
+
+# =========================================================================
+# degraded-network survival: kill/respawn churn, breaker readmission via
+# heartbeat probe, hedged dispatch neutrality (docs/reliability.md
+# "Degraded networks")
+
+
+def _counter(name, *labels):
+    from xgboost_tpu.telemetry.registry import get_registry
+
+    fam = get_registry().get(name)
+    if fam is None:
+        return 0.0
+    if labels:
+        for values, child in fam.collect():
+            if values == tuple(labels):
+                return float(child.value)
+        return 0.0
+    return sum(child.value for _v, child in fam.collect())
+
+
+@pytest.mark.slow
+def test_fleet_kill_respawn_churn_deterministic(fleet_models, tmp_path):
+    """20 kill/respawn cycles: every request completes with the exact
+    reference bits (zero drops), the fleet returns to full strength each
+    cycle, and the respawn accounting is monotonic."""
+    X = fleet_models["X"]
+    ref = fleet_models["ref_a"]
+    with ServingFleet({"a": fleet_models["a"]}, n_replicas=2,
+                      cache_dir=str(tmp_path / "cache"), max_respawns=25,
+                      warmup_buckets=(64,)) as fleet:
+        np.testing.assert_array_equal(
+            fleet.predict("a", X, timeout=120), ref)
+        for cycle in range(20):
+            with fleet._cv:
+                victim = next(r for r in fleet._replicas.values()
+                              if r.alive and r.proc is not None)
+            futs = [fleet.submit("a", X) for _ in range(4)]
+            victim.proc.send_signal(signal.SIGKILL)
+            for fut in futs:  # nothing dropped, nothing wrong
+                np.testing.assert_array_equal(fut.result(timeout=120), ref)
+            deadline = time.monotonic() + 120
+            while (fleet.alive_replicas() < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert fleet.alive_replicas() == 2, f"cycle {cycle}"
+            assert fleet._respawned == cycle + 1
+        np.testing.assert_array_equal(
+            fleet.predict("a", X, timeout=120), ref)
+
+
+@pytest.mark.slow
+def test_fleet_breaker_pong_probe_readmits_without_traffic(fleet_models):
+    """The EWMA breaker ejects a laggy replica; with NO further traffic
+    (a healthy sibling absorbs everything), the first heartbeat pong
+    after cooldown is the half-open probe and readmits it — readmission
+    must not depend on starving the healthy replicas first."""
+    X = fleet_models["X"][:32]
+    opened0 = _counter("xtb_net_breaker_transitions_total", "open")
+    closed0 = _counter("xtb_net_breaker_transitions_total", "closed")
+    with ServingFleet({"a": fleet_models["a"]}, n_replicas=2,
+                      warmup_buckets=(64,), heartbeat_s=0.2,
+                      heartbeat_timeout_s=10.0, breaker_latency_s=0.05,
+                      breaker_cooldown_s=0.4) as fleet:
+        ref = fleet.predict("a", X, timeout=60)
+        # every replica0 frame (results and pongs alike) arrives 0.3s
+        # late: the EWMA trips past the 50ms threshold immediately
+        faults.install({"faults": [{"site": "wire.recv", "kind": "delay",
+                                    "seconds": 0.3, "rank": "replica0",
+                                    "times": 16}]})
+        deadline = time.monotonic() + 30
+        while (_counter("xtb_net_breaker_transitions_total", "open")
+               == opened0 and time.monotonic() < deadline):
+            np.testing.assert_array_equal(
+                fleet.predict("a", X, timeout=60), ref)
+        assert _counter("xtb_net_breaker_transitions_total",
+                        "open") > opened0
+        faults.clear()  # the link heals; no requests from here on
+        deadline = time.monotonic() + 10
+        while (_counter("xtb_net_breaker_transitions_total", "closed")
+               == closed0 and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert _counter("xtb_net_breaker_transitions_total",
+                        "closed") > closed0
+        with fleet._cv:
+            assert fleet._replicas["replica0"].breaker == "closed"
+        np.testing.assert_array_equal(
+            fleet.predict("a", X, timeout=60), ref)
+
+
+@pytest.mark.slow
+def test_fleet_hedged_dispatch_bitwise_neutral(fleet_models):
+    """Hedging past the latency-quantile budget returns whichever copy
+    settles first — and the bytes are the reference's either way (the
+    twin shares the future; replicas are deterministic)."""
+    X = fleet_models["X"][:48]
+    with ServingFleet({"a": fleet_models["a"]}, n_replicas=2,
+                      warmup_buckets=(64,), heartbeat_s=0.1,
+                      heartbeat_timeout_s=30.0,
+                      hedge_quantile=0.5, hedge_min_s=0.05) as fleet:
+        ref = fleet.predict("a", X, timeout=60)
+        for _ in range(9):  # latency history >= 8 arms the hedge budget
+            np.testing.assert_array_equal(
+                fleet.predict("a", X, timeout=60), ref)
+        hedges0 = _counter("xtb_net_hedges_total")
+        wins0 = _counter("xtb_net_hedge_wins_total")
+        # replica0's rx path stalls 0.8s per frame: an in-flight request
+        # ages past the ~ms p50 budget and hedges onto replica1
+        faults.install({"faults": [{"site": "wire.recv", "kind": "delay",
+                                    "seconds": 0.8, "rank": "replica0",
+                                    "times": 12}]})
+        deadline = time.monotonic() + 30
+        while (_counter("xtb_net_hedges_total") == hedges0
+               and time.monotonic() < deadline):
+            np.testing.assert_array_equal(
+                fleet.predict("a", X, timeout=60), ref)
+        assert _counter("xtb_net_hedges_total") > hedges0
+        assert _counter("xtb_net_hedge_wins_total") > wins0
+        faults.clear()
+        np.testing.assert_array_equal(
+            fleet.predict("a", X, timeout=60), ref)
 
 
 # =========================================================================

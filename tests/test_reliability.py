@@ -166,6 +166,69 @@ def test_faults_counted_in_telemetry():
 
 
 # =========================================================================
+# degraded-network fault kinds (docs/reliability.md "Degraded networks")
+
+
+def _spec_for(kind, site="s", **kw):
+    faults.install({"faults": [dict({"site": site, "kind": kind}, **kw)]})
+    return faults.active().specs[0]
+
+
+def test_jitter_seconds_seeded_per_invocation():
+    """latency-kind jitter is a pure function of (seed, invocation):
+    frame N of a replay jitters by exactly what frame N drew last run."""
+    spec = _spec_for("latency", seconds=0.5, jitter_seed=7)
+    draws = [faults.jitter_seconds(spec, i) for i in range(64)]
+    assert draws == [faults.jitter_seconds(spec, i) for i in range(64)]
+    assert all(0.0 <= d < 0.5 for d in draws)
+    assert len(set(draws)) > 32  # per-frame variation, not one constant
+    other = _spec_for("latency", seconds=0.5, jitter_seed=8)
+    assert [faults.jitter_seconds(other, i) for i in range(64)] != draws
+
+
+def test_throttle_seconds_is_link_arithmetic():
+    spec = _spec_for("throttle", bytes_per_s=1_000_000.0)
+    assert faults.throttle_seconds(spec, 500_000) == pytest.approx(0.5)
+    assert faults.throttle_seconds(spec, 0) == 0.0
+    # an unshaped (rate <= 0) spec delays nothing rather than dividing
+    assert faults.throttle_seconds(_spec_for("throttle"), 1 << 20) == 0.0
+
+
+def test_partition_blocks_stable_seeded_bipartition():
+    """One seed cuts a deterministic peer subset; the same seed at a
+    different seam cuts an independent side (that independence is what
+    makes a single plan produce asymmetric, half-open links); a peer
+    unknown at the seam is never blocked."""
+    peers = [f"replica{i}" for i in range(16)] + list(range(16))
+    tx = _spec_for("partition", site="tx", jitter_seed=5)
+    cut = {p for p in peers if faults.partition_blocks(tx, p)}
+    assert cut == {p for p in peers if faults.partition_blocks(tx, p)}
+    assert 0 < len(cut) < len(peers)
+    assert faults.partition_blocks(tx, None) is False
+    rx = _spec_for("partition", site="rx", jitter_seed=5)
+    rx_cut = {p for p in peers if faults.partition_blocks(rx, p)}
+    assert rx_cut != cut  # site-salted: each seam draws its own side
+    # some peer's tx side is cut while its rx side is not: the half-open
+    # wedge the degraded-network scenarios lean on
+    assert any(p in cut and p not in rx_cut for p in peers)
+
+
+def test_degraded_kinds_at_the_seam():
+    """latency sleeps its seeded jitter inline (and is returned so the
+    seam can log); the caller-applied kinds come back as specs, budgeted
+    by ``times`` like every other kind."""
+    faults.install({"faults": [{"site": "s", "kind": "latency",
+                                "seconds": 0.0, "times": 2}]})
+    assert faults.maybe_inject("s").kind == "latency"
+    assert faults.maybe_inject("s").kind == "latency"
+    assert faults.maybe_inject("s") is None  # budget spent
+    assert faults.active().fired("s") == 2
+    for kind in ("throttle", "blackhole_rx", "blackhole_tx", "partition"):
+        spec = _spec_for(kind)
+        assert faults.maybe_inject("s") is spec
+
+
+# =========================================================================
 # checkpoint manager (atomicity, keep-last-K, corruption fallback)
 
 
@@ -466,6 +529,65 @@ def test_recv_msg_timeout_is_a_detected_fault():
         send_msg(b, {"cmd": "ping"}, timeout=5.0)
         assert recv_msg(a, timeout=5.0) == {"cmd": "ping"}
     finally:
+        a.close()
+        b.close()
+
+
+def test_recv_msg_slow_loris_trickle_bounded():
+    """A peer drip-feeding one byte per interval must exhaust ONE
+    cumulative message budget (clocked from the first byte's arrival),
+    not reset the per-recv timeout on every byte."""
+    import threading
+    import time
+
+    from xgboost_tpu.tracker import recv_msg, send_msg
+
+    a, b = socket.socketpair()
+    c, d = socket.socketpair()
+    try:
+        send_msg(b, {"cmd": "ping", "pad": "x" * 200}, timeout=5.0)
+        b.shutdown(socket.SHUT_WR)
+        blob = b"".join(iter(lambda: a.recv(4096), b""))
+
+        def _trickle():
+            try:
+                for i in range(len(blob)):
+                    c.sendall(blob[i:i + 1])
+                    time.sleep(0.05)
+            except OSError:
+                pass  # the reader gave up and closed: expected
+
+        threading.Thread(target=_trickle, daemon=True).start()
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            recv_msg(d, timeout=0.5)
+        # one budget for the whole message, not budget * bytes
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        for s in (a, b, c, d):
+            s.close()
+
+
+def test_send_msg_trailing_rides_the_fault_decision():
+    """A header announcing a payload and the payload itself are one
+    atomic fault unit: a blackhole_tx that swallows the header must
+    swallow the trailing bytes too — a swallowed header followed by
+    loose payload bytes would desync the peer's framing (corruption,
+    not a network fault)."""
+    from xgboost_tpu.tracker import recv_msg, send_msg
+
+    a, b = socket.socketpair()
+    try:
+        faults.install({"faults": [{"site": "tracker.message",
+                                    "kind": "blackhole_tx", "times": 1}]})
+        send_msg(a, {"cmd": "coll", "nbytes": 4}, timeout=5.0,
+                 trailing=b"\x00\x01\x02\x03")
+        # the frame vanished WITH its payload: the next message parses
+        # cleanly instead of reading payload bytes as a length prefix
+        send_msg(a, {"cmd": "ping"}, timeout=5.0)
+        assert recv_msg(b, timeout=5.0) == {"cmd": "ping"}
+    finally:
+        faults.clear()
         a.close()
         b.close()
 

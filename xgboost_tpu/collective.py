@@ -572,6 +572,21 @@ def _coll_progress() -> None:
 
 
 _coll_hist = None  # xtb_coll_wait_seconds family (lazy; import stays cheap)
+_slow_coll = None  # xtb_net_slow_coll_total (lazy, same pattern)
+_LINK_BUDGET: Any = "unset"  # lazily resolved XGBOOST_TPU_LINK_TIMEOUT_S
+
+
+def _link_budget_s() -> Optional[float]:
+    """The per-link collective deadline (``XGBOOST_TPU_LINK_TIMEOUT_S``),
+    read once: the same budget the tracker relay uses to declare a
+    never-contributing rank lost, applied here as the worker-local
+    slow-link attribution threshold."""
+    global _LINK_BUDGET
+    if _LINK_BUDGET == "unset":
+        from .tracker import _link_timeout_s
+
+        _LINK_BUDGET = _link_timeout_s()
+    return _LINK_BUDGET
 
 
 def _observe_wait(op: str, t0: float) -> None:
@@ -581,8 +596,15 @@ def _observe_wait(op: str, t0: float) -> None:
     so the rank with the largest wait is pointing at the straggler, per
     op.  Shipped snapshots merge these driver-side, where the per-rank
     labels make cross-rank comparison one scrape
-    (docs/observability.md § Distributed observability)."""
-    global _coll_hist
+    (docs/observability.md § Distributed observability).
+
+    A wait past the per-link deadline additionally counts into
+    ``xtb_net_slow_coll_total{op,rank}`` with a flight fault: this rank
+    finished its own work and then waited on a slow or partitioned peer
+    longer than the deadline the relay holds links to — the worker-local
+    side of slow-peer attribution (docs/reliability.md "Degraded
+    networks")."""
+    global _coll_hist, _slow_coll
     if _coll_hist is None:
         from .telemetry.registry import get_registry
 
@@ -594,7 +616,24 @@ def _observe_wait(op: str, t0: float) -> None:
         rank = get_rank()
     except Exception:  # pragma: no cover - backend mid-teardown
         rank = -1
-    _coll_hist.labels(op, str(rank)).observe(time.perf_counter() - t0)
+    wait = time.perf_counter() - t0
+    _coll_hist.labels(op, str(rank)).observe(wait)
+    budget = _link_budget_s()
+    if budget is not None and wait > budget:
+        if _slow_coll is None:
+            from .telemetry.registry import get_registry
+
+            _slow_coll = get_registry().counter(
+                "xtb_net_slow_coll_total",
+                "collectives whose blocked wall exceeded the per-link "
+                "deadline (this rank waited on a slow or partitioned "
+                "peer)", ("op", "rank"))
+        _slow_coll.labels(op, str(rank)).inc()
+        from .telemetry import flight as _flight
+
+        _flight.record("fault", "collective.slow_link", op=op,
+                       rank=rank, wait_s=round(wait, 3),
+                       budget_s=budget)
 
 
 def _reconcile_native_kernels() -> None:

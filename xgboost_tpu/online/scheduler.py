@@ -151,8 +151,14 @@ class OnlineScheduler:
 
     def enable(self) -> None:
         """Turn the loop's intake on: broadcast the sample rate, register
-        the feedback sink."""
+        the feedback sink, and (when the fleet supports label-feed
+        connections) route remote ``op="label"`` frames into the same
+        join as the in-process :meth:`label` API."""
         self.fleet.set_feedback_sink(self._on_feedback)
+        # guarded: unit-test stubs implement only the feedback surface
+        set_labels = getattr(self.fleet, "set_label_sink", None)
+        if set_labels is not None:
+            set_labels(self.hub.label)
         self.fleet.set_sampling(self.model, self.config.sample_every)
         with self._lock:
             self._enabled = True
@@ -166,6 +172,9 @@ class OnlineScheduler:
         if was:
             self.fleet.set_sampling(self.model, 0)
             self.fleet.set_feedback_sink(None)
+            set_labels = getattr(self.fleet, "set_label_sink", None)
+            if set_labels is not None:
+                set_labels(None)
 
     def label(self, trace: Optional[str], y) -> bool:
         """Label one request by its trace id (``Future.trace_id``)."""

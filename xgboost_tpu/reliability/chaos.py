@@ -844,6 +844,241 @@ def _check_resource(fired, artifacts, baseline) -> Dict[str, str]:
     return inv
 
 
+# ---------------------------------------------------------- fleet_degraded
+_N_DEGRADED_BASE = 24
+_N_DEGRADED_EXTRA = 8
+_DEGRADED_BREAKER_S = 0.1
+_DEGRADED_HB_TIMEOUT_S = 2.0
+# respawn cycles + jitter stacking, not the 180s request timeout: the
+# whole point of the degraded-network plane is that the tail is bounded
+# by DETECTION budgets (heartbeat deadline, breaker cooldown, hedge)
+_DEGRADED_P99_CAP_S = 8.0
+
+
+def _run_fleet_degraded(workdir: str) -> dict:
+    """The fleet on a gray network: replica0's frames arrive late
+    (seeded per-frame jitter at the dispatcher's ``wire.recv`` seam),
+    replica1 goes half-open (its frames — pongs included — vanish
+    inbound while its rx direction stays up).  Driver-side seams only,
+    like the ``fleet`` scenario.  The contract: every request completes
+    with exact bits (twin=True digest), the EWMA breaker ejects the
+    laggard and readmits it after cooldown, the liveness ladder (no
+    pong AND no frame) declares the half-open replica and the respawn
+    restores strength, and the p99 stays bounded by detection budgets
+    (docs/reliability.md "Degraded networks")."""
+    import numpy as np
+
+    from ..serving.fleet import FleetConfig, ServingFleet
+
+    plan = faults.active()
+    cuts = sum(1 for s in (plan.specs if plan else [])
+               if s.site == "wire.recv" and s.kind == "blackhole_rx")
+    opened0 = _counter_labeled("xtb_net_breaker_transitions_total", "open")
+    closed0 = _counter_labeled("xtb_net_breaker_transitions_total",
+                               "closed")
+    hedges0 = _counter_total("xtb_net_hedges_total")
+    bst, Q = _fleet_fixture()
+    cfg = FleetConfig(n_replicas=2, max_respawns=4, nthread_per_replica=1,
+                      cache_dir=os.path.join(
+                          tempfile.gettempdir(), "xtb_chaos_warm"),
+                      heartbeat_s=0.25,
+                      heartbeat_timeout_s=_DEGRADED_HB_TIMEOUT_S,
+                      breaker_latency_s=_DEGRADED_BREAKER_S,
+                      breaker_cooldown_s=0.5,
+                      hedge_quantile=0.9, hedge_min_s=0.05)
+    outs: List[bytes] = []
+    lats: List[float] = []
+    with ServingFleet({"m": bst}, cfg) as fleet:
+
+        def _req(i: int) -> None:
+            rows = Q[(i * 5) % 48: (i * 5) % 48 + 16]
+            t = time.monotonic()
+            # predict() raising = a dropped request = a red episode
+            outs.append(np.ascontiguousarray(
+                fleet.predict("m", rows, timeout=180), np.float32
+            ).tobytes())
+            lats.append(time.monotonic() - t)
+
+        for i in range(_N_DEGRADED_BASE):
+            _req(i)
+        if cuts:
+            # the liveness verdict is wall-clocked (no pong AND no other
+            # frame past the deadline): hold the episode open until the
+            # half-open replica is actually declared, bounded
+            deadline = time.monotonic() + 20.0
+            while (not fleet.flight_dumps
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+        for j in range(_N_DEGRADED_EXTRA):
+            # the same rows as requests 0..N-1: the recovered fleet must
+            # return the same bytes the degraded one did
+            _req(j)
+        if _counter_labeled("xtb_net_breaker_transitions_total",
+                            "open") > opened0:
+            # readmission is wall-clocked too (cooldown, then a
+            # heartbeat probe): hold the episode open until the ejected
+            # replica is readmitted, bounded
+            deadline = time.monotonic() + 10.0
+            while (_counter_labeled("xtb_net_breaker_transitions_total",
+                                    "closed") <= closed0
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+        deaths = len(fleet.flight_dumps)
+    ordered = sorted(lats)
+    p99 = ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+    return {"digest": _digest(*outs), "completed": len(outs),
+            "expected": _N_DEGRADED_BASE + _N_DEGRADED_EXTRA,
+            "deaths": deaths, "cuts_scheduled": cuts,
+            "p99_s": round(p99, 3),
+            "lats": [round(x, 4) for x in lats],
+            "extras_match_base": all(
+                outs[_N_DEGRADED_BASE + j] == outs[j]
+                for j in range(_N_DEGRADED_EXTRA)),
+            "breaker_opened": _counter_labeled(
+                "xtb_net_breaker_transitions_total", "open") - opened0,
+            "breaker_closed": _counter_labeled(
+                "xtb_net_breaker_transitions_total", "closed") - closed0,
+            "hedges": _counter_total("xtb_net_hedges_total") - hedges0}
+
+
+def _check_fleet_degraded(fired, artifacts, baseline) -> Dict[str, str]:
+    inv = {}
+    inv["no_dropped_requests"] = (
+        "ok" if artifacts["completed"] == artifacts["expected"]
+        else f"FAIL: {artifacts['completed']}/{artifacts['expected']} "
+             "requests completed")
+    inv["recovered_fleet_bitwise"] = (
+        "ok" if artifacts["extras_match_base"]
+        else "FAIL: post-recovery predictions differ from the same "
+             "rows' pre-degradation bytes")
+    inv["p99_bounded"] = (
+        "ok" if artifacts["p99_s"] <= _DEGRADED_P99_CAP_S
+        else f"FAIL: p99 {artifacts['p99_s']}s > {_DEGRADED_P99_CAP_S}s "
+             "— the tail must be bounded by detection budgets, not the "
+             "request timeout")
+    if artifacts["cuts_scheduled"]:
+        inv["half_open_replica_declared"] = (
+            "ok" if artifacts["deaths"] >= 1
+            else "FAIL: a blackhole_rx was scheduled but the liveness "
+                 "ladder never declared the half-open replica")
+    else:
+        inv["no_false_death"] = (
+            "ok" if artifacts["deaths"] == 0
+            else f"FAIL: {artifacts['deaths']} replica deaths with no "
+                 "rx cut scheduled — jitter alone must not kill")
+    inv["deaths_bounded"] = (
+        "ok" if artifacts["deaths"] <= 5   # 1 + max_respawns
+        else f"FAIL: {artifacts['deaths']} deaths exceed the respawn "
+             "budget + 1")
+    lats = artifacts["lats"]
+    # conditions under which an EWMA (alpha 0.2) trip is GUARANTEED:
+    # the first-ever sample seeds the EWMA directly, and five
+    # consecutive samples above 2x the threshold lift any EWMA past it
+    # (0.2 * sum(0.8^i, i<5) = 0.672 > 0.5) — queue-wait-inflated
+    # latencies only arise once a breaker is already open, so either
+    # branch implies an `open` transition happened
+    trip_certain = bool(lats) and (
+        lats[0] > 2 * _DEGRADED_BREAKER_S
+        or any(all(v > 2 * _DEGRADED_BREAKER_S for v in lats[i:i + 5])
+               for i in range(len(lats) - 4)))
+    if trip_certain:
+        inv["breaker_ejected"] = (
+            "ok" if artifacts["breaker_opened"] >= 1
+            else "FAIL: sustained slow results yet the breaker never "
+                 "opened")
+    if artifacts["breaker_opened"]:
+        inv["breaker_readmitted"] = (
+            "ok" if artifacts["breaker_closed"] >= 1
+            else "FAIL: the breaker opened but never readmitted the "
+                 "replica after the link healed")
+    return inv
+
+
+# ----------------------------------------------------------- net_partition
+def _run_net_partition(workdir: str) -> dict:
+    """3-rank elastic training through an asymmetric partition: one
+    rank's tracker-seam sends vanish (``blackhole_tx``) while its
+    inbound stays live — the half-open wedge.  The relay's per-link
+    deadline attributes the silence, declares the RANK (not its
+    process: the peer is alive behind the cut), sends the
+    ``declared_dead`` rejoin invitation, and holds the regroup open
+    inside the readmission grace until the severed rank reconnects —
+    world 3 is restored in the SAME regroup, no round ever commits at
+    world 2, so the model must be bitwise-identical to the fault-free
+    twin (run_episode's twin check; that is this scenario's heart)."""
+    import functools
+    import glob
+
+    from ..launcher import run_distributed
+    from .checkpoint import latest_checkpoint
+
+    plan = faults.active()
+    cuts = sum(1 for s in (plan.specs if plan else [])
+               if s.site == "tracker.message" and s.kind == "blackhole_tx")
+    readmit0 = _counter_labeled("xtb_net_readmissions_total", "readmitted")
+    ckpt = os.path.join(workdir, "ck")
+    out = os.path.join(workdir, "model.ubj")
+    flight_dir = os.path.join(workdir, "flight")
+    # a tight per-link deadline (the thing under test) + a frozen
+    # telemetry cadence: the periodic registry ship rides the same
+    # tracker.message seam on a wall clock, and suppressing it keeps
+    # each worker's per-site invocation numbering deterministic — which
+    # is what makes `at` a replayable partition onset
+    overrides = {
+        "XGBOOST_TPU_FLIGHT_DIR": flight_dir,
+        "XGBOOST_TPU_LINK_TIMEOUT_S": "2.0",
+        "XGBOOST_TPU_TELEMETRY_INTERVAL": "3600",
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        run_distributed(
+            functools.partial(_elastic_chaos_worker, ckpt_dir=ckpt,
+                              out_path=out, rounds=6, num_shards=6),
+            num_workers=3, platform="cpu", timeout=200,
+            rendezvous="tracker", elastic=True,
+            fault_plan=_active_plan_json(), max_respawns=0)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    st = latest_checkpoint(ckpt)
+    with open(out, "rb") as fh:
+        model = fh.read()
+    stacks = glob.glob(os.path.join(flight_dir, "stacks_*.txt"))
+    return {"digest": _digest(model), "round": st.round if st else -1,
+            "world": st.world if st else -1, "stacks": len(stacks),
+            "cuts_scheduled": cuts,
+            "readmitted": _counter_labeled(
+                "xtb_net_readmissions_total", "readmitted") - readmit0}
+
+
+def _check_net_partition(fired, artifacts, baseline) -> Dict[str, str]:
+    inv = {}
+    inv["finished_all_rounds"] = (
+        "ok" if artifacts["round"] == 6
+        else f"FAIL: finished at round {artifacts['round']}, wanted 6")
+    inv["world_restored"] = (
+        "ok" if artifacts["world"] == 3
+        else f"FAIL: world {artifacts['world']} != 3 — the half-open "
+             "rank was not readmitted (rounds committed without it)")
+    inv["no_watchdog_escalation"] = (
+        "ok" if artifacts["stacks"] == 0
+        else f"FAIL: {artifacts['stacks']} stack dumps — recovery must "
+             "ride the link deadline, not the stall watchdog")
+    if artifacts["cuts_scheduled"]:
+        # the readmission counter lives in the DRIVER's registry (the
+        # tracker runs in the driver process), so the grace window's
+        # outcome is visible here even though the cut fires in a worker
+        inv["readmitted_same_regroup"] = (
+            "ok" if artifacts["readmitted"] >= 1
+            else "FAIL: a partition was scheduled but no rank was "
+                 "readmitted inside the grace window")
+    return inv
+
+
 def _pin_kill_at(spec: dict) -> dict:
     # a {rank, round} kill re-fires when a survivor inherits the rank and
     # redoes the round (docs/reliability.md, the elastic sharp edge):
@@ -984,6 +1219,55 @@ SCENARIOS: Dict[str, Scenario] = {
         ),
         run=_run_stall, check=_check_stall, twin=False,
         cost_hint_s=40.0, deadline_s=240.0, max_faults=3),
+    "fleet_degraded": Scenario(
+        name="fleet_degraded",
+        catalog=(
+            # driver-side seams only (like `fleet`): the dispatcher's rx
+            # path for replica0 jitters, replica1's inbound frames —
+            # pongs included — vanish.  The rank filters are disjoint,
+            # so neither spec starves the other's invocations
+            CatalogEntry("wire.recv", "latency",
+                         {"rank": ["replica0"], "seconds": (0.3, 0.6),
+                          "times": [3, 4, 5],
+                          "jitter_seed": (0, 1 << 16)}),
+            CatalogEntry("wire.recv", "blackhole_rx",
+                         {"rank": ["replica1"], "times": [40]}),
+            CatalogEntry("wire.frame", "throttle",
+                         {"rank": ["replica0"],
+                          "bytes_per_s": (1e5, 4e5), "times": [2, 4]}),
+        ),
+        run=_run_fleet_degraded, check=_check_fleet_degraded, twin=True,
+        cost_hint_s=30.0, deadline_s=300.0, max_faults=3,
+        # one jitter window and one half-open link per episode: a second
+        # latency spec would stack past the p99 cap, a second rx cut
+        # would double the respawn budget the deaths bound assumes
+        per_plan_caps={("wire.recv", "latency"): 1,
+                       ("wire.recv", "blackhole_rx"): 1}),
+    "net_partition": Scenario(
+        name="net_partition",
+        catalog=(
+            # the cut: one rank's sends vanish mid-training.  `at` is
+            # the worker's tracker.message invocation index (start
+            # handshake, coll_join, then contributes — deterministic
+            # with periodic telemetry frozen), so 6..15 lands on a
+            # contribute send.  The flavor specs below budget at most
+            # 3+3 claimed invocations (0..5), so they can never starve
+            # the cut's pinned invocation
+            CatalogEntry("tracker.message", "blackhole_tx",
+                         {"rank": [1, 2], "at": (6, 16), "times": [1]}),
+            CatalogEntry("tracker.message", "latency",
+                         {"seconds": (0.05, 0.2), "times": [2, 3],
+                          "jitter_seed": (0, 1 << 16)}),
+            CatalogEntry("tracker.message", "throttle",
+                         {"bytes_per_s": (2e5, 8e5), "times": [2, 3]}),
+        ),
+        run=_run_net_partition, check=_check_net_partition, twin=True,
+        cost_hint_s=60.0, deadline_s=300.0, max_faults=3,
+        # one asymmetric cut per episode: two simultaneous cuts could
+        # leave a lone survivor wedged on both links at once
+        per_plan_caps={("tracker.message", "blackhole_tx"): 1,
+                       ("tracker.message", "latency"): 1,
+                       ("tracker.message", "throttle"): 1}),
 }
 
 

@@ -48,6 +48,31 @@ something; each must land on a *degradation ladder*, never a crash):
   level (extmem prefetch off, page LRU cache cut).  Fired at the
   ``resource.pressure`` seam the governor polls.
 
+Network-degradation kinds (docs/reliability.md "Degraded networks" — the
+deterministic stand-ins for gray links: slow, shaped, or half-open, never
+cleanly dead; each must be *survived*, not just detected):
+
+- ``latency``      — sleep a per-invocation jitter sampled in
+  ``[0, seconds]`` from a seeded hash of ``(jitter_seed, invocation)``
+  (:func:`jitter_seconds`), applied at the seam like ``delay`` but
+  different every frame and identical every replay.
+- ``throttle``     — returned to the caller, which owns the bytes being
+  sent: sleep ``nbytes / bytes_per_s`` (:func:`throttle_seconds`) before
+  the write, shaping the link's effective bandwidth.
+- ``blackhole_tx`` — returned to the caller at a *send* seam: the bytes
+  silently vanish (the write is skipped, the connection stays open) — the
+  outbound half of a half-open link.  The peer sees silence, not EOF.
+- ``blackhole_rx`` — returned to the caller at a *receive* seam
+  (``wire.recv`` / ``tracker.recv``): the caller reads a full frame and
+  discards it, so inbound data is consumed by the kernel but never
+  delivered up the stack — the inbound half of a half-open link.
+- ``partition``    — returned to the caller at either socket seam: a
+  seeded bipartition of ranks/replicas (:func:`partition_blocks` — a pure
+  hash of ``(jitter_seed, peer)``).  Links whose peer lands on the cut
+  side behave as blackholed in the seam's direction; because the send and
+  receive seams consult the same predicate independently, one seed yields
+  *asymmetric* partitions (a rank whose tx is cut but rx is not).
+
 Plans install programmatically (``install(...)``) or through the
 ``XGBOOST_TPU_FAULT_PLAN`` environment variable — either inline JSON or a
 path to a JSON file — so spawned worker subprocesses inherit the plan with
@@ -70,10 +95,12 @@ import json
 import os
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Union
 
 __all__ = ["FaultInjected", "FaultSpec", "FaultPlan", "install", "clear",
-           "active", "maybe_inject", "corrupt_bytes", "ENV_VAR", "SEAMS",
+           "active", "maybe_inject", "corrupt_bytes", "jitter_seconds",
+           "throttle_seconds", "partition_blocks", "ENV_VAR", "SEAMS",
            "STRICT_ENV"]
 
 ENV_VAR = "XGBOOST_TPU_FAULT_PLAN"
@@ -102,6 +129,8 @@ SEAMS = frozenset({
     "extmem.page_load",
     "extmem.page_decode",
     "wire.frame",
+    "wire.recv",
+    "tracker.recv",
     "modelstore.publish",
     "tracker.journal",
     "watchdog.escalate",
@@ -119,7 +148,8 @@ STRICT_ENV = "XGBOOST_TPU_STRICT_SEAMS"
 _STRICT: Optional[bool] = None
 
 _KINDS = ("kill", "exception", "delay", "drop_connection", "truncate",
-          "corrupt", "disk_full", "mem_pressure", "fd_exhaust", "slow_disk")
+          "corrupt", "disk_full", "mem_pressure", "fd_exhaust", "slow_disk",
+          "latency", "throttle", "blackhole_rx", "blackhole_tx", "partition")
 
 
 def _strict() -> bool:
@@ -161,6 +191,8 @@ class FaultSpec:
     keep_bytes: Optional[int] = None  # truncate: bytes to keep (None = half)
     offset: Optional[int] = None     # corrupt: byte offset (None = middle)
     xor_mask: int = 0xFF             # corrupt: XOR applied to the byte
+    jitter_seed: int = 0             # latency/partition: determinism seed
+    bytes_per_s: float = 0.0         # throttle: shaped link bandwidth
     message: str = "injected fault"
 
     def __post_init__(self) -> None:
@@ -221,8 +253,9 @@ class FaultPlan:
             return [(spec, self._fired.get(i, 0))
                     for i, spec in enumerate(self.specs)]
 
-    def _claim(self, site: str, rank, round) -> Optional[FaultSpec]:
-        """Match-and-count under the lock; returns the spec to fire."""
+    def _claim(self, site: str, rank, round):
+        """Match-and-count under the lock; returns ``(spec, invocation)``
+        to fire (the invocation index seeds per-frame jitter) or None."""
         with self._lock:
             inv = self._calls.get(site, 0)
             self._calls[site] = inv + 1
@@ -233,7 +266,7 @@ class FaultPlan:
                     continue
                 if spec.matches(inv, rank, round):
                     self._fired[i] = self._fired.get(i, 0) + 1
-                    return spec
+                    return spec, inv
         return None
 
 
@@ -310,11 +343,13 @@ def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
     """Seam entry point.  ``rank`` may be an int or a zero-arg callable
     (resolved only when some spec for this site constrains rank, so seams
     can pass ``collective.get_rank`` without paying for it when unused).
-    Applies ``kill``/``exception``/``delay``/``slow_disk`` here and
-    raises the matching ``OSError`` for ``disk_full`` (ENOSPC) /
+    Applies ``kill``/``exception``/``delay``/``slow_disk``/``latency``
+    here and raises the matching ``OSError`` for ``disk_full`` (ENOSPC) /
     ``fd_exhaust`` (EMFILE); returns the spec for caller-applied kinds
-    (``drop_connection``, ``truncate``, ``corrupt``, ``mem_pressure``)
-    and for ``delay``/``slow_disk`` (so callers can log), else None."""
+    (``drop_connection``, ``truncate``, ``corrupt``, ``mem_pressure``,
+    ``throttle``, ``blackhole_rx``, ``blackhole_tx``, ``partition``)
+    and for ``delay``/``slow_disk``/``latency`` (so callers can log),
+    else None."""
     if _strict() and site not in SEAMS:
         raise ValueError(f"unknown fault seam {site!r} (strict mode); "
                          f"known seams: {sorted(SEAMS)}")
@@ -328,9 +363,10 @@ def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
         rank = rank()
     elif callable(rank):
         rank = None
-    spec = plan._claim(site, rank, round)
-    if spec is None:
+    claimed = plan._claim(site, rank, round)
+    if claimed is None:
         return None
+    spec, invocation = claimed
     _count(site, spec.kind)
     if spec.kind == "kill":
         import sys
@@ -353,6 +389,8 @@ def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
         raise FaultInjected(f"{site}: {spec.message}")
     if spec.kind in ("delay", "slow_disk"):
         time.sleep(spec.seconds)
+    elif spec.kind == "latency":
+        time.sleep(jitter_seconds(spec, invocation))
     elif spec.kind == "disk_full":
         import errno
 
@@ -379,3 +417,40 @@ def corrupt_bytes(data, spec: FaultSpec) -> bytes:
     mask = (int(spec.xor_mask) & 0xFF) or 0xFF
     buf[off % len(buf)] ^= mask
     return bytes(buf)
+
+
+def jitter_seconds(spec: FaultSpec, invocation: int) -> float:
+    """Per-invocation latency sample in ``[0, spec.seconds)`` for a
+    ``latency``-kind spec: a pure hash of ``(jitter_seed, invocation)``,
+    so frame N of a replay jitters by exactly what frame N jittered by
+    last run — no ambient RNG, no shared state."""
+    h = zlib.crc32(f"{int(spec.jitter_seed)}:{int(invocation)}".encode())
+    return float(spec.seconds) * ((h & 0xFFFFFF) / float(1 << 24))
+
+
+def throttle_seconds(spec: FaultSpec, nbytes: int) -> float:
+    """Shaping delay for ``nbytes`` under a ``throttle``-kind spec's
+    ``bytes_per_s`` link budget.  The caller (which owns the socket)
+    sleeps this long before the write — a pure function, so a shaped
+    transfer replays with identical pacing.  A non-positive rate shapes
+    nothing (0.0) rather than dividing by zero."""
+    rate = float(spec.bytes_per_s)
+    if rate <= 0.0:
+        return 0.0
+    return float(nbytes) / rate
+
+
+def partition_blocks(spec: FaultSpec, peer: Any) -> bool:
+    """Whether ``peer`` (a rank int or replica label) lands on the cut
+    side of a ``partition``-kind spec's seeded bipartition: the parity of
+    a pure hash of ``(jitter_seed, peer)``.  Send and receive seams call
+    this independently with the same seed, so one spec yields asymmetric
+    partitions — a peer whose hash cuts its tx seam but not its rx seam
+    is exactly the half-open wedge the scenario wants.  The hash covers
+    the spec's ``site`` too, so two specs sharing one seed (one at a send
+    seam, one at a receive seam) cut independent sides.  ``None`` (peer
+    unknown at this seam) never blocks."""
+    if peer is None:
+        return False
+    h = zlib.crc32(f"{int(spec.jitter_seed)}:{spec.site}:{peer}".encode())
+    return bool(h & 1)

@@ -40,6 +40,31 @@ dispatcher, ``drop_connection`` severs the chosen replica's socket — the
 deterministic stand-in for a replica vanishing mid-conversation
 (docs/reliability.md).
 
+**Degraded-network survival** (docs/reliability.md "Degraded
+networks"): a replica that is merely *slow* or *half-open* (process
+alive, one direction blackholed) never EOFs, so the death path above
+cannot see it.  Three layers close that gap without bigger timeouts:
+
+- **Heartbeats**: the dispatcher pings every replica on a schedule over
+  the same serialized control-frame path (``wire.PING``/``wire.PONG``);
+  a replica with no pong AND no other frame for ``heartbeat_timeout_s``
+  is declared dead — which also folds first-response liveness in (a
+  replica that acks ``ready`` and then never answers its first predict
+  trips the same deadline instead of coasting to the global one).
+- **Circuit breaker**: a per-replica EWMA of send->result latency
+  trips closed -> open when it exceeds ``breaker_latency_s``, ejecting
+  the slow replica from dispatch *before* it blows the SLO; after
+  ``breaker_cooldown_s`` a single half-open probe request readmits it
+  on success (closed) or re-opens on failure.
+- **Hedged dispatch**: an in-flight predict older than the
+  ``hedge_quantile`` of recent latencies (floored at ``hedge_min_s``)
+  is re-issued to a free replica as a twin with a fresh id sharing the
+  SAME future — replicas are deterministic, so the first result to
+  settle wins bitwise-identically and the loser is discarded by the id
+  check (``xtb_net_hedge_*`` counts issued/won/wasted).  Hedging is
+  bitwise-neutral by construction: hedge-on returns exactly the bytes
+  hedge-off would.
+
 **Lifecycle integration** (docs/serving.md "Online model lifecycle"):
 :meth:`ServingFleet.load_version` / :meth:`~ServingFleet.activate_version`
 / :meth:`~ServingFleet.retire_version` broadcast control frames that ride
@@ -98,6 +123,24 @@ _KS_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.5)
 # PSI's conventional decision points straddle 0.1 ("noticeable shift") and
 # 0.25 ("act"); decades around them, open-ended above
 _PSI_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+# cumulative per-frame read budget on the dispatcher's rx loops (the
+# slow-loris bound in wire.recv_frame): a peer trickling one byte per
+# idle interval gets this much wall per frame TOTAL, not per read.
+# Generous by default — a full 2 GiB payload over loopback clears it by
+# orders of magnitude — and env-tunable for tight test harnesses.
+FRAME_BUDGET_ENV = "XGBOOST_TPU_FRAME_BUDGET_S"
+
+
+def _frame_budget_s() -> Optional[float]:
+    raw = os.environ.get(FRAME_BUDGET_ENV, "").strip()
+    if not raw:
+        return 120.0
+    try:
+        v = float(raw)
+    except ValueError:
+        return 120.0
+    return v if v > 0 else None
 
 
 def _ks_stat(a: np.ndarray, b: np.ndarray) -> float:
@@ -194,12 +237,22 @@ class FleetConfig:
     max_respawns: int = 2
     ready_timeout_s: float = 300.0
     platform: Optional[str] = None    # replica jax platform (None = inherit)
+    # --- degraded-network survival (docs/reliability.md "Degraded
+    # networks"); breaker and hedging default OFF, heartbeats default ON
+    heartbeat_s: float = 2.0          # ping cadence (0 = no heartbeats)
+    heartbeat_timeout_s: float = 30.0  # no pong AND no frame -> declared
+    breaker_latency_s: float = 0.0    # EWMA trip point (0 = breaker off)
+    breaker_cooldown_s: float = 2.0   # open -> half-open probe delay
+    hedge_quantile: float = 0.0       # latency quantile (0 = no hedging)
+    hedge_min_s: float = 0.01         # hedge budget floor
 
     def __post_init__(self) -> None:
         if self.n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         if self.max_queue < 1:
             raise ValueError("max_queue must be >= 1")
+        if not 0.0 <= self.hedge_quantile < 1.0:
+            raise ValueError("hedge_quantile must be in [0, 1)")
 
     def resolve_slo(self, tenant: Optional[str]) -> SLOClass:
         if tenant is None:
@@ -282,6 +335,32 @@ class _Instruments:
             "current AIMD admission window (queued requests admitted "
             "before shedding; collapses under overload, recovers on "
             "completions)")
+        self.hb_rtt = reg.histogram(
+            "xtb_net_heartbeat_rtt_seconds",
+            "application-level ping->pong round trip per replica",
+            ("replica",), buckets=_LATENCY_BUCKETS)
+        self.breaker_state = reg.gauge(
+            "xtb_net_breaker_state",
+            "per-replica circuit breaker state (0 closed, 1 open, "
+            "2 half-open)", ("replica",))
+        self.breaker_transitions = reg.counter(
+            "xtb_net_breaker_transitions_total",
+            "circuit breaker state transitions, by target state", ("to",))
+        self.hedges = reg.counter(
+            "xtb_net_hedges_total",
+            "hedge twins issued for in-flight requests past the hedge "
+            "budget")
+        self.hedge_wins = reg.counter(
+            "xtb_net_hedge_wins_total",
+            "hedged requests whose twin's result settled the caller "
+            "first")
+        self.hedge_wasted = reg.counter(
+            "xtb_net_hedge_wasted_total",
+            "duplicate hedge-pair results discarded after the pair's "
+            "first settle")
+        self.label_frames = reg.counter(
+            "xtb_net_label_frames_total",
+            "op=\"label\" frames received over label-feed connections")
 
     @classmethod
     def get(cls) -> "_Instruments":
@@ -358,7 +437,7 @@ class AdaptiveAdmission:
 class _Request:
     __slots__ = ("id", "model", "header", "payload", "future",
                  "slo", "deadline", "t_submit", "tries", "state",
-                 "t_submit_ns", "t_send_ns")
+                 "t_submit_ns", "t_send_ns", "hedge", "hedged")
 
     def __init__(self, rid: int, model: str, header: dict, payload,
                  slo: SLOClass) -> None:
@@ -378,6 +457,11 @@ class _Request:
         # merged chrome://tracing timeline)
         self.t_submit_ns = time.perf_counter_ns()
         self.t_send_ns = 0
+        # hedged dispatch: `hedge` marks a twin (fresh id, SHARED future);
+        # `hedged` marks an original that already has a twin out, so the
+        # tick never double-hedges
+        self.hedge = False
+        self.hedged = False
 
 
 class DispatchQueue:
@@ -441,9 +525,12 @@ class DispatchQueue:
             if req.state != "queued":  # lazily drop shed/expired/cancelled
                 heapq.heappop(self._heap)
                 continue
-            if req.future.cancelled():
-                # the caller timed out and cancelled: don't burn a replica
-                # on an answer nobody will read
+            if req.future.cancelled() or req.future.done():
+                # cancelled: the caller timed out — don't burn a replica on
+                # an answer nobody will read.  done: a hedge twin already
+                # settled the shared future while this side sat requeued
+                # after its replica died — dispatching it again is pure
+                # waste.
                 heapq.heappop(self._heap)
                 req.state = "done"
                 self._live -= 1
@@ -487,7 +574,9 @@ class _Replica:
     mutation happens under the fleet condition variable)."""
 
     __slots__ = ("label", "proc", "sock", "rx", "in_flight", "ready_info",
-                 "alive", "ctrl", "quarantined")
+                 "alive", "ctrl", "quarantined", "last_rx", "last_ping",
+                 "ping_sent", "ping_seq", "ewma", "breaker",
+                 "breaker_until", "probe", "txlock")
 
     def __init__(self, label: str, proc) -> None:
         self.label = label
@@ -503,6 +592,19 @@ class _Replica:
         # set by an op="quarantine" frame (arena checksum divergence):
         # the death that follows is a quarantine, not a crash
         self.quarantined: Optional[str] = None
+        # --- degraded-network state (mutated under the fleet cv, except
+        # last_rx which any rx frame stamps — a GIL-atomic float store)
+        self.last_rx = 0.0                       # monotonic of last frame
+        self.last_ping = 0.0                     # monotonic of last ping
+        self.ping_sent: Dict[int, float] = {}    # seq -> send monotonic
+        self.ping_seq = 0
+        self.ewma: Optional[float] = None        # send->result EWMA
+        self.breaker = "closed"                  # closed|open|half_open
+        self.breaker_until = 0.0                 # open -> probe allowed at
+        self.probe = False                       # half-open probe out
+        # heartbeat pings share the socket with dispatch sends from other
+        # threads; two interleaved sendalls would shear a frame
+        self.txlock = threading.Lock()
 
 
 _ERR_TYPES = {"ValueError": ValueError, "KeyError": KeyError,
@@ -574,6 +676,13 @@ class ServingFleet:
         # driver-side consumer of decoded feedback records
         self._sampling: Dict[str, int] = {}
         self._feedback_sink = None
+        # consumer for op="label" frames from label-feed connections
+        # (signature sink(trace, y)); the online loop registers
+        # FeedbackHub.label here
+        self._label_sink = None
+        # recent send->result predict latencies (under _cv): the sample
+        # the hedge-budget quantile is computed from
+        self._lat_hist: deque = deque(maxlen=512)
         self._respawned = 0
         self._started = False
         self._bringup_done = False
@@ -727,6 +836,18 @@ class ServingFleet:
             try:
                 sock.settimeout(self.config.ready_timeout_s)
                 hello, _ = wire.recv_frame(sock)
+                if hello.get("kind") == "label_feed":
+                    # not a replica: a label producer (possibly another
+                    # process/host) streaming op="label" frames for the
+                    # online loop's join — its own rx thread, no replica
+                    # bookkeeping
+                    sock.settimeout(None)
+                    src = str(hello.get("label", "labeler"))
+                    threading.Thread(
+                        target=self._label_rx_loop, args=(src, sock),
+                        daemon=True,
+                        name=f"xtb-fleet-label-{src}").start()
+                    continue
                 ready, _ = wire.recv_frame(sock)
                 sock.settimeout(None)
                 label = hello.get("label", "?")
@@ -750,6 +871,13 @@ class ServingFleet:
                 rep.rx = rx
                 rep.ready_info = ready
                 rep.alive = True
+                # liveness baseline: the ready frame is frame zero, so a
+                # replica that acks ready and then never answers anything
+                # trips the heartbeat deadline instead of coasting to the
+                # global one; last_ping = now delays the first ping by one
+                # full heartbeat period
+                rep.last_rx = rep.last_ping = time.monotonic()
+                self._ins.breaker_state.labels(label).set(0.0)
                 # version resync for RESPAWNS: the replica read the
                 # manifest's active versions at process startup, which may
                 # predate an activate committed while it was warming up
@@ -791,9 +919,11 @@ class ServingFleet:
         # instead of three — the reacquire under a many-threaded
         # dispatcher was profiled at ~ms of convoy per request
         stream = wire.reader(sock)
+        budget = _frame_budget_s()
         while True:
             try:
-                header, payload = wire.recv_frame(stream)
+                header, payload = wire.recv_frame(stream, budget_s=budget,
+                                                  peer=label)
             except (wire.WireError, OSError) as e:
                 if isinstance(e, wire.WireCorruptError):
                     # corrupt replica->dispatcher frame: the death path
@@ -806,7 +936,15 @@ class ServingFleet:
                                    replica=label)
                 self._on_replica_death(label, e)
                 return
+            rep_rx = self._replicas.get(label)
+            if rep_rx is not None:
+                # any frame proves the replica end-to-end alive: stamp the
+                # liveness clock (GIL-atomic float store, no lock needed)
+                rep_rx.last_rx = time.monotonic()
             op = header.get("op")
+            if op == wire.PONG:
+                self._on_pong(label, header)
+                continue
             if op == wire.TELEMETRY:
                 # unsolicited shipment from the replica's serve loop: it
                 # does NOT complete the in-flight request — ingest and go
@@ -856,10 +994,12 @@ class ServingFleet:
                         # their ordering — that IS the drain contract)
                         if rep.ctrl:
                             nxt = rep.ctrl.popleft()
-                        else:
+                        elif self._breaker_free(rep, time.monotonic()):
                             nxt, expired = self._queue.pop(time.monotonic())
                         if nxt is not None:
                             rep.in_flight = nxt
+                            if rep.breaker == "half_open":
+                                rep.probe = True
             self._expire(expired)
             if nxt is not None:
                 # next request on the wire BEFORE this result's caller is
@@ -869,6 +1009,13 @@ class ServingFleet:
             if req is None or header.get("id") != req.id:
                 continue  # late/unmatched frame (e.g. post-reroute twin)
             if op == "result":
+                if req.header.get("op") == "predict" and req.t_send_ns:
+                    # send->result latency for the EWMA/breaker and the
+                    # hedge-budget quantile (stamped BEFORE the send, so
+                    # tx-side link degradation counts against the replica)
+                    self._net_observe(
+                        label,
+                        (time.perf_counter_ns() - req.t_send_ns) / 1e9)
                 shape = tuple(int(x) for x in header["shape"])
                 arr = np.frombuffer(payload, np.float32).reshape(shape)
                 self._finish(req, arr)
@@ -877,6 +1024,54 @@ class ServingFleet:
             else:
                 etype = _ERR_TYPES.get(header.get("etype", ""), RuntimeError)
                 self._fail(req, etype(header.get("error", "replica error")))
+
+    def _label_rx_loop(self, source: str, sock) -> None:
+        """One label-feed connection: decode each ``op="label"`` frame
+        (trace id + float32 labels) and hand it to the registered sink —
+        the online loop's FeedbackHub.label, whose bounded symmetric
+        join counts every drop.  Best-effort like feedback ingest: a
+        malformed frame or sink error is recorded and dropped, never
+        fatal — the serving plane must not depend on a label producer."""
+        stream = wire.reader(sock)
+        budget = _frame_budget_s()
+        while True:
+            try:
+                header, payload = wire.recv_frame(stream, budget_s=budget,
+                                                  peer=source)
+            except wire.WireError:
+                break  # producer gone (EOF/corrupt/slow-loris): drop it
+            except OSError as e:
+                # same verdict, but a socket-level failure gets classified
+                # (ENOSPC/EMFILE here would otherwise surface three
+                # subsystems away as a mystery)
+                _resources.note_os_error(e, "fleet.label_rx")
+                break
+            op = header.get("op")
+            if op == "close":
+                break
+            if op != wire.LABEL:
+                continue  # unknown op on a label feed: ignore
+            self._ins.label_frames.inc()
+            try:
+                trace = header.get("trace")
+                y = np.frombuffer(payload, np.float32)
+            except (TypeError, ValueError) as e:
+                _flight.record("fault", "fleet.label_decode",
+                               source=source, error=str(e))
+                continue
+            with self._cv:
+                sink = self._label_sink
+            if sink is None:
+                continue
+            try:
+                sink(trace, y)
+            except Exception as e:  # a broken consumer must not kill rx
+                _flight.record("fault", "fleet.label_sink",
+                               source=source, error=str(e))
+        try:
+            sock.close()
+        except OSError as e:
+            _note_os(e, "fleet.sock_close", benign=_EBADF_ONLY)
 
     def _ingest_feedback(self, label: str, header: dict, payload) -> None:
         """One replica feedback frame: decode the (features, scores) pair
@@ -931,6 +1126,9 @@ class ServingFleet:
     def _finish(self, req: _Request, arr: np.ndarray) -> None:
         req.state = "done"
         if req.future.set_running_or_notify_cancel():
+            if req.hedge:
+                # the twin beat the original to the shared future
+                self._ins.hedge_wins.inc()
             req.future.set_result(arr)
             if _trace.active() and req.header.get("trace"):
                 # dispatcher-side bracket of the whole request: with the
@@ -960,6 +1158,10 @@ class ServingFleet:
             if v is not None:
                 self._ins.version_latency.labels(
                     req.model, str(v)).observe(lat)
+        elif req.hedge or req.hedged:
+            # the pair's other side already settled the caller: this
+            # duplicate result is the waste a hedge knowingly pays for
+            self._ins.hedge_wasted.inc()
 
     def _finish_ctrl(self, req: _Request, header: dict) -> None:
         """A replica acked a lifecycle control frame: the future carries
@@ -1017,6 +1219,9 @@ class ServingFleet:
             rep.ctrl.clear()
             self._ins.replicas.set(
                 sum(1 for r in self._replicas.values() if r.alive))
+            # a dead replica's breaker is moot: park the gauge at closed
+            # so the label doesn't read as permanently ejected
+            self._ins.breaker_state.labels(label).set(0.0)
             if (req is not None and not closed
                     and req.header.get("op") != "predict"):
                 # a replica-bound control frame cannot reroute to a peer:
@@ -1144,6 +1349,7 @@ class ServingFleet:
             # real headroom recovers (internally rate-limited), ending a
             # brownout instead of latching it for the process lifetime
             _resources.get_governor().poll(self._store_dir)
+            self._net_tick()
             self._pump()
 
     def _pump(self) -> None:
@@ -1164,17 +1370,23 @@ class ServingFleet:
                 free = [r for r in self._replicas.values()
                         if r.alive and r.in_flight is None]
                 # replica-bound control frames first (they cannot be
-                # served by any other replica and must not starve)
+                # served by any other replica and must not starve; the
+                # breaker never gates them — an ejected replica still
+                # takes lifecycle ops)
                 for r in free:
                     if r.ctrl:
                         req = r.ctrl.popleft()
                         target = r
                         break
                 if req is None and free:
-                    req, expired = self._queue.pop(now)
-                    target = free[0] if req is not None else None
+                    admit = [r for r in free if self._breaker_free(r, now)]
+                    if admit:
+                        req, expired = self._queue.pop(now)
+                        target = admit[0] if req is not None else None
                 if req is not None:
                     target.in_flight = req
+                    if target.breaker == "half_open":
+                        target.probe = True
             self._expire(expired)
             if req is None:
                 return
@@ -1200,8 +1412,13 @@ class ServingFleet:
                 _note_os(e, "fleet.sock_close", benign=_SHUTDOWN_BENIGN)
             return
         try:
-            wire.send_frame(rep.sock, req.header, req.payload)
+            # stamp BEFORE the send: tx-side link degradation (jitter,
+            # throttling) must count against the replica's measured
+            # latency, or the breaker could never see a slow outbound link
             req.t_send_ns = time.perf_counter_ns()
+            with rep.txlock:
+                wire.send_frame(rep.sock, req.header, req.payload,
+                                peer=rep.label)
             if req.header.get("op") == "predict":
                 self._ins.requests.labels(req.model).inc()
                 if _trace.active() and req.header.get("trace"):
@@ -1213,6 +1430,198 @@ class ServingFleet:
                                 replica=rep.label)
         except OSError as e:
             self._on_replica_death(rep.label, e)
+
+    # ------------------------------------- degraded-network survival plane
+    def _set_breaker(self, rep: _Replica, state: str) -> None:
+        """Transition a replica's circuit breaker (cv held): state,
+        gauge, transition counter, flight event."""
+        if rep.breaker == state:
+            return
+        rep.breaker = state
+        rep.probe = False
+        self._ins.breaker_transitions.labels(state).inc()
+        self._ins.breaker_state.labels(rep.label).set(
+            {"closed": 0.0, "open": 1.0, "half_open": 2.0}[state])
+        _flight.record("event", "fleet.breaker", replica=rep.label,
+                       state=state)
+
+    def _breaker_free(self, rep: _Replica, now: float) -> bool:
+        """Whether the breaker lets this replica take queued predicts
+        (cv held).  Walks open -> half-open once the cooldown elapses;
+        half-open admits at most ONE outstanding probe — the caller that
+        claims the replica marks ``rep.probe``."""
+        if self.config.breaker_latency_s <= 0:
+            return True
+        if rep.breaker == "open" and now >= rep.breaker_until:
+            self._set_breaker(rep, "half_open")
+        if rep.breaker == "open":
+            return False
+        if rep.breaker == "half_open" and rep.probe:
+            return False
+        return True
+
+    def _net_observe(self, label: str, lat: float) -> None:
+        """One send->result predict latency: feed the hedge-budget
+        sample, update the replica's EWMA, and run the breaker state
+        machine (docs/reliability.md "Degraded networks")."""
+        thresh = self.config.breaker_latency_s
+        with self._cv:
+            self._lat_hist.append(lat)
+            rep = self._replicas.get(label)
+            if rep is None:
+                return
+            rep.ewma = lat if rep.ewma is None else (
+                0.2 * lat + 0.8 * rep.ewma)
+            if thresh <= 0:
+                return
+            if rep.breaker == "half_open":
+                # this result IS the probe's verdict
+                if lat <= thresh:
+                    rep.ewma = lat  # the probe is the new baseline
+                    self._set_breaker(rep, "closed")
+                else:
+                    rep.breaker_until = (time.monotonic()
+                                         + self.config.breaker_cooldown_s)
+                    self._set_breaker(rep, "open")
+            elif rep.breaker == "closed" and rep.ewma > thresh:
+                rep.breaker_until = (time.monotonic()
+                                     + self.config.breaker_cooldown_s)
+                self._set_breaker(rep, "open")
+
+    def _on_pong(self, label: str, header: dict) -> None:
+        """A replica answered a heartbeat: close out the matching ping,
+        observe the application-level round trip, and — when the
+        replica's breaker is waiting on a probe no traffic will ever
+        send it — let the pong BE the probe.  This is a network breaker:
+        the RTT rides the same degraded rx path a predict result would,
+        and without it an ejected replica whose siblings absorb all
+        traffic would stay ejected forever (readmission must not depend
+        on starving the healthy replicas first)."""
+        now = time.monotonic()
+        with self._cv:
+            rep = self._replicas.get(label)
+            if rep is None:
+                return
+            try:
+                t0 = rep.ping_sent.pop(int(header.get("seq", -1)), None)
+            except (TypeError, ValueError):
+                t0 = None
+            rtt = (now - t0) if t0 is not None else None
+            if (rtt is not None and self.config.breaker_latency_s > 0
+                    and not rep.probe):
+                if rep.breaker == "open" and now >= rep.breaker_until:
+                    self._set_breaker(rep, "half_open")
+                if rep.breaker == "half_open":
+                    if rtt <= self.config.breaker_latency_s:
+                        rep.ewma = rtt  # the probe is the new baseline
+                        self._set_breaker(rep, "closed")
+                    else:
+                        rep.breaker_until = (
+                            now + self.config.breaker_cooldown_s)
+                        self._set_breaker(rep, "open")
+        if rtt is not None:
+            self._ins.hb_rtt.labels(label).observe(rtt)
+
+    def _hedge_budget_locked(self) -> Optional[float]:
+        """Quantile-derived hedge budget (cv held): the configured
+        quantile of recent send->result latencies, floored at
+        ``hedge_min_s``.  None = hedging off or not enough history yet
+        (a cold fleet must not hedge off noise)."""
+        q = self.config.hedge_quantile
+        if q <= 0.0 or len(self._lat_hist) < 8:
+            return None
+        lats = sorted(self._lat_hist)
+        idx = min(len(lats) - 1, int(q * len(lats)))
+        return max(lats[idx], self.config.hedge_min_s)
+
+    def _net_tick(self) -> None:
+        """Degraded-network housekeeping, run from the dispatch loop's
+        0.2s cadence: schedule heartbeat pings, declare half-open
+        replicas dead (no pong AND no other frame past the deadline),
+        and hedge in-flight predicts past the quantile budget onto free
+        replicas.  All state decisions under the cv; every socket write
+        outside it."""
+        cfg = self.config
+        now = time.monotonic()
+        pings: List[_Replica] = []
+        dead: List[str] = []
+        hedges: List[Tuple[_Replica, _Request]] = []
+        with self._cv:
+            if self._closed:
+                return
+            for rep in self._replicas.values():
+                if not rep.alive or rep.sock is None:
+                    continue
+                if (cfg.heartbeat_s > 0
+                        and now - rep.last_ping >= cfg.heartbeat_s):
+                    rep.last_ping = now
+                    rep.ping_seq += 1
+                    rep.ping_sent[rep.ping_seq] = now
+                    pings.append(rep)
+                if (cfg.heartbeat_timeout_s > 0 and rep.ping_sent
+                        and (now - min(rep.ping_sent.values())
+                             > cfg.heartbeat_timeout_s)
+                        and now - rep.last_rx > cfg.heartbeat_timeout_s):
+                    # half-open or wedged: the oldest ping went
+                    # unanswered AND nothing else arrived either.  TCP
+                    # keepalive cannot see this (the tx direction still
+                    # works); EOF never comes (the process is alive).
+                    dead.append(rep.label)
+            budget = self._hedge_budget_locked()
+            if budget is not None:
+                spare = [r for r in self._replicas.values()
+                         if r.alive and r.in_flight is None
+                         and r.label not in dead
+                         and self._breaker_free(r, now)]
+                for rep in list(self._replicas.values()):
+                    if not spare:
+                        break  # hedging is bounded to spare capacity
+                    req = rep.in_flight
+                    if (req is None or rep.label in dead
+                            or req.header.get("op") != "predict"
+                            or req.hedge or req.hedged
+                            or not req.t_send_ns):
+                        continue
+                    age = (time.perf_counter_ns() - req.t_send_ns) / 1e9
+                    if age <= budget:
+                        continue
+                    # twin: fresh id (the rx id check drops whichever
+                    # result loses), SHARED future (first settle wins —
+                    # replicas are deterministic, so the winner's bytes
+                    # equal the loser's and hedging stays bitwise-neutral)
+                    twin_id = next(self._next_id)
+                    hdr = dict(req.header)
+                    hdr["id"] = twin_id
+                    hdr["hedge"] = True  # replica skips feedback capture
+                    twin = _Request(twin_id, req.model, hdr, req.payload,
+                                    req.slo)
+                    twin.future = req.future
+                    twin.hedge = True
+                    twin.state = "inflight"
+                    req.hedged = True
+                    tgt = spare.pop(0)
+                    tgt.in_flight = twin
+                    if tgt.breaker == "half_open":
+                        tgt.probe = True
+                    hedges.append((tgt, twin))
+        for rep in pings:
+            try:
+                with rep.txlock:
+                    wire.send_frame(rep.sock, {"op": wire.PING,
+                                               "seq": rep.ping_seq},
+                                    peer=rep.label)
+            except OSError as e:
+                self._on_replica_death(rep.label, e)
+        for label in dead:
+            _flight.record("fault", "fleet.half_open", replica=label)
+            self._on_replica_death(label, TimeoutError(
+                f"replica {label}: no pong and no frame within "
+                f"{cfg.heartbeat_timeout_s}s (half-open or wedged link)"))
+        for tgt, twin in hedges:
+            self._ins.hedges.inc()
+            _flight.record("event", "fleet.hedge", replica=tgt.label,
+                           id=twin.id, model=twin.model)
+            self._send(tgt, twin)
 
     # ------------------------------------------------------------------ API
     def submit(self, model: str, X=None, *, arrow=None,
@@ -1501,6 +1910,25 @@ class ServingFleet:
         with self._cv:
             return self._sampling.get(model, 0)
 
+    def set_label_sink(self, sink) -> None:
+        """Register the consumer for ``op="label"`` frames arriving over
+        label-feed connections (called ``sink(trace, y)`` on the feed's
+        rx thread).  The online loop registers ``FeedbackHub.label``
+        here, so labels produced in another process/host join the same
+        bounded symmetric join as in-process ones.  ``None``
+        unregisters; sink exceptions are contained like feedback's."""
+        with self._cv:
+            self._label_sink = sink
+
+    def label_endpoint(self) -> Tuple[str, int]:
+        """(host, port) a label producer connects to — the fleet's frame
+        listener.  Open the channel with :func:`wire.label_feed` and
+        stream labels with :func:`wire.send_label`."""
+        if self._listener is None:
+            raise RuntimeError("fleet not started: no listener yet")
+        host, port = self._listener.getsockname()[:2]
+        return str(host), int(port)
+
     # ------------------------------------------------------- shadow scoring
     def set_shadow(self, model: str, version: int,
                    fraction: float) -> None:
@@ -1662,7 +2090,8 @@ class ServingFleet:
         for rep in reps:
             if rep.sock is not None:
                 try:
-                    wire.send_frame(rep.sock, {"op": "close"})
+                    with rep.txlock:
+                        wire.send_frame(rep.sock, {"op": "close"})
                 except OSError as e:
                     _note_os(e, "fleet.shutdown",
                              benign=_SHUTDOWN_BENIGN)

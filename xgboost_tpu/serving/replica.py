@@ -326,7 +326,11 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
     stream = wire.reader(sock)  # one GIL event per frame, not three
     while True:
         try:
-            header, payload = wire.recv_frame(stream)
+            # peer=label lets fault plans shape this direction of the
+            # link independently (blackhole_rx / partition on the
+            # replica's inbound side); no budget_s — the dispatcher is
+            # the trusted side, and an idle dispatcher is not a stall
+            header, payload = wire.recv_frame(stream, peer=label)
         except wire.WireCorruptError:
             # corrupted frame: this connection cannot be trusted at any
             # subsequent byte — quarantine it (exit; the dispatcher's
@@ -342,6 +346,16 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
         rid = header.get("id")
         if op == "close":
             return
+        if op == wire.PING:
+            # heartbeat: answer immediately, before the watchdog guard —
+            # the serialized connection already proves ordering, and a
+            # pong queued behind a long predict still lands within the
+            # dispatcher's liveness deadline while a half-open link never
+            # answers at all
+            wire.send_frame(sock, {"op": wire.PONG,
+                                   "seq": header.get("seq"),
+                                   "label": label})
+            continue
         # liveness marker (ships with every telemetry frame) + a per-
         # request watchdog: a frame whose handling wedges past the budget
         # gets an all-thread stack dump and then a LOUD death, steering
@@ -425,10 +439,13 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
                                    rows=int(out.shape[0]))
                     # feedback capture AFTER the result frame: only
                     # unversioned (live-traffic) requests — explicit-
-                    # version probes and shadow twins are measurements,
-                    # not traffic the window should learn from
+                    # version probes, shadow twins, and hedge twins are
+                    # measurements/duplicates, not traffic the window
+                    # should learn from (a hedge pair sampled twice would
+                    # double-weight one request)
                     ev = sample.get(header["model"], 0)
                     if (ev > 0 and header.get("version") is None
+                            and not header.get("hedge")
                             and _sampled(header.get("trace"), ev)):
                         _capture_feedback(sock, header, X, out)
                 except Exception as e:  # per-request failure: serve on

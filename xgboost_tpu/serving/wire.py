@@ -36,12 +36,25 @@ an absurd buffer, and a header that fails to JSON-decode is a
 The ``wire.frame`` fault seam in :func:`send_frame` injects deterministic
 byte flips (``corrupt`` kind) after the CRC is computed, which is how the
 chaos harness proves the detection end to end.
+
+**Degraded links** (docs/reliability.md "Degraded networks"): the same
+``wire.frame`` seam shapes outbound traffic — ``latency`` jitters each
+frame from a seeded hash, ``throttle`` paces the write to a byte budget,
+``blackhole_tx``/``partition`` silently swallow it (connection open, peer
+starving: a half-open link).  The receive side has its own seam,
+``wire.recv`` in :func:`recv_frame`, where ``blackhole_rx``/``partition``
+consume a full frame without delivering it.  :func:`recv_frame` also
+takes a cumulative per-frame ``budget_s`` — the clock starts at the first
+prefix byte and covers every subsequent read, so a slow-loris peer
+trickling one byte per idle-timeout interval can no longer hold an rx
+slot indefinitely (it gets ``budget_s`` total, not per read).
 """
 from __future__ import annotations
 
 import json
 import socket
 import struct
+import time
 import zlib
 from typing import Any, Optional, Tuple
 
@@ -76,6 +89,27 @@ TELEMETRY = "telemetry"
 # Unsolicited like TELEMETRY — the dispatcher ingests it without touching
 # the in-flight request (docs/online.md "Sampling & the join contract").
 FEEDBACK = "feedback"
+
+# dispatcher <-> replica application-level heartbeat (docs/reliability.md
+# "Degraded networks"): the dispatcher sends {"op": PING, "seq": n} on a
+# schedule; the replica's serve loop answers {"op": PONG, "seq": n}
+# immediately.  Because the connection is serialized, a pong queued
+# behind a long predict still proves the replica end-to-end alive —
+# while a half-open replica (alive process, blackholed return path)
+# never answers, which TCP keepalive cannot see.  Pongs feed the
+# xtb_net_heartbeat_rtt_seconds histogram and the liveness deadline.
+PING = "ping"
+PONG = "pong"
+
+# external label producer -> dispatcher (online/feedback.py label feed):
+# header {"op": LABEL, "trace": <trace id>}, payload = raw f32 outcome
+# values for that trace's rows.  Arrives on a dedicated label-feed
+# connection (a hello frame with kind="label_feed" on the fleet's
+# listener) and lands in the same bounded symmetric label join as the
+# in-process ``label()`` API — same horizon, same counted drops, so a
+# remote label pipeline gets no laxer loss accounting than a local one
+# (docs/online.md "Sampling & the join contract").
+LABEL = "label"
 
 
 class WireError(RuntimeError):
@@ -120,12 +154,16 @@ def configure(sock: socket.socket) -> socket.socket:
 
 
 def send_frame(sock: socket.socket, header: dict,
-               payload: Optional[Any] = None) -> None:
+               payload: Optional[Any] = None, *,
+               peer: Optional[Any] = None) -> None:
     """Write one frame.  ``payload`` may be bytes/bytearray/memoryview —
     a large one is handed to the kernel as-is (no intermediate concat
     copy of the row data); small ones merge into the prefix+header write
     (one syscall beats one copy at that size).  The prefix CRC covers
-    header + payload (~GB/s, a fraction of what the kernel copy costs)."""
+    header + payload (~GB/s, a fraction of what the kernel copy costs).
+    ``peer`` names the far end (replica label / rank) for link-scoped
+    fault matching — a ``partition`` spec cuts only the links whose peer
+    hashes onto the wrong side."""
     from ..reliability import faults as _faults
 
     hdr = json.dumps(header, separators=(",", ":")).encode()
@@ -135,17 +173,29 @@ def send_frame(sock: socket.socket, header: dict,
     crc = zlib.crc32(body, zlib.crc32(hdr))
     prefix = _PREFIX.pack(len(hdr), len(body), crc)
     head = prefix + hdr
-    spec = _faults.maybe_inject("wire.frame")
-    if spec is not None and spec.kind == "corrupt":
-        # deterministic damage AFTER the CRC was computed, scoped to the
-        # header+payload region the CRC covers: the receiver must detect
-        # it (WireCorruptError) and quarantine the connection.  (A flip
-        # in the length prefix itself is indistinguishable from a stalled
-        # or insane peer — the MAX_* bounds and callers' timeouts own
-        # that case.)
-        sock.sendall(prefix
-                     + _faults.corrupt_bytes(hdr + bytes(body), spec))
-        return
+    spec = _faults.maybe_inject("wire.frame", rank=peer)
+    if spec is not None:
+        if spec.kind == "corrupt":
+            # deterministic damage AFTER the CRC was computed, scoped to
+            # the header+payload region the CRC covers: the receiver must
+            # detect it (WireCorruptError) and quarantine the connection.
+            # (A flip in the length prefix itself is indistinguishable
+            # from a stalled or insane peer — the MAX_* bounds and
+            # callers' timeouts own that case.)
+            sock.sendall(prefix
+                         + _faults.corrupt_bytes(hdr + bytes(body), spec))
+            return
+        if spec.kind == "blackhole_tx" or (
+                spec.kind == "partition"
+                and _faults.partition_blocks(spec, peer)):
+            # half-open link, outbound side: the bytes vanish but the
+            # connection stays up — the peer sees silence, never EOF.
+            # Detection is the application's job (heartbeat deadline,
+            # per-link budget), which is the point.
+            return
+        if spec.kind == "throttle":
+            time.sleep(_faults.throttle_seconds(
+                spec, len(head) + len(body)))
     if len(body) and len(body) <= _INLINE_PAYLOAD:
         sock.sendall(head + bytes(body))
         return
@@ -166,7 +216,8 @@ def reader(sock: socket.socket):
     return sock.makefile("rb", buffering=1 << 16)
 
 
-def _recv_exact(stream, n: int) -> memoryview:
+def _recv_exact(stream, n: int,
+                deadline: Optional[float] = None) -> memoryview:
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
@@ -177,39 +228,75 @@ def _recv_exact(stream, n: int) -> memoryview:
         if not r:
             raise WireError("connection closed mid-frame")
         got += r
+        # the slow-loris bound: every partial read is a checkpoint
+        # against the frame's CUMULATIVE deadline, so a peer drip-feeding
+        # one byte per idle-timeout interval exhausts one budget instead
+        # of resetting it on each byte
+        if deadline is not None and got < n \
+                and time.monotonic() >= deadline:
+            raise WireError(
+                f"frame read exceeded its cumulative deadline with "
+                f"{n - got} of {n} bytes outstanding (slow-loris bound)")
     return memoryview(buf)
 
 
-def recv_frame(stream) -> Tuple[dict, memoryview]:
+def recv_frame(stream, *, budget_s: Optional[float] = None,
+               peer: Optional[Any] = None) -> Tuple[dict, memoryview]:
     """Read one frame -> (header, payload view) from a socket or a
     :func:`reader` stream.  Raises WireError on EOF at a frame boundary
     too (callers treat any WireError as peer-gone); length-prefix
     violations and CRC mismatches (:class:`WireCorruptError`) are
     WireErrors as well, so a poisoned connection fails itself, not the
-    fleet, and damaged bytes are never JSON-decoded."""
-    prefix = _recv_exact(stream, _PREFIX.size)
-    hlen, plen, crc = _PREFIX.unpack(prefix)
-    if hlen > MAX_HEADER:
-        raise WireError(f"unreasonable header length {hlen}")
-    if plen > MAX_PAYLOAD:
-        raise WireError(f"unreasonable payload length {plen}")
-    hdr_bytes = _recv_exact(stream, hlen)
-    payload = _recv_exact(stream, plen) if plen else memoryview(b"")
-    if zlib.crc32(payload, zlib.crc32(hdr_bytes)) != crc:
-        from ..reliability import integrity as _integrity
+    fleet, and damaged bytes are never JSON-decoded.
 
-        _integrity.corrupt_detected("wire")
-        raise WireCorruptError(
-            f"frame CRC mismatch ({hlen}B header, {plen}B payload): "
-            "corrupted in transit — quarantining the connection")
-    try:
-        header = json.loads(bytes(hdr_bytes))
-    except ValueError as e:
-        raise WireError(f"undecodable frame header: {e}") from e
-    if not isinstance(header, dict):
-        raise WireError(f"frame header is {type(header).__name__}, "
-                        "expected a JSON object")
-    return header, payload
+    ``budget_s`` bounds one frame's total read wall: the clock starts
+    when the first prefix byte arrives (idle time between frames is
+    free) and a frame still incomplete at the deadline is a WireError —
+    the slow-loris bound.  It needs at least a trickle to check against
+    (each arriving chunk is a checkpoint); a peer sending *nothing* is
+    the idle-timeout/heartbeat layer's case, not this one.  ``peer``
+    scopes rx-side fault matching (``wire.recv`` seam), where
+    ``blackhole_rx``/``partition`` consume a frame without delivering
+    it — the half-open link's inbound side."""
+    from ..reliability import faults as _faults
+
+    while True:
+        spec = _faults.maybe_inject("wire.recv", rank=peer)
+        first = _recv_exact(stream, 1)
+        deadline = (time.monotonic() + budget_s) if budget_s is not None \
+            else None
+        rest = _recv_exact(stream, _PREFIX.size - 1, deadline)
+        hlen, plen, crc = _PREFIX.unpack(bytes(first) + bytes(rest))
+        if hlen > MAX_HEADER:
+            raise WireError(f"unreasonable header length {hlen}")
+        if plen > MAX_PAYLOAD:
+            raise WireError(f"unreasonable payload length {plen}")
+        hdr_bytes = _recv_exact(stream, hlen, deadline)
+        payload = _recv_exact(stream, plen, deadline) if plen \
+            else memoryview(b"")
+        if zlib.crc32(payload, zlib.crc32(hdr_bytes)) != crc:
+            from ..reliability import integrity as _integrity
+
+            _integrity.corrupt_detected("wire")
+            raise WireCorruptError(
+                f"frame CRC mismatch ({hlen}B header, {plen}B payload): "
+                "corrupted in transit — quarantining the connection")
+        if spec is not None and (
+                spec.kind == "blackhole_rx"
+                or (spec.kind == "partition"
+                    and _faults.partition_blocks(spec, peer))):
+            # half-open link, inbound side: the kernel delivered the
+            # frame, the application never sees it.  Loop for the next
+            # frame — the connection stays alive and silent.
+            continue
+        try:
+            header = json.loads(bytes(hdr_bytes))
+        except ValueError as e:
+            raise WireError(f"undecodable frame header: {e}") from e
+        if not isinstance(header, dict):
+            raise WireError(f"frame header is {type(header).__name__}, "
+                            "expected a JSON object")
+        return header, payload
 
 
 # ---------------------------------------------------------------- encoding
@@ -238,6 +325,33 @@ def encode_arrow(batch) -> Tuple[dict, memoryview]:
     buf = sink.getvalue()
     return ({"enc": ARROW, "shape": [batch.num_rows, batch.num_columns]},
             memoryview(buf))
+
+
+def label_feed(host: str, port: int, label: str = "labeler",
+               timeout: Optional[float] = 30.0) -> socket.socket:
+    """Open a label-feed channel to a fleet listener
+    (``ServingFleet.label_endpoint()``): connect, configure, and send
+    the ``kind="label_feed"`` hello that routes this connection to the
+    fleet's label rx loop instead of replica bookkeeping.  ``timeout``
+    bounds the connect AND every later send on the socket — a
+    black-holed route is a detected fault, not a wedged producer.  The
+    caller owns the socket (close it when the producer is done)."""
+    sock = configure(socket.create_connection((host, int(port)),
+                                              timeout=timeout))
+    send_frame(sock, {"op": "hello", "kind": "label_feed",
+                      "label": label})
+    return sock
+
+
+def send_label(sock: socket.socket, trace: str, y, *,
+               peer: Optional[Any] = None) -> None:
+    """One ``op="label"`` frame on a label-feed channel: the outcome
+    values for ``trace``'s rows, float32 raw — joined driver-side by the
+    online loop's FeedbackHub (docs/online.md)."""
+    arr = np.ascontiguousarray(np.asarray(y, np.float32).reshape(-1))
+    send_frame(sock, {"op": LABEL, "trace": trace,
+                      "shape": [int(arr.shape[0])]},
+               memoryview(arr).cast("B"), peer=peer)
 
 
 def decode_matrix(header: dict, payload) -> np.ndarray:

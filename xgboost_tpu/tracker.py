@@ -22,17 +22,33 @@ instead of a damaged histogram folding into an allreduce
 (docs/reliability.md "Integrity & chaos").  The ``tracker.message`` fault
 seam in :func:`send_msg` injects deterministic byte flips to prove the
 detection.
+
+**Degraded links** (docs/reliability.md "Degraded networks"): the same
+``tracker.message`` seam shapes outbound control traffic (``latency``
+jitter, ``throttle`` pacing, ``blackhole_tx``/``partition`` silent
+swallows) and a ``tracker.recv`` seam in :func:`recv_msg` consumes
+inbound messages without delivering them (``blackhole_rx``/
+``partition``) — together they model a half-open or partitioned link
+whose TCP connection never errors.  Detection is layered: ``timeout`` in
+:func:`recv_msg` is now a *cumulative* per-message deadline (the clock
+starts at the first byte, so a slow-loris peer trickling one byte per
+idle interval exhausts one budget), and ``XGBOOST_TPU_LINK_TIMEOUT_S``
+arms a per-link collective deadline on the relay — much tighter than the
+global stall ladder — that converts an asymmetric wedge (a rank whose
+contributions vanish while it still hears us) into the ordinary elastic
+regroup path within a bounded budget.
 """
 from __future__ import annotations
 
 import contextlib
 import json
+import os
 import socket
 import struct
 import threading
 import time
 import warnings
-from typing import Dict, List, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 from .elastic import RegroupRequired
 
@@ -69,15 +85,56 @@ def _op_timeout(sock: socket.socket, timeout: Optional[float]):
 # a detected connection fault, not a 4 GiB allocation
 MAX_MSG = 1 << 26
 
+# per-link collective deadline (docs/reliability.md "Degraded networks"):
+# when set (seconds), the relay declares a rank dead once a gather has
+# been waiting on it this long past the FIRST contribution's arrival —
+# converting an asymmetric wedge into the elastic regroup path in bounded
+# time instead of waiting out op_timeout or a stall-watchdog budget.
+# Unset = the global budgets own the case.
+LINK_TIMEOUT_ENV = "XGBOOST_TPU_LINK_TIMEOUT_S"
+
+
+def _link_timeout_s() -> Optional[float]:
+    raw = os.environ.get(LINK_TIMEOUT_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+def _readmit_grace_s(link_timeout: Optional[float]) -> float:
+    """Readmission window for a rank DECLARED lost by the per-link
+    deadline: its severed channel is an invitation to rejoin, and the
+    regroup the declaration triggered stays open this long waiting for
+    the comeback (2x the link budget, clamped) — a healed asymmetric
+    partition then restores the original world without committing a
+    single round at reduced membership, which is what keeps the model
+    bitwise-identical to a fault-free run."""
+    base = 2.0 * (link_timeout if link_timeout else 1.0)
+    return min(10.0, max(1.0, base))
+
 
 def send_msg(sock: socket.socket, obj: dict,
-             timeout: Optional[float] = None) -> None:
+             timeout: Optional[float] = None, *,
+             peer: Any = None, trailing: bytes = b"") -> None:
+    """One length-prefixed JSON message.  ``peer`` names the far end of
+    the link (the worker rank on a tracker<->worker channel) for
+    link-scoped fault matching at the ``tracker.message`` seam.
+    ``trailing`` rides along as raw bytes AFTER the frame, under the
+    same fault decision: a header announcing a payload and the payload
+    itself must vanish (blackhole/partition) or be paced (throttle) as
+    ONE unit — a swallowed header followed by loose payload bytes would
+    desync the peer's framing, which is corruption, not a network
+    fault."""
     import zlib
 
     from .reliability import faults as _faults
 
     payload = json.dumps(obj).encode()
-    spec = _faults.maybe_inject("tracker.message")
+    spec = _faults.maybe_inject("tracker.message", rank=peer)
     if spec is not None and spec.kind == "corrupt":
         # deterministic damage AFTER the CRC below is computed over the
         # ORIGINAL payload; scoped to the payload region (a flipped
@@ -88,48 +145,98 @@ def send_msg(sock: socket.socket, obj: dict,
     else:
         frame = (struct.pack(">II", len(payload), zlib.crc32(payload))
                  + payload)
+    if spec is not None:
+        if spec.kind == "blackhole_tx" or (
+                spec.kind == "partition"
+                and _faults.partition_blocks(spec, peer)):
+            # half-open link, outbound side: the message vanishes, the
+            # connection stays up — the peer must DETECT the silence
+            # (link deadline, liveness ladder), which is the point
+            return
+        if spec.kind == "throttle":
+            time.sleep(_faults.throttle_seconds(
+                spec, len(frame) + len(trailing)))
     with _op_timeout(sock, timeout):
         sock.sendall(frame)
+        if trailing:
+            sock.sendall(trailing)
 
 
 def recv_msg(sock: socket.socket,
-             timeout: Optional[float] = None) -> Optional[dict]:
+             timeout: Optional[float] = None, *,
+             peer: Any = None) -> Optional[dict]:
     """One length-prefixed JSON message; None on clean EOF.  ``timeout``
-    bounds the WHOLE message (socket.timeout is an OSError subclass, so
-    existing error paths treat expiry as a connection fault).  A CRC
-    mismatch or an insane length prefix raises ``ConnectionError`` — the
-    corrupted channel is quarantined like a dropped one."""
+    bounds the WHOLE message *cumulatively*: each recv is bounded by it
+    as a socket timeout AND the message must complete within it, clocked
+    from the first byte's arrival — a slow-loris peer trickling one byte
+    per idle interval exhausts one budget instead of resetting it per
+    byte (socket.timeout is an OSError subclass, so existing error paths
+    treat expiry either way as a connection fault).  A CRC mismatch or
+    an insane length prefix raises ``ConnectionError`` — the corrupted
+    channel is quarantined like a dropped one.  ``peer`` scopes rx-side
+    fault matching (``tracker.recv`` seam), where ``blackhole_rx``/
+    ``partition`` consume a message without delivering it."""
     import zlib
 
-    with _op_timeout(sock, timeout):
-        hdr = b""
-        while len(hdr) < 8:
-            chunk = sock.recv(8 - len(hdr))
-            if not chunk:
-                return None
-            hdr += chunk
-        n, crc = struct.unpack(">II", hdr)
-        if n > MAX_MSG:
+    from .reliability import faults as _faults
+
+    while True:
+        spec = _faults.maybe_inject("tracker.recv", rank=peer)
+        deadline: Optional[float] = None
+        with _op_timeout(sock, timeout):
+            hdr = b""
+            while len(hdr) < 8:
+                chunk = sock.recv(8 - len(hdr))
+                if not chunk:
+                    return None
+                if deadline is None and timeout is not None:
+                    # the cumulative clock starts at the first byte —
+                    # idle time between messages stays free
+                    deadline = time.monotonic() + timeout
+                hdr += chunk
+                if (deadline is not None and len(hdr) < 8
+                        and time.monotonic() >= deadline):
+                    raise ConnectionError(
+                        "tracker message header exceeded its cumulative "
+                        "deadline (slow-loris bound) — dropping the "
+                        "connection")
+            n, crc = struct.unpack(">II", hdr)
+            if n > MAX_MSG:
+                from .reliability import integrity as _integrity
+
+                _integrity.corrupt_detected("tracker")
+                raise ConnectionError(
+                    f"tracker message length {n} exceeds the {MAX_MSG} "
+                    "bound (corrupted length prefix?) — dropping the "
+                    "connection")
+            buf = b""
+            while len(buf) < n:
+                chunk = sock.recv(n - len(buf))
+                if not chunk:
+                    return None
+                buf += chunk
+                if (deadline is not None and len(buf) < n
+                        and time.monotonic() >= deadline):
+                    raise ConnectionError(
+                        f"tracker message body exceeded its cumulative "
+                        f"deadline with {n - len(buf)} of {n} bytes "
+                        "outstanding (slow-loris bound) — dropping the "
+                        "connection")
+        if zlib.crc32(buf) != crc:
             from .reliability import integrity as _integrity
 
             _integrity.corrupt_detected("tracker")
             raise ConnectionError(
-                f"tracker message length {n} exceeds the {MAX_MSG} bound "
-                "(corrupted length prefix?) — dropping the connection")
-        buf = b""
-        while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
-            if not chunk:
-                return None
-            buf += chunk
-    if zlib.crc32(buf) != crc:
-        from .reliability import integrity as _integrity
-
-        _integrity.corrupt_detected("tracker")
-        raise ConnectionError(
-            f"tracker message CRC mismatch ({n} bytes): corrupted in "
-            "transit — dropping the connection")
-    return json.loads(buf.decode())
+                f"tracker message CRC mismatch ({n} bytes): corrupted in "
+                "transit — dropping the connection")
+        if spec is not None and (
+                spec.kind == "blackhole_rx"
+                or (spec.kind == "partition"
+                    and _faults.partition_blocks(spec, peer))):
+            # half-open link, inbound side: the kernel delivered the
+            # message, the application never sees it — loop for the next
+            continue
+        return json.loads(buf.decode())
 
 
 def get_host_ip(host_ip: str = "auto") -> str:
@@ -214,6 +321,13 @@ class CollRelay:
         self.op_timeout = op_timeout
         self.elastic = bool(elastic)
         self.epoch = 0
+        # per-link collective deadline (XGBOOST_TPU_LINK_TIMEOUT_S): once
+        # the FIRST contribution of a gather arrives, a rank still
+        # missing this many seconds later is declared lost and the epoch
+        # regroups — asymmetric wedges convert to recovery in bounded
+        # time instead of waiting out op_timeout (docs/reliability.md
+        # "Degraded networks")
+        self.link_timeout = _link_timeout_s()
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host_ip, 0))
@@ -221,12 +335,28 @@ class CollRelay:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending: Dict[int, Dict[int, bytes]] = {}  # seq -> rank -> buf
+        self._first_t: Dict[int, float] = {}  # seq -> first-arrival mono
         self._results: Dict[int, tuple] = {}  # seq -> (payload, refcount)
         self._departed: set = set()
         self._failed: Optional[str] = None
         self._regroup_pending = False
         self._closing = False
         self.on_worker_lost = None  # callback(rank, msg) -> abort fan-out
+        self._slow_hist = None  # xtb_net_slow_peer_seconds, created lazily
+
+    def _observe_slow_peer(self, rank: int, gap_s: float) -> None:
+        """Slow-peer attribution: the gather's LAST contributor closed a
+        ``gap_s``-second spread behind the first — the relay-side
+        complement of the per-rank ``xtb_coll_wait_seconds`` view (the
+        rank every OTHER rank burned that wall waiting for)."""
+        if self._slow_hist is None:
+            from .telemetry.registry import get_registry
+
+            self._slow_hist = get_registry().histogram(
+                "xtb_net_slow_peer_seconds", "spread between a gather's "
+                "first and last contribution, attributed to the closing "
+                "rank", ("rank",))
+        self._slow_hist.labels(str(rank)).observe(gap_s)
 
     def start(self) -> None:
         self._listener.listen(self.world)
@@ -268,7 +398,7 @@ class CollRelay:
         try:
             while True:
                 try:
-                    hdr = recv_msg(conn)
+                    hdr = recv_msg(conn, peer=rank)
                 except OSError:
                     hdr = None
                 if hdr is None or hdr.get("cmd") != "coll":
@@ -289,19 +419,19 @@ class CollRelay:
                     # membership is changing: the worker raises
                     # RegroupRequired and reconnects on the next epoch
                     send_msg(conn, {"cmd": "coll_regroup",
-                                    "epoch": self.epoch}, timeout=30.0)
+                                    "epoch": self.epoch}, timeout=30.0,
+                             peer=rank)
                     break
                 if result is None:
                     send_msg(conn, {"cmd": "coll_error",
                                     "msg": self._failed or "relay failed"},
-                             timeout=30.0)
+                             timeout=30.0, peer=rank)
                     break
                 send_msg(conn, {"cmd": "coll_result", "seq": seq,
                                 "nbytes": len(result),
                                 "crc": _crc32(result)},
-                         timeout=self.op_timeout)
-                with _op_timeout(conn, self.op_timeout):
-                    conn.sendall(result)
+                         timeout=self.op_timeout, peer=rank,
+                         trailing=result)
         except OSError:
             pass
         finally:
@@ -341,6 +471,7 @@ class CollRelay:
                 if lost_mid_gather:
                     self._regroup_pending = True
                     self._pending.clear()
+                    self._first_t.clear()
                     self._results.clear()
                 self._cond.notify_all()
         if lost_mid_gather and self.on_worker_lost is not None:
@@ -367,6 +498,7 @@ class CollRelay:
                 return  # that regroup already formed the next epoch
             self._regroup_pending = True
             self._pending.clear()
+            self._first_t.clear()
             self._results.clear()
             self._cond.notify_all()
 
@@ -378,6 +510,7 @@ class CollRelay:
             self.world = int(world)
             self.epoch = int(epoch)
             self._pending.clear()
+            self._first_t.clear()
             self._results.clear()
             self._departed.clear()
             self._failed = None
@@ -388,13 +521,19 @@ class CollRelay:
                     epoch: int = 0):
         """Add ``rank``'s payload; block until the gather completes; returns
         the rank-ordered concatenation, ``_REGROUP`` when membership is
-        changing (elastic), or None on failure/timeout."""
+        changing (elastic), or None on failure/timeout.  With a per-link
+        deadline armed (``XGBOOST_TPU_LINK_TIMEOUT_S``), ranks still
+        missing that long after the gather's FIRST contribution are
+        declared lost and the epoch regroups — the bounded conversion of
+        an asymmetric wedge into recovery."""
         deadline = time.monotonic() + self.op_timeout
+        wedged: Optional[list] = None
         with self._cond:
             if self.elastic and (self._regroup_pending
                                  or epoch != self.epoch):
                 return _REGROUP
             self._pending.setdefault(seq, {})[rank] = buf
+            first_t = self._first_t.setdefault(seq, time.monotonic())
             while True:
                 if self.elastic and (self._regroup_pending
                                      or epoch != self.epoch):
@@ -405,6 +544,12 @@ class CollRelay:
                 if got is not None and len(got) == self.world:
                     payload = b"".join(got[r] for r in range(self.world))
                     del self._pending[seq]
+                    # slow-peer attribution: THIS call closed the gather,
+                    # so the spread behind the first arrival is this
+                    # rank's to own
+                    self._observe_slow_peer(
+                        rank, time.monotonic()
+                        - self._first_t.pop(seq, first_t))
                     self._results[seq] = (payload, self.world)
                     self._cond.notify_all()
                 if seq in self._results:
@@ -422,14 +567,45 @@ class CollRelay:
                         # steer every blocked worker into the regroup
                         self._regroup_pending = True
                         self._pending.clear()
+                        self._first_t.clear()
                         self._results.clear()
                         self._cond.notify_all()
                         return _REGROUP
+                    break
+                if (self.elastic and self.link_timeout is not None
+                        and got is not None
+                        and time.monotonic() - first_t
+                        > self.link_timeout):
+                    # per-link deadline: somebody contributed link_timeout
+                    # seconds ago and these ranks still have not — their
+                    # links are wedged (half-open, partitioned, or the
+                    # peer is glacial).  Declare them lost NOW so the
+                    # survivors regroup within the link budget instead of
+                    # the op_timeout/watchdog horizon.
+                    wedged = sorted(set(range(self.world)) - set(got)
+                                    - self._departed)
+                    self._departed.update(wedged)
+                    self._regroup_pending = True
+                    self._pending.clear()
+                    self._first_t.clear()
+                    self._results.clear()
+                    self._cond.notify_all()
                     break
                 left = deadline - time.monotonic()
                 if left <= 0:
                     break
                 self._cond.wait(timeout=min(left, 5.0))
+        if wedged is not None:
+            for lost in wedged:
+                if self.on_worker_lost is not None:
+                    # declared=True: the peer may well be alive behind a
+                    # wedged link — the tracker severs it expecting either
+                    # a corpse or a comeback (readmission grace)
+                    self.on_worker_lost(
+                        lost, f"collective link deadline "
+                        f"({self.link_timeout:g}s) exceeded: rank {lost} "
+                        f"never contributed to seq {seq}", True)
+            return _REGROUP
         self._fail(f"collective seq {seq} incomplete "
                    f"(departed={sorted(self._departed)})")
         return None
@@ -517,6 +693,13 @@ class RabitTracker:
         self._regroup_t0 = 0.0
         self._regroup_joins: Dict[socket.socket, int] = {}  # conn -> round
         self._joiners: List[socket.socket] = []  # parked replacement conns
+        # readmission grace (link-deadline declarations only): how many
+        # declared-lost ranks the pending regroup still waits a comeback
+        # from, and until when (monotonic) it may wait
+        self._readmit_waiting = 0
+        self._readmit_until = 0.0
+        self._readmit_timer = False
+        self._readmit_ins = None  # xtb_net_readmissions_total, lazy
         self.lost_workers = 0
         # last shipped telemetry payload per source label ("rank<N>"):
         # retained after the worker dies (postmortem + merged scrape)
@@ -660,9 +843,11 @@ class RabitTracker:
             send_msg(r0_conn, {"rank": 0, "world": self.n_workers,
                                "coordinator": None,
                                "coll_port": self._relay.port,
-                               "failover": failover},
-                     timeout=self.handshake_timeout)
-            reply = recv_msg(r0_conn, timeout=self.handshake_timeout)
+                               "failover": failover,
+                               "elastic": self.elastic},
+                     timeout=self.handshake_timeout, peer=0)
+            reply = recv_msg(r0_conn, timeout=self.handshake_timeout,
+                             peer=0)
         except OSError:
             reply = None
         if not reply or reply.get("cmd") != "coordinator":
@@ -680,8 +865,9 @@ class RabitTracker:
                 send_msg(conn, {"rank": rank, "world": self.n_workers,
                                 "coordinator": coordinator,
                                 "coll_port": self._relay.port,
-                                "failover": failover},
-                         timeout=self.handshake_timeout)
+                                "failover": failover,
+                                "elastic": self.elastic},
+                         timeout=self.handshake_timeout, peer=rank)
             except OSError:
                 pass  # the worker's watcher EOF-detection handles its death
         with self._lock:
@@ -790,7 +976,8 @@ class RabitTracker:
                         try:
                             send_msg(other, {"cmd": "abort",
                                              "msg": self._error},
-                                     timeout=30.0)
+                                     timeout=30.0,
+                                     peer=self._members.get(other))
                         except OSError:
                             pass
         self._done.set()
@@ -799,7 +986,7 @@ class RabitTracker:
         clean = False
         while True:
             try:
-                msg = recv_msg(conn)
+                msg = recv_msg(conn, peer=rank)
             except OSError:
                 msg = None
             if msg is None:
@@ -847,6 +1034,8 @@ class RabitTracker:
                     # spurious "regroup with no members" error
                     self._regrouping = False
                     self._regroup_joins = {}
+                    self._readmit_waiting = 0
+                    self._readmit_until = 0.0
             if self.elastic:
                 self._journal_write(force=True)
                 # a clean exit during a pending regroup: the remaining
@@ -976,7 +1165,8 @@ class RabitTracker:
         if stage_name == "dump":
             try:
                 send_msg(conn, {"cmd": "stackdump",
-                                "reason": f"{seam} watchdog"}, timeout=5.0)
+                                "reason": f"{seam} watchdog"}, timeout=5.0,
+                         peer=rank)
             except OSError:
                 pass
         elif stage_name == "stall":
@@ -1119,7 +1309,7 @@ class RabitTracker:
         _flight.record("event", "tracker.readopt", rank=rank, epoch=epoch)
         try:
             send_msg(conn, {"cmd": "readopted", "epoch": epoch,
-                            "failover": True}, timeout=30.0)
+                            "failover": True}, timeout=30.0, peer=rank)
         except OSError:
             # the reply never arrived: ROLL BACK the membership — no
             # watcher exists yet, so a zombie member here would block
@@ -1141,7 +1331,8 @@ class RabitTracker:
         # already joined while this straggler was reconnecting
         self._maybe_complete_regroup()
 
-    def _relay_worker_lost(self, rank: int, msg: str) -> None:
+    def _relay_worker_lost(self, rank: int, msg: str,
+                           declared: bool = False) -> None:
         if not self.elastic:
             self._fan_abort(rank, msg, None)
             return
@@ -1149,10 +1340,10 @@ class RabitTracker:
             conn = next((c for c, r in self._members.items() if r == rank),
                         None)
         if conn is not None:
-            self._on_worker_death(conn, rank, msg)
+            self._on_worker_death(conn, rank, msg, declared=declared)
 
     def _on_worker_death(self, conn: socket.socket, rank: int,
-                         msg: str) -> None:
+                         msg: str, declared: bool = False) -> None:
         """Elastic death handling (idempotent per connection): drop the
         member, flush the relay, and start a regroup among the survivors.
         With nobody left the job has failed — there is no one to carry the
@@ -1167,6 +1358,32 @@ class RabitTracker:
             survivors = len(self._members)
             joiners = len(self._joiners)
             epoch_now = self._epoch
+        # sever the channel: for an ACTUAL death this is a no-op (the
+        # socket is already gone), but a DECLARED death — link deadline,
+        # stall ladder — leaves a live wedged peer behind, and 'declared
+        # dead' must recover identically to 'actually dead': its watcher
+        # EOFs, its blocked collective surfaces, and it can never
+        # half-participate in an epoch that no longer contains it
+        if declared:
+            # link-deadline declaration: the peer is likely alive behind
+            # a half-open link — invite it back BEFORE severing (the
+            # tracker->worker direction of an asymmetric cut usually
+            # still works; best-effort either way).  Only an invited
+            # worker rejoins, so stall-ladder declarations keep their
+            # old fail-and-respawn semantics.
+            try:
+                send_msg(conn, {"cmd": "declared_dead", "rejoin": True},
+                         timeout=5.0, peer=rank)
+            except OSError:
+                pass
+        try:
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
         from .elastic import instruments as _elastic_ins
         from .telemetry import flight as _flight
 
@@ -1183,6 +1400,16 @@ class RabitTracker:
                     self._error = f"worker {rank}: {msg} (no survivors)"
             self._done.set()
             return
+        if declared:
+            # a DECLARED death (link deadline) severed a possibly-live
+            # peer: hold the regroup open one grace window for its rejoin
+            # handshake, so a healed half-open link restores the world
+            # in the SAME regroup its loss triggered
+            grace = _readmit_grace_s(self._relay.link_timeout)
+            with self._lock:
+                self._readmit_waiting += 1
+                self._readmit_until = max(self._readmit_until,
+                                          time.monotonic() + grace)
         self._request_regroup()
 
     def _request_regroup(self) -> None:
@@ -1210,7 +1437,7 @@ class RabitTracker:
                     try:
                         send_msg(conn, {"cmd": "regroup_pending",
                                         "epoch": self._epoch + 1},
-                                 timeout=30.0)
+                                 timeout=30.0, peer=self._members[conn])
                     except OSError:
                         pass  # its watcher will report the death
         self._maybe_complete_regroup()
@@ -1229,6 +1456,27 @@ class RabitTracker:
         self._relay.invalidate(epoch_now)
         self._maybe_complete_regroup()
 
+    def _readmit_expire(self) -> None:
+        """Grace timer: the declared-lost rank never came back — complete
+        the regroup with whoever is here."""
+        with self._lock:
+            self._readmit_timer = False
+        self._maybe_complete_regroup()
+
+    def _count_readmission(self, outcome: str) -> None:
+        """``xtb_net_readmissions_total{outcome}``: grace windows closed by
+        a comeback (``readmitted``) vs timed out (``expired``)."""
+        if self._readmit_ins is None:
+            from .telemetry.registry import get_registry
+
+            self._readmit_ins = get_registry().counter(
+                "xtb_net_readmissions_total",
+                "link-deadline readmission grace windows closed, by "
+                "outcome (readmitted = the declared-lost rank rejoined "
+                "the same regroup; expired = it never came back)",
+                ("outcome",))
+        self._readmit_ins.labels(outcome).inc()
+
     def _maybe_complete_regroup(self) -> None:
         """Form the next epoch once every live member has joined: compact
         rank assignment (survivors by previous rank, then parked joiners),
@@ -1242,6 +1490,28 @@ class RabitTracker:
                 return  # a tracker-recovery re-adoption is still draining
             if set(self._regroup_joins) != set(self._members):
                 return  # someone is still draining toward its boundary
+            now = time.monotonic()
+            if (self._readmit_waiting > len(self._joiners)
+                    and now < self._readmit_until):
+                # readmission grace: a rank declared lost by the link
+                # deadline gets one bounded window to rejoin THIS regroup
+                # (its rejoin 'start' handshake re-triggers completion);
+                # forming without it would commit rounds at reduced
+                # membership that a healed partition can never reconcile
+                if not self._readmit_timer:
+                    self._readmit_timer = True
+                    t = threading.Timer(self._readmit_until - now + 0.05,
+                                        self._readmit_expire)
+                    t.daemon = True
+                    t.start()
+                return
+            if self._readmit_waiting:
+                self._count_readmission(
+                    "readmitted"
+                    if len(self._joiners) >= self._readmit_waiting
+                    else "expired")
+                self._readmit_waiting = 0
+                self._readmit_until = 0.0
             survivors = sorted(self._members, key=self._members.get)
             old_ranks = dict(self._members)  # conn -> pre-regroup rank
             joiners = list(self._joiners)
@@ -1300,9 +1570,10 @@ class RabitTracker:
                                     "coordinator": "",
                                     # a parked JOINER's start handshake is
                                     # answered by this message: it must
-                                    # learn failover is armed here
-                                    "failover": self._journal is not None},
-                             timeout=30.0)
+                                    # learn failover/elastic are armed here
+                                    "failover": self._journal is not None,
+                                    "elastic": True},
+                             timeout=30.0, peer=nr)
                 except OSError:
                     pass  # the death will be seen and regrouped again
             # capture under the lock: a joiner's conn could die (and leave
@@ -1415,6 +1686,12 @@ class TrackerClient:
         # failover: the tracker journals its state — a dropped channel is
         # a coordinator respawn to reconnect to, not (necessarily) the end
         self.failover = bool(reply.get("failover", False))
+        # elastic: a severed channel may be a DECLARED death (link
+        # deadline) of this very-much-alive process — worth one rejoin
+        # attempt before giving up the job, but only when the tracker's
+        # pre-sever invitation said so
+        self.elastic = bool(reply.get("elastic", False))
+        self._rejoin_invited = False
         self._host = host
         self._port = int(port)
         self._closed = False
@@ -1441,7 +1718,8 @@ class TrackerClient:
                 s.bind((my_ip, 0))
                 self.coordinator = f"{my_ip}:{s.getsockname()[1]}"
             send_msg(self._sock, {"cmd": "coordinator",
-                                  "addr": self.coordinator})
+                                  "addr": self.coordinator},
+                     peer=self.rank)
         else:
             self.coordinator = str(reply["coordinator"])
         # handshake done: the persistent connection is now the abort channel
@@ -1461,7 +1739,7 @@ class TrackerClient:
     def _watch(self) -> None:
         while True:
             try:
-                msg = recv_msg(self._sock)
+                msg = recv_msg(self._sock, peer=self.rank)
             except socket.timeout:
                 # a concurrent TIMED send (ship_telemetry / signal_error
                 # both bound their sends) toggles the shared socket's
@@ -1480,6 +1758,16 @@ class TrackerClient:
                 # with backoff and re-adopt into the journaled epoch.
                 if self._closed or not self.failover:
                     if not self._closed:
+                        # elastic: an invited sever is a DECLARED death
+                        # (link deadline) of this live process — the
+                        # tracker holds the regroup open a grace window
+                        # for exactly this comeback
+                        with self._state_lock:
+                            invited = self._rejoin_invited
+                        if self.elastic and invited and self._rejoin():
+                            with self._state_lock:
+                                self._rejoin_invited = False
+                            continue
                         # a regroup entered (or about to be entered) on a
                         # DEAD channel would wait out its full timeout for
                         # an assignment that can never arrive: fail it now
@@ -1488,6 +1776,13 @@ class TrackerClient:
                 if not self._reconnect():
                     self._channel_lost()
                     return
+                continue
+            if msg.get("cmd") == "declared_dead":
+                # the coordinator is about to sever us over a link-
+                # deadline declaration: the EOF that follows is an
+                # invitation to rejoin, not the end of the job
+                with self._state_lock:
+                    self._rejoin_invited = bool(msg.get("rejoin"))
                 continue
             if msg.get("cmd") == "stackdump":
                 # the tracker's stall watchdog wants to see this process's
@@ -1567,8 +1862,9 @@ class TrackerClient:
                 s.settimeout(30.0)
                 send_msg(s, {"cmd": "readopt", "rank": self.rank,
                              "epoch": self.epoch,
-                             "round": marks.get("round")})
-                reply = recv_msg(s)
+                             "round": marks.get("round")},
+                         peer=self.rank)
+                reply = recv_msg(s, peer=self.rank)
                 if not reply or reply.get("cmd") != "readopted":
                     raise ConnectionError(
                         f"tracker refused re-adoption: {reply!r}")
@@ -1596,6 +1892,64 @@ class TrackerClient:
         self._connected.set()
         flight.record("event", "tracker.readopted", rank=self.rank,
                       epoch=self.epoch)
+        return True
+
+    def _rejoin(self) -> bool:
+        """Severed by the coordinator while this process is alive — the
+        signature of a DECLARED death (per-link deadline): the tracker
+        cut the channel expecting either a corpse or a comeback.  One
+        bounded attempt at the comeback: re-run the ``start`` handshake
+        as a replacement joiner and adopt the regroup assignment it is
+        answered with (the tracker holds that regroup open for a
+        readmission grace window, so a healed half-open link restores
+        the original world).  Returns False when the tracker is really
+        gone — the caller fails loud through :meth:`_channel_lost`."""
+        from .telemetry import flight
+
+        self._connected.clear()
+        # membership is changing: the blocked collective must drain into
+        # RegroupRequired, not retry a relay epoch we are no longer in
+        self._regroup_flag.set()
+        self.interrupt_collective()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        flight.record("event", "tracker.rejoin", rank=self.rank,
+                      epoch=self.epoch)
+        try:
+            s = socket.create_connection((self._host, self._port),
+                                         timeout=10.0)
+        except OSError:
+            return False
+        try:
+            # the reply IS the regroup assignment (a parked joiner's
+            # handshake is answered at absorption) — bounded: a tracker
+            # that parks us forever surfaces as a timeout, not a hang
+            s.settimeout(30.0)
+            send_msg(s, {"cmd": "start", "host": socket.gethostname(),
+                         "task_id": f"rejoin-{self.rank}"})
+            reply = recv_msg(s)
+        except (OSError, ValueError):
+            try:
+                s.close()
+            except OSError:
+                pass
+            return False
+        if not reply or "rank" not in reply:
+            try:
+                s.close()
+            except OSError:
+                pass
+            return False
+        s.settimeout(None)
+        with self._state_lock:
+            self._regroup_info = reply
+            self._sock = s
+        self._regroup_ready.set()
+        self._connected.set()
+        flight.record("event", "tracker.rejoined",
+                      rank=reply.get("rank"), epoch=reply.get("epoch"))
         return True
 
     def _channel_lost(self) -> None:
@@ -1666,6 +2020,7 @@ class TrackerClient:
             self._coll_interrupted = False  # the new epoch starts clean
         self._regroup_ready.clear()
         wait_s = timeout or self.op_timeout
+        early = False
         for attempt in range(3):
             # failover: a regroup can be entered WHILE the watcher is
             # still re-adopting into a respawned tracker — wait for the
@@ -1675,10 +2030,17 @@ class TrackerClient:
                 raise RuntimeError(
                     "tracker unreachable during elastic regroup "
                     "(re-adoption never completed)")
+            with self._state_lock:
+                early = self._regroup_info is not None
+            if early:
+                # a rejoin handshake (declared-dead comeback) was already
+                # answered with the assignment itself: no join to send —
+                # and the ready event it set may predate the clear above
+                break
             try:
                 send_msg(self._sock, {"cmd": "regroup_join",
                                       "round": int(completed_round)},
-                         timeout=30.0)
+                         timeout=30.0, peer=self.rank)
                 break
             except OSError as e:
                 if attempt >= 2 or not self.failover:
@@ -1686,7 +2048,7 @@ class TrackerClient:
                         f"tracker unreachable during elastic regroup: {e}"
                     ) from e
                 time.sleep(0.5)  # let the watcher notice and reconnect
-        if not self._regroup_ready.wait(wait_s):
+        if not early and not self._regroup_ready.wait(wait_s):
             raise RuntimeError(
                 "elastic regroup timed out waiting for the tracker "
                 "assignment")
@@ -1725,8 +2087,24 @@ class TrackerClient:
                 seed=self.rank, retry_on=(OSError,))
             send_msg(self._coll, {"cmd": "coll_join", "rank": self.rank,
                                   "epoch": self.epoch},
-                     timeout=30.0)
+                     timeout=30.0, peer=self.rank)
         return self._coll
+
+    def _await_regroup_verdict(self, budget_s: float = 2.0) -> bool:
+        """A severed relay connection in elastic mode usually means the
+        tracker just declared this rank (link deadline) and the control
+        channel's verdict — a ``declared_dead`` invitation plus EOF, or a
+        regroup broadcast — is milliseconds behind on the watcher thread.
+        Poll briefly for it so the collective surfaces RegroupRequired
+        (recoverable) instead of a hard I/O error (fatal).  A rank the
+        tracker really has abandoned burns the budget and fails exactly
+        as before."""
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            if self._regroup_flag.is_set() or self._coll_interrupted:
+                return True
+            time.sleep(0.05)
+        return False
 
     def coll_allgather(self, arr) -> "object":
         """Rank-ordered allgather over the tracker's socket relay:
@@ -1748,17 +2126,19 @@ class TrackerClient:
                 send_msg(s, {"cmd": "coll", "seq": seq,
                              "nbytes": len(payload),
                              "crc": _crc32(payload)},
-                         timeout=self.op_timeout)
-                with _op_timeout(s, self.op_timeout):
-                    s.sendall(payload)
-                hdr = recv_msg(s, timeout=self.op_timeout)
+                         timeout=self.op_timeout, peer=self.rank,
+                         trailing=payload)
+                hdr = recv_msg(s, timeout=self.op_timeout,
+                               peer=self.rank)
                 if hdr and hdr.get("cmd") == "coll_regroup":
                     raise RegroupRequired(
                         "collective membership changed mid-operation")
                 if not hdr or hdr.get("cmd") != "coll_result":
                     if hdr is None and (self._coll_interrupted
                                         or self._regroup_flag.is_set()
-                                        or self.failover):
+                                        or self.failover
+                                        or (self.elastic
+                                            and self._await_regroup_verdict())):
                         # a shutdown() poke (watchdog stall stage /
                         # failover reconnect) surfaces as clean EOF here,
                         # not OSError: same recovery — drain into regroup
@@ -1780,7 +2160,9 @@ class TrackerClient:
                         f"relay gather seq {seq} CRC mismatch: corrupted "
                         "payload — dropping the relay connection")
             except OSError as e:
-                if self._regroup_flag.is_set() or self._coll_interrupted:
+                if (self._regroup_flag.is_set() or self._coll_interrupted
+                        or (self.elastic
+                            and self._await_regroup_verdict())):
                     # elastic regroup pending, or the collective-wait
                     # watchdog severed the relay at its stall stage: both
                     # recover through the regroup path
@@ -1810,7 +2192,7 @@ class TrackerClient:
                "progress": payload.get("progress"),
                "pid": payload.get("pid", 0)}
         try:
-            send_msg(self._sock, msg, timeout=30.0)
+            send_msg(self._sock, msg, timeout=30.0, peer=self.rank)
             return True
         except (OSError, TypeError, ValueError):
             return False
@@ -1818,7 +2200,8 @@ class TrackerClient:
     def signal_error(self, msg: str) -> None:
         # bounded: a dying worker must not block on a wedged tracker
         try:
-            send_msg(self._sock, {"cmd": "error", "msg": msg}, timeout=30.0)
+            send_msg(self._sock, {"cmd": "error", "msg": msg}, timeout=30.0,
+                     peer=self.rank)
         except OSError:
             pass
 
@@ -1835,7 +2218,8 @@ class TrackerClient:
                     pass
                 self._coll = None
         try:
-            send_msg(self._sock, {"cmd": "shutdown"}, timeout=30.0)
+            send_msg(self._sock, {"cmd": "shutdown"}, timeout=30.0,
+                     peer=self.rank)
             self._sock.close()
         except OSError:
             pass
