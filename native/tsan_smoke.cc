@@ -9,10 +9,22 @@
 //      on), each caller bitwise vs the sequential reference;
 //   4. injected worker death (xtb_pool_kill_worker, the
 //      `native.parallel_for` fault seam): region completes, results stay
-//      correct, the pool respawns to full strength.
+//      correct, the pool respawns to full strength;
+//   5. rapid-fire tiny regions (the ABA window between back-to-back
+//      dispatches);
+//   6. kernel perf-counter RAII (XtbKernelPerf -> record_perf) under
+//      concurrent kernel callers WHILE a poller thread reads
+//      xtb_pool_kernel_perf/xtb_pool_kernel_stats live — invocation
+//      counts must stay monotone mid-flight and land exactly;
+//   7. heartbeat-era mixed dispatch: hist / hist_q / split / predict /
+//      tiny OTHER regions from six threads at once, with a heartbeat
+//      thread polling pool liveness + every kernel's counters on a short
+//      interval (the fleet heartbeat-loop traffic shape).
 //
 // Exits 0 + prints TSAN-SMOKE-OK when every check passes (TSAN itself
 // fails the process on a detected race).
+#include <atomic>
+#include <chrono>
 #include <cstdio>
 #include <cstring>
 #include <random>
@@ -225,6 +237,167 @@ int main() {
         return 1;
       }
     }
+  }
+
+  // --- 6. perf-counter RAII under concurrent callers + live poller ---
+  // Every kernel entry opens an XtbKernelPerf bracket whose dtor folds
+  // [invocations, wall_ns, cycles, bytes, flops] into the shared stats
+  // slot; a telemetry poller reads those slots with xtb_pool_kernel_perf
+  // WHILE brackets are closing on other threads.  TSAN checks the
+  // accounting atomics; we check the numbers: monotone mid-flight, and
+  // exactly one invocation per kernel call once the writers join.
+  const int NK = xtb_pool_n_kernels();
+  std::vector<int64_t> perf0(5), perf_now(5), stats_now(13);
+  xtb_pool_kernel_perf(XTB_K_HIST, perf0.data());
+  const int64_t hist_inv0 = perf0[0];
+  xtb_pool_kernel_perf(XTB_K_PREDICT, perf0.data());
+  const int64_t pred_inv0 = perf0[0];
+
+  constexpr int kPerfThreads = 4, kPerfIters = 4;
+  std::atomic<bool> done{false};
+  std::atomic<bool> perf_ok{true};
+  std::thread poller([&] {
+    std::vector<int64_t> last(NK, 0), p(5), s(13);
+    for (int k = 0; k < NK; ++k) {
+      xtb_pool_kernel_perf(k, p.data());
+      last[k] = p[0];
+    }
+    while (!done.load(std::memory_order_acquire)) {
+      for (int k = 0; k < NK; ++k) {
+        xtb_pool_kernel_perf(k, p.data());
+        xtb_pool_kernel_stats(k, s.data());
+        // a live counter read may be mid-bracket, but never backwards
+        // and never negative
+        if (p[0] < last[k] || p[1] < 0 || p[3] < 0 || s[0] < 0 || s[1] < 0) {
+          fprintf(stderr, "FAIL: perf counters went backwards (%s: %lld -> %lld)\n",
+                  xtb_pool_kernel_name(k), static_cast<long long>(last[k]),
+                  static_cast<long long>(p[0]));
+          perf_ok.store(false);
+        }
+        last[k] = p[0];
+      }
+      std::this_thread::sleep_for(std::chrono::microseconds(200));
+    }
+  });
+  {
+    std::vector<std::thread> writers;
+    for (int c = 0; c < kPerfThreads; ++c) {
+      writers.emplace_back([&] {
+        for (int it = 0; it < kPerfIters; ++it) {
+          auto h = run_hist(d);
+          if (memcmp(h.data(), ref.data(), h.size() * sizeof(float)) != 0)
+            perf_ok.store(false);
+          auto p = run_predict(d, feat, thr, dleft, lr, value, groups, T, M);
+          if (memcmp(p.data(), pref.data(), p.size() * sizeof(float)) != 0)
+            perf_ok.store(false);
+        }
+      });
+    }
+    for (auto& t : writers) t.join();
+  }
+  done.store(true, std::memory_order_release);
+  poller.join();
+  if (!perf_ok.load()) return 1;
+  xtb_pool_kernel_perf(XTB_K_HIST, perf_now.data());
+  const int64_t hist_calls = kPerfThreads * kPerfIters;
+  if (perf_now[0] - hist_inv0 != hist_calls || perf_now[1] <= 0 ||
+      perf_now[3] <= 0) {
+    fprintf(stderr,
+            "FAIL: hist perf bracket miscount (d_inv=%lld want %lld, "
+            "wall=%lld, bytes=%lld)\n",
+            static_cast<long long>(perf_now[0] - hist_inv0),
+            static_cast<long long>(hist_calls),
+            static_cast<long long>(perf_now[1]),
+            static_cast<long long>(perf_now[3]));
+    return 1;
+  }
+  xtb_pool_kernel_perf(XTB_K_PREDICT, perf_now.data());
+  if (perf_now[0] - pred_inv0 != hist_calls) {
+    fprintf(stderr, "FAIL: predict perf bracket miscount (d_inv=%lld)\n",
+            static_cast<long long>(perf_now[0] - pred_inv0));
+    return 1;
+  }
+
+  // --- 7. heartbeat-era mixed dispatch: six threads driving FOUR kernel
+  // families through the one shared pool at once (hist + hist_q + split +
+  // predict + tiny OTHER regions), while a heartbeat thread polls
+  // liveness and every kernel's counters on a short interval — the
+  // traffic shape a fleet heartbeat loop sees, where telemetry reads
+  // race live perf-bracket closes and pool region turnover ---
+  std::atomic<bool> hb_done{false};
+  std::atomic<bool> mixed_ok{true};
+  std::thread heartbeat([&] {
+    std::vector<int64_t> p(5), s(13);
+    while (!hb_done.load(std::memory_order_acquire)) {
+      if (xtb_pool_alive_workers() < 1) {
+        fprintf(stderr, "FAIL: heartbeat saw an empty pool\n");
+        mixed_ok.store(false);
+      }
+      for (int k = 0; k < NK; ++k) {
+        xtb_pool_kernel_perf(k, p.data());
+        xtb_pool_kernel_stats(k, s.data());
+      }
+      (void)xtb_pool_regions_total();
+      std::this_thread::sleep_for(std::chrono::microseconds(500));
+    }
+  });
+  {
+    std::vector<std::thread> traffic;
+    for (int c = 0; c < 6; ++c) {
+      traffic.emplace_back([&, c] {
+        std::vector<float> g(N), GLo(N), HLo(N);
+        std::vector<int32_t> fo(N), bo(N);
+        std::vector<uint8_t> dlo(N);
+        std::vector<int32_t> q(static_cast<size_t>(N) * F * B * 6);
+        for (int it = 0; it < 3; ++it) {
+          switch ((c + it) % 4) {
+            case 0: {
+              auto h = run_hist(d);
+              if (memcmp(h.data(), ref.data(),
+                         h.size() * sizeof(float)) != 0)
+                mixed_ok.store(false);
+              break;
+            }
+            case 1: {
+              auto p = run_predict(d, feat, thr, dleft, lr, value, groups,
+                                   T, M);
+              if (memcmp(p.data(), pref.data(),
+                         p.size() * sizeof(float)) != 0)
+                mixed_ok.store(false);
+              break;
+            }
+            case 2: {
+              run_split(g.data(), fo.data(), bo.data(), dlo.data(),
+                        GLo.data(), HLo.data());
+              if (memcmp(g.data(), g1.data(), N * sizeof(float)) != 0)
+                mixed_ok.store(false);
+              break;
+            }
+            default: {
+              xtb_hist_q_impl(d.bins.data(), limbs.data(), d.pos.data(), R,
+                              F, B, N - 1, N, 1, 6, q.data());
+              if (memcmp(q.data(), q1.data(),
+                         q.size() * sizeof(int32_t)) != 0)
+                mixed_ok.store(false);
+              break;
+            }
+          }
+          std::vector<int64_t> sums(4, 0);
+          xtb_parallel_for(4, 1, XTB_K_OTHER, [&](int64_t b, int64_t e) {
+            for (int64_t i = b; i < e; ++i) sums[i] = i;
+          });
+          for (int64_t i = 0; i < 4; ++i)
+            if (sums[i] != i) mixed_ok.store(false);
+        }
+      });
+    }
+    for (auto& t : traffic) t.join();
+  }
+  hb_done.store(true, std::memory_order_release);
+  heartbeat.join();
+  if (!mixed_ok.load()) {
+    fprintf(stderr, "FAIL: heartbeat-era mixed dispatch diverged\n");
+    return 1;
   }
 
   printf("TSAN-SMOKE-OK regions=%lld simd=%s\n",

@@ -173,6 +173,20 @@ def bench_serve(X, y, reps, batch=256):
 
 
 def main(out_path: str) -> int:
+    # the overhead gate measures the witness-OFF configuration: unless
+    # the operator armed lockdep on purpose, the raw C lock factories
+    # must be in place — merged-but-unarmed lockdep patches nothing and
+    # therefore cannot move these walls
+    from xgboost_tpu.reliability import lockdep
+
+    if not lockdep.enabled():
+        import _thread
+        import threading
+
+        assert threading.Lock is _thread.allocate_lock, \
+            "lockdep disarmed but threading.Lock is not the raw factory"
+        print("bench_obs: lockdep witness off, raw lock factories verified")
+
     scale = _env_float("BENCH_OBS_SCALE", 0.02)
     reps = max(1, int(_env_float("BENCH_OBS_REPS", 3)))
     rounds = max(1, int(_env_float("BENCH_OBS_ROUNDS", 5)))

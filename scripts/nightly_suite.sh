@@ -28,6 +28,40 @@ fi
 
 python -m pytest tests/ -q --durations=25
 
+# lockdep-armed legs (docs/reliability.md "Lockdep witness"): the runtime
+# witness watches real multi-process traffic for lock-order inversions
+# and locks held across fault seams.  Any violation prints the
+# XTB-LOCKDEP-VIOLATION marker on stderr at process exit — a leg passes
+# only when its whole process tree stays silent.
+run_lockdep_clean() {
+    local log
+    log=$(mktemp /tmp/xtb_lockdep_leg.XXXXXX.log)
+    XGBOOST_TPU_LOCKDEP=1 "$@" >"$log" 2>&1 || { cat "$log"; rm -f "$log"; return 1; }
+    if grep -n "XTB-LOCKDEP-VIOLATION" "$log"; then
+        echo "lockdep witness reported violations under: $*" >&2
+        cat "$log"
+        rm -f "$log"
+        return 1
+    fi
+    tail -n 3 "$log"
+    rm -f "$log"
+}
+
+# chaos soak under the armed witness: every episode additionally checks
+# the lockdep_silent invariant (reliability/chaos.py), and the marker
+# grep catches violations from killed child processes too
+echo "== lockdep-armed chaos soak =="
+run_lockdep_clean env JAX_PLATFORMS=cpu python scripts/chaos_soak.py \
+    --budget-s 60 --seed "${NIGHTLY_SEED:-20260804}"
+
+# multi-process smokes under the armed witness: tracker fan-out under a
+# mid-round kill, and fleet dispatch/heartbeat traffic with a replica
+# SIGKILL — the two densest lock/wire interleavings in the tree
+echo "== lockdep-armed fault smoke =="
+run_lockdep_clean env JAX_PLATFORMS=cpu python scripts/fault_smoke.py 4 6
+echo "== lockdep-armed fleet smoke =="
+run_lockdep_clean env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py 2 60
+
 # telemetry smoke: a short traced training run must leave a parseable JSONL
 # whose span names cover the per-round phases (docs/observability.md)
 TRACE_OUT=$(mktemp /tmp/xtb_telemetry_smoke.XXXXXX.jsonl)
@@ -151,8 +185,12 @@ JAX_PLATFORMS=cpu python scripts/fleet_smoke.py 3 120
 
 # observability overhead guard (docs/observability.md): train+serve walls
 # with telemetry shipping on vs off on the higgs config shape, min-of-N
-# with interleaved legs; fails beyond BENCH_OBS_MAX_PCT (default 5%)
-JAX_PLATFORMS=cpu python scripts/bench_obs.py bench_out/BENCH_OBS.json
+# with interleaved legs; fails beyond BENCH_OBS_MAX_PCT (default 5%).
+# Runs with the lockdep witness explicitly OFF: the script asserts the
+# raw threading factories are in place (witness-off means NOTHING is
+# patched — merged-but-unarmed lockdep cannot move this gate)
+XGBOOST_TPU_LOCKDEP=0 JAX_PLATFORMS=cpu \
+    python scripts/bench_obs.py bench_out/BENCH_OBS.json
 
 # composed-fault chaos soak (docs/reliability.md "Integrity & chaos"):
 # >= 20 seeded multi-fault episodes round-robin across the scenario

@@ -19,6 +19,13 @@ if "--xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# arm the runtime lockdep witness for the whole suite (before the first
+# xgboost_tpu import, so module-level locks are witnessed): every test
+# doubles as a lock-order/seam-discipline probe, and the session fixture
+# below asserts the suite produced zero reports.  Respect an explicit
+# operator setting (e.g. XGBOOST_TPU_LOCKDEP=0 to profile witness cost).
+os.environ.setdefault("XGBOOST_TPU_LOCKDEP", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -52,7 +59,7 @@ _QUICK_FILES = {
     "test_lifecycle.py", "test_updaters_process.py", "test_extmem.py",
     "test_integrity.py", "test_chaos.py", "test_watchdog.py",
     "test_failover.py", "test_resources.py", "test_window_store.py",
-    "test_online.py", "test_profiler.py",
+    "test_online.py", "test_profiler.py", "test_lockdep.py",
 }
 _QUICK_DENY = {
     # measured > ~8 s (full-suite --durations)
@@ -117,6 +124,21 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
             "test_oracle_parity/test_exact oracle check SKIPPED.\n"
             "Rebuild with: bash oracle/build_oracle.sh   (~40 min, durable "
             "under /root/oracle_build)")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockdep_clean_session():
+    """The whole suite must leave the lockdep witness silent: any
+    lock-order inversion or lock-held-across-seam report from real test
+    traffic is a concurrency bug, not noise.  Tests that provoke reports
+    deliberately (test_lockdep.py) clear them before returning."""
+    yield
+    from xgboost_tpu.reliability import lockdep
+
+    if lockdep.enabled():
+        rs = lockdep.reports()
+        assert not rs, "lockdep witness reports leaked from the suite: " \
+            + "; ".join(f"[{r['kind']}] {r['msg']}" for r in rs)
 
 
 @pytest.fixture(scope="session")

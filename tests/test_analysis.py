@@ -409,7 +409,8 @@ def test_rule_catalog_covers_all_families():
     cat = {code for code, _rule, _desc in rule_catalog()}
     assert {"XTB101", "XTB102", "XTB103", "XTB201", "XTB202", "XTB203",
             "XTB301", "XTB302", "XTB303", "XTB304", "XTB401", "XTB402",
-            "XTB403", "XTB501", "XTB502"} <= cat
+            "XTB403", "XTB501", "XTB502", "XTB901", "XTB902", "XTB903",
+            "XTB905", "XTB906"} <= cat
 
 
 # ---------------------------------------------------------------------------
@@ -672,3 +673,331 @@ def test_no_blanket_suppressions_in_tree():
     offenders = [o for o in offenders
                  if os.sep + "analysis" + os.sep not in o]
     assert offenders == []
+
+
+# ---------------------------------------------------------------------------
+# XTB901/902/903 — lock-order and blocking-under-lock discipline
+# ---------------------------------------------------------------------------
+
+def test_lockorder_abba_inversion_fires():
+    r = lint_source(src("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self.x = 0
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        self.x += 1
+                        self.x += 2
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        self.x += 1
+                        self.x += 2
+    """), "xgboost_tpu/m.py", select=["XTB9"])
+    assert codes(r) == ["XTB901"]
+    # the report names both locks so the fix (pick ONE order) is obvious
+    assert "S._a" in r.findings[0].message
+    assert "S._b" in r.findings[0].message
+
+
+def test_lockorder_consistent_nesting_clean():
+    r = lint_source(src("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self.x = 0
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        self.x += 1
+                        self.x += 2
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        self.x -= 1
+                        self.x -= 2
+    """), "xgboost_tpu/m.py", select=["XTB9"])
+    assert codes(r) == []
+
+
+def test_lockorder_transitive_cycle_through_helper():
+    # one() holds _a and calls a helper that takes _b; two() nests the
+    # other way — the inversion is only visible through the call graph
+    r = lint_source(src("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self.x = 0
+
+            def _bump(self):
+                with self._b:
+                    self.x += 1
+                    self.x += 2
+
+            def one(self):
+                with self._a:
+                    self.x += 1
+                    self._bump()
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        self.x += 1
+                        self.x += 2
+    """), "xgboost_tpu/m.py", select=["XTB9"])
+    assert codes(r) == ["XTB901"]
+
+
+def test_blocking_while_holding_lock_fires():
+    r = lint_source(src("""
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self.x = 0
+
+            def one(self):
+                with self._a:
+                    time.sleep(1.0)
+                    self.x += 1
+    """), "xgboost_tpu/m.py", select=["XTB9"])
+    assert codes(r) == ["XTB902"]
+
+
+def test_blocking_declared_serialization_lock_exempt():
+    # _XTB_SERIAL_LOCKS declares the contract: holding _tx across wire
+    # I/O is the lock's purpose.  XTB902 waived; the lock stays in the
+    # XTB901 order graph.
+    r = lint_source(src("""
+        import threading
+        import time
+
+        _XTB_SERIAL_LOCKS = ("S._tx",)
+
+        class S:
+            def __init__(self):
+                self._tx = threading.Lock()
+                self.x = 0
+
+            def one(self):
+                with self._tx:
+                    time.sleep(1.0)
+                    self.x += 1
+    """), "xgboost_tpu/m.py", select=["XTB9"])
+    assert codes(r) == []
+
+
+def test_blocking_after_release_clean():
+    r = lint_source(src("""
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self.x = 0
+
+            def one(self):
+                with self._a:
+                    n = self.x
+                    self.x += 1
+                time.sleep(n)
+    """), "xgboost_tpu/m.py", select=["XTB9"])
+    assert codes(r) == []
+
+
+def test_handler_lock_acquire_fires_and_bounded_clean():
+    fired = lint_source(src("""
+        import atexit
+        import threading
+
+        _lock = threading.Lock()
+        _buf = []
+
+        @atexit.register
+        def _flush():
+            with _lock:
+                _buf.clear()
+                _buf.append(1)
+    """), "xgboost_tpu/m.py", select=["XTB9"])
+    assert codes(fired) == ["XTB903"]
+
+    bounded = lint_source(src("""
+        import atexit
+        import threading
+
+        _lock = threading.Lock()
+        _buf = []
+
+        @atexit.register
+        def _flush():
+            if not _lock.acquire(timeout=1.0):
+                return
+            try:
+                _buf.clear()
+                _buf.append(1)
+            finally:
+                _lock.release()
+    """), "xgboost_tpu/m.py", select=["XTB9"])
+    assert codes(bounded) == []
+
+
+def test_lockorder_suppression_honored():
+    r = lint_source(src("""
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self.x = 0
+
+            def one(self):
+                with self._a:
+                    time.sleep(1.0)  # xtblint: disable=XTB902
+                    self.x += 1
+    """), "xgboost_tpu/m.py", select=["XTB9"])
+    assert codes(r) == []
+    assert [f.code for f in r.suppressed] == ["XTB902"]
+
+
+# ---------------------------------------------------------------------------
+# XTB905/XTB906 — env-knob catalog
+# ---------------------------------------------------------------------------
+
+def _knob_docs(tmp_path, table):
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    (docs / "knobs.md").write_text(table)
+    # the other doc contracts skip quietly when their files are absent
+    return str(docs)
+
+
+def test_envknob_undocumented_read_fires(tmp_path):
+    r = lint_source(src("""
+        import os
+
+        V = os.environ.get("XGBOOST_TPU_MYSTERY_KNOB", "1")
+    """), "xgboost_tpu/m.py", select=["XTB905"],
+        docs_root=_knob_docs(tmp_path, "| `XGBOOST_TPU_OTHER` | x |\n"))
+    assert codes(r) == ["XTB905"]
+    assert "XGBOOST_TPU_MYSTERY_KNOB" in r.findings[0].message
+
+
+def test_envknob_stale_row_fires_and_pattern_exempt(tmp_path):
+    r = lint_source(src("""
+        import os
+
+        V = os.environ.get("XGBOOST_TPU_LIVE_KNOB")
+    """), "xgboost_tpu/m.py", select=["XTB9"],
+        docs_root=_knob_docs(tmp_path, src("""
+            | `XGBOOST_TPU_LIVE_KNOB` | documented and read |
+            | `XGBOOST_TPU_GONE_KNOB` | stale row |
+            | `XGBOOST_TPU_WATCHDOG_<SEAM>_S` | pattern row, exempt |
+        """)))
+    assert codes(r) == ["XTB906"]
+    assert "XGBOOST_TPU_GONE_KNOB" in r.findings[0].message
+
+
+def test_envknob_const_reference_and_concat_resolved(tmp_path):
+    # the ENV_X = "XGBOOST_TPU_..." constant idiom and the derived-name
+    # concat (trace.py's _OWNER_VAR) both resolve to documented reads
+    r = lint_source(src("""
+        import os
+
+        ENV_BASE = "XGBOOST_TPU_THING"
+        _DERIVED = ENV_BASE + "_EXTRA"
+
+        def f():
+            return (os.environ.get(ENV_BASE),
+                    os.environ.get(_DERIVED))
+    """), "xgboost_tpu/m.py", select=["XTB9"],
+        docs_root=_knob_docs(tmp_path, src("""
+            | `XGBOOST_TPU_THING` | base |
+            | `XGBOOST_TPU_THING_EXTRA` | derived |
+        """)))
+    assert codes(r) == []
+
+
+# (no separate whole-package XTB905/906 reconciliation test: the gate
+# test above lints the full package with EVERY rule enabled — an
+# undocumented read or stale knobs.md row already fails it)
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end: mixed families + suppressions through the JSON reporter
+# ---------------------------------------------------------------------------
+
+def test_cli_mixed_families_and_suppressions_e2e(tmp_path):
+    mixed = tmp_path / "mixed.py"
+    mixed.write_text(src("""
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self.x = 0
+
+            def one(self):
+                with self._a:
+                    time.sleep(1.0)
+                    self.x += 1
+
+            def stamp(self):
+                return time.time()
+
+            def stamp_ok(self):
+                return time.time()  # xtblint: disable=XTB501
+    """))
+    rep = tmp_path / "rep.json"
+    p = subprocess.run(
+        [sys.executable, "-m", "xgboost_tpu.analysis", str(mixed),
+         "--format", "json", "--json-out", str(rep)],
+        capture_output=True, text=True, env=dict(os.environ, PYTHONPATH=REPO),
+        cwd=str(tmp_path))
+    assert p.returncode == 1
+    payload = json.loads(rep.read_text())
+    assert payload["clean"] is False
+    assert payload["counts"] == {"XTB902": 1, "XTB501": 1}
+    # stdout carries the same JSON document as --json-out
+    assert json.loads(p.stdout)["counts"] == payload["counts"]
+    # the suppressed XTB501 is REPORTED (trend tracking), not dropped
+    assert [f["code"] for f in payload["suppressed"]] == ["XTB501"]
+    # exit-code contract: suppressing every finding makes the gate pass
+    clean = tmp_path / "clean.py"
+    clean.write_text("import time\nt = time.time()  "
+                     "# xtblint: disable=XTB501\n")
+    p2 = subprocess.run(
+        [sys.executable, "-m", "xgboost_tpu.analysis", str(clean)],
+        capture_output=True, text=True, env=dict(os.environ, PYTHONPATH=REPO),
+        cwd=str(tmp_path))
+    assert p2.returncode == 0, p2.stdout + p2.stderr
+
+
+def test_gate_changed_mode_exits_zero():
+    """scripts/lint_gate.sh --changed (the quick-tier fast mode) passes on
+    the tree as committed/staged right now."""
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "lint_gate.sh"), "--changed"],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, PYTHONPATH=REPO))
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "lint_gate OK" in p.stdout

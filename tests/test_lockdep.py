@@ -1,0 +1,201 @@
+"""Runtime lockdep witness (reliability/lockdep.py).
+
+The suite-wide conftest arms the witness (XGBOOST_TPU_LOCKDEP=1 before
+the first package import), so these tests exercise the REAL armed
+configuration: patched factories, wrapped package locks, the seam hook
+in faults.maybe_inject.  Tests that provoke reports deliberately clear
+them (the session fixture asserts the suite ends report-free).
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from xgboost_tpu.reliability import faults, lockdep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_witness():
+    lockdep.clear()
+    yield
+    lockdep.clear()
+
+
+def _run_py(code, **env):
+    return subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+             **env})
+
+
+def test_armed_in_suite_and_package_locks_wrapped():
+    assert lockdep.enabled()
+    from xgboost_tpu.telemetry import flight
+
+    key = getattr(flight._lock, "_xtb_key", None)
+    assert key is not None and key.startswith("telemetry/flight.py:")
+
+
+def test_off_by_default_nothing_patched():
+    p = _run_py(
+        "import threading, _thread\n"
+        "import xgboost_tpu\n"
+        "from xgboost_tpu.reliability import lockdep\n"
+        "assert not lockdep.enabled()\n"
+        "assert threading.Lock is _thread.allocate_lock\n"
+        "print('raw')\n",
+        XGBOOST_TPU_LOCKDEP="0")
+    assert p.returncode == 0, p.stderr
+    assert "raw" in p.stdout
+
+
+def test_abba_inversion_reported_on_first_conflicting_acquire():
+    a = lockdep.named_lock("t/abba_a")
+    b = lockdep.named_lock("t/abba_b")
+
+    def nest(first, second):
+        with first:
+            with second:
+                pass
+
+    t = threading.Thread(target=nest, args=(a, b))
+    t.start(); t.join()
+    assert lockdep.reports() == []  # one order established, no conflict
+    t = threading.Thread(target=nest, args=(b, a))
+    t.start(); t.join()
+    kinds = [r["kind"] for r in lockdep.reports()]
+    assert kinds == ["order"]
+    msg = lockdep.reports()[0]["msg"]
+    assert "t/abba_a" in msg and "t/abba_b" in msg
+
+
+def test_consistent_order_stays_silent():
+    a = lockdep.named_lock("t/cons_a")
+    b = lockdep.named_lock("t/cons_b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockdep.reports() == []
+
+
+def test_bounded_acquire_adds_no_edges():
+    # trylock/timeout acquires cannot deadlock: no edge, so the reversed
+    # unbounded nesting later is a fresh (single) order, not an inversion
+    a = lockdep.named_lock("t/bnd_a")
+    b = lockdep.named_lock("t/bnd_b")
+    with a:
+        assert b.acquire(timeout=0.5)
+        b.release()
+    with b:
+        with a:
+            pass
+    assert lockdep.reports() == []
+
+
+def test_self_deadlock_check_plain_vs_rlock():
+    c = lockdep.named_lock("t/self_c")
+    c.acquire()
+    lockdep._check_before_acquire("t/self_c", False)
+    assert [r["kind"] for r in lockdep.reports()] == ["self-deadlock"]
+    c.release()
+    lockdep.clear()
+    r = lockdep.named_lock("t/self_r", reentrant=True)
+    with r:
+        with r:  # real re-entrant acquire: legal, silent
+            pass
+    assert lockdep.reports() == []
+
+
+def test_condition_on_wrapped_lock_works():
+    cond = threading.Condition(lockdep.named_lock("t/cond", reentrant=True))
+    woke = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5.0)
+            woke.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    with cond:
+        cond.notify_all()
+    t.join(5.0)
+    assert woke == [1]
+    assert lockdep.reports() == []
+
+
+def test_seam_witness_fires_through_maybe_inject():
+    lk = lockdep.named_lock("t/seam_lk")
+    with lk:
+        faults.maybe_inject("tracker.message")
+    rs = lockdep.reports()
+    assert [r["kind"] for r in rs] == ["seam"]
+    assert "t/seam_lk" in rs[0]["msg"]
+    assert "tracker.message" in rs[0]["msg"]
+    # once per lock/seam pair: crossing again adds nothing
+    with lk:
+        faults.maybe_inject("tracker.message")
+    assert len(lockdep.reports()) == 1
+
+
+def test_mark_serial_waives_seam_and_ignores_raw_locks():
+    lk = lockdep.mark_serial(lockdep.named_lock("t/serial_lk"))
+    with lk:
+        faults.maybe_inject("tracker.message")
+    assert lockdep.reports() == []
+    # raw (unwitnessed) lock: mark_serial is a harmless no-op
+    import _thread
+
+    raw = _thread.allocate_lock()
+    assert lockdep.mark_serial(raw) is raw
+
+
+def test_atexit_marker_printed_on_violation():
+    p = _run_py(
+        "from xgboost_tpu.reliability import lockdep, faults\n"
+        "lk = lockdep.named_lock('t/x')\n"
+        "with lk:\n"
+        "    faults.maybe_inject('tracker.message')\n",
+        XGBOOST_TPU_LOCKDEP="1")
+    assert p.returncode == 0, p.stderr
+    assert "XTB-LOCKDEP-VIOLATION: 1 report(s)" in p.stderr
+    assert "t/x" in p.stderr
+
+
+def test_raise_mode_raises_at_offending_acquire():
+    p = _run_py(
+        "from xgboost_tpu.reliability import lockdep, faults\n"
+        "lk = lockdep.named_lock('t/x')\n"
+        "try:\n"
+        "    with lk:\n"
+        "        faults.maybe_inject('tracker.message')\n"
+        "except lockdep.LockdepViolation as e:\n"
+        "    print('raised:', e)\n"
+        "    lockdep.clear()\n",
+        XGBOOST_TPU_LOCKDEP="1", XGBOOST_TPU_LOCKDEP_RAISE="1")
+    assert p.returncode == 0, p.stderr
+    assert "raised:" in p.stdout
+
+
+def test_armed_training_run_stays_silent():
+    # the tentpole acceptance shape in miniature: real training traffic
+    # under the armed witness produces zero reports
+    import numpy as np
+
+    import xgboost_tpu as xtb
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(120, 6))
+    y = (X[:, 0] > 0).astype(np.float32)
+    d = xtb.DMatrix(X, label=y)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": 3}, d,
+                    num_boost_round=3)
+    bst.predict(d)
+    assert lockdep.reports() == []

@@ -12,6 +12,12 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# arm the runtime lock-order witness (no-op unless XGBOOST_TPU_LOCKDEP=1)
+# before any sibling import creates a lock — module-level locks in
+# telemetry/reliability/data are only witnessed if the factories are
+# patched first (docs/reliability.md "Lockdep witness")
+from .reliability import lockdep as _lockdep  # noqa: E402,F401
+
 from .config import config_context, get_config, set_config
 from .core import Booster
 from .data.dmatrix import DMatrix, MetaInfo, QuantileDMatrix

@@ -33,7 +33,9 @@ def _parser() -> argparse.ArgumentParser:
                     "hazards (XTB1xx), lock discipline (XTB2xx), fault-seam "
                     "consistency (XTB3xx), metric-name consistency "
                     "(XTB4xx), nondeterminism (XTB5xx), SIMD confinement "
-                    "(XTB6xx), and unbounded blocking calls (XTB7xx).")
+                    "(XTB6xx), unbounded blocking calls (XTB7xx), lock-order "
+                    "and blocking-under-lock discipline (XTB901-903), and "
+                    "the env-knob catalog (XTB905/XTB906).")
     p.add_argument("paths", nargs="*", help="files/directories to lint "
                    "(default: ./xgboost_tpu)")
     p.add_argument("--format", choices=("text", "json"), default="text")

@@ -189,14 +189,15 @@ def _detect_docs_root(paths: Sequence[str]) -> Optional[str]:
 
 def _rules() -> List[Rule]:
     # imported here so `import xgboost_tpu.analysis.core` stays cycle-free
-    from . import (blocking, locks, metric_names, nondet, resource_errors,
-                   retrace, seams, simd_seam)
+    from . import (blocking, envknobs, lockorder, locks, metric_names,
+                   nondet, resource_errors, retrace, seams, simd_seam)
 
     return [retrace.RetraceRule(), locks.LockDisciplineRule(),
             locks.CapiDispatchRule(), seams.SeamConsistencyRule(),
             metric_names.MetricNameRule(), nondet.NondeterminismRule(),
             simd_seam.SimdSeamRule(), blocking.BlockingCallRule(),
-            resource_errors.ResourceErrorRule()]
+            resource_errors.ResourceErrorRule(), lockorder.LockOrderRule(),
+            envknobs.EnvKnobRule()]
 
 
 @dataclasses.dataclass

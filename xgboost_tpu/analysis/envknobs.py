@@ -1,0 +1,156 @@
+"""XTB9xx (knobs) — ``XGBOOST_TPU_*``/``XTB_*`` env-knob catalog.
+
+The package grew ~40 environment knobs across telemetry, reliability,
+serving, and training; operators discover them by grepping.  This rule
+is the XTB4xx metric-catalog contract applied to configuration: every
+env read must appear in the ``docs/knobs.md`` table, and every table row
+must still correspond to a live read — so the doc IS the catalog and
+cannot rot in either direction.
+
+- **XTB905** — an ``XGBOOST_TPU_*``/``XTB_*`` env variable read in the
+  package (``os.environ.get``/``[]``/``setdefault``/``pop``,
+  ``os.getenv``) that the ``docs/knobs.md`` table does not mention.
+- **XTB906** — a knob named in the ``docs/knobs.md`` table that nothing
+  in the package reads (renamed or deleted knob leaving a stale row).
+  Pattern rows — names containing ``<`` (e.g. the per-seam
+  ``XGBOOST_TPU_WATCHDOG_<SEAM>_S`` family built dynamically) — are
+  exempt: the dynamic construction is invisible to a static read scan.
+
+Knob names usually flow through module constants (``ENV_HZ =
+"XGBOOST_TPU_PROF_HZ"`` ... ``os.environ.get(ENV_HZ)``), often imported
+across modules; the rule resolves a constant reference project-wide when
+the bare name or attribute maps to exactly one knob-shaped value.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import Finding, Project, Rule, SourceFile
+
+_FACT_READS = "envknobs.reads"      # list[(name-or-None, ref, path, line)]
+_FACT_CONSTS = "envknobs.consts"    # const name -> set of knob values
+
+_PREFIXES = ("XGBOOST_TPU_", "XTB_")
+_DOC = "knobs.md"
+_DOC_TOKEN_RE = re.compile(r"\b(?:XGBOOST_TPU|XTB)_[A-Z0-9_]*(?:<[A-Z_]+>"
+                           r"[A-Z0-9_]*)?\b")
+_READ_METHODS = ("get", "setdefault", "pop", "getenv")
+
+
+def _knobbish(value: object) -> bool:
+    return isinstance(value, str) and value.startswith(_PREFIXES)
+
+
+def _const_str(node: ast.AST, local: Dict[str, str]) -> Optional[str]:
+    """Fold a module-level string expression: literals, references to
+    already-seen knob consts, and ``+`` concatenations of those (the
+    ``_OWNER_VAR = ENV_VAR + "_OWNER_PID"`` derived-knob idiom)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return local.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _const_str(node.left, local)
+        right = _const_str(node.right, local)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def _env_read_arg(node: ast.AST) -> Optional[ast.expr]:
+    """The name expression when ``node`` reads the environment."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        tail = node.func.attr
+        recv = node.func.value
+        if tail == "getenv" and isinstance(recv, ast.Name) \
+                and recv.id == "os" and node.args:
+            return node.args[0]
+        if tail in _READ_METHODS and isinstance(recv, ast.Attribute) \
+                and recv.attr == "environ" and node.args:
+            return node.args[0]
+    if isinstance(node, ast.Subscript) and \
+            isinstance(node.value, ast.Attribute) and \
+            node.value.attr == "environ":
+        sl = node.slice
+        return sl if isinstance(sl, ast.expr) else None
+    return None
+
+
+class EnvKnobRule(Rule):
+    name = "env-knobs"
+    codes = {
+        "XTB905": "XGBOOST_TPU_*/XTB_* env read missing from the "
+                  "docs/knobs.md catalog table",
+        "XTB906": "knob named in docs/knobs.md that nothing reads "
+                  "(stale row; pattern rows with <...> are exempt)",
+    }
+
+    def check_file(self, sf: SourceFile, project: Project,
+                   ) -> Iterable[Finding]:
+        reads: list = project.facts.setdefault(_FACT_READS, [])
+        consts: Dict[str, set] = project.facts.setdefault(_FACT_CONSTS, {})
+        local: Dict[str, str] = {}
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                value = _const_str(node.value, local)
+                if value is not None and _knobbish(value):
+                    local[node.targets[0].id] = value
+                    consts.setdefault(node.targets[0].id,
+                                      set()).add(value)
+        for node in ast.walk(sf.tree):
+            arg = _env_read_arg(node)
+            if arg is None:
+                continue
+            line = getattr(node, "lineno", 1)
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if arg.value.startswith(_PREFIXES):
+                    reads.append((arg.value, None, sf.path, line))
+            elif isinstance(arg, ast.Name):
+                if arg.id in local:
+                    reads.append((local[arg.id], None, sf.path, line))
+                else:
+                    reads.append((None, arg.id, sf.path, line))
+            elif isinstance(arg, ast.Attribute):
+                reads.append((None, arg.attr, sf.path, line))
+        return ()
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        doc = project.doc_text(_DOC)
+        if doc is None:
+            return ()
+        raw: List[Tuple[Optional[str], Optional[str], str, int]] = (
+            project.facts.get(_FACT_READS) or [])
+        consts: Dict[str, set] = project.facts.get(_FACT_CONSTS) or {}
+        read_names: Dict[str, Tuple[str, int]] = {}
+        for name, ref, path, line in raw:
+            if name is None and ref is not None:
+                vals = consts.get(ref, ())
+                if len(vals) == 1:
+                    name = next(iter(vals))
+            if name is not None:
+                read_names.setdefault(name, (path, line))
+        findings: List[Finding] = []
+        for name in sorted(read_names):
+            if name not in doc:
+                path, line = read_names[name]
+                findings.append(Finding(
+                    path, line, 0, "XTB905",
+                    f"env knob {name!r} read here but missing from "
+                    f"{project.doc_path(_DOC)} — add a row (name, default, "
+                    f"consumer, effect) to the knobs table"))
+        for i, line_text in enumerate(doc.splitlines(), start=1):
+            for token in _DOC_TOKEN_RE.findall(line_text):
+                if "<" in token:
+                    continue  # dynamic per-seam/per-site pattern row
+                if token in _PREFIXES or token in ("XGBOOST_TPU_",):
+                    continue
+                if token not in read_names:
+                    findings.append(Finding(
+                        project.doc_path(_DOC), i, 0, "XTB906",
+                        f"knob {token!r} documented but nothing in the "
+                        f"package reads it — stale row (renamed knob?) or "
+                        f"missing consumer"))
+        return findings

@@ -1,0 +1,837 @@
+"""XTB9xx — concurrency contract: static lock-order analysis.
+
+XTB201 checks *that* guarded attributes are locked; this family checks
+*how* locks compose.  It discovers every lock the package creates
+(``threading.Lock``/``RLock``/``Condition`` attributes, module-level
+locks, ``fcntl.flock`` sites), rebuilds the may-acquire-after graph from
+``with`` blocks and explicit ``acquire``/``release`` across the
+intra-class and cross-module call graph, and reports:
+
+- **XTB901** — lock-order inversion: a cycle in the may-acquire-after
+  graph.  Two threads taking the same pair of locks in opposite orders
+  is the classic ABBA deadlock; the finding prints a witness site for
+  every edge on the cycle so both paths are visible in the report.
+- **XTB902** — blocking call while holding a lock: a socket/wire
+  send or recv, ``Future.result``, queue ``get``, ``subprocess``,
+  ``time.sleep``, ``fcntl.flock``, or a ``faults.maybe_inject`` seam
+  reached inside a lock scope.  One wedged peer then stalls every
+  thread that wants the lock — the hang class PR 14's watchdog mops up
+  at runtime becomes a lint failure instead.
+- **XTB903** — unbounded lock acquisition inside a ``signal``/
+  ``atexit``/fork handler.  Interpreter shutdown and ``fork()`` run
+  these on a thread that may not own the lock; a plain ``with lock:``
+  there can hang exit (or deadlock the forked child) forever.  Bounded
+  acquires (``acquire(timeout=...)``/``acquire(blocking=False)``) are
+  the sanctioned shape, as is the paired fork-safety idiom
+  (``os.register_at_fork(before=l.acquire, after_in_parent=l.release,
+  after_in_child=<releaser>)``).
+
+Two *structural* escape hatches exist instead of comment suppressions
+(the gate forbids blanket disables, and these keep the contract visible
+in code):
+
+- A **pure serialization lock** — one whose every ``with`` body in the
+  whole package is a single simple statement — exempts that single
+  statement from XTB902.  This is the tx-lock idiom: the lock exists
+  only to serialize one wire write; there is no other critical section
+  it could stall.
+- A module may declare ``_XTB_SERIAL_LOCKS = ("Class.attr", ...)`` to
+  mark a lock as an intentional collective-serialization lock (held
+  across a blocking protocol round by design, with an out-of-band
+  interrupt path).  Declared locks are exempt from XTB902 but still
+  participate in XTB901 ordering — declaring a lock never hides a
+  deadlock cycle.
+
+``Condition(self._lock)`` aliases the condition attribute to the lock it
+wraps (one underlying lock, one graph node), and ``.wait()`` on a held
+lock/condition is never a blocking finding for *that* lock (wait
+releases it) — only for other locks still held around it.
+
+See docs/static_analysis.md (XTB9xx section) and the runtime half in
+``reliability/lockdep.py``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, \
+    Tuple
+
+from .blocking import _call_tail, _has_kwarg, _queueish, _receiver_tail
+from .core import Finding, Project, Rule, SourceFile
+
+SERIAL_DECL = "_XTB_SERIAL_LOCKS"
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+
+# wire-protocol helpers in this package that stall on a peer
+_WIRE_TAILS = ("send_msg", "recv_msg", "send_frame", "recv_frame",
+               "_recv_exact")
+# socket-level tails that stall on the network regardless of receiver
+_SOCKET_TAILS = ("accept", "connect", "recv", "recv_into", "sendall",
+                 "create_connection", "getaddrinfo")
+_SUBPROCESS_TAILS = ("run", "check_call", "check_output", "Popen")
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_lock_ctor(node: ast.expr) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when ``node`` constructs one."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _LOCK_FACTORIES and \
+            isinstance(f.value, ast.Name) and f.value.id == "threading":
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in _LOCK_FACTORIES:
+        return f.id
+    return None
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _single_simple(body: Sequence[ast.stmt]) -> bool:
+    """True when a with-body is one simple (non-compound) statement —
+    the serialization-lock shape."""
+    return len(body) == 1 and isinstance(
+        body[0], (ast.Expr, ast.Assign, ast.AnnAssign, ast.AugAssign,
+                  ast.Return, ast.Pass))
+
+
+def _fn(key: str) -> str:
+    return key.split("::", 1)[1] if "::" in key else key
+
+
+def _bounded_acquire(node: ast.Call) -> bool:
+    if _has_kwarg(node, "timeout"):
+        return True
+    for kw in node.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    if node.args and isinstance(node.args[0], ast.Constant) and \
+            node.args[0].value is False:
+        return True
+    return False
+
+
+def _flock_nonblocking(node: ast.Call) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "LOCK_NB":
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "LOCK_NB":
+            return True
+    return False
+
+
+class _ClassInfo:
+    def __init__(self, name: str, rel: str) -> None:
+        self.name = name
+        self.rel = rel
+        self.lock_attrs: Dict[str, str] = {}   # attr -> canonical attr
+        self.attr_types: Dict[str, str] = {}   # attr -> class name
+        self.methods: Set[str] = set()
+
+
+class _ModuleInfo:
+    def __init__(self, sf: SourceFile) -> None:
+        self.sf = sf
+        self.rel = sf.rel
+        self.locks: Set[str] = set()           # module-level lock names
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.funcs: Set[str] = set()           # module-level function names
+        self.import_mods: Dict[str, str] = {}  # alias -> module basename
+        self.import_names: Dict[str, Tuple[str, str]] = {}  # name->(mod,orig)
+        self.serial_decls: List[str] = []
+
+
+class _Held:
+    __slots__ = ("lock", "serial")
+
+    def __init__(self, lock: str, serial: bool) -> None:
+        self.lock = lock
+        self.serial = serial
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "sf", "node", "desc")
+
+    def __init__(self, src: str, dst: str, sf: SourceFile, node: ast.AST,
+                 desc: str) -> None:
+        self.src = src
+        self.dst = dst
+        self.sf = sf
+        self.node = node
+        self.desc = desc
+
+
+class _Analysis:
+    """Whole-project lock model, built in finalize."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.modules: Dict[str, _ModuleInfo] = {}
+        self.class_by_name: Dict[str, _ClassInfo] = {}
+        self.lock_attr_owners: Dict[str, Set[str]] = {}
+        self.mod_by_base: Dict[str, Optional[str]] = {}
+        self.serial_locks: Set[str] = set()
+        # per-function facts (key: "<rel>::<qualname>")
+        self.direct_acq: Dict[str, List[Tuple[str, SourceFile, ast.AST]]] = {}
+        self.calls: Dict[str, List[Tuple[str, Tuple[str, ...], ast.AST]]] = {}
+        self.edges: List[_Edge] = []
+        self.blocking: List[Tuple[SourceFile, ast.AST, str,
+                                  Tuple[_Held, ...]]] = []
+        # (sf, registration node, kind, ("func", key) | ("lock", lock id))
+        self.handlers: List[Tuple[SourceFile, ast.AST, str,
+                                  Tuple[str, str]]] = []
+        # locks ever held via a multi-statement with / explicit acquire —
+        # the complement of the pure-serialization set
+        self.multi_stmt_locks: Set[str] = set()
+
+    # ---------------- discovery ----------------
+
+    def discover(self) -> None:
+        for sf in self.project.files:
+            mi = _ModuleInfo(sf)
+            self.modules[mi.rel] = mi
+            base = mi.rel.rsplit("/", 1)[-1]
+            base = base[:-3] if base.endswith(".py") else base
+            if base in self.mod_by_base:       # ambiguous basename: disable
+                self.mod_by_base[base] = None
+            else:
+                self.mod_by_base[base] = mi.rel
+            for node in sf.tree.body:
+                self._discover_top(mi, node)
+        for mi in self.modules.values():
+            for ci in mi.classes.values():
+                if ci.name not in self.class_by_name:
+                    self.class_by_name[ci.name] = ci
+                for attr in ci.lock_attrs:
+                    self.lock_attr_owners.setdefault(attr, set()).add(ci.name)
+        for mi in self.modules.values():
+            for decl in mi.serial_decls:
+                lid = self._declared_lock_id(mi, decl)
+                if lid:
+                    self.serial_locks.add(lid)
+
+    def _discover_top(self, mi: _ModuleInfo, node: ast.stmt) -> None:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if _is_lock_ctor(node.value):
+                mi.locks.add(name)
+            elif name == SERIAL_DECL and isinstance(
+                    node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, str):
+                        mi.serial_decls.append(elt.value)
+        elif isinstance(node, _FUNC_DEFS):
+            mi.funcs.add(node.name)
+        elif isinstance(node, ast.ClassDef):
+            ci = _ClassInfo(node.name, mi.rel)
+            mi.classes[node.name] = ci
+            self._discover_class(ci, node)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                mi.import_mods[a.asname or a.name.split(".")[-1]] = \
+                    a.name.split(".")[-1]
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                mi.import_mods[a.asname or a.name] = a.name
+                mi.import_names[a.asname or a.name] = (
+                    (node.module or "").split(".")[-1], a.name)
+        elif isinstance(node, (ast.If, ast.Try)):
+            # guarded module top (if hasattr(os, ...):, try: import ...)
+            for sub in getattr(node, "body", ()):
+                self._discover_top(mi, sub)
+            for sub in getattr(node, "orelse", ()):
+                self._discover_top(mi, sub)
+
+    def _discover_class(self, ci: _ClassInfo, cls: ast.ClassDef) -> None:
+        aliases: Dict[str, str] = {}
+        for meth in cls.body:
+            if not isinstance(meth, _FUNC_DEFS):
+                continue
+            ci.methods.add(meth.name)
+            for node in ast.walk(meth):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                attr = _self_attr(node.targets[0])
+                if attr is None:
+                    continue
+                kind = _is_lock_ctor(node.value)
+                if kind:
+                    ci.lock_attrs.setdefault(attr, attr)
+                    if kind == "Condition" and node.value.args:
+                        wrapped = _self_attr(node.value.args[0])
+                        if wrapped is not None:
+                            aliases[attr] = wrapped
+                elif isinstance(node.value, ast.Call) and \
+                        isinstance(node.value.func, ast.Name):
+                    ci.attr_types.setdefault(attr, node.value.func.id)
+        # Condition(self._x) shares _x's underlying lock: one graph node
+        for cond_attr, wrapped in aliases.items():
+            if wrapped in ci.lock_attrs:
+                ci.lock_attrs[cond_attr] = ci.lock_attrs[wrapped]
+
+    def _declared_lock_id(self, mi: _ModuleInfo, decl: str) -> Optional[str]:
+        if "." in decl:
+            cls, attr = decl.split(".", 1)
+            ci = mi.classes.get(cls) or self.class_by_name.get(cls)
+            if ci is not None and attr in ci.lock_attrs:
+                return f"{ci.name}.{ci.lock_attrs[attr]}"
+            return f"{cls}.{attr}"
+        if decl in mi.locks:
+            return f"{mi.rel}:{decl}"
+        return None
+
+    # ---------------- resolution ----------------
+
+    def _resolve_lock(self, mi: _ModuleInfo, ci: Optional[_ClassInfo],
+                      expr: ast.expr) -> Optional[str]:
+        """Lock identity for an expression, or None when untracked."""
+        if isinstance(expr, ast.Name):
+            if expr.id in mi.locks:
+                return f"{mi.rel}:{expr.id}"
+            imp = mi.import_names.get(expr.id)
+            if imp is not None:
+                rel = self.mod_by_base.get(imp[0])
+                if rel and imp[1] in self.modules[rel].locks:
+                    return f"{rel}:{imp[1]}"
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        recv = expr.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            if ci is not None and attr in ci.lock_attrs:
+                return f"{ci.name}.{ci.lock_attrs[attr]}"
+            return None
+        if isinstance(recv, ast.Name) and recv.id in mi.import_mods:
+            base = mi.import_mods[recv.id]
+            rel = self.mod_by_base.get(base)
+            if rel and attr in self.modules[rel].locks:
+                return f"{rel}:{attr}"
+            return None
+        # self.<typed attr>.<lock attr>
+        inner = _self_attr(recv)
+        if inner is not None and ci is not None:
+            tname = ci.attr_types.get(inner)
+            tci = self.class_by_name.get(tname) if tname else None
+            if tci is not None and attr in tci.lock_attrs:
+                return f"{tci.name}.{tci.lock_attrs[attr]}"
+        # fallback: a lock-attribute name unique to one class (rep.txlock)
+        owners = self.lock_attr_owners.get(attr)
+        if owners is not None and len(owners) == 1:
+            tci = self.class_by_name[next(iter(owners))]
+            return f"{tci.name}.{tci.lock_attrs[attr]}"
+        return None
+
+    def _resolve_callee(self, mi: _ModuleInfo, ci: Optional[_ClassInfo],
+                        func: ast.expr) -> Optional[str]:
+        """Function key for a call target within the project, or None."""
+        if isinstance(func, ast.Name):
+            if func.id in mi.funcs:
+                return f"{mi.rel}::{func.id}"
+            imp = mi.import_names.get(func.id)
+            if imp is not None:
+                rel = self.mod_by_base.get(imp[0])
+                if rel and imp[1] in self.modules[rel].funcs:
+                    return f"{rel}::{imp[1]}"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        meth = func.attr
+        recv = func.value
+        if isinstance(recv, ast.Name) and recv.id == "self" and ci is not None:
+            if meth in ci.methods:
+                return f"{ci.rel}::{ci.name}.{meth}"
+            return None
+        if isinstance(recv, ast.Name) and recv.id in mi.import_mods:
+            rel = self.mod_by_base.get(mi.import_mods[recv.id])
+            if rel is not None and meth in self.modules[rel].funcs:
+                return f"{rel}::{meth}"
+            return None
+        inner = _self_attr(recv)
+        if inner is not None and ci is not None:
+            tci = self.class_by_name.get(ci.attr_types.get(inner, ""))
+            if tci is not None and meth in tci.methods:
+                return f"{tci.rel}::{tci.name}.{meth}"
+        return None
+
+    # ---------------- per-function scan ----------------
+
+    def scan(self) -> None:
+        for rel in sorted(self.modules):
+            mi = self.modules[rel]
+            for node in mi.sf.tree.body:
+                self._scan_module_stmt(mi, node)
+
+    def _scan_module_stmt(self, mi: _ModuleInfo, node: ast.stmt) -> None:
+        if isinstance(node, _FUNC_DEFS):
+            self._scan_func(mi, None, node, node.name)
+        elif isinstance(node, ast.ClassDef):
+            ci = mi.classes[node.name]
+            for meth in node.body:
+                if isinstance(meth, _FUNC_DEFS):
+                    self._scan_func(mi, ci, meth,
+                                    f"{node.name}.{meth.name}")
+        elif isinstance(node, (ast.If, ast.Try)):
+            for sub in getattr(node, "body", ()):
+                self._scan_module_stmt(mi, sub)
+            for sub in getattr(node, "orelse", ()):
+                self._scan_module_stmt(mi, sub)
+        else:
+            self._scan_stmt(mi, None, f"{mi.rel}::<module>", node, [], [])
+
+    def _scan_func(self, mi: _ModuleInfo, ci: Optional[_ClassInfo],
+                   node: ast.AST, qual: str) -> None:
+        key = f"{mi.rel}::{qual}"
+        self.direct_acq.setdefault(key, [])
+        self.calls.setdefault(key, [])
+        for dec in getattr(node, "decorator_list", ()):
+            if isinstance(dec, ast.Attribute) and dec.attr == "register" \
+                    and isinstance(dec.value, ast.Name) \
+                    and dec.value.id == "atexit":
+                self.handlers.append((mi.sf, node, "atexit", ("func", key)))
+        body = [node.body] if isinstance(node, ast.Lambda) else node.body
+        explicit: List[_Held] = []
+        for stmt in body:
+            self._scan_stmt(mi, ci, key, stmt, [], explicit)
+
+    def _record_acquire(self, mi: _ModuleInfo, key: str, lock: str,
+                        node: ast.AST, held: Sequence[_Held]) -> None:
+        self.direct_acq.setdefault(key, []).append((lock, mi.sf, node))
+        for h in held:
+            if h.lock != lock:
+                self.edges.append(_Edge(
+                    h.lock, lock, mi.sf, node,
+                    f"{_fn(key)} ({mi.rel}:{getattr(node, 'lineno', 0)}) "
+                    f"acquires {lock} while holding {h.lock}"))
+
+    def _scan_stmt(self, mi: _ModuleInfo, ci: Optional[_ClassInfo], key: str,
+                   stmt: ast.AST, held: List[_Held],
+                   explicit: List[_Held]) -> None:
+        if isinstance(stmt, _FUNC_DEFS):
+            # closure: runs later on some other stack — no lock credit
+            self._scan_func(mi, ci, stmt,
+                            f"{_fn(key)}.<locals>.{stmt.name}")
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            entered: List[_Held] = []
+            serial = _single_simple(stmt.body)
+            for item in stmt.items:
+                self._scan_expr(mi, ci, key, item.context_expr, held,
+                                explicit)
+                lock = self._resolve_lock(mi, ci, item.context_expr)
+                if lock is not None:
+                    self._record_acquire(mi, key, lock, stmt, held + explicit)
+                    entered.append(_Held(lock, serial))
+                    if not serial:
+                        self.multi_stmt_locks.add(lock)
+            for inner in stmt.body:
+                self._scan_stmt(mi, ci, key, inner, held + entered, explicit)
+            return
+        if isinstance(stmt, ast.Try):
+            for part in (stmt.body, stmt.handlers, stmt.orelse,
+                         stmt.finalbody):
+                for inner in part:
+                    if isinstance(inner, ast.ExceptHandler):
+                        for s in inner.body:
+                            self._scan_stmt(mi, ci, key, s, held, explicit)
+                    else:
+                        self._scan_stmt(mi, ci, key, inner, held, explicit)
+            return
+        if isinstance(stmt, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+            for field in ("test", "iter"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, ast.expr):
+                    self._scan_expr(mi, ci, key, sub, held, explicit)
+            for inner in list(stmt.body) + list(stmt.orelse):
+                self._scan_stmt(mi, ci, key, inner, held, explicit)
+            return
+        self._scan_expr(mi, ci, key, stmt, held, explicit)
+
+    def _scan_expr(self, mi: _ModuleInfo, ci: Optional[_ClassInfo], key: str,
+                   root: ast.AST, held: List[_Held],
+                   explicit: List[_Held]) -> None:
+        """Pruned walk: calls are checked with the current held set;
+        lambdas/defs are scanned as closures with an empty one."""
+        stack: List[ast.AST] = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                self._scan_func(mi, ci, node,
+                                f"{_fn(key)}.<locals>.<lambda:{node.lineno}>")
+                continue
+            if isinstance(node, _FUNC_DEFS):
+                self._scan_func(mi, ci, node,
+                                f"{_fn(key)}.<locals>.{node.name}")
+                continue
+            if isinstance(node, ast.Call):
+                self._scan_call(mi, ci, key, node, held, explicit)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _scan_call(self, mi: _ModuleInfo, ci: Optional[_ClassInfo], key: str,
+                   node: ast.Call, held: List[_Held],
+                   explicit: List[_Held]) -> None:
+        tail = _call_tail(node.func)
+        eff = held + explicit
+        # --- explicit acquire/release on a tracked lock ---
+        if tail in ("acquire", "release") and \
+                isinstance(node.func, ast.Attribute):
+            lock = self._resolve_lock(mi, ci, node.func.value)
+            if lock is not None:
+                if tail == "acquire":
+                    if not _bounded_acquire(node):
+                        self._record_acquire(mi, key, lock, node, eff)
+                    explicit.append(_Held(lock, False))
+                    self.multi_stmt_locks.add(lock)
+                else:
+                    for i in range(len(explicit) - 1, -1, -1):
+                        if explicit[i].lock == lock:
+                            del explicit[i]
+                            break
+                return
+        # --- handler registrations (XTB903) ---
+        self._scan_registration(mi, ci, key, node, tail)
+        # --- wait: releases the lock it is called on ---
+        if tail in ("wait", "wait_for") and \
+                isinstance(node.func, ast.Attribute):
+            target = self._resolve_lock(mi, ci, node.func.value)
+            if target is not None and any(h.lock == target for h in eff):
+                rest = tuple(h for h in eff if h.lock != target)
+                if rest:
+                    self.blocking.append((mi.sf, node, f".{tail}()", rest))
+            elif eff and not node.args and not _has_kwarg(node, "timeout"):
+                self.blocking.append((mi.sf, node,
+                                      f"unbounded .{tail}()", tuple(eff)))
+            return
+        # --- blocking tokens (XTB902) ---
+        token = self._blocking_token(node, tail)
+        if token is not None and eff:
+            self.blocking.append((mi.sf, node, token, tuple(eff)))
+        # --- call graph ---
+        callee = self._resolve_callee(mi, ci, node.func)
+        if callee is not None:
+            self.calls.setdefault(key, []).append(
+                (callee, tuple(h.lock for h in eff), node))
+
+    def _blocking_token(self, node: ast.Call, tail: str) -> Optional[str]:
+        recv = _receiver_tail(node.func)
+        if tail == "sleep" and recv in ("", "time"):
+            return "time.sleep"
+        if tail in _WIRE_TAILS:
+            return f"{tail}()"
+        if tail == "maybe_inject":
+            return "maybe_inject() fault seam"
+        if tail in _SUBPROCESS_TAILS and recv == "subprocess":
+            return f"subprocess.{tail}"
+        if tail == "communicate":
+            return ".communicate()"
+        if tail in _SOCKET_TAILS and isinstance(node.func, ast.Attribute):
+            return f".{tail}()"
+        if tail == "result" and isinstance(node.func, ast.Attribute):
+            return ".result()"
+        if tail == "get" and _queueish(recv):
+            return "queue .get()"
+        if tail == "join" and isinstance(node.func, ast.Attribute) \
+                and not node.args and not node.keywords:
+            return ".join()"
+        if tail in ("flock", "lockf") and not _flock_nonblocking(node):
+            return f"fcntl.{tail}"
+        return None
+
+    # ---------------- handler registrations ----------------
+
+    def _scan_registration(self, mi: _ModuleInfo, ci: Optional[_ClassInfo],
+                           key: str, node: ast.Call, tail: str) -> None:
+        recv = _receiver_tail(node.func)
+        if tail == "register" and recv == "atexit" and node.args:
+            self._record_handler(mi, ci, key, node, "atexit", node.args[0])
+        elif tail == "signal" and recv == "signal" and len(node.args) >= 2:
+            self._record_handler(mi, ci, key, node, "signal", node.args[1])
+        elif tail == "register_at_fork":
+            self._scan_at_fork(mi, ci, key, node)
+
+    def _scan_at_fork(self, mi: _ModuleInfo, ci: Optional[_ClassInfo],
+                      key: str, node: ast.Call) -> None:
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        before, in_parent = kw.get("before"), kw.get("after_in_parent")
+        in_child = kw.get("after_in_child")
+        # the sanctioned fork-safety idiom: hold L across fork, release
+        # on both sides — before=L.acquire, after_in_parent=L.release,
+        # after_in_child releasing the same lock
+        block = self._bound_lock_method(mi, ci, before, "acquire")
+        if block is not None and \
+                self._bound_lock_method(mi, ci, in_parent,
+                                        "release") == block and \
+                self._releases(mi, ci, in_child, block):
+            return
+        for tag, h in (("fork-before", before), ("fork-parent", in_parent),
+                       ("fork-child", in_child)):
+            if h is not None:
+                self._record_handler(mi, ci, key, node, tag, h)
+
+    def _bound_lock_method(self, mi: _ModuleInfo, ci: Optional[_ClassInfo],
+                           expr: Optional[ast.expr], meth: str,
+                           ) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and expr.attr == meth:
+            return self._resolve_lock(mi, ci, expr.value)
+        return None
+
+    def _releases(self, mi: _ModuleInfo, ci: Optional[_ClassInfo],
+                  expr: Optional[ast.expr], lock: str) -> bool:
+        if self._bound_lock_method(mi, ci, expr, "release") == lock:
+            return True
+        if isinstance(expr, ast.Name):
+            fn = self._find_func_node(mi, expr.id)
+            if fn is not None:
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Call) and \
+                            _call_tail(sub.func) == "release" and \
+                            isinstance(sub.func, ast.Attribute) and \
+                            self._resolve_lock(mi, ci,
+                                               sub.func.value) == lock:
+                        return True
+        return False
+
+    def _find_func_node(self, mi: _ModuleInfo, name: str,
+                        ) -> Optional[ast.AST]:
+        for node in ast.walk(mi.sf.tree):
+            if isinstance(node, _FUNC_DEFS) and node.name == name:
+                return node
+        return None
+
+    def _record_handler(self, mi: _ModuleInfo, ci: Optional[_ClassInfo],
+                        key: str, reg_node: ast.AST, kind: str,
+                        expr: ast.expr) -> None:
+        lock = self._bound_lock_method(mi, ci, expr, "acquire")
+        if lock is not None:
+            self.handlers.append((mi.sf, reg_node, kind, ("lock", lock)))
+            return
+        if self._bound_lock_method(mi, ci, expr, "release") is not None:
+            return  # a bare release never blocks
+        fkey: Optional[str] = None
+        if isinstance(expr, ast.Name):
+            if expr.id in mi.funcs:
+                fkey = f"{mi.rel}::{expr.id}"
+        elif isinstance(expr, ast.Lambda):
+            fkey = f"{mi.rel}::{_fn(key)}.<locals>.<lambda:{expr.lineno}>"
+        elif isinstance(expr, ast.Attribute):
+            inner = _self_attr(expr)
+            if inner is not None and ci is not None and inner in ci.methods:
+                fkey = f"{ci.rel}::{ci.name}.{inner}"
+        if fkey is not None:
+            self.handlers.append((mi.sf, reg_node, kind, ("func", fkey)))
+
+    # ---------------- fixpoint + verdicts ----------------
+
+    def fixpoint(self) -> Dict[str, Set[str]]:
+        """Transitive may-acquire set per function (unbounded acquires)."""
+        trans: Dict[str, Set[str]] = {
+            k: {lock for lock, _, _ in v}
+            for k, v in self.direct_acq.items()}
+        changed = True
+        rounds = 0
+        while changed and rounds < 64:
+            changed = False
+            rounds += 1
+            for k, calls in self.calls.items():
+                cur = trans.setdefault(k, set())
+                before = len(cur)
+                for callee, _, _ in calls:
+                    cur |= trans.get(callee, set())
+                if len(cur) != before:
+                    changed = True
+        return trans
+
+    def call_edges(self, trans: Dict[str, Set[str]]) -> None:
+        """Project callee acquisition sets onto held-at-callsite locks."""
+        for k in sorted(self.calls):
+            for callee, held_ids, node in self.calls[k]:
+                if not held_ids:
+                    continue
+                mi = self.modules[k.split("::", 1)[0]]
+                for dst in sorted(trans.get(callee, ())):
+                    if dst in held_ids:
+                        continue  # reentrant hold along the call chain
+                    for src in held_ids:
+                        self.edges.append(_Edge(
+                            src, dst, mi.sf, node,
+                            f"{_fn(k)} ({mi.rel}:"
+                            f"{getattr(node, 'lineno', 0)}) holds {src} "
+                            f"while calling {_fn(callee)} which acquires "
+                            f"{dst}"))
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    codes = {
+        "XTB901": "lock-order inversion: a cycle in the may-acquire-after "
+                  "graph (ABBA deadlock); both witness paths printed",
+        "XTB902": "blocking call (wire/socket/queue/subprocess/sleep/"
+                  "fault-seam) while holding a lock",
+        "XTB903": "unbounded lock acquisition in a signal/atexit/fork "
+                  "handler (shutdown or forked child can hang)",
+    }
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        an = _Analysis(project)
+        an.discover()
+        an.scan()
+        trans = an.fixpoint()
+        an.call_edges(trans)
+        findings: List[Finding] = []
+        findings.extend(self._cycles(an))
+        findings.extend(self._blocking(an))
+        findings.extend(self._handlers(an, trans))
+        return findings
+
+    # --- XTB901 ---
+
+    def _cycles(self, an: _Analysis) -> Iterator[Finding]:
+        adj: Dict[str, Dict[str, _Edge]] = {}
+        for e in an.edges:
+            adj.setdefault(e.src, {}).setdefault(e.dst, e)
+        for scc in _sccs(adj):
+            if len(scc) < 2:
+                continue
+            cycle = _cycle_path(adj, scc)
+            if not cycle:
+                continue
+            first = cycle[0]
+            path = " -> ".join([e.src for e in cycle] + [cycle[0].src])
+            witnesses = "; ".join(
+                f"path {i + 1}: {e.desc}" for i, e in enumerate(cycle))
+            yield first.sf.finding(
+                first.node, "XTB901",
+                f"lock-order inversion {path} — two threads taking these "
+                f"locks in opposite orders deadlock ({witnesses}); pick one "
+                f"order and document it in docs/reliability.md's lock "
+                f"hierarchy")
+
+    # --- XTB902 ---
+
+    def _blocking(self, an: _Analysis) -> Iterator[Finding]:
+        for sf, node, token, helds in an.blocking:
+            locks = []
+            for h in helds:
+                if h.serial and h.lock not in an.multi_stmt_locks:
+                    continue  # pure serialization lock, sole statement
+                if h.lock in an.serial_locks:
+                    continue  # declared intentional serialization lock
+                locks.append(h.lock)
+            if not locks:
+                continue
+            held = ", ".join(dict.fromkeys(locks))
+            yield sf.finding(
+                node, "XTB902",
+                f"{token} while holding {held}: one wedged peer stalls "
+                f"every thread wanting the lock — collect under the lock, "
+                f"do the blocking work after release (or declare a "
+                f"serialization lock via {SERIAL_DECL})")
+
+    # --- XTB903 ---
+
+    def _handlers(self, an: _Analysis,
+                  trans: Dict[str, Set[str]]) -> Iterator[Finding]:
+        for sf, node, kind, target in an.handlers:
+            if target[0] == "lock":
+                locks: List[str] = [target[1]]
+            else:
+                locks = sorted(trans.get(target[1], ()))
+            if not locks:
+                continue
+            what = target[1] if target[0] == "func" else \
+                f"{target[1]}.acquire"
+            yield sf.finding(
+                node, "XTB903",
+                f"{kind} handler {_fn(what)} acquires "
+                f"{', '.join(locks)} unbounded — shutdown/fork runs on a "
+                f"thread that may not own it and hangs forever; use "
+                f"acquire(timeout=...) and degrade, or the paired "
+                f"register_at_fork acquire/release idiom")
+
+
+def _sccs(adj: Dict[str, Dict[str, _Edge]]) -> List[List[str]]:
+    """Tarjan (iterative), deterministic node order."""
+    nodes = sorted(set(adj) | {d for m in adj.values() for d in m})
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for root in nodes:
+        if root in index:
+            continue
+        work: List[Tuple[str, Iterator[str]]] = [
+            (root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(sorted(comp))
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+    return out
+
+
+def _cycle_path(adj: Dict[str, Dict[str, _Edge]],
+                scc: List[str]) -> List[_Edge]:
+    """A closed edge walk through an SCC starting at its smallest node."""
+    start = scc[0]
+    members = set(scc)
+    # BFS back to start staying inside the SCC
+    best: Dict[str, List[_Edge]] = {start: []}
+    frontier = [start]
+    while frontier:
+        nxt: List[str] = []
+        for v in frontier:
+            for w in sorted(adj.get(v, ())):
+                if w not in members:
+                    continue
+                if w == start and best[v]:
+                    return best[v] + [adj[v][w]]
+                if w != start and w not in best:
+                    best[w] = best[v] + [adj[v][w]]
+                    nxt.append(w)
+        frontier = nxt
+    # two-node cycle where the first hop closes immediately
+    for w in sorted(adj.get(start, ())):
+        if w in members and start in adj.get(w, {}):
+            return [adj[start][w], adj[w][start]]
+    return []

@@ -39,6 +39,12 @@ fault-plan schema, serving degradation behavior).
 """
 from __future__ import annotations
 
+# the lockdep witness must arm (XGBOOST_TPU_LOCKDEP=1) before the sibling
+# imports below run: they create module-level locks at import, and only
+# locks created through the patched factories are witnessed
+from . import lockdep
+lockdep.maybe_install_from_env()
+
 from . import faults, integrity, resources, watchdog
 from .checkpoint import (CheckpointCallback, CheckpointManager,
                          CheckpointState, latest_checkpoint, scrub_dir)
@@ -48,6 +54,7 @@ from .retry import RetriesExhausted, backoff_delays, retry_call
 
 __all__ = [
     "TrackerJournal",
+    "lockdep",
     "watchdog",
     "CheckpointCallback",
     "CheckpointManager",

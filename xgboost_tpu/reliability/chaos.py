@@ -62,7 +62,7 @@ import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from . import faults
+from . import faults, lockdep
 
 __all__ = ["CatalogEntry", "Scenario", "SCENARIOS", "EpisodeReport",
            "generate_plan", "run_episode", "soak"]
@@ -1329,6 +1329,7 @@ def run_episode(scenario: str, seed: int, *,
     baseline = _baseline(sc)  # before the plan installs: twin is fault-free
 
     counted_before = _counter_total("xtb_faults_injected_total")
+    lockdep_before = len(lockdep.reports()) if lockdep.enabled() else 0
     plan = faults.install(json.loads(json.dumps(plan_dict)))
     outcome: Dict[str, Any] = {}
     t0 = time.monotonic()
@@ -1363,6 +1364,15 @@ def run_episode(scenario: str, seed: int, *,
         "ok" if counted_delta == fired
         else f"FAIL: xtb_faults_injected_total moved {counted_delta}, "
              f"plan fired {fired}")
+    if lockdep.enabled():
+        # the witness must stay silent under fire: fault-path code taking
+        # locks out of order or across seams is exactly what chaos exists
+        # to flush out
+        leaked = lockdep.reports()[lockdep_before:]
+        invariants["lockdep_silent"] = (
+            "ok" if not leaked
+            else "FAIL: " + "; ".join(
+                f"[{r['kind']}] {r['msg']}" for r in leaked[:4]))
     artifacts = outcome.get("artifacts") or {}
     if sc.twin and baseline is not None and not error and not hung:
         invariants["bitwise_vs_twin"] = (

@@ -277,6 +277,10 @@ class FaultPlan:
 _PLAN: Optional[FaultPlan] = None
 _ENV_CHECKED = False
 _counter = None  # xtb_faults_injected_total family, created lazily
+# lockdep witness hook: lockdep.install() points this at note_seam so a
+# lock held across any fault seam is reported (runtime XTB902); None —
+# one global read per maybe_inject — when the witness is unarmed
+_lockdep_seam = None
 
 
 def install(plan: Union[FaultPlan, dict, list, str, None]) -> Optional[FaultPlan]:
@@ -350,6 +354,8 @@ def maybe_inject(site: str, *, rank: Any = None, round: Optional[int] = None,
     ``throttle``, ``blackhole_rx``, ``blackhole_tx``, ``partition``)
     and for ``delay``/``slow_disk``/``latency`` (so callers can log),
     else None."""
+    if _lockdep_seam is not None:
+        _lockdep_seam(site)
     if _strict() and site not in SEAMS:
         raise ValueError(f"unknown fault seam {site!r} (strict mode); "
                          f"known seams: {sorted(SEAMS)}")

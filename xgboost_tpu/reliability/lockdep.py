@@ -1,0 +1,429 @@
+"""Runtime lockdep witness — the dynamic half of the XTB9xx contract.
+
+The static rule (analysis/lockorder.py) proves lock-order and
+blocking-under-lock discipline for the call graphs it can resolve; this
+module witnesses the paths it cannot — dynamic dispatch, callbacks,
+threads handed locks through closures — by watching the real lock
+traffic of a live process:
+
+- **Order graph**: every *unbounded* blocking acquire taken while other
+  witnessed locks are held adds held→acquired edges to a global order
+  graph.  The first acquire that would close a cycle (an ABBA: thread 1
+  took A then B sometime, thread 2 now takes A while holding B) is
+  reported with the established path — before it can deadlock, because
+  the edge direction conflict is visible on first occurrence even when
+  the interleaving never actually wedges.
+- **Seam witness**: reliability/faults.py calls :func:`note_seam` at the
+  top of ``maybe_inject`` when armed, so any witnessed lock held across
+  a fault seam — the runtime analogue of static XTB902 — is reported
+  (once per lock/seam pair).  Locks declared serialization locks via
+  :func:`mark_serial` (the runtime analogue of ``_XTB_SERIAL_LOCKS``)
+  are exempt.
+- **Self-deadlock**: a thread re-acquiring a non-reentrant lock it
+  already holds is reported immediately (the inner acquire would hang).
+
+Armed by ``XGBOOST_TPU_LOCKDEP=1`` (read once, at package import —
+:func:`maybe_install_from_env` runs before any sibling module creates a
+lock, so module-level locks are witnessed too).  When the variable is
+unset NOTHING is patched and the cost is exactly zero: ``threading.Lock``
+is still the raw C factory.  When armed, only locks *created by package
+code* are wrapped (creation site resolved by stack walk); third-party
+locks (JAX, stdlib) stay raw, so overhead is confined to the package's
+own synchronization.
+
+Reports accumulate in-process (:func:`reports`, capped), land in the
+flight recorder ring, and are printed at exit with the
+``XTB-LOCKDEP-VIOLATION`` marker the nightly suite greps for.  Set
+``XGBOOST_TPU_LOCKDEP_RAISE=1`` to raise :class:`LockdepViolation` at
+the offending acquire instead (pinpoints the stack in a repro run).
+"""
+from __future__ import annotations
+
+import _thread
+import atexit
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+__all__ = ["install", "uninstall", "enabled", "maybe_install_from_env",
+           "mark_serial", "named_lock", "note_seam", "reports", "clear",
+           "LockdepViolation", "ENV_ENABLE", "ENV_RAISE"]
+
+ENV_ENABLE = "XGBOOST_TPU_LOCKDEP"
+ENV_RAISE = "XGBOOST_TPU_LOCKDEP_RAISE"
+
+_OFF_VALUES = ("", "0", "false", "off", "no")
+
+# package root (".../xgboost_tpu") — only locks created by files under it
+# are wrapped
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SELF_FILE = os.path.abspath(__file__)
+
+# witness state.  The state lock is a raw _thread lock on purpose: it is
+# not created through the patched factories (no recursion into the
+# witness) and not part of any ordering the package declares — it is
+# only ever held for graph/report bookkeeping, never across user code.
+_state_lock = _thread.allocate_lock()
+_order: Dict[str, Set[str]] = {}      # key -> keys acquired while key held
+_serial_keys: Set[str] = set()        # mark_serial()-declared keys
+_seam_seen: Set[Tuple[str, str]] = set()
+_reports: List[Dict[str, Any]] = []
+_MAX_REPORTS = 64
+
+_tls = threading.local()              # .held: List[str]; .busy: bool
+
+_installed = False
+_orig_lock = threading.Lock
+_orig_rlock = threading.RLock
+_orig_condition = threading.Condition
+
+
+class LockdepViolation(RuntimeError):
+    """Raised at the offending acquire when XGBOOST_TPU_LOCKDEP_RAISE=1."""
+
+
+def _creation_site() -> Optional[str]:
+    """``"rel/path.py:lineno"`` of the package frame creating a lock, or
+    None when the creator is outside the package (lock stays raw)."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        base = os.path.basename(fn)
+        if os.path.abspath(fn) != _SELF_FILE and base != "threading.py":
+            break
+        f = f.f_back
+    if f is None:
+        return None
+    fn = os.path.abspath(f.f_code.co_filename)
+    if not fn.startswith(_PKG_DIR + os.sep):
+        return None
+    rel = fn[len(_PKG_DIR) + 1:].replace(os.sep, "/")
+    return f"{rel}:{f.f_lineno}"
+
+
+def _held() -> List[str]:
+    tls = _tls.__dict__
+    held = tls.get("held")
+    if held is None:
+        held = tls["held"] = []
+    return held
+
+
+def _push(key: str) -> None:
+    _held().append(key)
+
+
+def _pop(key: str) -> None:
+    held = _tls.__dict__.get("held")
+    if not held:
+        return
+    # LIFO discipline is the overwhelmingly common case -> O(1) pop
+    if held[-1] == key:
+        held.pop()
+        return
+    for i in range(len(held) - 2, -1, -1):
+        if held[i] == key:
+            del held[i]
+            return
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """BFS path src -> dst in the order graph (caller holds _state_lock)."""
+    if src == dst:
+        return [src]
+    prev: Dict[str, str] = {}
+    frontier = [src]
+    while frontier:
+        nxt: List[str] = []
+        for node in frontier:
+            for succ in _order.get(node, ()):
+                if succ in prev or succ == src:
+                    continue
+                prev[succ] = node
+                if succ == dst:
+                    path = [dst]
+                    while path[-1] != src:
+                        path.append(prev[path[-1]])
+                    path.reverse()
+                    return path
+                nxt.append(succ)
+        frontier = nxt
+    return None
+
+
+def _report(kind: str, msg: str) -> None:
+    entry = {"kind": kind, "msg": msg,
+             "thread": threading.current_thread().name}
+    with _state_lock:
+        if len(_reports) < _MAX_REPORTS:
+            _reports.append(entry)
+    # ring-append may itself take a witnessed lock (flight._lock): the
+    # busy flag stops the witness from recursing into itself
+    _tls.busy = True
+    try:
+        from ..telemetry import flight
+
+        flight.record("lockdep", kind, msg=msg)
+    except Exception:  # pragma: no cover - telemetry must not mask this
+        pass
+    finally:
+        _tls.busy = False
+    if os.environ.get(ENV_RAISE, "").strip().lower() not in _OFF_VALUES:
+        raise LockdepViolation(f"[{kind}] {msg}")
+
+
+def _check_before_acquire(key: str, reentrant: bool) -> None:
+    """Order/self-deadlock check for an *unbounded* blocking acquire of
+    ``key``.  Bounded acquires (trylock / timeout) skip this: they cannot
+    participate in a deadlock cycle, matching static XTB901 semantics."""
+    tls = _tls.__dict__
+    if tls.get("busy"):
+        return
+    held = tls.get("held")
+    if not held:
+        return
+    for h in dict.fromkeys(held):
+        if h == key:
+            if not reentrant:
+                _report("self-deadlock",
+                        f"thread re-acquires non-reentrant lock {key} "
+                        f"it already holds (inner acquire would hang)")
+            continue
+        if key in _order.get(h, ()):  # fast path: edge already recorded
+            continue
+        with _state_lock:
+            succ = _order.setdefault(h, set())
+            if key in succ:
+                continue
+            path = _find_path(key, h)
+            succ.add(key)
+        if path is not None:
+            cycle = " -> ".join(path + [key])
+            _report("order",
+                    f"lock-order inversion: acquiring {key} while holding "
+                    f"{h}, but the established order is {cycle}")
+
+
+class _WitnessLock:
+    """threading.Lock wrapper: witness bookkeeping around the raw lock."""
+
+    __slots__ = ("_inner", "_key")
+    _reentrant = False
+
+    def __init__(self, inner: Any, key: str) -> None:
+        self._inner = inner
+        self._key = key
+
+    @property
+    def _xtb_key(self) -> str:
+        return self._key
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # uncontended leaf acquires (nothing held) dominate real traffic:
+        # the order/seam machinery only engages when this thread already
+        # holds a witnessed lock, so the fast path is one dict.get
+        tls = _tls.__dict__
+        held = tls.get("held")
+        if held is None:
+            held = tls["held"] = []
+        elif held and blocking and timeout < 0:
+            _check_before_acquire(self._key, self._reentrant)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            held.append(self._key)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _pop(self._key)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        tls = _tls.__dict__
+        held = tls.get("held")
+        if held is None:
+            held = tls["held"] = []
+        elif held:
+            _check_before_acquire(self._key, self._reentrant)
+        self._inner.acquire()
+        held.append(self._key)
+        return True
+
+    def __exit__(self, *exc: Any) -> None:
+        self._inner.release()
+        held = _tls.__dict__.get("held")
+        if held:
+            if held[-1] == self._key:
+                held.pop()
+            else:
+                _pop(self._key)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<{type(self).__name__} {self._key} of {self._inner!r}>"
+
+
+class _WitnessRLock(_WitnessLock):
+    """threading.RLock wrapper.  Re-entrant acquires are legal (no
+    self-deadlock report); the ``_release_save``/``_acquire_restore``/
+    ``_is_owned`` trio keeps ``threading.Condition`` working on top —
+    Condition.wait drops every recursion level, so the witness drops
+    every held entry too (a lock being waited on is not held)."""
+
+    __slots__ = ()
+    _reentrant = True
+
+    def _release_save(self) -> Tuple[Any, int]:
+        state = self._inner._release_save()
+        held = getattr(_tls, "held", None)
+        n = 0
+        if held:
+            n = held.count(self._key)
+            if n:
+                _tls.held = [k for k in held if k != self._key]
+        return (state, n)
+
+    def _acquire_restore(self, saved: Tuple[Any, int]) -> None:
+        state, n = saved
+        self._inner._acquire_restore(state)
+        for _ in range(n):
+            _push(self._key)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def _lock_factory() -> Any:
+    inner = _orig_lock()
+    key = _creation_site()
+    return inner if key is None else _WitnessLock(inner, key)
+
+
+def _rlock_factory() -> Any:
+    inner = _orig_rlock()
+    key = _creation_site()
+    return inner if key is None else _WitnessRLock(inner, key)
+
+
+def _condition_factory(lock: Any = None) -> Any:
+    # the no-arg form must route through the patched RLock factory so the
+    # implicit lock is witnessed (keyed at the Condition creation site)
+    return _orig_condition(lock if lock is not None else _rlock_factory())
+
+
+def named_lock(name: str, *, reentrant: bool = False) -> Any:
+    """A witnessed lock with an explicit key, regardless of creation site
+    or arming — unit tests and ad-hoc tools build deliberate ABBA pairs
+    with these without patching threading."""
+    if reentrant:
+        return _WitnessRLock(_orig_rlock(), name)
+    return _WitnessLock(_orig_lock(), name)
+
+
+def mark_serial(lock: Any) -> Any:
+    """Declare ``lock`` a serialization lock: holding it across a fault
+    seam is its documented contract, not a violation (runtime analogue of
+    the static ``_XTB_SERIAL_LOCKS`` declaration; still in the order
+    graph).  No-op on raw (unwitnessed) locks.  Returns the lock."""
+    key = getattr(lock, "_xtb_key", None)
+    if key is not None:
+        with _state_lock:
+            _serial_keys.add(key)
+    return lock
+
+
+def note_seam(site: str) -> None:
+    """Called by faults.maybe_inject when armed: report (once per
+    lock/seam pair) every non-serial witnessed lock held across it."""
+    if getattr(_tls, "busy", False):
+        return
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    for h in dict.fromkeys(held):
+        pair = (h, site)
+        if h in _serial_keys or pair in _seam_seen:
+            continue
+        with _state_lock:
+            if pair in _seam_seen:
+                continue
+            _seam_seen.add(pair)
+        _report("seam",
+                f"lock {h} held across fault seam {site!r} — collect under "
+                f"the lock, cross the seam after release (or mark_serial)")
+
+
+def reports() -> List[Dict[str, Any]]:
+    """Accumulated violation reports (copies; capped at {cap})."""
+    with _state_lock:
+        return [dict(r) for r in _reports]
+
+
+reports.__doc__ = reports.__doc__.format(cap=_MAX_REPORTS)  # type: ignore
+
+
+def clear() -> None:
+    """Drop reports and the learned order graph (test isolation)."""
+    with _state_lock:
+        _reports.clear()
+        _order.clear()
+        _seam_seen.clear()
+
+
+def enabled() -> bool:
+    return _installed
+
+
+def install() -> bool:
+    """Patch the threading lock factories and arm the seam hook.
+    Idempotent; returns True when armed after the call."""
+    global _installed
+    if _installed:
+        return True
+    threading.Lock = _lock_factory  # type: ignore[assignment]
+    threading.RLock = _rlock_factory  # type: ignore[assignment]
+    threading.Condition = _condition_factory  # type: ignore[assignment]
+    from . import faults
+
+    faults._lockdep_seam = note_seam
+    atexit.register(_atexit_report)
+    _installed = True
+    return True
+
+
+def uninstall() -> None:
+    """Restore the raw factories and disarm the seam hook.  Locks already
+    wrapped keep witnessing; state (graph, reports) is kept — clear()
+    drops it."""
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _orig_lock  # type: ignore[assignment]
+    threading.RLock = _orig_rlock  # type: ignore[assignment]
+    threading.Condition = _orig_condition  # type: ignore[assignment]
+    from . import faults
+
+    faults._lockdep_seam = None
+    _installed = False
+
+
+def maybe_install_from_env() -> bool:
+    """Arm iff ``XGBOOST_TPU_LOCKDEP`` is set truthy.  Called from
+    package import *before* sibling modules create their module-level
+    locks, so those are witnessed too."""
+    if os.environ.get(ENV_ENABLE, "").strip().lower() in _OFF_VALUES:
+        return False
+    return install()
+
+
+def _atexit_report() -> None:  # pragma: no cover - interpreter teardown
+    # plain list read, no lock taken: an XTB903-clean handler that still
+    # gets the marker out even if a witness structure is mid-update
+    n = len(_reports)
+    if not n:
+        return
+    sys.stderr.write(f"XTB-LOCKDEP-VIOLATION: {n} report(s)\n")
+    for r in _reports[:_MAX_REPORTS]:
+        sys.stderr.write(f"  [{r['kind']}] ({r['thread']}) {r['msg']}\n")

@@ -103,6 +103,7 @@ import numpy as np
 
 from ..launcher import WorkerFailedError, spawn_worker, stderr_tail
 from ..reliability import faults as _faults
+from ..reliability import lockdep as _lockdep
 from ..reliability import resources as _resources
 from ..telemetry import distributed as _distributed
 from ..telemetry import flight as _flight
@@ -603,8 +604,9 @@ class _Replica:
         self.breaker_until = 0.0                 # open -> probe allowed at
         self.probe = False                       # half-open probe out
         # heartbeat pings share the socket with dispatch sends from other
-        # threads; two interleaved sendalls would shear a frame
-        self.txlock = threading.Lock()
+        # threads; two interleaved sendalls would shear a frame.  Held
+        # across the wire by contract -> serial for the lockdep witness
+        self.txlock = _lockdep.mark_serial(threading.Lock())
 
 
 _ERR_TYPES = {"ValueError": ValueError, "KeyError": KeyError,

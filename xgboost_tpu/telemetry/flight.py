@@ -114,26 +114,29 @@ def events() -> List[Dict[str, Any]]:
         return list(_ring)
 
 
-def _payload() -> Dict[str, Any]:
+def _payload(evs: List[Dict[str, Any]]) -> Dict[str, Any]:
     return {
         "label": _resolved_label(),
         "pid": os.getpid(),
         "wall_at_dump": datetime.now(timezone.utc).isoformat(),
         "mono_at_dump": time.monotonic(),
-        "events": events(),
+        "events": evs,
     }
+
+
+def _write(path: str, evs: List[Dict[str, Any]]) -> str:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(_payload(evs), fh)
+    os.replace(tmp, path)
+    return path
 
 
 def dump(path: Optional[str] = None) -> str:
     """Write the ring to ``path`` (default :func:`default_path`)
     atomically; returns the path.  Safe to call repeatedly — each call
     replaces the file with the current ring."""
-    path = path or default_path()
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump(_payload(), fh)
-    os.replace(tmp, path)
-    return path
+    return _write(path or default_path(), events())
 
 
 def stacks_path(label: Optional[str] = None) -> str:
@@ -205,8 +208,18 @@ def install(label: Optional[str] = None,
 
 
 def _atexit_dump() -> None:  # pragma: no cover - interpreter teardown
+    # bounded acquire (XTB903): a recorder wedged on the ring lock must
+    # not hang shutdown; an unlocked best-effort snapshot beats no dump
+    # at all on the death path
     try:
-        dump(_spill_path)
+        if _lock.acquire(timeout=1.0):
+            try:
+                evs = list(_ring)
+            finally:
+                _lock.release()
+        else:
+            evs = list(_ring)
+        _write(_spill_path or default_path(), evs)
     except Exception:
         pass
 

@@ -192,7 +192,12 @@ def flush() -> None:
 @atexit.register
 def _close() -> None:  # pragma: no cover - interpreter teardown
     global _file, _path
-    with _lock:
+    # bounded acquire (XTB903): an emitter thread wedged on the lock must
+    # not hang interpreter shutdown — losing the last flush beats never
+    # exiting
+    if not _lock.acquire(timeout=1.0):
+        return
+    try:
         if _file is not None:
             try:
                 _file.flush()
@@ -203,3 +208,5 @@ def _close() -> None:  # pragma: no cover - interpreter teardown
             # they no-op instead of writing to a closed handle
             _file = None
             _path = None
+    finally:
+        _lock.release()

@@ -48,9 +48,11 @@ import struct
 import threading
 import time
 import warnings
-from typing import Any, Dict, List, Optional, Union
+import weakref
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 from .elastic import RegroupRequired
+from .reliability import lockdep as _lockdep
 
 # sentinel returned by CollRelay._contribute while elastic membership is
 # changing: the serve thread answers ``coll_regroup`` instead of a payload
@@ -61,6 +63,13 @@ _REGROUP = object()
 # instead of a silent wedge.  Blocking reads that are SUPPOSED to wait
 # forever — the abort-channel watchers — pass timeout=None explicitly.
 OP_TIMEOUT = 300.0
+
+# xtblint XTB902 contract (docs/static_analysis.md "Annotating an
+# intentional ordering"): the client's collective lock is DESIGNED to be
+# held across a full blocking protocol round — it serializes collectives
+# on the one relay socket, and interrupt_collective() is the documented
+# out-of-band escape that unblocks the holder without taking the lock
+_XTB_SERIAL_LOCKS = ("TrackerClient._coll_lock", "RabitTracker._journal_io")
 
 
 @contextlib.contextmanager
@@ -682,6 +691,17 @@ class RabitTracker:
         self._done = threading.Event()
         self._error: Optional[str] = None
         self._lock = threading.Lock()
+        # per-connection control-send locks (fleet's txlock idiom): sends
+        # happen with _lock RELEASED so a wedged peer cannot stall the
+        # membership state, but concurrent senders to one socket must not
+        # shear a frame.  Weak keys: entries die with their socket.
+        self._ctl_tx: "weakref.WeakKeyDictionary[socket.socket, threading.Lock]" = \
+            weakref.WeakKeyDictionary()
+        # journal append serialization (_XTB_SERIAL_LOCKS): entries must
+        # land in state-capture order, so capture+append pairs run under
+        # this lock — NOT under _lock, which must never be held across
+        # the disk write (a slow disk stalls the journal, not liveness)
+        self._journal_io = _lockdep.mark_serial(threading.Lock())
         self._thread: Optional[threading.Thread] = None
         # --- membership state (all guarded by _lock) ---
         self._members: Dict[socket.socket, int] = {}  # live conn -> rank
@@ -765,19 +785,24 @@ class RabitTracker:
         if self._journal is None:
             return
         now = time.monotonic()
-        with self._lock:
-            if not self._serve_done and self._recovered is None:
-                return  # no roster yet: nothing replayable to record
-            if not force and now - self._journal_last < 1.0:
-                return
-            self._journal_last = now
-            state = self._journal_state()
-        try:
-            self._journal.append(state)
-        except OSError as e:  # journal loss degrades failover, not the job
-            warnings.warn(f"tracker journal write failed ({e}); a tracker "
-                          "respawn may not recover this transition",
-                          RuntimeWarning, stacklevel=2)
+        # io lock OUTSIDE the state lock (_journal_io -> _lock order):
+        # holding it across capture+append keeps entries in state-capture
+        # order (replay trusts the LAST entry, so an older capture landing
+        # after a newer one would resurrect stale state on respawn)
+        with self._journal_io:
+            with self._lock:
+                if not self._serve_done and self._recovered is None:
+                    return  # no roster yet: nothing replayable to record
+                if not force and now - self._journal_last < 1.0:
+                    return
+                self._journal_last = now
+                state = self._journal_state()
+            try:
+                self._journal.append(state)
+            except OSError as e:  # journal loss degrades failover, not job
+                warnings.warn(f"tracker journal write failed ({e}); a "
+                              "tracker respawn may not recover this "
+                              "transition", RuntimeWarning, stacklevel=2)
 
     def _serve(self) -> None:
         pending = []  # (sort_key, arrival, conn)
@@ -964,22 +989,39 @@ class RabitTracker:
                            reason="never re-adopted after tracker recovery")
         self._maybe_complete_regroup()
 
+    def _send_ctl(self, conn: socket.socket, payload: dict, *,
+                  timeout: float, peer: Optional[int] = None) -> None:
+        """Control-plane send with the state lock NOT held (XTB902): a
+        wedged peer stalls only its own connection, never the membership
+        state every watcher/tick needs.  The per-connection tx lock keeps
+        concurrent control frames to one socket from shearing."""
+        with self._lock:
+            lk = self._ctl_tx.get(conn)
+            if lk is None:
+                # serialization lock: held across the wire send by
+                # contract, so the lockdep witness must not flag it
+                lk = self._ctl_tx[conn] = _lockdep.mark_serial(
+                    threading.Lock())
+        with lk:
+            send_msg(conn, payload, timeout=timeout, peer=peer)
+
     def _fan_abort(self, rank: int, msg: str,
                    source: Optional[socket.socket]) -> None:
         """First failure wins: record it and abort every OTHER worker
         (tracker.cc:345; workers' watchers exit on receipt)."""
+        targets: List[Tuple[socket.socket, Optional[int]]] = []
+        err = ""
         with self._lock:
             if self._error is None:
-                self._error = f"worker {rank}: {msg}"
-                for other in self._conns:
-                    if other is not source:
-                        try:
-                            send_msg(other, {"cmd": "abort",
-                                             "msg": self._error},
-                                     timeout=30.0,
-                                     peer=self._members.get(other))
-                        except OSError:
-                            pass
+                self._error = err = f"worker {rank}: {msg}"
+                targets = [(other, self._members.get(other))
+                           for other in self._conns if other is not source]
+        for other, peer in targets:
+            try:
+                self._send_ctl(other, {"cmd": "abort", "msg": err},
+                               timeout=30.0, peer=peer)
+            except OSError:
+                pass
         self._done.set()
 
     def _watch_worker(self, conn: socket.socket, rank: int) -> None:
@@ -1011,23 +1053,14 @@ class RabitTracker:
                 self._ingest_telemetry(cur, msg)
                 continue
         if clean:
+            stranded: List[socket.socket] = []
             with self._lock:
                 self._members.pop(conn, None)
                 self._clean_exits += 1
                 if not self._members and self._joiners:
                     # training finished with replacements still parked:
                     # there is nothing left to absorb them into
-                    for j in self._joiners:
-                        try:
-                            send_msg(j, {"cmd": "abort",
-                                         "msg": "training already complete"},
-                                     timeout=5.0)
-                        except OSError:
-                            pass
-                        try:
-                            j.close()
-                        except OSError:
-                            pass
+                    stranded = self._joiners
                     self._joiners = []
                     # the regroup those joiners triggered can never form —
                     # a stale flag here would turn the clean finish into a
@@ -1036,6 +1069,17 @@ class RabitTracker:
                     self._regroup_joins = {}
                     self._readmit_waiting = 0
                     self._readmit_until = 0.0
+            for j in stranded:
+                try:
+                    self._send_ctl(j, {"cmd": "abort",
+                                       "msg": "training already complete"},
+                                   timeout=5.0)
+                except OSError:
+                    pass
+                try:
+                    j.close()
+                except OSError:
+                    pass
             if self.elastic:
                 self._journal_write(force=True)
                 # a clean exit during a pending regroup: the remaining
@@ -1433,13 +1477,15 @@ class RabitTracker:
             with self._lock:
                 if self._closing or self._error is not None:
                     return
-                for conn in self._members:
-                    try:
-                        send_msg(conn, {"cmd": "regroup_pending",
-                                        "epoch": self._epoch + 1},
-                                 timeout=30.0, peer=self._members[conn])
-                    except OSError:
-                        pass  # its watcher will report the death
+                pending = list(self._members.items())
+                next_epoch = self._epoch + 1
+            for conn, peer in pending:
+                try:
+                    self._send_ctl(conn, {"cmd": "regroup_pending",
+                                          "epoch": next_epoch},
+                                   timeout=30.0, peer=peer)
+                except OSError:
+                    pass  # its watcher will report the death
         self._maybe_complete_regroup()
 
     def _handle_regroup_join(self, conn: socket.socket, round_: int) -> None:
@@ -1546,39 +1592,54 @@ class RabitTracker:
             # the old ranks must not age anyone in the new epoch
             self._liveness = {}
             self._relay.regroup(new_world, epoch)
+            epoch_state = None
             if self._journal is not None:
-                # durable-commit-first: the new epoch must hit the journal
-                # BEFORE any worker is told about it — a tracker killed
-                # between announce and journal would otherwise respawn
-                # believing the OLD epoch while the workers run the new
-                # one (and a reader racing the replies would see a stale
-                # epoch, the flake this ordering fix removes)
+                self._journal_last = time.monotonic()
+                epoch_state = self._journal_state()
+            coll_port = self._relay.port
+            failover = self._journal is not None
+            announce = list(enumerate(ordered))
+            # capture under the lock: a joiner's conn could die (and leave
+            # _members) before the watcher threads below start
+            joiner_ranks = [(conn, self._members[conn]) for conn in joiners]
+        if epoch_state is not None:
+            # durable-commit-first: the new epoch must hit the journal
+            # BEFORE any worker is told about it — a tracker killed
+            # between announce and journal would otherwise respawn
+            # believing the OLD epoch while the workers run the new one.
+            # Under _journal_io (NOT _lock — a slow disk must stall only
+            # the journal, lockdep seam witness): a concurrent throttled
+            # _journal_write holds _journal_io across its own capture+
+            # append, so it cannot land a pre-epoch capture after this
+            # entry and make replay resurrect the old epoch
+            with self._journal_io:
                 try:
-                    self._journal_last = time.monotonic()
-                    self._journal.append(self._journal_state())
+                    self._journal.append(epoch_state)
                 except OSError as e:
                     warnings.warn(
                         f"tracker journal write failed ({e}); a tracker "
                         "respawn may not recover this epoch",
                         RuntimeWarning, stacklevel=2)
-            for nr, conn in enumerate(ordered):
-                try:
-                    send_msg(conn, {"cmd": "regroup", "epoch": epoch,
-                                    "rank": nr, "world": new_world,
-                                    "round": resume_round,
-                                    "coll_port": self._relay.port,
-                                    "coordinator": "",
-                                    # a parked JOINER's start handshake is
-                                    # answered by this message: it must
-                                    # learn failover/elastic are armed here
-                                    "failover": self._journal is not None,
-                                    "elastic": True},
-                             timeout=30.0, peer=nr)
-                except OSError:
-                    pass  # the death will be seen and regrouped again
-            # capture under the lock: a joiner's conn could die (and leave
-            # _members) before the watcher threads below start
-            joiner_ranks = [(conn, self._members[conn]) for conn in joiners]
+        # announces OUTSIDE the state lock (XTB902): the journal commit
+        # above still precedes every announce, and the per-connection tx
+        # locks keep a concurrent regroup_pending/abort from shearing a
+        # frame; a wedged peer stalls only its own socket
+        for nr, conn in announce:
+            try:
+                self._send_ctl(conn, {"cmd": "regroup", "epoch": epoch,
+                                      "rank": nr, "world": new_world,
+                                      "round": resume_round,
+                                      "coll_port": coll_port,
+                                      "coordinator": "",
+                                      # a parked JOINER's start handshake
+                                      # is answered by this message: it
+                                      # must learn failover/elastic are
+                                      # armed here
+                                      "failover": failover,
+                                      "elastic": True},
+                               timeout=30.0, peer=nr)
+            except OSError:
+                pass  # the death will be seen and regrouped again
         from .elastic import instruments as _elastic_ins
         from .telemetry import flight as _flight
 
@@ -1701,6 +1762,9 @@ class TrackerClient:
         self._coll_seq = 0
         self._coll_interrupted = False  # set by the collective watchdog
         self._coll_lock = threading.Lock()
+        # serialization lock (_XTB_SERIAL_LOCKS): held across wire I/O by
+        # contract, so the runtime witness must not flag the seam crossing
+        _lockdep.mark_serial(self._coll_lock)
         self._state_lock = threading.Lock()
         self._connected = threading.Event()      # channel is usable
         self._connected.set()
