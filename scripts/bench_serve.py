@@ -18,8 +18,11 @@ graceful degradation"):
   warm persistent compile cache (cold gets a FRESH cache dir every rep;
   warm reuses the dir the cold rep just populated — a within-run pair).
 - ``fleet_saturation`` — sustained throughput + p99 under mixed
-  two-model closed-loop traffic at fleet sizes {1, 2, 4}, all sizes
-  measured in this run (the fleet-of-1 row IS the baseline pair).
+  two-model closed-loop traffic across the (n_replicas, n_shards)
+  sweep in ``FLEET_CONFIGS``, all configs measured in this run (the
+  1x1 row IS the baseline pair).  Client threads scale with the fleet
+  and carry distinct tenants (the shard routing key); sharded rows
+  record per-shard rows/s and rx-loop busy fraction.
 - ``lifecycle_swap`` — p99 during a hot version swap vs the same run's
   steady state, with the requests in flight during each swap recorded.
 - ``shed_vs_degrade`` — per-SLO-class completions/sheds and gold p99
@@ -32,7 +35,13 @@ and reports the MINIMUM wall (min-of-N estimates the code's actual cost;
 the mean estimates the host's load average), latency percentiles taken
 from the min-wall rep.  The ``reps`` field records N.
 
+Every section carries the host fingerprint (cores + arch + SIMD flag
+set, the ladder's convention); ``--diff old.json new.json`` compares
+two artifacts section by section and REFUSES (exit 2) any pair stamped
+by different hosts.
+
 Usage:  python scripts/bench_serve.py [out.json]   (default BENCH_SERVE.json)
+        python scripts/bench_serve.py --diff old.json new.json
 Knobs:  BENCH_SERVE_ROUNDS / _DEPTH / _FEATURES for model size,
         BENCH_SERVE_ITERS to scale the timed loops,
         BENCH_SERVE_REPS for min-of-N (default 3),
@@ -41,8 +50,10 @@ Knobs:  BENCH_SERVE_ROUNDS / _DEPTH / _FEATURES for model size,
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import platform as _platform
 import shutil
 import sys
 import tempfile
@@ -56,10 +67,46 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 BATCH_SIZES = (1, 64, 4096)
 ITERS = {1: 400, 64: 200, 4096: 30}
-FLEET_SIZES = (1, 2, 4)
+# (n_replicas, n_shards) sweep: the single-dispatcher column up to 4,
+# then the sharded front-end past the dispatcher ceiling
+FLEET_CONFIGS = ((1, 1), (2, 1), (4, 1), (4, 2), (8, 2), (8, 4), (12, 4))
 FLEET_BATCH = 512       # rows per fleet request
-FLEET_CLIENTS = 8       # closed-loop client threads
+FLEET_CLIENTS = 8       # closed-loop client threads (floor; scales with fleet)
 FLEET_REQS_PER_CLIENT = 40
+
+_HOST_FP = None
+
+
+def _host_fingerprint() -> dict:
+    """What makes a wall-clock number comparable: core count, arch, and
+    the SIMD capability set (the ladder's convention).  Stamped on every
+    section of BENCH_SERVE.json; --diff refuses (exit 2) when the ids
+    differ — a cross-host wall ratio is not a regression signal, it is
+    two different machines."""
+    global _HOST_FP
+    if _HOST_FP is None:
+        from xgboost_tpu.utils import native as _native
+
+        simd = _native.simd_info()
+        info = dict(cores=os.cpu_count(), machine=_platform.machine(),
+                    cpu_flags=sorted(simd.get("cpu_flags", [])),
+                    lanes=simd.get("lanes"))
+        blob = json.dumps(info, sort_keys=True).encode()
+        info["id"] = hashlib.sha256(blob).hexdigest()[:12]
+        _HOST_FP = info
+    return _HOST_FP
+
+
+def _stamp(section):
+    """Attach the host fingerprint to a section dict (or to every row of
+    a section list) so any later cross-file comparison can refuse
+    cross-host pairs."""
+    if isinstance(section, list):
+        for row in section:
+            _stamp(row)
+    elif isinstance(section, dict):
+        section["host"] = _host_fingerprint()
+    return section
 
 
 def _reps() -> int:
@@ -203,29 +250,58 @@ def bench_fleet_coldstart(model_paths: dict, workdir: str) -> dict:
     }
 
 
-def _fleet_load(fleet, Xa, Xb) -> dict:
-    """One closed-loop mixed two-model load: FLEET_CLIENTS threads, each
-    alternating models request by request.  Returns wall + latencies."""
-    lats = [None] * FLEET_CLIENTS
+def _fleet_configs() -> tuple:
+    """The (n_replicas, n_shards) sweep, capped to what this host can
+    actually demonstrate: a config with more replicas than max(4, cores)
+    measures core-oversubscription, not dispatcher design.  Skips are
+    LOUD (printed and recorded in the report) — a silently truncated
+    sweep reads as 'measured everything' when it didn't."""
+    cores = os.cpu_count() or 1
+    cap = max(4, cores)
+    run = tuple(c for c in FLEET_CONFIGS if c[0] <= cap)
+    skipped = tuple(c for c in FLEET_CONFIGS if c[0] > cap)
+    if skipped:
+        print(f"fleet saturation: host has {cores} cores — skipping "
+              f"{['%dx%d-shard' % c for c in skipped]} (replica counts "
+              f"past max(4, cores)={cap} measure oversubscription)")
+    return run, skipped
+
+
+def _fleet_clients(n_replicas: int) -> int:
+    """Closed-loop clients sized to the fleet, not a constant: window-1
+    dispatch means a replica idles whenever no request is queued for it,
+    so demonstrating N-replica scale-out needs comfortably more than N
+    outstanding requests (3x keeps every shard's queue non-empty without
+    drowning the host in client threads)."""
+    return max(FLEET_CLIENTS, 3 * n_replicas)
+
+
+def _fleet_load(fleet, Xa, Xb, n_clients) -> dict:
+    """One closed-loop mixed two-model load: n_clients threads, each
+    with a distinct tenant (the shard-routing key — distinct tenants
+    spread a sharded fleet's traffic across every shard), alternating
+    models request by request.  Returns wall + latencies."""
+    lats = [None] * n_clients
     errors = []
-    barrier = threading.Barrier(FLEET_CLIENTS)
+    barrier = threading.Barrier(n_clients)
 
     def client(tid):
         lat = np.empty(FLEET_REQS_PER_CLIENT)
+        tenant = f"c{tid}"
         try:
             barrier.wait(60)
             for i in range(FLEET_REQS_PER_CLIENT):
                 model, X = (("a", Xa) if (tid + i) % 2 == 0
                             else ("b", Xb))
                 t0 = time.perf_counter()
-                fleet.predict(model, X, timeout=600)
+                fleet.predict(model, X, tenant=tenant, timeout=600)
                 lat[i] = time.perf_counter() - t0
             lats[tid] = lat
         except BaseException as e:  # pragma: no cover
             errors.append(repr(e))
 
     threads = [threading.Thread(target=client, args=(t,))
-               for t in range(FLEET_CLIENTS)]
+               for t in range(n_clients)]
     t0 = time.perf_counter()
     for t in threads:
         t.start()
@@ -237,15 +313,30 @@ def _fleet_load(fleet, Xa, Xb) -> dict:
     return {"wall": wall, "lat": np.concatenate(lats)}
 
 
+def _shard_counters(fleet, n_shards: int) -> dict:
+    """Snapshot the per-shard counters (monotonic; callers diff before/
+    after a timed window)."""
+    ins = fleet._ins
+    return {k: {"rows": ins.shard_rows.get(str(k)),
+                "busy": ins.shard_rx_busy.get(str(k))}
+            for k in range(n_shards)}
+
+
 def bench_fleet_saturation(model_paths: dict, workdir: str,
                            features: int) -> list:
-    """Sustained mixed-traffic throughput + p99 at fleet sizes 1/2/4.
+    """Sustained mixed-traffic throughput + p99 across the
+    (n_replicas, n_shards) sweep in FLEET_CONFIGS.
 
-    All sizes run in THIS invocation (within-run pairs: the size-1 row is
-    the baseline the fleet-of-4 acceptance ratio divides by); per size,
+    All configs run in THIS invocation (within-run pairs: the 1x1 row is
+    the baseline every acceptance ratio divides by); per config,
     min-of-N walls with percentiles from the min-wall rep.  The shared
     warm cache keeps what's measured at steady state, not compile time.
-    """
+    Client threads scale with the fleet (3x replicas) so the closed loop
+    never becomes the bottleneck; each client carries its own tenant so
+    shard routing spreads the load.  Sharded rows also record per-shard
+    rows/s and the rx-loop busy fraction (time the shard's dispatcher-
+    side rx threads spent OUT of the blocking recv — the dispatcher-
+    ceiling signal the sharding exists to break)."""
     from xgboost_tpu.serving import ServingFleet
 
     cache = os.path.join(workdir, "saturation_cache")
@@ -253,32 +344,51 @@ def bench_fleet_saturation(model_paths: dict, workdir: str,
     Xa = rng.normal(size=(FLEET_BATCH, features)).astype(np.float32)
     Xb = rng.normal(size=(FLEET_BATCH, features)).astype(np.float32)
     rows = []
-    n_requests = FLEET_CLIENTS * FLEET_REQS_PER_CLIENT
-    for n in FLEET_SIZES:
-        with ServingFleet(model_paths, n_replicas=n, cache_dir=cache,
+    configs, _ = _fleet_configs()
+    for n, shards in configs:
+        n_clients = _fleet_clients(n)
+        n_requests = n_clients * FLEET_REQS_PER_CLIENT
+        with ServingFleet(model_paths, n_replicas=n, n_shards=shards,
+                          cache_dir=cache,
                           warmup_buckets=(FLEET_BATCH,)) as fleet:
-            _fleet_load(fleet, Xa, Xb)  # steady-state warm pass, untimed
+            _fleet_load(fleet, Xa, Xb, n_clients)  # warm pass, untimed
             best = None
             for _ in range(_reps()):
-                r = _fleet_load(fleet, Xa, Xb)
+                c0 = _shard_counters(fleet, shards)
+                r = _fleet_load(fleet, Xa, Xb, n_clients)
+                r["shard_delta"] = {
+                    k: {"rows": c1["rows"] - c0[k]["rows"],
+                        "busy": c1["busy"] - c0[k]["busy"]}
+                    for k, c1 in _shard_counters(fleet, shards).items()}
                 if best is None or r["wall"] < best["wall"]:
                     best = r
         p50, p99 = np.percentile(best["lat"], [50, 99])
+        wall = best["wall"]
+        per_shard = [
+            {"shard": k,
+             "rows_per_s": round(d["rows"] / wall, 1),
+             "rx_busy_frac": round(d["busy"] / wall, 4)}
+            for k, d in sorted(best["shard_delta"].items())]
         row = {
             "n_replicas": n,
-            "clients": FLEET_CLIENTS,
+            "n_shards": shards,
+            "clients": n_clients,
             "requests": n_requests,
             "batch": FLEET_BATCH,
             "reps": _reps(),
-            "wall_s": round(best["wall"], 3),
-            "requests_per_s": round(n_requests / best["wall"], 1),
-            "rows_per_s": round(n_requests * FLEET_BATCH / best["wall"], 1),
+            "wall_s": round(wall, 3),
+            "requests_per_s": round(n_requests / wall, 1),
+            "rows_per_s": round(n_requests * FLEET_BATCH / wall, 1),
             "p50_ms": round(float(p50) * 1e3, 3),
             "p99_ms": round(float(p99) * 1e3, 3),
+            "per_shard": per_shard,
         }
         rows.append(row)
-        print(f"fleet n={n}  rows/s={row['rows_per_s']:.0f}  "
-              f"p50={row['p50_ms']:.1f}ms  p99={row['p99_ms']:.1f}ms")
+        busy = max((s["rx_busy_frac"] for s in per_shard), default=0.0)
+        print(f"fleet n={n} shards={shards}  "
+              f"rows/s={row['rows_per_s']:.0f}  "
+              f"p50={row['p50_ms']:.1f}ms  p99={row['p99_ms']:.1f}ms  "
+              f"max rx busy={busy:.0%}")
     return rows
 
 
@@ -503,6 +613,7 @@ def main(out_path: str) -> int:
         "generated_unix": int(time.time()),
         "reps": _reps(),
         "host_cores": os.cpu_count(),
+        "host": _host_fingerprint(),
         "model": {"rounds": rounds, "max_depth": depth, "features": features,
                   "objective": "binary:logistic"},
         "config": {"warmup_buckets": [1, 64, 4096], "max_batch": 4096,
@@ -515,10 +626,10 @@ def main(out_path: str) -> int:
         for b in BATCH_SIZES:
             iters = max(10, int(ITERS[b] * scale))
             r = bench_direct(eng, X, b, iters)
-            report["results"].append(r)
+            report["results"].append(_stamp(r))
             print(f"batch={b:5d}  p50={r['p50_ms']:.3f}ms  "
                   f"p99={r['p99_ms']:.3f}ms  rows/s={r['rows_per_s']:.0f}")
-        report["concurrent"] = bench_concurrent(eng, X)
+        report["concurrent"] = _stamp(bench_concurrent(eng, X))
         steady = report["concurrent"]["engine_metrics"]["compiles_steady"]
         print(f"concurrent: {report['concurrent']['requests_per_s']:.0f} "
               f"req/s over {report['concurrent']['threads']} threads, "
@@ -542,37 +653,49 @@ def main(out_path: str) -> int:
             bst_b.save_model(pb)
             bst_c.save_model(pc)
             cs = bench_fleet_coldstart({"a": pa, "b": pb, "c": pc}, workdir)
-            report["fleet_coldstart"] = cs
+            report["fleet_coldstart"] = _stamp(cs)
             print(f"fleet coldstart ({cs['programs']} programs): "
                   f"cold={cs['cold_warmup_s']:.2f}s "
                   f"warm={cs['warm_warmup_s']:.3f}s "
                   f"speedup={cs['speedup']:.0f}x")
             sat = bench_fleet_saturation({"a": pa, "b": pb}, workdir,
                                          features)
-            report["fleet_saturation"] = sat
+            report["fleet_saturation"] = _stamp(sat)
             base = sat[0]["rows_per_s"]
-            top = sat[-1]["rows_per_s"]
-            report["fleet_scaling_vs_single"] = round(top / base, 2)
-            report["fleet_best_scaling"] = round(
-                max(r["rows_per_s"] for r in sat) / base, 2)
+            top_row = max(sat, key=lambda r: r["rows_per_s"])
+            top = top_row["rows_per_s"]
+            unsharded = [r for r in sat if r["n_shards"] == 1]
+            report["fleet_scaling_vs_single"] = round(
+                unsharded[-1]["rows_per_s"] / base, 2)
+            report["fleet_best_scaling"] = round(top / base, 2)
+            report["fleet_best_config"] = {
+                "n_replicas": top_row["n_replicas"],
+                "n_shards": top_row["n_shards"],
+                "rows_per_s": top}
+            _, skipped = _fleet_configs()
+            if skipped:
+                report["fleet_configs_skipped"] = [
+                    {"n_replicas": n, "n_shards": s} for n, s in skipped]
+            max_reps = max(r["n_replicas"] for r in sat)
             cores = os.cpu_count() or 1
-            if cores < 2 * max(FLEET_SIZES):
-                # N replicas + dispatcher need ~N+1 cores to demonstrate
-                # replica-limited scale-out; below that the rows measure
-                # core-oversubscription, not the dispatcher design (total
-                # CPU bounds fleet/single at cores/1 when a single replica
-                # already saturates its core)
+            if cores < 2 * max_reps:
+                # N replicas + dispatchers + clients need ~2N cores to
+                # demonstrate replica-limited scale-out; below that the
+                # rows measure core-oversubscription, not the dispatcher
+                # design (total CPU bounds fleet/single at cores/1 when a
+                # single replica already saturates its core)
                 report["fleet_scaling_note"] = (
                     f"host-bound: {cores} cores for "
-                    f"{max(FLEET_SIZES)} replicas + dispatcher; "
+                    f"{max_reps} replicas + dispatchers; "
                     f"theoretical scaling ceiling ~{cores}.0x")
-            print(f"fleet-of-{sat[-1]['n_replicas']} vs single: "
+            print(f"fleet best {top_row['n_replicas']}x"
+                  f"{top_row['n_shards']}-shard vs single: "
                   f"{top / base:.2f}x "
                   f"({report.get('fleet_scaling_note', 'replica-limited')})")
             svd = bench_shed_vs_degrade(pa, workdir, features)
-            report["shed_vs_degrade"] = svd
+            report["shed_vs_degrade"] = _stamp(svd)
             ls = bench_lifecycle_swap(workdir, features, bst)
-            report["lifecycle_swap"] = ls
+            report["lifecycle_swap"] = _stamp(ls)
             print(f"lifecycle swap: wall={ls['swap_wall_s'] * 1e3:.0f}ms  "
                   f"{ls['requests_during_swap']} requests in flight  "
                   f"p99 during={ls['p99_during_ms']}ms "
@@ -602,5 +725,55 @@ def main(out_path: str) -> int:
     return rc
 
 
+def diff_main(old_path: str, new_path: str) -> int:
+    """Compare two BENCH_SERVE.json files section by section; refuses
+    (exit 2) when any compared pair was produced on different hosts —
+    cross-host wall-clock ratios are two machines, not a regression."""
+    with open(old_path) as fh:
+        old = json.load(fh)
+    with open(new_path) as fh:
+        new = json.load(fh)
+    rc = 0
+
+    def hosts_match(name, a, b) -> bool:
+        nonlocal rc
+        ha, hb = (a or {}).get("host"), (b or {}).get("host")
+        if not ha or not hb or ha.get("id") != hb.get("id"):
+            print(f"[{name}] REFUSED: rows are from different hosts "
+                  f"({(ha or {}).get('id', 'unstamped')} vs "
+                  f"{(hb or {}).get('id', 'unstamped')}) — wall-clock "
+                  f"deltas across hosts are not comparable")
+            rc = 2
+            return False
+        return True
+
+    def pct(name, wa, wb, unit):
+        if wa and wb:
+            print(f"[{name}] {wa}{unit} -> {wb}{unit} "
+                  f"({(wb - wa) / wa * 100.0:+.1f}%)")
+
+    oldr = {r["batch"]: r for r in old.get("results", [])}
+    for b, rb in {r["batch"]: r for r in new.get("results", [])}.items():
+        ra = oldr.get(b)
+        if ra and hosts_match(f"direct batch={b}", ra, rb):
+            pct(f"direct batch={b} p99", ra["p99_ms"], rb["p99_ms"], "ms")
+    ca, cb = old.get("concurrent"), new.get("concurrent")
+    if ca and cb and hosts_match("concurrent", ca, cb):
+        pct("concurrent req/s", ca["requests_per_s"],
+            cb["requests_per_s"], "")
+    key = lambda r: (r.get("n_replicas"), r.get("n_shards", 1))
+    olds = {key(r): r for r in old.get("fleet_saturation", [])}
+    for k, rb in {key(r): r
+                  for r in new.get("fleet_saturation", [])}.items():
+        ra = olds.get(k)
+        name = f"fleet {k[0]}x{k[1]}-shard"
+        if ra and hosts_match(name, ra, rb):
+            pct(f"{name} rows/s", ra["rows_per_s"], rb["rows_per_s"], "")
+    return rc
+
+
 if __name__ == "__main__":
+    if "--diff" in sys.argv:
+        i = sys.argv.index("--diff")
+        sys.exit(diff_main(sys.argv[i + 1], sys.argv[i + 2]))
     sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "BENCH_SERVE.json"))

@@ -21,6 +21,12 @@ One scenario, end to end against real replica processes:
    sidecars) contains a dispatcher `fleet.request` and a replica
    `replica.execute` event sharing one request trace id across two pids.
 
+Then the **sharded leg** (docs/serving.md "Sharded topology"): a 2-shard
+4-replica fleet under tenant-spread traffic, SIGKILL one shard's replica
+mid-stream — zero dropped, every answer bitwise, the respawn lands in
+the victim's OWN shard (label prefix), and the sibling shard never
+respawns.
+
 Usage: JAX_PLATFORMS=cpu python scripts/fleet_smoke.py [n_replicas] [reqs]
 """
 import os
@@ -53,6 +59,85 @@ def train_pair(workdir):
         paths[name] = os.path.join(workdir, f"{name}.json")
         bst.save_model(paths[name])
     return paths, X
+
+
+def sharded_leg(paths, Xq, ref, workdir, per_client: int) -> list:
+    """2-shard fleet, SIGKILL one shard's replica mid-stream: zero
+    dropped + bitwise, respawn within the victim's own shard."""
+    from xgboost_tpu.serving import ServingFleet
+    from xgboost_tpu.serving.fleet import shard_of
+
+    errors = []
+    kill_at = threading.Event()
+    done = [0]
+    lock = threading.Lock()
+    with ServingFleet(paths, n_replicas=4, n_shards=2,
+                      cache_dir=os.path.join(workdir, "cache"),
+                      warmup_buckets=(BATCH,), max_respawns=1) as fleet:
+        sh0, sh1 = fleet._shards
+        print(f"sharded leg: {fleet.alive_replicas()}/4 replicas across "
+              f"{len(fleet._shards)} shards")
+
+        def client(tid):
+            tenant = f"smoke{tid}"
+            try:
+                for i in range(per_client):
+                    model = "a" if (tid + i) % 2 == 0 else "b"
+                    out = fleet.predict(model, Xq, tenant=tenant,
+                                        timeout=600)
+                    with lock:
+                        done[0] += 1
+                    if not np.array_equal(out, ref[model]):
+                        errors.append(f"sharded client{tid} req{i}: "
+                                      f"WRONG BITS for model {model}")
+                    if tid == 0 and i == per_client // 4:
+                        kill_at.set()
+            except BaseException as e:
+                errors.append(f"sharded client{tid}: {e!r}")
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(N_CLIENTS)]
+        for t in threads:
+            t.start()
+        assert kill_at.wait(timeout=600), "traffic never reached kill point"
+        with sh0._cv:
+            victim = next(r for r in sh0._replicas.values() if r.alive)
+        print(f"killing {victim.label} (pid {victim.proc.pid}) in shard 0 "
+              f"mid-stream")
+        victim.proc.send_signal(signal.SIGKILL)
+        for t in threads:
+            t.join(900)
+        if any(t.is_alive() for t in threads):
+            errors.append("sharded: clients never finished")
+        deadline = time.monotonic() + 120
+        while sh0.alive_replicas() < 2 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        if sh0.alive_replicas() < 2:
+            errors.append("sharded: shard 0 never respawned to strength")
+        if sh1._respawned != 0:
+            errors.append("sharded: the SIBLING shard respawned — the "
+                          "death leaked across the shard boundary")
+        with sh0._cv:
+            respawns = [lab for lab in sh0._replicas if "respawn" in lab]
+        if not respawns or not all(lab.startswith("s0:")
+                                   for lab in respawns):
+            errors.append(f"sharded: respawn labels {respawns} not owned "
+                          f"by shard 0")
+        # routing still pure-hash after the respawn
+        for tid in range(N_CLIENTS):
+            k = shard_of("a", f"smoke{tid}", 2)
+            out = fleet.predict("a", Xq, tenant=f"smoke{tid}", timeout=600)
+            if not np.array_equal(out, ref["a"]):
+                errors.append(f"sharded post-respawn tenant smoke{tid} "
+                              f"(shard {k}): WRONG BITS")
+    total = N_CLIENTS * per_client
+    if done[0] != total:
+        errors.append(f"sharded: lost {total - done[0]} of {total} "
+                      f"requests")
+    if not errors:
+        print(f"sharded leg OK: {done[0]}/{total} requests bitwise "
+              f"through a shard-0 replica kill; respawn stayed in-shard")
+    return errors
 
 
 def main() -> int:
@@ -208,6 +293,8 @@ def main() -> int:
     print(f"fleet smoke: {done}/{total} requests completed through a "
           f"replica kill; p50={p50 * 1e3:.1f}ms p99={p99 * 1e3:.1f}ms; "
           f"fleet back at {respawned}/{n_replicas} replicas")
+    errors.extend(sharded_leg(paths, Xq, ref, workdir,
+                              max(4, per_client // 2)))
     if errors:
         print(f"FAIL: {errors[:5]}", file=sys.stderr)
         return 1

@@ -1,0 +1,287 @@
+"""Sharded serving front-end: routing invariants, parity, and the
+per-shard reliability contract (docs/serving.md "Sharded topology").
+
+Fast tier pins the pure pieces — the shard hash (stability, tenant/model
+sensitivity, spread), FleetConfig validation, and native-vs-Python wire
+reader parity on a socketpair.  The slow multi-process tests pin the
+contracts the sharding must not bend: same tenant/model routes to the
+same shard across respawns, a sharded fleet is bitwise-identical to a
+1-shard fleet, and a SIGKILL'd replica's in-flight window-1 batch
+requeues within its OWN shard's replica group (the sibling shard never
+sees a respawn).
+"""
+import os
+import signal
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xtb
+from xgboost_tpu.reliability import faults
+from xgboost_tpu.serving import ServeConfig, ServingEngine, ServingFleet
+from xgboost_tpu.serving import wire
+from xgboost_tpu.serving.fleet import FleetConfig, shard_of
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _train(seed=0, n=400, f=8, rounds=5, depth=4):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    bst = xtb.train({"objective": "binary:logistic", "max_depth": depth,
+                     "seed": seed}, xtb.DMatrix(X, label=y), rounds,
+                    verbose_eval=False)
+    return bst, X
+
+
+# =========================================================================
+# shard_of: the routing contract
+
+
+def test_shard_of_stable_and_key_sensitive():
+    # pure function of (tenant, model, n): identical across calls and
+    # processes — which is WHY routing survives respawns
+    assert shard_of("m", "t1", 4) == shard_of("m", "t1", 4)
+    assert shard_of("m", "t1", 4) == zlib.crc32(b"t1\x00m") % 4
+    # tenant and model are both part of the key
+    keys = {(m, t): shard_of(m, t, 8)
+            for m in ("a", "b") for t in ("t1", "t2", None)}
+    assert len(set(keys.values())) > 1
+    # None tenant and "" tenant collapse to the same key (the header
+    # omits tenant entirely for both)
+    assert shard_of("m", None, 8) == shard_of("m", "", 8)
+    # n=1 is always shard 0 (the unsharded fleet's degenerate case)
+    assert all(shard_of("m", f"t{i}", 1) == 0 for i in range(16))
+
+
+def test_shard_of_spreads():
+    hits = {shard_of("m", f"tenant{i}", 4) for i in range(64)}
+    assert hits == {0, 1, 2, 3}
+
+
+def test_fleet_config_shard_validation(monkeypatch):
+    assert FleetConfig(n_replicas=4, n_shards=2).n_shards == 2
+    with pytest.raises(ValueError, match="divisible"):
+        FleetConfig(n_replicas=3, n_shards=2)
+    with pytest.raises(ValueError, match="n_shards"):
+        FleetConfig(n_replicas=4, n_shards=-1)
+    # env default resolution (n_shards=0 = "use the env, default 1")
+    monkeypatch.setenv("XGBOOST_TPU_FLEET_SHARDS", "2")
+    assert FleetConfig(n_replicas=4).n_shards == 2
+    monkeypatch.delenv("XGBOOST_TPU_FLEET_SHARDS")
+    assert FleetConfig(n_replicas=4).n_shards == 1
+
+
+# =========================================================================
+# native wire reader: parity with the pure-Python path
+
+
+def _send_frame_raw(sock, header: bytes, payload: bytes,
+                    corrupt: bool = False):
+    crc = zlib.crc32(payload, zlib.crc32(header))
+    if corrupt:
+        crc ^= 0xFF
+    sock.sendall(struct.pack("<IQI", len(header), len(payload), crc)
+                 + header + payload)
+
+
+def test_native_reader_parity_with_python():
+    """Both readers decode the same frames to the same bytes; the native
+    path engages only on blocking sockets (the fleet's own config)."""
+    lib_loaded = wire._native_lib() is not None
+    payload = os.urandom(4096)
+    for native in (True, False):
+        a, b = socket.socketpair()
+        try:
+            if not native:
+                b.settimeout(60)  # timeout => Python buffered reader
+            rd = wire.reader(b)
+            is_native = isinstance(rd, wire._NativeReader)
+            assert is_native == (native and lib_loaded)
+            _send_frame_raw(a, b'{"op": "x", "id": 7}', payload)
+            hdr, body = wire.recv_frame(rd)
+            assert hdr == {"op": "x", "id": 7}
+            assert bytes(body) == payload
+        finally:
+            a.close()
+            b.close()
+
+
+@pytest.mark.skipif(wire._native_lib() is None,
+                    reason="native wire library unavailable")
+def test_native_reader_crc_and_kill_switch(monkeypatch):
+    a, b = socket.socketpair()
+    try:
+        rd = wire.reader(b)
+        assert isinstance(rd, wire._NativeReader)
+        _send_frame_raw(a, b'{"op": "x"}', b"abc", corrupt=True)
+        with pytest.raises(wire.WireCorruptError):
+            wire.recv_frame(rd)
+    finally:
+        a.close()
+        b.close()
+    # the kill switch forces the Python reader for new connections
+    monkeypatch.setenv("XGBOOST_TPU_WIRE_NATIVE", "0")
+    monkeypatch.setattr(wire, "_NATIVE", None)
+    a, b = socket.socketpair()
+    try:
+        assert not isinstance(wire.reader(b), wire._NativeReader)
+    finally:
+        a.close()
+        b.close()
+        monkeypatch.setattr(wire, "_NATIVE", None)
+
+
+@pytest.mark.skipif(wire._native_lib() is None,
+                    reason="native wire library unavailable")
+def test_native_crc32_matches_zlib():
+    import ctypes
+
+    from xgboost_tpu.utils.native import load_wire
+
+    lib = load_wire()
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 7, 8, 9, 4096, 65537):
+        blob = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+        c_buf = (ctypes.c_ubyte * max(1, len(blob))).from_buffer_copy(
+            blob or b"\x00")
+        assert lib.xtb_wire_crc32(0, c_buf, len(blob)) == zlib.crc32(blob)
+        # rolling: split at an odd offset
+        k = n // 3
+        part = lib.xtb_wire_crc32(0, c_buf, k)
+        c_rest = (ctypes.c_ubyte * max(1, n - k)).from_buffer_copy(
+            blob[k:] or b"\x00")
+        assert lib.xtb_wire_crc32(part, c_rest, n - k) == zlib.crc32(blob)
+
+
+# =========================================================================
+# multi-process: sharded fleet contracts
+
+
+@pytest.fixture(scope="module")
+def shard_models(tmp_path_factory):
+    d = tmp_path_factory.mktemp("shard_models")
+    bst, X = _train(seed=21, f=8, rounds=5, depth=4)
+    p = str(d / "a.json")
+    bst.save_model(p)
+    eng = ServingEngine(ServeConfig(use_batcher=False))
+    eng.add_model("a", p)
+    ref = eng.predict("a", X, direct=True)
+    eng.close()
+    return {"a": p, "X": X, "ref": ref}
+
+
+@pytest.mark.slow
+def test_sharded_bitwise_parity_and_routing(shard_models, tmp_path):
+    """A 2-shard fleet answers bitwise-identically to a 1-shard fleet
+    for every tenant, and each (tenant, model) key's requests land on
+    exactly the shard_of shard (pinned via the per-shard request
+    counters)."""
+    X = shard_models["X"]
+    ref = shard_models["ref"]
+    cache = str(tmp_path / "cache")
+    tenants = [f"t{i}" for i in range(6)] + [None]
+    with ServingFleet({"a": shard_models["a"]}, n_replicas=2, n_shards=1,
+                      cache_dir=cache, warmup_buckets=(64, 512)) as fleet:
+        single = {t: fleet.predict("a", X, tenant=t, timeout=120)
+                  for t in tenants}
+    with ServingFleet({"a": shard_models["a"]}, n_replicas=4, n_shards=2,
+                      cache_dir=cache, warmup_buckets=(64, 512)) as fleet:
+        assert len(fleet._shards) == 2
+        assert fleet.alive_replicas() == 4
+        ins = fleet._ins
+        for t in tenants:
+            k = shard_of("a", t, 2)
+            before = ins.shard_requests.get(str(k))
+            other = ins.shard_requests.get(str(1 - k))
+            out = fleet.predict("a", X, tenant=t, timeout=120)
+            np.testing.assert_array_equal(out, ref)
+            np.testing.assert_array_equal(out, single[t])
+            assert ins.shard_requests.get(str(k)) > before
+            assert ins.shard_requests.get(str(1 - k)) == other
+        # shard-prefixed replica labels partition the registry
+        labels = sorted(r for sh in fleet._shards
+                        for r in sh._replicas)
+        assert all(lab.startswith(("s0:", "s1:")) for lab in labels)
+
+
+@pytest.mark.slow
+def test_sharded_kill_requeues_within_own_shard(shard_models, tmp_path):
+    """SIGKILL one shard's replica mid-stream: its in-flight window-1
+    batch requeues within its OWN shard's replica group (zero loss,
+    bitwise), the respawn carries the shard's label prefix, routing is
+    unchanged, and the sibling shard never respawns."""
+    X = shard_models["X"]
+    ref = shard_models["ref"]
+    # tenants pinned to shard 0 / shard 1 respectively
+    t0 = next(f"t{i}" for i in range(64) if shard_of("a", f"t{i}", 2) == 0)
+    t1 = next(f"t{i}" for i in range(64) if shard_of("a", f"t{i}", 2) == 1)
+    with ServingFleet({"a": shard_models["a"]}, n_replicas=4, n_shards=2,
+                      cache_dir=str(tmp_path / "cache"), max_respawns=2,
+                      warmup_buckets=(64, 512)) as fleet:
+        sh0, sh1 = fleet._shards
+        np.testing.assert_array_equal(
+            fleet.predict("a", X, tenant=t0, timeout=120), ref)
+        with sh0._cv:
+            victim = next(r for r in sh0._replicas.values()
+                          if r.alive and r.proc is not None)
+        futs = [fleet.submit("a", X, tenant=t0) for _ in range(6)]
+        victim.proc.send_signal(signal.SIGKILL)
+        for fut in futs:  # zero dropped, bitwise
+            np.testing.assert_array_equal(fut.result(timeout=120), ref)
+        deadline = time.monotonic() + 120
+        while (sh0.alive_replicas() < 2 and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert sh0.alive_replicas() == 2
+        assert sh0._respawned == 1 and sh1._respawned == 0
+        with sh0._cv:
+            respawn = [lab for lab in sh0._replicas
+                       if "respawn" in lab]
+        assert respawn and all(lab.startswith("s0:") for lab in respawn)
+        # routing unchanged across the respawn: the same tenants still
+        # land on the same shards (pure hash, no rebalancing)
+        for tenant, shard in ((t0, sh0), (t1, sh1)):
+            before = fleet._ins.shard_requests.get(shard._shard_label)
+            np.testing.assert_array_equal(
+                fleet.predict("a", X, tenant=tenant, timeout=120), ref)
+            assert (fleet._ins.shard_requests.get(shard._shard_label)
+                    > before)
+
+
+@pytest.mark.slow
+def test_sharded_lifecycle_broadcast_every_shard(shard_models, tmp_path):
+    """Version lifecycle ops fan out: every shard loads/activates, and
+    the sharded answer tracks the active version for every tenant."""
+    from xgboost_tpu.serving import ModelStore
+
+    store = ModelStore(str(tmp_path / "store"))
+    bst, X = _train(seed=21, f=8, rounds=5, depth=4)
+    store.publish("a", bst)
+    store.set_active("a", 1)
+    cont = xtb.train(dict(bst.params), xtb.DMatrix(
+        X, label=(X[:, 0] > 0).astype(np.float32)), 2,
+        verbose_eval=False, xgb_model=bst)
+    store.publish("a", cont)
+    with ServingFleet(store_dir=store.dir, n_replicas=4, n_shards=2,
+                      cache_dir=str(tmp_path / "cache"),
+                      warmup_buckets=(64, 512)) as fleet:
+        v1 = {t: fleet.predict("a", X, tenant=t, timeout=120)
+              for t in ("t0", "t1", "t2", "t3")}
+        acks = fleet.load_version("a", 2)
+        assert len(acks) == 4  # every replica in every shard acked
+        fleet.activate_version("a", 2)
+        assert fleet.active_version("a") == 2
+        for t, old in v1.items():
+            new = fleet.predict("a", X, tenant=t, timeout=120)
+            assert not np.array_equal(new, old)

@@ -856,19 +856,23 @@ _DEGRADED_P99_CAP_S = 8.0
 
 
 def _run_fleet_degraded(workdir: str) -> dict:
-    """The fleet on a gray network: replica0's frames arrive late
-    (seeded per-frame jitter at the dispatcher's ``wire.recv`` seam),
-    replica1 goes half-open (its frames — pongs included — vanish
-    inbound while its rx direction stays up).  Driver-side seams only,
-    like the ``fleet`` scenario.  The contract: every request completes
-    with exact bits (twin=True digest), the EWMA breaker ejects the
-    laggard and readmits it after cooldown, the liveness ladder (no
-    pong AND no frame) declares the half-open replica and the respawn
-    restores strength, and the p99 stays bounded by detection budgets
-    (docs/reliability.md "Degraded networks")."""
+    """A 2-SHARD fleet on a gray network: shard 0's first replica
+    (``s0:replica0``) sees late frames (seeded per-frame jitter at its
+    shard's ``wire.recv`` seam), shard 1's first replica
+    (``s1:replica0``) goes half-open (its frames — pongs included —
+    vanish inbound while its rx direction stays up).  Driver-side seams
+    only, like the ``fleet`` scenario.  The contract: every request on
+    BOTH shards completes with exact bits (twin=True digest — traffic
+    alternates shard-pinned tenants), shard 0's EWMA breaker ejects the
+    laggard and readmits it after cooldown, shard 1's liveness ladder
+    (no pong AND no frame) declares the half-open replica and the
+    respawn restores strength WITHIN shard 1 — each shard's
+    degraded-network plane acts on its own state, neither disturbs the
+    other (docs/reliability.md "Degraded networks", docs/serving.md
+    "Sharded topology")."""
     import numpy as np
 
-    from ..serving.fleet import FleetConfig, ServingFleet
+    from ..serving.fleet import FleetConfig, ServingFleet, shard_of
 
     plan = faults.active()
     cuts = sum(1 for s in (plan.specs if plan else [])
@@ -878,7 +882,8 @@ def _run_fleet_degraded(workdir: str) -> dict:
                                "closed")
     hedges0 = _counter_total("xtb_net_hedges_total")
     bst, Q = _fleet_fixture()
-    cfg = FleetConfig(n_replicas=2, max_respawns=4, nthread_per_replica=1,
+    cfg = FleetConfig(n_replicas=4, n_shards=2, max_respawns=4,
+                      nthread_per_replica=1,
                       cache_dir=os.path.join(
                           tempfile.gettempdir(), "xtb_chaos_warm"),
                       heartbeat_s=0.25,
@@ -886,6 +891,11 @@ def _run_fleet_degraded(workdir: str) -> dict:
                       breaker_latency_s=_DEGRADED_BREAKER_S,
                       breaker_cooldown_s=0.5,
                       hedge_quantile=0.9, hedge_min_s=0.05)
+    # deterministic shard-pinned tenants: request i alternates shards,
+    # so the SAME i maps to the same (tenant, rows) in base and replay
+    # passes — the digest and extras_match_base contracts need that
+    tenant_for = [next(t for t in (f"g{j}" for j in range(64))
+                       if shard_of("m", t, 2) == k) for k in (0, 1)]
     outs: List[bytes] = []
     lats: List[float] = []
     with ServingFleet({"m": bst}, cfg) as fleet:
@@ -895,7 +905,8 @@ def _run_fleet_degraded(workdir: str) -> dict:
             t = time.monotonic()
             # predict() raising = a dropped request = a red episode
             outs.append(np.ascontiguousarray(
-                fleet.predict("m", rows, timeout=180), np.float32
+                fleet.predict("m", rows, tenant=tenant_for[i % 2],
+                              timeout=180), np.float32
             ).tobytes())
             lats.append(time.monotonic() - t)
 
@@ -1222,18 +1233,20 @@ SCENARIOS: Dict[str, Scenario] = {
     "fleet_degraded": Scenario(
         name="fleet_degraded",
         catalog=(
-            # driver-side seams only (like `fleet`): the dispatcher's rx
-            # path for replica0 jitters, replica1's inbound frames —
-            # pongs included — vanish.  The rank filters are disjoint,
-            # so neither spec starves the other's invocations
+            # driver-side seams only (like `fleet`), on a 2-SHARD fleet:
+            # shard 0's rx path for its first replica jitters, shard 1's
+            # first replica's inbound frames — pongs included — vanish.
+            # The rank filters are disjoint (full shard-prefixed
+            # labels), so neither spec starves the other's invocations,
+            # and each shard's degradation plane is exercised alone
             CatalogEntry("wire.recv", "latency",
-                         {"rank": ["replica0"], "seconds": (0.3, 0.6),
+                         {"rank": ["s0:replica0"], "seconds": (0.3, 0.6),
                           "times": [3, 4, 5],
                           "jitter_seed": (0, 1 << 16)}),
             CatalogEntry("wire.recv", "blackhole_rx",
-                         {"rank": ["replica1"], "times": [40]}),
+                         {"rank": ["s1:replica0"], "times": [40]}),
             CatalogEntry("wire.frame", "throttle",
-                         {"rank": ["replica0"],
+                         {"rank": ["s0:replica0"],
                           "bytes_per_s": (1e5, 4e5), "times": [2, 4]}),
         ),
         run=_run_fleet_degraded, check=_check_fleet_degraded, twin=True,

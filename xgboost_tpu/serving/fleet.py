@@ -1,7 +1,20 @@
-"""Serving fleet: N replica processes behind one dispatcher.
+"""Serving fleet: N replica processes behind one (or N sharded) dispatchers.
 
 The multi-process scale-out layer over :class:`ServingEngine`
 (docs/serving.md "Fleet" has the full topology/tuning guide):
+
+**Sharding** (``n_shards`` > 1, docs/serving.md "Sharded topology"):
+past ~4 replicas a single dispatcher thread + one big cv lock becomes
+the ceiling, so the fleet splits into shared-nothing shards.  Each shard
+is a full single-shard fleet — its own listener socket, DispatchQueue,
+cv lock, rx threads, heartbeat/breaker/hedge state, and replica group
+(labels prefixed ``s{k}:``) — while the mmap ModelStore, the warm
+compile cache, the AIMD/brownout governor, and the telemetry registry
+stay shared (per-shard series carry a ``shard=`` label).  The front-end
+object routes ``submit`` by a stable hash of (tenant, model)
+(:func:`shard_of`) and fans admin/lifecycle calls out to every shard;
+every reliability semantic below holds *per shard* (a killed replica's
+window-1 batch requeues within its own shard's replica group).
 
 - **Replicas** are launcher-spawned subprocesses (``serving/replica.py``)
   sharing the mmap :class:`ModelStore` (one host copy of every booster)
@@ -93,6 +106,7 @@ import tempfile
 import threading
 import time
 import warnings
+import zlib
 from collections import deque
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeout
@@ -142,6 +156,27 @@ def _frame_budget_s() -> Optional[float]:
     except ValueError:
         return 120.0
     return v if v > 0 else None
+
+
+# default dispatcher shard count when FleetConfig.n_shards is 0 ("auto"):
+# one shard preserves the classic single-dispatcher topology exactly
+SHARDS_ENV = "XGBOOST_TPU_FLEET_SHARDS"
+# SO_REUSEPORT accept path for sharded fleets: every shard binds the SAME
+# port and an accepted replica connection is handed to its owning shard by
+# hello-label prefix.  Default off — per-shard listener ports need no
+# kernel support and no cross-shard handoff.
+REUSEPORT_ENV = "XGBOOST_TPU_FLEET_REUSEPORT"
+
+
+def shard_of(model: str, tenant: Optional[str], n_shards: int) -> int:
+    """Client-side partition for the sharded front-end: which dispatcher
+    shard owns (tenant, model) traffic.  A pure hash of the routing key —
+    no registry, no state — so the SAME tenant/model pair lands on the
+    SAME shard across respawns, restarts, and processes (the routing
+    contract docs/serving.md pins and tests/test_fleet_shards.py
+    enforces)."""
+    key = f"{tenant or ''}\x00{model}".encode()
+    return zlib.crc32(key) % max(1, int(n_shards))
 
 
 def _ks_stat(a: np.ndarray, b: np.ndarray) -> float:
@@ -246,10 +281,38 @@ class FleetConfig:
     breaker_cooldown_s: float = 2.0   # open -> half-open probe delay
     hedge_quantile: float = 0.0       # latency quantile (0 = no hedging)
     hedge_min_s: float = 0.01         # hedge budget floor
+    # --- sharded front-end (docs/serving.md "Sharded topology"):
+    # n_shards > 1 splits the fleet into shared-nothing dispatcher shards,
+    # each owning n_replicas/n_shards replicas, its own listener, queue,
+    # rx threads, and degraded-network state; submit() routes by
+    # hash(tenant, model).  0 = XGBOOST_TPU_FLEET_SHARDS (default 1).
+    n_shards: int = 0
+    # None = XGBOOST_TPU_FLEET_REUSEPORT (default off): shards share one
+    # SO_REUSEPORT listening port instead of per-shard ports
+    reuseport: Optional[bool] = None
 
     def __post_init__(self) -> None:
+        if self.n_shards == 0:
+            raw = os.environ.get(SHARDS_ENV, "").strip()
+            try:
+                self.n_shards = int(raw) if raw else 1
+            except ValueError:
+                self.n_shards = 1
+        if self.reuseport is None:
+            self.reuseport = os.environ.get(
+                REUSEPORT_ENV, "").strip().lower() not in (
+                    "", "0", "false", "off", "no")
         if self.n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        if self.n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        if self.n_replicas % self.n_shards:
+            # n_replicas is the fleet TOTAL; every shard owns an equal
+            # replica group (uneven groups would skew both the routing
+            # contract and the saturation math)
+            raise ValueError(
+                f"n_replicas ({self.n_replicas}) must be divisible by "
+                f"n_shards ({self.n_shards})")
         if self.max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         if not 0.0 <= self.hedge_quantile < 1.0:
@@ -362,6 +425,24 @@ class _Instruments:
         self.label_frames = reg.counter(
             "xtb_net_label_frames_total",
             "op=\"label\" frames received over label-feed connections")
+        # --- sharded front-end series (docs/serving.md "Sharded
+        # topology"): per-shard throughput + rx-loop occupancy, labeled by
+        # owning dispatcher shard ("0" on an unsharded fleet)
+        self.shards = reg.gauge(
+            "xtb_fleet_shards", "configured dispatcher shards")
+        self.shard_requests = reg.counter(
+            "xtb_fleet_shard_requests_total",
+            "predict requests dispatched, by owning dispatcher shard",
+            ("shard",))
+        self.shard_rows = reg.counter(
+            "xtb_fleet_shard_rows_total",
+            "payload rows dispatched, by owning dispatcher shard",
+            ("shard",))
+        self.shard_rx_busy = reg.counter(
+            "xtb_fleet_shard_rx_busy_seconds_total",
+            "rx-loop seconds spent processing received frames (vs "
+            "blocked waiting for one), by dispatcher shard — busy/wall "
+            "is the shard's rx occupancy fraction", ("shard",))
 
     @classmethod
     def get(cls) -> "_Instruments":
@@ -662,7 +743,7 @@ class ServingFleet:
         # (the merged /metrics view and the postmortem dump read these)
         self._telemetry: Dict[str, dict] = {}
         self._flight_rings: Dict[str, list] = {}
-        self.flight_dumps: Dict[str, str] = {}
+        self._flight_dumps: Dict[str, str] = {}
         # label -> reason for every replica that quarantined itself after
         # a failed arena verification (retained after death, like the
         # telemetry above — the postmortem surface)
@@ -695,6 +776,22 @@ class ServingFleet:
         self._sched_thread: Optional[threading.Thread] = None
         self._store_dir: Optional[str] = None
         self._tmp_store = False
+        # --- sharded front-end state (docs/serving.md "Sharded
+        # topology").  With n_shards > 1 THIS instance becomes a pure
+        # router: start() builds one single-shard sibling ServingFleet
+        # per shard (each with its own listener, queue, cv, rx threads,
+        # and degraded-network state — shared-nothing by construction;
+        # the store/cache dirs and the telemetry registry stay shared)
+        # and submit() routes by shard_of(model, tenant).  The list is
+        # immutable once start() returns, so routing reads it lock-free.
+        self._shards: Optional[List["ServingFleet"]] = None
+        self._label_prefix = ""     # "s<k>:" on a shard, "" unsharded
+        self._shard_label = "0"     # {shard=} label on per-shard series
+        self._ext_listener: Optional[Socket] = None  # pre-bound listener
+        # SO_REUSEPORT accept path: label-prefix -> owning shard, shared
+        # by every sibling so an accept landing on the wrong shard's
+        # listener hands the connection to its owner
+        self._shard_peers: Optional[Dict[str, "ServingFleet"]] = None
 
     # ---------------------------------------------------------------- start
     def start(self) -> "ServingFleet":
@@ -702,6 +799,8 @@ class ServingFleet:
 
         from .modelstore import ModelStore
 
+        if self.config.n_shards > 1:
+            return self._start_sharded()
         with self._cv:
             if self._started:
                 return self
@@ -742,9 +841,11 @@ class ServingFleet:
                               f"implicitly latest-tracking")
             for name, version in store.serving_entries():
                 self._versions[name] = version
-        listener = socketlib.socket()
-        listener.bind(("127.0.0.1", 0))
-        listener.listen(max(8, self.config.n_replicas * 2))
+        listener = self._ext_listener
+        if listener is None:
+            listener = socketlib.socket()
+            listener.bind(("127.0.0.1", 0))
+            listener.listen(max(8, self.config.n_replicas * 2))
         with self._cv:
             self._listener = listener
         accept = threading.Thread(target=self._accept_loop, daemon=True,
@@ -755,7 +856,7 @@ class ServingFleet:
             self._accept_thread = accept
             self._sched_thread = sched
         for i in range(self.config.n_replicas):
-            self._spawn(f"replica{i}")
+            self._spawn(f"{self._label_prefix}replica{i}")
         accept.start()
         sched.start()
         deadline = time.monotonic() + self.config.ready_timeout_s
@@ -776,6 +877,98 @@ class ServingFleet:
                 f"fleet start: only {ready}/{self.config.n_replicas} "
                 f"replicas became ready within "
                 f"{self.config.ready_timeout_s}s", failures)
+        with self._cv:
+            self._bringup_done = True
+        return self
+
+    def _start_sharded(self) -> "ServingFleet":
+        """Bring up the shared-nothing sharded topology: publish the
+        models ONCE into the (shared) store, then build and start one
+        single-shard sibling fleet per shard concurrently.  Each sibling
+        owns its replica group end to end — listener, DispatchQueue,
+        heartbeat/breaker/hedge state, rx threads, its own cv lock — so
+        shards never contend on a shared dispatcher lock; only the mmap
+        store, the warm compile cache, the process-wide governor, and the
+        telemetry registry (per-shard series separated by the ``shard=``
+        label and shard-prefixed replica labels) are shared."""
+        import socket as socketlib
+
+        from .modelstore import ModelStore
+
+        cfg = self.config
+        with self._cv:
+            if self._started:
+                return self
+            self._started = True
+            self._store_dir = cfg.store_dir
+            if self._store_dir is None:
+                self._store_dir = tempfile.mkdtemp(prefix="xtb_fleet_store_")
+                self._tmp_store = True
+        store = ModelStore(self._store_dir)
+        for name, source in self._models.items():
+            store.publish(name, source)
+        if not store.entries():
+            raise ValueError("fleet has no models: pass models= or a "
+                             "pre-populated store_dir=")
+        try:
+            store.commit_active()
+        except OSError as e:
+            warnings.warn(f"model store {self._store_dir} is not "
+                          f"writable ({e}); serving versions stay "
+                          f"implicitly latest-tracking")
+        n = cfg.n_shards
+        listeners: Optional[List[Socket]] = None
+        if cfg.reuseport and hasattr(socketlib, "SO_REUSEPORT"):
+            # every shard listens on ONE shared port: the kernel spreads
+            # incoming replica connections across the shard listeners,
+            # and an accept that lands on the wrong shard is handed to
+            # its owner by hello-label prefix (_accept_loop)
+            listeners = []
+            port = 0
+            for _ in range(n):
+                s = socketlib.socket()
+                s.setsockopt(socketlib.SOL_SOCKET,
+                             socketlib.SO_REUSEPORT, 1)
+                s.bind(("127.0.0.1", port))
+                port = s.getsockname()[1]
+                s.listen(max(8, cfg.n_replicas * 2))
+                listeners.append(s)
+        shards: List[ServingFleet] = []
+        for k in range(n):
+            sub = dataclasses.replace(
+                cfg, n_shards=1, n_replicas=cfg.n_replicas // n,
+                store_dir=self._store_dir)
+            shard = ServingFleet(None, sub)
+            shard._label_prefix = f"s{k}:"
+            shard._shard_label = str(k)
+            if listeners is not None:
+                shard._ext_listener = listeners[k]
+            shards.append(shard)
+        if listeners is not None:
+            peers = {f"s{k}": shards[k] for k in range(n)}
+            for shard in shards:
+                shard._shard_peers = peers
+        with self._cv:
+            self._shards = shards
+        self._ins.shards.set(float(n))
+        errs: List[BaseException] = []
+
+        def _boot(shard: "ServingFleet") -> None:
+            try:
+                shard.start()
+            except BaseException as e:  # surfaced to the caller below
+                errs.append(e)
+
+        threads = [threading.Thread(target=_boot, args=(s,), daemon=True,
+                                    name=f"xtb-fleet-boot-s{i}")
+                   for i, s in enumerate(shards)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            self.close()
+            raise errs[0]
         with self._cv:
             self._bringup_done = True
         return self
@@ -862,58 +1055,74 @@ class ServingFleet:
                 _note_os(e, "fleet.handshake")
                 sock.close()
                 continue
-            rx = threading.Thread(target=self._rx_loop, args=(label, sock),
-                                  daemon=True, name=f"xtb-fleet-rx-{label}")
-            with self._cv:
-                rep = self._replicas.get(label)
-                if rep is None or self._closed:
-                    sock.close()
-                    continue
-                rep.sock = sock
-                rep.rx = rx
-                rep.ready_info = ready
-                rep.alive = True
-                # liveness baseline: the ready frame is frame zero, so a
-                # replica that acks ready and then never answers anything
-                # trips the heartbeat deadline instead of coasting to the
-                # global one; last_ping = now delays the first ping by one
-                # full heartbeat period
-                rep.last_rx = rep.last_ping = time.monotonic()
-                self._ins.breaker_state.labels(label).set(0.0)
-                # version resync for RESPAWNS: the replica read the
-                # manifest's active versions at process startup, which may
-                # predate an activate committed while it was warming up
-                # (spawn -> set_active -> broadcast that skipped the
-                # not-yet-ready respawn).  Idempotent activate frames,
-                # dispatched ahead of any traffic, bring it to the fleet's
-                # view; when the replica already serves that version this
-                # is a no-op pin.  Initial bring-up needs none of this:
-                # start() returns only after every replica is ready, so no
-                # activate can precede an initial replica's manifest read.
-                for name, version in (self._versions.items()
-                                      if self._bringup_done else ()):
-                    rid = next(self._next_id)
-                    rep.ctrl.append(_Request(
-                        rid, name, {"op": "activate", "model": name,
-                                    "version": int(version), "id": rid},
-                        b"", self.config.default_slo))
-                # feedback-capture resync, same contract as the version
-                # resync above: a respawn that missed the sample broadcast
-                # converges to the fleet's configured rate
-                for name, every in (self._sampling.items()
-                                    if self._bringup_done else ()):
-                    rid = next(self._next_id)
-                    rep.ctrl.append(_Request(
-                        rid, name, {"op": "sample", "model": name,
-                                    "every": int(every), "id": rid},
-                        b"", self.config.default_slo))
-                self._ins.replicas.set(
-                    sum(1 for r in self._replicas.values() if r.alive))
-                self._cv.notify_all()
-            self._ins.coldstart.labels(
-                ready.get("cache_state", "cold")).observe(
-                float(ready.get("warmup_s", 0.0)))
-            rx.start()
+            # SO_REUSEPORT accept path: the kernel may spread replica
+            # connections across the shard listeners, so the one that
+            # landed here can belong to a sibling — the hello label's
+            # shard prefix names the owner; registration happens there,
+            # under the OWNER's cv
+            owner = self
+            if self._shard_peers is not None and ":" in label:
+                owner = self._shard_peers.get(label.split(":", 1)[0], self)
+            owner._register_replica(label, sock, ready)
+
+    def _register_replica(self, label: str, sock, ready: dict) -> None:
+        """Adopt one post-handshake replica connection: bookkeeping,
+        respawn resync control frames, rx thread.  Factored out of
+        :meth:`_accept_loop` because under the SO_REUSEPORT accept path
+        the accepting thread may be a sibling shard's — every mutation
+        here is under THIS shard's cv, whichever thread runs it."""
+        rx = threading.Thread(target=self._rx_loop, args=(label, sock),
+                              daemon=True, name=f"xtb-fleet-rx-{label}")
+        with self._cv:
+            rep = self._replicas.get(label)
+            if rep is None or self._closed:
+                sock.close()
+                return
+            rep.sock = sock
+            rep.rx = rx
+            rep.ready_info = ready
+            rep.alive = True
+            # liveness baseline: the ready frame is frame zero, so a
+            # replica that acks ready and then never answers anything
+            # trips the heartbeat deadline instead of coasting to the
+            # global one; last_ping = now delays the first ping by one
+            # full heartbeat period
+            rep.last_rx = rep.last_ping = time.monotonic()
+            self._ins.breaker_state.labels(label).set(0.0)
+            # version resync for RESPAWNS: the replica read the
+            # manifest's active versions at process startup, which may
+            # predate an activate committed while it was warming up
+            # (spawn -> set_active -> broadcast that skipped the
+            # not-yet-ready respawn).  Idempotent activate frames,
+            # dispatched ahead of any traffic, bring it to the fleet's
+            # view; when the replica already serves that version this
+            # is a no-op pin.  Initial bring-up needs none of this:
+            # start() returns only after every replica is ready, so no
+            # activate can precede an initial replica's manifest read.
+            for name, version in (self._versions.items()
+                                  if self._bringup_done else ()):
+                rid = next(self._next_id)
+                rep.ctrl.append(_Request(
+                    rid, name, {"op": "activate", "model": name,
+                                "version": int(version), "id": rid},
+                    b"", self.config.default_slo))
+            # feedback-capture resync, same contract as the version
+            # resync above: a respawn that missed the sample broadcast
+            # converges to the fleet's configured rate
+            for name, every in (self._sampling.items()
+                                if self._bringup_done else ()):
+                rid = next(self._next_id)
+                rep.ctrl.append(_Request(
+                    rid, name, {"op": "sample", "model": name,
+                                "every": int(every), "id": rid},
+                    b"", self.config.default_slo))
+            self._ins.replicas.set(
+                sum(1 for r in self._replicas.values() if r.alive))
+            self._cv.notify_all()
+        self._ins.coldstart.labels(
+            ready.get("cache_state", "cold")).observe(
+            float(ready.get("warmup_s", 0.0)))
+        rx.start()
 
     # ------------------------------------------------------------ rx per rep
     def _rx_loop(self, label: str, sock) -> None:
@@ -922,7 +1131,16 @@ class ServingFleet:
         # dispatcher was profiled at ~ms of convoy per request
         stream = wire.reader(sock)
         budget = _frame_budget_s()
+        # rx occupancy: seconds spent PROCESSING frames vs blocked in
+        # recv, accumulated per dispatcher shard.  busy/wall is the
+        # shard's rx-loop busy fraction — the saturation bench reads it
+        # to prove the dispatcher (not the load generator or replicas)
+        # is/isn't the ceiling (docs/observability.md).
+        busy = self._ins.shard_rx_busy.labels(self._shard_label)
+        t_resume = 0.0
         while True:
+            if t_resume:
+                busy.inc(time.monotonic() - t_resume)
             try:
                 header, payload = wire.recv_frame(stream, budget_s=budget,
                                                   peer=label)
@@ -938,6 +1156,7 @@ class ServingFleet:
                                    replica=label)
                 self._on_replica_death(label, e)
                 return
+            t_resume = time.monotonic()
             rep_rx = self._replicas.get(label)
             if rep_rx is not None:
                 # any frame proves the replica end-to-end alive: stamp the
@@ -1284,7 +1503,7 @@ class ServingFleet:
             self._pump()  # the requeued request goes to a live replica now
         if respawn:
             self._ins.respawns.inc()
-            self._spawn(f"respawn{n}")
+            self._spawn(f"{self._label_prefix}respawn{n}")
         elif not self._alive_or_pending():
             # fleet extinct: nothing will ever drain the queue — fail what
             # is queued AND mark the fleet so later submits fail fast
@@ -1322,8 +1541,21 @@ class ServingFleet:
             _resources.note_os_error(e, "fleet.flight_dump")
             return None       # block the death path
         with self._cv:
-            self.flight_dumps[label] = path
+            self._flight_dumps[label] = path
         return path
+
+    @property
+    def flight_dumps(self) -> Dict[str, str]:
+        """label -> postmortem path for every dead replica; on a sharded
+        fleet, merged across shards (prefixed labels never collide)."""
+        if self._shards is not None:
+            out: Dict[str, str] = {}
+            for sh in self._shards:
+                with sh._cv:
+                    out.update(sh._flight_dumps)
+            return out
+        with self._cv:
+            return dict(self._flight_dumps)
 
     def _alive_or_pending(self) -> bool:
         with self._cv:
@@ -1423,6 +1655,13 @@ class ServingFleet:
                                 peer=rep.label)
             if req.header.get("op") == "predict":
                 self._ins.requests.labels(req.model).inc()
+                # per-shard throughput attribution: the bench divides
+                # Δrows by wall to report rows/s per dispatcher shard
+                self._ins.shard_requests.labels(self._shard_label).inc()
+                shape = req.header.get("shape")
+                if shape:
+                    self._ins.shard_rows.labels(self._shard_label).inc(
+                        float(shape[0]))
                 if _trace.active() and req.header.get("trace"):
                     # queue-time bracket: submit -> on-the-wire (re-emitted
                     # per try when a reroute requeues the request)
@@ -1634,6 +1873,15 @@ class ServingFleet:
         or pre-encoded IPC bytes, forwarded untouched)."""
         if (X is None) == (arrow is None):
             raise ValueError("pass exactly one of X= or arrow=")
+        if self._shards is not None:
+            # sharded front-end: pure-hash client-side partitioning —
+            # the owning shard runs the WHOLE admission path (brownout,
+            # AIMD window, shed, shadow) against its own state
+            shard = self._shards[shard_of(model, tenant,
+                                          len(self._shards))]
+            return shard.submit(model, X, arrow=arrow, tenant=tenant,
+                                output_margin=output_margin,
+                                version=version)
         slo = self.config.resolve_slo(tenant)
         # resource-pressure brownout BEFORE any other work — including
         # the payload encode, which is exactly the CPU/memory cost a
@@ -1816,6 +2064,11 @@ class ServingFleet:
         pass — all while the incumbent keeps serving.  Returns per-replica
         acks carrying aot_hits/aot_compiled (a same-architecture
         continuation shows hits, not compiles)."""
+        if self._shards is not None:
+            trace = trace or self._broadcast_trace()
+            return [a for sh in self._shards
+                    for a in sh.load_version(model, version, timeout,
+                                             trace)]
         fields = {"op": "load", "model": model, "version": int(version)}
         if trace:
             fields["trace"] = trace
@@ -1834,6 +2087,15 @@ class ServingFleet:
         nothing is dropped, and no request observes a half-swap."""
         from .modelstore import ModelStore
 
+        if self._shards is not None:
+            # each shard runs the full commit-first sequence itself;
+            # set_active is idempotent under the manifest flock, and the
+            # per-shard _versions update keeps each shard's respawn
+            # resync frames correct
+            trace = trace or self._broadcast_trace()
+            return [a for sh in self._shards
+                    for a in sh.activate_version(model, version, timeout,
+                                                 trace)]
         ModelStore(self._store_dir).set_active(model, int(version))
         with self._cv:
             # fleet view moves WITH the durable commit, before the
@@ -1853,12 +2115,25 @@ class ServingFleet:
         rides each replica's serialized connection, so it executes only
         after every predict dispatched before it has drained; replicas
         refuse to retire the active version."""
+        if self._shards is not None:
+            trace = trace or self._broadcast_trace()
+            return [a for sh in self._shards
+                    for a in sh.retire_version(model, version, timeout,
+                                               trace)]
         fields = {"op": "retire", "model": model, "version": int(version)}
         if trace:
             fields["trace"] = trace
         return self._control_all(fields, timeout)
 
+    def _broadcast_trace(self) -> str:
+        """One trace id shared by a sharded broadcast's per-shard legs,
+        so lifecycle CycleReports and replica logs correlate the whole
+        fan-out as one operation."""
+        return f"ctrl-{os.getpid():x}-{next(self._next_id):x}"
+
     def active_version(self, model: str) -> Optional[int]:
+        if self._shards is not None:
+            return self._shards[0].active_version(model)
         with self._cv:
             return self._versions.get(model)
 
@@ -1871,11 +2146,19 @@ class ServingFleet:
         and dies — its in-flight batch reroutes and :attr:`quarantined`
         records the reason.  Riding the serialized connection means the
         scrub drains behind every predict dispatched before it."""
+        if self._shards is not None:
+            return [a for sh in self._shards
+                    for a in sh.scrub_replicas(timeout)]
         return self._control_all({"op": "scrub", "model": "*"}, timeout)
 
     def quarantined_replicas(self) -> Dict[str, str]:
         """label -> reason for every self-quarantined replica (retained
         after death)."""
+        if self._shards is not None:
+            out: Dict[str, str] = {}
+            for sh in self._shards:
+                out.update(sh.quarantined_replicas())
+            return out
         with self._cv:
             return dict(self.quarantined)
 
@@ -1891,6 +2174,9 @@ class ServingFleet:
         every = int(every)
         if every < 0:
             raise ValueError(f"every must be >= 0, got {every}")
+        if self._shards is not None:
+            return [a for sh in self._shards
+                    for a in sh.set_sampling(model, every, timeout)]
         with self._cv:
             if every > 0:
                 self._sampling[model] = every
@@ -1904,11 +2190,17 @@ class ServingFleet:
         (dicts with model/trace/X/scores/replica), called on rx threads.
         ``None`` unregisters.  Sink exceptions are contained (flight
         fault), not propagated into the rx loop."""
+        if self._shards is not None:
+            for sh in self._shards:
+                sh.set_feedback_sink(sink)
+            return
         with self._cv:
             self._feedback_sink = sink
 
     def sampling_rate(self, model: str) -> int:
         """The configured feedback-capture rate (0 = off)."""
+        if self._shards is not None:
+            return self._shards[0].sampling_rate(model)
         with self._cv:
             return self._sampling.get(model, 0)
 
@@ -1919,13 +2211,21 @@ class ServingFleet:
         here, so labels produced in another process/host join the same
         bounded symmetric join as in-process ones.  ``None``
         unregisters; sink exceptions are contained like feedback's."""
+        if self._shards is not None:
+            for sh in self._shards:
+                sh.set_label_sink(sink)
+            return
         with self._cv:
             self._label_sink = sink
 
     def label_endpoint(self) -> Tuple[str, int]:
         """(host, port) a label producer connects to — the fleet's frame
         listener.  Open the channel with :func:`wire.label_feed` and
-        stream labels with :func:`wire.send_label`."""
+        stream labels with :func:`wire.send_label`.  On a sharded fleet
+        this is shard 0's listener (every shard accepts label feeds and
+        the sink is fanned out, so any shard's endpoint works)."""
+        if self._shards is not None:
+            return self._shards[0].label_endpoint()
         if self._listener is None:
             raise RuntimeError("fleet not started: no listener yet")
         host, port = self._listener.getsockname()[:2]
@@ -1941,6 +2241,13 @@ class ServingFleet:
         if not 0.0 < fraction <= 1.0:
             raise ValueError(f"shadow fraction must be in (0, 1], "
                              f"got {fraction}")
+        if self._shards is not None:
+            # a model's traffic spans shards (tenant is part of the
+            # routing key): every shard mirrors its own slice, stats
+            # merge on read
+            for sh in self._shards:
+                sh.set_shadow(model, version, fraction)
+            return
         every = max(1, round(1.0 / fraction))
         with self._cv:
             self._shadow[model] = {
@@ -1964,10 +2271,35 @@ class ServingFleet:
                 "mean_cal": (sh["sum_cal"] / pairs) if pairs else 0.0,
                 "max_cal": sh["max_cal"]}
 
+    @staticmethod
+    def _merge_shadow_raw(raws: List[dict]) -> Optional[dict]:
+        """Fold per-shard shadow accumulators into one: sums add, maxes
+        max — the summary derives means from the folded sums."""
+        if not raws:
+            return None
+        out = dict(raws[0])
+        for r in raws[1:]:
+            for k in ("pairs", "failures", "sum_div", "sum_ks",
+                      "sum_psi", "sum_cal"):
+                out[k] += r[k]
+            for k in ("max_div", "max_ks", "max_psi", "max_cal"):
+                out[k] = max(out[k], r[k])
+        return out
+
     def clear_shadow(self, model: str) -> Optional[dict]:
         """Stop mirroring; returns the accumulated comparator stats
         (pairs, failures, mean/max divergence and KS) or None if never
-        set."""
+        set.  On a sharded fleet the per-shard accumulators merge into
+        one summary."""
+        if self._shards is not None:
+            raws = []
+            for shard in self._shards:
+                with shard._cv:
+                    raw = shard._shadow.pop(model, None)
+                if raw is not None:
+                    raws.append(raw)
+            merged = self._merge_shadow_raw(raws)
+            return None if merged is None else self._shadow_summary(merged)
         with self._cv:
             sh = self._shadow.pop(model, None)
         if sh is None:
@@ -1975,6 +2307,15 @@ class ServingFleet:
         return self._shadow_summary(sh)
 
     def shadow_stats(self, model: str) -> Optional[dict]:
+        if self._shards is not None:
+            raws = []
+            for shard in self._shards:
+                with shard._cv:
+                    raw = shard._shadow.get(model)
+                    if raw is not None:
+                        raws.append(dict(raw))
+            merged = self._merge_shadow_raw(raws)
+            return None if merged is None else self._shadow_summary(merged)
         with self._cv:
             sh = self._shadow.get(model)
             if sh is None:
@@ -2042,15 +2383,21 @@ class ServingFleet:
     def replica_info(self) -> List[dict]:
         """Ready-frame info per live replica (warmup_s, aot hit/compile
         counts, cache_state) — the cold-start telemetry."""
+        if self._shards is not None:
+            return [info for sh in self._shards for info in sh.replica_info()]
         with self._cv:
             return [dict(r.ready_info) for r in self._replicas.values()
                     if r.alive and r.ready_info]
 
     def alive_replicas(self) -> int:
+        if self._shards is not None:
+            return sum(sh.alive_replicas() for sh in self._shards)
         with self._cv:
             return sum(1 for r in self._replicas.values() if r.alive)
 
     def queue_depth(self) -> int:
+        if self._shards is not None:
+            return sum(sh.queue_depth() for sh in self._shards)
         with self._cv:
             return len(self._queue)
 
@@ -2073,6 +2420,20 @@ class ServingFleet:
                 return
             self._closed = True
             self._cv.notify_all()
+        if self._shards is not None:
+            # shards first (they own the sockets and subprocesses), in
+            # parallel — each is an independent single-shard fleet
+            ts = [threading.Thread(target=sh.close, daemon=True)
+                  for sh in self._shards]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if self._tmp_store and self._store_dir:
+                import shutil
+
+                shutil.rmtree(self._store_dir, ignore_errors=True)
+            return
         self._shutdown()
 
     def _shutdown(self) -> None:

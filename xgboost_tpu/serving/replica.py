@@ -323,7 +323,9 @@ def _serve_loop(sock, engine, fast: dict, store=None, warm=None,
     # pays nothing until the online loop turns it on)
     sample: dict = {}
     last_ship = last_scrub = time.monotonic()
-    stream = wire.reader(sock)  # one GIL event per frame, not three
+    # native rx path when available (xtb_wire.cc): ONE GIL release
+    # covers the whole frame read + CRC; pure-Python reader otherwise
+    stream = wire.reader(sock)
     while True:
         try:
             # peer=label lets fault plans shape this direction of the
@@ -585,6 +587,10 @@ def main(argv=None) -> int:
         "cache_state": ("warm" if n_hits and not n_compiled
                         else "partial" if n_hits else "cold"),
         "backend": jax.default_backend(),
+        # sharded fleets prefix labels with "s{k}:" — surfacing the
+        # shard here lets replica_info() rows identify their owner
+        "shard": (args.label.split(":", 1)[0]
+                  if ":" in args.label else ""),
     })
 
     ship_telemetry(sock, args.label)  # baseline snapshot before traffic

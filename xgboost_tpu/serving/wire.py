@@ -51,7 +51,9 @@ slot indefinitely (it gets ``budget_s`` total, not per read).
 """
 from __future__ import annotations
 
+import ctypes
 import json
+import os
 import socket
 import struct
 import time
@@ -204,6 +206,45 @@ def send_frame(sock: socket.socket, header: dict,
         sock.sendall(body)
 
 
+_NATIVE = None  # None = unresolved; False = disabled/unavailable; CDLL = ready
+
+
+def _native_lib():
+    """The native rx library (native/xtb_wire.cc via utils/native), or
+    None for the pure-Python frame path.  ``XGBOOST_TPU_WIRE_NATIVE=0``
+    is the kill switch (default on when the library loads); resolved
+    once per process."""
+    global _NATIVE
+    if _NATIVE is None:
+        if os.environ.get("XGBOOST_TPU_WIRE_NATIVE", "1").strip().lower() \
+                in ("", "0", "false", "off", "no"):
+            _NATIVE = False
+        else:
+            from ..utils.native import load_wire
+
+            _NATIVE = load_wire() or False
+    return _NATIVE or None
+
+
+class _NativeReader:
+    """Frame source backed by libxtb_wire: :func:`recv_frame` reads the
+    whole frame — prefix, header, payload, CRC verify — in two native
+    calls (one GIL release each) instead of per-chunk interpreter reads.
+    Under a sharded dispatcher the GIL *reacquire* per read is the
+    convoy cost this removes; the thread takes the GIL back only to
+    JSON-decode the tiny header.  Only created for sockets in plain
+    blocking mode at a frame boundary; the socket stays owned by the
+    caller."""
+    __slots__ = ("sock", "fd")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock  # keeps the fd alive for the reader's lifetime
+        self.fd = sock.fileno()
+
+    def close(self) -> None:
+        self.sock = None
+
+
 def reader(sock: socket.socket):
     """Buffered frame source for a long-lived fleet connection.  A frame
     is 3+ reads (prefix, header, payload); on a raw socket each is a
@@ -212,7 +253,16 @@ def reader(sock: socket.socket):
     ~ms under convoy).  A ``BufferedReader`` usually serves the prefix
     and header out of the buffer: one GIL event per frame instead of
     three.  Safe to create any time the stream is at a frame boundary
-    (``makefile`` shares the fd — no dup, no double-buffering)."""
+    (``makefile`` shares the fd — no dup, no double-buffering).
+
+    When the native wire library is available (utils/native.load_wire;
+    ``XGBOOST_TPU_WIRE_NATIVE=0`` forces it off) and the socket is in
+    plain blocking mode, the source is a :class:`_NativeReader` instead:
+    one GIL release covers the whole frame read and the CRC verify,
+    under the identical frame contract (bounds, cumulative slow-loris
+    budget, CRC semantics, fault seams stay Python-side)."""
+    if _native_lib() is not None and sock.gettimeout() is None:
+        return _NativeReader(sock)
     return sock.makefile("rb", buffering=1 << 16)
 
 
@@ -260,6 +310,8 @@ def recv_frame(stream, *, budget_s: Optional[float] = None,
     it — the half-open link's inbound side."""
     from ..reliability import faults as _faults
 
+    if isinstance(stream, _NativeReader):
+        return _recv_frame_native(stream, budget_s=budget_s, peer=peer)
     while True:
         spec = _faults.maybe_inject("wire.recv", rank=peer)
         first = _recv_exact(stream, 1)
@@ -297,6 +349,80 @@ def recv_frame(stream, *, budget_s: Optional[float] = None,
             raise WireError(f"frame header is {type(header).__name__}, "
                             "expected a JSON object")
         return header, payload
+
+
+def _native_raise(rc: int, what: str) -> None:
+    """Map a libxtb_wire return code onto the same WireError taxonomy the
+    Python reader raises (CRC handled at the call site — it also bumps
+    the integrity counter)."""
+    if rc in (1, -1):
+        raise WireError("connection closed mid-frame")
+    if rc == -2:
+        raise WireError(
+            f"frame {what} read exceeded its cumulative deadline "
+            "(slow-loris bound)")
+    raise WireError(f"socket read failed during frame {what} (rc={rc})")
+
+
+def _recv_frame_native(rd: "_NativeReader", *,
+                       budget_s: Optional[float] = None,
+                       peer: Optional[Any] = None) -> Tuple[dict, memoryview]:
+    """:func:`recv_frame` over a :class:`_NativeReader`: the byte loop
+    (prefix read, body read, CRC) runs in libxtb_wire under ONE GIL
+    release per call; every policy decision — length bounds, the
+    ``wire.recv`` fault seam with its blackhole re-loop, corruption
+    accounting, error classification — stays here so both paths are
+    observably identical."""
+    from ..reliability import faults as _faults
+
+    lib = _native_lib()
+    while True:
+        spec = _faults.maybe_inject("wire.recv", rank=peer)
+        hlen = ctypes.c_uint()
+        plen = ctypes.c_ulonglong()
+        crc = ctypes.c_uint()
+        deadline = ctypes.c_double()
+        rc = lib.xtb_wire_read_prefix(
+            rd.fd, float(budget_s) if budget_s is not None else 0.0,
+            ctypes.byref(hlen), ctypes.byref(plen), ctypes.byref(crc),
+            ctypes.byref(deadline))
+        if rc != 0:
+            _native_raise(rc, "prefix")
+        hl, pl = int(hlen.value), int(plen.value)
+        if hl > MAX_HEADER:
+            raise WireError(f"unreasonable header length {hl}")
+        if pl > MAX_PAYLOAD:
+            raise WireError(f"unreasonable payload length {pl}")
+        buf = bytearray(hl + pl)
+        rc = lib.xtb_wire_read_body(
+            rd.fd, (ctypes.c_ubyte * len(buf)).from_buffer(buf), len(buf),
+            deadline.value, crc.value)
+        if rc == -6:
+            from ..reliability import integrity as _integrity
+
+            _integrity.corrupt_detected("wire")
+            raise WireCorruptError(
+                f"frame CRC mismatch ({hl}B header, {pl}B payload): "
+                "corrupted in transit — quarantining the connection")
+        if rc != 0:
+            _native_raise(rc, "body")
+        if spec is not None and (
+                spec.kind == "blackhole_rx"
+                or (spec.kind == "partition"
+                    and _faults.partition_blocks(spec, peer))):
+            # half-open link, inbound side — same contract as the Python
+            # reader: the frame was consumed, the application never sees
+            # it, the connection stays alive and silent
+            continue
+        view = memoryview(buf)
+        try:
+            header = json.loads(bytes(view[:hl]))
+        except ValueError as e:
+            raise WireError(f"undecodable frame header: {e}") from e
+        if not isinstance(header, dict):
+            raise WireError(f"frame header is {type(header).__name__}, "
+                            "expected a JSON object")
+        return header, view[hl:]
 
 
 # ---------------------------------------------------------------- encoding

@@ -446,6 +446,51 @@ def ffi_usable() -> bool:
     return not FFI_DISTRIBUTED_VETO and load_ffi()
 
 
+_WIRE_LIB = None
+_WIRE_TRIED = False
+
+
+def load_wire() -> Optional[ctypes.CDLL]:
+    """The fleet wire rx library (native/xtb_wire.cc): one GIL release
+    covers a whole frame read + CRC verify on serving sockets.  Same
+    auto-build / graceful-None contract as :func:`load_native`;
+    serving/wire.py keeps its pure-Python reader when this returns None,
+    so the wire contract never depends on a toolchain."""
+    global _WIRE_LIB, _WIRE_TRIED
+    if _WIRE_LIB is not None or _WIRE_TRIED:
+        return _WIRE_LIB
+    _WIRE_TRIED = True
+    nd = _native_dir()
+    so = os.path.join(nd, "libxtb_wire.so")
+    src = os.path.join(nd, "xtb_wire.cc")
+    stale = (not os.path.exists(so)
+             or (os.path.exists(src)
+                 and os.path.getmtime(src) > os.path.getmtime(so)))
+    if stale:
+        try:
+            subprocess.run(["make", "-C", nd, "wire"], capture_output=True,
+                           timeout=120, check=True)
+        except Exception:
+            if not os.path.exists(so):
+                return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    c = ctypes
+    lib.xtb_wire_read_prefix.restype = c.c_int
+    lib.xtb_wire_read_prefix.argtypes = [
+        c.c_int, c.c_double, c.POINTER(c.c_uint), c.POINTER(c.c_ulonglong),
+        c.POINTER(c.c_uint), c.POINTER(c.c_double)]
+    lib.xtb_wire_read_body.restype = c.c_int
+    lib.xtb_wire_read_body.argtypes = [
+        c.c_int, c.c_void_p, c.c_ulonglong, c.c_double, c.c_uint]
+    lib.xtb_wire_crc32.restype = c.c_uint
+    lib.xtb_wire_crc32.argtypes = [c.c_uint, c.c_void_p, c.c_ulonglong]
+    _WIRE_LIB = lib
+    return lib
+
+
 def parse_libsvm(path: str):
     """Parse a libsvm file -> (indptr, indices, values, labels, qid|None, n_col).
 
